@@ -1,0 +1,27 @@
+"""pilosa_tpu — a TPU-native bitmap-index database framework.
+
+A brand-new implementation of the capabilities of FeatureBase/Pilosa
+(reference: github.com/featurebasedb/featurebase; structural analysis in
+SURVEY.md): roaring-style bitmap set algebra, bit-sliced-integer (BSI)
+fields, TopK/TopN, GroupBy, time-quantum views, key translation, PQL and
+SQL query languages — re-architected for TPUs:
+
+- per-shard hot loops (bitwise set algebra, popcounts, BSI plane walks)
+  are XLA/Pallas kernels over packed ``uint32`` lanes;
+- the reference's per-shard HTTP MapReduce fan-out (executor.go:6449)
+  becomes static shard placement on a ``jax.sharding.Mesh`` with ICI
+  collectives (psum / all_gather) as the reduce path;
+- host-side storage (RBF-style pages + WAL) feeds dense bitmap tiles
+  into HBM; the Python controller only plans and does I/O.
+"""
+
+from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXP, WORDS_PER_SHARD
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "SHARD_WIDTH",
+    "SHARD_WIDTH_EXP",
+    "WORDS_PER_SHARD",
+    "__version__",
+]

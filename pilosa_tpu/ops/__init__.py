@@ -1,0 +1,12 @@
+"""Device-side kernels: packed-bitmap set algebra, BSI plane math.
+
+These are the TPU equivalents of the reference's "kernel-grade" Go code:
+roaring container pairwise ops (roaring/roaring.go:927-1663), BSI plane
+walks (fragment.go:724-1305), and popcount loops.  Container
+polymorphism (array/run/bitmap) collapses on-device to dense packed
+``uint32`` lanes; sparse encodings live host-side in the storage layer.
+"""
+
+from pilosa_tpu.ops import bitmap, bsi
+
+__all__ = ["bitmap", "bsi"]

@@ -1,0 +1,195 @@
+"""Dense packed-bitmap kernels.
+
+A shard-row is one bit per column, packed LSB-first into ``uint32``
+words: column ``c`` lives at word ``c >> 5``, bit ``c & 31``.  A full
+2^20-column shard-row is ``uint32[32768]`` (128 KiB).  All ops are pure
+``jnp`` functions of arrays whose *last* axis is the word axis, so they
+vmap/broadcast over arbitrary leading batch axes (rows, shards) and jit
+cleanly onto the TPU VPU.
+
+Reference semantics covered here (behavior, not code):
+- pairwise set ops — roaring/roaring.go:927-1663 (intersect/union/
+  difference/xor for all container-type pairs collapse to single
+  bitwise ops on dense lanes);
+- Count/Any — roaring popcount paths (roaring/roaring.go:542);
+- CountRange / column-range masks — roaring/roaring.go:573;
+- Shift — roaring shift-by-1 used by PQL Shift() (executor.go Shift).
+
+Host-side packing helpers (numpy) mirror what the storage layer's
+container decoder produces for HBM upload.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from pilosa_tpu.shardwidth import BITS_PER_WORD, SHARD_WIDTH
+
+_WORD_DTYPE = jnp.uint32
+_NP_WORD_DTYPE = np.uint32
+
+
+# ---------------------------------------------------------------------------
+# Host-side packing helpers (numpy — used by storage/ingest/tests)
+# ---------------------------------------------------------------------------
+
+def empty(width: int = SHARD_WIDTH) -> np.ndarray:
+    """An all-zeros packed shard-row of `width` bits (width % 32 == 0)."""
+    assert width % BITS_PER_WORD == 0
+    return np.zeros(width // BITS_PER_WORD, dtype=_NP_WORD_DTYPE)
+
+
+def from_columns(cols, width: int = SHARD_WIDTH) -> np.ndarray:
+    """Pack a list/array of set column ids (< width) into words."""
+    words = empty(width)
+    cols = np.asarray(cols, dtype=np.int64)
+    if cols.size:
+        assert cols.min() >= 0 and cols.max() < width, "column id out of range"
+        np.bitwise_or.at(
+            words, cols >> 5, _NP_WORD_DTYPE(1) << (cols & 31).astype(_NP_WORD_DTYPE)
+        )
+    return words
+
+
+def to_columns(words) -> np.ndarray:
+    """Unpack a packed row back into a sorted array of set column ids."""
+    words = np.asarray(words, dtype=_NP_WORD_DTYPE)
+    # uint32 little-endian byte view -> unpackbits(bitorder little) gives
+    # bit i of word w at flat index w*32 + i, matching our LSB-first layout.
+    bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+    return np.nonzero(bits)[0].astype(np.uint64)
+
+
+def range_mask(start: int, end: int, width: int = SHARD_WIDTH) -> np.ndarray:
+    """Packed mask with bits set for columns in [start, end)."""
+    start = max(0, min(start, width))
+    end = max(start, min(end, width))
+    mask = empty(width)
+    sw, sb = start >> 5, start & 31
+    ew, eb = end >> 5, end & 31
+    if sw == ew:
+        if sb != eb:
+            mask[sw] = ((_NP_WORD_DTYPE(1) << (eb - sb)) - 1) << sb
+        return mask
+    mask[sw] = _NP_WORD_DTYPE(0xFFFFFFFF) << sb
+    mask[sw + 1 : ew] = 0xFFFFFFFF
+    if eb:
+        mask[ew] = (_NP_WORD_DTYPE(1) << eb) - 1
+    return mask
+
+
+# ---------------------------------------------------------------------------
+# Device-side ops (jnp — jit/vmap/shard_map friendly)
+# ---------------------------------------------------------------------------
+
+def intersect(a, b):
+    return jnp.bitwise_and(a, b)
+
+
+def union(a, b):
+    return jnp.bitwise_or(a, b)
+
+
+def difference(a, b):
+    """a AND NOT b."""
+    return jnp.bitwise_and(a, jnp.bitwise_not(b))
+
+
+def xor(a, b):
+    return jnp.bitwise_xor(a, b)
+
+
+def complement(a):
+    """Bitwise NOT over the full shard width.
+
+    PQL ``Not()`` is existence-relative (executor.go executeNotShard);
+    the executor composes this with the existence row via difference().
+    """
+    return jnp.bitwise_not(a)
+
+
+def popcount_words(words):
+    """Per-word popcount (uint32 -> int32 counts 0..32)."""
+    return jax.lax.population_count(words).astype(jnp.int32)
+
+
+def count(words):
+    """Number of set bits, reduced over the last (word) axis -> int32.
+
+    Per-shard counts are < 2^20 so int32 is exact; cross-shard totals
+    are combined in int64/Python on the host (SURVEY §7 "Exactness").
+    """
+    return jnp.sum(popcount_words(words), axis=-1)
+
+
+def any_set(words):
+    """True if any bit is set (last axis)."""
+    return jnp.any(words != 0, axis=-1)
+
+
+def intersection_count(a, b):
+    """popcount(a & b) without materializing the intersection separately.
+
+    Mirrors roaring.IntersectionCount (roaring/roaring.go:711); XLA fuses
+    the AND into the popcount-reduce so this is one pass over HBM.
+    """
+    return count(jnp.bitwise_and(a, b))
+
+
+def shift(words, n: int = 1):
+    """Shift all bits toward higher column ids by static n (zero fill).
+
+    Column c becomes column c+n; bits shifted past the end are dropped.
+    Reference: PQL Shift() -> executor.executeShiftShard -> Row.Shift.
+    """
+    if n == 0:
+        return words
+    assert n > 0
+    q, r = divmod(n, BITS_PER_WORD)
+    w = words.shape[-1]
+    zeros_shape = words.shape[:-1] + (min(q + 1, w),)
+    zpad = jnp.zeros(zeros_shape, dtype=words.dtype)
+    if q:
+        if q >= w:
+            return jnp.zeros_like(words)
+        words_q = jnp.concatenate(
+            [zpad[..., : q], words[..., : w - q]], axis=-1)
+    else:
+        words_q = words
+    if r == 0:
+        return words_q
+    # carry bits across word boundaries
+    prev = jnp.concatenate([zpad[..., :1], words_q[..., : w - 1]], axis=-1)
+    return (words_q << np.uint32(r)) | (prev >> np.uint32(BITS_PER_WORD - r))
+
+
+def count_range(words, start: int, end: int, width: int | None = None):
+    """Count of set bits with column id in [start, end) (static bounds).
+
+    Mirrors roaring CountRange (roaring/roaring.go:573).  The mask is a
+    host-built constant captured by jit, so on device this is a fused
+    AND + popcount-reduce.
+    """
+    if width is None:
+        width = words.shape[-1] * BITS_PER_WORD
+    mask = jnp.asarray(range_mask(start, end, width))
+    return count(jnp.bitwise_and(words, mask))
+
+
+def column_bit(col: int, width: int = SHARD_WIDTH) -> np.ndarray:
+    """Packed row with exactly one column set (host helper)."""
+    return from_columns([col], width)
+
+
+# Multi-row folds -----------------------------------------------------------
+
+def union_rows(rows):
+    """OR-fold over axis 0: rows (R, W) -> (W,). Used by Rows/GroupBy paths."""
+    return jnp.bitwise_or.reduce(rows, axis=0)
+
+
+def intersect_rows(rows):
+    """AND-fold over axis 0."""
+    return jnp.bitwise_and.reduce(rows, axis=0)

@@ -1,0 +1,22 @@
+"""Shard-width constants.
+
+The reference fixes shards at 2^20 columns (shardwidth/helper.go:14,
+``Exponent = 20``).  We keep the same width so data layouts and query
+semantics line up, and derive the packed-word geometry used by the
+device kernels: a shard-row is one bit per column packed LSB-first into
+``uint32`` words, i.e. ``2^20 / 32 = 32768`` words = 128 KiB — which is
+256 TPU lanes x 128 sublanes, a natural VPU tile.
+"""
+
+SHARD_WIDTH_EXP = 20
+SHARD_WIDTH = 1 << SHARD_WIDTH_EXP  # 1,048,576 columns per shard
+
+BITS_PER_WORD = 32
+WORDS_PER_SHARD = SHARD_WIDTH // BITS_PER_WORD  # 32,768 uint32 words
+
+# BSI row layout within a bsiGroup view (fragment.go:34-66): row 0 is the
+# not-null/exists bit, row 1 the sign bit, rows 2.. the magnitude bits
+# (LSB first).
+BSI_EXISTS_BIT = 0
+BSI_SIGN_BIT = 1
+BSI_OFFSET_BIT = 2

@@ -1,0 +1,25 @@
+"""Test config: run JAX on a virtual 8-device CPU mesh.
+
+This is the analog of the reference's in-process multi-node cluster
+harness (test/cluster.go:31 MustRunCluster): instead of N server
+processes with embedded etcd, we get N XLA host devices so every
+sharding/collective path compiles and runs in one process.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0x5EED)

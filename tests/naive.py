@@ -1,0 +1,49 @@
+"""Naive pure-Python/numpy reference implementations.
+
+The reference cross-checks its roaring kernels against deliberately
+simple implementations (roaring/naive.go:1-309); these play the same
+role for the packed-bitmap and BSI device kernels.  Everything here
+works on plain Python sets / dicts of exact ints.
+"""
+
+from __future__ import annotations
+
+
+def naive_range(values: dict[int, int], op: str, a: int, b: int | None = None):
+    """Columns (set) matching a comparison over {col: value}."""
+    if op == "eq":
+        return {c for c, v in values.items() if v == a}
+    if op == "neq":
+        return {c for c, v in values.items() if v != a}
+    if op == "lt":
+        return {c for c, v in values.items() if v < a}
+    if op == "lte":
+        return {c for c, v in values.items() if v <= a}
+    if op == "gt":
+        return {c for c, v in values.items() if v > a}
+    if op == "gte":
+        return {c for c, v in values.items() if v >= a}
+    if op == "between":
+        return {c for c, v in values.items() if a <= v <= b}
+    raise ValueError(op)
+
+
+def naive_sum(values: dict[int, int], filter_cols=None):
+    cols = values.keys() if filter_cols is None else values.keys() & filter_cols
+    return sum(values[c] for c in cols), len(cols)
+
+
+def naive_min(values: dict[int, int], filter_cols=None):
+    cols = values.keys() if filter_cols is None else values.keys() & filter_cols
+    if not cols:
+        return 0, 0
+    m = min(values[c] for c in cols)
+    return m, sum(1 for c in cols if values[c] == m)
+
+
+def naive_max(values: dict[int, int], filter_cols=None):
+    cols = values.keys() if filter_cols is None else values.keys() & filter_cols
+    if not cols:
+        return 0, 0
+    m = max(values[c] for c in cols)
+    return m, sum(1 for c in cols if values[c] == m)

@@ -1,0 +1,105 @@
+"""Packed-bitmap kernel tests vs set-algebra ground truth.
+
+Mirrors the reference's container-op test approach (roaring tests vs
+naive.go) on a small shard width for speed.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from pilosa_tpu.ops import bitmap as bm
+
+W = 1 << 12  # small shard width for tests (bits); multiple of 32
+
+
+def randcols(rng, n, width=W):
+    return np.unique(rng.integers(0, width, size=n))
+
+
+def test_pack_roundtrip(rng):
+    cols = randcols(rng, 500)
+    words = bm.from_columns(cols, W)
+    assert words.shape == (W // 32,)
+    np.testing.assert_array_equal(bm.to_columns(words), cols.astype(np.uint64))
+
+
+def test_pack_empty():
+    words = bm.from_columns([], W)
+    assert bm.to_columns(words).size == 0
+    assert int(bm.count(jnp.asarray(words))) == 0
+    assert not bool(bm.any_set(jnp.asarray(words)))
+
+
+@pytest.mark.parametrize("opname,setop", [
+    ("intersect", lambda a, b: a & b),
+    ("union", lambda a, b: a | b),
+    ("difference", lambda a, b: a - b),
+    ("xor", lambda a, b: a ^ b),
+])
+def test_set_ops(rng, opname, setop):
+    a = set(randcols(rng, 700).tolist())
+    b = set(randcols(rng, 700).tolist())
+    wa = jnp.asarray(bm.from_columns(sorted(a), W))
+    wb = jnp.asarray(bm.from_columns(sorted(b), W))
+    got = getattr(bm, opname)(wa, wb)
+    expect = setop(a, b)
+    assert set(bm.to_columns(np.asarray(got)).tolist()) == expect
+    assert int(bm.count(got)) == len(expect)
+
+
+def test_complement_difference_full(rng):
+    a = set(randcols(rng, 300).tolist())
+    wa = jnp.asarray(bm.from_columns(sorted(a), W))
+    full = jnp.asarray(bm.from_columns(range(W), W))
+    got = bm.intersect(bm.complement(wa), full)
+    assert set(bm.to_columns(np.asarray(got)).tolist()) == set(range(W)) - a
+
+
+def test_intersection_count(rng):
+    a = set(randcols(rng, 900).tolist())
+    b = set(randcols(rng, 900).tolist())
+    wa = jnp.asarray(bm.from_columns(sorted(a), W))
+    wb = jnp.asarray(bm.from_columns(sorted(b), W))
+    assert int(bm.intersection_count(wa, wb)) == len(a & b)
+
+
+@pytest.mark.parametrize("n", [1, 7, 31, 32, 33, 64, 100, W - 1, W, W + 5])
+def test_shift(rng, n):
+    a = randcols(rng, 200).tolist()
+    wa = jnp.asarray(bm.from_columns(a, W))
+    got = bm.shift(wa, n)
+    expect = {c + n for c in a if c + n < W}
+    assert set(bm.to_columns(np.asarray(got)).tolist()) == expect
+
+
+def test_shift_zero(rng):
+    a = randcols(rng, 50).tolist()
+    wa = jnp.asarray(bm.from_columns(a, W))
+    np.testing.assert_array_equal(np.asarray(bm.shift(wa, 0)), np.asarray(wa))
+
+
+@pytest.mark.parametrize("start,end", [
+    (0, 0), (0, W), (5, 5), (0, 31), (0, 32), (1, 33), (31, 97),
+    (64, 128), (100, 2000), (W - 33, W), (W - 1, W),
+])
+def test_count_range_and_mask(rng, start, end):
+    a = randcols(rng, 800).tolist()
+    wa = jnp.asarray(bm.from_columns(a, W))
+    expect = sum(1 for c in a if start <= c < end)
+    assert int(bm.count_range(wa, start, end)) == expect
+    mask = bm.range_mask(start, end, W)
+    assert set(bm.to_columns(mask).tolist()) == set(range(start, end))
+
+
+def test_batched_ops(rng):
+    """Ops broadcast over a leading row axis — the vmap-free batch path."""
+    rows = [set(randcols(rng, 300).tolist()) for _ in range(6)]
+    stack = jnp.asarray(
+        np.stack([bm.from_columns(sorted(r), W) for r in rows]))
+    counts = np.asarray(bm.count(stack))
+    assert counts.tolist() == [len(r) for r in rows]
+    u = bm.union_rows(stack)
+    assert set(bm.to_columns(np.asarray(u)).tolist()) == set().union(*rows)
+    i = bm.intersect_rows(stack)
+    assert set(bm.to_columns(np.asarray(i)).tolist()) == set.intersection(*rows)

@@ -1,0 +1,175 @@
+"""BSI kernel tests vs exact naive implementations.
+
+Covers the semantics of fragment.go rangeOp/rangeBetween/sum/min/max
+(fragment.go:718-1305) including negatives (sign-magnitude), zero,
+depth-edge predicates, filters, and >2^53 sums.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from pilosa_tpu.ops import bitmap as bm
+from pilosa_tpu.ops import bsi
+from tests.naive import naive_max, naive_min, naive_range, naive_sum
+
+W = 1 << 12
+
+
+def make_values(rng, n=400, lo=-1000, hi=1000, width=W):
+    cols = np.unique(rng.integers(0, width, size=n))
+    vals = rng.integers(lo, hi + 1, size=cols.size)
+    return {int(c): int(v) for c, v in zip(cols, vals)}
+
+
+def encode(values, depth=None):
+    cols = sorted(values)
+    return bsi.encode(cols, [values[c] for c in cols], depth=depth, width=W)
+
+
+def cols_of(words):
+    return set(bm.to_columns(np.asarray(words)).tolist())
+
+
+def run_cmp(values, op, pred, pred2=None, depth=None):
+    # depth must cover both stored magnitudes and predicate magnitudes:
+    # the executor widens/short-circuits out-of-range predicates at plan
+    # time; the kernels require predicates that fit (predicate_masks
+    # asserts this).
+    preds = [pred] + ([pred2] if pred2 is not None else [])
+    need = max([abs(v) for v in values.values()] + [abs(p) for p in preds] + [1])
+    d = max(depth or 1, need.bit_length())
+    planes = jnp.asarray(encode(values, depth=d))
+    if op == "between":
+        a, b = pred, pred2
+        abits = jnp.asarray(bsi.predicate_masks(abs(a), d))
+        bbits = jnp.asarray(bsi.predicate_masks(abs(b), d))
+        return bsi.range_between(planes, abits, bbits,
+                                 jnp.asarray(a < 0), jnp.asarray(b < 0))
+    pbits = jnp.asarray(bsi.predicate_masks(abs(pred), d))
+    neg = jnp.asarray(pred < 0)
+    if op == "eq":
+        return bsi.range_eq(planes, pbits, neg)
+    if op == "neq":
+        return bsi.range_neq(planes, pbits, neg)
+    if op in ("lt", "lte"):
+        return bsi.range_lt(planes, pbits, neg, allow_eq=(op == "lte"))
+    if op in ("gt", "gte"):
+        return bsi.range_gt(planes, pbits, neg, allow_eq=(op == "gte"))
+    raise ValueError(op)
+
+
+def test_encode_decode_roundtrip(rng):
+    values = make_values(rng)
+    cols, vals = bsi.decode(encode(values))
+    assert {int(c): v for c, v in zip(cols, vals)} == values
+
+
+@pytest.mark.parametrize("op", ["eq", "neq", "lt", "lte", "gt", "gte"])
+@pytest.mark.parametrize("pred", [-1000, -500, -17, -1, 0, 1, 3, 17, 500, 999])
+def test_range_ops(rng, op, pred):
+    values = make_values(rng)
+    got = cols_of(run_cmp(values, op, pred))
+    assert got == naive_range(values, op, pred), (op, pred)
+
+
+@pytest.mark.parametrize("op,pred", [
+    ("eq", 1023), ("lt", 1023), ("lte", 1023), ("gt", 1023), ("gte", 1023),
+    ("lt", -1023), ("gt", -1023), ("eq", -1023),
+])
+def test_range_depth_edges(rng, op, pred):
+    # predicate at the very top of the representable magnitude range
+    values = make_values(rng, lo=-1023, hi=1023)
+    got = cols_of(run_cmp(values, op, pred, depth=10))
+    assert got == naive_range(values, op, pred)
+
+
+@pytest.mark.parametrize("a,b", [
+    (-100, 100), (0, 0), (-1, 1), (10, 500), (-500, -10), (-3, -3),
+    (7, 7), (0, 999), (-999, 0), (-999, 999), (100, -100), (1, 0),
+])
+def test_between(rng, a, b):
+    values = make_values(rng)
+    got = cols_of(run_cmp(values, "between", a, b))
+    assert got == naive_range(values, "between", a, b)
+
+
+def test_positive_only(rng):
+    values = make_values(rng, lo=0, hi=255)
+    for op, pred in [("lt", 100), ("gte", 0), ("gt", 0), ("eq", 0),
+                     ("lte", 255), ("between", (0, 255))]:
+        if op == "between":
+            got = cols_of(run_cmp(values, op, *pred))
+            assert got == naive_range(values, op, *pred)
+        else:
+            got = cols_of(run_cmp(values, op, pred))
+            assert got == naive_range(values, op, pred)
+
+
+def test_sum(rng):
+    values = make_values(rng)
+    out = bsi.sum_counts(jnp.asarray(encode(values)))
+    s, c = bsi.host_sum(*out)
+    assert (s, c) == naive_sum(values)
+
+
+def test_sum_filtered(rng):
+    values = make_values(rng)
+    filt_cols = set(list(values)[::3]) | {1, 2, 3}
+    filt = jnp.asarray(bm.from_columns(sorted(filt_cols), W))
+    out = bsi.sum_counts(jnp.asarray(encode(values)), filt)
+    s, c = bsi.host_sum(*out)
+    assert (s, c) == naive_sum(values, filt_cols)
+
+
+def test_sum_exact_beyond_2_53():
+    # 3 columns of 2^60 — float64 would lose exactness, host ints don't.
+    values = {5: 1 << 60, 77: 1 << 60, 99: (1 << 60) + 7}
+    out = bsi.sum_counts(jnp.asarray(encode(values)))
+    s, c = bsi.host_sum(*out)
+    assert (s, c) == (3 * (1 << 60) + 7, 3)
+
+
+@pytest.mark.parametrize("lo,hi", [(-1000, 1000), (-50, -1), (1, 50), (0, 0)])
+def test_min_max(rng, lo, hi):
+    values = make_values(rng, lo=lo, hi=hi)
+    planes = jnp.asarray(encode(values))
+    assert bsi.host_minmax(*bsi.min_op(planes)) == naive_min(values)
+    assert bsi.host_minmax(*bsi.max_op(planes)) == naive_max(values)
+
+
+def test_min_max_filtered(rng):
+    values = make_values(rng)
+    filt_cols = set(list(values)[:20])
+    filt = jnp.asarray(bm.from_columns(sorted(filt_cols), W))
+    assert bsi.host_minmax(
+        *bsi.min_op(jnp.asarray(encode(values)), filt)) == naive_min(values, filt_cols)
+    assert bsi.host_minmax(
+        *bsi.max_op(jnp.asarray(encode(values)), filt)) == naive_max(values, filt_cols)
+
+
+def test_min_max_empty():
+    planes = jnp.asarray(bsi.encode([], [], depth=4, width=W))
+    assert bsi.host_minmax(*bsi.min_op(planes)) == (0, 0)
+    assert bsi.host_minmax(*bsi.max_op(planes)) == (0, 0)
+
+
+def test_encode_depth_too_small_raises():
+    with pytest.raises(ValueError):
+        bsi.encode([0], [16], depth=4, width=W)
+
+
+def test_encode_int64_min_magnitude():
+    v = -(1 << 63)  # int64 min: magnitude 2^63 needs depth 64
+    planes = bsi.encode([3], [v], width=W)
+    assert planes.shape[0] == 2 + 64
+    cols, vals = bsi.decode(planes)
+    assert cols.tolist() == [3] and vals == [v]
+
+
+def test_range_no_values_out_of_scope(rng):
+    # values only exist where the exists-plane says so: neq(x) never
+    # returns non-existent columns.
+    values = {10: 5, 20: -5}
+    got = cols_of(run_cmp(values, "neq", 999))
+    assert got == {10, 20}
