@@ -1,0 +1,20 @@
+"""Query executor: PQL call-tree → per-shard device kernels → reduce.
+
+The analog of the reference's executor.go: translate → dispatch →
+map over shards → reduce.  Shard fan-out here is a device-mesh
+placement (parallel/) instead of HTTP mapReduce.
+"""
+
+from pilosa_tpu.executor.results import (
+    DistinctValues,
+    GroupCount,
+    Pair,
+    RowResult,
+    ValCount,
+)
+from pilosa_tpu.executor.executor import Executor
+
+__all__ = [
+    "Executor", "RowResult", "ValCount", "DistinctValues", "Pair",
+    "GroupCount",
+]

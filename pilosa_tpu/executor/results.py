@@ -1,0 +1,107 @@
+"""Query result types.
+
+Python analogs of the reference result shapes: Row (row.go:15 —
+per-shard segments), ValCount (executor.go:8345), SignedRow-style
+distinct values, Pair/PairsField (TopN), GroupCounts (executor.go:3553).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from pilosa_tpu.ops import bitmap as bm
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+class RowResult:
+    """A set of columns, stored as per-shard packed segments."""
+
+    def __init__(self, width: int = SHARD_WIDTH):
+        self.width = width
+        self.segments: dict[int, np.ndarray] = {}
+        self.keys: list[str] | None = None  # set by key translation
+
+    @classmethod
+    def from_segments(cls, segs: dict[int, np.ndarray],
+                      width: int = SHARD_WIDTH) -> "RowResult":
+        r = cls(width)
+        r.segments = {int(s): np.asarray(w, dtype=np.uint32)
+                      for s, w in segs.items()}
+        return r
+
+    @classmethod
+    def from_columns(cls, cols, width: int = SHARD_WIDTH) -> "RowResult":
+        r = cls(width)
+        cols = np.asarray(sorted(int(c) for c in cols), dtype=np.int64)
+        if cols.size:
+            shards = cols // width
+            for s in np.unique(shards):
+                r.segments[int(s)] = bm.from_columns(
+                    cols[shards == s] % width, width)
+        return r
+
+    def columns(self) -> np.ndarray:
+        """Materialize absolute column ids (shard*width + col)."""
+        parts = []
+        for s in sorted(self.segments):
+            cols = bm.to_columns(self.segments[s])
+            if cols.size:
+                parts.append(cols.astype(np.int64) + s * self.width)
+        return np.concatenate(parts) if parts else np.array([], dtype=np.int64)
+
+    def count(self) -> int:
+        return int(sum(np.bitwise_count(w).sum()
+                       for w in self.segments.values()))
+
+    def any(self) -> bool:
+        return any(w.any() for w in self.segments.values())
+
+    def shard_words(self, shard: int) -> np.ndarray:
+        w = self.segments.get(shard)
+        return w if w is not None else bm.empty(self.width)
+
+    def __eq__(self, other):
+        if not isinstance(other, RowResult):
+            return NotImplemented
+        return np.array_equal(self.columns(), other.columns())
+
+    def __repr__(self):
+        cols = self.columns()
+        preview = cols[:10].tolist()
+        suffix = "..." if cols.size > 10 else ""
+        return f"RowResult({preview}{suffix}, n={cols.size})"
+
+
+@dataclass
+class ValCount:
+    """Sum/Min/Max/Percentile result (executor.go ValCount): the value
+    in field units (int, float for decimal, datetime for timestamp)
+    plus the count of columns contributing."""
+    value: Any = None
+    count: int = 0
+
+
+@dataclass
+class DistinctValues:
+    """Distinct over a BSI field (reference SignedRow executor.go:8225):
+    the sorted distinct values."""
+    values: list = field(default_factory=list)
+
+
+@dataclass
+class Pair:
+    """TopN/TopK entry (cache.go:374 Pair): row id + count."""
+    id: int = 0
+    key: str | None = None
+    count: int = 0
+
+
+@dataclass
+class GroupCount:
+    """One GroupBy result group (executor.go GroupCount)."""
+    group: list[dict]  # [{"field":..., "row_id":... or "value":...}, ...]
+    count: int = 0
+    agg: Any = None

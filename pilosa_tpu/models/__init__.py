@@ -1,0 +1,20 @@
+"""Data model: Holder → Index → Field → View → Fragment.
+
+The same containment hierarchy as the reference (holder.go:58,
+index.go:27, field.go:73, view.go:36, fragment.go:84), as light Python
+metadata objects.  A fragment is the data-plane unit — one bitmap per
+(field, view, shard) keyed ``row*SHARD_WIDTH + col`` — holding packed
+host rows plus a device-tile cache that feeds the XLA kernels.
+"""
+
+from pilosa_tpu.models.schema import FieldOptions, FieldType, TimeQuantum
+from pilosa_tpu.models.fragment import Fragment
+from pilosa_tpu.models.view import View, VIEW_STANDARD, VIEW_BSI_PREFIX
+from pilosa_tpu.models.field import Field
+from pilosa_tpu.models.index import Index
+from pilosa_tpu.models.holder import Holder
+
+__all__ = [
+    "FieldOptions", "FieldType", "TimeQuantum", "Fragment", "View",
+    "VIEW_STANDARD", "VIEW_BSI_PREFIX", "Field", "Index", "Holder",
+]

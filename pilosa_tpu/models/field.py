@@ -1,0 +1,243 @@
+"""Field — a typed column of the index (field.go:73).
+
+Types: set, int, time, mutex, bool, decimal, timestamp
+(field.go:43-49).  Set-like types write rows into the standard view
+(plus time-quantum views for time fields); BSI types (int, decimal,
+timestamp) write sign-magnitude bit-planes into a ``bsig_<field>``
+view.  Mutex enforces one row per column on write; bool is a 2-row
+mutex (false=0, true=1).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import threading
+
+import numpy as np
+
+from pilosa_tpu.models import timeq
+from pilosa_tpu.models.schema import FieldOptions, FieldType
+from pilosa_tpu.models.view import (
+    VIEW_STANDARD,
+    View,
+    bsi_view_name,
+)
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+FALSE_ROW, TRUE_ROW = 0, 1  # bool field rows (field.go falseRowID/trueRowID)
+
+
+class Field:
+    def __init__(self, index: str, name: str, options: FieldOptions | None = None,
+                 width: int = SHARD_WIDTH):
+        self.index_name = index
+        self.name = name
+        self.options = options or FieldOptions()
+        self.width = width
+        self.views: dict[str, View] = {}
+        self._lock = threading.RLock()
+        # BSI depth grows with observed magnitudes (bsiGroup, field.go:2394)
+        if self.options.type.is_bsi:
+            lo, hi = self.options.min, self.options.max
+            if lo is not None and hi is not None:
+                from pilosa_tpu.ops.bsi import depth_for_range
+                self.bit_depth = depth_for_range(lo, hi)
+            else:
+                self.bit_depth = 1
+        else:
+            self.bit_depth = 0
+        self._min_seen: int | None = None
+        self._max_seen: int | None = None
+
+    # -- views --------------------------------------------------------------
+
+    def view(self, name: str, create: bool = False) -> View | None:
+        with self._lock:
+            v = self.views.get(name)
+            if v is None and create:
+                v = View(self.index_name, self.name, name, self.width)
+                self.views[name] = v
+            return v
+
+    @property
+    def bsi_view(self) -> str:
+        return bsi_view_name(self.name)
+
+    @property
+    def available_shards(self) -> set[int]:
+        s: set[int] = set()
+        for v in self.views.values():
+            s.update(v.fragments)
+        return s
+
+    # -- scaling / conversion for typed values ------------------------------
+
+    def value_to_int(self, value) -> int:
+        """Convert a user value to the stored BSI integer."""
+        t = self.options.type
+        if t == FieldType.DECIMAL:
+            from decimal import Decimal
+            from fractions import Fraction
+            if isinstance(value, (str, float)):
+                value = Decimal(str(value))
+            # exact scaling; inputs finer than the scale round half-even
+            scaled = Fraction(value) * (10 ** self.options.scale)
+            return round(scaled)
+        if t == FieldType.TIMESTAMP:
+            if isinstance(value, str):
+                value = timeq.parse_time(value)
+            if isinstance(value, dt.datetime):
+                return self.options.timestamp_to_int(value)
+            return int(value)
+        return int(value)
+
+    def int_to_value(self, v: int):
+        t = self.options.type
+        if t == FieldType.DECIMAL:
+            return v / (10 ** self.options.scale)
+        if t == FieldType.TIMESTAMP:
+            return self.options.int_to_timestamp(v)
+        return v
+
+    def _grow_depth(self, magnitude: int):
+        need = max(1, int(magnitude).bit_length())
+        if need > self.bit_depth:
+            self.bit_depth = need
+
+    # -- writes -------------------------------------------------------------
+
+    def set_bit(self, row: int, col: int,
+                timestamp: dt.datetime | None = None) -> bool:
+        """Set (row, col); routes to standard + time-quantum views."""
+        t = self.options.type
+        if t == FieldType.BOOL and row not in (FALSE_ROW, TRUE_ROW):
+            raise ValueError("bool field rows must be 0 or 1")
+        shard = col // self.width
+        shard_col = col % self.width
+        changed = False
+        view_names = [VIEW_STANDARD]
+        if t == FieldType.TIME and timestamp is not None:
+            view_names += timeq.views_by_time(
+                VIEW_STANDARD, timestamp, self.options.time_quantum)
+        for vn in view_names:
+            frag = self.view(vn, create=True).fragment(shard, create=True)
+            if t in (FieldType.MUTEX, FieldType.BOOL):
+                for other in frag.row_ids:
+                    if other != row:
+                        frag.clear_bit(other, shard_col)
+            changed |= frag.set_bit(row, shard_col)
+        return changed
+
+    def clear_bit(self, row: int, col: int) -> bool:
+        shard, shard_col = divmod(col, self.width)
+        changed = False
+        for v in self.views.values():
+            frag = v.fragment(shard)
+            if frag is not None:
+                changed |= frag.clear_bit(row, shard_col)
+        return changed
+
+    def set_value(self, col: int, value) -> bool:
+        iv = self.value_to_int(value)
+        self._grow_depth(abs(iv))
+        self._min_seen = iv if self._min_seen is None else min(self._min_seen, iv)
+        self._max_seen = iv if self._max_seen is None else max(self._max_seen, iv)
+        shard, shard_col = divmod(col, self.width)
+        frag = self.view(self.bsi_view, create=True).fragment(shard, create=True)
+        return frag.set_value(shard_col, self.bit_depth, iv)
+
+    def clear_value(self, col: int) -> bool:
+        shard, shard_col = divmod(col, self.width)
+        v = self.view(self.bsi_view)
+        frag = v.fragment(shard) if v else None
+        return frag.clear_value(shard_col, self.bit_depth) if frag else False
+
+    def import_values(self, cols, values):
+        """Bulk BSI import grouped by shard."""
+        cols = np.asarray(cols, dtype=np.int64)
+        ivs = np.asarray([self.value_to_int(v) for v in values], dtype=np.int64)
+        if cols.size == 0:
+            return
+        mags = np.abs(ivs)
+        self._grow_depth(int(mags.max()))
+        self._min_seen = int(ivs.min()) if self._min_seen is None else min(
+            self._min_seen, int(ivs.min()))
+        self._max_seen = int(ivs.max()) if self._max_seen is None else max(
+            self._max_seen, int(ivs.max()))
+        view = self.view(self.bsi_view, create=True)
+        shards = cols // self.width
+        for shard in np.unique(shards):
+            sel = shards == shard
+            frag = view.fragment(int(shard), create=True)
+            frag.import_values(cols[sel] % self.width, ivs[sel],
+                               self.bit_depth)
+
+    def import_bits(self, rows, cols, timestamps=None):
+        """Bulk set-bit import grouped by shard (+ time views)."""
+        rows = np.asarray(rows, dtype=np.int64)
+        cols = np.asarray(cols, dtype=np.int64)
+        shards = cols // self.width
+        is_mutexish = self.options.type in (FieldType.MUTEX, FieldType.BOOL)
+        for shard in np.unique(shards):
+            sel = shards == shard
+            frag = self.view(VIEW_STANDARD, create=True).fragment(
+                int(shard), create=True)
+            if is_mutexish:
+                for r, c in zip(rows[sel], cols[sel] % self.width):
+                    for other in frag.row_ids:
+                        if other != r:
+                            frag.clear_bit(other, int(c))
+                    frag.set_bit(int(r), int(c))
+            else:
+                frag.import_bits(rows[sel], cols[sel] % self.width)
+        if self.options.type == FieldType.TIME and timestamps is not None:
+            for r, c, ts in zip(rows, cols, timestamps):
+                if ts is None:
+                    continue
+                self.set_bit(int(r), int(c), timestamp=timeq.parse_time(ts))
+
+    # -- reads --------------------------------------------------------------
+
+    def row_ids(self) -> list[int]:
+        """All row ids present in the standard view across shards."""
+        v = self.views.get(VIEW_STANDARD)
+        if v is None:
+            return []
+        ids: set[int] = set()
+        for frag in v.fragments.values():
+            ids.update(frag.row_ids)
+        return sorted(ids)
+
+    def views_for_range(self, from_=None, to=None) -> list[str]:
+        """Views to union for a Row(field=x, from=..., to=...) query."""
+        if from_ is None and to is None:
+            return [VIEW_STANDARD]
+        if self.options.type != FieldType.TIME:
+            raise ValueError(
+                f"field {self.name} is not a time field; from/to not supported")
+        # Open-ended bounds clamp to the span of existing quantum views
+        # so the walk never scans from/to the beginning/end of time.
+        existing = [v for v in self.views
+                    if v.startswith(VIEW_STANDARD + "_")]
+        if from_ is None or to is None:
+            if not existing:
+                return []
+            stamps = sorted(v[len(VIEW_STANDARD) + 1:] for v in existing)
+            fmts = {4: "%Y", 6: "%Y%m", 8: "%Y%m%d", 10: "%Y%m%d%H"}
+
+            def parse_stamp(s):
+                return dt.datetime.strptime(s, fmts[len(s)])
+            lo = min(parse_stamp(s) for s in stamps)
+            hi = max(parse_stamp(s) for s in stamps)
+            hi = hi + dt.timedelta(days=366)  # past the coarsest view's span
+            start = timeq.parse_time(from_) if from_ is not None else lo
+            end = timeq.parse_time(to) if to is not None else hi
+        else:
+            start = timeq.parse_time(from_)
+            end = timeq.parse_time(to)
+        views = timeq.views_by_time_range(
+            VIEW_STANDARD, start, end, self.options.time_quantum)
+        return [v for v in views if v in self.views]
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "options": self.options.to_dict()}

@@ -1,0 +1,194 @@
+"""Fragment — the data-plane unit: one bitmap per (field, view, shard).
+
+Mirrors fragment.go:84: a fragment is logically a single bitmap keyed
+``row*SHARD_WIDTH + col``.  Host-side, rows are kept as packed uint32
+word arrays (the storage layer will swap in compressed containers);
+device-side, a per-row tile cache feeds the XLA kernels, invalidated on
+write.  BSI views reuse the same row space: row 0 = exists, row 1 =
+sign, rows 2.. = magnitude planes (fragment.go:34-66), so BSI plane
+stacks are just ``rows[0..2+depth)`` stacked into one (2+depth, W)
+device tensor.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from pilosa_tpu.ops import bitmap as bm
+from pilosa_tpu.shardwidth import (
+    BSI_OFFSET_BIT,
+    BSI_SIGN_BIT,
+    SHARD_WIDTH,
+)
+
+
+class Fragment:
+    """Host rows + device tile cache for one (index, field, view, shard)."""
+
+    def __init__(self, index: str, field: str, view: str, shard: int,
+                 width: int = SHARD_WIDTH):
+        self.index_name = index
+        self.field_name = field
+        self.view_name = view
+        self.shard = shard
+        self.width = width
+        self._rows: dict[int, np.ndarray] = {}   # row id -> packed words
+        self._device: dict[int, jnp.ndarray] = {}
+        self._planes_cache: jnp.ndarray | None = None
+
+    # -- host mutation ------------------------------------------------------
+
+    def _row_mut(self, row: int) -> np.ndarray:
+        w = self._rows.get(row)
+        if w is None:
+            w = bm.empty(self.width)
+            self._rows[row] = w
+        self._invalidate(row)
+        return w
+
+    def _invalidate(self, row: int):
+        self._device.pop(row, None)
+        self._planes_cache = None
+
+    def set_bit(self, row: int, col: int) -> bool:
+        """Set one bit; returns True if it changed (fragment.setBit)."""
+        assert 0 <= col < self.width
+        w, b = col >> 5, np.uint32(1) << (col & 31)
+        words = self._row_mut(row)
+        if words[w] & b:
+            return False
+        words[w] |= b
+        return True
+
+    def clear_bit(self, row: int, col: int) -> bool:
+        words = self._rows.get(row)
+        if words is None:
+            return False
+        w, b = col >> 5, np.uint32(1) << (col & 31)
+        if not (words[w] & b):
+            return False
+        self._invalidate(row)
+        words[w] &= ~b
+        return True
+
+    def import_bits(self, rows, cols, clear: bool = False):
+        """Bulk set/clear: vectorized OR/ANDNOT per distinct row
+        (fragment.bulkImport semantics, minus the roaring plumbing)."""
+        rows = np.asarray(rows, dtype=np.int64)
+        cols = np.asarray(cols, dtype=np.int64)
+        assert rows.shape == cols.shape
+        for r in np.unique(rows):
+            sel = cols[rows == r]
+            mask = bm.from_columns(sel, self.width)
+            words = self._row_mut(int(r))
+            if clear:
+                words &= ~mask
+            else:
+                words |= mask
+
+    def contains(self, row: int, col: int) -> bool:
+        words = self._rows.get(row)
+        if words is None:
+            return False
+        return bool((words[col >> 5] >> np.uint32(col & 31)) & 1)
+
+    # -- BSI mutation (fragment.setValueBase semantics) ---------------------
+
+    def set_value(self, col: int, depth: int, value: int) -> bool:
+        """Write one sign-magnitude value across the bit-plane rows."""
+        uval = abs(int(value))
+        assert uval < (1 << depth), "value magnitude exceeds bit depth"
+        changed = False
+        for i in range(depth):
+            op = self.set_bit if (uval >> i) & 1 else self.clear_bit
+            changed |= op(BSI_OFFSET_BIT + i, col)
+        changed |= self.set_bit(0, col)  # exists
+        if value < 0:
+            changed |= self.set_bit(BSI_SIGN_BIT, col)
+        else:
+            changed |= self.clear_bit(BSI_SIGN_BIT, col)
+        return changed
+
+    def clear_value(self, col: int, depth: int) -> bool:
+        changed = False
+        for r in range(2 + depth):
+            changed |= self.clear_bit(r, col)
+        return changed
+
+    def import_values(self, cols, values, depth: int, clear: bool = False):
+        """Bulk BSI write (fragment.importValue semantics): last-write-
+        wins per column, vectorized per plane."""
+        cols = np.asarray(cols, dtype=np.int64)
+        vals = np.asarray(values, dtype=np.int64).reshape(-1)
+        assert cols.shape == vals.shape
+        if cols.size == 0:
+            return
+        # last-write-wins dedup
+        _, rev_first = np.unique(cols[::-1], return_index=True)
+        keep = cols.size - 1 - rev_first
+        cols, vals = cols[keep], vals[keep]
+        touched = bm.from_columns(cols, self.width)
+        if clear:
+            for r in range(2 + depth):
+                self._row_mut(r)[:] &= ~touched
+            return
+        neg = vals < 0
+        mags = np.where(neg, np.negative(vals), vals).view(np.uint64)
+        assert int(mags.max()).bit_length() <= depth, \
+            "value magnitude exceeds bit depth"
+        self._row_mut(0)[:] |= touched
+        sign_words = self._row_mut(BSI_SIGN_BIT)
+        sign_words &= ~touched
+        sign_words |= bm.from_columns(cols[neg], self.width)
+        for i in range(depth):
+            plane = self._row_mut(BSI_OFFSET_BIT + i)
+            plane &= ~touched
+            plane |= bm.from_columns(
+                cols[(mags >> np.uint64(i)) & np.uint64(1) == 1], self.width)
+
+    # -- reads --------------------------------------------------------------
+
+    @property
+    def row_ids(self) -> list[int]:
+        return sorted(r for r, w in self._rows.items() if w.any())
+
+    def max_row_id(self) -> int:
+        ids = self.row_ids
+        return ids[-1] if ids else 0
+
+    def row_words(self, row: int) -> np.ndarray:
+        """Packed host words for a row (zeros if absent)."""
+        w = self._rows.get(row)
+        return w if w is not None else bm.empty(self.width)
+
+    def row_count(self, row: int) -> int:
+        w = self._rows.get(row)
+        return int(np.bitwise_count(w).sum()) if w is not None else 0
+
+    # -- device tiles -------------------------------------------------------
+
+    def device_row(self, row: int) -> jnp.ndarray:
+        """Row tile in HBM (cached until the row is written)."""
+        t = self._device.get(row)
+        if t is None:
+            t = jnp.asarray(self.row_words(row))
+            self._device[row] = t
+        return t
+
+    def device_rows(self, rows) -> jnp.ndarray:
+        """Stacked (R, W) tile for a list of row ids."""
+        return jnp.stack([self.device_row(r) for r in rows]) if len(rows) \
+            else jnp.zeros((0, self.width // 32), dtype=jnp.uint32)
+
+    def device_planes(self, depth: int) -> jnp.ndarray:
+        """(2+depth, W) BSI plane stack for the kernel layer."""
+        p = self._planes_cache
+        if p is None or p.shape[0] != 2 + depth:
+            p = jnp.asarray(
+                np.stack([self.row_words(r) for r in range(2 + depth)]))
+            self._planes_cache = p
+        return p
+
+    def memory_bytes(self) -> int:
+        return sum(w.nbytes for w in self._rows.values())

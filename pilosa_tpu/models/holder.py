@@ -1,0 +1,77 @@
+"""Holder — root of all data (holder.go:58).
+
+Owns the index map and schema persistence.  Bitmap data persistence
+lives in the storage layer; the holder (de)serializes the schema as
+JSON under its directory, mirroring holder.Open's schema load
+(holder.go:432).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from pilosa_tpu.models.index import Index
+from pilosa_tpu.models.schema import FieldOptions
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+SCHEMA_FILE = "schema.json"
+
+
+class Holder:
+    def __init__(self, path: str | None = None, width: int = SHARD_WIDTH):
+        self.path = path
+        self.width = width
+        self.indexes: dict[str, Index] = {}
+        self._lock = threading.RLock()
+
+    def create_index(self, name: str, keys: bool = False,
+                     track_existence: bool = True,
+                     ok_if_exists: bool = False) -> Index:
+        with self._lock:
+            if name in self.indexes:
+                if ok_if_exists:
+                    return self.indexes[name]
+                raise ValueError(f"index already exists: {name}")
+            idx = Index(name, keys=keys, track_existence=track_existence,
+                        width=self.width)
+            self.indexes[name] = idx
+            return idx
+
+    def index(self, name: str) -> Index | None:
+        return self.indexes.get(name)
+
+    def delete_index(self, name: str):
+        with self._lock:
+            self.indexes.pop(name, None)
+
+    def schema(self) -> list[dict]:
+        return [idx.to_dict() for _, idx in sorted(self.indexes.items())]
+
+    # -- schema persistence -------------------------------------------------
+
+    def save_schema(self):
+        if not self.path:
+            return
+        os.makedirs(self.path, exist_ok=True)
+        with open(os.path.join(self.path, SCHEMA_FILE), "w") as f:
+            json.dump(self.schema(), f, indent=1)
+
+    def load_schema(self):
+        if not self.path:
+            return
+        p = os.path.join(self.path, SCHEMA_FILE)
+        if not os.path.exists(p):
+            return
+        with open(p) as f:
+            for idx_d in json.load(f):
+                opts = idx_d.get("options", {})
+                idx = self.create_index(
+                    idx_d["name"], keys=opts.get("keys", False),
+                    track_existence=opts.get("trackExistence", True),
+                    ok_if_exists=True)
+                for fd in idx_d.get("fields", []):
+                    idx.create_field(
+                        fd["name"], FieldOptions.from_dict(fd["options"]),
+                        ok_if_exists=True)

@@ -1,0 +1,89 @@
+"""Index — a namespace of fields (index.go:27).
+
+Tracks column existence in a hidden ``_exists`` field when
+track_existence is on (index.go existenceFieldName), which backs
+Not()/All() and column counts.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from pilosa_tpu.models.field import Field
+from pilosa_tpu.models.schema import FieldOptions, FieldType
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+EXISTENCE_FIELD = "_exists"
+
+
+class Index:
+    def __init__(self, name: str, keys: bool = False,
+                 track_existence: bool = True, width: int = SHARD_WIDTH):
+        self.name = name
+        self.keys = keys
+        self.track_existence = track_existence
+        self.width = width
+        self.fields: dict[str, Field] = {}
+        self._lock = threading.RLock()
+        if track_existence:
+            self._ensure_existence()
+
+    def _ensure_existence(self) -> Field:
+        f = self.fields.get(EXISTENCE_FIELD)
+        if f is None:
+            f = Field(self.name, EXISTENCE_FIELD,
+                      FieldOptions(type=FieldType.SET), self.width)
+            self.fields[EXISTENCE_FIELD] = f
+        return f
+
+    def create_field(self, name: str, options: FieldOptions | None = None,
+                     ok_if_exists: bool = False) -> Field:
+        with self._lock:
+            if name in self.fields:
+                if ok_if_exists or name == EXISTENCE_FIELD:
+                    return self.fields[name]
+                raise ValueError(f"field already exists: {name}")
+            f = Field(self.name, name, options, self.width)
+            self.fields[name] = f
+            return f
+
+    def field(self, name: str) -> Field | None:
+        return self.fields.get(name)
+
+    def delete_field(self, name: str):
+        with self._lock:
+            self.fields.pop(name, None)
+
+    def public_fields(self) -> list[Field]:
+        return [f for n, f in sorted(self.fields.items())
+                if n != EXISTENCE_FIELD]
+
+    def mark_columns_exist(self, cols):
+        if not self.track_existence:
+            return
+        f = self._ensure_existence()
+        f.import_bits([0] * len(cols), cols)
+
+    def existence_row(self, shard: int):
+        """Packed existence words for a shard (or None if untracked)."""
+        f = self.fields.get(EXISTENCE_FIELD)
+        if f is None:
+            return None
+        v = f.views.get("standard")
+        frag = v.fragment(shard) if v else None
+        return frag.row_words(0) if frag else None
+
+    @property
+    def available_shards(self) -> set[int]:
+        s: set[int] = set()
+        for f in self.fields.values():
+            s.update(f.available_shards)
+        return s
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "options": {"keys": self.keys,
+                        "trackExistence": self.track_existence},
+            "fields": [f.to_dict() for f in self.public_fields()],
+        }

@@ -1,0 +1,134 @@
+"""Field types and options.
+
+Mirrors the reference's field model (field.go:43-49 field types,
+field.go:122-391 functional options): set, int, time, mutex, bool,
+decimal, timestamp.  Int-like types (int/decimal/timestamp) are stored
+as BSI bit-planes; decimal scales by 10^scale, timestamp converts to
+integer units since an epoch.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from dataclasses import dataclass, field as _field
+from enum import Enum
+
+
+class FieldType(str, Enum):
+    SET = "set"
+    INT = "int"
+    TIME = "time"
+    MUTEX = "mutex"
+    BOOL = "bool"
+    DECIMAL = "decimal"
+    TIMESTAMP = "timestamp"
+
+    @property
+    def is_bsi(self) -> bool:
+        return self in (FieldType.INT, FieldType.DECIMAL, FieldType.TIMESTAMP)
+
+
+class TimeQuantum(str):
+    """Subset of "YMDH" in order, e.g. "YMD" (time.go TimeQuantum)."""
+
+    VALID = ("", "Y", "M", "D", "H", "YM", "MD", "DH", "YMD", "MDH", "YMDH")
+
+    def __new__(cls, value: str = ""):
+        v = (value or "").upper()
+        if v not in cls.VALID:
+            raise ValueError(f"invalid time quantum: {value!r}")
+        return super().__new__(cls, v)
+
+    @property
+    def has_year(self):
+        return "Y" in self
+
+    @property
+    def has_month(self):
+        return "M" in self
+
+    @property
+    def has_day(self):
+        return "D" in self
+
+    @property
+    def has_hour(self):
+        return "H" in self
+
+
+# Epoch for timestamp fields (field.go DefaultEpoch).
+DEFAULT_EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+
+_TIME_UNITS = {"s": 1, "ms": 10**3, "us": 10**6, "ns": 10**9}
+
+# TopN row-cache defaults (field.go:31, cache.go): ranked cache of
+# 50,000 rows.
+CACHE_TYPE_RANKED = "ranked"
+CACHE_TYPE_LRU = "lru"
+CACHE_TYPE_NONE = "none"
+DEFAULT_CACHE_SIZE = 50000
+
+
+@dataclass
+class FieldOptions:
+    type: FieldType = FieldType.SET
+    # BSI bounds (int/decimal/timestamp); depth derived from these.
+    min: int | None = None
+    max: int | None = None
+    scale: int = 0              # decimal: value stored as v * 10^scale
+    time_unit: str = "s"        # timestamp granularity
+    epoch: _dt.datetime = DEFAULT_EPOCH
+    time_quantum: TimeQuantum = _field(default_factory=TimeQuantum)
+    ttl: float = 0.0            # seconds; 0 = keep all time views
+    cache_type: str = CACHE_TYPE_RANKED
+    cache_size: int = DEFAULT_CACHE_SIZE
+    keys: bool = False          # string row keys (translate store)
+    foreign_index: str | None = None
+
+    def __post_init__(self):
+        if self.type == FieldType.TIME and not self.time_quantum:
+            raise ValueError("time field requires a time_quantum")
+        if self.type == FieldType.DECIMAL and self.scale < 0:
+            raise ValueError("decimal scale must be >= 0")
+        if self.time_unit not in _TIME_UNITS:
+            raise ValueError(f"invalid time unit {self.time_unit!r}")
+        if self.type == FieldType.BOOL and self.keys:
+            raise ValueError("bool fields cannot have keys")
+
+    def timestamp_to_int(self, ts: _dt.datetime) -> int:
+        if ts.tzinfo is None:
+            ts = ts.replace(tzinfo=_dt.timezone.utc)
+        delta = ts - self.epoch
+        # integer math only: float total_seconds() corrupts ns units
+        whole = delta.days * 86400 + delta.seconds
+        unit = _TIME_UNITS[self.time_unit]
+        return whole * unit + delta.microseconds * unit // 10**6
+
+    def int_to_timestamp(self, v: int) -> _dt.datetime:
+        return self.epoch + _dt.timedelta(seconds=v / _TIME_UNITS[self.time_unit])
+
+    def to_dict(self) -> dict:
+        d = {"type": self.type.value}
+        if self.type.is_bsi:
+            d.update(min=self.min, max=self.max)
+        if self.type == FieldType.DECIMAL:
+            d["scale"] = self.scale
+        if self.type == FieldType.TIMESTAMP:
+            d.update(time_unit=self.time_unit, epoch=self.epoch.isoformat())
+        if self.type == FieldType.TIME:
+            d.update(time_quantum=str(self.time_quantum), ttl=self.ttl)
+        if self.type in (FieldType.SET, FieldType.MUTEX, FieldType.TIME):
+            d.update(cache_type=self.cache_type, cache_size=self.cache_size)
+        d["keys"] = self.keys
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FieldOptions":
+        kw = dict(d)
+        kw["type"] = FieldType(kw.get("type", "set"))
+        if "time_quantum" in kw:
+            kw["time_quantum"] = TimeQuantum(kw["time_quantum"])
+        if "epoch" in kw and isinstance(kw["epoch"], str):
+            kw["epoch"] = _dt.datetime.fromisoformat(kw["epoch"])
+        return cls(**{k: v for k, v in kw.items()
+                      if k in cls.__dataclass_fields__})

@@ -1,0 +1,135 @@
+"""Time-quantum view naming (behavioral port of time.go:75-271).
+
+A time field materializes one view per quantum unit per written
+timestamp (``standard_2006``, ``standard_200601``, …).  Range queries
+traverse a minimal view set covering [start, end): walk up from the
+smallest unit until aligned to the next larger unit, cover the middle
+with the largest available units, then walk back down.  When only
+coarse units exist (e.g. quantum "Y"), views overcover the range edges
+— same as the reference.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+
+from pilosa_tpu.models.schema import TimeQuantum
+
+_FMT = {"Y": "%Y", "M": "%Y%m", "D": "%Y%m%d", "H": "%Y%m%d%H"}
+
+TIME_FORMAT = "%Y-%m-%dT%H:%M"  # pql time literal format (time.go TimeFormat)
+
+
+def view_by_time_unit(name: str, t: dt.datetime, unit: str) -> str:
+    return f"{name}_{t.strftime(_FMT[unit])}"
+
+
+def views_by_time(name: str, t: dt.datetime, q: TimeQuantum) -> list[str]:
+    """All quantum views a write at time t lands in (time.go viewsByTime)."""
+    return [view_by_time_unit(name, t, unit) for unit in q]
+
+
+def _add_month(t: dt.datetime) -> dt.datetime:
+    # time.go addMonth: avoid Jan 31 + 1mo = Mar 2 normalization.
+    if t.day > 28:
+        t = t.replace(day=1, minute=0, second=0, microsecond=0)
+    y, m = (t.year + 1, 1) if t.month == 12 else (t.year, t.month + 1)
+    try:
+        return t.replace(year=y, month=m)
+    except ValueError:  # e.g. Feb 30 — Go normalizes; days<=28 never hit this
+        return t.replace(year=y, month=m, day=28)
+
+
+def _add_year(t: dt.datetime) -> dt.datetime:
+    try:
+        return t.replace(year=t.year + 1)
+    except ValueError:  # Feb 29 on a leap year (Go normalizes to Mar 1)
+        return t.replace(year=t.year + 1, month=3, day=1)
+
+
+def _next_year_gte(t: dt.datetime, end: dt.datetime) -> bool:
+    nxt = _add_year(t)
+    return nxt.year == end.year or end > nxt
+
+
+def _next_month_gte(t: dt.datetime, end: dt.datetime) -> bool:
+    nxt = _add_month(t)
+    return (nxt.year, nxt.month) == (end.year, end.month) or end > nxt
+
+
+def _next_day_gte(t: dt.datetime, end: dt.datetime) -> bool:
+    nxt = t + dt.timedelta(days=1)
+    return (nxt.year, nxt.month, nxt.day) == (end.year, end.month, end.day) \
+        or end > nxt
+
+
+def views_by_time_range(name: str, start: dt.datetime, end: dt.datetime,
+                        q: TimeQuantum) -> list[str]:
+    """Minimal view set covering [start, end) (time.go viewsByTimeRange)."""
+    t = start
+    results: list[str] = []
+
+    # Walk up from smallest units to largest units.
+    if q.has_hour or q.has_day or q.has_month:
+        while t < end:
+            if q.has_hour:
+                if not _next_day_gte(t, end):
+                    break
+                elif t.hour != 0:
+                    results.append(view_by_time_unit(name, t, "H"))
+                    t += dt.timedelta(hours=1)
+                    continue
+            if q.has_day:
+                if not _next_month_gte(t, end):
+                    break
+                elif t.day != 1:
+                    results.append(view_by_time_unit(name, t, "D"))
+                    t += dt.timedelta(days=1)
+                    continue
+            if q.has_month:
+                if not _next_year_gte(t, end):
+                    break
+                elif t.month != 1:
+                    results.append(view_by_time_unit(name, t, "M"))
+                    t = _add_month(t)
+                    continue
+            break
+
+    # Walk back down from largest units to smallest units.
+    while t < end:
+        if q.has_year and _next_year_gte(t, end):
+            results.append(view_by_time_unit(name, t, "Y"))
+            t = _add_year(t)
+        elif q.has_month and _next_month_gte(t, end):
+            results.append(view_by_time_unit(name, t, "M"))
+            t = _add_month(t)
+        elif q.has_day and _next_day_gte(t, end):
+            results.append(view_by_time_unit(name, t, "D"))
+            t += dt.timedelta(days=1)
+        elif q.has_hour:
+            results.append(view_by_time_unit(name, t, "H"))
+            t += dt.timedelta(hours=1)
+        else:
+            break
+
+    return results
+
+
+def parse_time(v) -> dt.datetime:
+    """Parse a PQL time literal (time.go parseTime/parsePartialTime).
+
+    Accepts "2006-01-02T15:04", partial forms ("2006", "2006-01",
+    "2006-01-02", "2006-01-02T15"), and unix seconds as int.
+    """
+    if isinstance(v, dt.datetime):
+        return v
+    if isinstance(v, (int, float)):
+        return dt.datetime.fromtimestamp(int(v), tz=dt.timezone.utc).replace(
+            tzinfo=None)
+    s = str(v)
+    for fmt in (TIME_FORMAT, "%Y-%m-%dT%H", "%Y-%m-%d", "%Y-%m", "%Y"):
+        try:
+            return dt.datetime.strptime(s, fmt)
+        except ValueError:
+            continue
+    raise ValueError(f"cannot parse time {v!r}")

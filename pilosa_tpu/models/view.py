@@ -1,0 +1,40 @@
+"""View — physical layout of a field (view.go:26-53).
+
+View names: ``standard`` for the primary layout, ``bsig_<field>`` for
+BSI bit-planes, and time-quantum views ``standard_YYYY[MM[DD[HH]]]``.
+A view owns one Fragment per shard.
+"""
+
+from __future__ import annotations
+
+from pilosa_tpu.models.fragment import Fragment
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+VIEW_STANDARD = "standard"
+VIEW_BSI_PREFIX = "bsig_"
+
+
+def bsi_view_name(field_name: str) -> str:
+    return VIEW_BSI_PREFIX + field_name
+
+
+class View:
+    def __init__(self, index: str, field: str, name: str,
+                 width: int = SHARD_WIDTH):
+        self.index_name = index
+        self.field_name = field
+        self.name = name
+        self.width = width
+        self.fragments: dict[int, Fragment] = {}
+
+    def fragment(self, shard: int, create: bool = False) -> Fragment | None:
+        f = self.fragments.get(shard)
+        if f is None and create:
+            f = Fragment(self.index_name, self.field_name, self.name, shard,
+                         self.width)
+            self.fragments[shard] = f
+        return f
+
+    @property
+    def shards(self) -> list[int]:
+        return sorted(self.fragments)

@@ -86,19 +86,35 @@ def encode(columns, values, depth: int | None = None,
 
 
 def decode(planes) -> tuple[np.ndarray, list[int]]:
-    """Inverse of encode: -> (columns, values) with exact Python ints."""
+    """Inverse of encode: -> (columns, values) with exact Python ints.
+
+    Vectorized per plane: one numpy gather+shift per magnitude bit
+    (depth passes over the set columns), with an object-int fallback
+    only for magnitudes beyond int64 (depth > 62).
+    """
     planes = np.asarray(planes)
     depth = planes.shape[0] - 2
     cols = bm.to_columns(planes[BSI_EXISTS_BIT])
-    values = []
-    for c in cols:
-        w, b = int(c) >> 5, int(c) & 31
-        mag = 0
+    if cols.size == 0:
+        return cols, []
+    w = (cols >> np.uint64(5)).astype(np.int64)
+    b = (cols & np.uint64(31)).astype(np.uint32)
+
+    def bits(plane):
+        return ((plane[w] >> b) & 1).astype(np.int64)
+
+    if depth <= 62:
+        mags = np.zeros(cols.size, dtype=np.int64)
         for i in range(depth):
-            mag |= ((int(planes[BSI_OFFSET_BIT + i, w]) >> b) & 1) << i
-        if (int(planes[BSI_SIGN_BIT, w]) >> b) & 1:
-            mag = -mag
-        values.append(mag)
+            mags |= bits(planes[BSI_OFFSET_BIT + i]) << np.int64(i)
+        sign = bits(planes[BSI_SIGN_BIT]).astype(bool)
+        values = np.where(sign, -mags, mags).tolist()
+    else:
+        mags = np.zeros(cols.size, dtype=object)
+        for i in range(depth):
+            mags += bits(planes[BSI_OFFSET_BIT + i]).astype(object) << i
+        sign = bits(planes[BSI_SIGN_BIT]).astype(bool)
+        values = [-m if s else m for m, s in zip(mags.tolist(), sign)]
     return cols, values
 
 

@@ -1,0 +1,29 @@
+"""Mesh parallelism: static shard→device placement + ICI collectives.
+
+Replaces the reference's distribution machinery (SURVEY §2.5): disco
+jump-hash shard→node assignment (disco/snapshot.go:64) becomes a
+static placement of shard tiles along a mesh axis; executor.mapReduce's
+HTTP fan-out/streaming reduce (executor.go:6449-6812) becomes jitted
+computation over sharded arrays with XLA collectives (psum/all_gather)
+riding ICI.
+"""
+
+from pilosa_tpu.parallel.mesh import (
+    make_mesh,
+    shard_spec,
+    place_shards,
+)
+from pilosa_tpu.parallel.dist import (
+    dist_count,
+    dist_count_intersect,
+    dist_bsi_sum_counts,
+    dist_topk_counts,
+    host_bsi_sum,
+    host_count,
+)
+
+__all__ = [
+    "make_mesh", "shard_spec", "place_shards",
+    "dist_count", "dist_count_intersect", "dist_bsi_sum_counts",
+    "dist_topk_counts", "host_bsi_sum", "host_count",
+]

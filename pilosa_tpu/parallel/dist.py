@@ -1,0 +1,80 @@
+"""Distributed reductions over mesh-sharded shard stacks.
+
+Each function takes a stacked global array whose axis 0 is the shard
+axis (placed with ``place_shards``) and runs ONE jitted program whose
+cross-shard combine lowers to XLA collectives over ICI — the TPU
+analog of executor.mapReduce's streaming reduceFn
+(executor.go:6449-6530).
+
+Exactness invariant (same as ops.bitmap.count): per-shard popcounts
+are < 2^20 and int32-exact; cross-shard totals can exceed 2^31, so
+device programs return PER-SHARD partials and the ``host_*`` combiners
+sum them in exact Python ints.  Device-side scalar reduces are only
+used where the bound is provably safe (see dist_topk_counts).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from pilosa_tpu.ops import bitmap as bm
+from pilosa_tpu.ops import bsi as bsi_ops
+
+
+@jax.jit
+def dist_count(tiles):
+    """Per-shard Count over (S, W) sharded tiles → (S,) int32."""
+    return bm.count(tiles)
+
+
+@jax.jit
+def dist_count_intersect(a, b):
+    """Per-shard Count(Intersect(a, b)) over (S, W) stacks → (S,)."""
+    return bm.count(jnp.bitwise_and(a, b))
+
+
+def host_count(partials) -> int:
+    """Exact cross-shard total."""
+    return int(np.asarray(partials, dtype=np.int64).sum())
+
+
+@jax.jit
+def dist_bsi_sum_counts(planes, filt):
+    """Per-shard BSI Sum partials.
+
+    planes: (S, 2+depth, W); filt: (S, W) filter tiles (all-ones for
+    no filter).  Returns (count, pos_pc, neg_pc) each with a leading
+    shard axis; combine with host_bsi_sum.
+    """
+    return jax.vmap(bsi_ops.sum_counts)(planes, filt)
+
+
+def host_bsi_sum(count, pos_pc, neg_pc) -> tuple[int, int]:
+    """Exact (sum, count) from per-shard sum partials."""
+    pos = np.asarray(pos_pc, dtype=np.int64).sum(axis=0)
+    neg = np.asarray(neg_pc, dtype=np.int64).sum(axis=0)
+    total = sum((int(p) - int(n)) << i
+                for i, (p, n) in enumerate(zip(pos, neg)))
+    return int(total), int(np.asarray(count, dtype=np.int64).sum())
+
+
+@partial(jax.jit, static_argnames=("k",))
+def dist_topk_counts(rows, filt, k: int):
+    """Per-row global counts + top-k (row-batched TopN/TopK reduce).
+
+    rows: (R, S, W) — R candidate row bitmaps stacked over S shards;
+    filt: (S, W).  Returns (values, indices) of the k largest global
+    intersection counts — the reduce half of executor.executeTopKShard
+    / mergerator (executor.go:2570-2704) as one XLA top_k over
+    ICI-reduced counts.
+
+    Safe range: per-(row, shard) counts are < 2^20, so the int32
+    cross-shard accumulation is exact for S < 2^11 shards (2 billion
+    columns); above that use per-shard partials + host combine.
+    """
+    counts = jnp.sum(bm.count(jnp.bitwise_and(rows, filt[None])), axis=1)
+    return jax.lax.top_k(counts, k)

@@ -1,0 +1,52 @@
+"""Device mesh construction and shard placement.
+
+The shard axis is the data-parallel axis: shard s of an index maps to
+device ``s % n_devices`` by stacking per-shard tiles along axis 0 of a
+global array sharded with ``PartitionSpec("shards", ...)``.  This is
+the static analog of the reference's jump-hash shard→node snapshot
+(disco/snapshot.go:54-69, cluster.go:107-230): placement is a pure
+function of (shard count, mesh), with no coordination service.
+
+A second mesh axis ("rows") shards batched row scans (TopK/GroupBy row
+blocks) — the closest thing a bitmap database has to model parallelism;
+there is no sequence-parallel analog (SURVEY §5.7).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: int | None = None, rows: int = 1) -> Mesh:
+    """A (rows, shards) mesh over the first rows*shards devices."""
+    devs = jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    assert n_devices % rows == 0
+    shape = (rows, n_devices // rows)
+    return Mesh(np.array(devs[:n_devices]).reshape(shape), ("rows", "shards"))
+
+
+def shard_spec(batch_axes: int = 0) -> P:
+    """PartitionSpec for a (S, ..., W) stack of shard tiles: axis 0 on
+    the 'shards' mesh axis, everything else replicated."""
+    return P(*( ("shards",) + (None,) * (batch_axes + 1) ))
+
+
+def place_shards(mesh: Mesh, tiles, batch_axes: int = 0):
+    """Put a stacked (S, ..., W) host array onto the mesh, shard axis 0.
+
+    S must be a multiple of the shards axis size (pad with zero tiles —
+    zero shards are harmless for every reduction we run).
+    """
+    tiles = np.asarray(tiles)
+    n = mesh.shape["shards"]
+    s = tiles.shape[0]
+    if s % n:
+        pad = n - s % n
+        tiles = np.concatenate(
+            [tiles, np.zeros((pad,) + tiles.shape[1:], dtype=tiles.dtype)])
+    sharding = NamedSharding(mesh, shard_spec(batch_axes))
+    return jax.device_put(tiles, sharding)

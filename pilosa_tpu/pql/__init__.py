@@ -1,0 +1,14 @@
+"""PQL — the Pilosa Query Language.
+
+Hand-written recursive-descent parser producing the same call-tree
+shape as the reference's PEG parser (pql/pql.peg, pql/ast.go): a Query
+of nested Calls with named args, condition args (``field > 5``,
+``field >< [a, b]``, ``5 < field < 10``), positional forms for
+Set/Clear/TopN/TopK/Rows/Min/Max/Sum/Percentile, lists, quoted
+strings, decimals, and time literals.
+"""
+
+from pilosa_tpu.pql.ast import Call, Condition, Query
+from pilosa_tpu.pql.parser import parse, ParseError
+
+__all__ = ["Call", "Condition", "Query", "parse", "ParseError"]

@@ -1,0 +1,99 @@
+"""PQL AST (shape of pql/ast.go Call/Query/Condition)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from decimal import Decimal
+from typing import Any
+
+# Condition ops (pql tokens): comparison ops plus BETWEEN variants.
+# Between ops state bound inclusivity: "><" is [a,b] (both inclusive,
+# from `field >< [a,b]`); the conditional forms `a < x < b` produce
+# the partially-open variants.
+OP_EQ, OP_NEQ = "==", "!="
+OP_LT, OP_LTE, OP_GT, OP_GTE = "<", "<=", ">", ">="
+OP_BETW = "><"            # inclusive-inclusive
+OP_BTWN_LT_LT = "<x<"     # exclusive-exclusive
+OP_BTWN_LTE_LT = "<=x<"
+OP_BTWN_LT_LTE = "<x<="
+OP_BTWN_LTE_LTE = "<=x<="  # same semantics as "><"
+
+BETWEEN_OPS = (OP_BETW, OP_BTWN_LT_LT, OP_BTWN_LTE_LT, OP_BTWN_LT_LTE,
+               OP_BTWN_LTE_LTE)
+
+
+@dataclass
+class Condition:
+    op: str
+    value: Any  # scalar, or [lo, hi] for between ops
+
+    def __repr__(self):
+        return f"Condition({self.op!r}, {self.value!r})"
+
+
+@dataclass
+class Call:
+    name: str
+    args: dict[str, Any] = field(default_factory=dict)
+    children: list["Call"] = field(default_factory=list)
+
+    def arg(self, key: str, default=None):
+        return self.args.get(key, default)
+
+    def has_condition_arg(self) -> bool:
+        return any(isinstance(v, Condition) for v in self.args.values())
+
+    def condition_field(self):
+        """(field, Condition) for calls like Row(x > 5)."""
+        for k, v in self.args.items():
+            if isinstance(v, Condition):
+                return k, v
+        return None, None
+
+    def field_arg(self):
+        """The single row-spec arg (field=row) for Set/Clear/Row
+        (pql.Call.FieldArg semantics)."""
+        for k, v in self.args.items():
+            if k.startswith("_") or isinstance(v, Condition):
+                continue
+            if k in ("from", "to"):
+                continue
+            return k, v
+        return None, None
+
+    def __repr__(self):
+        parts = [repr(c) for c in self.children]
+        parts += [f"{k}={v!r}" for k, v in self.args.items()]
+        return f"{self.name}({', '.join(parts)})"
+
+
+@dataclass
+class Query:
+    calls: list[Call] = field(default_factory=list)
+
+    def __repr__(self):
+        return "".join(repr(c) for c in self.calls)
+
+
+def is_between(cond: Condition) -> bool:
+    return cond.op in BETWEEN_OPS
+
+
+def between_bounds_inclusive(cond: Condition) -> tuple[int, int]:
+    """Normalize any between-op to inclusive integer bounds [lo, hi]."""
+    lo, hi = cond.value
+    lo, hi = int(lo), int(hi)
+    if cond.op in (OP_BTWN_LT_LT, OP_BTWN_LT_LTE):
+        lo += 1
+    if cond.op in (OP_BTWN_LT_LT, OP_BTWN_LTE_LT):
+        hi -= 1
+    return lo, hi
+
+
+__all__ = [
+    "Call", "Condition", "Query", "Decimal", "is_between",
+    "between_bounds_inclusive",
+    "OP_EQ", "OP_NEQ", "OP_LT", "OP_LTE", "OP_GT", "OP_GTE", "OP_BETW",
+    "OP_BTWN_LT_LT", "OP_BTWN_LTE_LT", "OP_BTWN_LT_LTE", "OP_BTWN_LTE_LTE",
+    "BETWEEN_OPS",
+]

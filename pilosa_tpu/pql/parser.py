@@ -1,0 +1,241 @@
+"""Recursive-descent PQL parser.
+
+Follows the surface of the reference grammar (pql/pql.peg): a query is
+a sequence of calls; call args are nested calls, ``key=value`` pairs,
+condition args (``key OP value`` with OP in < <= == != >= > ><), or
+conditional triples (``5 < key < 10``).  Values: null/true/false,
+decimals, quoted strings, bare words, time literals, lists, nested
+calls.  Positional forms (Set/Clear column, posfield for
+TopN/TopK/Rows/Min/Max/Sum/Percentile) are normalized into the
+``_col``/``_field``/``_timestamp`` args the executor expects
+(pql/ast.go addPosNum/addPosStr).
+"""
+
+from __future__ import annotations
+
+import re
+from decimal import Decimal
+
+from pilosa_tpu.pql.ast import (
+    OP_BETW,
+    OP_BTWN_LT_LT,
+    OP_BTWN_LT_LTE,
+    OP_BTWN_LTE_LT,
+    OP_BTWN_LTE_LTE,
+    Call,
+    Condition,
+    Query,
+)
+
+
+class ParseError(Exception):
+    pass
+
+
+_TOKEN_RE = re.compile(r"""
+    (?P<ws>\s+)
+  | (?P<timestamp>\d{4}-\d{2}-\d{2}T\d{2}:\d{2}(:\d{2}(\.\d+)?(Z|[+-]\d{2}:\d{2}))?)
+  | (?P<decimal>-?\d+\.\d*|-?\.\d+|-?\d+)
+  | (?P<ident>[A-Za-z_$Θ][A-Za-z0-9_\-:Θ]*)
+  | (?P<dq>"(?:\\"|\\\\|\\n|\\t|[^"\\])*")
+  | (?P<sq>'(?:\\'|\\\\|\\n|\\t|[^'\\])*')
+  | (?P<op>><|<=|>=|==|!=|<|>|=)
+  | (?P<punct>[(),\[\]])
+""", re.VERBOSE)
+
+# Calls whose first positional value is a column (pql.peg Set/Clear).
+_COL_CALLS = {"Set", "Clear"}
+# Calls whose first positional identifier is the field (pql.peg posfield).
+_POSFIELD_CALLS = {"TopN", "TopK", "Percentile", "Rows", "Min", "Max", "Sum",
+                   "Distinct", "MinRow", "MaxRow"}
+# Canonical capitalizations (pql canonicalCaps).
+_CANONICAL = {n.lower(): n for n in [
+    "All", "Apply", "Clear", "ClearRow", "ConstRow", "Count", "Delete",
+    "Difference", "Distinct", "Extract", "GroupBy", "IncludesColumn",
+    "Intersect", "Limit", "Max", "Min", "MinRow", "MaxRow", "Not", "Options",
+    "Percentile", "Range", "Row", "Rows", "Set", "Shift", "Sort", "Store",
+    "Sum", "TopK", "TopN", "Union", "UnionRows", "Xor",
+]}
+
+
+class _Tokens:
+    def __init__(self, text: str):
+        self.text = text
+        self.toks: list[tuple[str, str, int]] = []
+        pos = 0
+        while pos < len(text):
+            m = _TOKEN_RE.match(text, pos)
+            if m is None:
+                raise ParseError(
+                    f"unexpected character {text[pos]!r} at {pos}")
+            pos = m.end()
+            kind = m.lastgroup
+            if kind != "ws":
+                self.toks.append((kind, m.group(), m.start()))
+        self.i = 0
+
+    def peek(self, ahead: int = 0):
+        j = self.i + ahead
+        return self.toks[j] if j < len(self.toks) else (None, None, len(self.text))
+
+    def next(self):
+        t = self.peek()
+        self.i += 1
+        return t
+
+    def expect(self, value: str):
+        kind, v, pos = self.next()
+        if v != value:
+            raise ParseError(f"expected {value!r} at {pos}, got {v!r}")
+        return v
+
+    def at_end(self):
+        return self.i >= len(self.toks)
+
+
+def parse(text: str) -> Query:
+    toks = _Tokens(text)
+    q = Query()
+    while not toks.at_end():
+        q.calls.append(_parse_call(toks))
+    return q
+
+
+def _parse_call(toks: _Tokens) -> Call:
+    kind, name, pos = toks.next()
+    if kind != "ident":
+        raise ParseError(f"expected call name at {pos}, got {name!r}")
+    name = _CANONICAL.get(name.lower(), name)
+    call = Call(name)
+    toks.expect("(")
+    first = True
+    npos = 0
+    while True:
+        k, v, _ = toks.peek()
+        if v == ")":
+            toks.next()
+            break
+        if not first:
+            if v == ",":
+                toks.next()
+                k, v, _ = toks.peek()
+                if v == ")":  # trailing comma
+                    toks.next()
+                    break
+            else:
+                raise ParseError(f"expected ',' or ')' in {name} args")
+        first = False
+        _parse_arg(toks, call, name, npos)
+        npos += 1
+    return call
+
+
+def _is_call_start(toks: _Tokens) -> bool:
+    k1, v1, _ = toks.peek()
+    k2, v2, _ = toks.peek(1)
+    return k1 == "ident" and v2 == "("
+
+
+def _parse_arg(toks: _Tokens, call: Call, name: str, npos: int):
+    # nested call
+    if _is_call_start(toks):
+        call.children.append(_parse_call(toks))
+        return
+    k1, v1, p1 = toks.peek()
+    k2, v2, _ = toks.peek(1)
+
+    # conditional triple: value < field < value
+    if (k1 in ("decimal", "timestamp") and v2 in ("<", "<=")):
+        lo = _scalar(k1, v1)
+        toks.next()
+        op1 = toks.next()[1]
+        fk, fv, fp = toks.next()
+        if fk != "ident":
+            raise ParseError(f"expected field in conditional at {fp}")
+        op2 = toks.next()[1]
+        if op2 not in ("<", "<="):
+            raise ParseError(f"expected < or <= in conditional, got {op2!r}")
+        hk, hv, hp = toks.next()
+        hi = _scalar(hk, hv)
+        op = {("<", "<"): OP_BTWN_LT_LT, ("<", "<="): OP_BTWN_LT_LTE,
+              ("<=", "<"): OP_BTWN_LTE_LT, ("<=", "<="): OP_BTWN_LTE_LTE}[
+            (op1, op2)]
+        call.args[fv] = Condition(op, [lo, hi])
+        return
+
+    # key=value / key OP value
+    if k1 == "ident" and v2 in ("=", "><", "<=", ">=", "==", "!=", "<", ">"):
+        toks.next()
+        op = toks.next()[1]
+        value = _parse_value(toks)
+        key = v1
+        if op == "=":
+            if key == "field":
+                key = "_field"
+            call.args[key] = value
+        else:
+            call.args[key] = Condition(op, value)
+        return
+
+    # positional value
+    value = _parse_value(toks)
+    if name in _COL_CALLS and npos == 0:
+        call.args["_col"] = value
+    elif name in _POSFIELD_CALLS and npos == 0 and isinstance(value, str):
+        call.args["_field"] = value
+    elif name in _COL_CALLS and isinstance(value, str) and npos >= 2:
+        call.args["_timestamp"] = value
+    elif k1 == "timestamp":
+        call.args["_timestamp"] = value
+    else:
+        # bare positional (e.g. Store(Row(...), f=1) handled via children;
+        # ClearRow(f=1) has kv form) — keep by position for forward compat
+        call.args[f"_arg{npos}"] = value
+
+
+def _scalar(kind, text):
+    if kind == "decimal":
+        return Decimal(text) if "." in text else int(text)
+    if kind == "timestamp":
+        return text
+    raise ParseError(f"expected scalar, got {text!r}")
+
+
+_ESCAPES = {'\\"': '"', "\\'": "'", "\\\\": "\\", "\\n": "\n", "\\t": "\t"}
+
+
+def _unquote(s: str) -> str:
+    body = s[1:-1]
+    return re.sub(r'\\.|\\', lambda m: _ESCAPES.get(m.group(), m.group()), body)
+
+
+def _parse_value(toks: _Tokens):
+    if _is_call_start(toks):
+        return _parse_call(toks)
+    kind, v, pos = toks.next()
+    if v == "[":
+        items = []
+        while True:
+            k2, v2, _ = toks.peek()
+            if v2 == "]":
+                toks.next()
+                break
+            if items:
+                toks.expect(",")
+            items.append(_parse_value(toks))
+        return items
+    if kind == "decimal":
+        return Decimal(v) if "." in v else int(v)
+    if kind == "timestamp":
+        return v
+    if kind in ("dq", "sq"):
+        return _unquote(v)
+    if kind == "ident":
+        if v == "null":
+            return None
+        if v == "true":
+            return True
+        if v == "false":
+            return False
+        return v  # bare word (key or time literal fragment)
+    raise ParseError(f"unexpected token {v!r} at {pos}")

@@ -16,6 +16,13 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
+
+# The axon sitecustomize force-selects the TPU platform via
+# jax.config.update("jax_platforms", "axon,cpu"), overriding the env
+# var — override it back before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -1,0 +1,334 @@
+"""End-to-end executor tests: write PQL → query PQL → exact results.
+
+Modeled on the reference's executor_test.go golden cases, run against a
+small shard width across multiple shards.
+"""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.executor import Executor, RowResult, ValCount
+from pilosa_tpu.executor.executor import ExecError
+from pilosa_tpu.models import FieldOptions, FieldType, Holder, TimeQuantum
+
+W = 1 << 12  # test shard width
+
+
+@pytest.fixture
+def holder():
+    return Holder(width=W)
+
+
+@pytest.fixture
+def ex(holder):
+    return Executor(holder)
+
+
+def setup_sets(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    idx.create_field("g")
+    # columns spanning 3 shards
+    a = [1, 2, 3, 100, W + 1, W + 50, 2 * W + 7]
+    b = [2, 3, 200, W + 1, 2 * W + 7, 2 * W + 9]
+    for c in a:
+        ex.execute("i", f"Set({c}, f=10)")
+    for c in b:
+        ex.execute("i", f"Set({c}, g=20)")
+    return idx, set(a), set(b)
+
+
+def cols(res) -> set:
+    assert isinstance(res, RowResult)
+    return set(res.columns().tolist())
+
+
+def test_set_and_row(holder, ex):
+    idx, a, b = setup_sets(holder, ex)
+    assert cols(ex.execute("i", "Row(f=10)")[0]) == a
+    assert cols(ex.execute("i", "Row(g=20)")[0]) == b
+
+
+def test_set_changed_flag(holder, ex):
+    holder.create_index("i").create_field("f")
+    assert ex.execute("i", "Set(5, f=1)")[0] is True
+    assert ex.execute("i", "Set(5, f=1)")[0] is False
+
+
+def test_boolean_ops(holder, ex):
+    idx, a, b = setup_sets(holder, ex)
+    assert cols(ex.execute("i", "Intersect(Row(f=10), Row(g=20))")[0]) == a & b
+    assert cols(ex.execute("i", "Union(Row(f=10), Row(g=20))")[0]) == a | b
+    assert cols(ex.execute("i", "Difference(Row(f=10), Row(g=20))")[0]) == a - b
+    assert cols(ex.execute("i", "Xor(Row(f=10), Row(g=20))")[0]) == a ^ b
+
+
+def test_count(holder, ex):
+    idx, a, b = setup_sets(holder, ex)
+    assert ex.execute("i", "Count(Row(f=10))")[0] == len(a)
+    assert ex.execute("i", "Count(Intersect(Row(f=10), Row(g=20)))")[0] == \
+        len(a & b)
+
+
+def test_not_all(holder, ex):
+    idx, a, b = setup_sets(holder, ex)
+    assert cols(ex.execute("i", "Not(Row(f=10))")[0]) == (a | b) - a
+    assert cols(ex.execute("i", "All()")[0]) == a | b
+
+
+def test_clear(holder, ex):
+    idx, a, b = setup_sets(holder, ex)
+    assert ex.execute("i", "Clear(2, f=10)")[0] is True
+    assert ex.execute("i", "Clear(2, f=10)")[0] is False
+    assert cols(ex.execute("i", "Row(f=10)")[0]) == a - {2}
+
+
+def test_shift(holder, ex):
+    holder.create_index("i").create_field("f")
+    for c in [0, 5, W - 1]:
+        ex.execute("i", f"Set({c}, f=1)")
+    got = cols(ex.execute("i", "Shift(Row(f=1), n=2)")[0])
+    # W-1 shifts across the shard boundary and is dropped (single-shard
+    # row semantics, matching reference Row.Shift within segment)
+    assert got == {2, 7}
+
+
+def test_const_row_limit(holder, ex):
+    holder.create_index("i").create_field("f")
+    # shards only exist where data exists (mapReduce visits available
+    # shards, executor.go:6449) — create shards 0 and 1
+    ex.execute("i", f"Set(1, f=1)Set({W + 9}, f=1)")
+    got = cols(ex.execute("i", f"ConstRow(columns=[1, 5, {W + 3}])")[0])
+    assert got == {1, 5, W + 3}
+    lim = ex.execute("i", f"Limit(ConstRow(columns=[1, 5, {W + 3}]), limit=2)")[0]
+    assert cols(lim) == {1, 5}
+    off = ex.execute(
+        "i", f"Limit(ConstRow(columns=[1, 5, {W + 3}]), limit=2, offset=1)")[0]
+    assert cols(off) == {5, W + 3}
+
+
+def test_includes_column(holder, ex):
+    setup_sets(holder, ex)
+    assert ex.execute("i", "IncludesColumn(Row(f=10), column=1)")[0] is True
+    assert ex.execute("i", "IncludesColumn(Row(f=10), column=200)")[0] is False
+
+
+def test_store_clearrow(holder, ex):
+    idx, a, b = setup_sets(holder, ex)
+    ex.execute("i", "Store(Intersect(Row(f=10), Row(g=20)), f=99)")
+    assert cols(ex.execute("i", "Row(f=99)")[0]) == a & b
+    assert ex.execute("i", "ClearRow(f=99)")[0] is True
+    assert cols(ex.execute("i", "Row(f=99)")[0]) == set()
+
+
+def test_mutex_field(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("m", FieldOptions(type=FieldType.MUTEX))
+    ex.execute("i", "Set(3, m=1)")
+    ex.execute("i", "Set(3, m=2)")  # must clear row 1
+    assert cols(ex.execute("i", "Row(m=1)")[0]) == set()
+    assert cols(ex.execute("i", "Row(m=2)")[0]) == {3}
+
+
+def test_bool_field(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("b", FieldOptions(type=FieldType.BOOL))
+    ex.execute("i", "Set(3, b=true)")
+    ex.execute("i", "Set(4, b=false)")
+    assert cols(ex.execute("i", "Row(b=true)")[0]) == {3}
+    assert cols(ex.execute("i", "Row(b=false)")[0]) == {4}
+    ex.execute("i", "Set(3, b=false)")  # flips
+    assert cols(ex.execute("i", "Row(b=true)")[0]) == set()
+    assert cols(ex.execute("i", "Row(b=false)")[0]) == {3, 4}
+
+
+class TestBSI:
+    def setup_index(self, holder, ex):
+        idx = holder.create_index("i")
+        idx.create_field("v", FieldOptions(type=FieldType.INT))
+        self.data = {1: 10, 2: -3, 3: 0, 100: 1000, W + 1: 57, W + 2: -999,
+                     2 * W + 5: 6}
+        for c, val in self.data.items():
+            ex.execute("i", f"Set({c}, v={val})")
+        return idx
+
+    def test_row_eq(self, holder, ex):
+        self.setup_index(holder, ex)
+        assert cols(ex.execute("i", "Row(v=10)")[0]) == {1}
+        assert cols(ex.execute("i", "Row(v == -3)")[0]) == {2}
+        assert cols(ex.execute("i", "Row(v == 12345)")[0]) == set()
+
+    @pytest.mark.parametrize("op,fn", [
+        ("<", lambda v, p: v < p), ("<=", lambda v, p: v <= p),
+        (">", lambda v, p: v > p), (">=", lambda v, p: v >= p),
+        ("!=", lambda v, p: v != p),
+    ])
+    @pytest.mark.parametrize("pred", [-999, -5, 0, 6, 57, 2000])
+    def test_row_compare(self, holder, ex, op, fn, pred):
+        self.setup_index(holder, ex)
+        got = cols(ex.execute("i", f"Row(v {op} {pred})")[0])
+        assert got == {c for c, v in self.data.items() if fn(v, pred)}
+
+    def test_between(self, holder, ex):
+        self.setup_index(holder, ex)
+        got = cols(ex.execute("i", "Row(v >< [-5, 57])")[0])
+        assert got == {c for c, v in self.data.items() if -5 <= v <= 57}
+        got = cols(ex.execute("i", "Row(-5 < v < 57)")[0])
+        assert got == {c for c, v in self.data.items() if -5 < v < 57}
+
+    def test_null_checks(self, holder, ex):
+        self.setup_index(holder, ex)
+        assert cols(ex.execute("i", "Row(v != null)")[0]) == set(self.data)
+        assert cols(ex.execute("i", "Row(v == null)")[0]) == set()
+        # add a column that exists only via another field
+        holder.index("i").create_field("f")
+        ex.execute("i", "Set(777, f=1)")
+        assert cols(ex.execute("i", "Row(v == null)")[0]) == {777}
+
+    def test_sum(self, holder, ex):
+        self.setup_index(holder, ex)
+        res = ex.execute("i", "Sum(field=v)")[0]
+        assert res == ValCount(value=sum(self.data.values()),
+                               count=len(self.data))
+
+    def test_sum_filtered(self, holder, ex):
+        self.setup_index(holder, ex)
+        res = ex.execute("i", "Sum(Row(v < 0), field=v)")[0]
+        negs = [v for v in self.data.values() if v < 0]
+        assert res == ValCount(value=sum(negs), count=len(negs))
+
+    def test_min_max(self, holder, ex):
+        self.setup_index(holder, ex)
+        assert ex.execute("i", "Min(field=v)")[0] == ValCount(
+            value=min(self.data.values()), count=1)
+        assert ex.execute("i", "Max(field=v)")[0] == ValCount(
+            value=max(self.data.values()), count=1)
+
+    def test_min_max_filtered(self, holder, ex):
+        self.setup_index(holder, ex)
+        res = ex.execute("i", "Min(Row(v > 0), field=v)")[0]
+        assert res == ValCount(value=6, count=1)
+
+    def test_distinct(self, holder, ex):
+        self.setup_index(holder, ex)
+        res = ex.execute("i", "Distinct(field=v)")[0]
+        assert res.values == sorted(set(self.data.values()))
+
+    def test_clear_value(self, holder, ex):
+        self.setup_index(holder, ex)
+        ex.execute("i", "Clear(1, v=0)")
+        assert cols(ex.execute("i", "Row(v=10)")[0]) == set()
+        res = ex.execute("i", "Sum(field=v)")[0]
+        assert res.count == len(self.data) - 1
+
+
+def test_decimal_field(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("d", FieldOptions(type=FieldType.DECIMAL, scale=2))
+    vals = {1: "1.50", 2: "-0.25", 3: "10.00", 4: "3.14"}
+    for c, v in vals.items():
+        ex.execute("i", f"Set({c}, d={v})")
+    assert cols(ex.execute("i", "Row(d > 1.5)")[0]) == {3, 4}
+    assert cols(ex.execute("i", "Row(d >= 1.5)")[0]) == {1, 3, 4}
+    assert cols(ex.execute("i", "Row(d < 0)")[0]) == {2}
+    assert cols(ex.execute("i", "Row(d == 3.14)")[0]) == {4}
+    # predicate finer than scale
+    assert cols(ex.execute("i", "Row(d > 1.499)")[0]) == {1, 3, 4}
+    assert cols(ex.execute("i", "Row(d == 1.505)")[0]) == set()
+    s = ex.execute("i", "Sum(field=d)")[0]
+    assert s.value == pytest.approx(14.39) and s.count == 4
+
+
+def test_timestamp_field(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("ts", FieldOptions(type=FieldType.TIMESTAMP))
+    ex.execute("i", "Set(1, ts='2020-01-01T00:00')")
+    ex.execute("i", "Set(2, ts='2021-06-15T12:30')")
+    ex.execute("i", "Set(3, ts='2019-03-01T00:00')")
+    got = cols(ex.execute("i", "Row(ts > '2020-01-01T00:00')")[0])
+    assert got == {2}
+    got = cols(ex.execute("i", "Row(ts >= '2020-01-01T00:00')")[0])
+    assert got == {1, 2}
+    mn = ex.execute("i", "Min(field=ts)")[0]
+    assert mn.value == dt.datetime(2019, 3, 1, tzinfo=dt.timezone.utc)
+
+
+def test_time_field_range(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("t", FieldOptions(
+        type=FieldType.TIME, time_quantum=TimeQuantum("YMD")))
+    ex.execute("i", "Set(1, t=10, 2020-01-15T00:00)")
+    ex.execute("i", "Set(2, t=10, 2020-03-10T00:00)")
+    ex.execute("i", "Set(3, t=10, 2021-06-01T00:00)")
+    # no range: standard view has everything
+    assert cols(ex.execute("i", "Row(t=10)")[0]) == {1, 2, 3}
+    got = cols(ex.execute(
+        "i", "Row(t=10, from='2020-01-01T00:00', to='2020-12-31T00:00')")[0])
+    assert got == {1, 2}
+    got = cols(ex.execute(
+        "i", "Row(t=10, from='2020-02-01T00:00', to='2021-12-31T00:00')")[0])
+    assert got == {2, 3}
+
+
+def test_rows_and_union_rows(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    ex.execute("i", "Set(1, f=3)Set(2, f=5)Set(9, f=7)")
+    assert ex.execute("i", "Rows(f)")[0] == [3, 5, 7]
+    assert ex.execute("i", "Rows(f, limit=2)")[0] == [3, 5]
+    assert ex.execute("i", "Rows(f, previous=3)")[0] == [5, 7]
+    assert ex.execute("i", "Rows(f, column=2)")[0] == [5]
+    assert cols(ex.execute("i", "UnionRows(Rows(f))")[0]) == {1, 2, 9}
+
+
+def test_min_max_row(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    ex.execute("i", "Set(1, f=3)Set(2, f=3)Set(5, f=9)")
+    p = ex.execute("i", "MinRow(f)")[0]
+    assert (p.id, p.count) == (3, 2)
+    p = ex.execute("i", "MaxRow(f)")[0]
+    assert (p.id, p.count) == (9, 1)
+
+
+def test_options_shards(holder, ex):
+    idx, a, b = setup_sets(holder, ex)
+    res = ex.execute("i", "Options(Row(f=10), shards=[0])")[0]
+    assert cols(res) == {c for c in a if c < W}
+
+
+def test_errors(holder, ex):
+    holder.create_index("i").create_field("f")
+    with pytest.raises(ExecError):
+        ex.execute("i", "Row(missing=1)")
+    with pytest.raises(ExecError):
+        ex.execute("i", "Sum(field=f)")  # not a BSI field
+    with pytest.raises(ExecError):
+        ex.execute("nope", "Row(f=1)")
+
+
+def test_multi_statement_query(holder, ex):
+    holder.create_index("i").create_field("f")
+    res = ex.execute("i", "Set(1, f=2)Set(5, f=2)Count(Row(f=2))")
+    assert res == [True, True, 2]
+
+
+def test_nested_distinct_respects_shards(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    # rows 1 and 2 present only in shard 1
+    ex.execute("i", f"Set(1, f=1)Set({W + 1}, f=2)")
+    assert ex.execute("i", "Count(Distinct(field=f))")[0] == 2
+    assert ex.execute(
+        "i", "Options(Count(Distinct(field=f)), shards=[0])")[0] == 1
+
+
+def test_includes_column_respects_shards(holder, ex):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    ex.execute("i", f"Set({W + 1}, f=1)Set(1, f=1)")
+    q = f"IncludesColumn(Row(f=1), column={W + 1})"
+    assert ex.execute("i", q)[0] is True
+    assert ex.execute("i", f"Options({q}, shards=[0])")[0] is False

@@ -1,0 +1,113 @@
+"""Data model tests: fragments, fields, holder schema persistence."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.models import FieldOptions, FieldType, Holder, TimeQuantum
+from pilosa_tpu.models.fragment import Fragment
+from pilosa_tpu.ops import bsi as bsi_ops
+
+W = 1 << 12
+
+
+def test_fragment_set_clear_contains():
+    f = Fragment("i", "f", "standard", 0, width=W)
+    assert f.set_bit(3, 100) is True
+    assert f.set_bit(3, 100) is False
+    assert f.contains(3, 100)
+    assert f.clear_bit(3, 100) is True
+    assert f.clear_bit(3, 100) is False
+    assert not f.contains(3, 100)
+
+
+def test_fragment_bulk_import():
+    f = Fragment("i", "f", "standard", 0, width=W)
+    rows = [1, 1, 2, 2, 2]
+    cols = [10, 20, 10, 30, 40]
+    f.import_bits(rows, cols)
+    assert f.row_count(1) == 2 and f.row_count(2) == 3
+    f.import_bits([1], [10], clear=True)
+    assert f.row_count(1) == 1
+
+
+def test_fragment_set_value_roundtrip():
+    f = Fragment("i", "v", "bsig_v", 0, width=W)
+    f.set_value(5, 8, 100)
+    f.set_value(6, 8, -42)
+    planes = np.asarray(f.device_planes(8))
+    cols, vals = bsi_ops.decode(planes)
+    assert dict(zip(cols.tolist(), vals)) == {5: 100, 6: -42}
+    # overwrite
+    f.set_value(5, 8, 7)
+    cols, vals = bsi_ops.decode(np.asarray(f.device_planes(8)))
+    assert dict(zip(cols.tolist(), vals)) == {5: 7, 6: -42}
+
+
+def test_fragment_import_values_last_write_wins():
+    f = Fragment("i", "v", "bsig_v", 0, width=W)
+    f.import_values([1, 2, 1], [5, 6, 9], depth=8)
+    cols, vals = bsi_ops.decode(np.asarray(f.device_planes(8)))
+    assert dict(zip(cols.tolist(), vals)) == {1: 9, 2: 6}
+    f.import_values([2], [0], depth=8, clear=True)
+    cols, vals = bsi_ops.decode(np.asarray(f.device_planes(8)))
+    assert dict(zip(cols.tolist(), vals)) == {1: 9}
+
+
+def test_field_depth_growth():
+    h = Holder(width=W)
+    idx = h.create_index("i")
+    f = idx.create_field("v", FieldOptions(type=FieldType.INT))
+    f.set_value(1, 3)
+    assert f.bit_depth == 2
+    f.set_value(2, 1000)  # grows depth
+    assert f.bit_depth == 10
+    # older value still readable at new depth
+    frag = f.views[f.bsi_view].fragment(0)
+    cols, vals = bsi_ops.decode(np.asarray(frag.device_planes(f.bit_depth)))
+    assert dict(zip(cols.tolist(), vals)) == {1: 3, 2: 1000}
+
+
+def test_field_min_max_option_depth():
+    h = Holder(width=W)
+    idx = h.create_index("i")
+    f = idx.create_field("v", FieldOptions(type=FieldType.INT,
+                                           min=-1000, max=1000))
+    assert f.bit_depth == 10
+
+
+def test_holder_schema_roundtrip(tmp_path):
+    h = Holder(path=str(tmp_path), width=W)
+    idx = h.create_index("i", keys=False)
+    idx.create_field("s")
+    idx.create_field("v", FieldOptions(type=FieldType.INT, min=0, max=100))
+    idx.create_field("d", FieldOptions(type=FieldType.DECIMAL, scale=3))
+    idx.create_field("t", FieldOptions(type=FieldType.TIME,
+                                       time_quantum=TimeQuantum("YMD")))
+    h.save_schema()
+
+    h2 = Holder(path=str(tmp_path), width=W)
+    h2.load_schema()
+    idx2 = h2.index("i")
+    assert idx2 is not None
+    assert sorted(f.name for f in idx2.public_fields()) == ["d", "s", "t", "v"]
+    assert idx2.field("v").options.type == FieldType.INT
+    assert idx2.field("d").options.scale == 3
+    assert idx2.field("t").options.time_quantum == "YMD"
+
+
+def test_index_duplicate_field_raises():
+    h = Holder(width=W)
+    idx = h.create_index("i")
+    idx.create_field("f")
+    with pytest.raises(ValueError):
+        idx.create_field("f")
+    idx.create_field("f", ok_if_exists=True)
+
+
+def test_timestamp_ns_exact():
+    import datetime as dt
+    opts = FieldOptions(type=FieldType.TIMESTAMP, time_unit="ns")
+    t = dt.datetime(2024, 1, 1, 0, 0, 0, 1, tzinfo=dt.timezone.utc)
+    assert opts.timestamp_to_int(t) == (
+        (t - opts.epoch).days * 86400 + (t - opts.epoch).seconds
+    ) * 10**9 + 1000

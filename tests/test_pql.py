@@ -1,0 +1,144 @@
+"""PQL parser tests — grammar surface per pql/pql.peg."""
+
+import pytest
+from decimal import Decimal
+
+from pilosa_tpu.pql import Call, Condition, ParseError, parse
+
+
+def one(q):
+    query = parse(q)
+    assert len(query.calls) == 1
+    return query.calls[0]
+
+
+def test_row_simple():
+    c = one("Row(f=1)")
+    assert c.name == "Row" and c.args == {"f": 1}
+
+
+def test_row_string_key():
+    c = one('Row(f="abc")')
+    assert c.args == {"f": "abc"}
+    c = one("Row(f='abc')")
+    assert c.args == {"f": "abc"}
+
+
+def test_row_bare_word():
+    c = one("Row(f=abc)")
+    assert c.args == {"f": "abc"}
+
+
+def test_nested_calls():
+    c = one("Count(Intersect(Row(a=1), Row(b=2)))")
+    assert c.name == "Count"
+    inner = c.children[0]
+    assert inner.name == "Intersect"
+    assert [ch.args for ch in inner.children] == [{"a": 1}, {"b": 2}]
+
+
+def test_set_positional():
+    c = one("Set(10, f=1)")
+    assert c.args == {"_col": 10, "f": 1}
+
+
+def test_set_with_timestamp():
+    c = one("Set(10, f=1, 2016-01-01T00:00)")
+    assert c.args["_col"] == 10 and c.args["f"] == 1
+    assert c.args["_timestamp"] == "2016-01-01T00:00"
+
+
+def test_set_string_col():
+    c = one("Set('col-key', f=1)")
+    assert c.args["_col"] == "col-key"
+
+
+def test_condition_ops():
+    for op in ["<", "<=", ">", ">=", "==", "!="]:
+        c = one(f"Row(x {op} 5)")
+        cond = c.args["x"]
+        assert isinstance(cond, Condition)
+        assert cond.op == op and cond.value == 5
+
+
+def test_condition_negative():
+    c = one("Row(x > -5)")
+    assert c.args["x"].value == -5
+
+
+def test_between():
+    c = one("Row(x >< [1, 100])")
+    cond = c.args["x"]
+    assert cond.op == "><" and cond.value == [1, 100]
+
+
+def test_conditional_triple():
+    c = one("Row(5 < x < 10)")
+    cond = c.args["x"]
+    assert cond.op == "<x<" and cond.value == [5, 10]
+    c = one("Row(5 <= x <= 10)")
+    assert c.args["x"].op == "<=x<="
+
+
+def test_posfield():
+    c = one("Sum(field=stars)")
+    assert c.args == {"_field": "stars"}
+    c = one("Sum(stars)")
+    assert c.args == {"_field": "stars"}
+    c = one("Sum(Row(f=1), field=stars)")
+    assert c.args == {"_field": "stars"} and c.children[0].name == "Row"
+    c = one("TopN(stars, n=5)")
+    assert c.args == {"_field": "stars", "n": 5}
+
+
+def test_row_time_range():
+    c = one("Row(f=1, from='2010-01-01T00:00', to='2011-01-01T00:00')")
+    assert c.args["from"] == "2010-01-01T00:00"
+    assert c.args["to"] == "2011-01-01T00:00"
+
+
+def test_decimal_value():
+    c = one("Row(d > 1.5)")
+    assert c.args["d"].value == Decimal("1.5")
+
+
+def test_bool_null_values():
+    c = one("Row(b=true)")
+    assert c.args["b"] is True
+    c = one("Row(b=false)")
+    assert c.args["b"] is False
+    c = one("Row(x != null)")
+    assert c.args["x"].op == "!=" and c.args["x"].value is None
+
+
+def test_list_value():
+    c = one("ConstRow(columns=[1, 2, 3])")
+    assert c.args["columns"] == [1, 2, 3]
+
+
+def test_multiple_calls():
+    q = parse("Set(1, f=2)Set(3, f=4)Count(Row(f=2))")
+    assert [c.name for c in q.calls] == ["Set", "Set", "Count"]
+
+
+def test_canonical_caps():
+    assert one("count(row(f=1))").name == "Count"
+
+
+def test_groupby_rows():
+    c = one("GroupBy(Rows(a), Rows(b), limit=10, aggregate=Sum(field=v))")
+    assert c.name == "GroupBy"
+    assert [ch.name for ch in c.children] == ["Rows", "Rows"]
+    assert c.args["limit"] == 10
+    assert c.args["aggregate"].name == "Sum"
+
+
+def test_parse_errors():
+    for bad in ["Row(", "Row)", "Row(f=)", "Row(f=1", "(f=1)", "Row(f==)"]:
+        with pytest.raises(ParseError):
+            parse(bad)
+
+
+def test_repr_roundtrip():
+    c = one("Count(Intersect(Row(a=1), Row(b=2)))")
+    assert parse(repr(c)).calls[0].name == "Count"

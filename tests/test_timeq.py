@@ -1,0 +1,109 @@
+"""Time-quantum view computation tests (time.go semantics)."""
+
+import datetime as dt
+
+import pytest
+
+from pilosa_tpu.models.schema import TimeQuantum
+from pilosa_tpu.models.timeq import (
+    parse_time,
+    views_by_time,
+    views_by_time_range,
+)
+
+
+def test_views_by_time():
+    t = dt.datetime(2020, 3, 15, 7)
+    assert views_by_time("standard", t, TimeQuantum("YMDH")) == [
+        "standard_2020", "standard_202003", "standard_20200315",
+        "standard_2020031507"]
+    assert views_by_time("standard", t, TimeQuantum("MD")) == [
+        "standard_202003", "standard_20200315"]
+
+
+def test_range_exact_yearly():
+    got = views_by_time_range(
+        "s", dt.datetime(2019, 1, 1), dt.datetime(2022, 1, 1),
+        TimeQuantum("Y"))
+    assert got == ["s_2019", "s_2020", "s_2021"]
+
+
+def test_range_walkup_walkdown():
+    got = views_by_time_range(
+        "s", dt.datetime(2019, 11, 29), dt.datetime(2020, 3, 2),
+        TimeQuantum("YMD"))
+    assert got == [
+        "s_20191129", "s_20191130",  # walk up days to month boundary
+        "s_201912",                  # walk up month to year boundary
+        "s_202001", "s_202002",      # walk down months
+        "s_20200301",                # walk down day
+    ]
+
+
+def test_range_full_year_uses_year_view():
+    got = views_by_time_range(
+        "s", dt.datetime(2019, 1, 1), dt.datetime(2020, 1, 1),
+        TimeQuantum("YMD"))
+    assert got == ["s_2019"]
+
+
+def test_range_hours():
+    got = views_by_time_range(
+        "s", dt.datetime(2020, 1, 1, 22), dt.datetime(2020, 1, 2, 2),
+        TimeQuantum("YMDH"))
+    assert got == ["s_2020010122", "s_2020010123", "s_2020010200",
+                   "s_2020010201"]
+
+
+def _span(view: str):
+    stamp = view.split("_", 1)[1]
+    fmt = {4: "%Y", 6: "%Y%m", 8: "%Y%m%d", 10: "%Y%m%d%H"}[len(stamp)]
+    start = dt.datetime.strptime(stamp, fmt)
+    if len(stamp) == 4:
+        end = start.replace(year=start.year + 1)
+    elif len(stamp) == 6:
+        y, m = (start.year + 1, 1) if start.month == 12 else \
+            (start.year, start.month + 1)
+        end = start.replace(year=y, month=m)
+    elif len(stamp) == 8:
+        end = start + dt.timedelta(days=1)
+    else:
+        end = start + dt.timedelta(hours=1)
+    return start, end
+
+
+@pytest.mark.parametrize("start,end", [
+    (dt.datetime(2019, 5, 14, 3), dt.datetime(2019, 5, 14, 9)),
+    (dt.datetime(2019, 5, 14, 3), dt.datetime(2020, 2, 2, 1)),
+    (dt.datetime(2019, 12, 31, 23), dt.datetime(2020, 1, 1, 1)),
+    (dt.datetime(2018, 1, 1, 0), dt.datetime(2021, 6, 2, 5)),
+    (dt.datetime(2019, 2, 28, 5), dt.datetime(2019, 3, 1, 0)),
+])
+def test_range_coverage_property(start, end):
+    """With the full YMDH quantum the views exactly cover [start_hour,
+    end) with no overlap."""
+    views = views_by_time_range("s", start, end, TimeQuantum("YMDH"))
+    spans = sorted(_span(v) for v in views)
+    # contiguous, non-overlapping
+    for (s1, e1), (s2, e2) in zip(spans, spans[1:]):
+        assert e1 == s2, (views, spans)
+    assert spans[0][0] == start.replace(minute=0, second=0, microsecond=0)
+    assert spans[-1][1] >= end
+    assert spans[-1][0] < end
+
+
+def test_parse_time_forms():
+    assert parse_time("2020-01-02T03:04") == dt.datetime(2020, 1, 2, 3, 4)
+    assert parse_time("2020-01-02") == dt.datetime(2020, 1, 2)
+    assert parse_time("2020-01") == dt.datetime(2020, 1, 1)
+    assert parse_time("2020") == dt.datetime(2020, 1, 1)
+    with pytest.raises(ValueError):
+        parse_time("garbage")
+
+
+def test_range_leap_day_start():
+    # Feb 29 start must not crash year arithmetic (Go normalizes to Mar 1)
+    got = views_by_time_range(
+        "s", dt.datetime(2020, 2, 29), dt.datetime(2022, 1, 1),
+        TimeQuantum("Y"))
+    assert got  # coarse overcoverage allowed; must not raise
