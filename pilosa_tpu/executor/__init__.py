@@ -7,14 +7,16 @@ placement (parallel/) instead of HTTP mapReduce.
 
 from pilosa_tpu.executor.results import (
     DistinctValues,
+    ExtractedTable,
     GroupCount,
     Pair,
     RowResult,
+    SortedRow,
     ValCount,
 )
 from pilosa_tpu.executor.executor import Executor
 
 __all__ = [
     "Executor", "RowResult", "ValCount", "DistinctValues", "Pair",
-    "GroupCount",
+    "GroupCount", "SortedRow", "ExtractedTable",
 ]

@@ -1,0 +1,460 @@
+"""Advanced query ops: TopN/TopK, GroupBy, Percentile, Sort, Extract,
+Delete.
+
+Reference semantics (behavior, not code):
+- TopN/TopK — executor.go:2357-2777, fragment.go:1317-1497.  The
+  reference approximates TopN through the per-fragment rank cache
+  (cache.go) and merges container iterators per shard; here row
+  counts are computed EXACTLY with chunked device batches
+  (rows x shards intersection popcounts), which subsumes both calls.
+- GroupBy — executor.go:3176-3986, 8617-8940: cartesian product of
+  Rows() of each child field, count = intersection count, optional
+  filter and Sum aggregate, having on count.
+- Percentile — executor.go:1310-1601: binary search on
+  Count(Row(field < x)) against desiredLess/desiredGreater.
+- Sort — executor.go:9321: columns of a filter ordered by BSI value.
+- Extract — executor.go:4758: per-column field values for a filter.
+- Delete — removes columns from every field + existence.
+
+All device work is fixed-shape chunked batches; cross-shard and
+cross-chunk accumulation happens host-side in exact ints.
+"""
+
+from __future__ import annotations
+
+import itertools
+from fractions import Fraction
+
+import numpy as np
+import jax.numpy as jnp
+
+from pilosa_tpu.executor.results import (
+    ExtractedTable,
+    GroupCount,
+    Pair,
+    SortedRow,
+    ValCount,
+)
+from pilosa_tpu.models.field import FALSE_ROW, TRUE_ROW
+from pilosa_tpu.models.schema import FieldType
+from pilosa_tpu.models.view import VIEW_STANDARD
+from pilosa_tpu.ops import bitmap as bm
+from pilosa_tpu.ops import bsi as bsi_ops
+from pilosa_tpu.pql.ast import Call, Condition
+
+_ROW_CHUNK = 256      # row tiles per device batch in count scans
+_SUM_CHUNK = 8        # combo masks per device batch when aggregating BSI
+
+
+def _trunc_div(a: int, b: int) -> int:
+    """Go-style truncating integer division (rounds toward zero)."""
+    q = a // b
+    if q < 0 and q * b != a:
+        q += 1
+    return q
+
+
+class AdvancedOps:
+    """Mixin for Executor: the data-dependent query calls."""
+
+    # -- shared helpers -------------------------------------------------
+
+    def _field_views(self, f, from_=None, to=None) -> list[str]:
+        if from_ is None and to is None:
+            return [VIEW_STANDARD]
+        return f.views_for_range(from_, to)
+
+    def _row_tiles(self, f, shard: int, row_ids, views) -> jnp.ndarray:
+        """(R, W) stacked tiles for row_ids, unioned across views."""
+        acc = None
+        for vn in views:
+            v = f.views.get(vn)
+            frag = v.fragment(shard) if v else None
+            if frag is None:
+                continue
+            tiles = frag.device_rows(list(row_ids))
+            acc = tiles if acc is None else bm.union(acc, tiles)
+        if acc is None:
+            acc = jnp.zeros((len(row_ids), f.width // 32), dtype=jnp.uint32)
+        return acc
+
+    def _all_row_ids(self, idx, f, shards) -> list[int]:
+        ids: set[int] = set()
+        v = f.views.get(VIEW_STANDARD)
+        if v is None:
+            return []
+        for shard in self._shard_list(idx, shards):
+            frag = v.fragment(shard)
+            if frag is not None:
+                ids.update(frag.row_ids)
+        return sorted(ids)
+
+    # -- TopN / TopK ----------------------------------------------------
+
+    def _execute_topnk(self, idx, call: Call, shards, pre, n_key: str):
+        fname = call.arg("_field")
+        f = idx.field(fname) if fname else None
+        if f is None:
+            raise self._err(f"{call.name} requires a field")
+        n = call.arg(n_key)
+        ids = call.arg("ids")
+        views = self._field_views(f, call.arg("from"), call.arg("to"))
+        row_ids = ([int(r) for r in ids] if ids is not None
+                   else self._all_row_ids(idx, f, shards))
+        if not row_ids:
+            return []
+        counts = {r: 0 for r in row_ids}
+        filter_call = call.children[0] if call.children else None
+        for shard in self._shard_list(idx, shards):
+            filt = (self._bitmap_call_shard(idx, filter_call, shard, pre)
+                    if filter_call else None)
+            for i in range(0, len(row_ids), _ROW_CHUNK):
+                chunk = row_ids[i:i + _ROW_CHUNK]
+                tiles = self._row_tiles(f, shard, chunk, views)
+                if filt is not None:
+                    tiles = bm.intersect(tiles, filt[None, :])
+                got = np.asarray(bm.count(tiles), dtype=np.int64)
+                for r, c in zip(chunk, got):
+                    counts[r] += int(c)
+        pairs = [Pair(id=r, count=c) for r, c in counts.items()
+                 if c > 0 or ids is not None]
+        pairs.sort(key=lambda p: (-p.count, p.id))
+        if n is not None:
+            pairs = pairs[: int(n)]
+        return pairs
+
+    # -- GroupBy --------------------------------------------------------
+
+    def _execute_groupby(self, idx, call: Call, shards, pre):
+        rows_calls = [c for c in call.children if c.name == "Rows"]
+        if not rows_calls:
+            raise self._err("GroupBy requires at least one Rows() child")
+        fields, row_lists = [], []
+        for rc in rows_calls:
+            fname = rc.arg("_field")
+            f = idx.field(fname) if fname else None
+            if f is None:
+                raise self._err("Rows requires a valid field")
+            fields.append(f)
+            row_lists.append(self._execute_rows(idx, rc, shards))
+        if any(not rl for rl in row_lists):
+            return []
+
+        filter_call = call.arg("filter")
+        agg_call = call.arg("aggregate")
+        agg_field = None
+        if agg_call is not None:
+            if not isinstance(agg_call, Call) or agg_call.name not in (
+                    "Sum", "Count"):
+                raise self._err("GroupBy aggregate must be Sum(...) or "
+                                "Count(Distinct(...))")
+            if agg_call.name == "Sum":
+                agg_field = self._bsi_field(idx, agg_call.arg("_field"))
+            else:
+                raise self._err(
+                    "GroupBy aggregate Count(Distinct) not yet supported")
+
+        combos = list(itertools.product(*[range(len(rl))
+                                          for rl in row_lists]))
+        counts = np.zeros(len(combos), dtype=np.int64)
+        agg_pos = agg_neg = None
+        if agg_field is not None:
+            depth = agg_field.bit_depth
+            agg_pos = np.zeros((len(combos), depth), dtype=np.int64)
+            agg_neg = np.zeros((len(combos), depth), dtype=np.int64)
+
+        combo_idx = np.array(combos, dtype=np.int64)  # (C, nf)
+        for shard in self._shard_list(idx, shards):
+            filt = (self._bitmap_call_shard(idx, filter_call, shard, pre)
+                    if filter_call is not None else None)
+            tiles_per_field = [
+                self._row_tiles(f, shard, rl, [VIEW_STANDARD])
+                for f, rl in zip(fields, row_lists)]
+            planes = None
+            if agg_field is not None:
+                v = agg_field.views.get(agg_field.bsi_view)
+                frag = v.fragment(shard) if v else None
+                if frag is not None:
+                    planes = frag.device_planes(agg_field.bit_depth)
+            chunk = _SUM_CHUNK if agg_field is not None else _ROW_CHUNK
+            for i in range(0, len(combos), chunk):
+                sel = combo_idx[i:i + chunk]
+                mask = tiles_per_field[0][sel[:, 0]]
+                for fi in range(1, len(fields)):
+                    mask = bm.intersect(mask, tiles_per_field[fi][sel[:, fi]])
+                if filt is not None:
+                    mask = bm.intersect(mask, filt[None, :])
+                counts[i:i + chunk] += np.asarray(bm.count(mask),
+                                                  dtype=np.int64)
+                if planes is not None:
+                    exists = planes[0][None, :] & mask
+                    sign = planes[1]
+                    pos = exists & ~sign[None, :]
+                    neg = exists & sign[None, :]
+                    mag = planes[2:]
+                    # (C, P) per-plane popcounts by sign
+                    pos_pc = bm.count(mag[None, :, :] & pos[:, None, :])
+                    neg_pc = bm.count(mag[None, :, :] & neg[:, None, :])
+                    agg_pos[i:i + chunk] += np.asarray(pos_pc, dtype=np.int64)
+                    agg_neg[i:i + chunk] += np.asarray(neg_pc, dtype=np.int64)
+
+        having = call.arg("having")
+        limit = call.arg("limit")
+        out = []
+        for ci, combo in enumerate(combos):
+            cnt = int(counts[ci])
+            if cnt == 0:
+                continue
+            group = [{"field": f.name, "row_id": rl[gi]}
+                     for f, rl, gi in zip(fields, row_lists, combo)]
+            agg = None
+            if agg_field is not None:
+                total = sum((int(p) - int(g)) << b for b, (p, g) in
+                            enumerate(zip(agg_pos[ci], agg_neg[ci])))
+                agg = agg_field.int_to_value(total)
+            gc = GroupCount(group=group, count=cnt, agg=agg)
+            if having is not None and not self._having_ok(gc, having):
+                continue
+            out.append(gc)
+            if limit is not None and len(out) >= int(limit):
+                break
+        return out
+
+    def _having_ok(self, gc: GroupCount, having) -> bool:
+        if not isinstance(having, Call) or having.name != "Condition":
+            raise self._err("having must be Condition(...)")
+        key, cond = having.condition_field()
+        if key not in ("count", "sum"):
+            raise self._err(f"having supports count/sum, got {key}")
+        val = gc.count if key == "count" else gc.agg
+        if val is None:
+            raise self._err(
+                "having on sum requires aggregate=Sum(field=...)")
+        import operator
+        from pilosa_tpu.pql import ast as past
+        if past.is_between(cond):
+            lo, hi = past.between_bounds_inclusive(cond)
+            return lo <= val <= hi
+        ops = {"==": operator.eq, "!=": operator.ne, "<": operator.lt,
+               "<=": operator.le, ">": operator.gt, ">=": operator.ge}
+        return ops[cond.op](val, cond.value)
+
+    # -- Percentile -----------------------------------------------------
+
+    def _execute_percentile(self, idx, call: Call, shards, pre):
+        nth = call.arg("nth")
+        if nth is None:
+            raise self._err("Percentile(): nth required")
+        nth = float(nth)
+        if not 0 <= nth <= 100:
+            raise self._err("Percentile(): nth must be in [0, 100]")
+        fname = call.arg("_field")
+        f = self._bsi_field(idx, fname) if fname else None
+        if f is None:
+            raise self._err("Percentile(): field required")
+        filter_call = call.arg("filter")
+
+        def count_cond(op, stored: int) -> int:
+            scale = 10 ** (f.options.scale
+                           if f.options.type == FieldType.DECIMAL else 0)
+            cond = Condition(op, Fraction(stored, scale))
+            row = Call("Row", args={f.name: cond})
+            tree = (Call("Intersect", children=[row, filter_call])
+                    if filter_call is not None else row)
+            return self._reduce_count(idx, tree, shards, pre)
+
+        nn_row = Call("Row", args={f.name: Condition("!=", None)})
+        total_tree = (Call("Intersect", children=[filter_call, nn_row])
+                      if filter_call is not None else nn_row)
+        total = self._reduce_count(idx, total_tree, shards, pre)
+        if total == 0:
+            return None
+        desired_less = int(total * nth / 100.0)
+        desired_greater = int(total * (100.0 - nth) / 100.0)
+
+        mm_call = Call("Min", args={"_field": f.name},
+                       children=[filter_call] if filter_call else [])
+        lo_vc = self._execute_minmax(idx, mm_call, shards, True, pre)
+        if desired_greater != 0 and desired_less == 0:
+            return lo_vc
+        mm_call = Call("Max", args={"_field": f.name},
+                       children=[filter_call] if filter_call else [])
+        hi_vc = self._execute_minmax(idx, mm_call, shards, False, pre)
+        if desired_greater == 0:
+            return hi_vc
+
+        lo = f.value_to_int(lo_vc.value) if not isinstance(
+            lo_vc.value, (int,)) else lo_vc.value
+        hi = f.value_to_int(hi_vc.value) if not isinstance(
+            hi_vc.value, (int,)) else hi_vc.value
+        possible = lo
+        broke = False
+        while lo < hi:
+            # Go-style midpoint without overflow: min/2 + max/2 +
+            # (min%2 + max%2)/2 with truncated div/rem
+            lo_rem = lo - _trunc_div(lo, 2) * 2
+            hi_rem = hi - _trunc_div(hi, 2) * 2
+            possible = (_trunc_div(lo, 2) + _trunc_div(hi, 2) +
+                        _trunc_div(lo_rem + hi_rem, 2))
+            if count_cond("<", possible) > desired_less:
+                hi = possible - 1
+                continue
+            if count_cond(">", possible) > desired_greater:
+                lo = possible + 1
+                continue
+            broke = True
+            break
+        if not broke:
+            # Divergence from the reference: when the search converges
+            # without both conditions holding, executor.go:1552 returns
+            # the stale last midpoint; we return the converged bound,
+            # which is at least as close to the requested percentile.
+            possible = lo
+        return ValCount(value=f.int_to_value(possible), count=1)
+
+    # -- Sort -----------------------------------------------------------
+
+    def _execute_sort(self, idx, call: Call, shards, pre):
+        fname = call.arg("_field") or call.arg("field")
+        f = self._bsi_field(idx, fname) if fname else None
+        if f is None:
+            raise self._err("Sort requires a BSI field")
+        desc = bool(call.arg("sort-desc", False))
+        filter_call = call.children[0] if call.children else None
+        all_cols, all_vals = [], []
+        for shard in self._shard_list(idx, shards):
+            v = f.views.get(f.bsi_view)
+            frag = v.fragment(shard) if v else None
+            if frag is None:
+                continue
+            cols, vals = bsi_ops.decode(
+                np.asarray(frag.device_planes(f.bit_depth)))
+            if filter_call is not None:
+                filt = np.asarray(self._bitmap_call_shard(
+                    idx, filter_call, shard, pre))
+                fcols = set(bm.to_columns(filt).tolist())
+                keep = [i for i, c in enumerate(cols.tolist())
+                        if c in fcols]
+                cols = cols[keep]
+                vals = [vals[i] for i in keep]
+            base = shard * idx.width
+            all_cols.extend(int(c) + base for c in cols)
+            all_vals.extend(vals)
+        order = sorted(range(len(all_cols)),
+                       key=lambda i: (-all_vals[i] if desc else all_vals[i],
+                                      all_cols[i]))
+        offset = int(call.arg("offset", 0))
+        limit = call.arg("limit")
+        end = None if limit is None else offset + int(limit)
+        order = order[offset:end]
+        return SortedRow(
+            columns=[all_cols[i] for i in order],
+            values=[f.int_to_value(all_vals[i]) for i in order])
+
+    # -- Extract --------------------------------------------------------
+
+    def _execute_extract(self, idx, call: Call, shards, pre):
+        if not call.children:
+            raise self._err("Extract requires a filter call")
+        filter_call = call.children[0]
+        bad = [c.name for c in call.children[1:] if c.name != "Rows"]
+        if bad:
+            raise self._err(
+                f"Extract children after the filter must be Rows(), got {bad}")
+        rows_calls = call.children[1:]
+        fnames = []
+        for rc in rows_calls:
+            fname = rc.arg("_field")
+            if fname is None or idx.field(fname) is None:
+                raise self._err("Extract Rows() requires a valid field")
+            fnames.append(fname)
+
+        if filter_call.name == "Sort":
+            # Sort keeps its ordering through Extract (executor.go:4762)
+            sorted_row = self._execute_sort(idx, filter_call, shards, pre)
+            columns = sorted_row.columns
+        else:
+            # general dispatch so cross-shard filters (Limit, nested
+            # Distinct, ...) work as Extract filters
+            row = self._execute_call(idx, filter_call, shards, pre)
+            if not hasattr(row, "columns"):
+                raise self._err(
+                    f"Extract filter must produce a row, got {filter_call.name}")
+            columns = row.columns().tolist()
+
+        col_values: dict[int, list] = {c: [] for c in columns}
+        # group filter columns by shard once; both branches touch only
+        # the shards the filter actually hits
+        by_shard: dict[int, list[int]] = {}
+        for c in columns:
+            by_shard.setdefault(c // idx.width, []).append(c)
+        for fname in fnames:
+            f = idx.field(fname)
+            t = f.options.type
+            if t.is_bsi:
+                vals = {}
+                v = f.views.get(f.bsi_view)
+                for shard in sorted(by_shard):
+                    frag = v.fragment(shard) if v else None
+                    if frag is None:
+                        continue
+                    cols, values = bsi_ops.decode(
+                        np.asarray(frag.device_planes(f.bit_depth)))
+                    base = shard * idx.width
+                    vals.update((int(c) + base, f.int_to_value(val))
+                                for c, val in zip(cols, values))
+                for c in columns:
+                    col_values[c].append(vals.get(c))
+            else:
+                membership: dict[int, list] = {c: [] for c in columns}
+                v = f.views.get(VIEW_STANDARD)
+                for shard, cs in sorted(by_shard.items()):
+                    frag = v.fragment(shard) if v else None
+                    if frag is None:
+                        continue
+                    local = np.array([c % idx.width for c in cs],
+                                     dtype=np.int64)
+                    w_i = local >> 5
+                    b_i = (local & 31).astype(np.uint32)
+                    for r in frag.row_ids:
+                        words = frag.row_words(r)
+                        hits = ((words[w_i] >> b_i) & 1).astype(bool)
+                        for c, h in zip(cs, hits):
+                            if h:
+                                membership[c].append(r)
+                for c in columns:
+                    rows = membership[c]
+                    if t == FieldType.BOOL:
+                        col_values[c].append(
+                            True if TRUE_ROW in rows else
+                            False if FALSE_ROW in rows else None)
+                    elif t == FieldType.MUTEX:
+                        col_values[c].append(rows[0] if rows else None)
+                    else:
+                        col_values[c].append(rows)
+        return ExtractedTable(
+            fields=fnames,
+            columns=[{"column": c, "rows": col_values[c]} for c in columns])
+
+    # -- Delete ---------------------------------------------------------
+
+    def _execute_delete(self, idx, call: Call, pre):
+        """Delete the columns matched by the child bitmap from every
+        field (executor.go:9050 delete-records semantics)."""
+        child = self._only_child(call)
+        changed = False
+        for shard in self._shard_list(idx, None):
+            words = np.asarray(self._bitmap_call_shard(
+                idx, child, shard, pre))
+            if not words.any():
+                continue
+            for f in idx.fields.values():
+                for v in f.views.values():
+                    frag = v.fragment(shard)
+                    if frag is not None:
+                        changed |= frag.clear_columns(words)
+        return changed
+
+    def _err(self, msg):
+        from pilosa_tpu.executor.executor import ExecError
+        return ExecError(msg)

@@ -53,7 +53,10 @@ class ExecError(Exception):
 _WRITE_CALLS = {"Set", "Clear", "Store", "ClearRow", "Delete"}
 
 
-class Executor:
+from pilosa_tpu.executor.advanced import AdvancedOps
+
+
+class Executor(AdvancedOps):
     def __init__(self, holder: Holder):
         self.holder = holder
 
@@ -100,6 +103,18 @@ class Executor:
             return self._execute_includes_column(idx, call, shards, pre)
         if name == "Limit":
             return self._execute_limit(idx, call, shards, pre)
+        if name == "TopN":
+            return self._execute_topnk(idx, call, shards, pre, "n")
+        if name == "TopK":
+            return self._execute_topnk(idx, call, shards, pre, "k")
+        if name == "GroupBy":
+            return self._execute_groupby(idx, call, shards, pre)
+        if name == "Percentile":
+            return self._execute_percentile(idx, call, shards, pre)
+        if name == "Sort":
+            return self._execute_sort(idx, call, shards, pre)
+        if name == "Extract":
+            return self._execute_extract(idx, call, shards, pre)
         # bitmap-producing calls
         return self._bitmap_result(idx, call, shards, pre)
 
@@ -590,6 +605,8 @@ class Executor:
             return self._execute_store(idx, call, pre)
         if name == "ClearRow":
             return self._execute_clear_row(idx, call)
+        if name == "Delete":
+            return self._execute_delete(idx, call, pre)
         raise ExecError(f"write call not yet supported: {name}")
 
     def _set_col(self, call) -> int:

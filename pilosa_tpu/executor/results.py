@@ -105,3 +105,18 @@ class GroupCount:
     group: list[dict]  # [{"field":..., "row_id":... or "value":...}, ...]
     count: int = 0
     agg: Any = None
+
+
+@dataclass
+class SortedRow:
+    """Sort result (executor.go:9540 SortedRow): columns ordered by a
+    BSI field's value, with the values carried alongside."""
+    columns: list = field(default_factory=list)
+    values: list = field(default_factory=list)
+
+
+@dataclass
+class ExtractedTable:
+    """Extract result (executor.go:4205 ExtractedTable)."""
+    fields: list = field(default_factory=list)
+    columns: list = field(default_factory=list)  # [{"column", "rows"}]

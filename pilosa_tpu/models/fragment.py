@@ -147,6 +147,18 @@ class Fragment:
             plane |= bm.from_columns(
                 cols[(mags >> np.uint64(i)) & np.uint64(1) == 1], self.width)
 
+    def clear_columns(self, mask_words: np.ndarray) -> bool:
+        """Clear every bit in the masked columns across ALL rows
+        (Delete-records path).  Returns True if anything changed."""
+        inv = ~np.asarray(mask_words, dtype=np.uint32)
+        changed = False
+        for r in list(self._rows):
+            row = self._rows[r]
+            if (row & ~inv).any():
+                self._row_mut(r)[:] = row & inv
+                changed = True
+        return changed
+
     # -- reads --------------------------------------------------------------
 
     @property

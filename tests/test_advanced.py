@@ -1,0 +1,253 @@
+"""TopN/TopK/GroupBy/Percentile/Sort/Extract/Delete tests vs naive
+ground truth (executor.go:2357-2777, 3176-3986, 1310, 9321, 4758)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.executor import Executor, SortedRow, ValCount
+from pilosa_tpu.models import FieldOptions, FieldType, Holder
+
+W = 1 << 12
+
+
+@pytest.fixture
+def holder():
+    return Holder(width=W)
+
+
+@pytest.fixture
+def ex(holder):
+    return Executor(holder)
+
+
+def make_data(holder, ex, rng, n=500, n_rows=8):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    idx.create_field("g")
+    idx.create_field("v", FieldOptions(type=FieldType.INT))
+    cols = np.unique(rng.integers(0, 3 * W, size=n))
+    frows = rng.integers(0, n_rows, size=cols.size)
+    grows = rng.integers(0, 3, size=cols.size)
+    vals = rng.integers(-100, 100, size=cols.size)
+    idx.field("f").import_bits(frows, cols)
+    idx.field("g").import_bits(grows, cols)
+    idx.field("v").import_values(cols, vals)
+    idx.mark_columns_exist(cols.tolist())
+    data = {}
+    for c, fr, gr, vv in zip(cols.tolist(), frows.tolist(), grows.tolist(),
+                             vals.tolist()):
+        data[c] = (fr, gr, vv)
+    return idx, data
+
+
+class TestTopN:
+    def test_topn_all(self, holder, ex, rng):
+        idx, data = make_data(holder, ex, rng)
+        got = ex.execute("i", "TopN(f)")[0]
+        from collections import Counter
+        expect = Counter(fr for fr, _, _ in data.values())
+        expect_sorted = sorted(expect.items(), key=lambda kv: (-kv[1], kv[0]))
+        assert [(p.id, p.count) for p in got] == expect_sorted
+
+    def test_topn_n(self, holder, ex, rng):
+        idx, data = make_data(holder, ex, rng)
+        got = ex.execute("i", "TopN(f, n=3)")[0]
+        assert len(got) == 3
+        counts = [p.count for p in got]
+        assert counts == sorted(counts, reverse=True)
+
+    def test_topn_filtered(self, holder, ex, rng):
+        idx, data = make_data(holder, ex, rng)
+        got = ex.execute("i", "TopN(f, Row(g=1), n=2)")[0]
+        from collections import Counter
+        expect = Counter(fr for fr, gr, _ in data.values() if gr == 1)
+        expect_sorted = sorted(expect.items(), key=lambda kv: (-kv[1], kv[0]))
+        assert [(p.id, p.count) for p in got] == expect_sorted[:2]
+
+    def test_topk_same_as_topn(self, holder, ex, rng):
+        idx, data = make_data(holder, ex, rng)
+        a = ex.execute("i", "TopN(f, n=4)")[0]
+        b = ex.execute("i", "TopK(f, k=4)")[0]
+        assert [(p.id, p.count) for p in a] == [(p.id, p.count) for p in b]
+
+    def test_topn_ids(self, holder, ex, rng):
+        idx, data = make_data(holder, ex, rng)
+        got = ex.execute("i", "TopN(f, ids=[0, 1])")[0]
+        from collections import Counter
+        expect = Counter(fr for fr, _, _ in data.values())
+        assert {p.id: p.count for p in got} == {0: expect[0], 1: expect[1]}
+
+
+class TestGroupBy:
+    def naive_groups(self, data, filt=None):
+        from collections import Counter
+        c = Counter()
+        sums = Counter()
+        for col, (fr, gr, vv) in data.items():
+            if filt is not None and not filt(col):
+                continue
+            c[(fr, gr)] += 1
+            sums[(fr, gr)] += vv
+        return c, sums
+
+    def test_groupby_two_fields(self, holder, ex, rng):
+        idx, data = make_data(holder, ex, rng)
+        got = ex.execute("i", "GroupBy(Rows(f), Rows(g))")[0]
+        expect, _ = self.naive_groups(data)
+        got_map = {(g.group[0]["row_id"], g.group[1]["row_id"]): g.count
+                   for g in got}
+        assert got_map == {k: v for k, v in expect.items() if v > 0}
+        # iteration order: first field outer
+        keys = [(g.group[0]["row_id"], g.group[1]["row_id"]) for g in got]
+        assert keys == sorted(keys)
+
+    def test_groupby_filter(self, holder, ex, rng):
+        idx, data = make_data(holder, ex, rng)
+        got = ex.execute("i", "GroupBy(Rows(g), filter=Row(v > 0))")[0]
+        from collections import Counter
+        expect = Counter(gr for _, gr, vv in data.values() if vv > 0)
+        assert {g.group[0]["row_id"]: g.count for g in got} == \
+            {k: v for k, v in expect.items() if v > 0}
+
+    def test_groupby_aggregate_sum(self, holder, ex, rng):
+        idx, data = make_data(holder, ex, rng)
+        got = ex.execute(
+            "i", "GroupBy(Rows(g), aggregate=Sum(field=v))")[0]
+        _, sums = self.naive_groups(data)
+        from collections import Counter
+        expect_sum = Counter()
+        for col, (fr, gr, vv) in data.items():
+            expect_sum[gr] += vv
+        for g in got:
+            assert g.agg == expect_sum[g.group[0]["row_id"]]
+
+    def test_groupby_having_limit(self, holder, ex, rng):
+        idx, data = make_data(holder, ex, rng)
+        from collections import Counter
+        expect = Counter(fr for fr, _, _ in data.values())
+        thresh = int(np.median(list(expect.values())))
+        got = ex.execute(
+            "i", f"GroupBy(Rows(f), having=Condition(count > {thresh}))")[0]
+        assert {g.group[0]["row_id"] for g in got} == \
+            {k for k, v in expect.items() if v > thresh}
+        got = ex.execute("i", "GroupBy(Rows(f), limit=2)")[0]
+        assert len(got) == 2
+
+
+class TestPercentile:
+    def test_median_odd(self, holder, ex):
+        idx = holder.create_index("i")
+        idx.create_field("v", FieldOptions(type=FieldType.INT))
+        for c, v in enumerate([10, 20, 30, 40, 50]):
+            ex.execute("i", f"Set({c}, v={v})")
+        res = ex.execute("i", "Percentile(field=v, nth=50)")[0]
+        assert res.value == 30
+
+    def test_p0_p100(self, holder, ex, rng):
+        idx, data = make_data(holder, ex, rng)
+        vals = [vv for _, _, vv in data.values()]
+        assert ex.execute("i", "Percentile(field=v, nth=0)")[0].value == \
+            min(vals)
+        assert ex.execute("i", "Percentile(field=v, nth=100)")[0].value == \
+            max(vals)
+
+    def test_median_properties(self, holder, ex, rng):
+        idx, data = make_data(holder, ex, rng)
+        vals = sorted(vv for _, _, vv in data.values())
+        res = ex.execute("i", "Percentile(field=v, nth=50)")[0]
+        n = len(vals)
+        less = sum(1 for v in vals if v < res.value)
+        greater = sum(1 for v in vals if v > res.value)
+        assert less <= n // 2 and greater <= n // 2
+
+    def test_percentile_filtered(self, holder, ex, rng):
+        idx, data = make_data(holder, ex, rng)
+        res = ex.execute(
+            "i", "Percentile(field=v, nth=0, filter=Row(v > 0))")[0]
+        assert res.value == min(vv for _, _, vv in data.values() if vv > 0)
+
+    def test_percentile_empty(self, holder, ex):
+        idx = holder.create_index("i")
+        idx.create_field("v", FieldOptions(type=FieldType.INT))
+        assert ex.execute("i", "Percentile(field=v, nth=50)")[0] is None
+
+
+class TestSort:
+    def test_sort_asc_desc(self, holder, ex, rng):
+        idx, data = make_data(holder, ex, rng)
+        got = ex.execute("i", "Sort(All(), field=v)")[0]
+        assert isinstance(got, SortedRow)
+        expect = sorted(data.items(), key=lambda kv: (kv[1][2], kv[0]))
+        assert got.columns == [c for c, _ in expect]
+        assert got.values == [v[2] for _, v in expect]
+        got = ex.execute("i", "Sort(All(), field=v, sort-desc=true)")[0]
+        expect = sorted(data.items(), key=lambda kv: (-kv[1][2], kv[0]))
+        assert got.columns == [c for c, _ in expect]
+
+    def test_sort_limit_offset(self, holder, ex, rng):
+        idx, data = make_data(holder, ex, rng)
+        full = ex.execute("i", "Sort(All(), field=v)")[0]
+        part = ex.execute("i", "Sort(All(), field=v, limit=5, offset=2)")[0]
+        assert part.columns == full.columns[2:7]
+
+    def test_sort_filtered(self, holder, ex, rng):
+        idx, data = make_data(holder, ex, rng)
+        got = ex.execute("i", "Sort(Row(g=1), field=v)")[0]
+        expect = sorted(((c, v[2]) for c, v in data.items() if v[1] == 1),
+                        key=lambda kv: (kv[1], kv[0]))
+        assert got.columns == [c for c, _ in expect]
+
+
+class TestExtract:
+    def test_extract_basic(self, holder, ex, rng):
+        idx, data = make_data(holder, ex, rng)
+        some = sorted(data)[:5]
+        cols_arg = ", ".join(str(c) for c in some)
+        got = ex.execute(
+            "i", f"Extract(ConstRow(columns=[{cols_arg}]), Rows(f), Rows(v))")[0]
+        assert got.fields == ["f", "v"]
+        for entry in got.columns:
+            c = entry["column"]
+            fr, gr, vv = data[c]
+            assert entry["rows"][0] == [fr]
+            assert entry["rows"][1] == vv
+
+    def test_extract_sorted(self, holder, ex, rng):
+        idx, data = make_data(holder, ex, rng)
+        got = ex.execute(
+            "i", "Extract(Sort(All(), field=v, limit=3), Rows(v))")[0]
+        expect = sorted(data.items(), key=lambda kv: (kv[1][2], kv[0]))[:3]
+        assert [e["column"] for e in got.columns] == [c for c, _ in expect]
+
+
+def test_delete(holder, ex, rng):
+    idx, data = make_data(holder, ex, rng)
+    before = ex.execute("i", "Count(All())")[0]
+    assert ex.execute("i", "Delete(Row(g=1))")[0] is True
+    n_g1 = sum(1 for _, gr, _ in data.values() if gr == 1)
+    assert ex.execute("i", "Count(All())")[0] == before - n_g1
+    assert ex.execute("i", "Count(Row(g=1))")[0] == 0
+    # values of deleted columns are gone too
+    s = ex.execute("i", "Sum(field=v)")[0]
+    assert s.value == sum(vv for _, gr, vv in data.values() if gr != 1)
+
+
+def test_extract_limit_filter(holder, ex, rng):
+    idx, data = make_data(holder, ex, rng)
+    got = ex.execute("i", "Extract(Limit(All(), limit=3), Rows(v))")[0]
+    expect = sorted(data)[:3]
+    assert [e["column"] for e in got.columns] == expect
+
+
+def test_having_sum_without_aggregate_errors(holder, ex, rng):
+    from pilosa_tpu.executor.executor import ExecError
+    make_data(holder, ex, rng)
+    with pytest.raises(ExecError):
+        ex.execute("i", "GroupBy(Rows(f), having=Condition(sum > 5))")
+
+
+def test_extract_non_rows_child_errors(holder, ex, rng):
+    from pilosa_tpu.executor.executor import ExecError
+    make_data(holder, ex, rng)
+    with pytest.raises(ExecError):
+        ex.execute("i", "Extract(All(), Row(f=1))")
