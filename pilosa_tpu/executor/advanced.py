@@ -121,6 +121,10 @@ class AdvancedOps:
         pairs.sort(key=lambda p: (-p.count, p.id))
         if n is not None:
             pairs = pairs[: int(n)]
+        if f.options.keys:
+            keys = f.row_translator.translate_ids([p.id for p in pairs])
+            for p, k in zip(pairs, keys):
+                p.key = k
         return pairs
 
     # -- GroupBy --------------------------------------------------------
@@ -136,7 +140,7 @@ class AdvancedOps:
             if f is None:
                 raise self._err("Rows requires a valid field")
             fields.append(f)
-            row_lists.append(self._execute_rows(idx, rc, shards))
+            row_lists.append(self._rows_ids(idx, rc, shards))
         if any(not rl for rl in row_lists):
             return []
 
@@ -205,8 +209,12 @@ class AdvancedOps:
             cnt = int(counts[ci])
             if cnt == 0:
                 continue
-            group = [{"field": f.name, "row_id": rl[gi]}
-                     for f, rl, gi in zip(fields, row_lists, combo)]
+            group = []
+            for f, rl, gi in zip(fields, row_lists, combo):
+                entry = {"field": f.name, "row_id": rl[gi]}
+                if f.options.keys:
+                    entry["row_key"] = f.row_translator.translate_id(rl[gi])
+                group.append(entry)
             agg = None
             if agg_field is not None:
                 total = sum((int(p) - int(g)) << b for b, (p, g) in
@@ -422,6 +430,7 @@ class AdvancedOps:
                         for c, h in zip(cs, hits):
                             if h:
                                 membership[c].append(r)
+                tr = f.row_translator if f.options.keys else None
                 for c in columns:
                     rows = membership[c]
                     if t == FieldType.BOOL:
@@ -429,12 +438,23 @@ class AdvancedOps:
                             True if TRUE_ROW in rows else
                             False if FALSE_ROW in rows else None)
                     elif t == FieldType.MUTEX:
-                        col_values[c].append(rows[0] if rows else None)
+                        r = rows[0] if rows else None
+                        if tr is not None and r is not None:
+                            r = tr.translate_id(r)
+                        col_values[c].append(r)
+                    elif tr is not None:
+                        col_values[c].append(tr.translate_ids(rows))
                     else:
                         col_values[c].append(rows)
-        return ExtractedTable(
-            fields=fnames,
-            columns=[{"column": c, "rows": col_values[c]} for c in columns])
+        out_cols = []
+        col_keys = (idx.column_translator.translate_ids(columns)
+                    if idx.keys else None)
+        for i, c in enumerate(columns):
+            entry = {"column": c, "rows": col_values[c]}
+            if col_keys is not None:
+                entry["column_key"] = col_keys[i]
+            out_cols.append(entry)
+        return ExtractedTable(fields=fnames, columns=out_cols)
 
     # -- Delete ---------------------------------------------------------
 

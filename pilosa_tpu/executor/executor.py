@@ -70,7 +70,16 @@ class Executor(AdvancedOps):
         if idx is None:
             raise ExecError(f"index not found: {index_name}")
         q = parse(query) if isinstance(query, str) else query
-        return [self._execute_call(idx, c, shards) for c in q.calls]
+        out = []
+        for c in q.calls:
+            res = self._execute_call(idx, c, shards)
+            # translateResults analog (executor.go:7519): attach column
+            # keys to row results on keyed indexes
+            if isinstance(res, RowResult) and idx.keys and \
+                    getattr(res, "is_row_ids", False) is False:
+                res.keys = idx.column_translator.translate_ids(res.columns())
+            out.append(res)
+        return out
 
     # ------------------------------------------------------------------
     # dispatch
@@ -244,6 +253,8 @@ class Executor(AdvancedOps):
             return self._bsi_condition_shard(
                 idx, fname, Condition(past.OP_EQ, row_val), shard)
         row_id = self._row_id_for_value(f, row_val)
+        if row_id is None:  # unknown row key → empty row
+            return jnp.zeros(idx.width // 32, dtype=jnp.uint32)
         views = f.views_for_range(call.arg("from"), call.arg("to"))
         acc = jnp.zeros(idx.width // 32, dtype=jnp.uint32)
         for vn in views:
@@ -253,15 +264,23 @@ class Executor(AdvancedOps):
                 acc = bm.union(acc, frag.device_row(row_id))
         return acc
 
-    def _row_id_for_value(self, f: Field, val) -> int:
+    def _row_id_for_value(self, f: Field, val, create: bool = False):
+        """Resolve a row value to a row id.  String keys go through the
+        field's TranslateStore; on the read path a missing key returns
+        None (empty row), matching FindKeys semantics."""
         if isinstance(val, bool):
             if f.options.type != FieldType.BOOL:
                 raise ExecError(
                     f"bool row value on non-bool field {f.name}")
             return TRUE_ROW if val else FALSE_ROW
         if isinstance(val, str):
-            raise ExecError(
-                f"string row keys not yet supported (field {f.name})")
+            tr = f.row_translator
+            if tr is None:
+                raise ExecError(
+                    f"field {f.name} does not use string keys")
+            if create:
+                return tr.create_keys(val)[val]
+            return tr.find_keys(val).get(val)
         if val is None:
             raise ExecError("null row value")
         return int(val)
@@ -503,11 +522,17 @@ class Executor(AdvancedOps):
                 elif int(bm.intersection_count(
                         frag.device_row(row_id), filt)) > 0:
                     rows_present.add(row_id)
-        return RowResult.from_columns(rows_present, idx.width)
+        if f.options.keys:
+            return DistinctValues(values=sorted(
+                k for k in f.row_translator.translate_ids(
+                    sorted(rows_present)) if k is not None))
+        res = RowResult.from_columns(rows_present, idx.width)
+        res.is_row_ids = True  # row ids, not columns: skip col-key xlate
+        return res
 
-    def _execute_rows(self, idx: Index, call: Call, shards) -> list[int]:
-        """Rows(field): row ids in the field (executor.executeRowsShard
-        basics: limit, previous, column filters)."""
+    def _rows_ids(self, idx: Index, call: Call, shards) -> list[int]:
+        """Rows(field) core returning raw row IDS (executor.
+        executeRowsShard basics: column, like, previous, limit)."""
         fname = call.arg("_field")
         f = idx.field(fname) if fname else None
         if f is None:
@@ -522,18 +547,56 @@ class Executor(AdvancedOps):
             if frag is None:
                 continue
             if column is not None:
-                c = int(column)
+                c = self._col_id(idx, column)
+                if c is None:
+                    continue  # unknown column key matches nothing
                 if c // idx.width != shard:
                     continue
                 ids.update(r for r in frag.row_ids
                            if frag.contains(r, c % idx.width))
             else:
                 ids.update(frag.row_ids)
+        like = call.arg("like")
+        if like is not None:
+            tr = f.row_translator
+            if tr is None:
+                raise ExecError("Rows(like=) requires a keyed field")
+            import re as _re
+            # LIKE pattern per like.go: % = any run, _ = single char
+            pat = _re.compile(
+                "^" + "".join(
+                    ".*" if ch == "%" else "." if ch == "_"
+                    else _re.escape(ch) for ch in like) + "$")
+            ids &= set(tr.match(lambda k: pat.match(k) is not None))
         out = sorted(ids)
         if previous is not None:
-            out = [r for r in out if r > int(previous)]
+            prev = previous
+            if isinstance(prev, str):
+                tr = f.row_translator
+                if tr is None:
+                    raise ExecError(
+                        "string previous= requires a keyed field")
+                found = tr.find_keys(prev)
+                if prev not in found:
+                    raise ExecError(
+                        f"previous= key not found: {prev!r}")
+                prev = found[prev]
+            out = [r for r in out if r > int(prev)]
         if limit is not None:
             out = out[: int(limit)]
+        return out
+
+    def _execute_rows(self, idx: Index, call: Call, shards) -> list:
+        """Rows(field): row ids, or keys for keyed fields
+        (RowIdentifiers.Keys in the reference)."""
+        fname = call.arg("_field")
+        f = idx.field(fname) if fname else None
+        if f is None:
+            raise ExecError("Rows requires a field")
+        out = self._rows_ids(idx, call, shards)
+        if f.options.keys:
+            keys = f.row_translator.translate_ids(out)
+            return [k if k is not None else r for k, r in zip(keys, out)]
         return out
 
     def _execute_union_rows(self, idx: Index, call: Call, shards) -> RowResult:
@@ -547,7 +610,7 @@ class Executor(AdvancedOps):
             f = idx.field(fname) if fname else None
             if f is None:
                 raise ExecError("Rows requires a field")
-            row_ids = self._execute_rows(idx, child, shards)
+            row_ids = self._rows_ids(idx, child, shards)
             for shard in shard_list:
                 v = f.views.get(VIEW_STANDARD)
                 frag = v.fragment(shard) if v else None
@@ -566,7 +629,9 @@ class Executor(AdvancedOps):
         col = call.arg("column")
         if col is None:
             raise ExecError("IncludesColumn requires column=")
-        col = int(col)
+        col = self._col_id(idx, col)
+        if col is None:
+            return False
         shard = col // idx.width
         if shards is not None and shard not in set(shards):
             return False
@@ -609,16 +674,27 @@ class Executor(AdvancedOps):
             return self._execute_delete(idx, call, pre)
         raise ExecError(f"write call not yet supported: {name}")
 
-    def _set_col(self, call) -> int:
+    def _col_id(self, idx: Index, col, create: bool = False):
+        """Resolve a column value (int id or string key) to an id.
+        Read path returns None for unknown keys (FindKeys semantics)."""
+        if isinstance(col, str):
+            tr = idx.column_translator
+            if tr is None:
+                raise ExecError(
+                    f"index {idx.name} does not use column keys")
+            if create:
+                return tr.create_keys(col)[col]
+            return tr.find_keys(col).get(col)
+        return int(col)
+
+    def _set_col(self, idx: Index, call, create: bool):
         col = call.arg("_col")
         if col is None:
             raise ExecError(f"{call.name} requires a column")
-        if isinstance(col, str):
-            raise ExecError("string column keys not yet supported")
-        return int(col)
+        return self._col_id(idx, col, create)
 
     def _execute_set(self, idx: Index, call: Call) -> bool:
-        col = self._set_col(call)
+        col = self._set_col(idx, call, create=True)
         fname, val = call.field_arg()
         if fname is None:
             raise ExecError("Set requires field=value")
@@ -630,13 +706,15 @@ class Executor(AdvancedOps):
         else:
             ts = call.arg("_timestamp")
             changed = f.set_bit(
-                self._row_id_for_value(f, val), col,
+                self._row_id_for_value(f, val, create=True), col,
                 timestamp=timeq.parse_time(ts) if ts else None)
         idx.mark_columns_exist([col])
         return changed
 
     def _execute_clear(self, idx: Index, call: Call) -> bool:
-        col = self._set_col(call)
+        col = self._set_col(idx, call, create=False)
+        if col is None:
+            return False  # unknown column key: nothing to clear
         fname, val = call.field_arg()
         if fname is None:
             raise ExecError("Clear requires field=value")
@@ -645,7 +723,8 @@ class Executor(AdvancedOps):
             raise ExecError(f"field not found: {fname}")
         if f.options.type.is_bsi:
             return f.clear_value(col)
-        return f.clear_bit(self._row_id_for_value(f, val), col)
+        row_id = self._row_id_for_value(f, val)
+        return False if row_id is None else f.clear_bit(row_id, col)
 
     def _execute_store(self, idx: Index, call: Call, pre=None) -> bool:
         """Store(Row(...), f=9): write the result bitmap as a row."""
@@ -656,7 +735,7 @@ class Executor(AdvancedOps):
         f = idx.field(fname)
         if f is None:
             f = idx.create_field(fname)
-        row_id = self._row_id_for_value(f, val)
+        row_id = self._row_id_for_value(f, val, create=True)
         for shard in self._shard_list(idx, None):
             words = np.asarray(self._bitmap_call_shard(idx, child, shard, pre))
             frag = f.view(VIEW_STANDARD, create=True).fragment(
@@ -672,6 +751,8 @@ class Executor(AdvancedOps):
         if f is None:
             raise ExecError(f"field not found: {fname}")
         row_id = self._row_id_for_value(f, val)
+        if row_id is None:
+            return False
         changed = False
         for v in f.views.values():
             for frag in v.fragments.values():

@@ -29,12 +29,14 @@ FALSE_ROW, TRUE_ROW = 0, 1  # bool field rows (field.go falseRowID/trueRowID)
 
 class Field:
     def __init__(self, index: str, name: str, options: FieldOptions | None = None,
-                 width: int = SHARD_WIDTH):
+                 width: int = SHARD_WIDTH, path: str | None = None):
         self.index_name = index
         self.name = name
         self.options = options or FieldOptions()
         self.width = width
+        self.path = path
         self.views: dict[str, View] = {}
+        self._row_translator = None
         self._lock = threading.RLock()
         # BSI depth grows with observed magnitudes (bsiGroup, field.go:2394)
         if self.options.type.is_bsi:
@@ -62,6 +64,22 @@ class Field:
     @property
     def bsi_view(self) -> str:
         return bsi_view_name(self.name)
+
+    @property
+    def row_translator(self):
+        """Sequential row-key translator (keys=True fields);
+        field.go per-field TranslateStore."""
+        if not self.options.keys:
+            return None
+        with self._lock:
+            if self._row_translator is None:
+                import os
+                from pilosa_tpu.storage.translate import TranslateStore
+                tpath = (os.path.join(self.path, "keys.jsonl")
+                         if self.path else None)
+                self._row_translator = TranslateStore(
+                    tpath, index=self.index_name, partition_id=-1)
+            return self._row_translator
 
     @property
     def available_shards(self) -> set[int]:

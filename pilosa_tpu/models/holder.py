@@ -34,8 +34,9 @@ class Holder:
                 if ok_if_exists:
                     return self.indexes[name]
                 raise ValueError(f"index already exists: {name}")
+            ipath = os.path.join(self.path, name) if self.path else None
             idx = Index(name, keys=keys, track_existence=track_existence,
-                        width=self.width)
+                        width=self.width, path=ipath)
             self.indexes[name] = idx
             return idx
 

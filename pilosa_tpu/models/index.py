@@ -7,6 +7,7 @@ Not()/All() and column counts.
 
 from __future__ import annotations
 
+import os
 import threading
 
 from pilosa_tpu.models.field import Field
@@ -18,15 +19,31 @@ EXISTENCE_FIELD = "_exists"
 
 class Index:
     def __init__(self, name: str, keys: bool = False,
-                 track_existence: bool = True, width: int = SHARD_WIDTH):
+                 track_existence: bool = True, width: int = SHARD_WIDTH,
+                 path: str | None = None):
         self.name = name
         self.keys = keys
         self.track_existence = track_existence
         self.width = width
+        self.path = path
         self.fields: dict[str, Field] = {}
         self._lock = threading.RLock()
+        self._column_translator = None
         if track_existence:
             self._ensure_existence()
+
+    @property
+    def column_translator(self):
+        """Partitioned column-key translator (keys=True indexes)."""
+        if not self.keys:
+            return None
+        with self._lock:
+            if self._column_translator is None:
+                from pilosa_tpu.storage.translate import PartitionedTranslator
+                tpath = os.path.join(self.path, "_keys") if self.path else None
+                self._column_translator = PartitionedTranslator(
+                    self.name, tpath, shard_width=self.width)
+            return self._column_translator
 
     def _ensure_existence(self) -> Field:
         f = self.fields.get(EXISTENCE_FIELD)
@@ -36,6 +53,9 @@ class Index:
             self.fields[EXISTENCE_FIELD] = f
         return f
 
+    def _field_path(self, name: str) -> str | None:
+        return os.path.join(self.path, "fields", name) if self.path else None
+
     def create_field(self, name: str, options: FieldOptions | None = None,
                      ok_if_exists: bool = False) -> Field:
         with self._lock:
@@ -43,7 +63,8 @@ class Index:
                 if ok_if_exists or name == EXISTENCE_FIELD:
                     return self.fields[name]
                 raise ValueError(f"field already exists: {name}")
-            f = Field(self.name, name, options, self.width)
+            f = Field(self.name, name, options, self.width,
+                      path=self._field_path(name))
             self.fields[name] = f
             return f
 

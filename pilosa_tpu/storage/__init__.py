@@ -1,0 +1,25 @@
+"""Host-side storage: key translation, ID allocation, persistence.
+
+The reference keeps string↔id translation in BoltDB stores
+(translate_boltdb.go) and bitmap data in RBF files (rbf/).  Here
+translation is a host-side append-log store (the device never sees
+strings — SURVEY §7 "Key translation throughput"); bitmap persistence
+lives in the snapshot module and will move behind the native RBF-lite
+library.
+"""
+
+from pilosa_tpu.storage.translate import (
+    PartitionedTranslator,
+    TranslateStore,
+    key_to_key_partition,
+    next_partitioned_id,
+    shard_to_shard_partition,
+    DEFAULT_PARTITION_N,
+)
+from pilosa_tpu.storage.idalloc import IDAllocator
+
+__all__ = [
+    "TranslateStore", "PartitionedTranslator", "IDAllocator",
+    "key_to_key_partition", "shard_to_shard_partition",
+    "next_partitioned_id", "DEFAULT_PARTITION_N",
+]

@@ -1,0 +1,69 @@
+"""ID allocation with reserve/commit sessions.
+
+Behavioral port of idalloc.go:43,127,238: ingesters reserve a range of
+column ids under a (key, session) pair, write records, then commit.
+Re-reserving with the same session before commit returns the same
+range (exactly-once semantics across ingester retries); a new session
+rolls the uncommitted range back and allocates fresh.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+
+class IDAllocator:
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self._next: dict[str, int] = {}       # key -> next unreserved id
+        self._reserved: dict[str, tuple[bytes, int, int]] = {}
+        self._lock = threading.RLock()
+        if path and os.path.exists(path):
+            with open(path) as f:
+                self._next = {k: int(v) for k, v in json.load(f).items()}
+
+    def _persist(self):
+        if self.path:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            with open(self.path, "w") as f:
+                json.dump(self._next, f)
+
+    def reserve(self, key: str, session: bytes, count: int) -> range:
+        """Reserve `count` ids for (key, session).  Matching an
+        in-flight session returns the same range (idalloc.go:127)."""
+        with self._lock:
+            held = self._reserved.get(key)
+            if held is not None:
+                h_session, h_start, h_count = held
+                if h_session == session:
+                    return range(h_start, h_start + h_count)
+                # new session: roll back the uncommitted reservation
+                self._next[key] = h_start
+            start = self._next.get(key, 0)
+            self._reserved[key] = (session, start, count)
+            self._next[key] = start + count
+            self._persist()
+            return range(start, start + count)
+
+    def commit(self, key: str, session: bytes, count: int | None = None):
+        """Commit the reservation (idalloc.go:238)."""
+        with self._lock:
+            held = self._reserved.get(key)
+            if held is None or held[0] != session:
+                raise KeyError("no matching reservation to commit")
+            _, start, r_count = held
+            if count is not None and count < r_count:
+                # partial use: return the tail
+                self._next[key] = start + count
+            del self._reserved[key]
+            self._persist()
+
+    def rollback(self, key: str, session: bytes):
+        with self._lock:
+            held = self._reserved.get(key)
+            if held is not None and held[0] == session:
+                self._next[key] = held[1]
+                del self._reserved[key]
+                self._persist()

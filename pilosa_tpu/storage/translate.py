@@ -1,0 +1,246 @@
+"""Key translation: string keys ↔ integer ids.
+
+Behavioral port of the reference's TranslateStore (translate.go:43)
+and its partitioned index-key layout:
+
+- Field row keys use a single sequential store (partition -1).
+- Index column keys are split over 256 partitions
+  (disco/snapshot.go:15 DefaultPartitionN); a key hashes to its
+  partition with FNV-64a over index+key (disco/snapshot.go:87), and
+  ids are allocated so that the id's SHARD also hashes to the same
+  partition (translate.go:103 GenerateNextPartitionedID) — keyed
+  columns therefore spread deterministically across the shard space,
+  which on the TPU build is what spreads them across the device mesh.
+
+Persistence is an append-only JSONL log per store (storage layer v0;
+the native storage library will replace the file format, not the
+semantics).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import threading
+
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+DEFAULT_PARTITION_N = 256
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_MASK = 0xFFFFFFFFFFFFFFFF
+
+
+def _fnv64a(*parts: bytes) -> int:
+    h = _FNV_OFFSET
+    for p in parts:
+        for b in p:
+            h = ((h ^ b) * _FNV_PRIME) & _MASK
+    return h
+
+
+def key_to_key_partition(index: str, key: str,
+                         partition_n: int = DEFAULT_PARTITION_N) -> int:
+    """disco.KeyToKeyPartition: fnv64a(index + key) % N."""
+    return _fnv64a(index.encode(), key.encode()) % partition_n
+
+
+@functools.lru_cache(maxsize=65536)
+def shard_to_shard_partition(index: str, shard: int,
+                             partition_n: int = DEFAULT_PARTITION_N) -> int:
+    """disco.ShardToShardPartition: fnv64a(index + bigendian(shard)) % N.
+    Memoized — the hot translate_ids path hits the same few shards for
+    millions of ids."""
+    return _fnv64a(index.encode(), shard.to_bytes(8, "big")) % partition_n
+
+
+def next_partitioned_id(index: str, prev: int, partition_id: int,
+                        partition_n: int = DEFAULT_PARTITION_N,
+                        shard_width: int = SHARD_WIDTH) -> int:
+    """translate.GenerateNextPartitionedID: smallest id > prev whose
+    shard belongs to partition_id (stepping by shard width)."""
+    if partition_id == -1:
+        return prev + 1
+    candidate = prev + 1
+    while True:
+        if shard_to_shard_partition(
+                index, candidate // shard_width, partition_n) == partition_id:
+            return candidate
+        candidate += shard_width
+
+
+class TranslateStore:
+    """One translation store (one field, or one index partition)."""
+
+    def __init__(self, path: str | None = None, index: str = "",
+                 partition_id: int = -1,
+                 partition_n: int = DEFAULT_PARTITION_N,
+                 shard_width: int = SHARD_WIDTH):
+        self.path = path
+        self.index = index
+        self.partition_id = partition_id
+        self.partition_n = partition_n
+        self.shard_width = shard_width
+        self.read_only = False
+        self._by_key: dict[str, int] = {}
+        self._by_id: dict[int, str] = {}
+        self._max_id = 0
+        self._lock = threading.RLock()
+        self._log = None
+        if path:
+            self._open()
+
+    def _open(self):
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        if os.path.exists(self.path):
+            with open(self.path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    entry = json.loads(line)
+                    self._set(entry["id"], entry["key"])
+        self._log = open(self.path, "a")
+
+    def close(self):
+        if self._log:
+            self._log.close()
+            self._log = None
+
+    def _set(self, id_: int, key: str):
+        self._by_key[key] = id_
+        self._by_id[id_] = key
+        self._max_id = max(self._max_id, id_)
+
+    def max_id(self) -> int:
+        return self._max_id
+
+    def find_keys(self, *keys: str) -> dict[str, int]:
+        """Look up ids; missing keys are absent from the result (not an
+        error) — translate.go FindKeys."""
+        with self._lock:
+            return {k: self._by_key[k] for k in keys if k in self._by_key}
+
+    def create_keys(self, *keys: str) -> dict[str, int]:
+        """Map keys to ids, allocating new ids as needed."""
+        if self.read_only:
+            raise PermissionError("translate store is read-only")
+        out = {}
+        with self._lock:
+            for k in keys:
+                id_ = self._by_key.get(k)
+                if id_ is None:
+                    id_ = next_partitioned_id(
+                        self.index, self._max_id, self.partition_id,
+                        self.partition_n, self.shard_width)
+                    self._set(id_, k)
+                    if self._log:
+                        self._log.write(json.dumps(
+                            {"id": id_, "key": k}) + "\n")
+                out[k] = id_
+            if self._log:
+                self._log.flush()
+        return out
+
+    def force_set(self, id_: int, key: str):
+        """Replication write path (translate.go ForceSet)."""
+        with self._lock:
+            self._set(id_, key)
+            if self._log:
+                self._log.write(json.dumps({"id": id_, "key": key}) + "\n")
+                self._log.flush()
+
+    def translate_id(self, id_: int) -> str | None:
+        return self._by_id.get(id_)
+
+    def translate_ids(self, ids) -> list[str | None]:
+        return [self._by_id.get(int(i)) for i in ids]
+
+    def match(self, predicate) -> list[int]:
+        """Ids of keys matching a predicate (translate.go Match)."""
+        with self._lock:
+            return sorted(id_ for k, id_ in self._by_key.items()
+                          if predicate(k))
+
+    def keys(self) -> list[str]:
+        return sorted(self._by_key)
+
+
+class PartitionedTranslator:
+    """Index column-key translation across N partition stores
+    (cluster.go:511-826 key-translation routing, single-controller)."""
+
+    def __init__(self, index: str, path: str | None = None,
+                 partition_n: int = DEFAULT_PARTITION_N,
+                 shard_width: int = SHARD_WIDTH):
+        self.index = index
+        self.partition_n = partition_n
+        self.shard_width = shard_width
+        self._stores: dict[int, TranslateStore] = {}
+        self._path = path
+        self._lock = threading.RLock()
+
+    def _store(self, partition: int) -> TranslateStore:
+        with self._lock:
+            return self._store_locked(partition)
+
+    def _store_locked(self, partition: int) -> TranslateStore:
+        s = self._stores.get(partition)
+        if s is None:
+            path = (os.path.join(self._path, f"keys.{partition:04d}.jsonl")
+                    if self._path else None)
+            s = TranslateStore(path, index=self.index,
+                               partition_id=partition,
+                               partition_n=self.partition_n,
+                               shard_width=self.shard_width)
+            self._stores[partition] = s
+        return s
+
+    def _group(self, keys) -> dict[int, list[str]]:
+        groups: dict[int, list[str]] = {}
+        for k in keys:
+            groups.setdefault(
+                key_to_key_partition(self.index, k, self.partition_n),
+                []).append(k)
+        return groups
+
+    def find_keys(self, *keys: str) -> dict[str, int]:
+        out = {}
+        for p, ks in self._group(keys).items():
+            out.update(self._store(p).find_keys(*ks))
+        return out
+
+    def create_keys(self, *keys: str) -> dict[str, int]:
+        out = {}
+        for p, ks in self._group(keys).items():
+            out.update(self._store(p).create_keys(*ks))
+        return out
+
+    def translate_ids(self, ids) -> list[str | None]:
+        # id → its shard's partition → that partition's store; the
+        # memoized shard hash makes this O(1) hashing per id
+        out = []
+        for i in ids:
+            p = shard_to_shard_partition(
+                self.index, int(i) // self.shard_width, self.partition_n)
+            out.append(self._store(p).translate_id(int(i)))
+        return out
+
+    def match(self, predicate) -> list[int]:
+        ids: list[int] = []
+        for p in list(self._stores):
+            ids.extend(self._stores[p].match(predicate))
+        # also open on-disk stores not yet loaded
+        if self._path and os.path.isdir(self._path):
+            for fn in os.listdir(self._path):
+                if fn.startswith("keys.") and fn.endswith(".jsonl"):
+                    p = int(fn.split(".")[1])
+                    if p not in self._stores:
+                        ids.extend(self._store(p).match(predicate))
+        return sorted(set(ids))
+
+    def close(self):
+        for s in self._stores.values():
+            s.close()

@@ -1,0 +1,213 @@
+"""Key translation tests: stores, partitioning, ID allocation, and
+executor integration (translate.go, idalloc.go, disco/snapshot.go)."""
+
+import pytest
+
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.models import FieldOptions, FieldType, Holder
+from pilosa_tpu.storage import (
+    IDAllocator,
+    PartitionedTranslator,
+    TranslateStore,
+    key_to_key_partition,
+    next_partitioned_id,
+    shard_to_shard_partition,
+)
+
+W = 1 << 12
+
+
+class TestTranslateStore:
+    def test_create_find_roundtrip(self):
+        s = TranslateStore()
+        ids = s.create_keys("a", "b", "c")
+        assert len(set(ids.values())) == 3
+        assert s.find_keys("a", "b") == {k: ids[k] for k in ("a", "b")}
+        assert s.find_keys("missing") == {}  # not an error
+        assert s.create_keys("a")["a"] == ids["a"]  # stable
+        assert s.translate_ids(list(ids.values())) == ["a", "b", "c"]
+
+    def test_sequential_ids_unpartitioned(self):
+        s = TranslateStore()
+        ids = s.create_keys("x", "y", "z")
+        assert sorted(ids.values()) == [1, 2, 3]
+
+    def test_persistence(self, tmp_path):
+        p = str(tmp_path / "keys.jsonl")
+        s = TranslateStore(p)
+        ids = s.create_keys("k1", "k2")
+        s.close()
+        s2 = TranslateStore(p)
+        assert s2.find_keys("k1", "k2") == ids
+        assert s2.create_keys("k3")["k3"] > max(ids.values())
+
+    def test_match(self):
+        s = TranslateStore()
+        s.create_keys("apple", "apricot", "banana")
+        got = s.match(lambda k: k.startswith("ap"))
+        assert got == sorted(s.find_keys("apple", "apricot").values())
+
+
+class TestPartitioned:
+    def test_partition_functions_deterministic(self):
+        assert key_to_key_partition("i", "k") == key_to_key_partition("i", "k")
+        assert 0 <= key_to_key_partition("i", "k") < 256
+        assert 0 <= shard_to_shard_partition("i", 5) < 256
+
+    def test_next_partitioned_id_lands_in_partition(self):
+        for p in (0, 7, 255):
+            id_ = next_partitioned_id("i", 0, p, shard_width=W)
+            assert shard_to_shard_partition("i", id_ // W) == p
+
+    def test_partitioned_translator(self, tmp_path):
+        t = PartitionedTranslator("i", str(tmp_path), shard_width=W)
+        keys = [f"user{n}" for n in range(50)]
+        ids = t.create_keys(*keys)
+        assert len(set(ids.values())) == 50
+        # id -> key roundtrip through shard partition routing
+        assert t.translate_ids([ids[k] for k in keys]) == keys
+        # key lands in the partition its id's shard hashes to
+        for k, id_ in ids.items():
+            assert shard_to_shard_partition("i", id_ // W) == \
+                key_to_key_partition("i", k)
+        t.close()
+        # reload from disk
+        t2 = PartitionedTranslator("i", str(tmp_path), shard_width=W)
+        assert t2.find_keys(*keys) == ids
+
+
+class TestIDAllocator:
+    def test_reserve_commit(self):
+        a = IDAllocator()
+        r1 = a.reserve("idx", b"s1", 10)
+        assert list(r1) == list(range(0, 10))
+        # same session re-reserves the same range (retry semantics)
+        assert list(a.reserve("idx", b"s1", 10)) == list(r1)
+        a.commit("idx", b"s1")
+        r2 = a.reserve("idx", b"s2", 5)
+        assert r2.start == 10
+
+    def test_new_session_rolls_back(self):
+        a = IDAllocator()
+        a.reserve("idx", b"s1", 10)
+        r2 = a.reserve("idx", b"s2", 5)  # s1 uncommitted -> rolled back
+        assert r2.start == 0
+
+    def test_persistence(self, tmp_path):
+        p = str(tmp_path / "ids.json")
+        a = IDAllocator(p)
+        a.reserve("idx", b"s", 7)
+        a.commit("idx", b"s")
+        a2 = IDAllocator(p)
+        assert a2.reserve("idx", b"x", 1).start == 7
+
+
+class TestKeyedQueries:
+    @pytest.fixture
+    def ex(self):
+        h = Holder(width=W)
+        return Executor(h), h
+
+    def test_keyed_rows_and_columns(self, ex):
+        ex, h = ex
+        idx = h.create_index("i", keys=True)
+        idx.create_field("f", FieldOptions(keys=True))
+        ex.execute("i", 'Set("alice", f="admin")')
+        ex.execute("i", 'Set("bob", f="admin")')
+        ex.execute("i", 'Set("alice", f="eng")')
+        res = ex.execute("i", 'Row(f="admin")')[0]
+        assert sorted(res.keys) == ["alice", "bob"]
+        assert ex.execute("i", 'Count(Row(f="admin"))')[0] == 2
+        # unknown row key -> empty, not error (FindKeys semantics)
+        assert ex.execute("i", 'Count(Row(f="nope"))')[0] == 0
+
+    def test_keyed_rows_listing(self, ex):
+        ex, h = ex
+        idx = h.create_index("i", keys=True)
+        idx.create_field("f", FieldOptions(keys=True))
+        ex.execute("i", 'Set("a", f="x")Set("b", f="y")')
+        assert sorted(ex.execute("i", "Rows(f)")[0]) == ["x", "y"]
+        assert ex.execute("i", 'Rows(f, like="x%")')[0] == ["x"]
+
+    def test_keyed_topn(self, ex):
+        ex, h = ex
+        idx = h.create_index("i", keys=True)
+        idx.create_field("f", FieldOptions(keys=True))
+        for c in "abc":
+            ex.execute("i", f'Set("{c}", f="popular")')
+        ex.execute("i", 'Set("a", f="rare")')
+        pairs = ex.execute("i", "TopN(f)")[0]
+        assert [(p.key, p.count) for p in pairs] == [
+            ("popular", 3), ("rare", 1)]
+
+    def test_keyed_groupby(self, ex):
+        ex, h = ex
+        idx = h.create_index("i", keys=True)
+        idx.create_field("f", FieldOptions(keys=True))
+        ex.execute("i", 'Set("u1", f="x")Set("u2", f="x")Set("u3", f="y")')
+        got = ex.execute("i", "GroupBy(Rows(f))")[0]
+        assert {g.group[0]["row_key"]: g.count for g in got} == \
+            {"x": 2, "y": 1}
+
+    def test_keyed_clear_and_includes(self, ex):
+        ex, h = ex
+        idx = h.create_index("i", keys=True)
+        idx.create_field("f", FieldOptions(keys=True))
+        ex.execute("i", 'Set("u1", f="x")')
+        assert ex.execute(
+            "i", 'IncludesColumn(Row(f="x"), column="u1")')[0] is True
+        assert ex.execute(
+            "i", 'IncludesColumn(Row(f="x"), column="zzz")')[0] is False
+        assert ex.execute("i", 'Clear("u1", f="x")')[0] is True
+        assert ex.execute("i", 'Count(Row(f="x"))')[0] == 0
+
+    def test_unkeyed_rejects_string(self, ex):
+        ex, h = ex
+        from pilosa_tpu.executor.executor import ExecError
+        idx = h.create_index("i")
+        idx.create_field("f")
+        with pytest.raises(ExecError):
+            ex.execute("i", 'Set(1, f="key")')
+        with pytest.raises(ExecError):
+            ex.execute("i", 'Set("colkey", f=1)')
+
+    def test_keyed_bsi_field(self, ex):
+        ex, h = ex
+        idx = h.create_index("i", keys=True)
+        idx.create_field("age", FieldOptions(type=FieldType.INT))
+        ex.execute("i", 'Set("alice", age=30)Set("bob", age=40)')
+        res = ex.execute("i", "Row(age > 35)")[0]
+        assert res.keys == ["bob"]
+        assert ex.execute("i", "Sum(field=age)")[0].value == 70
+
+
+def test_keyed_rows_column_filter():
+    h = Holder(width=W)
+    ex = Executor(h)
+    idx = h.create_index("i", keys=True)
+    idx.create_field("f", FieldOptions(keys=True))
+    ex.execute("i", 'Set("c1", f="r1")Set("c2", f="r2")')
+    assert ex.execute("i", 'Rows(f, column="c1")')[0] == ["r1"]
+    assert ex.execute("i", 'Rows(f, column="missing")')[0] == []
+
+
+def test_keyed_rows_previous_unknown_errors():
+    from pilosa_tpu.executor.executor import ExecError
+    h = Holder(width=W)
+    ex = Executor(h)
+    idx = h.create_index("i", keys=True)
+    idx.create_field("f", FieldOptions(keys=True))
+    ex.execute("i", 'Set("c", f="r")')
+    with pytest.raises(ExecError):
+        ex.execute("i", 'Rows(f, previous="zzz")')
+
+
+def test_keyed_extract_translates():
+    h = Holder(width=W)
+    ex = Executor(h)
+    idx = h.create_index("i", keys=True)
+    idx.create_field("f", FieldOptions(keys=True))
+    ex.execute("i", 'Set("u1", f="x")Set("u2", f="y")')
+    got = ex.execute("i", "Extract(All(), Rows(f))")[0]
+    by_key = {e["column_key"]: e["rows"][0] for e in got.columns}
+    assert by_key == {"u1": ["x"], "u2": ["y"]}
