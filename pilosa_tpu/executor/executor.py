@@ -137,6 +137,16 @@ class Executor(AdvancedOps):
             return sorted(shards)
         return sorted(idx.available_shards) or [0]
 
+    def _tree_shards(self, idx: Index, shards, pre) -> list[int]:
+        """Shard walk for a bitmap tree: the query's shard set plus any
+        shards contributed by precomputed cross-shard results (nested
+        Distinct row-id bitmaps can land outside the data shards)."""
+        out = set(self._shard_list(idx, shards))
+        if shards is None:
+            for res in pre.values():
+                out.update(res.segments)
+        return sorted(out)
+
     def _precompute_nested(self, idx: Index, call: Call, shards) -> dict:
         """Evaluate nested Distinct calls ONCE per query over the
         query's shard set (the reference executes them as separate
@@ -151,11 +161,13 @@ class Executor(AdvancedOps):
                 if isinstance(v, Call):
                     walk(v, False)
             if not is_root and c.name == "Distinct":
-                res = self._execute_distinct(idx, c, shards, pre)
+                res = self._execute_distinct(idx, c, shards, pre, raw=True)
                 if isinstance(res, DistinctValues):
                     raise ExecError(
                         "BSI Distinct cannot be nested as a bitmap call")
                 pre[id(c)] = res
+            elif not is_root and c.name == "UnionRows":
+                pre[id(c)] = self._execute_union_rows(idx, c, shards)
 
         walk(call, True)
         return pre
@@ -169,7 +181,7 @@ class Executor(AdvancedOps):
         if pre is None:
             pre = self._precompute_nested(idx, call, shards)
         out = RowResult(idx.width)
-        for shard in self._shard_list(idx, shards):
+        for shard in self._tree_shards(idx, shards, pre):
             words = np.asarray(self._bitmap_call_shard(idx, call, shard, pre))
             if words.any():
                 out.segments[shard] = words
@@ -211,9 +223,9 @@ class Executor(AdvancedOps):
             in_shard = [c % idx.width for c in cols
                         if c // idx.width == shard]
             return jnp.asarray(bm.from_columns(in_shard, idx.width))
-        if name == "Distinct":
-            # nested Distinct: row ids materialized as a bitmap,
-            # precomputed once per query in _precompute_nested
+        if name in ("Distinct", "UnionRows"):
+            # cross-shard calls materialized once per query in
+            # _precompute_nested; served per shard from the cache
             return jnp.asarray(pre[id(call)].shard_words(shard))
         raise ExecError(f"unknown or non-bitmap call: {name}")
 
@@ -283,6 +295,9 @@ class Executor(AdvancedOps):
             return tr.find_keys(val).get(val)
         if val is None:
             raise ExecError("null row value")
+        if f.options.keys:
+            raise ExecError(
+                f"field {f.name} uses row keys; got id {val!r}")
         return int(val)
 
     # -- BSI predicates -------------------------------------------------
@@ -400,7 +415,7 @@ class Executor(AdvancedOps):
 
     def _reduce_count(self, idx: Index, call: Call, shards, pre) -> int:
         total = 0
-        for shard in self._shard_list(idx, shards):
+        for shard in self._tree_shards(idx, shards, pre):
             words = self._bitmap_call_shard(idx, call, shard, pre)
             total += int(bm.count(words))
         return total
@@ -482,7 +497,8 @@ class Executor(AdvancedOps):
     # Distinct / Rows / misc
     # ------------------------------------------------------------------
 
-    def _execute_distinct(self, idx: Index, call: Call, shards, pre=None):
+    def _execute_distinct(self, idx: Index, call: Call, shards,
+                          pre=None, raw: bool = False):
         fname = call.arg("_field")
         if fname is None:
             raise ExecError("Distinct requires field=")
@@ -522,12 +538,12 @@ class Executor(AdvancedOps):
                 elif int(bm.intersection_count(
                         frag.device_row(row_id), filt)) > 0:
                     rows_present.add(row_id)
-        if f.options.keys:
+        res = RowResult.from_columns(rows_present, idx.width)
+        res.is_row_ids = True  # row ids, not columns: skip col-key xlate
+        if f.options.keys and not raw:
             return DistinctValues(values=sorted(
                 k for k in f.row_translator.translate_ids(
                     sorted(rows_present)) if k is not None))
-        res = RowResult.from_columns(rows_present, idx.width)
-        res.is_row_ids = True  # row ids, not columns: skip col-key xlate
         return res
 
     def _rows_ids(self, idx: Index, call: Call, shards) -> list[int]:
@@ -540,6 +556,10 @@ class Executor(AdvancedOps):
         column = call.arg("column")
         previous = call.arg("previous")
         limit = call.arg("limit")
+        if column is not None:
+            column = self._col_id(idx, column)
+            if column is None:
+                return []  # unknown column key matches nothing
         ids: set[int] = set()
         for shard in self._shard_list(idx, shards):
             v = f.views.get(VIEW_STANDARD)
@@ -547,9 +567,7 @@ class Executor(AdvancedOps):
             if frag is None:
                 continue
             if column is not None:
-                c = self._col_id(idx, column)
-                if c is None:
-                    continue  # unknown column key matches nothing
+                c = int(column)
                 if c // idx.width != shard:
                     continue
                 ids.update(r for r in frag.row_ids
@@ -566,7 +584,8 @@ class Executor(AdvancedOps):
             pat = _re.compile(
                 "^" + "".join(
                     ".*" if ch == "%" else "." if ch == "_"
-                    else _re.escape(ch) for ch in like) + "$")
+                    else _re.escape(ch) for ch in like) + "$",
+                _re.DOTALL)
             ids &= set(tr.match(lambda k: pat.match(k) is not None))
         out = sorted(ids)
         if previous is not None:
@@ -685,6 +704,9 @@ class Executor(AdvancedOps):
             if create:
                 return tr.create_keys(col)[col]
             return tr.find_keys(col).get(col)
+        if idx.keys:
+            raise ExecError(
+                f"index {idx.name} uses column keys; got id {col!r}")
         return int(col)
 
     def _set_col(self, idx: Index, call, create: bool):

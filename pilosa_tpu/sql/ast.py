@@ -1,0 +1,132 @@
+"""SQL AST node types (shape of sql3/parser/ast.go, subset)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class ColumnDef:
+    name: str
+    type: str            # id,string,int,decimal,timestamp,bool,idset,stringset
+    scale: int = 0
+    min: int | None = None
+    max: int | None = None
+    time_quantum: str | None = None
+
+
+@dataclass
+class CreateTable:
+    name: str
+    columns: list[ColumnDef]
+    keys: bool = False   # _id is string-keyed
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropTable:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class ShowTables:
+    pass
+
+
+@dataclass
+class ShowColumns:
+    table: str = ""
+
+
+@dataclass
+class Insert:
+    table: str
+    columns: list[str]
+    rows: list[list]
+    replace: bool = False
+
+
+@dataclass
+class Delete:
+    table: str
+    where: Any = None
+
+
+# --- expressions -----------------------------------------------------------
+
+@dataclass
+class Col:
+    name: str
+
+
+@dataclass
+class Lit:
+    value: Any
+
+
+@dataclass
+class BinOp:
+    op: str              # = != < <= > >= and or like
+    left: Any
+    right: Any
+
+
+@dataclass
+class Not:
+    expr: Any
+
+
+@dataclass
+class InList:
+    col: Any
+    items: list
+    negated: bool = False
+
+
+@dataclass
+class Between:
+    col: Any
+    lo: Any
+    hi: Any
+    negated: bool = False
+
+
+@dataclass
+class IsNull:
+    col: Any
+    negated: bool = False
+
+
+@dataclass
+class Agg:
+    func: str            # count sum min max avg percentile
+    arg: Any = None      # Col or None (count(*))
+    distinct: bool = False
+    extra: Any = None    # percentile nth
+
+
+@dataclass
+class SelectItem:
+    expr: Any            # Col | Agg | Lit
+    alias: str | None = None
+
+
+@dataclass
+class OrderBy:
+    expr: Any
+    desc: bool = False
+
+
+@dataclass
+class Select:
+    items: list[SelectItem] = field(default_factory=list)
+    table: str = ""
+    where: Any = None
+    group_by: list = field(default_factory=list)
+    having: Any = None
+    order_by: list[OrderBy] = field(default_factory=list)
+    limit: int | None = None
+    offset: int | None = None
+    distinct: bool = False

@@ -1,0 +1,659 @@
+"""SQL engine: compile parsed SQL onto the PQL executor.
+
+The planner mirrors sql3/planner's central idea — push WHERE filters
+and aggregates down into per-shard PQL ops (PlanOpPQLTableScan /
+PlanOpPQLAggregate / PlanOpPQLGroupBy, sql3/planner/planoptimizer.go)
+— without a fan-out operator: the executor's shard loop / device mesh
+already spans the data (SURVEY §7.6).
+
+Supported surface: CREATE/DROP TABLE, SHOW TABLES/COLUMNS, INSERT
+[OR REPLACE], DELETE ... WHERE, SELECT with projections, aggregates
+(COUNT[ DISTINCT]/SUM/MIN/MAX/AVG/PERCENTILE), WHERE (=, !=, <, <=,
+>, >=, IN, LIKE, BETWEEN, IS [NOT] NULL, AND/OR/NOT), GROUP BY +
+HAVING, ORDER BY, LIMIT/OFFSET, SELECT DISTINCT col.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+from dataclasses import dataclass, field as _f
+
+from pilosa_tpu.executor import (
+    DistinctValues,
+    Executor,
+    RowResult,
+    SortedRow,
+    ValCount,
+)
+from pilosa_tpu.models import FieldOptions, FieldType, Holder, TimeQuantum
+from pilosa_tpu.pql.ast import Call, Condition
+from pilosa_tpu.sql import ast
+from pilosa_tpu.sql.lexer import SQLError
+from pilosa_tpu.sql.parser import parse_sql
+
+
+@dataclass
+class SQLResult:
+    schema: list = _f(default_factory=list)   # [(name, sql_type)]
+    rows: list = _f(default_factory=list)
+
+
+_SQL_TYPE_FOR_FIELD = {
+    FieldType.INT: "int",
+    FieldType.DECIMAL: "decimal",
+    FieldType.TIMESTAMP: "timestamp",
+    FieldType.BOOL: "bool",
+}
+
+
+def _sql_type(f) -> str:
+    t = f.options.type
+    if t in _SQL_TYPE_FOR_FIELD:
+        return _SQL_TYPE_FOR_FIELD[t]
+    if t == FieldType.MUTEX:
+        return "string" if f.options.keys else "id"
+    # set / time
+    return "stringset" if f.options.keys else "idset"
+
+
+class SQLEngine:
+    def __init__(self, holder: Holder):
+        self.holder = holder
+        self.executor = Executor(holder)
+
+    def query(self, sql: str) -> list[SQLResult]:
+        from pilosa_tpu.executor.executor import ExecError
+        try:
+            return [self._execute(stmt) for stmt in parse_sql(sql)]
+        except ExecError as e:  # surface executor errors as SQL errors
+            raise SQLError(str(e)) from e
+
+    def query_one(self, sql: str) -> SQLResult:
+        return self.query(sql)[-1]
+
+    # ------------------------------------------------------------------
+
+    def _execute(self, stmt) -> SQLResult:
+        if isinstance(stmt, ast.CreateTable):
+            return self._create_table(stmt)
+        if isinstance(stmt, ast.DropTable):
+            return self._drop_table(stmt)
+        if isinstance(stmt, ast.ShowTables):
+            return SQLResult(schema=[("name", "string")],
+                             rows=[(n,) for n in sorted(self.holder.indexes)])
+        if isinstance(stmt, ast.ShowColumns):
+            return self._show_columns(stmt)
+        if isinstance(stmt, ast.Insert):
+            return self._insert(stmt)
+        if isinstance(stmt, ast.Delete):
+            return self._delete(stmt)
+        if isinstance(stmt, ast.Select):
+            return self._select(stmt)
+        raise SQLError(f"unsupported statement {type(stmt).__name__}")
+
+    # -- DDL ------------------------------------------------------------
+
+    def _create_table(self, stmt: ast.CreateTable) -> SQLResult:
+        if self.holder.index(stmt.name) is not None:
+            if stmt.if_not_exists:
+                return SQLResult()
+            raise SQLError(f"table already exists: {stmt.name}")
+        idx = self.holder.create_index(stmt.name, keys=stmt.keys)
+        for cd in stmt.columns:
+            if cd.name == "_id":
+                continue
+            idx.create_field(cd.name, self._field_options(cd))
+        self.holder.save_schema()
+        return SQLResult()
+
+    def _field_options(self, cd: ast.ColumnDef) -> FieldOptions:
+        t = cd.type
+        if t == "int":
+            return FieldOptions(type=FieldType.INT, min=cd.min, max=cd.max)
+        if t == "decimal":
+            return FieldOptions(type=FieldType.DECIMAL, scale=cd.scale)
+        if t == "timestamp":
+            return FieldOptions(type=FieldType.TIMESTAMP)
+        if t == "bool":
+            return FieldOptions(type=FieldType.BOOL)
+        if t == "id":
+            return FieldOptions(type=FieldType.MUTEX)
+        if t == "string":
+            return FieldOptions(type=FieldType.MUTEX, keys=True)
+        if t == "idset":
+            if cd.time_quantum:
+                return FieldOptions(type=FieldType.TIME,
+                                    time_quantum=TimeQuantum(cd.time_quantum))
+            return FieldOptions(type=FieldType.SET)
+        if t == "stringset":
+            if cd.time_quantum:
+                return FieldOptions(type=FieldType.TIME,
+                                    time_quantum=TimeQuantum(cd.time_quantum),
+                                    keys=True)
+            return FieldOptions(type=FieldType.SET, keys=True)
+        raise SQLError(f"unknown column type {t!r}")
+
+    def _drop_table(self, stmt: ast.DropTable) -> SQLResult:
+        if self.holder.index(stmt.name) is None and not stmt.if_exists:
+            raise SQLError(f"table not found: {stmt.name}")
+        self.holder.delete_index(stmt.name)
+        self.holder.save_schema()
+        return SQLResult()
+
+    def _show_columns(self, stmt: ast.ShowColumns) -> SQLResult:
+        idx = self._index(stmt.table)
+        rows = [("_id", "string" if idx.keys else "id")]
+        rows += [(f.name, _sql_type(f)) for f in idx.public_fields()]
+        return SQLResult(schema=[("name", "string"), ("type", "string")],
+                         rows=rows)
+
+    # -- DML ------------------------------------------------------------
+
+    def _index(self, name: str):
+        idx = self.holder.index(name)
+        if idx is None:
+            raise SQLError(f"table not found: {name}")
+        return idx
+
+    def _col_id(self, idx, v, create=True):
+        if isinstance(v, str):
+            tr = idx.column_translator
+            if tr is None:
+                raise SQLError(f"table {idx.name} has integer _id")
+            return tr.create_keys(v)[v] if create else \
+                tr.find_keys(v).get(v)
+        if idx.keys:
+            raise SQLError(
+                f"table {idx.name} has string _id; got {v!r}")
+        return int(v)
+
+    def _insert(self, stmt: ast.Insert) -> SQLResult:
+        idx = self._index(stmt.table)
+        if "_id" not in stmt.columns:
+            raise SQLError("INSERT requires an _id column")
+        id_pos = stmt.columns.index("_id")
+        fields = []
+        for c in stmt.columns:
+            if c == "_id":
+                fields.append(None)
+                continue
+            f = idx.field(c)
+            if f is None:
+                raise SQLError(f"column not found: {c}")
+            fields.append(f)
+        for row in stmt.rows:
+            col = self._col_id(idx, row[id_pos])
+            if stmt.replace:
+                # full-record replace: drop existing values first
+                from pilosa_tpu.ops import bitmap as bm
+                shard, sc = divmod(col, idx.width)
+                mask = bm.from_columns([sc], idx.width)
+                for f in idx.fields.values():
+                    for v in f.views.values():
+                        frag = v.fragment(shard)
+                        if frag is not None:
+                            frag.clear_columns(mask)
+            for f, v in zip(fields, row):
+                if f is None or v is None:
+                    continue
+                t = f.options.type
+                if t.is_bsi:
+                    f.set_value(col, v)
+                elif t == FieldType.BOOL:
+                    f.set_bit(1 if v else 0, col)
+                else:
+                    vals = v if isinstance(v, list) else [v]
+                    if t == FieldType.MUTEX and len(vals) > 1:
+                        raise SQLError(
+                            f"column {f.name} accepts a single value")
+                    for item in vals:
+                        f.set_bit(self._row_id(f, item, create=True), col)
+            idx.mark_columns_exist([col])
+        return SQLResult()
+
+    def _row_id(self, f, v, create=False):
+        if isinstance(v, str):
+            tr = f.row_translator
+            if tr is None:
+                raise SQLError(
+                    f"column {f.name} holds ids, got string {v!r}")
+            if create:
+                return tr.create_keys(v)[v]
+            return tr.find_keys(v).get(v)
+        return int(v)
+
+    def _delete(self, stmt: ast.Delete) -> SQLResult:
+        idx = self._index(stmt.table)
+        filt = self._compile_where(idx, stmt.where)
+        self.executor._execute_call(idx, Call("Delete", children=[filt]),
+                                    None)
+        return SQLResult()
+
+    # -- WHERE → PQL ----------------------------------------------------
+
+    def _field(self, idx, name: str):
+        f = idx.field(name)
+        if f is None:
+            raise SQLError(f"column not found: {name}")
+        return f
+
+    def _compile_where(self, idx, where) -> Call:
+        if where is None:
+            return Call("All")
+        return self._where(idx, where)
+
+    def _where(self, idx, e) -> Call:
+        if isinstance(e, ast.BinOp):
+            if e.op == "and":
+                return Call("Intersect", children=[
+                    self._where(idx, e.left), self._where(idx, e.right)])
+            if e.op == "or":
+                return Call("Union", children=[
+                    self._where(idx, e.left), self._where(idx, e.right)])
+            return self._comparison(idx, e)
+        if isinstance(e, ast.Not):
+            return Call("Not", children=[self._where(idx, e.expr)])
+        if isinstance(e, ast.InList):
+            return self._in_list(idx, e)
+        if isinstance(e, ast.Between):
+            name = self._col_name(e.col)
+            lo = e.lo.value if isinstance(e.lo, ast.Lit) else e.lo
+            hi = e.hi.value if isinstance(e.hi, ast.Lit) else e.hi
+            node = Call("Row", args={name: Condition("><", [lo, hi])})
+            return Call("Not", children=[node]) if e.negated else node
+        if isinstance(e, ast.IsNull):
+            return self._is_null(idx, e)
+        raise SQLError(f"unsupported WHERE expression {e!r}")
+
+    def _col_name(self, e) -> str:
+        if not isinstance(e, ast.Col):
+            raise SQLError(f"expected column, got {e!r}")
+        return e.name
+
+    def _comparison(self, idx, e: ast.BinOp) -> Call:
+        # normalize literal-on-left
+        left, right, op = e.left, e.right, e.op
+        if isinstance(left, ast.Lit) and isinstance(right, ast.Col):
+            left, right = right, left
+            op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+        name = self._col_name(left)
+        if not isinstance(right, ast.Lit):
+            raise SQLError("comparison requires a literal")
+        val = right.value
+        if name == "_id":
+            cid = self._col_id(idx, val, create=False)
+            cols = [cid] if cid is not None else []
+            # intersect with existence: a ConstRow bit for a missing
+            # record must not count
+            node = Call("Intersect", children=[
+                Call("ConstRow", args={"columns": cols}), Call("All")])
+            if op in ("=",):
+                return node
+            if op == "!=":
+                return Call("Not", children=[node])
+            raise SQLError("_id supports =, != and IN")
+        f = self._field(idx, name)
+        t = f.options.type
+        if op == "like":
+            if f.row_translator is None:
+                raise SQLError("LIKE requires a string column")
+            return Call("UnionRows", children=[
+                Call("Rows", args={"_field": name, "like": val})])
+        if t.is_bsi:
+            pql_op = {"=": "==", "!=": "!="}.get(op, op)
+            return Call("Row", args={name: Condition(pql_op, val)})
+        if t == FieldType.BOOL:
+            if op not in ("=", "!="):
+                raise SQLError("bool columns support = and !=")
+            node = Call("Row", args={name: bool(val)})
+            return Call("Not", children=[node]) if op == "!=" else node
+        # set / mutex / time: row membership
+        if op == "=":
+            return Call("Row", args={name: val})
+        if op == "!=":
+            return Call("Not", children=[Call("Row", args={name: val})])
+        raise SQLError(f"operator {op} not supported on {t.value} columns")
+
+    def _in_list(self, idx, e: ast.InList) -> Call:
+        name = self._col_name(e.col)
+        if name == "_id":
+            cols = []
+            for v in e.items:
+                cid = self._col_id(idx, v, create=False)
+                if cid is not None:
+                    cols.append(cid)
+            node = Call("Intersect", children=[
+                Call("ConstRow", args={"columns": cols}), Call("All")])
+        else:
+            f = self._field(idx, name)
+            if f.options.type.is_bsi:
+                children = [Call("Row", args={name: Condition("==", v)})
+                            for v in e.items]
+            else:
+                children = [Call("Row", args={name: v}) for v in e.items]
+            node = Call("Union", children=children)
+        return Call("Not", children=[node]) if e.negated else node
+
+    def _is_null(self, idx, e: ast.IsNull) -> Call:
+        name = self._col_name(e.col)
+        f = self._field(idx, name)
+        if f.options.type.is_bsi:
+            node = Call("Row", args={name: Condition(
+                "!=" if e.negated else "==", None)})
+            return node
+        # set-like: null = exists but no row in this field
+        union = Call("UnionRows", children=[
+            Call("Rows", args={"_field": name})])
+        if e.negated:
+            return union
+        return Call("Not", children=[union])
+
+    # -- SELECT ---------------------------------------------------------
+
+    def _select(self, stmt: ast.Select) -> SQLResult:
+        idx = self._index(stmt.table)
+        filt = self._compile_where(idx, stmt.where)
+
+        # expand * into _id + all columns
+        items: list[ast.SelectItem] = []
+        for it in stmt.items:
+            if isinstance(it.expr, ast.Col) and it.expr.name == "*":
+                items.append(ast.SelectItem(ast.Col("_id"), "_id"))
+                items += [ast.SelectItem(ast.Col(f.name), f.name)
+                          for f in idx.public_fields()]
+            else:
+                items.append(it)
+
+        if stmt.having is not None and not stmt.group_by:
+            raise SQLError("HAVING requires GROUP BY")
+        aggs = [it for it in items if isinstance(it.expr, ast.Agg)]
+        if stmt.group_by:
+            return self._select_grouped(idx, stmt, items, filt)
+        if aggs:
+            if len(aggs) != len(items):
+                raise SQLError(
+                    "mixing aggregates and columns requires GROUP BY")
+            return self._select_aggregates(idx, stmt, items, filt)
+        if stmt.distinct and len(items) == 1 and \
+                isinstance(items[0].expr, ast.Col) and \
+                items[0].expr.name != "_id":
+            return self._select_distinct(idx, stmt, items[0], filt)
+        return self._select_rows(idx, stmt, items, filt)
+
+    def _name_of(self, it: ast.SelectItem) -> str:
+        if it.alias:
+            return it.alias
+        e = it.expr
+        if isinstance(e, ast.Col):
+            return e.name
+        if isinstance(e, ast.Agg):
+            inner = e.arg.name if e.arg else "*"
+            d = "distinct " if e.distinct else ""
+            return f"{e.func}({d}{inner})"
+        return "expr"
+
+    def _select_aggregates(self, idx, stmt, items, filt) -> SQLResult:
+        ex = self.executor
+        row_vals, schema = [], []
+        for it in items:
+            a: ast.Agg = it.expr
+            schema.append((self._name_of(it), self._agg_type(idx, a)))
+            row_vals.append(self._eval_agg(idx, a, filt))
+        return SQLResult(schema=schema, rows=[tuple(row_vals)])
+
+    def _agg_type(self, idx, a: ast.Agg) -> str:
+        if a.func == "count":
+            return "int"
+        if a.func == "avg":
+            return "decimal"
+        f = self._field(idx, a.arg.name)
+        return _sql_type(f)
+
+    def _eval_agg(self, idx, a: ast.Agg, filt: Call):
+        ex = self.executor
+        has_filter = not (filt.name == "All" and not filt.args)
+        fchildren = [filt] if has_filter else []
+        if a.func == "count" and a.arg is None:
+            return ex._execute_call(idx, Call(
+                "Count", children=[filt]), None)
+        if a.func == "count" and a.distinct:
+            res = ex._execute_call(idx, Call(
+                "Distinct", args={"_field": a.arg.name},
+                children=fchildren), None)
+            return len(res.values) if isinstance(res, DistinctValues) \
+                else res.count()
+        if a.func == "count":
+            # non-null count of the column
+            f = self._field(idx, a.arg.name)
+            if f.options.type.is_bsi:
+                nn = Call("Row", args={a.arg.name: Condition("!=", None)})
+            else:
+                nn = Call("UnionRows", children=[
+                    Call("Rows", args={"_field": a.arg.name})])
+            tree = Call("Intersect", children=[filt, nn]) if has_filter else nn
+            return ex._execute_call(idx, Call("Count", children=[tree]), None)
+        if a.func in ("sum", "min", "max", "avg"):
+            call_name = {"sum": "Sum", "min": "Min", "max": "Max",
+                         "avg": "Sum"}[a.func]
+            res = ex._execute_call(idx, Call(
+                call_name, args={"_field": a.arg.name},
+                children=fchildren), None)
+            if a.func == "avg":
+                return res.value / res.count if res.count else None
+            return res.value
+        if a.func == "percentile":
+            args = {"_field": a.arg.name, "nth": a.extra}
+            if has_filter:
+                args["filter"] = filt
+            res = ex._execute_call(idx, Call("Percentile", args=args), None)
+            return res.value if res is not None else None
+        raise SQLError(f"unsupported aggregate {a.func}")
+
+    def _select_grouped(self, idx, stmt, items, filt) -> SQLResult:
+        group_cols = stmt.group_by
+        # validate items: group cols or aggregates
+        schema, getters = [], []
+        sum_field = None
+        for it in items:
+            e = it.expr
+            if isinstance(e, ast.Col):
+                if e.name not in group_cols:
+                    raise SQLError(
+                        f"column {e.name} must appear in GROUP BY")
+                gi = group_cols.index(e.name)
+                f = self._field(idx, e.name)
+                schema.append((self._name_of(it),
+                               "string" if f.options.keys else "id"))
+                getters.append(("group", gi))
+            elif isinstance(e, ast.Agg):
+                if e.func == "count" and e.arg is None:
+                    schema.append((self._name_of(it), "int"))
+                    getters.append(("count", None))
+                elif e.func in ("sum", "avg"):
+                    if sum_field is None:
+                        sum_field = e.arg.name
+                    elif sum_field != e.arg.name:
+                        raise SQLError(
+                            "only one SUM column per grouped query")
+                    schema.append((self._name_of(it), self._agg_type(idx, e)))
+                    getters.append((e.func, None))
+                else:
+                    raise SQLError(
+                        f"aggregate {e.func} not supported with GROUP BY")
+            else:
+                raise SQLError("invalid GROUP BY projection")
+        args = {}
+        has_filter = not (filt.name == "All" and not filt.args)
+        if has_filter:
+            args["filter"] = filt
+        if sum_field is not None:
+            args["aggregate"] = Call("Sum", args={"_field": sum_field})
+        having = stmt.having
+        if having is not None:
+            args["having"] = self._compile_having(having)
+        call = Call("GroupBy", args=args, children=[
+            Call("Rows", args={"_field": g}) for g in group_cols])
+        groups = self.executor._execute_call(idx, call, None)
+        rows = []
+        for g in groups:
+            vals = []
+            for kind, gi in getters:
+                if kind == "group":
+                    ge = g.group[gi]
+                    vals.append(ge.get("row_key", ge["row_id"]))
+                elif kind == "count":
+                    vals.append(g.count)
+                elif kind == "sum":
+                    vals.append(g.agg)
+                elif kind == "avg":
+                    vals.append(g.agg / g.count if g.count else None)
+            rows.append(tuple(vals))
+        rows = self._order_rows(stmt, schema, rows)
+        rows = self._limit_rows(stmt, rows)
+        return SQLResult(schema=schema, rows=rows)
+
+    def _compile_having(self, having) -> Call:
+        # HAVING COUNT(*) > n / SUM(col) > n → Condition(count/sum OP n)
+        if isinstance(having, ast.BinOp) and \
+                isinstance(having.left, ast.Agg):
+            a = having.left
+            key = "count" if a.func == "count" else "sum"
+            if not isinstance(having.right, ast.Lit):
+                raise SQLError("HAVING requires a literal bound")
+            op = {"=": "=="}.get(having.op, having.op)
+            return Call("Condition",
+                        args={key: Condition(op, having.right.value)})
+        raise SQLError("HAVING supports COUNT(*)/SUM(col) comparisons")
+
+    def _select_distinct(self, idx, stmt, item, filt) -> SQLResult:
+        name = item.expr.name
+        f = self._field(idx, name)
+        has_filter = not (filt.name == "All" and not filt.args)
+        res = self.executor._execute_call(idx, Call(
+            "Distinct", args={"_field": name},
+            children=[filt] if has_filter else []), None)
+        if isinstance(res, DistinctValues):
+            values = res.values
+        else:
+            values = res.columns().tolist()
+            if f.options.keys:
+                values = f.row_translator.translate_ids(values)
+        rows = [(self._to_sql_value(v),) for v in values]
+        schema = [(self._name_of(item), _sql_type(f))]
+        sel = stmt
+        rows = self._order_rows(sel, schema, rows)
+        rows = self._limit_rows(sel, rows)
+        return SQLResult(schema=schema, rows=rows)
+
+    def _select_rows(self, idx, stmt, items, filt) -> SQLResult:
+        names = [self._col_name(it.expr) for it in items]
+        for n in names:
+            if n != "_id":
+                self._field(idx, n)  # validate before executing
+        non_id = [n for n in names if n != "_id"]
+        order_col = None
+        if stmt.order_by:
+            if len(stmt.order_by) != 1:
+                raise SQLError("single ORDER BY column supported")
+            ob = stmt.order_by[0]
+            order_col = self._col_name(ob.expr)
+        # pushdown: ORDER BY on BSI column → Sort; plain LIMIT → Limit
+        inner = filt
+        host_sort = False
+        if order_col is not None and order_col != "_id":
+            f = self._field(idx, order_col)
+            if f.options.type.is_bsi:
+                args = {"_field": order_col}
+                if stmt.order_by[0].desc:
+                    args["sort-desc"] = True
+                if stmt.limit is not None and stmt.having is None:
+                    args["limit"] = stmt.limit + (stmt.offset or 0)
+                inner = Call("Sort", args=args, children=[filt])
+            else:
+                host_sort = True
+        elif order_col == "_id":
+            host_sort = stmt.order_by[0].desc  # asc is natural order
+        if not host_sort and order_col is None and stmt.limit is not None:
+            inner = Call("Limit", args={
+                "limit": stmt.limit + (stmt.offset or 0)}, children=[filt])
+
+        extract_cols = list(non_id)
+        if host_sort and order_col not in names and order_col != "_id":
+            extract_cols.append(order_col)  # fetched for sorting only
+        extract = Call("Extract", children=[inner] + [
+            Call("Rows", args={"_field": n}) for n in extract_cols])
+        table = self.executor._execute_call(idx, extract, None)
+
+        schema = []
+        for it in items:
+            n = self._col_name(it.expr)
+            if n == "_id":
+                schema.append((self._name_of(it),
+                               "string" if idx.keys else "id"))
+            else:
+                schema.append((self._name_of(it),
+                               _sql_type(self._field(idx, n))))
+        rows = []
+        sort_keys = []
+        for entry in table.columns:
+            vals = []
+            for it in items:
+                n = self._col_name(it.expr)
+                if n == "_id":
+                    vals.append(entry.get("column_key", entry["column"]))
+                else:
+                    vals.append(self._to_sql_value(
+                        entry["rows"][extract_cols.index(n)]))
+            rows.append(tuple(vals))
+            if host_sort:
+                if order_col == "_id":
+                    k = entry.get("column_key", entry["column"])
+                else:
+                    k = entry["rows"][extract_cols.index(order_col)]
+                if isinstance(k, list):  # set column: sort by first value
+                    k = sorted(k)[0] if k else None
+                sort_keys.append(k)
+        if host_sort:
+            order = sorted(range(len(rows)),
+                           key=lambda i: (sort_keys[i] is None, sort_keys[i]),
+                           reverse=stmt.order_by[0].desc)
+            rows = [rows[i] for i in order]
+        if stmt.distinct:
+            seen, deduped = set(), []
+            for r in rows:
+                k = tuple(tuple(sorted(v)) if isinstance(v, list) else v
+                          for v in r)
+                if k not in seen:
+                    seen.add(k)
+                    deduped.append(r)
+            rows = deduped
+        rows = self._limit_rows(stmt, rows)
+        return SQLResult(schema=schema, rows=rows)
+
+    def _order_rows(self, stmt, schema, rows):
+        if not stmt.order_by:
+            return rows
+        if len(stmt.order_by) != 1:
+            raise SQLError("single ORDER BY column supported")
+        ob = stmt.order_by[0]
+        name = (self._col_name(ob.expr) if isinstance(ob.expr, ast.Col)
+                else self._name_of(ast.SelectItem(ob.expr)))
+        names = [s[0] for s in schema]
+        if name not in names:
+            raise SQLError(f"ORDER BY column {name!r} not in projection")
+        i = names.index(name)
+        return sorted(rows, key=lambda r: (r[i] is None, r[i]),
+                      reverse=ob.desc)
+
+    def _limit_rows(self, stmt, rows):
+        off = stmt.offset or 0
+        if stmt.limit is not None:
+            return rows[off:off + stmt.limit]
+        return rows[off:] if off else rows
+
+    def _to_sql_value(self, v):
+        if isinstance(v, dt.datetime):
+            return v.isoformat()
+        if isinstance(v, list):
+            return v
+        return v

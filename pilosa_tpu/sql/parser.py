@@ -1,0 +1,357 @@
+"""Recursive-descent SQL parser (sql3/parser/parser.go subset).
+
+Statements: CREATE TABLE / DROP TABLE / SHOW TABLES / SHOW COLUMNS /
+INSERT [OR REPLACE] / DELETE / SELECT with WHERE, GROUP BY, HAVING,
+ORDER BY, LIMIT/OFFSET, DISTINCT, and aggregate projections.
+"""
+
+from __future__ import annotations
+
+from decimal import Decimal
+
+from pilosa_tpu.sql import ast
+from pilosa_tpu.sql.lexer import SQLError, Token, tokenize
+
+_TYPES = {"id", "string", "int", "decimal", "timestamp", "bool", "idset",
+          "stringset"}
+
+
+class Parser:
+    def __init__(self, text: str):
+        self.toks = tokenize(text)
+        self.i = 0
+
+    # -- token plumbing -------------------------------------------------
+
+    def peek(self, ahead=0) -> Token:
+        return self.toks[min(self.i + ahead, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.peek()
+        self.i += 1
+        return t
+
+    def accept(self, kind, value=None) -> Token | None:
+        t = self.peek()
+        if t.kind == kind and (value is None or t.value == value):
+            return self.next()
+        return None
+
+    def expect(self, kind, value=None) -> Token:
+        t = self.accept(kind, value)
+        if t is None:
+            got = self.peek()
+            raise SQLError(
+                f"expected {value or kind} at {got.pos}, got {got.value!r}")
+        return t
+
+    def kw(self, word) -> Token | None:
+        return self.accept("keyword", word)
+
+    def expect_kw(self, word) -> Token:
+        return self.expect("keyword", word)
+
+    # -- statements -----------------------------------------------------
+
+    def parse(self):
+        stmts = []
+        while self.peek().kind != "eof":
+            stmts.append(self.statement())
+            self.accept("op", ";")
+        if not stmts:
+            raise SQLError("empty statement")
+        return stmts
+
+    def statement(self):
+        t = self.peek()
+        if t.kind != "keyword":
+            raise SQLError(f"unexpected {t.value!r} at {t.pos}")
+        if t.value == "create":
+            return self.create_table()
+        if t.value == "drop":
+            return self.drop_table()
+        if t.value == "show":
+            return self.show()
+        if t.value in ("insert", "replace"):
+            return self.insert()
+        if t.value == "delete":
+            return self.delete()
+        if t.value == "select":
+            return self.select()
+        raise SQLError(f"unsupported statement {t.value!r}")
+
+    def create_table(self):
+        self.expect_kw("create")
+        self.expect_kw("table")
+        if_not_exists = False
+        if self.kw("if"):
+            self.expect_kw("not")
+            self.expect_kw("exists")
+            if_not_exists = True
+        name = self.expect("ident").value
+        self.expect("op", "(")
+        cols = []
+        keys = False
+        while True:
+            cname = self.expect("ident").value
+            ctype = self.next().value.lower()
+            if ctype not in _TYPES:
+                raise SQLError(f"unknown column type {ctype!r}")
+            cd = ast.ColumnDef(cname, ctype)
+            if ctype == "decimal" and self.accept("op", "("):
+                cd.scale = int(self.expect("number").value)
+                self.expect("op", ")")
+            # column constraints subset: min/max for int ("min"/"max"
+            # lex as keywords, "timequantum" as an ident)
+            while self.peek().kind in ("ident", "keyword") and \
+                    self.peek().value.lower() in ("min", "max", "timequantum"):
+                opt = self.next().value.lower()
+                if opt == "timequantum":
+                    cd.time_quantum = self.expect("string").value
+                else:
+                    v = int(self.expect("number").value)
+                    setattr(cd, opt, v)
+            if cname == "_id":
+                keys = ctype == "string"
+            cols.append(cd)
+            if not self.accept("op", ","):
+                break
+        self.expect("op", ")")
+        return ast.CreateTable(name, cols, keys=keys,
+                               if_not_exists=if_not_exists)
+
+    def drop_table(self):
+        self.expect_kw("drop")
+        self.expect_kw("table")
+        if_exists = False
+        if self.kw("if"):
+            self.expect_kw("exists")
+            if_exists = True
+        return ast.DropTable(self.expect("ident").value, if_exists=if_exists)
+
+    def show(self):
+        self.expect_kw("show")
+        if self.kw("tables"):
+            return ast.ShowTables()
+        if self.kw("columns"):
+            self.expect_kw("from")
+            return ast.ShowColumns(self.expect("ident").value)
+        raise SQLError("expected TABLES or COLUMNS after SHOW")
+
+    def insert(self):
+        replace = False
+        if self.kw("replace"):
+            self.expect_kw("into")
+            replace = True
+        else:
+            self.expect_kw("insert")
+            if self.kw("or"):
+                self.expect_kw("replace")
+                replace = True
+            self.expect_kw("into")
+        table = self.expect("ident").value
+        cols = []
+        self.expect("op", "(")
+        while True:
+            cols.append(self.expect("ident").value)
+            if not self.accept("op", ","):
+                break
+        self.expect("op", ")")
+        self.expect_kw("values")
+        rows = []
+        while True:
+            self.expect("op", "(")
+            row = []
+            while True:
+                row.append(self.literal_value())
+                if not self.accept("op", ","):
+                    break
+            self.expect("op", ")")
+            if len(row) != len(cols):
+                raise SQLError("VALUES arity mismatch")
+            rows.append(row)
+            if not self.accept("op", ","):
+                break
+        return ast.Insert(table, cols, rows, replace=replace)
+
+    def delete(self):
+        self.expect_kw("delete")
+        self.expect_kw("from")
+        table = self.expect("ident").value
+        where = None
+        if self.kw("where"):
+            where = self.expr()
+        return ast.Delete(table, where)
+
+    def select(self):
+        self.expect_kw("select")
+        sel = ast.Select()
+        sel.distinct = bool(self.kw("distinct"))
+        while True:
+            if self.accept("op", "*"):
+                sel.items.append(ast.SelectItem(ast.Col("*")))
+            else:
+                e = self.expr()
+                alias = None
+                if self.kw("as"):
+                    alias = self.next().value
+                sel.items.append(ast.SelectItem(e, alias))
+            if not self.accept("op", ","):
+                break
+        self.expect_kw("from")
+        sel.table = self.expect("ident").value
+        if self.kw("where"):
+            sel.where = self.expr()
+        if self.kw("group"):
+            self.expect_kw("by")
+            while True:
+                sel.group_by.append(self.expect("ident").value)
+                if not self.accept("op", ","):
+                    break
+        if self.kw("having"):
+            sel.having = self.expr()
+        if self.kw("order"):
+            self.expect_kw("by")
+            while True:
+                e = self.expr()
+                desc = False
+                if self.kw("desc"):
+                    desc = True
+                elif self.kw("asc"):
+                    pass
+                sel.order_by.append(ast.OrderBy(e, desc))
+                if not self.accept("op", ","):
+                    break
+        if self.kw("limit"):
+            sel.limit = int(self.expect("number").value)
+        if self.kw("offset"):
+            sel.offset = int(self.expect("number").value)
+        return sel
+
+    # -- expressions ----------------------------------------------------
+
+    def expr(self):
+        return self.or_expr()
+
+    def or_expr(self):
+        left = self.and_expr()
+        while self.kw("or"):
+            left = ast.BinOp("or", left, self.and_expr())
+        return left
+
+    def and_expr(self):
+        left = self.not_expr()
+        while self.kw("and"):
+            left = ast.BinOp("and", left, self.not_expr())
+        return left
+
+    def not_expr(self):
+        if self.kw("not"):
+            return ast.Not(self.not_expr())
+        return self.cmp_expr()
+
+    def cmp_expr(self):
+        left = self.primary()
+        t = self.peek()
+        if t.kind == "op" and t.value in ("=", "!=", "<>", "<", "<=", ">",
+                                          ">="):
+            op = self.next().value
+            if op == "<>":
+                op = "!="
+            return ast.BinOp(op, left, self.primary())
+        if t.kind == "keyword":
+            negated = False
+            if t.value == "not":
+                # col NOT IN / NOT LIKE / NOT BETWEEN
+                nxt = self.peek(1)
+                if nxt.kind == "keyword" and nxt.value in ("in", "like",
+                                                           "between"):
+                    self.next()
+                    negated = True
+                    t = self.peek()
+            if self.kw("in"):
+                self.expect("op", "(")
+                items = []
+                while True:
+                    items.append(self.literal_value())
+                    if not self.accept("op", ","):
+                        break
+                self.expect("op", ")")
+                return ast.InList(left, items, negated=negated)
+            if self.kw("like"):
+                pat = self.expect("string").value
+                node = ast.BinOp("like", left, ast.Lit(pat))
+                return ast.Not(node) if negated else node
+            if self.kw("between"):
+                lo = self.primary()
+                self.expect_kw("and")
+                hi = self.primary()
+                return ast.Between(left, lo, hi, negated=negated)
+            if self.kw("is"):
+                negated = bool(self.kw("not"))
+                self.expect_kw("null")
+                return ast.IsNull(left, negated=negated)
+        return left
+
+    def primary(self):
+        t = self.peek()
+        if t.kind == "op" and t.value == "(":
+            self.next()
+            e = self.expr()
+            self.expect("op", ")")
+            return e
+        if t.kind == "keyword" and t.value in ("count", "sum", "min", "max",
+                                               "avg", "percentile"):
+            return self.aggregate()
+        if t.kind == "number" or (t.kind == "op" and t.value == "-"):
+            return ast.Lit(self.literal_value())
+        if t.kind == "string":
+            return ast.Lit(self.next().value)
+        if t.kind == "keyword" and t.value in ("true", "false", "null"):
+            self.next()
+            return ast.Lit({"true": True, "false": False,
+                            "null": None}[t.value])
+        if t.kind == "ident":
+            return ast.Col(self.next().value)
+        raise SQLError(f"unexpected {t.value!r} at {t.pos}")
+
+    def aggregate(self):
+        func = self.next().value
+        self.expect("op", "(")
+        distinct = bool(self.kw("distinct"))
+        if self.accept("op", "*"):
+            arg = None
+        else:
+            arg = ast.Col(self.expect("ident").value)
+        extra = None
+        if func == "percentile":
+            self.expect("op", ",")
+            extra = self.literal_value()
+        self.expect("op", ")")
+        return ast.Agg(func, arg, distinct=distinct, extra=extra)
+
+    def literal_value(self):
+        t = self.next()
+        if t.kind == "number":
+            return Decimal(t.value) if "." in t.value else int(t.value)
+        if t.kind == "op" and t.value == "-":
+            v = self.literal_value()
+            return -v
+        if t.kind == "string":
+            return t.value
+        if t.kind == "keyword" and t.value in ("true", "false", "null"):
+            return {"true": True, "false": False, "null": None}[t.value]
+        if t.kind == "op" and t.value == "(":
+            # tuple literal for set columns: (1, 2, 3) or ('a','b')
+            items = []
+            while True:
+                items.append(self.literal_value())
+                if not self.accept("op", ","):
+                    break
+            self.expect("op", ")")
+            return items
+        raise SQLError(f"expected literal at {t.pos}, got {t.value!r}")
+
+
+def parse_sql(text: str):
+    return Parser(text).parse()

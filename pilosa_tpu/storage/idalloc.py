@@ -22,13 +22,30 @@ class IDAllocator:
         self._lock = threading.RLock()
         if path and os.path.exists(path):
             with open(path) as f:
-                self._next = {k: int(v) for k, v in json.load(f).items()}
+                state = json.load(f)
+            if "next" not in state and "reserved" not in state:
+                # legacy flat format: the whole dict is the next-map
+                state = {"next": state}
+            self._next = {k: int(v) for k, v in state.get("next", {}).items()}
+            self._reserved = {
+                k: (bytes.fromhex(sess), int(start), int(count))
+                for k, (sess, start, count)
+                in state.get("reserved", {}).items()}
 
     def _persist(self):
+        """Both next-ids AND in-flight reservations persist, so an
+        ingester retrying the same session after a crash gets the same
+        range back (idalloc.go keeps reservations in BoltDB)."""
         if self.path:
             os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
             with open(self.path, "w") as f:
-                json.dump(self._next, f)
+                json.dump({
+                    "next": self._next,
+                    "reserved": {
+                        k: [sess.hex(), start, count]
+                        for k, (sess, start, count)
+                        in self._reserved.items()},
+                }, f)
 
     def reserve(self, key: str, session: bytes, count: int) -> range:
         """Reserve `count` ids for (key, session).  Matching an

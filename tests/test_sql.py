@@ -1,0 +1,261 @@
+"""SQL conformance tests, modeled on the reference's declarative
+sql3/test/defs suites (defs_groupby.go, defs_join.go, ...)."""
+
+import pytest
+
+from pilosa_tpu.models import Holder
+from pilosa_tpu.sql import SQLEngine, SQLError
+
+W = 1 << 12
+
+
+@pytest.fixture
+def eng():
+    e = SQLEngine(Holder(width=W))
+    e.query("""
+      CREATE TABLE orders (
+        _id id, region string, status string, qty int,
+        price decimal(2), tags stringset, paid bool
+      )""")
+    e.query("""
+      INSERT INTO orders (_id, region, status, qty, price, tags, paid) VALUES
+        (1, 'west', 'open',    5, '10.50', ('a','b'), true),
+        (2, 'west', 'closed', 12,  '3.25', ('b'),     false),
+        (3, 'east', 'open',    7, '99.99', ('a','c'), true),
+        (4, 'east', 'open',    2,  '1.00', ('c'),     false),
+        (5, 'north','closed', 12,  '0.75', ('a'),     true)""")
+    return e
+
+
+def rows(res):
+    return res.rows
+
+
+def test_show_tables_and_columns(eng):
+    assert rows(eng.query_one("SHOW TABLES")) == [("orders",)]
+    cols = dict(rows(eng.query_one("SHOW COLUMNS FROM orders")))
+    assert cols["qty"] == "int" and cols["region"] == "string"
+    assert cols["tags"] == "stringset" and cols["price"] == "decimal"
+    assert cols["_id"] == "id" and cols["paid"] == "bool"
+
+
+def test_count_star(eng):
+    assert rows(eng.query_one("SELECT COUNT(*) FROM orders")) == [(5,)]
+
+
+def test_count_where(eng):
+    q = "SELECT COUNT(*) FROM orders WHERE region = 'west'"
+    assert rows(eng.query_one(q)) == [(2,)]
+    q = "SELECT COUNT(*) FROM orders WHERE qty > 5 AND status = 'open'"
+    assert rows(eng.query_one(q)) == [(1,)]
+    q = "SELECT COUNT(*) FROM orders WHERE region = 'west' OR region = 'east'"
+    assert rows(eng.query_one(q)) == [(4,)]
+    q = "SELECT COUNT(*) FROM orders WHERE NOT status = 'open'"
+    assert rows(eng.query_one(q)) == [(2,)]
+
+
+def test_comparison_operators(eng):
+    assert rows(eng.query_one(
+        "SELECT COUNT(*) FROM orders WHERE qty >= 12")) == [(2,)]
+    assert rows(eng.query_one(
+        "SELECT COUNT(*) FROM orders WHERE qty != 12")) == [(3,)]
+    assert rows(eng.query_one(
+        "SELECT COUNT(*) FROM orders WHERE qty BETWEEN 5 AND 7")) == [(2,)]
+    assert rows(eng.query_one(
+        "SELECT COUNT(*) FROM orders WHERE price < 4")) == [(3,)]
+    assert rows(eng.query_one(
+        "SELECT COUNT(*) FROM orders WHERE paid = true")) == [(3,)]
+
+
+def test_in_like(eng):
+    assert rows(eng.query_one(
+        "SELECT COUNT(*) FROM orders WHERE region IN ('west','north')")) \
+        == [(3,)]
+    assert rows(eng.query_one(
+        "SELECT COUNT(*) FROM orders WHERE region NOT IN ('west')")) == [(3,)]
+    assert rows(eng.query_one(
+        "SELECT COUNT(*) FROM orders WHERE region LIKE 'w%'")) == [(2,)]
+
+
+def test_id_filters(eng):
+    assert rows(eng.query_one(
+        "SELECT COUNT(*) FROM orders WHERE _id = 3")) == [(1,)]
+    assert rows(eng.query_one(
+        "SELECT COUNT(*) FROM orders WHERE _id IN (1, 2, 99)")) == [(2,)]
+
+
+def test_set_membership(eng):
+    # set columns match if ANY element equals
+    assert rows(eng.query_one(
+        "SELECT COUNT(*) FROM orders WHERE tags = 'a'")) == [(3,)]
+    assert rows(eng.query_one(
+        "SELECT COUNT(*) FROM orders WHERE tags = 'c'")) == [(2,)]
+
+
+def test_aggregates(eng):
+    assert rows(eng.query_one("SELECT SUM(qty) FROM orders")) == [(38,)]
+    assert rows(eng.query_one("SELECT MIN(qty), MAX(qty) FROM orders")) == \
+        [(2, 12)]
+    r = rows(eng.query_one("SELECT AVG(qty) FROM orders"))[0][0]
+    assert r == pytest.approx(38 / 5)
+    assert rows(eng.query_one(
+        "SELECT COUNT(DISTINCT region) FROM orders")) == [(3,)]
+    assert rows(eng.query_one(
+        "SELECT SUM(qty) FROM orders WHERE region = 'west'")) == [(17,)]
+    assert rows(eng.query_one(
+        "SELECT SUM(price) FROM orders"))[0][0] == pytest.approx(115.49)
+
+
+def test_select_rows(eng):
+    res = eng.query_one(
+        "SELECT _id, qty FROM orders WHERE status = 'open' ORDER BY qty")
+    assert res.schema == [("_id", "id"), ("qty", "int")]
+    assert rows(res) == [(4, 2), (1, 5), (3, 7)]
+
+
+def test_select_star(eng):
+    res = eng.query_one("SELECT * FROM orders WHERE _id = 1")
+    d = dict(zip([s[0] for s in res.schema], res.rows[0]))
+    assert d["_id"] == 1 and d["qty"] == 5 and d["region"] == "west"
+    assert sorted(d["tags"]) == ["a", "b"]
+    assert d["price"] == pytest.approx(10.5) and d["paid"] is True
+
+
+def test_order_limit_offset(eng):
+    res = eng.query_one("SELECT _id FROM orders ORDER BY qty DESC LIMIT 2")
+    assert rows(res) == [(2,), (5,)]
+    res = eng.query_one(
+        "SELECT _id FROM orders ORDER BY qty LIMIT 2 OFFSET 1")
+    assert rows(res) == [(1,), (3,)]
+    res = eng.query_one("SELECT _id FROM orders ORDER BY region")
+    assert [r[0] for r in rows(res)] == [3, 4, 5, 1, 2]
+
+
+def test_group_by(eng):
+    res = eng.query_one("""
+      SELECT region, COUNT(*), SUM(qty) FROM orders
+      GROUP BY region ORDER BY region""")
+    assert rows(res) == [("east", 2, 9), ("north", 1, 12), ("west", 2, 17)]
+
+
+def test_group_by_having(eng):
+    res = eng.query_one("""
+      SELECT region, COUNT(*) FROM orders
+      GROUP BY region HAVING COUNT(*) > 1 ORDER BY region""")
+    assert rows(res) == [("east", 2), ("west", 2)]
+
+
+def test_group_by_where(eng):
+    res = eng.query_one("""
+      SELECT status, COUNT(*) FROM orders WHERE qty > 4
+      GROUP BY status ORDER BY status""")
+    assert rows(res) == [("closed", 2), ("open", 2)]
+
+
+def test_group_by_avg(eng):
+    res = eng.query_one(
+        "SELECT region, AVG(qty) FROM orders GROUP BY region ORDER BY region")
+    d = dict(rows(res))
+    assert d["west"] == pytest.approx(8.5)
+
+
+def test_select_distinct(eng):
+    res = eng.query_one("SELECT DISTINCT region FROM orders ORDER BY region")
+    assert rows(res) == [("east",), ("north",), ("west",)]
+    res = eng.query_one("SELECT DISTINCT qty FROM orders ORDER BY qty")
+    assert rows(res) == [(2,), (5,), (7,), (12,)]
+
+
+def test_is_null(eng):
+    eng.query("INSERT INTO orders (_id, region) VALUES (9, 'south')")
+    assert rows(eng.query_one(
+        "SELECT COUNT(*) FROM orders WHERE qty IS NULL")) == [(1,)]
+    assert rows(eng.query_one(
+        "SELECT COUNT(*) FROM orders WHERE qty IS NOT NULL")) == [(5,)]
+    assert rows(eng.query_one(
+        "SELECT COUNT(*) FROM orders WHERE status IS NULL")) == [(1,)]
+
+
+def test_delete(eng):
+    eng.query("DELETE FROM orders WHERE region = 'west'")
+    assert rows(eng.query_one("SELECT COUNT(*) FROM orders")) == [(3,)]
+
+
+def test_insert_merge_and_replace(eng):
+    eng.query("INSERT INTO orders (_id, tags) VALUES (1, ('z'))")
+    res = eng.query_one("SELECT tags FROM orders WHERE _id = 1")
+    assert sorted(res.rows[0][0]) == ["a", "b", "z"]  # INSERT merges sets
+    eng.query("REPLACE INTO orders (_id, tags, qty) VALUES (1, ('q'), 3)")
+    res = eng.query_one("SELECT tags, qty, region FROM orders WHERE _id = 1")
+    assert res.rows[0][0] == ["q"]          # replaced
+    assert res.rows[0][1] == 3
+    assert res.rows[0][2] is None           # other columns cleared
+
+
+def test_string_id_table():
+    e = SQLEngine(Holder(width=W))
+    e.query("CREATE TABLE users (_id string, role string, age int)")
+    e.query("""INSERT INTO users (_id, role, age) VALUES
+        ('alice', 'admin', 30), ('bob', 'eng', 40)""")
+    res = e.query_one("SELECT _id, age FROM users WHERE role = 'admin'")
+    assert res.rows == [("alice", 30)]
+    assert res.schema[0] == ("_id", "string")
+
+
+def test_errors(eng):
+    with pytest.raises(SQLError):
+        eng.query("SELECT nope FROM orders")
+    with pytest.raises(SQLError):
+        eng.query("SELECT * FROM missing")
+    with pytest.raises(SQLError):
+        eng.query("SELECT region, COUNT(*) FROM orders")  # no GROUP BY
+    with pytest.raises(SQLError):
+        eng.query("CREATE TABLE orders (_id id, x int)")  # exists
+    with pytest.raises(SQLError):
+        eng.query("SELECT garbage syntax here")
+
+
+def test_multi_statement(eng):
+    res = eng.query(
+        "SELECT COUNT(*) FROM orders; SELECT SUM(qty) FROM orders")
+    assert rows(res[0]) == [(5,)] and rows(res[1]) == [(38,)]
+
+
+def test_percentile(eng):
+    res = eng.query_one("SELECT PERCENTILE(qty, 50) FROM orders")
+    vals = sorted([5, 12, 7, 2, 12])
+    v = res.rows[0][0]
+    assert sum(1 for x in vals if x < v) <= 2
+    assert sum(1 for x in vals if x > v) <= 2
+
+
+def test_create_if_exists_typo_rejected(eng):
+    with pytest.raises(SQLError):
+        eng.query("CREATE TABLE IF EXISTS t2 (_id id, x int)")
+    eng.query("CREATE TABLE IF NOT EXISTS orders (_id id, x int)")  # no-op
+
+
+def test_int_min_max_constraints(eng):
+    eng.query("CREATE TABLE t2 (_id id, age int min 0 max 150)")
+    idx = eng.holder.index("t2")
+    assert idx.field("age").bit_depth == 8  # 150 needs 8 bits
+
+
+def test_keyed_table_rejects_int_id():
+    e = SQLEngine(Holder(width=W))
+    e.query("CREATE TABLE u (_id string, r string)")
+    with pytest.raises(SQLError):
+        e.query("INSERT INTO u (_id, r) VALUES (7, 'x')")
+
+
+def test_select_distinct_multi_column(eng):
+    eng.query("""INSERT INTO orders (_id, region, status) VALUES
+        (11, 'west', 'open'), (12, 'west', 'open')""")
+    res = eng.query_one(
+        "SELECT DISTINCT region, status FROM orders ORDER BY region")
+    assert len(res.rows) == len(set(res.rows))
+
+
+def test_having_without_group_by_rejected(eng):
+    with pytest.raises(SQLError):
+        eng.query("SELECT COUNT(*) FROM orders HAVING COUNT(*) > 100")

@@ -211,3 +211,45 @@ def test_keyed_extract_translates():
     got = ex.execute("i", "Extract(All(), Rows(f))")[0]
     by_key = {e["column_key"]: e["rows"][0] for e in got.columns}
     assert by_key == {"u1": ["x"], "u2": ["y"]}
+
+
+def test_nested_distinct_keyed_field():
+    h = Holder(width=W)
+    ex = Executor(h)
+    idx = h.create_index("i", keys=True)
+    idx.create_field("f", FieldOptions(keys=True))
+    ex.execute("i", 'Set("alice", f="admin")Set("bob", f="eng")')
+    assert ex.execute("i", "Count(Distinct(field=f))")[0] == 2
+
+
+def test_keyed_rejects_int_ids():
+    from pilosa_tpu.executor.executor import ExecError
+    h = Holder(width=W)
+    ex = Executor(h)
+    idx = h.create_index("i", keys=True)
+    idx.create_field("f", FieldOptions(keys=True))
+    with pytest.raises(ExecError):
+        ex.execute("i", "Set(5, f=\"x\")")
+    with pytest.raises(ExecError):
+        ex.execute("i", "Set(\"c\", f=7)")
+
+
+def test_like_matches_newline():
+    h = Holder(width=W)
+    ex = Executor(h)
+    idx = h.create_index("i", keys=True)
+    idx.create_field("f", FieldOptions(keys=True))
+    ex.execute("i", 'Set("c", f="a\nb")')
+    assert ex.execute("i", 'Rows(f, like="%")')[0] == ["a\nb"]
+
+
+def test_idalloc_reservation_survives_restart(tmp_path):
+    p = str(tmp_path / "ids.json")
+    a = IDAllocator(p)
+    r1 = a.reserve("idx", b"s1", 10)
+    # process crash before commit: a retrying ingester with the same
+    # session must get the same range
+    a2 = IDAllocator(p)
+    assert list(a2.reserve("idx", b"s1", 10)) == list(r1)
+    a2.commit("idx", b"s1")
+    assert a2.reserve("idx", b"s2", 1).start == 10
