@@ -161,11 +161,12 @@ class AdvancedOps:
         combos = list(itertools.product(*[range(len(rl))
                                           for rl in row_lists]))
         counts = np.zeros(len(combos), dtype=np.int64)
-        agg_pos = agg_neg = None
+        agg_pos = agg_neg = agg_nn = None
         if agg_field is not None:
             depth = agg_field.bit_depth
             agg_pos = np.zeros((len(combos), depth), dtype=np.int64)
             agg_neg = np.zeros((len(combos), depth), dtype=np.int64)
+            agg_nn = np.zeros(len(combos), dtype=np.int64)
 
         combo_idx = np.array(combos, dtype=np.int64)  # (C, nf)
         for shard in self._shard_list(idx, shards):
@@ -192,6 +193,8 @@ class AdvancedOps:
                                                   dtype=np.int64)
                 if planes is not None:
                     exists = planes[0][None, :] & mask
+                    agg_nn[i:i + chunk] += np.asarray(bm.count(exists),
+                                                      dtype=np.int64)
                     sign = planes[1]
                     pos = exists & ~sign[None, :]
                     neg = exists & sign[None, :]
@@ -215,12 +218,14 @@ class AdvancedOps:
                 if f.options.keys:
                     entry["row_key"] = f.row_translator.translate_id(rl[gi])
                 group.append(entry)
-            agg = None
+            agg = agg_count = None
             if agg_field is not None:
                 total = sum((int(p) - int(g)) << b for b, (p, g) in
                             enumerate(zip(agg_pos[ci], agg_neg[ci])))
                 agg = agg_field.int_to_value(total)
-            gc = GroupCount(group=group, count=cnt, agg=agg)
+                agg_count = int(agg_nn[ci])
+            gc = GroupCount(group=group, count=cnt, agg=agg,
+                            agg_count=agg_count)
             if having is not None and not self._having_ok(gc, having):
                 continue
             out.append(gc)

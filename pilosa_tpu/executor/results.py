@@ -105,6 +105,7 @@ class GroupCount:
     group: list[dict]  # [{"field":..., "row_id":... or "value":...}, ...]
     count: int = 0
     agg: Any = None
+    agg_count: Any = None  # non-null rows feeding agg (for AVG = agg/agg_count)
 
 
 @dataclass

@@ -98,11 +98,22 @@ class SQLEngine:
             if stmt.if_not_exists:
                 return SQLResult()
             raise SQLError(f"table already exists: {stmt.name}")
-        idx = self.holder.create_index(stmt.name, keys=stmt.keys)
+        # validate every column option before creating anything, so a
+        # bad column never leaves a half-created table behind
+        cols, seen = [], set()
         for cd in stmt.columns:
+            if cd.name in seen:
+                raise SQLError(f"duplicate column name: {cd.name}")
+            seen.add(cd.name)
             if cd.name == "_id":
                 continue
-            idx.create_field(cd.name, self._field_options(cd))
+            try:
+                cols.append((cd.name, self._field_options(cd)))
+            except ValueError as e:
+                raise SQLError(str(e)) from e
+        idx = self.holder.create_index(stmt.name, keys=stmt.keys)
+        for name, opts in cols:
+            idx.create_field(name, opts)
         self.holder.save_schema()
         return SQLResult()
 
@@ -220,6 +231,8 @@ class SQLEngine:
             if create:
                 return tr.create_keys(v)[v]
             return tr.find_keys(v).get(v)
+        if f.options.keys:
+            raise SQLError(f"column {f.name} uses keys; got id {v!r}")
         return int(v)
 
     def _delete(self, stmt: ast.Delete) -> SQLResult:
@@ -241,6 +254,11 @@ class SQLEngine:
         if where is None:
             return Call("All")
         return self._where(idx, where)
+
+    @staticmethod
+    def _has_filter(filt: Call) -> bool:
+        """True unless filt is the no-op match-everything All()."""
+        return not (filt.name == "All" and not filt.args)
 
     def _where(self, idx, e) -> Call:
         if isinstance(e, ast.BinOp):
@@ -411,7 +429,7 @@ class SQLEngine:
 
     def _eval_agg(self, idx, a: ast.Agg, filt: Call):
         ex = self.executor
-        has_filter = not (filt.name == "All" and not filt.args)
+        has_filter = self._has_filter(filt)
         fchildren = [filt] if has_filter else []
         if a.func == "count" and a.arg is None:
             return ex._execute_call(idx, Call(
@@ -483,7 +501,7 @@ class SQLEngine:
             else:
                 raise SQLError("invalid GROUP BY projection")
         args = {}
-        has_filter = not (filt.name == "All" and not filt.args)
+        has_filter = self._has_filter(filt)
         if has_filter:
             args["filter"] = filt
         if sum_field is not None:
@@ -504,9 +522,11 @@ class SQLEngine:
                 elif kind == "count":
                     vals.append(g.count)
                 elif kind == "sum":
-                    vals.append(g.agg)
+                    # SUM over only NULLs is NULL, not 0
+                    vals.append(g.agg if g.agg_count else None)
                 elif kind == "avg":
-                    vals.append(g.agg / g.count if g.count else None)
+                    vals.append(g.agg / g.agg_count if g.agg_count
+                                else None)
             rows.append(tuple(vals))
         rows = self._order_rows(stmt, schema, rows)
         rows = self._limit_rows(stmt, rows)
@@ -528,7 +548,7 @@ class SQLEngine:
     def _select_distinct(self, idx, stmt, item, filt) -> SQLResult:
         name = item.expr.name
         f = self._field(idx, name)
-        has_filter = not (filt.name == "All" and not filt.args)
+        has_filter = self._has_filter(filt)
         res = self.executor._execute_call(idx, Call(
             "Distinct", args={"_field": name},
             children=[filt] if has_filter else []), None)
@@ -557,32 +577,49 @@ class SQLEngine:
                 raise SQLError("single ORDER BY column supported")
             ob = stmt.order_by[0]
             order_col = self._col_name(ob.expr)
-        # pushdown: ORDER BY on BSI column → Sort; plain LIMIT → Limit
+        # pushdown: ORDER BY on BSI column → Sort; plain LIMIT → Limit.
+        # LIMIT must stay host-side under DISTINCT (dedup shrinks the
+        # row set, so a pushed limit would under-return).
         inner = filt
         host_sort = False
+        null_tail = None  # rows where the BSI sort column is NULL
         if order_col is not None and order_col != "_id":
             f = self._field(idx, order_col)
             if f.options.type.is_bsi:
                 args = {"_field": order_col}
                 if stmt.order_by[0].desc:
                     args["sort-desc"] = True
-                if stmt.limit is not None and stmt.having is None:
+                if stmt.limit is not None and not stmt.distinct:
                     args["limit"] = stmt.limit + (stmt.offset or 0)
                 inner = Call("Sort", args=args, children=[filt])
+                # Sort yields only rows holding a value; NULL-valued
+                # rows are appended after (NULLS LAST)
+                nf = Call("Row", args={order_col: Condition("==", None)})
+                null_tail = Call("Intersect", children=[filt, nf]) \
+                    if self._has_filter(filt) else nf
             else:
                 host_sort = True
         elif order_col == "_id":
             host_sort = stmt.order_by[0].desc  # asc is natural order
-        if not host_sort and order_col is None and stmt.limit is not None:
+        if not host_sort and order_col is None and stmt.limit is not None \
+                and not stmt.distinct:
             inner = Call("Limit", args={
                 "limit": stmt.limit + (stmt.offset or 0)}, children=[filt])
 
         extract_cols = list(non_id)
         if host_sort and order_col not in names and order_col != "_id":
             extract_cols.append(order_col)  # fetched for sorting only
-        extract = Call("Extract", children=[inner] + [
-            Call("Rows", args={"_field": n}) for n in extract_cols])
-        table = self.executor._execute_call(idx, extract, None)
+        def run_extract(src):
+            c = Call("Extract", children=[src] + [
+                Call("Rows", args={"_field": n}) for n in extract_cols])
+            return self.executor._execute_call(idx, c, None)
+
+        table = run_extract(inner)
+        need_nulls = null_tail is not None and (
+            stmt.limit is None or stmt.distinct or
+            len(table.columns) < stmt.limit + (stmt.offset or 0))
+        if need_nulls:
+            table.columns.extend(run_extract(null_tail).columns)
 
         schema = []
         for it in items:
@@ -614,10 +651,12 @@ class SQLEngine:
                     k = sorted(k)[0] if k else None
                 sort_keys.append(k)
         if host_sort:
-            order = sorted(range(len(rows)),
-                           key=lambda i: (sort_keys[i] is None, sort_keys[i]),
-                           reverse=stmt.order_by[0].desc)
-            rows = [rows[i] for i in order]
+            # NULLS LAST in both directions (matches the Sort pushdown)
+            nn = [i for i, k in enumerate(sort_keys) if k is not None]
+            nulls = [i for i, k in enumerate(sort_keys) if k is None]
+            nn.sort(key=lambda i: sort_keys[i],
+                    reverse=stmt.order_by[0].desc)
+            rows = [rows[i] for i in nn + nulls]
         if stmt.distinct:
             seen, deduped = set(), []
             for r in rows:
@@ -642,8 +681,10 @@ class SQLEngine:
         if name not in names:
             raise SQLError(f"ORDER BY column {name!r} not in projection")
         i = names.index(name)
-        return sorted(rows, key=lambda r: (r[i] is None, r[i]),
-                      reverse=ob.desc)
+        nn = [r for r in rows if r[i] is not None]
+        nulls = [r for r in rows if r[i] is None]
+        nn.sort(key=lambda r: r[i], reverse=ob.desc)
+        return nn + nulls
 
     def _limit_rows(self, stmt, rows):
         off = stmt.offset or 0

@@ -259,3 +259,70 @@ def test_select_distinct_multi_column(eng):
 def test_having_without_group_by_rejected(eng):
     with pytest.raises(SQLError):
         eng.query("SELECT COUNT(*) FROM orders HAVING COUNT(*) > 100")
+
+
+# -- regression tests: review findings on NULL/DISTINCT/DDL edge cases --
+
+
+@pytest.fixture
+def eng_nulls(eng):
+    # row 9 exists (region set) but qty/price are NULL
+    eng.query("INSERT INTO orders (_id, region) VALUES (9, 'west')")
+    return eng
+
+
+def test_grouped_avg_uses_nonnull_count(eng_nulls):
+    got = dict(rows(eng_nulls.query_one(
+        "SELECT region, AVG(qty) FROM orders GROUP BY region")))
+    # west rows: qty 5, 12, NULL -> avg 8.5 (not 17/3)
+    assert got["west"] == 8.5
+    flat = rows(eng_nulls.query_one(
+        "SELECT AVG(qty) FROM orders WHERE region = 'west'"))
+    assert flat == [(8.5,)]
+
+
+def test_order_by_bsi_keeps_null_rows(eng_nulls):
+    got = rows(eng_nulls.query_one("SELECT _id FROM orders ORDER BY qty"))
+    assert [r[0] for r in got] == [4, 1, 3, 2, 5, 9]  # NULL qty last
+    got = rows(eng_nulls.query_one(
+        "SELECT _id FROM orders ORDER BY qty DESC"))
+    assert [r[0] for r in got][:2] == [2, 5] and got[-1][0] == 9
+    # LIMIT spanning into the NULL tail
+    got = rows(eng_nulls.query_one(
+        "SELECT _id FROM orders ORDER BY qty LIMIT 6"))
+    assert [r[0] for r in got] == [4, 1, 3, 2, 5, 9]
+
+
+def test_distinct_multi_column_with_limit(eng):
+    got = rows(eng.query_one(
+        "SELECT DISTINCT status, paid FROM orders LIMIT 3"))
+    assert len(got) == 3
+    allr = rows(eng.query_one("SELECT DISTINCT status, paid FROM orders"))
+    assert len(allr) == 4
+
+
+def test_insert_int_id_into_string_column_rejected(eng):
+    with pytest.raises(SQLError):
+        eng.query("INSERT INTO orders (_id, region) VALUES (7, 42)")
+
+
+def test_create_table_bad_option_leaves_no_table(eng):
+    with pytest.raises(SQLError):
+        eng.query("CREATE TABLE t2 (_id id, x idset timequantum 'BAD')")
+    assert rows(eng.query_one("SHOW TABLES")) == [("orders",)]
+    eng.query("CREATE TABLE t2 (_id id, x idset timequantum 'YMD')")
+    assert ("t2",) in rows(eng.query_one("SHOW TABLES"))
+
+
+def test_create_table_duplicate_column_rejected(eng):
+    with pytest.raises(SQLError):
+        eng.query("CREATE TABLE t3 (_id id, x int, x int)")
+    assert ("t3",) not in rows(eng.query_one("SHOW TABLES"))
+
+
+def test_grouped_sum_all_null_group(eng_nulls):
+    eng_nulls.query("INSERT INTO orders (_id, region) VALUES (10, 'south')")
+    got = dict(rows(eng_nulls.query_one(
+        "SELECT region, SUM(qty) FROM orders GROUP BY region")))
+    assert got["south"] is None
+    assert got["west"] == 17
