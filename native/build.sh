@@ -1,0 +1,11 @@
+#!/bin/sh
+# Build the native host libraries into native/build/.
+# Usage: native/build.sh [debug]
+set -e
+cd "$(dirname "$0")"
+mkdir -p build
+FLAGS="-O2 -DNDEBUG"
+[ "$1" = debug ] && FLAGS="-O0 -g -fsanitize=address,undefined"
+g++ -std=c++17 -shared -fPIC $FLAGS -Wall -Wextra \
+    -o build/librbf_tpu.so rbf/rbf.cc
+echo "built build/librbf_tpu.so"

@@ -29,12 +29,14 @@ FALSE_ROW, TRUE_ROW = 0, 1  # bool field rows (field.go falseRowID/trueRowID)
 
 class Field:
     def __init__(self, index: str, name: str, options: FieldOptions | None = None,
-                 width: int = SHARD_WIDTH, path: str | None = None):
+                 width: int = SHARD_WIDTH, path: str | None = None,
+                 storage=None):
         self.index_name = index
         self.name = name
         self.options = options or FieldOptions()
         self.width = width
         self.path = path
+        self.storage = storage
         self.views: dict[str, View] = {}
         self._row_translator = None
         self._lock = threading.RLock()
@@ -57,7 +59,8 @@ class Field:
         with self._lock:
             v = self.views.get(name)
             if v is None and create:
-                v = View(self.index_name, self.name, name, self.width)
+                v = View(self.index_name, self.name, name, self.width,
+                         storage=self.storage)
                 self.views[name] = v
             return v
 
@@ -256,6 +259,11 @@ class Field:
         views = timeq.views_by_time_range(
             VIEW_STANDARD, start, end, self.options.time_quantum)
         return [v for v in views if v in self.views]
+
+    def close(self):
+        if self._row_translator is not None:
+            self._row_translator.close()
+            self._row_translator = None
 
     def to_dict(self) -> dict:
         return {"name": self.name, "options": self.options.to_dict()}

@@ -27,7 +27,7 @@ class Fragment:
     """Host rows + device tile cache for one (index, field, view, shard)."""
 
     def __init__(self, index: str, field: str, view: str, shard: int,
-                 width: int = SHARD_WIDTH):
+                 width: int = SHARD_WIDTH, storage=None):
         self.index_name = index
         self.field_name = field
         self.view_name = view
@@ -36,6 +36,11 @@ class Fragment:
         self._rows: dict[int, np.ndarray] = {}   # row id -> packed words
         self._device: dict[int, jnp.ndarray] = {}
         self._planes_cache: jnp.ndarray | None = None
+        # rows changed since the last storage sync (persisted by
+        # IndexStorage.write_fragments; empty when storage is None)
+        self.dirty_rows: set[int] = set()
+        if storage is not None:
+            self._rows = storage.load_rows(field, view, shard, width)
 
     # -- host mutation ------------------------------------------------------
 
@@ -50,6 +55,7 @@ class Fragment:
     def _invalidate(self, row: int):
         self._device.pop(row, None)
         self._planes_cache = None
+        self.dirty_rows.add(row)
 
     def set_bit(self, row: int, col: int) -> bool:
         """Set one bit; returns True if it changed (fragment.setBit)."""

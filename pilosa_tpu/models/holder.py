@@ -45,7 +45,29 @@ class Holder:
 
     def delete_index(self, name: str):
         with self._lock:
-            self.indexes.pop(name, None)
+            idx = self.indexes.pop(name, None)
+            if idx is None:
+                return
+            # remove ALL on-disk state (bitmaps, key translators) and
+            # drop the index from the persisted schema, or reopening
+            # would resurrect it / a recreated index would inherit keys
+            idx.close()
+            if idx.path and os.path.isdir(idx.path):
+                import shutil
+                shutil.rmtree(idx.path)
+            self.save_schema()
+
+    def sync(self):
+        """Persist schema + all dirty fragment rows."""
+        with self._lock:
+            self.save_schema()
+            for idx in self.indexes.values():
+                idx.sync()
+
+    def close(self):
+        with self._lock:
+            for idx in self.indexes.values():
+                idx.close()
 
     def schema(self) -> list[dict]:
         return [idx.to_dict() for _, idx in sorted(self.indexes.items())]
@@ -76,3 +98,4 @@ class Holder:
                     idx.create_field(
                         fd["name"], FieldOptions.from_dict(fd["options"]),
                         ok_if_exists=True)
+                idx.load_fragments()

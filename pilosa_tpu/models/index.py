@@ -29,6 +29,10 @@ class Index:
         self.fields: dict[str, Field] = {}
         self._lock = threading.RLock()
         self._column_translator = None
+        self.storage = None
+        if path is not None:
+            from pilosa_tpu.storage.shards import IndexStorage
+            self.storage = IndexStorage(path)
         if track_existence:
             self._ensure_existence()
 
@@ -49,7 +53,8 @@ class Index:
         f = self.fields.get(EXISTENCE_FIELD)
         if f is None:
             f = Field(self.name, EXISTENCE_FIELD,
-                      FieldOptions(type=FieldType.SET), self.width)
+                      FieldOptions(type=FieldType.SET), self.width,
+                      storage=self.storage)
             self.fields[EXISTENCE_FIELD] = f
         return f
 
@@ -64,7 +69,7 @@ class Index:
                     return self.fields[name]
                 raise ValueError(f"field already exists: {name}")
             f = Field(self.name, name, options, self.width,
-                      path=self._field_path(name))
+                      path=self._field_path(name), storage=self.storage)
             self.fields[name] = f
             return f
 
@@ -73,7 +78,59 @@ class Index:
 
     def delete_field(self, name: str):
         with self._lock:
-            self.fields.pop(name, None)
+            f = self.fields.pop(name, None)
+            if f is None:
+                return
+            if self.storage is not None:
+                self.storage.delete_field_bitmaps(name)
+            # drop the field's key-translator files too, or a recreated
+            # field would inherit the old key->row mappings
+            f.close()
+            fp = self._field_path(name)
+            if fp and os.path.isdir(fp):
+                import shutil
+                shutil.rmtree(fp)
+
+    # -- persistence -----------------------------------------------------
+
+    def sync(self):
+        """Persist dirty fragment rows, one write tx per shard file."""
+        if self.storage is None:
+            return
+        with self._lock:
+            by_shard: dict[int, list] = {}
+            for f in self.fields.values():
+                for v in f.views.values():
+                    for frag in v.fragments.values():
+                        if frag.dirty_rows:
+                            by_shard.setdefault(frag.shard, []).append(frag)
+            for shard in sorted(by_shard):
+                self.storage.write_fragments(by_shard[shard])
+
+    def load_fragments(self):
+        """Materialize every fragment present on disk (holder open)."""
+        if self.storage is None:
+            return
+        with self._lock:
+            for fname, vname, shard in self.storage.discover():
+                f = self.fields.get(fname)
+                if f is None:
+                    continue  # bitmap for a dropped/unknown field
+                frag = f.view(vname, create=True).fragment(shard, create=True)
+                if f.options.type.is_bsi:
+                    # recover observed bit depth from the stored planes
+                    from pilosa_tpu.shardwidth import BSI_OFFSET_BIT
+                    depth = frag.max_row_id() - BSI_OFFSET_BIT + 1
+                    if depth > f.bit_depth:
+                        f.bit_depth = depth
+
+    def close(self):
+        if self.storage is not None:
+            self.storage.close()
+        if self._column_translator is not None:
+            self._column_translator.close()
+        for f in self.fields.values():
+            f.close()
 
     def public_fields(self) -> list[Field]:
         return [f for n, f in sorted(self.fields.items())

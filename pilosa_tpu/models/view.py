@@ -20,18 +20,19 @@ def bsi_view_name(field_name: str) -> str:
 
 class View:
     def __init__(self, index: str, field: str, name: str,
-                 width: int = SHARD_WIDTH):
+                 width: int = SHARD_WIDTH, storage=None):
         self.index_name = index
         self.field_name = field
         self.name = name
         self.width = width
+        self.storage = storage
         self.fragments: dict[int, Fragment] = {}
 
     def fragment(self, shard: int, create: bool = False) -> Fragment | None:
         f = self.fragments.get(shard)
         if f is None and create:
             f = Fragment(self.index_name, self.field_name, self.name, shard,
-                         self.width)
+                         self.width, storage=self.storage)
             self.fragments[shard] = f
         return f
 

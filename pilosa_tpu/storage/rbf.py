@@ -1,0 +1,315 @@
+"""ctypes bindings for the native rbf_tpu storage engine.
+
+The engine (native/rbf/rbf.cc) is the host-side storage layer per
+SURVEY §2.2: a single-file page store with WAL + checkpoint and
+one-writer/N-reader MVCC snapshots, holding roaring-encoded containers
+(array/runs/bitmap) that decode into the dense 8KB uint32 tiles the
+device kernels consume.  Reference behavior parity: rbf/db.go (DB
+lifecycle), rbf/tx.go (bitmap catalog + container get/put/remove),
+roaring container encodings (container_stash.go:46).
+
+The shared library builds on demand with g++ (cached by source mtime).
+"""
+
+from __future__ import annotations
+
+import ctypes as ct
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+PAGE_SIZE = 8192
+TILE_BYTES = 8192
+TILE_WORDS = TILE_BYTES // 4       # uint32 words per container tile
+TILE_BITS = 1 << 16                # bits per container
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE = os.path.join(_ROOT, "native")
+_SO = os.path.join(_NATIVE, "build", "librbf_tpu.so")
+_SRC = [os.path.join(_NATIVE, "rbf", "rbf.cc"),
+        os.path.join(_NATIVE, "rbf", "rbf.h")]
+
+_build_lock = threading.Lock()
+_lib = None
+
+
+class RBFError(RuntimeError):
+    pass
+
+
+def _build_needed() -> bool:
+    if not os.path.exists(_SO):
+        return True
+    so_m = os.path.getmtime(_SO)
+    return any(os.path.getmtime(s) > so_m for s in _SRC)
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        if _build_needed():
+            subprocess.run(["sh", os.path.join(_NATIVE, "build.sh")],
+                           check=True, capture_output=True)
+        lib = ct.CDLL(_SO)
+        lib.rbf_errmsg.restype = ct.c_char_p
+        lib.rbf_open.restype = ct.c_void_p
+        lib.rbf_open.argtypes = [ct.c_char_p]
+        lib.rbf_close.argtypes = [ct.c_void_p]
+        lib.rbf_checkpoint.argtypes = [ct.c_void_p]
+        lib.rbf_wal_size.restype = ct.c_int64
+        lib.rbf_wal_size.argtypes = [ct.c_void_p]
+        lib.rbf_page_count.restype = ct.c_int64
+        lib.rbf_page_count.argtypes = [ct.c_void_p]
+        lib.rbf_begin.restype = ct.c_void_p
+        lib.rbf_begin.argtypes = [ct.c_void_p, ct.c_int]
+        lib.rbf_commit.argtypes = [ct.c_void_p]
+        lib.rbf_rollback.argtypes = [ct.c_void_p]
+        for fn in ("rbf_create_bitmap", "rbf_delete_bitmap",
+                   "rbf_has_bitmap"):
+            getattr(lib, fn).argtypes = [ct.c_void_p, ct.c_char_p]
+        lib.rbf_list_bitmaps.restype = ct.c_int64
+        lib.rbf_list_bitmaps.argtypes = [ct.c_void_p, ct.c_char_p,
+                                         ct.c_int64]
+        lib.rbf_put_container.argtypes = [ct.c_void_p, ct.c_char_p,
+                                          ct.c_uint64, ct.c_void_p]
+        lib.rbf_get_container.argtypes = [ct.c_void_p, ct.c_char_p,
+                                          ct.c_uint64, ct.c_void_p]
+        lib.rbf_remove_container.argtypes = [ct.c_void_p, ct.c_char_p,
+                                             ct.c_uint64]
+        lib.rbf_container_count.restype = ct.c_int64
+        lib.rbf_container_count.argtypes = [ct.c_void_p, ct.c_char_p]
+        lib.rbf_bitmap_count.restype = ct.c_int64
+        lib.rbf_bitmap_count.argtypes = [ct.c_void_p, ct.c_char_p]
+        lib.rbf_get_range.argtypes = [ct.c_void_p, ct.c_char_p,
+                                      ct.c_uint64, ct.c_int64, ct.c_void_p]
+        lib.rbf_iter_open.restype = ct.c_void_p
+        lib.rbf_iter_open.argtypes = [ct.c_void_p, ct.c_char_p]
+        lib.rbf_iter_next.argtypes = [ct.c_void_p,
+                                      ct.POINTER(ct.c_uint64), ct.c_void_p]
+        lib.rbf_iter_close.argtypes = [ct.c_void_p]
+        lib.rbf_container_encode.restype = ct.c_int32
+        lib.rbf_container_encode.argtypes = [ct.c_void_p, ct.c_void_p,
+                                             ct.POINTER(ct.c_int32)]
+        lib.rbf_container_decode.argtypes = [ct.c_int32, ct.c_void_p,
+                                             ct.c_int32, ct.c_void_p]
+        _lib = lib
+    return _lib
+
+
+def _err(lib, rc, what):
+    raise RBFError(f"{what}: rc={rc} ({lib.rbf_errmsg().decode()})")
+
+
+NOTFOUND = -2
+BUSY = -3
+
+
+def _as_tile_buf(arr: np.ndarray):
+    assert arr.dtype == np.uint32 and arr.flags.c_contiguous
+    return arr.ctypes.data_as(ct.c_void_p)
+
+
+class Tx:
+    """One transaction (read snapshot or exclusive writer)."""
+
+    def __init__(self, db: "DB", writable: bool):
+        self._lib = db._lib
+        ptr = self._lib.rbf_begin(db._ptr, 1 if writable else 0)
+        if not ptr:
+            _err(self._lib, -1, "begin")
+        self._ptr = ptr
+        self.writable = writable
+
+    def commit(self):
+        if self._ptr:
+            rc = self._lib.rbf_commit(self._ptr)
+            self._ptr = None
+            if rc != 0:
+                _err(self._lib, rc, "commit")
+
+    def rollback(self):
+        if self._ptr:
+            self._lib.rbf_rollback(self._ptr)
+            self._ptr = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, et, ev, tb):
+        if et is None:
+            self.commit()
+        else:
+            self.rollback()
+
+    # -- catalog --
+
+    def create_bitmap(self, name: str):
+        rc = self._lib.rbf_create_bitmap(self._ptr, name.encode())
+        if rc != 0:
+            _err(self._lib, rc, "create_bitmap")
+
+    def delete_bitmap(self, name: str) -> bool:
+        rc = self._lib.rbf_delete_bitmap(self._ptr, name.encode())
+        if rc == NOTFOUND:
+            return False
+        if rc != 0:
+            _err(self._lib, rc, "delete_bitmap")
+        return True
+
+    def has_bitmap(self, name: str) -> bool:
+        rc = self._lib.rbf_has_bitmap(self._ptr, name.encode())
+        if rc < 0:
+            _err(self._lib, rc, "has_bitmap")
+        return rc == 1
+
+    def list_bitmaps(self) -> list[str]:
+        need = self._lib.rbf_list_bitmaps(self._ptr, None, 0)
+        if need < 0:
+            _err(self._lib, need, "list_bitmaps")
+        if need == 0:
+            return []
+        buf = ct.create_string_buffer(int(need))
+        self._lib.rbf_list_bitmaps(self._ptr, buf, need)
+        return buf.raw[:need].decode().rstrip("\n").split("\n")
+
+    # -- containers --
+
+    def put(self, name: str, ckey: int, dense: np.ndarray):
+        """Store a dense uint32[2048] tile (all-zeros removes)."""
+        rc = self._lib.rbf_put_container(self._ptr, name.encode(),
+                                         ckey, _as_tile_buf(dense))
+        if rc != 0:
+            _err(self._lib, rc, "put")
+
+    def get(self, name: str, ckey: int) -> np.ndarray | None:
+        out = np.zeros(TILE_WORDS, dtype=np.uint32)
+        rc = self._lib.rbf_get_container(self._ptr, name.encode(),
+                                         ckey, _as_tile_buf(out))
+        if rc == NOTFOUND:
+            return None
+        if rc != 0:
+            _err(self._lib, rc, "get")
+        return out
+
+    def remove(self, name: str, ckey: int) -> bool:
+        rc = self._lib.rbf_remove_container(self._ptr, name.encode(), ckey)
+        if rc == NOTFOUND:
+            return False
+        if rc != 0:
+            _err(self._lib, rc, "remove")
+        return True
+
+    def container_count(self, name: str) -> int:
+        n = self._lib.rbf_container_count(self._ptr, name.encode())
+        if n < 0:
+            _err(self._lib, n, "container_count")
+        return int(n)
+
+    def count(self, name: str) -> int:
+        n = self._lib.rbf_bitmap_count(self._ptr, name.encode())
+        if n < 0:
+            _err(self._lib, n, "count")
+        return int(n)
+
+    def get_range(self, name: str, base: int, n: int) -> np.ndarray:
+        """Read containers [base, base+n) as an (n*2048,) uint32 array
+        of dense tiles (the HBM upload path)."""
+        out = np.zeros(n * TILE_WORDS, dtype=np.uint32)
+        rc = self._lib.rbf_get_range(self._ptr, name.encode(), base, n,
+                                     _as_tile_buf(out))
+        if rc != 0:
+            _err(self._lib, rc, "get_range")
+        return out
+
+    def items(self, name: str):
+        """Yield (ckey, dense uint32[2048]) in key order."""
+        it = self._lib.rbf_iter_open(self._ptr, name.encode())
+        if not it:
+            _err(self._lib, -1, "iter_open")
+        try:
+            key = ct.c_uint64()
+            while True:
+                out = np.zeros(TILE_WORDS, dtype=np.uint32)
+                rc = self._lib.rbf_iter_next(it, ct.byref(key),
+                                             _as_tile_buf(out))
+                if rc == 0:
+                    return
+                if rc < 0:
+                    _err(self._lib, rc, "iter_next")
+                yield int(key.value), out
+        finally:
+            self._lib.rbf_iter_close(it)
+
+
+class DB:
+    """One rbf_tpu database file (+ .wal sidecar)."""
+
+    def __init__(self, path: str):
+        self._lib = _load()
+        ptr = self._lib.rbf_open(path.encode())
+        if not ptr:
+            raise RBFError(
+                f"open {path}: {self._lib.rbf_errmsg().decode()}")
+        self._ptr = ptr
+        self.path = path
+
+    def begin(self, write: bool = False) -> Tx:
+        return Tx(self, write)
+
+    def checkpoint(self) -> bool:
+        """Fold the WAL into the main file; False if readers pin it."""
+        rc = self._lib.rbf_checkpoint(self._ptr)
+        if rc == BUSY:
+            return False
+        if rc != 0:
+            _err(self._lib, rc, "checkpoint")
+        return True
+
+    @property
+    def wal_size(self) -> int:
+        return int(self._lib.rbf_wal_size(self._ptr))
+
+    @property
+    def page_count(self) -> int:
+        return int(self._lib.rbf_page_count(self._ptr))
+
+    def close(self):
+        if self._ptr:
+            rc = self._lib.rbf_close(self._ptr)
+            self._ptr = None
+            if rc != 0:
+                _err(self._lib, rc, "close")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def container_encode(dense: np.ndarray) -> tuple[int, bytes]:
+    """Encode a dense uint32[2048] tile -> (enc, payload)."""
+    lib = _load()
+    out = ct.create_string_buffer(TILE_BYTES)
+    enc = ct.c_int32()
+    n = lib.rbf_container_encode(_as_tile_buf(dense), out, ct.byref(enc))
+    if n < 0:
+        _err(lib, n, "encode")
+    return int(enc.value), out.raw[:n]
+
+
+def container_decode(enc: int, payload: bytes) -> np.ndarray:
+    lib = _load()
+    out = np.zeros(TILE_WORDS, dtype=np.uint32)
+    rc = lib.rbf_container_decode(enc, payload, len(payload),
+                                  _as_tile_buf(out))
+    if rc != 0:
+        _err(lib, rc, "decode")
+    return out

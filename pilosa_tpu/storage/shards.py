@@ -1,0 +1,163 @@
+"""Per-index shard storage — DB-file-per-shard layout.
+
+Mirrors the reference's dbshard scheme (dbshard.go:1-30: one RBF DB
+file per (index, shard) under ``backends/``), with bitmap names
+``<field>/<view>`` inside each shard file and container keys
+``row * tiles_per_row + tile`` (fragment.go:84 keying collapsed onto
+dense 2^16-bit tiles).
+
+The in-memory Fragment remains the query-plane source (dense rows +
+device tile cache); this layer is durability: ``sync()`` persists
+dirty rows inside one write transaction per shard file, and fragments
+reload from here on holder open.  WALs are checkpointed once they pass
+a size threshold (rbf/db.go checkpoint-on-size behavior).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import threading
+
+import numpy as np
+
+from pilosa_tpu.storage import rbf
+
+BACKENDS_DIR = "backends"
+_SHARD_FILE = re.compile(r"^shard\.(\d+)\.rbf$")
+CHECKPOINT_WAL_BYTES = 64 << 20
+
+
+def bitmap_name(field: str, view: str) -> str:
+    return f"{field}/{view}"
+
+
+class IndexStorage:
+    """Owns the per-shard RBF DB handles of one index."""
+
+    def __init__(self, path: str):
+        self.path = path  # index directory
+        self._dbs: dict[int, rbf.DB] = {}
+        self._lock = threading.Lock()
+
+    def _dir(self) -> str:
+        return os.path.join(self.path, BACKENDS_DIR)
+
+    def _shard_path(self, shard: int) -> str:
+        return os.path.join(self._dir(), f"shard.{shard:04d}.rbf")
+
+    def db(self, shard: int) -> rbf.DB:
+        # one handle per shard file, ever: a second handle would replay
+        # (and truncate) a WAL the first is still appending to
+        with self._lock:
+            d = self._dbs.get(shard)
+            if d is None:
+                os.makedirs(self._dir(), exist_ok=True)
+                d = rbf.DB(self._shard_path(shard))
+                self._dbs[shard] = d
+            return d
+
+    def shards_on_disk(self) -> list[int]:
+        if not os.path.isdir(self._dir()):
+            return []
+        out = []
+        for fn in os.listdir(self._dir()):
+            m = _SHARD_FILE.match(fn)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def discover(self) -> list[tuple[str, str, int]]:
+        """All (field, view, shard) triples present on disk."""
+        out = []
+        for shard in self.shards_on_disk():
+            with self.db(shard).begin() as tx:
+                for name in tx.list_bitmaps():
+                    field, _, view = name.partition("/")
+                    out.append((field, view, shard))
+        return out
+
+    # -- fragment IO -----------------------------------------------------
+
+    @staticmethod
+    def _tiles_per_row(width: int) -> int:
+        return max(1, width >> 16)
+
+    def load_rows(self, field: str, view: str, shard: int,
+                  width: int) -> dict[int, np.ndarray]:
+        """Read every row of a fragment as packed uint32 word arrays."""
+        nw = width // 32
+        tpr = self._tiles_per_row(width)
+        rows: dict[int, np.ndarray] = {}
+        name = bitmap_name(field, view)
+        with self.db(shard).begin() as tx:
+            if not tx.has_bitmap(name):
+                return rows
+            for ckey, tile in tx.items(name):
+                row, t = divmod(ckey, tpr)
+                w = rows.get(row)
+                if w is None:
+                    w = np.zeros(nw, dtype=np.uint32)
+                    rows[row] = w
+                if tpr == 1 and nw < rbf.TILE_WORDS:
+                    w[:] = tile[:nw]
+                else:
+                    w[t * rbf.TILE_WORDS:(t + 1) * rbf.TILE_WORDS] = tile
+        return rows
+
+    def write_fragments(self, frags) -> None:
+        """Persist dirty rows of fragments belonging to ONE shard in a
+        single write transaction."""
+        if not frags:
+            return
+        shard = frags[0].shard
+        db = self.db(shard)
+        with db.begin(write=True) as tx:
+            for frag in frags:
+                assert frag.shard == shard
+                name = bitmap_name(frag.field_name, frag.view_name)
+                tx.create_bitmap(name)
+                tpr = self._tiles_per_row(frag.width)
+                nw = frag.width // 32
+                for row in sorted(frag.dirty_rows):
+                    words = frag.row_words(row)
+                    if tpr == 1 and nw < rbf.TILE_WORDS:
+                        tile = np.zeros(rbf.TILE_WORDS, dtype=np.uint32)
+                        tile[:nw] = words
+                        tx.put(name, row, tile)
+                    else:
+                        for t in range(tpr):
+                            tile = np.ascontiguousarray(
+                                words[t * rbf.TILE_WORDS:
+                                      (t + 1) * rbf.TILE_WORDS])
+                            tx.put(name, row * tpr + t, tile)
+        for frag in frags:
+            frag.dirty_rows.clear()
+        if db.wal_size > CHECKPOINT_WAL_BYTES:
+            db.checkpoint()  # best-effort; skipped if readers pinned
+
+    def delete_field_bitmaps(self, field: str) -> None:
+        prefix = field + "/"
+        for shard in self.shards_on_disk():
+            with self.db(shard).begin(write=True) as tx:
+                for name in tx.list_bitmaps():
+                    if name.startswith(prefix):
+                        tx.delete_bitmap(name)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def checkpoint_all(self) -> None:
+        for d in self._dbs.values():
+            d.checkpoint()
+
+    def close(self) -> None:
+        for d in self._dbs.values():
+            d.close()
+        self._dbs.clear()
+
+    def destroy(self) -> None:
+        """Close and delete all storage (index deletion)."""
+        self.close()
+        if os.path.isdir(self._dir()):
+            shutil.rmtree(self._dir())
