@@ -19,6 +19,7 @@ Single-host v0: shards iterate in a Python loop; the mesh executor
 from __future__ import annotations
 
 import datetime as dt
+import time
 from decimal import Decimal
 from fractions import Fraction
 from math import ceil, floor
@@ -33,6 +34,8 @@ from pilosa_tpu.executor.results import (
     ValCount,
 )
 from pilosa_tpu.models import timeq
+from pilosa_tpu.obs import metrics
+from pilosa_tpu.obs.tracing import start_span
 from pilosa_tpu.models.field import FALSE_ROW, TRUE_ROW, Field
 from pilosa_tpu.models.holder import Holder
 from pilosa_tpu.models.index import EXISTENCE_FIELD, Index
@@ -66,20 +69,31 @@ class Executor(AdvancedOps):
 
     def execute(self, index_name: str, query: str | Query,
                 shards: list[int] | None = None) -> list:
-        idx = self.holder.index(index_name)
-        if idx is None:
-            raise ExecError(f"index not found: {index_name}")
-        q = parse(query) if isinstance(query, str) else query
-        out = []
-        for c in q.calls:
-            res = self._execute_call(idx, c, shards)
-            # translateResults analog (executor.go:7519): attach column
-            # keys to row results on keyed indexes
-            if isinstance(res, RowResult) and idx.keys and \
-                    getattr(res, "is_row_ids", False) is False:
-                res.keys = idx.column_translator.translate_ids(res.columns())
-            out.append(res)
-        return out
+        t0 = time.perf_counter()
+        status = "error"
+        try:
+            idx = self.holder.index(index_name)
+            if idx is None:
+                raise ExecError(f"index not found: {index_name}")
+            q = parse(query) if isinstance(query, str) else query
+            out = []
+            # tracing.StartSpanFromContext analog (executor.go:6450)
+            with start_span("executor.Execute", index=index_name):
+                for c in q.calls:
+                    with start_span(f"executor.execute{c.name}"):
+                        res = self._execute_call(idx, c, shards)
+                    # translateResults analog (executor.go:7519): attach
+                    # column keys to row results on keyed indexes
+                    if isinstance(res, RowResult) and idx.keys and \
+                            getattr(res, "is_row_ids", False) is False:
+                        res.keys = idx.column_translator.translate_ids(
+                            res.columns())
+                    out.append(res)
+            status = "ok"
+            return out
+        finally:
+            metrics.QUERY_TOTAL.inc(index=index_name, status=status)
+            metrics.QUERY_DURATION.observe(time.perf_counter() - t0)
 
     # ------------------------------------------------------------------
     # dispatch

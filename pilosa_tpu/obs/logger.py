@@ -1,0 +1,74 @@
+"""Leveled logger with a nop default (reference: logger/logger.go —
+``Logger`` interface with Printf-style Debugf/Infof/Warnf/Errorf and a
+``NopLogger``; we keep the same four levels and the nop)."""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import IO
+
+DEBUG, INFO, WARN, ERROR = 10, 20, 30, 40
+_LEVEL_NAMES = {DEBUG: "DEBUG", INFO: "INFO", WARN: "WARN", ERROR: "ERROR"}
+
+
+class Logger:
+    """Leveled, %-formatted logger writing one line per call."""
+
+    def __init__(self, stream: IO[str] | None = None, level: int = INFO,
+                 name: str = ""):
+        self.stream = stream if stream is not None else sys.stderr
+        self.level = level
+        self.name = name
+        self._lock = threading.Lock()
+
+    def _log(self, level: int, fmt: str, *args):
+        if level < self.level:
+            return
+        msg = (fmt % args) if args else fmt
+        ts = time.strftime("%Y-%m-%dT%H:%M:%S")
+        prefix = f"{ts} {_LEVEL_NAMES[level]:5s}"
+        if self.name:
+            prefix += f" [{self.name}]"
+        with self._lock:
+            self.stream.write(f"{prefix} {msg}\n")
+
+    def debug(self, fmt: str, *args):
+        self._log(DEBUG, fmt, *args)
+
+    def info(self, fmt: str, *args):
+        self._log(INFO, fmt, *args)
+
+    def warn(self, fmt: str, *args):
+        self._log(WARN, fmt, *args)
+
+    def error(self, fmt: str, *args):
+        self._log(ERROR, fmt, *args)
+
+    def with_prefix(self, name: str) -> "Logger":
+        child = Logger(self.stream, self.level, name)
+        child._lock = self._lock
+        return child
+
+
+class NopLogger(Logger):
+    """Discards everything (logger.NopLogger analog)."""
+
+    def __init__(self):
+        super().__init__(stream=sys.stderr, level=ERROR + 1)
+
+    def _log(self, level: int, fmt: str, *args):
+        pass
+
+
+def StderrLogger(level: int = INFO) -> Logger:
+    return Logger(sys.stderr, level)
+
+
+def new_logger(verbose: bool = False, path: str | None = None) -> Logger:
+    """Build the server logger from config (server.go log-path wiring)."""
+    level = DEBUG if verbose else INFO
+    if path:
+        return Logger(open(path, "a", buffering=1), level)
+    return Logger(sys.stderr, level)

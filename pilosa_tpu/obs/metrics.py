@@ -1,0 +1,205 @@
+"""Central metrics registry with prometheus text exposition.
+
+Reference: metrics.go (one central file defining every counter/gauge/
+histogram, e.g. metrics.go:86-126) exposed at ``/metrics``
+(http_handler.go:495) and as JSON at ``/metrics.json``.  We keep the
+same shape: a process-global ``registry`` holding named metrics with
+label support, rendered in prometheus text format without any external
+client library.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+
+_DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _label_key(labels: dict[str, str] | None) -> tuple:
+    if not labels:
+        return ()
+    return tuple(sorted(labels.items()))
+
+
+def _fmt_labels(key: tuple) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+class _Metric:
+    kind = ""
+
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+
+    def render(self) -> list[str]:
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name: str, help_: str = ""):
+        super().__init__(name, help_)
+        self._vals: dict[tuple, float] = {}
+
+    def inc(self, n: float = 1.0, **labels):
+        k = _label_key(labels)
+        with self._lock:
+            self._vals[k] = self._vals.get(k, 0.0) + n
+
+    def value(self, **labels) -> float:
+        return self._vals.get(_label_key(labels), 0.0)
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} counter"]
+        for k in sorted(self._vals):
+            out.append(f"{self.name}{_fmt_labels(k)} {self._vals[k]:g}")
+        return out
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name: str, help_: str = ""):
+        super().__init__(name, help_)
+        self._vals: dict[tuple, float] = {}
+
+    def set(self, v: float, **labels):
+        with self._lock:
+            self._vals[_label_key(labels)] = float(v)
+
+    def add(self, n: float = 1.0, **labels):
+        k = _label_key(labels)
+        with self._lock:
+            self._vals[k] = self._vals.get(k, 0.0) + n
+
+    def value(self, **labels) -> float:
+        return self._vals.get(_label_key(labels), 0.0)
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} gauge"]
+        for k in sorted(self._vals):
+            out.append(f"{self.name}{_fmt_labels(k)} {self._vals[k]:g}")
+        return out
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help_: str = "",
+                 buckets: tuple = _DEFAULT_BUCKETS):
+        super().__init__(name, help_)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple, list[int]] = {}
+        self._sum: dict[tuple, float] = {}
+        self._n: dict[tuple, int] = {}
+
+    def observe(self, v: float, **labels):
+        k = _label_key(labels)
+        with self._lock:
+            if k not in self._counts:
+                self._counts[k] = [0] * (len(self.buckets) + 1)
+            # first bucket whose upper bound (le) admits v; overflow
+            # values land in the +Inf slot at index len(buckets)
+            i = bisect_left(self.buckets, v)
+            self._counts[k][i] += 1
+            self._sum[k] = self._sum.get(k, 0.0) + v
+            self._n[k] = self._n.get(k, 0) + 1
+
+    def count(self, **labels) -> int:
+        return self._n.get(_label_key(labels), 0)
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        for k in sorted(self._n):
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum += self._counts[k][i]
+                lk = k + (("le", f"{b:g}"),)
+                out.append(f"{self.name}_bucket{_fmt_labels(lk)} {cum}")
+            lk = k + (("le", "+Inf"),)
+            out.append(f"{self.name}_bucket{_fmt_labels(lk)} {self._n[k]}")
+            out.append(f"{self.name}_sum{_fmt_labels(k)} {self._sum[k]:g}")
+            out.append(f"{self.name}_count{_fmt_labels(k)} {self._n[k]}")
+        return out
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get(name, Counter, help_)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get(name, Gauge, help_)
+
+    def histogram(self, name: str, help_: str = "",
+                  buckets: tuple = _DEFAULT_BUCKETS) -> Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Histogram(name, help_, buckets)
+                self._metrics[name] = m
+            assert isinstance(m, Histogram)
+            return m
+
+    def _get(self, name, cls, help_):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help_)
+                self._metrics[name] = m
+            assert isinstance(m, cls), f"metric {name} is {type(m)}"
+            return m
+
+    def render_text(self) -> str:
+        lines = []
+        for name in sorted(self._metrics):
+            lines.extend(self._metrics[name].render())
+        return "\n".join(lines) + "\n"
+
+    def render_json(self) -> dict:
+        out = {}
+        for name, m in sorted(self._metrics.items()):
+            if isinstance(m, (Counter, Gauge)):
+                out[name] = {_fmt_labels(k) or "": v
+                             for k, v in m._vals.items()}
+            elif isinstance(m, Histogram):
+                out[name] = {_fmt_labels(k) or "":
+                             {"count": m._n[k], "sum": m._sum[k]}
+                             for k in m._n}
+        return out
+
+
+# Process-global registry + the centrally defined metrics the engine
+# uses (metrics.go analog; same naming style, pilosa_ prefix).
+registry = MetricsRegistry()
+
+QUERY_TOTAL = registry.counter(
+    "pilosa_query_total", "Total PQL queries executed")
+QUERY_DURATION = registry.histogram(
+    "pilosa_query_duration_seconds", "PQL query latency")
+SQL_TOTAL = registry.counter(
+    "pilosa_sql_total", "Total SQL queries executed")
+IMPORT_TOTAL = registry.counter(
+    "pilosa_import_total", "Total import requests")
+IMPORTED_BITS = registry.counter(
+    "pilosa_imported_bits_total", "Total bits set via imports")
+HTTP_REQUESTS = registry.counter(
+    "pilosa_http_request_total", "HTTP requests by route/status")
+JOB_TOTAL = registry.counter(
+    "pilosa_job_total", "Per-shard executor jobs run")

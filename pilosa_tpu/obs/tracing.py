@@ -1,0 +1,133 @@
+"""Tracing — global Tracer with nop default + profiled query spans.
+
+Reference: tracing/tracing.go:12 (global ``Tracer`` interface, nop
+default, opentracing adapter) and the profiled-span machinery
+(tracing/tracing.go:22-50) that returns a span tree with timings when
+``QueryRequest.Profile=true`` (handler.go:40).  Spans are threaded
+through the engine the same way (``start_span`` at every layer).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+
+class Span:
+    """One timed operation; children nest via the active-span stack."""
+
+    __slots__ = ("name", "tags", "start", "end", "children")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.tags: dict = {}
+        self.start = time.perf_counter()
+        self.end: float | None = None
+        self.children: list[Span] = []
+
+    def set_tag(self, key: str, value):
+        self.tags[key] = value
+
+    def finish(self):
+        if self.end is None:
+            self.end = time.perf_counter()
+
+    @property
+    def duration(self) -> float:
+        return (self.end if self.end is not None
+                else time.perf_counter()) - self.start
+
+    def to_dict(self) -> dict:
+        d = {"name": self.name, "duration_us": int(self.duration * 1e6)}
+        if self.tags:
+            d["tags"] = dict(self.tags)
+        if self.children:
+            d["children"] = [c.to_dict() for c in self.children]
+        return d
+
+
+ProfiledSpan = Span  # profiled spans are plain spans kept in a tree
+
+
+class Tracer:
+    """Records a span tree per thread.  Subclass or use as-is."""
+
+    def __init__(self):
+        self._tls = threading.local()
+
+    def _stack(self) -> list[Span]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = []
+            self._tls.stack = st
+        return st
+
+    @contextmanager
+    def span(self, name: str, **tags):
+        s = Span(name)
+        s.tags.update(tags)
+        st = self._stack()
+        if st:
+            st[-1].children.append(s)
+        st.append(s)
+        try:
+            yield s
+        finally:
+            s.finish()
+            st.pop()
+            self.on_finish(s, root=not st)
+
+    def on_finish(self, span: Span, root: bool):
+        """Hook for exporters (opentracing adapter analog)."""
+
+
+class NopTracer(Tracer):
+    @contextmanager
+    def span(self, name: str, **tags):
+        yield _NOP_SPAN
+
+
+class _NopSpan(Span):
+    def __init__(self):
+        super().__init__("nop")
+
+    def set_tag(self, key: str, value):
+        pass
+
+
+_NOP_SPAN = _NopSpan()
+
+_global = NopTracer()
+
+
+def set_tracer(t: Tracer):
+    global _global
+    _global = t
+
+
+def get_tracer() -> Tracer:
+    return _global
+
+
+def start_span(name: str, **tags):
+    """StartSpanFromContext analog — context is the thread."""
+    return _global.span(name, **tags)
+
+
+class RecordingTracer(Tracer):
+    """Keeps finished root spans; used for Profile=true queries and
+    the query-history ring (http_handler.go:540)."""
+
+    def __init__(self, keep: int = 100):
+        super().__init__()
+        self.roots: list[Span] = []
+        self.keep = keep
+        self._lock = threading.Lock()
+
+    def on_finish(self, span: Span, root: bool):
+        if root:
+            with self._lock:
+                self.roots.append(span)
+                if len(self.roots) > self.keep:
+                    self.roots.pop(0)

@@ -1,0 +1,126 @@
+"""Observability tests — logger, metrics exposition, tracing spans."""
+
+import io
+import threading
+
+from pilosa_tpu.obs import (
+    Logger,
+    MetricsRegistry,
+    NopTracer,
+    RecordingTracer,
+    set_tracer,
+    start_span,
+)
+from pilosa_tpu.obs import logger as lg
+
+
+def test_logger_levels_and_format():
+    buf = io.StringIO()
+    log = Logger(buf, level=lg.INFO)
+    log.debug("hidden %d", 1)
+    log.info("hello %s", "world")
+    log.error("boom")
+    out = buf.getvalue()
+    assert "hidden" not in out
+    assert "INFO" in out and "hello world" in out
+    assert "ERROR" in out and "boom" in out
+
+
+def test_logger_prefix():
+    buf = io.StringIO()
+    log = Logger(buf).with_prefix("executor")
+    log.info("x")
+    assert "[executor]" in buf.getvalue()
+
+
+def test_counter_gauge_labels():
+    r = MetricsRegistry()
+    c = r.counter("q_total", "queries")
+    c.inc()
+    c.inc(2, index="i0")
+    g = r.gauge("open_dbs")
+    g.set(5)
+    g.add(-1)
+    text = r.render_text()
+    assert "# TYPE q_total counter" in text
+    assert "q_total 1" in text
+    assert 'q_total{index="i0"} 2' in text
+    assert "open_dbs 4" in text
+    assert c.value(index="i0") == 2
+
+
+def test_histogram_buckets():
+    r = MetricsRegistry()
+    h = r.histogram("lat", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.05, 0.5, 5.0):
+        h.observe(v)
+    text = r.render_text()
+    assert 'lat_bucket{le="0.01"} 1' in text
+    assert 'lat_bucket{le="0.1"} 3' in text
+    assert 'lat_bucket{le="1"} 4' in text
+    assert 'lat_bucket{le="+Inf"} 5' in text
+    assert "lat_count 5" in text
+    # bucket boundary: le is inclusive
+    h2 = r.histogram("lat2", buckets=(0.01, 0.1, 1.0))
+    h2.observe(0.1)
+    assert 'lat2_bucket{le="0.1"} 1' in r.render_text()
+
+
+def test_metrics_registry_same_instance():
+    r = MetricsRegistry()
+    assert r.counter("a") is r.counter("a")
+
+
+def test_render_json():
+    r = MetricsRegistry()
+    r.counter("c").inc(3)
+    r.histogram("h").observe(0.2)
+    j = r.render_json()
+    assert j["c"][""] == 3
+    assert j["h"][""]["count"] == 1
+
+
+def test_tracer_span_tree():
+    t = RecordingTracer()
+    set_tracer(t)
+    try:
+        with start_span("query", index="i") as root:
+            with start_span("mapReduce"):
+                with start_span("shard", shard=0):
+                    pass
+            with start_span("translate"):
+                pass
+        assert len(t.roots) == 1
+        d = t.roots[0].to_dict()
+        assert d["name"] == "query"
+        assert d["tags"] == {"index": "i"}
+        names = [c["name"] for c in d["children"]]
+        assert names == ["mapReduce", "translate"]
+        assert d["children"][0]["children"][0]["tags"] == {"shard": 0}
+        assert d["duration_us"] >= 0
+    finally:
+        set_tracer(NopTracer())
+
+
+def test_tracer_thread_isolation():
+    t = RecordingTracer()
+    set_tracer(t)
+    try:
+        def work(i):
+            with start_span(f"root{i}"):
+                with start_span("child"):
+                    pass
+        ts = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+        [x.start() for x in ts]
+        [x.join() for x in ts]
+        assert len(t.roots) == 4
+        for r in t.roots:
+            assert len(r.children) == 1
+    finally:
+        set_tracer(NopTracer())
+
+
+def test_nop_tracer_cheap():
+    set_tracer(NopTracer())
+    with start_span("x") as s:
+        s.set_tag("a", 1)  # no-op, no error
