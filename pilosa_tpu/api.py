@@ -1,0 +1,396 @@
+"""API facade — every externally visible operation as one method.
+
+Reference: ``API`` struct (api.go:45) — the single entry point the
+HTTP/gRPC handlers call into: Query (api.go:209), schema CRUD
+(api.go:254-477), imports (api.go:618,1438,1771), status/info, backup
+snapshots (api.go:1265).  The TPU build keeps the same facade shape
+over Holder + Executor + SQLEngine, plus JSON serialization of every
+result type (the handler-side marshaling of http_handler.go).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import threading
+import time
+
+import numpy as np
+
+from pilosa_tpu import __version__
+from pilosa_tpu.executor.executor import ExecError, Executor
+from pilosa_tpu.executor.results import (
+    DistinctValues,
+    ExtractedTable,
+    GroupCount,
+    Pair,
+    RowResult,
+    SortedRow,
+    ValCount,
+)
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.models.schema import FieldOptions
+from pilosa_tpu.obs import metrics
+from pilosa_tpu.obs.tracing import RecordingTracer, Tracer, start_span
+from pilosa_tpu.pql.parser import ParseError
+from pilosa_tpu.sql.lexer import SQLError
+from pilosa_tpu.sql.engine import SQLEngine
+
+
+class ApiError(Exception):
+    def __init__(self, msg: str, status: int = 400):
+        super().__init__(msg)
+        self.status = status
+
+
+class QueryHistoryEntry:
+    __slots__ = ("index", "query", "start", "duration")
+
+    def __init__(self, index, query, start, duration):
+        self.index = index
+        self.query = query
+        self.start = start
+        self.duration = duration
+
+    def to_dict(self):
+        return {"index": self.index, "query": self.query,
+                "start": self.start, "runtime_ns": int(self.duration * 1e9)}
+
+
+class API:
+    """Facade over the engine (api.go:45 analog)."""
+
+    def __init__(self, holder: Holder, name: str = "node0"):
+        self.holder = holder
+        self.name = name
+        self.executor = Executor(holder)
+        self.sql_engine = SQLEngine(holder)
+        self.start_time = time.time()
+        self._history: list[QueryHistoryEntry] = []
+        self._hist_lock = threading.Lock()
+        self.history_keep = 100
+
+    # ------------------------------------------------------------------
+    # queries
+    # ------------------------------------------------------------------
+
+    def query(self, index: str, pql: str, shards: list[int] | None = None,
+              profile: bool = False) -> dict:
+        """PQL query (api.go:209 API.Query).  Returns the full
+        QueryResponse dict: {"results": [...]} (+"profile" spans when
+        requested, tracing/tracing.go:22-50 behavior)."""
+        t0 = time.time()
+        tracer = None
+        if profile:
+            from pilosa_tpu.obs import tracing as _tr
+            tracer = RecordingTracer()
+            prev = _tr.push_thread_tracer(tracer)
+        try:
+            try:
+                results = self.executor.execute(index, pql, shards)
+            except (ExecError, ParseError, ValueError, KeyError) as e:
+                raise ApiError(str(e), 400)
+        finally:
+            if profile:
+                _tr.pop_thread_tracer(prev)
+        resp = {"results": [serialize_result(r) for r in results]}
+        if profile and tracer.roots:
+            resp["profile"] = [s.to_dict() for s in tracer.roots]
+        self._record_history(index, pql, t0)
+        return resp
+
+    def sql(self, statement: str) -> dict:
+        """SQL query (http_handler.go:1440 /sql).  Returns
+        {"schema": {"fields": [...]}, "data": [...]} like the
+        reference's SQL response shape."""
+        metrics.SQL_TOTAL.inc()
+        t0 = time.time()
+        try:
+            res = self.sql_engine.query_one(statement)
+        except (ExecError, SQLError, ParseError, ValueError, KeyError) as e:
+            raise ApiError(str(e), 400)
+        self._record_history("", statement, t0)
+        return {
+            "schema": {"fields": [{"name": n, "type": t}
+                                  for n, t in res.schema]},
+            "data": [[_json_value(v) for v in row] for row in res.rows],
+        }
+
+    def _record_history(self, index, query, t0):
+        e = QueryHistoryEntry(index, query, t0, time.time() - t0)
+        with self._hist_lock:
+            self._history.append(e)
+            if len(self._history) > self.history_keep:
+                self._history.pop(0)
+
+    def query_history(self) -> list[dict]:
+        """Recent queries (http_handler.go:540 /query-history)."""
+        with self._hist_lock:
+            return [e.to_dict() for e in reversed(self._history)]
+
+    # ------------------------------------------------------------------
+    # schema (api.go:254-477)
+    # ------------------------------------------------------------------
+
+    def schema(self) -> dict:
+        return {"indexes": self.holder.schema()}
+
+    def create_index(self, name: str, keys: bool = False,
+                     track_existence: bool = True) -> dict:
+        _validate_name(name)
+        try:
+            idx = self.holder.create_index(
+                name, keys=keys, track_existence=track_existence)
+        except ValueError as e:
+            raise ApiError(str(e), 409)
+        self.holder.save_schema()
+        return idx.to_dict()
+
+    def delete_index(self, name: str):
+        if self.holder.index(name) is None:
+            raise ApiError(f"index not found: {name}", 404)
+        self.holder.delete_index(name)
+        self.holder.save_schema()
+
+    def create_field(self, index: str, field: str,
+                     options: dict | None = None) -> dict:
+        _validate_name(field)
+        idx = self._index(index)
+        try:
+            opts = FieldOptions.from_dict(options or {})
+            f = idx.create_field(field, opts)
+        except ValueError as e:
+            raise ApiError(str(e), 409)
+        self.holder.save_schema()
+        return f.to_dict()
+
+    def delete_field(self, index: str, field: str):
+        idx = self._index(index)
+        if idx.field(field) is None:
+            raise ApiError(f"field not found: {field}", 404)
+        idx.delete_field(field)
+        self.holder.save_schema()
+
+    def apply_schema(self, schema: dict):
+        """POST /schema (api.go ApplySchema): idempotent bulk create."""
+        for ix in schema.get("indexes", []):
+            idx = self.holder.create_index(
+                ix["name"], keys=ix.get("keys", False),
+                track_existence=ix.get("track_existence", True),
+                ok_if_exists=True)
+            for fd in ix.get("fields", []):
+                opts = FieldOptions.from_dict(fd.get("options", {}))
+                idx.create_field(fd["name"], opts, ok_if_exists=True)
+        self.holder.save_schema()
+
+    # ------------------------------------------------------------------
+    # imports (api.go:618 Import, api.go:1438 ImportValue)
+    # ------------------------------------------------------------------
+
+    def import_bits(self, index: str, field: str, rows=None, cols=None,
+                    row_keys=None, col_keys=None, timestamps=None,
+                    clear: bool = False) -> int:
+        idx = self._index(index)
+        f = idx.field(field)
+        if f is None:
+            raise ApiError(f"field not found: {field}", 404)
+        metrics.IMPORT_TOTAL.inc(index=index)
+        rows = self._translate_rows(f, rows, row_keys)
+        cols = self._translate_cols(idx, cols, col_keys)
+        if len(rows) != len(cols):
+            raise ApiError("rows and columns length mismatch", 400)
+        if clear:
+            n = 0
+            for r, c in zip(rows, cols):
+                n += bool(f.clear_bit(int(r), int(c)))
+            return n
+        f.import_bits(rows, cols, timestamps)
+        idx.mark_columns_exist(cols)
+        n = len(cols)
+        metrics.IMPORTED_BITS.inc(n, index=index)
+        return n
+
+    def import_values(self, index: str, field: str, cols=None, values=None,
+                      col_keys=None, clear: bool = False) -> int:
+        idx = self._index(index)
+        f = idx.field(field)
+        if f is None:
+            raise ApiError(f"field not found: {field}", 404)
+        metrics.IMPORT_TOTAL.inc(index=index)
+        cols = self._translate_cols(idx, cols, col_keys)
+        if values is None:
+            raise ApiError("values required", 400)
+        if len(values) != len(cols):
+            raise ApiError("columns and values length mismatch", 400)
+        if clear:
+            n = 0
+            for c in cols:
+                n += bool(f.clear_value(int(c)))
+            return n
+        f.import_values(cols, values)
+        idx.mark_columns_exist(cols)
+        n = len(cols)
+        metrics.IMPORTED_BITS.inc(n, index=index)
+        return n
+
+    def _translate_rows(self, f, rows, row_keys):
+        if row_keys is not None:
+            if not f.options.keys:
+                raise ApiError("field does not use row keys", 400)
+            m = f.row_translator.create_keys(*row_keys)
+            return [m[k] for k in row_keys]
+        return rows if rows is not None else []
+
+    def _translate_cols(self, idx, cols, col_keys):
+        if col_keys is not None:
+            if not idx.keys:
+                raise ApiError("index does not use column keys", 400)
+            m = idx.column_translator.create_keys(*col_keys)
+            return [m[k] for k in col_keys]
+        return cols if cols is not None else []
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+
+    def info(self) -> dict:
+        import pilosa_tpu.shardwidth as sw
+        return {
+            "shard_width": sw.SHARD_WIDTH,
+            "memory": None,
+            "cpu_arch": "tpu",
+            "version": __version__,
+            "uptime_seconds": int(time.time() - self.start_time),
+        }
+
+    def version(self) -> dict:
+        return {"version": __version__}
+
+    def status(self) -> dict:
+        return {
+            "state": "NORMAL",
+            "node": {"id": self.name, "is_primary": True},
+            "local_id": self.name,
+            "cluster_name": "pilosa-tpu",
+            "indexes": sorted(self.holder.indexes),
+        }
+
+    def shard_max(self) -> dict:
+        return {ix.name: (max(ix.available_shards)
+                          if ix.available_shards else 0)
+                for ix in self.holder.indexes.values()}
+
+    # ------------------------------------------------------------------
+    # translation (api.go:929-1038 data streaming analogs)
+    # ------------------------------------------------------------------
+
+    def translate_keys(self, index: str, field: str | None, keys: list,
+                       create: bool = False) -> list:
+        idx = self._index(index)
+        if field:
+            f = idx.field(field)
+            if f is None or not f.options.keys:
+                raise ApiError("field not found or not keyed", 400)
+            tr = f.row_translator
+        else:
+            if not idx.keys:
+                raise ApiError("index does not use keys", 400)
+            tr = idx.column_translator
+        if create:
+            m = tr.create_keys(*keys)
+        else:
+            m = tr.find_keys(*keys)
+        return [int(m[k]) if k in m else None for k in keys]
+
+    def translate_ids(self, index: str, field: str | None,
+                      ids: list) -> list:
+        idx = self._index(index)
+        if field:
+            f = idx.field(field)
+            if f is None or not f.options.keys:
+                raise ApiError("field not found or not keyed", 400)
+            tr = f.row_translator
+        else:
+            if not idx.keys:
+                raise ApiError("index does not use keys", 400)
+            tr = idx.column_translator
+        return tr.translate_ids(ids)
+
+    def _index(self, name: str):
+        idx = self.holder.index(name)
+        if idx is None:
+            raise ApiError(f"index not found: {name}", 404)
+        return idx
+
+
+_NAME_OK = set("abcdefghijklmnopqrstuvwxyz0123456789-_")
+
+
+def _validate_name(name: str):
+    if not name or name[0] not in "abcdefghijklmnopqrstuvwxyz" or \
+            not all(c in _NAME_OK for c in name) or len(name) > 230:
+        raise ApiError(f"invalid name: {name!r}", 400)
+
+
+# ----------------------------------------------------------------------
+# result serialization (handler-side marshaling)
+# ----------------------------------------------------------------------
+
+def _json_value(v):
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, dt.datetime):
+        return v.isoformat()
+    if isinstance(v, np.ndarray):
+        return [_json_value(x) for x in v.tolist()]
+    if isinstance(v, (list, tuple)):
+        return [_json_value(x) for x in v]
+    return v
+
+
+def serialize_result(r) -> object:
+    """One PQL result → JSON-able object, mirroring the reference's
+    QueryResponse marshaling of each result type."""
+    if r is None or isinstance(r, (bool, int, float, str)):
+        return _json_value(r)
+    if isinstance(r, (np.integer, np.floating)):
+        return _json_value(r)
+    if isinstance(r, RowResult):
+        d = {"columns": [int(c) for c in r.columns()]}
+        if r.keys is not None:
+            d["keys"] = list(r.keys)
+        return d
+    if isinstance(r, ValCount):
+        return {"value": _json_value(r.value), "count": int(r.count)}
+    if isinstance(r, DistinctValues):
+        return {"values": [_json_value(v) for v in r.values]}
+    if isinstance(r, Pair):
+        d = {"id": int(r.id), "count": int(r.count)}
+        if r.key is not None:
+            d["key"] = r.key
+        return d
+    if isinstance(r, GroupCount):
+        d = {"group": [_json_value(g) if not isinstance(g, dict) else
+                       {k: _json_value(v) for k, v in g.items()}
+                       for g in r.group],
+             "count": int(r.count)}
+        if r.agg is not None:
+            d["agg"] = _json_value(r.agg)
+        return d
+    if isinstance(r, SortedRow):
+        return {"columns": [int(c) for c in r.columns],
+                "values": [_json_value(v) for v in r.values]}
+    if isinstance(r, ExtractedTable):
+        return {"fields": [_json_value(f) if not isinstance(f, dict) else f
+                           for f in r.fields],
+                "columns": [{k: _json_value(v) for k, v in c.items()}
+                            if isinstance(c, dict) else _json_value(c)
+                            for c in r.columns]}
+    if isinstance(r, (list, tuple)):
+        return [serialize_result(x) for x in r]
+    if isinstance(r, dict):
+        return {k: serialize_result(v) for k, v in r.items()}
+    if isinstance(r, np.ndarray):
+        return [_json_value(x) for x in r.tolist()]
+    return _json_value(r)

@@ -71,6 +71,9 @@ class Executor(AdvancedOps):
                 shards: list[int] | None = None) -> list:
         t0 = time.perf_counter()
         status = "error"
+        # label only with names of real indexes: arbitrary client
+        # strings would grow metric cardinality without bound
+        known = self.holder.index(index_name) is not None
         try:
             idx = self.holder.index(index_name)
             if idx is None:
@@ -92,7 +95,8 @@ class Executor(AdvancedOps):
             status = "ok"
             return out
         finally:
-            metrics.QUERY_TOTAL.inc(index=index_name, status=status)
+            metrics.QUERY_TOTAL.inc(
+                index=index_name if known else "(unknown)", status=status)
             metrics.QUERY_DURATION.observe(time.perf_counter() - t0)
 
     # ------------------------------------------------------------------

@@ -62,8 +62,10 @@ class Counter(_Metric):
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} counter"]
-        for k in sorted(self._vals):
-            out.append(f"{self.name}{_fmt_labels(k)} {self._vals[k]:g}")
+        with self._lock:
+            vals = dict(self._vals)
+        for k in sorted(vals):
+            out.append(f"{self.name}{_fmt_labels(k)} {vals[k]:g}")
         return out
 
 
@@ -89,8 +91,10 @@ class Gauge(_Metric):
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} gauge"]
-        for k in sorted(self._vals):
-            out.append(f"{self.name}{_fmt_labels(k)} {self._vals[k]:g}")
+        with self._lock:
+            vals = dict(self._vals)
+        for k in sorted(vals):
+            out.append(f"{self.name}{_fmt_labels(k)} {vals[k]:g}")
         return out
 
 
@@ -123,16 +127,20 @@ class Histogram(_Metric):
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} histogram"]
-        for k in sorted(self._n):
+        with self._lock:
+            counts = {k: list(v) for k, v in self._counts.items()}
+            sums = dict(self._sum)
+            ns = dict(self._n)
+        for k in sorted(ns):
             cum = 0
             for i, b in enumerate(self.buckets):
-                cum += self._counts[k][i]
+                cum += counts[k][i]
                 lk = k + (("le", f"{b:g}"),)
                 out.append(f"{self.name}_bucket{_fmt_labels(lk)} {cum}")
             lk = k + (("le", "+Inf"),)
-            out.append(f"{self.name}_bucket{_fmt_labels(lk)} {self._n[k]}")
-            out.append(f"{self.name}_sum{_fmt_labels(k)} {self._sum[k]:g}")
-            out.append(f"{self.name}_count{_fmt_labels(k)} {self._n[k]}")
+            out.append(f"{self.name}_bucket{_fmt_labels(lk)} {ns[k]}")
+            out.append(f"{self.name}_sum{_fmt_labels(k)} {sums[k]:g}")
+            out.append(f"{self.name}_count{_fmt_labels(k)} {ns[k]}")
         return out
 
 
@@ -142,26 +150,26 @@ class MetricsRegistry:
         self._lock = threading.Lock()
 
     def counter(self, name: str, help_: str = "") -> Counter:
-        return self._get(name, Counter, help_)
+        return self._get(name, Counter, lambda: Counter(name, help_))
 
     def gauge(self, name: str, help_: str = "") -> Gauge:
-        return self._get(name, Gauge, help_)
+        return self._get(name, Gauge, lambda: Gauge(name, help_))
 
     def histogram(self, name: str, help_: str = "",
                   buckets: tuple = _DEFAULT_BUCKETS) -> Histogram:
-        with self._lock:
-            m = self._metrics.get(name)
-            if m is None:
-                m = Histogram(name, help_, buckets)
-                self._metrics[name] = m
-            assert isinstance(m, Histogram)
-            return m
+        m = self._get(name, Histogram,
+                      lambda: Histogram(name, help_, buckets))
+        if m.buckets != tuple(sorted(buckets)):
+            raise ValueError(
+                f"histogram {name} already registered with different "
+                f"buckets {m.buckets}")
+        return m
 
-    def _get(self, name, cls, help_):
+    def _get(self, name, cls, factory=None):
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
-                m = cls(name, help_)
+                m = factory() if factory else cls(name, "")
                 self._metrics[name] = m
             assert isinstance(m, cls), f"metric {name} is {type(m)}"
             return m
@@ -174,14 +182,17 @@ class MetricsRegistry:
 
     def render_json(self) -> dict:
         out = {}
-        for name, m in sorted(self._metrics.items()):
-            if isinstance(m, (Counter, Gauge)):
-                out[name] = {_fmt_labels(k) or "": v
-                             for k, v in m._vals.items()}
-            elif isinstance(m, Histogram):
-                out[name] = {_fmt_labels(k) or "":
-                             {"count": m._n[k], "sum": m._sum[k]}
-                             for k in m._n}
+        with self._lock:
+            items = sorted(self._metrics.items())
+        for name, m in items:
+            with m._lock:
+                if isinstance(m, (Counter, Gauge)):
+                    out[name] = {_fmt_labels(k) or "": v
+                                 for k, v in m._vals.items()}
+                elif isinstance(m, Histogram):
+                    out[name] = {_fmt_labels(k) or "":
+                                 {"count": m._n[k], "sum": m._sum[k]}
+                                 for k in m._n}
         return out
 
 

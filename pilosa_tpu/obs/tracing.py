@@ -99,6 +99,7 @@ class _NopSpan(Span):
 _NOP_SPAN = _NopSpan()
 
 _global = NopTracer()
+_tls = threading.local()
 
 
 def set_tracer(t: Tracer):
@@ -107,12 +108,28 @@ def set_tracer(t: Tracer):
 
 
 def get_tracer() -> Tracer:
-    return _global
+    """The active tracer: a per-thread override (profiled queries)
+    wins over the process-global tracer."""
+    t = getattr(_tls, "tracer", None)
+    return t if t is not None else _global
+
+
+def push_thread_tracer(t: Tracer) -> Tracer | None:
+    """Install a tracer for THIS thread only (Profile=true queries on
+    a threaded server must not race the process-global tracer).
+    Returns the previous thread-local tracer to restore."""
+    prev = getattr(_tls, "tracer", None)
+    _tls.tracer = t
+    return prev
+
+
+def pop_thread_tracer(prev: Tracer | None):
+    _tls.tracer = prev
 
 
 def start_span(name: str, **tags):
     """StartSpanFromContext analog — context is the thread."""
-    return _global.span(name, **tags)
+    return get_tracer().span(name, **tags)
 
 
 class RecordingTracer(Tracer):

@@ -1,0 +1,5 @@
+"""Server — HTTP transport over the API facade (SURVEY §2.6)."""
+
+from pilosa_tpu.server.http import HTTPServer, Server
+
+__all__ = ["HTTPServer", "Server"]

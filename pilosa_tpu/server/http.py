@@ -1,0 +1,298 @@
+"""HTTP transport — router + handlers over the API facade.
+
+Reference: http_handler.go (route table :493-562, gorilla/mux) and
+server.go (Server wiring holder+executor+monitors).  Routes kept:
+
+    POST   /index/{index}/query             PQL (?profile=true)
+    POST   /sql                             SQL
+    GET    /schema                          full schema
+    POST   /schema                          apply schema (idempotent)
+    POST   /index/{index}                   create index
+    DELETE /index/{index}                   delete index
+    POST   /index/{index}/field/{field}     create field (JSON options)
+    DELETE /index/{index}/field/{field}     delete field
+    POST   /index/{index}/field/{field}/import         bits/values
+    POST   /internal/translate/{index}/keys/find|create (+?field=)
+    GET    /internal/translate/{index}/ids  (?field=)
+    GET    /status /info /version /metrics /metrics.json
+    GET    /internal/shards/max
+    GET    /query-history
+
+The server is a stdlib ThreadingHTTPServer — the transport is not the
+hot path (queries run on-device); a C++ server would buy nothing here.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from pilosa_tpu.api import API, ApiError
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.obs import metrics
+from pilosa_tpu.obs.logger import Logger, NopLogger
+
+
+class Route:
+    def __init__(self, method: str, pattern: str, fn):
+        self.method = method
+        self.re = re.compile("^" + re.sub(
+            r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern) + "$")
+        self.fn = fn
+
+
+class Server:
+    """Wires holder + API + HTTP listener (server.go:46 analog)."""
+
+    def __init__(self, holder: Holder | None = None, bind: str = "127.0.0.1",
+                 port: int = 0, logger: Logger | None = None,
+                 auth=None, api: API | None = None):
+        self._owns_holder = holder is None
+        self.holder = holder if holder is not None else Holder()
+        self.api = api if api is not None else API(self.holder)
+        self.logger = logger or NopLogger()
+        self.auth = auth  # wired by pilosa_tpu.auth middleware
+        self._routes: list[Route] = []
+        self._register_routes()
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer((bind, port), handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def serve_forever(self):
+        self.logger.info("listening on :%d", self.port)
+        self.httpd.serve_forever()
+
+    def start(self):
+        """Serve on a background thread (tests, embedded use)."""
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+        if self._owns_holder:
+            self.holder.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- routing -------------------------------------------------------
+
+    def _register_routes(self):
+        r = self._routes.append
+        r(Route("POST", "/index/{index}/query", self._post_query))
+        r(Route("POST", "/sql", self._post_sql))
+        r(Route("GET", "/schema", self._get_schema))
+        r(Route("POST", "/schema", self._post_schema))
+        r(Route("POST", "/index/{index}", self._post_index))
+        r(Route("DELETE", "/index/{index}", self._delete_index))
+        r(Route("POST", "/index/{index}/field/{field}", self._post_field))
+        r(Route("DELETE", "/index/{index}/field/{field}",
+                self._delete_field))
+        r(Route("POST", "/index/{index}/field/{field}/import",
+                self._post_import))
+        r(Route("POST", "/internal/translate/{index}/keys/find",
+                self._post_translate_find))
+        r(Route("POST", "/internal/translate/{index}/keys/create",
+                self._post_translate_create))
+        r(Route("POST", "/internal/translate/{index}/ids",
+                self._post_translate_ids))
+        r(Route("GET", "/internal/shards/max", self._get_shards_max))
+        r(Route("GET", "/status", lambda req: self.api.status()))
+        r(Route("GET", "/info", lambda req: self.api.info()))
+        r(Route("GET", "/version", lambda req: self.api.version()))
+        r(Route("GET", "/query-history",
+                lambda req: self.api.query_history()))
+        r(Route("GET", "/metrics", self._get_metrics))
+        r(Route("GET", "/metrics.json",
+                lambda req: metrics.registry.render_json()))
+
+    def dispatch(self, method: str, path: str, req) -> tuple[int, object]:
+        for rt in self._routes:
+            if rt.method != method:
+                continue
+            m = rt.re.match(path)
+            if m:
+                req.vars = m.groupdict()
+                try:
+                    return 200, rt.fn(req)
+                except ApiError as e:
+                    return e.status, {"error": str(e)}
+                except Exception as e:  # keep the connection alive
+                    self.logger.error("http 500 on %s: %s", path, e)
+                    return 500, {"error": f"internal error: {e}"}
+        return 404, {"error": f"no route: {method} {path}"}
+
+    # -- handlers ------------------------------------------------------
+
+    def _post_query(self, req):
+        body = req.json()
+        if isinstance(body, dict):
+            pql = body.get("query", "")
+            shards = body.get("shards")
+        else:  # raw PQL body, like the reference's text/plain mode
+            pql = req.text()
+            shards = None
+        profile = req.query.get("profile", ["false"])[0] == "true"
+        return self.api.query(req.vars["index"], pql, shards, profile)
+
+    def _post_sql(self, req):
+        body = req.json()
+        stmt = body.get("sql", "") if isinstance(body, dict) else req.text()
+        return self.api.sql(stmt)
+
+    def _get_schema(self, req):
+        return self.api.schema()
+
+    def _post_schema(self, req):
+        self.api.apply_schema(req.json() or {})
+        return {}
+
+    def _post_index(self, req):
+        body = req.json() or {}
+        opts = body.get("options", body)
+        return self.api.create_index(
+            req.vars["index"], keys=bool(opts.get("keys", False)),
+            track_existence=bool(opts.get("trackExistence",
+                                          opts.get("track_existence", True))))
+
+    def _delete_index(self, req):
+        self.api.delete_index(req.vars["index"])
+        return {}
+
+    def _post_field(self, req):
+        body = req.json() or {}
+        return self.api.create_field(
+            req.vars["index"], req.vars["field"], body.get("options", body))
+
+    def _delete_field(self, req):
+        self.api.delete_field(req.vars["index"], req.vars["field"])
+        return {}
+
+    def _post_import(self, req):
+        body = req.json() or {}
+        kw = dict(index=req.vars["index"], field=req.vars["field"],
+                  clear=bool(body.get("clear", False)))
+        if "values" in body:
+            n = self.api.import_values(
+                cols=body.get("columns"), values=body.get("values"),
+                col_keys=body.get("columnKeys"), **kw)
+        else:
+            n = self.api.import_bits(
+                rows=body.get("rows"), cols=body.get("columns"),
+                row_keys=body.get("rowKeys"),
+                col_keys=body.get("columnKeys"),
+                timestamps=body.get("timestamps"), **kw)
+        return {"imported": n}
+
+    def _post_translate_find(self, req):
+        body = req.json() or {}
+        return self.api.translate_keys(
+            req.vars["index"], req.query.get("field", [None])[0],
+            body.get("keys", []), create=False)
+
+    def _post_translate_create(self, req):
+        body = req.json() or {}
+        return self.api.translate_keys(
+            req.vars["index"], req.query.get("field", [None])[0],
+            body.get("keys", []), create=True)
+
+    def _post_translate_ids(self, req):
+        body = req.json() or {}
+        return self.api.translate_ids(
+            req.vars["index"], req.query.get("field", [None])[0],
+            body.get("ids", []))
+
+    def _get_shards_max(self, req):
+        return {"standard": self.api.shard_max()}
+
+    def _get_metrics(self, req):
+        return RawResponse(metrics.registry.render_text(),
+                           "text/plain; version=0.0.4")
+
+
+class RawResponse:
+    def __init__(self, body: str, content_type: str):
+        self.body = body
+        self.content_type = content_type
+
+
+HTTPServer = Server  # alias matching the reference's naming
+
+
+def _make_handler(server: Server):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        # request helpers -------------------------------------------------
+        def _body(self) -> bytes:
+            n = int(self.headers.get("Content-Length", 0) or 0)
+            return self.rfile.read(n) if n else b""
+
+        def json(self):
+            raw = self._raw if self._raw is not None else b""
+            if not raw:
+                return None
+            try:
+                return json.loads(raw)
+            except json.JSONDecodeError:
+                return None
+
+        def text(self) -> str:
+            return (self._raw or b"").decode("utf-8", "replace")
+
+        # dispatch --------------------------------------------------------
+        def _handle(self, method: str):
+            u = urlparse(self.path)
+            self.query = parse_qs(u.query)
+            self._raw = self._body() if method in ("POST", "PUT") else None
+            if server.auth is not None:
+                err = server.auth.check(self, u.path)
+                if err is not None:
+                    self._send(err[0], {"error": err[1]})
+                    return
+            status, result = server.dispatch(method, u.path, self)
+            self._send(status, result)
+            metrics.HTTP_REQUESTS.inc(
+                method=method, path=u.path.split("/")[1] or "/",
+                status=str(status))
+
+        def _send(self, status: int, result):
+            if isinstance(result, RawResponse):
+                body = result.body.encode()
+                ctype = result.content_type
+            else:
+                body = json.dumps(result).encode()
+                ctype = "application/json"
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            self._handle("GET")
+
+        def do_POST(self):
+            self._handle("POST")
+
+        def do_DELETE(self):
+            self._handle("DELETE")
+
+        def log_message(self, fmt, *args):
+            server.logger.debug("http: " + fmt, *args)
+
+    return Handler

@@ -1,0 +1,191 @@
+"""HTTP server + API facade tests — drive the reference's route
+surface (http_handler.go:493-562) over a live in-process server."""
+
+import json
+
+import http.client
+
+import pytest
+
+from pilosa_tpu.server import Server
+
+
+@pytest.fixture()
+def srv():
+    s = Server().start()
+    yield s
+    s.close()
+
+
+def req(srv, method, path, body=None):
+    c = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+    data = json.dumps(body) if isinstance(body, (dict, list)) else body
+    c.request(method, path, body=data,
+              headers={"Content-Type": "application/json"})
+    r = c.getresponse()
+    raw = r.read()
+    c.close()
+    try:
+        return r.status, json.loads(raw)
+    except json.JSONDecodeError:
+        return r.status, raw.decode()
+
+
+def test_version_info_status(srv):
+    st, v = req(srv, "GET", "/version")
+    assert st == 200 and "version" in v
+    st, info = req(srv, "GET", "/info")
+    assert st == 200 and info["shard_width"] == 1 << 20
+    st, s = req(srv, "GET", "/status")
+    assert st == 200 and s["state"] == "NORMAL"
+
+
+def test_index_field_lifecycle(srv):
+    st, d = req(srv, "POST", "/index/i0", {"options": {"keys": False}})
+    assert st == 200 and d["name"] == "i0"
+    st, d = req(srv, "POST", "/index/i0", {})
+    assert st == 409
+    st, d = req(srv, "POST", "/index/i0/field/f0", {"options": {"type": "set"}})
+    assert st == 200 and d["name"] == "f0"
+    st, sch = req(srv, "GET", "/schema")
+    names = [ix["name"] for ix in sch["indexes"]]
+    assert "i0" in names
+    st, _ = req(srv, "DELETE", "/index/i0/field/f0")
+    assert st == 200
+    st, _ = req(srv, "DELETE", "/index/i0")
+    assert st == 200
+    st, _ = req(srv, "DELETE", "/index/i0")
+    assert st == 404
+
+
+def test_invalid_names(srv):
+    st, d = req(srv, "POST", "/index/BadName", {})
+    assert st == 400 and "error" in d
+
+
+def test_query_roundtrip(srv):
+    req(srv, "POST", "/index/i1", {})
+    req(srv, "POST", "/index/i1/field/f", {})
+    st, d = req(srv, "POST", "/index/i1/query",
+                {"query": "Set(1, f=10) Set(2, f=10) Set(1, f=20)"})
+    assert st == 200 and d["results"] == [True, True, True]
+    st, d = req(srv, "POST", "/index/i1/query",
+                {"query": "Count(Row(f=10))"})
+    assert d["results"] == [2]
+    st, d = req(srv, "POST", "/index/i1/query",
+                {"query": "Row(f=10)"})
+    assert d["results"][0]["columns"] == [1, 2]
+    # raw PQL body (text/plain mode)
+    st, d = req(srv, "POST", "/index/i1/query", "Count(Row(f=20))")
+    assert d["results"] == [1]
+    # bad query
+    st, d = req(srv, "POST", "/index/i1/query", {"query": "Nope("})
+    assert st == 400 and "error" in d
+
+
+def test_query_profile(srv):
+    req(srv, "POST", "/index/ip", {})
+    req(srv, "POST", "/index/ip/field/f", {})
+    st, d = req(srv, "POST", "/index/ip/query?profile=true",
+                {"query": "Count(Row(f=1))"})
+    assert st == 200
+    prof = d["profile"]
+    assert prof and prof[0]["name"] == "executor.Execute"
+
+
+def test_import_bits_and_values(srv):
+    req(srv, "POST", "/index/i2", {})
+    req(srv, "POST", "/index/i2/field/f", {})
+    req(srv, "POST", "/index/i2/field/b",
+        {"options": {"type": "int", "min": 0, "max": 1000}})
+    st, d = req(srv, "POST", "/index/i2/field/f/import",
+                {"rows": [1, 1, 2], "columns": [10, 11, 10]})
+    assert st == 200 and d["imported"] == 3
+    st, d = req(srv, "POST", "/index/i2/field/b/import",
+                {"columns": [10, 11], "values": [7, 9]})
+    assert st == 200 and d["imported"] == 2
+    st, d = req(srv, "POST", "/index/i2/query", {"query": "Sum(field=b)"})
+    assert d["results"][0] == {"value": 16, "count": 2}
+    # clear
+    st, d = req(srv, "POST", "/index/i2/field/f/import",
+                {"rows": [1], "columns": [10], "clear": True})
+    assert d["imported"] == 1
+    st, d = req(srv, "POST", "/index/i2/query", {"query": "Count(Row(f=1))"})
+    assert d["results"] == [1]
+
+
+def test_keyed_import_and_translate(srv):
+    req(srv, "POST", "/index/k", {"options": {"keys": True}})
+    req(srv, "POST", "/index/k/field/f", {"options": {"keys": True}})
+    st, d = req(srv, "POST", "/index/k/field/f/import",
+                {"rowKeys": ["red", "red", "blue"],
+                 "columnKeys": ["a", "b", "a"]})
+    assert st == 200 and d["imported"] == 3
+    st, d = req(srv, "POST", "/index/k/query", {"query": 'Row(f="red")'})
+    assert sorted(d["results"][0]["keys"]) == ["a", "b"]
+    # translate endpoints
+    st, ids = req(srv, "POST", "/internal/translate/k/keys/find",
+                  {"keys": ["a", "zzz"]})
+    assert st == 200 and ids[0] is not None and ids[1] is None
+    st, ids = req(srv, "POST", "/internal/translate/k/keys/create",
+                  {"keys": ["new1"]})
+    assert st == 200 and isinstance(ids[0], int)
+    st, keys = req(srv, "POST", "/internal/translate/k/ids",
+                   {"ids": [ids[0]]})
+    assert keys == ["new1"]
+
+
+def test_sql_over_http(srv):
+    st, _ = req(srv, "POST", "/sql",
+                {"sql": "CREATE TABLE t (_id id, n int min 0 max 100)"})
+    assert st == 200
+    st, _ = req(srv, "POST", "/sql",
+                {"sql": "INSERT INTO t (_id, n) VALUES (1, 5), (2, 7)"})
+    assert st == 200
+    st, d = req(srv, "POST", "/sql", {"sql": "SELECT COUNT(*) FROM t"})
+    assert st == 200 and d["data"] == [[2]]
+    assert d["schema"]["fields"]
+    st, d = req(srv, "POST", "/sql", {"sql": "SELECT bogus FROM nope"})
+    assert st == 400
+
+
+def test_schema_apply_idempotent(srv):
+    schema = {"indexes": [
+        {"name": "sa", "keys": False,
+         "fields": [{"name": "f", "options": {"type": "set"}},
+                    {"name": "n", "options": {"type": "int",
+                                              "min": 0, "max": 10}}]}]}
+    st, _ = req(srv, "POST", "/schema", schema)
+    assert st == 200
+    st, _ = req(srv, "POST", "/schema", schema)  # idempotent
+    assert st == 200
+    st, sch = req(srv, "GET", "/schema")
+    ix = [i for i in sch["indexes"] if i["name"] == "sa"][0]
+    assert {f["name"] for f in ix["fields"]} >= {"f", "n"}
+
+
+def test_metrics_and_history(srv):
+    req(srv, "POST", "/index/m", {})
+    req(srv, "POST", "/index/m/field/f", {})
+    req(srv, "POST", "/index/m/query", {"query": "Count(Row(f=1))"})
+    st, text = req(srv, "GET", "/metrics")
+    assert st == 200 and "pilosa_query_total" in text
+    st, j = req(srv, "GET", "/metrics.json")
+    assert st == 200 and "pilosa_query_total" in j
+    st, hist = req(srv, "GET", "/query-history")
+    assert st == 200
+    assert any(h["query"].startswith("Count") for h in hist)
+
+
+def test_shards_max(srv):
+    req(srv, "POST", "/index/sm", {})
+    req(srv, "POST", "/index/sm/field/f", {})
+    req(srv, "POST", "/index/sm/query",
+        {"query": f"Set({3 * (1 << 20) + 5}, f=1)"})
+    st, d = req(srv, "GET", "/internal/shards/max")
+    assert st == 200 and d["standard"]["sm"] == 3
+
+
+def test_404(srv):
+    st, d = req(srv, "GET", "/nope")
+    assert st == 404
