@@ -68,6 +68,12 @@ class API:
         self._history: list[QueryHistoryEntry] = []
         self._hist_lock = threading.Lock()
         self.history_keep = 100
+        # imports serialize per index, the analog of the reference's
+        # one-writer-per-shard RBF write transaction (api.go:618 under
+        # Qcx write Tx); concurrent ingest still parallelizes batching
+        # and key translation outside this lock
+        self._import_locks: dict[str, threading.Lock] = {}
+        self._import_locks_mu = threading.Lock()
 
     # ------------------------------------------------------------------
     # queries
@@ -171,8 +177,19 @@ class API:
         self.holder.save_schema()
 
     def apply_schema(self, schema: dict):
-        """POST /schema (api.go ApplySchema): idempotent bulk create."""
-        for ix in schema.get("indexes", []):
+        """POST /schema (api.go ApplySchema): idempotent bulk create.
+        Validated up front so a bad entry can't leave earlier indexes
+        half-created."""
+        indexes = schema.get("indexes", [])
+        try:
+            for ix in indexes:
+                _validate_name(ix["name"])
+                for fd in ix.get("fields", []):
+                    _validate_name(fd["name"])
+                    FieldOptions.from_dict(fd.get("options", {}))
+        except (KeyError, TypeError, ValueError, AttributeError) as e:
+            raise ApiError(f"invalid schema: {e!r}", 400)
+        for ix in indexes:
             idx = self.holder.create_index(
                 ix["name"], keys=ix.get("keys", False),
                 track_existence=ix.get("track_existence", True),
@@ -198,16 +215,24 @@ class API:
         cols = self._translate_cols(idx, cols, col_keys)
         if len(rows) != len(cols):
             raise ApiError("rows and columns length mismatch", 400)
-        if clear:
-            n = 0
-            for r, c in zip(rows, cols):
-                n += bool(f.clear_bit(int(r), int(c)))
-            return n
-        f.import_bits(rows, cols, timestamps)
-        idx.mark_columns_exist(cols)
+        with self._import_lock(index):
+            if clear:
+                n = 0
+                for r, c in zip(rows, cols):
+                    n += bool(f.clear_bit(int(r), int(c)))
+                return n
+            f.import_bits(rows, cols, timestamps)
+            idx.mark_columns_exist(cols)
         n = len(cols)
         metrics.IMPORTED_BITS.inc(n, index=index)
         return n
+
+    def _import_lock(self, index: str) -> threading.Lock:
+        with self._import_locks_mu:
+            lk = self._import_locks.get(index)
+            if lk is None:
+                lk = self._import_locks[index] = threading.Lock()
+            return lk
 
     def import_values(self, index: str, field: str, cols=None, values=None,
                       col_keys=None, clear: bool = False) -> int:
@@ -221,13 +246,14 @@ class API:
             raise ApiError("values required", 400)
         if len(values) != len(cols):
             raise ApiError("columns and values length mismatch", 400)
-        if clear:
-            n = 0
-            for c in cols:
-                n += bool(f.clear_value(int(c)))
-            return n
-        f.import_values(cols, values)
-        idx.mark_columns_exist(cols)
+        with self._import_lock(index):
+            if clear:
+                n = 0
+                for c in cols:
+                    n += bool(f.clear_value(int(c)))
+                return n
+            f.import_values(cols, values)
+            idx.mark_columns_exist(cols)
         n = len(cols)
         metrics.IMPORTED_BITS.inc(n, index=index)
         return n

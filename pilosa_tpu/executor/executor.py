@@ -71,11 +71,11 @@ class Executor(AdvancedOps):
                 shards: list[int] | None = None) -> list:
         t0 = time.perf_counter()
         status = "error"
+        idx = self.holder.index(index_name)
         # label only with names of real indexes: arbitrary client
         # strings would grow metric cardinality without bound
-        known = self.holder.index(index_name) is not None
+        known = idx is not None
         try:
-            idx = self.holder.index(index_name)
             if idx is None:
                 raise ExecError(f"index not found: {index_name}")
             q = parse(query) if isinstance(query, str) else query

@@ -1,0 +1,36 @@
+"""Ingest — batcher, importer, and the IDK-style pipeline (SURVEY §2.7).
+
+Reference shapes re-built for the TPU engine:
+
+- ``Batch`` (batch/batch.go:55 RecordBatch): accumulate records
+  client-side, translate keys in batches, group bits/values per field,
+  import through one ``Importer`` call per field per flush.
+- ``Importer`` (importer.go:13): the bridge to the engine — in-process
+  (API facade) or remote (HTTP client).
+- ``Pipeline`` (idk/ingest.go:59 Main): Source → schema apply →
+  batch → import loop with per-worker clones and offset commits.
+- Sources (idk/csv, idk/datagen, idk/kafka): CSV files with typed
+  headers, a seeded data generator, and a gated Kafka stub.
+"""
+
+from pilosa_tpu.ingest.batch import Batch, Record
+from pilosa_tpu.ingest.importer import APIImporter, Importer
+from pilosa_tpu.ingest.pipeline import Pipeline
+from pilosa_tpu.ingest.sources import (
+    CSVSource,
+    DatagenSource,
+    KafkaSource,
+    Source,
+)
+
+__all__ = [
+    "Batch",
+    "Record",
+    "Importer",
+    "APIImporter",
+    "Pipeline",
+    "Source",
+    "CSVSource",
+    "DatagenSource",
+    "KafkaSource",
+]

@@ -1,0 +1,119 @@
+"""Batch — client-side columnar batcher.
+
+Reference: batch/batch.go (``RecordBatch`` batch.go:55, ``Batch.Add``
+:459, ``Import`` :753, ``doTranslation`` :860): accumulate up to
+``size`` records, translate ALL unresolved keys in one round per
+store, then group per field and ship one import per field.  Key
+behaviors kept: batched translation (the ingest bottleneck is
+string-key churn, §7 "hard parts"), null handling (missing field →
+no bit), set-fields accepting scalar or list, int/decimal/timestamp
+values, bool fields, time fields with per-record timestamps, and
+clear-on-mutex semantics delegated to the engine's field type.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as _f
+from typing import Any
+
+
+@dataclass
+class Record:
+    """One ingested record: an id (int or string key) + field values."""
+    id: Any
+    values: dict[str, Any] = _f(default_factory=dict)
+    time: Any = None  # per-record timestamp for time fields
+
+
+class Batch:
+    """Accumulates records and imports them per field on flush."""
+
+    def __init__(self, importer, index: str, schema: dict,
+                 size: int = 1 << 16, index_keys: bool = False):
+        """schema: {field_name: {"type": ..., "keys": bool}} — the
+        subset of the index's fields this batch feeds."""
+        self.importer = importer
+        self.index = index
+        self.schema = schema
+        self.size = size
+        self.index_keys = index_keys
+        self._records: list[Record] = []
+        self.imported = 0
+
+    def __len__(self):
+        return len(self._records)
+
+    def add(self, rec: Record) -> bool:
+        """Add one record; returns True when the batch is now full
+        (caller should flush — ErrBatchNowFull behavior batch.go:459)."""
+        self._records.append(rec)
+        return len(self._records) >= self.size
+
+    def flush(self):
+        """Translate keys then import per field (batch.Import :753)."""
+        if not self._records:
+            return
+        recs = self._records
+        self._records = []
+        ids = self._resolve_ids(recs)
+        for fname, fopts in self.schema.items():
+            ftype = fopts.get("type", "set")
+            if ftype in ("int", "decimal", "timestamp"):
+                self._flush_values(fname, recs, ids)
+            else:
+                self._flush_bits(fname, fopts, recs, ids)
+
+    def _resolve_ids(self, recs) -> list[int]:
+        """Record ids → column ids, translating string keys in ONE
+        batched call (doTranslation batch.go:860)."""
+        if not self.index_keys:
+            return [int(r.id) for r in recs]
+        keys = sorted({str(r.id) for r in recs})
+        mapping = self.importer.create_keys(self.index, None, keys)
+        return [mapping[str(r.id)] for r in recs]
+
+    def _flush_bits(self, fname, fopts, recs, ids):
+        rows: list[Any] = []
+        cols: list[int] = []
+        times: list[Any] = []
+        has_time = fopts.get("type") == "time"
+        for r, col in zip(recs, ids):
+            if fname not in r.values or r.values[fname] is None:
+                continue
+            v = r.values[fname]
+            vs = v if isinstance(v, (list, tuple, set)) else [v]
+            for one in vs:
+                rows.append(one)
+                cols.append(col)
+                if has_time:
+                    times.append(r.time)
+        if not cols:
+            return
+        if fopts.get("keys"):
+            mapping = self.importer.create_keys(
+                self.index, fname, sorted({str(x) for x in rows}))
+            rows = [mapping[str(x)] for x in rows]
+        else:
+            rows = [_row_id(x) for x in rows]
+        self.imported += self.importer.import_bits(
+            self.index, fname, rows, cols,
+            timestamps=times if has_time else None)
+
+    def _flush_values(self, fname, recs, ids):
+        cols = []
+        values = []
+        for r, col in zip(recs, ids):
+            v = r.values.get(fname)
+            if v is None:
+                continue
+            cols.append(col)
+            values.append(v)
+        if cols:
+            self.imported += self.importer.import_values(
+                self.index, fname, cols, values)
+
+
+def _row_id(v) -> int:
+    if isinstance(v, bool):
+        return 1 if v else 0
+    return int(v)

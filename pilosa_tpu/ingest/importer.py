@@ -1,0 +1,51 @@
+"""Importer — the bridge from client-side batches to the engine.
+
+Reference: importer.go:13 (``Importer`` interface) with the on-prem
+implementation bridging batch→API (importer.go:34).  The TPU build's
+default is in-process (single-controller: the ingester usually runs on
+the TPU host); an HTTP implementation lives in pilosa_tpu.client.
+"""
+
+from __future__ import annotations
+
+
+class Importer:
+    """Importer interface (importer.go:13)."""
+
+    def import_bits(self, index: str, field: str, rows, cols,
+                    timestamps=None, clear: bool = False) -> int:
+        raise NotImplementedError
+
+    def import_values(self, index: str, field: str, cols, values,
+                      clear: bool = False) -> int:
+        raise NotImplementedError
+
+    def create_keys(self, index: str, field: str | None,
+                    keys: list[str]) -> dict[str, int]:
+        raise NotImplementedError
+
+    def apply_schema(self, schema: dict):
+        raise NotImplementedError
+
+
+class APIImporter(Importer):
+    """In-process importer over the API facade."""
+
+    def __init__(self, api):
+        self.api = api
+
+    def import_bits(self, index, field, rows, cols, timestamps=None,
+                    clear=False):
+        return self.api.import_bits(index, field, rows=rows, cols=cols,
+                                    timestamps=timestamps, clear=clear)
+
+    def import_values(self, index, field, cols, values, clear=False):
+        return self.api.import_values(index, field, cols=cols,
+                                      values=values, clear=clear)
+
+    def create_keys(self, index, field, keys):
+        ids = self.api.translate_keys(index, field, keys, create=True)
+        return dict(zip(keys, ids))
+
+    def apply_schema(self, schema):
+        self.api.apply_schema(schema)

@@ -1,0 +1,92 @@
+"""Pipeline — the IDK ``Main`` ingest loop.
+
+Reference: idk/ingest.go:59,255,357 — per-concurrency worker clones,
+each looping Source.Record → batch.Add → (full?) flush → offset
+commit.  Here a single Source feeds N worker threads over a queue;
+each worker owns its own Batch (m.clone() per ingester,
+idk/ingest.go:302) and flushes independently; offsets commit after
+the owning batch flushed (at-least-once, matching the reference).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from pilosa_tpu.ingest.batch import Batch
+
+
+class Pipeline:
+    def __init__(self, source, importer, index: str,
+                 batch_size: int = 1 << 16, concurrency: int = 1,
+                 index_keys: bool | None = None):
+        self.source = source
+        self.importer = importer
+        self.index = index
+        self.batch_size = batch_size
+        self.concurrency = max(1, concurrency)
+        self.index_keys = (source.id_keys if index_keys is None and
+                           hasattr(source, "id_keys") else bool(index_keys))
+        self.records_ingested = 0
+
+    def apply_schema(self):
+        """Schema-detect step: create index+fields from the source."""
+        fields = [{"name": n, "options": dict(o)}
+                  for n, o in self.source.schema.items()]
+        self.importer.apply_schema({"indexes": [{
+            "name": self.index, "keys": self.index_keys,
+            "fields": fields}]})
+
+    def run(self) -> int:
+        """Ingest everything; returns the number of records."""
+        self.apply_schema()
+        if self.concurrency == 1:
+            n = self._run_worker(iter(self.source))
+            self.records_ingested = n
+            return n
+        q: queue.Queue = queue.Queue(maxsize=self.concurrency * 1024)
+        counts = [0] * self.concurrency
+        errs: list[BaseException] = []
+
+        def worker(i):
+            def drain():
+                while True:
+                    rec = q.get()
+                    if rec is None:
+                        return
+                    yield rec
+            try:
+                counts[i] = self._run_worker(drain())
+            except BaseException as e:  # surface to the caller
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                   for i in range(self.concurrency)]
+        for t in threads:
+            t.start()
+        n_in = 0
+        for rec in self.source:
+            q.put(rec)
+            n_in += 1
+        for _ in threads:
+            q.put(None)
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+        self.records_ingested = sum(counts)
+        assert self.records_ingested == n_in
+        return self.records_ingested
+
+    def _run_worker(self, records) -> int:
+        b = Batch(self.importer, self.index, self.source.schema,
+                  size=self.batch_size, index_keys=self.index_keys)
+        n = 0
+        for rec in records:
+            if b.add(rec):
+                b.flush()
+                self.source.commit(n)
+            n += 1
+        b.flush()
+        self.source.commit(n)
+        return n

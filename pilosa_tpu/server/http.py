@@ -61,22 +61,28 @@ class Server:
         self.httpd = ThreadingHTTPServer((bind, port), handler)
         self.port = self.httpd.server_address[1]
         self._thread: threading.Thread | None = None
+        self._serving = False
 
     # -- lifecycle -----------------------------------------------------
 
     def serve_forever(self):
         self.logger.info("listening on :%d", self.port)
+        self._serving = True
         self.httpd.serve_forever()
 
     def start(self):
         """Serve on a background thread (tests, embedded use)."""
+        self._serving = True
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, daemon=True)
         self._thread.start()
         return self
 
     def close(self):
-        self.httpd.shutdown()
+        # shutdown() blocks on an event only serve_forever() sets —
+        # calling it on a never-started server would deadlock
+        if self._serving:
+            self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
@@ -139,8 +145,8 @@ class Server:
     # -- handlers ------------------------------------------------------
 
     def _post_query(self, req):
-        body = req.json()
-        if isinstance(body, dict):
+        body = req.json_lenient()
+        if body is not None:
             pql = body.get("query", "")
             shards = body.get("shards")
         else:  # raw PQL body, like the reference's text/plain mode
@@ -150,15 +156,18 @@ class Server:
         return self.api.query(req.vars["index"], pql, shards, profile)
 
     def _post_sql(self, req):
-        body = req.json()
-        stmt = body.get("sql", "") if isinstance(body, dict) else req.text()
+        body = req.json_lenient()
+        stmt = body.get("sql", "") if body is not None else req.text()
         return self.api.sql(stmt)
 
     def _get_schema(self, req):
         return self.api.schema()
 
     def _post_schema(self, req):
-        self.api.apply_schema(req.json() or {})
+        body = req.json()
+        if body is None:
+            raise ApiError("request body required", 400)
+        self.api.apply_schema(body)
         return {}
 
     def _post_index(self, req):
@@ -243,12 +252,25 @@ def _make_handler(server: Server):
             return self.rfile.read(n) if n else b""
 
         def json(self):
-            raw = self._raw if self._raw is not None else b""
+            """Parse the body as a JSON object; 400 on malformed JSON
+            or a non-object body, None when the body is empty."""
+            raw = self._raw or b""
             if not raw:
                 return None
             try:
-                return json.loads(raw)
-            except json.JSONDecodeError:
+                v = json.loads(raw)
+            except json.JSONDecodeError as e:
+                raise ApiError(f"malformed JSON body: {e}", 400)
+            if not isinstance(v, dict):
+                raise ApiError("JSON body must be an object", 400)
+            return v
+
+        def json_lenient(self):
+            """For endpoints with a raw-text fallback mode (/sql and
+            PQL query bodies): parsed JSON dict, or None."""
+            try:
+                return self.json()
+            except ApiError:
                 return None
 
         def text(self) -> str:
@@ -258,7 +280,9 @@ def _make_handler(server: Server):
         def _handle(self, method: str):
             u = urlparse(self.path)
             self.query = parse_qs(u.query)
-            self._raw = self._body() if method in ("POST", "PUT") else None
+            # always drain the body: unread bytes on a keep-alive
+            # connection would be parsed as the next request line
+            self._raw = self._body()
             if server.auth is not None:
                 err = server.auth.check(self, u.path)
                 if err is not None:

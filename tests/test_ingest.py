@@ -1,0 +1,129 @@
+"""Ingest tests — batcher, CSV/datagen sources, pipeline semantics
+(reference: batch/batch.go, idk/ingest.go loop behaviors)."""
+
+import io
+
+import pytest
+
+from pilosa_tpu.api import API
+from pilosa_tpu.ingest import (
+    APIImporter,
+    Batch,
+    CSVSource,
+    DatagenSource,
+    KafkaSource,
+    Pipeline,
+    Record,
+)
+from pilosa_tpu.models.holder import Holder
+
+
+@pytest.fixture()
+def api():
+    return API(Holder())
+
+
+def test_batch_bits_and_values(api):
+    api.apply_schema({"indexes": [{"name": "b", "fields": [
+        {"name": "f", "options": {"type": "set"}},
+        {"name": "n", "options": {"type": "int", "min": 0, "max": 100}},
+    ]}]})
+    b = Batch(APIImporter(api), "b",
+              {"f": {"type": "set"}, "n": {"type": "int"}}, size=3)
+    assert not b.add(Record(id=1, values={"f": 7, "n": 10}))
+    assert not b.add(Record(id=2, values={"f": [7, 8], "n": 20}))
+    assert b.add(Record(id=3, values={"n": None}))  # full at 3
+    b.flush()
+    [res] = api.query("b", "Count(Row(f=7))")["results"]
+    assert res == 2
+    [res] = api.query("b", "Sum(field=n)")["results"]
+    assert res == {"value": 30, "count": 2}
+    # record 3 had no f value and a null n: no bits anywhere
+    [res] = api.query("b", "Count(Row(f=8))")["results"]
+    assert res == 1
+
+
+def test_batch_keyed_translation(api):
+    api.apply_schema({"indexes": [{"name": "k", "keys": True, "fields": [
+        {"name": "color", "options": {"type": "set", "keys": True}},
+    ]}]})
+    b = Batch(APIImporter(api), "k", {"color": {"type": "set", "keys": True}},
+              size=10, index_keys=True)
+    b.add(Record(id="alice", values={"color": "red"}))
+    b.add(Record(id="bob", values={"color": ["red", "blue"]}))
+    b.flush()
+    [res] = api.query("k", 'Row(color="red")')["results"]
+    assert sorted(res["keys"]) == ["alice", "bob"]
+
+
+def test_csv_source_and_pipeline(api):
+    csv = io.StringIO(
+        "_id,segment:id,name:string,qty:int,ok:bool,tags:stringset\n"
+        "1,3,aaa,10,true,x;y\n"
+        "2,3,bbb,20,false,y\n"
+        "3,4,,30,true,\n")
+    src = CSVSource(csv)
+    assert src.schema["qty"]["type"] == "int"
+    assert src.schema["name"]["keys"] is True
+    p = Pipeline(src, APIImporter(api), "c")
+    assert p.run() == 3
+    [res] = api.query("c", "Count(Row(segment=3))")["results"]
+    assert res == 2
+    [res] = api.query("c", "Sum(field=qty)")["results"]
+    assert res == {"value": 60, "count": 3}
+    [res] = api.query("c", 'Count(Row(tags="y"))')["results"]
+    assert res == 2
+    [res] = api.query("c", "Count(Row(ok=true))")["results"]
+    assert res == 2
+    # record 3's empty name → no bit
+    [res] = api.query("c", "Count(Row(segment=4))")["results"]
+    assert res == 1
+
+
+def test_csv_keyed_ids(api):
+    csv = io.StringIO("_id:string,seg:id\nuserA,1\nuserB,1\n")
+    src = CSVSource(csv)
+    p = Pipeline(src, APIImporter(api), "ck")
+    assert p.run() == 2
+    [res] = api.query("ck", "Row(seg=1)")["results"]
+    assert sorted(res["keys"]) == ["userA", "userB"]
+
+
+def test_csv_bad_header():
+    with pytest.raises(ValueError):
+        CSVSource(io.StringIO("_id,x:bogustype\n1,2\n"))
+    with pytest.raises(ValueError):
+        CSVSource(io.StringIO("x:id\n1\n"))  # no _id
+
+
+def test_datagen_deterministic(api):
+    src1 = list(DatagenSource(50, seed=7))
+    src2 = list(DatagenSource(50, seed=7))
+    assert [r.values for r in src1] == [r.values for r in src2]
+
+
+def test_pipeline_concurrency_matches_serial(api):
+    p1 = Pipeline(DatagenSource(500, seed=3), APIImporter(api), "s1",
+                  batch_size=64, concurrency=1)
+    p1.run()
+    p4 = Pipeline(DatagenSource(500, seed=3), APIImporter(api), "s4",
+                  batch_size=64, concurrency=4)
+    assert p4.run() == 500
+    for q in ("Count(Row(segment=5))", "Sum(field=amount)",
+              "Count(Row(active=true))"):
+        r1 = api.query("s1", q)["results"]
+        r4 = api.query("s4", q)["results"]
+        assert r1 == r4, q
+
+
+def test_pipeline_small_batches_flush_all(api):
+    p = Pipeline(DatagenSource(97, seed=1), APIImporter(api), "sb",
+                 batch_size=10)
+    assert p.run() == 97
+    [res] = api.query("sb", "Count(All())")["results"]
+    assert res == 97
+
+
+def test_kafka_gated():
+    with pytest.raises(NotImplementedError):
+        KafkaSource("broker:9092")
