@@ -1,0 +1,49 @@
+"""Cluster & coordination (SURVEY §2.5) — the multi-HOST plane.
+
+Two distinct scales of "distributed" exist in this framework:
+
+- **Inside one TPU slice** the mesh executor (pilosa_tpu.parallel) is
+  the data plane: shards are placed on devices by a static
+  NamedSharding and reduces are ICI collectives.  None of the code in
+  this package runs per-query there — that is the whole point of the
+  TPU re-design (reference executor.go:6449's HTTP mapReduce becomes
+  one jitted program).
+- **Across hosts/slices** (or across independent TPU pods over DCN),
+  coordination still needs a control plane and a data plane, which
+  this package provides re-designed from the reference's:
+  etcd-embedded membership (etcd/embed.go) → a pluggable ``DisCo``
+  registry (in-memory single-process default, the test.Cluster
+  analog); jump-hash shard→node snapshots (disco/snapshot.go:64,
+  disco/hasher.go:16); ReplicaN write fan-out (api.go:651); query
+  fan-out with replica failover (executor.go:6505); cluster-wide
+  exclusive transactions (transaction.go).
+"""
+
+from pilosa_tpu.cluster.hash import jump_hash
+from pilosa_tpu.cluster.disco import (
+    DisCo,
+    InMemDisCo,
+    Node,
+    NodeState,
+)
+from pilosa_tpu.cluster.snapshot import ClusterSnapshot
+from pilosa_tpu.cluster.client import InternalClient
+from pilosa_tpu.cluster.coordinator import ClusterExecutor, ClusterNode
+from pilosa_tpu.cluster.txn import (
+    Transaction,
+    TransactionManager,
+)
+
+__all__ = [
+    "jump_hash",
+    "DisCo",
+    "InMemDisCo",
+    "Node",
+    "NodeState",
+    "ClusterSnapshot",
+    "InternalClient",
+    "ClusterExecutor",
+    "ClusterNode",
+    "Transaction",
+    "TransactionManager",
+]

@@ -1,0 +1,80 @@
+"""InternalClient — node-to-node HTTP data plane.
+
+Reference: internal_client.go:35 (QueryNode, imports, translate-data
+streaming between nodes).  JSON over HTTP against the same public
+route surface (the reference also reuses its handler routes with
+``Remote=true``); connections are short-lived — cross-HOST traffic is
+rare by design (per-query fan-out only exists across slices, never
+across devices of one slice).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+
+
+class RemoteError(Exception):
+    """The remote node answered with an error status."""
+
+    def __init__(self, status: int, msg: str):
+        super().__init__(f"remote {status}: {msg}")
+        self.status = status
+
+
+class InternalClient:
+    def __init__(self, timeout: float = 30.0):
+        self.timeout = timeout
+
+    def _request(self, uri: str, method: str, path: str, body=None):
+        host, _, port = uri.partition(":")
+        conn = http.client.HTTPConnection(host, int(port or 80),
+                                          timeout=self.timeout)
+        try:
+            conn.request(method, path,
+                         body=None if body is None else json.dumps(body),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            raw = resp.read()
+        finally:
+            conn.close()
+        data = json.loads(raw) if raw else None
+        if resp.status != 200:
+            msg = data.get("error", "") if isinstance(data, dict) else str(data)
+            raise RemoteError(resp.status, msg)
+        return data
+
+    # executor.remoteExec's transport (executor.go:6392)
+    def query_node(self, uri: str, index: str, pql: str,
+                   shards: list[int] | None) -> dict:
+        return self._request(uri, "POST", f"/index/{index}/query",
+                             {"query": pql, "shards": shards,
+                              "remote": True})
+
+    def import_bits(self, uri: str, index: str, field: str, rows, cols,
+                    timestamps=None, clear=False) -> int:
+        body = {"rows": list(map(int, rows)),
+                "columns": list(map(int, cols)), "clear": clear}
+        if timestamps is not None:
+            body["timestamps"] = timestamps
+        r = self._request(uri, "POST",
+                          f"/index/{index}/field/{field}/import", body)
+        return r["imported"]
+
+    def import_values(self, uri: str, index: str, field: str, cols,
+                      values, clear=False) -> int:
+        r = self._request(uri, "POST",
+                          f"/index/{index}/field/{field}/import",
+                          {"columns": list(map(int, cols)),
+                           "values": list(values), "clear": clear})
+        return r["imported"]
+
+    def create_keys(self, uri: str, index: str, field: str | None,
+                    keys: list[str]) -> list[int]:
+        q = f"?field={field}" if field else ""
+        return self._request(
+            uri, "POST", f"/internal/translate/{index}/keys/create{q}",
+            {"keys": keys})
+
+    def status(self, uri: str) -> dict:
+        return self._request(uri, "GET", "/status")

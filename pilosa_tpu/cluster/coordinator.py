@@ -1,0 +1,316 @@
+"""ClusterNode + ClusterExecutor — multi-host fan-out with failover.
+
+Reference: the node-distribution half of executor.mapReduce
+(executor.go:6392-6812): group shards by owning node (shardsByNode
+:6416), run local shards in-process, POST remote shard groups to
+their owners, stream-reduce responses, and fail over to a replica on
+connection errors (:6505-6518).  Writes forward synchronously to all
+shard replicas (api.go:651-672).
+
+The TPU re-design keeps this layer HOST-level only: a "node" is one
+controller process owning one TPU slice; its local shards evaluate as
+ONE jitted mesh program (pilosa_tpu.parallel), not a per-shard loop.
+Cross-node reduces operate on the serialized result forms (the wire
+format), mirroring how the reference reduces decoded protobuf rows.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from pilosa_tpu.cluster.client import InternalClient, RemoteError
+from pilosa_tpu.cluster.disco import DisCo, InMemDisCo, Node, NodeState
+from pilosa_tpu.cluster.snapshot import ClusterSnapshot
+from pilosa_tpu.cluster.txn import TransactionManager
+from pilosa_tpu.pql import parse
+
+
+class ClusterError(Exception):
+    pass
+
+
+class ClusterNode:
+    """One cluster member: an HTTP Server + disco registration +
+    heartbeat loop (server.go Open wiring)."""
+
+    def __init__(self, node_id: str, disco: DisCo, holder=None,
+                 replica_n: int = 1, bind: str = "127.0.0.1",
+                 heartbeat_interval: float = 1.0):
+        from pilosa_tpu.server import Server
+        self.server = Server(holder=holder, bind=bind)
+        self.api = self.server.api
+        self.api.name = node_id
+        self.node_id = node_id
+        self.disco = disco
+        self.replica_n = replica_n
+        self.txns = TransactionManager()
+        self.uri = f"127.0.0.1:{self.server.port}"
+        self._hb_interval = heartbeat_interval
+        self._hb_stop = threading.Event()
+        self._hb_thread: threading.Thread | None = None
+        self.executor = ClusterExecutor(self)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def open(self):
+        """disCo.Start + serve + heartbeats (server.go:618)."""
+        self.server.start()
+        self.disco.start(Node(id=self.node_id, uri=self.uri))
+        self._hb_thread = threading.Thread(target=self._hb_loop,
+                                           daemon=True)
+        self._hb_thread.start()
+        return self
+
+    def _hb_loop(self):
+        while not self._hb_stop.wait(self._hb_interval):
+            self.disco.heartbeat(self.node_id)
+            if isinstance(self.disco, InMemDisCo):
+                self.disco.check_heartbeats()
+
+    def pause(self):
+        """Stop heartbeating AND serving (fault injection — the pumba
+        container-pause analog, internal/clustertests)."""
+        self._hb_stop.set()
+        self.server.httpd.shutdown()
+
+    def close(self):
+        self._hb_stop.set()
+        if self._hb_thread:
+            self._hb_thread.join(timeout=2)
+        self.disco.close(self.node_id)
+        self.server.close()
+
+    # -- placement -----------------------------------------------------
+
+    def snapshot(self) -> ClusterSnapshot:
+        return ClusterSnapshot(self.disco.nodes(), self.replica_n)
+
+    # -- writes (replicated) -------------------------------------------
+
+    def import_bits(self, index: str, field: str, rows, cols,
+                    timestamps=None) -> int:
+        """Route bits to shard owners; forward to all replicas
+        synchronously (api.go:651-672)."""
+        snap = self.snapshot()
+        groups: dict[int, list[int]] = {}
+        width = self.api.holder.width
+        for i, c in enumerate(cols):
+            groups.setdefault(int(c) // width, []).append(i)
+        n = 0
+        shards_touched = set()
+        for shard, idxs in groups.items():
+            srows = [int(rows[i]) for i in idxs]
+            scols = [int(cols[i]) for i in idxs]
+            stimes = ([timestamps[i] for i in idxs]
+                      if timestamps is not None else None)
+            for node in snap.shard_nodes(index, shard):
+                n_ = self._import_to(node, index, field, srows, scols,
+                                     stimes)
+            n += n_
+            shards_touched.add(shard)
+        self.disco.add_shards(index, "", shards_touched)
+        return n
+
+    def import_values(self, index: str, field: str, cols, values) -> int:
+        snap = self.snapshot()
+        groups: dict[int, list[int]] = {}
+        width = self.api.holder.width
+        for i, c in enumerate(cols):
+            groups.setdefault(int(c) // width, []).append(i)
+        n = 0
+        shards_touched = set()
+        for shard, idxs in groups.items():
+            scols = [int(cols[i]) for i in idxs]
+            svals = [values[i] for i in idxs]
+            for node in snap.shard_nodes(index, shard):
+                if node.id == self.node_id:
+                    n_ = self.api.import_values(index, field, cols=scols,
+                                                values=svals)
+                else:
+                    n_ = self._client().import_values(
+                        node.uri, index, field, scols, svals)
+            n += n_
+            shards_touched.add(shard)
+        self.disco.add_shards(index, "", shards_touched)
+        return n
+
+    def _import_to(self, node, index, field, rows, cols, times):
+        if node.id == self.node_id:
+            return self.api.import_bits(index, field, rows=rows,
+                                        cols=cols, timestamps=times)
+        return self._client().import_bits(node.uri, index, field, rows,
+                                          cols, timestamps=times)
+
+    def _client(self) -> InternalClient:
+        return InternalClient()
+
+    def apply_schema(self, schema: dict):
+        """Schema changes broadcast to every node (broadcast.go
+        SendSync of schema messages)."""
+        self.disco.set_schema(schema)
+        for node in self.disco.nodes():
+            if node.id == self.node_id:
+                self.api.apply_schema(schema)
+            else:
+                self._client()._request(node.uri, "POST", "/schema",
+                                        schema)
+
+    # -- queries -------------------------------------------------------
+
+    def query(self, index: str, pql: str) -> dict:
+        return self.executor.execute(index, pql)
+
+
+class ClusterExecutor:
+    """Shard fan-out over nodes + reduce over wire-format results."""
+
+    def __init__(self, node: ClusterNode):
+        self.node = node
+
+    def execute(self, index: str, pql: str) -> dict:
+        snap = self.node.snapshot()
+        shards = sorted(self.node.disco.shards(index, ""))
+        if not shards:
+            # no data imported through the cluster path: run locally
+            return self.node.api.query(index, pql)
+        q = parse(pql)
+        partials = self._fan_out(snap, index, pql, shards)
+        # reduce call-by-call across nodes (streaming reduceFn analog)
+        results = []
+        for ci in range(len(q.calls)):
+            vals = [p[ci] for p in partials]
+            results.append(_reduce(q.calls[ci], vals))
+        return {"results": results}
+
+    def _fan_out(self, snap, index, pql, shards,
+                 attempts: int = 3) -> list[list]:
+        """Group shards by owner and execute; when a node fails, mark
+        it DOWN and re-plan ONLY its shards against the remaining live
+        replicas — per-shard failover, never running a shard on a node
+        that doesn't own a replica of it (executor.go:6505-6518)."""
+        by_node = snap.shards_by_node(index, shards)
+        partials: list[list] = []
+        failed_shards: list[int] = []
+        last_err = None
+        for node_id, node_shards in sorted(by_node.items()):
+            node = snap.node(node_id)
+            try:
+                if node_id == self.node.node_id:
+                    resp = self.node.api.query(index, pql,
+                                               shards=node_shards)
+                else:
+                    resp = self.node._client().query_node(
+                        node.uri, index, pql, node_shards)
+                partials.append(resp["results"])
+            except (ConnectionError, OSError, TimeoutError) as e:
+                last_err = e
+                self.node.disco.set_state(node_id, NodeState.DOWN)
+                failed_shards.extend(node_shards)
+        if failed_shards:
+            if attempts <= 1:
+                raise ClusterError(
+                    f"replicas exhausted for shards "
+                    f"{failed_shards[:4]}...: {last_err}")
+            # shards_by_node consults node state, so the DOWN mark
+            # reroutes each failed shard to its next live replica; a
+            # shard with no live replica keeps its dead owner and the
+            # retry fails it for good
+            snap2 = self.node.snapshot()
+            dead = {n.id for n in snap2.nodes
+                    if n.state != NodeState.STARTED}
+            for s in failed_shards:
+                owners = {n.id for n in snap2.shard_nodes(index, s)}
+                if owners <= dead:
+                    raise ClusterError(
+                        f"no live replica for shard {s}: {last_err}")
+            partials.extend(
+                self._fan_out(snap2, index, pql, failed_shards,
+                              attempts - 1))
+        return partials
+
+
+# ----------------------------------------------------------------------
+# cross-node reducers over serialized results
+# ----------------------------------------------------------------------
+
+def _reduce(call, vals: list):
+    call_name = call.name
+    if not vals:
+        return None
+    if len(vals) == 1:
+        return vals[0]
+    first = vals[0]
+    if call_name in ("Count", "Store"):
+        return sum(vals)
+    if call_name in ("Set", "Clear", "ClearRow"):
+        return any(vals)
+    if call_name == "Sum":
+        return {"value": sum(v["value"] or 0 for v in vals),
+                "count": sum(v["count"] for v in vals)}
+    if call_name in ("Min", "Max"):
+        pick = min if call_name == "Min" else max
+        present = [v for v in vals if v["count"] > 0]
+        if not present:
+            return {"value": None, "count": 0}
+        best = pick(v["value"] for v in present)
+        return {"value": best,
+                "count": sum(v["count"] for v in present
+                             if v["value"] == best)}
+    if call_name in ("TopN", "TopK"):
+        merged: dict = {}
+        for v in vals:
+            for p in v:
+                k = p.get("key", p.get("id"))
+                if k in merged:
+                    merged[k]["count"] += p["count"]
+                else:
+                    merged[k] = dict(p)
+        out = sorted(merged.values(),
+                     key=lambda p: (-p["count"], p.get("id", 0)))
+        # re-apply the requested limit after the cross-node merge —
+        # per-node truncation alone would return up to n*nodes pairs
+        n = call.arg("n") or call.arg("k")
+        if n:
+            out = out[:int(n)]
+        return out
+    if call_name == "Rows":
+        out = set()
+        for v in vals:
+            out.update(v)
+        return sorted(out)
+    if call_name == "Distinct":
+        out = set()
+        for v in vals:
+            out.update(v["values"])
+        return {"values": sorted(out)}
+    if call_name == "GroupBy":
+        merged = {}
+        for v in vals:
+            for g in v:
+                key = tuple(sorted(
+                    (d.get("field", ""), d.get("row_id"),
+                     str(d.get("value"))) for d in g["group"]))
+                if key in merged:
+                    merged[key]["count"] += g["count"]
+                    if g.get("agg") is not None:
+                        merged[key]["agg"] = (merged[key].get("agg") or 0) \
+                            + g["agg"]
+                else:
+                    merged[key] = dict(g)
+        return list(merged.values())
+    if isinstance(first, dict) and "columns" in first:
+        # Row-like: union of column sets (+ keys when present)
+        cols = set()
+        keys = set()
+        has_keys = False
+        for v in vals:
+            cols.update(v["columns"])
+            if "keys" in v:
+                has_keys = True
+                keys.update(v["keys"])
+        out = {"columns": sorted(cols)}
+        if has_keys:
+            out["keys"] = sorted(keys)
+        return out
+    return first

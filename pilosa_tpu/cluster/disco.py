@@ -1,0 +1,178 @@
+"""DisCo — distributed coordination abstractions.
+
+Reference: disco/disco.go — ``DisCo`` (lifecycle/leader :35),
+``Noder`` (node list :92), ``Schemator`` (schema KV), ``Sharder``
+(available-shards KV :113), and the ``NodeState`` machine (:46-63).
+The reference backs these with an embedded etcd server per node
+(etcd/embed.go); the TPU build's default backend is an in-process
+registry — on a TPU pod the controller is a single process and
+membership is static, so a consensus store is not needed for
+correctness, only for multi-controller deployments (where a real etcd
+or k8s API can implement this same interface).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+class NodeState:
+    UNKNOWN = "UNKNOWN"
+    STARTING = "STARTING"
+    STARTED = "STARTED"
+    RESIZING = "RESIZING"
+    DOWN = "DOWN"
+
+
+@dataclass
+class Node:
+    id: str
+    uri: str = ""           # host:port for the data-plane HTTP API
+    grpc_uri: str = ""
+    state: str = NodeState.STARTING
+    is_primary: bool = False
+    last_heartbeat: float = field(default_factory=time.time)
+
+    def to_dict(self):
+        return {"id": self.id, "uri": self.uri, "state": self.state,
+                "is_primary": self.is_primary}
+
+
+class DisCo:
+    """Coordination backend interface: lifecycle + membership + schema
+    + shard registry (disco.DisCo/Noder/Schemator/Sharder merged — in
+    the reference they are four interfaces implemented by one etcd
+    object; one Python class states that more directly)."""
+
+    # lifecycle
+    def start(self, node: Node):
+        raise NotImplementedError
+
+    def close(self, node_id: str):
+        raise NotImplementedError
+
+    def is_leader(self, node_id: str) -> bool:
+        raise NotImplementedError
+
+    # Noder
+    def nodes(self) -> list[Node]:
+        raise NotImplementedError
+
+    def heartbeat(self, node_id: str):
+        raise NotImplementedError
+
+    def set_state(self, node_id: str, state: str):
+        raise NotImplementedError
+
+    # Schemator
+    def schema(self) -> dict:
+        raise NotImplementedError
+
+    def set_schema(self, schema: dict):
+        raise NotImplementedError
+
+    # Sharder
+    def shards(self, index: str, field: str) -> set[int]:
+        raise NotImplementedError
+
+    def add_shards(self, index: str, field: str, shards: set[int]):
+        raise NotImplementedError
+
+
+class InMemDisCo(DisCo):
+    """Single-process registry shared by all nodes of an in-process
+    cluster (the test.Cluster analog, test/cluster.go:31) and the
+    default for single-controller TPU deployments.
+
+    Failure detection: nodes heartbeat; ``check_heartbeats`` marks
+    nodes DOWN after ``lease_ttl`` without one (etcd lease analog,
+    etcd/embed.go:458)."""
+
+    def __init__(self, lease_ttl: float = 5.0):
+        self._nodes: dict[str, Node] = {}
+        self._schema: dict = {}
+        self._shards: dict[tuple[str, str], set[int]] = {}
+        self._lock = threading.RLock()
+        self.lease_ttl = lease_ttl
+
+    # lifecycle --------------------------------------------------------
+    def start(self, node: Node):
+        with self._lock:
+            node.state = NodeState.STARTED
+            node.last_heartbeat = time.time()
+            self._nodes[node.id] = node
+            self._elect()
+
+    def close(self, node_id: str):
+        with self._lock:
+            self._nodes.pop(node_id, None)
+            self._elect()
+
+    def _elect(self):
+        """Leader = lowest node id among live nodes (the reference
+        derives primary from etcd leadership; any stable rule works)."""
+        live = [n for n in self._nodes.values()
+                if n.state == NodeState.STARTED]
+        leader = min(live, key=lambda n: n.id).id if live else None
+        for n in self._nodes.values():
+            n.is_primary = (n.id == leader)
+
+    def is_leader(self, node_id: str) -> bool:
+        with self._lock:
+            n = self._nodes.get(node_id)
+            return bool(n and n.is_primary)
+
+    # Noder ------------------------------------------------------------
+    def nodes(self) -> list[Node]:
+        with self._lock:
+            return sorted(self._nodes.values(), key=lambda n: n.id)
+
+    def heartbeat(self, node_id: str):
+        with self._lock:
+            n = self._nodes.get(node_id)
+            if n:
+                n.last_heartbeat = time.time()
+                if n.state == NodeState.DOWN:
+                    n.state = NodeState.STARTED
+                    self._elect()
+
+    def set_state(self, node_id: str, state: str):
+        with self._lock:
+            n = self._nodes.get(node_id)
+            if n:
+                n.state = state
+                self._elect()
+
+    def check_heartbeats(self) -> list[str]:
+        """Mark nodes DOWN whose lease expired; returns their ids."""
+        now = time.time()
+        downed = []
+        with self._lock:
+            for n in self._nodes.values():
+                if n.state == NodeState.STARTED and \
+                        now - n.last_heartbeat > self.lease_ttl:
+                    n.state = NodeState.DOWN
+                    downed.append(n.id)
+            if downed:
+                self._elect()
+        return downed
+
+    # Schemator --------------------------------------------------------
+    def schema(self) -> dict:
+        with self._lock:
+            return dict(self._schema)
+
+    def set_schema(self, schema: dict):
+        with self._lock:
+            self._schema = dict(schema)
+
+    # Sharder ----------------------------------------------------------
+    def shards(self, index: str, field: str) -> set[int]:
+        with self._lock:
+            return set(self._shards.get((index, field), set()))
+
+    def add_shards(self, index: str, field: str, shards: set[int]):
+        with self._lock:
+            self._shards.setdefault((index, field), set()).update(shards)

@@ -1,0 +1,23 @@
+"""Jump consistent hashing (disco/hasher.go:16 ``Jmphasher``).
+
+The standard Lamport/Veach jump-hash: maps a 64-bit key to one of n
+buckets with minimal movement when n changes.  Used for both
+partition→node and (via partition) shard→node placement.
+"""
+
+from __future__ import annotations
+
+_MASK = (1 << 64) - 1
+
+
+def jump_hash(key: int, n: int) -> int:
+    """Jump consistent hash of ``key`` onto ``n`` buckets."""
+    if n <= 0:
+        raise ValueError("n must be positive")
+    k = key & _MASK
+    b, j = -1, 0
+    while j < n:
+        b = j
+        k = (k * 2862933555777941757 + 1) & _MASK
+        j = int((b + 1) * (float(1 << 31) / float((k >> 33) + 1)))
+    return b

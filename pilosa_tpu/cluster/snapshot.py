@@ -1,0 +1,73 @@
+"""ClusterSnapshot — static shard/partition→node placement.
+
+Reference: disco/snapshot.go (``ClusterSnapshot``, PartitionToNodes
+:54, ShardToShardPartition :64, ``DefaultPartitionN = 256`` :15) and
+cluster.go:107-230.  Placement is a pure function of (sorted node
+list, partitionN, replicaN): shard → fnv-hash partition → jump-hash
+primary node, replicas on the following nodes in ring order.  The
+executor takes ONE snapshot per query so a concurrent membership
+change can't split a query across two placements.
+"""
+
+from __future__ import annotations
+
+from pilosa_tpu.cluster.disco import Node, NodeState
+from pilosa_tpu.cluster.hash import jump_hash
+from pilosa_tpu.storage.translate import (
+    key_to_key_partition,
+    shard_to_shard_partition,
+)
+
+DEFAULT_PARTITION_N = 256
+
+
+class ClusterSnapshot:
+    def __init__(self, nodes: list[Node], replica_n: int = 1,
+                 partition_n: int = DEFAULT_PARTITION_N):
+        self.nodes = sorted(nodes, key=lambda n: n.id)
+        self.replica_n = max(1, min(replica_n, len(self.nodes) or 1))
+        self.partition_n = partition_n
+
+    def shard_partition(self, index: str, shard: int) -> int:
+        return shard_to_shard_partition(index, shard, self.partition_n)
+
+    def key_partition(self, index: str, key: str) -> int:
+        return key_to_key_partition(index, key, self.partition_n)
+
+    def partition_nodes(self, partition: int) -> list[Node]:
+        """Primary + replicas for a partition (PartitionToNodes)."""
+        if not self.nodes:
+            return []
+        primary = jump_hash(partition, len(self.nodes))
+        return [self.nodes[(primary + i) % len(self.nodes)]
+                for i in range(self.replica_n)]
+
+    def shard_nodes(self, index: str, shard: int) -> list[Node]:
+        """Nodes owning a shard, primary first (ShardNodes)."""
+        return self.partition_nodes(self.shard_partition(index, shard))
+
+    def key_nodes(self, index: str, key: str) -> list[Node]:
+        return self.partition_nodes(self.key_partition(index, key))
+
+    def primary(self) -> Node | None:
+        for n in self.nodes:
+            if n.is_primary:
+                return n
+        return self.nodes[0] if self.nodes else None
+
+    def shards_by_node(self, index: str, shards) -> dict[str, list[int]]:
+        """Group shards by PRIMARY owner (executor.go:6416
+        shardsByNode) — the fan-out plan for one query."""
+        out: dict[str, list[int]] = {}
+        for s in shards:
+            owners = self.shard_nodes(index, s)
+            live = [n for n in owners if n.state == NodeState.STARTED]
+            owner = (live or owners)[0]
+            out.setdefault(owner.id, []).append(s)
+        return out
+
+    def node(self, node_id: str) -> Node | None:
+        for n in self.nodes:
+            if n.id == node_id:
+                return n
+        return None

@@ -64,10 +64,17 @@ class Pipeline:
                    for i in range(self.concurrency)]
         for t in threads:
             t.start()
-        n_in = 0
         for rec in self.source:
-            q.put(rec)
-            n_in += 1
+            # bounded put that still notices dead workers: if every
+            # worker died on an error the queue never drains and a
+            # plain put() would block forever
+            while True:
+                try:
+                    q.put(rec, timeout=0.5)
+                    break
+                except queue.Full:
+                    if errs:
+                        raise errs[0]
         for _ in threads:
             q.put(None)
         for t in threads:
@@ -75,7 +82,6 @@ class Pipeline:
         if errs:
             raise errs[0]
         self.records_ingested = sum(counts)
-        assert self.records_ingested == n_in
         return self.records_ingested
 
     def _run_worker(self, records) -> int:

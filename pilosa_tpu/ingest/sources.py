@@ -35,15 +35,20 @@ _CSV_TYPES = {
 
 def _parse_header(cols: list[str]):
     """``name:type`` header cells (default string→set field).  The
-    ``_id`` / ``_id:key`` cell names the record id column."""
+    ``_id`` / ``_id:string`` cell names the record id column; an
+    optional ``_ts`` cell carries the record timestamp feeding any
+    ``time``-typed fields' quantum views."""
     schema = {}
     id_col, id_keys = None, False
     fields = []
     for c in cols:
         name, _, typ = c.partition(":")
         typ = typ or ("id" if name == "_id" else "string")
-        if typ not in _CSV_TYPES and name != "_id":
+        if typ not in _CSV_TYPES and name not in ("_id", "_ts"):
             raise ValueError(f"unknown csv type {typ!r} in column {c!r}")
+        if name == "_ts":
+            fields.append(("_ts", None))
+            continue
         if name == "_id":
             id_col = name
             id_keys = typ in ("string", "key")
@@ -69,7 +74,9 @@ def _parse_header(cols: list[str]):
 def _convert(typ: str, raw: str):
     if raw == "":
         return None
-    if typ in ("id", "idset"):
+    if typ in ("id", "time"):
+        # a time-typed cell is a row id; its timestamp comes from the
+        # record's _ts column
         return int(raw)
     if typ == "int":
         return int(raw)
@@ -77,8 +84,6 @@ def _convert(typ: str, raw: str):
         return float(raw)
     if typ == "bool":
         return raw.lower() in ("1", "true", "t", "yes")
-    if typ in ("idset", "stringset")  :
-        return raw.split(";")
     return raw
 
 
@@ -101,10 +106,14 @@ class CSVSource(Source):
             if not cells:
                 continue
             rec_id = None
+            rec_ts = None
             values = {}
             for (name, typ), raw in zip(self._fields, cells):
                 if name == "_id":
                     rec_id = raw if self.id_keys else int(raw)
+                    continue
+                if name == "_ts":
+                    rec_ts = raw or None
                     continue
                 if typ in ("idset", "stringset") and raw:
                     values[name] = [ _convert("id" if typ == "idset"
@@ -114,7 +123,7 @@ class CSVSource(Source):
                     v = _convert(typ, raw)
                     if v is not None:
                         values[name] = v
-            yield Record(id=rec_id, values=values)
+            yield Record(id=rec_id, values=values, time=rec_ts)
         if self._fh:
             self._fh.close()
 

@@ -127,7 +127,8 @@ def parse_time(v) -> dt.datetime:
         return dt.datetime.fromtimestamp(int(v), tz=dt.timezone.utc).replace(
             tzinfo=None)
     s = str(v)
-    for fmt in (TIME_FORMAT, "%Y-%m-%dT%H", "%Y-%m-%d", "%Y-%m", "%Y"):
+    for fmt in (TIME_FORMAT, "%Y-%m-%dT%H:%M:%S", "%Y-%m-%dT%H",
+                "%Y-%m-%d", "%Y-%m", "%Y"):
         try:
             return dt.datetime.strptime(s, fmt)
         except ValueError:

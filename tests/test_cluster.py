@@ -1,0 +1,197 @@
+"""Cluster tests — jump hash, snapshot placement, in-process
+multi-node harness (test.Cluster analog, test/cluster.go:31),
+replication + failover, transactions."""
+
+import time
+
+import pytest
+
+from pilosa_tpu.cluster import (
+    ClusterNode,
+    ClusterSnapshot,
+    InMemDisCo,
+    Node,
+    NodeState,
+    TransactionManager,
+    jump_hash,
+)
+from pilosa_tpu.cluster.txn import TransactionError
+from pilosa_tpu.models.holder import Holder
+
+SHARD = 1 << 20
+
+
+def test_jump_hash_properties():
+    # deterministic, in-range, balanced-ish
+    for n in (1, 2, 3, 7, 16):
+        for k in range(100):
+            b = jump_hash(k, n)
+            assert 0 <= b < n
+            assert b == jump_hash(k, n)
+    # monotone growth: moving 16 -> 17 buckets only moves keys to 17
+    moved = [k for k in range(1000)
+             if jump_hash(k, 16) != jump_hash(k, 17)]
+    assert all(jump_hash(k, 17) == 16 for k in moved)
+    assert len(moved) < 120  # ~1/17 of keys
+
+
+def _nodes(n):
+    return [Node(id=f"node{i}", uri=f"127.0.0.1:{9000+i}",
+                 state=NodeState.STARTED) for i in range(n)]
+
+
+def test_snapshot_placement_stable():
+    snap = ClusterSnapshot(_nodes(3), replica_n=2)
+    owners = snap.shard_nodes("i", 0)
+    assert len(owners) == 2 and owners[0].id != owners[1].id
+    # placement is a pure function
+    snap2 = ClusterSnapshot(_nodes(3), replica_n=2)
+    assert [n.id for n in snap2.shard_nodes("i", 0)] == \
+        [n.id for n in owners]
+    # every shard owned; distribution across nodes reasonably spread
+    counts = {}
+    for s in range(100):
+        nid = snap.shard_nodes("i", s)[0].id
+        counts[nid] = counts.get(nid, 0) + 1
+    assert len(counts) == 3
+
+
+def test_shards_by_node_covers_all():
+    snap = ClusterSnapshot(_nodes(4), replica_n=1)
+    groups = snap.shards_by_node("i", range(50))
+    got = sorted(s for g in groups.values() for s in g)
+    assert got == list(range(50))
+
+
+@pytest.fixture()
+def cluster():
+    disco = InMemDisCo(lease_ttl=1.0)
+    nodes = [ClusterNode(f"node{i}", disco, holder=Holder(),
+                         replica_n=2, heartbeat_interval=0.2).open()
+             for i in range(3)]
+    yield nodes
+    for n in nodes:
+        try:
+            n.close()
+        except Exception:
+            pass
+
+
+SCHEMA = {"indexes": [{"name": "c", "fields": [
+    {"name": "f", "options": {"type": "set"}},
+    {"name": "v", "options": {"type": "int", "min": 0, "max": 1000}},
+]}]}
+
+
+def test_cluster_basic_query(cluster):
+    n0 = cluster[0]
+    n0.apply_schema(SCHEMA)
+    # bits across 4 shards
+    cols = [1, 5, SHARD + 1, 2 * SHARD + 7, 3 * SHARD + 9]
+    n0.import_bits("c", "f", [1] * len(cols), cols)
+    n0.import_values("c", "v", cols, [10, 20, 30, 40, 50])
+    # query from a DIFFERENT node: fan-out + reduce
+    r = cluster[1].query("c", "Count(Row(f=1))")
+    assert r["results"] == [5]
+    r = cluster[2].query("c", "Row(f=1)")
+    assert r["results"][0]["columns"] == sorted(cols)
+    r = cluster[1].query("c", "Sum(Row(f=1), field=v)")
+    assert r["results"][0] == {"value": 150, "count": 5}
+    r = cluster[1].query("c", "TopN(f)")
+    assert r["results"][0][0]["count"] == 5
+
+
+def test_cluster_replication_failover(cluster):
+    n0 = cluster[0]
+    n0.apply_schema(SCHEMA)
+    cols = list(range(0, 6 * SHARD, SHARD // 2))  # 12 bits over 6 shards
+    n0.import_bits("c", "f", [1] * len(cols), cols)
+    assert cluster[1].query("c", "Count(Row(f=1))")["results"] == [12]
+    # kill one NON-coordinator node; replica_n=2 → every shard still
+    # has a live copy; query must succeed via failover
+    victim = cluster[2]
+    victim.pause()
+    r = cluster[1].query("c", "Count(Row(f=1))")
+    assert r["results"] == [12]
+    # the failed node got marked DOWN
+    states = {n.id: n.state for n in cluster[1].disco.nodes()}
+    assert states["node2"] == NodeState.DOWN
+
+
+def test_heartbeat_failure_detection():
+    disco = InMemDisCo(lease_ttl=0.3)
+    a = ClusterNode("a", disco, holder=Holder(),
+                    heartbeat_interval=0.1).open()
+    b = ClusterNode("b", disco, holder=Holder(),
+                    heartbeat_interval=0.1).open()
+    assert all(n.state == NodeState.STARTED for n in disco.nodes())
+    b._hb_stop.set()  # stop b's heartbeats only
+    time.sleep(0.8)
+    disco.check_heartbeats()
+    states = {n.id: n.state for n in disco.nodes()}
+    assert states["b"] == NodeState.DOWN
+    assert states["a"] == NodeState.STARTED
+    # leader moved off a downed primary if needed
+    assert disco.is_leader("a")
+    a.close()
+    b.close()
+
+
+def test_primary_election():
+    disco = InMemDisCo()
+    disco.start(Node(id="n2"))
+    disco.start(Node(id="n1"))
+    assert disco.is_leader("n1")
+    disco.close("n1")
+    assert disco.is_leader("n2")
+
+
+def test_transactions_exclusive():
+    tm = TransactionManager()
+    t1 = tm.start()
+    assert t1.active
+    # exclusive queues behind t1
+    tex = tm.start(exclusive=True)
+    assert not tex.active
+    # no new txs while exclusive pending
+    with pytest.raises(TransactionError):
+        tm.start()
+    tm.finish(t1.id)
+    assert tm.get(tex.id).active
+    tm.finish(tex.id)
+    # idle manager: exclusive starts active
+    t = tm.start(exclusive=True)
+    assert t.active
+
+
+def test_transaction_expiry():
+    tm = TransactionManager()
+    t = tm.start(timeout=0.05)
+    time.sleep(0.1)
+    with pytest.raises(TransactionError):
+        tm.get(t.id)
+
+
+def test_cluster_topn_limit(cluster):
+    n0 = cluster[0]
+    n0.apply_schema(SCHEMA)
+    # rows with distinct counts spread over shards
+    cols, rows = [], []
+    for row, n in ((1, 9), (2, 6), (3, 3), (4, 1)):
+        for i in range(n):
+            rows.append(row)
+            cols.append(i * SHARD + row)  # spread over shards
+    n0.import_bits("c", "f", rows, cols)
+    r = cluster[1].query("c", "TopN(f, n=2)")
+    pairs = r["results"][0]
+    assert len(pairs) == 2
+    assert pairs[0]["id"] == 1 and pairs[0]["count"] == 9
+    assert pairs[1]["id"] == 2 and pairs[1]["count"] == 6
+
+
+def test_two_exclusives_rejected():
+    tm = TransactionManager()
+    t1 = tm.start()
+    tm.start(exclusive=True)
+    with pytest.raises(TransactionError):
+        tm.start(exclusive=True)

@@ -127,3 +127,32 @@ def test_pipeline_small_batches_flush_all(api):
 def test_kafka_gated():
     with pytest.raises(NotImplementedError):
         KafkaSource("broker:9092")
+
+
+def test_csv_time_field_with_ts(api):
+    csv = io.StringIO(
+        "_id,ev:time,_ts\n"
+        "1,7,2020-03-15T10:00:00\n"
+        "2,7,2021-06-01T00:00:00\n")
+    src = CSVSource(csv)
+    assert src.schema["ev"]["type"] == "time"
+    p = Pipeline(src, APIImporter(api), "tv")
+    assert p.run() == 2
+    [r] = api.query("tv", "Count(Row(ev=7))")["results"]
+    assert r == 2
+    [r] = api.query(
+        "tv", "Count(Row(ev=7, from='2020-01-01T00:00', to='2020-12-31T00:00'))"
+    )["results"]
+    assert r == 1
+
+
+def test_pipeline_worker_error_raises_not_hangs(api):
+    class BadSource(DatagenSource):
+        def __iter__(self):
+            for i in range(10000):
+                yield Record(id="not-an-int", values={"segment": 1})
+    src = BadSource(1)
+    src.id_keys = False  # force int(id) failure in every batch
+    p = Pipeline(src, APIImporter(api), "bad", batch_size=5, concurrency=3)
+    with pytest.raises((ValueError, TypeError)):
+        p.run()
