@@ -80,7 +80,7 @@ class API:
     # ------------------------------------------------------------------
 
     def query(self, index: str, pql: str, shards: list[int] | None = None,
-              profile: bool = False) -> dict:
+              profile: bool = False, remote: bool = False) -> dict:
         """PQL query (api.go:209 API.Query).  Returns the full
         QueryResponse dict: {"results": [...]} (+"profile" spans when
         requested, tracing/tracing.go:22-50 behavior)."""
@@ -92,7 +92,8 @@ class API:
             prev = _tr.push_thread_tracer(tracer)
         try:
             try:
-                results = self.executor.execute(index, pql, shards)
+                results = self.executor.execute(index, pql, shards,
+                                                remote=remote)
             except (ExecError, ParseError, ValueError, KeyError) as e:
                 raise ApiError(str(e), 400)
         finally:

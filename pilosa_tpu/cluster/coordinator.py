@@ -16,6 +16,7 @@ format), mirroring how the reference reduces decoded protobuf rows.
 
 from __future__ import annotations
 
+import http.client
 import threading
 import time
 
@@ -24,6 +25,15 @@ from pilosa_tpu.cluster.disco import DisCo, InMemDisCo, Node, NodeState
 from pilosa_tpu.cluster.snapshot import ClusterSnapshot
 from pilosa_tpu.cluster.txn import TransactionManager
 from pilosa_tpu.pql import parse
+
+# network failures that trigger replica failover (executor.go:6505
+# matches on connection errors; IncompleteRead etc. are
+# http.client.HTTPException, not OSError)
+_NET_ERRORS = (ConnectionError, OSError, TimeoutError,
+               http.client.HTTPException)
+
+# pql.Call.IsWrite analog (mirrors executor._WRITE_CALLS)
+_WRITE_CALLS = {"Set", "Clear", "Store", "ClearRow", "Delete"}
 
 
 class ClusterError(Exception):
@@ -70,9 +80,13 @@ class ClusterNode:
 
     def pause(self):
         """Stop heartbeating AND serving (fault injection — the pumba
-        container-pause analog, internal/clustertests)."""
+        container-pause analog, internal/clustertests).  server_close
+        releases the listening socket so clients get an immediate
+        connection-refused instead of hanging in the accept backlog
+        until their timeout."""
         self._hb_stop.set()
         self.server.httpd.shutdown()
+        self.server.httpd.server_close()
 
     def close(self):
         self._hb_stop.set()
@@ -169,12 +183,17 @@ class ClusterExecutor:
         self.node = node
 
     def execute(self, index: str, pql: str) -> dict:
+        q = parse(pql)
+        if any(c.name in _WRITE_CALLS for c in q.calls):
+            # writes route per-call by placement (api.go:651-672);
+            # mixed queries evaluate call-by-call in order
+            return {"results": [self._execute_call(index, c)
+                                for c in q.calls]}
         snap = self.node.snapshot()
         shards = sorted(self.node.disco.shards(index, ""))
         if not shards:
             # no data imported through the cluster path: run locally
             return self.node.api.query(index, pql)
-        q = parse(pql)
         partials = self._fan_out(snap, index, pql, shards)
         # reduce call-by-call across nodes (streaming reduceFn analog)
         results = []
@@ -182,6 +201,85 @@ class ClusterExecutor:
             vals = [p[ci] for p in partials]
             results.append(_reduce(q.calls[ci], vals))
         return {"results": results}
+
+    def _execute_call(self, index: str, call) -> object:
+        """Execute ONE call with placement-aware routing."""
+        if call.name not in _WRITE_CALLS:
+            snap = self.node.snapshot()
+            shards = sorted(self.node.disco.shards(index, ""))
+            if not shards:
+                return self.node.api.query(index, call.to_pql())["results"][0]
+            partials = self._fan_out(snap, index, call.to_pql(), shards)
+            return _reduce(call, [p[0] for p in partials])
+        if call.name in ("Set", "Clear"):
+            return self._execute_col_write(index, call)
+        # Store/ClearRow/Delete touch every shard of the index: run on
+        # every live node against its local shards, reduce with any().
+        # Same failover contract as _execute_col_write: a node dying
+        # mid-write is marked DOWN and skipped; its shards' replicas
+        # on surviving nodes still apply the write.
+        snap = self.node.snapshot()
+        vals = []
+        last_err = None
+        for n in snap.nodes:
+            if n.state != NodeState.STARTED:
+                continue
+            try:
+                vals.append(self._run_on(snap, n.id, index, call.to_pql()))
+            except _NET_ERRORS as e:
+                last_err = e
+                self.node.disco.set_state(n.id, NodeState.DOWN)
+        if not vals:
+            raise ClusterError(
+                f"no live node accepted {call.name}: {last_err}")
+        return _reduce(call, vals)
+
+    def _execute_col_write(self, index: str, call) -> object:
+        """Set/Clear: route to the column's shard owner + replicas and
+        register the shard (the write half of executor.mapReduce +
+        api.ImportRoaringShard's replica forwarding)."""
+        col = call.arg("_col")
+        if isinstance(col, str):
+            # String column keys: translate on the coordinator's store
+            # first, then route the call BY ID so placement/replication
+            # see the same column everywhere.  (The reference routes
+            # translation to partition owners, translate.go:103; here
+            # the coordinator's store is authoritative and the id is
+            # what ships over the wire.)
+            idx = self.node.api.holder.index(index)
+            if idx is None or idx.column_translator is None:
+                return self.node.api.query(index, call.to_pql())["results"][0]
+            col = idx.column_translator.create_keys(col)[col]
+            call = type(call)(name=call.name,
+                              args={**call.args, "_col": int(col)},
+                              children=call.children)
+        shard = int(col) // self.node.api.holder.width
+        snap = self.node.snapshot()
+        vals = []
+        last_err = None
+        for n in snap.shard_nodes(index, shard):
+            try:
+                vals.append(self._run_on(snap, n.id, index, call.to_pql()))
+            except _NET_ERRORS as e:
+                # a dead replica doesn't fail the write as long as one
+                # owner acks it (reads will fail over the same way)
+                last_err = e
+                self.node.disco.set_state(n.id, NodeState.DOWN)
+        if not vals:
+            raise ClusterError(
+                f"no live replica accepted write for shard {shard}: "
+                f"{last_err}")
+        self.node.disco.add_shards(index, "", {shard})
+        return _reduce(call, vals)
+
+    def _run_on(self, snap, node_id: str, index: str, pql: str):
+        # remote=True everywhere: routed calls carry pre-translated ids
+        if node_id == self.node.node_id:
+            return self.node.api.query(index, pql,
+                                       remote=True)["results"][0]
+        node = snap.node(node_id)
+        return self.node._client().query_node(
+            node.uri, index, pql, None)["results"][0]
 
     def _fan_out(self, snap, index, pql, shards,
                  attempts: int = 3) -> list[list]:
@@ -203,7 +301,7 @@ class ClusterExecutor:
                     resp = self.node._client().query_node(
                         node.uri, index, pql, node_shards)
                 partials.append(resp["results"])
-            except (ConnectionError, OSError, TimeoutError) as e:
+            except _NET_ERRORS as e:
                 last_err = e
                 self.node.disco.set_state(node_id, NodeState.DOWN)
                 failed_shards.extend(node_shards)
@@ -241,9 +339,9 @@ def _reduce(call, vals: list):
     if len(vals) == 1:
         return vals[0]
     first = vals[0]
-    if call_name in ("Count", "Store"):
+    if call_name == "Count":
         return sum(vals)
-    if call_name in ("Set", "Clear", "ClearRow"):
+    if call_name in ("Set", "Clear", "ClearRow", "Store", "Delete"):
         return any(vals)
     if call_name == "Sum":
         return {"value": sum(v["value"] or 0 for v in vals),

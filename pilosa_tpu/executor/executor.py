@@ -18,6 +18,7 @@ Single-host v0: shards iterate in a Python loop; the mesh executor
 
 from __future__ import annotations
 
+import contextvars
 import datetime as dt
 import time
 from decimal import Decimal
@@ -55,6 +56,10 @@ class ExecError(Exception):
 # Calls that write (pql.Call.IsWrite analog).
 _WRITE_CALLS = {"Set", "Clear", "Store", "ClearRow", "Delete"}
 
+# True while serving a node-to-node (Remote=true) request whose ids
+# were already translated by the coordinator (executor.go opt.Remote)
+_REMOTE = contextvars.ContextVar("pilosa_tpu_remote", default=False)
+
 
 from pilosa_tpu.executor.advanced import AdvancedOps
 
@@ -68,7 +73,19 @@ class Executor(AdvancedOps):
     # ------------------------------------------------------------------
 
     def execute(self, index_name: str, query: str | Query,
-                shards: list[int] | None = None) -> list:
+                shards: list[int] | None = None,
+                remote: bool = False) -> list:
+        """remote=True marks a node-to-node call shipping
+        pre-translated ids (executor.go opt.Remote): keyed indexes then
+        accept raw column ids instead of rejecting them."""
+        tok = _REMOTE.set(remote)
+        try:
+            return self._execute(index_name, query, shards)
+        finally:
+            _REMOTE.reset(tok)
+
+    def _execute(self, index_name: str, query: str | Query,
+                 shards: list[int] | None = None) -> list:
         t0 = time.perf_counter()
         status = "error"
         idx = self.holder.index(index_name)
@@ -722,7 +739,7 @@ class Executor(AdvancedOps):
             if create:
                 return tr.create_keys(col)[col]
             return tr.find_keys(col).get(col)
-        if idx.keys:
+        if idx.keys and not _REMOTE.get():
             raise ExecError(
                 f"index {idx.name} uses column keys; got id {col!r}")
         return int(col)

@@ -75,8 +75,16 @@ class Pipeline:
                 except queue.Full:
                     if errs:
                         raise errs[0]
+        # sentinel puts need the same dead-worker guard as record puts:
+        # if all workers died with the queue full, no one drains it
         for _ in threads:
-            q.put(None)
+            while True:
+                try:
+                    q.put(None, timeout=0.5)
+                    break
+                except queue.Full:
+                    if errs:
+                        raise errs[0]
         for t in threads:
             t.join()
         if errs:

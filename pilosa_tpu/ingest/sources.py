@@ -43,8 +43,12 @@ def _parse_header(cols: list[str]):
     fields = []
     for c in cols:
         name, _, typ = c.partition(":")
-        typ = typ or ("id" if name == "_id" else "string")
-        if typ not in _CSV_TYPES and name not in ("_id", "_ts"):
+        typ = typ or {"_id": "id", "_ts": "timestamp"}.get(name, "string")
+        # 'key' (valid only on _id) is the one annotation outside
+        # _CSV_TYPES; _ts must be a timestamp
+        if (typ not in _CSV_TYPES and
+                not (name == "_id" and typ == "key")) or (
+                name == "_ts" and typ != "timestamp"):
             raise ValueError(f"unknown csv type {typ!r} in column {c!r}")
         if name == "_ts":
             fields.append(("_ts", None))

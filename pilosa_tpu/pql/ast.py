@@ -66,6 +66,26 @@ class Call:
         parts += [f"{k}={v!r}" for k, v in self.args.items()]
         return f"{self.name}({', '.join(parts)})"
 
+    def to_pql(self) -> str:
+        """Serialize back to parseable PQL text (pql.Call.String
+        analog) — used by the cluster layer to ship single calls to
+        shard owners."""
+        parts = [c.to_pql() for c in self.children]
+        if "_col" in self.args:
+            parts.append(_pql_value(self.args["_col"]))
+        if "_field" in self.args:
+            parts.append(str(self.args["_field"]))
+        for k, v in self.args.items():
+            if k in ("_col", "_field", "_timestamp"):
+                continue
+            if isinstance(v, Condition):
+                parts.append(_pql_condition(k, v))
+            else:
+                parts.append(f"{k}={_pql_value(v)}")
+        if "_timestamp" in self.args:
+            parts.append(str(self.args["_timestamp"]))
+        return f"{self.name}({', '.join(parts)})"
+
 
 @dataclass
 class Query:
@@ -73,6 +93,27 @@ class Query:
 
     def __repr__(self):
         return "".join(repr(c) for c in self.calls)
+
+
+def _pql_value(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, str):
+        return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
+    if isinstance(v, (list, tuple)):
+        return "[" + ",".join(_pql_value(x) for x in v) + "]"
+    return str(v)
+
+
+def _pql_condition(field_name: str, cond: Condition) -> str:
+    if cond.op in BETWEEN_OPS:
+        lo, hi = cond.value
+        if cond.op in (OP_BETW, OP_BTWN_LTE_LTE):
+            return f"{field_name} >< [{_pql_value(lo)},{_pql_value(hi)}]"
+        left, right = cond.op.split("x")
+        return (f"{_pql_value(lo)} {left} {field_name} {right} "
+                f"{_pql_value(hi)}")
+    return f"{field_name} {cond.op} {_pql_value(cond.value)}"
 
 
 def is_between(cond: Condition) -> bool:

@@ -146,14 +146,17 @@ class Server:
 
     def _post_query(self, req):
         body = req.json_lenient()
+        remote = False
         if body is not None:
             pql = body.get("query", "")
             shards = body.get("shards")
+            remote = bool(body.get("remote"))
         else:  # raw PQL body, like the reference's text/plain mode
             pql = req.text()
             shards = None
         profile = req.query.get("profile", ["false"])[0] == "true"
-        return self.api.query(req.vars["index"], pql, shards, profile)
+        return self.api.query(req.vars["index"], pql, shards, profile,
+                              remote=remote)
 
     def _post_sql(self, req):
         body = req.json_lenient()

@@ -195,3 +195,50 @@ def test_two_exclusives_rejected():
     tm.start(exclusive=True)
     with pytest.raises(TransactionError):
         tm.start(exclusive=True)
+
+
+def test_cluster_routed_write(cluster):
+    """Set/Clear route by placement + replicate; every node then
+    agrees on the answer (the write is not node-local)."""
+    n0 = cluster[0]
+    n0.apply_schema(SCHEMA)
+    col = 5 * SHARD + 123
+    r = cluster[1].query("c", f"Set({col}, f=1)")
+    assert r["results"] == [True]
+    # shard got registered so reads fan out
+    assert (5 in cluster[0].disco.shards("c", ""))
+    for n in cluster:
+        assert n.query("c", "Count(Row(f=1))")["results"] == [1]
+    # the bit lives on BOTH replicas: pause one owner, count survives
+    snap = cluster[0].snapshot()
+    owners = [n.id for n in snap.shard_nodes("c", 5)]
+    assert len(set(owners)) == 2
+    victim = next(n for n in cluster if n.node_id == owners[0])
+    alive = next(n for n in cluster if n.node_id not in owners) \
+        if len(owners) < 3 else cluster[0]
+    victim.pause()
+    assert alive.query("c", "Count(Row(f=1))")["results"] == [1]
+    # clear through yet another node
+    r = alive.query("c", f"Clear({col}, f=1)")
+    assert r["results"] == [True]
+    assert alive.query("c", "Count(Row(f=1))")["results"] == [0]
+
+
+def test_cluster_mixed_write_read_query(cluster):
+    n0 = cluster[0]
+    n0.apply_schema(SCHEMA)
+    r = n0.query("c", f"Set(1, f=2)Set({SHARD+2}, f=2)Count(Row(f=2))")
+    assert r["results"] == [True, True, 2]
+
+
+def test_cluster_keyed_column_write(cluster):
+    """Set with a string column key translates on the coordinator and
+    routes the resulting id to shard owners + replicas."""
+    schema = {"indexes": [{"name": "k", "keys": True, "fields": [
+        {"name": "f", "options": {"type": "set"}}]}]}
+    cluster[0].apply_schema(schema)
+    r = cluster[1].query("k", 'Set("abc", f=1)')
+    assert r["results"] == [True]
+    # visible from every node (shard registered, write replicated)
+    for n in cluster:
+        assert n.query("k", "Count(Row(f=1))")["results"] == [1]
