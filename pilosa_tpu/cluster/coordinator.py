@@ -240,16 +240,13 @@ class ClusterExecutor:
         api.ImportRoaringShard's replica forwarding)."""
         col = call.arg("_col")
         if isinstance(col, str):
-            # String column keys: translate on the coordinator's store
-            # first, then route the call BY ID so placement/replication
-            # see the same column everywhere.  (The reference routes
-            # translation to partition owners, translate.go:103; here
-            # the coordinator's store is authoritative and the id is
-            # what ships over the wire.)
-            idx = self.node.api.holder.index(index)
-            if idx is None or idx.column_translator is None:
+            # String column keys translate on the key-partition OWNER
+            # (translate.go:103 partitioned stores): every node routes
+            # the same key to the same store, so key->id assignment is
+            # consistent cluster-wide; the call then ships BY ID.
+            col = self._translate_col_key(index, col)
+            if col is None:
                 return self.node.api.query(index, call.to_pql())["results"][0]
-            col = idx.column_translator.create_keys(col)[col]
             call = type(call)(name=call.name,
                               args={**call.args, "_col": int(col)},
                               children=call.children)
@@ -271,6 +268,23 @@ class ClusterExecutor:
                 f"{last_err}")
         self.node.disco.add_shards(index, "", {shard})
         return _reduce(call, vals)
+
+    def _translate_col_key(self, index: str, key: str):
+        """Create the key on its partition owner's store; returns the
+        id, or None when the index has no column-key translation."""
+        idx = self.node.api.holder.index(index)
+        if idx is None or idx.column_translator is None:
+            return None
+        snap = self.node.snapshot()
+        owners = snap.key_nodes(index, key)
+        owner = next((n for n in owners
+                      if n.state == NodeState.STARTED),
+                     owners[0] if owners else None)
+        if owner is None or owner.id == self.node.node_id:
+            return idx.column_translator.create_keys(key)[key]
+        # /internal/translate returns ids aligned with the keys list
+        got = self.node._client().create_keys(owner.uri, index, None, [key])
+        return got[0]
 
     def _run_on(self, snap, node_id: str, index: str, pql: str):
         # remote=True everywhere: routed calls carry pre-translated ids

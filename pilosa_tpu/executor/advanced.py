@@ -40,6 +40,7 @@ from pilosa_tpu.models.schema import FieldType
 from pilosa_tpu.models.view import VIEW_STANDARD
 from pilosa_tpu.ops import bitmap as bm
 from pilosa_tpu.ops import bsi as bsi_ops
+from pilosa_tpu.ops import kernels
 from pilosa_tpu.pql.ast import Call, Condition
 
 _ROW_CHUNK = 256      # row tiles per device batch in count scans
@@ -99,12 +100,22 @@ class AdvancedOps:
         n = call.arg(n_key)
         ids = call.arg("ids")
         views = self._field_views(f, call.arg("from"), call.arg("to"))
+        filter_call = call.children[0] if call.children else None
+        if (ids is None and filter_call is None
+                and views == [VIEW_STANDARD]
+                and call.name == "TopN"):
+            # unfiltered TopN reads counts straight off the per-
+            # fragment rank caches — the reference's fragment.top
+            # cache path (fragment.go:1317, cache.go) — falling back
+            # to the exact scan when any fragment has no cache
+            pairs = self._topn_from_caches(idx, f, shards)
+            if pairs is not None:
+                return self._finish_topn(f, pairs, n, ids)
         row_ids = ([int(r) for r in ids] if ids is not None
                    else self._all_row_ids(idx, f, shards))
         if not row_ids:
             return []
         counts = {r: 0 for r in row_ids}
-        filter_call = call.children[0] if call.children else None
         for shard in self._shard_list(idx, shards):
             filt = (self._bitmap_call_shard(idx, filter_call, shard, pre)
                     if filter_call else None)
@@ -112,12 +123,42 @@ class AdvancedOps:
                 chunk = row_ids[i:i + _ROW_CHUNK]
                 tiles = self._row_tiles(f, shard, chunk, views)
                 if filt is not None:
+                    if kernels.enabled():
+                        # one fused AND+popcount pass (Pallas) — the
+                        # TopK candidate hot loop (executor.go:2750)
+                        got = np.asarray(
+                            kernels.masked_popcount(tiles, filt),
+                            dtype=np.int64)
+                        for r, c in zip(chunk, got):
+                            counts[r] += int(c)
+                        continue
                     tiles = bm.intersect(tiles, filt[None, :])
                 got = np.asarray(bm.count(tiles), dtype=np.int64)
                 for r, c in zip(chunk, got):
                     counts[r] += int(c)
         pairs = [Pair(id=r, count=c) for r, c in counts.items()
                  if c > 0 or ids is not None]
+        return self._finish_topn(f, pairs, n, ids)
+
+    def _topn_from_caches(self, idx, f, shards) -> list | None:
+        """Merge per-fragment cache counts; None => no cache, use the
+        exact scan."""
+        v = f.views.get(VIEW_STANDARD)
+        if v is None:
+            return []
+        counts: dict[int, int] = {}
+        for shard in self._shard_list(idx, shards):
+            frag = v.fragment(shard)
+            if frag is None:
+                continue
+            cache = frag.row_cache()
+            if cache is None:
+                return None
+            for r, c in cache.top():
+                counts[r] = counts.get(r, 0) + c
+        return [Pair(id=r, count=c) for r, c in counts.items() if c > 0]
+
+    def _finish_topn(self, f, pairs, n, ids):
         pairs.sort(key=lambda p: (-p.count, p.id))
         if n is not None:
             pairs = pairs[: int(n)]

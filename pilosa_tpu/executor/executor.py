@@ -44,6 +44,7 @@ from pilosa_tpu.models.schema import FieldType
 from pilosa_tpu.models.view import VIEW_STANDARD
 from pilosa_tpu.ops import bitmap as bm
 from pilosa_tpu.ops import bsi as bsi_ops
+from pilosa_tpu.ops import kernels
 from pilosa_tpu.pql import ast as past
 from pilosa_tpu.pql import parse
 from pilosa_tpu.pql.ast import Call, Condition, Query
@@ -468,7 +469,12 @@ class Executor(AdvancedOps):
                 continue
             planes = frag.device_planes(f.bit_depth)
             filt = self._filter_words(idx, call, shard, pre)
-            s, c = bsi_ops.host_sum(*bsi_ops.sum_counts(planes, filt))
+            if kernels.enabled():
+                # single fused pass over the plane stack (Pallas)
+                parts = kernels.bsi_sum_counts(planes, filt)
+            else:
+                parts = bsi_ops.sum_counts(planes, filt)
+            s, c = bsi_ops.host_sum(*parts)
             total += s
             count += c
         return ValCount(value=f.int_to_value(total), count=count)

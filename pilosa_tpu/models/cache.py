@@ -1,0 +1,123 @@
+"""Per-fragment row-rank caches for TopN (cache.go).
+
+The reference keeps, per (set-field, view, shard) fragment, a cache of
+row-id -> bit-count used by TopN to avoid scanning every row
+(cache.go:25 lruCache, cache.go:48 rankCache; fragment.openCache
+fragment.go:201).  Cache types per field: ``ranked`` (default,
+field.go:31), ``lru``, ``none`` (field.go:2486-2488).
+
+TPU re-design notes: counts are maintained incrementally on the host
+write path (a popcount over the packed row the mutation just touched)
+and consumed by the executor's TopN candidate selection — the device
+never sees the cache.  Instead of the reference's persisted ``.cache``
+files, caches rebuild lazily from the loaded rows on first use after a
+cold open (the reference does the same recalculation whenever its
+cache file is missing).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+CACHE_TYPE_RANKED = "ranked"
+CACHE_TYPE_LRU = "lru"
+CACHE_TYPE_NONE = "none"
+DEFAULT_CACHE_SIZE = 50000
+
+# rankCache keeps up to thresholdFactor * cache_size entries before
+# pruning back down (cache.go thresholdFactor = 1.1)
+_THRESHOLD_FACTOR = 1.1
+
+
+class RankCache:
+    """Sorted threshold cache (cache.go:130 rankCache).
+
+    Holds up to ~cache_size row counts; once full, rows whose count is
+    below the current floor are not admitted — TopN over a ranked
+    cache is exact for the top `cache_size` rows and silently drops
+    the long tail, matching reference behavior.
+    """
+
+    def __init__(self, max_entries: int = DEFAULT_CACHE_SIZE):
+        self.max_entries = max_entries
+        self._counts: dict[int, int] = {}
+        self._threshold = 0  # admission floor once at capacity
+
+    def add(self, row_id: int, count: int) -> None:
+        count = int(count)
+        if count == 0:
+            self._counts.pop(int(row_id), None)
+            return
+        if (len(self._counts) >= self.max_entries
+                and count < self._threshold
+                and int(row_id) not in self._counts):
+            return
+        self._counts[int(row_id)] = count
+        if len(self._counts) > self.max_entries * _THRESHOLD_FACTOR:
+            self._prune()
+
+    bulk_add = add
+
+    def _prune(self) -> None:
+        keep = sorted(self._counts.items(),
+                      key=lambda kv: (-kv[1], kv[0]))[: self.max_entries]
+        self._counts = dict(keep)
+        self._threshold = keep[-1][1] if keep else 0
+
+    def top(self) -> list[tuple[int, int]]:
+        """(row_id, count) pairs, highest count first (ties by id)."""
+        return sorted(self._counts.items(), key=lambda kv: (-kv[1], kv[0]))
+
+    def ids(self) -> list[int]:
+        return sorted(self._counts)
+
+    def count(self, row_id: int) -> int:
+        return self._counts.get(int(row_id), 0)
+
+    def __len__(self) -> int:
+        return len(self._counts)
+
+
+class LRUCache:
+    """LRU row cache (cache.go:25 lruCache): recency-evicting, so Top
+    reflects recently touched rows only."""
+
+    def __init__(self, max_entries: int = DEFAULT_CACHE_SIZE):
+        self.max_entries = max_entries
+        self._counts: OrderedDict[int, int] = OrderedDict()
+
+    def add(self, row_id: int, count: int) -> None:
+        row_id, count = int(row_id), int(count)
+        if count == 0:
+            self._counts.pop(row_id, None)
+            return
+        self._counts[row_id] = count
+        self._counts.move_to_end(row_id)
+        while len(self._counts) > self.max_entries:
+            self._counts.popitem(last=False)
+
+    bulk_add = add
+
+    def top(self) -> list[tuple[int, int]]:
+        return sorted(self._counts.items(), key=lambda kv: (-kv[1], kv[0]))
+
+    def ids(self) -> list[int]:
+        return sorted(self._counts)
+
+    def count(self, row_id: int) -> int:
+        return self._counts.get(int(row_id), 0)
+
+    def __len__(self) -> int:
+        return len(self._counts)
+
+
+def make_cache(cache_type: str, size: int = DEFAULT_CACHE_SIZE):
+    """Cache factory (field.go:2486 cacheType switch); None for
+    ``none`` — callers fall back to full row scans."""
+    if cache_type == CACHE_TYPE_RANKED:
+        return RankCache(size)
+    if cache_type == CACHE_TYPE_LRU:
+        return LRUCache(size)
+    if cache_type == CACHE_TYPE_NONE:
+        return None
+    raise ValueError(f"unknown cache type {cache_type!r}")

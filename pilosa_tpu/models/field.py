@@ -18,6 +18,7 @@ import numpy as np
 from pilosa_tpu.models import timeq
 from pilosa_tpu.models.schema import FieldOptions, FieldType
 from pilosa_tpu.models.view import (
+    VIEW_BSI_PREFIX,
     VIEW_STANDARD,
     View,
     bsi_view_name,
@@ -59,8 +60,16 @@ class Field:
         with self._lock:
             v = self.views.get(name)
             if v is None and create:
+                # TopN caches attach to row-oriented views of set-like
+                # fields only: BSI plane views and bool fields carry
+                # none (field.go NewField cache defaults)
+                cache_type = self.options.cache_type
+                if (name.startswith(VIEW_BSI_PREFIX)
+                        or self.options.type == FieldType.BOOL):
+                    cache_type = "none"
                 v = View(self.index_name, self.name, name, self.width,
-                         storage=self.storage)
+                         storage=self.storage, cache_type=cache_type,
+                         cache_size=self.options.cache_size)
                 self.views[name] = v
             return v
 

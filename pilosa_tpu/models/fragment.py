@@ -15,6 +15,7 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
+from pilosa_tpu.models.cache import make_cache
 from pilosa_tpu.ops import bitmap as bm
 from pilosa_tpu.shardwidth import (
     BSI_OFFSET_BIT,
@@ -27,7 +28,8 @@ class Fragment:
     """Host rows + device tile cache for one (index, field, view, shard)."""
 
     def __init__(self, index: str, field: str, view: str, shard: int,
-                 width: int = SHARD_WIDTH, storage=None):
+                 width: int = SHARD_WIDTH, storage=None,
+                 cache_type: str = "none", cache_size: int = 50000):
         self.index_name = index
         self.field_name = field
         self.view_name = view
@@ -39,8 +41,17 @@ class Fragment:
         # rows changed since the last storage sync (persisted by
         # IndexStorage.write_fragments; empty when storage is None)
         self.dirty_rows: set[int] = set()
+        # TopN rank cache (fragment.openCache, fragment.go:201):
+        # counts refresh lazily from _cache_stale on access, so hot
+        # write paths pay only a dict-insert, not a popcount.  An
+        # insertion-ordered dict (not a set) so the deferred refresh
+        # replays rows in write order — LRU recency survives batching.
+        self._cache = make_cache(cache_type, cache_size)
+        self._cache_stale: dict[int, None] = {}
         if storage is not None:
             self._rows = storage.load_rows(field, view, shard, width)
+            if self._cache is not None:
+                self._cache_stale.update(dict.fromkeys(self._rows))
 
     # -- host mutation ------------------------------------------------------
 
@@ -56,6 +67,10 @@ class Fragment:
         self._device.pop(row, None)
         self._planes_cache = None
         self.dirty_rows.add(row)
+        if self._cache is not None:
+            # re-insert at the end: most recent write is refreshed last
+            self._cache_stale.pop(row, None)
+            self._cache_stale[row] = None
 
     def set_bit(self, row: int, col: int) -> bool:
         """Set one bit; returns True if it changed (fragment.setBit)."""
@@ -183,6 +198,17 @@ class Fragment:
     def row_count(self, row: int) -> int:
         w = self._rows.get(row)
         return int(np.bitwise_count(w).sum()) if w is not None else 0
+
+    def row_cache(self):
+        """The TopN rank/LRU cache, refreshed for rows written since
+        the last access (None when the field's cache type is none)."""
+        if self._cache is None:
+            return None
+        if self._cache_stale:
+            for r in self._cache_stale:  # insertion (= write) order
+                self._cache.add(r, self.row_count(r))
+            self._cache_stale = {}
+        return self._cache
 
     # -- device tiles -------------------------------------------------------
 

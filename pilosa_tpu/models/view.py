@@ -20,19 +20,24 @@ def bsi_view_name(field_name: str) -> str:
 
 class View:
     def __init__(self, index: str, field: str, name: str,
-                 width: int = SHARD_WIDTH, storage=None):
+                 width: int = SHARD_WIDTH, storage=None,
+                 cache_type: str = "none", cache_size: int = 50000):
         self.index_name = index
         self.field_name = field
         self.name = name
         self.width = width
         self.storage = storage
+        self.cache_type = cache_type
+        self.cache_size = cache_size
         self.fragments: dict[int, Fragment] = {}
 
     def fragment(self, shard: int, create: bool = False) -> Fragment | None:
         f = self.fragments.get(shard)
         if f is None and create:
             f = Fragment(self.index_name, self.field_name, self.name, shard,
-                         self.width, storage=self.storage)
+                         self.width, storage=self.storage,
+                         cache_type=self.cache_type,
+                         cache_size=self.cache_size)
             self.fragments[shard] = f
         return f
 

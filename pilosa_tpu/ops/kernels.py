@@ -42,6 +42,21 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def enabled() -> bool:
+    """Whether the executor should route hot ops through these kernels.
+
+    On TPU the compiled kernels win; off-TPU the interpreter would be
+    far slower than XLA's fused jnp path, so callers fall back.
+    PILOSA_TPU_PALLAS=1/0 forces it either way (1 exercises the
+    interpret path in tests; 0 is the escape hatch on TPU).
+    """
+    import os
+    v = os.environ.get("PILOSA_TPU_PALLAS")
+    if v in ("0", "1"):
+        return v == "1"
+    return jax.default_backend() == "tpu"
+
+
 def _pc(x):
     return jax.lax.population_count(x).astype(jnp.int32)
 

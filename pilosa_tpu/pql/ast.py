@@ -74,7 +74,9 @@ class Call:
         if "_col" in self.args:
             parts.append(_pql_value(self.args["_col"]))
         if "_field" in self.args:
-            parts.append(str(self.args["_field"]))
+            # named form: a positional field is only recognized at
+            # position 0, which a child call may already occupy
+            parts.append(f"field={self.args['_field']}")
         for k, v in self.args.items():
             if k in ("_col", "_field", "_timestamp"):
                 continue

@@ -1,0 +1,125 @@
+"""TopN rank/LRU cache tests (cache.go behavior)."""
+
+import numpy as np
+
+from pilosa_tpu.models.cache import LRUCache, RankCache, make_cache
+from pilosa_tpu.models.fragment import Fragment
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.models.schema import FieldOptions, FieldType
+
+WIDTH = 1 << 12
+
+
+def test_rank_cache_orders_and_prunes():
+    c = RankCache(max_entries=10)
+    for r in range(30):
+        c.add(r, r + 1)
+    top = c.top()
+    # pruned to max_entries, highest counts kept
+    assert len(c) <= 11  # threshold factor slack
+    assert top[0] == (29, 30)
+    assert all(top[i][1] >= top[i + 1][1] for i in range(len(top) - 1))
+    # below-threshold rows are not admitted once full
+    c.add(100, 1)
+    assert c.count(100) == 0
+    # zero count removes
+    c.add(29, 0)
+    assert c.count(29) == 0
+
+
+def test_lru_cache_evicts_by_recency():
+    c = LRUCache(max_entries=3)
+    for r in (1, 2, 3):
+        c.add(r, 10 * r)
+    c.add(1, 11)  # touch 1 -> 2 is now oldest
+    c.add(4, 40)
+    assert c.count(2) == 0
+    assert {r for r, _ in c.top()} == {1, 3, 4}
+
+
+def test_make_cache_types():
+    assert isinstance(make_cache("ranked"), RankCache)
+    assert isinstance(make_cache("lru"), LRUCache)
+    assert make_cache("none") is None
+    try:
+        make_cache("bogus")
+        assert False
+    except ValueError:
+        pass
+
+
+def test_fragment_cache_tracks_mutations():
+    f = Fragment("i", "f", "standard", 0, width=WIDTH,
+                 cache_type="ranked")
+    for col in range(5):
+        f.set_bit(1, col)
+    f.set_bit(2, 0)
+    cache = f.row_cache()
+    assert cache.top()[0] == (1, 5)
+    assert cache.count(2) == 1
+    f.clear_bit(1, 0)
+    assert f.row_cache().count(1) == 4
+    # clearing a row entirely drops it from the cache
+    f.clear_bit(2, 0)
+    assert f.row_cache().count(2) == 0
+    # bulk import updates too
+    f.import_bits([7] * 3, [1, 2, 3])
+    assert f.row_cache().count(7) == 3
+
+
+def test_fragment_cache_none():
+    f = Fragment("i", "f", "standard", 0, width=WIDTH)
+    f.set_bit(1, 1)
+    assert f.row_cache() is None
+
+
+def test_topn_uses_cache_and_matches_exact(rng):
+    h = Holder(width=WIDTH)
+    idx = h.create_index("t")
+    fld = idx.create_field("f", FieldOptions(type=FieldType.SET))
+    rows = rng.integers(0, 20, size=500)
+    cols = rng.integers(0, 4 * WIDTH, size=500)
+    for r, c in zip(rows, cols):
+        fld.set_bit(int(r), int(c))
+    idx.mark_columns_exist([int(c) for c in cols])
+    from pilosa_tpu.executor.executor import Executor
+    ex = Executor(h)
+    got = ex.execute("t", "TopN(f, n=5)")[0]
+    # ground truth by exact per-row count of distinct columns
+    want = {}
+    for r in range(20):
+        want[r] = len({int(c) for rr, c in zip(rows, cols) if rr == r})
+    best = sorted(want.items(), key=lambda kv: (-kv[1], kv[0]))[:5]
+    assert [(p.id, p.count) for p in got] == best
+    # ids= path stays exact and includes zero-count rows
+    got_ids = ex.execute("t", "TopN(f, ids=[0,1,99])")[0]
+    assert {p.id for p in got_ids} == {0, 1, 99}
+
+
+def test_topn_cache_respects_lru_field_option():
+    h = Holder(width=WIDTH)
+    idx = h.create_index("t2")
+    fld = idx.create_field(
+        "f", FieldOptions(type=FieldType.SET, cache_type="lru",
+                          cache_size=2))
+    # 3 rows; lru size 2 -> oldest row falls out of TopN entirely
+    fld.set_bit(1, 0)
+    fld.set_bit(2, 1)
+    fld.set_bit(3, 2)
+    idx.mark_columns_exist([0, 1, 2])
+    from pilosa_tpu.executor.executor import Executor
+    got = Executor(h).execute("t2", "TopN(f)")[0]
+    assert {p.id for p in got} == {2, 3}
+
+
+def test_lru_refresh_preserves_write_order():
+    # ids chosen so hash order != write order would expose set-order
+    # refresh; the ordered stale dict must preserve recency
+    f = Fragment("i", "f", "standard", 0, width=WIDTH,
+                 cache_type="lru", cache_size=2)
+    order = [1 << 40, 3, 1 << 20]
+    for i, r in enumerate(order):
+        f.set_bit(r, i)
+    cache = f.row_cache()
+    # first-written row evicted, last two survive
+    assert set(cache.ids()) == {3, 1 << 20}
