@@ -105,14 +105,19 @@ class API:
         self._record_history(index, pql, t0)
         return resp
 
-    def sql(self, statement: str) -> dict:
+    def sql(self, statement: str, auth_check=None) -> dict:
         """SQL query (http_handler.go:1440 /sql).  Returns
         {"schema": {"fields": [...]}, "data": [...]} like the
-        reference's SQL response shape."""
+        reference's SQL response shape.  auth_check, when set, gates
+        each statement's table access (Authorizer.sql_check)."""
         metrics.SQL_TOTAL.inc()
         t0 = time.time()
+        engine = self.sql_engine
+        if auth_check is not None:
+            from pilosa_tpu.sql.engine import SQLEngine
+            engine = SQLEngine(self.holder, auth_check=auth_check)
         try:
-            res = self.sql_engine.query_one(statement)
+            res = engine.query_one(statement)
         except (ExecError, SQLError, ParseError, ValueError, KeyError) as e:
             raise ApiError(str(e), 400)
         self._record_history("", statement, t0)

@@ -23,8 +23,10 @@ class RemoteError(Exception):
 
 
 class InternalClient:
-    def __init__(self, timeout: float = 30.0):
+    def __init__(self, timeout: float = 30.0,
+                 headers: dict | None = None):
         self.timeout = timeout
+        self.headers = headers or {}  # e.g. Authorization bearer token
 
     def _request(self, uri: str, method: str, path: str, body=None):
         host, _, port = uri.partition(":")
@@ -33,7 +35,8 @@ class InternalClient:
         try:
             conn.request(method, path,
                          body=None if body is None else json.dumps(body),
-                         headers={"Content-Type": "application/json"})
+                         headers={"Content-Type": "application/json",
+                                  **self.headers})
             resp = conn.getresponse()
             raw = resp.read()
         finally:

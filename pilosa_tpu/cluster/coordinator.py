@@ -46,9 +46,13 @@ class ClusterNode:
 
     def __init__(self, node_id: str, disco: DisCo, holder=None,
                  replica_n: int = 1, bind: str = "127.0.0.1",
-                 heartbeat_interval: float = 1.0):
+                 heartbeat_interval: float = 1.0, auth=None,
+                 auth_token: str | None = None):
         from pilosa_tpu.server import Server
-        self.server = Server(holder=holder, bind=bind)
+        self.server = Server(holder=holder, bind=bind, auth=auth)
+        # bearer token attached to all node-to-node requests so peer
+        # traffic passes the chkAuthZ middleware when auth is on
+        self.auth_token = auth_token
         self.api = self.server.api
         self.api.name = node_id
         self.node_id = node_id
@@ -157,6 +161,9 @@ class ClusterNode:
                                           cols, timestamps=times)
 
     def _client(self) -> InternalClient:
+        if self.auth_token:
+            return InternalClient(
+                headers={"Authorization": f"Bearer {self.auth_token}"})
         return InternalClient()
 
     def apply_schema(self, schema: dict):

@@ -11,4 +11,18 @@ strings, decimals, and time literals.
 from pilosa_tpu.pql.ast import Call, Condition, Query
 from pilosa_tpu.pql.parser import parse, ParseError
 
-__all__ = ["Call", "Condition", "Query", "parse", "ParseError"]
+# pql.Call.IsWrite (pql/ast.go writeCallNames)
+WRITE_CALLS = {"Set", "Clear", "Store", "ClearRow", "Delete"}
+
+
+def is_write_query(pql: str) -> bool:
+    """True when any call in the query mutates (conservative True on
+    parse errors — used by authz need selection)."""
+    try:
+        return any(c.name in WRITE_CALLS for c in parse(pql).calls)
+    except Exception:
+        return True
+
+
+__all__ = ["Call", "Condition", "Query", "parse", "ParseError",
+           "WRITE_CALLS", "is_write_query"]

@@ -54,7 +54,9 @@ class Server:
         self.holder = holder if holder is not None else Holder()
         self.api = api if api is not None else API(self.holder)
         self.logger = logger or NopLogger()
-        self.auth = auth  # wired by pilosa_tpu.auth middleware
+        # (Authenticator, Authorizer | None) — enables the chkAuthZ
+        # middleware in dispatch (http_handler.go chkAuthZ)
+        self.auth = auth
         self._routes: list[Route] = []
         self._register_routes()
         handler = _make_handler(self)
@@ -125,6 +127,53 @@ class Server:
         r(Route("GET", "/metrics", self._get_metrics))
         r(Route("GET", "/metrics.json",
                 lambda req: metrics.registry.render_json()))
+        r(Route("GET", "/login", self._get_login))
+
+    # paths served without a token when auth is enabled
+    # (http_handler.go: login/metrics/version stay open)
+    _OPEN_PATHS = {"/version", "/metrics", "/metrics.json", "/login"}
+
+    def _get_login(self, req):
+        if self.auth is None:
+            raise ApiError("auth not enabled", 400)
+        authn_, _ = self.auth
+        return {"url": authn_.login_url()}
+
+    def _check_auth(self, method: str, path: str, req):
+        """chkAuthZ middleware (http_handler.go chkAuthZ): validate the
+        bearer token, then require read (GET) / write (other) on the
+        route's index, or admin for /internal + schema writes."""
+        req.auth_claims = {}
+        if self.auth is None or path in self._OPEN_PATHS:
+            return
+        from pilosa_tpu.server.authn import AuthError
+        authn_, authz_ = self.auth
+        try:
+            claims = authn_.authenticate(req.headers.get("Authorization", ""))
+        except AuthError as e:
+            raise ApiError(str(e), 401)
+        req.auth_claims = claims
+        if authz_ is None:
+            return
+        groups = claims.get("groups", [])
+        if path.startswith("/internal") or (
+                path == "/schema" and method != "GET"):
+            if not authz_.is_admin(groups):
+                raise ApiError("admin required", 403)
+            return
+        index = req.vars.get("index")
+        if index is None:
+            return
+        if path.endswith("/query"):
+            # reads POST too: permission follows the query's calls
+            from pilosa_tpu.pql import is_write_query
+            body = req.json_lenient()
+            pql = (body or {}).get("query") or req.text()
+            need = "write" if is_write_query(pql) else "read"
+        else:
+            need = "read" if method == "GET" else "write"
+        if not authz_.allowed(groups, index, need):
+            raise ApiError(f"not authorized for {need} on {index}", 403)
 
     def dispatch(self, method: str, path: str, req) -> tuple[int, object]:
         for rt in self._routes:
@@ -134,6 +183,7 @@ class Server:
             if m:
                 req.vars = m.groupdict()
                 try:
+                    self._check_auth(method, path, req)
                     return 200, rt.fn(req)
                 except ApiError as e:
                     return e.status, {"error": str(e)}
@@ -161,10 +211,24 @@ class Server:
     def _post_sql(self, req):
         body = req.json_lenient()
         stmt = body.get("sql", "") if body is not None else req.text()
-        return self.api.sql(stmt)
+        auth_check = None
+        if self.auth is not None and self.auth[1] is not None:
+            auth_check = self.auth[1].sql_check(
+                req.auth_claims.get("groups", []))
+        try:
+            return self.api.sql(stmt, auth_check=auth_check)
+        except PermissionError as e:
+            raise ApiError(str(e), 403)
 
     def _get_schema(self, req):
-        return self.api.schema()
+        schema = self.api.schema()
+        if self.auth is not None and self.auth[1] is not None:
+            groups = req.auth_claims.get("groups", [])
+            authz_ = self.auth[1]
+            schema = {"indexes": [
+                ix for ix in schema.get("indexes", [])
+                if authz_.allowed(groups, ix["name"], "read")]}
+        return schema
 
     def _post_schema(self, req):
         body = req.json()
@@ -286,11 +350,6 @@ def _make_handler(server: Server):
             # always drain the body: unread bytes on a keep-alive
             # connection would be parsed as the next request line
             self._raw = self._body()
-            if server.auth is not None:
-                err = server.auth.check(self, u.path)
-                if err is not None:
-                    self._send(err[0], {"error": err[1]})
-                    return
             status, result = server.dispatch(method, u.path, self)
             self._send(status, result)
             metrics.HTTP_REQUESTS.inc(

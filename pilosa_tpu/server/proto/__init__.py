@@ -1,0 +1,8 @@
+"""Generated protobuf messages for the gRPC plane.
+
+pilosa_tpu_pb2.py is generated from pilosa_tpu.proto by protoc
+(``protoc --python_out=. pilosa_tpu.proto``) and checked in, the way
+the reference checks in its generated pb/ code.
+"""
+
+from pilosa_tpu.server.proto import pilosa_tpu_pb2 as pb  # noqa: F401

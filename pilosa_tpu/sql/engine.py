@@ -57,19 +57,47 @@ def _sql_type(f) -> str:
 
 
 class SQLEngine:
-    def __init__(self, holder: Holder):
+    def __init__(self, holder: Holder, auth_check=None):
         self.holder = holder
         self.executor = Executor(holder)
+        # auth_check(table_or_None, "read"|"write") raises on denial —
+        # the SQL-side authz hook (the reference resolves table names
+        # during planning and consults authz per table)
+        self.auth_check = auth_check
+
+    @staticmethod
+    def _stmt_access(stmt) -> tuple[str | None, str]:
+        """(table, needed-permission) for one statement."""
+        if isinstance(stmt, (ast.Select, ast.ShowColumns)):
+            return stmt.table, "read"
+        if isinstance(stmt, ast.ShowTables):
+            return None, "read"
+        if isinstance(stmt, (ast.CreateTable, ast.DropTable,
+                             ast.Insert, ast.Delete)):
+            return stmt.name if hasattr(stmt, "name") else stmt.table, \
+                "write"
+        return None, "write"
 
     def query(self, sql: str) -> list[SQLResult]:
         from pilosa_tpu.executor.executor import ExecError
         try:
-            return [self._execute(stmt) for stmt in parse_sql(sql)]
+            stmts = parse_sql(sql)
+            if self.auth_check is not None:
+                for stmt in stmts:
+                    self.auth_check(*self._stmt_access(stmt))
+            return [self._execute(stmt) for stmt in stmts]
         except ExecError as e:  # surface executor errors as SQL errors
             raise SQLError(str(e)) from e
 
     def query_one(self, sql: str) -> SQLResult:
         return self.query(sql)[-1]
+
+    def _can_read(self, table: str) -> bool:
+        try:
+            self.auth_check(table, "read")
+            return True
+        except Exception:
+            return False
 
     # ------------------------------------------------------------------
 
@@ -79,8 +107,11 @@ class SQLEngine:
         if isinstance(stmt, ast.DropTable):
             return self._drop_table(stmt)
         if isinstance(stmt, ast.ShowTables):
+            names = sorted(self.holder.indexes)
+            if self.auth_check is not None:
+                names = [n for n in names if self._can_read(n)]
             return SQLResult(schema=[("name", "string")],
-                             rows=[(n,) for n in sorted(self.holder.indexes)])
+                             rows=[(n,) for n in names])
         if isinstance(stmt, ast.ShowColumns):
             return self._show_columns(stmt)
         if isinstance(stmt, ast.Insert):

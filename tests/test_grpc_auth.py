@@ -1,0 +1,330 @@
+"""gRPC service + authn/authz tests (server/grpc_test.go,
+authn/authz test strategies)."""
+
+import time
+
+import grpc
+import pytest
+
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.api import API
+from pilosa_tpu.server.authn import (
+    AuthError,
+    Authenticator,
+    decode_jwt,
+    encode_jwt,
+)
+from pilosa_tpu.server.authz import Authorizer
+from pilosa_tpu.server.grpc import GRPCServer
+from pilosa_tpu.server.proto import pb
+
+SECRET = b"cluster-shared-secret"
+
+
+@pytest.fixture()
+def stack():
+    holder = Holder()
+    api = API(holder)
+    srv = GRPCServer(api, bind="127.0.0.1:0").start()
+    chan = grpc.insecure_channel(srv.uri)
+    yield api, srv, chan
+    chan.close()
+    srv.stop()
+    holder.close()
+
+
+def _unary(chan, method, req, resp_cls):
+    fn = chan.unary_unary(f"/proto.Pilosa/{method}",
+                          request_serializer=req.SerializeToString,
+                          response_deserializer=resp_cls.FromString)
+    return fn(req)
+
+
+def _stream(chan, method, req):
+    fn = chan.unary_stream(f"/proto.Pilosa/{method}",
+                           request_serializer=req.SerializeToString,
+                           response_deserializer=pb.RowResponse.FromString)
+    return list(fn(req))
+
+
+def test_grpc_index_crud_and_pql(stack):
+    api, srv, chan = stack
+    _unary(chan, "CreateIndex", pb.CreateIndexRequest(name="g"),
+           pb.CreateIndexResponse)
+    got = _unary(chan, "GetIndexes", pb.GetIndexesRequest(),
+                 pb.GetIndexesResponse)
+    assert [i.name for i in got.indexes] == ["g"]
+
+    api.create_field("g", "f", {"type": "set"})
+    for col in (1, 2, 66000):
+        api.query("g", f"Set({col}, f=7)")
+
+    rows = _stream(chan, "QueryPQL",
+                   pb.QueryPQLRequest(index="g", pql="Row(f=7)"))
+    assert [r.columns[0].uint64Val for r in rows] == [1, 2, 66000]
+    assert rows[0].headers[0].name == "_id"
+
+    table = _unary(chan, "QueryPQLUnary",
+                   pb.QueryPQLRequest(index="g", pql="Count(Row(f=7))"),
+                   pb.TableResponse)
+    assert table.rows[0].columns[0].uint64Val == 3
+
+    # TopN pairs shape
+    rows = _stream(chan, "QueryPQL",
+                   pb.QueryPQLRequest(index="g", pql="TopN(f)"))
+    assert rows[0].columns[0].uint64Val == 7
+    assert rows[0].columns[1].uint64Val == 3
+
+    _unary(chan, "DeleteIndex", pb.DeleteIndexRequest(name="g"),
+           pb.DeleteIndexResponse)
+    got = _unary(chan, "GetIndexes", pb.GetIndexesRequest(),
+                 pb.GetIndexesResponse)
+    assert not got.indexes
+
+
+def test_grpc_sql(stack):
+    api, srv, chan = stack
+    table = _unary(chan, "QuerySQLUnary", pb.QuerySQLRequest(
+        sql="CREATE TABLE t (_id ID, v INT MIN 0 MAX 100)"),
+        pb.TableResponse)
+    _unary(chan, "QuerySQLUnary", pb.QuerySQLRequest(
+        sql="INSERT INTO t (_id, v) VALUES (1, 42), (2, 58)"),
+        pb.TableResponse)
+    table = _unary(chan, "QuerySQLUnary", pb.QuerySQLRequest(
+        sql="SELECT _id, v FROM t ORDER BY _id"), pb.TableResponse)
+    assert [r.columns[1].int64Val for r in table.rows] == [42, 58]
+    assert table.headers[1].name == "v"
+
+
+def test_grpc_inspect(stack):
+    api, srv, chan = stack
+    api.create_index("ins")
+    api.create_field("ins", "f", {"type": "set"})
+    api.create_field("ins", "v", {"type": "int", "min": 0, "max": 99})
+    api.query("ins", "Set(5, f=1)Set(5, f=2)")
+    api.query("ins", "Set(5, v=42)")
+    req = pb.InspectRequest(index="ins")
+    req.columns.ids.vals.extend([5])
+    rows = _stream(chan, "Inspect", req)
+    assert rows[0].columns[0].uint64Val == 5
+    by_name = {h.name: c for h, c in
+               zip(rows[0].headers, rows[0].columns)}
+    assert by_name["f"].stringVal == "1,2"
+    assert by_name["v"].stringVal == "42"
+
+
+def test_grpc_errors(stack):
+    api, srv, chan = stack
+    with pytest.raises(grpc.RpcError) as e:
+        _unary(chan, "GetIndex", pb.GetIndexRequest(name="nope"),
+               pb.GetIndexResponse)
+    assert e.value.code() == grpc.StatusCode.NOT_FOUND
+    with pytest.raises(grpc.RpcError) as e:
+        _stream(chan, "QueryPQL",
+                pb.QueryPQLRequest(index="nope", pql="Count(Row(f=1))"))
+    assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+# -- authn ---------------------------------------------------------------
+
+def test_jwt_roundtrip_and_expiry():
+    tok = encode_jwt({"sub": "u", "groups": ["g1"],
+                      "exp": time.time() + 60}, SECRET)
+    claims = decode_jwt(tok, SECRET)
+    assert claims["sub"] == "u" and claims["groups"] == ["g1"]
+    with pytest.raises(AuthError):
+        decode_jwt(tok, b"wrong-secret")
+    expired = encode_jwt({"exp": time.time() - 1}, SECRET)
+    with pytest.raises(AuthError):
+        decode_jwt(expired, SECRET)
+    with pytest.raises(AuthError):
+        decode_jwt("garbage", SECRET)
+
+
+def test_authenticator_bearer_and_cache():
+    a = Authenticator(SECRET, client_id="cid",
+                      authorize_url="https://idp/authorize")
+    tok = encode_jwt({"groups": ["g"], "exp": time.time() + 60}, SECRET)
+    c1 = a.authenticate(f"Bearer {tok}")
+    c2 = a.authenticate(tok)  # bare token + cache hit
+    assert c1 == c2
+    with pytest.raises(AuthError):
+        a.authenticate("")
+    assert "client_id=cid" in a.login_url()
+
+
+# -- authz ---------------------------------------------------------------
+
+def test_authorizer_levels():
+    az = Authorizer(user_groups={
+        "readers": {"sales": "read"},
+        "writers": {"sales": "write"},
+    }, admin_group="admins")
+    assert az.allowed(["readers"], "sales", "read")
+    assert not az.allowed(["readers"], "sales", "write")
+    assert az.allowed(["writers", "readers"], "sales", "write")
+    assert not az.allowed(["writers"], "hr", "read")
+    assert az.allowed(["admins"], "anything", "admin")
+    assert az.allowed_indexes(["readers"]) == ["sales"]
+    assert az.allowed_indexes(["admins"]) == ["*"]
+
+
+def test_authorizer_from_yaml(tmp_path):
+    p = tmp_path / "policy.yaml"
+    p.write_text(
+        'user-groups:\n'
+        '  "g1":\n'
+        '    "idx": "write"\n'
+        'admin: "root"\n')
+    az = Authorizer.from_yaml(str(p))
+    assert az.allowed(["g1"], "idx", "read")
+    assert az.is_admin(["root"])
+
+
+# -- HTTP middleware -----------------------------------------------------
+
+def test_http_auth_middleware():
+    from pilosa_tpu.cluster.client import InternalClient, RemoteError
+    from pilosa_tpu.server.http import Server
+
+    authn = Authenticator(SECRET)
+    authz = Authorizer(user_groups={"writers": {"a": "write"}},
+                       admin_group="admins")
+    srv = Server(auth=(authn, authz)).start()
+    uri = f"127.0.0.1:{srv.port}"
+    cli = InternalClient()
+    try:
+        # no token -> 401
+        with pytest.raises(RemoteError) as e:
+            cli._request(uri, "POST", "/index/a", {})
+        assert e.value.status == 401
+        # /version stays open
+        assert cli._request(uri, "GET", "/version")
+        # writer token can create + query its index
+        tok = encode_jwt({"groups": ["writers"],
+                          "exp": time.time() + 60}, SECRET)
+        hdrs = {"Authorization": f"Bearer {tok}"}
+        cli2 = InternalClient(headers=hdrs)
+        cli2._request(uri, "POST", "/index/a", {})
+        # but not another index
+        with pytest.raises(RemoteError) as e:
+            cli2._request(uri, "POST", "/index/b", {})
+        assert e.value.status == 403
+        # nor admin-only schema writes
+        with pytest.raises(RemoteError) as e:
+            cli2._request(uri, "POST", "/schema", {"indexes": []})
+        assert e.value.status == 403
+        # admin token can
+        atok = encode_jwt({"groups": ["admins"],
+                           "exp": time.time() + 60}, SECRET)
+        cli3 = InternalClient(headers={"Authorization": f"Bearer {atok}"})
+        cli3._request(uri, "POST", "/schema", {"indexes": []})
+        # login URL endpoint
+        assert "url" in cli._request(uri, "GET", "/login")
+    finally:
+        srv.close()
+
+
+def test_sql_authz_per_table(stack_auth=None):
+    """SQL statements are authorized per table; SHOW TABLES filters."""
+    holder = Holder()
+    api = API(holder)
+    authn = Authenticator(SECRET)
+    authz = Authorizer(user_groups={
+        "sales-rw": {"sales": "write"},
+        "sales-ro": {"sales": "read"},
+    }, admin_group="admins")
+    srv = GRPCServer(api, auth=(authn, authz)).start()
+    chan = grpc.insecure_channel(srv.uri)
+    try:
+        def md(groups):
+            tok = encode_jwt({"groups": groups,
+                              "exp": time.time() + 60}, SECRET)
+            return (("authorization", f"Bearer {tok}"),)
+
+        def sql(stmt, groups):
+            fn = chan.unary_unary(
+                "/proto.Pilosa/QuerySQLUnary",
+                request_serializer=pb.QuerySQLRequest.SerializeToString,
+                response_deserializer=pb.TableResponse.FromString)
+            return fn(pb.QuerySQLRequest(sql=stmt), metadata=md(groups))
+
+        sql("CREATE TABLE sales (_id ID, v INT MIN 0 MAX 9)",
+            ["sales-rw"])
+        api.create_index("secret")
+        # read-only group can select but not insert
+        sql("SELECT COUNT(*) FROM sales", ["sales-ro"])
+        with pytest.raises(grpc.RpcError) as e:
+            sql("INSERT INTO sales (_id, v) VALUES (1, 2)", ["sales-ro"])
+        assert e.value.code() == grpc.StatusCode.PERMISSION_DENIED
+        # no grant on secret at all
+        with pytest.raises(grpc.RpcError) as e:
+            sql("SELECT COUNT(*) FROM secret", ["sales-ro"])
+        assert e.value.code() == grpc.StatusCode.PERMISSION_DENIED
+        # SHOW TABLES only lists readable tables
+        t = sql("SHOW TABLES", ["sales-ro"])
+        assert [r.columns[0].stringVal for r in t.rows] == ["sales"]
+        # GetIndexes filters the same way
+        fn = chan.unary_unary(
+            "/proto.Pilosa/GetIndexes",
+            request_serializer=pb.GetIndexesRequest.SerializeToString,
+            response_deserializer=pb.GetIndexesResponse.FromString)
+        got = fn(pb.GetIndexesRequest(), metadata=md(["sales-ro"]))
+        assert [i.name for i in got.indexes] == ["sales"]
+    finally:
+        chan.close()
+        srv.stop()
+        holder.close()
+
+
+def test_http_read_token_can_query():
+    """POST query with only read calls passes with a read grant; a
+    write call in the same route needs write (chkAuthZ per-call)."""
+    from pilosa_tpu.cluster.client import InternalClient, RemoteError
+    from pilosa_tpu.server.http import Server
+
+    authn = Authenticator(SECRET)
+    authz = Authorizer(user_groups={"ro": {"a": "read"},
+                                    "rw": {"a": "write"}})
+    srv = Server(auth=(authn, authz)).start()
+    uri = f"127.0.0.1:{srv.port}"
+    rw = InternalClient(headers={"Authorization": "Bearer " + encode_jwt(
+        {"groups": ["rw"], "exp": time.time() + 60}, SECRET)})
+    ro = InternalClient(headers={"Authorization": "Bearer " + encode_jwt(
+        {"groups": ["ro"], "exp": time.time() + 60}, SECRET)})
+    try:
+        rw._request(uri, "POST", "/index/a", {})
+        rw._request(uri, "POST", "/index/a/field/f", {"type": "set"})
+        rw._request(uri, "POST", "/index/a/query", {"query": "Set(1, f=1)"})
+        r = ro._request(uri, "POST", "/index/a/query",
+                        {"query": "Count(Row(f=1))"})
+        assert r["results"] == [1]
+        with pytest.raises(RemoteError) as e:
+            ro._request(uri, "POST", "/index/a/query",
+                        {"query": "Set(2, f=1)"})
+        assert e.value.status == 403
+    finally:
+        srv.close()
+
+
+def test_cluster_auth_token_peer_traffic():
+    """Node-to-node traffic carries the bearer token so replication
+    works with auth enabled."""
+    from pilosa_tpu.cluster import ClusterNode, InMemDisCo
+
+    authn = Authenticator(SECRET)
+    tok = encode_jwt({"groups": ["admins"], "exp": time.time() + 3600},
+                     SECRET)
+    authz = Authorizer(admin_group="admins")
+    disco = InMemDisCo(lease_ttl=1.0)
+    nodes = [ClusterNode(f"n{i}", disco, holder=Holder(), replica_n=2,
+                         auth=(authn, authz), auth_token=tok).open()
+             for i in range(2)]
+    try:
+        nodes[0].apply_schema({"indexes": [{"name": "c", "fields": [
+            {"name": "f", "options": {"type": "set"}}]}]})
+        nodes[0].import_bits("c", "f", [1, 1], [0, 1 << 20])
+        assert nodes[1].query("c", "Count(Row(f=1))")["results"] == [2]
+    finally:
+        for n in nodes:
+            n.close()
