@@ -11,6 +11,7 @@ result type (the handler-side marshaling of http_handler.go).
 from __future__ import annotations
 
 import datetime as dt
+import os
 import threading
 import time
 
@@ -74,6 +75,17 @@ class API:
         # and key translation outside this lock
         self._import_locks: dict[str, threading.Lock] = {}
         self._import_locks_mu = threading.Lock()
+        # cluster-wide exclusive transactions (transaction.go:20);
+        # backup holds one while streaming files (ctl/backup.go:30)
+        from pilosa_tpu.cluster.txn import TransactionManager
+        self.txns = TransactionManager()
+
+    def _check_writable(self):
+        """Writes are refused while an exclusive transaction is active
+        (transaction.go: backup quiesces the cluster)."""
+        if self.txns.exclusive_active():
+            raise ApiError(
+                "cluster is read-only: exclusive transaction active", 409)
 
     # ------------------------------------------------------------------
     # queries
@@ -85,6 +97,9 @@ class API:
         QueryResponse dict: {"results": [...]} (+"profile" spans when
         requested, tracing/tracing.go:22-50 behavior)."""
         t0 = time.time()
+        from pilosa_tpu.pql import is_write_query
+        if is_write_query(pql):
+            self._check_writable()
         tracer = None
         if profile:
             from pilosa_tpu.obs import tracing as _tr
@@ -112,12 +127,10 @@ class API:
         each statement's table access (Authorizer.sql_check)."""
         metrics.SQL_TOTAL.inc()
         t0 = time.time()
-        engine = self.sql_engine
-        if auth_check is not None:
-            from pilosa_tpu.sql.engine import SQLEngine
-            engine = SQLEngine(self.holder, auth_check=auth_check)
         try:
-            res = engine.query_one(statement)
+            res = self.sql_engine.query_one(
+                statement, auth_check=auth_check,
+                write_guard=self._check_writable)
         except (ExecError, SQLError, ParseError, ValueError, KeyError) as e:
             raise ApiError(str(e), 400)
         self._record_history("", statement, t0)
@@ -212,6 +225,7 @@ class API:
     def import_bits(self, index: str, field: str, rows=None, cols=None,
                     row_keys=None, col_keys=None, timestamps=None,
                     clear: bool = False) -> int:
+        self._check_writable()
         idx = self._index(index)
         f = idx.field(field)
         if f is None:
@@ -242,6 +256,7 @@ class API:
 
     def import_values(self, index: str, field: str, cols=None, values=None,
                       col_keys=None, clear: bool = False) -> int:
+        self._check_writable()
         idx = self._index(index)
         f = idx.field(field)
         if f is None:
@@ -305,6 +320,83 @@ class API:
             "cluster_name": "pilosa-tpu",
             "indexes": sorted(self.holder.indexes),
         }
+
+    # ------------------------------------------------------------------
+    # transactions (api.go Transactions/StartTransaction; transaction.go)
+    # ------------------------------------------------------------------
+
+    def start_transaction(self, id=None, exclusive: bool = False,
+                          timeout: float | None = None) -> dict:
+        from pilosa_tpu.cluster.txn import TransactionError
+        try:
+            return self.txns.start(id=id, timeout=timeout,
+                                   exclusive=exclusive).to_dict()
+        except TransactionError as e:
+            raise ApiError(str(e), 409)
+
+    def finish_transaction(self, tid: str) -> dict:
+        from pilosa_tpu.cluster.txn import TransactionError
+        try:
+            return self.txns.finish(tid).to_dict()
+        except TransactionError as e:
+            raise ApiError(str(e), 404)
+
+    def get_transaction(self, tid: str) -> dict:
+        from pilosa_tpu.cluster.txn import TransactionError
+        try:
+            return self.txns.get(tid).to_dict()
+        except TransactionError as e:
+            raise ApiError(str(e), 404)
+
+    # ------------------------------------------------------------------
+    # backup / restore (ctl/backup.go, ctl/restore.go; RBF files are
+    # the checkpoint source of truth — SURVEY §5.4)
+    # ------------------------------------------------------------------
+
+    def _safe_rel_path(self, rel: str) -> str:
+        if not self.holder.path:
+            raise ApiError("node has no data directory", 400)
+        base = os.path.abspath(self.holder.path)
+        p = os.path.abspath(os.path.normpath(os.path.join(base, rel)))
+        if not p.startswith(base + os.sep):
+            raise ApiError(f"path escapes data directory: {rel}", 400)
+        return p
+
+    def backup_manifest(self) -> dict:
+        """Flush + list every data file (schema, RBF shards + WALs,
+        translate stores) relative to the data directory."""
+        if not self.holder.path:
+            raise ApiError("node has no data directory", 400)
+        self.holder.sync()
+        files = []
+        for root, _, fns in os.walk(self.holder.path):
+            for fn in fns:
+                files.append(os.path.relpath(
+                    os.path.join(root, fn), self.holder.path))
+        return {"schema": self.schema(), "files": sorted(files)}
+
+    def backup_file(self, rel: str) -> bytes:
+        p = self._safe_rel_path(rel)
+        if not os.path.isfile(p):
+            raise ApiError(f"no such backup file: {rel}", 404)
+        with open(p, "rb") as f:
+            return f.read()
+
+    def restore_file(self, rel: str, data: bytes):
+        p = self._safe_rel_path(rel)
+        os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
+        with open(p, "wb") as f:
+            f.write(data)
+
+    def restore_complete(self):
+        """Reload the holder from the restored files (the restore
+        analog of ctl/restore.go's post-upload reload)."""
+        if not self.holder.path:
+            raise ApiError("node has no data directory", 400)
+        self.holder.close()
+        self.holder.indexes = {}
+        self.holder.load_schema()
+        return {"indexes": sorted(self.holder.indexes)}
 
     def shard_max(self) -> dict:
         return {ix.name: (max(ix.available_shards)

@@ -1,0 +1,3 @@
+"""Operator CLI (cmd/root.go + ctl/ command set, cli/ fbsql shell)."""
+
+from pilosa_tpu.cli.main import main  # noqa: F401

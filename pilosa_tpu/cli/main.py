@@ -1,0 +1,348 @@
+"""``pilosa-tpu`` command — the operator entry point.
+
+Command set mirrors the reference CLI (cmd/root.go:94-111 subcommand
+registration; implementations in ctl/):
+
+    server            run a node                       (ctl/server.go)
+    backup            snapshot a live node             (ctl/backup.go:30)
+    restore           upload a backup to a node        (ctl/restore.go)
+    import            CSV import through the batcher   (ctl/import.go)
+    export            dump a field as CSV              (ctl/export.go)
+    generate-config   print default config             (cmd generate-config)
+    keygen            mint an HS256 auth token         (qa/fakeidp analog)
+    rbf               inspect RBF shard files          (ctl/rbf.go)
+    sql               fbsql interactive shell          (cli/cli.go)
+    version
+
+argparse instead of cobra; flags keep the reference's names where they
+exist (--host, --index, --field, --output-dir, ...).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _client(args):
+    from pilosa_tpu.cluster.client import InternalClient
+    headers = {}
+    if getattr(args, "token", None):
+        headers["Authorization"] = f"Bearer {args.token}"
+    return InternalClient(timeout=getattr(args, "timeout", 60.0),
+                          headers=headers)
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+def cmd_server(args) -> int:
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.obs.logger import StdLogger
+    from pilosa_tpu.server.http import Server
+
+    holder = Holder(path=args.data_dir) if args.data_dir else Holder()
+    holder.load_schema()
+    auth = None
+    if args.auth_secret:
+        from pilosa_tpu.server.authn import Authenticator
+        from pilosa_tpu.server.authz import Authorizer
+        authz = (Authorizer.from_yaml(args.auth_policy)
+                 if args.auth_policy else None)
+        auth = (Authenticator(args.auth_secret.encode()), authz)
+    logger = StdLogger()
+    srv = Server(holder=holder, bind=args.bind, port=args.port,
+                 logger=logger, auth=auth)
+    grpc_srv = None
+    if args.grpc_port >= 0:
+        from pilosa_tpu.server.grpc import GRPCServer
+        grpc_srv = GRPCServer(srv.api,
+                              bind=f"{args.bind}:{args.grpc_port}",
+                              auth=auth).start()
+        logger.info("grpc listening on :%d", grpc_srv.port)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if grpc_srv:
+            grpc_srv.stop()
+        holder.sync()
+        srv.close()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# backup / restore (ctl/backup.go:30,87; ctl/restore.go)
+# ---------------------------------------------------------------------------
+
+def _safe_join(base: str, rel: str) -> str:
+    """Join a manifest-supplied relative path, refusing traversal out
+    of base (zip-slip guard — the server must not steer writes)."""
+    if os.path.isabs(rel) or ".." in rel.replace("\\", "/").split("/"):
+        raise ValueError(f"unsafe path in manifest: {rel!r}")
+    return os.path.join(base, rel)
+
+
+def cmd_backup(args) -> int:
+    cli = _client(args)
+    # hold a cluster-exclusive transaction while streaming, so no
+    # writer mutates shards mid-backup (ctl/backup.go:87)
+    tx = cli._request(args.host, "POST", "/transaction",
+                      {"exclusive": True, "timeout": 300.0})
+    tid = tx["id"]
+    try:
+        deadline = time.time() + 30
+        while not tx.get("active"):
+            if time.time() > deadline:
+                print("timed out waiting for exclusive transaction",
+                      file=sys.stderr)
+                return 1
+            time.sleep(0.1)
+            tx = cli._request(args.host, "GET", f"/transaction/{tid}")
+        man = cli._request(args.host, "GET", "/internal/backup/manifest")
+        os.makedirs(args.output_dir, exist_ok=True)
+        with open(os.path.join(args.output_dir, "MANIFEST.json"),
+                  "w") as f:
+            json.dump(man, f, indent=1)
+        for rel in man["files"]:
+            data = cli.get_raw(args.host,
+                               f"/internal/backup/file?path={rel}")
+            dst = _safe_join(args.output_dir, rel)
+            os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+            with open(dst, "wb") as f:
+                f.write(data)
+            if not args.quiet:
+                print(f"backed up {rel} ({len(data)} bytes)")
+    finally:
+        cli._request(args.host, "POST", f"/transaction/{tid}/finish")
+    print(f"backup complete: {len(man['files'])} files "
+          f"-> {args.output_dir}")
+    return 0
+
+
+def cmd_restore(args) -> int:
+    cli = _client(args)
+    man_path = os.path.join(args.source_dir, "MANIFEST.json")
+    with open(man_path) as f:
+        man = json.load(f)
+    for rel in man["files"]:
+        with open(_safe_join(args.source_dir, rel), "rb") as f:
+            data = f.read()
+        cli.post_raw(args.host,
+                     f"/internal/restore/file?path={rel}", data)
+        if not args.quiet:
+            print(f"restored {rel} ({len(data)} bytes)")
+    got = cli._request(args.host, "POST", "/internal/restore/complete")
+    print(f"restore complete: indexes {got['indexes']}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# import / export
+# ---------------------------------------------------------------------------
+
+def cmd_import(args) -> int:
+    from pilosa_tpu.ingest.importer import HTTPImporter
+    from pilosa_tpu.ingest.pipeline import Pipeline
+    from pilosa_tpu.ingest.sources import CSVSource
+
+    src = CSVSource(args.file)
+    importer = HTTPImporter(args.host, client=_client(args))
+    pipe = Pipeline(src, importer, args.index,
+                    batch_size=args.batch_size,
+                    concurrency=args.concurrency,
+                    index_keys=args.keys or None)
+    pipe.apply_schema()
+    n = pipe.run()
+    print(f"imported {n} records into {args.index}")
+    return 0
+
+
+def cmd_export(args) -> int:
+    """Dump field bits as row,col CSV (ctl/export.go semantics)."""
+    cli = _client(args)
+    resp = cli._request(args.host, "POST", f"/index/{args.index}/query",
+                        {"query": f"Rows({args.field})"})
+    rows = resp["results"][0]
+    out = open(args.output, "w") if args.output else sys.stdout
+    try:
+        for row in rows:
+            rid = row if not isinstance(row, dict) else row.get("id", row)
+            r = cli._request(
+                args.host, "POST", f"/index/{args.index}/query",
+                {"query": f"Row({args.field}={rid})"})
+            for col in r["results"][0]["columns"]:
+                out.write(f"{rid},{col}\n")
+    finally:
+        if args.output:
+            out.close()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+DEFAULT_CONFIG = """\
+# pilosa-tpu configuration (TOML).  Flags override file values;
+# environment variables PILOSA_TPU_* override both
+# (server/config.go analog).
+
+data-dir = "~/.pilosa-tpu"
+bind = "127.0.0.1"
+port = 10101
+grpc-port = 20101
+
+[cluster]
+name = "cluster0"
+replicas = 1
+
+[auth]
+# enable by setting a shared HS256 secret
+secret = ""
+policy = ""      # YAML group->permission file (authz)
+
+[tpu]
+# pallas kernel dispatch: "auto" | "on" | "off"
+kernels = "auto"
+"""
+
+
+def cmd_generate_config(args) -> int:
+    print(DEFAULT_CONFIG, end="")
+    return 0
+
+
+def cmd_keygen(args) -> int:
+    from pilosa_tpu.server.authn import encode_jwt
+    claims = {"sub": args.subject,
+              "groups": args.groups.split(",") if args.groups else [],
+              "exp": time.time() + args.ttl}
+    print(encode_jwt(claims, args.secret.encode()))
+    return 0
+
+
+def cmd_rbf(args) -> int:
+    """RBF shard file inspection (ctl/rbf.go check/pages)."""
+    from pilosa_tpu.storage import rbf
+    db = rbf.DB(args.file)
+    try:
+        with db.begin() as tx:
+            names = tx.list_bitmaps()
+            print(f"file: {args.file}")
+            print(f"bitmaps: {len(names)}")
+            for name in names:
+                n = sum(1 for _ in tx.items(name))
+                print(f"  {name}: {n} containers")
+    finally:
+        db.close()
+    return 0
+
+
+def cmd_version(args) -> int:
+    from pilosa_tpu import __version__
+    print(__version__)
+    return 0
+
+
+def cmd_sql(args) -> int:
+    from pilosa_tpu.cli.fbsql import run_shell
+    return run_shell(args)
+
+
+# ---------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pilosa-tpu",
+        description="TPU-native bitmap index — operator CLI")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def host_flags(sp):
+        sp.add_argument("--host", default="127.0.0.1:10101",
+                        help="host:port of the node")
+        sp.add_argument("--token", default=os.environ.get(
+            "PILOSA_TPU_TOKEN"), help="bearer token (auth-enabled nodes)")
+        sp.add_argument("--timeout", type=float, default=60.0)
+
+    sp = sub.add_parser("server", help="run a node")
+    sp.add_argument("--data-dir", default=None)
+    sp.add_argument("--bind", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=10101)
+    sp.add_argument("--grpc-port", type=int, default=20101,
+                    help="-1 disables gRPC")
+    sp.add_argument("--auth-secret", default="")
+    sp.add_argument("--auth-policy", default="")
+    sp.set_defaults(fn=cmd_server)
+
+    sp = sub.add_parser("backup", help="back up a live node")
+    host_flags(sp)
+    sp.add_argument("--output-dir", required=True)
+    sp.add_argument("--quiet", action="store_true")
+    sp.set_defaults(fn=cmd_backup)
+
+    sp = sub.add_parser("restore", help="restore a backup to a node")
+    host_flags(sp)
+    sp.add_argument("--source-dir", required=True)
+    sp.add_argument("--quiet", action="store_true")
+    sp.set_defaults(fn=cmd_restore)
+
+    sp = sub.add_parser("import", help="import a CSV file")
+    host_flags(sp)
+    sp.add_argument("--index", "-i", required=True)
+    sp.add_argument("--batch-size", type=int, default=65536)
+    sp.add_argument("--concurrency", type=int, default=1)
+    sp.add_argument("--keys", action="store_true",
+                    help="index uses string column keys")
+    sp.add_argument("file")
+    sp.set_defaults(fn=cmd_import)
+
+    sp = sub.add_parser("export", help="export a field as CSV")
+    host_flags(sp)
+    sp.add_argument("--index", "-i", required=True)
+    sp.add_argument("--field", "-f", required=True)
+    sp.add_argument("--output", "-o", default=None)
+    sp.set_defaults(fn=cmd_export)
+
+    sp = sub.add_parser("generate-config",
+                        help="print the default config file")
+    sp.set_defaults(fn=cmd_generate_config)
+
+    sp = sub.add_parser("keygen", help="mint an HS256 bearer token")
+    sp.add_argument("--secret", required=True)
+    sp.add_argument("--subject", default="admin")
+    sp.add_argument("--groups", default="")
+    sp.add_argument("--ttl", type=float, default=3600.0)
+    sp.set_defaults(fn=cmd_keygen)
+
+    sp = sub.add_parser("rbf", help="inspect an RBF shard file")
+    sp.add_argument("file")
+    sp.set_defaults(fn=cmd_rbf)
+
+    sp = sub.add_parser("sql", help="interactive SQL shell (fbsql)")
+    host_flags(sp)
+    sp.add_argument("-c", "--command", default=None,
+                    help="run one statement and exit")
+    sp.set_defaults(fn=cmd_sql)
+
+    sp = sub.add_parser("version")
+    sp.set_defaults(fn=cmd_version)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except Exception as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

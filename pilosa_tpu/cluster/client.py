@@ -81,3 +81,43 @@ class InternalClient:
 
     def status(self, uri: str) -> dict:
         return self._request(uri, "GET", "/status")
+
+    # -- raw binary transfers (backup/restore file streaming) ----------
+
+    def get_raw(self, uri: str, path: str) -> bytes:
+        host, _, port = uri.partition(":")
+        conn = http.client.HTTPConnection(host, int(port or 80),
+                                          timeout=self.timeout)
+        try:
+            conn.request("GET", path, headers=self.headers)
+            resp = conn.getresponse()
+            raw = resp.read()
+        finally:
+            conn.close()
+        if resp.status != 200:
+            try:
+                msg = json.loads(raw).get("error", "")
+            except Exception:
+                msg = raw[:200].decode("utf-8", "replace")
+            raise RemoteError(resp.status, msg)
+        return raw
+
+    def post_raw(self, uri: str, path: str, data: bytes) -> None:
+        host, _, port = uri.partition(":")
+        conn = http.client.HTTPConnection(host, int(port or 80),
+                                          timeout=self.timeout)
+        try:
+            conn.request("POST", path, body=data,
+                         headers={"Content-Type":
+                                  "application/octet-stream",
+                                  **self.headers})
+            resp = conn.getresponse()
+            raw = resp.read()
+        finally:
+            conn.close()
+        if resp.status != 200:
+            try:
+                msg = json.loads(raw).get("error", "")
+            except Exception:
+                msg = raw[:200].decode("utf-8", "replace")
+            raise RemoteError(resp.status, msg)

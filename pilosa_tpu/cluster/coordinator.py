@@ -23,7 +23,6 @@ import time
 from pilosa_tpu.cluster.client import InternalClient, RemoteError
 from pilosa_tpu.cluster.disco import DisCo, InMemDisCo, Node, NodeState
 from pilosa_tpu.cluster.snapshot import ClusterSnapshot
-from pilosa_tpu.cluster.txn import TransactionManager
 from pilosa_tpu.pql import parse
 
 # network failures that trigger replica failover (executor.go:6505
@@ -58,7 +57,9 @@ class ClusterNode:
         self.node_id = node_id
         self.disco = disco
         self.replica_n = replica_n
-        self.txns = TransactionManager()
+        # ONE manager per node, shared with the API's HTTP endpoints —
+        # two would let an exclusive transaction and a write disagree
+        self.txns = self.api.txns
         self.uri = f"127.0.0.1:{self.server.port}"
         self._hb_interval = heartbeat_interval
         self._hb_stop = threading.Event()

@@ -70,6 +70,15 @@ class TransactionManager:
             self._txs[tid] = tx
             return _copy(tx)
 
+    def exclusive_active(self) -> bool:
+        """True while an ACTIVE exclusive transaction holds the
+        cluster read-only (transaction.go: writes are refused while a
+        backup's exclusive transaction runs)."""
+        with self._lock:
+            self._expire_locked()
+            return any(t.exclusive and t.active
+                       for t in self._txs.values())
+
     def finish(self, tid: str) -> Transaction:
         with self._lock:
             tx = self._txs.pop(tid, None)

@@ -49,3 +49,31 @@ class APIImporter(Importer):
 
     def apply_schema(self, schema):
         self.api.apply_schema(schema)
+
+
+class HTTPImporter(Importer):
+    """Importer over the HTTP import endpoints of a remote node — the
+    client-side half of the reference's shard-transactional import
+    path (client/client.go import; api.go:618)."""
+
+    def __init__(self, host: str, client=None):
+        from pilosa_tpu.cluster.client import InternalClient
+        self.host = host
+        self.client = client or InternalClient()
+
+    def import_bits(self, index, field, rows, cols, timestamps=None,
+                    clear=False):
+        return self.client.import_bits(self.host, index, field,
+                                       rows, cols, timestamps=timestamps,
+                                       clear=clear)
+
+    def import_values(self, index, field, cols, values, clear=False):
+        return self.client.import_values(self.host, index, field,
+                                         cols, values, clear=clear)
+
+    def create_keys(self, index, field, keys):
+        ids = self.client.create_keys(self.host, index, field, list(keys))
+        return dict(zip(keys, ids))
+
+    def apply_schema(self, schema):
+        self.client._request(self.host, "POST", "/schema", schema)

@@ -71,12 +71,28 @@ def decode_jwt(token: str, secret: bytes) -> dict:
         raise AuthError("malformed token claims")
     if not isinstance(claims, dict):
         raise AuthError("malformed token claims")
-    now = time.time()
-    if "exp" in claims and now >= float(claims["exp"]):
-        raise AuthError("token expired")
-    if "nbf" in claims and now < float(claims["nbf"]):
-        raise AuthError("token not yet valid")
+    _check_time_claims(claims)
     return claims
+
+
+def _claim_num(claims: dict, name: str) -> float | None:
+    v = claims.get(name)
+    if v is None:
+        return None
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        raise AuthError(f"malformed {name} claim")
+
+
+def _check_time_claims(claims: dict) -> None:
+    now = time.time()
+    exp = _claim_num(claims, "exp")
+    if exp is not None and now >= exp:
+        raise AuthError("token expired")
+    nbf = _claim_num(claims, "nbf")
+    if nbf is not None and now < nbf:
+        raise AuthError("token not yet valid")
 
 
 class Authenticator:
@@ -107,8 +123,7 @@ class Authenticator:
         now = time.time()
         if hit and now - hit[0] < self.cache_ttl:
             claims = hit[1]
-            if "exp" in claims and now >= float(claims["exp"]):
-                raise AuthError("token expired")
+            _check_time_claims(claims)
             return claims
         claims = decode_jwt(token, self.secret)
         self._cache[token] = (now, claims)

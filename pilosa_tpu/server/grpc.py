@@ -257,17 +257,14 @@ class GRPCHandler:
 
     def _sql_results(self, request, ctx):
         claims = self._check(ctx, None, write=False)
-        engine = self.sql
+        auth_check = None
         if self.auth is not None and self.auth[1] is not None:
             # per-statement table authz (the reference checks each
             # resolved table during SQL planning)
-            from pilosa_tpu.sql.engine import SQLEngine
-            engine = SQLEngine(
-                self.api.holder,
-                auth_check=self.auth[1].sql_check(
-                    claims.get("groups", [])))
+            auth_check = self.auth[1].sql_check(claims.get("groups", []))
         try:
-            return engine.query(request.sql)
+            return self.sql.query(request.sql, auth_check=auth_check,
+                                  write_guard=self.api._check_writable)
         except PermissionError as e:
             ctx.abort(grpc.StatusCode.PERMISSION_DENIED, str(e))
         except Exception as e:

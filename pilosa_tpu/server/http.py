@@ -128,6 +128,19 @@ class Server:
         r(Route("GET", "/metrics.json",
                 lambda req: metrics.registry.render_json()))
         r(Route("GET", "/login", self._get_login))
+        r(Route("POST", "/transaction", self._post_transaction))
+        r(Route("POST", "/transaction/{tid}/finish",
+                lambda req: self.api.finish_transaction(req.vars["tid"])))
+        r(Route("GET", "/transaction/{tid}",
+                lambda req: self.api.get_transaction(req.vars["tid"])))
+        r(Route("GET", "/transactions",
+                lambda req: self.api.txns.list()))
+        r(Route("GET", "/internal/backup/manifest",
+                lambda req: self.api.backup_manifest()))
+        r(Route("GET", "/internal/backup/file", self._get_backup_file))
+        r(Route("POST", "/internal/restore/file", self._post_restore_file))
+        r(Route("POST", "/internal/restore/complete",
+                lambda req: self.api.restore_complete()))
 
     # paths served without a token when auth is enabled
     # (http_handler.go: login/metrics/version stay open)
@@ -156,8 +169,12 @@ class Server:
         if authz_ is None:
             return
         groups = claims.get("groups", [])
-        if path.startswith("/internal") or (
+        if path.startswith("/internal") or \
+                path.startswith("/transaction") or (
                 path == "/schema" and method != "GET"):
+            # transactions included: an exclusive transaction holds the
+            # whole cluster read-only, so starting/finishing one is an
+            # operator action
             if not authz_.is_admin(groups):
                 raise ApiError("admin required", 403)
             return
@@ -219,6 +236,22 @@ class Server:
             return self.api.sql(stmt, auth_check=auth_check)
         except PermissionError as e:
             raise ApiError(str(e), 403)
+
+    def _post_transaction(self, req):
+        body = req.json_lenient() or {}
+        return self.api.start_transaction(
+            id=body.get("id"), exclusive=bool(body.get("exclusive")),
+            timeout=body.get("timeout"))
+
+    def _get_backup_file(self, req):
+        rel = req.query.get("path", [""])[0]
+        return RawResponse(self.api.backup_file(rel),
+                           "application/octet-stream")
+
+    def _post_restore_file(self, req):
+        rel = req.query.get("path", [""])[0]
+        self.api.restore_file(rel, req._raw or b"")
+        return {}
 
     def _get_schema(self, req):
         schema = self.api.schema()
@@ -301,7 +334,7 @@ class Server:
 
 
 class RawResponse:
-    def __init__(self, body: str, content_type: str):
+    def __init__(self, body: str | bytes, content_type: str):
         self.body = body
         self.content_type = content_type
 
@@ -358,7 +391,8 @@ def _make_handler(server: Server):
 
         def _send(self, status: int, result):
             if isinstance(result, RawResponse):
-                body = result.body.encode()
+                body = (result.body if isinstance(result.body, bytes)
+                        else result.body.encode())
                 ctype = result.content_type
             else:
                 body = json.dumps(result).encode()

@@ -57,13 +57,9 @@ def _sql_type(f) -> str:
 
 
 class SQLEngine:
-    def __init__(self, holder: Holder, auth_check=None):
+    def __init__(self, holder: Holder):
         self.holder = holder
         self.executor = Executor(holder)
-        # auth_check(table_or_None, "read"|"write") raises on denial —
-        # the SQL-side authz hook (the reference resolves table names
-        # during planning and consults authz per table)
-        self.auth_check = auth_check
 
     @staticmethod
     def _stmt_access(stmt) -> tuple[str | None, str]:
@@ -78,38 +74,53 @@ class SQLEngine:
                 "write"
         return None, "write"
 
-    def query(self, sql: str) -> list[SQLResult]:
+    def query(self, sql: str, auth_check=None,
+              write_guard=None) -> list[SQLResult]:
+        """Execute statements.
+
+        auth_check(table_or_None, "read"|"write") raises on denial —
+        the SQL-side authz hook (the reference resolves table names
+        during planning and consults authz per table).  write_guard()
+        is called once when any statement writes (the exclusive-
+        transaction read-only gate).
+        """
         from pilosa_tpu.executor.executor import ExecError
         try:
             stmts = parse_sql(sql)
-            if self.auth_check is not None:
+            if write_guard is not None and any(
+                    self._stmt_access(s)[1] == "write" for s in stmts):
+                write_guard()
+            if auth_check is not None:
                 for stmt in stmts:
-                    self.auth_check(*self._stmt_access(stmt))
-            return [self._execute(stmt) for stmt in stmts]
+                    auth_check(*self._stmt_access(stmt))
+            return [self._execute(stmt, auth_check) for stmt in stmts]
         except ExecError as e:  # surface executor errors as SQL errors
             raise SQLError(str(e)) from e
 
-    def query_one(self, sql: str) -> SQLResult:
-        return self.query(sql)[-1]
+    def query_one(self, sql: str, auth_check=None,
+                  write_guard=None) -> SQLResult:
+        return self.query(sql, auth_check, write_guard)[-1]
 
-    def _can_read(self, table: str) -> bool:
+    @staticmethod
+    def _can_read(auth_check, table: str) -> bool:
         try:
-            self.auth_check(table, "read")
+            auth_check(table, "read")
             return True
         except Exception:
             return False
 
     # ------------------------------------------------------------------
 
-    def _execute(self, stmt) -> SQLResult:
+    def _execute(self, stmt, auth_check=None) -> SQLResult:
         if isinstance(stmt, ast.CreateTable):
             return self._create_table(stmt)
         if isinstance(stmt, ast.DropTable):
             return self._drop_table(stmt)
         if isinstance(stmt, ast.ShowTables):
             names = sorted(self.holder.indexes)
-            if self.auth_check is not None:
-                names = [n for n in names if self._can_read(n)]
+            if auth_check is not None:
+                names = [n for n in names
+                         if self._can_read(auth_check, n)]
             return SQLResult(schema=[("name", "string")],
                              rows=[(n,) for n in names])
         if isinstance(stmt, ast.ShowColumns):

@@ -1,0 +1,175 @@
+"""CLI + backup/restore tests (ctl/backup.go, ctl/restore.go flow;
+qa/scripts/backupRestoreTest.sh gauntlet shape)."""
+
+import io
+import json
+import os
+
+import pytest
+
+from pilosa_tpu.cli.main import main
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.server.http import Server
+
+SHARD = 1 << 20
+
+
+@pytest.fixture()
+def node(tmp_path):
+    holder = Holder(path=str(tmp_path / "data"))
+    srv = Server(holder=holder).start()
+    yield srv, holder, f"127.0.0.1:{srv.port}"
+    srv.close()
+
+
+def _seed(api):
+    api.apply_schema({"indexes": [{"name": "b", "keys": False, "fields": [
+        {"name": "f", "options": {"type": "set"}},
+        {"name": "v", "options": {"type": "int", "min": 0, "max": 500}},
+    ]}]})
+    cols = [1, 2, SHARD + 3, 2 * SHARD + 4]
+    api.import_bits("b", "f", rows=[1, 1, 2, 1], cols=cols)
+    api.import_values("b", "v", cols=cols, values=[10, 20, 30, 40])
+
+
+def test_backup_restore_roundtrip(node, tmp_path):
+    srv, holder, host = node
+    _seed(srv.api)
+    assert srv.api.query("b", "Count(Row(f=1))")["results"] == [3]
+
+    bdir = str(tmp_path / "bkp")
+    assert main(["backup", "--host", host, "--output-dir", bdir,
+                 "--quiet"]) == 0
+    man = json.load(open(os.path.join(bdir, "MANIFEST.json")))
+    assert any(f.endswith(".rbf") for f in man["files"])
+    assert "schema.json" in man["files"]
+    # transaction released
+    assert srv.api.txns.list() == {}
+
+    # restore into a FRESH node
+    holder2 = Holder(path=str(tmp_path / "data2"))
+    srv2 = Server(holder=holder2).start()
+    try:
+        host2 = f"127.0.0.1:{srv2.port}"
+        assert main(["restore", "--host", host2, "--source-dir", bdir,
+                     "--quiet"]) == 0
+        assert srv2.api.query("b", "Count(Row(f=1))")["results"] == [3]
+        r = srv2.api.query("b", "Sum(Row(f=1), field=v)")["results"][0]
+        assert r == {"value": 70, "count": 3}
+        assert srv2.api.query("b", "Row(f=2)")["results"][0][
+            "columns"] == [SHARD + 3]
+    finally:
+        srv2.close()
+
+
+def test_backup_path_traversal_rejected(node):
+    srv, holder, host = node
+    from pilosa_tpu.cluster.client import InternalClient, RemoteError
+    cli = InternalClient()
+    with pytest.raises(RemoteError) as e:
+        cli.get_raw(host, "/internal/backup/file?path=../../etc/passwd")
+    assert e.value.status == 400
+
+
+def test_transactions_http(node):
+    srv, holder, host = node
+    from pilosa_tpu.cluster.client import InternalClient, RemoteError
+    cli = InternalClient()
+    tx = cli._request(host, "POST", "/transaction", {"exclusive": True})
+    assert tx["active"] is True and tx["exclusive"] is True
+    # second exclusive rejected while one is pending/active
+    with pytest.raises(RemoteError) as e:
+        cli._request(host, "POST", "/transaction", {"exclusive": True})
+    assert e.value.status == 409
+    cli._request(host, "POST", f"/transaction/{tx['id']}/finish")
+    assert cli._request(host, "GET", "/transactions") == {}
+
+
+def test_cli_import_and_export(node, tmp_path, capsys):
+    srv, holder, host = node
+    csv = tmp_path / "data.csv"
+    csv.write_text(
+        "_id,color:string,size:int\n"
+        "1,red,10\n2,blue,20\n3,red,30\n")
+    assert main(["import", "--host", host, "-i", "ci",
+                 str(csv)]) == 0
+    out = capsys.readouterr().out
+    assert "imported 3 records" in out
+    r = srv.api.sql("SELECT COUNT(*) FROM ci WHERE color = 'red'")
+    assert r["data"][0][0] == 2
+
+
+def test_cli_version_and_config(capsys):
+    assert main(["version"]) == 0
+    assert main(["generate-config"]) == 0
+    out = capsys.readouterr().out
+    assert "data-dir" in out
+
+
+def test_cli_keygen_roundtrip(capsys):
+    assert main(["keygen", "--secret", "s3cr3t",
+                 "--groups", "a,b"]) == 0
+    tok = capsys.readouterr().out.strip()
+    from pilosa_tpu.server.authn import decode_jwt
+    claims = decode_jwt(tok, b"s3cr3t")
+    assert claims["groups"] == ["a", "b"]
+
+
+def test_cli_rbf_inspect(node, tmp_path, capsys):
+    srv, holder, host = node
+    _seed(srv.api)
+    holder.sync()
+    rbf_files = []
+    for root, _, fns in os.walk(holder.path):
+        rbf_files += [os.path.join(root, f) for f in fns
+                      if f.endswith(".rbf")]
+    assert rbf_files
+    assert main(["rbf", rbf_files[0]]) == 0
+    out = capsys.readouterr().out
+    assert "bitmaps:" in out
+
+
+def test_fbsql_shell(node, capsys):
+    srv, holder, host = node
+    from pilosa_tpu.cli.fbsql import Shell
+    from pilosa_tpu.cluster.client import InternalClient
+    sh = Shell(host, InternalClient())
+    out = io.StringIO()
+    sh.execute("CREATE TABLE s (_id ID, x INT MIN 0 MAX 9);", out)
+    sh.execute("INSERT INTO s (_id, x) VALUES (1, 5), (2, 7);", out)
+    sh.execute("SELECT _id, x FROM s ORDER BY x DESC;", out)
+    text = out.getvalue()
+    assert "_id" in text and "7" in text
+    # meta commands
+    out2 = io.StringIO()
+    sh.execute("\\d", out2)
+    assert "s" in out2.getvalue()
+    assert sh.execute("\\q", out2) is False
+    out3 = io.StringIO()
+    sh.execute("SELECT bogus FROM nope;", out3)
+    assert "ERROR" in out3.getvalue()
+
+
+def test_exclusive_transaction_blocks_writes(node):
+    """While an exclusive transaction is active, imports, PQL writes,
+    and SQL writes are refused with 409 (the backup quiesce)."""
+    srv, holder, host = node
+    _seed(srv.api)
+    from pilosa_tpu.api import ApiError
+    tx = srv.api.start_transaction(exclusive=True)
+    assert tx["active"]
+    with pytest.raises(ApiError) as e:
+        srv.api.import_bits("b", "f", rows=[1], cols=[9])
+    assert e.value.status == 409
+    with pytest.raises(ApiError) as e:
+        srv.api.query("b", "Set(9, f=1)")
+    assert e.value.status == 409
+    with pytest.raises(ApiError) as e:
+        srv.api.sql("INSERT INTO b (_id, v) VALUES (9, 1)")
+    assert e.value.status == 409
+    # reads still work
+    assert srv.api.query("b", "Count(Row(f=1))")["results"] == [3]
+    assert srv.api.sql("SELECT COUNT(*) FROM b")["data"][0][0] == 4
+    srv.api.finish_transaction(tx["id"])
+    # writable again
+    srv.api.import_bits("b", "f", rows=[1], cols=[9])
