@@ -1,0 +1,32 @@
+"""DAX — disaggregated serverless deployment (dax/, SURVEY §2.8).
+
+The reference's storage/compute split: stateless *compute* workers own
+table-shard "jobs" assigned by a *controller*; all durable state lives
+in a shared write-log + snapshot store, so any worker can pick up any
+shard by loading its snapshot and replaying the log.  A stateless
+*queryer* fans queries out to whichever workers currently own the
+touched shards.
+
+This maps directly onto the TPU build's own split (host storage is
+the source of truth, device state is a cache): a compute worker is a
+controller process driving one TPU slice; elastic recovery is
+"replay the log into a fresh worker".
+
+Components (reference files):
+    Controller  — dax/controller/, balancer/balancer.go, poller/poller.go
+    Directive   — dax/directive.go:8; api_directive.go:19,172,559
+    Computer    — dax/computer/
+    Queryer     — dax/queryer/queryer.go:34, orchestrator.go:83
+    WriteLogger — dax/writelogger/writelogger.go:22
+    Snapshotter — dax/snapshotter/snapshotter.go:24
+"""
+
+from pilosa_tpu.dax.controller import Controller
+from pilosa_tpu.dax.computer import ComputeNode
+from pilosa_tpu.dax.directive import Directive
+from pilosa_tpu.dax.queryer import Queryer
+from pilosa_tpu.dax.snapshotter import Snapshotter
+from pilosa_tpu.dax.writelogger import WriteLogger
+
+__all__ = ["Controller", "ComputeNode", "Directive", "Queryer",
+           "Snapshotter", "WriteLogger"]

@@ -1,0 +1,164 @@
+"""ComputeNode — a stateless worker serving assigned shard jobs.
+
+Reference: dax/computer/ + api_directive.go.  A worker is an ordinary
+engine node (holder + API + HTTP) whose data is entirely reconstructed
+from shared storage: on receiving a Directive it diffs desired vs held
+shard jobs, loads newly assigned shards from the latest snapshot plus
+the write-log tail (api_directive.go:559 loadShard), and drops
+revoked ones.  All writes append to the WriteLogger BEFORE applying
+locally, so worker loss never loses acknowledged writes.
+
+TPU note: "apply locally" lands the bits in host fragments whose
+device tiles refresh lazily — recovery is host-side log replay; the
+chip just re-caches.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from pilosa_tpu.dax.directive import Directive
+from pilosa_tpu.dax.snapshotter import (
+    Snapshotter,
+    load_fragment_rows,
+    snapshot_fragment_rows,
+)
+from pilosa_tpu.dax.writelogger import WriteLogger
+
+
+class ComputeNode:
+    def __init__(self, address: str, writelogger: WriteLogger,
+                 snapshotter: Snapshotter, bind: str = "127.0.0.1"):
+        from pilosa_tpu.models.holder import Holder
+        from pilosa_tpu.server.http import Server
+        self.address = address
+        self.wl = writelogger
+        self.snaps = snapshotter
+        self.server = Server(holder=Holder(), bind=bind)
+        self.api = self.server.api
+        self.directive_version = -1
+        # table -> set of shards this worker currently serves
+        self.held: dict[str, set[int]] = {}
+        self._lock = threading.Lock()
+        self.server.add_route("POST", "/directive", self._post_directive)
+        self.server.add_route("POST", "/dax/import", self._post_import)
+        self.server.add_route("GET", "/dax/held",
+                              lambda req: {t: sorted(s) for t, s in
+                                           self.held.items()})
+
+    # -- lifecycle -----------------------------------------------------
+
+    def open(self):
+        self.server.start()
+        self.uri = f"127.0.0.1:{self.server.port}"
+        return self
+
+    def close(self):
+        self.server.close()
+
+    # -- directive enactment (api_directive.go:19,172) -----------------
+
+    def _post_directive(self, req):
+        d = Directive.from_dict(req.json())
+        self.apply_directive(d)
+        return {"applied": d.version}
+
+    def apply_directive(self, d: Directive):
+        with self._lock:
+            if d.version <= self.directive_version:
+                return  # stale directive (api_directive.go version gate)
+            if d.schema:
+                self.api.apply_schema(d.schema)
+            for table, want in d.assignments.items():
+                want = set(want)
+                have = self.held.get(table, set())
+                for shard in sorted(want - have):
+                    self._load_shard(table, shard)
+                for shard in sorted(have - want):
+                    self._drop_shard(table, shard)
+                self.held[table] = want
+            for table in list(self.held):
+                if table not in d.assignments:
+                    for shard in sorted(self.held[table]):
+                        self._drop_shard(table, shard)
+                    del self.held[table]
+            self.directive_version = d.version
+
+    def _load_shard(self, table: str, shard: int):
+        """snapshot + write-log tail -> local fragments
+        (api_directive.go:559 loadShard)."""
+        idx = self.api.holder.index(table)
+        if idx is None:
+            return
+        version = 0
+        snap = self.snaps.latest(table, shard)
+        if snap is not None:
+            version, blob = snap
+            for (fname, view, row), words in load_fragment_rows(
+                    blob).items():
+                f = idx.field(fname)
+                if f is None:
+                    continue
+                frag = f.view(view, create=True).fragment(
+                    shard, create=True)
+                frag._row_mut(row)[:] = words
+        for e in self.wl.replay(table, shard, from_version=version):
+            self._apply_entry(e)
+
+    def _drop_shard(self, table: str, shard: int):
+        idx = self.api.holder.index(table)
+        if idx is None:
+            return
+        for f in idx.fields.values():
+            for v in f.views.values():
+                v.fragments.pop(shard, None)
+
+    # -- writes: log first, then apply ---------------------------------
+
+    def _post_import(self, req):
+        e = req.json()
+        table, shard = e["table"], int(e["shard"])
+        with self._lock:
+            if shard not in self.held.get(table, set()):
+                from pilosa_tpu.api import ApiError
+                raise ApiError(
+                    f"worker does not hold {table}/shard {shard}", 409)
+            self.wl.append(table, shard, e)
+            n = self._apply_entry(e)
+        return {"imported": n}
+
+    def _apply_entry(self, e: dict) -> int:
+        if e["op"] == "bits":
+            return self.api.import_bits(
+                e["table"], e["field"], rows=e["rows"], cols=e["cols"],
+                timestamps=e.get("timestamps"))
+        if e["op"] == "values":
+            return self.api.import_values(
+                e["table"], e["field"], cols=e["cols"],
+                values=e["values"])
+        raise ValueError(f"unknown write-log op {e['op']!r}")
+
+    # -- snapshotting (dax/snapshotter; checkpoint = snapshot + trunc) --
+
+    def snapshot_shard(self, table: str, shard: int):
+        # under _lock vs concurrent _post_import: the recorded log
+        # version must match the fragment rows exactly, or recovery
+        # replays the wrong tail and drops an acknowledged write
+        with self._lock:
+            self._snapshot_shard_locked(table, shard)
+
+    def _snapshot_shard_locked(self, table: str, shard: int):
+        idx = self.api.holder.index(table)
+        if idx is None:
+            return
+        version = self.wl.version(table, shard)
+        rows = {}
+        for f in idx.fields.values():
+            for v in f.views.values():
+                frag = v.fragment(shard)
+                if frag is None:
+                    continue
+                for r in frag.row_ids:
+                    rows[(f.name, v.name, r)] = frag.row_words(r)
+        self.snaps.write(table, shard, version,
+                         snapshot_fragment_rows(rows))

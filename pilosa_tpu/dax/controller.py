@@ -1,0 +1,177 @@
+"""Controller — worker registry, shard-job balancer, health poller.
+
+Reference: dax/controller/ — RegisterNode/DeregisterNode, the
+balancer spreading table-shard jobs across workers
+(balancer/balancer.go), the schemar (schema store), and the Poller
+that health-checks workers and triggers rebalancing when one dies
+(poller/poller.go:14-60): dead worker -> its jobs reassign to
+survivors -> new Directives pushed -> workers recover the shards from
+snapshot + write-log.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from pilosa_tpu.cluster.client import InternalClient
+from pilosa_tpu.cluster.hash import jump_hash
+from pilosa_tpu.dax.directive import Directive
+from pilosa_tpu.storage.translate import shard_to_shard_partition
+
+
+class NoWorkersError(Exception):
+    pass
+
+
+def _place(table: str, shard: int, addrs: list[str]) -> str:
+    """Stable shard-job placement: fnv partition -> jump hash onto the
+    sorted worker list (balancer/balancer.go goal; same scheme as the
+    cluster layer, disco/hasher.go:16).  Adding a shard or a worker
+    moves only ~1/n of the jobs — no mass snapshot+replay churn."""
+    p = shard_to_shard_partition(table, shard)
+    return addrs[jump_hash(p, len(addrs))]
+
+
+class Controller:
+    def __init__(self, poll_interval: float = 1.0):
+        self.workers: dict[str, str] = {}       # address -> uri
+        self.schema: dict = {}
+        # table -> sorted shard ids registered for it
+        self.tables: dict[str, set[int]] = {}
+        self._versions: dict[str, int] = {}     # per-worker directive ver
+        self._lock = threading.RLock()
+        self._poll_interval = poll_interval
+        self._poll_stop = threading.Event()
+        self._poll_thread: threading.Thread | None = None
+        self._client = InternalClient(timeout=5.0)
+
+    # -- registry ------------------------------------------------------
+
+    def register_worker(self, address: str, uri: str):
+        with self._lock:
+            self.workers[address] = uri
+            self._rebalance_locked()
+
+    def deregister_worker(self, address: str):
+        with self._lock:
+            self.workers.pop(address, None)
+            self._versions.pop(address, None)
+            self._rebalance_locked()
+
+    # -- schema (dax/controller schemar) -------------------------------
+
+    def apply_schema(self, schema: dict):
+        with self._lock:
+            self.schema = schema
+            for ix in schema.get("indexes", []):
+                self.tables.setdefault(ix["name"], set())
+            self._push_directives_locked()
+
+    def add_shards(self, table: str, shards):
+        """New shards observed (ingest registers them before writing)."""
+        with self._lock:
+            have = self.tables.setdefault(table, set())
+            new = set(map(int, shards)) - have
+            if not new:
+                return
+            have |= new
+            self._push_directives_locked()
+
+    # -- balance (balancer/balancer.go) --------------------------------
+
+    def assignments(self) -> dict[str, dict[str, list[int]]]:
+        """worker address -> {table: [shards]} under the current
+        balance."""
+        with self._lock:
+            return self._assignments_locked()
+
+    def _assignments_locked(self) -> dict[str, dict[str, list[int]]]:
+        addrs = sorted(self.workers)
+        out = {a: {} for a in addrs}
+        if not addrs:
+            return out
+        for table, shards in sorted(self.tables.items()):
+            for shard in sorted(shards):
+                a = _place(table, shard, addrs)
+                out[a].setdefault(table, []).append(shard)
+        return out
+
+    def worker_for(self, table: str, shard: int) -> tuple[str, str]:
+        """(address, uri) of the worker owning a shard job."""
+        with self._lock:
+            addrs = sorted(self.workers)
+            if not addrs:
+                raise NoWorkersError("no compute workers registered")
+            a = _place(table, shard, addrs)
+            return a, self.workers[a]
+
+    def _rebalance_locked(self):
+        self._push_directives_locked()
+
+    def _push_directives_locked(self):
+        """Compute the plan under the lock, POST directives OUTSIDE it
+        (a hung worker must not stall worker_for/add_shards for its
+        whole HTTP timeout), then prune workers that refused."""
+        while True:
+            plan = self._assignments_locked()
+            targets = []
+            for addr, asg in plan.items():
+                self._versions[addr] = self._versions.get(addr, 0) + 1
+                targets.append((addr, self.workers[addr], Directive(
+                    address=addr, version=self._versions[addr],
+                    schema=self.schema, assignments=asg)))
+            self._lock.release()
+            dead = []
+            try:
+                for addr, uri, d in targets:
+                    try:
+                        self._client._request(uri, "POST", "/directive",
+                                              d.to_dict())
+                    except Exception:
+                        dead.append(addr)
+            finally:
+                self._lock.acquire()
+            if not dead:
+                return
+            for addr in dead:
+                # a worker that can't take its directive is gone;
+                # removing it reassigns its jobs to the survivors
+                self.workers.pop(addr, None)
+                self._versions.pop(addr, None)
+            if not self.workers:
+                return
+
+    # -- poller (dax/controller/poller/poller.go) ----------------------
+
+    def start_poller(self):
+        self._poll_thread = threading.Thread(target=self._poll_loop,
+                                             daemon=True)
+        self._poll_thread.start()
+        return self
+
+    def stop_poller(self):
+        self._poll_stop.set()
+        if self._poll_thread:
+            self._poll_thread.join(timeout=2)
+
+    def _poll_loop(self):
+        while not self._poll_stop.wait(self._poll_interval):
+            self.poll_once()
+
+    def poll_once(self):
+        """Health-check every worker; rebalance away from dead ones."""
+        with self._lock:
+            workers = dict(self.workers)
+        dead = []
+        for addr, uri in workers.items():
+            try:
+                self._client._request(uri, "GET", "/status")
+            except Exception:
+                dead.append(addr)
+        if dead:
+            with self._lock:
+                for addr in dead:
+                    self.workers.pop(addr, None)
+                    self._versions.pop(addr, None)
+                self._rebalance_locked()
+        return dead

@@ -1,0 +1,105 @@
+"""Queryer — stateless query front end over compute workers.
+
+Reference: dax/queryer/queryer.go:34 + orchestrator.go:83 — a
+re-implementation of the executor's mapReduce that asks the
+Controller which workers own the touched shards, fans the PQL out per
+worker, and reduces the serialized partials (the same cross-node
+reducers the cluster layer uses).
+
+Writes route through the queryer too: each (table, shard) import goes
+to its owning worker, which write-logs before applying.  SQL fronting
+(the reference embeds the sql3 planner here) rides on the same
+orchestration and is deliberately PQL-first in this build; DDL and
+ingest are covered via apply_schema/import_*.
+"""
+
+from __future__ import annotations
+
+from pilosa_tpu.cluster.client import InternalClient
+from pilosa_tpu.cluster.coordinator import _reduce
+from pilosa_tpu.dax.controller import Controller
+from pilosa_tpu.pql import parse
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+def _empty_result(call):
+    """Zero-value for a call over zero shards — matches what a node
+    returns for an empty index (single-node semantics)."""
+    name = call.name
+    if name == "Count":
+        return 0
+    if name in ("Sum", "Min", "Max"):
+        return {"value": None if name != "Sum" else 0, "count": 0}
+    if name in ("TopN", "TopK", "Rows", "GroupBy"):
+        return []
+    if name == "Distinct":
+        return {"values": []}
+    return {"columns": []}
+
+
+class Queryer:
+    def __init__(self, controller: Controller):
+        self.controller = controller
+        self._client = InternalClient()
+
+    # -- schema / ingest ----------------------------------------------
+
+    def apply_schema(self, schema: dict):
+        self.controller.apply_schema(schema)
+
+    def _group_by_shard(self, cols, width: int = SHARD_WIDTH):
+        groups: dict[int, list[int]] = {}
+        for i, c in enumerate(cols):
+            groups.setdefault(int(c) // width, []).append(i)
+        return groups
+
+    def import_bits(self, table: str, field: str, rows, cols) -> int:
+        n = 0
+        groups = self._group_by_shard(cols)
+        self.controller.add_shards(table, groups.keys())
+        for shard, idxs in groups.items():
+            _, uri = self.controller.worker_for(table, shard)
+            r = self._client._request(uri, "POST", "/dax/import", {
+                "op": "bits", "table": table, "field": field,
+                "shard": shard,
+                "rows": [int(rows[i]) for i in idxs],
+                "cols": [int(cols[i]) for i in idxs]})
+            n += r["imported"]
+        return n
+
+    def import_values(self, table: str, field: str, cols, values) -> int:
+        n = 0
+        groups = self._group_by_shard(cols)
+        self.controller.add_shards(table, groups.keys())
+        for shard, idxs in groups.items():
+            _, uri = self.controller.worker_for(table, shard)
+            r = self._client._request(uri, "POST", "/dax/import", {
+                "op": "values", "table": table, "field": field,
+                "shard": shard,
+                "cols": [int(cols[i]) for i in idxs],
+                "values": [values[i] for i in idxs]})
+            n += r["imported"]
+        return n
+
+    # -- reads (orchestrator.go:83 Execute) ----------------------------
+
+    def query(self, table: str, pql: str) -> dict:
+        q = parse(pql)
+        shards = sorted(self.controller.tables.get(table, ()))
+        # group shards by owning worker (ComputeNodes in the reference)
+        by_worker: dict[str, list[int]] = {}
+        uris: dict[str, str] = {}
+        for s in shards:
+            addr, uri = self.controller.worker_for(table, s)
+            by_worker.setdefault(addr, []).append(s)
+            uris[addr] = uri
+        partials = []
+        for addr in sorted(by_worker):
+            resp = self._client.query_node(uris[addr], table, pql,
+                                           by_worker[addr])
+            partials.append(resp["results"])
+        if not partials:
+            return {"results": [_empty_result(c) for c in q.calls]}
+        return {"results": [
+            _reduce(q.calls[ci], [p[ci] for p in partials])
+            for ci in range(len(q.calls))]}

@@ -1,0 +1,52 @@
+"""DAX service host — controller + queryer (+ workers) in one process.
+
+Reference: dax/server/ — one binary can host any combination of the
+controller, queryer, and computer services; tests and small
+deployments run them all in-process (the test.Cluster analog for
+DAX).
+"""
+
+from __future__ import annotations
+
+import os
+
+from pilosa_tpu.dax.computer import ComputeNode
+from pilosa_tpu.dax.controller import Controller
+from pilosa_tpu.dax.queryer import Queryer
+from pilosa_tpu.dax.snapshotter import Snapshotter
+from pilosa_tpu.dax.writelogger import WriteLogger
+
+
+class DAXService:
+    """All three services over one shared storage directory."""
+
+    def __init__(self, storage_dir: str, n_workers: int = 2,
+                 poll_interval: float = 0.5):
+        self.wl = WriteLogger(os.path.join(storage_dir, "writelog"))
+        self.snaps = Snapshotter(os.path.join(storage_dir, "snapshots"))
+        self.controller = Controller(poll_interval=poll_interval)
+        self.queryer = Queryer(self.controller)
+        self.workers: list[ComputeNode] = []
+        for i in range(n_workers):
+            self.add_worker(f"worker{i}")
+
+    def add_worker(self, address: str) -> ComputeNode:
+        w = ComputeNode(address, self.wl, self.snaps).open()
+        self.workers.append(w)
+        self.controller.register_worker(address, w.uri)
+        return w
+
+    def kill_worker(self, address: str):
+        """Fault injection: stop the worker WITHOUT deregistering —
+        the poller must notice (poller/poller.go behavior)."""
+        for w in self.workers:
+            if w.address == address:
+                w.close()
+
+    def close(self):
+        self.controller.stop_poller()
+        for w in self.workers:
+            try:
+                w.close()
+            except Exception:
+                pass

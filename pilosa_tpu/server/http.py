@@ -37,11 +37,13 @@ from pilosa_tpu.obs.logger import Logger, NopLogger
 
 
 class Route:
-    def __init__(self, method: str, pattern: str, fn):
+    def __init__(self, method: str, pattern: str, fn,
+                 admin_only: bool = False):
         self.method = method
         self.re = re.compile("^" + re.sub(
             r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern) + "$")
         self.fn = fn
+        self.admin_only = admin_only
 
 
 class Server:
@@ -152,7 +154,8 @@ class Server:
         authn_, _ = self.auth
         return {"url": authn_.login_url()}
 
-    def _check_auth(self, method: str, path: str, req):
+    def _check_auth(self, method: str, path: str, req,
+                    admin_only: bool = False):
         """chkAuthZ middleware (http_handler.go chkAuthZ): validate the
         bearer token, then require read (GET) / write (other) on the
         route's index, or admin for /internal + schema writes."""
@@ -169,7 +172,7 @@ class Server:
         if authz_ is None:
             return
         groups = claims.get("groups", [])
-        if path.startswith("/internal") or \
+        if admin_only or path.startswith("/internal") or \
                 path.startswith("/transaction") or (
                 path == "/schema" and method != "GET"):
             # transactions included: an exclusive transaction holds the
@@ -192,6 +195,16 @@ class Server:
         if not authz_.allowed(groups, index, need):
             raise ApiError(f"not authorized for {need} on {index}", 403)
 
+    def add_route(self, method: str, pattern: str, fn,
+                  admin_only: bool = True):
+        """Register an extra route (embedding services — DAX compute
+        nodes hang /directive etc. off the same listener).  Injected
+        routes default to admin-only under auth: the middleware's
+        per-index rules don't know them, and cluster-internal control
+        surfaces must not be reachable with a mere read token."""
+        self._routes.append(Route(method, pattern, fn,
+                                  admin_only=admin_only))
+
     def dispatch(self, method: str, path: str, req) -> tuple[int, object]:
         for rt in self._routes:
             if rt.method != method:
@@ -200,7 +213,8 @@ class Server:
             if m:
                 req.vars = m.groupdict()
                 try:
-                    self._check_auth(method, path, req)
+                    self._check_auth(method, path, req,
+                                     admin_only=rt.admin_only)
                     return 200, rt.fn(req)
                 except ApiError as e:
                     return e.status, {"error": str(e)}
