@@ -41,27 +41,36 @@ def _client(args):
 # ---------------------------------------------------------------------------
 
 def cmd_server(args) -> int:
+    from pilosa_tpu import config as cfgmod
     from pilosa_tpu.models.holder import Holder
     from pilosa_tpu.obs.logger import StdLogger
     from pilosa_tpu.server.http import Server
 
-    holder = Holder(path=args.data_dir) if args.data_dir else Holder()
+    # flags > env > config file > defaults (server/config.go layering)
+    cfg = cfgmod.load(args.config, overrides={
+        "data_dir": args.data_dir, "bind": args.bind,
+        "port": args.port, "grpc_port": args.grpc_port,
+        "auth_secret": args.auth_secret or None,
+        "auth_policy": args.auth_policy or None,
+    })
+    cfg.apply_kernel_setting()
+    holder = Holder(path=cfg.data_dir) if cfg.data_dir else Holder()
     holder.load_schema()
     auth = None
-    if args.auth_secret:
+    if cfg.auth_secret:
         from pilosa_tpu.server.authn import Authenticator
         from pilosa_tpu.server.authz import Authorizer
-        authz = (Authorizer.from_yaml(args.auth_policy)
-                 if args.auth_policy else None)
-        auth = (Authenticator(args.auth_secret.encode()), authz)
+        authz = (Authorizer.from_yaml(cfg.auth_policy)
+                 if cfg.auth_policy else None)
+        auth = (Authenticator(cfg.auth_secret.encode()), authz)
     logger = StdLogger()
-    srv = Server(holder=holder, bind=args.bind, port=args.port,
+    srv = Server(holder=holder, bind=cfg.bind, port=cfg.port,
                  logger=logger, auth=auth)
     grpc_srv = None
-    if args.grpc_port >= 0:
+    if cfg.grpc_port >= 0:
         from pilosa_tpu.server.grpc import GRPCServer
         grpc_srv = GRPCServer(srv.api,
-                              bind=f"{args.bind}:{args.grpc_port}",
+                              bind=f"{cfg.bind}:{cfg.grpc_port}",
                               auth=auth).start()
         logger.info("grpc listening on :%d", grpc_srv.port)
     try:
@@ -271,10 +280,12 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--timeout", type=float, default=60.0)
 
     sp = sub.add_parser("server", help="run a node")
+    sp.add_argument("--config", "-c", default=None,
+                    help="TOML config file (generate-config prints one)")
     sp.add_argument("--data-dir", default=None)
-    sp.add_argument("--bind", default="127.0.0.1")
-    sp.add_argument("--port", type=int, default=10101)
-    sp.add_argument("--grpc-port", type=int, default=20101,
+    sp.add_argument("--bind", default=None)
+    sp.add_argument("--port", type=int, default=None)
+    sp.add_argument("--grpc-port", type=int, default=None,
                     help="-1 disables gRPC")
     sp.add_argument("--auth-secret", default="")
     sp.add_argument("--auth-policy", default="")

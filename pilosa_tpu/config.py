@@ -1,0 +1,97 @@
+"""Layered configuration: defaults < TOML file < env < flags.
+
+Reference: server/config.go — one Config struct populated from a TOML
+file, PILOSA_* environment variables, and cobra flags, in that
+precedence order; ``featurebase generate-config`` prints the default
+file (cmd generate-config).  Env prefix here: ``PILOSA_TPU_``;
+nested TOML tables flatten with ``_`` (``[auth] secret`` ->
+``PILOSA_TPU_AUTH_SECRET``).
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field, fields
+
+
+@dataclass
+class Config:
+    data_dir: str = ""
+    bind: str = "127.0.0.1"
+    port: int = 10101
+    grpc_port: int = 20101
+    cluster_name: str = "cluster0"
+    replicas: int = 1
+    auth_secret: str = ""
+    auth_policy: str = ""
+    tpu_kernels: str = "auto"   # auto | on | off -> PILOSA_TPU_PALLAS
+
+    def apply_kernel_setting(self):
+        """Translate tpu_kernels into the Pallas dispatch env flag.
+        'auto' (the default) leaves PILOSA_TPU_PALLAS untouched — a
+        user-exported override must survive config loading."""
+        if self.tpu_kernels == "on":
+            os.environ["PILOSA_TPU_PALLAS"] = "1"
+        elif self.tpu_kernels == "off":
+            os.environ["PILOSA_TPU_PALLAS"] = "0"
+
+
+# TOML key (possibly [table] key) -> Config attribute
+_TOML_KEYS = {
+    "data-dir": "data_dir",
+    "bind": "bind",
+    "port": "port",
+    "grpc-port": "grpc_port",
+    "cluster.name": "cluster_name",
+    "cluster.replicas": "replicas",
+    "auth.secret": "auth_secret",
+    "auth.policy": "auth_policy",
+    "tpu.kernels": "tpu_kernels",
+}
+
+ENV_PREFIX = "PILOSA_TPU_"
+
+
+def _flatten(doc: dict, prefix: str = "") -> dict:
+    out = {}
+    for k, v in doc.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key + "."))
+        else:
+            out[key] = v
+    return out
+
+
+def load(path: str | None = None, env: dict | None = None,
+         overrides: dict | None = None) -> Config:
+    """Build a Config with flag > env > file > default precedence
+    (server/config.go's viper layering)."""
+    cfg = Config()
+    names = {f.name for f in fields(Config)}
+    if path:
+        with open(path, "rb") as f:
+            doc = tomllib.load(f)
+        for tk, attr in _TOML_KEYS.items():
+            flat = _flatten(doc)
+            if tk in flat:
+                setattr(cfg, attr, _coerce(cfg, attr, flat[tk]))
+    env = os.environ if env is None else env
+    for attr in names:
+        ev = env.get(ENV_PREFIX + attr.upper())
+        if ev is not None:
+            setattr(cfg, attr, _coerce(cfg, attr, ev))
+    for k, v in (overrides or {}).items():
+        if v is not None and k in names:
+            setattr(cfg, k, _coerce(cfg, k, v))
+    return cfg
+
+
+def _coerce(cfg: Config, attr: str, value):
+    cur = getattr(cfg, attr)
+    if isinstance(cur, bool):
+        return str(value).lower() in ("1", "true", "yes", "on")
+    if isinstance(cur, int):
+        return int(value)
+    return str(value)

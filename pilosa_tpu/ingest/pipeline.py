@@ -96,11 +96,15 @@ class Pipeline:
         b = Batch(self.importer, self.index, self.source.schema,
                   size=self.batch_size, index_keys=self.index_keys)
         n = 0
+        pending = 0  # records flushed downstream since last commit
         for rec in records:
-            if b.add(rec):
-                b.flush()
-                self.source.commit(n)
+            full = b.add(rec)
             n += 1
+            pending += 1
+            if full:
+                b.flush()
+                self.source.commit(pending)
+                pending = 0
         b.flush()
-        self.source.commit(n)
+        self.source.commit(pending)
         return n

@@ -161,11 +161,16 @@ class DatagenSource(Source):
 
 
 class KafkaSource(Source):
-    """Gated stub — the environment has no Kafka client library; the
-    interface matches idk/kafka/source.go:34 so a real consumer can
-    drop in (poll loop yielding Records, commit() committing offsets)."""
+    """Gated adapter for a REAL Kafka broker via confluent-kafka —
+    absent in this environment.  Use
+    :class:`pilosa_tpu.ingest.kafka.StreamSource` for full Kafka
+    consumer-group semantics (partitions, offset commit, resume) over
+    the embeddable in-process Broker; this class exists so a
+    confluent-backed deployment keeps the idk/kafka/source.go:34
+    interface."""
 
     def __init__(self, *a, **kw):
         raise NotImplementedError(
             "KafkaSource requires a kafka client (confluent-kafka); "
-            "not available in this environment")
+            "use pilosa_tpu.ingest.kafka.StreamSource for the "
+            "in-process broker")

@@ -1,0 +1,209 @@
+"""Per-index Arrow dataframe — the wide-column companion store.
+
+Reference: the experimental dataframe alongside bitmaps (apply.go:1-25
+``Apply`` running an ivy program over columns, arrow.go Arrow
+import/export, the ``/index/{i}/dataframe`` route
+http_handler.go:506), persisted as Parquet.
+
+TPU re-design: columns are Arrow arrays on the host; numeric
+aggregations ship the column to the device and reduce there
+(jnp.sum/min/max over an fp32/int32 vector feeds the VPU — the same
+"host store, device compute" split as the bitmap path).  ``Apply``
+takes a Python/numpy expression over column names instead of ivy/APL
+(the reference marks ivy experimental; the capability — row-aligned
+computed columns — is the same).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import threading
+
+import numpy as np
+
+
+class DataframeError(Exception):
+    pass
+
+
+# the Apply expression language: arithmetic/comparison/boolean ops over
+# column names plus these functions — NO attribute access, NO arbitrary
+# names, so there is no path to modules, dunders, or ctypes
+_FUNCS = {"abs": np.abs, "where": np.where, "log": np.log,
+          "exp": np.exp, "sqrt": np.sqrt, "sum": np.sum,
+          "mean": np.mean, "min": np.min, "max": np.max,
+          "minimum": np.minimum, "maximum": np.maximum}
+
+_ALLOWED_NODES = (
+    ast.Expression, ast.BinOp, ast.UnaryOp, ast.BoolOp, ast.Compare,
+    ast.Call, ast.Name, ast.Constant, ast.IfExp, ast.Load,
+    ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv, ast.Mod,
+    ast.Pow, ast.USub, ast.UAdd, ast.Not, ast.Invert,
+    ast.And, ast.Or, ast.Eq, ast.NotEq, ast.Lt, ast.LtE, ast.Gt,
+    ast.GtE, ast.BitAnd, ast.BitOr, ast.BitXor,
+)
+
+
+def _safe_eval(expr: str, names: dict):
+    """Evaluate a column expression over a sealed AST whitelist.
+
+    Blacklists don't survive adversaries (numpy alone reexports
+    ctypes); instead only the node types above are compiled, calls
+    may target only _FUNCS, and names resolve only to columns or
+    _FUNCS entries.
+    """
+    try:
+        tree = ast.parse(expr, mode="eval")
+    except SyntaxError as e:
+        raise DataframeError(f"bad expression: {e}")
+    for node in ast.walk(tree):
+        if not isinstance(node, _ALLOWED_NODES):
+            raise DataframeError(
+                f"disallowed syntax: {type(node).__name__}")
+        if isinstance(node, ast.Call):
+            if not (isinstance(node.func, ast.Name)
+                    and node.func.id in _FUNCS) or node.keywords:
+                raise DataframeError("only the built-in functions "
+                                     f"{sorted(_FUNCS)} may be called")
+        if isinstance(node, ast.Name) and \
+                node.id not in names and node.id not in _FUNCS:
+            raise DataframeError(f"unknown name: {node.id}")
+    ns = {**_FUNCS, **names, "__builtins__": {}}
+    return eval(compile(tree, "<apply>", "eval"), ns)  # noqa: S307
+
+
+class IndexDataframe:
+    """Columnar rows keyed by the index's record id (_id)."""
+
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self._cols: dict[str, list] = {"_id": []}
+        self._lock = threading.RLock()
+        if path and os.path.exists(self._file):
+            self._load()
+
+    @property
+    def _file(self) -> str:
+        return os.path.join(self.path, "dataframe.parquet")
+
+    # -- ingest --------------------------------------------------------
+
+    def add_rows(self, rows: list[dict]):
+        """Append records ({"_id": ..., col: value, ...}); ragged
+        columns null-fill (arrow.go ingest semantics).  Validates the
+        whole batch first — a rejected batch appends NOTHING, so a
+        client retry after a 400 can't duplicate rows."""
+        for i, r in enumerate(rows):
+            if "_id" not in r:
+                raise DataframeError(f"row {i} missing _id")
+        with self._lock:
+            n = len(self._cols["_id"])
+            for r in rows:
+                for k in r:
+                    if k not in self._cols:
+                        self._cols[k] = [None] * n
+                for k in self._cols:
+                    self._cols[k].append(r.get(k))
+                n += 1
+
+    # -- persistence (Parquet like the reference) ----------------------
+
+    def save(self):
+        if not self.path:
+            return
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        os.makedirs(self.path, exist_ok=True)
+        with self._lock:
+            table = pa.table({k: pa.array(v)
+                              for k, v in self._cols.items()})
+            pq.write_table(table, self._file)
+
+    def _load(self):
+        import pyarrow.parquet as pq
+        table = pq.read_table(self._file)
+        self._cols = {name: table.column(name).to_pylist()
+                      for name in table.column_names}
+        self._cols.setdefault("_id", [])
+
+    # -- reads ---------------------------------------------------------
+
+    @property
+    def n_rows(self) -> int:
+        return len(self._cols["_id"])
+
+    def schema(self) -> list[dict]:
+        out = []
+        for name, vals in self._cols.items():
+            sample = next((v for v in vals if v is not None), None)
+            t = ("int" if isinstance(sample, (int, np.integer))
+                 and not isinstance(sample, bool) else
+                 "bool" if isinstance(sample, bool) else
+                 "float" if isinstance(sample, (float, np.floating)) else
+                 "string")
+            out.append({"name": name, "type": t})
+        return out
+
+    def to_arrow(self):
+        import pyarrow as pa
+        with self._lock:
+            return pa.table({k: pa.array(v)
+                             for k, v in self._cols.items()})
+
+    def column(self, name: str) -> np.ndarray:
+        with self._lock:
+            if name not in self._cols:
+                raise DataframeError(f"no such column: {name}")
+            return np.asarray(self._cols[name])
+
+    # -- compute (apply.go Apply; device-side aggregation) -------------
+
+    def apply(self, expr: str, columns: list[str] | None = None):
+        """Evaluate a numpy expression over columns; names bind to the
+        column arrays.  Returns the result column as a row-aligned
+        list (or a scalar for reducing expressions)."""
+        with self._lock:
+            names = {}
+            for name, vals in self._cols.items():
+                if columns is not None and name not in columns \
+                        and name != "_id":
+                    continue
+                try:
+                    names[name] = np.asarray(
+                        [0 if v is None else v for v in vals])
+                except Exception:
+                    names[name] = np.asarray(vals, dtype=object)
+        try:
+            out = _safe_eval(expr, names)
+        except DataframeError:
+            raise
+        except Exception as e:
+            raise DataframeError(f"apply failed: {e}")
+        if np.isscalar(out):
+            return out
+        return np.asarray(out).tolist()
+
+    def aggregate(self, op: str, column: str):
+        """Device-side reduction of a numeric column: the vector rides
+        HBM->VPU via one jnp reduce (host falls back off-accelerator
+        automatically — same code path)."""
+        import jax.numpy as jnp
+        vals = self.column(column)
+        if vals.dtype == object:
+            # ragged/null-filled column: nulls contribute 0 to the
+            # reduction (count still counts all rows)
+            try:
+                vals = np.array([0 if v is None else v for v in vals],
+                                dtype=np.float64)
+            except (TypeError, ValueError):
+                raise DataframeError(f"column {column} is not numeric")
+        arr = jnp.asarray(vals)
+        ops = {"sum": jnp.sum, "min": jnp.min, "max": jnp.max,
+               "mean": jnp.mean, "count": lambda x: x.shape[0]}
+        if op not in ops:
+            raise DataframeError(f"unknown aggregate {op!r}")
+        out = ops[op](arr)
+        return float(out) if op == "mean" else \
+            float(np.asarray(out)) if arr.dtype.kind == "f" else \
+            int(np.asarray(out))

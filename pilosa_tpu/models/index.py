@@ -30,11 +30,22 @@ class Index:
         self._lock = threading.RLock()
         self._column_translator = None
         self.storage = None
+        self._dataframe = None
         if path is not None:
             from pilosa_tpu.storage.shards import IndexStorage
             self.storage = IndexStorage(path)
         if track_existence:
             self._ensure_existence()
+
+    @property
+    def dataframe(self):
+        """Lazy per-index Arrow dataframe (apply.go / arrow.go;
+        /index/{i}/dataframe route)."""
+        with self._lock:  # two racing firsts must not double-create
+            if self._dataframe is None:
+                from pilosa_tpu.models.dataframe import IndexDataframe
+                self._dataframe = IndexDataframe(self.path)
+            return self._dataframe
 
     @property
     def column_translator(self):

@@ -137,6 +137,10 @@ class Server:
                 lambda req: self.api.get_transaction(req.vars["tid"])))
         r(Route("GET", "/transactions",
                 lambda req: self.api.txns.list()))
+        r(Route("POST", "/index/{index}/dataframe", self._post_dataframe))
+        r(Route("GET", "/index/{index}/dataframe", self._get_dataframe))
+        r(Route("POST", "/index/{index}/dataframe/apply",
+                self._post_dataframe_apply))
         r(Route("GET", "/internal/backup/manifest",
                 lambda req: self.api.backup_manifest()))
         r(Route("GET", "/internal/backup/file", self._get_backup_file))
@@ -250,6 +254,42 @@ class Server:
             return self.api.sql(stmt, auth_check=auth_check)
         except PermissionError as e:
             raise ApiError(str(e), 403)
+
+    def _df(self, req):
+        from pilosa_tpu.models.dataframe import DataframeError
+        idx = self.api.holder.index(req.vars["index"])
+        if idx is None:
+            raise ApiError(f"index not found: {req.vars['index']}", 404)
+        return idx.dataframe
+
+    def _post_dataframe(self, req):
+        """Append rows to the index dataframe (arrow.go ingest;
+        http_handler.go:506 route)."""
+        body = req.json() or {}
+        df = self._df(req)
+        try:
+            df.add_rows(body.get("rows", []))
+        except Exception as e:
+            raise ApiError(str(e), 400)
+        df.save()
+        return {"rows": df.n_rows}
+
+    def _get_dataframe(self, req):
+        df = self._df(req)
+        return {"schema": df.schema(), "rows": df.n_rows}
+
+    def _post_dataframe_apply(self, req):
+        from pilosa_tpu.models.dataframe import DataframeError
+        body = req.json() or {}
+        df = self._df(req)
+        try:
+            if "aggregate" in body:
+                return {"result": df.aggregate(body["aggregate"],
+                                               body["column"])}
+            return {"result": df.apply(body.get("expr", ""),
+                                       body.get("columns"))}
+        except DataframeError as e:
+            raise ApiError(str(e), 400)
 
     def _post_transaction(self, req):
         body = req.json_lenient() or {}

@@ -16,6 +16,7 @@ HAVING, ORDER BY, LIMIT/OFFSET, SELECT DISTINCT col.
 from __future__ import annotations
 
 import datetime as dt
+import math
 from dataclasses import dataclass, field as _f
 
 from pilosa_tpu.executor import (
@@ -54,6 +55,30 @@ def _sql_type(f) -> str:
         return "string" if f.options.keys else "id"
     # set / time
     return "stringset" if f.options.keys else "idset"
+
+
+def _distinct_key(row) -> bytes:
+    """Canonical byte key preserving Python equality semantics
+    (1 == 1.0 == True must stay ONE distinct row, as the previous
+    set-of-tuples dedup treated them): numerics canonicalize through
+    Fraction, which is exact for ints, bools, floats, and Decimals."""
+    from fractions import Fraction
+    parts = []
+    for v in row:
+        if isinstance(v, list):
+            parts.append("l:" + ",".join(
+                _distinct_key([x]).decode() for x in sorted(
+                    v, key=lambda x: (str(type(x)), str(x)))))
+        elif v is None:
+            parts.append("z")
+        elif isinstance(v, float) and not math.isfinite(v):
+            parts.append("f:" + repr(v))  # nan/inf have no Fraction
+        elif isinstance(v, (bool, int, float)) or \
+                type(v).__name__ == "Decimal":
+            parts.append(f"n:{Fraction(v)}")
+        else:
+            parts.append("s:" + str(v))
+    return "|".join(parts).encode()
 
 
 class SQLEngine:
@@ -700,14 +725,20 @@ class SQLEngine:
                     reverse=stmt.order_by[0].desc)
             rows = [rows[i] for i in nn + nulls]
         if stmt.distinct:
-            seen, deduped = set(), []
-            for r in rows:
-                k = tuple(tuple(sorted(v)) if isinstance(v, list) else v
-                          for v in r)
-                if k not in seen:
-                    seen.add(k)
-                    deduped.append(r)
-            rows = deduped
+            # spill-backed dedup: in-memory set until the threshold,
+            # then the on-disk extendible hash (sql3 opdistinct over
+            # bufferpool/extendiblehash)
+            import tempfile
+            from pilosa_tpu.storage.extendiblehash import SpillSet
+            spill = SpillSet(tempfile.mktemp(suffix=".distinct"))
+            try:
+                deduped = []
+                for r in rows:
+                    if spill.add(_distinct_key(r)):
+                        deduped.append(r)
+                rows = deduped
+            finally:
+                spill.close()
         rows = self._limit_rows(stmt, rows)
         return SQLResult(schema=schema, rows=rows)
 

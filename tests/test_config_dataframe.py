@@ -1,0 +1,120 @@
+"""Config layering + Arrow dataframe tests."""
+
+import pytest
+
+from pilosa_tpu import config as cfgmod
+from pilosa_tpu.models.dataframe import DataframeError, IndexDataframe
+
+
+def test_config_layering(tmp_path):
+    p = tmp_path / "c.toml"
+    p.write_text(
+        'data-dir = "/var/data"\n'
+        'port = 7777\n'
+        '[cluster]\nreplicas = 3\n'
+        '[auth]\nsecret = "filesec"\n'
+        '[tpu]\nkernels = "off"\n')
+    # file only
+    cfg = cfgmod.load(str(p), env={})
+    assert cfg.data_dir == "/var/data"
+    assert cfg.port == 7777
+    assert cfg.replicas == 3
+    assert cfg.auth_secret == "filesec"
+    assert cfg.tpu_kernels == "off"
+    # env overrides file
+    cfg = cfgmod.load(str(p), env={"PILOSA_TPU_PORT": "8888",
+                                   "PILOSA_TPU_AUTH_SECRET": "envsec"})
+    assert cfg.port == 8888 and cfg.auth_secret == "envsec"
+    # flags override env
+    cfg = cfgmod.load(str(p), env={"PILOSA_TPU_PORT": "8888"},
+                      overrides={"port": 9999, "bind": None})
+    assert cfg.port == 9999
+    assert cfg.bind == "127.0.0.1"  # None override ignored
+    # defaults without file
+    assert cfgmod.load(env={}).port == 10101
+
+
+def test_config_kernel_setting(monkeypatch):
+    import os
+    monkeypatch.delenv("PILOSA_TPU_PALLAS", raising=False)
+    cfg = cfgmod.Config(tpu_kernels="on")
+    cfg.apply_kernel_setting()
+    assert os.environ["PILOSA_TPU_PALLAS"] == "1"
+    # auto leaves a user-exported override untouched
+    cfg = cfgmod.Config(tpu_kernels="auto")
+    cfg.apply_kernel_setting()
+    assert os.environ["PILOSA_TPU_PALLAS"] == "1"
+    cfg = cfgmod.Config(tpu_kernels="off")
+    cfg.apply_kernel_setting()
+    assert os.environ["PILOSA_TPU_PALLAS"] == "0"
+    monkeypatch.delenv("PILOSA_TPU_PALLAS", raising=False)
+
+
+def test_dataframe_rows_and_apply(tmp_path):
+    df = IndexDataframe(str(tmp_path))
+    df.add_rows([{"_id": 1, "price": 10.0, "qty": 3},
+                 {"_id": 2, "price": 2.5, "qty": 8},
+                 {"_id": 3, "price": 4.0}])
+    assert df.n_rows == 3
+    types = {s["name"]: s["type"] for s in df.schema()}
+    assert types["price"] == "float" and types["qty"] == "int"
+    # ragged column null-filled
+    assert df.column("qty").tolist() == [3, 8, None]
+    # row-aligned computed column (apply.go Apply capability)
+    got = df.apply("price * qty")
+    assert got == [30.0, 20.0, 0.0]
+    # reducing expression through the whitelisted function table
+    assert df.apply("sum(price)") == 16.5
+    with pytest.raises(DataframeError):
+        df.apply("__import__('os')")
+    with pytest.raises(DataframeError):
+        df.column("nope")
+
+
+def test_dataframe_device_aggregate(tmp_path):
+    df = IndexDataframe(str(tmp_path))
+    df.add_rows([{"_id": i, "v": i * 2} for i in range(100)])
+    assert df.aggregate("sum", "v") == 2 * sum(range(100))
+    assert df.aggregate("min", "v") == 0
+    assert df.aggregate("max", "v") == 198
+    assert df.aggregate("count", "v") == 100
+    assert df.aggregate("mean", "v") == pytest.approx(99.0)
+    with pytest.raises(DataframeError):
+        df.aggregate("median", "v")
+
+
+def test_dataframe_parquet_roundtrip(tmp_path):
+    df = IndexDataframe(str(tmp_path))
+    df.add_rows([{"_id": 1, "a": "x"}, {"_id": 2, "a": "y"}])
+    df.save()
+    df2 = IndexDataframe(str(tmp_path))
+    assert df2.n_rows == 2
+    assert df2.column("a").tolist() == ["x", "y"]
+    assert df2.to_arrow().num_rows == 2
+
+
+def test_dataframe_http_routes():
+    from pilosa_tpu.cluster.client import InternalClient, RemoteError
+    from pilosa_tpu.server.http import Server
+
+    srv = Server().start()
+    uri = f"127.0.0.1:{srv.port}"
+    cli = InternalClient()
+    try:
+        cli._request(uri, "POST", "/index/dfi", {})
+        r = cli._request(uri, "POST", "/index/dfi/dataframe", {
+            "rows": [{"_id": 1, "x": 5}, {"_id": 2, "x": 7}]})
+        assert r["rows"] == 2
+        r = cli._request(uri, "GET", "/index/dfi/dataframe")
+        assert any(s["name"] == "x" for s in r["schema"])
+        r = cli._request(uri, "POST", "/index/dfi/dataframe/apply",
+                         {"expr": "x + 1"})
+        assert r["result"] == [6, 8]
+        r = cli._request(uri, "POST", "/index/dfi/dataframe/apply",
+                         {"aggregate": "sum", "column": "x"})
+        assert r["result"] == 12
+        with pytest.raises(RemoteError) as e:
+            cli._request(uri, "POST", "/index/nope/dataframe", {})
+        assert e.value.status == 404
+    finally:
+        srv.close()

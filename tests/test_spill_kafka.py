@@ -1,0 +1,226 @@
+"""Bufferpool + extendible hash (DISTINCT spill) and streaming ingest
+(Kafka-semantics source, SQL source) tests."""
+
+import json
+import sqlite3
+
+import pytest
+
+from pilosa_tpu.storage.bufferpool import (
+    PAGE_SIZE,
+    BufferPool,
+    DiskManager,
+)
+from pilosa_tpu.storage.extendiblehash import ExtendibleHash, SpillSet
+from pilosa_tpu.ingest.kafka import Broker, SQLSource, StreamSource
+
+
+# -- bufferpool ----------------------------------------------------------
+
+def test_bufferpool_eviction_and_persistence(tmp_path):
+    dm = DiskManager(str(tmp_path / "pages.db"))
+    pool = BufferPool(dm, max_frames=4)
+    pages = []
+    for i in range(10):  # > max_frames: forces clock eviction
+        p = pool.new_page()
+        p.data[:4] = i.to_bytes(4, "little")
+        pages.append(p.page_no)
+        pool.unpin(p, dirty=True)
+    for i, pno in enumerate(pages):
+        p = pool.fetch(pno)
+        assert int.from_bytes(p.data[:4], "little") == i
+        pool.unpin(p)
+    pool.close()
+    # survives reopen
+    pool2 = BufferPool(DiskManager(str(tmp_path / "pages.db")), 4)
+    p = pool2.fetch(pages[3])
+    assert int.from_bytes(p.data[:4], "little") == 3
+    pool2.close()
+
+
+def test_bufferpool_pinned_exhaustion(tmp_path):
+    pool = BufferPool(DiskManager(str(tmp_path / "p.db")), max_frames=2)
+    a = pool.new_page()
+    b = pool.new_page()
+    with pytest.raises(RuntimeError):
+        pool.new_page()  # both frames pinned
+    pool.unpin(a)
+    pool.new_page()  # now a victim exists
+    pool.close()
+
+
+# -- extendible hash -----------------------------------------------------
+
+def test_extendible_hash_grows(tmp_path):
+    pool = BufferPool(DiskManager(str(tmp_path / "eh.db")),
+                      max_frames=32)
+    eh = ExtendibleHash(pool)
+    n = 5000  # forces many splits + directory doubling
+    for i in range(n):
+        eh.put(f"key-{i}".encode(), str(i).encode())
+    assert len(eh) == n
+    assert eh.global_depth > 0
+    for i in range(0, n, 97):
+        assert eh.get(f"key-{i}".encode()) == str(i).encode()
+    assert eh.get(b"missing") is None
+    # overwrite does not grow the count
+    eh.put(b"key-1", b"new")
+    assert len(eh) == n
+    assert eh.get(b"key-1") == b"new"
+    assert sorted(eh.keys()) == sorted(
+        f"key-{i}".encode() for i in range(n))
+    pool.close()
+
+
+def test_spillset_spills(tmp_path):
+    s = SpillSet(str(tmp_path / "sp.bin"), threshold=100)
+    added = 0
+    for i in range(500):
+        added += s.add(f"k{i % 250}".encode())
+    assert added == 250
+    assert len(s) == 250
+    assert s._mem is None  # spilled to disk
+    assert sorted(s) == sorted(f"k{i}".encode() for i in range(250))
+    s.close()
+
+
+def test_sql_distinct_spill(monkeypatch, tmp_path):
+    """SELECT DISTINCT still correct when the spill threshold is tiny."""
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.sql.engine import SQLEngine
+    import pilosa_tpu.storage.extendiblehash as ehmod
+
+    orig = ehmod.SpillSet
+
+    def tiny(path, threshold=1 << 16, frames=64):
+        return orig(path, threshold=4, frames=16)
+
+    monkeypatch.setattr(ehmod, "SpillSet", tiny)
+    h = Holder()
+    eng = SQLEngine(h)
+    eng.query("CREATE TABLE d (_id ID, g INT MIN 0 MAX 9)")
+    vals = ", ".join(f"({i}, {i % 7})" for i in range(100))
+    eng.query(f"INSERT INTO d (_id, g) VALUES {vals}")
+    res = eng.query_one("SELECT DISTINCT g FROM d ORDER BY g")
+    assert [r[0] for r in res.rows] == list(range(7))
+
+
+# -- streaming source ----------------------------------------------------
+
+def test_broker_partitions_and_offsets():
+    b = Broker(n_partitions=3)
+    b.create_topic("t")
+    for i in range(9):
+        b.produce("t", {"i": i}, key=f"k{i % 3}")
+    total = sum(len(b.fetch("t", p, 0, 100)) for p in b.partitions("t"))
+    assert total == 9
+    b.commit_offsets("g", "t", {0: 2})
+    assert b.committed("g", "t") == {0: 2}
+    # commits are monotonic
+    b.commit_offsets("g", "t", {0: 1})
+    assert b.committed("g", "t") == {0: 2}
+
+
+def test_stream_source_schema_and_resume():
+    b = Broker(n_partitions=2)
+    for i in range(10):
+        b.produce("events", {"_id": i, "color": f"c{i % 3}",
+                             "size": i * 10}, key=str(i))
+    src = StreamSource(b, "events", group="g1")
+    recs = list(src)
+    assert len(recs) == 10
+    assert src.schema["color"]["type"] == "set"
+    assert src.schema["size"]["type"] == "int"
+    src.commit(len(recs))
+    # a new consumer in the same group resumes past committed offsets
+    src2 = StreamSource(b, "events", group="g1")
+    assert list(src2) == []
+    # ... but new messages flow
+    b.produce("events", {"_id": 99, "color": "c9", "size": 1})
+    assert len(list(StreamSource(b, "events", group="g1"))) == 1
+    # an uncommitted consumer re-reads everything (at-least-once)
+    assert len(list(StreamSource(b, "events", group="other"))) == 11
+
+
+def test_stream_source_end_to_end_pipeline():
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.api import API
+    from pilosa_tpu.ingest.importer import APIImporter
+    from pilosa_tpu.ingest.pipeline import Pipeline
+
+    b = Broker()
+    for i in range(50):
+        b.produce("logs", {"_id": i, "lvl": "err" if i % 5 == 0
+                           else "info", "code": i % 4})
+    holder = Holder()
+    api = API(holder)
+    src = StreamSource(b, "logs", group="ingest")
+    # detect schema by pre-scanning messages happens lazily; run once
+    pipe = Pipeline(src, APIImporter(api), "logs")
+    # schema detection needs a peek: iterate one record via detect
+    for rec in src:
+        break
+    pipe.apply_schema()
+    n = pipe.run()
+    assert n >= 49  # the peeked record may or may not re-deliver
+    r = api.sql("SELECT COUNT(*) FROM logs WHERE lvl = 'err'")
+    assert r["data"][0][0] == 10
+
+
+def test_sql_source(tmp_path):
+    conn = sqlite3.connect(":memory:")
+    conn.execute("CREATE TABLE users (id INTEGER, name TEXT, age INTEGER)")
+    conn.executemany("INSERT INTO users VALUES (?, ?, ?)",
+                     [(i, f"u{i}", 20 + i % 5) for i in range(20)])
+    src = SQLSource(conn, "SELECT id AS _id, name, age FROM users")
+    recs = list(src)
+    assert len(recs) == 20
+    assert src.schema["age"]["type"] == "int"
+    assert src.schema["name"]["type"] == "set"
+    assert recs[0].id == 0 and recs[0].values["name"] == "u0"
+
+
+def test_stream_commit_only_flushed():
+    """commit(n) commits only the n oldest pending records; yielded-
+    but-unflushed records re-deliver (at-least-once)."""
+    b = Broker(n_partitions=1)
+    for i in range(6):
+        b.produce("t2", {"_id": i, "x": 1}, partition=0)
+    src = StreamSource(b, "t2", group="g")
+    it = iter(src)
+    for _ in range(4):
+        next(it)
+    src.commit(2)  # only first two flushed
+    assert b.committed("g", "t2") == {0: 2}
+    # fresh consumer resumes at offset 2: re-reads records 2..5
+    src2 = StreamSource(b, "t2", group="g")
+    assert [r.id for r in src2] == [2, 3, 4, 5]
+
+
+def test_spillset_wide_keys(tmp_path):
+    s = SpillSet(str(tmp_path / "w.bin"), threshold=2)
+    big = [b"K" * 20000 + str(i).encode() for i in range(6)]
+    added = sum(s.add(k) for k in big + big)
+    assert added == 6  # dedup across spill with page-sized digests
+    s.close()
+
+
+def test_dataframe_apply_sandbox_blocks_escape():
+    from pilosa_tpu.models.dataframe import (
+        DataframeError,
+        IndexDataframe,
+    )
+    df = IndexDataframe()
+    df.add_rows([{"_id": 1, "x": 2}])
+    for evil in (
+        "np.ctypeslib.ctypes.CDLL(None)",       # attribute escape
+        "__import__('os')",
+        "(1).__class__",
+        "[x for x in x]",
+        "x.sum()",                               # attribute access
+    ):
+        with pytest.raises(DataframeError):
+            df.apply(evil)
+    # the legitimate language still works
+    assert df.apply("where(x > 1, x * 10, 0)") == [20]
+    assert df.apply("sum(x) + max(x)") == 4
