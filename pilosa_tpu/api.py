@@ -247,6 +247,66 @@ class API:
         metrics.IMPORTED_BITS.inc(n, index=index)
         return n
 
+    def import_roaring(self, index: str, field: str, shard: int,
+                       rows: dict, clear: bool = False) -> int:
+        """Roaring-encoded fragment import (api.go:1771 ImportRoaring;
+        fragment.importRoaring fragment.go:2038): one official-format
+        roaring blob per row id, columns shard-relative.  Returns the
+        number of bits set/cleared."""
+        import base64
+        from pilosa_tpu.storage import roaring
+        self._check_writable()
+        idx = self._index(index)
+        f = idx.field(field)
+        if f is None:
+            raise ApiError(f"field not found: {field}", 404)
+        metrics.IMPORT_TOTAL.inc(index=index)
+        n = 0
+        touched = []
+        with self._import_lock(index):
+            for row_s, blob in rows.items():
+                row = int(row_s)
+                data = base64.b64decode(blob) if isinstance(blob, str) \
+                    else blob
+                try:
+                    cols = roaring.decode(data)
+                except Exception as e:
+                    # truncated buffers raise struct.error/ValueError
+                    # from the codec internals — all client-input 400s
+                    raise ApiError(f"bad roaring data for row {row}: {e}",
+                                   400)
+                if cols.size and int(cols.max()) >= idx.width:
+                    raise ApiError(
+                        f"column {int(cols.max())} exceeds shard width",
+                        400)
+                abs_cols = cols.astype(np.int64) + shard * idx.width
+                if clear:
+                    for c in abs_cols:
+                        f.clear_bit(row, int(c))
+                else:
+                    f.import_bits([row] * len(abs_cols), abs_cols)
+                    touched.extend(abs_cols.tolist())
+                n += int(cols.size)
+            if not clear and touched:
+                idx.mark_columns_exist(touched)
+        metrics.IMPORTED_BITS.inc(n, index=index)
+        return n
+
+    def export_roaring(self, index: str, field: str, shard: int,
+                       row: int) -> bytes:
+        """One row's shard segment as official roaring bytes."""
+        from pilosa_tpu.models.view import VIEW_STANDARD
+        from pilosa_tpu.storage import roaring
+        idx = self._index(index)
+        f = idx.field(field)
+        if f is None:
+            raise ApiError(f"field not found: {field}", 404)
+        v = f.views.get(VIEW_STANDARD)
+        frag = v.fragment(shard) if v else None
+        if frag is None:
+            return roaring.encode([])
+        return roaring.encode(roaring.from_words(frag.row_words(row)))
+
     def _import_lock(self, index: str) -> threading.Lock:
         with self._import_locks_mu:
             lk = self._import_locks.get(index)

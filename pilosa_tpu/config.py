@@ -73,8 +73,8 @@ def load(path: str | None = None, env: dict | None = None,
     if path:
         with open(path, "rb") as f:
             doc = tomllib.load(f)
+        flat = _flatten(doc)
         for tk, attr in _TOML_KEYS.items():
-            flat = _flatten(doc)
             if tk in flat:
                 setattr(cfg, attr, _coerce(cfg, attr, flat[tk]))
     env = os.environ if env is None else env

@@ -76,10 +76,15 @@ def _safe_eval(expr: str, names: dict):
 class IndexDataframe:
     """Columnar rows keyed by the index's record id (_id)."""
 
+    #: appended rows buffered before an automatic Parquet rewrite —
+    #: saving per request would re-serialize the whole table each time
+    SAVE_EVERY = 4096
+
     def __init__(self, path: str | None = None):
         self.path = path
         self._cols: dict[str, list] = {"_id": []}
         self._lock = threading.RLock()
+        self._unsaved = 0
         if path and os.path.exists(self._file):
             self._load()
 
@@ -106,6 +111,7 @@ class IndexDataframe:
                 for k in self._cols:
                     self._cols[k].append(r.get(k))
                 n += 1
+            self._unsaved += len(rows)
 
     # -- persistence (Parquet like the reference) ----------------------
 
@@ -119,6 +125,21 @@ class IndexDataframe:
             table = pa.table({k: pa.array(v)
                               for k, v in self._cols.items()})
             pq.write_table(table, self._file)
+            self._unsaved = 0
+
+    def maybe_save(self):
+        """Save when enough appends accumulated (the ingest path's
+        amortized persistence; sync() forces the tail out)."""
+        with self._lock:
+            due = self._unsaved >= self.SAVE_EVERY
+        if due:
+            self.save()
+
+    def sync(self):
+        with self._lock:
+            dirty = self._unsaved > 0
+        if dirty:
+            self.save()
 
     def _load(self):
         import pyarrow.parquet as pq
@@ -131,11 +152,14 @@ class IndexDataframe:
 
     @property
     def n_rows(self) -> int:
-        return len(self._cols["_id"])
+        with self._lock:
+            return len(self._cols["_id"])
 
     def schema(self) -> list[dict]:
         out = []
-        for name, vals in self._cols.items():
+        with self._lock:  # add_rows may be inserting new columns
+            items = [(n, list(v)) for n, v in self._cols.items()]
+        for name, vals in items:
             sample = next((v for v in vals if v is not None), None)
             t = ("int" if isinstance(sample, (int, np.integer))
                  and not isinstance(sample, bool) else

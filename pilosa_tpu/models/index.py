@@ -106,6 +106,8 @@ class Index:
 
     def sync(self):
         """Persist dirty fragment rows, one write tx per shard file."""
+        if self._dataframe is not None:
+            self._dataframe.sync()
         if self.storage is None:
             return
         with self._lock:

@@ -130,6 +130,10 @@ class Server:
         r(Route("GET", "/metrics.json",
                 lambda req: metrics.registry.render_json()))
         r(Route("GET", "/login", self._get_login))
+        r(Route("GET", "/debug/errors", self._get_debug_errors))
+        r(Route("GET", "/internal/diagnostics", self._get_diagnostics))
+        r(Route("GET", "/internal/perf-counters",
+                self._get_perf_counters))
         r(Route("POST", "/transaction", self._post_transaction))
         r(Route("POST", "/transaction/{tid}/finish",
                 lambda req: self.api.finish_transaction(req.vars["tid"])))
@@ -137,6 +141,12 @@ class Server:
                 lambda req: self.api.get_transaction(req.vars["tid"])))
         r(Route("GET", "/transactions",
                 lambda req: self.api.txns.list()))
+        r(Route("POST",
+                "/index/{index}/field/{field}/import-roaring/{shard}",
+                self._post_import_roaring))
+        r(Route("GET",
+                "/index/{index}/field/{field}/row/{row}/roaring",
+                self._get_row_roaring))
         r(Route("POST", "/index/{index}/dataframe", self._post_dataframe))
         r(Route("GET", "/index/{index}/dataframe", self._get_dataframe))
         r(Route("POST", "/index/{index}/dataframe/apply",
@@ -151,6 +161,20 @@ class Server:
     # paths served without a token when auth is enabled
     # (http_handler.go: login/metrics/version stay open)
     _OPEN_PATHS = {"/version", "/metrics", "/metrics.json", "/login"}
+
+    def _get_debug_errors(self, req):
+        """Recent captured errors (monitor.go events; /debug surface)."""
+        from pilosa_tpu.obs.monitor import global_monitor
+        return global_monitor.recent()
+
+    def _get_diagnostics(self, req):
+        from pilosa_tpu import __version__
+        from pilosa_tpu.obs.diagnostics import Diagnostics
+        return Diagnostics(version=__version__).payload()
+
+    def _get_perf_counters(self, req):
+        from pilosa_tpu.obs.diagnostics import performance_counters
+        return performance_counters.snapshot()
 
     def _get_login(self, req):
         if self.auth is None:
@@ -177,7 +201,8 @@ class Server:
             return
         groups = claims.get("groups", [])
         if admin_only or path.startswith("/internal") or \
-                path.startswith("/transaction") or (
+                path.startswith("/transaction") or \
+                path.startswith("/debug") or (
                 path == "/schema" and method != "GET"):
             # transactions included: an exclusive transaction holds the
             # whole cluster read-only, so starting/finishing one is an
@@ -223,6 +248,8 @@ class Server:
                 except ApiError as e:
                     return e.status, {"error": str(e)}
                 except Exception as e:  # keep the connection alive
+                    from pilosa_tpu.obs.monitor import capture_exception
+                    capture_exception(e, path=path, method=method)
                     self.logger.error("http 500 on %s: %s", path, e)
                     return 500, {"error": f"internal error: {e}"}
         return 404, {"error": f"no route: {method} {path}"}
@@ -255,6 +282,23 @@ class Server:
         except PermissionError as e:
             raise ApiError(str(e), 403)
 
+    def _post_import_roaring(self, req):
+        """Roaring import (route shape of /import-roaring in
+        http_handler.go): {"rows": {rowID: base64-roaring}, "clear"}."""
+        body = req.json() or {}
+        n = self.api.import_roaring(
+            req.vars["index"], req.vars["field"],
+            int(req.vars["shard"]), body.get("rows", {}),
+            clear=bool(body.get("clear")))
+        return {"imported": n}
+
+    def _get_row_roaring(self, req):
+        shard = int(req.query.get("shard", ["0"])[0])
+        data = self.api.export_roaring(
+            req.vars["index"], req.vars["field"], shard,
+            int(req.vars["row"]))
+        return RawResponse(data, "application/octet-stream")
+
     def _df(self, req):
         from pilosa_tpu.models.dataframe import DataframeError
         idx = self.api.holder.index(req.vars["index"])
@@ -271,7 +315,7 @@ class Server:
             df.add_rows(body.get("rows", []))
         except Exception as e:
             raise ApiError(str(e), 400)
-        df.save()
+        df.maybe_save()  # amortized; holder.sync flushes the tail
         return {"rows": df.n_rows}
 
     def _get_dataframe(self, req):

@@ -57,28 +57,29 @@ def _sql_type(f) -> str:
     return "stringset" if f.options.keys else "idset"
 
 
-def _distinct_key(row) -> bytes:
-    """Canonical byte key preserving Python equality semantics
+def _canon_value(v):
+    """Canonical structural form preserving Python equality semantics
     (1 == 1.0 == True must stay ONE distinct row, as the previous
     set-of-tuples dedup treated them): numerics canonicalize through
     Fraction, which is exact for ints, bools, floats, and Decimals."""
     from fractions import Fraction
-    parts = []
-    for v in row:
-        if isinstance(v, list):
-            parts.append("l:" + ",".join(
-                _distinct_key([x]).decode() for x in sorted(
-                    v, key=lambda x: (str(type(x)), str(x)))))
-        elif v is None:
-            parts.append("z")
-        elif isinstance(v, float) and not math.isfinite(v):
-            parts.append("f:" + repr(v))  # nan/inf have no Fraction
-        elif isinstance(v, (bool, int, float)) or \
-                type(v).__name__ == "Decimal":
-            parts.append(f"n:{Fraction(v)}")
-        else:
-            parts.append("s:" + str(v))
-    return "|".join(parts).encode()
+    if isinstance(v, list):
+        return ("l", tuple(sorted((_canon_value(x) for x in v),
+                                  key=repr)))
+    if v is None:
+        return ("z",)
+    if isinstance(v, float) and not math.isfinite(v):
+        return ("f", repr(v))  # nan/inf have no Fraction
+    if isinstance(v, (bool, int, float)) or \
+            type(v).__name__ == "Decimal":
+        return ("n", str(Fraction(v)))
+    return ("s", str(v))
+
+
+def _distinct_key(row) -> bytes:
+    # repr of a nested tuple of tagged values is unambiguous (strings
+    # are quoted/escaped), so no delimiter collisions are possible
+    return repr(tuple(_canon_value(v) for v in row)).encode()
 
 
 class SQLEngine:
@@ -728,9 +729,12 @@ class SQLEngine:
             # spill-backed dedup: in-memory set until the threshold,
             # then the on-disk extendible hash (sql3 opdistinct over
             # bufferpool/extendiblehash)
+            import os
             import tempfile
             from pilosa_tpu.storage.extendiblehash import SpillSet
-            spill = SpillSet(tempfile.mktemp(suffix=".distinct"))
+            fd, spill_path = tempfile.mkstemp(suffix=".distinct")
+            os.close(fd)  # mkstemp (not mktemp): no TOCTOU on the name
+            spill = SpillSet(spill_path)
             try:
                 deduped = []
                 for r in rows:

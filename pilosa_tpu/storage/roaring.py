@@ -1,0 +1,137 @@
+"""Official RoaringBitmap serialization — decode + encode.
+
+Reference: the roaring/ package reads and writes both its own pilosa
+format and the official interchange format
+(roaring/roaring.go:1730 WriteTo, unmarshal_binary.go — cookies
+12346/12347 per the RoaringFormatSpec).  This module implements the
+official 32-bit format so standard roaring tooling can exchange row
+bitmaps with this framework; fragment-level import ships one roaring
+blob per row id (shard-relative columns), covering the reference's
+importRoaring path (fragment.go:2038) without its 64-bit container
+keys.
+
+Decoding is vectorized: array containers are one frombuffer; bitmap
+containers unpack via np.unpackbits; run containers expand with
+np.repeat arithmetic.  Dense-tile interop: to_words()/from_words()
+convert to the packed uint32 lanes the device kernels consume.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+SERIAL_COOKIE_NO_RUN = 12346
+SERIAL_COOKIE = 12347
+NO_OFFSET_THRESHOLD = 4
+_ARRAY_MAX = 4096          # cardinality <= this encodes as array
+_BITMAP_BYTES = 8192
+
+
+class RoaringError(ValueError):
+    pass
+
+
+def decode(buf: bytes) -> np.ndarray:
+    """Deserialize official-format bytes -> sorted uint32 values."""
+    if len(buf) < 4:
+        raise RoaringError("short roaring buffer")
+    cookie = struct.unpack_from("<I", buf, 0)[0]
+    if (cookie & 0xFFFF) == SERIAL_COOKIE:
+        n = (cookie >> 16) + 1
+        off = 4
+        flag_bytes = (n + 7) // 8
+        run_flags = np.unpackbits(
+            np.frombuffer(buf, np.uint8, flag_bytes, off),
+            bitorder="little")[:n].astype(bool)
+        off += flag_bytes
+        has_offsets = n >= NO_OFFSET_THRESHOLD
+    elif cookie == SERIAL_COOKIE_NO_RUN:
+        n = struct.unpack_from("<I", buf, 4)[0]
+        off = 8
+        run_flags = np.zeros(n, dtype=bool)
+        has_offsets = True
+    else:
+        raise RoaringError(f"bad roaring cookie {cookie}")
+    keys = np.zeros(n, dtype=np.uint32)
+    cards = np.zeros(n, dtype=np.int64)
+    for i in range(n):
+        k, c = struct.unpack_from("<HH", buf, off + 4 * i)
+        keys[i], cards[i] = k, c + 1
+    off += 4 * n
+    if has_offsets:
+        off += 4 * n  # offsets are redundant for sequential decode
+    out = []
+    for i in range(n):
+        base = np.uint32(keys[i]) << np.uint32(16)
+        if run_flags[i]:
+            n_runs = struct.unpack_from("<H", buf, off)[0]
+            off += 2
+            pairs = np.frombuffer(buf, np.uint16, 2 * n_runs, off
+                                  ).astype(np.int64).reshape(-1, 2)
+            off += 4 * n_runs
+            lengths = pairs[:, 1] + 1
+            starts = np.repeat(pairs[:, 0], lengths)
+            steps = np.arange(int(lengths.sum())) - np.repeat(
+                np.concatenate(([0], np.cumsum(lengths)[:-1])), lengths)
+            vals = (starts + steps).astype(np.uint32)
+        elif cards[i] <= _ARRAY_MAX:
+            vals = np.frombuffer(buf, np.uint16, int(cards[i]), off
+                                 ).astype(np.uint32)
+            off += 2 * int(cards[i])
+        else:
+            bits = np.unpackbits(
+                np.frombuffer(buf, np.uint8, _BITMAP_BYTES, off),
+                bitorder="little")
+            off += _BITMAP_BYTES
+            vals = np.nonzero(bits)[0].astype(np.uint32)
+        out.append(base | vals)
+    return (np.concatenate(out) if out
+            else np.array([], dtype=np.uint32))
+
+
+def encode(values) -> bytes:
+    """Serialize sorted-able uint32 values in the no-run official
+    format (cookie 12346 — every reader supports it; the reference
+    likewise writes without optimizing to runs unless asked)."""
+    vals = np.unique(np.asarray(values, dtype=np.uint32))
+    keys = (vals >> np.uint32(16)).astype(np.uint16)
+    uniq_keys, starts = np.unique(keys, return_index=True)
+    bounds = list(starts) + [len(vals)]
+    n = len(uniq_keys)
+    head = struct.pack("<II", SERIAL_COOKIE_NO_RUN, n)
+    desc = b"".join(
+        struct.pack("<HH", int(k), int(bounds[i + 1] - bounds[i] - 1))
+        for i, k in enumerate(uniq_keys))
+    bodies = []
+    for i in range(n):
+        lows = (vals[bounds[i]:bounds[i + 1]] & np.uint32(0xFFFF)
+                ).astype(np.uint16)
+        if lows.size <= _ARRAY_MAX:
+            bodies.append(lows.tobytes())
+        else:
+            bits = np.zeros(1 << 16, dtype=np.uint8)
+            bits[lows] = 1
+            bodies.append(np.packbits(bits, bitorder="little").tobytes())
+    offsets = []
+    pos = len(head) + len(desc) + 4 * n
+    for b in bodies:
+        offsets.append(struct.pack("<I", pos))
+        pos += len(b)
+    return head + desc + b"".join(offsets) + b"".join(bodies)
+
+
+def to_words(values, width: int) -> np.ndarray:
+    """Roaring values -> packed uint32 lanes (device tile layout)."""
+    from pilosa_tpu.ops import bitmap as bm
+    vals = np.asarray(values, dtype=np.int64)
+    if vals.size and vals.max() >= width:
+        raise RoaringError(
+            f"value {int(vals.max())} exceeds shard width {width}")
+    return bm.from_columns(vals, width)
+
+
+def from_words(words) -> np.ndarray:
+    from pilosa_tpu.ops import bitmap as bm
+    return bm.to_columns(words).astype(np.uint32)

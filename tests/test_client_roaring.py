@@ -1,0 +1,139 @@
+"""Client library (ORM builders, shard-aware import) + roaring
+serialization roundtrip/interop tests."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.client import Client, Schema
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.server.http import Server
+from pilosa_tpu.storage import roaring
+
+SHARD = 1 << 20
+
+
+@pytest.fixture()
+def node():
+    srv = Server(holder=Holder()).start()
+    yield srv, f"127.0.0.1:{srv.port}"
+    srv.close()
+
+
+# -- roaring format ------------------------------------------------------
+
+@pytest.mark.parametrize("vals", [
+    [],
+    [0],
+    [1, 2, 3, 65535, 65536, 1 << 20],
+    list(range(5000)),                       # bitmap container
+    list(range(0, 1 << 18, 7)),              # multiple keys
+    [2**32 - 1],
+])
+def test_roaring_roundtrip(vals):
+    got = roaring.decode(roaring.encode(vals))
+    np.testing.assert_array_equal(got, np.unique(
+        np.asarray(vals, dtype=np.uint32)))
+
+
+def test_roaring_run_container_decode(rng):
+    """Hand-build a with-runs buffer (cookie 12347) and decode it."""
+    import struct
+    # one run container, key 0: runs [5..9], [100..100]
+    n = 1
+    cookie = struct.pack("<I", roaring.SERIAL_COOKIE | ((n - 1) << 16))
+    flags = bytes([0b1])
+    desc = struct.pack("<HH", 0, 6 - 1)  # cardinality 6
+    body = struct.pack("<H", 2) + struct.pack("<HH", 5, 4) + \
+        struct.pack("<HH", 100, 0)
+    buf = cookie + flags + desc + body  # n < 4: no offsets
+    got = roaring.decode(buf)
+    np.testing.assert_array_equal(got, [5, 6, 7, 8, 9, 100])
+
+
+def test_roaring_fuzz_roundtrip(rng):
+    """Property fuzz vs numpy ground truth (roaring/fuzzer.go shape)."""
+    for _ in range(25):
+        n = int(rng.integers(0, 3000))
+        vals = rng.integers(0, 2**21, size=n, dtype=np.uint32)
+        got = roaring.decode(roaring.encode(vals))
+        np.testing.assert_array_equal(got, np.unique(vals))
+    with pytest.raises(roaring.RoaringError):
+        roaring.decode(b"\x00\x01")
+    with pytest.raises(roaring.RoaringError):
+        roaring.decode(b"\xff\xff\xff\xff\x00\x00\x00\x00")
+
+
+def test_import_export_roaring_http(node):
+    srv, host = node
+    c = Client(host)
+    s = Schema()
+    idx = s.index("ri")
+    idx.field("f")
+    c.sync_schema(s)
+    blob = roaring.encode([1, 5, 9000])
+    n = c.import_roaring("ri", "f", shard=1, rows={7: blob})
+    assert n == 3
+    got = c.query(s.index("ri").count(s.index("ri").field("f").row(7)))
+    assert got == [3]
+    # columns land shard-relative
+    r = c.query(s.index("ri").field("f").row(7))
+    assert r[0]["columns"] == [SHARD + 1, SHARD + 5, SHARD + 9000]
+    # export back
+    data = c._http.get_raw(
+        host, "/index/ri/field/f/row/7/roaring?shard=1")
+    np.testing.assert_array_equal(roaring.decode(data), [1, 5, 9000])
+    # clear through roaring
+    c.import_roaring("ri", "f", shard=1,
+                     rows={7: roaring.encode([5])}, clear=True)
+    r = c.query(s.index("ri").field("f").row(7))
+    assert r[0]["columns"] == [SHARD + 1, SHARD + 9000]
+
+
+# -- client ORM ----------------------------------------------------------
+
+def test_client_orm_end_to_end(node):
+    srv, host = node
+    c = Client(host)
+    schema = Schema()
+    events = schema.index("events")
+    user = events.field("user", type="set", keys=True)
+    amount = events.field("amount", type="int", min=0, max=10**6)
+    c.sync_schema(schema)
+
+    c.query(user.set(1, "alice"))
+    c.query(user.set(2, "alice"))
+    c.query(user.set(2, "bob"))
+    c.import_values("events", "amount", [(1, 100), (2, 250)])
+
+    assert c.query(events.count(user.row("alice"))) == [2]
+    both = user.row("alice") & user.row("bob")
+    assert c.query(events.count(both)) == [1]
+    either = user.row("alice") | user.row("bob")
+    assert c.query(events.count(either)) == [2]
+    r = c.query(amount.sum(user.row("alice")))
+    assert r[0] == {"value": 350, "count": 2}
+    r = c.query(amount.between(150, 300))
+    assert r[0]["columns"] == [2]
+    r = c.query(user.topn(1))
+    assert r[0][0]["key"] == "alice" and r[0][0]["count"] == 2
+    # batch query
+    r = c.query(events.batch_query(
+        events.count(user.row("alice")), events.count(user.row("bob"))))
+    assert r == [2, 1]
+    # schema readback includes what we created
+    s2 = c.schema()
+    assert "events" in s2.indexes
+    assert "user" in s2.indexes["events"].fields
+
+
+def test_client_shard_aware_import(node):
+    srv, host = node
+    c = Client(host)
+    s = Schema()
+    s.index("imp").field("f")
+    c.sync_schema(s)
+    bits = [(1, i * (SHARD // 2)) for i in range(8)]  # 4 shards
+    n = c.import_bits("imp", "f", bits, batch_size=3)  # multi batch
+    assert n == 8
+    assert c.query(s.index("imp").count(s.index("imp").field("f")
+                                        .row(1))) == [8]

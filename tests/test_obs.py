@@ -124,3 +124,73 @@ def test_nop_tracer_cheap():
     set_tracer(NopTracer())
     with start_span("x") as s:
         s.set_tag("a", 1)  # no-op, no error
+
+
+def test_diagnostics_payload_and_version_check():
+    from pilosa_tpu.obs.diagnostics import Diagnostics
+
+    sent = []
+    d = Diagnostics(version="1.2.3", send=sent.append)
+    d.set("node_id", "n0")
+    d.flush()
+    assert sent and sent[0]["version"] == "1.2.3"
+    assert sent[0]["node_id"] == "n0"
+    assert sent[0]["num_cpu"] >= 1
+    # reporting disabled: start() is a no-op, flush keeps local copy
+    d2 = Diagnostics(version="x")
+    assert d2.start()._thread is None
+    d2.flush()
+    assert d2.last_payload is not None
+    assert Diagnostics.check_version("1.0.0", "1.2.0") is not None
+    assert Diagnostics.check_version("2.0.0", "1.9.9") is None
+    assert Diagnostics.check_version("2.0.0", "weird") is None
+
+
+def test_performance_counters():
+    from pilosa_tpu.obs.diagnostics import PerformanceCounters
+
+    pc = PerformanceCounters()
+    pc.add("queries", 3)
+    pc.add("queries")
+    pc.set_gauge("goroutines", 7)
+    snap = pc.snapshot()
+    assert snap == {"queries": 4, "goroutines": 7}
+    assert '"queries": 4' in pc.dump_json()
+
+
+def test_monitor_capture_and_http_wiring():
+    from pilosa_tpu.obs.monitor import Monitor, global_monitor
+    from pilosa_tpu.cluster.client import InternalClient, RemoteError
+    from pilosa_tpu.server.http import Server
+    import pytest as _pytest
+
+    m = Monitor(keep=2)
+    for i in range(3):
+        try:
+            raise ValueError(f"e{i}")
+        except ValueError as e:
+            m.capture_exception(e, query=f"q{i}")
+    ev = m.recent()
+    assert len(ev) == 2 and ev[-1]["message"] == "e2"
+    assert "ValueError" in ev[-1]["traceback"]
+
+    # a handler crash is captured by the global monitor and surfaced
+    # at /debug/errors
+    srv = Server().start()
+    uri = f"127.0.0.1:{srv.port}"
+    srv.add_route("GET", "/boom", lambda req: 1 / 0, admin_only=False)
+    cli = InternalClient()
+    try:
+        before = len(global_monitor.recent())
+        with _pytest.raises(RemoteError):
+            cli._request(uri, "GET", "/boom")
+        events = cli._request(uri, "GET", "/debug/errors")
+        assert len(events) > before
+        assert events[-1]["type"] == "ZeroDivisionError"
+        # diagnostics + perf counters endpoints respond
+        d = cli._request(uri, "GET", "/internal/diagnostics")
+        assert "version" in d and "num_cpu" in d
+        assert isinstance(
+            cli._request(uri, "GET", "/internal/perf-counters"), dict)
+    finally:
+        srv.close()
