@@ -73,6 +73,29 @@ class Field:
                 self.views[name] = v
             return v
 
+    def remove_expired_views(self, now: dt.datetime | None = None) -> list[str]:
+        """Drop time-quantum views whose span ended more than
+        options.ttl seconds ago (time.go:158 TTL view removal; the
+        holder ticker drives this).  Returns removed view names."""
+        if self.options.ttl <= 0:
+            return []
+        now = now or dt.datetime.now(dt.timezone.utc).replace(tzinfo=None)
+        removed = []
+        with self._lock:
+            for name in list(self.views):
+                span = timeq.view_time_range(name)
+                if span is None:
+                    continue
+                _, end = span
+                if (now - end).total_seconds() > self.options.ttl:
+                    self.views.pop(name)
+                    removed.append(name)
+                    if self.storage is not None:
+                        # also reclaim the persisted bitmaps, or the
+                        # expired view resurrects on the next open
+                        self.storage.delete_view_bitmaps(self.name, name)
+        return removed
+
     @property
     def bsi_view(self) -> str:
         return bsi_view_name(self.name)
@@ -252,14 +275,10 @@ class Field:
         if from_ is None or to is None:
             if not existing:
                 return []
-            stamps = sorted(v[len(VIEW_STANDARD) + 1:] for v in existing)
-            fmts = {4: "%Y", 6: "%Y%m", 8: "%Y%m%d", 10: "%Y%m%d%H"}
-
-            def parse_stamp(s):
-                return dt.datetime.strptime(s, fmts[len(s)])
-            lo = min(parse_stamp(s) for s in stamps)
-            hi = max(parse_stamp(s) for s in stamps)
-            hi = hi + dt.timedelta(days=366)  # past the coarsest view's span
+            spans = [timeq.view_time_range(v) for v in existing]
+            spans = [s for s in spans if s is not None]
+            lo = min(s[0] for s in spans)
+            hi = max(s[1] for s in spans)
             start = timeq.parse_time(from_) if from_ is not None else lo
             end = timeq.parse_time(to) if to is not None else hi
         else:

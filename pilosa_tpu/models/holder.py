@@ -64,6 +64,16 @@ class Holder:
             for idx in self.indexes.values():
                 idx.sync()
 
+    def remove_expired_views(self) -> list[str]:
+        """TTL sweep over every time field (the reference's view-
+        removal ticker, time.go:158 + holder monitors)."""
+        removed = []
+        with self._lock:
+            for idx in self.indexes.values():
+                for f in idx.fields.values():
+                    removed += f.remove_expired_views()
+        return removed
+
     def close(self):
         with self._lock:
             for idx in self.indexes.values():

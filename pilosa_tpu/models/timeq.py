@@ -134,3 +134,29 @@ def parse_time(v) -> dt.datetime:
         except ValueError:
             continue
     raise ValueError(f"cannot parse time {v!r}")
+
+
+def view_time_range(view_name: str) -> tuple[dt.datetime, dt.datetime] | None:
+    """(start, end) span of a quantum view name, None for non-time
+    views (time.go timeOfView): ``standard_2006`` covers the year,
+    ``standard_20060102`` the day, etc."""
+    _, _, suffix = view_name.rpartition("_")
+    if not suffix.isdigit():
+        return None
+    fmts = {4: "%Y", 6: "%Y%m", 8: "%Y%m%d", 10: "%Y%m%d%H"}
+    fmt = fmts.get(len(suffix))
+    if fmt is None:
+        return None
+    try:
+        start = dt.datetime.strptime(suffix, fmt)
+    except ValueError:
+        return None
+    if len(suffix) == 4:
+        end = start.replace(year=start.year + 1)
+    elif len(suffix) == 6:
+        end = _add_month(start)
+    elif len(suffix) == 8:
+        end = start + dt.timedelta(days=1)
+    else:
+        end = start + dt.timedelta(hours=1)
+    return start, end

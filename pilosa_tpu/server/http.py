@@ -66,12 +66,16 @@ class Server:
         self.port = self.httpd.server_address[1]
         self._thread: threading.Thread | None = None
         self._serving = False
+        self.maintenance_interval = 60.0  # TTL sweep + flush cadence
+        self._ticker_thread: threading.Thread | None = None
+        self._ticker_stop = threading.Event()
 
     # -- lifecycle -----------------------------------------------------
 
     def serve_forever(self):
         self.logger.info("listening on :%d", self.port)
         self._serving = True
+        self._start_tickers()
         self.httpd.serve_forever()
 
     def start(self):
@@ -80,9 +84,34 @@ class Server:
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, daemon=True)
         self._thread.start()
+        self._start_tickers()
         return self
 
+    def _start_tickers(self):
+        """Holder maintenance loop: TTL view sweep + flush (the
+        reference's cache-flush ticker, holder.go:1244, and TTL view
+        removal, time.go:158)."""
+        if self._ticker_thread is not None:
+            return
+        self._ticker_thread = threading.Thread(target=self._tick_loop,
+                                               daemon=True)
+        self._ticker_thread.start()
+
+    def _tick_loop(self):
+        while not self._ticker_stop.wait(self.maintenance_interval):
+            try:
+                removed = self.holder.remove_expired_views()
+                if removed:
+                    self.logger.info("ttl removed %d views",
+                                     len(removed))
+                self.holder.sync()
+            except Exception as e:
+                self.logger.error("maintenance tick failed: %s", e)
+
     def close(self):
+        self._ticker_stop.set()
+        if self._ticker_thread:
+            self._ticker_thread.join(timeout=2)
         # shutdown() blocks on an event only serve_forever() sets —
         # calling it on a never-started server would deadlock
         if self._serving:

@@ -145,6 +145,15 @@ class IndexStorage:
                     if name.startswith(prefix):
                         tx.delete_bitmap(name)
 
+    def delete_view_bitmaps(self, field: str, view: str) -> None:
+        """Remove ONE view's bitmap from every shard file (TTL view
+        expiry; the per-field analog of delete_field_bitmaps)."""
+        name = bitmap_name(field, view)
+        for shard in self.shards_on_disk():
+            with self.db(shard).begin(write=True) as tx:
+                if tx.has_bitmap(name):
+                    tx.delete_bitmap(name)
+
     # -- lifecycle -------------------------------------------------------
 
     def checkpoint_all(self) -> None:

@@ -107,3 +107,112 @@ def test_range_leap_day_start():
         "s", dt.datetime(2020, 2, 29), dt.datetime(2022, 1, 1),
         TimeQuantum("Y"))
     assert got  # coarse overcoverage allowed; must not raise
+
+
+def test_view_time_range_parsing():
+    import datetime as dt
+    from pilosa_tpu.models import timeq
+
+    assert timeq.view_time_range("standard_2006") == (
+        dt.datetime(2006, 1, 1), dt.datetime(2007, 1, 1))
+    assert timeq.view_time_range("standard_200612") == (
+        dt.datetime(2006, 12, 1), dt.datetime(2007, 1, 1))
+    assert timeq.view_time_range("standard_20060102") == (
+        dt.datetime(2006, 1, 2), dt.datetime(2006, 1, 3))
+    assert timeq.view_time_range("standard_2006010215")[1] == \
+        dt.datetime(2006, 1, 2, 16)
+    assert timeq.view_time_range("standard") is None
+    assert timeq.view_time_range("bsig_f") is None
+    assert timeq.view_time_range("standard_209") is None
+
+
+def test_ttl_view_removal():
+    import datetime as dt
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.models.schema import (
+        FieldOptions,
+        FieldType,
+        TimeQuantum,
+    )
+
+    h = Holder(width=1 << 12)
+    idx = h.create_index("ttl")
+    f = idx.create_field("ev", FieldOptions(
+        type=FieldType.TIME, time_quantum=TimeQuantum("YMD"),
+        ttl=86400.0))  # 1 day
+    old = dt.datetime(2020, 1, 1, 12)
+    recent = dt.datetime.now(dt.timezone.utc).replace(tzinfo=None)
+    f.set_bit(1, 10, timestamp=old)
+    f.set_bit(1, 11, timestamp=recent)
+    views_before = set(f.views)
+    assert any(v.startswith("standard_2020") for v in views_before)
+    removed = h.remove_expired_views()
+    assert any(v.startswith("standard_2020") for v in removed)
+    # current-period views and the standard view survive
+    assert "standard" in f.views
+    assert all(not v.startswith("standard_2020") for v in f.views)
+    # ttl=0 fields are never swept
+    f2 = idx.create_field("keep", FieldOptions(
+        type=FieldType.TIME, time_quantum=TimeQuantum("Y")))
+    f2.set_bit(1, 1, timestamp=old)
+    assert f2.remove_expired_views() == []
+
+
+def test_ttl_removal_persists(tmp_path):
+    """Expired views are deleted from storage too — a reopen must not
+    resurrect them."""
+    import datetime as dt
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.models.schema import (
+        FieldOptions,
+        FieldType,
+        TimeQuantum,
+    )
+
+    path = str(tmp_path / "ttl")
+    h = Holder(path=path)
+    idx = h.create_index("t")
+    f = idx.create_field("ev", FieldOptions(
+        type=FieldType.TIME, time_quantum=TimeQuantum("YM"),
+        ttl=3600.0))
+    f.set_bit(1, 5, timestamp=dt.datetime(2019, 6, 1))
+    h.sync()  # persist the quantum views
+    removed = h.remove_expired_views()
+    assert removed
+    h.sync()
+    h.close()
+    h2 = Holder(path=path)
+    h2.load_schema()
+    f2 = h2.index("t").field("ev")
+    assert all(not v.startswith("standard_2019") for v in f2.views)
+    h2.close()
+
+
+def test_server_maintenance_ticker():
+    import time as _time
+    import datetime as dt
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.models.schema import (
+        FieldOptions,
+        FieldType,
+        TimeQuantum,
+    )
+    from pilosa_tpu.server.http import Server
+
+    holder = Holder()
+    srv = Server(holder=holder)
+    srv.maintenance_interval = 0.1
+    srv.start()
+    try:
+        idx = holder.create_index("tick")
+        f = idx.create_field("ev", FieldOptions(
+            type=FieldType.TIME, time_quantum=TimeQuantum("Y"),
+            ttl=1.0))
+        f.set_bit(1, 1, timestamp=dt.datetime(2000, 1, 1))
+        deadline = _time.time() + 3
+        while _time.time() < deadline and any(
+                v.startswith("standard_2000") for v in f.views):
+            _time.sleep(0.05)
+        assert all(not v.startswith("standard_2000") for v in f.views)
+    finally:
+        srv.close()
