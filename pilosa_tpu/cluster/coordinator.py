@@ -39,6 +39,15 @@ class ClusterError(Exception):
     pass
 
 
+def _catch(fn, *args):
+    """Run fn, returning the exception instead of raising (pool tasks
+    settle independently; the caller sorts failures per node)."""
+    try:
+        return fn(*args)
+    except Exception as e:
+        return e
+
+
 class ClusterNode:
     """One cluster member: an HTTP Server + disco registration +
     heartbeat loop (server.go Open wiring)."""
@@ -313,20 +322,30 @@ class ClusterExecutor:
         partials: list[list] = []
         failed_shards: list[int] = []
         last_err = None
-        for node_id, node_shards in sorted(by_node.items()):
+
+        def one(pool, item):
+            node_id, node_shards = item
             node = snap.node(node_id)
-            try:
-                if node_id == self.node.node_id:
-                    resp = self.node.api.query(index, pql,
-                                               shards=node_shards)
-                else:
-                    resp = self.node._client().query_node(
-                        node.uri, index, pql, node_shards)
-                partials.append(resp["results"])
-            except _NET_ERRORS as e:
-                last_err = e
+            if node_id == self.node.node_id:
+                return self.node.api.query(index, pql,
+                                           shards=node_shards)
+            with pool.blocked():  # RPC wait: let the pool grow
+                return self.node._client().query_node(
+                    node.uri, index, pql, node_shards)
+
+        from pilosa_tpu.taskpool import Pool
+        jobs = sorted(by_node.items())
+        pool = Pool(size=2)  # task.Pool default size (executor.go:6714)
+        outs = pool.map(lambda p, it: _catch(one, p, it), jobs)
+        for (node_id, node_shards), out in zip(jobs, outs):
+            if isinstance(out, Exception):
+                if not isinstance(out, _NET_ERRORS):
+                    raise out
+                last_err = out
                 self.node.disco.set_state(node_id, NodeState.DOWN)
                 failed_shards.extend(node_shards)
+            else:
+                partials.append(out["results"])
         if failed_shards:
             if attempts <= 1:
                 raise ClusterError(

@@ -93,11 +93,15 @@ class Queryer:
             addr, uri = self.controller.worker_for(table, s)
             by_worker.setdefault(addr, []).append(s)
             uris[addr] = uri
-        partials = []
-        for addr in sorted(by_worker):
-            resp = self._client.query_node(uris[addr], table, pql,
-                                           by_worker[addr])
-            partials.append(resp["results"])
+        from pilosa_tpu.taskpool import Pool
+
+        def one(pool, addr):
+            with pool.blocked():  # RPC wait
+                return self._client.query_node(uris[addr], table, pql,
+                                               by_worker[addr])
+
+        partials = [r["results"] for r in
+                    Pool(size=2).map(one, sorted(by_worker))]
         if not partials:
             return {"results": [_empty_result(c) for c in q.calls]}
         return {"results": [

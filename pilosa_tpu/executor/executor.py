@@ -450,18 +450,26 @@ class Executor(AdvancedOps):
         return None
 
     def _reduce_count(self, idx: Index, call: Call, shards, pre) -> int:
-        total = 0
-        for shard in self._tree_shards(idx, shards, pre):
-            words = self._bitmap_call_shard(idx, call, shard, pre)
-            total += int(bm.count(words))
-        return total
+        """Count: per-shard popcounts fetched in ONE device->host
+        transfer.  A per-shard int() would sync the host every
+        iteration (executor.go's per-shard mapFn is free to — its
+        'device' is local RAM); stacking keeps the device pipeline
+        full and moves a single (S,) vector."""
+        words = [self._bitmap_call_shard(idx, call, shard, pre)
+                 for shard in self._tree_shards(idx, shards, pre)]
+        if not words:
+            return 0
+        counts = np.asarray(bm.count(jnp.stack(words)), dtype=np.int64)
+        return int(counts.sum())
 
     def _execute_sum(self, idx: Index, call: Call, shards, pre) -> ValCount:
         fname = call.arg("_field")
         if fname is None:
             raise ExecError("Sum requires field=")
         f = self._bsi_field(idx, fname)
-        total, count = 0, 0
+        # queue every shard's device scan, then fetch all per-plane
+        # popcounts in one sync (see _reduce_count)
+        parts_per_shard = []
         for shard in self._shard_list(idx, shards):
             v = f.views.get(f.bsi_view)
             frag = v.fragment(shard) if v else None
@@ -471,12 +479,18 @@ class Executor(AdvancedOps):
             filt = self._filter_words(idx, call, shard, pre)
             if kernels.enabled():
                 # single fused pass over the plane stack (Pallas)
-                parts = kernels.bsi_sum_counts(planes, filt)
+                parts_per_shard.append(kernels.bsi_sum_counts(planes, filt))
             else:
-                parts = bsi_ops.sum_counts(planes, filt)
-            s, c = bsi_ops.host_sum(*parts)
-            total += s
-            count += c
+                parts_per_shard.append(bsi_ops.sum_counts(planes, filt))
+        total, count = 0, 0
+        if parts_per_shard:
+            cnt = np.asarray(jnp.stack([p[0] for p in parts_per_shard]))
+            pos = np.asarray(jnp.stack([p[1] for p in parts_per_shard]))
+            neg = np.asarray(jnp.stack([p[2] for p in parts_per_shard]))
+            for i in range(len(parts_per_shard)):
+                s, c = bsi_ops.host_sum(cnt[i], pos[i], neg[i])
+                total += s
+                count += c
         return ValCount(value=f.int_to_value(total), count=count)
 
     def _execute_minmax(self, idx: Index, call: Call, shards,

@@ -59,6 +59,15 @@ class Delete:
 @dataclass
 class Col:
     name: str
+    table: str | None = None  # qualified reference (joins)
+
+
+@dataclass
+class Join:
+    """INNER JOIN clause (sql3 opnestedloops.go nested-loop join)."""
+    table: str
+    left: "Col"
+    right: "Col"
 
 
 @dataclass
@@ -123,6 +132,7 @@ class OrderBy:
 class Select:
     items: list[SelectItem] = field(default_factory=list)
     table: str = ""
+    joins: list[Join] = field(default_factory=list)
     where: Any = None
     group_by: list = field(default_factory=list)
     having: Any = None

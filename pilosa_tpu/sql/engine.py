@@ -437,6 +437,9 @@ class SQLEngine:
     # -- SELECT ---------------------------------------------------------
 
     def _select(self, stmt: ast.Select) -> SQLResult:
+        if stmt.joins:
+            return self._select_join(stmt)
+        self._reject_foreign_quals(stmt)
         idx = self._index(stmt.table)
         filt = self._compile_where(idx, stmt.where)
 
@@ -465,6 +468,27 @@ class SQLEngine:
                 items[0].expr.name != "_id":
             return self._select_distinct(idx, stmt, items[0], filt)
         return self._select_rows(idx, stmt, items, filt)
+
+    def _reject_foreign_quals(self, stmt: ast.Select):
+        """Non-join selects must not reference other tables: a bogus
+        qualifier would otherwise silently resolve to the bare name."""
+        def walk(e):
+            if isinstance(e, ast.Col):
+                if e.table is not None and e.table != stmt.table:
+                    raise SQLError(f"unknown table {e.table!r}")
+                return
+            if e is None or isinstance(e, (str, int, float, bool)):
+                return
+            for attr in ("left", "right", "expr", "col", "arg"):
+                sub = getattr(e, attr, None)
+                if sub is not None:
+                    walk(sub)
+        for it in stmt.items:
+            walk(it.expr)
+        walk(stmt.where)
+        walk(stmt.having)
+        for ob in stmt.order_by:
+            walk(ob.expr)
 
     def _name_of(self, it: ast.SelectItem) -> str:
         if it.alias:
@@ -746,18 +770,248 @@ class SQLEngine:
         rows = self._limit_rows(stmt, rows)
         return SQLResult(schema=schema, rows=rows)
 
+    # -- INNER JOIN (sql3 opnestedloops.go nested-loop join) -----------
+
+    def _cell_value(self, idx, name: str, col_id: int):
+        """One column's value for one record id (join materialization).
+        BSI fields -> typed value or None; set-like -> row key/id (or
+        sorted list when multiple); _id -> the id."""
+        if name == "_id":
+            return col_id
+        f = self._field(idx, name)
+        shard, scol = divmod(col_id, f.width)
+        if f.options.type.is_bsi:
+            v = f.views.get(f.bsi_view)
+            frag = v.fragment(shard) if v else None
+            if frag is None or not frag.contains(0, scol):
+                return None
+            mag = sum(1 << i for i in range(f.bit_depth)
+                      if frag.contains(2 + i, scol))
+            return f.int_to_value(-mag if frag.contains(1, scol) else mag)
+        from pilosa_tpu.models.view import VIEW_STANDARD
+        view = f.views.get(VIEW_STANDARD)
+        frag = view.fragment(shard) if view else None
+        if frag is None:
+            return None
+        rows = [r for r in frag.row_ids if frag.contains(r, scol)]
+        if not rows:
+            return None
+        if f.options.type == FieldType.BOOL:
+            return rows[-1] == 1
+        if f.options.keys:
+            keys = f.row_translator.translate_ids(rows)
+            return keys[0] if len(keys) == 1 else sorted(keys)
+        return rows[0] if len(rows) == 1 else rows
+
+    def _table_ids(self, idx, filt) -> list:
+        res = self.executor._execute_call(idx, filt, None)
+        return [int(c) for c in res.columns()]
+
+    def _select_join(self, stmt: ast.Select) -> SQLResult:
+        """Nested-loop INNER JOIN of two tables on column equality.
+        The right side builds a hash of join-key -> record ids; left
+        records probe it (the hashed refinement of opnestedloops.go's
+        loop).  WHERE may reference either table's columns and is
+        evaluated on the joined rows."""
+        if len(stmt.joins) != 1:
+            raise SQLError("a single JOIN is supported")
+        if stmt.group_by or stmt.having or stmt.distinct:
+            raise SQLError("JOIN with GROUP BY/HAVING/DISTINCT "
+                           "not supported yet")
+        join = stmt.joins[0]
+        lname, rname = stmt.table, join.table
+        if lname == rname:
+            raise SQLError("self-join requires table aliases "
+                           "(not supported)")
+        lidx, ridx = self._index(lname), self._index(rname)
+
+        def side_of(c: ast.Col) -> str:
+            if c.table is None:
+                raise SQLError("JOIN ON columns must be qualified "
+                               "(table.column)")
+            if c.table not in (lname, rname):
+                raise SQLError(f"unknown table in ON: {c.table}")
+            return c.table
+
+        jl, jr = join.left, join.right
+        if side_of(jl) == rname:
+            jl, jr = jr, jl
+        if side_of(jl) != lname or side_of(jr) != rname:
+            raise SQLError("JOIN ON must relate the two joined tables")
+
+        # projected columns; '*' expands to both tables' columns
+        items: list[tuple[str, str, str]] = []  # (out name, table, col)
+        for it in stmt.items:
+            e = it.expr
+            if isinstance(e, ast.Agg):
+                if e.func == "count" and e.arg is None:
+                    items.append((self._name_of(it), "", "count(*)"))
+                    continue
+                raise SQLError("JOIN supports only COUNT(*) aggregate")
+            if not isinstance(e, ast.Col):
+                raise SQLError("JOIN projections must be columns")
+            if e.name == "*":
+                items.append(("_id", lname, "_id"))
+                items += [(f.name, lname, f.name)
+                          for f in lidx.public_fields()]
+                items += [(f"{rname}._id", rname, "_id")]
+                items += [(f"{rname}.{f.name}", rname, f.name)
+                          for f in ridx.public_fields()]
+                continue
+            table = e.table or lname
+            if table not in (lname, rname):
+                raise SQLError(f"unknown table {table!r} in projection")
+            items.append((it.alias or (e.name if e.table is None else
+                                       f"{e.table}.{e.name}"),
+                          table, e.name))
+        if any(c == "count(*)" for _, _, c in items) and len(items) > 1:
+            raise SQLError(
+                "JOIN cannot mix COUNT(*) with other projections")
+
+        # WHERE: validate table qualifications up front; conditions
+        # evaluate on the joined row (qualified or left-default)
+        where = stmt.where
+
+        def walk(e):
+            if isinstance(e, ast.Col):
+                t = e.table or lname
+                if t not in (lname, rname):
+                    raise SQLError(f"unknown table {t!r} in WHERE")
+                return
+            for attr in ("left", "right", "expr", "col"):
+                sub = getattr(e, attr, None)
+                if sub is not None and not isinstance(
+                        sub, (str, int, float, bool)):
+                    walk(sub)
+        if where is not None:
+            walk(where)
+
+        all_call = Call("All")
+        left_ids = self._table_ids(lidx, all_call)
+        right_ids = self._table_ids(ridx, all_call)
+
+        # hash the right side by join-key value
+        rmap: dict = {}
+        for rid in right_ids:
+            v = self._cell_value(ridx, jr.name, rid)
+            if v is None:
+                continue
+            for key in (v if isinstance(v, list) else [v]):
+                rmap.setdefault(key, []).append(rid)
+
+        # memoize per (table, col, record): a left record matching k
+        # right rows would otherwise re-decode its cells k times
+        cell_cache: dict = {}
+
+        def cell(table, idx_, col, record_id):
+            key = (table, col, record_id)
+            if key not in cell_cache:
+                cell_cache[key] = self._cell_value(idx_, col, record_id)
+            return cell_cache[key]
+
+        def joined_value(table, col, lid, rid):
+            if table == lname:
+                return cell(lname, lidx, col, lid)
+            return cell(rname, ridx, col, rid)
+
+        def where_ok(lid, rid):
+            if where is None:
+                return True
+            return bool(self._eval_join_expr(where, lname, rname,
+                                             lidx, ridx, lid, rid))
+
+        rows = []
+        count_only = items and items[0][2] == "count(*)" and \
+            len(items) == 1
+        n = 0
+        for lid in left_ids:
+            lv = self._cell_value(lidx, jl.name, lid)
+            if lv is None:
+                continue
+            for key in (lv if isinstance(lv, list) else [lv]):
+                for rid in rmap.get(key, ()):
+                    if not where_ok(lid, rid):
+                        continue
+                    if count_only:
+                        n += 1
+                    else:
+                        rows.append(tuple(
+                            joined_value(t, c, lid, rid)
+                            for _, t, c in items))
+        if count_only:
+            return SQLResult(schema=[(items[0][0], "int")], rows=[(n,)])
+        # typed schema: resolve each projected column's SQL type
+        schema = []
+        for name, t, c in items:
+            idx_ = lidx if t == lname else ridx
+            if c == "_id":
+                schema.append((name, "id"))
+            else:
+                schema.append((name, _sql_type(self._field(idx_, c))))
+        rows = self._order_rows(stmt, schema, rows)
+        rows = self._limit_rows(stmt, rows)
+        return SQLResult(schema=schema, rows=rows)
+
+    def _eval_join_expr(self, e, lname, rname, lidx, ridx, lid, rid):
+        """Evaluate a WHERE expression over one joined row."""
+        if isinstance(e, ast.Lit):
+            return e.value
+        if isinstance(e, ast.Col):
+            t = e.table or lname
+            return self._cell_value(lidx if t == lname else ridx,
+                                    e.name, lid if t == lname else rid)
+        ev = lambda x: self._eval_join_expr(x, lname, rname, lidx,
+                                            ridx, lid, rid)
+        if isinstance(e, ast.BinOp):
+            if e.op == "and":
+                return ev(e.left) and ev(e.right)
+            if e.op == "or":
+                return ev(e.left) or ev(e.right)
+            l, r = ev(e.left), ev(e.right)
+            if l is None or r is None:
+                return False
+            if e.op == "=":
+                return l == r
+            if e.op in ("!=", "<>"):
+                return l != r
+            if e.op not in ("<", "<=", ">", ">="):
+                raise SQLError(f"JOIN WHERE operator {e.op!r} "
+                               "not supported")
+            try:
+                return {"<": l < r, "<=": l <= r,
+                        ">": l > r, ">=": l >= r}[e.op]
+            except TypeError:
+                raise SQLError(
+                    f"cannot compare {type(l).__name__} with "
+                    f"{type(r).__name__} in JOIN WHERE")
+        if isinstance(e, ast.Not):
+            return not ev(e.expr)
+        if isinstance(e, ast.IsNull):
+            return (ev(e.col) is None) != e.negated
+        raise SQLError(f"unsupported WHERE form in JOIN: {e!r}")
+
     def _order_rows(self, stmt, schema, rows):
         if not stmt.order_by:
             return rows
         if len(stmt.order_by) != 1:
             raise SQLError("single ORDER BY column supported")
         ob = stmt.order_by[0]
-        name = (self._col_name(ob.expr) if isinstance(ob.expr, ast.Col)
-                else self._name_of(ast.SelectItem(ob.expr)))
+        if isinstance(ob.expr, ast.Col) and ob.expr.table:
+            name = f"{ob.expr.table}.{ob.expr.name}"
+        elif isinstance(ob.expr, ast.Col):
+            name = ob.expr.name
+        else:
+            name = self._name_of(ast.SelectItem(ob.expr))
         names = [s[0] for s in schema]
-        if name not in names:
-            raise SQLError(f"ORDER BY column {name!r} not in projection")
-        i = names.index(name)
+        # unqualified names also match a unique qualified projection
+        matches = [i for i, n in enumerate(names)
+                   if n == name or ("." not in name
+                                    and n.split(".")[-1] == name)]
+        if len(matches) != 1:
+            raise SQLError(f"ORDER BY column {name!r} not in projection"
+                           if not matches else
+                           f"ORDER BY column {name!r} is ambiguous")
+        i = matches[0]
         nn = [r for r in rows if r[i] is not None]
         nulls = [r for r in rows if r[i] is None]
         nn.sort(key=lambda r: r[i], reverse=ob.desc)

@@ -200,6 +200,20 @@ class Parser:
                 break
         self.expect_kw("from")
         sel.table = self.expect("ident").value
+        while True:
+            if self.kw("inner"):
+                self.expect_kw("join")
+            elif not self.kw("join"):
+                break
+            jt = self.expect("ident").value
+            self.expect_kw("on")
+            cond = self.expr()
+            if not (isinstance(cond, ast.BinOp) and cond.op == "="
+                    and isinstance(cond.left, ast.Col)
+                    and isinstance(cond.right, ast.Col)):
+                raise SQLError(
+                    "JOIN ON must be column = column equality")
+            sel.joins.append(ast.Join(jt, cond.left, cond.right))
         if self.kw("where"):
             sel.where = self.expr()
         if self.kw("group"):
@@ -312,7 +326,10 @@ class Parser:
             return ast.Lit({"true": True, "false": False,
                             "null": None}[t.value])
         if t.kind == "ident":
-            return ast.Col(self.next().value)
+            name = self.next().value
+            if self.accept("op", "."):
+                return ast.Col(self.expect("ident").value, table=name)
+            return ast.Col(name)
         raise SQLError(f"unexpected {t.value!r} at {t.pos}")
 
     def aggregate(self):

@@ -326,3 +326,62 @@ def test_grouped_sum_all_null_group(eng_nulls):
         "SELECT region, SUM(qty) FROM orders GROUP BY region")))
     assert got["south"] is None
     assert got["west"] == 17
+
+
+def test_inner_join_basic(engine=None):
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.sql.engine import SQLEngine
+
+    eng = SQLEngine(Holder())
+    eng.query("CREATE TABLE users (_id ID, name STRING, age INT MIN 0 MAX 120)")
+    eng.query("CREATE TABLE orders (_id ID, user_id INT MIN 0 MAX 1000, "
+              "amount INT MIN 0 MAX 10000)")
+    eng.query("INSERT INTO users (_id, name, age) VALUES "
+              "(1, 'alice', 30), (2, 'bob', 40), (3, 'carol', 50)")
+    eng.query("INSERT INTO orders (_id, user_id, amount) VALUES "
+              "(10, 1, 100), (11, 1, 150), (12, 2, 200), (13, 99, 5)")
+
+    r = eng.query_one(
+        "SELECT orders._id, users.name, orders.amount "
+        "FROM orders INNER JOIN users ON orders.user_id = users._id "
+        "ORDER BY amount DESC")
+    assert [tuple(x) for x in r.rows] == [
+        (12, "bob", 200), (11, "alice", 150), (10, "alice", 100)]
+    assert [s[0] for s in r.schema] == ["orders._id", "users.name",
+                                        "orders.amount"]
+
+    # COUNT(*) over the join; order of ON sides is irrelevant
+    r = eng.query_one(
+        "SELECT COUNT(*) FROM orders JOIN users "
+        "ON users._id = orders.user_id")
+    assert r.rows == [(3,)]
+
+    # WHERE may reference either side
+    r = eng.query_one(
+        "SELECT users.name FROM orders JOIN users "
+        "ON orders.user_id = users._id "
+        "WHERE users.age > 35 AND orders.amount >= 200")
+    assert [tuple(x) for x in r.rows] == [("bob",)]
+
+    # LIMIT applies post-join
+    r = eng.query_one(
+        "SELECT orders._id FROM orders JOIN users "
+        "ON orders.user_id = users._id ORDER BY orders._id LIMIT 2")
+    assert [x[0] for x in r.rows] == [10, 11]
+
+
+def test_inner_join_errors():
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.sql.engine import SQLEngine
+    from pilosa_tpu.sql.lexer import SQLError
+    import pytest as _pytest
+
+    eng = SQLEngine(Holder())
+    eng.query("CREATE TABLE a (_id ID, x INT MIN 0 MAX 9)")
+    eng.query("CREATE TABLE b (_id ID, y INT MIN 0 MAX 9)")
+    with _pytest.raises(SQLError):
+        eng.query("SELECT x FROM a JOIN b ON x = y")  # unqualified ON
+    with _pytest.raises(SQLError):
+        eng.query("SELECT x FROM a JOIN b ON a.x = a.x")  # one-sided
+    with _pytest.raises(SQLError):
+        eng.query("SELECT c.z FROM a JOIN b ON a.x = b.y")  # bad table

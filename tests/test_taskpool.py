@@ -1,0 +1,58 @@
+"""Adaptive worker pool tests (task/doc.go behavior)."""
+
+import threading
+import time
+
+import pytest
+
+from pilosa_tpu.taskpool import Pool
+
+
+def test_pool_map_order_and_results():
+    p = Pool(size=3)
+    assert p.map(lambda x: x * 2, range(10)) == [x * 2 for x in range(10)]
+    assert p.map(lambda x: x, []) == []
+
+
+def test_pool_propagates_first_exception():
+    p = Pool(size=2)
+
+    def f(x):
+        if x == 3:
+            raise ValueError("boom3")
+        if x == 7:
+            raise ValueError("boom7")
+        return x
+
+    with pytest.raises(ValueError) as e:
+        p.map(f, range(10))
+    assert "boom3" in str(e.value)  # first by item order
+
+
+def test_pool_grows_when_all_blocked():
+    """With size=1, two tasks that BOTH must be in-flight to finish
+    would deadlock in a fixed pool; blocked() lets it grow."""
+    p = Pool(size=1, max_size=8)
+    barrier = threading.Barrier(2, timeout=5)
+
+    def task(pool, i):
+        with pool.blocked():
+            barrier.wait()  # needs BOTH tasks running concurrently
+        return i
+
+    t0 = time.time()
+    assert p.map(task, [0, 1]) == [0, 1]
+    assert time.time() - t0 < 5
+
+
+def test_pool_concurrency_speedup():
+    p = Pool(size=4)
+
+    def task(pool, i):
+        with pool.blocked():
+            time.sleep(0.05)
+        return i
+
+    t0 = time.time()
+    p.map(task, range(8))
+    assert time.time() - t0 < 0.05 * 8  # faster than serial
