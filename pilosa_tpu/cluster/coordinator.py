@@ -132,10 +132,14 @@ class ClusterNode:
             scols = [int(cols[i]) for i in idxs]
             stimes = ([timestamps[i] for i in idxs]
                       if timestamps is not None else None)
-            for node in snap.shard_nodes(index, shard):
+            # count changed bits ONCE per shard — from the primary
+            # (first owner); replica writes are forwarded but their
+            # counts are duplicates, not additional bits (api.go:651)
+            for j, node in enumerate(snap.shard_nodes(index, shard)):
                 n_ = self._import_to(node, index, field, srows, scols,
                                      stimes)
-            n += n_
+                if j == 0:
+                    n += n_
             shards_touched.add(shard)
         self.disco.add_shards(index, "", shards_touched)
         return n
@@ -151,14 +155,15 @@ class ClusterNode:
         for shard, idxs in groups.items():
             scols = [int(cols[i]) for i in idxs]
             svals = [values[i] for i in idxs]
-            for node in snap.shard_nodes(index, shard):
+            for j, node in enumerate(snap.shard_nodes(index, shard)):
                 if node.id == self.node_id:
                     n_ = self.api.import_values(index, field, cols=scols,
                                                 values=svals)
                 else:
                     n_ = self._client().import_values(
                         node.uri, index, field, scols, svals)
-            n += n_
+                if j == 0:  # primary's count only (see import_bits)
+                    n += n_
             shards_touched.add(shard)
         self.disco.add_shards(index, "", shards_touched)
         return n

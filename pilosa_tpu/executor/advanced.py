@@ -38,6 +38,7 @@ from pilosa_tpu.executor.results import (
 from pilosa_tpu.models.field import FALSE_ROW, TRUE_ROW
 from pilosa_tpu.models.schema import FieldType
 from pilosa_tpu.models.view import VIEW_STANDARD
+from pilosa_tpu.executor.stacked import Unstackable
 from pilosa_tpu.ops import bitmap as bm
 from pilosa_tpu.ops import bsi as bsi_ops
 from pilosa_tpu.ops import kernels
@@ -115,6 +116,14 @@ class AdvancedOps:
                    else self._all_row_ids(idx, f, shards))
         if not row_ids:
             return []
+        if getattr(self, "use_stacked", False):
+            try:
+                pairs = self._topnk_stacked(idx, f, row_ids, views,
+                                            filter_call, shards, pre, ids)
+            except Unstackable:
+                pairs = None
+            if pairs is not None:
+                return self._finish_topn(f, pairs, n, ids)
         counts = {r: 0 for r in row_ids}
         for shard in self._shard_list(idx, shards):
             filt = (self._bitmap_call_shard(idx, filter_call, shard, pre)
@@ -139,6 +148,30 @@ class AdvancedOps:
         pairs = [Pair(id=r, count=c) for r, c in counts.items()
                  if c > 0 or ids is not None]
         return self._finish_topn(f, pairs, n, ids)
+
+    # device-batch byte budget for the stacked (R, S, W) row scans
+    _ROWS_STACK_BUDGET = 1 << 28  # 256 MiB
+
+    def _topnk_stacked(self, idx, f, row_ids, views, filter_call,
+                       shards, pre, ids):
+        """TopN/TopK candidate scan on the stacked engine: for each
+        chunk of candidate rows, ONE fused (R, S, W) AND+popcount
+        device pass with the filter tree inlined (executor.go:2750
+        topKFilter + mergerator, collapsed into a single program)."""
+        eng = self.stacked
+        skey = tuple(self._shard_list(idx, shards))
+        words = idx.width // 32
+        chunk = max(1, self._ROWS_STACK_BUDGET // (max(len(skey), 1)
+                                                   * words * 4))
+        counts: dict[int, int] = {}
+        for i in range(0, len(row_ids), chunk):
+            rows = row_ids[i:i + chunk]
+            stack = eng.rows_stack_for(idx, f, tuple(views), rows, skey)
+            got = eng.row_counts(idx, stack, filter_call, list(skey), pre)
+            for r, c in zip(rows, got):
+                counts[r] = int(c)
+        return [Pair(id=r, count=c) for r, c in counts.items()
+                if c > 0 or ids is not None]
 
     def _topn_from_caches(self, idx, f, shards) -> list | None:
         """Merge per-fragment cache counts; None => no cache, use the

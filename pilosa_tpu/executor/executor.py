@@ -63,11 +63,24 @@ _REMOTE = contextvars.ContextVar("pilosa_tpu_remote", default=False)
 
 
 from pilosa_tpu.executor.advanced import AdvancedOps
+from pilosa_tpu.executor.stacked import StackedEngine, Unstackable
 
 
 class Executor(AdvancedOps):
     def __init__(self, holder: Holder):
         self.holder = holder
+        # the mesh-integrated stacked engine (executor/stacked.py):
+        # bitmap trees run as ONE jitted program over (S, W) shard
+        # stacks — the jitted analog of mapReduce (executor.go:6449).
+        # The per-shard Python loop below survives only as the
+        # fallback for trees the IR can't express.
+        self.stacked = StackedEngine(self)
+        self.use_stacked = True
+
+    def set_mesh(self, mesh):
+        """Place all shard stacks over a jax.sharding.Mesh; cross-
+        shard reductions then lower to ICI collectives."""
+        self.stacked.set_mesh(mesh)
 
     # ------------------------------------------------------------------
     # entry point (executor.Execute analog)
@@ -217,7 +230,19 @@ class Executor(AdvancedOps):
         if pre is None:
             pre = self._precompute_nested(idx, call, shards)
         out = RowResult(idx.width)
-        for shard in self._tree_shards(idx, shards, pre):
+        tree_shards = self._tree_shards(idx, shards, pre)
+        if self.use_stacked:
+            try:
+                words = self.stacked.words(idx, call, tree_shards, pre)
+                metrics.STACKED_QUERIES.inc(path="stacked")
+                if words is not None:
+                    for i, shard in enumerate(tree_shards):
+                        if words[i].any():
+                            out.segments[shard] = words[i]
+                return out
+            except Unstackable:
+                metrics.STACKED_QUERIES.inc(path="loop")
+        for shard in tree_shards:
             words = np.asarray(self._bitmap_call_shard(idx, call, shard, pre))
             if words.any():
                 out.segments[shard] = words
@@ -450,13 +475,19 @@ class Executor(AdvancedOps):
         return None
 
     def _reduce_count(self, idx: Index, call: Call, shards, pre) -> int:
-        """Count: per-shard popcounts fetched in ONE device->host
-        transfer.  A per-shard int() would sync the host every
-        iteration (executor.go's per-shard mapFn is free to — its
-        'device' is local RAM); stacking keeps the device pipeline
-        full and moves a single (S,) vector."""
+        """Count: the whole tree runs as one stacked device program
+        with a single (S,) partials fetch; cross-shard totals are
+        summed in exact host ints (SURVEY §7 "Exactness")."""
+        tree_shards = self._tree_shards(idx, shards, pre)
+        if self.use_stacked:
+            try:
+                n = self.stacked.count(idx, call, tree_shards, pre)
+                metrics.STACKED_QUERIES.inc(path="stacked")
+                return n
+            except Unstackable:
+                metrics.STACKED_QUERIES.inc(path="loop")
         words = [self._bitmap_call_shard(idx, call, shard, pre)
-                 for shard in self._tree_shards(idx, shards, pre)]
+                 for shard in tree_shards]
         if not words:
             return 0
         counts = np.asarray(bm.count(jnp.stack(words)), dtype=np.int64)
@@ -467,6 +498,15 @@ class Executor(AdvancedOps):
         if fname is None:
             raise ExecError("Sum requires field=")
         f = self._bsi_field(idx, fname)
+        if self.use_stacked:
+            try:
+                filter_call = call.children[0] if call.children else None
+                total, count = self.stacked.bsi_sum(
+                    idx, f, filter_call, self._shard_list(idx, shards), pre)
+                metrics.STACKED_QUERIES.inc(path="stacked")
+                return ValCount(value=f.int_to_value(total), count=count)
+            except Unstackable:
+                metrics.STACKED_QUERIES.inc(path="loop")
         # queue every shard's device scan, then fetch all per-plane
         # popcounts in one sync (see _reduce_count)
         parts_per_shard = []
@@ -817,7 +857,7 @@ class Executor(AdvancedOps):
             words = np.asarray(self._bitmap_call_shard(idx, child, shard, pre))
             frag = f.view(VIEW_STANDARD, create=True).fragment(
                 shard, create=True)
-            frag._row_mut(row_id)[:] = words
+            frag.set_row_words(row_id, words)
         return True
 
     def _execute_clear_row(self, idx: Index, call: Call) -> bool:
@@ -835,6 +875,6 @@ class Executor(AdvancedOps):
             for frag in v.fragments.values():
                 w = frag._rows.get(row_id)
                 if w is not None and w.any():
-                    frag._row_mut(row_id)[:] = 0
+                    frag.set_row_words(row_id, 0)
                     changed = True
         return changed

@@ -1,0 +1,661 @@
+"""Stacked shard execution — the mesh-integrated query engine.
+
+This is the TPU re-design of the reference's ``mapReduce`` shard
+fan-out (executor.go:6449-6812).  The reference maps a per-shard
+``mapFn`` over a worker pool and streams partial results through a
+``reduceFn``; here the shard axis becomes the LEADING AXIS of every
+operand: a whole PQL bitmap call tree compiles to ONE jitted XLA
+program over ``(S, W)`` shard-stacked tiles, and the cross-shard
+reduce is an XLA reduction that lowers to a ``psum`` over ICI when the
+stacks are placed on a ``jax.sharding.Mesh`` (shard axis sharded over
+the mesh's "shards" axis, exactly the placement of
+``parallel.place_shards``).
+
+Pieces:
+
+- ``PlanBuilder`` walks a ``pql.Call`` tree and emits a static IR
+  (nested tuples) plus a flat list of *leaf* arrays (stacked row
+  tiles, BSI plane stacks, existence rows, precomputed cross-shard
+  results) and *param* arrays (BSI predicate masks / sign flags that
+  change per query WITHOUT recompiling).
+- ``TileStackCache`` memoizes the expensive part — stacking S host
+  rows into one device-resident array — keyed by fragment versions so
+  any write invalidates exactly the stacks it touched.  The cache is
+  byte-bounded with LRU eviction (the HBM-residency policy the
+  reference implements with its rank cache, cache.go:130).
+- A per-structure jit cache: two queries with the same tree *shape*
+  (e.g. ``Count(Intersect(Row(f=A), Row(g=B)))`` for any A, B) reuse
+  one compiled executable; predicates ride in as runtime params.
+
+Supported reductions: ``words`` (bitmap result), ``count``,
+``bsi_sum`` (Sum over a filter tree), ``row_counts`` (the TopN/TopK
+candidate-row scan, executor.go:2750 topKFilter as one fused AND +
+popcount over the (R, S, W) stack).
+
+Anything the IR cannot express raises ``Unstackable`` and the executor
+falls back to the per-shard loop path (the reference's own remote/
+local split has the same shape: fast path plus fallback).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from pilosa_tpu.models.view import VIEW_STANDARD
+from pilosa_tpu.ops import bitmap as bm
+from pilosa_tpu.ops import bsi as bsi_ops
+from pilosa_tpu.pql import ast as past
+from pilosa_tpu.pql.ast import Call, Condition
+
+
+class Unstackable(Exception):
+    """Raised when a call tree has no stacked-program equivalent."""
+
+
+# ---------------------------------------------------------------------------
+# tile-stack cache
+# ---------------------------------------------------------------------------
+
+class TileStackCache:
+    """LRU byte-bounded cache of device-resident shard stacks.
+
+    An entry is keyed by (index, field, view-set, row, shards, mesh
+    epoch) and guarded by the tuple of contributing fragment versions:
+    any host write bumps the fragment version (models/fragment.py) and
+    the next access rebuilds just that stack.  Eviction is LRU over
+    bytes — the HBM analog of the reference's rank-cache residency
+    policy (cache.go:130): hot query rows stay device-resident, cold
+    ones re-upload on demand.
+    """
+
+    def __init__(self, max_bytes: int = 8 << 30):
+        self.max_bytes = max_bytes
+        self._entries: OrderedDict[tuple, tuple] = OrderedDict()
+        self._bytes = 0
+        # queries are served concurrently from the threaded HTTP/gRPC
+        # servers; the LRU's linked list is not safe to mutate from
+        # two handler threads at once
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key, versions: tuple, build):
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is not None and ent[0] == versions:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return ent[1]
+            self.misses += 1
+        arr = build()  # outside the lock: stack + device upload is slow
+        nbytes = int(np.prod(arr.shape)) * arr.dtype.itemsize
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[2]
+            self._entries[key] = (versions, arr, nbytes)
+            self._bytes += nbytes
+            while self._bytes > self.max_bytes and len(self._entries) > 1:
+                _, (_, _, nb) = self._entries.popitem(last=False)
+                self._bytes -= nb
+        return arr
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
+
+
+# ---------------------------------------------------------------------------
+# per-structure jit cache
+# ---------------------------------------------------------------------------
+
+_JIT_CACHE: dict[str, object] = {}
+
+_NARY_OPS = {
+    "union": bm.union,
+    "intersect": bm.intersect,
+    "difference": bm.difference,
+    "xor": bm.xor,
+}
+
+_BSI_CMP = {
+    "eq": lambda p, pb, neg: bsi_ops.range_eq(p, pb, neg),
+    "neq": lambda p, pb, neg: bsi_ops.range_neq(p, pb, neg),
+    "lt": lambda p, pb, neg: bsi_ops.range_lt(p, pb, neg, allow_eq=False),
+    "lte": lambda p, pb, neg: bsi_ops.range_lt(p, pb, neg, allow_eq=True),
+    "gt": lambda p, pb, neg: bsi_ops.range_gt(p, pb, neg, allow_eq=False),
+    "gte": lambda p, pb, neg: bsi_ops.range_gt(p, pb, neg, allow_eq=True),
+}
+
+
+def _eval(node, leaves, params):
+    """Trace-time recursive evaluation of the static IR."""
+    k = node[0]
+    if k == "leaf":
+        return leaves[node[1]]
+    if k == "zeros":
+        return jnp.uint32(0)  # broadcasts through every bitwise op
+    if k == "nary":
+        op = _NARY_OPS[node[1]]
+        acc = _eval(node[2][0], leaves, params)
+        for c in node[2][1:]:
+            acc = op(acc, _eval(c, leaves, params))
+        return acc
+    if k == "not":
+        return bm.difference(leaves[node[1]], _eval(node[2], leaves, params))
+    if k == "shift":
+        return bm.shift(_eval(node[2], leaves, params), node[1])
+    if k == "bsi_cmp":
+        planes = leaves[node[1]]                      # (S, P, W)
+        fn = _BSI_CMP[node[2]]
+        pb, neg = params[node[3]], params[node[4]]
+        return jax.vmap(fn, in_axes=(0, None, None))(planes, pb, neg)
+    if k == "bsi_between":
+        planes = leaves[node[1]]
+        ab, bb = params[node[2]], params[node[3]]
+        an, bn = params[node[4]], params[node[5]]
+        return jax.vmap(bsi_ops.range_between,
+                        in_axes=(0, None, None, None, None))(
+            planes, ab, bb, an, bn)
+    if k == "bsi_notnull":
+        return leaves[node[1]][:, 0]                  # exists plane
+    if k == "bsi_null":
+        planes = leaves[node[1]]
+        return bm.difference(leaves[node[2]], planes[:, 0])
+    raise AssertionError(f"bad IR node {k}")
+
+
+def _as_stack(out, leaves):
+    """Shape guard: tree evaluation always yields an (S, W) stack.
+
+    Zeros nodes are constant-folded away by the builder, so a scalar
+    can only reach here through an IR bug — fail loudly rather than
+    broadcasting to a guessed shape.
+    """
+    assert out.ndim >= 2, "stacked IR produced a scalar (unfolded zeros?)"
+    return out
+
+
+def _compiled(plan):
+    """plan: ("words"|"count", tree) | ("bsi_sum", planes_i, tree|None)
+    | ("row_counts", rows_i, tree|None).  One jitted fn per structure."""
+    sig = repr(plan)
+    fn = _JIT_CACHE.get(sig)
+    if fn is not None:
+        return fn
+    kind = plan[0]
+    if kind == "words":
+        tree = plan[1]
+
+        def run(leaves, params):
+            return _as_stack(_eval(tree, leaves, params), leaves)
+    elif kind == "count":
+        tree = plan[1]
+
+        def run(leaves, params):
+            return bm.count(_as_stack(_eval(tree, leaves, params), leaves))
+    elif kind == "bsi_sum":
+        planes_i, tree = plan[1], plan[2]
+
+        def run(leaves, params):
+            planes = leaves[planes_i]
+            if tree is None:
+                return jax.vmap(lambda p: bsi_ops.sum_counts(p, None))(planes)
+            filt = _as_stack(_eval(tree, leaves, params), leaves)
+            return jax.vmap(bsi_ops.sum_counts)(planes, filt)
+    elif kind == "row_counts":
+        rows_i, tree = plan[1], plan[2]
+
+        def run(leaves, params):
+            rows = leaves[rows_i]                     # (R, S, W)
+            if tree is None:
+                return bm.count(rows)                 # (R, S)
+            filt = _as_stack(_eval(tree, leaves, params), leaves)
+            return bm.count(jnp.bitwise_and(rows, filt[None]))
+    else:
+        raise AssertionError(kind)
+    fn = jax.jit(run)
+    _JIT_CACHE[sig] = fn
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# plan builder
+# ---------------------------------------------------------------------------
+
+class PlanBuilder:
+    """Walks a bitmap Call tree → IR + leaf/param arrays.
+
+    Mirrors the dispatch set of executeBitmapCallShard
+    (executor.go:1782): Row (incl. BSI conditions + time views),
+    Union/Intersect/Difference/Xor/Not/All/Shift/ConstRow, and
+    precomputed cross-shard leaves (Distinct/UnionRows) served from
+    the per-query precompute cache.
+    """
+
+    def __init__(self, engine: "StackedEngine", idx, shards: list[int], pre):
+        self.engine = engine
+        self.ex = engine.executor
+        self.idx = idx
+        self.shards = list(shards)
+        self.skey = tuple(self.shards)
+        self.pre = pre or {}
+        self.leaves: list = []
+        self.params: list = []
+        self._leaf_keys: dict = {}
+
+    # -- leaf helpers ---------------------------------------------------
+
+    def _add_leaf(self, arr) -> int:
+        self.leaves.append(arr)
+        return len(self.leaves) - 1
+
+    def _cached_leaf(self, key, fetch) -> int:
+        i = self._leaf_keys.get(key)
+        if i is None:
+            i = self._add_leaf(fetch())
+            self._leaf_keys[key] = i
+        return i
+
+    def _param(self, arr) -> int:
+        # params are tiny (predicate masks, sign flags): keep them on
+        # the host and let jit move them with the call — no eager
+        # device commit (host_only harnesses never touch a device)
+        self.params.append(np.asarray(arr))
+        return len(self.params) - 1
+
+    def _row_leaf(self, field, views: tuple[str, ...], row_id: int) -> int:
+        return self._cached_leaf(
+            ("row", self.idx.name, field.name, views, row_id),
+            lambda: self.engine.row_stack(self.idx, field, views, row_id,
+                                          self.skey))
+
+    def _planes_leaf(self, field) -> int:
+        return self._cached_leaf(
+            ("planes", self.idx.name, field.name, field.bit_depth),
+            lambda: self.engine.plane_stack(self.idx, field, self.skey))
+
+    def _existence_leaf(self) -> int:
+        if not self.idx.track_existence:
+            raise Unstackable("existence tracking off")
+        return self._cached_leaf(
+            ("exists", self.idx.name),
+            lambda: self.engine.existence_stack(self.idx, self.skey))
+
+    def _pre_leaf(self, call) -> int:
+        res = self.pre.get(id(call))
+        if res is None:
+            raise Unstackable(f"no precomputed result for {call.name}")
+        return self._cached_leaf(
+            ("pre", id(call)),
+            lambda: self.engine.place(np.stack(
+                [res.shard_words(s) for s in self.shards])))
+
+    # -- tree walk ------------------------------------------------------
+
+    def build(self, call: Call):
+        name = call.name
+        if name in ("Row", "Range"):
+            return self._build_row(call)
+        if name in ("Union", "Intersect", "Difference", "Xor"):
+            op = name.lower()
+            if not call.children:
+                if name in ("Union", "Xor"):
+                    return ("zeros",)
+                raise Unstackable(f"{name} requires subqueries")
+            children = [self.build(c) for c in call.children]
+            # constant-fold zeros so ("zeros",) never survives inside
+            # a tree (its scalar broadcast is only safe at the root):
+            #   union/xor: drop zero terms; intersect: any zero term
+            #   zeroes the whole product; difference: zero base is
+            #   zero, zero subtrahends drop out.
+            zero = ("zeros",)
+            if op in ("union", "xor"):
+                children = [c for c in children if c != zero]
+                if not children:
+                    return zero
+            elif op == "intersect":
+                if zero in children:
+                    return zero
+            elif op == "difference":
+                if children[0] == zero:
+                    return zero
+                children = [children[0]] + [c for c in children[1:]
+                                            if c != zero]
+            if len(children) == 1:
+                return children[0]
+            return ("nary", op, tuple(children))
+        if name == "Not":
+            child = self.ex._only_child(call)
+            exist_i = self._existence_leaf()
+            sub = self.build(child)
+            if sub == ("zeros",):
+                return ("leaf", exist_i)
+            return ("not", exist_i, sub)
+        if name == "All":
+            return ("leaf", self._existence_leaf())
+        if name == "Shift":
+            child = self.ex._only_child(call)
+            n = int(call.arg("n", 1))
+            sub = self.build(child)
+            if sub == ("zeros",):
+                return sub
+            return ("shift", n, sub)
+        if name == "ConstRow":
+            cols = call.arg("columns", []) or []
+            width = self.idx.width
+            per_shard = {}
+            for c in cols:
+                per_shard.setdefault(c // width, []).append(c % width)
+            stack = np.stack([bm.from_columns(per_shard.get(s, []), width)
+                              for s in self.shards])
+            return ("leaf", self._add_leaf(self.engine.place(stack)))
+        if name in ("Distinct", "UnionRows"):
+            return ("leaf", self._pre_leaf(call))
+        if name == "Precomputed":
+            return ("leaf", self._pre_leaf(call))
+        raise Unstackable(f"not a stackable bitmap call: {name}")
+
+    def _build_row(self, call: Call):
+        ex = self.ex
+        fname, cond = call.condition_field()
+        if cond is not None:
+            return self._build_bsi(fname, cond)
+        fname, row_val = call.field_arg()
+        if fname is None:
+            raise Unstackable("Row() without field argument")
+        f = self.idx.field(fname)
+        if f is None:
+            raise Unstackable(f"field not found: {fname}")
+        if f.options.type.is_bsi:
+            return self._build_bsi(fname, Condition(past.OP_EQ, row_val))
+        row_id = ex._row_id_for_value(f, row_val)
+        if row_id is None:
+            return ("zeros",)
+        views = tuple(f.views_for_range(call.arg("from"), call.arg("to")))
+        return ("leaf", self._row_leaf(f, views, row_id))
+
+    def _build_bsi(self, fname: str, cond: Condition):
+        """BSI predicate → IR, mirroring the plan-time scaling and
+        short-circuits of Executor._bsi_condition_shard."""
+        ex = self.ex
+        f = ex._bsi_field(self.idx, fname)
+        depth = f.bit_depth
+        v = f.views.get(f.bsi_view)
+        if v is None or not v.fragments:
+            if cond.value is None and cond.op == past.OP_EQ:
+                return ("leaf", self._existence_leaf())
+            return ("zeros",)
+        planes_i = self._planes_leaf(f)
+
+        if cond.value is None:
+            if cond.op == past.OP_EQ:
+                return ("bsi_null", planes_i, self._existence_leaf())
+            if cond.op == past.OP_NEQ:
+                return ("bsi_notnull", planes_i)
+            raise Unstackable(f"invalid null comparison {cond.op}")
+
+        max_mag = (1 << depth) - 1
+
+        def masks(up):
+            return self._param(bsi_ops.predicate_masks(up, depth))
+
+        def flag(b):
+            return self._param(bool(b))
+
+        if past.is_between(cond):
+            lo_raw, hi_raw = cond.value
+            lo = ex._scaled_bound(f, lo_raw, round_up=True)
+            hi = ex._scaled_bound(f, hi_raw, round_up=False)
+            if cond.op in (past.OP_BTWN_LT_LT, past.OP_BTWN_LT_LTE):
+                lo = max(lo, ex._scaled_bound(f, lo_raw, round_up=False) + 1)
+            if cond.op in (past.OP_BTWN_LT_LT, past.OP_BTWN_LTE_LT):
+                hi = min(hi, ex._scaled_bound(f, hi_raw, round_up=True) - 1)
+            lo, hi = max(lo, -max_mag), min(hi, max_mag)
+            if lo > hi:
+                return ("zeros",)
+            return ("bsi_between", planes_i, masks(abs(lo)), masks(abs(hi)),
+                    flag(lo < 0), flag(hi < 0))
+
+        op = cond.op
+        if op in (past.OP_EQ, past.OP_NEQ):
+            p_lo = ex._scaled_bound(f, cond.value, round_up=False)
+            p_hi = ex._scaled_bound(f, cond.value, round_up=True)
+            out_of_range = p_lo != p_hi or abs(p_lo) > max_mag
+            if op == past.OP_EQ:
+                if out_of_range:
+                    return ("zeros",)
+                return ("bsi_cmp", planes_i, "eq", masks(abs(p_lo)),
+                        flag(p_lo < 0))
+            if out_of_range:
+                return ("bsi_notnull", planes_i)
+            return ("bsi_cmp", planes_i, "neq", masks(abs(p_lo)),
+                    flag(p_lo < 0))
+        if op in (past.OP_LT, past.OP_LTE):
+            allow_eq = op == past.OP_LTE
+            p = ex._scaled_bound(f, cond.value, round_up=not allow_eq)
+            if p > max_mag:
+                return ("bsi_notnull", planes_i)
+            if p < -max_mag:
+                return ("zeros",)
+            return ("bsi_cmp", planes_i, "lte" if allow_eq else "lt",
+                    masks(abs(p)), flag(p < 0))
+        if op in (past.OP_GT, past.OP_GTE):
+            allow_eq = op == past.OP_GTE
+            p = ex._scaled_bound(f, cond.value, round_up=allow_eq)
+            if p < -max_mag:
+                return ("bsi_notnull", planes_i)
+            if p > max_mag:
+                return ("zeros",)
+            return ("bsi_cmp", planes_i, "gte" if allow_eq else "gt",
+                    masks(abs(p)), flag(p < 0))
+        raise Unstackable(f"unsupported condition op {op}")
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+class StackedEngine:
+    """Executes PQL call trees as stacked-shard device programs.
+
+    Owned by Executor; holds the tile-stack cache and the (optional)
+    device mesh.  With a mesh set, every stack is placed with the
+    shard axis sharded over the mesh "shards" axis (the placement of
+    parallel.place_shards) and XLA inserts the ICI collectives for the
+    cross-shard reduction — the jitted analog of mapReduce's reduceFn.
+    """
+
+    def __init__(self, executor, max_cache_bytes: int = 8 << 30):
+        self.executor = executor
+        self.mesh = None
+        self.cache = TileStackCache(max_cache_bytes)
+        # host_only=True keeps leaf stacks as numpy (no eager device
+        # commit); jit transfers them at call time.  Used by harnesses
+        # that want the compiled program without touching a device.
+        self.host_only = False
+
+    # -- mesh / placement ----------------------------------------------
+
+    def set_mesh(self, mesh):
+        """Set (or clear) the device mesh; placed stacks are mesh-
+        specific so the cache restarts cold."""
+        self.mesh = mesh
+        self.cache.clear()
+
+    def place(self, arr: np.ndarray):
+        """Host (S, ..., W) stack → device; axis 0 sharded over the
+        mesh (zero-padded to a multiple) via parallel.place_shards."""
+        arr = np.ascontiguousarray(arr)
+        if self.host_only:
+            return arr
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        from pilosa_tpu.parallel.mesh import place_shards
+        return place_shards(self.mesh, arr, batch_axes=arr.ndim - 2)
+
+    # -- stack builders (cached) ---------------------------------------
+
+    def _frags(self, idx, field, view: str, shards):
+        v = field.views.get(view)
+        return [v.fragment(s) if v else None for s in shards]
+
+    def _versions(self, frags) -> tuple:
+        return tuple(-1 if fr is None else fr.version for fr in frags)
+
+    def row_stack(self, idx, field, views: tuple[str, ...], row_id: int,
+                  skey: tuple):
+        """(S, W) device stack of one row, unioned across views."""
+        shards = list(skey)
+        key = ("row", idx.name, field.name, views, row_id, skey,
+               id(self.mesh))
+        per_view = [self._frags(idx, field, vn, shards) for vn in views]
+        versions = tuple(self._versions(fr) for fr in per_view)
+
+        def build():
+            width = idx.width
+            out = np.zeros((len(shards), width // 32), dtype=np.uint32)
+            for frags in per_view:
+                for i, fr in enumerate(frags):
+                    if fr is not None:
+                        out[i] |= fr.row_words(row_id)
+            return self.place(out)
+
+        return self.cache.get(key, versions, build)
+
+    def plane_stack(self, idx, field, skey: tuple):
+        """(S, 2+depth, W) device stack of a BSI field's planes."""
+        shards = list(skey)
+        depth = field.bit_depth
+        key = ("planes", idx.name, field.name, depth, skey, id(self.mesh))
+        frags = self._frags(idx, field, field.bsi_view, shards)
+        versions = self._versions(frags)
+
+        def build():
+            width = idx.width
+            out = np.zeros((len(shards), 2 + depth, width // 32),
+                           dtype=np.uint32)
+            for i, fr in enumerate(frags):
+                if fr is not None:
+                    for r in range(2 + depth):
+                        out[i, r] = fr.row_words(r)
+            return self.place(out)
+
+        return self.cache.get(key, versions, build)
+
+    def existence_stack(self, idx, skey: tuple):
+        from pilosa_tpu.models.index import EXISTENCE_FIELD
+        f = idx.fields.get(EXISTENCE_FIELD)
+        if f is None:
+            raise Unstackable("no existence field")
+        return self.row_stack(idx, f, (VIEW_STANDARD,), 0, skey)
+
+    # -- execution entry points ----------------------------------------
+
+    def _run(self, plan, builder):
+        fn = _compiled(plan)
+        return fn(tuple(builder.leaves), tuple(builder.params))
+
+    def count(self, idx, call: Call, shards: list[int], pre) -> int:
+        """Exact Count via one device program + one host fetch."""
+        if not shards:
+            return 0
+        b = PlanBuilder(self, idx, shards, pre)
+        tree = b.build(call)
+        if tree == ("zeros",):
+            return 0
+        counts = np.asarray(self._run(("count", tree), b), dtype=np.int64)
+        return int(counts.sum())
+
+    def words(self, idx, call: Call, shards: list[int], pre):
+        """(S, W) numpy result of a bitmap tree (one fetch), or None
+        for a statically-empty tree."""
+        if not shards:
+            return None
+        b = PlanBuilder(self, idx, shards, pre)
+        tree = b.build(call)
+        if tree == ("zeros",):
+            return None
+        out = np.asarray(self._run(("words", tree), b))
+        return out[: len(shards)]  # drop mesh padding shards
+
+    def bsi_sum(self, idx, field, filter_call, shards: list[int], pre):
+        """Per-shard Sum partials for `field` under an optional filter
+        tree; host-combined into exact ints by the caller."""
+        b = PlanBuilder(self, idx, shards, pre)
+        planes_i = b._planes_leaf(field)
+        tree = None
+        if filter_call is not None:
+            tree = b.build(filter_call)
+            if tree == ("zeros",):
+                return 0, 0
+        cnt, pos, neg = self._run(("bsi_sum", planes_i, tree), b)
+        pos = np.asarray(pos, dtype=np.int64).sum(axis=0)
+        neg = np.asarray(neg, dtype=np.int64).sum(axis=0)
+        total = sum((int(p) - int(n)) << i
+                    for i, (p, n) in enumerate(zip(pos, neg)))
+        return int(total), int(np.asarray(cnt, dtype=np.int64).sum())
+
+    def row_counts(self, idx, rows_stack, filter_call, shards: list[int],
+                   pre) -> np.ndarray:
+        """(R,) exact intersection counts of candidate-row stacks
+        against a filter tree — the TopN/TopK hot loop as one fused
+        device pass (executor.go:2750 topKFilter)."""
+        b = PlanBuilder(self, idx, shards, pre)
+        rows_i = b._add_leaf(rows_stack)
+        tree = b.build(filter_call) if filter_call is not None else None
+        if tree == ("zeros",):
+            return np.zeros(rows_stack.shape[0], dtype=np.int64)
+        partials = np.asarray(
+            self._run(("row_counts", rows_i, tree), b), dtype=np.int64)
+        return partials.sum(axis=1)
+
+    def rows_stack_for(self, idx, field, views: tuple[str, ...],
+                       row_ids, skey: tuple):
+        """(R, S, W) stacked candidate rows for the TopN/TopK scan.
+
+        Cached as ONE chunk-level entry (not R per-row entries): a
+        broad TopN over thousands of rows must not flood the LRU and
+        evict the hot per-query leaves, but a repeated TopN on a warm
+        engine should not re-upload its candidate stacks either.
+        """
+        shards = list(skey)
+        row_key = tuple(int(r) for r in row_ids)
+        key = ("rowchunk", idx.name, field.name, views, row_key, skey,
+               id(self.mesh))
+        per_view = [self._frags(idx, field, vn, shards) for vn in views]
+        versions = tuple(self._versions(fr) for fr in per_view)
+
+        def build():
+            width = idx.width
+            out = np.zeros((len(row_key), len(shards), width // 32),
+                           dtype=np.uint32)
+            for frags in per_view:
+                for si, fr in enumerate(frags):
+                    if fr is not None:
+                        for ri, r in enumerate(row_key):
+                            out[ri, si] |= fr.row_words(r)
+            if self.mesh is None:
+                return jnp.asarray(out)
+            # shard axis is axis 1 here; pad + shard it over the mesh
+            n = self.mesh.shape["shards"]
+            s = out.shape[1]
+            if s % n:
+                out = np.concatenate(
+                    [out, np.zeros((out.shape[0], n - s % n, out.shape[2]),
+                                   dtype=out.dtype)], axis=1)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            return jax.device_put(
+                out, NamedSharding(self.mesh, P(None, "shards", None)))
+
+        return self.cache.get(key, versions, build)

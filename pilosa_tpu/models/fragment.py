@@ -38,6 +38,10 @@ class Fragment:
         self._rows: dict[int, np.ndarray] = {}   # row id -> packed words
         self._device: dict[int, jnp.ndarray] = {}
         self._planes_cache: jnp.ndarray | None = None
+        # monotonically increasing write stamp: every host mutation
+        # bumps it, and device-side stack caches (executor/stacked.py
+        # TileStackCache) compare stamps to detect staleness
+        self.version = 0
         # rows changed since the last storage sync (persisted by
         # IndexStorage.write_fragments; empty when storage is None)
         self.dirty_rows: set[int] = set()
@@ -64,6 +68,7 @@ class Fragment:
         return w
 
     def _invalidate(self, row: int):
+        self.version += 1
         self._device.pop(row, None)
         self._planes_cache = None
         self.dirty_rows.add(row)
@@ -71,6 +76,19 @@ class Fragment:
             # re-insert at the end: most recent write is refreshed last
             self._cache_stale.pop(row, None)
             self._cache_stale[row] = None
+
+    def touch(self, row: int):
+        """Post-mutation invalidation.  ``_row_mut`` invalidates BEFORE
+        handing out the mutable array; every mutator must also touch()
+        AFTER the bytes land, or a concurrent reader that snapshots
+        ``version`` between the two could cache pre-write data under
+        the post-write version forever."""
+        self._invalidate(row)
+
+    def set_row_words(self, row: int, words) -> None:
+        """Replace a whole row (Store()/ClearRow write path)."""
+        self._row_mut(row)[:] = words
+        self.touch(row)
 
     def set_bit(self, row: int, col: int) -> bool:
         """Set one bit; returns True if it changed (fragment.setBit)."""
@@ -80,6 +98,7 @@ class Fragment:
         if words[w] & b:
             return False
         words[w] |= b
+        self.touch(row)
         return True
 
     def clear_bit(self, row: int, col: int) -> bool:
@@ -91,6 +110,7 @@ class Fragment:
             return False
         self._invalidate(row)
         words[w] &= ~b
+        self.touch(row)
         return True
 
     def import_bits(self, rows, cols, clear: bool = False):
@@ -107,6 +127,7 @@ class Fragment:
                 words &= ~mask
             else:
                 words |= mask
+            self.touch(int(r))
 
     def contains(self, row: int, col: int) -> bool:
         words = self._rows.get(row)
@@ -153,6 +174,7 @@ class Fragment:
         if clear:
             for r in range(2 + depth):
                 self._row_mut(r)[:] &= ~touched
+                self.touch(r)
             return
         neg = vals < 0
         mags = np.where(neg, np.negative(vals), vals).view(np.uint64)
@@ -167,6 +189,8 @@ class Fragment:
             plane &= ~touched
             plane |= bm.from_columns(
                 cols[(mags >> np.uint64(i)) & np.uint64(1) == 1], self.width)
+        for r in range(2 + depth):
+            self.touch(r)
 
     def clear_columns(self, mask_words: np.ndarray) -> bool:
         """Clear every bit in the masked columns across ALL rows
@@ -177,6 +201,7 @@ class Fragment:
             row = self._rows[r]
             if (row & ~inv).any():
                 self._row_mut(r)[:] = row & inv
+                self.touch(r)
                 changed = True
         return changed
 

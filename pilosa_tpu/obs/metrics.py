@@ -214,3 +214,6 @@ HTTP_REQUESTS = registry.counter(
     "pilosa_http_request_total", "HTTP requests by route/status")
 JOB_TOTAL = registry.counter(
     "pilosa_job_total", "Per-shard executor jobs run")
+STACKED_QUERIES = registry.counter(
+    "pilosa_stacked_queries_total",
+    "Query ops routed to the stacked mesh engine vs the shard loop")

@@ -101,6 +101,39 @@ def test_cluster_basic_query(cluster):
     assert r["results"][0][0]["count"] == 5
 
 
+def test_import_count_with_replication(cluster):
+    """The returned changed-bit count is the primary's count, counted
+    once per shard — NOT accumulated per replica and NOT dropped for
+    all but the last replica (api.go:651-672 semantics)."""
+    n0 = cluster[0]
+    n0.apply_schema(SCHEMA)
+    cols = [1, 5, SHARD + 1, 2 * SHARD + 7, 3 * SHARD + 9]
+    n = n0.import_bits("c", "f", [1] * len(cols), cols)
+    assert n == 5  # replica_n=2 must not double- or under-count
+    nv = n0.import_values("c", "v", cols, [10, 20, 30, 40, 50])
+    assert nv == 5
+
+
+def test_import_count_empty_owner_set():
+    """A shard with no live owners contributes 0 (previously: unbound
+    or stale n_)."""
+    disco = InMemDisCo(lease_ttl=1.0)
+    node = ClusterNode("solo", disco, holder=Holder(),
+                       replica_n=2, heartbeat_interval=0.2).open()
+    try:
+        node.apply_schema(SCHEMA)
+
+        class _EmptySnap:
+            def shard_nodes(self, index, shard):
+                return []
+
+        node.snapshot = lambda: _EmptySnap()
+        assert node.import_bits("c", "f", [1, 1], [1, 2]) == 0
+        assert node.import_values("c", "v", [1, 2], [7, 8]) == 0
+    finally:
+        node.close()
+
+
 def test_cluster_replication_failover(cluster):
     n0 = cluster[0]
     n0.apply_schema(SCHEMA)
