@@ -1,135 +1,213 @@
-"""Headline benchmark — north-star query on real hardware.
+"""Headline benchmark — the north-star queries through the REAL engine.
 
-Measures per-query device latency of the fused distributed-query step
-(PQL ``Count(Intersect(Row, Row))`` plus TopK over candidate rows) on a
-~1-billion-column / 1M-columns-per-shard index, the workload named by
-BASELINE.json's north star (reference harness: qa/scripts/perf/able/
-ableTest.sh:63, cmd/pilosa-bench/main.go:25-60 — the reference repo
-publishes no numbers, so the target is the north star itself:
-p50 < 10 ms on a v5e-16).
+Unlike round 1 (which timed a hand-written fused kernel over synthetic
+arrays), this drives ``Executor.execute()`` end-to-end: PQL text in,
+parser → stacked plan compiler (executor/stacked.py) → one jitted
+device program per tree → exact host reduction.  The index is real —
+Holder/Index/Field/Fragment populated through the bulk dense-row
+import path (``Fragment.import_row_words``, the dense analog of the
+reference's ImportRoaring restore path; the reference's own 1B-row
+"able" gauntlet likewise restores pre-built data rather than per-bit
+ingest, qa/scripts/perf/able/able.yaml).
 
-Methodology: the dev harness reaches the chip through a network tunnel
-whose ~70 ms per-dispatch RTT would swamp the ~5 ms device scan, so we
-run K query iterations inside ONE jitted ``lax.fori_loop`` (inputs
-perturbed per-iteration so XLA cannot hoist the scan out of the loop)
-and difference two trip counts to cancel the constant dispatch
-overhead.  That is the latency a real deployment sees, where the
-controller runs on the TPU host.  We run on however many chips are
-present and report the v5e-16 equivalent by linear shard-data-parallel
-scaling (the query is embarrassingly parallel over shards with a
-scalar psum reduce — see pilosa_tpu/parallel/).
+Workload (BASELINE.json north star; reference harnesses
+qa/scripts/perf/able/ableTest.sh:63, cmd/pilosa-bench/main.go:25-60):
+``Count(Intersect(Row(a=1), Row(b=1)))`` and ``TopN(t, n=10)`` over
+~1e9 columns (954 shards x 2^20), ~1e9 set cells in a/b.
+
+Methodology notes (all measured, nothing assumed):
+- The dev harness reaches the chip through a network tunnel with a
+  multi-ms per-dispatch RTT.  We therefore time the SAME engine path
+  twice — at full scale and on a tiny 1-shard index — and subtract:
+  both runs issue identical dispatch sequences, so the difference is
+  pure device scan time.  Raw wall numbers are printed to stderr.
+- Backend init is probed in a SUBPROCESS with a timeout and retried
+  with backoff (round 1 lost its only perf evidence to one init
+  crash); if the TPU never comes up the bench falls back to CPU with
+  the platform recorded in the metric name.
+- v5e-16 equivalent: the scan is shard-data-parallel (the stacked
+  engine shards the same program over a mesh — tests/test_stacked.py
+  proves the mesh path; only one chip is physically reachable here),
+  so 16-chip time is device_time x chips/16, labeled as an equivalent.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": per_query_ms_v5e16_equiv, "unit": "ms",
-     "vs_baseline": 10.0 / value}
-so vs_baseline > 1.0 means the north-star target is beaten.
+    {"metric": ..., "value": p50_ms, "unit": "ms", "vs_baseline": ...}
+vs_baseline > 1.0 means the 10 ms north-star target is beaten.
 """
 
 from __future__ import annotations
 
-import functools
 import json
+import os
 import statistics
+import subprocess
 import sys
 import time
 
 NORTH_STAR_MS = 10.0
 NORTH_STAR_CHIPS = 16
-TOPK_CANDIDATE_ROWS = 32
-K = 10
+PROBE_TIMEOUT_S = 240
+PROBE_ATTEMPTS = 3
+PROBE_BACKOFF_S = 30
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def probe_backend() -> tuple[str, int]:
+    """Initialize JAX in a subprocess (a hung TPU init cannot wedge
+    the bench) with retries; returns (platform, n_devices)."""
+    # the site customization force-selects the TPU platform through
+    # jax.config, overriding the env var — honor an explicit
+    # JAX_PLATFORMS (CPU smoke runs) by overriding it back
+    code = ("import os, jax;\n"
+            "p = os.environ.get('JAX_PLATFORMS');\n"
+            "jax.config.update('jax_platforms', p) if p else None;\n"
+            "d = jax.devices(); print(d[0].platform, len(d))")
+    for attempt in range(1, PROBE_ATTEMPTS + 1):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True,
+                text=True, timeout=PROBE_TIMEOUT_S)
+            if out.returncode == 0 and out.stdout.strip():
+                platform, n = out.stdout.split()
+                log(f"backend probe ok: {platform} x{n} "
+                    f"(attempt {attempt})")
+                return platform, int(n)
+            log(f"backend probe attempt {attempt} rc={out.returncode}: "
+                f"{out.stderr.strip()[-300:]}")
+        except subprocess.TimeoutExpired:
+            log(f"backend probe attempt {attempt} timed out "
+                f"({PROBE_TIMEOUT_S}s)")
+        if attempt < PROBE_ATTEMPTS:
+            time.sleep(PROBE_BACKOFF_S)
+    # TPU unreachable: run the engine on CPU so the round still has an
+    # engine-path record, clearly labeled
+    log("TPU backend unavailable after retries — falling back to CPU")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    return "cpu", 0
+
+
+def build_index(n_shards: int, topn_rows: int, seed: int = 7):
+    """A real index populated through the bulk import path."""
+    import numpy as np
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.models.view import VIEW_STANDARD
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    rng = np.random.default_rng(seed)
+    h = Holder()  # full 2^20-column shards
+    idx = h.create_index("bench", track_existence=False)
+    words = SHARD_WIDTH // 32
+    cells = 0
+    t0 = time.perf_counter()
+    for fname, rows in (("a", [1]), ("b", [1]),
+                        ("t", list(range(topn_rows)))):
+        f = idx.create_field(fname)
+        view = f.view(VIEW_STANDARD, create=True)
+        for shard in range(n_shards):
+            frag = view.fragment(shard, create=True)
+            for r in rows:
+                w = rng.integers(0, 1 << 32, size=words, dtype=np.uint32)
+                frag.import_row_words(r, w)
+                cells += int(np.bitwise_count(w).sum())
+    log(f"index built: {n_shards} shards x {SHARD_WIDTH} cols, "
+        f"{cells / 1e9:.2f}e9 cells, {time.perf_counter() - t0:.1f}s host")
+    return h, cells
+
+
+def run_queries(h, reps: int, label: str) -> dict[str, list[float]]:
+    """Time the two north-star queries through Executor.execute."""
+    from pilosa_tpu.executor.executor import Executor
+
+    ex = Executor(h)
+    queries = {
+        "count_intersect": "Count(Intersect(Row(a=1), Row(b=1)))",
+        "topn": "TopN(t, n=10)",
+    }
+    # warmup: compiles the stacked programs + uploads the tile stacks
+    for name, q in queries.items():
+        t0 = time.perf_counter()
+        res = ex.execute("bench", q)
+        log(f"[{label}] warm {name}: {time.perf_counter() - t0:.2f}s "
+            f"(compile+upload) result={_preview(res)}")
+    times: dict[str, list[float]] = {k: [] for k in queries}
+    for _ in range(reps):
+        for name, q in queries.items():
+            t0 = time.perf_counter()
+            ex.execute("bench", q)
+            times[name].append(time.perf_counter() - t0)
+    for name, ts in times.items():
+        log(f"[{label}] {name}: p50={statistics.median(ts)*1e3:.2f}ms "
+            f"min={min(ts)*1e3:.2f}ms max={max(ts)*1e3:.2f}ms")
+    return times
+
+
+def _preview(res):
+    r = res[0]
+    if isinstance(r, list):
+        return [(p.id, p.count) for p in r[:3]]
+    return r
 
 
 def main() -> None:
+    platform, _ = probe_backend()
     import jax
-    import jax.numpy as jnp
-
-    from pilosa_tpu.ops import bitmap as bm
-
+    if platform == "cpu":
+        # override the site customization's forced TPU selection
+        jax.config.update("jax_platforms", "cpu")
     devs = jax.devices()
-    on_tpu = devs[0].platform == "tpu"
-    n_chips = len(devs)
+    platform = devs[0].platform
+    n_chips = len(devs) if platform != "cpu" else 1
+    on_tpu = platform not in ("cpu",)
 
-    if on_tpu:
-        # 954 shards x 2^20 columns/shard ~= 1.0e9 columns.
-        n_shards = 954
-    else:  # CPU smoke mode for dev boxes; numbers are not meaningful
-        n_shards = 8
+    n_shards = int(os.environ.get(
+        "PILOSA_BENCH_SHARDS", "954" if on_tpu else "8"))
+    topn_rows = int(os.environ.get("PILOSA_BENCH_TOPN_ROWS", "8"))
+    reps = 20 if on_tpu else 5
 
-    words = 1 << 15  # 2^20 cols / 32 bits
+    h, cells = build_index(n_shards, topn_rows)
+    full = run_queries(h, reps, f"{n_shards}sh")
 
-    # Generate the index on-device: host->device over a tunneled chip
-    # would dominate setup time for ~4 GB of tiles.
-    @jax.jit
-    def gen(key):
-        ka, kb, kr = jax.random.split(key, 3)
-        a = jax.random.bits(ka, (n_shards, words), dtype=jnp.uint32)
-        b = jax.random.bits(kb, (n_shards, words), dtype=jnp.uint32)
-        rows = jax.random.bits(
-            kr, (TOPK_CANDIDATE_ROWS, n_shards, words), dtype=jnp.uint32)
-        return a, b, rows
+    # dispatch-floor calibration: same engine path, 1 shard, so the
+    # wall-time difference is pure device scan time at scale
+    h_tiny, _ = build_index(1, topn_rows)
+    tiny = run_queries(h_tiny, reps, "1sh")
 
-    a, b, rows = jax.block_until_ready(gen(jax.random.key(7)))
+    p50 = {k: statistics.median(v) for k, v in full.items()}
+    p50_tiny = {k: statistics.median(v) for k, v in tiny.items()}
+    net_ms = {k: max((p50[k] - p50_tiny[k]) * 1e3, 1e-3) for k in p50}
+    workload_ms = sum(net_ms.values())
+    equiv16_ms = workload_ms * (n_chips / NORTH_STAR_CHIPS)
+    wall_ms = sum(p50.values()) * 1e3
 
-    def query(a, b, rows):
-        # totals here stay < 2^31 (~1e9 cells, half set), so int32 is
-        # exact; the executor proper widens to int64/Python on the host
-        count_intersect = jnp.sum(bm.count(jnp.bitwise_and(a, b)))
-        row_counts = jnp.sum(bm.count(rows), axis=1)
-        top_vals, top_ids = jax.lax.top_k(row_counts, K)
-        return count_intersect, top_vals, top_ids
+    log(f"platform={platform} chips={n_chips} shards={n_shards} "
+        f"cells={cells/1e9:.2f}e9")
+    log(f"net device p50: count_intersect={net_ms['count_intersect']:.3f}ms "
+        f"topn={net_ms['topn']:.3f}ms workload={workload_ms:.3f}ms "
+        f"(wall p50 incl tunnel dispatch: {wall_ms:.1f}ms)")
+    log(f"v5e-16 equivalent (shard-parallel, {n_chips} chip measured): "
+        f"{equiv16_ms:.3f}ms vs north star {NORTH_STAR_MS}ms")
 
-    @functools.partial(jax.jit, static_argnames="iters")
-    def query_loop(a, b, rows, iters):
-        def body(i, acc):
-            # perturb inputs by the loop counter so the scan is not
-            # loop-invariant (costs one fused elementwise pass, making
-            # the measurement slightly pessimistic, never optimistic)
-            s = i.astype(jnp.uint32)
-            ci, tv, ti = query(a ^ s, b ^ s, rows ^ s)
-            return acc + ci + tv[0] + ti[0]
-        return jax.lax.fori_loop(0, iters, body, jnp.int32(0))
-
-    def timed(iters, reps):
-        # .item() (host scalar fetch) is the only true sync point on
-        # the tunneled platform: block_until_ready returns early there
-        out = []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            query_loop(a, b, rows, iters).item()
-            out.append(time.perf_counter() - t0)
-        return out
-
-    lo_iters, hi_iters = (16, 64) if on_tpu else (1, 4)
-    timed(lo_iters, 1)  # compile
-    timed(hi_iters, 1)  # compile
-    reps = 5 if on_tpu else 3
-    t_lo = statistics.median(timed(lo_iters, reps))
-    t_hi = statistics.median(timed(hi_iters, reps))
-    per_query_ms = max(t_hi - t_lo, 1e-9) / (hi_iters - lo_iters) * 1e3
-
-    # v5e-16 equivalent: shards split evenly over 16 chips; the reduce
-    # is one scalar psum + a (R,) all-reduce, negligible vs the scan.
-    equiv_ms = per_query_ms * (n_chips / NORTH_STAR_CHIPS)
-    bytes_scanned = (2 + TOPK_CANDIDATE_ROWS) * n_shards * words * 4
-    gbps_chip = bytes_scanned / (per_query_ms / 1e3) / n_chips / 1e9
-
-    sanity = query(a, b, rows)
+    suffix = "" if on_tpu else "_cpu_fallback"
     result = {
-        "metric": "north_star_count_intersect_topk_p50_v5e16_equiv",
-        "value": round(equiv_ms, 4),
+        "metric": ("engine_count_intersect_plus_topn_p50_v5e16_equiv"
+                   + suffix),
+        "value": round(equiv16_ms, 4),
         "unit": "ms",
-        "vs_baseline": round(NORTH_STAR_MS / equiv_ms, 3),
+        "vs_baseline": round(NORTH_STAR_MS / equiv16_ms, 3),
     }
-    # context lines on stderr so stdout stays a single JSON line
-    print(
-        f"platform={devs[0].platform} chips={n_chips} shards={n_shards} "
-        f"per_query_measured={per_query_ms:.3f}ms "
-        f"equiv_16chip={equiv_ms:.4f}ms scan_bw={gbps_chip:.0f}GB/s/chip "
-        f"count_intersect={int(sanity[0])}",
-        file=sys.stderr,
-    )
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # clear failure JSON — never a bare crash
+        print(json.dumps({
+            "metric": "engine_count_intersect_plus_topn_p50_v5e16_equiv",
+            "value": None, "unit": "ms", "vs_baseline": None,
+            "error": f"{type(e).__name__}: {e}"[:400],
+        }))
+        raise

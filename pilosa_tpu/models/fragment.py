@@ -129,6 +129,18 @@ class Fragment:
                 words |= mask
             self.touch(int(r))
 
+    def import_row_words(self, row: int, words) -> None:
+        """Bulk dense-row import: OR pre-packed words into a row.
+
+        The dense-tile analog of fragment.importRoaring
+        (fragment.go:2038), which ingests pre-encoded roaring
+        containers wholesale instead of per-bit ops — the restore /
+        bulk-load fast path.
+        """
+        w = self._row_mut(row)
+        np.bitwise_or(w, np.asarray(words, dtype=np.uint32), out=w)
+        self.touch(row)
+
     def contains(self, row: int, col: int) -> bool:
         words = self._rows.get(row)
         if words is None:
