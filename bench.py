@@ -96,6 +96,8 @@ def build_index(n_shards: int, topn_rows: int, seed: int = 7):
     from pilosa_tpu.models.view import VIEW_STANDARD
     from pilosa_tpu.shardwidth import SHARD_WIDTH
 
+    from pilosa_tpu.models.schema import CACHE_TYPE_NONE, FieldOptions
+
     rng = np.random.default_rng(seed)
     h = Holder()  # full 2^20-column shards
     idx = h.create_index("bench", track_existence=False)
@@ -104,7 +106,12 @@ def build_index(n_shards: int, topn_rows: int, seed: int = 7):
     t0 = time.perf_counter()
     for fname, rows in (("a", [1]), ("b", [1]),
                         ("t", list(range(topn_rows)))):
-        f = idx.create_field(fname)
+        # cache_type none on the TopN field forces the stacked device
+        # scan — an unfiltered TopN on a ranked-cache field would be
+        # served by the host rank-cache merge instead, measuring the
+        # wrong path (advisor r02)
+        f = idx.create_field(
+            fname, FieldOptions(cache_type=CACHE_TYPE_NONE))
         view = f.view(VIEW_STANDARD, create=True)
         for shard in range(n_shards):
             frag = view.fragment(shard, create=True)
@@ -197,6 +204,16 @@ def main() -> None:
         "value": round(equiv16_ms, 4),
         "unit": "ms",
         "vs_baseline": round(NORTH_STAR_MS / equiv16_ms, 3),
+        # raw, unextrapolated record (VERDICT r02 item 1c): platform,
+        # scale, and wall p50s incl. tunnel dispatch for both runs
+        "platform": platform,
+        "chips": n_chips,
+        "shards": n_shards,
+        "cells": cells,
+        "raw_wall_p50_ms": {k: round(v * 1e3, 3) for k, v in p50.items()},
+        "raw_wall_p50_1shard_ms": {k: round(v * 1e3, 3)
+                                   for k, v in p50_tiny.items()},
+        "net_device_p50_ms": {k: round(v, 3) for k, v in net_ms.items()},
     }
     print(json.dumps(result))
 

@@ -149,8 +149,12 @@ class AdvancedOps:
                  if c > 0 or ids is not None]
         return self._finish_topn(f, pairs, n, ids)
 
-    # device-batch byte budget for the stacked (R, S, W) row scans
-    _ROWS_STACK_BUDGET = 1 << 28  # 256 MiB
+    # device-batch byte budget for the stacked (R, S, W) row scans.
+    # Sized so the design-scale TopN candidate set (16 rows x 954
+    # shards x 128 KiB = 2 GiB) runs as ONE device dispatch: through a
+    # multi-ms-RTT tunnel every extra chunk costs a full round trip
+    # (measured r03: 4 chunks -> 401 ms net vs ~1.3 ms of device scan)
+    _ROWS_STACK_BUDGET = 1 << 31  # 2 GiB
 
     def _topnk_stacked(self, idx, f, row_ids, views, filter_call,
                        shards, pre, ids):
@@ -220,7 +224,7 @@ class AdvancedOps:
 
         filter_call = call.arg("filter")
         agg_call = call.arg("aggregate")
-        agg_field = None
+        agg_field = distinct_field = distinct_inner = None
         if agg_call is not None:
             if not isinstance(agg_call, Call) or agg_call.name not in (
                     "Sum", "Count"):
@@ -229,11 +233,113 @@ class AdvancedOps:
             if agg_call.name == "Sum":
                 agg_field = self._bsi_field(idx, agg_call.arg("_field"))
             else:
-                raise self._err(
-                    "GroupBy aggregate Count(Distinct) not yet supported")
+                # Count(Distinct(field=D)) (executor.go:3918 aggregate
+                # dispatch): per group, the number of distinct values
+                # (BSI) or distinct row ids (set-like) of D
+                dc = agg_call.children[0] if agg_call.children else None
+                if (not isinstance(dc, Call)
+                        or dc.name != "Distinct"
+                        or dc.arg("_field") is None):
+                    raise self._err(
+                        "GroupBy Count aggregate requires "
+                        "Count(Distinct(field=...))")
+                distinct_field = idx.field(dc.arg("_field"))
+                if distinct_field is None:
+                    raise self._err(
+                        f"field not found: {dc.arg('_field')}")
+                distinct_inner = (dc.children[0] if dc.children
+                                  else None)
 
         combos = list(itertools.product(*[range(len(rl))
                                           for rl in row_lists]))
+        shard_list = self._shard_list(idx, shards)
+        counts = agg_nn = agg_pos = agg_neg = None
+        if getattr(self, "use_stacked", False) and distinct_field is None:
+            try:
+                counts, agg = self.stacked.groupby(
+                    idx, list(zip(fields, row_lists)), filter_call,
+                    agg_field, shard_list, pre)
+                if agg is not None:
+                    agg_nn, agg_pos, agg_neg = agg
+            except Unstackable:
+                counts = None
+        if counts is None:
+            counts, agg_nn, agg_pos, agg_neg = self._groupby_loop(
+                idx, fields, row_lists, combos, filter_call, agg_field,
+                shard_list, pre)
+
+        # previous= paging (executor.go:8617 groupByIterator seek):
+        # resume strictly after the given group, in product order.
+        # Resolved BEFORE the (host-heavy) Count(Distinct) pass so a
+        # paged query never recomputes groups before the seek point.
+        previous = call.arg("previous")
+        start_ci = 0
+        if previous is not None:
+            if len(previous) != len(fields):
+                raise self._err(
+                    "previous= must have one entry per Rows() child")
+            prev_ids = []
+            for f, p in zip(fields, previous):
+                if isinstance(p, str):
+                    tr = f.row_translator
+                    if tr is None:
+                        raise self._err(
+                            "string previous= entry on unkeyed field")
+                    found = tr.find_keys(p)
+                    if p not in found:
+                        raise self._err(f"previous= key not found: {p!r}")
+                    p = found[p]
+                prev_ids.append(int(p))
+            prev_combo = tuple(prev_ids)
+            for ci, combo in enumerate(combos):
+                ids = tuple(rl[gi] for rl, gi in zip(row_lists, combo))
+                if ids > prev_combo:
+                    start_ci = ci
+                    break
+            else:
+                return []
+
+        distinct_counts = None
+        if distinct_field is not None:
+            distinct_counts = self._groupby_count_distinct(
+                idx, fields, row_lists, combos, counts, filter_call,
+                distinct_inner, distinct_field, shard_list, pre,
+                start_ci)
+
+        having = call.arg("having")
+        limit = call.arg("limit")
+        out = []
+        for ci in range(start_ci, len(combos)):
+            combo = combos[ci]
+            cnt = int(counts[ci])
+            if cnt == 0:
+                continue
+            group = []
+            for f, rl, gi in zip(fields, row_lists, combo):
+                entry = {"field": f.name, "row_id": rl[gi]}
+                if f.options.keys:
+                    entry["row_key"] = f.row_translator.translate_id(rl[gi])
+                group.append(entry)
+            agg = agg_count = None
+            if agg_field is not None:
+                total = sum((int(p) - int(g)) << b for b, (p, g) in
+                            enumerate(zip(agg_pos[ci], agg_neg[ci])))
+                agg = agg_field.int_to_value(total)
+                agg_count = int(agg_nn[ci])
+            elif distinct_counts is not None:
+                agg = agg_count = int(distinct_counts[ci])
+            gc = GroupCount(group=group, count=cnt, agg=agg,
+                            agg_count=agg_count)
+            if having is not None and not self._having_ok(gc, having):
+                continue
+            out.append(gc)
+            if limit is not None and len(out) >= int(limit):
+                break
+        return out
+
+    def _groupby_loop(self, idx, fields, row_lists, combos, filter_call,
+                      agg_field, shard_list, pre):
+        """Per-shard fallback for trees the stacked IR can't express."""
         counts = np.zeros(len(combos), dtype=np.int64)
         agg_pos = agg_neg = agg_nn = None
         if agg_field is not None:
@@ -243,7 +349,7 @@ class AdvancedOps:
             agg_nn = np.zeros(len(combos), dtype=np.int64)
 
         combo_idx = np.array(combos, dtype=np.int64)  # (C, nf)
-        for shard in self._shard_list(idx, shards):
+        for shard in shard_list:
             filt = (self._bitmap_call_shard(idx, filter_call, shard, pre)
                     if filter_call is not None else None)
             tiles_per_field = [
@@ -278,33 +384,86 @@ class AdvancedOps:
                     neg_pc = bm.count(mag[None, :, :] & neg[:, None, :])
                     agg_pos[i:i + chunk] += np.asarray(pos_pc, dtype=np.int64)
                     agg_neg[i:i + chunk] += np.asarray(neg_pc, dtype=np.int64)
+        return counts, agg_nn, agg_pos, agg_neg
 
-        having = call.arg("having")
-        limit = call.arg("limit")
-        out = []
-        for ci, combo in enumerate(combos):
-            cnt = int(counts[ci])
-            if cnt == 0:
-                continue
-            group = []
-            for f, rl, gi in zip(fields, row_lists, combo):
-                entry = {"field": f.name, "row_id": rl[gi]}
-                if f.options.keys:
-                    entry["row_key"] = f.row_translator.translate_id(rl[gi])
-                group.append(entry)
-            agg = agg_count = None
-            if agg_field is not None:
-                total = sum((int(p) - int(g)) << b for b, (p, g) in
-                            enumerate(zip(agg_pos[ci], agg_neg[ci])))
-                agg = agg_field.int_to_value(total)
-                agg_count = int(agg_nn[ci])
-            gc = GroupCount(group=group, count=cnt, agg=agg,
-                            agg_count=agg_count)
-            if having is not None and not self._having_ok(gc, having):
-                continue
-            out.append(gc)
-            if limit is not None and len(out) >= int(limit):
-                break
+    def _groupby_count_distinct(self, idx, fields, row_lists, combos,
+                                counts, filter_call, inner_filter,
+                                dfield, shard_list, pre, start_ci=0):
+        """Count(Distinct(field=D)) per group: distinct BSI values /
+        distinct set rows of D among the group's columns, restricted
+        by the GroupBy filter AND the Distinct call's own filter child.
+        Host numpy over fragment rows + the engine's device-decoded
+        value stream (O(shard-chunk) device calls, consumed chunk-by-
+        chunk so host memory stays bounded); sets unioned across
+        shards.  Only combos >= start_ci (the previous= seek point)
+        are computed."""
+        from pilosa_tpu.ops import bsi as bsi_ops
+
+        nonzero = [ci for ci in range(start_ci, len(combos))
+                   if counts[ci] > 0]
+        sets: dict[int, set] = {ci: set() for ci in nonzero}
+        is_bsi = dfield.options.type.is_bsi
+        if is_bsi and dfield.bit_depth > 62:
+            raise self._err("Count(Distinct) unsupported for depth > 62")
+
+        def shard_groups():
+            """Yield (shard, ex_row, vals_row) aligned with the decode
+            stream's chunking for BSI D; (shard, None, None) otherwise."""
+            if not is_bsi:
+                for s in shard_list:
+                    yield s, None, None
+                return
+            for chunk_ids, ex, vals in self.stacked.decode_stream(
+                    idx, dfield, tuple(shard_list)):
+                for i, s in enumerate(chunk_ids):
+                    yield s, ex[i], vals[i]
+
+        for shard, ex, vals in shard_groups():
+            filt = None
+            if filter_call is not None:
+                filt = np.asarray(self._bitmap_call_shard(
+                    idx, filter_call, shard, pre))
+            if inner_filter is not None:
+                inner = np.asarray(self._bitmap_call_shard(
+                    idx, inner_filter, shard, pre))
+                filt = inner if filt is None else filt & inner
+            tiles = []
+            for f, rl in zip(fields, row_lists):
+                v = f.views.get(VIEW_STANDARD)
+                frag = v.fragment(shard) if v else None
+                tiles.append([
+                    frag.row_words(r) if frag is not None
+                    else bm.empty(idx.width) for r in rl])
+            if not is_bsi:
+                v = dfield.views.get(VIEW_STANDARD)
+                dfrag = v.fragment(shard) if v else None
+                if dfrag is None:
+                    continue
+                drows = dfrag.row_ids
+                dwords = np.stack([dfrag.row_words(r) for r in drows]) \
+                    if drows else None
+            for ci in nonzero:
+                combo = combos[ci]
+                mask = tiles[0][combo[0]].copy()
+                for fi in range(1, len(fields)):
+                    mask &= tiles[fi][combo[fi]]
+                if filt is not None:
+                    mask &= filt
+                if not mask.any():
+                    continue
+                if is_bsi:
+                    bits = bsi_ops.unpack_bits_np(mask) & ex
+                    if bits.any():
+                        sets[ci].update(np.unique(vals[bits]).tolist())
+                else:
+                    if dwords is None:
+                        continue
+                    hit = (dwords & mask[None]).any(axis=1)
+                    sets[ci].update(
+                        r for r, h in zip(drows, hit) if h)
+        out = np.zeros(len(combos), dtype=np.int64)
+        for ci, s in sets.items():
+            out[ci] = len(s)
         return out
 
     def _having_ok(self, gc: GroupCount, having) -> bool:
@@ -408,6 +567,12 @@ class AdvancedOps:
             raise self._err("Sort requires a BSI field")
         desc = bool(call.arg("sort-desc", False))
         filter_call = call.children[0] if call.children else None
+        if getattr(self, "use_stacked", False) and f.bit_depth <= 62:
+            try:
+                return self._sort_stacked(idx, f, desc, filter_call,
+                                          call, shards, pre)
+            except Unstackable:
+                pass
         all_cols, all_vals = [], []
         for shard in self._shard_list(idx, shards):
             v = f.views.get(f.bsi_view)
@@ -419,9 +584,8 @@ class AdvancedOps:
             if filter_call is not None:
                 filt = np.asarray(self._bitmap_call_shard(
                     idx, filter_call, shard, pre))
-                fcols = set(bm.to_columns(filt).tolist())
-                keep = [i for i, c in enumerate(cols.tolist())
-                        if c in fcols]
+                fbits = bsi_ops.unpack_bits_np(filt)
+                keep = np.nonzero(fbits[cols])[0]
                 cols = cols[keep]
                 vals = [vals[i] for i in keep]
             base = shard * idx.width
@@ -437,6 +601,48 @@ class AdvancedOps:
         return SortedRow(
             columns=[all_cols[i] for i in order],
             values=[f.int_to_value(all_vals[i]) for i in order])
+
+    def _sort_stacked(self, idx, f, desc, filter_call, call, shards, pre):
+        """Sort on the stacked engine (executor.go:9321 re-designed):
+        the filter tree runs as ONE stacked program, BSI values
+        materialize via the chunked device decode (O(shard-chunks)
+        device calls), and ordering is one vectorized lexsort — no
+        per-column Python anywhere."""
+        skey = tuple(self._shard_list(idx, shards))
+        filt_words = None
+        if filter_call is not None:
+            filt_words = self.stacked.words(idx, filter_call,
+                                            list(skey), pre)
+            if filt_words is None:      # statically-empty filter
+                return SortedRow(columns=[], values=[])
+        all_cols, all_vals = [], []
+        pos = 0
+        for chunk_ids, ex, vals in self.stacked.decode_stream(
+                idx, f, skey):
+            sel = ex
+            if filt_words is not None:
+                sel = sel & bsi_ops.unpack_bits_np(
+                    filt_words[pos:pos + len(chunk_ids)])
+            pos += len(chunk_ids)
+            si, ci = np.nonzero(sel)
+            if si.size:
+                bases = np.asarray(chunk_ids, dtype=np.int64)[si] \
+                    * idx.width
+                all_cols.append(bases + ci)
+                all_vals.append(vals[si, ci])
+        if not all_cols:
+            return SortedRow(columns=[], values=[])
+        cols = np.concatenate(all_cols)
+        vals_ = np.concatenate(all_vals)
+        key = -vals_ if desc else vals_
+        order = np.lexsort((cols, key))
+        offset = int(call.arg("offset", 0))
+        limit = call.arg("limit")
+        end = None if limit is None else offset + int(limit)
+        order = order[offset:end]
+        return SortedRow(
+            columns=cols[order].tolist(),
+            values=[f.int_to_value(int(x)) for x in vals_[order]])
 
     # -- Extract --------------------------------------------------------
 
@@ -480,16 +686,35 @@ class AdvancedOps:
             t = f.options.type
             if t.is_bsi:
                 vals = {}
-                v = f.views.get(f.bsi_view)
-                for shard in sorted(by_shard):
-                    frag = v.fragment(shard) if v else None
-                    if frag is None:
-                        continue
-                    cols, values = bsi_ops.decode(
-                        np.asarray(frag.device_planes(f.bit_depth)))
-                    base = shard * idx.width
-                    vals.update((int(c) + base, f.int_to_value(val))
-                                for c, val in zip(cols, values))
+                if getattr(self, "use_stacked", False) \
+                        and f.bit_depth <= 62:
+                    # chunked device decode + vectorized gather of just
+                    # the wanted columns (executor.go:4758 re-designed)
+                    skey = tuple(sorted(by_shard))
+                    for chunk_ids, ex, dec in self.stacked.decode_stream(
+                            idx, f, skey):
+                        for i, s in enumerate(chunk_ids):
+                            cs = by_shard.get(s)
+                            if not cs:
+                                continue
+                            local = np.asarray(cs, dtype=np.int64) \
+                                % idx.width
+                            present = ex[i][local]
+                            got = dec[i][local]
+                            vals.update(
+                                (c, f.int_to_value(int(x)) if p else None)
+                                for c, p, x in zip(cs, present, got))
+                else:
+                    v = f.views.get(f.bsi_view)
+                    for shard in sorted(by_shard):
+                        frag = v.fragment(shard) if v else None
+                        if frag is None:
+                            continue
+                        cols_, values = bsi_ops.decode(
+                            np.asarray(frag.device_planes(f.bit_depth)))
+                        base = shard * idx.width
+                        vals.update((int(c) + base, f.int_to_value(val))
+                                    for c, val in zip(cols_, values))
                 for c in columns:
                     col_values[c].append(vals.get(c))
             else:

@@ -206,9 +206,21 @@ class Executor(AdvancedOps):
         def walk(c: Call, is_root: bool):
             for ch in c.children:
                 walk(ch, False)
-            for v in c.args.values():
-                if isinstance(v, Call):
-                    walk(v, False)
+            for k, v in c.args.items():
+                if not isinstance(v, Call):
+                    continue
+                # a GroupBy aggregate's Count(Distinct(...)) is not a
+                # bitmap operand — the aggregate handler consumes that
+                # Distinct node itself (executor.go:3918).  Its filter
+                # children ARE bitmap operands and still need their
+                # own nested precompute.
+                if (c.name == "GroupBy" and k == "aggregate"
+                        and v.name == "Count" and v.children
+                        and v.children[0].name == "Distinct"):
+                    for ch in v.children[0].children:
+                        walk(ch, False)
+                    continue
+                walk(v, False)
             if not is_root and c.name == "Distinct":
                 res = self._execute_distinct(idx, c, shards, pre, raw=True)
                 if isinstance(res, DistinctValues):
@@ -567,6 +579,22 @@ class Executor(AdvancedOps):
         if f is None:
             raise ExecError(f"{call.name} requires a field")
         filter_call = call.children[0] if call.children else None
+        if self.use_stacked:
+            # one batched (R, S, W) scan for all candidate rows
+            # (fragment.minRow/maxRow were the last per-row dispatch)
+            try:
+                row_ids = self._all_row_ids(idx, f, shards)
+                if not row_ids:
+                    return Pair(id=0, count=0)
+                pairs = self._topnk_stacked(
+                    idx, f, row_ids, [VIEW_STANDARD], filter_call,
+                    shards, pre, ids=None)
+                if not pairs:
+                    return Pair(id=0, count=0)
+                best = (min if is_min else max)(pairs, key=lambda p: p.id)
+                return Pair(id=best.id, count=best.count)
+            except Unstackable:
+                pass
         candidates: dict[int, int] = {}
         for shard in self._shard_list(idx, shards):
             v = f.views.get(VIEW_STANDARD)
@@ -601,6 +629,12 @@ class Executor(AdvancedOps):
         if f is None:
             raise ExecError(f"field not found: {fname}")
         if f.options.type.is_bsi:
+            if self.use_stacked and f.bit_depth <= 62:
+                try:
+                    return self._distinct_bsi_stacked(
+                        idx, f, call, shards, pre)
+                except Unstackable:
+                    pass
             vals: set[int] = set()
             for shard in self._shard_list(idx, shards):
                 v = f.views.get(f.bsi_view)
@@ -611,28 +645,44 @@ class Executor(AdvancedOps):
                 cols, values = bsi_ops.decode(np.asarray(
                     frag.device_planes(f.bit_depth)))
                 if filt is not None:
-                    fcols = set(bm.to_columns(np.asarray(filt)).tolist())
+                    fbits = bsi_ops.unpack_bits_np(np.asarray(filt))
                     values = [val for c, val in zip(cols, values)
-                              if int(c) in fcols]
+                              if fbits[int(c)]]
                 vals.update(values)
             return DistinctValues(values=sorted(
                 f.int_to_value(v) for v in vals))
         # set-like: distinct row ids with any bit (within filter)
         rows_present: set[int] = set()
-        for shard in self._shard_list(idx, shards):
-            v = f.views.get(VIEW_STANDARD)
-            frag = v.fragment(shard) if v else None
-            if frag is None:
-                continue
-            filt = self._filter_words(idx, call, shard, pre)
-            for row_id in frag.row_ids:
-                if row_id in rows_present:
+        filter_call = call.children[0] if call.children else None
+        stacked_done = False
+        if self.use_stacked and filter_call is not None:
+            # one fused (R, S, W) scan instead of a per-(row, shard)
+            # device call each — the TopN candidate machinery reused
+            try:
+                row_ids = self._all_row_ids(idx, f, shards)
+                if row_ids:
+                    pairs = self._topnk_stacked(
+                        idx, f, row_ids, [VIEW_STANDARD], filter_call,
+                        shards, pre, ids=None)
+                    rows_present = {p.id for p in pairs}
+                stacked_done = True
+            except Unstackable:
+                pass
+        if not stacked_done:
+            for shard in self._shard_list(idx, shards):
+                v = f.views.get(VIEW_STANDARD)
+                frag = v.fragment(shard) if v else None
+                if frag is None:
                     continue
-                if filt is None:
-                    rows_present.add(row_id)
-                elif int(bm.intersection_count(
-                        frag.device_row(row_id), filt)) > 0:
-                    rows_present.add(row_id)
+                filt = self._filter_words(idx, call, shard, pre)
+                for row_id in frag.row_ids:
+                    if row_id in rows_present:
+                        continue
+                    if filt is None:
+                        rows_present.add(row_id)
+                    elif int(bm.intersection_count(
+                            frag.device_row(row_id), filt)) > 0:
+                        rows_present.add(row_id)
         res = RowResult.from_columns(rows_present, idx.width)
         res.is_row_ids = True  # row ids, not columns: skip col-key xlate
         if f.options.keys and not raw:
@@ -640,6 +690,33 @@ class Executor(AdvancedOps):
                 k for k in f.row_translator.translate_ids(
                     sorted(rows_present)) if k is not None))
         return res
+
+    def _distinct_bsi_stacked(self, idx: Index, f: Field, call: Call,
+                              shards, pre) -> DistinctValues:
+        """Distinct over a BSI field on the stacked engine
+        (executor.go:2034 re-designed): filter tree as one stacked
+        program, values via the chunked device decode, uniquing in
+        vectorized numpy."""
+        skey = tuple(self._shard_list(idx, shards))
+        filt_words = None
+        if call.children:
+            filt_words = self.stacked.words(idx, call.children[0],
+                                            list(skey), pre)
+            if filt_words is None:      # statically-empty filter
+                return DistinctValues(values=[])
+        vals: set[int] = set()
+        pos = 0
+        for chunk_ids, ex, dec in self.stacked.decode_stream(
+                idx, f, skey):
+            sel = ex
+            if filt_words is not None:
+                sel = sel & bsi_ops.unpack_bits_np(
+                    filt_words[pos:pos + len(chunk_ids)])
+            pos += len(chunk_ids)
+            if sel.any():
+                vals.update(np.unique(dec[sel]).tolist())
+        return DistinctValues(values=sorted(
+            f.int_to_value(v) for v in vals))
 
     def _rows_ids(self, idx: Index, call: Call, shards) -> list[int]:
         """Rows(field) core returning raw row IDS (executor.

@@ -6,10 +6,12 @@ fan-out (executor.go:6449-6812).  The reference maps a per-shard
 ``reduceFn``; here the shard axis becomes the LEADING AXIS of every
 operand: a whole PQL bitmap call tree compiles to ONE jitted XLA
 program over ``(S, W)`` shard-stacked tiles, and the cross-shard
-reduce is an XLA reduction that lowers to a ``psum`` over ICI when the
-stacks are placed on a ``jax.sharding.Mesh`` (shard axis sharded over
-the mesh's "shards" axis, exactly the placement of
-``parallel.place_shards``).
+reduce happens IN the program (``jnp.sum`` over the shard axis, which
+GSPMD lowers to a ``psum`` over ICI when the stacks are placed on a
+``jax.sharding.Mesh`` with the shard axis sharded over the mesh's
+"shards" axis, exactly the placement of ``parallel.place_shards``).
+The in-program reduce is int32; above ``_REDUCE_MAX_SHARDS`` shards
+the engine fetches per-shard partials and sums in exact host ints.
 
 Pieces:
 
@@ -49,8 +51,14 @@ import jax.numpy as jnp
 from pilosa_tpu.models.view import VIEW_STANDARD
 from pilosa_tpu.ops import bitmap as bm
 from pilosa_tpu.ops import bsi as bsi_ops
+from pilosa_tpu.ops import kernels
 from pilosa_tpu.pql import ast as past
 from pilosa_tpu.pql.ast import Call, Condition
+
+# In-program cross-shard reduction is exact in int32 only while
+# S * 2^20 < 2^31; beyond ~2000 shards the engine falls back to
+# per-shard partials summed on the host in Python ints.
+_REDUCE_MAX_SHARDS = 2000
 
 
 class Unstackable(Exception):
@@ -98,9 +106,16 @@ class TileStackCache:
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= old[2]
+            if nbytes > self.max_bytes:
+                # an entry that alone exceeds the budget is never
+                # cached (it would pin the cache over budget forever);
+                # the caller still gets the freshly built stack
+                return arr
             self._entries[key] = (versions, arr, nbytes)
             self._bytes += nbytes
-            while self._bytes > self.max_bytes and len(self._entries) > 1:
+            # the new entry is most-recent so it is popped last, and
+            # since nbytes <= max_bytes the loop stops before it
+            while self._bytes > self.max_bytes and self._entries:
                 _, (_, _, nb) = self._entries.popitem(last=False)
                 self._bytes -= nb
         return arr
@@ -119,7 +134,13 @@ class TileStackCache:
 # per-structure jit cache
 # ---------------------------------------------------------------------------
 
-_JIT_CACHE: dict[str, object] = {}
+# Bounded LRU of compiled executables keyed by plan structure.  Shared
+# across Executor instances (two engines over the same schema compile
+# identical programs); bounded so a long-lived server that sees many
+# distinct tree shapes doesn't accumulate executables forever.
+_JIT_CACHE: OrderedDict[str, object] = OrderedDict()
+_JIT_CACHE_MAX = 256
+_JIT_LOCK = threading.Lock()
 
 _NARY_OPS = {
     "union": bm.union,
@@ -186,13 +207,40 @@ def _as_stack(out, leaves):
     return out
 
 
-def _compiled(plan):
-    """plan: ("words"|"count", tree) | ("bsi_sum", planes_i, tree|None)
-    | ("row_counts", rows_i, tree|None).  One jitted fn per structure."""
-    sig = repr(plan)
-    fn = _JIT_CACHE.get(sig)
-    if fn is not None:
-        return fn
+def _count_partials(tree, kern: bool):
+    """(S,) per-shard popcounts of a tree.  With kernels enabled and
+    every operand device-RESIDENT (a leaf — exactly the no-producer-
+    to-fuse case kernels.py's dispatch rule names), route through the
+    fused Pallas passes; anything with an upstream XLA producer stays
+    with XLA so fusion isn't broken."""
+    if kern and tree[0] == "leaf":
+        i = tree[1]
+        return lambda leaves, params: kernels.popcount_rows(leaves[i])
+    if (kern and tree[0] == "nary" and tree[1] == "intersect"
+            and len(tree[2]) == 2
+            and all(c[0] == "leaf" for c in tree[2])):
+        i, j = tree[2][0][1], tree[2][1][1]
+        return lambda leaves, params: kernels.pair_popcount(
+            leaves[i], leaves[j])
+    return lambda leaves, params: bm.count(
+        _as_stack(_eval(tree, leaves, params), leaves))
+
+
+def _compiled(plan, kern: bool = False):
+    """plan: ("words", tree) | ("count", tree, reduce)
+    | ("bsi_sum", planes_i, tree|None, reduce)
+    | ("row_counts", rows_i, tree|None, reduce).
+    One jitted fn per structure; `kern` routes resident-leaf hot ops
+    through the Pallas kernels.  With reduce=True the cross-shard sum
+    happens IN the program — under a mesh it lowers to a psum over ICI
+    (the jitted analog of mapReduce's reduceFn); int32-exact up to
+    _REDUCE_MAX_SHARDS shards, the caller's responsibility."""
+    sig = (repr(plan), kern)
+    with _JIT_LOCK:
+        fn = _JIT_CACHE.get(sig)
+        if fn is not None:
+            _JIT_CACHE.move_to_end(sig)
+            return fn
     kind = plan[0]
     if kind == "words":
         tree = plan[1]
@@ -200,32 +248,100 @@ def _compiled(plan):
         def run(leaves, params):
             return _as_stack(_eval(tree, leaves, params), leaves)
     elif kind == "count":
-        tree = plan[1]
+        tree, reduce_ = plan[1], plan[2]
+        partials = _count_partials(tree, kern)
 
         def run(leaves, params):
-            return bm.count(_as_stack(_eval(tree, leaves, params), leaves))
+            c = partials(leaves, params)              # (S,)
+            return jnp.sum(c) if reduce_ else c
     elif kind == "bsi_sum":
-        planes_i, tree = plan[1], plan[2]
+        planes_i, tree, reduce_ = plan[1], plan[2], plan[3]
 
         def run(leaves, params):
-            planes = leaves[planes_i]
+            planes = leaves[planes_i]                 # (S, P, W)
             if tree is None:
-                return jax.vmap(lambda p: bsi_ops.sum_counts(p, None))(planes)
-            filt = _as_stack(_eval(tree, leaves, params), leaves)
-            return jax.vmap(bsi_ops.sum_counts)(planes, filt)
+                if kern:
+                    cnt, pos, neg = jax.vmap(
+                        lambda p: kernels.bsi_sum_counts(p, None))(planes)
+                else:
+                    cnt, pos, neg = jax.vmap(
+                        lambda p: bsi_ops.sum_counts(p, None))(planes)
+            else:
+                if kern and tree[0] == "leaf":
+                    filt = leaves[tree[1]]
+                    cnt, pos, neg = jax.vmap(
+                        kernels.bsi_sum_counts)(planes, filt)
+                else:
+                    filt = _as_stack(_eval(tree, leaves, params), leaves)
+                    cnt, pos, neg = jax.vmap(
+                        bsi_ops.sum_counts)(planes, filt)
+            if reduce_:
+                return (jnp.sum(cnt), jnp.sum(pos, axis=0),
+                        jnp.sum(neg, axis=0))         # scalar, (P,), (P,)
+            return cnt, pos, neg
+    elif kind == "groupby":
+        # plan: ("groupby", (stack_i, ...), planes_i|None, tree|None,
+        #        reduce) — executeGroupByShard (executor.go:3918) as one
+        # program: combo masks = gathered row-stack intersections, count
+        # + optional BSI Sum partials, cross-shard reduce in-program.
+        stack_is, planes_i, tree, reduce_ = (plan[1], plan[2], plan[3],
+                                             plan[4])
+
+        def run(leaves, params):
+            sel = params[-1]                          # (C, nf) int32
+            m = leaves[stack_is[0]][sel[:, 0]]        # (C, S, W)
+            for fi in range(1, len(stack_is)):
+                m = jnp.bitwise_and(m, leaves[stack_is[fi]][sel[:, fi]])
+            if tree is not None:
+                filt = _as_stack(_eval(tree, leaves, params), leaves)
+                m = jnp.bitwise_and(m, filt[None])
+            counts = bm.count(m)                      # (C, S)
+            if planes_i is None:
+                return jnp.sum(counts, axis=1) if reduce_ else counts
+            planes = leaves[planes_i]                 # (S, P, W)
+            exists, sign = planes[:, 0], planes[:, 1]
+            em = jnp.bitwise_and(m, exists[None])
+            nn = bm.count(em)                         # (C, S)
+            pos = jnp.bitwise_and(em, ~sign[None])
+            neg = jnp.bitwise_and(em, sign[None])
+            mag_p = jnp.moveaxis(planes[:, 2:], 1, 0)  # (P, S, W)
+
+            def body(carry, p_sw):
+                pc = bm.count(jnp.bitwise_and(pos, p_sw[None]))  # (C, S)
+                nc = bm.count(jnp.bitwise_and(neg, p_sw[None]))
+                if reduce_:
+                    pc, nc = jnp.sum(pc, axis=1), jnp.sum(nc, axis=1)
+                return carry, (pc, nc)
+
+            _, (pos_pc, neg_pc) = jax.lax.scan(body, 0, mag_p)
+            if reduce_:
+                counts, nn = jnp.sum(counts, axis=1), jnp.sum(nn, axis=1)
+            return counts, nn, pos_pc, neg_pc  # (C,),(C,),(P,C),(P,C)
     elif kind == "row_counts":
-        rows_i, tree = plan[1], plan[2]
+        rows_i, tree, reduce_ = plan[1], plan[2], plan[3]
 
         def run(leaves, params):
             rows = leaves[rows_i]                     # (R, S, W)
             if tree is None:
-                return bm.count(rows)                 # (R, S)
-            filt = _as_stack(_eval(tree, leaves, params), leaves)
-            return bm.count(jnp.bitwise_and(rows, filt[None]))
+                if kern:
+                    r, s, w = rows.shape
+                    c = kernels.popcount_rows(
+                        rows.reshape(r * s, w)).reshape(r, s)
+                else:
+                    c = bm.count(rows)                # (R, S)
+            elif kern and tree[0] == "leaf":
+                c = kernels.rows_filter_counts(rows, leaves[tree[1]])
+            else:
+                filt = _as_stack(_eval(tree, leaves, params), leaves)
+                c = bm.count(jnp.bitwise_and(rows, filt[None]))
+            return jnp.sum(c, axis=1) if reduce_ else c
     else:
         raise AssertionError(kind)
     fn = jax.jit(run)
-    _JIT_CACHE[sig] = fn
+    with _JIT_LOCK:
+        _JIT_CACHE[sig] = fn
+        while len(_JIT_CACHE) > _JIT_CACHE_MAX:
+            _JIT_CACHE.popitem(last=False)
     return fn
 
 
@@ -466,6 +582,17 @@ class PlanBuilder:
 # engine
 # ---------------------------------------------------------------------------
 
+from functools import partial
+
+
+@partial(jax.jit, static_argnums=2)
+def _decode_slice(planes, start, size):
+    """Module-level (stable identity => one JAX compile per shape) BSI
+    decode of a shard slice of a resident plane stack."""
+    sl = jax.lax.dynamic_slice_in_dim(planes, start, size, axis=0)
+    return bsi_ops.decode_device(sl)
+
+
 class StackedEngine:
     """Executes PQL call trees as stacked-shard device programs.
 
@@ -563,8 +690,14 @@ class StackedEngine:
     # -- execution entry points ----------------------------------------
 
     def _run(self, plan, builder):
-        fn = _compiled(plan)
+        fn = _compiled(plan, kern=kernels.enabled() and not self.host_only)
         return fn(tuple(builder.leaves), tuple(builder.params))
+
+    def _reduce_in_program(self, shards) -> bool:
+        """In-program (ICI-collective) cross-shard reduce is int32-
+        exact only below _REDUCE_MAX_SHARDS (counts < 2^20 per shard);
+        larger fleets fetch per-shard partials and sum in host ints."""
+        return len(shards) <= _REDUCE_MAX_SHARDS
 
     def count(self, idx, call: Call, shards: list[int], pre) -> int:
         """Exact Count via one device program + one host fetch."""
@@ -574,8 +707,10 @@ class StackedEngine:
         tree = b.build(call)
         if tree == ("zeros",):
             return 0
-        counts = np.asarray(self._run(("count", tree), b), dtype=np.int64)
-        return int(counts.sum())
+        red = self._reduce_in_program(shards)
+        counts = np.asarray(self._run(("count", tree, red), b),
+                            dtype=np.int64)
+        return int(counts) if red else int(counts.sum())
 
     def words(self, idx, call: Call, shards: list[int], pre):
         """(S, W) numpy result of a bitmap tree (one fetch), or None
@@ -590,8 +725,9 @@ class StackedEngine:
         return out[: len(shards)]  # drop mesh padding shards
 
     def bsi_sum(self, idx, field, filter_call, shards: list[int], pre):
-        """Per-shard Sum partials for `field` under an optional filter
-        tree; host-combined into exact ints by the caller."""
+        """Sum over `field` under an optional filter tree.  Per-plane
+        popcounts reduce across shards in-program; the plane-weighted
+        total is combined on the host in exact Python ints."""
         b = PlanBuilder(self, idx, shards, pre)
         planes_i = b._planes_leaf(field)
         tree = None
@@ -599,9 +735,12 @@ class StackedEngine:
             tree = b.build(filter_call)
             if tree == ("zeros",):
                 return 0, 0
-        cnt, pos, neg = self._run(("bsi_sum", planes_i, tree), b)
-        pos = np.asarray(pos, dtype=np.int64).sum(axis=0)
-        neg = np.asarray(neg, dtype=np.int64).sum(axis=0)
+        red = self._reduce_in_program(shards)
+        cnt, pos, neg = self._run(("bsi_sum", planes_i, tree, red), b)
+        pos = np.asarray(pos, dtype=np.int64)
+        neg = np.asarray(neg, dtype=np.int64)
+        if not red:
+            pos, neg = pos.sum(axis=0), neg.sum(axis=0)
         total = sum((int(p) - int(n)) << i
                     for i, (p, n) in enumerate(zip(pos, neg)))
         return int(total), int(np.asarray(cnt, dtype=np.int64).sum())
@@ -616,9 +755,127 @@ class StackedEngine:
         tree = b.build(filter_call) if filter_call is not None else None
         if tree == ("zeros",):
             return np.zeros(rows_stack.shape[0], dtype=np.int64)
-        partials = np.asarray(
-            self._run(("row_counts", rows_i, tree), b), dtype=np.int64)
-        return partials.sum(axis=1)
+        red = self._reduce_in_program(shards)
+        out = np.asarray(
+            self._run(("row_counts", rows_i, tree, red), b), dtype=np.int64)
+        return out if red else out.sum(axis=1)
+
+    def groupby(self, idx, fields_rows, filter_call, agg_field,
+                shards: list[int], pre, combo_chunk: int = 8):
+        """GroupBy on the stacked engine: the full combo cartesian
+        product evaluated as chunked device programs over gathered
+        (R, S, W) row stacks (executor.go:3918 + 8617 groupByIterator,
+        re-expressed as fixed-shape gathers + one scan over the BSI
+        planes for the Sum aggregate).
+
+        fields_rows: [(field, row_ids), ...].  Returns (counts (C,)
+        int64, None | (nn (C,), pos (C, P), neg (C, P)) int64 arrays)
+        in cartesian-product order (itertools.product semantics).
+        """
+        skey = tuple(shards)
+        # the gathered row stacks are resident all at once — bail to
+        # the bounded per-shard loop path when they would not fit the
+        # same byte budget the TopN candidate scan chunks to
+        total_rows = sum(len(rl) for _, rl in fields_rows)
+        est = total_rows * max(len(skey), 1) * (idx.width // 8)
+        if est > (1 << 31):
+            raise Unstackable(
+                f"groupby row stacks ~{est >> 20} MiB exceed budget")
+        b = PlanBuilder(self, idx, list(skey), pre)
+        stack_is = tuple(
+            b._add_leaf(self.rows_stack_for(
+                idx, f, (VIEW_STANDARD,), rl, skey))
+            for f, rl in fields_rows)
+        planes_i = None
+        if agg_field is not None:
+            planes_i = b._planes_leaf(agg_field)
+        tree = None
+        sizes = [len(rl) for _, rl in fields_rows]
+        n_combos = int(np.prod(sizes))
+        depth = agg_field.bit_depth if agg_field is not None else 0
+        if filter_call is not None:
+            tree = b.build(filter_call)
+            if tree == ("zeros",):
+                zero_agg = None if agg_field is None else (
+                    np.zeros(n_combos, dtype=np.int64),
+                    np.zeros((n_combos, depth), dtype=np.int64),
+                    np.zeros((n_combos, depth), dtype=np.int64))
+                return np.zeros(n_combos, dtype=np.int64), zero_agg
+        red = self._reduce_in_program(skey)
+        plan = ("groupby", stack_is, planes_i, tree, red)
+        # cartesian product in C order: index combo ci decomposes
+        # exactly like itertools.product over the row lists
+        combo_idx = np.stack(np.meshgrid(
+            *[np.arange(s, dtype=np.int32) for s in sizes],
+            indexing="ij"), axis=-1).reshape(n_combos, len(sizes))
+        counts = np.zeros(n_combos, dtype=np.int64)
+        nn = pos = neg = None
+        if agg_field is not None:
+            nn = np.zeros(n_combos, dtype=np.int64)
+            pos = np.zeros((n_combos, depth), dtype=np.int64)
+            neg = np.zeros((n_combos, depth), dtype=np.int64)
+        for lo in range(0, n_combos, combo_chunk):
+            hi = min(lo + combo_chunk, n_combos)
+            sel = combo_idx[lo:hi]
+            if hi - lo < combo_chunk:  # pad: combo 0 re-counted, dropped
+                sel = np.concatenate(
+                    [sel, np.zeros((combo_chunk - (hi - lo),
+                                    len(sizes)), dtype=np.int32)])
+            params = tuple(b.params) + (sel,)
+            fn = _compiled(plan, kern=kernels.enabled()
+                           and not self.host_only)
+            out = fn(tuple(b.leaves), params)
+            if agg_field is None:
+                c = np.asarray(out, dtype=np.int64)
+                if not red:
+                    c = c.sum(axis=1)
+                counts[lo:hi] = c[: hi - lo]
+            else:
+                c, n_, p_, g_ = (np.asarray(x, dtype=np.int64)
+                                 for x in out)
+                if not red:
+                    c, n_ = c.sum(axis=1), n_.sum(axis=1)
+                    p_, g_ = p_.sum(axis=2), g_.sum(axis=2)
+                counts[lo:hi] = c[: hi - lo]
+                nn[lo:hi] = n_[: hi - lo]
+                pos[lo:hi] = p_.T[: hi - lo]  # (P, C) -> (C, P)
+                neg[lo:hi] = g_.T[: hi - lo]
+        agg = None if agg_field is None else (nn, pos, neg)
+        return counts, agg
+
+    # shards decoded per device call in decode_stream: bounds the
+    # (4, S_chunk, 2^20)-int32 decode output to ~1 GiB at full width
+    _DECODE_CHUNK = 64
+
+    def decode_stream(self, idx, field, skey: tuple):
+        """Stream decoded BSI values: yields (shard_ids, exists, values)
+        with exists (S_c, width) bool and values (S_c, width) int64
+        numpy arrays — ONE device program per <=_DECODE_CHUNK shards
+        (ops.bsi.decode_device), never per-column host work."""
+        shards = list(skey)
+        if not shards:
+            return
+        planes = self.plane_stack(idx, field, tuple(skey))  # (S', P, W)
+        if self.host_only or isinstance(planes, np.ndarray):
+            pl = np.asarray(planes)
+            depth = pl.shape[1] - 2
+            for lo in range(0, len(shards), self._DECODE_CHUNK):
+                hi = min(lo + self._DECODE_CHUNK, len(shards))
+                ex = bsi_ops.unpack_bits_np(pl[lo:hi, 0])
+                sign = bsi_ops.unpack_bits_np(pl[lo:hi, 1])
+                vals = np.zeros(ex.shape, dtype=np.int64)
+                for i in range(depth):
+                    vals |= bsi_ops.unpack_bits_np(
+                        pl[lo:hi, 2 + i]).astype(np.int64) << i
+                vals = np.where(sign, -vals, vals)
+                yield shards[lo:hi], ex, np.where(ex, vals, 0)
+            return
+
+        for lo in range(0, len(shards), self._DECODE_CHUNK):
+            hi = min(lo + self._DECODE_CHUNK, len(shards))
+            e, s, vlo, vhi = _decode_slice(planes, lo, hi - lo)
+            ex, vals = bsi_ops.host_combine_decoded(e, s, vlo, vhi)
+            yield shards[lo:hi], ex, vals
 
     def rows_stack_for(self, idx, field, views: tuple[str, ...],
                        row_ids, skey: tuple):
@@ -645,6 +902,8 @@ class StackedEngine:
                     if fr is not None:
                         for ri, r in enumerate(row_key):
                             out[ri, si] |= fr.row_words(r)
+            if self.host_only:
+                return out  # mirror place(): no device touch
             if self.mesh is None:
                 return jnp.asarray(out)
             # shard axis is axis 1 here; pad + shard it over the mesh

@@ -118,6 +118,62 @@ def decode(planes) -> tuple[np.ndarray, list[int]]:
     return cols, values
 
 
+def unpack_bits(words):
+    """Device bit-unpack: (..., W) uint32 words -> (..., W*32) int32
+    0/1 per column (column c = word c>>5, bit c&31)."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (words[..., None] >> shifts) & jnp.uint32(1)
+    return bits.reshape(*words.shape[:-1],
+                        words.shape[-1] * 32).astype(jnp.int32)
+
+
+def decode_device(planes):
+    """Device-side BSI decode: planes (..., 2+depth, W) ->
+    (exists, sign, lo, hi), each (..., W*32) int32.
+
+    The value of column c is  (-1)^sign * (lo + (hi << 31)); the split
+    keeps every device word in int32 (no x64) for depths up to 62.
+    This is the fixed-shape replacement for the reference's per-column
+    value materialization (executor.go:4758 Extract, 9321 Sort,
+    2034 Distinct-BSI): one pass over the plane stack unpacks ALL 2^20
+    columns at once, so Sort/Extract/Distinct issue O(shard-chunks)
+    device calls instead of O(columns) host work.
+    """
+    depth = planes.shape[-2] - 2
+    assert depth <= 62, "decode_device supports depth <= 62"
+    exists = unpack_bits(planes[..., BSI_EXISTS_BIT, :])
+    sign = unpack_bits(planes[..., BSI_SIGN_BIT, :])
+    lo = jnp.zeros_like(exists)
+    hi = jnp.zeros_like(exists)
+    for i in range(depth):
+        bit = unpack_bits(planes[..., BSI_OFFSET_BIT + i, :])
+        if i < 31:
+            lo = lo | (bit << i)
+        else:
+            hi = hi | (bit << (i - 31))
+    return exists, sign, lo, hi
+
+
+def host_combine_decoded(exists, sign, lo, hi):
+    """Numpy combine of decode_device outputs -> (exists bool array,
+    values int64 array over ALL columns; value 0 where not exists)."""
+    ex = np.asarray(exists).astype(bool)
+    vals = (np.asarray(lo).astype(np.int64)
+            | (np.asarray(hi).astype(np.int64) << 31))
+    neg = np.asarray(sign).astype(bool)
+    vals = np.where(neg, -vals, vals)
+    return ex, np.where(ex, vals, 0)
+
+
+def unpack_bits_np(words: np.ndarray) -> np.ndarray:
+    """Host bit-unpack mirroring unpack_bits: (..., W) uint32 ->
+    (..., W*32) bool."""
+    words = np.asarray(words, dtype=np.uint32)
+    bits = (words[..., None] >> np.arange(32, dtype=np.uint32)) & 1
+    return bits.reshape(*words.shape[:-1],
+                        words.shape[-1] * 32).astype(bool)
+
+
 def predicate_masks(upredicate: int, depth: int) -> np.ndarray:
     """Per-plane broadcast masks for an unsigned predicate.
 

@@ -133,6 +133,92 @@ class TestGroupBy:
         got = ex.execute("i", "GroupBy(Rows(f), limit=2)")[0]
         assert len(got) == 2
 
+    def test_groupby_stacked_matches_loop(self, holder, ex, rng):
+        """The stacked device program and the per-shard loop agree on
+        counts and Sum aggregates (executor.go:3918 semantics)."""
+        idx, data = make_data(holder, ex, rng)
+        q = ("GroupBy(Rows(f), Rows(g), filter=Row(v > -50), "
+             "aggregate=Sum(field=v))")
+        got = ex.execute("i", q)[0]
+        ex_loop = Executor(holder)
+        ex_loop.use_stacked = False
+        want = ex_loop.execute("i", q)[0]
+        assert [(g.group, g.count, g.agg) for g in got] == \
+            [(g.group, g.count, g.agg) for g in want]
+        assert ex.stacked.cache.misses > 0  # stacked path engaged
+
+    def test_groupby_count_distinct_bsi(self, holder, ex, rng):
+        """aggregate=Count(Distinct(field=v)): distinct BSI values
+        per group (executor.go:3918 count-distinct aggregate)."""
+        idx, data = make_data(holder, ex, rng)
+        got = ex.execute(
+            "i", "GroupBy(Rows(g), aggregate=Count(Distinct(field=v)))")[0]
+        expect: dict[int, set] = {}
+        for col, (fr, gr, vv) in data.items():
+            expect.setdefault(gr, set()).add(vv)
+        for g in got:
+            assert g.agg == len(expect[g.group[0]["row_id"]])
+
+    def test_groupby_count_distinct_inner_filter(self, holder, ex, rng):
+        """The Distinct call's own filter child restricts the distinct
+        scan, like the standalone Distinct path (executor.py:476)."""
+        idx, data = make_data(holder, ex, rng)
+        got = ex.execute(
+            "i", "GroupBy(Rows(g), "
+                 "aggregate=Count(Distinct(Row(f=1), field=v)))")[0]
+        expect: dict[int, set] = {}
+        for col, (fr, gr, vv) in data.items():
+            if fr == 1:
+                expect.setdefault(gr, set()).add(vv)
+        for g in got:
+            assert g.agg == len(expect.get(g.group[0]["row_id"], set()))
+
+    def test_groupby_count_distinct_nested_precompute(self, holder, ex,
+                                                      rng):
+        """A nested Distinct inside the aggregate Distinct's filter is
+        precomputed like any bitmap operand (regression: the walker
+        used to skip the whole aggregate subtree -> KeyError)."""
+        idx, data = make_data(holder, ex, rng)
+        q = ("GroupBy(Rows(g), aggregate=Count(Distinct(Intersect("
+             "Row(f=1), Distinct(Row(v > 0), field=f)), field=v)))")
+        got = ex.execute("i", q)[0]
+        ex_loop = Executor(holder)
+        ex_loop.use_stacked = False
+        want = ex_loop.execute("i", q)[0]
+        assert [(g.group, g.count, g.agg) for g in got] == \
+            [(g.group, g.count, g.agg) for g in want]
+
+    def test_groupby_count_distinct_set(self, holder, ex, rng):
+        """Count(Distinct) over a set field counts distinct rows of
+        that field intersecting each group."""
+        idx, data = make_data(holder, ex, rng)
+        got = ex.execute(
+            "i", "GroupBy(Rows(g), aggregate=Count(Distinct(field=f)))")[0]
+        expect: dict[int, set] = {}
+        for col, (fr, gr, vv) in data.items():
+            expect.setdefault(gr, set()).add(fr)
+        for g in got:
+            assert g.agg == len(expect[g.group[0]["row_id"]])
+
+    def test_groupby_previous_paging(self, holder, ex, rng):
+        """previous= resumes strictly after the given group in product
+        order (groupByIterator seek, executor.go:8617)."""
+        idx, data = make_data(holder, ex, rng)
+        full = ex.execute("i", "GroupBy(Rows(f), Rows(g))")[0]
+        assert len(full) > 3
+        pivot = full[2]
+        pf = pivot.group[0]["row_id"]
+        pg = pivot.group[1]["row_id"]
+        resumed = ex.execute(
+            "i", f"GroupBy(Rows(f), Rows(g), previous=[{pf}, {pg}])")[0]
+        assert [(g.group, g.count) for g in resumed] == \
+            [(g.group, g.count) for g in full[3:]]
+        # paging past the end yields nothing
+        lf = full[-1].group[0]["row_id"]
+        lg = full[-1].group[1]["row_id"]
+        assert ex.execute(
+            "i", f"GroupBy(Rows(f), Rows(g), previous=[{lf}, {lg}])")[0] == []
+
 
 class TestPercentile:
     def test_median_odd(self, holder, ex):
@@ -218,6 +304,41 @@ class TestExtract:
             "i", "Extract(Sort(All(), field=v, limit=3), Rows(v))")[0]
         expect = sorted(data.items(), key=lambda kv: (kv[1][2], kv[0]))[:3]
         assert [e["column"] for e in got.columns] == [c for c, _ in expect]
+
+
+class TestStackedLoopEquivalence:
+    """The device-decode paths (Sort/Extract/Distinct/MinRow/MaxRow,
+    executor.go:9321/4758/2034 + fragment.minRow) agree exactly with
+    the per-shard loop fallback."""
+
+    QUERIES = [
+        "Sort(Row(f=1), field=v)",
+        "Sort(All(), field=v, sort-desc=true, limit=7, offset=3)",
+        "Distinct(field=v)",
+        "Distinct(Row(g=1), field=v)",
+        "Distinct(Row(v > 0), field=f)",
+        "MinRow(field=f)",
+        "MaxRow(field=f)",
+        "MinRow(Row(g=2), field=f)",
+        "Extract(Row(v > 10), Rows(v), Rows(f))",
+    ]
+
+    def test_paths_agree(self, holder, ex, rng):
+        idx, data = make_data(holder, ex, rng)
+        ex_loop = Executor(holder)
+        ex_loop.use_stacked = False
+
+        def norm(r):
+            if isinstance(r, SortedRow):
+                return (r.columns, r.values)
+            if hasattr(r, "columns") and callable(r.columns):
+                return r.columns().tolist()
+            return r
+
+        for q in self.QUERIES:
+            got = [norm(r) for r in ex.execute("i", q)]
+            want = [norm(r) for r in ex_loop.execute("i", q)]
+            assert got == want, q
 
 
 def test_delete(holder, ex, rng):

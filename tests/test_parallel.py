@@ -86,11 +86,12 @@ def test_dryrun_multichip_entry():
 
 def test_entry_compiles():
     """entry() returns the stacked engine's compiled count program
-    over host-resident leaves; calling it yields (S,) partials."""
+    over host-resident leaves; calling it yields the in-program-
+    reduced total count (a scalar)."""
     import numpy as np
     import __graft_entry__ as ge
     fn, args = ge.entry()
     leaves, params = args
     assert all(isinstance(lf, np.ndarray) for lf in leaves)  # no device
     out = fn(*args)
-    assert out.ndim == 1 and int(np.asarray(out).sum()) >= 0
+    assert out.ndim == 0 and int(np.asarray(out)) >= 0
