@@ -14,6 +14,7 @@ import datetime as dt
 import os
 import threading
 import time
+from decimal import Decimal
 
 import numpy as np
 
@@ -69,6 +70,14 @@ class API:
         self._history: list[QueryHistoryEntry] = []
         self._hist_lock = threading.Lock()
         self.history_keep = 100
+        # long-query log (server.go:201 OptServerLongQueryTime): any
+        # query slower than this (seconds) is logged with its span
+        # timings and kept in a ring for /debug/long-queries.
+        # 0 disables.
+        self.long_query_time: float = 0.0
+        self._long_queries: list[dict] = []
+        from pilosa_tpu.obs.logger import StderrLogger
+        self.logger = StderrLogger()
         # imports serialize per index, the analog of the reference's
         # one-writer-per-shard RBF write transaction (api.go:618 under
         # Qcx write Tx); concurrent ingest still parallelizes batching
@@ -101,7 +110,10 @@ class API:
         if is_write_query(pql):
             self._check_writable()
         tracer = None
-        if profile:
+        # a slow-query threshold records spans for every query so the
+        # long-query log can include per-phase timings (server.go:201)
+        want_trace = profile or self.long_query_time > 0
+        if want_trace:
             from pilosa_tpu.obs import tracing as _tr
             tracer = RecordingTracer()
             prev = _tr.push_thread_tracer(tracer)
@@ -112,12 +124,12 @@ class API:
             except (ExecError, ParseError, ValueError, KeyError) as e:
                 raise ApiError(str(e), 400)
         finally:
-            if profile:
+            if want_trace:
                 _tr.pop_thread_tracer(prev)
         resp = {"results": [serialize_result(r) for r in results]}
         if profile and tracer.roots:
             resp["profile"] = [s.to_dict() for s in tracer.roots]
-        self._record_history(index, pql, t0)
+        self._record_history(index, pql, t0, tracer)
         return resp
 
     def sql(self, statement: str, auth_check=None) -> dict:
@@ -140,17 +152,35 @@ class API:
             "data": [[_json_value(v) for v in row] for row in res.rows],
         }
 
-    def _record_history(self, index, query, t0):
-        e = QueryHistoryEntry(index, query, t0, time.time() - t0)
+    def _record_history(self, index, query, t0, tracer=None):
+        dur = time.time() - t0
+        e = QueryHistoryEntry(index, query, t0, dur)
         with self._hist_lock:
             self._history.append(e)
             if len(self._history) > self.history_keep:
                 self._history.pop(0)
+        if 0 < self.long_query_time <= dur:
+            entry = e.to_dict()
+            if tracer is not None and tracer.roots:
+                entry["spans"] = [s.to_dict() for s in tracer.roots]
+            with self._hist_lock:
+                self._long_queries.append(entry)
+                if len(self._long_queries) > self.history_keep:
+                    self._long_queries.pop(0)
+            self.logger.warn(
+                "long query (%.1fms > %.0fms) index=%r: %s",
+                dur * 1e3, self.long_query_time * 1e3, index,
+                str(query)[:200])
 
     def query_history(self) -> list[dict]:
         """Recent queries (http_handler.go:540 /query-history)."""
         with self._hist_lock:
             return [e.to_dict() for e in reversed(self._history)]
+
+    def long_queries(self) -> list[dict]:
+        """Slow-query ring with span timings (/debug/long-queries)."""
+        with self._hist_lock:
+            return list(reversed(self._long_queries))
 
     # ------------------------------------------------------------------
     # schema (api.go:254-477)
@@ -463,6 +493,125 @@ class API:
                           if ix.available_shards else 0)
                 for ix in self.holder.indexes.values()}
 
+    def available_shards(self, index: str) -> list[int]:
+        """This node's known shard set for one index (the repair peer
+        merges these so a rejoin learns shards created while it was
+        down)."""
+        return sorted(self._index_or_404(index).available_shards)
+
+    # ------------------------------------------------------------------
+    # translation sync + replica repair (holder.go:1488-1715 translate
+    # syncer; fragment.go checksum blocks)
+    # ------------------------------------------------------------------
+
+    def _index_or_404(self, index: str):
+        idx = self.holder.index(index)
+        if idx is None:
+            raise ApiError(f"index not found: {index}", 404)
+        return idx
+
+    def translate_partitions(self, index: str) -> list[int]:
+        """Partitions of this index's column-key store holding keys."""
+        idx = self._index_or_404(index)
+        if not idx.keys:
+            raise ApiError(f"index {index} is not keyed", 400)
+        return idx.column_translator.nonempty_partitions()
+
+    def translate_partition_snapshot(self, index: str,
+                                     partition: int) -> dict:
+        idx = self._index_or_404(index)
+        if not idx.keys:
+            raise ApiError(f"index {index} is not keyed", 400)
+        return idx.column_translator.partition_snapshot(int(partition))
+
+    def translate_restore_partition(self, index: str, partition: int,
+                                    snap: dict) -> dict:
+        idx = self._index_or_404(index)
+        if not idx.keys:
+            raise ApiError(f"index {index} is not keyed", 400)
+        idx.column_translator.restore_partition(int(partition), snap)
+        return {"restored": int(partition),
+                "entries": len(snap.get("entries", []))}
+
+    def field_translate_snapshot(self, index: str, field: str) -> dict:
+        idx = self._index_or_404(index)
+        f = idx.field(field)
+        if f is None or f.row_translator is None:
+            raise ApiError(f"no keyed field {field} in {index}", 404)
+        return f.row_translator.snapshot()
+
+    def field_translate_restore(self, index: str, field: str,
+                                snap: dict) -> dict:
+        idx = self._index_or_404(index)
+        f = idx.field(field)
+        if f is None or f.row_translator is None:
+            raise ApiError(f"no keyed field {field} in {index}", 404)
+        f.row_translator.restore_snapshot(snap)
+        return {"entries": len(snap.get("entries", []))}
+
+    def _fragment_or_404(self, index, field, view, shard, create=False):
+        idx = self._index_or_404(index)
+        f = idx.field(field)
+        if f is None:
+            raise ApiError(f"field not found: {field}", 404)
+        v = f.view(view, create=create)
+        if v is None:
+            raise ApiError(f"view not found: {view}", 404)
+        frag = v.fragment(int(shard), create=create)
+        if frag is None:
+            raise ApiError(f"no fragment shard={shard}", 404)
+        return frag
+
+    def fragment_views(self, index: str, field: str) -> list[str]:
+        idx = self._index_or_404(index)
+        f = idx.field(field)
+        if f is None:
+            raise ApiError(f"field not found: {field}", 404)
+        return sorted(f.views)
+
+    def fragment_checksums(self, index: str, field: str, view: str,
+                           shard: int) -> dict:
+        """Block digests for divergence detection; {} when the
+        fragment does not exist (nothing stored => all-empty)."""
+        idx = self._index_or_404(index)
+        f = idx.field(field)
+        v = f.views.get(view) if f else None
+        frag = v.fragment(int(shard)) if v else None
+        if frag is None:
+            return {}
+        return {str(b): d for b, d in frag.block_checksums().items()}
+
+    def fragment_block(self, index: str, field: str, view: str,
+                       shard: int, block: int) -> dict:
+        """One block's rows as base64(zlib(packed words)); {} when the
+        fragment does not exist (all-empty: the repair peer then
+        clears its diverged rows)."""
+        import base64
+        import zlib
+        idx = self._index_or_404(index)
+        f = idx.field(field)
+        v = f.views.get(view) if f else None
+        frag = v.fragment(int(shard)) if v else None
+        if frag is None:
+            return {}
+        return {str(r): base64.b64encode(
+                    zlib.compress(np.ascontiguousarray(w).tobytes())
+                ).decode()
+                for r, w in frag.block_rows(int(block)).items()}
+
+    def fragment_set_block(self, index: str, field: str, view: str,
+                           shard: int, block: int, payload: dict) -> dict:
+        import base64
+        import zlib
+        frag = self._fragment_or_404(index, field, view, shard,
+                                     create=True)
+        rows = {}
+        for r, b64 in payload.items():
+            raw = zlib.decompress(base64.b64decode(b64))
+            rows[int(r)] = np.frombuffer(raw, dtype=np.uint32)
+        frag.set_block_rows(int(block), rows)
+        return {"block": int(block), "rows": len(rows)}
+
     # ------------------------------------------------------------------
     # translation (api.go:929-1038 data streaming analogs)
     # ------------------------------------------------------------------
@@ -523,6 +672,10 @@ def _json_value(v):
     if isinstance(v, (np.integer,)):
         return int(v)
     if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, Decimal):
+        # JSON number (reference decimal wire shape); exactness is an
+        # engine-level property — the wire is display-precision
         return float(v)
     if isinstance(v, dt.datetime):
         return v.isoformat()

@@ -66,6 +66,8 @@ def cmd_server(args) -> int:
     logger = StdLogger()
     srv = Server(holder=holder, bind=cfg.bind, port=cfg.port,
                  logger=logger, auth=auth)
+    srv.api.long_query_time = float(cfg.long_query_time)
+    srv.api.logger = logger
     grpc_srv = None
     if cfg.grpc_port >= 0:
         from pilosa_tpu.server.grpc import GRPCServer

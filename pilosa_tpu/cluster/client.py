@@ -84,6 +84,10 @@ class InternalClient:
 
     # -- raw binary transfers (backup/restore file streaming) ----------
 
+    def get_json(self, uri: str, path: str):
+        """GET a JSON internal resource (sync/repair endpoints)."""
+        return json.loads(self.get_raw(uri, path))
+
     def get_raw(self, uri: str, path: str) -> bytes:
         host, _, port = uri.partition(":")
         conn = http.client.HTTPConnection(host, int(port or 80),

@@ -114,6 +114,141 @@ class ClusterNode:
     def snapshot(self) -> ClusterSnapshot:
         return ClusterSnapshot(self.disco.nodes(), self.replica_n)
 
+    # -- rejoin resync (holder.go:1488-1715 + fragment.go checksums) ---
+
+    def sync_from_peers(self) -> dict:
+        """Pull what this node missed while dead: translate-store
+        snapshots from partition owners (holder.go:1488-1715 translate
+        syncer) and diverged fragment blocks from each shard's primary
+        (fragment.go checksum-block repair).  Returns counters."""
+        snap = self.snapshot()
+        client = self._client()
+        stats = {"partitions": 0, "fields": 0, "blocks": 0}
+        peers = {n.id: n for n in snap.nodes
+                 if n.id != self.node_id and n.state == NodeState.STARTED}
+        if not peers:
+            return stats
+        for index in sorted(self.api.holder.indexes):
+            idx = self.api.holder.index(index)
+            # column-key partitions: restore from each partition's
+            # primary owner when that owner is another live node
+            if idx.keys:
+                seen: set[int] = set()
+                for peer in peers.values():
+                    try:
+                        parts = client.get_json(
+                            peer.uri,
+                            f"/internal/translate/{index}/partitions")
+                    except _NET_ERRORS + (RemoteError,):
+                        continue
+                    for p in parts:
+                        if p in seen:
+                            continue
+                        # pull from the first LIVE owner — even when
+                        # we are the jump-hash primary for p, the
+                        # replicas that stayed up hold the newer keys
+                        owners = snap.partition_nodes(p)
+                        owner = next((n for n in owners
+                                      if n.id in peers), None)
+                        if owner is None:
+                            # no live replica owns p; fall back to the
+                            # peer that reported it so rejoin still
+                            # recovers the keys
+                            owner = peer
+                        try:
+                            s = client.get_json(
+                                owner.uri,
+                                f"/internal/translate/{index}"
+                                f"/partition/{p}/snapshot")
+                        except _NET_ERRORS + (RemoteError,):
+                            continue
+                        idx.column_translator.restore_partition(p, s)
+                        seen.add(p)
+                        stats["partitions"] += 1
+            # field row-key stores replicate on every node: pull from
+            # ANY live peer (a rejoining cluster primary is the one
+            # node guaranteed to be stale, so "primary only" would
+            # skip exactly the case that needs the sync)
+            src = (snap.primary() if snap.primary() is not None
+                   and snap.primary().id in peers
+                   else next(iter(peers.values())))
+            for fname in sorted(idx.fields):
+                f = idx.field(fname)
+                if f is None or not f.options.keys:
+                    continue
+                try:
+                    s = client.get_json(
+                        src.uri,
+                        f"/internal/translate/{index}/field/"
+                        f"{fname}/snapshot")
+                except _NET_ERRORS + (RemoteError,):
+                    continue
+                f.row_translator.restore_snapshot(s)
+                stats["fields"] += 1
+            # fragment repair: for every shard this node replicates,
+            # diff block checksums against a live co-owner.  The shard
+            # set merges every peer's view — shards created while this
+            # node was down are unknown locally.
+            all_shards = set(idx.available_shards)
+            for peer in peers.values():
+                try:
+                    all_shards.update(client.get_json(
+                        peer.uri, f"/internal/shards/{index}"))
+                except _NET_ERRORS + (RemoteError,):
+                    continue
+            for fname in sorted(idx.fields):
+                f = idx.field(fname)
+                if f is None:
+                    continue
+                for shard in sorted(all_shards):
+                    owners = snap.shard_nodes(index, shard)
+                    if self.node_id not in (n.id for n in owners):
+                        continue
+                    # pull from the first LIVE co-owner — even when we
+                    # are the jump-hash primary: after downtime the
+                    # replicas that stayed up hold the newer data
+                    src = next((n for n in owners if n.id in peers),
+                               None)
+                    if src is None:
+                        continue  # no live peer holds this shard
+                    stats["blocks"] += self._repair_fragment(
+                        client, src, index, fname, shard)
+        return stats
+
+    def _repair_fragment(self, client, primary, index, fname,
+                         shard) -> int:
+        """Diff + pull diverged blocks for every view of one
+        (field, shard) from the primary."""
+        repaired = 0
+        try:
+            views = client.get_json(
+                primary.uri, f"/internal/fragment/{index}/{fname}/views")
+        except _NET_ERRORS + (RemoteError,):
+            return 0
+        for view in views:
+            try:
+                theirs = client.get_json(
+                    primary.uri,
+                    f"/internal/fragment/{index}/{fname}/{view}/"
+                    f"{shard}/checksums")
+            except _NET_ERRORS + (RemoteError,):
+                continue
+            mine = self.api.fragment_checksums(index, fname, view, shard)
+            diverged = [b for b in set(theirs) | set(mine)
+                        if theirs.get(b) != mine.get(b)]
+            for b in diverged:
+                try:
+                    payload = client.get_json(
+                        primary.uri,
+                        f"/internal/fragment/{index}/{fname}/{view}/"
+                        f"{shard}/block/{b}")
+                except _NET_ERRORS + (RemoteError,):
+                    continue
+                self.api.fragment_set_block(
+                    index, fname, view, shard, int(b), payload)
+                repaired += 1
+        return repaired
+
     # -- writes (replicated) -------------------------------------------
 
     def import_bits(self, index: str, field: str, rows, cols,

@@ -26,6 +26,9 @@ class Config:
     auth_secret: str = ""
     auth_policy: str = ""
     tpu_kernels: str = "auto"   # auto | on | off -> PILOSA_TPU_PALLAS
+    # queries slower than this (seconds) go to the long-query log;
+    # 0 disables (server.go:201 OptServerLongQueryTime)
+    long_query_time: float = 0.0
 
     def apply_kernel_setting(self):
         """Translate tpu_kernels into the Pallas dispatch env flag.
@@ -48,6 +51,7 @@ _TOML_KEYS = {
     "auth.secret": "auth_secret",
     "auth.policy": "auth_policy",
     "tpu.kernels": "tpu_kernels",
+    "long-query-time": "long_query_time",
 }
 
 ENV_PREFIX = "PILOSA_TPU_"

@@ -950,8 +950,7 @@ class Executor(AdvancedOps):
         changed = False
         for v in f.views.values():
             for frag in v.fragments.values():
-                w = frag._rows.get(row_id)
-                if w is not None and w.any():
+                if frag.row_count(row_id):
                     frag.set_row_words(row_id, 0)
                     changed = True
         return changed

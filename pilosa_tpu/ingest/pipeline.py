@@ -10,6 +10,7 @@ the owning batch flushed (at-least-once, matching the reference).
 
 from __future__ import annotations
 
+import hashlib
 import queue
 import threading
 
@@ -19,7 +20,7 @@ from pilosa_tpu.ingest.batch import Batch
 class Pipeline:
     def __init__(self, source, importer, index: str,
                  batch_size: int = 1 << 16, concurrency: int = 1,
-                 index_keys: bool | None = None):
+                 index_keys: bool | None = None, allocator=None):
         self.source = source
         self.importer = importer
         self.index = index
@@ -28,6 +29,12 @@ class Pipeline:
         self.index_keys = (source.id_keys if index_keys is None and
                            hasattr(source, "id_keys") else bool(index_keys))
         self.records_ingested = 0
+        # optional IDAllocator for records WITHOUT an _id: ids come
+        # from reserve/commit sessions keyed by the source position
+        # (idk/idallocator.go over idalloc.go:127 — a crashed worker
+        # that retries the same batch reserves the SAME session and
+        # gets the same range, so replayed records keep their ids)
+        self.allocator = allocator
 
     def apply_schema(self):
         """Schema-detect step: create index+fields from the source."""
@@ -56,7 +63,7 @@ class Pipeline:
                         return
                     yield rec
             try:
-                counts[i] = self._run_worker(drain())
+                counts[i] = self._run_worker(drain(), worker=i)
             except BaseException as e:  # surface to the caller
                 errs.append(e)
 
@@ -92,19 +99,57 @@ class Pipeline:
         self.records_ingested = sum(counts)
         return self.records_ingested
 
-    def _run_worker(self, records) -> int:
+    def _alloc_session(self, n: int) -> bytes:
+        """Deterministic reservation session for the CURRENT batch:
+        derived from the source position of its first record, so a
+        crash/retry of the same batch reserves the same session (and
+        therefore the same id range, idalloc.go:127)."""
+        pos = None
+        if hasattr(self.source, "_pending") and self.source._pending:
+            pos = self.source._pending[-1]
+        return hashlib.blake2b(
+            f"{self.index}|{pos}|{n}".encode(),
+            digest_size=16).digest()
+
+    def _run_worker(self, records, worker: int = 0) -> int:
         b = Batch(self.importer, self.index, self.source.schema,
                   size=self.batch_size, index_keys=self.index_keys)
         n = 0
         pending = 0  # records flushed downstream since last commit
+        block: range | None = None
+        block_i = 0
+        session: bytes | None = None
+        # sessions are per worker (the allocator supports concurrent
+        # in-flight sessions on one key); same-id replay determinism
+        # holds at concurrency=1 — with workers, queue distribution is
+        # nondeterministic, so replays keep uniqueness, not identity
+        # (the reference's per-clone consumers have the same shape,
+        # idk/ingest.go:302)
+        akey = self.index
         for rec in records:
+            if rec.id is None:
+                if self.allocator is None:
+                    raise ValueError(
+                        "record without _id and no id allocator")
+                if block is None or block_i >= len(block):
+                    session = self._alloc_session(n) + bytes([worker])
+                    block = self.allocator.reserve(
+                        akey, session, self.batch_size)
+                    block_i = 0
+                rec.id = block[block_i]
+                block_i += 1
             full = b.add(rec)
             n += 1
             pending += 1
             if full:
                 b.flush()
+                if block is not None:
+                    self.allocator.commit(akey, session, block_i)
+                    block, session = None, None
                 self.source.commit(pending)
                 pending = 0
         b.flush()
+        if block is not None:
+            self.allocator.commit(akey, session, block_i)
         self.source.commit(pending)
         return n

@@ -147,7 +147,9 @@ class Field:
     def int_to_value(self, v: int):
         t = self.options.type
         if t == FieldType.DECIMAL:
-            return v / (10 ** self.options.scale)
+            # exact: float division would corrupt values like 115.49
+            from decimal import Decimal
+            return Decimal(int(v)).scaleb(-self.options.scale)
         if t == FieldType.TIMESTAMP:
             return self.options.int_to_timestamp(v)
         return v

@@ -1,10 +1,21 @@
 """Fragment — the data-plane unit: one bitmap per (field, view, shard).
 
 Mirrors fragment.go:84: a fragment is logically a single bitmap keyed
-``row*SHARD_WIDTH + col``.  Host-side, rows are kept as packed uint32
-word arrays (the storage layer will swap in compressed containers);
-device-side, a per-row tile cache feeds the XLA kernels, invalidated on
-write.  BSI views reuse the same row space: row 0 = exists, row 1 =
+``row*SHARD_WIDTH + col``.  Host-side, each row lives in one of two
+representations chosen by cardinality — the in-memory analog of the
+reference's array/bitmap container split (roaring/container_stash.go:
+46-85, roaring/roaring.go:232):
+
+- **sparse**: a sorted int64 array of set column ids, for rows with
+  <= ``SPARSE_MAX`` bits (64 KiB worst case vs 128 KiB dense) — so a
+  shard with a million near-empty rows needs megabytes, not 128 GiB;
+- **dense**: packed uint32 words, the device-tile form, once a row
+  crosses the threshold (mutation promotes in place).
+
+Dense decode happens only at device-upload / read time
+(``row_words``); all mutators work on the compressed form.
+Device-side, a per-row tile cache feeds the XLA kernels, invalidated
+on write.  BSI views reuse the same row space: row 0 = exists, row 1 =
 sign, rows 2.. = magnitude planes (fragment.go:34-66), so BSI plane
 stacks are just ``rows[0..2+depth)`` stacked into one (2+depth, W)
 device tensor.
@@ -21,6 +32,7 @@ from pilosa_tpu.shardwidth import (
     BSI_OFFSET_BIT,
     BSI_SIGN_BIT,
     SHARD_WIDTH,
+    SPARSE_MAX,
 )
 
 
@@ -36,6 +48,7 @@ class Fragment:
         self.shard = shard
         self.width = width
         self._rows: dict[int, np.ndarray] = {}   # row id -> packed words
+        self._sparse: dict[int, np.ndarray] = {}  # row id -> sorted cols
         self._device: dict[int, jnp.ndarray] = {}
         self._planes_cache: jnp.ndarray | None = None
         # monotonically increasing write stamp: every host mutation
@@ -53,17 +66,49 @@ class Fragment:
         self._cache = make_cache(cache_type, cache_size)
         self._cache_stale: dict[int, None] = {}
         if storage is not None:
-            self._rows = storage.load_rows(field, view, shard, width)
+            # load_rows already compresses as it streams (peak = one
+            # dense row): int64 arrays are sorted column ids, uint32
+            # arrays are packed words
+            for r, w in storage.load_rows(field, view, shard,
+                                          width).items():
+                if w.dtype == np.int64:
+                    self._sparse[r] = w
+                else:
+                    self._rows[r] = w
             if self._cache is not None:
                 self._cache_stale.update(dict.fromkeys(self._rows))
+                self._cache_stale.update(dict.fromkeys(self._sparse))
+
+    @property
+    def sparse_row_count(self) -> int:
+        """Rows currently held in compressed (column-array) form."""
+        return len(self._sparse)
+
+    def _densify(self, row: int) -> np.ndarray:
+        """Promote a sparse row to dense words (in place)."""
+        cols = self._sparse.pop(row)
+        w = bm.from_columns(cols, self.width)
+        self._rows[row] = w
+        return w
+
+    def _store_cols(self, row: int, arr: np.ndarray) -> None:
+        """Store a sorted column array, promoting past the threshold."""
+        self._sparse[row] = arr
+        if arr.size > SPARSE_MAX:
+            self._densify(row)
 
     # -- host mutation ------------------------------------------------------
 
     def _row_mut(self, row: int) -> np.ndarray:
+        """Mutable DENSE words for a row (densifying if needed) —
+        the bulk/word-level write path."""
         w = self._rows.get(row)
         if w is None:
-            w = bm.empty(self.width)
-            self._rows[row] = w
+            if row in self._sparse:
+                w = self._densify(row)
+            else:
+                w = bm.empty(self.width)
+                self._rows[row] = w
         self._invalidate(row)
         return w
 
@@ -86,17 +131,47 @@ class Fragment:
         self._invalidate(row)
 
     def set_row_words(self, row: int, words) -> None:
-        """Replace a whole row (Store()/ClearRow write path)."""
-        self._row_mut(row)[:] = words
+        """Replace a whole row (Store()/ClearRow write path); the
+        result re-compresses when it lands under the threshold.  The
+        old contents are fully replaced, so they are dropped without
+        decoding."""
+        self._invalidate(row)
+        self._sparse.pop(row, None)
+        w = self._rows.get(row)
+        if w is None:
+            w = bm.empty(self.width)
+        w[:] = words
+        if int(np.bitwise_count(w).sum()) <= SPARSE_MAX:
+            self._rows.pop(row, None)
+            self._sparse[row] = bm.to_columns(w).astype(np.int64)
+        else:
+            self._rows[row] = w
         self.touch(row)
 
     def set_bit(self, row: int, col: int) -> bool:
         """Set one bit; returns True if it changed (fragment.setBit)."""
         assert 0 <= col < self.width
+        words = self._rows.get(row)
+        if words is None:
+            # sparse path: sorted-insert, promoting at the threshold
+            # (the array-container write path, roaring/roaring.go:927)
+            arr = self._sparse.get(row)
+            if arr is None:
+                self._invalidate(row)
+                self._sparse[row] = np.array([col], dtype=np.int64)
+                self.touch(row)
+                return True
+            i = int(np.searchsorted(arr, col))
+            if i < arr.size and arr[i] == col:
+                return False
+            self._invalidate(row)
+            self._store_cols(row, np.insert(arr, i, col))
+            self.touch(row)
+            return True
         w, b = col >> 5, np.uint32(1) << (col & 31)
-        words = self._row_mut(row)
         if words[w] & b:
             return False
+        self._invalidate(row)
         words[w] |= b
         self.touch(row)
         return True
@@ -104,7 +179,16 @@ class Fragment:
     def clear_bit(self, row: int, col: int) -> bool:
         words = self._rows.get(row)
         if words is None:
-            return False
+            arr = self._sparse.get(row)
+            if arr is None:
+                return False
+            i = int(np.searchsorted(arr, col))
+            if i >= arr.size or arr[i] != col:
+                return False
+            self._invalidate(row)
+            self._sparse[row] = np.delete(arr, i)
+            self.touch(row)
+            return True
         w, b = col >> 5, np.uint32(1) << (col & 31)
         if not (words[w] & b):
             return False
@@ -114,20 +198,53 @@ class Fragment:
         return True
 
     def import_bits(self, rows, cols, clear: bool = False):
-        """Bulk set/clear: vectorized OR/ANDNOT per distinct row
-        (fragment.bulkImport semantics, minus the roaring plumbing)."""
+        """Bulk set/clear: vectorized merge per distinct row
+        (fragment.bulkImport semantics, minus the roaring plumbing).
+        Rows stay in compressed form until they cross SPARSE_MAX."""
         rows = np.asarray(rows, dtype=np.int64)
         cols = np.asarray(cols, dtype=np.int64)
         assert rows.shape == cols.shape
-        for r in np.unique(rows):
-            sel = cols[rows == r]
+        if cols.size:
+            # validate once up front: the sparse branches below bypass
+            # bm.from_columns and would otherwise store bad ids whose
+            # failures surface far from the import (or, for negatives,
+            # silently wrap in clear_columns' word indexing)
+            assert 0 <= cols.min() and cols.max() < self.width, \
+                "column id out of range"
+        # group columns by row with one sort (not one O(n) mask per
+        # distinct row — a million-row sparse import must stay O(n log n))
+        order = np.argsort(rows, kind="stable")
+        rows_s, cols_s = rows[order], cols[order]
+        uniq, starts = np.unique(rows_s, return_index=True)
+        bounds = np.append(starts[1:], rows_s.size)
+        for r, lo_i, hi_i in zip(uniq.tolist(), starts.tolist(),
+                                 bounds.tolist()):
+            r = int(r)
+            sel = cols_s[lo_i:hi_i]
+            dense = self._rows.get(r)
+            if dense is None and not clear:
+                arr = self._sparse.get(r)
+                base = arr if arr is not None else \
+                    np.array([], dtype=np.int64)
+                self._invalidate(r)
+                self._store_cols(r, np.union1d(base, sel))
+                self.touch(r)
+                continue
+            if dense is None and clear:
+                arr = self._sparse.get(r)
+                if arr is None:
+                    continue
+                self._invalidate(r)
+                self._sparse[r] = np.setdiff1d(arr, sel)
+                self.touch(r)
+                continue
             mask = bm.from_columns(sel, self.width)
-            words = self._row_mut(int(r))
+            words = self._row_mut(r)
             if clear:
                 words &= ~mask
             else:
                 words |= mask
-            self.touch(int(r))
+            self.touch(r)
 
     def import_row_words(self, row: int, words) -> None:
         """Bulk dense-row import: OR pre-packed words into a row.
@@ -144,7 +261,11 @@ class Fragment:
     def contains(self, row: int, col: int) -> bool:
         words = self._rows.get(row)
         if words is None:
-            return False
+            arr = self._sparse.get(row)
+            if arr is None:
+                return False
+            i = int(np.searchsorted(arr, col))
+            return i < arr.size and int(arr[i]) == col
         return bool((words[col >> 5] >> np.uint32(col & 31)) & 1)
 
     # -- BSI mutation (fragment.setValueBase semantics) ---------------------
@@ -207,12 +328,22 @@ class Fragment:
     def clear_columns(self, mask_words: np.ndarray) -> bool:
         """Clear every bit in the masked columns across ALL rows
         (Delete-records path).  Returns True if anything changed."""
-        inv = ~np.asarray(mask_words, dtype=np.uint32)
+        mask = np.asarray(mask_words, dtype=np.uint32)
+        inv = ~mask
         changed = False
         for r in list(self._rows):
             row = self._rows[r]
-            if (row & ~inv).any():
+            if (row & mask).any():
                 self._row_mut(r)[:] = row & inv
+                self.touch(r)
+                changed = True
+        for r in list(self._sparse):
+            arr = self._sparse[r]
+            hit = ((mask[arr >> 5] >> (arr & 31).astype(np.uint32))
+                   & 1).astype(bool)
+            if hit.any():
+                self._invalidate(r)
+                self._sparse[r] = arr[~hit]
                 self.touch(r)
                 changed = True
         return changed
@@ -221,20 +352,32 @@ class Fragment:
 
     @property
     def row_ids(self) -> list[int]:
-        return sorted(r for r, w in self._rows.items() if w.any())
+        ids = [r for r, w in self._rows.items() if w.any()]
+        ids += [r for r, a in self._sparse.items() if a.size]
+        return sorted(ids)
 
     def max_row_id(self) -> int:
         ids = self.row_ids
         return ids[-1] if ids else 0
 
     def row_words(self, row: int) -> np.ndarray:
-        """Packed host words for a row (zeros if absent)."""
+        """Packed host words for a row (zeros if absent).  Sparse rows
+        decode to a fresh dense array — the decode-at-upload boundary;
+        treat the result as read-only."""
         w = self._rows.get(row)
-        return w if w is not None else bm.empty(self.width)
+        if w is not None:
+            return w
+        arr = self._sparse.get(row)
+        if arr is not None:
+            return bm.from_columns(arr, self.width)
+        return bm.empty(self.width)
 
     def row_count(self, row: int) -> int:
         w = self._rows.get(row)
-        return int(np.bitwise_count(w).sum()) if w is not None else 0
+        if w is not None:
+            return int(np.bitwise_count(w).sum())
+        arr = self._sparse.get(row)
+        return int(arr.size) if arr is not None else 0
 
     def row_cache(self):
         """The TopN rank/LRU cache, refreshed for rows written since
@@ -272,4 +415,49 @@ class Fragment:
         return p
 
     def memory_bytes(self) -> int:
-        return sum(w.nbytes for w in self._rows.values())
+        return (sum(w.nbytes for w in self._rows.values())
+                + sum(a.nbytes for a in self._sparse.values()))
+
+    # -- block checksums / replica repair -------------------------------
+    # (fragment.go checksum-block machinery: merkle-style digests per
+    # row-range block so replicas detect divergence and re-sync only
+    # the diverged blocks)
+
+    BLOCK_ROWS = 64
+
+    def block_checksums(self) -> dict[int, str]:
+        """Digest per row block b = rows [b*BLOCK_ROWS, (b+1)*BLOCK_ROWS).
+        Only blocks with set bits appear; digests cover (row id, sorted
+        set-column ids) pairs in row order — representation-independent
+        AND proportional to set bits, so a million sparse rows hash
+        their columns, not a million dense 128 KiB decodes."""
+        import hashlib
+        acc: dict[int, "hashlib._Hash"] = {}
+        for r in self.row_ids:
+            b = r // self.BLOCK_ROWS
+            h = acc.get(b)
+            if h is None:
+                h = acc[b] = hashlib.blake2b(digest_size=16)
+            h.update(int(r).to_bytes(8, "little"))
+            arr = self._sparse.get(r)
+            if arr is None:
+                arr = bm.to_columns(self._rows[r]).astype(np.int64)
+            h.update(np.ascontiguousarray(arr).tobytes())
+        return {b: h.hexdigest() for b, h in acc.items()}
+
+    def block_rows(self, block: int) -> dict[int, np.ndarray]:
+        """Packed words of every non-empty row in one block."""
+        lo, hi = block * self.BLOCK_ROWS, (block + 1) * self.BLOCK_ROWS
+        return {r: self.row_words(r) for r in self.row_ids
+                if lo <= r < hi}
+
+    def set_block_rows(self, block: int, rows: dict[int, np.ndarray]):
+        """Replace one block's contents with the owner's rows (repair
+        write path): rows absent from the payload are cleared."""
+        lo, hi = block * self.BLOCK_ROWS, (block + 1) * self.BLOCK_ROWS
+        for r in [r for r in self.row_ids if lo <= r < hi]:
+            if r not in rows:
+                self.set_row_words(r, 0)
+        for r, words in rows.items():
+            assert lo <= int(r) < hi, "row outside block"
+            self.set_row_words(int(r), words)

@@ -1,0 +1,73 @@
+"""On-demand process profiling — the pprof/fgprof endpoint backends.
+
+The reference exposes Go pprof + fgprof at /debug/pprof and
+/debug/fgprof (http_handler.go:493-494).  The Python analogs here:
+
+- :func:`sample_stacks` — a wall-clock stack sampler over ALL threads
+  (fgprof's model: samples blocked time too, not just on-CPU), built
+  on ``sys._current_frames``.  Output is folded-stack lines
+  ("fn_a;fn_b;fn_c N") — the flamegraph interchange format.
+- :func:`heap_snapshot` — tracemalloc top allocation sites (the heap
+  profile analog).  tracemalloc is started on first use and left
+  running so successive snapshots can be compared.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import tracemalloc
+from collections import Counter
+
+
+def sample_stacks(seconds: float = 2.0, hz: int = 100,
+                  max_frames: int = 64) -> str:
+    """Sample every live thread's stack for `seconds` at `hz`.
+
+    Returns folded-stack lines sorted by count (descending), one per
+    distinct stack: ``file:func;file:func;... count``.  The sampling
+    thread itself is excluded.
+    """
+    me = threading.get_ident()
+    counts: Counter[tuple] = Counter()
+    interval = 1.0 / max(1, hz)
+    deadline = time.monotonic() + max(0.0, seconds)
+    n_samples = 0
+    while time.monotonic() < deadline:
+        for tid, top in sys._current_frames().items():
+            if tid == me:
+                continue
+            stack = []
+            f = top
+            while f is not None and len(stack) < max_frames:
+                code = f.f_code
+                stack.append(f"{code.co_filename.rsplit('/', 1)[-1]}"
+                             f":{code.co_name}")
+                f = f.f_back
+            counts[tuple(reversed(stack))] += 1
+        n_samples += 1
+        time.sleep(interval)
+    lines = [f"{';'.join(stack)} {n}"
+             for stack, n in counts.most_common()]
+    header = (f"# wall-clock stack samples: {n_samples} rounds @ {hz}Hz "
+              f"over {seconds}s ({len(counts)} distinct stacks)")
+    return "\n".join([header] + lines) + "\n"
+
+
+def heap_snapshot(top: int = 25) -> str:
+    """Top allocation sites by current size (tracemalloc)."""
+    if not tracemalloc.is_tracing():
+        tracemalloc.start()
+        return ("# tracemalloc just started — call again after some "
+                "work to see allocations\n")
+    snap = tracemalloc.take_snapshot()
+    stats = snap.statistics("lineno")[:top]
+    total = sum(s.size for s in snap.statistics("filename"))
+    lines = [f"# heap: {total / (1 << 20):.1f} MiB traced, "
+             f"top {len(stats)} sites"]
+    for s in stats:
+        fr = s.traceback[0]
+        lines.append(f"{fr.filename.rsplit('/', 1)[-1]}:{fr.lineno} "
+                     f"size={s.size >> 10}KiB count={s.count}")
+    return "\n".join(lines) + "\n"

@@ -150,6 +150,9 @@ class Server:
         r(Route("POST", "/internal/translate/{index}/ids",
                 self._post_translate_ids))
         r(Route("GET", "/internal/shards/max", self._get_shards_max))
+        r(Route("GET", "/internal/shards/{index}",
+                lambda req: self.api.available_shards(
+                    req.vars["index"])))
         r(Route("GET", "/status", lambda req: self.api.status()))
         r(Route("GET", "/info", lambda req: self.api.info()))
         r(Route("GET", "/version", lambda req: self.api.version()))
@@ -160,6 +163,12 @@ class Server:
                 lambda req: metrics.registry.render_json()))
         r(Route("GET", "/login", self._get_login))
         r(Route("GET", "/debug/errors", self._get_debug_errors))
+        # profiling surface (http_handler.go:493-494 pprof/fgprof):
+        # wall-clock stack sampler + heap snapshot + slow-query ring
+        r(Route("GET", "/debug/profile", self._get_debug_profile))
+        r(Route("GET", "/debug/allocs", self._get_debug_allocs))
+        r(Route("GET", "/debug/long-queries",
+                lambda req: self.api.long_queries()))
         r(Route("GET", "/internal/diagnostics", self._get_diagnostics))
         r(Route("GET", "/internal/perf-counters",
                 self._get_perf_counters))
@@ -180,6 +189,40 @@ class Server:
         r(Route("GET", "/index/{index}/dataframe", self._get_dataframe))
         r(Route("POST", "/index/{index}/dataframe/apply",
                 self._post_dataframe_apply))
+        # translation sync + replica repair (holder.go:1488-1715;
+        # fragment.go checksum blocks)
+        r(Route("GET", "/internal/translate/{index}/partitions",
+                lambda req: self.api.translate_partitions(
+                    req.vars["index"])))
+        r(Route("GET",
+                "/internal/translate/{index}/partition/{p}/snapshot",
+                lambda req: self.api.translate_partition_snapshot(
+                    req.vars["index"], int(req.vars["p"]))))
+        r(Route("POST",
+                "/internal/translate/{index}/partition/{p}/restore",
+                lambda req: self.api.translate_restore_partition(
+                    req.vars["index"], int(req.vars["p"]),
+                    req.json())))
+        r(Route("GET",
+                "/internal/translate/{index}/field/{field}/snapshot",
+                lambda req: self.api.field_translate_snapshot(
+                    req.vars["index"], req.vars["field"])))
+        r(Route("GET", "/internal/fragment/{index}/{field}/views",
+                lambda req: self.api.fragment_views(
+                    req.vars["index"], req.vars["field"])))
+        r(Route("GET",
+                "/internal/fragment/{index}/{field}/{view}/{shard}"
+                "/checksums",
+                lambda req: self.api.fragment_checksums(
+                    req.vars["index"], req.vars["field"],
+                    req.vars["view"], int(req.vars["shard"]))))
+        r(Route("GET",
+                "/internal/fragment/{index}/{field}/{view}/{shard}"
+                "/block/{b}",
+                lambda req: self.api.fragment_block(
+                    req.vars["index"], req.vars["field"],
+                    req.vars["view"], int(req.vars["shard"]),
+                    int(req.vars["b"]))))
         r(Route("GET", "/internal/backup/manifest",
                 lambda req: self.api.backup_manifest()))
         r(Route("GET", "/internal/backup/file", self._get_backup_file))
@@ -195,6 +238,21 @@ class Server:
         """Recent captured errors (monitor.go events; /debug surface)."""
         from pilosa_tpu.obs.monitor import global_monitor
         return global_monitor.recent()
+
+    def _get_debug_profile(self, req):
+        """fgprof-style wall-clock stack sample; ?seconds=&hz= bound
+        the collection (defaults 2s @ 100Hz, capped at 30s)."""
+        from pilosa_tpu.obs import profiler
+        seconds = min(30.0, float(req.query.get("seconds", ["2"])[0]))
+        hz = min(1000, int(req.query.get("hz", ["100"])[0]))
+        return RawResponse(profiler.sample_stacks(seconds, hz),
+                           "text/plain")
+
+    def _get_debug_allocs(self, req):
+        """tracemalloc heap snapshot (pprof allocs analog)."""
+        from pilosa_tpu.obs import profiler
+        top = int(req.query.get("top", ["25"])[0])
+        return RawResponse(profiler.heap_snapshot(top), "text/plain")
 
     def _get_diagnostics(self, req):
         from pilosa_tpu import __version__

@@ -20,3 +20,12 @@ WORDS_PER_SHARD = SHARD_WIDTH // BITS_PER_WORD  # 32,768 uint32 words
 BSI_EXISTS_BIT = 0
 BSI_SIGN_BIT = 1
 BSI_OFFSET_BIT = 2
+
+# In-memory hybrid row store threshold (the array/bitmap container
+# split of roaring/container_stash.go:46-85 applied per shard-row):
+# rows with at most this many set bits are held as sorted int64
+# column arrays (8 B/bit); above it they promote to packed uint32
+# words.  8192 puts the crossover at 64 KiB array vs 128 KiB dense
+# for the full 2^20 width.  Shared by models.fragment (in-memory) and
+# storage.shards (compress-on-load).
+SPARSE_MAX = 8192

@@ -64,10 +64,13 @@ class Col:
 
 @dataclass
 class Join:
-    """INNER JOIN clause (sql3 opnestedloops.go nested-loop join)."""
+    """JOIN clause (sql3 opnestedloops.go nested-loop join).  With
+    outer=True it is a LEFT [OUTER] JOIN: unmatched left records
+    survive with NULL right-side values."""
     table: str
     left: "Col"
     right: "Col"
+    outer: bool = False
 
 
 @dataclass
@@ -92,6 +95,22 @@ class InList:
     col: Any
     items: list
     negated: bool = False
+
+
+@dataclass
+class InSelect:
+    """col [NOT] IN (SELECT ...) — uncorrelated subquery semi-join
+    (sql3/planner subquery compilation)."""
+    col: Any
+    select: "Select"
+    negated: bool = False
+
+
+@dataclass
+class SubQuery:
+    """Scalar subquery: (SELECT <one aggregate/column> ...) used as a
+    value in a comparison."""
+    select: "Select"
 
 
 @dataclass
@@ -126,6 +145,21 @@ class SelectItem:
 class OrderBy:
     expr: Any
     desc: bool = False
+
+
+@dataclass
+class BulkInsert:
+    """BULK INSERT ... FROM 'file' WITH FORMAT 'CSV' INPUT 'FILE'
+    (sql3/parser bulk-insert statement, CSV/file subset).  Columns map
+    positionally to CSV fields; header_row skips the first line."""
+    table: str
+    columns: list[str]
+    path: str = ""
+    format: str = "CSV"
+    input: str = "FILE"
+    header_row: bool = False
+    # inline payload for INPUT 'STREAM': rows arrive as literal text
+    payload: str | None = None
 
 
 @dataclass

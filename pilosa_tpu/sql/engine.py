@@ -153,6 +153,8 @@ class SQLEngine:
             return self._show_columns(stmt)
         if isinstance(stmt, ast.Insert):
             return self._insert(stmt)
+        if isinstance(stmt, ast.BulkInsert):
+            return self._bulk_insert(stmt)
         if isinstance(stmt, ast.Delete):
             return self._delete(stmt)
         if isinstance(stmt, ast.Select):
@@ -261,34 +263,113 @@ class SQLEngine:
                 raise SQLError(f"column not found: {c}")
             fields.append(f)
         for row in stmt.rows:
-            col = self._col_id(idx, row[id_pos])
-            if stmt.replace:
-                # full-record replace: drop existing values first
-                from pilosa_tpu.ops import bitmap as bm
-                shard, sc = divmod(col, idx.width)
-                mask = bm.from_columns([sc], idx.width)
-                for f in idx.fields.values():
-                    for v in f.views.values():
-                        frag = v.fragment(shard)
-                        if frag is not None:
-                            frag.clear_columns(mask)
-            for f, v in zip(fields, row):
-                if f is None or v is None:
-                    continue
-                t = f.options.type
-                if t.is_bsi:
-                    f.set_value(col, v)
-                elif t == FieldType.BOOL:
-                    f.set_bit(1 if v else 0, col)
-                else:
-                    vals = v if isinstance(v, list) else [v]
-                    if t == FieldType.MUTEX and len(vals) > 1:
-                        raise SQLError(
-                            f"column {f.name} accepts a single value")
-                    for item in vals:
-                        f.set_bit(self._row_id(f, item, create=True), col)
-            idx.mark_columns_exist([col])
+            self._apply_record(idx, fields, row, id_pos, stmt.replace)
         return SQLResult()
+
+    def _apply_record(self, idx, fields, row, id_pos, replace):
+        """Write one record's values (shared by INSERT / BULK INSERT)."""
+        col = self._col_id(idx, row[id_pos])
+        if replace:
+            # full-record replace: drop existing values first
+            from pilosa_tpu.ops import bitmap as bm
+            shard, sc = divmod(col, idx.width)
+            mask = bm.from_columns([sc], idx.width)
+            for f in idx.fields.values():
+                for v in f.views.values():
+                    frag = v.fragment(shard)
+                    if frag is not None:
+                        frag.clear_columns(mask)
+        for f, v in zip(fields, row):
+            if f is None or v is None:
+                continue
+            t = f.options.type
+            if t.is_bsi:
+                f.set_value(col, v)
+            elif t == FieldType.BOOL:
+                f.set_bit(1 if v else 0, col)
+            else:
+                vals = v if isinstance(v, list) else [v]
+                if t == FieldType.MUTEX and len(vals) > 1:
+                    raise SQLError(
+                        f"column {f.name} accepts a single value")
+                for item in vals:
+                    f.set_bit(self._row_id(f, item, create=True), col)
+        idx.mark_columns_exist([col])
+
+    def _bulk_insert(self, stmt: ast.BulkInsert) -> SQLResult:
+        """BULK INSERT: stream a CSV (file or inline payload) through
+        the same record-apply path as INSERT — the COPY/BULK INSERT
+        ingest statement (sql3/parser bulk insert, CSV subset).
+        Columns map positionally; empty cells are NULL; idset/
+        stringset cells may hold ';'-separated lists."""
+        import csv
+        import io
+
+        idx = self._index(stmt.table)
+        if "_id" not in stmt.columns:
+            raise SQLError("BULK INSERT requires an _id column")
+        id_pos = stmt.columns.index("_id")
+        fields = []
+        for c in stmt.columns:
+            if c == "_id":
+                fields.append(None)
+                continue
+            f = idx.field(c)
+            if f is None:
+                raise SQLError(f"column not found: {c}")
+            fields.append(f)
+
+        def convert(f, text: str):
+            if text == "":
+                return None
+            if f is None:  # _id
+                return text if idx.keys else int(text)
+            t = f.options.type
+            if t == FieldType.INT or t == FieldType.TIMESTAMP:
+                return int(text) if t == FieldType.INT else text
+            if t == FieldType.DECIMAL:
+                from decimal import Decimal
+                return Decimal(text)
+            if t == FieldType.BOOL:
+                return text.strip().lower() in ("1", "true", "t", "yes")
+            if ";" in text:
+                items = text.split(";")
+                return [int(i) if not f.options.keys else i
+                        for i in items]
+            return text if f.options.keys else int(text)
+
+        if stmt.input == "FILE":
+            try:
+                fh = open(stmt.path, newline="")
+            except OSError as exc:
+                raise SQLError(
+                    f"BULK INSERT cannot read {stmt.path!r}: {exc}")
+        else:
+            fh = io.StringIO(stmt.payload or "")
+        n = 0
+        with fh:
+            reader = csv.reader(fh)
+            for i, raw in enumerate(reader):
+                if i == 0 and stmt.header_row:
+                    continue
+                if not raw:
+                    continue
+                if len(raw) != len(stmt.columns):
+                    raise SQLError(
+                        f"CSV row {i + 1} has {len(raw)} fields, "
+                        f"expected {len(stmt.columns)}")
+                try:
+                    row = [convert(f, cell.strip())
+                           for f, cell in zip(fields, raw)]
+                except (ValueError, ArithmeticError) as exc:
+                    raise SQLError(
+                        f"CSV row {i + 1}: bad value ({exc})")
+                if row[id_pos] is None:
+                    raise SQLError(f"CSV row {i + 1} has empty _id")
+                self._apply_record(idx, fields, row, id_pos,
+                                   replace=False)
+                n += 1
+        return SQLResult(schema=[("rows_inserted", "int")], rows=[(n,)])
 
     def _row_id(self, f, v, create=False):
         if isinstance(v, str):
@@ -341,12 +422,30 @@ class SQLEngine:
             return Call("Not", children=[self._where(idx, e.expr)])
         if isinstance(e, ast.InList):
             return self._in_list(idx, e)
+        if isinstance(e, ast.InSelect):
+            # uncorrelated IN-subquery: materialize the subquery's
+            # single column, then compile as an IN list (the semi-join
+            # shape of sql3/planner subquery compilation)
+            vals = self._subquery_column(e.select)
+            if e.negated and any(v is None for v in vals):
+                # strict SQL: NOT IN against a list containing NULL is
+                # never TRUE (UNKNOWN for non-matches) -> empty result
+                return Call("ConstRow", args={"columns": []})
+            return self._in_list(idx, ast.InList(
+                e.col, [v for v in vals if v is not None],
+                negated=e.negated))
         if isinstance(e, ast.Between):
             name = self._col_name(e.col)
             lo = e.lo.value if isinstance(e.lo, ast.Lit) else e.lo
             hi = e.hi.value if isinstance(e.hi, ast.Lit) else e.hi
-            node = Call("Row", args={name: Condition("><", [lo, hi])})
-            return Call("Not", children=[node]) if e.negated else node
+            if e.negated:
+                # strict SQL: NULL NOT BETWEEN x AND y is UNKNOWN ->
+                # excluded.  The range union stays within not-null
+                # rows, unlike Not() which would admit NULLs.
+                return Call("Union", children=[
+                    Call("Row", args={name: Condition("<", lo)}),
+                    Call("Row", args={name: Condition(">", hi)})])
+            return Call("Row", args={name: Condition("><", [lo, hi])})
         if isinstance(e, ast.IsNull):
             return self._is_null(idx, e)
         raise SQLError(f"unsupported WHERE expression {e!r}")
@@ -356,9 +455,32 @@ class SQLEngine:
             raise SQLError(f"expected column, got {e!r}")
         return e.name
 
+    def _subquery_column(self, sub: ast.Select) -> list:
+        """Execute an uncorrelated subquery; must yield one column."""
+        res = self._select(sub)
+        if len(res.schema) != 1:
+            raise SQLError("subquery must select exactly one column")
+        return [r[0] for r in res.rows]
+
+    def _scalar_subquery(self, sub: ast.Select):
+        """Scalar subquery: one column, at most one row (NULL if none)."""
+        vals = self._subquery_column(sub)
+        if len(vals) > 1:
+            raise SQLError("scalar subquery returned more than one row")
+        return vals[0] if vals else None
+
     def _comparison(self, idx, e: ast.BinOp) -> Call:
-        # normalize literal-on-left
+        # normalize literal-on-left; resolve scalar subqueries first
         left, right, op = e.left, e.right, e.op
+        if isinstance(left, ast.SubQuery) or isinstance(right, ast.SubQuery):
+            if isinstance(left, ast.SubQuery):
+                left = ast.Lit(self._scalar_subquery(left.select))
+            if isinstance(right, ast.SubQuery):
+                right = ast.Lit(self._scalar_subquery(right.select))
+            # comparison with a NULL scalar is UNKNOWN -> matches nothing
+            for side in (left, right):
+                if isinstance(side, ast.Lit) and side.value is None:
+                    return Call("ConstRow", args={"columns": []})
         if isinstance(left, ast.Lit) and isinstance(right, ast.Col):
             left, right = right, left
             op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
@@ -415,8 +537,15 @@ class SQLEngine:
             if f.options.type.is_bsi:
                 children = [Call("Row", args={name: Condition("==", v)})
                             for v in e.items]
-            else:
-                children = [Call("Row", args={name: v}) for v in e.items]
+                node = Call("Union", children=children)
+                if e.negated:
+                    # strict SQL: NULL NOT IN (...) is UNKNOWN ->
+                    # excluded, so gate the complement on not-null
+                    return Call("Intersect", children=[
+                        Call("Row", args={name: Condition("!=", None)}),
+                        Call("Not", children=[node])])
+                return node
+            children = [Call("Row", args={name: v}) for v in e.items]
             node = Call("Union", children=children)
         return Call("Not", children=[node]) if e.negated else node
 
@@ -561,6 +690,12 @@ class SQLEngine:
 
     def _select_grouped(self, idx, stmt, items, filt) -> SQLResult:
         group_cols = stmt.group_by
+        if any(self._field(idx, g).options.type.is_bsi
+               for g in group_cols):
+            # PQL GroupBy(Rows(...)) only walks set-like fields; int/
+            # decimal/timestamp group columns take the generic hashed
+            # path (sql3's non-pushdown PlanOpGroupBy)
+            return self._select_grouped_generic(idx, stmt, items, filt)
         # validate items: group cols or aggregates
         schema, getters = [], []
         sum_field = None
@@ -624,6 +759,106 @@ class SQLEngine:
         rows = self._limit_rows(stmt, rows)
         return SQLResult(schema=schema, rows=rows)
 
+    def _select_grouped_generic(self, idx, stmt, items, filt) -> SQLResult:
+        """Hashed GROUP BY over materialized record values — the
+        fallback when a group column is BSI (sql3 planner's generic
+        PlanOpGroupBy instead of the PQL GroupBy pushdown)."""
+        group_cols = stmt.group_by
+        schema, getters = [], []
+        agg_specs = []  # (func, col or None)
+        for it in items:
+            e = it.expr
+            if isinstance(e, ast.Col):
+                if e.name not in group_cols:
+                    raise SQLError(
+                        f"column {e.name} must appear in GROUP BY")
+                f = self._field(idx, e.name)
+                schema.append((self._name_of(it), _sql_type(f)))
+                getters.append(("group", group_cols.index(e.name)))
+            elif isinstance(e, ast.Agg):
+                if e.func == "count" and e.arg is None:
+                    schema.append((self._name_of(it), "int"))
+                    getters.append(("agg", len(agg_specs)))
+                    agg_specs.append(("count*", None))
+                elif e.func in ("count", "sum", "avg", "min", "max"):
+                    schema.append((self._name_of(it),
+                                   self._agg_type(idx, e)))
+                    getters.append(("agg", len(agg_specs)))
+                    agg_specs.append((e.func, e.arg.name))
+                else:
+                    raise SQLError(
+                        f"aggregate {e.func} not supported with GROUP BY")
+            else:
+                raise SQLError("invalid GROUP BY projection")
+
+        groups: dict[tuple, list] = {}
+        for rid in self._table_ids(idx, filt):
+            key = tuple(self._group_key(idx, g, rid) for g in group_cols)
+            groups.setdefault(key, []).append(rid)
+
+        rows = []
+        for key, rids in groups.items():
+            agg_vals = []
+            for func, col in agg_specs:
+                if func == "count*":
+                    agg_vals.append(len(rids))
+                    continue
+                vals = [self._cell_value(idx, col, r) for r in rids]
+                vals = [v for v in vals if v is not None]
+                if func == "count":
+                    agg_vals.append(len(vals))
+                elif not vals:
+                    agg_vals.append(None)
+                elif func == "sum":
+                    agg_vals.append(sum(vals))
+                elif func == "avg":
+                    agg_vals.append(sum(vals) / len(vals))
+                elif func == "min":
+                    agg_vals.append(min(vals))
+                elif func == "max":
+                    agg_vals.append(max(vals))
+            if stmt.having is not None and not self._generic_having_ok(
+                    stmt.having, len(rids), agg_specs, agg_vals):
+                continue
+            out = []
+            for kind, i in getters:
+                out.append(key[i] if kind == "group" else agg_vals[i])
+            rows.append(tuple(out))
+        rows = self._order_rows(stmt, schema, rows)
+        rows = self._limit_rows(stmt, rows)
+        return SQLResult(schema=schema, rows=rows)
+
+    def _group_key(self, idx, col: str, rid: int):
+        v = self._cell_value(idx, col, rid)
+        return tuple(sorted(v)) if isinstance(v, list) else v
+
+    def _generic_having_ok(self, having, count, agg_specs, agg_vals):
+        if not (isinstance(having, ast.BinOp)
+                and isinstance(having.left, ast.Agg)
+                and isinstance(having.right, ast.Lit)):
+            raise SQLError(
+                "HAVING supports COUNT(*)/SUM(col) comparisons")
+        a = having.left
+        if a.func == "count" and a.arg is None:
+            val = count
+        else:
+            for i, (func, col) in enumerate(agg_specs):
+                if func == a.func and col == (a.arg.name if a.arg
+                                              else None):
+                    val = agg_vals[i]
+                    break
+            else:
+                raise SQLError(
+                    "HAVING aggregate must appear in the projection")
+        if val is None:
+            return False
+        import operator
+        ops = {"=": operator.eq, "!=": operator.ne, "<": operator.lt,
+               "<=": operator.le, ">": operator.gt, ">=": operator.ge}
+        if having.op not in ops:
+            raise SQLError(f"HAVING operator {having.op!r} unsupported")
+        return ops[having.op](val, having.right.value)
+
     def _compile_having(self, having) -> Call:
         # HAVING COUNT(*) > n / SUM(col) > n → Condition(count/sum OP n)
         if isinstance(having, ast.BinOp) and \
@@ -664,9 +899,16 @@ class SQLEngine:
                 self._field(idx, n)  # validate before executing
         non_id = [n for n in names if n != "_id"]
         order_col = None
-        if stmt.order_by:
-            if len(stmt.order_by) != 1:
-                raise SQLError("single ORDER BY column supported")
+        multi_order = stmt.order_by and len(stmt.order_by) > 1
+        if multi_order:
+            # multi-key: materialize unordered, then the shared host
+            # sort (_order_rows) applies every key; keys must be
+            # projected.  LIMIT stays host-side (applies after sort).
+            for ob in stmt.order_by:
+                if self._col_name(ob.expr) not in names:
+                    raise SQLError(
+                        "multi-key ORDER BY columns must be projected")
+        elif stmt.order_by:
             ob = stmt.order_by[0]
             order_col = self._col_name(ob.expr)
         # pushdown: ORDER BY on BSI column → Sort; plain LIMIT → Limit.
@@ -693,8 +935,8 @@ class SQLEngine:
                 host_sort = True
         elif order_col == "_id":
             host_sort = stmt.order_by[0].desc  # asc is natural order
-        if not host_sort and order_col is None and stmt.limit is not None \
-                and not stmt.distinct:
+        if not host_sort and not multi_order and order_col is None \
+                and stmt.limit is not None and not stmt.distinct:
             inner = Call("Limit", args={
                 "limit": stmt.limit + (stmt.offset or 0)}, children=[filt])
 
@@ -749,6 +991,8 @@ class SQLEngine:
             nn.sort(key=lambda i: sort_keys[i],
                     reverse=stmt.order_by[0].desc)
             rows = [rows[i] for i in nn + nulls]
+        if multi_order:
+            rows = self._order_rows(stmt, schema, rows)
         if stmt.distinct:
             # spill-backed dedup: in-memory set until the threshold,
             # then the on-disk extendible hash (sql3 opdistinct over
@@ -808,11 +1052,13 @@ class SQLEngine:
         return [int(c) for c in res.columns()]
 
     def _select_join(self, stmt: ast.Select) -> SQLResult:
-        """Nested-loop INNER JOIN of two tables on column equality.
-        The right side builds a hash of join-key -> record ids; left
-        records probe it (the hashed refinement of opnestedloops.go's
-        loop).  WHERE may reference either table's columns and is
-        evaluated on the joined rows."""
+        """Nested-loop INNER / LEFT OUTER JOIN of two tables on column
+        equality.  The right side builds a hash of join-key -> record
+        ids; left records probe it (the hashed refinement of
+        opnestedloops.go's loop; LEFT JOIN per opnestedloops.go's
+        outer variant: a left record with no key match survives once
+        with NULL right-side values, and WHERE evaluates AFTER the
+        join).  WHERE may reference either table's columns."""
         if len(stmt.joins) != 1:
             raise SQLError("a single JOIN is supported")
         if stmt.group_by or stmt.having or stmt.distinct:
@@ -904,6 +1150,8 @@ class SQLEngine:
         cell_cache: dict = {}
 
         def cell(table, idx_, col, record_id):
+            if record_id is None:  # unmatched LEFT JOIN right side
+                return None
             key = (table, col, record_id)
             if key not in cell_cache:
                 cell_cache[key] = self._cell_value(idx_, col, record_id)
@@ -924,20 +1172,27 @@ class SQLEngine:
         count_only = items and items[0][2] == "count(*)" and \
             len(items) == 1
         n = 0
+        outer = join.outer
+
+        def emit(lid, rid):
+            nonlocal n
+            if count_only:
+                n += 1
+            else:
+                rows.append(tuple(joined_value(t, c, lid, rid)
+                                  for _, t, c in items))
+
         for lid in left_ids:
             lv = self._cell_value(lidx, jl.name, lid)
-            if lv is None:
-                continue
-            for key in (lv if isinstance(lv, list) else [lv]):
-                for rid in rmap.get(key, ()):
-                    if not where_ok(lid, rid):
-                        continue
-                    if count_only:
-                        n += 1
-                    else:
-                        rows.append(tuple(
-                            joined_value(t, c, lid, rid)
-                            for _, t, c in items))
+            any_key_match = False
+            if lv is not None:
+                for key in (lv if isinstance(lv, list) else [lv]):
+                    for rid in rmap.get(key, ()):
+                        any_key_match = True
+                        if where_ok(lid, rid):
+                            emit(lid, rid)
+            if outer and not any_key_match and where_ok(lid, None):
+                emit(lid, None)
         if count_only:
             return SQLResult(schema=[(items[0][0], "int")], rows=[(n,)])
         # typed schema: resolve each projected column's SQL type
@@ -958,8 +1213,11 @@ class SQLEngine:
             return e.value
         if isinstance(e, ast.Col):
             t = e.table or lname
+            rec = lid if t == lname else rid
+            if rec is None:  # unmatched LEFT JOIN side
+                return None
             return self._cell_value(lidx if t == lname else ridx,
-                                    e.name, lid if t == lname else rid)
+                                    e.name, rec)
         ev = lambda x: self._eval_join_expr(x, lname, rname, lidx,
                                             ridx, lid, rid)
         if isinstance(e, ast.BinOp):
@@ -991,31 +1249,34 @@ class SQLEngine:
         raise SQLError(f"unsupported WHERE form in JOIN: {e!r}")
 
     def _order_rows(self, stmt, schema, rows):
+        """Multi-key ORDER BY: stable sorts applied last-key-first,
+        NULLS LAST within each key's direction."""
         if not stmt.order_by:
             return rows
-        if len(stmt.order_by) != 1:
-            raise SQLError("single ORDER BY column supported")
-        ob = stmt.order_by[0]
-        if isinstance(ob.expr, ast.Col) and ob.expr.table:
-            name = f"{ob.expr.table}.{ob.expr.name}"
-        elif isinstance(ob.expr, ast.Col):
-            name = ob.expr.name
-        else:
-            name = self._name_of(ast.SelectItem(ob.expr))
         names = [s[0] for s in schema]
-        # unqualified names also match a unique qualified projection
-        matches = [i for i, n in enumerate(names)
-                   if n == name or ("." not in name
-                                    and n.split(".")[-1] == name)]
-        if len(matches) != 1:
-            raise SQLError(f"ORDER BY column {name!r} not in projection"
-                           if not matches else
-                           f"ORDER BY column {name!r} is ambiguous")
-        i = matches[0]
-        nn = [r for r in rows if r[i] is not None]
-        nulls = [r for r in rows if r[i] is None]
-        nn.sort(key=lambda r: r[i], reverse=ob.desc)
-        return nn + nulls
+        rows = list(rows)
+        for ob in reversed(stmt.order_by):
+            if isinstance(ob.expr, ast.Col) and ob.expr.table:
+                name = f"{ob.expr.table}.{ob.expr.name}"
+            elif isinstance(ob.expr, ast.Col):
+                name = ob.expr.name
+            else:
+                name = self._name_of(ast.SelectItem(ob.expr))
+            # unqualified names also match a unique qualified projection
+            matches = [i for i, n in enumerate(names)
+                       if n == name or ("." not in name
+                                        and n.split(".")[-1] == name)]
+            if len(matches) != 1:
+                raise SQLError(
+                    f"ORDER BY column {name!r} not in projection"
+                    if not matches else
+                    f"ORDER BY column {name!r} is ambiguous")
+            i = matches[0]
+            nn = [r for r in rows if r[i] is not None]
+            nulls = [r for r in rows if r[i] is None]
+            nn.sort(key=lambda r: r[i], reverse=ob.desc)
+            rows = nn + nulls
+        return rows
 
     def _limit_rows(self, stmt, rows):
         off = stmt.offset or 0

@@ -51,6 +51,14 @@ class Parser:
     def expect_kw(self, word) -> Token:
         return self.expect("keyword", word)
 
+    def ctx_kw(self, word) -> Token | None:
+        """Contextual keyword: lexes as an ident (so it stays usable
+        as a column/table name) but acts as a keyword here."""
+        t = self.peek()
+        if t.kind == "ident" and t.value.lower() == word:
+            return self.next()
+        return None
+
     # -- statements -----------------------------------------------------
 
     def parse(self):
@@ -72,6 +80,8 @@ class Parser:
             return self.drop_table()
         if t.value == "show":
             return self.show()
+        if t.value == "bulk":
+            return self.bulk_insert()
         if t.value in ("insert", "replace"):
             return self.insert()
         if t.value == "delete":
@@ -174,6 +184,47 @@ class Parser:
                 break
         return ast.Insert(table, cols, rows, replace=replace)
 
+    def bulk_insert(self):
+        """BULK INSERT INTO t (_id, a, b) FROM '<src>' WITH FORMAT
+        'CSV' INPUT 'FILE'|'STREAM' [HEADER_ROW] (sql3/parser bulk-
+        insert, CSV subset; columns map positionally to CSV fields;
+        INPUT 'STREAM' takes the rows inline as the FROM string)."""
+        self.expect_kw("bulk")
+        self.expect_kw("insert")
+        self.expect_kw("into")
+        table = self.expect("ident").value
+        cols = []
+        self.expect("op", "(")
+        while True:
+            cols.append(self.expect("ident").value)
+            if not self.accept("op", ","):
+                break
+        self.expect("op", ")")
+        self.expect_kw("from")
+        src = self.expect("string").value
+        stmt = ast.BulkInsert(table, cols)
+        self.expect_kw("with")
+        fmt = inp = None
+        while True:
+            if self.ctx_kw("format"):
+                fmt = self.expect("string").value.upper()
+            elif self.ctx_kw("input"):
+                inp = self.expect("string").value.upper()
+            elif self.ctx_kw("header_row"):
+                stmt.header_row = True
+            else:
+                break
+        if fmt != "CSV":
+            raise SQLError("BULK INSERT supports FORMAT 'CSV'")
+        if inp not in ("FILE", "STREAM"):
+            raise SQLError("BULK INSERT supports INPUT 'FILE'|'STREAM'")
+        stmt.format, stmt.input = fmt, inp
+        if inp == "FILE":
+            stmt.path = src
+        else:
+            stmt.payload = src
+        return stmt
+
     def delete(self):
         self.expect_kw("delete")
         self.expect_kw("from")
@@ -201,8 +252,26 @@ class Parser:
         self.expect_kw("from")
         sel.table = self.expect("ident").value
         while True:
+            outer = False
+
+            def _at_left_join() -> bool:
+                # LEFT [OUTER] JOIN with left/outer as contextual
+                # keywords (still valid identifiers elsewhere)
+                t0, t1, t2 = self.peek(), self.peek(1), self.peek(2)
+                if not (t0.kind == "ident" and t0.value.lower() == "left"):
+                    return False
+                if t1.kind == "keyword" and t1.value == "join":
+                    return True
+                return (t1.kind == "ident" and t1.value.lower() == "outer"
+                        and t2.kind == "keyword" and t2.value == "join")
+
             if self.kw("inner"):
                 self.expect_kw("join")
+            elif _at_left_join():
+                self.next()  # left
+                self.ctx_kw("outer")
+                self.expect_kw("join")
+                outer = True
             elif not self.kw("join"):
                 break
             jt = self.expect("ident").value
@@ -213,7 +282,8 @@ class Parser:
                     and isinstance(cond.right, ast.Col)):
                 raise SQLError(
                     "JOIN ON must be column = column equality")
-            sel.joins.append(ast.Join(jt, cond.left, cond.right))
+            sel.joins.append(ast.Join(jt, cond.left, cond.right,
+                                      outer=outer))
         if self.kw("where"):
             sel.where = self.expr()
         if self.kw("group"):
@@ -285,6 +355,11 @@ class Parser:
                     t = self.peek()
             if self.kw("in"):
                 self.expect("op", "(")
+                if self.peek().kind == "keyword" and \
+                        self.peek().value == "select":
+                    sub = self.select()
+                    self.expect("op", ")")
+                    return ast.InSelect(left, sub, negated=negated)
                 items = []
                 while True:
                     items.append(self.literal_value())
@@ -311,6 +386,11 @@ class Parser:
         t = self.peek()
         if t.kind == "op" and t.value == "(":
             self.next()
+            if self.peek().kind == "keyword" and \
+                    self.peek().value == "select":
+                sub = self.select()
+                self.expect("op", ")")
+                return ast.SubQuery(sub)
             e = self.expr()
             self.expect("op", ")")
             return e

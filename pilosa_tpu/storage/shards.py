@@ -86,24 +86,50 @@ class IndexStorage:
 
     def load_rows(self, field: str, view: str, shard: int,
                   width: int) -> dict[int, np.ndarray]:
-        """Read every row of a fragment as packed uint32 word arrays."""
+        """Read every row of a fragment, compressing AS rows complete:
+        a returned int64 array is sorted column ids (rows with at most
+        SPARSE_MAX bits), a uint32 array is packed words.  Peak dense
+        memory is ONE row, so a million near-empty persisted rows load
+        in megabytes — the restore-path half of the hybrid row store
+        (models/fragment.py)."""
+        from pilosa_tpu.ops import bitmap as bm
+        from pilosa_tpu.shardwidth import SPARSE_MAX
+
         nw = width // 32
         tpr = self._tiles_per_row(width)
         rows: dict[int, np.ndarray] = {}
         name = bitmap_name(field, view)
+
+        def finalize(row: int, w: np.ndarray):
+            if int(np.bitwise_count(w).sum()) <= SPARSE_MAX:
+                rows[row] = bm.to_columns(w).astype(np.int64)
+            else:
+                rows[row] = w
+
+        cur_row, cur_w = None, None
         with self.db(shard).begin() as tx:
             if not tx.has_bitmap(name):
                 return rows
             for ckey, tile in tx.items(name):
                 row, t = divmod(ckey, tpr)
-                w = rows.get(row)
-                if w is None:
-                    w = np.zeros(nw, dtype=np.uint32)
-                    rows[row] = w
+                if row != cur_row:
+                    if cur_row is not None:
+                        finalize(cur_row, cur_w)
+                    prev = rows.pop(row, None)  # defensive: reopened row
+                    if prev is None:
+                        cur_w = np.zeros(nw, dtype=np.uint32)
+                    elif prev.dtype == np.int64:
+                        cur_w = bm.from_columns(prev, width)
+                    else:
+                        cur_w = prev
+                    cur_row = row
                 if tpr == 1 and nw < rbf.TILE_WORDS:
-                    w[:] = tile[:nw]
+                    cur_w[:] = tile[:nw]
                 else:
-                    w[t * rbf.TILE_WORDS:(t + 1) * rbf.TILE_WORDS] = tile
+                    cur_w[t * rbf.TILE_WORDS:
+                          (t + 1) * rbf.TILE_WORDS] = tile
+        if cur_row is not None:
+            finalize(cur_row, cur_w)
         return rows
 
     def write_fragments(self, frags) -> None:

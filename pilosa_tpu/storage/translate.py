@@ -167,6 +167,37 @@ class TranslateStore:
     def keys(self) -> list[str]:
         return sorted(self._by_key)
 
+    # -- replication / sync (holder.go:1488-1715 translation syncer) --
+
+    def entries(self) -> list[tuple[int, str]]:
+        """Stable (id, key) listing for snapshot streaming."""
+        with self._lock:
+            return sorted(self._by_id.items())
+
+    def snapshot(self) -> dict:
+        """Serializable full-state snapshot (the analog of the boltdb
+        snapshot writer, translate_boltdb.go), streamed to replicas /
+        rejoining nodes."""
+        with self._lock:
+            return {"index": self.index, "partition": self.partition_id,
+                    "entries": [[i, k] for i, k in sorted(
+                        self._by_id.items())]}
+
+    def restore_snapshot(self, snap: dict):
+        """Replace contents from a snapshot taken on the owner."""
+        with self._lock:
+            self._by_key.clear()
+            self._by_id.clear()
+            self._max_id = 0
+            for i, k in snap.get("entries", []):
+                self._set(int(i), k)
+            if self._log:  # rewrite the persisted log to match
+                self._log.close()
+                with open(self.path, "w") as f:
+                    for i, k in sorted(self._by_id.items()):
+                        f.write(json.dumps({"id": i, "key": k}) + "\n")
+                self._log = open(self.path, "a")
+
 
 class PartitionedTranslator:
     """Index column-key translation across N partition stores
@@ -240,6 +271,24 @@ class PartitionedTranslator:
                     if p not in self._stores:
                         ids.extend(self._store(p).match(predicate))
         return sorted(set(ids))
+
+    def partition_snapshot(self, partition: int) -> dict:
+        """Snapshot ONE partition store for streaming to a peer."""
+        return self._store(partition).snapshot()
+
+    def restore_partition(self, partition: int, snap: dict):
+        self._store(partition).restore_snapshot(snap)
+
+    def nonempty_partitions(self) -> list[int]:
+        with self._lock:
+            out = [p for p, s in self._stores.items() if s.max_id()]
+        if self._path and os.path.isdir(self._path):
+            for fn in os.listdir(self._path):
+                if fn.startswith("keys.") and fn.endswith(".jsonl"):
+                    p = int(fn.split(".")[1])
+                    if p not in out and self._store(p).max_id():
+                        out.append(p)
+        return sorted(out)
 
     def close(self):
         for s in self._stores.values():

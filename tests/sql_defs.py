@@ -1,0 +1,360 @@
+"""Declarative SQL conformance definitions.
+
+Models the reference's table-driven sql3/test/defs suites
+(sql3/test/defs/defs_groupby.go, defs_join.go, defs_subquery tests,
+executed by sql3/sql_test.go:34): each case is pure data — setup SQL,
+one query, and the expected rows — executed by
+tests/test_sql_conformance.py against a fresh engine.
+
+Case tuple: (name, sql, expected) where expected is
+- a list of row tuples  -> compared as a multiset (order-free)
+- ("ordered", [rows])   -> compared in order (ORDER BY cases)
+- ("error", "substr")   -> SQLError whose message contains substr
+- an int                -> single-cell result (scalar shorthand)
+"""
+
+from decimal import Decimal
+
+# Shared schema + data every case starts from.
+SETUP = [
+    """CREATE TABLE orders (
+         _id id, region string, status string, qty int,
+         price decimal(2), tags stringset, paid bool, cust int)""",
+    """INSERT INTO orders (_id, region, status, qty, price, tags, paid, cust)
+       VALUES
+        (1, 'west',  'open',   5,  '10.50', ('a','b'), true,  10),
+        (2, 'west',  'closed', 12, '3.25',  ('b'),     false, 10),
+        (3, 'east',  'open',   7,  '99.99', ('a','c'), true,  20),
+        (4, 'east',  'open',   2,  '1.00',  ('c'),     false, 30),
+        (5, 'north', 'closed', 12, '0.75',  ('a'),     true,  99),
+        (6, 'south', 'open',   null, null,  ('b','c'), true,  20)""",
+    """CREATE TABLE customers (
+         _id id, name string, region string, credit int)""",
+    """INSERT INTO customers (_id, name, region, credit) VALUES
+        (10, 'alice', 'west', 100),
+        (20, 'bob',   'east', 50),
+        (30, 'carol', 'east', 9)""",
+]
+
+D = Decimal
+
+CASES = [
+    # ---- meta / DDL -----------------------------------------------------
+    ("show_tables", "SHOW TABLES", [("customers",), ("orders",)]),
+    ("show_columns_types", "SHOW COLUMNS FROM customers",
+     [("_id", "id"), ("name", "string"), ("region", "string"),
+      ("credit", "int")]),
+    ("create_if_not_exists",
+     "CREATE TABLE IF NOT EXISTS orders (_id id, x int); "
+     "SELECT count(*) FROM orders", 6),
+    ("create_duplicate_errors",
+     "CREATE TABLE orders (_id id, x int)", ("error", "exists")),
+    ("drop_if_exists_missing", "DROP TABLE IF EXISTS nope; SHOW TABLES",
+     [("customers",), ("orders",)]),
+    ("drop_then_gone", "DROP TABLE customers; SHOW TABLES", [("orders",)]),
+    ("unknown_table_errors", "SELECT * FROM nope", ("error", "nope")),
+    ("unknown_column_errors", "SELECT bogus FROM orders",
+     ("error", "bogus")),
+
+    # ---- INSERT ---------------------------------------------------------
+    ("insert_adds_row",
+     "INSERT INTO orders (_id, qty) VALUES (7, 1); "
+     "SELECT count(*) FROM orders", 7),
+    ("insert_or_replace_overwrites",
+     "INSERT OR REPLACE INTO orders (_id, region, qty) "
+     "VALUES (1, 'moved', 3); "
+     "SELECT region, qty FROM orders WHERE _id = 1", [("moved", 3)]),
+    ("replace_clears_old_values",
+     "REPLACE INTO orders (_id, qty) VALUES (1, 8); "
+     "SELECT region FROM orders WHERE _id = 1", [(None,)]),
+    ("insert_arity_mismatch",
+     "INSERT INTO orders (_id, qty) VALUES (9, 1, 2)",
+     ("error", "arity")),
+    ("insert_requires_id",
+     "INSERT INTO orders (qty) VALUES (1)", ("error", "_id")),
+    ("insert_unknown_column",
+     "INSERT INTO orders (_id, nope) VALUES (9, 1)", ("error", "nope")),
+
+    # ---- WHERE: int comparisons ----------------------------------------
+    ("int_eq", "SELECT _id FROM orders WHERE qty = 12", [(2,), (5,)]),
+    ("int_neq", "SELECT _id FROM orders WHERE qty != 12",
+     [(1,), (3,), (4,)]),
+    ("int_lt", "SELECT _id FROM orders WHERE qty < 5", [(4,)]),
+    ("int_lte", "SELECT _id FROM orders WHERE qty <= 5", [(1,), (4,)]),
+    ("int_gt", "SELECT _id FROM orders WHERE qty > 7", [(2,), (5,)]),
+    ("int_gte", "SELECT _id FROM orders WHERE qty >= 7",
+     [(2,), (3,), (5,)]),
+    ("int_literal_on_left", "SELECT _id FROM orders WHERE 7 < qty",
+     [(2,), (5,)]),
+    ("int_between", "SELECT _id FROM orders WHERE qty BETWEEN 5 AND 7",
+     [(1,), (3,)]),
+    ("int_not_between",
+     "SELECT _id FROM orders WHERE qty NOT BETWEEN 5 AND 7",
+     [(2,), (4,), (5,)]),
+    ("is_null_int", "SELECT _id FROM orders WHERE qty IS NULL", [(6,)]),
+    ("is_not_null_int", "SELECT _id FROM orders WHERE qty IS NOT NULL",
+     [(1,), (2,), (3,), (4,), (5,)]),
+    ("is_null_string", "SELECT _id FROM orders WHERE region IS NULL", []),
+
+    # ---- WHERE: IN / LIKE ----------------------------------------------
+    ("in_int", "SELECT _id FROM orders WHERE qty IN (2, 5)", [(1,), (4,)]),
+    # strict SQL: NULL NOT IN (...) is UNKNOWN, so row 6 is excluded
+    ("not_in_int", "SELECT _id FROM orders WHERE qty NOT IN (2, 5, 7)",
+     [(2,), (5,)]),
+    ("in_string", "SELECT _id FROM orders WHERE region IN ('east','north')",
+     [(3,), (4,), (5,)]),
+    ("like_suffix", "SELECT _id FROM orders WHERE region LIKE '%st'",
+     [(1,), (2,), (3,), (4,)]),
+    ("like_prefix", "SELECT _id FROM orders WHERE region LIKE 'we%'",
+     [(1,), (2,)]),
+    ("like_underscore", "SELECT _id FROM orders WHERE region LIKE '_est'",
+     [(1,), (2,)]),
+    ("not_like", "SELECT _id FROM orders WHERE region NOT LIKE '%st'",
+     [(5,), (6,)]),
+
+    # ---- WHERE: bool / decimal / string / sets / _id --------------------
+    ("bool_true", "SELECT _id FROM orders WHERE paid = true",
+     [(1,), (3,), (5,), (6,)]),
+    ("bool_neq", "SELECT _id FROM orders WHERE paid != true",
+     [(2,), (4,)]),
+    ("decimal_lt", "SELECT _id FROM orders WHERE price < 2",
+     [(4,), (5,)]),
+    ("decimal_gte", "SELECT _id FROM orders WHERE price >= 10.50",
+     [(1,), (3,)]),
+    ("decimal_eq", "SELECT _id FROM orders WHERE price = 3.25", [(2,)]),
+    ("decimal_between",
+     "SELECT _id FROM orders WHERE price BETWEEN 1 AND 11",
+     [(1,), (2,), (4,)]),
+    ("string_eq", "SELECT _id FROM orders WHERE status = 'open'",
+     [(1,), (3,), (4,), (6,)]),
+    ("string_neq", "SELECT _id FROM orders WHERE status != 'open'",
+     [(2,), (5,)]),
+    ("set_membership", "SELECT _id FROM orders WHERE tags = 'a'",
+     [(1,), (3,), (5,)]),
+    ("set_not_member", "SELECT _id FROM orders WHERE tags != 'a'",
+     [(2,), (4,), (6,)]),
+    ("set_in", "SELECT _id FROM orders WHERE tags IN ('a', 'c')",
+     [(1,), (3,), (4,), (5,), (6,)]),
+    ("id_eq", "SELECT region FROM orders WHERE _id = 3", [("east",)]),
+    ("id_neq", "SELECT count(*) FROM orders WHERE _id != 3", 5),
+    ("id_in", "SELECT _id FROM orders WHERE _id IN (1, 4, 999)",
+     [(1,), (4,)]),
+
+    # ---- logical combinators -------------------------------------------
+    ("and_", "SELECT _id FROM orders WHERE region = 'east' AND paid = true",
+     [(3,)]),
+    ("or_", "SELECT _id FROM orders WHERE qty = 2 OR qty = 5",
+     [(1,), (4,)]),
+    ("not_", "SELECT _id FROM orders WHERE NOT status = 'open'",
+     [(2,), (5,)]),
+    ("precedence_and_over_or",
+     "SELECT _id FROM orders "
+     "WHERE region = 'west' AND qty = 5 OR region = 'north'",
+     [(1,), (5,)]),
+    ("parens_override",
+     "SELECT _id FROM orders "
+     "WHERE region = 'west' AND (qty = 5 OR qty = 12)",
+     [(1,), (2,)]),
+
+    # ---- aggregates -----------------------------------------------------
+    ("count_star", "SELECT count(*) FROM orders", 6),
+    ("count_col_skips_null", "SELECT count(qty) FROM orders", 5),
+    ("count_distinct_int", "SELECT count(distinct qty) FROM orders", 4),
+    ("count_distinct_string",
+     "SELECT count(distinct status) FROM orders", 2),
+    ("sum_int", "SELECT sum(qty) FROM orders", 38),
+    ("min_int", "SELECT min(qty) FROM orders", 2),
+    ("max_int", "SELECT max(qty) FROM orders", 12),
+    ("sum_decimal", "SELECT sum(price) FROM orders",
+     [(D("115.49"),)]),
+    ("min_decimal", "SELECT min(price) FROM orders", [(D("0.75"),)]),
+    ("agg_with_where",
+     "SELECT sum(qty) FROM orders WHERE region = 'west'", 17),
+    ("count_where_empty",
+     "SELECT count(*) FROM orders WHERE qty > 100", 0),
+
+    # ---- GROUP BY / HAVING ---------------------------------------------
+    ("groupby_count",
+     "SELECT status, count(*) FROM orders GROUP BY status",
+     [("open", 4), ("closed", 2)]),
+    ("groupby_sum",
+     "SELECT region, sum(qty) FROM orders GROUP BY region",
+     [("west", 17), ("east", 9), ("north", 12), ("south", None)]),
+    ("groupby_two_cols",
+     "SELECT region, status, count(*) FROM orders "
+     "GROUP BY region, status",
+     [("west", "open", 1), ("west", "closed", 1), ("east", "open", 2),
+      ("north", "closed", 1), ("south", "open", 1)]),
+    # the NULL group is a real SQL group (generic hashed path)
+    ("groupby_int_col",
+     "SELECT qty, count(*) FROM orders GROUP BY qty",
+     [(2, 1), (5, 1), (7, 1), (12, 2), (None, 1)]),
+    ("groupby_where",
+     "SELECT status, count(*) FROM orders WHERE region = 'east' "
+     "GROUP BY status", [("open", 2)]),
+    ("groupby_having_count",
+     "SELECT status, count(*) FROM orders GROUP BY status "
+     "HAVING count(*) > 2", [("open", 4)]),
+    ("groupby_having_sum",
+     "SELECT region, sum(qty) FROM orders GROUP BY region "
+     "HAVING sum(qty) >= 12", [("west", 17), ("north", 12)]),
+    ("groupby_set_column",
+     "SELECT tags, count(*) FROM orders GROUP BY tags",
+     [("a", 3), ("b", 3), ("c", 3)]),
+
+    # ---- ORDER BY / LIMIT / OFFSET / DISTINCT ---------------------------
+    ("order_by_asc",
+     "SELECT _id FROM orders WHERE qty IS NOT NULL ORDER BY qty",
+     ("ordered", [(4,), (1,), (3,), (2,), (5,)])),
+    ("order_by_desc",
+     "SELECT _id, qty FROM orders WHERE qty >= 7 ORDER BY qty DESC, _id",
+     ("ordered", [(2, 12), (5, 12), (3, 7)])),
+    ("order_by_string",
+     "SELECT region FROM orders WHERE _id IN (1, 3, 5) ORDER BY region",
+     ("ordered", [("east",), ("north",), ("west",)])),
+    ("limit_", "SELECT _id FROM orders ORDER BY _id LIMIT 2",
+     ("ordered", [(1,), (2,)])),
+    ("limit_offset", "SELECT _id FROM orders ORDER BY _id LIMIT 2 OFFSET 3",
+     ("ordered", [(4,), (5,)])),
+    ("distinct_string", "SELECT DISTINCT status FROM orders",
+     [("closed",), ("open",)]),
+    ("distinct_int", "SELECT DISTINCT qty FROM orders",
+     [(2,), (5,), (7,), (12,)]),
+    ("distinct_with_where",
+     "SELECT DISTINCT region FROM orders WHERE paid = true",
+     [("east",), ("north",), ("south",), ("west",)]),
+
+    # ---- projections ----------------------------------------------------
+    ("select_columns",
+     "SELECT region, qty FROM orders WHERE _id = 2", [("west", 12)]),
+    # '*' expands to _id + fields in name order (Index.public_fields)
+    ("select_star_shape",
+     "SELECT * FROM orders WHERE _id = 4",
+     [(4, 30, False, D("1.00"), 2, "east", "open", ["c"])]),
+    ("select_alias",
+     "SELECT qty AS n FROM orders WHERE _id = 1", [(5,)]),
+    ("empty_result", "SELECT _id FROM orders WHERE region = 'mars'", []),
+
+    # ---- JOIN -----------------------------------------------------------
+    ("inner_join_basic",
+     "SELECT orders._id, customers.name FROM orders "
+     "INNER JOIN customers ON orders.cust = customers._id",
+     [(1, "alice"), (2, "alice"), (3, "bob"), (4, "carol"),
+      (6, "bob")]),
+    ("inner_join_where_right",
+     "SELECT orders._id FROM orders "
+     "INNER JOIN customers ON orders.cust = customers._id "
+     "WHERE customers.region = 'east'", [(3,), (4,), (6,)]),
+    ("inner_join_where_both",
+     "SELECT orders._id FROM orders "
+     "JOIN customers ON orders.cust = customers._id "
+     "WHERE customers.credit >= 50 AND orders.paid = true",
+     [(1,), (3,), (6,)]),
+    ("inner_join_count",
+     "SELECT count(*) FROM orders "
+     "INNER JOIN customers ON orders.cust = customers._id", 5),
+    ("left_join_keeps_unmatched",
+     "SELECT orders._id, customers.name FROM orders "
+     "LEFT JOIN customers ON orders.cust = customers._id",
+     [(1, "alice"), (2, "alice"), (3, "bob"), (4, "carol"),
+      (5, None), (6, "bob")]),
+    ("left_outer_join_spelled",
+     "SELECT count(*) FROM orders "
+     "LEFT OUTER JOIN customers ON orders.cust = customers._id", 6),
+    ("left_join_anti_join",
+     "SELECT orders._id FROM orders "
+     "LEFT JOIN customers ON orders.cust = customers._id "
+     "WHERE customers._id IS NULL", [(5,)]),
+    ("left_join_where_filters_nulls",
+     "SELECT orders._id FROM orders "
+     "LEFT JOIN customers ON orders.cust = customers._id "
+     "WHERE customers.credit > 40", [(1,), (2,), (3,), (6,)]),
+    ("join_unqualified_on_errors",
+     "SELECT _id FROM orders JOIN customers ON cust = _id",
+     ("error", "qualified")),
+
+    # ---- subqueries -----------------------------------------------------
+    ("in_subquery",
+     "SELECT _id FROM orders WHERE cust IN "
+     "(SELECT _id FROM customers WHERE region = 'east')",
+     [(3,), (4,), (6,)]),
+    ("not_in_subquery",
+     "SELECT _id FROM orders WHERE cust NOT IN "
+     "(SELECT _id FROM customers WHERE region = 'east')",
+     [(1,), (2,), (5,)]),
+    ("in_subquery_same_table",
+     "SELECT _id FROM orders WHERE qty IN "
+     "(SELECT qty FROM orders WHERE region = 'north')", [(2,), (5,)]),
+    ("scalar_subquery_max",
+     "SELECT _id FROM orders WHERE qty = (SELECT max(qty) FROM orders)",
+     [(2,), (5,)]),
+    ("scalar_subquery_cross_table",
+     "SELECT name FROM customers WHERE credit = "
+     "(SELECT max(credit) FROM customers)", [("alice",)]),
+    ("scalar_subquery_empty_matches_nothing",
+     "SELECT _id FROM orders WHERE qty = "
+     "(SELECT max(qty) FROM orders WHERE region = 'mars')", []),
+    ("scalar_subquery_multirow_errors",
+     "SELECT _id FROM orders WHERE qty = "
+     "(SELECT qty FROM orders WHERE region = 'west')",
+     ("error", "more than one row")),
+    ("subquery_multicolumn_errors",
+     "SELECT _id FROM orders WHERE qty IN "
+     "(SELECT _id, qty FROM orders)", ("error", "one column")),
+
+    # ---- BULK INSERT ----------------------------------------------------
+    ("bulk_insert_stream",
+     "BULK INSERT INTO orders (_id, region, qty) "
+     "FROM '20,mars,9\n21,mars,3' WITH FORMAT 'CSV' INPUT 'STREAM'; "
+     "SELECT _id, qty FROM orders WHERE region = 'mars'",
+     [(20, 9), (21, 3)]),
+    ("bulk_insert_header_row",
+     "BULK INSERT INTO orders (_id, region, qty) "
+     "FROM '_id,region,qty\n22,venus,4' "
+     "WITH FORMAT 'CSV' INPUT 'STREAM' HEADER_ROW; "
+     "SELECT qty FROM orders WHERE region = 'venus'", [(4,)]),
+    ("bulk_insert_null_cells",
+     "BULK INSERT INTO orders (_id, region, qty) "
+     "FROM '23,,7' WITH FORMAT 'CSV' INPUT 'STREAM'; "
+     "SELECT region, qty FROM orders WHERE _id = 23", [(None, 7)]),
+    ("bulk_insert_set_list",
+     "BULK INSERT INTO orders (_id, tags) "
+     "FROM '24,a;c' WITH FORMAT 'CSV' INPUT 'STREAM'; "
+     "SELECT _id FROM orders WHERE tags = 'c'", [(3,), (4,), (6,), (24,)]),
+    ("bulk_insert_reports_count",
+     "BULK INSERT INTO orders (_id, qty) "
+     "FROM '30,1\n31,2\n32,3' WITH FORMAT 'CSV' INPUT 'STREAM'",
+     [(3,)]),
+    ("bulk_insert_arity_errors",
+     "BULK INSERT INTO orders (_id, region, qty) "
+     "FROM '25,x' WITH FORMAT 'CSV' INPUT 'STREAM'", ("error", "fields")),
+    ("bulk_insert_bad_format_errors",
+     "BULK INSERT INTO orders (_id) FROM 'x' "
+     "WITH FORMAT 'JSON' INPUT 'STREAM'", ("error", "CSV")),
+
+    # ---- regression lockdowns (r03 review findings) ----------------------
+    ("multikey_order_limit_sorts_before_limit",
+     "SELECT _id, qty FROM orders WHERE qty IS NOT NULL "
+     "ORDER BY qty, _id LIMIT 2",
+     ("ordered", [(4, 2), (1, 5)])),
+    ("not_in_subquery_with_null_is_empty",
+     "SELECT _id FROM orders WHERE qty NOT IN "
+     "(SELECT qty FROM orders)", []),
+    ("contextual_keywords_stay_identifiers",
+     "CREATE TABLE kwtest (_id id, input int, format string); "
+     "INSERT INTO kwtest (_id, input, format) VALUES (1, 5, 'x'); "
+     "SELECT input, format FROM kwtest", [(5, "x")]),
+    ("bulk_insert_missing_file_is_sql_error",
+     "BULK INSERT INTO orders (_id, qty) FROM '/no/such/file.csv' "
+     "WITH FORMAT 'CSV' INPUT 'FILE'", ("error", "cannot read")),
+
+    # ---- DELETE ---------------------------------------------------------
+    ("delete_where",
+     "DELETE FROM orders WHERE region = 'west'; "
+     "SELECT count(*) FROM orders", 4),
+    ("delete_by_id",
+     "DELETE FROM orders WHERE _id = 6; "
+     "SELECT _id FROM orders WHERE region = 'south'", []),
+    ("delete_all",
+     "DELETE FROM orders; SELECT count(*) FROM orders", 0),
+]

@@ -8,6 +8,7 @@ import datetime as dt
 
 import numpy as np
 import pytest
+from decimal import Decimal
 
 from pilosa_tpu.executor import Executor, RowResult, ValCount
 from pilosa_tpu.executor.executor import ExecError
@@ -238,7 +239,7 @@ def test_decimal_field(holder, ex):
     assert cols(ex.execute("i", "Row(d > 1.499)")[0]) == {1, 3, 4}
     assert cols(ex.execute("i", "Row(d == 1.505)")[0]) == set()
     s = ex.execute("i", "Sum(field=d)")[0]
-    assert s.value == pytest.approx(14.39) and s.count == 4
+    assert s.value == Decimal("14.39") and s.count == 4
 
 
 def test_timestamp_field(holder, ex):

@@ -189,3 +189,51 @@ def test_shards_max(srv):
 def test_404(srv):
     st, d = req(srv, "GET", "/nope")
     assert st == 404
+
+
+def test_debug_profile_endpoints(srv):
+    """pprof/fgprof analogs (http_handler.go:493-494): stack sampler,
+    heap snapshot, slow-query ring."""
+    st, body = req(srv, "GET", "/debug/profile?seconds=0.2&hz=50")
+    assert st == 200 and "stack samples" in body
+    st, body = req(srv, "GET", "/debug/allocs")
+    assert st == 200 and ("tracemalloc" in body or "heap:" in body)
+    # second call must produce a real snapshot
+    st, body = req(srv, "GET", "/debug/allocs")
+    assert st == 200 and "heap:" in body
+
+
+def test_long_query_log(srv):
+    srv.api.long_query_time = 1e-9  # everything is "slow"
+    req(srv, "POST", "/index/lq", {})
+    req(srv, "POST", "/index/lq/field/f", {})
+    req(srv, "POST", "/index/lq/query", {"query": "Set(1, f=1)"})
+    req(srv, "POST", "/index/lq/query", {"query": "Count(Row(f=1))"})
+    st, entries = req(srv, "GET", "/debug/long-queries")
+    assert st == 200 and len(entries) >= 2
+    top = entries[0]
+    assert top["query"] == "Count(Row(f=1))"
+    assert top["runtime_ns"] > 0
+    # span timings ride along (server.go:201 long-query log + spans)
+    assert top["spans"] and top["spans"][0]["name"] == "executor.Execute"
+
+
+def test_long_query_log_off_by_default(srv):
+    req(srv, "POST", "/index/lq2", {})
+    st, entries = req(srv, "GET", "/debug/long-queries")
+    assert st == 200 and entries == []
+
+
+def test_decimal_over_http(srv):
+    """Decimal values serialize as JSON numbers end-to-end."""
+    st, _ = req(srv, "POST", "/sql", {"sql":
+        "CREATE TABLE d (_id id, p decimal(2))"})
+    assert st == 200
+    st, _ = req(srv, "POST", "/sql", {"sql":
+        "INSERT INTO d (_id, p) VALUES (1, '10.50'), (2, '104.99')"})
+    assert st == 200
+    st, r = req(srv, "POST", "/sql", {"sql": "SELECT sum(p) FROM d"})
+    assert st == 200 and r["data"] == [[115.49]], r
+    st, r = req(srv, "POST", "/index/d/query",
+                {"query": "Sum(field=p)"})
+    assert st == 200 and r["results"][0]["value"] == 115.49, r

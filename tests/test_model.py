@@ -30,6 +30,64 @@ def test_fragment_bulk_import():
     assert f.row_count(1) == 1
 
 
+class TestSparseRows:
+    """Hybrid sparse/dense row store (the in-memory analog of the
+    array/bitmap container split, roaring/container_stash.go:46-85):
+    cold sparse rows stay as column arrays, hot rows promote to packed
+    words, and every read/write path agrees across the threshold."""
+
+    def test_sparse_until_threshold(self):
+        from pilosa_tpu.models.fragment import SPARSE_MAX
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+        f = Fragment("i", "f", "standard", 0, width=SHARD_WIDTH)
+        f.set_bit(7, 10)
+        f.set_bit(7, 3)
+        assert f.sparse_row_count == 1
+        assert f.contains(7, 3) and f.contains(7, 10)
+        assert not f.contains(7, 4)
+        assert f.row_count(7) == 2
+        assert np.asarray(f.row_words(7)).sum() > 0
+        # crossing the threshold promotes to dense, same semantics
+        cols = np.arange(SPARSE_MAX + 5) * 17 % SHARD_WIDTH
+        f.import_bits(np.full(cols.size, 9), cols)
+        assert f.sparse_row_count == 1  # row 9 went dense
+        assert f.row_count(9) == np.unique(cols).size
+
+    def test_million_sparse_rows_bounded_memory(self):
+        """1M rows x 2 bits at full shard width stays in tens of MB —
+        dense would need ~128 GiB (VERDICT r02 item 2)."""
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+        f = Fragment("i", "f", "standard", 0, width=SHARD_WIDTH)
+        n = 1_000_000
+        rows = np.repeat(np.arange(n // 2), 2)
+        cols = (rows * 2654435761) % SHARD_WIDTH
+        cols[1::2] = (cols[1::2] + 7) % SHARD_WIDTH
+        f.import_bits(rows, cols)
+        assert f.sparse_row_count == n // 2
+        assert f.memory_bytes() < 200 * (1 << 20)
+        r = int(rows[123456])
+        assert f.row_count(r) in (1, 2)  # 2 unless the cols collided
+
+    def test_clear_and_delete_on_sparse(self):
+        f = Fragment("i", "f", "standard", 0, width=W)
+        f.import_bits([1, 1, 2], [5, 9, 5])
+        assert f.clear_bit(1, 5)
+        assert f.row_count(1) == 1
+        mask = np.zeros(W // 32, dtype=np.uint32)
+        mask[0] = np.uint32(1) << 5  # column 5
+        assert f.clear_columns(mask) is True
+        assert f.row_count(2) == 0
+        assert f.row_ids == [1]
+
+    def test_set_row_words_recompresses(self):
+        f = Fragment("i", "f", "standard", 0, width=W)
+        words = np.zeros(W // 32, dtype=np.uint32)
+        words[3] = 0b1011
+        f.set_row_words(4, words)
+        assert f.sparse_row_count == 1
+        assert f.row_count(4) == 3
+
+
 def test_fragment_set_value_roundtrip():
     f = Fragment("i", "v", "bsig_v", 0, width=W)
     f.set_value(5, 8, 100)

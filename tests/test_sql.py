@@ -2,6 +2,7 @@
 sql3/test/defs suites (defs_groupby.go, defs_join.go, ...)."""
 
 import pytest
+from decimal import Decimal
 
 from pilosa_tpu.models import Holder
 from pilosa_tpu.sql import SQLEngine, SQLError
@@ -103,7 +104,7 @@ def test_aggregates(eng):
     assert rows(eng.query_one(
         "SELECT SUM(qty) FROM orders WHERE region = 'west'")) == [(17,)]
     assert rows(eng.query_one(
-        "SELECT SUM(price) FROM orders"))[0][0] == pytest.approx(115.49)
+        "SELECT SUM(price) FROM orders"))[0][0] == Decimal("115.49")
 
 
 def test_select_rows(eng):
@@ -118,7 +119,7 @@ def test_select_star(eng):
     d = dict(zip([s[0] for s in res.schema], res.rows[0]))
     assert d["_id"] == 1 and d["qty"] == 5 and d["region"] == "west"
     assert sorted(d["tags"]) == ["a", "b"]
-    assert d["price"] == pytest.approx(10.5) and d["paid"] is True
+    assert d["price"] == Decimal("10.50") and d["paid"] is True
 
 
 def test_order_limit_offset(eng):
@@ -156,7 +157,7 @@ def test_group_by_avg(eng):
     res = eng.query_one(
         "SELECT region, AVG(qty) FROM orders GROUP BY region ORDER BY region")
     d = dict(rows(res))
-    assert d["west"] == pytest.approx(8.5)
+    assert d["west"] == Decimal("8.5")
 
 
 def test_select_distinct(eng):

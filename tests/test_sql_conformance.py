@@ -1,0 +1,65 @@
+"""Declarative SQL conformance runner (sql3/sql_test.go:34 analog):
+executes every case in tests/sql_defs.py against a fresh engine."""
+
+import pytest
+
+from pilosa_tpu.models import Holder
+from pilosa_tpu.sql import SQLEngine, SQLError
+
+from tests.sql_defs import CASES, SETUP
+
+W = 1 << 12
+
+
+def fresh_engine() -> SQLEngine:
+    e = SQLEngine(Holder(width=W))
+    for stmt in SETUP:
+        e.query(stmt)
+    return e
+
+
+def canon(rows):
+    """Order-free multiset comparison key (lists inside rows sorted)."""
+    def cell(v):
+        return tuple(sorted(v)) if isinstance(v, list) else v
+    return sorted(
+        (tuple(cell(c) for c in r) for r in rows),
+        key=repr)
+
+
+@pytest.mark.parametrize(
+    "name,sql,expected", CASES, ids=[c[0] for c in CASES])
+def test_sql_conformance(name, sql, expected):
+    eng = fresh_engine()
+    if isinstance(expected, tuple) and expected and expected[0] == "error":
+        with pytest.raises(SQLError) as exc:
+            for res in eng.query(sql):
+                pass
+        assert expected[1].lower() in str(exc.value).lower(), exc.value
+        return
+    results = eng.query(sql)
+    got = results[-1].rows
+    if isinstance(expected, int):
+        assert got == [(expected,)], got
+    elif isinstance(expected, tuple) and expected[0] == "ordered":
+        assert [tuple(r) for r in got] == expected[1], got
+    else:
+        assert canon(got) == canon(expected), (canon(got), canon(expected))
+
+
+def test_case_count_meets_bar():
+    """The suite must stay at or above the 100-case conformance bar."""
+    assert len(CASES) >= 100, len(CASES)
+
+
+def test_bulk_insert_from_file(tmp_path):
+    """INPUT 'FILE' reads a real CSV from disk."""
+    eng = fresh_engine()
+    p = tmp_path / "orders.csv"
+    p.write_text("40,mars,9\n41,mars,3\n")
+    res = eng.query_one(
+        f"BULK INSERT INTO orders (_id, region, qty) FROM '{p}' "
+        "WITH FORMAT 'CSV' INPUT 'FILE'")
+    assert res.rows == [(2,)]
+    got = eng.query_one("SELECT _id FROM orders WHERE region = 'mars'")
+    assert sorted(got.rows) == [(40,), (41,)]

@@ -87,11 +87,29 @@ class TestIDAllocator:
         r2 = a.reserve("idx", b"s2", 5)
         assert r2.start == 10
 
-    def test_new_session_rolls_back(self):
+    def test_concurrent_sessions_disjoint(self):
+        """Concurrent in-flight sessions on one key get DISJOINT
+        ranges (per-clone ingesters, idk/ingest.go:302) and each
+        session's retry still returns its own range."""
+        a = IDAllocator()
+        r1 = a.reserve("idx", b"s1", 10)
+        r2 = a.reserve("idx", b"s2", 5)
+        assert set(r1).isdisjoint(set(r2))
+        assert list(a.reserve("idx", b"s1", 10)) == list(r1)
+        assert list(a.reserve("idx", b"s2", 5)) == list(r2)
+        a.commit("idx", b"s1")
+        a.commit("idx", b"s2", count=2)  # tail 7..10 returns to pool
+        assert a.reserve("idx", b"s3", 1).start == 12
+
+    def test_rollback_returns_tail(self):
         a = IDAllocator()
         a.reserve("idx", b"s1", 10)
-        r2 = a.reserve("idx", b"s2", 5)  # s1 uncommitted -> rolled back
-        assert r2.start == 0
+        a.rollback("idx", b"s1")  # newest reservation: tail returns
+        assert a.reserve("idx", b"s2", 5).start == 0
+        # rollback of a NON-newest reservation abandons its range
+        a.reserve("idx", b"s3", 5)
+        a.rollback("idx", b"s2")
+        assert a.reserve("idx", b"s4", 1).start == 10
 
     def test_persistence(self, tmp_path):
         p = str(tmp_path / "ids.json")
