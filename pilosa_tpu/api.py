@@ -714,6 +714,8 @@ def serialize_result(r) -> object:
              "count": int(r.count)}
         if r.agg is not None:
             d["agg"] = _json_value(r.agg)
+        if r.agg_count is not None:
+            d["agg_count"] = _json_value(r.agg_count)
         return d
     if isinstance(r, SortedRow):
         return {"columns": [int(c) for c in r.columns],

@@ -339,10 +339,17 @@ class ClusterExecutor:
     def __init__(self, node: ClusterNode):
         self.node = node
 
+    @staticmethod
+    def _is_extract_of_sort(call) -> bool:
+        return (call.name == "Extract" and call.children
+                and call.children[0].name == "Sort")
+
     def execute(self, index: str, pql: str) -> dict:
         q = parse(pql)
-        if any(c.name in _WRITE_CALLS for c in q.calls):
+        if any(c.name in _WRITE_CALLS or self._is_extract_of_sort(c)
+               for c in q.calls):
             # writes route per-call by placement (api.go:651-672);
+            # Extract(Sort(...)) needs the order-preserving split —
             # mixed queries evaluate call-by-call in order
             return {"results": [self._execute_call(index, c)
                                 for c in q.calls]}
@@ -362,6 +369,8 @@ class ClusterExecutor:
     def _execute_call(self, index: str, call) -> object:
         """Execute ONE call with placement-aware routing."""
         if call.name not in _WRITE_CALLS:
+            if self._is_extract_of_sort(call):
+                return self._extract_of_sort(index, call)
             snap = self.node.snapshot()
             shards = sorted(self.node.disco.shards(index, ""))
             if not shards:
@@ -390,6 +399,24 @@ class ClusterExecutor:
             raise ClusterError(
                 f"no live node accepted {call.name}: {last_err}")
         return _reduce(call, vals)
+
+    def _extract_of_sort(self, index: str, call) -> dict:
+        """Extract keeps its Sort child's ORDER (executor.go:4762).
+        A cross-node Extract reduce cannot reconstruct it, so merge
+        the Sort first (order-preserving reduce), then Extract those
+        columns and reorder the wire entries to the Sort order."""
+        from pilosa_tpu.pql.ast import Call
+
+        sorted_row = self._execute_call(index, call.children[0])
+        cols = list(sorted_row.get("columns", []))
+        table = self._execute_call(index, Call(
+            "Extract",
+            children=[Call("ConstRow", args={"columns": cols})]
+            + list(call.children[1:])))
+        by_col = {c.get("column"): c
+                  for c in table.get("columns", [])}
+        table["columns"] = [by_col[c] for c in cols if c in by_col]
+        return table
 
     def _execute_col_write(self, index: str, call) -> object:
         """Set/Clear: route to the column's shard owner + replicas and
@@ -575,9 +602,39 @@ def _reduce(call, vals: list):
                     if g.get("agg") is not None:
                         merged[key]["agg"] = (merged[key].get("agg") or 0) \
                             + g["agg"]
+                    if g.get("agg_count") is not None:
+                        merged[key]["agg_count"] = \
+                            (merged[key].get("agg_count") or 0) \
+                            + g["agg_count"]
                 else:
                     merged[key] = dict(g)
         return list(merged.values())
+    if call_name == "Extract":
+        # disjoint shards: concatenate per-column entries, column order
+        out = {"fields": first.get("fields", []), "columns": []}
+        for v in vals:
+            out["columns"].extend(v.get("columns", []))
+        out["columns"].sort(
+            key=lambda c: c.get("column", c.get("column_key", 0)))
+        return out
+    if call_name == "Sort":
+        # k-way merge by (value, column); values arrive pre-sorted per
+        # node, and offset/limit re-applies after the merge.  Two
+        # stable passes (column asc, then value in the requested
+        # direction) keep DESC correct for ANY comparable value type —
+        # timestamps cross the wire as ISO strings, not numbers.
+        pairs = []
+        for v in vals:
+            pairs.extend(zip(v.get("values", []), v.get("columns", [])))
+        desc = bool(call.arg("sort-desc", False))
+        pairs.sort(key=lambda p: p[1])
+        pairs.sort(key=lambda p: p[0], reverse=desc)
+        offset = int(call.arg("offset", 0) or 0)
+        limit = call.arg("limit")
+        end = None if limit is None else offset + int(limit)
+        pairs = pairs[offset:end]
+        return {"columns": [c for _, c in pairs],
+                "values": [x for x, _ in pairs]}
     if isinstance(first, dict) and "columns" in first:
         # Row-like: union of column sets (+ keys when present)
         cols = set()

@@ -101,7 +101,9 @@ class ComputeNode:
                     continue
                 frag = f.view(view, create=True).fragment(
                     shard, create=True)
-                frag._row_mut(row)[:] = words
+                # set_row_words keeps the invalidate/touch protocol
+                # and re-compresses sparse rows on load
+                frag.set_row_words(row, words)
         for e in self.wl.replay(table, shard, from_version=version):
             self._apply_entry(e)
 

@@ -67,6 +67,18 @@ class Controller:
                 self.tables.setdefault(ix["name"], set())
             self._push_directives_locked()
 
+    def drop_table(self, table: str):
+        """Remove a table fleet-wide: schema + shard jobs + fresh
+        directives so workers drop their held shards."""
+        with self._lock:
+            self.tables.pop(table, None)
+            if self.schema:
+                self.schema = {
+                    "indexes": [ix for ix in
+                                self.schema.get("indexes", [])
+                                if ix.get("name") != table]}
+            self._push_directives_locked()
+
     def add_shards(self, table: str, shards):
         """New shards observed (ingest registers them before writing)."""
         with self._lock:

@@ -7,10 +7,15 @@ worker, and reduces the serialized partials (the same cross-node
 reducers the cluster layer uses).
 
 Writes route through the queryer too: each (table, shard) import goes
-to its owning worker, which write-logs before applying.  SQL fronting
-(the reference embeds the sql3 planner here) rides on the same
-orchestration and is deliberately PQL-first in this build; DDL and
-ingest are covered via apply_schema/import_*.
+to its owning worker, which write-logs before applying.
+
+SQL fronting (dax/queryer/queryer.go:134 embeds the sql3 planner over
+a Controller-backed schema API): :meth:`Queryer.sql` runs the SAME
+SQL engine over a schema-only holder whose executor ships each
+compiled PQL call to the compute workers and decodes the wire results
+back into engine result objects — the single-controller analog of the
+reference's orchestrator-backed planner.  DDL and INSERT translate to
+controller schema changes and routed imports.
 """
 
 from __future__ import annotations
@@ -18,6 +23,8 @@ from __future__ import annotations
 from pilosa_tpu.cluster.client import InternalClient
 from pilosa_tpu.cluster.coordinator import _reduce
 from pilosa_tpu.dax.controller import Controller
+from pilosa_tpu.executor.executor import Executor
+from pilosa_tpu.executor.results import deserialize_result
 from pilosa_tpu.pql import parse
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
@@ -37,10 +44,50 @@ def _empty_result(call):
     return {"columns": []}
 
 
+class _RemoteExecutor(Executor):
+    """Executor whose calls execute ON THE COMPUTE WORKERS: every
+    dispatched call serializes back to PQL (Call.to_pql) and rides the
+    queryer's fan-out; wire results decode into engine result objects.
+    The local holder carries SCHEMA ONLY (no fragments), so the SQL
+    engine's planning (WHERE compilation, schema checks, key handling)
+    works unchanged while the data plane stays remote."""
+
+    supports_local_cells = False  # fragments live on the workers
+
+    def __init__(self, holder, queryer: "Queryer"):
+        super().__init__(holder)
+        self.queryer = queryer
+
+    def _execute_call(self, idx, call, shards, pre=None):
+        if call.name == "Extract" and call.children \
+                and call.children[0].name == "Sort":
+            # Extract keeps the Sort child's ORDER (executor.go:4762);
+            # a cross-worker Extract reduce cannot reconstruct it, so
+            # split: merge the Sort remotely (order-preserving
+            # reduce), then Extract those columns and reorder locally
+            # — the same split the local path makes.
+            from pilosa_tpu.pql.ast import Call
+            sorted_row = self._execute_call(idx, call.children[0],
+                                            shards)
+            const = Call("ConstRow",
+                         args={"columns": list(sorted_row.columns)})
+            table = self._execute_call(
+                idx, Call("Extract",
+                          children=[const] + list(call.children[1:])),
+                shards)
+            by_col = {c.get("column"): c for c in table.columns}
+            table.columns = [by_col[c] for c in sorted_row.columns
+                             if c in by_col]
+            return table
+        res = self.queryer.query(idx.name, call.to_pql())["results"][0]
+        return deserialize_result(call, res, idx.width)
+
+
 class Queryer:
     def __init__(self, controller: Controller):
         self.controller = controller
         self._client = InternalClient()
+        self._sql = None  # lazy: schema-only holder + engine
 
     # -- schema / ingest ----------------------------------------------
 
@@ -80,6 +127,130 @@ class Queryer:
                 "values": [values[i] for i in idxs]})
             n += r["imported"]
         return n
+
+    # -- SQL fronting (queryer.go:134 QuerySQL) -------------------------
+
+    def _sql_engine(self):
+        if self._sql is None:
+            from pilosa_tpu.models.holder import Holder
+            from pilosa_tpu.sql import SQLEngine
+            holder = Holder()
+            eng = SQLEngine(holder)
+            eng.executor = _RemoteExecutor(holder, self)
+            self._sql = eng
+        # mirror controller schema into the schema-only holder
+        self._apply_schema_local(self._sql.holder,
+                                 self.controller.schema)
+        return self._sql
+
+    @staticmethod
+    def _apply_schema_local(holder, schema: dict):
+        from pilosa_tpu.models.schema import FieldOptions
+        names = set()
+        for ix in schema.get("indexes", []):
+            names.add(ix["name"])
+            idx = holder.create_index(ix["name"],
+                                      keys=ix.get("keys", False),
+                                      ok_if_exists=True)
+            for f in ix.get("fields", []):
+                idx.create_field(
+                    f["name"],
+                    FieldOptions.from_dict(f.get("options", {})),
+                    ok_if_exists=True)
+        # mirror is authoritative-FROM-controller: drop local indexes
+        # the controller no longer knows (DROP TABLE must not
+        # resurrect on the next mirror refresh)
+        for n in list(holder.indexes):
+            if n not in names:
+                holder.delete_index(n)
+
+    def sql(self, statement: str) -> dict:
+        """SQL over the compute fleet: reads compile locally (schema-
+        only holder) and execute remotely; DDL updates the controller
+        schema; INSERT routes through the shard-owner imports.
+        Returns the API wire shape {"schema": ..., "data": ...}."""
+        from pilosa_tpu.sql import SQLError
+        from pilosa_tpu.sql import ast as sqlast
+        from pilosa_tpu.sql.parser import parse_sql
+
+        stmts = parse_sql(statement)
+        out = None
+        for stmt in stmts:
+            if isinstance(stmt, sqlast.Select) and (
+                    stmt.joins or any(
+                        isinstance(it.expr, sqlast.Col)
+                        and it.expr.table for it in stmt.items)):
+                raise SQLError(
+                    "JOIN is not supported on the DAX queryer yet")
+            eng = self._sql_engine()
+            if isinstance(stmt, sqlast.CreateTable):
+                eng._execute(stmt)  # schema-only holder
+                self.apply_schema({"indexes": eng.holder.schema()})
+                out = {"schema": {"fields": []}, "data": []}
+                continue
+            if isinstance(stmt, sqlast.DropTable):
+                eng._execute(stmt)  # schema-only holder (404 checks)
+                self.controller.drop_table(stmt.name)
+                out = {"schema": {"fields": []}, "data": []}
+                continue
+            if isinstance(stmt, sqlast.Insert):
+                out = self._sql_insert(stmt)
+                continue
+            res = eng._execute(stmt)
+            out = {
+                "schema": {"fields": [
+                    {"name": n, "type": t} for n, t in res.schema]},
+                "data": [list(r) for r in res.rows],
+            }
+        return out
+
+    def _sql_insert(self, stmt) -> dict:
+        """INSERT VALUES routed through owner imports (unkeyed ids)."""
+        from pilosa_tpu.sql.engine import SQLError
+
+        eng = self._sql_engine()
+        idx = eng.holder.index(stmt.table)
+        if idx is None:
+            raise SQLError(f"table not found: {stmt.table}")
+        if idx.keys:
+            raise SQLError(
+                "keyed tables need the cluster path, not DAX yet")
+        if "_id" not in stmt.columns:
+            raise SQLError("INSERT requires an _id column")
+        id_pos = stmt.columns.index("_id")
+        n = 0
+        for row in stmt.rows:
+            col = int(row[id_pos])
+            if stmt.replace:
+                # full-record replace: clear the old values on the
+                # owning worker first (the engine's clear_columns
+                # analog, shipped as a Delete of just this record)
+                self.query(stmt.table,
+                           f"Delete(ConstRow(columns=[{col}]))")
+            for cname, v in zip(stmt.columns, row):
+                if cname == "_id" or v is None:
+                    continue
+                f = idx.field(cname)
+                if f is None:
+                    raise SQLError(f"column not found: {cname}")
+                t = f.options.type
+                if t.is_bsi:
+                    self.import_values(stmt.table, cname, [col],
+                                       [f.value_to_int(v)])
+                elif t.value == "bool":
+                    self.import_bits(stmt.table, cname,
+                                     [1 if v else 0], [col])
+                else:
+                    vals = v if isinstance(v, list) else [v]
+                    for item in vals:
+                        if isinstance(item, str):
+                            raise SQLError(
+                                "keyed rows need the cluster path, "
+                                "not DAX yet")
+                        self.import_bits(stmt.table, cname,
+                                         [int(item)], [col])
+            n += 1
+        return {"schema": {"fields": []}, "data": [[n]]}
 
     # -- reads (orchestrator.go:83 Execute) ----------------------------
 

@@ -91,6 +91,22 @@ class Broker:
             for p, o in offsets.items():
                 cur[p] = max(cur.get(p, 0), o)
 
+    def reset_offsets(self, group: str, topic: str,
+                      offsets: dict[int, int]):
+        """Seek: OVERWRITE checkpoints (kinesis iterator semantics —
+        commit_offsets only moves forward)."""
+        with self._lock:
+            self._group_offsets.setdefault((group, topic), {}).update(
+                {p: int(o) for p, o in offsets.items()})
+
+    def head(self, topic: str, partition: int) -> int:
+        """Next offset to be produced (the high watermark) — O(1)."""
+        with self._lock:
+            parts = self._topics.get(topic)
+            if parts is None:
+                return 0
+            return len(parts[partition])
+
 
 class StreamSource(Source):
     """Consumer-group Source over a Broker (idk/kafka/source.go:34).
@@ -220,3 +236,36 @@ class SQLSource(Source):
             values = {n: row[i] for i, n in enumerate(self._names)
                       if i != idx_id and row[i] is not None}
             yield Record(id=row[idx_id], values=values)
+
+
+class KinesisSource(StreamSource):
+    """Kinesis-semantics source (idk/kinesis): shard iterators with a
+    start position instead of consumer-group offsets.
+
+    - ``TRIM_HORIZON`` starts at the oldest retained record;
+    - ``LATEST`` starts at the stream head (only NEW records);
+    - ``RESUME`` (the checkpointing mode) behaves like StreamSource:
+      continue from the committed checkpoint.
+
+    Checkpoints commit through the same group-offset store, so the
+    at-least-once replay contract matches the Kafka source.
+    """
+
+    def __init__(self, broker: Broker, topic: str, group: str = "g0",
+                 iterator_type: str = "RESUME", poll_batch: int = 500,
+                 schema: dict | None = None):
+        super().__init__(broker, topic, group=group, schema=schema,
+                         poll_batch=poll_batch)
+        it = iterator_type.upper()
+        if it not in ("TRIM_HORIZON", "LATEST", "RESUME"):
+            raise ValueError(f"unknown iterator type {iterator_type!r}")
+        if it == "TRIM_HORIZON":
+            # a true seek: existing checkpoints rewind too
+            self.broker.reset_offsets(
+                group, topic,
+                {p: 0 for p in broker.partitions(topic)})
+        elif it == "LATEST":
+            self.broker.reset_offsets(
+                group, topic,
+                {p: broker.head(topic, p)
+                 for p in broker.partitions(topic)})

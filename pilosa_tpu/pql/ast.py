@@ -100,6 +100,11 @@ class Query:
 def _pql_value(v) -> str:
     if isinstance(v, bool):
         return "true" if v else "false"
+    if v is None:
+        return "null"
+    if isinstance(v, Call):
+        # nested call args (GroupBy aggregate=, filter=, having=)
+        return v.to_pql()
     if isinstance(v, str):
         return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
     if isinstance(v, (list, tuple)):

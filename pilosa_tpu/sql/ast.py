@@ -31,6 +31,26 @@ class DropTable:
 
 
 @dataclass
+class CreateView:
+    """CREATE VIEW name AS SELECT ... (sql3 CREATE VIEW): a stored
+    select re-executed when the view is queried."""
+    name: str
+    select: "Select" = None
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropView:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class ShowViews:
+    pass
+
+
+@dataclass
 class ShowTables:
     pass
 

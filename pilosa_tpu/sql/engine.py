@@ -86,12 +86,21 @@ class SQLEngine:
     def __init__(self, holder: Holder):
         self.holder = holder
         self.executor = Executor(holder)
+        # name -> stored Select (sql3 CREATE VIEW); views re-execute
+        # on read
+        self._views: dict[str, ast.Select] = {}
 
-    @staticmethod
-    def _stmt_access(stmt) -> tuple[str | None, str]:
+    def _stmt_access(self, stmt) -> tuple[str | None, str]:
         """(table, needed-permission) for one statement."""
         if isinstance(stmt, (ast.Select, ast.ShowColumns)):
-            return stmt.table, "read"
+            # a view's access rides its underlying table
+            v = self._views.get(stmt.table) if isinstance(
+                stmt, ast.Select) else None
+            return (v.table if v is not None else stmt.table), "read"
+        if isinstance(stmt, ast.CreateView):
+            return stmt.select.table, "read"
+        if isinstance(stmt, (ast.DropView, ast.ShowViews)):
+            return None, "read"
         if isinstance(stmt, ast.ShowTables):
             return None, "read"
         if isinstance(stmt, (ast.CreateTable, ast.DropTable,
@@ -151,6 +160,26 @@ class SQLEngine:
                              rows=[(n,) for n in names])
         if isinstance(stmt, ast.ShowColumns):
             return self._show_columns(stmt)
+        if isinstance(stmt, ast.CreateView):
+            if stmt.name in self._views or \
+                    self.holder.index(stmt.name) is not None:
+                if stmt.if_not_exists and stmt.name in self._views:
+                    return SQLResult()
+                raise SQLError(f"view or table exists: {stmt.name}")
+            if stmt.select.table in self._views:
+                raise SQLError("views over views are not supported")
+            self._views[stmt.name] = stmt.select
+            return SQLResult()
+        if isinstance(stmt, ast.DropView):
+            if stmt.name not in self._views:
+                if stmt.if_exists:
+                    return SQLResult()
+                raise SQLError(f"view not found: {stmt.name}")
+            del self._views[stmt.name]
+            return SQLResult()
+        if isinstance(stmt, ast.ShowViews):
+            return SQLResult(schema=[("name", "string")],
+                             rows=[(n,) for n in sorted(self._views)])
         if isinstance(stmt, ast.Insert):
             return self._insert(stmt)
         if isinstance(stmt, ast.BulkInsert):
@@ -164,6 +193,8 @@ class SQLEngine:
     # -- DDL ------------------------------------------------------------
 
     def _create_table(self, stmt: ast.CreateTable) -> SQLResult:
+        if stmt.name in self._views:
+            raise SQLError(f"view exists: {stmt.name}")
         if self.holder.index(stmt.name) is not None:
             if stmt.if_not_exists:
                 return SQLResult()
@@ -566,6 +597,8 @@ class SQLEngine:
     # -- SELECT ---------------------------------------------------------
 
     def _select(self, stmt: ast.Select) -> SQLResult:
+        if stmt.table in self._views:
+            return self._select_view(stmt)
         if stmt.joins:
             return self._select_join(stmt)
         self._reject_foreign_quals(stmt)
@@ -597,6 +630,37 @@ class SQLEngine:
                 items[0].expr.name != "_id":
             return self._select_distinct(idx, stmt, items[0], filt)
         return self._select_rows(idx, stmt, items, filt)
+
+    def _select_view(self, stmt: ast.Select) -> SQLResult:
+        """Query a stored view: re-execute its select, then apply the
+        outer projection / ORDER BY / LIMIT by result-column name.
+        Outer WHERE/GROUP BY/aggregates over views are not supported
+        (the reference's planner expands views generally; this subset
+        is documented)."""
+        if stmt.where is not None or stmt.group_by or stmt.joins or \
+                stmt.having is not None or stmt.distinct:
+            raise SQLError(
+                "views support projection/ORDER BY/LIMIT only")
+        inner = self._views[stmt.table]
+        res = self._select(inner)
+        names = [s[0] for s in res.schema]
+        cols: list[int] = []
+        for it in stmt.items:
+            e = it.expr
+            if isinstance(e, ast.Col) and e.name == "*":
+                cols.extend(range(len(names)))
+                continue
+            if not isinstance(e, ast.Col):
+                raise SQLError("view projections must be columns")
+            if e.name not in names:
+                raise SQLError(
+                    f"column {e.name!r} not in view {stmt.table}")
+            cols.append(names.index(e.name))
+        schema = [res.schema[i] for i in cols]
+        rows = [tuple(r[i] for i in cols) for r in res.rows]
+        rows = self._order_rows(stmt, schema, rows)
+        rows = self._limit_rows(stmt, rows)
+        return SQLResult(schema=schema, rows=rows)
 
     def _reject_foreign_quals(self, stmt: ast.Select):
         """Non-join selects must not reference other tables: a bogus
@@ -764,6 +828,10 @@ class SQLEngine:
         fallback when a group column is BSI (sql3 planner's generic
         PlanOpGroupBy instead of the PQL GroupBy pushdown)."""
         group_cols = stmt.group_by
+        if not self.executor.supports_local_cells:
+            raise SQLError(
+                "GROUP BY on int/decimal/timestamp columns is not "
+                "supported on the DAX queryer yet")
         schema, getters = [], []
         agg_specs = []  # (func, col or None)
         for it in items:
@@ -1059,6 +1127,8 @@ class SQLEngine:
         outer variant: a left record with no key match survives once
         with NULL right-side values, and WHERE evaluates AFTER the
         join).  WHERE may reference either table's columns."""
+        if not self.executor.supports_local_cells:
+            raise SQLError("JOIN is not supported on the DAX queryer yet")
         if len(stmt.joins) != 1:
             raise SQLError("a single JOIN is supported")
         if stmt.group_by or stmt.having or stmt.distinct:

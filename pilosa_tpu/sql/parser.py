@@ -92,6 +92,8 @@ class Parser:
 
     def create_table(self):
         self.expect_kw("create")
+        if self.ctx_kw("view"):
+            return self._create_view()
         self.expect_kw("table")
         if_not_exists = False
         if self.kw("if"):
@@ -130,8 +132,26 @@ class Parser:
         return ast.CreateTable(name, cols, keys=keys,
                                if_not_exists=if_not_exists)
 
+    def _create_view(self):
+        if_not_exists = False
+        if self.kw("if"):
+            self.expect_kw("not")
+            self.expect_kw("exists")
+            if_not_exists = True
+        name = self.expect("ident").value
+        self.expect_kw("as")
+        sel = self.select()
+        return ast.CreateView(name, sel, if_not_exists=if_not_exists)
+
     def drop_table(self):
         self.expect_kw("drop")
+        if self.ctx_kw("view"):
+            if_exists = False
+            if self.kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return ast.DropView(self.expect("ident").value,
+                                if_exists=if_exists)
         self.expect_kw("table")
         if_exists = False
         if self.kw("if"):
@@ -143,10 +163,12 @@ class Parser:
         self.expect_kw("show")
         if self.kw("tables"):
             return ast.ShowTables()
+        if self.ctx_kw("views"):
+            return ast.ShowViews()
         if self.kw("columns"):
             self.expect_kw("from")
             return ast.ShowColumns(self.expect("ident").value)
-        raise SQLError("expected TABLES or COLUMNS after SHOW")
+        raise SQLError("expected TABLES, VIEWS or COLUMNS after SHOW")
 
     def insert(self):
         replace = False

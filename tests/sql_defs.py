@@ -332,6 +332,36 @@ CASES = [
      "BULK INSERT INTO orders (_id) FROM 'x' "
      "WITH FORMAT 'JSON' INPUT 'STREAM'", ("error", "CSV")),
 
+    # ---- views ----------------------------------------------------------
+    ("create_view_and_select",
+     "CREATE VIEW open_orders AS SELECT _id, qty FROM orders "
+     "WHERE status = 'open'; "
+     "SELECT _id FROM open_orders", [(1,), (3,), (4,), (6,)]),
+    ("view_star_and_order",
+     "CREATE VIEW oq AS SELECT _id, qty FROM orders "
+     "WHERE qty IS NOT NULL; "
+     "SELECT * FROM oq ORDER BY qty DESC, _id LIMIT 2",
+     ("ordered", [(2, 12), (5, 12)])),
+    ("view_reflects_new_data",
+     "CREATE VIEW ov AS SELECT count(*) FROM orders; "
+     "INSERT INTO orders (_id, qty) VALUES (50, 1); "
+     "SELECT * FROM ov", 7),
+    ("show_views",
+     "CREATE VIEW v1 AS SELECT _id FROM orders; SHOW VIEWS",
+     [("v1",)]),
+    ("drop_view",
+     "CREATE VIEW v1 AS SELECT _id FROM orders; DROP VIEW v1; "
+     "SHOW VIEWS", []),
+    ("drop_view_missing_errors", "DROP VIEW nope",
+     ("error", "view not found")),
+    ("view_name_collision_errors",
+     "CREATE VIEW orders AS SELECT _id FROM orders",
+     ("error", "exists")),
+    ("view_where_unsupported",
+     "CREATE VIEW v2 AS SELECT _id, qty FROM orders; "
+     "SELECT _id FROM v2 WHERE qty > 1",
+     ("error", "projection/ORDER BY/LIMIT")),
+
     # ---- regression lockdowns (r03 review findings) ----------------------
     ("multikey_order_limit_sorts_before_limit",
      "SELECT _id, qty FROM orders WHERE qty IS NOT NULL "

@@ -224,3 +224,25 @@ def test_dataframe_apply_sandbox_blocks_escape():
     # the legitimate language still works
     assert df.apply("where(x > 1, x * 10, 0)") == [20]
     assert df.apply("sum(x) + max(x)") == 4
+
+
+def test_kinesis_iterator_types():
+    """TRIM_HORIZON replays everything, LATEST only new records, and
+    checkpoints resume like the Kafka source (idk/kinesis semantics)."""
+    from pilosa_tpu.ingest.kafka import KinesisSource
+
+    b = Broker(n_partitions=2)
+    for i in range(10):
+        b.produce("s", {"_id": i, "v": i})
+    src = KinesisSource(b, "s", group="k1", iterator_type="TRIM_HORIZON")
+    assert len(list(src)) == 10
+    # LATEST skips the backlog; only records produced afterward arrive
+    src2 = KinesisSource(b, "s", group="k2", iterator_type="LATEST")
+    assert list(src2) == []
+    b.produce("s", {"_id": 100, "v": 1})
+    got = list(src2)
+    assert [r.id for r in got] == [100]
+    # RESUME honors committed checkpoints (at-least-once)
+    src2.commit(1)
+    src3 = KinesisSource(b, "s", group="k2", iterator_type="RESUME")
+    assert list(src3) == []
