@@ -347,10 +347,11 @@ class ClusterExecutor:
     def execute(self, index: str, pql: str) -> dict:
         q = parse(pql)
         if any(c.name in _WRITE_CALLS or self._is_extract_of_sort(c)
-               for c in q.calls):
+               or c.name == "Sort" for c in q.calls):
             # writes route per-call by placement (api.go:651-672);
-            # Extract(Sort(...)) needs the order-preserving split —
-            # mixed queries evaluate call-by-call in order
+            # Extract(Sort(...)) needs the order-preserving split and
+            # Sort needs its offset hoisted to the merge — mixed
+            # queries evaluate call-by-call in order
             return {"results": [self._execute_call(index, c)
                                 for c in q.calls]}
         snap = self.node.snapshot()
@@ -370,12 +371,17 @@ class ClusterExecutor:
         """Execute ONE call with placement-aware routing."""
         if call.name not in _WRITE_CALLS:
             if self._is_extract_of_sort(call):
-                return self._extract_of_sort(index, call)
+                return extract_of_sort_wire(
+                    call, lambda c: self._execute_call(index, c))
+            shipped = call
+            if call.name == "Sort":
+                shipped = _sort_call_for_shipping(call)
             snap = self.node.snapshot()
             shards = sorted(self.node.disco.shards(index, ""))
             if not shards:
                 return self.node.api.query(index, call.to_pql())["results"][0]
-            partials = self._fan_out(snap, index, call.to_pql(), shards)
+            partials = self._fan_out(snap, index, shipped.to_pql(),
+                                     shards)
             return _reduce(call, [p[0] for p in partials])
         if call.name in ("Set", "Clear"):
             return self._execute_col_write(index, call)
@@ -399,24 +405,6 @@ class ClusterExecutor:
             raise ClusterError(
                 f"no live node accepted {call.name}: {last_err}")
         return _reduce(call, vals)
-
-    def _extract_of_sort(self, index: str, call) -> dict:
-        """Extract keeps its Sort child's ORDER (executor.go:4762).
-        A cross-node Extract reduce cannot reconstruct it, so merge
-        the Sort first (order-preserving reduce), then Extract those
-        columns and reorder the wire entries to the Sort order."""
-        from pilosa_tpu.pql.ast import Call
-
-        sorted_row = self._execute_call(index, call.children[0])
-        cols = list(sorted_row.get("columns", []))
-        table = self._execute_call(index, Call(
-            "Extract",
-            children=[Call("ConstRow", args={"columns": cols})]
-            + list(call.children[1:])))
-        by_col = {c.get("column"): c
-                  for c in table.get("columns", [])}
-        table["columns"] = [by_col[c] for c in cols if c in by_col]
-        return table
 
     def _execute_col_write(self, index: str, call) -> object:
         """Set/Clear: route to the column's shard owner + replicas and
@@ -539,6 +527,45 @@ class ClusterExecutor:
 # ----------------------------------------------------------------------
 # cross-node reducers over serialized results
 # ----------------------------------------------------------------------
+
+def _sort_call_for_shipping(call):
+    """Rewrite a Sort for per-node execution: nodes must NOT apply the
+    offset (each would drop its own head rows — wrong rows globally);
+    they return the top (offset+limit) instead and the merge reduce
+    applies the original offset/limit once (the same hoist the SQL
+    layer does for its Sort pushdown, sql/engine.py)."""
+    from pilosa_tpu.pql.ast import Call
+
+    offset = int(call.arg("offset", 0) or 0)
+    limit = call.arg("limit")
+    if not offset and limit is None:
+        return call
+    args = {k: v for k, v in call.args.items()
+            if k not in ("offset", "limit")}
+    if limit is not None:
+        args["limit"] = int(limit) + offset
+    return Call("Sort", args=args, children=list(call.children))
+
+
+def extract_of_sort_wire(call, run):
+    """Extract keeps its Sort child's ORDER (executor.go:4762).  A
+    cross-node Extract reduce cannot reconstruct it, so merge the Sort
+    first (order-preserving reduce), then Extract those columns and
+    reorder the wire entries to the Sort order.  `run(call)` executes
+    one call and returns its wire dict — shared by the cluster
+    executor and the DAX remote executor."""
+    from pilosa_tpu.pql.ast import Call
+
+    sorted_row = run(call.children[0])
+    cols = list(sorted_row.get("columns", []))
+    table = run(Call(
+        "Extract",
+        children=[Call("ConstRow", args={"columns": cols})]
+        + list(call.children[1:])))
+    by_col = {c.get("column"): c for c in table.get("columns", [])}
+    table["columns"] = [by_col[c] for c in cols if c in by_col]
+    return table
+
 
 def _reduce(call, vals: list):
     call_name = call.name

@@ -21,7 +21,11 @@ controller schema changes and routed imports.
 from __future__ import annotations
 
 from pilosa_tpu.cluster.client import InternalClient
-from pilosa_tpu.cluster.coordinator import _reduce
+from pilosa_tpu.cluster.coordinator import (
+    _reduce,
+    _sort_call_for_shipping,
+    extract_of_sort_wire,
+)
 from pilosa_tpu.dax.controller import Controller
 from pilosa_tpu.executor.executor import Executor
 from pilosa_tpu.executor.results import deserialize_result
@@ -59,26 +63,8 @@ class _RemoteExecutor(Executor):
         self.queryer = queryer
 
     def _execute_call(self, idx, call, shards, pre=None):
-        if call.name == "Extract" and call.children \
-                and call.children[0].name == "Sort":
-            # Extract keeps the Sort child's ORDER (executor.go:4762);
-            # a cross-worker Extract reduce cannot reconstruct it, so
-            # split: merge the Sort remotely (order-preserving
-            # reduce), then Extract those columns and reorder locally
-            # — the same split the local path makes.
-            from pilosa_tpu.pql.ast import Call
-            sorted_row = self._execute_call(idx, call.children[0],
-                                            shards)
-            const = Call("ConstRow",
-                         args={"columns": list(sorted_row.columns)})
-            table = self._execute_call(
-                idx, Call("Extract",
-                          children=[const] + list(call.children[1:])),
-                shards)
-            by_col = {c.get("column"): c for c in table.columns}
-            table.columns = [by_col[c] for c in sorted_row.columns
-                             if c in by_col]
-            return table
+        # the queryer handles the Sort offset hoist and the
+        # Extract(Sort) order-preserving split at the wire level
         res = self.queryer.query(idx.name, call.to_pql())["results"][0]
         return deserialize_result(call, res, idx.width)
 
@@ -176,10 +162,7 @@ class Queryer:
         stmts = parse_sql(statement)
         out = None
         for stmt in stmts:
-            if isinstance(stmt, sqlast.Select) and (
-                    stmt.joins or any(
-                        isinstance(it.expr, sqlast.Col)
-                        and it.expr.table for it in stmt.items)):
+            if isinstance(stmt, sqlast.Select) and stmt.joins:
                 raise SQLError(
                     "JOIN is not supported on the DAX queryer yet")
             eng = self._sql_engine()
@@ -195,6 +178,19 @@ class Queryer:
                 continue
             if isinstance(stmt, sqlast.Insert):
                 out = self._sql_insert(stmt)
+                continue
+            if isinstance(stmt, sqlast.BulkInsert):
+                # materialize the converted CSV rows (shared engine
+                # helper), then route through the same shard-owner
+                # imports as INSERT — executing it on the schema-only
+                # mirror would silently drop the data
+                idx = eng.holder.index(stmt.table)
+                if idx is None:
+                    raise SQLError(f"table not found: {stmt.table}")
+                fields, _ = eng._bulk_fields(idx, stmt.columns)
+                rows = list(eng._iter_bulk_rows(stmt, idx, fields))
+                out = self._sql_insert(sqlast.Insert(
+                    stmt.table, stmt.columns, rows))
                 continue
             res = eng._execute(stmt)
             out = {
@@ -218,15 +214,15 @@ class Queryer:
         if "_id" not in stmt.columns:
             raise SQLError("INSERT requires an _id column")
         id_pos = stmt.columns.index("_id")
-        n = 0
+        # accumulate per-field batches so the fleet sees ONE import
+        # fan-out per field, not one RPC per (row, value)
+        bit_rows: dict[str, tuple[list, list]] = {}
+        val_cols: dict[str, tuple[list, list]] = {}
+        replace_cols: list[int] = []
         for row in stmt.rows:
             col = int(row[id_pos])
             if stmt.replace:
-                # full-record replace: clear the old values on the
-                # owning worker first (the engine's clear_columns
-                # analog, shipped as a Delete of just this record)
-                self.query(stmt.table,
-                           f"Delete(ConstRow(columns=[{col}]))")
+                replace_cols.append(col)
             for cname, v in zip(stmt.columns, row):
                 if cname == "_id" or v is None:
                     continue
@@ -235,27 +231,58 @@ class Queryer:
                     raise SQLError(f"column not found: {cname}")
                 t = f.options.type
                 if t.is_bsi:
-                    self.import_values(stmt.table, cname, [col],
-                                       [f.value_to_int(v)])
+                    cs, vs = val_cols.setdefault(cname, ([], []))
+                    cs.append(col)
+                    vs.append(f.value_to_int(v))
                 elif t.value == "bool":
-                    self.import_bits(stmt.table, cname,
-                                     [1 if v else 0], [col])
+                    rs, cs = bit_rows.setdefault(cname, ([], []))
+                    rs.append(1 if v else 0)
+                    cs.append(col)
                 else:
                     vals = v if isinstance(v, list) else [v]
+                    rs, cs = bit_rows.setdefault(cname, ([], []))
                     for item in vals:
                         if isinstance(item, str):
                             raise SQLError(
                                 "keyed rows need the cluster path, "
                                 "not DAX yet")
-                        self.import_bits(stmt.table, cname,
-                                         [int(item)], [col])
-            n += 1
-        return {"schema": {"fields": []}, "data": [[n]]}
+                        rs.append(int(item))
+                        cs.append(col)
+        if replace_cols:
+            # full-record replace: clear old values on the owners
+            # first (the engine's clear_columns analog), one fan-out
+            cols_pql = ",".join(str(c) for c in replace_cols)
+            self.query(stmt.table,
+                       f"Delete(ConstRow(columns=[{cols_pql}]))")
+        for cname, (rs, cs) in bit_rows.items():
+            self.import_bits(stmt.table, cname, rs, cs)
+        for cname, (cs, vs) in val_cols.items():
+            self.import_values(stmt.table, cname, cs, vs)
+        return {"schema": {"fields": []}, "data": [[len(stmt.rows)]]}
 
     # -- reads (orchestrator.go:83 Execute) ----------------------------
 
     def query(self, table: str, pql: str) -> dict:
         q = parse(pql)
+        # order-sensitive calls need call-level handling before the
+        # fan-out (same contracts as ClusterExecutor): Extract(Sort)
+        # splits; Sort hoists its offset to the merge
+        if any((c.name == "Extract" and c.children
+                and c.children[0].name == "Sort") for c in q.calls):
+            results = []
+            for c in q.calls:
+                if c.name == "Extract" and c.children \
+                        and c.children[0].name == "Sort":
+                    results.append(extract_of_sort_wire(
+                        c, lambda cc: self.query(
+                            table, cc.to_pql())["results"][0]))
+                else:
+                    results.append(
+                        self.query(table, c.to_pql())["results"][0])
+            return {"results": results}
+        shipped = [(_sort_call_for_shipping(c) if c.name == "Sort"
+                    else c) for c in q.calls]
+        pql = "".join(c.to_pql() for c in shipped)
         shards = sorted(self.controller.tables.get(table, ()))
         # group shards by owning worker (ComputeNodes in the reference)
         by_worker: dict[str, list[int]] = {}

@@ -906,15 +906,24 @@ class StackedEngine:
                 return out  # mirror place(): no device touch
             if self.mesh is None:
                 return jnp.asarray(out)
-            # shard axis is axis 1 here; pad + shard it over the mesh
+            # 2D placement: candidate rows over the "rows" mesh axis,
+            # shards over "shards" (the TopK/GroupBy row-block
+            # parallelism named in parallel/mesh.py — zero-padded on
+            # both axes; zero rows/shards are popcount-neutral)
             n = self.mesh.shape["shards"]
             s = out.shape[1]
             if s % n:
                 out = np.concatenate(
                     [out, np.zeros((out.shape[0], n - s % n, out.shape[2]),
                                    dtype=out.dtype)], axis=1)
+            nr = self.mesh.shape["rows"]
+            r = out.shape[0]
+            if r % nr:
+                out = np.concatenate(
+                    [out, np.zeros((nr - r % nr,) + out.shape[1:],
+                                   dtype=out.dtype)], axis=0)
             from jax.sharding import NamedSharding, PartitionSpec as P
             return jax.device_put(
-                out, NamedSharding(self.mesh, P(None, "shards", None)))
+                out, NamedSharding(self.mesh, P("rows", "shards", None)))
 
         return self.cache.get(key, versions, build)

@@ -259,6 +259,9 @@ class KinesisSource(StreamSource):
         it = iterator_type.upper()
         if it not in ("TRIM_HORIZON", "LATEST", "RESUME"):
             raise ValueError(f"unknown iterator type {iterator_type!r}")
+        # a LATEST source built before the first produce must still
+        # pin head checkpoints — materialize the topic's partitions
+        self.broker.create_topic(topic)
         if it == "TRIM_HORIZON":
             # a true seek: existing checkpoints rewind too
             self.broker.reset_offsets(

@@ -35,6 +35,14 @@ from pilosa_tpu.shardwidth import (
     SPARSE_MAX,
 )
 
+# Paranoia mode (the roaring_paranoia.go build-tag asserts + rbf
+# Tx.Check analog, SURVEY §5.2): PILOSA_TPU_PARANOIA=1 re-validates
+# the hybrid row-store invariants after every mutation.  Off by
+# default — the checks cost O(row) per touched row.
+import os as _os
+
+PARANOIA = _os.environ.get("PILOSA_TPU_PARANOIA") == "1"
+
 
 class Fragment:
     """Host rows + device tile cache for one (index, field, view, shard)."""
@@ -129,6 +137,34 @@ class Fragment:
         ``version`` between the two could cache pre-write data under
         the post-write version forever."""
         self._invalidate(row)
+        if PARANOIA:
+            self.check_row(row)
+
+    def check_row(self, row: int):
+        """Paranoia assert for one row's representation invariants."""
+        dense = self._rows.get(row)
+        arr = self._sparse.get(row)
+        assert not (dense is not None and arr is not None), \
+            f"row {row} in BOTH dense and sparse stores"
+        if arr is not None:
+            assert arr.ndim == 1 and arr.dtype == np.int64, arr.dtype
+            assert arr.size <= SPARSE_MAX, \
+                f"sparse row {row} over threshold ({arr.size})"
+            if arr.size:
+                assert (np.diff(arr) > 0).all(), \
+                    f"sparse row {row} not strictly sorted"
+                assert 0 <= int(arr[0]) and int(arr[-1]) < self.width, \
+                    f"sparse row {row} column out of range"
+        if dense is not None:
+            assert dense.dtype == np.uint32 and \
+                dense.size == self.width // 32, \
+                f"dense row {row} bad geometry"
+
+    def check(self):
+        """Full-fragment invariant sweep (rbf Tx.Check analog)."""
+        for r in set(self._rows) | set(self._sparse):
+            self.check_row(r)
+        assert self.version >= 0
 
     def set_row_words(self, row: int, words) -> None:
         """Replace a whole row (Store()/ClearRow write path); the

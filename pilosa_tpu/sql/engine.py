@@ -337,11 +337,20 @@ class SQLEngine:
         import io
 
         idx = self._index(stmt.table)
-        if "_id" not in stmt.columns:
+        fields, id_pos = self._bulk_fields(idx, stmt.columns)
+        n = 0
+        for row in self._iter_bulk_rows(stmt, idx, fields):
+            self._apply_record(idx, fields, row, id_pos, replace=False)
+            n += 1
+        return SQLResult(schema=[("rows_inserted", "int")], rows=[(n,)])
+
+    def _bulk_fields(self, idx, columns):
+        """Resolve BULK INSERT target fields (+ the _id position)."""
+        if "_id" not in columns:
             raise SQLError("BULK INSERT requires an _id column")
-        id_pos = stmt.columns.index("_id")
+        id_pos = columns.index("_id")
         fields = []
-        for c in stmt.columns:
+        for c in columns:
             if c == "_id":
                 fields.append(None)
                 continue
@@ -349,6 +358,15 @@ class SQLEngine:
             if f is None:
                 raise SQLError(f"column not found: {c}")
             fields.append(f)
+        return fields, id_pos
+
+    def _iter_bulk_rows(self, stmt, idx, fields):
+        """Yield type-converted rows from the CSV source — shared by
+        the local apply path and the DAX routed path."""
+        import csv
+        import io
+
+        id_pos = stmt.columns.index("_id")
 
         def convert(f, text: str):
             if text == "":
@@ -377,7 +395,6 @@ class SQLEngine:
                     f"BULK INSERT cannot read {stmt.path!r}: {exc}")
         else:
             fh = io.StringIO(stmt.payload or "")
-        n = 0
         with fh:
             reader = csv.reader(fh)
             for i, raw in enumerate(reader):
@@ -397,10 +414,7 @@ class SQLEngine:
                         f"CSV row {i + 1}: bad value ({exc})")
                 if row[id_pos] is None:
                     raise SQLError(f"CSV row {i + 1} has empty _id")
-                self._apply_record(idx, fields, row, id_pos,
-                                   replace=False)
-                n += 1
-        return SQLResult(schema=[("rows_inserted", "int")], rows=[(n,)])
+                yield row
 
     def _row_id(self, f, v, create=False):
         if isinstance(v, str):

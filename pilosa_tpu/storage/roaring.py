@@ -94,8 +94,14 @@ def decode(buf: bytes) -> np.ndarray:
 def encode(values) -> bytes:
     """Serialize sorted-able uint32 values in the no-run official
     format (cookie 12346 — every reader supports it; the reference
-    likewise writes without optimizing to runs unless asked)."""
-    vals = np.unique(np.asarray(values, dtype=np.uint32))
+    likewise writes without optimizing to runs unless asked).
+    Values must fit uint32 — the official interop format is 32-bit;
+    silently truncating would corrupt round-trips."""
+    raw = np.asarray(values, dtype=np.uint64)
+    if raw.size and int(raw.max()) > 0xFFFFFFFF:
+        raise RoaringError(
+            "official roaring format holds 32-bit values only")
+    vals = np.unique(raw.astype(np.uint32))
     keys = (vals >> np.uint32(16)).astype(np.uint16)
     uniq_keys, starts = np.unique(keys, return_index=True)
     bounds = list(starts) + [len(vals)]

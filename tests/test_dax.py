@@ -234,3 +234,22 @@ def test_dax_sql_order_by_timestamp_desc(dax):
     r = q.sql("SELECT _id FROM ts ORDER BY t DESC")
     assert [row[0] for row in r["data"]] == \
         [SHARD + 2, 2 * SHARD + 3, 1]
+
+
+def test_dax_sql_bulk_insert_and_sort_offset(dax):
+    """BULK INSERT routes to the workers (not the schema-only mirror);
+    Sort with OFFSET hoists the offset to the cross-worker merge."""
+    q = dax.queryer
+    q.sql("CREATE TABLE b (_id id, v int min 0 max 10000)")
+    rows = "\n".join(f"{s * SHARD + 1},{s * 10}" for s in range(6))
+    r = q.sql(f"BULK INSERT INTO b (_id, v) FROM '{rows}' "
+              "WITH FORMAT 'CSV' INPUT 'STREAM'")
+    assert r["data"] == [[6]]
+    # the data must live on the WORKERS: a fresh count is remote
+    assert q.sql("SELECT count(*) FROM b")["data"] == [[6]]
+    # Sort offset: each worker holds different shards; the offset
+    # must apply once after the merge, not per worker
+    r = q.query("b", "Sort(All(), field=v, offset=2, limit=3)")
+    assert r["results"][0]["values"] == [20, 30, 40]
+    r = q.sql("SELECT _id FROM b ORDER BY v LIMIT 2 OFFSET 1")
+    assert [row[0] for row in r["data"]] == [SHARD + 1, 2 * SHARD + 1]

@@ -1,0 +1,128 @@
+"""Randomized property tests — the go-fuzz analog (roaring/fuzzer.go,
+fuzz_test.go on UnmarshalBinary; SURVEY §4 "Fuzz" row) plus the
+paranoia invariant mode (roaring_paranoia.go / rbf Tx.Check analog)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.storage import roaring
+
+W = 1 << 12
+
+
+class TestRoaringCodecFuzz:
+    def test_roundtrip_random_shapes(self):
+        """encode/decode identity across container-shape regimes:
+        sparse arrays, dense runs, full containers, huge gaps."""
+        rng = np.random.default_rng(0)
+        cases = [
+            np.array([], dtype=np.uint64),
+            np.array([0], dtype=np.uint64),
+            np.array([1, 2**32 - 1], dtype=np.uint64),
+            np.arange(5000, dtype=np.uint64),          # run container
+            np.arange(0, 1 << 16, 2, dtype=np.uint64),  # half-dense
+        ]
+        for _ in range(40):
+            n = int(rng.integers(1, 4000))
+            vals = np.unique(rng.integers(
+                0, 1 << 32, size=n).astype(np.uint64))
+            cases.append(vals)
+        for vals in cases:
+            blob = roaring.encode(vals)
+            got = roaring.decode(blob)
+            np.testing.assert_array_equal(
+                np.asarray(got, dtype=np.uint64), vals)
+
+    def test_encode_rejects_64bit(self):
+        """The official interop format is 32-bit; out-of-domain values
+        must error, not silently truncate."""
+        with pytest.raises(roaring.RoaringError):
+            roaring.encode(np.array([2**33], dtype=np.uint64))
+
+    def test_decode_garbage_never_crashes(self):
+        """Arbitrary bytes must raise RoaringError (or decode), never
+        segfault/IndexError — the UnmarshalBinary fuzz target."""
+        rng = np.random.default_rng(1)
+        blobs = [b"", b"\x00", b"\xff" * 16, rng.bytes(3), rng.bytes(64)]
+        # mutated valid blobs: flip bytes in a real encoding
+        valid = bytearray(roaring.encode(
+            np.arange(0, 10000, 3, dtype=np.uint64)))
+        for _ in range(60):
+            mut = bytearray(valid)
+            for _ in range(int(rng.integers(1, 8))):
+                mut[int(rng.integers(0, len(mut)))] = int(
+                    rng.integers(0, 256))
+            blobs.append(bytes(mut))
+        for blob in blobs:
+            try:
+                roaring.decode(blob)
+            except (roaring.RoaringError, ValueError):
+                pass  # clean rejection is the contract
+
+    def test_truncations_never_crash(self):
+        valid = roaring.encode(np.arange(0, 65536, 7, dtype=np.uint64))
+        for cut in range(0, len(valid), max(1, len(valid) // 50)):
+            try:
+                roaring.decode(valid[:cut])
+            except (roaring.RoaringError, ValueError):
+                pass
+
+
+class TestFragmentParanoia:
+    def test_random_op_soup_keeps_invariants(self, monkeypatch):
+        """Random set/clear/import/replace ops with paranoia checks on
+        every touch; final state cross-checked against a python-set
+        model (the naive.go pattern)."""
+        from pilosa_tpu.models import fragment as frag_mod
+        monkeypatch.setattr(frag_mod, "PARANOIA", True)
+        f = frag_mod.Fragment("i", "f", "standard", 0, width=W)
+        model: dict[int, set[int]] = {}
+        rng = np.random.default_rng(2)
+        for step in range(300):
+            op = rng.integers(0, 5)
+            row = int(rng.integers(0, 6))
+            if op == 0:
+                col = int(rng.integers(0, W))
+                f.set_bit(row, col)
+                model.setdefault(row, set()).add(col)
+            elif op == 1:
+                col = int(rng.integers(0, W))
+                f.clear_bit(row, col)
+                model.get(row, set()).discard(col)
+            elif op == 2:
+                cols = rng.integers(0, W, size=int(rng.integers(1, 50)))
+                f.import_bits(np.full(cols.size, row), cols)
+                model.setdefault(row, set()).update(map(int, cols))
+            elif op == 3:
+                cols = rng.integers(0, W, size=int(rng.integers(1, 20)))
+                f.import_bits(np.full(cols.size, row), cols, clear=True)
+                model.get(row, set()).difference_update(map(int, cols))
+            else:
+                cols = set(map(int, rng.integers(
+                    0, W, size=int(rng.integers(0, 30)))))
+                words = np.zeros(W // 32, dtype=np.uint32)
+                for c in cols:
+                    words[c >> 5] |= np.uint32(1) << (c & 31)
+                f.set_row_words(row, words)
+                model[row] = set(cols)
+        f.check()
+        for row in range(6):
+            want = sorted(model.get(row, set()))
+            from pilosa_tpu.ops import bitmap as bm
+            got = bm.to_columns(f.row_words(row)).tolist()
+            assert got == want, (row, len(got), len(want))
+
+    def test_check_catches_corruption(self):
+        from pilosa_tpu.models.fragment import Fragment
+        f = Fragment("i", "f", "standard", 0, width=W)
+        f.set_bit(1, 5)
+        # corrupt: unsorted sparse array
+        f._sparse[1] = np.array([9, 3], dtype=np.int64)
+        with pytest.raises(AssertionError):
+            f.check()
+        # corrupt: row in both stores
+        f2 = Fragment("i", "f", "standard", 0, width=W)
+        f2.set_bit(1, 5)
+        f2._rows[1] = np.zeros(W // 32, dtype=np.uint32)
+        with pytest.raises(AssertionError):
+            f2.check()

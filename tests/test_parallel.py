@@ -79,6 +79,39 @@ def test_dist_topk(rng, mesh):
     assert np.asarray(vals).tolist() == expect[order[:3]].tolist()
 
 
+def test_2d_mesh_rows_axis():
+    """A (2, 4) mesh shards candidate-row blocks over 'rows' and
+    shards over 'shards'; TopN/GroupBy results stay exact with both
+    axes active (parallel/mesh.py 2D placement)."""
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.models import Holder
+
+    rng = np.random.default_rng(5)
+    h = Holder(width=2048)
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    g = idx.create_field("g")
+    n = 600
+    cols = rng.integers(0, 13 * 2048, size=n)
+    f.import_bits(rng.integers(0, 7, size=n), cols)  # 7 rows: pads to 8
+    g.import_bits(rng.integers(0, 3, size=n),
+                  rng.integers(0, 13 * 2048, size=n))
+    idx.mark_columns_exist(cols.tolist())
+    ex2d = Executor(h)
+    ex2d.set_mesh(make_mesh(8, rows=2))
+    ex_loop = Executor(h)
+    ex_loop.use_stacked = False
+    for q in ("TopN(f, n=5)", "TopN(f, Row(g=1), n=5)",
+              "GroupBy(Rows(f), Rows(g))", "MinRow(field=f)",
+              "Count(Intersect(Row(f=1), Row(g=2)))"):
+        got = ex2d.execute("i", q)
+        want = ex_loop.execute("i", q)
+        norm = lambda rs: [
+            (r.columns().tolist() if hasattr(r, "columns")
+             and callable(getattr(r, "columns")) else r) for r in rs]
+        assert norm(got) == norm(want), q
+
+
 def test_dryrun_multichip_entry():
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)
