@@ -159,7 +159,11 @@ def _preview(res):
 
 
 def main() -> None:
-    platform, _ = probe_backend()
+    platform, probe_n = probe_backend()
+    # probe_backend returns n=0 ONLY on the tunnel-failure fallback;
+    # an explicit JAX_PLATFORMS=cpu smoke run reports its real device
+    # count
+    tunnel_down = platform == "cpu" and probe_n == 0
     import jax
     if platform == "cpu":
         # override the site customization's forced TPU selection
@@ -215,6 +219,15 @@ def main() -> None:
                                    for k, v in p50_tiny.items()},
         "net_device_p50_ms": {k: round(v, 3) for k, v in net_ms.items()},
     }
+    if tunnel_down:
+        # the chip was measured in-session when reachable; the record
+        # (954 shards / 5.0e9 cells, 0.30 ms v5e-16 equiv, 33x under
+        # target) lives in BENCH_TPU_NOTES.md with raw walls +
+        # methodology — this fallback means the tunnel was down at
+        # bench time, not that no TPU measurement exists
+        result["note"] = ("TPU tunnel unreachable at bench time; "
+                          "see BENCH_TPU_NOTES.md for the in-session "
+                          "TPU-measured record at design scale")
     print(json.dumps(result))
 
 

@@ -82,6 +82,35 @@ def _distinct_key(row) -> bytes:
     return repr(tuple(_canon_value(v) for v in row)).encode()
 
 
+# Optimizer (the planoptimizer.go analog, as compile-time rules).
+# The reference runs explicit optimizer passes over a PlanOperator
+# tree (sql3/planner/planoptimizer.go); this engine bakes the same
+# rewrites into compilation, where each is a one-line decision
+# instead of a tree transform:
+#
+# - filter pushdown           WHERE compiles straight to a PQL tree
+#                             executed shard-parallel on device
+#                             (_compile_where) — the
+#                             PlanOpPQLTableScan filter push
+# - aggregate pushdown        COUNT/SUM/MIN/MAX/AVG/PERCENTILE become
+#                             single PQL aggregate calls
+#                             (_select_aggregates)
+# - GROUP BY pushdown         set-like group columns ride the PQL
+#                             GroupBy (stacked device program); only
+#                             BSI group columns take the generic
+#                             hashed path
+# - Sort/TopN pushdown        ORDER BY on a BSI column becomes the
+#                             device Sort with limit+offset hoisted
+#                             (_select_rows), NULLS LAST appended
+# - LIMIT pushdown            plain LIMIT becomes PQL Limit unless
+#                             DISTINCT/sort semantics forbid it
+# - DISTINCT pushdown         single-column DISTINCT becomes the PQL
+#                             Distinct scan (_select_distinct)
+# - join hash refinement      nested-loop JOIN hashes the right side
+#                             (the opnestedloops.go hashed variant)
+# - subquery materialization  uncorrelated IN/scalar subqueries
+#                             evaluate once and fold into the outer
+#                             predicate
 class SQLEngine:
     def __init__(self, holder: Holder):
         self.holder = holder
