@@ -253,25 +253,11 @@ class AdvancedOps:
         combos = list(itertools.product(*[range(len(rl))
                                           for rl in row_lists]))
         shard_list = self._shard_list(idx, shards)
-        counts = agg_nn = agg_pos = agg_neg = None
-        if getattr(self, "use_stacked", False) and distinct_field is None:
-            try:
-                counts, agg = self.stacked.groupby(
-                    idx, list(zip(fields, row_lists)), filter_call,
-                    agg_field, shard_list, pre)
-                if agg is not None:
-                    agg_nn, agg_pos, agg_neg = agg
-            except Unstackable:
-                counts = None
-        if counts is None:
-            counts, agg_nn, agg_pos, agg_neg = self._groupby_loop(
-                idx, fields, row_lists, combos, filter_call, agg_field,
-                shard_list, pre)
 
         # previous= paging (executor.go:8617 groupByIterator seek):
-        # resume strictly after the given group, in product order.
-        # Resolved BEFORE the (host-heavy) Count(Distinct) pass so a
-        # paged query never recomputes groups before the seek point.
+        # resume strictly after the given group, in product order —
+        # resolved BEFORE any computation so a paged query evaluates
+        # only the requested tail of the combo space.
         previous = call.arg("previous")
         start_ci = 0
         if previous is not None:
@@ -298,19 +284,33 @@ class AdvancedOps:
                     break
             else:
                 return []
+        combos = combos[start_ci:]
+
+        counts = agg_nn = agg_pos = agg_neg = None
+        if getattr(self, "use_stacked", False) and distinct_field is None:
+            try:
+                counts, agg = self.stacked.groupby(
+                    idx, list(zip(fields, row_lists)), filter_call,
+                    agg_field, shard_list, pre, combos)
+                if agg is not None:
+                    agg_nn, agg_pos, agg_neg = agg
+            except Unstackable:
+                counts = None
+        if counts is None:
+            counts, agg_nn, agg_pos, agg_neg = self._groupby_loop(
+                idx, fields, row_lists, combos, filter_call, agg_field,
+                shard_list, pre)
 
         distinct_counts = None
         if distinct_field is not None:
             distinct_counts = self._groupby_count_distinct(
                 idx, fields, row_lists, combos, counts, filter_call,
-                distinct_inner, distinct_field, shard_list, pre,
-                start_ci)
+                distinct_inner, distinct_field, shard_list, pre)
 
         having = call.arg("having")
         limit = call.arg("limit")
         out = []
-        for ci in range(start_ci, len(combos)):
-            combo = combos[ci]
+        for ci, combo in enumerate(combos):
             cnt = int(counts[ci])
             if cnt == 0:
                 continue
@@ -388,18 +388,18 @@ class AdvancedOps:
 
     def _groupby_count_distinct(self, idx, fields, row_lists, combos,
                                 counts, filter_call, inner_filter,
-                                dfield, shard_list, pre, start_ci=0):
+                                dfield, shard_list, pre):
         """Count(Distinct(field=D)) per group: distinct BSI values /
         distinct set rows of D among the group's columns, restricted
         by the GroupBy filter AND the Distinct call's own filter child.
         Host numpy over fragment rows + the engine's device-decoded
         value stream (O(shard-chunk) device calls, consumed chunk-by-
         chunk so host memory stays bounded); sets unioned across
-        shards.  Only combos >= start_ci (the previous= seek point)
-        are computed."""
+        shards.  The caller already trimmed combos to the previous=
+        tail, so every nonzero combo here is needed."""
         from pilosa_tpu.ops import bsi as bsi_ops
 
-        nonzero = [ci for ci in range(start_ci, len(combos))
+        nonzero = [ci for ci in range(len(combos))
                    if counts[ci] > 0]
         sets: dict[int, set] = {ci: set() for ci in nonzero}
         is_bsi = dfield.options.type.is_bsi

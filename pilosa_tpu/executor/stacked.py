@@ -761,16 +761,18 @@ class StackedEngine:
         return out if red else out.sum(axis=1)
 
     def groupby(self, idx, fields_rows, filter_call, agg_field,
-                shards: list[int], pre, combo_chunk: int = 8):
-        """GroupBy on the stacked engine: the full combo cartesian
-        product evaluated as chunked device programs over gathered
+                shards: list[int], pre, combos,
+                combo_chunk: int = 8):
+        """GroupBy on the stacked engine: the given combos (index
+        tuples into each field's row list — the caller enumerates and
+        pages them) evaluated as chunked device programs over gathered
         (R, S, W) row stacks (executor.go:3918 + 8617 groupByIterator,
         re-expressed as fixed-shape gathers + one scan over the BSI
         planes for the Sum aggregate).
 
         fields_rows: [(field, row_ids), ...].  Returns (counts (C,)
         int64, None | (nn (C,), pos (C, P), neg (C, P)) int64 arrays)
-        in cartesian-product order (itertools.product semantics).
+        aligned with `combos`.
         """
         skey = tuple(shards)
         # the gathered row stacks are resident all at once — bail to
@@ -790,8 +792,7 @@ class StackedEngine:
         if agg_field is not None:
             planes_i = b._planes_leaf(agg_field)
         tree = None
-        sizes = [len(rl) for _, rl in fields_rows]
-        n_combos = int(np.prod(sizes))
+        n_combos = len(combos)
         depth = agg_field.bit_depth if agg_field is not None else 0
         if filter_call is not None:
             tree = b.build(filter_call)
@@ -803,11 +804,8 @@ class StackedEngine:
                 return np.zeros(n_combos, dtype=np.int64), zero_agg
         red = self._reduce_in_program(skey)
         plan = ("groupby", stack_is, planes_i, tree, red)
-        # cartesian product in C order: index combo ci decomposes
-        # exactly like itertools.product over the row lists
-        combo_idx = np.stack(np.meshgrid(
-            *[np.arange(s, dtype=np.int32) for s in sizes],
-            indexing="ij"), axis=-1).reshape(n_combos, len(sizes))
+        combo_idx = np.asarray(combos, dtype=np.int32).reshape(
+            n_combos, len(fields_rows))
         counts = np.zeros(n_combos, dtype=np.int64)
         nn = pos = neg = None
         if agg_field is not None:
@@ -820,7 +818,7 @@ class StackedEngine:
             if hi - lo < combo_chunk:  # pad: combo 0 re-counted, dropped
                 sel = np.concatenate(
                     [sel, np.zeros((combo_chunk - (hi - lo),
-                                    len(sizes)), dtype=np.int32)])
+                                    len(fields_rows)), dtype=np.int32)])
             params = tuple(b.params) + (sel,)
             fn = _compiled(plan, kern=kernels.enabled()
                            and not self.host_only)
