@@ -52,6 +52,7 @@ def cmd_server(args) -> int:
         "port": args.port, "grpc_port": args.grpc_port,
         "auth_secret": args.auth_secret or None,
         "auth_policy": args.auth_policy or None,
+        "long_query_time": args.long_query_time,
     })
     cfg.apply_kernel_setting()
     holder = Holder(path=cfg.data_dir) if cfg.data_dir else Holder()
@@ -291,6 +292,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="-1 disables gRPC")
     sp.add_argument("--auth-secret", default="")
     sp.add_argument("--auth-policy", default="")
+    sp.add_argument("--long-query-time", type=float, default=None,
+                    help="log queries slower than this many seconds "
+                         "(0 disables; server.go:201 analog)")
     sp.set_defaults(fn=cmd_server)
 
     sp = sub.add_parser("backup", help="back up a live node")

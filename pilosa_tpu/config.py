@@ -98,4 +98,6 @@ def _coerce(cfg: Config, attr: str, value):
         return str(value).lower() in ("1", "true", "yes", "on")
     if isinstance(cur, int):
         return int(value)
+    if isinstance(cur, float):
+        return float(value)
     return str(value)

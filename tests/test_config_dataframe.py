@@ -118,3 +118,14 @@ def test_dataframe_http_routes():
         assert e.value.status == 404
     finally:
         srv.close()
+
+
+def test_float_config_coercion():
+    """Float settings (long-query-time) coerce from flags/env/TOML —
+    not silently stringified (regression: _coerce lacked a float
+    branch)."""
+    from pilosa_tpu import config as cfgmod
+    cfg = cfgmod.load(overrides={"long_query_time": 0.25})
+    assert cfg.long_query_time == 0.25
+    cfg = cfgmod.load(env={"PILOSA_TPU_LONG_QUERY_TIME": "1.5"})
+    assert cfg.long_query_time == 1.5
