@@ -284,39 +284,67 @@ def _compiled(plan, kern: bool = False):
         #        reduce) — executeGroupByShard (executor.go:3918) as one
         # program: combo masks = gathered row-stack intersections, count
         # + optional BSI Sum partials, cross-shard reduce in-program.
-        stack_is, planes_i, tree, reduce_ = (plan[1], plan[2], plan[3],
-                                             plan[4])
+        # The combo space arrives pre-chunked as (n_chunks, C, nf) and
+        # a lax.scan walks the chunks INSIDE the program: one dispatch
+        # per GroupBy regardless of combo count (through a multi-ms-RTT
+        # tunnel, a host-side chunk loop costs a round trip per chunk —
+        # measured r03: 60 combos / 8-chunks = 8 RTTs ~ 640 ms of pure
+        # dispatch on a ~100 ms device scan), while the per-chunk
+        # (C, S, W) mask buffer stays bounded.  With reduce, the four
+        # aggregate outputs concatenate into ONE flat array so the
+        # host pays a single fetch round trip, and `signed=False`
+        # (BSI field with min >= 0) skips the sign-split masks and
+        # the whole negative-plane popcount pass.
+        stack_is, planes_i, tree, reduce_, signed = (
+            plan[1], plan[2], plan[3], plan[4], plan[5])
 
         def run(leaves, params):
-            sel = params[-1]                          # (C, nf) int32
-            m = leaves[stack_is[0]][sel[:, 0]]        # (C, S, W)
-            for fi in range(1, len(stack_is)):
-                m = jnp.bitwise_and(m, leaves[stack_is[fi]][sel[:, fi]])
+            sel_all = params[-1]                      # (n_chunks, C, nf)
+            filt = None
             if tree is not None:
                 filt = _as_stack(_eval(tree, leaves, params), leaves)
-                m = jnp.bitwise_and(m, filt[None])
-            counts = bm.count(m)                      # (C, S)
-            if planes_i is None:
-                return jnp.sum(counts, axis=1) if reduce_ else counts
-            planes = leaves[planes_i]                 # (S, P, W)
-            exists, sign = planes[:, 0], planes[:, 1]
-            em = jnp.bitwise_and(m, exists[None])
-            nn = bm.count(em)                         # (C, S)
-            pos = jnp.bitwise_and(em, ~sign[None])
-            neg = jnp.bitwise_and(em, sign[None])
-            mag_p = jnp.moveaxis(planes[:, 2:], 1, 0)  # (P, S, W)
 
-            def body(carry, p_sw):
-                pc = bm.count(jnp.bitwise_and(pos, p_sw[None]))  # (C, S)
-                nc = bm.count(jnp.bitwise_and(neg, p_sw[None]))
+            def chunk_body(carry, sel):               # sel: (C, nf)
+                m = leaves[stack_is[0]][sel[:, 0]]    # (C, S, W)
+                for fi in range(1, len(stack_is)):
+                    m = jnp.bitwise_and(m,
+                                        leaves[stack_is[fi]][sel[:, fi]])
+                if filt is not None:
+                    m = jnp.bitwise_and(m, filt[None])
+                counts = bm.count(m)                  # (C, S)
+                if planes_i is None:
+                    return carry, (jnp.sum(counts, axis=1)
+                                   if reduce_ else counts)
+                planes = leaves[planes_i]             # (S, P, W)
+                exists, sign = planes[:, 0], planes[:, 1]
+                em = jnp.bitwise_and(m, exists[None])
+                nn = bm.count(em)                     # (C, S)
+                pos = em if not signed else \
+                    jnp.bitwise_and(em, ~sign[None])
+                neg = None if not signed else \
+                    jnp.bitwise_and(em, sign[None])
+                mag_p = jnp.moveaxis(planes[:, 2:], 1, 0)  # (P, S, W)
+
+                def body(c2, p_sw):
+                    pc = bm.count(jnp.bitwise_and(pos, p_sw[None]))
+                    nc = (jnp.zeros_like(pc) if neg is None else
+                          bm.count(jnp.bitwise_and(neg, p_sw[None])))
+                    if reduce_:
+                        pc, nc = jnp.sum(pc, axis=1), jnp.sum(nc, axis=1)
+                    return c2, (pc, nc)
+
+                _, (pos_pc, neg_pc) = jax.lax.scan(body, 0, mag_p)
+                c, n = counts, nn
                 if reduce_:
-                    pc, nc = jnp.sum(pc, axis=1), jnp.sum(nc, axis=1)
-                return carry, (pc, nc)
+                    c, n = jnp.sum(c, axis=1), jnp.sum(n, axis=1)
+                return carry, (c, n, pos_pc, neg_pc)
 
-            _, (pos_pc, neg_pc) = jax.lax.scan(body, 0, mag_p)
-            if reduce_:
-                counts, nn = jnp.sum(counts, axis=1), jnp.sum(nn, axis=1)
-            return counts, nn, pos_pc, neg_pc  # (C,),(C,),(P,C),(P,C)
+            _, ys = jax.lax.scan(chunk_body, 0, sel_all)
+            if planes_i is not None and reduce_:
+                c, n, p, g = ys  # one flat fetch instead of four
+                return jnp.concatenate(
+                    [c.ravel(), n.ravel(), p.ravel(), g.ravel()])
+            return ys  # leading axis = n_chunks on every output
     elif kind == "row_counts":
         rows_i, tree, reduce_ = plan[1], plan[2], plan[3]
 
@@ -803,43 +831,56 @@ class StackedEngine:
                     np.zeros((n_combos, depth), dtype=np.int64))
                 return np.zeros(n_combos, dtype=np.int64), zero_agg
         red = self._reduce_in_program(skey)
-        plan = ("groupby", stack_is, planes_i, tree, red)
-        combo_idx = np.asarray(combos, dtype=np.int32).reshape(
-            n_combos, len(fields_rows))
-        counts = np.zeros(n_combos, dtype=np.int64)
-        nn = pos = neg = None
+        # when no fragment holds any sign-plane bit (row_ids is cached
+        # per fragment version, so this is a dict sweep, not a scan),
+        # the program skips the sign-split and negative popcounts
+        # entirely.  Checked against the DATA, not options.min — value
+        # writes are not range-enforced, so a declared min>=0 field
+        # can still hold negatives.
+        signed = False
         if agg_field is not None:
-            nn = np.zeros(n_combos, dtype=np.int64)
-            pos = np.zeros((n_combos, depth), dtype=np.int64)
-            neg = np.zeros((n_combos, depth), dtype=np.int64)
-        for lo in range(0, n_combos, combo_chunk):
-            hi = min(lo + combo_chunk, n_combos)
-            sel = combo_idx[lo:hi]
-            if hi - lo < combo_chunk:  # pad: combo 0 re-counted, dropped
-                sel = np.concatenate(
-                    [sel, np.zeros((combo_chunk - (hi - lo),
-                                    len(fields_rows)), dtype=np.int32)])
-            params = tuple(b.params) + (sel,)
-            fn = _compiled(plan, kern=kernels.enabled()
-                           and not self.host_only)
-            out = fn(tuple(b.leaves), params)
-            if agg_field is None:
-                c = np.asarray(out, dtype=np.int64)
-                if not red:
-                    c = c.sum(axis=1)
-                counts[lo:hi] = c[: hi - lo]
-            else:
-                c, n_, p_, g_ = (np.asarray(x, dtype=np.int64)
-                                 for x in out)
-                if not red:
-                    c, n_ = c.sum(axis=1), n_.sum(axis=1)
-                    p_, g_ = p_.sum(axis=2), g_.sum(axis=2)
-                counts[lo:hi] = c[: hi - lo]
-                nn[lo:hi] = n_[: hi - lo]
-                pos[lo:hi] = p_.T[: hi - lo]  # (P, C) -> (C, P)
-                neg[lo:hi] = g_.T[: hi - lo]
-        agg = None if agg_field is None else (nn, pos, neg)
-        return counts, agg
+            frags = self._frags(idx, agg_field, agg_field.bsi_view,
+                                list(skey))
+            signed = any(fr is not None and 1 in fr.row_ids
+                         for fr in frags)
+        plan = ("groupby", stack_is, planes_i, tree, red, signed)
+        nf = len(fields_rows)
+        n_chunks = -(-n_combos // combo_chunk)
+        padded = n_chunks * combo_chunk
+        combo_idx = np.zeros((padded, nf), dtype=np.int32)
+        combo_idx[:n_combos] = np.asarray(
+            combos, dtype=np.int32).reshape(n_combos, nf)
+        # pad combos re-count combo 0; their rows are dropped below
+        sel_all = combo_idx.reshape(n_chunks, combo_chunk, nf)
+        fn = _compiled(plan, kern=kernels.enabled() and not self.host_only)
+        out = fn(tuple(b.leaves), tuple(b.params) + (sel_all,))
+        if agg_field is None:
+            c = np.asarray(out, dtype=np.int64)   # (n_chunks, C[, S])
+            if not red:
+                c = c.sum(axis=-1)
+            counts = c.reshape(-1)[:n_combos]
+            return counts, None
+        if red:
+            # one flat (2*K + 2*K*P,) fetch, split by layout
+            flat = np.asarray(out, dtype=np.int64)
+            k = padded
+            c = flat[:k]
+            n_ = flat[k:2 * k]
+            p_ = flat[2 * k:2 * k + k * depth].reshape(
+                n_chunks, depth, combo_chunk)
+            g_ = flat[2 * k + k * depth:].reshape(
+                n_chunks, depth, combo_chunk)
+        else:
+            c, n_, p_, g_ = (np.asarray(x, dtype=np.int64) for x in out)
+            # unreduced: trailing S axis summed here
+            c, n_ = c.sum(axis=-1), n_.sum(axis=-1)
+            p_, g_ = p_.sum(axis=-1), g_.sum(axis=-1)
+        counts = c.reshape(-1)[:n_combos]
+        nn = n_.reshape(-1)[:n_combos]
+        # (n_chunks, P, C) -> (n_chunks*C, P)
+        pos = p_.transpose(0, 2, 1).reshape(-1, depth)[:n_combos]
+        neg = g_.transpose(0, 2, 1).reshape(-1, depth)[:n_combos]
+        return counts, (nn, pos, neg)
 
     # shards decoded per device call in decode_stream: bounds the
     # (4, S_chunk, 2^20)-int32 decode output to ~1 GiB at full width

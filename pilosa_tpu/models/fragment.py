@@ -63,6 +63,10 @@ class Fragment:
         # bumps it, and device-side stack caches (executor/stacked.py
         # TileStackCache) compare stamps to detect staleness
         self.version = 0
+        # row_ids is hot on TopN/Rows scans (954 shards x R rows of
+        # .any() sweeps = ~GB of host traffic per query); cache it
+        # under the same version stamp the device tile cache uses
+        self._row_ids_cache: tuple[int, list[int]] | None = None
         # rows changed since the last storage sync (persisted by
         # IndexStorage.write_fragments; empty when storage is None)
         self.dirty_rows: set[int] = set()
@@ -388,9 +392,14 @@ class Fragment:
 
     @property
     def row_ids(self) -> list[int]:
+        cached = self._row_ids_cache
+        if cached is not None and cached[0] == self.version:
+            return list(cached[1])
         ids = [r for r, w in self._rows.items() if w.any()]
         ids += [r for r, a in self._sparse.items() if a.size]
-        return sorted(ids)
+        ids.sort()
+        self._row_ids_cache = (self.version, ids)
+        return list(ids)
 
     def max_row_id(self) -> int:
         ids = self.row_ids

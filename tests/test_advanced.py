@@ -121,6 +121,39 @@ class TestGroupBy:
         for g in got:
             assert g.agg == expect_sum[g.group[0]["row_id"]]
 
+    def test_groupby_sum_negative_values_despite_min_zero(
+            self, holder, ex, rng):
+        """The unsigned fast path must key on the sign plane's DATA,
+        not options.min — writes are not range-enforced, so a declared
+        min>=0 field can still hold negatives (r03 review)."""
+        idx = holder.create_index("i")
+        idx.create_field("g")
+        idx.create_field("q", FieldOptions(type=FieldType.INT,
+                                           min=0, max=100))
+        idx.field("g").import_bits([0, 0], [1, 2])
+        idx.field("q").import_values([1, 2], [-7, 5])
+        idx.mark_columns_exist([1, 2])
+        got = ex.execute("i", "GroupBy(Rows(g), aggregate=Sum(field=q))")[0]
+        assert got[0].agg == -2
+
+    def test_groupby_sum_unsigned_data_fast_path(self, holder, ex, rng):
+        """All-positive data exercises the skip-negative-planes path
+        and must stay exact."""
+        idx = holder.create_index("i")
+        idx.create_field("g")
+        idx.create_field("q", FieldOptions(type=FieldType.INT,
+                                           min=0, max=100))
+        cols = list(range(1, 40))
+        vals = [int(v) for v in rng.integers(0, 100, size=len(cols))]
+        idx.field("g").import_bits([c % 3 for c in cols], cols)
+        idx.field("q").import_values(cols, vals)
+        idx.mark_columns_exist(cols)
+        got = ex.execute("i", "GroupBy(Rows(g), aggregate=Sum(field=q))")[0]
+        expect = {}
+        for c, v in zip(cols, vals):
+            expect[c % 3] = expect.get(c % 3, 0) + v
+        assert {g.group[0]["row_id"]: g.agg for g in got} == expect
+
     def test_groupby_having_limit(self, holder, ex, rng):
         idx, data = make_data(holder, ex, rng)
         from collections import Counter
