@@ -757,13 +757,8 @@ class Executor(AdvancedOps):
             tr = f.row_translator
             if tr is None:
                 raise ExecError("Rows(like=) requires a keyed field")
-            import re as _re
-            # LIKE pattern per like.go: % = any run, _ = single char
-            pat = _re.compile(
-                "^" + "".join(
-                    ".*" if ch == "%" else "." if ch == "_"
-                    else _re.escape(ch) for ch in like) + "$",
-                _re.DOTALL)
+            from pilosa_tpu.pql.like import like_regex
+            pat = like_regex(like)
             ids &= set(tr.match(lambda k: pat.match(k) is not None))
         out = sorted(ids)
         if previous is not None:

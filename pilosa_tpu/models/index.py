@@ -87,6 +87,46 @@ class Index:
     def field(self, name: str) -> Field | None:
         return self.fields.get(name)
 
+    def rename_field(self, old: str, new: str):
+        """ALTER TABLE .. RENAME COLUMN old TO new (sql3/planner/
+        compilealtertable.go): renames the field in the schema, moves
+        its key-translator directory, and rewrites its persisted
+        bitmaps under the new name."""
+        from pilosa_tpu.models.view import bsi_view_name
+        with self._lock:
+            f = self.fields.get(old)
+            if f is None:
+                raise ValueError(f"field not found: {old}")
+            if new in self.fields or new == EXISTENCE_FIELD:
+                raise ValueError(f"field already exists: {new}")
+            del self.fields[old]
+            f.name = new
+            self.fields[new] = f
+            # move the key-translator dir; open handles survive a
+            # POSIX rename
+            oldp, newp = self._field_path(old), self._field_path(new)
+            if oldp and os.path.isdir(oldp):
+                os.rename(oldp, newp)
+            if f.path:
+                f.path = newp
+            old_bsi, new_bsi = bsi_view_name(old), bsi_view_name(new)
+            for vn in list(f.views):
+                v = f.views[vn]
+                v.field_name = new
+                nvn = new_bsi if vn == old_bsi else vn
+                for frag in v.fragments.values():
+                    frag.field_name = new
+                    frag.view_name = nvn
+                    # rewrite every row under the new bitmap name
+                    frag.dirty_rows.update(frag._rows)
+                    frag.dirty_rows.update(frag._sparse)
+                if nvn != vn:
+                    v.name = nvn
+                    f.views[nvn] = f.views.pop(vn)
+        if self.storage is not None:
+            self.sync()
+            self.storage.delete_field_bitmaps(old)
+
     def delete_field(self, name: str):
         with self._lock:
             f = self.fields.pop(name, None)

@@ -1,0 +1,19 @@
+"""LIKE-pattern matching shared by the PQL Rows(like=) path and the
+SQL residue evaluator (like.go:13 planLike semantics: ``%`` matches
+any run, ``_`` exactly one character)."""
+
+from __future__ import annotations
+
+import re
+
+
+def like_regex(pattern: str) -> re.Pattern:
+    return re.compile(
+        "^" + "".join(
+            ".*" if ch == "%" else "." if ch == "_" else re.escape(ch)
+            for ch in pattern) + "$",
+        re.DOTALL)
+
+
+def like_match(value: str, pattern: str) -> bool:
+    return like_regex(pattern).match(value) is not None

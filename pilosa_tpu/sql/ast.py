@@ -61,6 +61,23 @@ class ShowColumns:
 
 
 @dataclass
+class ShowCreateTable:
+    table: str = ""
+
+
+@dataclass
+class AlterTable:
+    """ALTER TABLE t ADD [COLUMN] def | DROP [COLUMN] name |
+    RENAME [COLUMN] old TO new (sql3/parser AlterTableStatement,
+    ast.go:1596; compiled by sql3/planner/compilealtertable.go)."""
+    table: str
+    op: str                       # add | drop | rename
+    column: ColumnDef | None = None   # add
+    name: str = ""                # drop: column; rename: old name
+    new_name: str = ""            # rename
+
+
+@dataclass
 class Insert:
     table: str
     columns: list[str]
@@ -103,6 +120,16 @@ class BinOp:
     op: str              # = != < <= > >= and or like
     left: Any
     right: Any
+
+
+@dataclass
+class Func:
+    """Scalar function call — the reference's built-in function
+    surface (sql3/planner/expressionanalyzercall.go case list;
+    implementations in inbuiltfunctions{string,date,set}.go) plus
+    user-defined functions (userdefinedfunctions.go)."""
+    name: str            # canonical upper-case
+    args: list = field(default_factory=list)
 
 
 @dataclass

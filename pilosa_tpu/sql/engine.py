@@ -121,11 +121,14 @@ class SQLEngine:
 
     def _stmt_access(self, stmt) -> tuple[str | None, str]:
         """(table, needed-permission) for one statement."""
-        if isinstance(stmt, (ast.Select, ast.ShowColumns)):
+        if isinstance(stmt, (ast.Select, ast.ShowColumns,
+                             ast.ShowCreateTable)):
             # a view's access rides its underlying table
             v = self._views.get(stmt.table) if isinstance(
                 stmt, ast.Select) else None
             return (v.table if v is not None else stmt.table), "read"
+        if isinstance(stmt, ast.AlterTable):
+            return stmt.table, "write"
         if isinstance(stmt, ast.CreateView):
             return stmt.select.table, "read"
         if isinstance(stmt, (ast.DropView, ast.ShowViews)):
@@ -189,6 +192,10 @@ class SQLEngine:
                              rows=[(n,) for n in names])
         if isinstance(stmt, ast.ShowColumns):
             return self._show_columns(stmt)
+        if isinstance(stmt, ast.ShowCreateTable):
+            return self._show_create_table(stmt)
+        if isinstance(stmt, ast.AlterTable):
+            return self._alter_table(stmt)
         if isinstance(stmt, ast.CreateView):
             if stmt.name in self._views or \
                     self.holder.index(stmt.name) is not None:
@@ -287,6 +294,55 @@ class SQLEngine:
         rows += [(f.name, _sql_type(f)) for f in idx.public_fields()]
         return SQLResult(schema=[("name", "string"), ("type", "string")],
                          rows=rows)
+
+    def _show_create_table(self, stmt: ast.ShowCreateTable) -> SQLResult:
+        """Canonical DDL round-trip: the emitted statement re-parses to
+        an equivalent table (sql3's SHOW CREATE TABLE)."""
+        idx = self._index(stmt.table)
+        defs = [f"_id {'string' if idx.keys else 'id'}"]
+        for f in idx.public_fields():
+            t = _sql_type(f)
+            d = f"{f.name} {t}"
+            o = f.options
+            if t == "decimal" and o.scale:
+                d += f"({o.scale})"
+            if t == "int":
+                if o.min is not None:
+                    d += f" min {o.min}"
+                if o.max is not None:
+                    d += f" max {o.max}"
+            if o.type == FieldType.TIME and o.time_quantum:
+                d += f" timequantum '{o.time_quantum}'"
+            defs.append(d)
+        ddl = f"CREATE TABLE {idx.name} ({', '.join(defs)})"
+        return SQLResult(schema=[("ddl", "string")], rows=[(ddl,)])
+
+    def _alter_table(self, stmt: ast.AlterTable) -> SQLResult:
+        """ALTER TABLE ADD/DROP/RENAME COLUMN (sql3/planner/
+        compilealtertable.go)."""
+        idx = self._index(stmt.table)
+        if stmt.op == "add":
+            cd = stmt.column
+            if cd.name == "_id":
+                raise SQLError("cannot add _id")
+            if idx.field(cd.name) is not None:
+                raise SQLError(f"column already exists: {cd.name}")
+            idx.create_field(cd.name, self._field_options(cd))
+        elif stmt.op == "drop":
+            if stmt.name == "_id":
+                raise SQLError("cannot drop _id")
+            if idx.field(stmt.name) is None:
+                raise SQLError(f"column not found: {stmt.name}")
+            idx.delete_field(stmt.name)
+        else:  # rename
+            if "_id" in (stmt.name, stmt.new_name):
+                raise SQLError("cannot rename _id")
+            try:
+                idx.rename_field(stmt.name, stmt.new_name)
+            except ValueError as e:
+                raise SQLError(str(e)) from e
+        self.holder.save_schema()
+        return SQLResult()
 
     # -- DML ------------------------------------------------------------
 
@@ -474,9 +530,109 @@ class SQLEngine:
         return f
 
     def _compile_where(self, idx, where) -> Call:
+        """WHERE → PQL with host residue: conjuncts that compile to
+        PQL ops push down (the PlanOpPQLTableScan filter push); the
+        rest — scalar functions, arithmetic — evaluate row-wise over
+        the pushed result and fold back as a ConstRow of matching ids
+        (the reference evaluates non-pushable filters row-wise in
+        PlanOpFilter, sql3/planner/opfilter.go)."""
         if where is None:
             return Call("All")
-        return self._where(idx, where)
+        where = self._fold_subqueries(where)
+        push, residue = self._split_where(where)
+        filt = self._where(idx, push) if push is not None else Call("All")
+        if residue is None:
+            return filt
+        ids = self._residue_ids(idx, filt, residue)
+        return Call("ConstRow", args={"columns": ids})
+
+    def _fold_subqueries(self, e):
+        """Replace scalar SubQuery nodes with their evaluated literal
+        (uncorrelated — they run once at compile time)."""
+        if isinstance(e, ast.SubQuery):
+            return ast.Lit(self._scalar_subquery(e.select))
+        if isinstance(e, ast.BinOp):
+            return ast.BinOp(e.op, self._fold_subqueries(e.left),
+                             self._fold_subqueries(e.right))
+        if isinstance(e, ast.Not):
+            return ast.Not(self._fold_subqueries(e.expr))
+        if isinstance(e, ast.Func):
+            return ast.Func(e.name,
+                            [self._fold_subqueries(x) for x in e.args])
+        if isinstance(e, ast.Between):
+            return ast.Between(self._fold_subqueries(e.col),
+                               self._fold_subqueries(e.lo),
+                               self._fold_subqueries(e.hi),
+                               negated=e.negated)
+        return e
+
+    _CMP_OPS = ("=", "!=", "<", "<=", ">", ">=", "like")
+
+    def _is_pushable(self, e) -> bool:
+        """True when `_where` can compile e to a PQL tree directly."""
+        if isinstance(e, ast.BinOp):
+            if e.op in ("and", "or"):
+                return self._is_pushable(e.left) and \
+                    self._is_pushable(e.right)
+            if e.op not in self._CMP_OPS:
+                return False  # arithmetic / concat
+            sides = (e.left, e.right)
+            return any(isinstance(s, ast.Col) for s in sides) and \
+                any(isinstance(s, ast.Lit) for s in sides)
+        if isinstance(e, ast.Not):
+            return self._is_pushable(e.expr)
+        if isinstance(e, (ast.InList, ast.InSelect, ast.IsNull)):
+            return isinstance(e.col, ast.Col)
+        if isinstance(e, ast.Between):
+            return isinstance(e.col, ast.Col) and \
+                isinstance(e.lo, ast.Lit) and isinstance(e.hi, ast.Lit)
+        if isinstance(e, ast.Func):
+            # SETCONTAINS* over (column, literal) become Row filters
+            return e.name in ("SETCONTAINS", "SETCONTAINSANY",
+                              "SETCONTAINSALL") and len(e.args) == 2 \
+                and isinstance(e.args[0], ast.Col) \
+                and isinstance(e.args[1], ast.Lit)
+        return False
+
+    def _split_where(self, e):
+        """(pushable, residue) — split at top-level ANDs only."""
+        if self._is_pushable(e):
+            return e, None
+        if isinstance(e, ast.BinOp) and e.op == "and":
+            lp, lr = self._split_where(e.left)
+            rp, rr = self._split_where(e.right)
+            push = lp if rp is None else rp if lp is None else \
+                ast.BinOp("and", lp, rp)
+            res = lr if rr is None else rr if lr is None else \
+                ast.BinOp("and", lr, rr)
+            return push, res
+        return None, e
+
+    def _residue_ids(self, idx, filt: Call, residue) -> list[int]:
+        """Evaluate a host-only predicate over the rows matching the
+        pushed filter; return the surviving column ids."""
+        from pilosa_tpu.sql.funcs import Evaluator, _truthy, columns_in
+        cols = sorted(n for n in columns_in(residue) if n != "_id")
+        for n in cols:
+            self._field(idx, n)  # validate
+        c = Call("Extract", children=[filt] + [
+            Call("Rows", args={"_field": n}) for n in cols])
+        table = self.executor._execute_call(idx, c, None)
+        ev = Evaluator(udfs=self._udf_callables())
+        out = []
+        for entry in table.columns:
+            env = {n: self._to_sql_value(entry["rows"][i])
+                   for i, n in enumerate(cols)}
+            env["_id"] = entry.get("column_key", entry["column"])
+            v = ev.eval(residue, env)
+            # strict boolean context (funcs._truthy): a non-boolean
+            # predicate (WHERE region) is a type error, not truthiness
+            if v is not None and _truthy(v):
+                out.append(int(entry["column"]))
+        return out
+
+    def _udf_callables(self) -> dict:
+        return {}
 
     @staticmethod
     def _has_filter(filt: Call) -> bool:
@@ -522,6 +678,27 @@ class SQLEngine:
             return Call("Row", args={name: Condition("><", [lo, hi])})
         if isinstance(e, ast.IsNull):
             return self._is_null(idx, e)
+        if isinstance(e, ast.Func) and e.name.startswith("SETCONTAINS"):
+            # membership pushdown (inbuiltfunctionsset.go →
+            # expressionpql.go): SETCONTAINS(col, v) is Row(col=v);
+            # ANY unions, ALL intersects
+            name = self._col_name(e.args[0])
+            f = self._field(idx, name)
+            if f.options.type.is_bsi:
+                raise SQLError(f"{e.name} requires a set column")
+            val = e.args[1].value
+            if e.name == "SETCONTAINS":
+                vals = [val]
+            else:
+                vals = val if isinstance(val, list) else [val]
+            rows = [Call("Row", args={name: v}) for v in vals]
+            if not rows:
+                return Call("All") if e.name == "SETCONTAINSALL" \
+                    else Call("ConstRow", args={"columns": []})
+            if len(rows) == 1:
+                return rows[0]
+            return Call("Union" if e.name == "SETCONTAINSANY"
+                        else "Intersect", children=rows)
         raise SQLError(f"unsupported WHERE expression {e!r}")
 
     def _col_name(self, e) -> str:
@@ -544,17 +721,9 @@ class SQLEngine:
         return vals[0] if vals else None
 
     def _comparison(self, idx, e: ast.BinOp) -> Call:
-        # normalize literal-on-left; resolve scalar subqueries first
+        # normalize literal-on-left (scalar subqueries were already
+        # folded to literals by _compile_where's _fold_subqueries pass)
         left, right, op = e.left, e.right, e.op
-        if isinstance(left, ast.SubQuery) or isinstance(right, ast.SubQuery):
-            if isinstance(left, ast.SubQuery):
-                left = ast.Lit(self._scalar_subquery(left.select))
-            if isinstance(right, ast.SubQuery):
-                right = ast.Lit(self._scalar_subquery(right.select))
-            # comparison with a NULL scalar is UNKNOWN -> matches nothing
-            for side in (left, right):
-                if isinstance(side, ast.Lit) and side.value is None:
-                    return Call("ConstRow", args={"columns": []})
         if isinstance(left, ast.Lit) and isinstance(right, ast.Col):
             left, right = right, left
             op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
@@ -562,6 +731,10 @@ class SQLEngine:
         if not isinstance(right, ast.Lit):
             raise SQLError("comparison requires a literal")
         val = right.value
+        if val is None:
+            # strict SQL: comparison with NULL is UNKNOWN -> matches
+            # nothing (use IS NULL for null tests)
+            return Call("ConstRow", args={"columns": []})
         if name == "_id":
             cid = self._col_id(idx, val, create=False)
             cols = [cid] if cid is not None else []
@@ -736,7 +909,43 @@ class SQLEngine:
             inner = e.arg.name if e.arg else "*"
             d = "distinct " if e.distinct else ""
             return f"{e.func}({d}{inner})"
+        if isinstance(e, ast.Func):
+            return e.name.lower()
         return "expr"
+
+    def _expr_type(self, idx, e) -> str:
+        """Result SQL type of a scalar expression (the reference sets
+        ResultDataType during analysis, expressionanalyzercall.go)."""
+        from pilosa_tpu.sql.funcs import FUNC_TYPES
+        if isinstance(e, ast.Lit):
+            v = e.value
+            if isinstance(v, bool):
+                return "bool"
+            if isinstance(v, int):
+                return "int"
+            if v is None or isinstance(v, str):
+                return "string"
+            return "decimal"
+        if isinstance(e, ast.Col):
+            if e.name == "_id":
+                return "string" if idx.keys else "id"
+            return _sql_type(self._field(idx, e.name))
+        if isinstance(e, ast.Func):
+            if e.name in self._udf_types():
+                return self._udf_types()[e.name]
+            return FUNC_TYPES.get(e.name, "string")
+        if isinstance(e, ast.BinOp):
+            if e.op == "||":
+                return "string"
+            if e.op in ("+", "-", "*", "/", "%"):
+                lt = self._expr_type(idx, e.left)
+                rt = self._expr_type(idx, e.right)
+                return "decimal" if "decimal" in (lt, rt) else "int"
+            return "bool"
+        return "bool"  # Not/IsNull/InList/Between
+
+    def _udf_types(self) -> dict:
+        return {}
 
     def _select_aggregates(self, idx, stmt, items, filt) -> SQLResult:
         ex = self.executor
@@ -1004,31 +1213,63 @@ class SQLEngine:
         return SQLResult(schema=schema, rows=rows)
 
     def _select_rows(self, idx, stmt, items, filt) -> SQLResult:
-        names = [self._col_name(it.expr) for it in items]
-        for n in names:
-            if n != "_id":
-                self._field(idx, n)  # validate before executing
-        non_id = [n for n in names if n != "_id"]
+        from pilosa_tpu.sql.funcs import Evaluator, columns_in
+        items = [ast.SelectItem(self._fold_subqueries(it.expr), it.alias)
+                 for it in items]
+        # classify projections: plain columns ride the Extract
+        # directly; scalar expressions evaluate row-wise over it
+        plans = []   # ("id",) | ("col", name) | ("expr", e)
+        ref_cols: set[str] = set()
+        for it in items:
+            e = it.expr
+            if isinstance(e, ast.Col):
+                if e.name == "_id":
+                    plans.append(("id",))
+                else:
+                    self._field(idx, e.name)
+                    ref_cols.add(e.name)
+                    plans.append(("col", e.name))
+            else:
+                for n in columns_in(e):
+                    if n != "_id":
+                        self._field(idx, n)
+                        ref_cols.add(n)
+                plans.append(("expr", e))
+        non_id = sorted(ref_cols)
+        names = [self._name_of(it) for it in items]
         order_col = None
+        order_expr = None  # non-column ORDER BY key (host-evaluated)
         multi_order = stmt.order_by and len(stmt.order_by) > 1
         if multi_order:
             # multi-key: materialize unordered, then the shared host
             # sort (_order_rows) applies every key; keys must be
             # projected.  LIMIT stays host-side (applies after sort).
-            for ob in stmt.order_by:
-                if self._col_name(ob.expr) not in names:
-                    raise SQLError(
-                        "multi-key ORDER BY columns must be projected")
+            pass  # name matching happens in _order_rows
         elif stmt.order_by:
             ob = stmt.order_by[0]
-            order_col = self._col_name(ob.expr)
+            if isinstance(ob.expr, ast.Col):
+                order_col = ob.expr.name
+            else:
+                order_expr = self._fold_subqueries(ob.expr)
+                for n in columns_in(order_expr):
+                    if n != "_id":
+                        self._field(idx, n)
+                        ref_cols.add(n)
+                non_id = sorted(ref_cols)
         # pushdown: ORDER BY on BSI column → Sort; plain LIMIT → Limit.
         # LIMIT must stay host-side under DISTINCT (dedup shrinks the
         # row set, so a pushed limit would under-return).
         inner = filt
         host_sort = False
+        order_alias = None  # ORDER BY a projected alias / output name
         null_tail = None  # rows where the BSI sort column is NULL
-        if order_col is not None and order_col != "_id":
+        if order_expr is not None:
+            host_sort = True
+        elif order_col is not None and order_col != "_id" and \
+                idx.field(order_col) is None and order_col in names:
+            order_alias = names.index(order_col)
+            host_sort = True
+        elif order_col is not None and order_col != "_id":
             f = self._field(idx, order_col)
             if f.options.type.is_bsi:
                 args = {"_field": order_col}
@@ -1052,7 +1293,8 @@ class SQLEngine:
                 "limit": stmt.limit + (stmt.offset or 0)}, children=[filt])
 
         extract_cols = list(non_id)
-        if host_sort and order_col not in names and order_col != "_id":
+        if host_sort and order_expr is None and order_alias is None \
+                and order_col != "_id" and order_col not in extract_cols:
             extract_cols.append(order_col)  # fetched for sorting only
         def run_extract(src):
             c = Call("Extract", children=[src] + [
@@ -1067,28 +1309,43 @@ class SQLEngine:
             table.columns.extend(run_extract(null_tail).columns)
 
         schema = []
-        for it in items:
-            n = self._col_name(it.expr)
-            if n == "_id":
+        for it, plan in zip(items, plans):
+            if plan[0] == "id":
                 schema.append((self._name_of(it),
                                "string" if idx.keys else "id"))
+            elif plan[0] == "col":
+                schema.append((self._name_of(it),
+                               _sql_type(self._field(idx, plan[1]))))
             else:
                 schema.append((self._name_of(it),
-                               _sql_type(self._field(idx, n))))
+                               self._expr_type(idx, plan[1])))
+        ev = Evaluator(udfs=self._udf_callables())
         rows = []
         sort_keys = []
         for entry in table.columns:
+            env = None
+            if order_expr is not None or \
+                    any(p[0] == "expr" for p in plans):
+                env = {n: self._to_sql_value(entry["rows"][i])
+                       for i, n in enumerate(extract_cols)}
+                env["_id"] = entry.get("column_key", entry["column"])
             vals = []
-            for it in items:
-                n = self._col_name(it.expr)
-                if n == "_id":
+            for plan in plans:
+                if plan[0] == "id":
                     vals.append(entry.get("column_key", entry["column"]))
+                elif plan[0] == "col":
+                    vals.append(self._to_sql_value(
+                        entry["rows"][extract_cols.index(plan[1])]))
                 else:
                     vals.append(self._to_sql_value(
-                        entry["rows"][extract_cols.index(n)]))
+                        ev.eval(plan[1], env)))
             rows.append(tuple(vals))
             if host_sort:
-                if order_col == "_id":
+                if order_expr is not None:
+                    k = ev.eval(order_expr, env)
+                elif order_alias is not None:
+                    k = vals[order_alias]
+                elif order_col == "_id":
                     k = entry.get("column_key", entry["column"])
                 else:
                     k = entry["rows"][extract_cols.index(order_col)]

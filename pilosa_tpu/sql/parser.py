@@ -88,6 +88,8 @@ class Parser:
             return self.delete()
         if t.value == "select":
             return self.select()
+        if t.value == "alter":
+            return self.alter_table()
         raise SQLError(f"unsupported statement {t.value!r}")
 
     def create_table(self):
@@ -105,32 +107,58 @@ class Parser:
         cols = []
         keys = False
         while True:
-            cname = self.expect("ident").value
-            ctype = self.next().value.lower()
-            if ctype not in _TYPES:
-                raise SQLError(f"unknown column type {ctype!r}")
-            cd = ast.ColumnDef(cname, ctype)
-            if ctype == "decimal" and self.accept("op", "("):
-                cd.scale = int(self.expect("number").value)
-                self.expect("op", ")")
-            # column constraints subset: min/max for int ("min"/"max"
-            # lex as keywords, "timequantum" as an ident)
-            while self.peek().kind in ("ident", "keyword") and \
-                    self.peek().value.lower() in ("min", "max", "timequantum"):
-                opt = self.next().value.lower()
-                if opt == "timequantum":
-                    cd.time_quantum = self.expect("string").value
-                else:
-                    v = int(self.expect("number").value)
-                    setattr(cd, opt, v)
-            if cname == "_id":
-                keys = ctype == "string"
+            cd = self.column_def()
+            if cd.name == "_id":
+                keys = cd.type == "string"
             cols.append(cd)
             if not self.accept("op", ","):
                 break
         self.expect("op", ")")
         return ast.CreateTable(name, cols, keys=keys,
                                if_not_exists=if_not_exists)
+
+    def column_def(self) -> ast.ColumnDef:
+        cname = self.expect("ident").value
+        ctype = self.next().value.lower()
+        if ctype not in _TYPES:
+            raise SQLError(f"unknown column type {ctype!r}")
+        cd = ast.ColumnDef(cname, ctype)
+        if ctype == "decimal" and self.accept("op", "("):
+            cd.scale = int(self.expect("number").value)
+            self.expect("op", ")")
+        # column constraints subset: min/max for int ("min"/"max"
+        # lex as keywords, "timequantum" as an ident)
+        while self.peek().kind in ("ident", "keyword") and \
+                self.peek().value.lower() in ("min", "max", "timequantum"):
+            opt = self.next().value.lower()
+            if opt == "timequantum":
+                cd.time_quantum = self.expect("string").value
+            else:
+                v = int(self.expect("number").value)
+                setattr(cd, opt, v)
+        return cd
+
+    def alter_table(self):
+        """ALTER TABLE t ADD [COLUMN] def | DROP [COLUMN] name |
+        RENAME [COLUMN] old TO new (sql3/parser AlterTableStatement)."""
+        self.expect_kw("alter")
+        self.expect_kw("table")
+        table = self.expect("ident").value
+        if self.kw("add"):
+            self.kw("column")
+            return ast.AlterTable(table, "add", column=self.column_def())
+        if self.kw("drop"):
+            self.kw("column")
+            return ast.AlterTable(table, "drop",
+                                  name=self.expect("ident").value)
+        if self.ctx_kw("rename"):
+            self.kw("column")
+            old = self.expect("ident").value
+            if not self.ctx_kw("to"):
+                raise SQLError("expected TO in RENAME COLUMN")
+            return ast.AlterTable(table, "rename", name=old,
+                                  new_name=self.expect("ident").value)
+        raise SQLError("expected ADD, DROP or RENAME after ALTER TABLE")
 
     def _create_view(self):
         if_not_exists = False
@@ -168,7 +196,11 @@ class Parser:
         if self.kw("columns"):
             self.expect_kw("from")
             return ast.ShowColumns(self.expect("ident").value)
-        raise SQLError("expected TABLES, VIEWS or COLUMNS after SHOW")
+        if self.kw("create"):
+            self.expect_kw("table")
+            return ast.ShowCreateTable(self.expect("ident").value)
+        raise SQLError(
+            "expected TABLES, VIEWS, COLUMNS or CREATE TABLE after SHOW")
 
     def insert(self):
         replace = False
@@ -357,14 +389,14 @@ class Parser:
         return self.cmp_expr()
 
     def cmp_expr(self):
-        left = self.primary()
+        left = self.add_expr()
         t = self.peek()
         if t.kind == "op" and t.value in ("=", "!=", "<>", "<", "<=", ">",
                                           ">="):
             op = self.next().value
             if op == "<>":
                 op = "!="
-            return ast.BinOp(op, left, self.primary())
+            return ast.BinOp(op, left, self.add_expr())
         if t.kind == "keyword":
             negated = False
             if t.value == "not":
@@ -394,15 +426,45 @@ class Parser:
                 node = ast.BinOp("like", left, ast.Lit(pat))
                 return ast.Not(node) if negated else node
             if self.kw("between"):
-                lo = self.primary()
+                lo = self.add_expr()
                 self.expect_kw("and")
-                hi = self.primary()
+                hi = self.add_expr()
                 return ast.Between(left, lo, hi, negated=negated)
             if self.kw("is"):
                 negated = bool(self.kw("not"))
                 self.expect_kw("null")
                 return ast.IsNull(left, negated=negated)
         return left
+
+    def add_expr(self):
+        """+ - and || (string concat) — the additive precedence level
+        of sql3/parser's expression grammar."""
+        left = self.mul_expr()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("+", "-", "||"):
+                op = self.next().value
+                left = ast.BinOp(op, left, self.mul_expr())
+            else:
+                return left
+
+    def mul_expr(self):
+        left = self.unary_expr()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("*", "/", "%"):
+                op = self.next().value
+                left = ast.BinOp(op, left, self.unary_expr())
+            else:
+                return left
+
+    def unary_expr(self):
+        if self.accept("op", "-"):
+            e = self.unary_expr()
+            if isinstance(e, ast.Lit) and isinstance(e.value, (int, Decimal)):
+                return ast.Lit(-e.value)
+            return ast.BinOp("-", ast.Lit(0), e)
+        return self.primary()
 
     def primary(self):
         t = self.peek()
@@ -414,12 +476,22 @@ class Parser:
                 self.expect("op", ")")
                 return ast.SubQuery(sub)
             e = self.expr()
+            if self.accept("op", ","):
+                # parenthesized tuple (set literal): every element must
+                # be literal — (1, 2) / ('a', 'b') for SETCONTAINSANY etc.
+                items = [self._lit_of(e)]
+                while True:
+                    items.append(self._lit_of(self.expr()))
+                    if not self.accept("op", ","):
+                        break
+                self.expect("op", ")")
+                return ast.Lit(items)
             self.expect("op", ")")
             return e
         if t.kind == "keyword" and t.value in ("count", "sum", "min", "max",
                                                "avg", "percentile"):
             return self.aggregate()
-        if t.kind == "number" or (t.kind == "op" and t.value == "-"):
+        if t.kind == "number":
             return ast.Lit(self.literal_value())
         if t.kind == "string":
             return ast.Lit(self.next().value)
@@ -429,10 +501,31 @@ class Parser:
                             "null": None}[t.value])
         if t.kind == "ident":
             name = self.next().value
+            if self.peek().kind == "op" and self.peek().value == "(":
+                return self.func_call(name)
             if self.accept("op", "."):
                 return ast.Col(self.expect("ident").value, table=name)
             return ast.Col(name)
         raise SQLError(f"unexpected {t.value!r} at {t.pos}")
+
+    @staticmethod
+    def _lit_of(e):
+        if not isinstance(e, ast.Lit):
+            raise SQLError("tuple literals must contain only literals")
+        return e.value
+
+    def func_call(self, name: str):
+        """Scalar function call NAME(arg, ...) — names stay usable as
+        plain identifiers elsewhere (contextual, like sql3's Call)."""
+        self.expect("op", "(")
+        args = []
+        if not self.accept("op", ")"):
+            while True:
+                args.append(self.expr())
+                if not self.accept("op", ","):
+                    break
+            self.expect("op", ")")
+        return ast.Func(name.upper(), args)
 
     def aggregate(self):
         func = self.next().value

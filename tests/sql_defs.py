@@ -387,4 +387,178 @@ CASES = [
      "SELECT _id FROM orders WHERE region = 'south'", []),
     ("delete_all",
      "DELETE FROM orders; SELECT count(*) FROM orders", 0),
+
+    # ---- scalar functions: string (inbuiltfunctionsstring.go) -----------
+    ("fn_upper_lower",
+     "SELECT UPPER(region), LOWER(status) FROM orders WHERE _id = 1",
+     [("WEST", "open")]),
+    ("fn_reverse", "SELECT REVERSE(region) FROM orders WHERE _id = 3",
+     [("tsae",)]),
+    ("fn_len_in_where", "SELECT _id FROM orders WHERE LEN(region) = 5",
+     [(5,), (6,)]),
+    ("fn_substring",
+     "SELECT SUBSTRING(region, 0, 2) FROM orders WHERE _id = 5",
+     [("no",)]),
+    ("fn_substring_no_len",
+     "SELECT SUBSTRING(region, 1) FROM orders WHERE _id = 5",
+     [("orth",)]),
+    ("fn_substring_out_of_range",
+     "SELECT SUBSTRING(region, 99) FROM orders WHERE _id = 5",
+     ("error", "out of range")),
+    ("fn_char_ascii",
+     "SELECT CHAR(119), ASCII('w') FROM orders WHERE _id = 1",
+     [("w", 119)]),
+    ("fn_charindex",
+     "SELECT CHARINDEX('s', region), CHARINDEX('zz', region) "
+     "FROM orders WHERE _id = 1", [(2, -1)]),
+    ("fn_trim_family",
+     "SELECT TRIM('  x  '), LTRIM('  x'), RTRIM('x  ') "
+     "FROM orders WHERE _id = 1", [("x", "x", "x")]),
+    ("fn_prefix_suffix",
+     "SELECT PREFIX(region, 2), SUFFIX(region, 2) "
+     "FROM orders WHERE _id = 1", [("we", "st")]),
+    ("fn_replicate_space",
+     "SELECT REPLICATE('ab', 3), LEN(SPACE(4)) "
+     "FROM orders WHERE _id = 1", [("ababab", 4)]),
+    ("fn_replaceall",
+     "SELECT REPLACEALL(region, 'w', 'b') FROM orders WHERE _id = 1",
+     [("best",)]),
+    ("fn_stringsplit",
+     "SELECT STRINGSPLIT('a,b,c', ','), STRINGSPLIT('a,b,c', ',', 2), "
+     "STRINGSPLIT('a,b,c', ',', 9) FROM orders WHERE _id = 1",
+     [("a", "c", "")]),
+    ("fn_format",
+     "SELECT FORMAT('%s-%d', region, qty) FROM orders WHERE _id = 2",
+     [("west-12",)]),
+    ("fn_str",
+     "SELECT STR(qty, 4), STR(qty, 2) FROM orders WHERE _id = 2",
+     [("  12", "12")]),
+    ("fn_nested",
+     "SELECT UPPER(SUBSTRING(region, 0, 1)) || LOWER(SUFFIX(region, 3)) "
+     "FROM orders WHERE _id = 1", [("West",)]),
+    ("fn_null_propagates",
+     "INSERT INTO orders (_id, qty) VALUES (7, 1); "
+     "SELECT UPPER(region) FROM orders WHERE _id = 7", [(None,)]),
+    ("fn_unknown_errors",
+     "SELECT NOSUCHFN(region) FROM orders", ("error", "NOSUCHFN")),
+
+    # ---- scalar functions: datetime (inbuiltfunctionsdate.go) -----------
+    ("fn_datetimepart",
+     "SELECT DATETIMEPART('YY', '2024-05-06T07:08:09'), "
+     "DATETIMEPART('M', '2024-05-06T07:08:09'), "
+     "DATETIMEPART('D', '2024-05-06T07:08:09'), "
+     "DATETIMEPART('HH', '2024-05-06T07:08:09') "
+     "FROM orders WHERE _id = 1", [(2024, 5, 6, 7)]),
+    ("fn_datetimename_month",
+     "SELECT DATETIMENAME('M', '2024-05-06T07:08:09') "
+     "FROM orders WHERE _id = 1", [("May",)]),
+    ("fn_date_trunc",
+     "SELECT DATE_TRUNC('M', '2024-05-06T07:08:09') "
+     "FROM orders WHERE _id = 1", [("2024-05-01T00:00:00",)]),
+    ("fn_datetimeadd",
+     "SELECT DATETIMEADD('D', 3, '2024-05-06T07:08:09'), "
+     "DATETIMEADD('M', 2, '2024-12-31T00:00:00'), "
+     "DATETIMEADD('YY', 1, '2024-02-29T00:00:00') "
+     "FROM orders WHERE _id = 1",
+     [("2024-05-09T07:08:09", "2025-03-03T00:00:00",
+       "2025-03-01T00:00:00")]),
+    ("fn_datetimediff",
+     "SELECT DATETIMEDIFF('D', '2024-05-01T00:00:00', "
+     "'2024-05-06T12:00:00'), DATETIMEDIFF('YY', "
+     "'2020-01-01T00:00:00', '2024-05-06T00:00:00') "
+     "FROM orders WHERE _id = 1", [(5, 4)]),
+    ("fn_datetimefromparts",
+     "SELECT DATETIMEFROMPARTS(2024, 5, 6, 7, 8, 9, 250) "
+     "FROM orders WHERE _id = 1", [("2024-05-06T07:08:09.250000",)]),
+    ("fn_totimestamp",
+     "SELECT TOTIMESTAMP(86400), TOTIMESTAMP(1000, 'ms') "
+     "FROM orders WHERE _id = 1",
+     [("1970-01-02T00:00:00", "1970-01-01T00:00:01")]),
+    ("fn_bad_interval",
+     "SELECT DATETIMEPART('XX', '2024-05-06T07:08:09') FROM orders",
+     ("error", "interval")),
+
+    # ---- scalar functions: set (inbuiltfunctionsset.go) -----------------
+    ("fn_setcontains",
+     "SELECT _id FROM orders WHERE SETCONTAINS(tags, 'a')",
+     [(1,), (3,), (5,)]),
+    ("fn_setcontainsany",
+     "SELECT _id FROM orders WHERE SETCONTAINSANY(tags, ('b', 'c'))",
+     [(1,), (2,), (3,), (4,), (6,)]),
+    ("fn_setcontainsall",
+     "SELECT _id FROM orders WHERE SETCONTAINSALL(tags, ('a', 'c'))",
+     [(3,)]),
+    ("fn_setcontains_negated",
+     "SELECT _id FROM orders WHERE NOT SETCONTAINS(tags, 'a') "
+     "AND qty IS NOT NULL", [(2,), (4,)]),
+    ("fn_setcontains_projection",
+     "SELECT _id, SETCONTAINS(tags, 'a') FROM orders "
+     "WHERE _id IN (1, 2)", [(1, True), (2, False)]),
+
+    # ---- arithmetic + expression projections ----------------------------
+    ("arith_projection",
+     "SELECT _id, qty * 2 + 1 FROM orders WHERE _id IN (1, 4)",
+     [(1, 11), (4, 5)]),
+    ("arith_div_mod",
+     "SELECT qty / 5, qty % 5 FROM orders WHERE _id = 2", [(2, 2)]),
+    ("arith_div_zero",
+     "SELECT qty / 0 FROM orders WHERE _id = 1",
+     ("error", "division by zero")),
+    ("arith_in_where",
+     "SELECT _id FROM orders WHERE qty * 2 = 24", [(2,), (5,)]),
+    ("arith_null_propagates",
+     "SELECT qty + 1 FROM orders WHERE _id = 6", [(None,)]),
+    ("concat_projection",
+     "SELECT region || '-' || status FROM orders WHERE _id = 1",
+     [("west-open",)]),
+    ("expr_mixing_pushed_and_residue",
+     "SELECT _id FROM orders WHERE qty > 4 AND LEN(region) = 4",
+     [(1,), (2,), (3,)]),
+    ("order_by_expression",
+     "SELECT _id FROM orders WHERE qty IS NOT NULL ORDER BY 0 - qty",
+     ("ordered", [(2,), (5,), (3,), (1,), (4,)])),
+    ("order_by_alias",
+     "SELECT _id, qty * 2 AS dbl FROM orders WHERE qty IS NOT NULL "
+     "ORDER BY dbl DESC LIMIT 2",
+     ("ordered", [(2, 24), (5, 24)])),
+
+    # ---- ALTER TABLE (compilealtertable.go) -----------------------------
+    ("alter_add_column",
+     "ALTER TABLE orders ADD COLUMN note string; "
+     "INSERT INTO orders (_id, note) VALUES (9, 'hi'); "
+     "SELECT note FROM orders WHERE _id = 9", [("hi",)]),
+    ("alter_add_duplicate_errors",
+     "ALTER TABLE orders ADD COLUMN qty int", ("error", "exists")),
+    ("alter_drop_column",
+     "ALTER TABLE orders DROP COLUMN tags; "
+     "SELECT tags FROM orders", ("error", "tags")),
+    ("alter_drop_missing_errors",
+     "ALTER TABLE orders DROP COLUMN nope", ("error", "nope")),
+    ("alter_rename_column",
+     "ALTER TABLE orders RENAME COLUMN qty TO amount; "
+     "SELECT _id FROM orders WHERE amount = 12", [(2,), (5,)]),
+    ("alter_rename_keyed_column_keeps_keys",
+     "ALTER TABLE orders RENAME COLUMN region TO zone; "
+     "SELECT zone FROM orders WHERE _id = 1", [("west",)]),
+    ("alter_rename_bsi_keeps_sum",
+     "ALTER TABLE orders RENAME COLUMN qty TO amount; "
+     "SELECT sum(amount) FROM orders", 38),
+    ("alter_rename_to_existing_errors",
+     "ALTER TABLE orders RENAME COLUMN qty TO region",
+     ("error", "exists")),
+    ("alter_unknown_table_errors",
+     "ALTER TABLE nope ADD COLUMN x int", ("error", "nope")),
+
+    ("fn_datetime_eq_string",
+     "CREATE TABLE ev (_id id, ts timestamp); "
+     "INSERT INTO ev (_id, ts) VALUES (1, '2024-05-06T07:08:09'), "
+     "(2, '2024-05-07T01:00:00'); "
+     "SELECT _id FROM ev WHERE DATE_TRUNC('D', ts) = "
+     "'2024-05-06T00:00:00'", [(1,)]),
+
+    # ---- SHOW CREATE TABLE ----------------------------------------------
+    ("show_create_table_roundtrip",
+     "SHOW CREATE TABLE customers",
+     [("CREATE TABLE customers (_id id, credit int, name string, "
+       "region string)",)]),
 ]
