@@ -123,6 +123,39 @@ class BinOp:
 
 
 @dataclass
+class Var:
+    """@name parameter reference inside a function body (sql3/parser
+    Variable, scanner.go scanVariable)."""
+    name: str
+
+
+@dataclass
+class CreateFunction:
+    """CREATE FUNCTION name(@p type, ...) RETURNS type AS (expr)
+    (sql3/parser CreateFunctionStatement, ast.go:3061).  The reference
+    parses this but disables execution — its bodies ran external code
+    (userdefinedfunctions.go 'remote code exploit' note); here the
+    body is a pure SQL scalar expression over the parameters, so
+    evaluation is safe and enabled."""
+    name: str
+    params: list = field(default_factory=list)   # [(name, sql_type)]
+    returns: str = "string"
+    body: Any = None
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropFunction:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class ShowFunctions:
+    pass
+
+
+@dataclass
 class Func:
     """Scalar function call — the reference's built-in function
     surface (sql3/planner/expressionanalyzercall.go case list;

@@ -118,6 +118,11 @@ class SQLEngine:
         # name -> stored Select (sql3 CREATE VIEW); views re-execute
         # on read
         self._views: dict[str, ast.Select] = {}
+        # UPPER name -> ast.CreateFunction (scalar-expression UDFs;
+        # the reference parses CREATE FUNCTION but disables execution
+        # because its bodies ran external code — these bodies are pure
+        # SQL expressions, so evaluation is safe)
+        self._functions: dict[str, ast.CreateFunction] = {}
 
     def _stmt_access(self, stmt) -> tuple[str | None, str]:
         """(table, needed-permission) for one statement."""
@@ -131,8 +136,11 @@ class SQLEngine:
             return stmt.table, "write"
         if isinstance(stmt, ast.CreateView):
             return stmt.select.table, "read"
-        if isinstance(stmt, (ast.DropView, ast.ShowViews)):
+        if isinstance(stmt, (ast.DropView, ast.ShowViews,
+                             ast.ShowFunctions)):
             return None, "read"
+        if isinstance(stmt, (ast.CreateFunction, ast.DropFunction)):
+            return None, "write"
         if isinstance(stmt, ast.ShowTables):
             return None, "read"
         if isinstance(stmt, (ast.CreateTable, ast.DropTable,
@@ -216,6 +224,23 @@ class SQLEngine:
         if isinstance(stmt, ast.ShowViews):
             return SQLResult(schema=[("name", "string")],
                              rows=[(n,) for n in sorted(self._views)])
+        if isinstance(stmt, ast.CreateFunction):
+            return self._create_function(stmt)
+        if isinstance(stmt, ast.DropFunction):
+            name = stmt.name.upper()
+            if name not in self._functions:
+                if stmt.if_exists:
+                    return SQLResult()
+                raise SQLError(f"function not found: {stmt.name}")
+            del self._functions[name]
+            return SQLResult()
+        if isinstance(stmt, ast.ShowFunctions):
+            rows = [(fd.name,
+                     "(" + ", ".join(f"@{p} {t}" for p, t in fd.params)
+                     + f") returns {fd.returns}")
+                    for _n, (fd, _cap) in sorted(self._functions.items())]
+            return SQLResult(schema=[("name", "string"),
+                                     ("signature", "string")], rows=rows)
         if isinstance(stmt, ast.Insert):
             return self._insert(stmt)
         if isinstance(stmt, ast.BulkInsert):
@@ -632,7 +657,71 @@ class SQLEngine:
         return out
 
     def _udf_callables(self) -> dict:
-        return {}
+        return {name: self._make_udf(defn)
+                for name, defn in self._functions.items()}
+
+    def _udf_types(self) -> dict:
+        return {name: stmt.returns
+                for name, (stmt, _cap) in self._functions.items()}
+
+    def _make_udf(self, defn):
+        """Callable for one UDF.  Callees come from the `captured`
+        snapshot bound at CREATE time, so later DROP + recreate can
+        never splice a cycle into an existing body, and the child
+        closures build once per definition, not once per row."""
+        from pilosa_tpu.sql.funcs import Evaluator
+        stmt, captured = defn
+        child = {n: self._make_udf(d) for n, d in captured.items()}
+        ev = Evaluator(udfs=child)
+
+        def call(args):
+            if len(args) != len(stmt.params):
+                raise SQLError(
+                    f"{stmt.name} expects {len(stmt.params)} "
+                    f"arguments, got {len(args)}")
+            env = {"@" + p: v for (p, _t), v in zip(stmt.params, args)}
+            return ev.eval(stmt.body, env)
+        return call
+
+    def _create_function(self, stmt: ast.CreateFunction) -> SQLResult:
+        from pilosa_tpu.sql.funcs import _ARITY
+        name = stmt.name.upper()
+        if name in _ARITY:
+            raise SQLError(
+                f"cannot redefine built-in function {stmt.name}")
+        if name in self._functions:
+            if stmt.if_not_exists:
+                return SQLResult()
+            raise SQLError(f"function already exists: {stmt.name}")
+        # body validation: parameters only (no table columns), calls
+        # only to builtins or PREVIOUSLY defined functions — combined
+        # with the captured-snapshot binding above, a body can never
+        # reach itself
+        params = {p for p, _t in stmt.params}
+        if len(params) != len(stmt.params):
+            raise SQLError("duplicate parameter name")
+        captured: dict[str, tuple] = {}
+
+        def check(e):
+            if isinstance(e, ast.Col):
+                raise SQLError(
+                    "function bodies may reference only parameters")
+            if isinstance(e, ast.Var) and e.name not in params:
+                raise SQLError(f"unknown parameter @{e.name}")
+            if isinstance(e, ast.Func):
+                if e.name in self._functions:
+                    captured[e.name] = self._functions[e.name]
+                elif e.name not in _ARITY:
+                    raise SQLError(f"unknown function {e.name}")
+                for x in e.args:
+                    check(x)
+            for attr in ("left", "right", "expr", "col", "lo", "hi"):
+                sub = getattr(e, attr, None)
+                if sub is not None and not isinstance(sub, (str, int)):
+                    check(sub)
+        check(stmt.body)
+        self._functions[name] = (stmt, captured)
+        return SQLResult()
 
     @staticmethod
     def _has_filter(filt: Call) -> bool:
@@ -943,9 +1032,6 @@ class SQLEngine:
                 return "decimal" if "decimal" in (lt, rt) else "int"
             return "bool"
         return "bool"  # Not/IsNull/InList/Between
-
-    def _udf_types(self) -> dict:
-        return {}
 
     def _select_aggregates(self, idx, stmt, items, filt) -> SQLResult:
         ex = self.executor

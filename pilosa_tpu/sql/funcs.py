@@ -388,6 +388,11 @@ class Evaluator:
             if e.name not in env:
                 raise SQLError(f"column not found: {e.name}")
             return env[e.name]
+        if isinstance(e, ast.Var):
+            key = "@" + e.name
+            if key not in env:
+                raise SQLError(f"unknown parameter @{e.name}")
+            return env[key]
         if isinstance(e, ast.Func):
             args = [self.eval(x, env) for x in e.args]
             udf = self.udfs.get(e.name)

@@ -23,6 +23,7 @@ KEYWORDS = {
 _TOKEN_RE = re.compile(r"""
     (?P<ws>\s+|--[^\n]*)
   | (?P<number>\d+\.\d*|\.\d+|\d+)
+  | (?P<var>@[A-Za-z_][A-Za-z0-9_]*)
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
   | (?P<qident>"[^"]*")
   | (?P<string>'(?:''|[^'])*')
@@ -49,6 +50,8 @@ def tokenize(text: str) -> list[Token]:
         if kind != "ws":
             if kind == "ident" and val.lower() in KEYWORDS:
                 toks.append(Token("keyword", val.lower(), pos))
+            elif kind == "var":
+                toks.append(Token("var", val[1:], pos))
             elif kind == "qident":
                 toks.append(Token("ident", val[1:-1], pos))
             elif kind == "string":

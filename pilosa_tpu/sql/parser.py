@@ -96,6 +96,8 @@ class Parser:
         self.expect_kw("create")
         if self.ctx_kw("view"):
             return self._create_view()
+        if self.ctx_kw("function"):
+            return self._create_function()
         self.expect_kw("table")
         if_not_exists = False
         if self.kw("if"):
@@ -171,8 +173,49 @@ class Parser:
         sel = self.select()
         return ast.CreateView(name, sel, if_not_exists=if_not_exists)
 
+    def _create_function(self):
+        """CREATE FUNCTION [IF NOT EXISTS] name(@p type, ...)
+        RETURNS type AS (expr) — sql3/parser CreateFunctionStatement
+        with a scalar-expression body."""
+        if_not_exists = False
+        if self.kw("if"):
+            self.expect_kw("not")
+            self.expect_kw("exists")
+            if_not_exists = True
+        name = self.expect("ident").value
+        self.expect("op", "(")
+        params = []
+        if not self.accept("op", ")"):
+            while True:
+                pname = self.expect("var").value
+                ptype = self.next().value.lower()
+                if ptype not in _TYPES:
+                    raise SQLError(f"unknown parameter type {ptype!r}")
+                params.append((pname, ptype))
+                if not self.accept("op", ","):
+                    break
+            self.expect("op", ")")
+        if not self.ctx_kw("returns"):
+            raise SQLError("expected RETURNS in CREATE FUNCTION")
+        rtype = self.next().value.lower()
+        if rtype not in _TYPES:
+            raise SQLError(f"unknown return type {rtype!r}")
+        self.expect_kw("as")
+        self.expect("op", "(")
+        body = self.expr()
+        self.expect("op", ")")
+        return ast.CreateFunction(name, params, rtype, body,
+                                  if_not_exists=if_not_exists)
+
     def drop_table(self):
         self.expect_kw("drop")
+        if self.ctx_kw("function"):
+            if_exists = False
+            if self.kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return ast.DropFunction(self.expect("ident").value,
+                                    if_exists=if_exists)
         if self.ctx_kw("view"):
             if_exists = False
             if self.kw("if"):
@@ -199,6 +242,8 @@ class Parser:
         if self.kw("create"):
             self.expect_kw("table")
             return ast.ShowCreateTable(self.expect("ident").value)
+        if self.ctx_kw("functions"):
+            return ast.ShowFunctions()
         raise SQLError(
             "expected TABLES, VIEWS, COLUMNS or CREATE TABLE after SHOW")
 
@@ -499,6 +544,8 @@ class Parser:
             self.next()
             return ast.Lit({"true": True, "false": False,
                             "null": None}[t.value])
+        if t.kind == "var":
+            return ast.Var(self.next().value)
         if t.kind == "ident":
             name = self.next().value
             if self.peek().kind == "op" and self.peek().value == "(":

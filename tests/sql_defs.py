@@ -561,4 +561,48 @@ CASES = [
      "SHOW CREATE TABLE customers",
      [("CREATE TABLE customers (_id id, credit int, name string, "
        "region string)",)]),
+
+    # ---- CREATE FUNCTION (scalar-expression UDFs) -----------------------
+    ("udf_projection",
+     "CREATE FUNCTION shout(@s string) RETURNS string AS "
+     "(UPPER(@s) || '!'); "
+     "SELECT shout(region) FROM orders WHERE _id = 1", [("WEST!",)]),
+    ("udf_in_where",
+     "CREATE FUNCTION dbl(@x int) RETURNS int AS (@x * 2); "
+     "SELECT _id FROM orders WHERE dbl(qty) = 24", [(2,), (5,)]),
+    ("udf_calls_udf",
+     "CREATE FUNCTION dbl(@x int) RETURNS int AS (@x * 2); "
+     "CREATE FUNCTION quad(@x int) RETURNS int AS (dbl(dbl(@x))); "
+     "SELECT quad(qty) FROM orders WHERE _id = 1", [(20,)]),
+    ("udf_arity_error",
+     "CREATE FUNCTION dbl(@x int) RETURNS int AS (@x * 2); "
+     "SELECT dbl(qty, 1) FROM orders", ("error", "arguments")),
+    ("udf_body_column_ref_errors",
+     "CREATE FUNCTION bad(@x int) RETURNS int AS (qty + @x)",
+     ("error", "parameters")),
+    ("udf_builtin_shadow_errors",
+     "CREATE FUNCTION upper(@s string) RETURNS string AS (@s)",
+     ("error", "built-in")),
+    ("udf_duplicate_errors",
+     "CREATE FUNCTION f(@x int) RETURNS int AS (@x); "
+     "CREATE FUNCTION f(@x int) RETURNS int AS (@x)",
+     ("error", "exists")),
+    ("udf_drop",
+     "CREATE FUNCTION f(@x int) RETURNS int AS (@x); "
+     "DROP FUNCTION f; SELECT f(qty) FROM orders", ("error", "F")),
+    ("udf_show_functions",
+     "CREATE FUNCTION dbl(@x int) RETURNS int AS (@x * 2); "
+     "SHOW FUNCTIONS",
+     [("dbl", "(@x int) returns int")]),
+    ("udf_null_param",
+     "CREATE FUNCTION dbl(@x int) RETURNS int AS (@x * 2); "
+     "SELECT dbl(qty) FROM orders WHERE _id = 6", [(None,)]),
+    ("udf_drop_recreate_cannot_cycle",
+     # callees bind at CREATE time: re-creating g in terms of f must
+     # not make the existing f recursive (r03 review)
+     "CREATE FUNCTION g(@x int) RETURNS int AS (@x); "
+     "CREATE FUNCTION f(@x int) RETURNS int AS (g(@x)); "
+     "DROP FUNCTION g; "
+     "CREATE FUNCTION g(@x int) RETURNS int AS (f(@x)); "
+     "SELECT f(qty), g(qty) FROM orders WHERE _id = 1", [(5, 5)]),
 ]
