@@ -179,6 +179,89 @@ def run_queries(h, reps: int, label: str) -> dict[str, list[float]]:
     return times
 
 
+def loop_calibrate(h, reps: int = 5) -> dict[str, float]:
+    """Per-execution DEVICE time (ms) of the two north-star scans,
+    measured RTT-independently: one dispatch runs the scan `iters`
+    times in a lax.fori_loop whose carry perturbs the input by an
+    opaque zero (so XLA cannot hoist the loop-invariant body), and
+    per-iteration time = (t_iters - t_1) / (iters - 1).  Needed
+    because the tunnel's per-dispatch RTT jitter (±6 ms between runs)
+    now exceeds the sub-RTT device scan itself, making the
+    full-vs-tiny wall subtraction go negative (measured r03)."""
+    import jax
+    import jax.numpy as jnp
+    from pilosa_tpu.executor.executor import Executor
+    from pilosa_tpu.models.view import VIEW_STANDARD
+    from pilosa_tpu.ops import bitmap as bm
+
+    ex = Executor(h)
+    idx = h.index("bench")
+    eng = ex.stacked
+    fa, fb, ft = idx.field("a"), idx.field("b"), idx.field("t")
+    shards = tuple(ft.views[VIEW_STANDARD].shards)
+    a = eng.row_stack(idx, fa, (VIEW_STANDARD,), 1, shards)
+    b = eng.row_stack(idx, fb, (VIEW_STANDARD,), 1, shards)
+    t_rows = sorted({r for s in shards
+                     for r in ft.views[VIEW_STANDARD]
+                     .fragment(s).row_ids})
+    rows = eng.rows_stack_for(idx, ft, (VIEW_STANDARD,), t_rows, shards)
+
+    @jax.jit
+    def count_loop(aa0, bb, n):
+        def body(_i, carry):
+            acc, aa = carry
+            z = (acc & 0).astype(jnp.uint32)  # opaque zero: no hoist
+            aa = aa.at[0, 0].add(z)
+            c = jnp.sum(bm.count(jnp.bitwise_and(aa, bb)))
+            return acc + c.astype(jnp.int32), aa
+        acc, _ = jax.lax.fori_loop(0, n, body, (jnp.int32(0), aa0))
+        return acc
+
+    @jax.jit
+    def rows_loop(rr0, n):
+        r = rr0.shape[0]
+        def body(_i, carry):
+            acc, rr = carry
+            z = (acc[0] & 0).astype(jnp.uint32)
+            rr = rr.at[0, 0, 0].add(z)
+            c = jnp.sum(bm.count(rr), axis=1).astype(jnp.int32)
+            return acc + c, rr
+        acc, _ = jax.lax.fori_loop(
+            0, n, body, (jnp.zeros(r, jnp.int32), rr0))
+        return acc
+
+    import numpy as np
+    out = {}
+    # n_big sized so loop compute >> the tunnel's RTT jitter; every
+    # timed call uses a FRESH n (the tunnel layer can serve repeated
+    # identical (executable, args) dispatches from a cache — measured:
+    # repeats return in 0.03 ms against a ~75 ms RTT), and timing is
+    # a VALUE fetch (block_until_ready does not block through the
+    # tunnel).  Correct per-iteration counts were verified: the
+    # returned accumulator scales exactly linearly with n (mod 2^32).
+    for name, fn, args, n_big in (
+            ("count_intersect", count_loop, (a, b), 1024),
+            ("topn", rows_loop, (rows,), 256)):
+        np.asarray(fn(*args, 7))  # compile + warm
+        fresh = iter(range(1, 1000))
+
+        def med(base, k):
+            ts = []
+            for _ in range(reps):
+                n = base + next(fresh)  # never repeat an n
+                t0 = time.perf_counter()
+                np.asarray(fn(*args, n))
+                ts.append(time.perf_counter() - t0)
+            return statistics.median(ts)
+        t_small = med(0, 0)       # n in [1, reps]: ~pure RTT
+        t_big = med(n_big, 0)     # n_big + small offsets
+        per_iter = (t_big - t_small) / n_big
+        out[name] = max(per_iter * 1e3, 1e-3)
+        log(f"loop-calibrated {name}: {out[name]:.4f}ms/scan "
+            f"(slope over {n_big} in-program iterations)")
+    return out
+
+
 def _preview(res):
     r = res[0]
     if isinstance(r, list):
@@ -210,6 +293,8 @@ def main() -> None:
 
     h, cells = build_index(n_shards, topn_rows)
     full = run_queries(h, reps, f"{n_shards}sh")
+    # RTT-independent device time for the sub-RTT north-star scans
+    cal = loop_calibrate(h) if on_tpu else None
 
     # dispatch-floor calibration: same engine path, 1 shard, so the
     # wall-time difference is pure device scan time at scale
@@ -220,8 +305,14 @@ def main() -> None:
     p50_tiny = {k: statistics.median(v) for k, v in tiny.items()}
     net_ms = {k: max((p50[k] - p50_tiny[k]) * 1e3, 1e-3) for k in p50}
     # the headline tracks the NORTH-STAR pair (BASELINE.json:
-    # Count(Intersect)+TopK); able_groupby reports alongside
-    workload_ms = net_ms["count_intersect"] + net_ms["topn"]
+    # Count(Intersect)+TopK); able_groupby reports alongside.  On TPU
+    # the loop-calibrated device times are authoritative — the wall
+    # subtraction is noise-dominated once a scan is under the tunnel's
+    # per-dispatch RTT jitter
+    if cal is not None:
+        workload_ms = cal["count_intersect"] + cal["topn"]
+    else:
+        workload_ms = net_ms["count_intersect"] + net_ms["topn"]
     equiv16_ms = workload_ms * (n_chips / NORTH_STAR_CHIPS)
     wall_ms = sum(p50.values()) * 1e3
 
@@ -251,6 +342,9 @@ def main() -> None:
                                    for k, v in p50_tiny.items()},
         "net_device_p50_ms": {k: round(v, 3) for k, v in net_ms.items()},
     }
+    if cal is not None:
+        result["loop_calibrated_device_ms"] = {
+            k: round(v, 4) for k, v in cal.items()}
     if tunnel_down:
         # the chip was measured in-session when reachable; the record
         # (954 shards / 5.0e9 cells, 0.30 ms v5e-16 equiv, 33x under
