@@ -156,6 +156,13 @@ class ShowFunctions:
 
 
 @dataclass
+class Explain:
+    """EXPLAIN stmt (sql3/parser parseExplain): returns the compiled
+    plan as rows instead of executing."""
+    stmt: Any = None
+
+
+@dataclass
 class Func:
     """Scalar function call — the reference's built-in function
     surface (sql3/planner/expressionanalyzercall.go case list;

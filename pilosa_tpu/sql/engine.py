@@ -234,6 +234,8 @@ class SQLEngine:
                 raise SQLError(f"function not found: {stmt.name}")
             del self._functions[name]
             return SQLResult()
+        if isinstance(stmt, ast.Explain):
+            return self._explain(stmt.stmt)
         if isinstance(stmt, ast.ShowFunctions):
             rows = [(fd.name,
                      "(" + ", ".join(f"@{p} {t}" for p, t in fd.params)
@@ -319,6 +321,93 @@ class SQLEngine:
         rows += [(f.name, _sql_type(f)) for f in idx.public_fields()]
         return SQLResult(schema=[("name", "string"), ("type", "string")],
                          rows=rows)
+
+    def _has_subquery(self, e) -> bool:
+        if isinstance(e, (ast.SubQuery, ast.InSelect)):
+            return True
+        if isinstance(e, ast.BinOp):
+            return self._has_subquery(e.left) or \
+                self._has_subquery(e.right)
+        if isinstance(e, ast.Not):
+            return self._has_subquery(e.expr)
+        if isinstance(e, ast.Func):
+            return any(self._has_subquery(x) for x in e.args)
+        if isinstance(e, ast.Between):
+            return any(self._has_subquery(x)
+                       for x in (e.col, e.lo, e.hi))
+        return False
+
+    def _explain(self, stmt) -> SQLResult:
+        """EXPLAIN: the compile decisions as plan rows, without
+        executing (sql3 parseExplain + PlanOperator.Plan())."""
+        out: list[tuple] = []
+
+        def add(line):
+            out.append((line,))
+        if not isinstance(stmt, ast.Select):
+            add(type(stmt).__name__.lower())
+            return SQLResult(schema=[("plan", "string")], rows=out)
+        if stmt.table in self._views:
+            add(f"view expansion: {stmt.table}")
+            return SQLResult(schema=[("plan", "string")], rows=out)
+        idx = self._index(stmt.table)
+        if stmt.joins:
+            for j in stmt.joins:
+                kind = "left outer" if j.outer else "inner"
+                add(f"nested-loop {kind} join {stmt.table} x {j.table} "
+                    f"on {j.left.name} = {j.right.name} (hashed right "
+                    "side)")
+            return SQLResult(schema=[("plan", "string")], rows=out)
+        push = residue = None
+        if stmt.where is not None and self._has_subquery(stmt.where):
+            # EXPLAIN must not execute; subqueries fold at execution
+            # time, so the filter cannot be rendered without running
+            # them
+            add("filter pushdown (PQL, shard-parallel device scan): "
+                "(contains subqueries — evaluated at execution time)")
+        else:
+            if stmt.where is not None:
+                push, residue = self._split_where(stmt.where)
+            filt = self._where(idx, push) if push is not None \
+                else Call("All")
+            add(f"filter pushdown (PQL, shard-parallel device scan): "
+                f"{filt.to_pql()}")
+            if residue is not None:
+                add("host residue filter: row-wise expression over the "
+                    "pushed result (ConstRow fold-back)")
+        aggs = [it.expr for it in stmt.items
+                if isinstance(it.expr, ast.Agg)]
+        if stmt.group_by:
+            bsi = any(self._field(idx, g).options.type.is_bsi
+                      for g in stmt.group_by)
+            add("generic hashed GROUP BY (BSI group column)" if bsi
+                else "PQL GroupBy pushdown (stacked device program): "
+                + ", ".join(f"Rows({g})" for g in stmt.group_by))
+        elif aggs:
+            for a in aggs:
+                inner = a.arg.name if a.arg else "*"
+                add(f"aggregate pushdown: {a.func}({inner})")
+        elif stmt.distinct and len(stmt.items) == 1 and \
+                isinstance(stmt.items[0].expr, ast.Col) and \
+                stmt.items[0].expr.name not in ("_id", "*"):
+            # mirrors _select's Distinct dispatch guard exactly
+            add(f"PQL Distinct scan: {stmt.items[0].expr.name}")
+        else:
+            ob = stmt.order_by[0] if len(stmt.order_by) == 1 else None
+            if ob is not None and isinstance(ob.expr, ast.Col) and \
+                    ob.expr.name != "_id" and \
+                    idx.field(ob.expr.name) is not None and \
+                    self._field(idx, ob.expr.name).options.type.is_bsi:
+                d = " desc" if ob.desc else ""
+                add(f"Sort pushdown (device BSI sort): "
+                    f"{ob.expr.name}{d}, NULLS LAST")
+            elif stmt.order_by:
+                add("host sort")
+            if stmt.limit is not None:
+                add(f"limit {stmt.limit}"
+                    + (f" offset {stmt.offset}" if stmt.offset else ""))
+            add("Extract scan (device row materialization)")
+        return SQLResult(schema=[("plan", "string")], rows=out)
 
     def _show_create_table(self, stmt: ast.ShowCreateTable) -> SQLResult:
         """Canonical DDL round-trip: the emitted statement re-parses to
@@ -1045,7 +1134,7 @@ class SQLEngine:
     def _agg_type(self, idx, a: ast.Agg) -> str:
         if a.func == "count":
             return "int"
-        if a.func == "avg":
+        if a.func in ("avg", "var", "corr"):
             return "decimal"
         f = self._field(idx, a.arg.name)
         return _sql_type(f)
@@ -1088,7 +1177,55 @@ class SQLEngine:
                 args["filter"] = filt
             res = ex._execute_call(idx, Call("Percentile", args=args), None)
             return res.value if res is not None else None
+        if a.func in ("var", "corr"):
+            return self._eval_var_corr(idx, a, filt)
         raise SQLError(f"unsupported aggregate {a.func}")
+
+    def _eval_var_corr(self, idx, a: ast.Agg, filt: Call):
+        """VAR(x): population variance; CORR(x, y): Pearson
+        correlation — both buffer the matching values like the
+        reference's aggregateVar/aggregateCorr (expressionagg.go:949,
+        1197) and return decimals at scale 6."""
+        from decimal import Decimal
+        if a.arg is None:
+            raise SQLError(f"{a.func} requires a column argument")
+        names = [a.arg.name]
+        if a.func == "corr":
+            names.append(self._col_name(a.extra))
+        for n in names:
+            f = self._field(idx, n)
+            if f.options.type not in (FieldType.INT, FieldType.DECIMAL):
+                raise SQLError(f"{a.func} requires a numeric column")
+        c = Call("Extract", children=[filt] + [
+            Call("Rows", args={"_field": n}) for n in names])
+        table = self.executor._execute_call(idx, c, None)
+        cols = [[], []]
+        for entry in table.columns:
+            vals = [entry["rows"][i] for i in range(len(names))]
+            if any(v is None for v in vals):
+                continue  # reference skips nil rows
+            for i, v in enumerate(vals):
+                cols[i].append(float(v))
+        xs = cols[0]
+        n = len(xs)
+        if n == 0:
+            return None
+        if a.func == "var":
+            mean = sum(xs) / n
+            var = sum((v - mean) ** 2 for v in xs) / n
+            return Decimal(f"{var:.6f}")
+        ys = cols[1]
+        sx, sy = sum(xs), sum(ys)
+        sxy = sum(x * y for x, y in zip(xs, ys))
+        sxx, syy = sum(x * x for x in xs), sum(y * y for y in ys)
+        # float rounding can push a variance term slightly negative
+        # for near-constant data; clamp so the sqrt stays real
+        vx = max(n * sxx - sx * sx, 0.0)
+        vy = max(n * syy - sy * sy, 0.0)
+        denom = (vx * vy) ** 0.5
+        if denom == 0:
+            return None
+        return Decimal(f"{(n * sxy - sx * sy) / denom:.6f}")
 
     def _select_grouped(self, idx, stmt, items, filt) -> SQLResult:
         group_cols = stmt.group_by

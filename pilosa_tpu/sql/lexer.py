@@ -17,7 +17,8 @@ KEYWORDS = {
     "max", "avg", "create", "table", "drop", "insert", "into", "values",
     "delete", "show", "tables", "columns", "databases", "if", "exists",
     "with", "replace", "bulk", "update", "set", "alter", "add", "column",
-    "inner", "join", "on", "top", "percentile",
+    "inner", "join", "on", "top", "percentile", "var", "corr",
+    "explain",
 }
 
 _TOKEN_RE = re.compile(r"""

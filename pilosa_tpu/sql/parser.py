@@ -90,6 +90,9 @@ class Parser:
             return self.select()
         if t.value == "alter":
             return self.alter_table()
+        if t.value == "explain":
+            self.next()
+            return ast.Explain(self.statement())
         raise SQLError(f"unsupported statement {t.value!r}")
 
     def create_table(self):
@@ -534,7 +537,8 @@ class Parser:
             self.expect("op", ")")
             return e
         if t.kind == "keyword" and t.value in ("count", "sum", "min", "max",
-                                               "avg", "percentile"):
+                                               "avg", "percentile", "var",
+                                               "corr"):
             return self.aggregate()
         if t.kind == "number":
             return ast.Lit(self.literal_value())
@@ -586,6 +590,10 @@ class Parser:
         if func == "percentile":
             self.expect("op", ",")
             extra = self.literal_value()
+        elif func == "corr":
+            # CORR(x, y) — two column args (expressionagg.go:949)
+            self.expect("op", ",")
+            extra = ast.Col(self.expect("ident").value)
         self.expect("op", ")")
         return ast.Agg(func, arg, distinct=distinct, extra=extra)
 

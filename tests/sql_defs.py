@@ -597,6 +597,57 @@ CASES = [
     ("udf_null_param",
      "CREATE FUNCTION dbl(@x int) RETURNS int AS (@x * 2); "
      "SELECT dbl(qty) FROM orders WHERE _id = 6", [(None,)]),
+    # ---- VAR / CORR aggregates (expressionagg.go:949,1197) --------------
+    ("agg_var",
+     # qty over non-null rows: 5,12,7,2,12 -> mean 7.6, pop. var 15.44
+     "SELECT var(qty) FROM orders", [(D("15.440000"),)]),
+    ("agg_var_filtered",
+     # west: 5,12 -> mean 8.5, var 12.25
+     "SELECT var(qty) FROM orders WHERE region = 'west'",
+     [(D("12.250000"),)]),
+    ("agg_corr",
+     # corr(qty, cust) over rows with both: perfectly computable pair
+     "SELECT corr(qty, qty) FROM orders", [(D("1.000000"),)]),
+    ("agg_var_non_numeric_errors",
+     "SELECT var(region) FROM orders", ("error", "numeric")),
+    ("agg_var_empty_is_null",
+     "SELECT var(qty) FROM orders WHERE qty > 999", [(None,)]),
+
+    # ---- EXPLAIN --------------------------------------------------------
+    ("explain_returns_plan_rows",
+     "EXPLAIN SELECT count(*) FROM orders WHERE qty > 4",
+     [("filter pushdown (PQL, shard-parallel device scan): "
+       "Row(qty > 4)",),
+      ("aggregate pushdown: count(*)",)]),
+    ("explain_groupby_pushdown",
+     "EXPLAIN SELECT region, count(*) FROM orders GROUP BY region",
+     [("filter pushdown (PQL, shard-parallel device scan): All()",),
+      ("PQL GroupBy pushdown (stacked device program): Rows(region)",)]),
+    ("explain_does_not_execute",
+     "EXPLAIN DELETE FROM orders; SELECT count(*) FROM orders", 6),
+    ("explain_does_not_run_subqueries",
+     # a subquery against a MISSING table must not error under
+     # EXPLAIN — subqueries evaluate at execution time only
+     "EXPLAIN SELECT _id FROM orders WHERE qty = "
+     "(SELECT max(qty) FROM nope)",
+     [("filter pushdown (PQL, shard-parallel device scan): "
+       "(contains subqueries — evaluated at execution time)",),
+      ("Extract scan (device row materialization)",)]),
+    ("explain_distinct_id_matches_execution",
+     # DISTINCT _id takes the row-scan path, not the Distinct scan
+     "EXPLAIN SELECT DISTINCT _id FROM orders",
+     [("filter pushdown (PQL, shard-parallel device scan): All()",),
+      ("Extract scan (device row materialization)",)]),
+    ("agg_var_star_errors",
+     "SELECT var(*) FROM orders", ("error", "column")),
+    ("agg_var_timestamp_errors",
+     "CREATE TABLE ev2 (_id id, ts timestamp); "
+     "SELECT var(ts) FROM ev2", ("error", "numeric")),
+    ("agg_corr_constant_is_null",
+     # zero variance -> undefined correlation -> NULL, never a crash
+     "SELECT corr(cust, qty) FROM orders WHERE region = 'mars'",
+     [(None,)]),
+
     ("udf_drop_recreate_cannot_cycle",
      # callees bind at CREATE time: re-creating g in terms of f must
      # not make the existing f recursive (r03 review)
