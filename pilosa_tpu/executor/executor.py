@@ -724,6 +724,16 @@ class Executor(AdvancedOps):
         return DistinctValues(values=sorted(
             f.int_to_value(v) for v in vals))
 
+    def _ranged_views(self, f, call: Call) -> list[str]:
+        """Views for a Rows/UnionRows call honoring from=/to= time
+        bounds (executor.go:4077 executeRowsShard walks the quantum
+        views in range)."""
+        frm, to = call.arg("from"), call.arg("to")
+        try:
+            return f.views_for_range(frm, to)
+        except ValueError as e:
+            raise ExecError(str(e))
+
     def _rows_ids(self, idx: Index, call: Call, shards) -> list[int]:
         """Rows(field) core returning raw row IDS (executor.
         executeRowsShard basics: column, like, previous, limit)."""
@@ -739,19 +749,21 @@ class Executor(AdvancedOps):
             if column is None:
                 return []  # unknown column key matches nothing
         ids: set[int] = set()
+        views = self._ranged_views(f, call)  # shard-independent
         for shard in self._shard_list(idx, shards):
-            v = f.views.get(VIEW_STANDARD)
-            frag = v.fragment(shard) if v else None
-            if frag is None:
-                continue
-            if column is not None:
-                c = int(column)
-                if c // idx.width != shard:
+            for vn in views:
+                v = f.views.get(vn)
+                frag = v.fragment(shard) if v else None
+                if frag is None:
                     continue
-                ids.update(r for r in frag.row_ids
-                           if frag.contains(r, c % idx.width))
-            else:
-                ids.update(frag.row_ids)
+                if column is not None:
+                    c = int(column)
+                    if c // idx.width != shard:
+                        continue
+                    ids.update(r for r in frag.row_ids
+                               if frag.contains(r, c % idx.width))
+                else:
+                    ids.update(frag.row_ids)
         like = call.arg("like")
         if like is not None:
             tr = f.row_translator
@@ -803,15 +815,21 @@ class Executor(AdvancedOps):
             if f is None:
                 raise ExecError("Rows requires a field")
             row_ids = self._rows_ids(idx, child, shards)
+            views = self._ranged_views(f, child)
             for shard in shard_list:
-                v = f.views.get(VIEW_STANDARD)
-                frag = v.fragment(shard) if v else None
-                if frag is None:
-                    continue
                 acc = jnp.asarray(out.segments.get(
                     shard, bm.empty(idx.width)))
-                for r in row_ids:
-                    acc = bm.union(acc, frag.device_row(r))
+                touched = False
+                for vn in views:
+                    v = f.views.get(vn)
+                    frag = v.fragment(shard) if v else None
+                    if frag is None:
+                        continue
+                    touched = True
+                    for r in row_ids:
+                        acc = bm.union(acc, frag.device_row(r))
+                if not touched:
+                    continue
                 words = np.asarray(acc)
                 if words.any():
                     out.segments[shard] = words

@@ -518,12 +518,29 @@ class SQLEngine:
             elif t == FieldType.BOOL:
                 f.set_bit(1 if v else 0, col)
             else:
+                ts = None
+                if t == FieldType.TIME and isinstance(v, list) and \
+                        len(v) == 2 and \
+                        isinstance(v[0], (str, int)) and \
+                        not isinstance(v[0], bool) and \
+                        isinstance(v[1], list):
+                    # quantum tuple ('<timestamp>', (vals...)) —
+                    # opinsert.go:275's 2-member time-quantum form
+                    from pilosa_tpu.models import timeq
+                    try:
+                        ts = timeq.parse_time(v[0])
+                    except ValueError:
+                        raise SQLError(
+                            f"column {f.name}: bad quantum timestamp "
+                            f"{v[0]!r}")
+                    v = v[1]
                 vals = v if isinstance(v, list) else [v]
                 if t == FieldType.MUTEX and len(vals) > 1:
                     raise SQLError(
                         f"column {f.name} accepts a single value")
                 for item in vals:
-                    f.set_bit(self._row_id(f, item, create=True), col)
+                    f.set_bit(self._row_id(f, item, create=True), col,
+                              timestamp=ts)
         idx.mark_columns_exist([col])
 
     def _bulk_insert(self, stmt: ast.BulkInsert) -> SQLResult:
@@ -702,6 +719,10 @@ class SQLEngine:
                 isinstance(e.lo, ast.Lit) and isinstance(e.hi, ast.Lit)
         if isinstance(e, ast.Func):
             # SETCONTAINS* over (column, literal) become Row filters
+            if e.name == "RANGEQ":
+                return len(e.args) == 3 and \
+                    isinstance(e.args[0], ast.Col) and \
+                    all(isinstance(x, ast.Lit) for x in e.args[1:])
             return e.name in ("SETCONTAINS", "SETCONTAINSANY",
                               "SETCONTAINSALL") and len(e.args) == 2 \
                 and isinstance(e.args[0], ast.Col) \
@@ -856,6 +877,25 @@ class SQLEngine:
             return Call("Row", args={name: Condition("><", [lo, hi])})
         if isinstance(e, ast.IsNull):
             return self._is_null(idx, e)
+        if isinstance(e, ast.Func) and e.name == "RANGEQ":
+            # RANGEQ(tq_col, from, to) -> time-ranged Rows filter
+            # (expressionpql.go:99; push-down only, like the
+            # reference — EvaluateRangeQ always errors)
+            name = self._col_name(e.args[0])
+            f = self._field(idx, name)
+            if f.options.type != FieldType.TIME:
+                raise SQLError("RANGEQ requires a timequantum column")
+            frm, to = e.args[1].value, e.args[2].value
+            if frm is None and to is None:
+                raise SQLError(
+                    "RANGEQ from and to cannot both be NULL")
+            args = {"_field": name}
+            if frm is not None:
+                args["from"] = frm
+            if to is not None:
+                args["to"] = to
+            return Call("UnionRows",
+                        children=[Call("Rows", args=args)])
         if isinstance(e, ast.Func) and e.name.startswith("SETCONTAINS"):
             # membership pushdown (inbuiltfunctionsset.go →
             # expressionpql.go): SETCONTAINS(col, v) is Row(col=v);

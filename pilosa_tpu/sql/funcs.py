@@ -198,6 +198,11 @@ def call_builtin(name: str, args: list):
     """Evaluate one built-in; args are already-evaluated Python values.
     Returns the SQL value (None = NULL)."""
     a = args
+    if name == "RANGEQ":
+        # push-down only, like the reference (EvaluateRangeQ errors)
+        raise SQLError(
+            "RANGEQ is only valid as a WHERE filter on a "
+            "timequantum column")
     bounds = _ARITY.get(name)
     if bounds is None:
         raise SQLError(f"unknown function {name}")

@@ -597,6 +597,51 @@ CASES = [
     ("udf_null_param",
      "CREATE FUNCTION dbl(@x int) RETURNS int AS (@x * 2); "
      "SELECT dbl(qty) FROM orders WHERE _id = 6", [(None,)]),
+    # ---- time quantum: tuple INSERT + RANGEQ (opinsert.go:275,
+    # expressionpql.go:99, inbuiltfunctionsquantum.go) --------------------
+    ("quantum_insert_and_rangeq",
+     "CREATE TABLE ev3 (_id id, sites idset timequantum 'YMD'); "
+     "INSERT INTO ev3 (_id, sites) VALUES "
+     "(1, ('2024-01-15T00:00:00', (7))), "
+     "(2, ('2024-06-20T00:00:00', (7))); "
+     "SELECT _id FROM ev3 WHERE "
+     "RANGEQ(sites, '2024-01-01T00:00:00', '2024-02-01T00:00:00')",
+     [(1,)]),
+    ("rangeq_open_from",
+     "CREATE TABLE ev3 (_id id, sites idset timequantum 'YMD'); "
+     "INSERT INTO ev3 (_id, sites) VALUES "
+     "(1, ('2024-01-15T00:00:00', (7))), "
+     "(2, ('2024-06-20T00:00:00', (7))); "
+     "SELECT _id FROM ev3 WHERE "
+     "RANGEQ(sites, null, '2024-02-01T00:00:00')", [(1,)]),
+    ("rangeq_both_null_errors",
+     "CREATE TABLE ev3 (_id id, sites idset timequantum 'YMD'); "
+     "SELECT _id FROM ev3 WHERE RANGEQ(sites, null, null)",
+     ("error", "NULL")),
+    ("rangeq_non_quantum_errors",
+     "SELECT _id FROM orders WHERE "
+     "RANGEQ(tags, '2024-01-01T00:00:00', null)",
+     ("error", "timequantum")),
+    ("rangeq_in_projection_errors",
+     # evaluation-time error, like the reference's EvaluateRangeQ —
+     # needs a row for the evaluator to reach the call
+     "CREATE TABLE ev3 (_id id, sites idset timequantum 'YMD'); "
+     "INSERT INTO ev3 (_id, sites) VALUES (1, (3)); "
+     "SELECT RANGEQ(sites, '2024-01-01T00:00:00', null) FROM ev3",
+     ("error", "WHERE filter")),
+    ("quantum_insert_unix_seconds_timestamp",
+     # int unix-seconds timestamps are accepted everywhere else
+     # (timeq.parse_time), including here (r03 review)
+     "CREATE TABLE ev3 (_id id, sites idset timequantum 'YMD'); "
+     "INSERT INTO ev3 (_id, sites) VALUES (1, (1705276800, (7))); "
+     "SELECT _id FROM ev3 WHERE "
+     "RANGEQ(sites, '2024-01-01T00:00:00', '2024-02-01T00:00:00')",
+     [(1,)]),
+    ("quantum_plain_set_insert_still_works",
+     "CREATE TABLE ev3 (_id id, sites idset timequantum 'YMD'); "
+     "INSERT INTO ev3 (_id, sites) VALUES (1, (3, 4)); "
+     "SELECT _id FROM ev3 WHERE SETCONTAINS(sites, 3)", [(1,)]),
+
     # ---- VAR / CORR aggregates (expressionagg.go:949,1197) --------------
     ("agg_var",
      # qty over non-null rows: 5,12,7,2,12 -> mean 7.6, pop. var 15.44
