@@ -80,6 +80,8 @@ class Server:
 
     def start(self):
         """Serve on a background thread (tests, embedded use)."""
+        from pilosa_tpu.obs import testhook
+        testhook.opened("http.Server", self, f"port={self.port}")
         self._serving = True
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, daemon=True)
@@ -109,6 +111,8 @@ class Server:
                 self.logger.error("maintenance tick failed: %s", e)
 
     def close(self):
+        from pilosa_tpu.obs import testhook
+        testhook.closed("http.Server", self)
         self._ticker_stop.set()
         if self._ticker_thread:
             self._ticker_thread.join(timeout=2)

@@ -179,6 +179,8 @@ class SpillSet:
         self._mem: set[bytes] | None = set()
         self._disk: ExtendibleHash | None = None
         self._pool = None
+        from pilosa_tpu.obs import testhook
+        testhook.opened("spill.SpillSet", self, path)
 
     # keys longer than this store as a 32-byte blake2b digest so no
     # entry can outgrow a bucket page (collision odds ~2^-128)
@@ -218,6 +220,8 @@ class SpillSet:
         return self._disk.keys()
 
     def close(self):
+        from pilosa_tpu.obs import testhook
+        testhook.closed("spill.SpillSet", self)
         if self._pool is not None:
             self._pool.close()
             self._pool.disk.destroy()

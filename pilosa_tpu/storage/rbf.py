@@ -259,6 +259,8 @@ class DB:
                 f"open {path}: {self._lib.rbf_errmsg().decode()}")
         self._ptr = ptr
         self.path = path
+        from pilosa_tpu.obs import testhook
+        testhook.opened("rbf.DB", self, path)
 
     def begin(self, write: bool = False) -> Tx:
         return Tx(self, write)
@@ -282,6 +284,8 @@ class DB:
 
     def close(self):
         if self._ptr:
+            from pilosa_tpu.obs import testhook
+            testhook.closed("rbf.DB", self)
             rc = self._lib.rbf_close(self._ptr)
             self._ptr = None
             if rc != 0:

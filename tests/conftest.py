@@ -16,6 +16,10 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Resource leak auditing (testhook/ analog): must be set before
+# pilosa_tpu.obs.testhook is imported anywhere.
+os.environ.setdefault("PILOSA_TPU_TESTHOOK", "1")
+
 import jax  # noqa: E402
 
 # The axon sitecustomize force-selects the TPU platform via
@@ -30,3 +34,20 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0x5EED)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _leak_audit():
+    """Session-end resource audit (testhook/auditor.go): every rbf
+    DB, HTTP server, and spill set opened by the suite must have been
+    closed."""
+    yield
+    from pilosa_tpu.obs import testhook
+    if not testhook.ENABLED:
+        return
+    leaks = testhook.audit()
+    assert not leaks, (
+        f"leaked resources at session end: {leaks}\n"
+        "opening stacks:\n"
+        + "\n".join("\n".join(v)
+                    for v in testhook.audit_stacks().values()))

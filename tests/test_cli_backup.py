@@ -20,6 +20,7 @@ def node(tmp_path):
     srv = Server(holder=holder).start()
     yield srv, holder, f"127.0.0.1:{srv.port}"
     srv.close()
+    holder.close()
 
 
 def _seed(api):
@@ -60,6 +61,7 @@ def test_backup_restore_roundtrip(node, tmp_path):
             "columns"] == [SHARD + 3]
     finally:
         srv2.close()
+        holder2.close()
 
 
 def test_backup_path_traversal_rejected(node):

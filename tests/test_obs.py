@@ -194,3 +194,33 @@ def test_monitor_capture_and_http_wiring():
             cli._request(uri, "GET", "/internal/perf-counters"), dict)
     finally:
         srv.close()
+
+
+class TestTesthook:
+    """Resource leak auditor (testhook/hook.go, auditor.go analog)."""
+
+    def test_open_close_cycle(self):
+        from pilosa_tpu.obs import testhook
+        if not testhook.ENABLED:
+            import pytest
+            pytest.skip("PILOSA_TPU_TESTHOOK disabled")
+        obj = object()
+        testhook.opened("unit.res", obj, "thing")
+        assert "unit.res" in testhook.audit()
+        assert testhook.audit()["unit.res"] == ["thing"]
+        assert testhook.audit_stacks()["unit.res"]
+        testhook.closed("unit.res", obj)
+        assert "unit.res" not in testhook.audit()
+
+    def test_rbf_db_tracked(self, tmp_path):
+        from pilosa_tpu.obs import testhook
+        from pilosa_tpu.storage import rbf
+        if not testhook.ENABLED:
+            import pytest
+            pytest.skip("PILOSA_TPU_TESTHOOK disabled")
+        db = rbf.DB(str(tmp_path / "x.rbf"))
+        assert any(str(tmp_path) in d
+                   for d in testhook.audit().get("rbf.DB", []))
+        db.close()
+        assert not any(str(tmp_path) in d
+                       for d in testhook.audit().get("rbf.DB", []))
