@@ -149,6 +149,29 @@ _NARY_OPS = {
     "xor": bm.xor,
 }
 
+# jitted wrappers around kernels.groupby_sum keyed by static shape
+# facts (through a high-RTT tunnel, an un-jitted call pays one
+# dispatch per pad/transpose around the pallas_call)
+_GB_KERNEL_JIT: dict = {}
+
+
+def _groupby_kernel_jit(nf: int, has_planes: bool, signed: bool):
+    key = (nf, has_planes, signed)
+    fn = _GB_KERNEL_JIT.get(key)
+    if fn is None:
+        def run(stacks, sel, planes):
+            c, n, p, g = kernels.groupby_sum(
+                list(stacks), sel, planes, signed=signed)
+            if not has_planes:
+                return c
+            # one flat fetch: each extra device->host pull costs a
+            # full tunnel round trip
+            return jnp.concatenate(
+                [c, n, p.ravel(), g.ravel()])
+        fn = jax.jit(run)
+        _GB_KERNEL_JIT[key] = fn
+    return fn
+
 _BSI_CMP = {
     "eq": lambda p, pb, neg: bsi_ops.range_eq(p, pb, neg),
     "neq": lambda p, pb, neg: bsi_ops.range_neq(p, pb, neg),
@@ -788,6 +811,56 @@ class StackedEngine:
             self._run(("row_counts", rows_i, tree, red), b), dtype=np.int64)
         return out if red else out.sum(axis=1)
 
+    # fused GroupBy kernel (ops/kernels.groupby_sum): default on a
+    # single real TPU device — measured 4x faster than the XLA scan
+    # at design scale (BENCH_TPU_NOTES r03).  Filter trees, big combo
+    # spaces (one-hot lane bound), multi-device meshes (needs a
+    # shard_map wrap), host-only mode, and CPU (interpreter) fall back
+    # to the XLA path.  PILOSA_TPU_GROUPBY_KERNEL=0 disables; =1
+    # forces (tests exercise the interpreter path this way).
+    _GROUPBY_KERNEL_MAX_COMBOS = 1024
+
+    def _groupby_kernel_ok(self, n_combos: int, n_shards: int) -> bool:
+        import os
+        flag = os.environ.get("PILOSA_TPU_GROUPBY_KERNEL", "")
+        if flag == "0" or self.host_only:
+            return False
+        if n_combos > self._GROUPBY_KERNEL_MAX_COMBOS:
+            return False
+        if n_shards > _REDUCE_MAX_SHARDS:
+            # the kernel accumulates per-combo totals in int32 across
+            # shard tiles — same exactness bound as the in-program
+            # reduce; bigger fleets take the unreduced XLA path
+            return False
+        if flag == "1":
+            return True
+        if jax.default_backend() != "tpu":
+            return False
+        n_dev = (self.mesh.devices.size if self.mesh is not None
+                 else jax.device_count())
+        return n_dev == 1
+
+    def _groupby_kernel_path(self, idx, fields_rows, agg_field, skey,
+                             combos, depth: int, signed: bool):
+        stacks = [self.rows_stack_for(idx, f, (VIEW_STANDARD,),
+                                      rl, skey)
+                  for f, rl in fields_rows]
+        planes = (self.plane_stack(idx, agg_field, skey)
+                  if agg_field is not None else None)
+        sel = np.asarray(combos, dtype=np.int32).reshape(
+            len(combos), len(fields_rows))
+        fn = _groupby_kernel_jit(len(stacks), planes is not None,
+                                 signed)
+        out = fn(tuple(stacks), sel, planes)
+        if agg_field is None:
+            return np.asarray(out, dtype=np.int64), None
+        flat = np.asarray(out, dtype=np.int64)
+        k = len(combos)
+        counts, nn = flat[:k], flat[k:2 * k]
+        pos = flat[2 * k:2 * k + k * depth].reshape(k, depth)
+        neg = flat[2 * k + k * depth:].reshape(k, depth)
+        return counts, (nn, pos, neg)
+
     def groupby(self, idx, fields_rows, filter_call, agg_field,
                 shards: list[int], pre, combos,
                 combo_chunk: int = 8):
@@ -811,29 +884,11 @@ class StackedEngine:
         if est > (1 << 31):
             raise Unstackable(
                 f"groupby row stacks ~{est >> 20} MiB exceed budget")
-        b = PlanBuilder(self, idx, list(skey), pre)
-        stack_is = tuple(
-            b._add_leaf(self.rows_stack_for(
-                idx, f, (VIEW_STANDARD,), rl, skey))
-            for f, rl in fields_rows)
-        planes_i = None
-        if agg_field is not None:
-            planes_i = b._planes_leaf(agg_field)
-        tree = None
         n_combos = len(combos)
         depth = agg_field.bit_depth if agg_field is not None else 0
-        if filter_call is not None:
-            tree = b.build(filter_call)
-            if tree == ("zeros",):
-                zero_agg = None if agg_field is None else (
-                    np.zeros(n_combos, dtype=np.int64),
-                    np.zeros((n_combos, depth), dtype=np.int64),
-                    np.zeros((n_combos, depth), dtype=np.int64))
-                return np.zeros(n_combos, dtype=np.int64), zero_agg
-        red = self._reduce_in_program(skey)
         # when no fragment holds any sign-plane bit (row_ids is cached
         # per fragment version, so this is a dict sweep, not a scan),
-        # the program skips the sign-split and negative popcounts
+        # both paths skip the sign-split and negative popcounts
         # entirely.  Checked against the DATA, not options.min — value
         # writes are not range-enforced, so a declared min>=0 field
         # can still hold negatives.
@@ -843,6 +898,29 @@ class StackedEngine:
                                 list(skey))
             signed = any(fr is not None and 1 in fr.row_ids
                          for fr in frags)
+        if filter_call is None and \
+                self._groupby_kernel_ok(n_combos, len(skey)):
+            return self._groupby_kernel_path(
+                idx, fields_rows, agg_field, skey, combos, depth,
+                signed)
+        b = PlanBuilder(self, idx, list(skey), pre)
+        stack_is = tuple(
+            b._add_leaf(self.rows_stack_for(
+                idx, f, (VIEW_STANDARD,), rl, skey))
+            for f, rl in fields_rows)
+        planes_i = None
+        if agg_field is not None:
+            planes_i = b._planes_leaf(agg_field)
+        tree = None
+        if filter_call is not None:
+            tree = b.build(filter_call)
+            if tree == ("zeros",):
+                zero_agg = None if agg_field is None else (
+                    np.zeros(n_combos, dtype=np.int64),
+                    np.zeros((n_combos, depth), dtype=np.int64),
+                    np.zeros((n_combos, depth), dtype=np.int64))
+                return np.zeros(n_combos, dtype=np.int64), zero_agg
+        red = self._reduce_in_program(skey)
         plan = ("groupby", stack_is, planes_i, tree, red, signed)
         nf = len(fields_rows)
         n_chunks = -(-n_combos // combo_chunk)

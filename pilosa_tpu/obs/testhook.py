@@ -22,8 +22,11 @@ import traceback
 ENABLED = os.environ.get("PILOSA_TPU_TESTHOOK") == "1"
 
 _lock = threading.Lock()
-# kind -> id(obj) -> (description, opening stack summary)
-_live: dict[str, dict[int, tuple[str, str]]] = {}
+# kind -> id(obj) -> (obj, description, opening stack summary).  The
+# object itself is kept (strong ref) so a leaked resource cannot be
+# garbage-collected and have its id() reused by a later open —
+# which would overwrite the leaked entry and mask the leak.
+_live: dict[str, dict[int, tuple[object, str, str]]] = {}
 
 
 def opened(kind: str, obj, description: str = "") -> None:
@@ -33,7 +36,7 @@ def opened(kind: str, obj, description: str = "") -> None:
     stack = "".join(traceback.format_stack(limit=6)[:-1])
     with _lock:
         _live.setdefault(kind, {})[id(obj)] = (
-            description or repr(obj), stack)
+            obj, description or repr(obj), stack)
 
 
 def closed(kind: str, obj) -> None:
@@ -46,14 +49,14 @@ def closed(kind: str, obj) -> None:
 def audit() -> dict[str, list[str]]:
     """kind -> descriptions of still-open resources."""
     with _lock:
-        return {k: [d for d, _s in v.values()]
+        return {k: [d for _o, d, _s in v.values()]
                 for k, v in _live.items() if v}
 
 
 def audit_stacks() -> dict[str, list[str]]:
     """kind -> opening stacks of still-open resources (diagnosis)."""
     with _lock:
-        return {k: [s for _d, s in v.values()]
+        return {k: [s for _o, _d, s in v.values()]
                 for k, v in _live.items() if v}
 
 

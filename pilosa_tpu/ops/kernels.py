@@ -32,6 +32,13 @@ rule in enabled(): kernels serve executor paths whose inputs are
 device-RESIDENT tiles (no producer to fuse); whole-pipeline jnp
 expressions stay with XLA.
 
+The exception is :func:`groupby_sum`, where the kernel is the DEFAULT
+on TPU: the XLA GroupBy scan must materialize gathered (C, S, W)
+combo masks and re-read them once per BSI plane, while the kernel's
+scalar-prefetch gather + plane-block reuse reads each operand stream
+approximately once (measured 4x faster at design scale, r03 — the
+schedule, not the arithmetic, is what XLA cannot reproduce).
+
 All kernels run in interpreter mode automatically off-TPU, so the same
 code path is exercised by the CPU test mesh (conftest.py).
 """
@@ -338,6 +345,146 @@ def rows_filter_counts(rows, filt):
     return jnp.concatenate(out, axis=0)
 
 
+# ---------------------------------------------------------------------------
+# fused GroupBy + Sum: the whole combo space in one pass
+# ---------------------------------------------------------------------------
+
+def _groupby_kernel(nf: int, depth: int, signed: bool, c_dim: int):
+    """Kernel body factory: nf field stacks, BSI depth (0 = no
+    aggregate), sign-split on/off, c_dim combos.  Outputs are whole
+    (·, C) blocks resident in VMEM for the entire grid; each step
+    accumulates into its combo's lane via a one-hot (dynamic lane
+    stores don't lower on TPU)."""
+
+    def kernel(sel_ref, *refs):
+        # refs: nf stack refs [+ planes_ref], then outputs
+        # (cnt_ref [, nn_ref, pos_ref, neg_ref])
+        stacks = refs[:nf]
+        i = nf
+        planes_ref = refs[i] if depth else None
+        i += 1 if depth else 0
+        cnt_ref = refs[i]
+        s, w, c = (pl.program_id(0), pl.program_id(1),
+                   pl.program_id(2))
+
+        @pl.when((s == 0) & (w == 0) & (c == 0))
+        def _init():
+            for r in refs[i:]:
+                r[...] = jnp.zeros_like(r)
+
+        onehot = (jax.lax.broadcasted_iota(
+            jnp.int32, (1, c_dim), 1) == c).astype(jnp.int32)
+        m = stacks[0][0]
+        for f in range(1, nf):
+            m = m & stacks[f][0]                   # (BS, BW)
+        cnt_ref[...] += jnp.sum(_pc(m)) * onehot
+        if depth:
+            nn_ref, pos_ref = refs[i + 1], refs[i + 2]
+            exists = planes_ref[:, 0, :]
+            em = m & exists
+            nn_ref[...] += jnp.sum(_pc(em)) * onehot
+            mag = planes_ref[:, 2:, :]             # (BS, depth, BW)
+            if signed:
+                neg_ref = refs[i + 3]
+                sign = planes_ref[:, 1, :]
+                pos = em & ~sign
+                neg = em & sign
+                pos_pc = jnp.sum(_pc(mag & pos[:, None, :]),
+                                 axis=(0, 2))      # (depth,)
+                neg_pc = jnp.sum(_pc(mag & neg[:, None, :]),
+                                 axis=(0, 2))
+                pos_ref[...] += pos_pc[:, None] * onehot
+                neg_ref[...] += neg_pc[:, None] * onehot
+            else:
+                pos_pc = jnp.sum(_pc(mag & em[:, None, :]),
+                                 axis=(0, 2))
+                pos_ref[...] += pos_pc[:, None] * onehot
+    return kernel
+
+
+_GB_SHARD_BLOCK = 8
+_GB_WORD_BLOCK = 4096
+
+
+def groupby_sum(stacks, sel, planes=None, signed=True):
+    """Fused GroupBy: every combo's count (+ BSI Sum partials) in ONE
+    pass over the field stacks (executor.go:3918 + 8617, collapsed).
+
+    stacks: list of (R_f, S, W) uint32 per GroupBy field;
+    sel: (C, nf) int32 combo row indices; planes: (S, P+2, W) or None;
+    signed: compute the negative sign-split (skippable when the sign
+    plane is empty).  Returns (counts (C,), nn (C,), pos (C, depth),
+    neg (C, depth)) int32 — nn/pos/neg None without planes.
+
+    Schedule: grid (S/BS, W/BW, C) with combos INNERMOST and the combo
+    row chosen via scalar-prefetched `sel` (the embedding-gather
+    pattern) — the plane block loads once per (shard, word) tile and
+    is reused by all C combos, so total HBM traffic is ~one read of
+    each stack row per referencing combo plus ONE read of the planes,
+    instead of the XLA path's per-chunk re-materialization (measured
+    r03: 273 ms -> see BENCH_TPU_NOTES for the kernel number).
+    Per-combo totals accumulate across shard tiles in int32 (exact
+    below ~2k shards; callers above that use the unreduced XLA path).
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    nf = len(stacks)
+    c_dim, nf2 = sel.shape
+    assert nf2 == nf and nf >= 1
+    s_dim, w_dim = stacks[0].shape[1:]
+    bs = min(_GB_SHARD_BLOCK, s_dim)
+    bw = min(_GB_WORD_BLOCK, w_dim)
+    stacks = [_pad_axis(_pad_axis(x, 1, bs), 2, bw) for x in stacks]
+    depth = 0
+    if planes is not None:
+        planes = _pad_axis(_pad_axis(planes, 0, bs), 2, bw)
+        depth = planes.shape[1] - 2
+    spad, wpad = stacks[0].shape[1:]
+    grid = (spad // bs, wpad // bw, c_dim)
+    sel = jnp.asarray(sel, dtype=jnp.int32)
+
+    def stack_spec(f):
+        return pl.BlockSpec(
+            (1, bs, bw), lambda s, w, c, sel_ref: (sel_ref[c, f], s, w))
+
+    in_specs = [stack_spec(f) for f in range(nf)]
+    arrays = list(stacks)
+    if planes is not None:
+        in_specs.append(pl.BlockSpec(
+            (bs, 2 + depth, bw), lambda s, w, c, sel_ref: (s, 0, w)))
+        arrays.append(planes)
+    # outputs live as whole (·, C) VMEM-resident blocks (index_map
+    # constant across the grid)
+    fixed = lambda s, w, c, sel_ref: (0, 0)
+    out_specs = [pl.BlockSpec((1, c_dim), fixed)]
+    out_shape = [jax.ShapeDtypeStruct((1, c_dim), jnp.int32)]
+    if planes is not None:
+        out_specs.append(pl.BlockSpec((1, c_dim), fixed))
+        out_shape.append(jax.ShapeDtypeStruct((1, c_dim), jnp.int32))
+        n_agg = 2 if signed else 1
+        for _ in range(n_agg):
+            out_specs.append(pl.BlockSpec((depth, c_dim), fixed))
+            out_shape.append(
+                jax.ShapeDtypeStruct((depth, c_dim), jnp.int32))
+    out = pl.pallas_call(
+        _groupby_kernel(nf, depth, signed, c_dim),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_specs,
+        ),
+        out_shape=out_shape,
+        interpret=_interpret(),
+    )(sel, *arrays)
+    if planes is None:
+        return out[0][0], None, None, None
+    counts, nn = out[0][0], out[1][0]
+    pos = out[2].T                                 # (C, depth)
+    neg = out[3].T if signed else jnp.zeros_like(pos)
+    return counts, nn, pos, neg
+
+
 def fused_query_counts(a, b, filt, rows):
     """Per-shard Count(Intersect) + TopK candidate counts.
 
@@ -355,5 +502,6 @@ __all__ = [
     "pair_popcount",
     "masked_popcount",
     "bsi_sum_counts",
+    "groupby_sum",
     "fused_query_counts",
 ]
