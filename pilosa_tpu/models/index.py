@@ -87,6 +87,41 @@ class Index:
     def field(self, name: str) -> Field | None:
         return self.fields.get(name)
 
+    def clone_to(self, dst: "Index") -> None:
+        """Deep-copy this index's schema, bitmaps (every view, so
+        time-quantum placement survives), key translations, and BSI
+        bookkeeping into `dst` (a fresh index created with the same
+        `keys`).  Owns the write-path state transfer — bit_depth and
+        observed extrema are not derivable from set_row_words alone —
+        so callers (SQL COPY) never touch field internals."""
+        import numpy as np
+        if self.keys and self.column_translator is not None:
+            for p, store in self.column_translator._stores.items():
+                dst.column_translator.restore_partition(
+                    p, store.snapshot())
+
+        def copy_field(f, nf):
+            nf.bit_depth = f.bit_depth
+            nf._min_seen = f._min_seen
+            nf._max_seen = f._max_seen
+            if f.row_translator is not None and \
+                    nf.row_translator is not None:
+                nf.row_translator.restore_snapshot(
+                    f.row_translator.snapshot())
+            for vn, v in f.views.items():
+                nv = nf.view(vn, create=True)
+                for shard, frag in v.fragments.items():
+                    nfrag = nv.fragment(shard, create=True)
+                    for r in frag.row_ids:
+                        nfrag.set_row_words(
+                            r, np.array(frag.row_words(r)))
+
+        for f in self.public_fields():
+            copy_field(f, dst.create_field(f.name, f.options))
+        ef = self.fields.get(EXISTENCE_FIELD)
+        if ef is not None:
+            copy_field(ef, dst._ensure_existence())
+
     def rename_field(self, old: str, new: str):
         """ALTER TABLE .. RENAME COLUMN old TO new (sql3/planner/
         compilealtertable.go): renames the field in the schema, moves

@@ -156,6 +156,22 @@ class ShowFunctions:
 
 
 @dataclass
+class Copy:
+    """COPY src TO dst (sql3/parser copy statement): clone a table's
+    schema and records into a new table."""
+    src: str
+    dst: str
+
+
+@dataclass
+class AlterView:
+    """ALTER VIEW name AS SELECT ... — replace a stored view's
+    definition (sql3/parser parseAlterViewStatement)."""
+    name: str
+    select: "Select" = None
+
+
+@dataclass
 class Explain:
     """EXPLAIN stmt (sql3/parser parseExplain): returns the compiled
     plan as rows instead of executing."""

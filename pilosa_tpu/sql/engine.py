@@ -134,6 +134,8 @@ class SQLEngine:
             return (v.table if v is not None else stmt.table), "read"
         if isinstance(stmt, ast.AlterTable):
             return stmt.table, "write"
+        if isinstance(stmt, ast.AlterView):
+            return stmt.select.table, "read"
         if isinstance(stmt, ast.CreateView):
             return stmt.select.table, "read"
         if isinstance(stmt, (ast.DropView, ast.ShowViews,
@@ -149,6 +151,15 @@ class SQLEngine:
                 "write"
         return None, "write"
 
+    def _stmt_accesses(self, stmt) -> list[tuple[str | None, str]]:
+        """All (table, permission) checks for one statement —
+        statements touching two tables need both."""
+        if isinstance(stmt, ast.Copy):
+            # reading src into a writable dst must not bypass src's
+            # read permission (r03 review: exfiltration via COPY)
+            return [(stmt.src, "read"), (stmt.dst, "write")]
+        return [self._stmt_access(stmt)]
+
     def query(self, sql: str, auth_check=None,
               write_guard=None) -> list[SQLResult]:
         """Execute statements.
@@ -163,11 +174,14 @@ class SQLEngine:
         try:
             stmts = parse_sql(sql)
             if write_guard is not None and any(
-                    self._stmt_access(s)[1] == "write" for s in stmts):
+                    perm == "write"
+                    for s in stmts
+                    for _t, perm in self._stmt_accesses(s)):
                 write_guard()
             if auth_check is not None:
                 for stmt in stmts:
-                    auth_check(*self._stmt_access(stmt))
+                    for table, perm in self._stmt_accesses(stmt):
+                        auth_check(table, perm)
             return [self._execute(stmt, auth_check) for stmt in stmts]
         except ExecError as e:  # surface executor errors as SQL errors
             raise SQLError(str(e)) from e
@@ -236,6 +250,15 @@ class SQLEngine:
             return SQLResult()
         if isinstance(stmt, ast.Explain):
             return self._explain(stmt.stmt)
+        if isinstance(stmt, ast.Copy):
+            return self._copy(stmt)
+        if isinstance(stmt, ast.AlterView):
+            if stmt.name not in self._views:
+                raise SQLError(f"view not found: {stmt.name}")
+            if stmt.select.table in self._views:
+                raise SQLError("views over views are not supported")
+            self._views[stmt.name] = stmt.select
+            return SQLResult()
         if isinstance(stmt, ast.ShowFunctions):
             rows = [(fd.name,
                      "(" + ", ".join(f"@{p} {t}" for p, t in fd.params)
@@ -1031,6 +1054,8 @@ class SQLEngine:
     # -- SELECT ---------------------------------------------------------
 
     def _select(self, stmt: ast.Select) -> SQLResult:
+        if not stmt.table:
+            return self._select_const(stmt)
         if stmt.table in self._views:
             return self._select_view(stmt)
         if stmt.joins:
@@ -1064,6 +1089,45 @@ class SQLEngine:
                 items[0].expr.name != "_id":
             return self._select_distinct(idx, stmt, items[0], filt)
         return self._select_rows(idx, stmt, items, filt)
+
+    def _select_const(self, stmt: ast.Select) -> SQLResult:
+        """FROM-less constant SELECT (sql3 allows e.g.
+        `select cast(1 as bool)`): items evaluate once, no table."""
+        from pilosa_tpu.sql.funcs import Evaluator
+        if stmt.where is not None or stmt.group_by or stmt.joins or \
+                stmt.having is not None:
+            raise SQLError("constant SELECT takes projections only")
+        ev = Evaluator(udfs=self._udf_callables())
+        schema, vals = [], []
+        for it in stmt.items:
+            e = self._fold_subqueries(it.expr)
+            # eval first: a Col reference errors here, so _expr_type
+            # (which only needs idx for Col lookups) runs idx-less
+            vals.append(self._to_sql_value(ev.eval(e, {})))
+            schema.append((self._name_of(it), self._expr_type(None, e)))
+        rows = self._limit_rows(stmt, [tuple(vals)])
+        return SQLResult(schema=schema, rows=rows)
+
+    def _copy(self, stmt: ast.Copy) -> SQLResult:
+        """COPY src TO dst (sql3 copy statement, defs_copy.go):
+        Index.clone_to owns the deep copy; a mid-copy failure never
+        strands a half-built table."""
+        if stmt.src in self._views:
+            raise SQLError("COPY supports tables, not views")
+        src = self.holder.index(stmt.src)
+        if src is None:
+            raise SQLError(f"table or view {stmt.src!r} not found")
+        if stmt.dst in self._views or \
+                self.holder.index(stmt.dst) is not None:
+            raise SQLError(f"table or view {stmt.dst!r} already exists")
+        dst = self.holder.create_index(stmt.dst, keys=src.keys)
+        try:
+            src.clone_to(dst)
+        except Exception:
+            self.holder.delete_index(stmt.dst)
+            raise
+        self.holder.save_schema()
+        return SQLResult()
 
     def _select_view(self, stmt: ast.Select) -> SQLResult:
         """Query a stored view: re-execute its select, then apply the
@@ -1149,6 +1213,9 @@ class SQLEngine:
                 return "string" if idx.keys else "id"
             return _sql_type(self._field(idx, e.name))
         if isinstance(e, ast.Func):
+            if e.name == "CAST" and len(e.args) == 3 and \
+                    isinstance(e.args[1], ast.Lit):
+                return e.args[1].value
             if e.name in self._udf_types():
                 return self._udf_types()[e.name]
             return FUNC_TYPES.get(e.name, "string")

@@ -191,7 +191,78 @@ _ARITY = {
     "TOTIMESTAMP": (1, 2),
     "SETCONTAINS": (2, 2), "SETCONTAINSANY": (2, 2),
     "SETCONTAINSALL": (2, 2),
+    "CAST": (3, 3),  # (expr, type, scale) — built by the parser
 }
+
+
+def _cast(v, t: str, scale: int):
+    """CAST(v AS t) — sql3 castOperand coercions (defs_cast.go
+    semantics: numeric/bool/string/timestamp interconvert; set types
+    are not castable)."""
+    from decimal import ROUND_HALF_EVEN, Decimal
+
+    def no(msg=None):
+        raise SQLError(
+            msg or f"{type(v).__name__!s} cannot be cast to {t!r}")
+    if t in ("idset", "stringset"):
+        no()
+    if t in ("int", "id"):
+        if isinstance(v, bool):
+            out = int(v)
+        elif isinstance(v, int):
+            out = v
+        elif isinstance(v, (float, Decimal)):
+            out = int(v)  # truncate toward zero
+        elif isinstance(v, str):
+            try:
+                out = int(v)
+            except ValueError:
+                no(f"cannot cast {v!r} to {t!r}")
+        else:
+            no()
+        if t == "id" and out < 0:
+            no("id cannot be negative")
+        return out
+    if t == "bool":
+        if isinstance(v, bool):
+            return v
+        if isinstance(v, int):
+            if v in (0, 1):
+                return bool(v)
+            no("bool cast requires 0 or 1")
+        if isinstance(v, str):
+            if v.lower() in ("true", "false"):
+                return v.lower() == "true"
+            no(f"cannot cast {v!r} to 'bool'")
+        no()
+    if t == "decimal":
+        if isinstance(v, bool):
+            v = int(v)
+        if isinstance(v, (int, float, str, Decimal)):
+            try:
+                d = Decimal(str(v))
+            except ArithmeticError:
+                no(f"cannot cast {v!r} to 'decimal'")
+            q = Decimal(1).scaleb(-int(scale))
+            return d.quantize(q, rounding=ROUND_HALF_EVEN)
+        no()
+    if t == "string":
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        if isinstance(v, dt.datetime):
+            return v.isoformat()
+        if isinstance(v, (int, float, Decimal, str)):
+            return str(v)
+        no()
+    if t == "timestamp":
+        if isinstance(v, dt.datetime):
+            return v
+        if isinstance(v, str):
+            return _ts(v, "CAST")
+        if isinstance(v, int) and not isinstance(v, bool):
+            return dt.datetime(1970, 1, 1) + dt.timedelta(seconds=v)
+        no()
+    raise SQLError(f"unknown cast type {t!r}")
 
 
 def call_builtin(name: str, args: list):
@@ -340,6 +411,9 @@ def _dispatch(name: str, a: list):
             raise SQLError(f"invalid time unit {unit!r}")
         return dt.datetime(1970, 1, 1) + dt.timedelta(
             seconds=_i(a[0], name) / _TIME_UNITS[unit])
+
+    if name == "CAST":
+        return _cast(a[0], a[1], a[2])
 
     # -- set (inbuiltfunctionsset.go) ---------------------------------
     if name == "SETCONTAINS":

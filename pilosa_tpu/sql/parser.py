@@ -72,6 +72,8 @@ class Parser:
 
     def statement(self):
         t = self.peek()
+        if t.kind == "ident" and t.value.lower() == "copy":
+            return self.copy_stmt()
         if t.kind != "keyword":
             raise SQLError(f"unexpected {t.value!r} at {t.pos}")
         if t.value == "create":
@@ -143,10 +145,22 @@ class Parser:
                 setattr(cd, opt, v)
         return cd
 
+    def copy_stmt(self):
+        self.next()  # copy (contextual)
+        src = self.expect("ident").value
+        if not self.ctx_kw("to"):
+            raise SQLError("expected TO in COPY")
+        return ast.Copy(src, self.expect("ident").value)
+
     def alter_table(self):
         """ALTER TABLE t ADD [COLUMN] def | DROP [COLUMN] name |
-        RENAME [COLUMN] old TO new (sql3/parser AlterTableStatement)."""
+        RENAME [COLUMN] old TO new (sql3/parser AlterTableStatement);
+        ALTER VIEW name AS SELECT ..."""
         self.expect_kw("alter")
+        if self.ctx_kw("view"):
+            name = self.expect("ident").value
+            self.expect_kw("as")
+            return ast.AlterView(name, self.select())
         self.expect_kw("table")
         table = self.expect("ident").value
         if self.kw("add"):
@@ -351,9 +365,14 @@ class Parser:
                 sel.items.append(ast.SelectItem(e, alias))
             if not self.accept("op", ","):
                 break
-        self.expect_kw("from")
-        sel.table = self.expect("ident").value
-        while True:
+        # FROM is optional (sql3 supports constant selects, e.g.
+        # `select cast(1 as bool)`); the tail clauses still parse so
+        # `SELECT 1 LIMIT 1` works and `SELECT 1 WHERE ...` errors in
+        # the engine, not as a bogus "unsupported statement"
+        has_from = bool(self.kw("from"))
+        if has_from:
+            sel.table = self.expect("ident").value
+        while has_from:
             outer = False
 
             def _at_left_join() -> bool:
@@ -569,6 +588,19 @@ class Parser:
         """Scalar function call NAME(arg, ...) — names stay usable as
         plain identifiers elsewhere (contextual, like sql3's Call)."""
         self.expect("op", "(")
+        if name.upper() == "CAST":
+            # CAST(expr AS type[(scale)]) — sql3/parser castExpr
+            e = self.expr()
+            self.expect_kw("as")
+            t = self.next().value.lower()
+            if t not in _TYPES:
+                raise SQLError(f"unknown cast type {t!r}")
+            scale = 0
+            if t == "decimal" and self.accept("op", "("):
+                scale = int(self.expect("number").value)
+                self.expect("op", ")")
+            self.expect("op", ")")
+            return ast.Func("CAST", [e, ast.Lit(t), ast.Lit(scale)])
         args = []
         if not self.accept("op", ")"):
             while True:

@@ -642,6 +642,68 @@ CASES = [
      "INSERT INTO ev3 (_id, sites) VALUES (1, (3, 4)); "
      "SELECT _id FROM ev3 WHERE SETCONTAINS(sites, 3)", [(1,)]),
 
+    # ---- CAST + constant SELECT (defs_cast.go) --------------------------
+    ("cast_int_to_bool", "SELECT CAST(1 AS bool), CAST(0 AS bool)",
+     [(True, False)]),
+    ("cast_int_to_decimal", "SELECT CAST(1 AS decimal(2))",
+     [(D("1.00"),)]),
+    ("cast_decimal_to_int_truncates",
+     "SELECT CAST(price AS int) FROM orders WHERE _id = 1", [(10,)]),
+    ("cast_string_to_int", "SELECT CAST('42' AS int)", [(42,)]),
+    ("cast_bad_string_to_int_errors", "SELECT CAST('xx' AS int)",
+     ("error", "cast")),
+    ("cast_int_to_string", "SELECT CAST(7 AS string)", [("7",)]),
+    ("cast_bool_to_string", "SELECT CAST(true AS string)",
+     [("true",)]),
+    ("cast_to_idset_errors", "SELECT CAST(1 AS idset)",
+     ("error", "cast")),
+    ("cast_int_to_timestamp", "SELECT CAST(86400 AS timestamp)",
+     [("1970-01-02T00:00:00",)]),
+    ("cast_string_to_timestamp",
+     "SELECT CAST('2024-05-06T07:08:09' AS timestamp)",
+     [("2024-05-06T07:08:09",)]),
+    ("cast_null_is_null", "SELECT CAST(null AS int)", [(None,)]),
+    ("cast_bool_out_of_range_errors", "SELECT CAST(7 AS bool)",
+     ("error", "bool")),
+    ("const_select_arithmetic", "SELECT 2 + 3 * 4, 'a' || 'b'",
+     [(14, "ab")]),
+    ("const_select_column_errors", "SELECT qty", ("error", "qty")),
+
+    # ---- COPY (defs_copy.go) --------------------------------------------
+    ("copy_table_roundtrip",
+     "COPY orders TO orders2; "
+     "SELECT region, qty, tags FROM orders2 WHERE _id = 1",
+     [("west", 5, ["a", "b"])]),
+    ("copy_preserves_counts",
+     "COPY orders TO orders2; SELECT count(*) FROM orders2", 6),
+    ("copy_missing_src_errors", "COPY nope TO x",
+     ("error", "not found")),
+    ("copy_existing_dst_errors", "COPY orders TO customers",
+     ("error", "exists")),
+    ("copy_then_independent_writes",
+     "COPY orders TO orders2; "
+     "DELETE FROM orders2 WHERE region = 'west'; "
+     "SELECT count(*) FROM orders2; SELECT count(*) FROM orders",
+     6),
+    ("copy_preserves_quantum_views",
+     "CREATE TABLE ev4 (_id id, sites idset timequantum 'YMD'); "
+     "INSERT INTO ev4 (_id, sites) VALUES "
+     "(1, ('2024-01-15T00:00:00', (7))), "
+     "(2, ('2024-06-20T00:00:00', (7))); "
+     "COPY ev4 TO ev5; "
+     "SELECT _id FROM ev5 WHERE "
+     "RANGEQ(sites, '2024-01-01T00:00:00', '2024-02-01T00:00:00')",
+     [(1,)]),
+
+    # ---- ALTER VIEW -----------------------------------------------------
+    ("alter_view_replaces_definition",
+     "CREATE VIEW v AS SELECT _id FROM orders WHERE qty = 12; "
+     "ALTER VIEW v AS SELECT _id FROM orders WHERE qty = 5; "
+     "SELECT _id FROM v", [(1,)]),
+    ("alter_view_missing_errors",
+     "ALTER VIEW nope AS SELECT _id FROM orders",
+     ("error", "not found")),
+
     # ---- VAR / CORR aggregates (expressionagg.go:949,1197) --------------
     ("agg_var",
      # qty over non-null rows: 5,12,7,2,12 -> mean 7.6, pop. var 15.44

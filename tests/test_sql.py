@@ -386,3 +386,29 @@ def test_inner_join_errors():
         eng.query("SELECT x FROM a JOIN b ON a.x = a.x")  # one-sided
     with _pytest.raises(SQLError):
         eng.query("SELECT c.z FROM a JOIN b ON a.x = b.y")  # bad table
+
+
+def test_copy_checks_src_read_permission(eng):
+    """COPY must not bypass the source's read permission (r03 review:
+    exfiltration into a writable destination)."""
+    def deny_orders_read(table, perm):
+        if table == "orders" and perm == "read":
+            raise SQLError("denied")
+    with pytest.raises(SQLError, match="denied"):
+        eng.query("COPY orders TO mine", auth_check=deny_orders_read)
+    # the denied copy must not leave a half-created table behind
+    assert ("mine",) not in rows(eng.query_one("SHOW TABLES"))
+
+
+def test_const_select_limit_and_where(eng):
+    assert rows(eng.query_one("SELECT 1 + 1 LIMIT 1")) == [(2,)]
+    assert rows(eng.query_one("SELECT 1 LIMIT 0")) == []
+    with pytest.raises(SQLError, match="projections only"):
+        eng.query("SELECT 1 WHERE 1 = 1")
+
+
+def test_const_select_udf_schema_type(eng):
+    eng.query("CREATE FUNCTION dbl(@x int) RETURNS int AS (@x * 2)")
+    res = eng.query_one("SELECT dbl(3)")
+    assert res.schema == [("dbl", "int")]
+    assert res.rows == [(6,)]
