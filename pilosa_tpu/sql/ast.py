@@ -156,6 +156,13 @@ class ShowFunctions:
 
 
 @dataclass
+class ShowDatabases:
+    """SHOW DATABASES — database scoping is a DAX/cloud concept
+    (dax controller schemar); a standalone node reports none."""
+    pass
+
+
+@dataclass
 class Copy:
     """COPY src TO dst (sql3/parser copy statement): clone a table's
     schema and records into a new table."""

@@ -139,7 +139,7 @@ class SQLEngine:
         if isinstance(stmt, ast.CreateView):
             return stmt.select.table, "read"
         if isinstance(stmt, (ast.DropView, ast.ShowViews,
-                             ast.ShowFunctions)):
+                             ast.ShowFunctions, ast.ShowDatabases)):
             return None, "read"
         if isinstance(stmt, (ast.CreateFunction, ast.DropFunction)):
             return None, "write"
@@ -259,6 +259,8 @@ class SQLEngine:
                 raise SQLError("views over views are not supported")
             self._views[stmt.name] = stmt.select
             return SQLResult()
+        if isinstance(stmt, ast.ShowDatabases):
+            return SQLResult(schema=[("name", "string")], rows=[])
         if isinstance(stmt, ast.ShowFunctions):
             rows = [(fd.name,
                      "(" + ", ".join(f"@{p} {t}" for p, t in fd.params)

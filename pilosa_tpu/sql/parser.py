@@ -141,8 +141,9 @@ class Parser:
             if opt == "timequantum":
                 cd.time_quantum = self.expect("string").value
             else:
+                neg = self.accept("op", "-") is not None
                 v = int(self.expect("number").value)
-                setattr(cd, opt, v)
+                setattr(cd, opt, -v if neg else v)
         return cd
 
     def copy_stmt(self):
@@ -261,6 +262,8 @@ class Parser:
             return ast.ShowCreateTable(self.expect("ident").value)
         if self.ctx_kw("functions"):
             return ast.ShowFunctions()
+        if self.kw("databases"):
+            return ast.ShowDatabases()
         raise SQLError(
             "expected TABLES, VIEWS, COLUMNS or CREATE TABLE after SHOW")
 

@@ -642,6 +642,28 @@ CASES = [
      "INSERT INTO ev3 (_id, sites) VALUES (1, (3, 4)); "
      "SELECT _id FROM ev3 WHERE SETCONTAINS(sites, 3)", [(1,)]),
 
+    # ---- negative-range BSI columns (defs_minmaxnegative.go) ------------
+    ("negative_int_roundtrip",
+     "CREATE TABLE mm (_id id, p int min 10 max 100, "
+     "n int min -100 max -10); "
+     "INSERT INTO mm (_id, p, n) VALUES (1, 11, -11), (2, 22, -22), "
+     "(3, 33, -33); "
+     "SELECT _id, p, n FROM mm ORDER BY _id",
+     ("ordered", [(1, 11, -11), (2, 22, -22), (3, 33, -33)])),
+    ("negative_int_aggregates",
+     "CREATE TABLE mm (_id id, n int min -100 max -10); "
+     "INSERT INTO mm (_id, n) VALUES (1, -11), (2, -22), (3, -33); "
+     "SELECT min(n), max(n), sum(n) FROM mm", [(-33, -11, -66)]),
+    ("negative_int_range_filters",
+     "CREATE TABLE mm (_id id, n int min -100 max -10); "
+     "INSERT INTO mm (_id, n) VALUES (1, -11), (2, -22), (3, -33); "
+     "SELECT _id FROM mm WHERE n < -15 AND n >= -33",
+     [(2,), (3,)]),
+    ("negative_int_order_by",
+     "CREATE TABLE mm (_id id, n int min -100 max -10); "
+     "INSERT INTO mm (_id, n) VALUES (1, -11), (2, -22), (3, -33); "
+     "SELECT _id FROM mm ORDER BY n", ("ordered", [(3,), (2,), (1,)])),
+
     # ---- CAST + constant SELECT (defs_cast.go) --------------------------
     ("cast_int_to_bool", "SELECT CAST(1 AS bool), CAST(0 AS bool)",
      [(True, False)]),
