@@ -131,3 +131,64 @@ def test_compiled_kernels_on_tpu():
     got = np.asarray(kernels.pair_popcount(a, b))
     want = np.asarray(bm.count(jnp.bitwise_and(a, b)))
     np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.skipif(
+    __import__("jax").default_backend() != "tpu",
+    reason="compiled (non-interpret) Mosaic path needs a real TPU")
+def test_compiled_groupby_kernel_on_tpu():
+    """TPU-gated: the fused GroupBy kernel compiles through Mosaic
+    and matches a naive numpy evaluation."""
+    import itertools
+
+    import jax.numpy as jnp
+
+    from pilosa_tpu.ops import kernels
+
+    rng = np.random.default_rng(1)
+    S, W, depth = 4, 2048, 3
+    stacks = [jnp.asarray(rng.integers(
+        0, 1 << 32, size=(r, S, W), dtype=np.uint32)) for r in (3, 2)]
+    planes = rng.integers(0, 1 << 32, size=(S, 2 + depth, W),
+                          dtype=np.uint32)
+    combos = np.array(list(itertools.product(range(3), range(2))),
+                      dtype=np.int32)
+    counts, nn, pos, neg = kernels.groupby_sum(
+        stacks, combos, jnp.asarray(planes), signed=True)
+    for ci, (a, b) in enumerate(combos):
+        m = np.asarray(stacks[0])[a] & np.asarray(stacks[1])[b]
+        em = m & planes[:, 0]
+        assert int(counts[ci]) == int(np.bitwise_count(m).sum())
+        assert int(nn[ci]) == int(np.bitwise_count(em).sum())
+
+
+def test_groupby_kernel_gating():
+    """The kernel path declines exactly the cases the XLA scan must
+    handle: host-only mode, big combo spaces, >2000-shard int32
+    bounds, and non-TPU backends (unless forced)."""
+    import os
+
+    from pilosa_tpu.executor.stacked import StackedEngine
+    from pilosa_tpu.models import Holder
+
+    eng = StackedEngine(Holder(width=W))
+    forced = os.environ.get("PILOSA_TPU_GROUPBY_KERNEL")
+    try:
+        os.environ["PILOSA_TPU_GROUPBY_KERNEL"] = "1"
+        assert eng._groupby_kernel_ok(60, 954)
+        assert not eng._groupby_kernel_ok(2000, 954)   # combo bound
+        assert not eng._groupby_kernel_ok(60, 2001)    # int32 bound
+        eng.host_only = True
+        assert not eng._groupby_kernel_ok(60, 954)
+        eng.host_only = False
+        os.environ["PILOSA_TPU_GROUPBY_KERNEL"] = "0"
+        assert not eng._groupby_kernel_ok(60, 954)
+        del os.environ["PILOSA_TPU_GROUPBY_KERNEL"]
+        import jax
+        if jax.default_backend() != "tpu":
+            assert not eng._groupby_kernel_ok(60, 954)
+    finally:
+        if forced is None:
+            os.environ.pop("PILOSA_TPU_GROUPBY_KERNEL", None)
+        else:
+            os.environ["PILOSA_TPU_GROUPBY_KERNEL"] = forced
