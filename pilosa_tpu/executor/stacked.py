@@ -155,6 +155,45 @@ _NARY_OPS = {
 _GB_KERNEL_JIT: dict = {}
 
 
+def _groupby_kernel_shard_map(mesh, nf: int, has_planes: bool,
+                              signed: bool):
+    """shard_map wrapper: every device runs the fused kernel on its
+    local shard slice, partial results psum over the whole mesh —
+    the kernel analog of the stacked engine's in-program reduce."""
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    key = (id(mesh), nf, has_planes, signed)
+    fn = _GB_KERNEL_JIT.get(key)
+    if fn is not None:
+        return fn
+    axes = ("rows", "shards")
+    stack_spec = tuple(P(None, axes, None) for _ in range(nf))
+    if has_planes:
+        in_specs = (stack_spec, P(None, None), P(axes, None, None))
+
+        def body(stacks, sel, planes):
+            c, n, p, g = kernels.groupby_sum(
+                list(stacks), sel, planes, signed=signed)
+            return jax.lax.psum(jnp.concatenate(
+                [c, n, p.ravel(), g.ravel()]), axes)
+    else:
+        in_specs = (stack_spec, P(None, None))
+
+        def body(stacks, sel):
+            c, _n, _p, _g = kernels.groupby_sum(
+                list(stacks), sel, None, signed=signed)
+            return jax.lax.psum(c, axes)
+
+    run = jax.jit(partial(
+        shard_map, mesh=mesh, in_specs=in_specs,
+        out_specs=P(None), check_vma=False)(body))
+    _GB_KERNEL_JIT[key] = run
+    return run
+
+
 def _groupby_kernel_jit(nf: int, has_planes: bool, signed: bool):
     key = (nf, has_planes, signed)
     fn = _GB_KERNEL_JIT.get(key)
@@ -834,24 +873,33 @@ class StackedEngine:
             return False
         if flag == "1":
             return True
-        if jax.default_backend() != "tpu":
-            return False
-        n_dev = (self.mesh.devices.size if self.mesh is not None
-                 else jax.device_count())
-        return n_dev == 1
+        return jax.default_backend() == "tpu"
 
     def _groupby_kernel_path(self, idx, fields_rows, agg_field, skey,
                              combos, depth: int, signed: bool):
-        stacks = [self.rows_stack_for(idx, f, (VIEW_STANDARD,),
-                                      rl, skey)
-                  for f, rl in fields_rows]
-        planes = (self.plane_stack(idx, agg_field, skey)
-                  if agg_field is not None else None)
+        multi = self._n_total_devices() > 1
+        if multi:
+            stacks = [self.rows_stack_flat(idx, f, (VIEW_STANDARD,),
+                                           rl, skey)
+                      for f, rl in fields_rows]
+            planes = (self.plane_stack_flat(idx, agg_field, skey)
+                      if agg_field is not None else None)
+            fn = _groupby_kernel_shard_map(
+                self.mesh, len(stacks), planes is not None, signed)
+        else:
+            stacks = [self.rows_stack_for(idx, f, (VIEW_STANDARD,),
+                                          rl, skey)
+                      for f, rl in fields_rows]
+            planes = (self.plane_stack(idx, agg_field, skey)
+                      if agg_field is not None else None)
+            fn = _groupby_kernel_jit(len(stacks), planes is not None,
+                                     signed)
         sel = np.asarray(combos, dtype=np.int32).reshape(
             len(combos), len(fields_rows))
-        fn = _groupby_kernel_jit(len(stacks), planes is not None,
-                                 signed)
-        out = fn(tuple(stacks), sel, planes)
+        if multi and planes is None:
+            out = fn(tuple(stacks), sel)
+        else:
+            out = fn(tuple(stacks), sel, planes)
         if agg_field is None:
             return np.asarray(out, dtype=np.int64), None
         flat = np.asarray(out, dtype=np.int64)
@@ -994,6 +1042,18 @@ class StackedEngine:
             ex, vals = bsi_ops.host_combine_decoded(e, s, vlo, vhi)
             yield shards[lo:hi], ex, vals
 
+    def _rows_stack_np(self, idx, per_view, row_key, n_shards):
+        """Host (R, S, W) assembly shared by the placement variants."""
+        width = idx.width
+        out = np.zeros((len(row_key), n_shards, width // 32),
+                       dtype=np.uint32)
+        for frags in per_view:
+            for si, fr in enumerate(frags):
+                if fr is not None:
+                    for ri, r in enumerate(row_key):
+                        out[ri, si] |= fr.row_words(r)
+        return out
+
     def rows_stack_for(self, idx, field, views: tuple[str, ...],
                        row_ids, skey: tuple):
         """(R, S, W) stacked candidate rows for the TopN/TopK scan.
@@ -1011,14 +1071,8 @@ class StackedEngine:
         versions = tuple(self._versions(fr) for fr in per_view)
 
         def build():
-            width = idx.width
-            out = np.zeros((len(row_key), len(shards), width // 32),
-                           dtype=np.uint32)
-            for frags in per_view:
-                for si, fr in enumerate(frags):
-                    if fr is not None:
-                        for ri, r in enumerate(row_key):
-                            out[ri, si] |= fr.row_words(r)
+            out = self._rows_stack_np(idx, per_view, row_key,
+                                      len(shards))
             if self.host_only:
                 return out  # mirror place(): no device touch
             if self.mesh is None:
@@ -1042,5 +1096,71 @@ class StackedEngine:
             from jax.sharding import NamedSharding, PartitionSpec as P
             return jax.device_put(
                 out, NamedSharding(self.mesh, P("rows", "shards", None)))
+
+        return self.cache.get(key, versions, build)
+
+    # -- flat placements for the mesh GroupBy kernel --------------------
+    # The shard_map kernel path shards the SHARD axis over every mesh
+    # device (rows axis included) and replicates candidate rows — a
+    # different layout from the 2D rows x shards placement above, so
+    # these live under their own cache keys.
+
+    def _n_total_devices(self) -> int:
+        return int(self.mesh.devices.size) if self.mesh is not None \
+            else 1
+
+    def rows_stack_flat(self, idx, field, views: tuple[str, ...],
+                        row_ids, skey: tuple):
+        """(R, S, W) with S sharded over ALL mesh devices, R
+        replicated (the kernel gathers rows locally by sel)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        shards = list(skey)
+        row_key = tuple(int(r) for r in row_ids)
+        key = ("rowchunk_flat", idx.name, field.name, views, row_key,
+               skey, id(self.mesh))
+        per_view = [self._frags(idx, field, vn, shards) for vn in views]
+        versions = tuple(self._versions(fr) for fr in per_view)
+
+        def build():
+            out = self._rows_stack_np(idx, per_view, row_key,
+                                      len(shards))
+            n = self._n_total_devices()
+            s = out.shape[1]
+            if s % n:
+                out = np.concatenate(
+                    [out, np.zeros(
+                        (out.shape[0], n - s % n, out.shape[2]),
+                        dtype=out.dtype)], axis=1)
+            return jax.device_put(out, NamedSharding(
+                self.mesh, P(None, ("rows", "shards"), None)))
+
+        return self.cache.get(key, versions, build)
+
+    def plane_stack_flat(self, idx, field, skey: tuple):
+        """(S, P, W) planes with S sharded over ALL mesh devices."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        shards = list(skey)
+        depth = field.bit_depth
+        key = ("planes_flat", idx.name, field.name, depth, skey,
+               id(self.mesh))
+        frags = self._frags(idx, field, field.bsi_view, shards)
+        versions = self._versions(frags)
+
+        def build():
+            width = idx.width
+            out = np.zeros((len(shards), 2 + depth, width // 32),
+                           dtype=np.uint32)
+            for i, fr in enumerate(frags):
+                if fr is not None:
+                    for r in range(2 + depth):
+                        out[i, r] = fr.row_words(r)
+            n = self._n_total_devices()
+            if out.shape[0] % n:
+                pad = n - out.shape[0] % n
+                out = np.concatenate(
+                    [out, np.zeros((pad,) + out.shape[1:],
+                                   dtype=out.dtype)])
+            return jax.device_put(out, NamedSharding(
+                self.mesh, P(("rows", "shards"), None, None)))
 
         return self.cache.get(key, versions, build)

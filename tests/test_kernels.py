@@ -228,3 +228,40 @@ class TestGroupbySum:
         as_t = lambda res: [(tuple(g["row_id"] for g in r.group),
                              r.count, r.agg, r.agg_count) for r in res]
         assert as_t(got) == as_t(want)
+
+    def test_engine_groupby_kernel_on_mesh(self, rng, monkeypatch):
+        """shard_map kernel path over a REAL 2x4 mesh: every device
+        runs the fused kernel on its shard slice, partials psum."""
+        import jax
+
+        from pilosa_tpu.executor import Executor
+        from pilosa_tpu.models import FieldOptions, FieldType, Holder
+        from pilosa_tpu.parallel.mesh import make_mesh
+
+        if len(jax.devices()) < 8:
+            import pytest
+            pytest.skip("needs 8 devices")
+        W = 1 << 12
+        h = Holder(width=W)
+        idx = h.create_index("i")
+        idx.create_field("g")
+        idx.create_field("d")
+        idx.create_field("v", FieldOptions(type=FieldType.INT,
+                                           min=-50, max=50))
+        cols = list(range(0, 5 * W, 7))
+        idx.field("g").import_bits([c % 3 for c in cols], cols)
+        idx.field("d").import_bits([c % 2 for c in cols], cols)
+        vals = [int(v) for v in rng.integers(-50, 50, size=len(cols))]
+        idx.field("v").import_values(cols, vals)
+        idx.mark_columns_exist(cols)
+        q = "GroupBy(Rows(g), Rows(d), aggregate=Sum(field=v))"
+        ex_loop = Executor(h)
+        ex_loop.use_stacked = False
+        want = ex_loop.execute("i", q)[0]
+        monkeypatch.setenv("PILOSA_TPU_GROUPBY_KERNEL", "1")
+        ex_mesh = Executor(h)
+        ex_mesh.set_mesh(make_mesh(8, rows=2))
+        got = ex_mesh.execute("i", q)[0]
+        as_t = lambda res: [(tuple(g["row_id"] for g in r.group),
+                             r.count, r.agg, r.agg_count) for r in res]
+        assert as_t(got) == as_t(want)
