@@ -175,3 +175,34 @@ def test_exclusive_transaction_blocks_writes(node):
     srv.api.finish_transaction(tx["id"])
     # writable again
     srv.api.import_bits("b", "f", rows=[1], cols=[9])
+
+
+def test_backup_restore_preserves_quantum_views(node, tmp_path):
+    """qa/testcases/bug-repros fb-1332 + fb-1287 shape: backup a
+    time-quantum field, delete the index, restore, and the ranged
+    Rows query must answer identically."""
+    import datetime as dt
+
+    srv, holder, host = node
+    srv.api.apply_schema({"indexes": [{"name": "q", "keys": False,
+        "fields": [{"name": "seg", "options": {
+            "type": "time", "time_quantum": "YMD"}}]}]})
+    idx = holder.index("q")
+    f = idx.field("seg")
+    f.set_bit(1, 5, timestamp=dt.datetime(2022, 1, 10))
+    f.set_bit(1, SHARD + 7, timestamp=dt.datetime(2022, 3, 2))
+    f.set_bit(1, 9, timestamp=dt.datetime(2022, 6, 1))
+    idx.mark_columns_exist([5, SHARD + 7, 9])
+    ranged = ('Count(UnionRows(Rows(seg, from="2022-01-02T15:04", '
+              'to="2022-04-02T15:04")))')
+    assert srv.api.query("q", ranged)["results"] == [2]
+
+    bdir = str(tmp_path / "qbak")
+    assert main(["backup", "--host", host, "--output-dir", bdir,
+                 "--quiet"]) == 0
+    srv.api.delete_index("q")
+    assert main(["restore", "--host", host, "--source-dir", bdir,
+                 "--quiet"]) == 0
+    assert srv.api.query("q", ranged)["results"] == [2]
+    assert srv.api.query(
+        "q", 'Count(UnionRows(Rows(seg)))')["results"] == [3]
