@@ -192,3 +192,29 @@ def test_groupby_kernel_gating():
             os.environ.pop("PILOSA_TPU_GROUPBY_KERNEL", None)
         else:
             os.environ["PILOSA_TPU_GROUPBY_KERNEL"] = forced
+
+
+def test_sort_extract_decode_chunking_at_scale(rng):
+    """Sort/Extract over enough shards to exercise decode_stream's
+    _DECODE_CHUNK boundary (device BSI decode in shard chunks, not
+    per-column host work), cross-checked against ground truth."""
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.executor.stacked import StackedEngine
+    from pilosa_tpu.models import FieldOptions, FieldType, Holder
+
+    n_shards = StackedEngine._DECODE_CHUNK + 3  # force >1 chunk
+    h = Holder(width=W)
+    idx = h.create_index("i")
+    idx.create_field("v", FieldOptions(type=FieldType.INT,
+                                       min=-100, max=100))
+    cols = rng.choice(n_shards * W, size=600, replace=False)
+    vals = rng.integers(-100, 100, size=cols.size)
+    idx.field("v").import_values(cols.tolist(),
+                                 [int(x) for x in vals])
+    idx.mark_columns_exist(cols.tolist())
+    ex = Executor(h)
+    got = ex.execute("i", "Sort(All(), field=v, limit=5)")[0]
+    want = sorted(zip(cols.tolist(), vals.tolist()),
+                  key=lambda cv: (cv[1], cv[0]))[:5]
+    assert [(int(c), int(v)) for c, v in
+            zip(got.columns, got.values)][:5] == want
