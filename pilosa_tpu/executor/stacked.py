@@ -877,6 +877,8 @@ class StackedEngine:
 
     def _groupby_kernel_path(self, idx, fields_rows, agg_field, skey,
                              combos, depth: int, signed: bool):
+        from pilosa_tpu.obs.metrics import GROUPBY_KERNEL
+        GROUPBY_KERNEL.inc()
         multi = self._n_total_devices() > 1
         if multi:
             stacks = [self.rows_stack_flat(idx, f, (VIEW_STANDARD,),

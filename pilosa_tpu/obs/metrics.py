@@ -217,3 +217,6 @@ JOB_TOTAL = registry.counter(
 STACKED_QUERIES = registry.counter(
     "pilosa_stacked_queries_total",
     "Query ops routed to the stacked mesh engine vs the shard loop")
+GROUPBY_KERNEL = registry.counter(
+    "pilosa_groupby_kernel_total",
+    "GroupBy queries served by the fused Pallas kernel path")

@@ -442,6 +442,29 @@ CASES = [
     ("fn_unknown_errors",
      "SELECT NOSUCHFN(region) FROM orders", ("error", "NOSUCHFN")),
 
+    ("fn_charindex_with_pos",
+     "SELECT CHARINDEX('s', 'mississippi', 4), "
+     "CHARINDEX('s', 'mississippi', 7) FROM orders WHERE _id = 1",
+     [(5, -1)]),
+    ("fn_str_overflow_renders_stars",
+     "SELECT STR(12345, 3) FROM orders WHERE _id = 1", [("***",)]),
+    ("fn_str_decimals",
+     "SELECT STR(price, 6, 1) FROM orders WHERE _id = 1",
+     [("  10.5",)]),
+    ("fn_replicate_zero", "SELECT REPLICATE('ab', 0) "
+     "FROM orders WHERE _id = 1", [("",)]),
+    ("fn_substring_full_tail",
+     "SELECT SUBSTRING(region, 0) FROM orders WHERE _id = 1",
+     [("west",)]),
+    ("fn_ascii_multichar_errors",
+     "SELECT ASCII(region) FROM orders WHERE _id = 1",
+     ("error", "single character")),
+    ("fn_arity_validated_before_null",
+     # NULL args must not mask an arity error (r03 review)
+     "INSERT INTO orders (_id, qty) VALUES (8, 1); "
+     "SELECT SUBSTRING(region, 1, 2, 3) FROM orders WHERE _id = 8",
+     ("error", "arguments")),
+
     # ---- scalar functions: datetime (inbuiltfunctionsdate.go) -----------
     ("fn_datetimepart",
      "SELECT DATETIMEPART('YY', '2024-05-06T07:08:09'), "
@@ -477,6 +500,25 @@ CASES = [
     ("fn_bad_interval",
      "SELECT DATETIMEPART('XX', '2024-05-06T07:08:09') FROM orders",
      ("error", "interval")),
+    ("fn_datetimepart_week_and_weekday",
+     # 2024-05-06 is a Monday: Go Weekday()=1, ISO week 19, yearday 127
+     "SELECT DATETIMEPART('W', '2024-05-06T00:00:00'), "
+     "DATETIMEPART('WK', '2024-05-06T00:00:00'), "
+     "DATETIMEPART('YD', '2024-05-06T00:00:00') "
+     "FROM orders WHERE _id = 1", [(1, 19, 127)]),
+    ("fn_datetimename_weekday",
+     "SELECT DATETIMENAME('W', '2024-05-06T00:00:00') "
+     "FROM orders WHERE _id = 1", [("Monday",)]),
+    ("fn_datetimediff_negative",
+     # reversed operands give a negative diff (b - a)
+     "SELECT DATETIMEDIFF('D', '2024-05-06T00:00:00', "
+     "'2024-05-01T00:00:00') FROM orders WHERE _id = 1", [(-5,)]),
+    ("fn_date_trunc_year",
+     "SELECT DATE_TRUNC('YY', '2024-05-06T07:08:09') "
+     "FROM orders WHERE _id = 1", [("2024-01-01T00:00:00",)]),
+    ("fn_totimestamp_us",
+     "SELECT TOTIMESTAMP(1500000, 'us') FROM orders WHERE _id = 1",
+     [("1970-01-01T00:00:01.500000",)]),
 
     # ---- scalar functions: set (inbuiltfunctionsset.go) -----------------
     ("fn_setcontains",
