@@ -221,11 +221,15 @@ class Field:
             self._max_seen, int(ivs.max()))
         view = self.view(self.bsi_view, create=True)
         shards = cols // self.width
-        for shard in np.unique(shards):
-            sel = shards == shard
+        order = np.argsort(shards, kind="stable")
+        cols_s, ivs_s, sh_s = cols[order], ivs[order], shards[order]
+        uniq, starts = np.unique(sh_s, return_index=True)
+        bounds = np.append(starts[1:], sh_s.size)
+        for shard, lo, hi in zip(uniq.tolist(), starts.tolist(),
+                                 bounds.tolist()):
             frag = view.fragment(int(shard), create=True)
-            frag.import_values(cols[sel] % self.width, ivs[sel],
-                               self.bit_depth)
+            frag.import_values(cols_s[lo:hi] % self.width,
+                               ivs_s[lo:hi], self.bit_depth)
 
     def import_bits(self, rows, cols, timestamps=None):
         """Bulk set-bit import grouped by shard (+ time views)."""
@@ -233,18 +237,27 @@ class Field:
         cols = np.asarray(cols, dtype=np.int64)
         shards = cols // self.width
         is_mutexish = self.options.type in (FieldType.MUTEX, FieldType.BOOL)
-        for shard in np.unique(shards):
-            sel = shards == shard
+        # one sort then contiguous slices per shard — a boolean mask
+        # per distinct shard is O(n_shards * n) and dominated a 2M-bit
+        # import (measured r03: 0.85 s of 1.4 s)
+        order = np.argsort(shards, kind="stable")
+        rows_s, cols_s, sh_s = rows[order], cols[order], shards[order]
+        uniq, starts = np.unique(sh_s, return_index=True)
+        bounds = np.append(starts[1:], sh_s.size)
+        for shard, lo, hi in zip(uniq.tolist(), starts.tolist(),
+                                 bounds.tolist()):
             frag = self.view(VIEW_STANDARD, create=True).fragment(
                 int(shard), create=True)
             if is_mutexish:
-                for r, c in zip(rows[sel], cols[sel] % self.width):
+                for r, c in zip(rows_s[lo:hi],
+                                cols_s[lo:hi] % self.width):
                     for other in frag.row_ids:
                         if other != r:
                             frag.clear_bit(other, int(c))
                     frag.set_bit(int(r), int(c))
             else:
-                frag.import_bits(rows[sel], cols[sel] % self.width)
+                frag.import_bits(rows_s[lo:hi],
+                                 cols_s[lo:hi] % self.width)
         if self.options.type == FieldType.TIME and timestamps is not None:
             for r, c, ts in zip(rows, cols, timestamps):
                 if ts is None:
