@@ -332,3 +332,22 @@ def test_kill_rejoin_resync():
                 n.close()
             except Exception:
                 pass
+
+
+def test_cluster_time_quantum_ranged_query_with_failover(cluster):
+    """Replicated time-quantum writes: quantum views land on every
+    replica, ranged Rows queries fan out correctly, and they survive
+    a node failure (fb-1287 query shape over the cluster)."""
+    n0 = cluster[0]
+    n0.apply_schema({"indexes": [{"name": "t", "keys": False,
+        "fields": [{"name": "seg", "options": {
+            "type": "time", "time_quantum": "YMD"}}]}]})
+    cols = [1, SHARD + 2, 2 * SHARD + 3, 3 * SHARD + 4]
+    stamps = ["2022-01-10T00:00", "2022-03-02T00:00",
+              "2022-06-01T00:00", "2022-01-20T00:00"]
+    n0.import_bits("t", "seg", [1] * 4, cols, timestamps=stamps)
+    ranged = ('Count(UnionRows(Rows(seg, from="2022-01-01T00:00", '
+              'to="2022-04-01T00:00")))')
+    assert cluster[1].query("t", ranged)["results"] == [3]
+    cluster[2].pause()
+    assert cluster[1].query("t", ranged)["results"] == [3]
