@@ -1577,10 +1577,19 @@ class SQLEngine:
             # sort (_order_rows) applies every key; keys must be
             # projected.  LIMIT stays host-side (applies after sort).
             pass  # name matching happens in _order_rows
-        elif stmt.order_by:
+        order_ordinal = None  # ORDER BY <n> (1-based projection index)
+        if not multi_order and stmt.order_by:
             ob = stmt.order_by[0]
             if isinstance(ob.expr, ast.Col):
                 order_col = ob.expr.name
+            elif isinstance(ob.expr, ast.Lit) and \
+                    isinstance(ob.expr.value, int) and \
+                    not isinstance(ob.expr.value, bool):
+                order_ordinal = ob.expr.value - 1
+                if not (0 <= order_ordinal < len(items)):
+                    raise SQLError(
+                        f"ORDER BY position {ob.expr.value} out of "
+                        "range")
             else:
                 order_expr = self._fold_subqueries(ob.expr)
                 for n in columns_in(order_expr):
@@ -1596,6 +1605,9 @@ class SQLEngine:
         order_alias = None  # ORDER BY a projected alias / output name
         null_tail = None  # rows where the BSI sort column is NULL
         if order_expr is not None:
+            host_sort = True
+        elif order_ordinal is not None:
+            order_alias = order_ordinal
             host_sort = True
         elif order_col is not None and order_col != "_id" and \
                 idx.field(order_col) is None and order_col in names:
@@ -1958,6 +1970,20 @@ class SQLEngine:
         names = [s[0] for s in schema]
         rows = list(rows)
         for ob in reversed(stmt.order_by):
+            if isinstance(ob.expr, ast.Lit) and \
+                    isinstance(ob.expr.value, int) and \
+                    not isinstance(ob.expr.value, bool):
+                # ORDER BY <n>: 1-based projection ordinal
+                i = ob.expr.value - 1
+                if not (0 <= i < len(names)):
+                    raise SQLError(
+                        f"ORDER BY position {ob.expr.value} out of "
+                        "range")
+                nn = [r for r in rows if r[i] is not None]
+                nulls = [r for r in rows if r[i] is None]
+                nn.sort(key=lambda r: r[i], reverse=ob.desc)
+                rows = nn + nulls
+                continue
             if isinstance(ob.expr, ast.Col) and ob.expr.table:
                 name = f"{ob.expr.table}.{ob.expr.name}"
             elif isinstance(ob.expr, ast.Col):

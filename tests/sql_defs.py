@@ -563,6 +563,17 @@ CASES = [
      "SELECT _id, qty * 2 AS dbl FROM orders WHERE qty IS NOT NULL "
      "ORDER BY dbl DESC LIMIT 2",
      ("ordered", [(2, 24), (5, 24)])),
+    ("order_by_ordinal",
+     # defs_orderby.go `order by 1 asc`
+     "SELECT qty, _id FROM orders WHERE qty IS NOT NULL ORDER BY 1",
+     ("ordered", [(2, 4), (5, 1), (7, 3), (12, 2), (12, 5)])),
+    ("order_by_ordinal_multi",
+     "SELECT region, qty FROM orders WHERE qty IS NOT NULL "
+     "ORDER BY 1, 2 DESC",
+     ("ordered", [("east", 7), ("east", 2), ("north", 12),
+                  ("west", 12), ("west", 5)])),
+    ("order_by_ordinal_out_of_range",
+     "SELECT qty FROM orders ORDER BY 3", ("error", "out of range")),
 
     # ---- ALTER TABLE (compilealtertable.go) -----------------------------
     ("alter_add_column",
