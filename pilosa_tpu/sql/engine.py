@@ -1573,10 +1573,22 @@ class SQLEngine:
         order_expr = None  # non-column ORDER BY key (host-evaluated)
         multi_order = stmt.order_by and len(stmt.order_by) > 1
         if multi_order:
-            # multi-key: materialize unordered, then the shared host
-            # sort (_order_rows) applies every key; keys must be
-            # projected.  LIMIT stays host-side (applies after sort).
-            pass  # name matching happens in _order_rows
+            # multi-key: materialize unordered, then host-sort with
+            # every key.  Keys need not be projected (defs_orderby's
+            # `order by foo asc, a_decimal asc`): unprojected sort
+            # columns ride the Extract, and exprs/ordinals/aliases
+            # evaluate per row.  LIMIT stays host-side (after sort).
+            for ob in stmt.order_by:
+                e = ob.expr
+                if isinstance(e, ast.Col) and e.name != "_id" and \
+                        idx.field(e.name) is not None:
+                    ref_cols.add(e.name)
+                elif not isinstance(e, (ast.Col, ast.Lit)):
+                    for n2 in columns_in(self._fold_subqueries(e)):
+                        if n2 != "_id":
+                            self._field(idx, n2)
+                            ref_cols.add(n2)
+            non_id = sorted(ref_cols)
         order_ordinal = None  # ORDER BY <n> (1-based projection index)
         if not multi_order and stmt.order_by:
             ob = stmt.order_by[0]
@@ -1664,12 +1676,42 @@ class SQLEngine:
                 schema.append((self._name_of(it),
                                self._expr_type(idx, plan[1])))
         ev = Evaluator(udfs=self._udf_callables())
+        # multi-key ORDER BY: resolve every key to a per-row getter
+        # plan ("ord" projection index | "id" | "col" extracted name |
+        # "alias" projection index | "expr" folded scalar)
+        mord = []
+        if multi_order:
+            for ob in stmt.order_by:
+                e = ob.expr
+                if isinstance(e, ast.Lit) and \
+                        isinstance(e.value, int) and \
+                        not isinstance(e.value, bool):
+                    i = e.value - 1
+                    if not (0 <= i < len(items)):
+                        raise SQLError(
+                            f"ORDER BY position {e.value} out of range")
+                    mord.append(("ord", i))
+                elif isinstance(e, ast.Col) and e.name == "_id":
+                    mord.append(("id", None))
+                elif isinstance(e, ast.Col) and \
+                        idx.field(e.name) is not None:
+                    mord.append(("col", e.name))
+                elif isinstance(e, ast.Col):
+                    if e.name not in names:
+                        raise SQLError(
+                            f"ORDER BY column {e.name!r} not found")
+                    mord.append(("alias", names.index(e.name)))
+                else:
+                    mord.append(("expr", self._fold_subqueries(e)))
+        need_env = (order_expr is not None
+                    or any(p[0] == "expr" for p in plans)
+                    or any(k == "expr" for k, _a in mord))
         rows = []
         sort_keys = []
+        mkeys = []
         for entry in table.columns:
             env = None
-            if order_expr is not None or \
-                    any(p[0] == "expr" for p in plans):
+            if need_env:
                 env = {n: self._to_sql_value(entry["rows"][i])
                        for i, n in enumerate(extract_cols)}
                 env["_id"] = entry.get("column_key", entry["column"])
@@ -1696,6 +1738,21 @@ class SQLEngine:
                 if isinstance(k, list):  # set column: sort by first value
                     k = sorted(k)[0] if k else None
                 sort_keys.append(k)
+            if multi_order:
+                mk = []
+                for kind, arg in mord:
+                    if kind == "ord" or kind == "alias":
+                        k = vals[arg]
+                    elif kind == "id":
+                        k = entry.get("column_key", entry["column"])
+                    elif kind == "col":
+                        k = entry["rows"][extract_cols.index(arg)]
+                    else:
+                        k = ev.eval(arg, env)
+                    if isinstance(k, list):
+                        k = sorted(k)[0] if k else None
+                    mk.append(k)
+                mkeys.append(mk)
         if host_sort:
             # NULLS LAST in both directions (matches the Sort pushdown)
             nn = [i for i, k in enumerate(sort_keys) if k is not None]
@@ -1704,7 +1761,15 @@ class SQLEngine:
                     reverse=stmt.order_by[0].desc)
             rows = [rows[i] for i in nn + nulls]
         if multi_order:
-            rows = self._order_rows(stmt, schema, rows)
+            # stable sorts applied last-key-first, NULLS LAST per key
+            order = list(range(len(rows)))
+            for ki in reversed(range(len(mord))):
+                desc = stmt.order_by[ki].desc
+                nn = [i for i in order if mkeys[i][ki] is not None]
+                nulls = [i for i in order if mkeys[i][ki] is None]
+                nn.sort(key=lambda i: mkeys[i][ki], reverse=desc)
+                order = nn + nulls
+            rows = [rows[i] for i in order]
         if stmt.distinct:
             # spill-backed dedup: in-memory set until the threshold,
             # then the on-disk extendible hash (sql3 opdistinct over

@@ -574,6 +574,17 @@ CASES = [
                   ("west", 12), ("west", 5)])),
     ("order_by_ordinal_out_of_range",
      "SELECT qty FROM orders ORDER BY 3", ("error", "out of range")),
+    ("order_by_multi_unprojected",
+     # defs_orderby.go `order by foo asc, a_decimal asc`: alias key +
+     # an UNPROJECTED column key in one ORDER BY
+     "SELECT qty AS foo, _id FROM orders WHERE qty IS NOT NULL "
+     "ORDER BY foo, price DESC",
+     ("ordered", [(2, 4), (5, 1), (7, 3), (12, 2), (12, 5)])),
+    ("order_by_multi_expr_key",
+     # qty % 5: id1->0, others->2; ties break by _id
+     "SELECT _id FROM orders WHERE qty IS NOT NULL "
+     "ORDER BY qty % 5, _id",
+     ("ordered", [(1,), (2,), (3,), (4,), (5,)])),
 
     # ---- ALTER TABLE (compilealtertable.go) -----------------------------
     ("alter_add_column",
