@@ -96,9 +96,27 @@ class Index:
         so callers (SQL COPY) never touch field internals."""
         import numpy as np
         if self.keys and self.column_translator is not None:
-            for p, store in self.column_translator._stores.items():
-                dst.column_translator.restore_partition(
-                    p, store.snapshot())
+            # partition routing hashes the INDEX NAME (key_to_key_
+            # partition / shard_to_shard_partition), so entries must
+            # re-partition under dst's name — and into BOTH the
+            # key-hash store (forward lookups) and the shard-owner
+            # store (reverse lookups) when those differ, which keeps
+            # each store's max-id tracking collision-safe for future
+            # allocations
+            from pilosa_tpu.storage.translate import (
+                key_to_key_partition,
+                shard_to_shard_partition,
+            )
+            ct = dst.column_translator
+            for _p, store in self.column_translator._stores.items():
+                for i, k in store.entries():
+                    fwd = key_to_key_partition(dst.name, k,
+                                               ct.partition_n)
+                    rev = shard_to_shard_partition(
+                        dst.name, i // ct.shard_width, ct.partition_n)
+                    ct._store(fwd).force_set(i, k)
+                    if rev != fwd:
+                        ct._store(rev).force_set(i, k)
 
         def copy_field(f, nf):
             nf.bit_depth = f.bit_depth

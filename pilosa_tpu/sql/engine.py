@@ -1796,8 +1796,12 @@ class SQLEngine:
     def _cell_value(self, idx, name: str, col_id: int):
         """One column's value for one record id (join materialization).
         BSI fields -> typed value or None; set-like -> row key/id (or
-        sorted list when multiple); _id -> the id."""
+        sorted list when multiple); _id -> the key (keyed tables) or
+        the id, matching what SELECT projects."""
         if name == "_id":
+            if idx.keys and idx.column_translator is not None:
+                k = idx.column_translator.translate_ids([col_id])[0]
+                return k if k is not None else col_id
             return col_id
         f = self._field(idx, name)
         shard, scol = divmod(col_id, f.width)

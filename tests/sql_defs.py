@@ -706,6 +706,50 @@ CASES = [
      "INSERT INTO ev3 (_id, sites) VALUES (1, (3, 4)); "
      "SELECT _id FROM ev3 WHERE SETCONTAINS(sites, 3)", [(1,)]),
 
+    # ---- keyed tables: string _id end-to-end (defs_keyed.go) ------------
+    ("keyed_table_roundtrip",
+     "CREATE TABLE users (_id string, region string, score int); "
+     "INSERT INTO users (_id, region, score) VALUES "
+     "('alice', 'west', 10), ('bob', 'east', 20), ('carol', 'west', 5); "
+     "SELECT _id, score FROM users WHERE region = 'west' "
+     "ORDER BY score DESC",
+     ("ordered", [("alice", 10), ("carol", 5)])),
+    ("keyed_table_id_filter",
+     "CREATE TABLE users (_id string, score int); "
+     "INSERT INTO users (_id, score) VALUES ('alice', 10), ('bob', 20); "
+     "SELECT score FROM users WHERE _id = 'bob'", [(20,)]),
+    ("keyed_table_id_in_list",
+     "CREATE TABLE users (_id string, score int); "
+     "INSERT INTO users (_id, score) VALUES "
+     "('alice', 10), ('bob', 20), ('dora', 30); "
+     "SELECT _id FROM users WHERE _id IN ('alice', 'dora', 'nope')",
+     [("alice",), ("dora",)]),
+    ("keyed_table_groupby_and_aggregate",
+     "CREATE TABLE users (_id string, region string, score int); "
+     "INSERT INTO users (_id, region, score) VALUES "
+     "('alice', 'west', 10), ('bob', 'east', 20), ('carol', 'west', 5); "
+     "SELECT region, sum(score) FROM users GROUP BY region",
+     [("west", 15), ("east", 20)]),
+    ("keyed_join_keyed",
+     # join between two string-keyed tables on a keyed column
+     "CREATE TABLE users (_id string, city string); "
+     "CREATE TABLE cities (_id string, pop int); "
+     "INSERT INTO users (_id, city) VALUES ('a', 'lyon'), ('b', 'nice'); "
+     "INSERT INTO cities (_id, pop) VALUES ('lyon', 500), ('nice', 300); "
+     "SELECT users._id, cities.pop FROM users "
+     "INNER JOIN cities ON users.city = cities._id",
+     [("a", 500), ("b", 300)]),
+    ("keyed_table_delete_by_key",
+     "CREATE TABLE users (_id string, score int); "
+     "INSERT INTO users (_id, score) VALUES ('alice', 10), ('bob', 20); "
+     "DELETE FROM users WHERE _id = 'alice'; "
+     "SELECT _id FROM users", [("bob",)]),
+    ("keyed_table_copy",
+     "CREATE TABLE users (_id string, tag stringset); "
+     "INSERT INTO users (_id, tag) VALUES ('a', ('x','y')), ('b', ('y')); "
+     "COPY users TO users2; "
+     "SELECT _id FROM users2 WHERE SETCONTAINS(tag, 'x')", [("a",)]),
+
     # ---- negative-range BSI columns (defs_minmaxnegative.go) ------------
     ("negative_int_roundtrip",
      "CREATE TABLE mm (_id id, p int min 10 max 100, "
