@@ -391,10 +391,26 @@ class Executor(AdvancedOps):
 
     def _scaled_bound(self, f: Field, v, round_up: bool) -> int:
         """Scale a predicate to stored units, rounding the bound
-        outward per the comparison op (exact rational arithmetic)."""
+        outward per the comparison op (exact rational arithmetic).
+        String bounds coerce by COLUMN type: timestamps for timestamp
+        columns, numerics elsewhere ('1.50' on a decimal column is a
+        decimal, not a time literal)."""
         if isinstance(v, str):
-            v = timeq.parse_time(v)
+            if f.options.type == FieldType.TIMESTAMP:
+                try:
+                    v = timeq.parse_time(v)
+                except ValueError as e:
+                    raise ExecError(str(e))
+            else:
+                try:
+                    v = Decimal(v)
+                except ArithmeticError:
+                    raise ExecError(
+                        f"cannot parse numeric bound {v!r}")
         if isinstance(v, dt.datetime):
+            if f.options.type != FieldType.TIMESTAMP:
+                raise ExecError(
+                    f"time predicate on {f.options.type.value} field")
             return f.options.timestamp_to_int(v)
         if isinstance(v, bool):
             raise ExecError("bool predicate on int field")

@@ -706,6 +706,21 @@ CASES = [
      "INSERT INTO ev3 (_id, sites) VALUES (1, (3, 4)); "
      "SELECT _id FROM ev3 WHERE SETCONTAINS(sites, 3)", [(1,)]),
 
+    # ---- decimal bounds as strings (defs_between.go forms) --------------
+    ("decimal_between_string_bounds",
+     # prices in [1, 11]: 10.50 (1), 3.25 (2), 1.00 (4)
+     "SELECT _id FROM orders WHERE price BETWEEN '1.00' AND '11.00'",
+     [(1,), (2,), (4,)]),
+    ("decimal_compare_string_bound",
+     "SELECT _id FROM orders WHERE price > '3.00'",
+     [(1,), (2,), (3,)]),
+    ("decimal_bad_string_bound_errors",
+     "SELECT _id FROM orders WHERE price > 'abc'",
+     ("error", "numeric")),
+    ("int_time_literal_bound_errors",
+     "SELECT _id FROM orders WHERE qty > '2022-01-02T00:00:00'",
+     ("error", "numeric")),
+
     # ---- keyed tables: string _id end-to-end (defs_keyed.go) ------------
     ("keyed_table_roundtrip",
      "CREATE TABLE users (_id string, region string, score int); "
