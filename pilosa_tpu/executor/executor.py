@@ -407,6 +407,9 @@ class Executor(AdvancedOps):
                 except ArithmeticError:
                     raise ExecError(
                         f"cannot parse numeric bound {v!r}")
+                if not v.is_finite():
+                    raise ExecError(
+                        f"numeric bound must be finite: {v!r}")
         if isinstance(v, dt.datetime):
             if f.options.type != FieldType.TIMESTAMP:
                 raise ExecError(

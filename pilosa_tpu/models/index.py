@@ -108,7 +108,12 @@ class Index:
                 shard_to_shard_partition,
             )
             ct = dst.column_translator
-            for _p, store in self.column_translator._stores.items():
+            src_ct = self.column_translator
+            # nonempty_partitions scans keys.*.jsonl on disk too —
+            # _stores alone misses partitions not yet lazily opened
+            # (e.g. right after a Holder reopen)
+            for _p in src_ct.nonempty_partitions():
+                store = src_ct._store(_p)
                 for i, k in store.entries():
                     fwd = key_to_key_partition(dst.name, k,
                                                ct.partition_n)

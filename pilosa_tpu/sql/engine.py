@@ -1183,6 +1183,28 @@ class SQLEngine:
         for ob in stmt.order_by:
             walk(ob.expr)
 
+    @staticmethod
+    def _ordinal_index(value: int, n: int) -> int:
+        """1-based ORDER BY projection ordinal -> 0-based index."""
+        i = value - 1
+        if not (0 <= i < n):
+            raise SQLError(f"ORDER BY position {value} out of range")
+        return i
+
+    @staticmethod
+    def _is_ordinal(e) -> bool:
+        return (isinstance(e, ast.Lit) and isinstance(e.value, int)
+                and not isinstance(e.value, bool))
+
+    @staticmethod
+    def _sorted_nulls_last(indices, key, desc: bool) -> list[int]:
+        """Stable sort of index list by key(i), NULLS LAST either
+        direction (the Sort pushdown's convention)."""
+        nn = [i for i in indices if key(i) is not None]
+        nulls = [i for i in indices if key(i) is None]
+        nn.sort(key=key, reverse=desc)
+        return nn + nulls
+
     def _name_of(self, it: ast.SelectItem) -> str:
         if it.alias:
             return it.alias
@@ -1594,14 +1616,9 @@ class SQLEngine:
             ob = stmt.order_by[0]
             if isinstance(ob.expr, ast.Col):
                 order_col = ob.expr.name
-            elif isinstance(ob.expr, ast.Lit) and \
-                    isinstance(ob.expr.value, int) and \
-                    not isinstance(ob.expr.value, bool):
-                order_ordinal = ob.expr.value - 1
-                if not (0 <= order_ordinal < len(items)):
-                    raise SQLError(
-                        f"ORDER BY position {ob.expr.value} out of "
-                        "range")
+            elif self._is_ordinal(ob.expr):
+                order_ordinal = self._ordinal_index(
+                    ob.expr.value, len(items))
             else:
                 order_expr = self._fold_subqueries(ob.expr)
                 for n in columns_in(order_expr):
@@ -1652,6 +1669,32 @@ class SQLEngine:
         if host_sort and order_expr is None and order_alias is None \
                 and order_col != "_id" and order_col not in extract_cols:
             extract_cols.append(order_col)  # fetched for sorting only
+        # multi-key ORDER BY: resolve every key to a per-row getter
+        # BEFORE executing anything, so a bad reference errors without
+        # paying for the scan.  Plans: ("ord" projection index | "id"
+        # | "col" extracted name | "alias" projection index | "expr"
+        # folded scalar)
+        mord = []
+        if multi_order:
+            for ob in stmt.order_by:
+                e = ob.expr
+                if self._is_ordinal(e):
+                    mord.append(
+                        ("ord", self._ordinal_index(e.value,
+                                                    len(items))))
+                elif isinstance(e, ast.Col) and e.name == "_id":
+                    mord.append(("id", None))
+                elif isinstance(e, ast.Col) and \
+                        idx.field(e.name) is not None:
+                    mord.append(("col", e.name))
+                elif isinstance(e, ast.Col):
+                    if e.name not in names:
+                        raise SQLError(
+                            f"ORDER BY column {e.name!r} not found")
+                    mord.append(("alias", names.index(e.name)))
+                else:
+                    mord.append(("expr", self._fold_subqueries(e)))
+
         def run_extract(src):
             c = Call("Extract", children=[src] + [
                 Call("Rows", args={"_field": n}) for n in extract_cols])
@@ -1676,33 +1719,6 @@ class SQLEngine:
                 schema.append((self._name_of(it),
                                self._expr_type(idx, plan[1])))
         ev = Evaluator(udfs=self._udf_callables())
-        # multi-key ORDER BY: resolve every key to a per-row getter
-        # plan ("ord" projection index | "id" | "col" extracted name |
-        # "alias" projection index | "expr" folded scalar)
-        mord = []
-        if multi_order:
-            for ob in stmt.order_by:
-                e = ob.expr
-                if isinstance(e, ast.Lit) and \
-                        isinstance(e.value, int) and \
-                        not isinstance(e.value, bool):
-                    i = e.value - 1
-                    if not (0 <= i < len(items)):
-                        raise SQLError(
-                            f"ORDER BY position {e.value} out of range")
-                    mord.append(("ord", i))
-                elif isinstance(e, ast.Col) and e.name == "_id":
-                    mord.append(("id", None))
-                elif isinstance(e, ast.Col) and \
-                        idx.field(e.name) is not None:
-                    mord.append(("col", e.name))
-                elif isinstance(e, ast.Col):
-                    if e.name not in names:
-                        raise SQLError(
-                            f"ORDER BY column {e.name!r} not found")
-                    mord.append(("alias", names.index(e.name)))
-                else:
-                    mord.append(("expr", self._fold_subqueries(e)))
         need_env = (order_expr is not None
                     or any(p[0] == "expr" for p in plans)
                     or any(k == "expr" for k, _a in mord))
@@ -1754,21 +1770,17 @@ class SQLEngine:
                     mk.append(k)
                 mkeys.append(mk)
         if host_sort:
-            # NULLS LAST in both directions (matches the Sort pushdown)
-            nn = [i for i, k in enumerate(sort_keys) if k is not None]
-            nulls = [i for i, k in enumerate(sort_keys) if k is None]
-            nn.sort(key=lambda i: sort_keys[i],
-                    reverse=stmt.order_by[0].desc)
-            rows = [rows[i] for i in nn + nulls]
+            order = self._sorted_nulls_last(
+                range(len(rows)), lambda i: sort_keys[i],
+                stmt.order_by[0].desc)
+            rows = [rows[i] for i in order]
         if multi_order:
             # stable sorts applied last-key-first, NULLS LAST per key
             order = list(range(len(rows)))
             for ki in reversed(range(len(mord))):
-                desc = stmt.order_by[ki].desc
-                nn = [i for i in order if mkeys[i][ki] is not None]
-                nulls = [i for i in order if mkeys[i][ki] is None]
-                nn.sort(key=lambda i: mkeys[i][ki], reverse=desc)
-                order = nn + nulls
+                order = self._sorted_nulls_last(
+                    order, lambda i: mkeys[i][ki],
+                    stmt.order_by[ki].desc)
             rows = [rows[i] for i in order]
         if stmt.distinct:
             # spill-backed dedup: in-memory set until the threshold,
@@ -2039,19 +2051,11 @@ class SQLEngine:
         names = [s[0] for s in schema]
         rows = list(rows)
         for ob in reversed(stmt.order_by):
-            if isinstance(ob.expr, ast.Lit) and \
-                    isinstance(ob.expr.value, int) and \
-                    not isinstance(ob.expr.value, bool):
-                # ORDER BY <n>: 1-based projection ordinal
-                i = ob.expr.value - 1
-                if not (0 <= i < len(names)):
-                    raise SQLError(
-                        f"ORDER BY position {ob.expr.value} out of "
-                        "range")
-                nn = [r for r in rows if r[i] is not None]
-                nulls = [r for r in rows if r[i] is None]
-                nn.sort(key=lambda r: r[i], reverse=ob.desc)
-                rows = nn + nulls
+            if self._is_ordinal(ob.expr):
+                i = self._ordinal_index(ob.expr.value, len(names))
+                order = self._sorted_nulls_last(
+                    range(len(rows)), lambda j: rows[j][i], ob.desc)
+                rows = [rows[j] for j in order]
                 continue
             if isinstance(ob.expr, ast.Col) and ob.expr.table:
                 name = f"{ob.expr.table}.{ob.expr.name}"
@@ -2069,10 +2073,9 @@ class SQLEngine:
                     if not matches else
                     f"ORDER BY column {name!r} is ambiguous")
             i = matches[0]
-            nn = [r for r in rows if r[i] is not None]
-            nulls = [r for r in rows if r[i] is None]
-            nn.sort(key=lambda r: r[i], reverse=ob.desc)
-            rows = nn + nulls
+            order = self._sorted_nulls_last(
+                range(len(rows)), lambda j: rows[j][i], ob.desc)
+            rows = [rows[j] for j in order]
         return rows
 
     def _limit_rows(self, stmt, rows):

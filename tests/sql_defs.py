@@ -717,6 +717,13 @@ CASES = [
     ("decimal_bad_string_bound_errors",
      "SELECT _id FROM orders WHERE price > 'abc'",
      ("error", "numeric")),
+    ("decimal_nonfinite_bound_errors",
+     # 'NaN'/'Infinity' parse as Decimals but are not usable bounds
+     "SELECT _id FROM orders WHERE price > 'NaN'",
+     ("error", "finite")),
+    ("decimal_infinity_bound_errors",
+     "SELECT _id FROM orders WHERE price > 'Infinity'",
+     ("error", "finite")),
     ("int_time_literal_bound_errors",
      "SELECT _id FROM orders WHERE qty > '2022-01-02T00:00:00'",
      ("error", "numeric")),

@@ -200,3 +200,29 @@ def test_delete_field_drops_row_keys(tmp_path):
     assert f2.row_translator.find_keys("x") == {}
     assert list(ids.values())[0] == f2.row_translator.create_keys("y")["y"]
     h.close()
+
+
+def test_copy_keyed_table_after_reopen(tmp_path):
+    """COPY of a keyed table must include key translations persisted
+    on disk but not yet lazily opened after a Holder reopen (r03
+    review: _stores alone misses them)."""
+    from pilosa_tpu.sql import SQLEngine
+
+    e = SQLEngine(Holder(path=str(tmp_path), width=W))
+    e.query("CREATE TABLE users (_id string, score int)")
+    e.query("INSERT INTO users (_id, score) VALUES "
+            "('alice', 10), ('bob', 20)")
+    e.holder.sync()
+    e.holder.save_schema()
+    e.holder.close()
+
+    h2 = Holder(path=str(tmp_path), width=W)
+    h2.load_schema()
+    try:
+        e2 = SQLEngine(h2)
+        e2.query("COPY users TO users2")
+        got = sorted(e2.query_one(
+            "SELECT _id, score FROM users2").rows)
+        assert got == [("alice", 10), ("bob", 20)]
+    finally:
+        h2.close()
