@@ -50,6 +50,13 @@ PROBE_TIMEOUT_S = 240
 PROBE_ATTEMPTS = 3
 PROBE_BACKOFF_S = 30
 
+# Committed, machine-readable record of the most recent successful
+# platform=tpu run (VERDICT r03 item 1): written on every TPU success,
+# re-emitted verbatim under ``last_tpu_record`` when the tunnel is down
+# at bench time so the round artifact always carries the TPU evidence.
+TPU_RECORD_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_RECORD.json")
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
@@ -345,15 +352,41 @@ def main() -> None:
     if cal is not None:
         result["loop_calibrated_device_ms"] = {
             k: round(v, 4) for k, v in cal.items()}
-    if tunnel_down:
-        # the chip was measured in-session when reachable; the record
-        # (954 shards / 5.0e9 cells, 0.30 ms v5e-16 equiv, 33x under
-        # target) lives in BENCH_TPU_NOTES.md with raw walls +
-        # methodology — this fallback means the tunnel was down at
-        # bench time, not that no TPU measurement exists
-        result["note"] = ("TPU tunnel unreachable at bench time; "
-                          "see BENCH_TPU_NOTES.md for the in-session "
-                          "TPU-measured record at design scale")
+    if on_tpu:
+        # persist the full raw record so future fallback runs can
+        # re-emit real TPU evidence machine-readably (VERDICT r03 #1);
+        # temp+rename so a kill mid-dump never strands truncated JSON
+        record = dict(result)
+        record["timestamp_utc"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        record["reps"] = reps
+        tmp = TPU_RECORD_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(record, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, TPU_RECORD_PATH)
+        log(f"TPU record written to {TPU_RECORD_PATH}")
+    else:
+        # carry the committed TPU record verbatim (if any) so the
+        # round artifact stays machine-verifiable on CPU runs
+        try:
+            with open(TPU_RECORD_PATH) as f:
+                result["last_tpu_record"] = json.load(f)
+        except FileNotFoundError:
+            pass
+        except (OSError, ValueError) as e:
+            result["last_tpu_record_error"] = f"{type(e).__name__}: {e}"
+        why = ("TPU tunnel unreachable at bench time" if tunnel_down
+               else "explicit CPU run (JAX_PLATFORMS=cpu)")
+        if "last_tpu_record" in result:
+            result["note"] = (
+                why + "; last_tpu_record is the committed raw record "
+                "of the most recent platform=tpu run of this same "
+                "script (see also BENCH_TPU_NOTES.md)")
+        else:
+            result["note"] = (
+                why + "; no committed TPU record exists yet — see "
+                "BENCH_TPU_NOTES.md for in-session records")
     print(json.dumps(result))
 
 

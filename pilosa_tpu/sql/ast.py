@@ -284,3 +284,7 @@ class Select:
     limit: int | None = None
     offset: int | None = None
     distinct: bool = False
+    # SELECT TOP(n): normalized onto `limit` by the parser
+    # (sql3/parser/parser.go:2376); kept for the TOP+LIMIT conflict
+    # check
+    top: int | None = None

@@ -1,0 +1,155 @@
+"""Shared SQL-layer primitives: the result shape, SQL type mapping,
+row canonicalization for DISTINCT, and the host-side ORDER BY /
+LIMIT helpers every execution path funnels through.
+
+Split out of engine.py (round 4): these are pure functions with no
+engine state, used by the where-compiler, the statement executor,
+and every SELECT strategy.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import math
+from dataclasses import dataclass, field as _f
+
+from pilosa_tpu.models import FieldType
+from pilosa_tpu.sql import ast
+from pilosa_tpu.sql.lexer import SQLError
+
+
+@dataclass
+class SQLResult:
+    schema: list = _f(default_factory=list)   # [(name, sql_type)]
+    rows: list = _f(default_factory=list)
+
+
+_SQL_TYPE_FOR_FIELD = {
+    FieldType.INT: "int",
+    FieldType.DECIMAL: "decimal",
+    FieldType.TIMESTAMP: "timestamp",
+    FieldType.BOOL: "bool",
+}
+
+
+def sql_type_of(f) -> str:
+    """SQL type name for a field (sql3's WireQueryField data types)."""
+    t = f.options.type
+    if t in _SQL_TYPE_FOR_FIELD:
+        return _SQL_TYPE_FOR_FIELD[t]
+    if t == FieldType.MUTEX:
+        return "string" if f.options.keys else "id"
+    # set / time
+    return "stringset" if f.options.keys else "idset"
+
+
+def canon_value(v):
+    """Canonical structural form preserving Python equality semantics
+    (1 == 1.0 == True must stay ONE distinct row, as a set-of-tuples
+    dedup would treat them): numerics canonicalize through Fraction,
+    which is exact for ints, bools, floats, and Decimals."""
+    from fractions import Fraction
+    if isinstance(v, list):
+        return ("l", tuple(sorted((canon_value(x) for x in v),
+                                  key=repr)))
+    if v is None:
+        return ("z",)
+    if isinstance(v, float) and not math.isfinite(v):
+        return ("f", repr(v))  # nan/inf have no Fraction
+    if isinstance(v, (bool, int, float)) or \
+            type(v).__name__ == "Decimal":
+        return ("n", str(Fraction(v)))
+    return ("s", str(v))
+
+
+def distinct_key(row) -> bytes:
+    # repr of a nested tuple of tagged values is unambiguous (strings
+    # are quoted/escaped), so no delimiter collisions are possible
+    return repr(tuple(canon_value(v) for v in row)).encode()
+
+
+def sorted_nulls_last(indices, key, desc: bool) -> list[int]:
+    """Stable sort of index list by key(i), NULLS LAST either
+    direction (the Sort pushdown's convention)."""
+    nn = [i for i in indices if key(i) is not None]
+    nulls = [i for i in indices if key(i) is None]
+    nn.sort(key=key, reverse=desc)
+    return nn + nulls
+
+
+def ordinal_index(value: int, n: int) -> int:
+    """1-based ORDER BY projection ordinal -> 0-based index."""
+    i = value - 1
+    if not (0 <= i < n):
+        raise SQLError(f"ORDER BY position {value} out of range")
+    return i
+
+
+def is_ordinal(e) -> bool:
+    return (isinstance(e, ast.Lit) and isinstance(e.value, int)
+            and not isinstance(e.value, bool))
+
+
+def name_of(it: ast.SelectItem) -> str:
+    """Output column name for one projection item."""
+    if it.alias:
+        return it.alias
+    e = it.expr
+    if isinstance(e, ast.Col):
+        return e.name
+    if isinstance(e, ast.Agg):
+        inner = e.arg.name if e.arg else "*"
+        d = "distinct " if e.distinct else ""
+        return f"{e.func}({d}{inner})"
+    if isinstance(e, ast.Func):
+        return e.name.lower()
+    return "expr"
+
+
+def order_rows(stmt, schema, rows):
+    """Multi-key ORDER BY over materialized rows: stable sorts applied
+    last-key-first, NULLS LAST within each key's direction."""
+    if not stmt.order_by:
+        return rows
+    names = [s[0] for s in schema]
+    rows = list(rows)
+    for ob in reversed(stmt.order_by):
+        if is_ordinal(ob.expr):
+            i = ordinal_index(ob.expr.value, len(names))
+            order = sorted_nulls_last(
+                range(len(rows)), lambda j: rows[j][i], ob.desc)
+            rows = [rows[j] for j in order]
+            continue
+        if isinstance(ob.expr, ast.Col) and ob.expr.table:
+            name = f"{ob.expr.table}.{ob.expr.name}"
+        elif isinstance(ob.expr, ast.Col):
+            name = ob.expr.name
+        else:
+            name = name_of(ast.SelectItem(ob.expr))
+        # unqualified names also match a unique qualified projection
+        matches = [i for i, n in enumerate(names)
+                   if n == name or ("." not in name
+                                    and n.split(".")[-1] == name)]
+        if len(matches) != 1:
+            raise SQLError(
+                f"ORDER BY column {name!r} not in projection"
+                if not matches else
+                f"ORDER BY column {name!r} is ambiguous")
+        i = matches[0]
+        order = sorted_nulls_last(
+            range(len(rows)), lambda j: rows[j][i], ob.desc)
+        rows = [rows[j] for j in order]
+    return rows
+
+
+def limit_rows(stmt, rows):
+    off = stmt.offset or 0
+    if stmt.limit is not None:
+        return rows[off:off + stmt.limit]
+    return rows[off:] if off else rows
+
+
+def to_sql_value(v):
+    if isinstance(v, dt.datetime):
+        return v.isoformat()
+    return v

@@ -1,4 +1,4 @@
-"""SQL engine: compile parsed SQL onto the PQL executor.
+"""SQL engine facade: parse → authorize → dispatch.
 
 The planner mirrors sql3/planner's central idea — push WHERE filters
 and aggregates down into per-shard PQL ops (PlanOpPQLTableScan /
@@ -6,111 +6,45 @@ PlanOpPQLAggregate / PlanOpPQLGroupBy, sql3/planner/planoptimizer.go)
 — without a fan-out operator: the executor's shard loop / device mesh
 already spans the data (SURVEY §7.6).
 
-Supported surface: CREATE/DROP TABLE, SHOW TABLES/COLUMNS, INSERT
-[OR REPLACE], DELETE ... WHERE, SELECT with projections, aggregates
-(COUNT[ DISTINCT]/SUM/MIN/MAX/AVG/PERCENTILE), WHERE (=, !=, <, <=,
->, >=, IN, LIKE, BETWEEN, IS [NOT] NULL, AND/OR/NOT), GROUP BY +
-HAVING, ORDER BY, LIMIT/OFFSET, SELECT DISTINCT col.
+Round-4 split (sql3 separates parser/planner/ops for the same
+reason):
+  common.py       result shape, SQL types, ORDER BY/LIMIT helpers
+  wherec.py       WHERE → PQL compiler + host residue fold-back
+  statements.py   DDL / DML / COPY / CREATE FUNCTION execution
+  plan.py         the SELECT plan-op layer (EXPLAIN prints these ops)
+  select_exec.py  the strategy bodies the plan ops run
+  engine.py       this facade: parse, authz, statement dispatch, UDF
+                  registry, schema lookups shared by the modules
+
+Supported surface: CREATE/DROP/ALTER TABLE, SHOW, INSERT [OR
+REPLACE], BULK INSERT, DELETE ... WHERE, COPY, CREATE FUNCTION/VIEW,
+EXPLAIN, SELECT with projections, aggregates (COUNT[ DISTINCT]/SUM/
+MIN/MAX/AVG/PERCENTILE/VAR/CORR), WHERE (=, !=, <, <=, >, >=, IN,
+LIKE, BETWEEN, IS [NOT] NULL, AND/OR/NOT, subqueries), GROUP BY +
+HAVING, ORDER BY (multi-key), LIMIT/OFFSET, DISTINCT, JOIN.
+
+Optimizer rewrites (the planoptimizer.go analogs) bake into
+compilation as one-line decisions instead of tree transforms: filter
+pushdown (wherec), aggregate/GROUP BY/Sort/LIMIT/DISTINCT pushdown
+(plan.py dispatch), join hash refinement (select_exec.select_join),
+subquery materialization (wherec.fold_subqueries).
 """
 
 from __future__ import annotations
 
-import datetime as dt
-import math
-from dataclasses import dataclass, field as _f
-
-from pilosa_tpu.executor import (
-    DistinctValues,
-    Executor,
-    RowResult,
-    SortedRow,
-    ValCount,
-)
-from pilosa_tpu.models import FieldOptions, FieldType, Holder, TimeQuantum
-from pilosa_tpu.pql.ast import Call, Condition
-from pilosa_tpu.sql import ast
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.models import Holder
+from pilosa_tpu.sql import ast, plan
+from pilosa_tpu.sql.common import SQLResult
 from pilosa_tpu.sql.lexer import SQLError
 from pilosa_tpu.sql.parser import parse_sql
+from pilosa_tpu.sql.select_exec import SelectExec
+from pilosa_tpu.sql.statements import StatementExec
+from pilosa_tpu.sql.wherec import WhereCompiler
+
+__all__ = ["SQLEngine", "SQLError", "SQLResult"]
 
 
-@dataclass
-class SQLResult:
-    schema: list = _f(default_factory=list)   # [(name, sql_type)]
-    rows: list = _f(default_factory=list)
-
-
-_SQL_TYPE_FOR_FIELD = {
-    FieldType.INT: "int",
-    FieldType.DECIMAL: "decimal",
-    FieldType.TIMESTAMP: "timestamp",
-    FieldType.BOOL: "bool",
-}
-
-
-def _sql_type(f) -> str:
-    t = f.options.type
-    if t in _SQL_TYPE_FOR_FIELD:
-        return _SQL_TYPE_FOR_FIELD[t]
-    if t == FieldType.MUTEX:
-        return "string" if f.options.keys else "id"
-    # set / time
-    return "stringset" if f.options.keys else "idset"
-
-
-def _canon_value(v):
-    """Canonical structural form preserving Python equality semantics
-    (1 == 1.0 == True must stay ONE distinct row, as the previous
-    set-of-tuples dedup treated them): numerics canonicalize through
-    Fraction, which is exact for ints, bools, floats, and Decimals."""
-    from fractions import Fraction
-    if isinstance(v, list):
-        return ("l", tuple(sorted((_canon_value(x) for x in v),
-                                  key=repr)))
-    if v is None:
-        return ("z",)
-    if isinstance(v, float) and not math.isfinite(v):
-        return ("f", repr(v))  # nan/inf have no Fraction
-    if isinstance(v, (bool, int, float)) or \
-            type(v).__name__ == "Decimal":
-        return ("n", str(Fraction(v)))
-    return ("s", str(v))
-
-
-def _distinct_key(row) -> bytes:
-    # repr of a nested tuple of tagged values is unambiguous (strings
-    # are quoted/escaped), so no delimiter collisions are possible
-    return repr(tuple(_canon_value(v) for v in row)).encode()
-
-
-# Optimizer (the planoptimizer.go analog, as compile-time rules).
-# The reference runs explicit optimizer passes over a PlanOperator
-# tree (sql3/planner/planoptimizer.go); this engine bakes the same
-# rewrites into compilation, where each is a one-line decision
-# instead of a tree transform:
-#
-# - filter pushdown           WHERE compiles straight to a PQL tree
-#                             executed shard-parallel on device
-#                             (_compile_where) — the
-#                             PlanOpPQLTableScan filter push
-# - aggregate pushdown        COUNT/SUM/MIN/MAX/AVG/PERCENTILE become
-#                             single PQL aggregate calls
-#                             (_select_aggregates)
-# - GROUP BY pushdown         set-like group columns ride the PQL
-#                             GroupBy (stacked device program); only
-#                             BSI group columns take the generic
-#                             hashed path
-# - Sort/TopN pushdown        ORDER BY on a BSI column becomes the
-#                             device Sort with limit+offset hoisted
-#                             (_select_rows), NULLS LAST appended
-# - LIMIT pushdown            plain LIMIT becomes PQL Limit unless
-#                             DISTINCT/sort semantics forbid it
-# - DISTINCT pushdown         single-column DISTINCT becomes the PQL
-#                             Distinct scan (_select_distinct)
-# - join hash refinement      nested-loop JOIN hashes the right side
-#                             (the opnestedloops.go hashed variant)
-# - subquery materialization  uncorrelated IN/scalar subqueries
-#                             evaluate once and fold into the outer
-#                             predicate
 class SQLEngine:
     def __init__(self, holder: Holder):
         self.holder = holder
@@ -118,11 +52,17 @@ class SQLEngine:
         # name -> stored Select (sql3 CREATE VIEW); views re-execute
         # on read
         self._views: dict[str, ast.Select] = {}
-        # UPPER name -> ast.CreateFunction (scalar-expression UDFs;
-        # the reference parses CREATE FUNCTION but disables execution
-        # because its bodies ran external code — these bodies are pure
-        # SQL expressions, so evaluation is safe)
-        self._functions: dict[str, ast.CreateFunction] = {}
+        # UPPER name -> (ast.CreateFunction, captured snapshot)
+        # (scalar-expression UDFs; the reference parses CREATE
+        # FUNCTION but disables execution because its bodies ran
+        # external code — these bodies are pure SQL expressions, so
+        # evaluation is safe)
+        self._functions: dict[str, tuple] = {}
+        self.wherec = WhereCompiler(self)
+        self.stmts = StatementExec(self)
+        self.select = SelectExec(self)
+
+    # -- authz ----------------------------------------------------------
 
     def _stmt_access(self, stmt) -> tuple[str | None, str]:
         """(table, needed-permission) for one statement."""
@@ -160,6 +100,16 @@ class SQLEngine:
             return [(stmt.src, "read"), (stmt.dst, "write")]
         return [self._stmt_access(stmt)]
 
+    @staticmethod
+    def _can_read(auth_check, table: str) -> bool:
+        try:
+            auth_check(table, "read")
+            return True
+        except Exception:
+            return False
+
+    # -- entry points ---------------------------------------------------
+
     def query(self, sql: str, auth_check=None,
               write_guard=None) -> list[SQLResult]:
         """Execute statements.
@@ -190,21 +140,14 @@ class SQLEngine:
                   write_guard=None) -> SQLResult:
         return self.query(sql, auth_check, write_guard)[-1]
 
-    @staticmethod
-    def _can_read(auth_check, table: str) -> bool:
-        try:
-            auth_check(table, "read")
-            return True
-        except Exception:
-            return False
-
-    # ------------------------------------------------------------------
+    # -- statement dispatch ---------------------------------------------
 
     def _execute(self, stmt, auth_check=None) -> SQLResult:
+        st = self.stmts
         if isinstance(stmt, ast.CreateTable):
-            return self._create_table(stmt)
+            return st.create_table(stmt)
         if isinstance(stmt, ast.DropTable):
-            return self._drop_table(stmt)
+            return st.drop_table(stmt)
         if isinstance(stmt, ast.ShowTables):
             names = sorted(self.holder.indexes)
             if auth_check is not None:
@@ -213,11 +156,11 @@ class SQLEngine:
             return SQLResult(schema=[("name", "string")],
                              rows=[(n,) for n in names])
         if isinstance(stmt, ast.ShowColumns):
-            return self._show_columns(stmt)
+            return st.show_columns(stmt)
         if isinstance(stmt, ast.ShowCreateTable):
-            return self._show_create_table(stmt)
+            return st.show_create_table(stmt)
         if isinstance(stmt, ast.AlterTable):
-            return self._alter_table(stmt)
+            return st.alter_table(stmt)
         if isinstance(stmt, ast.CreateView):
             if stmt.name in self._views or \
                     self.holder.index(stmt.name) is not None:
@@ -239,7 +182,7 @@ class SQLEngine:
             return SQLResult(schema=[("name", "string")],
                              rows=[(n,) for n in sorted(self._views)])
         if isinstance(stmt, ast.CreateFunction):
-            return self._create_function(stmt)
+            return st.create_function(stmt)
         if isinstance(stmt, ast.DropFunction):
             name = stmt.name.upper()
             if name not in self._functions:
@@ -249,9 +192,9 @@ class SQLEngine:
             del self._functions[name]
             return SQLResult()
         if isinstance(stmt, ast.Explain):
-            return self._explain(stmt.stmt)
+            return plan.explain(self, stmt.stmt)
         if isinstance(stmt, ast.Copy):
-            return self._copy(stmt)
+            return st.copy(stmt)
         if isinstance(stmt, ast.AlterView):
             if stmt.name not in self._views:
                 raise SQLError(f"view not found: {stmt.name}")
@@ -265,231 +208,37 @@ class SQLEngine:
             rows = [(fd.name,
                      "(" + ", ".join(f"@{p} {t}" for p, t in fd.params)
                      + f") returns {fd.returns}")
-                    for _n, (fd, _cap) in sorted(self._functions.items())]
+                    for _n, (fd, _cap)
+                    in sorted(self._functions.items())]
             return SQLResult(schema=[("name", "string"),
-                                     ("signature", "string")], rows=rows)
+                                     ("signature", "string")],
+                             rows=rows)
         if isinstance(stmt, ast.Insert):
-            return self._insert(stmt)
+            return st.insert(stmt)
         if isinstance(stmt, ast.BulkInsert):
-            return self._bulk_insert(stmt)
+            return st.bulk_insert(stmt)
         if isinstance(stmt, ast.Delete):
-            return self._delete(stmt)
+            return st.delete(stmt)
         if isinstance(stmt, ast.Select):
             return self._select(stmt)
         raise SQLError(f"unsupported statement {type(stmt).__name__}")
 
-    # -- DDL ------------------------------------------------------------
+    def _select(self, stmt: ast.Select) -> SQLResult:
+        return plan.plan_select(self, stmt).run()
 
-    def _create_table(self, stmt: ast.CreateTable) -> SQLResult:
-        if stmt.name in self._views:
-            raise SQLError(f"view exists: {stmt.name}")
-        if self.holder.index(stmt.name) is not None:
-            if stmt.if_not_exists:
-                return SQLResult()
-            raise SQLError(f"table already exists: {stmt.name}")
-        # validate every column option before creating anything, so a
-        # bad column never leaves a half-created table behind
-        cols, seen = [], set()
-        for cd in stmt.columns:
-            if cd.name in seen:
-                raise SQLError(f"duplicate column name: {cd.name}")
-            seen.add(cd.name)
-            if cd.name == "_id":
-                continue
-            try:
-                cols.append((cd.name, self._field_options(cd)))
-            except ValueError as e:
-                raise SQLError(str(e)) from e
-        idx = self.holder.create_index(stmt.name, keys=stmt.keys)
-        for name, opts in cols:
-            idx.create_field(name, opts)
-        self.holder.save_schema()
-        return SQLResult()
-
-    def _field_options(self, cd: ast.ColumnDef) -> FieldOptions:
-        t = cd.type
-        if t == "int":
-            return FieldOptions(type=FieldType.INT, min=cd.min, max=cd.max)
-        if t == "decimal":
-            return FieldOptions(type=FieldType.DECIMAL, scale=cd.scale)
-        if t == "timestamp":
-            return FieldOptions(type=FieldType.TIMESTAMP)
-        if t == "bool":
-            return FieldOptions(type=FieldType.BOOL)
-        if t == "id":
-            return FieldOptions(type=FieldType.MUTEX)
-        if t == "string":
-            return FieldOptions(type=FieldType.MUTEX, keys=True)
-        if t == "idset":
-            if cd.time_quantum:
-                return FieldOptions(type=FieldType.TIME,
-                                    time_quantum=TimeQuantum(cd.time_quantum))
-            return FieldOptions(type=FieldType.SET)
-        if t == "stringset":
-            if cd.time_quantum:
-                return FieldOptions(type=FieldType.TIME,
-                                    time_quantum=TimeQuantum(cd.time_quantum),
-                                    keys=True)
-            return FieldOptions(type=FieldType.SET, keys=True)
-        raise SQLError(f"unknown column type {t!r}")
-
-    def _drop_table(self, stmt: ast.DropTable) -> SQLResult:
-        if self.holder.index(stmt.name) is None and not stmt.if_exists:
-            raise SQLError(f"table not found: {stmt.name}")
-        self.holder.delete_index(stmt.name)
-        self.holder.save_schema()
-        return SQLResult()
-
-    def _show_columns(self, stmt: ast.ShowColumns) -> SQLResult:
-        idx = self._index(stmt.table)
-        rows = [("_id", "string" if idx.keys else "id")]
-        rows += [(f.name, _sql_type(f)) for f in idx.public_fields()]
-        return SQLResult(schema=[("name", "string"), ("type", "string")],
-                         rows=rows)
-
-    def _has_subquery(self, e) -> bool:
-        if isinstance(e, (ast.SubQuery, ast.InSelect)):
-            return True
-        if isinstance(e, ast.BinOp):
-            return self._has_subquery(e.left) or \
-                self._has_subquery(e.right)
-        if isinstance(e, ast.Not):
-            return self._has_subquery(e.expr)
-        if isinstance(e, ast.Func):
-            return any(self._has_subquery(x) for x in e.args)
-        if isinstance(e, ast.Between):
-            return any(self._has_subquery(x)
-                       for x in (e.col, e.lo, e.hi))
-        return False
-
-    def _explain(self, stmt) -> SQLResult:
-        """EXPLAIN: the compile decisions as plan rows, without
-        executing (sql3 parseExplain + PlanOperator.Plan())."""
-        out: list[tuple] = []
-
-        def add(line):
-            out.append((line,))
-        if not isinstance(stmt, ast.Select):
-            add(type(stmt).__name__.lower())
-            return SQLResult(schema=[("plan", "string")], rows=out)
-        if stmt.table in self._views:
-            add(f"view expansion: {stmt.table}")
-            return SQLResult(schema=[("plan", "string")], rows=out)
-        idx = self._index(stmt.table)
-        if stmt.joins:
-            for j in stmt.joins:
-                kind = "left outer" if j.outer else "inner"
-                add(f"nested-loop {kind} join {stmt.table} x {j.table} "
-                    f"on {j.left.name} = {j.right.name} (hashed right "
-                    "side)")
-            return SQLResult(schema=[("plan", "string")], rows=out)
-        push = residue = None
-        if stmt.where is not None and self._has_subquery(stmt.where):
-            # EXPLAIN must not execute; subqueries fold at execution
-            # time, so the filter cannot be rendered without running
-            # them
-            add("filter pushdown (PQL, shard-parallel device scan): "
-                "(contains subqueries — evaluated at execution time)")
-        else:
-            if stmt.where is not None:
-                push, residue = self._split_where(stmt.where)
-            filt = self._where(idx, push) if push is not None \
-                else Call("All")
-            add(f"filter pushdown (PQL, shard-parallel device scan): "
-                f"{filt.to_pql()}")
-            if residue is not None:
-                add("host residue filter: row-wise expression over the "
-                    "pushed result (ConstRow fold-back)")
-        aggs = [it.expr for it in stmt.items
-                if isinstance(it.expr, ast.Agg)]
-        if stmt.group_by:
-            bsi = any(self._field(idx, g).options.type.is_bsi
-                      for g in stmt.group_by)
-            add("generic hashed GROUP BY (BSI group column)" if bsi
-                else "PQL GroupBy pushdown (stacked device program): "
-                + ", ".join(f"Rows({g})" for g in stmt.group_by))
-        elif aggs:
-            for a in aggs:
-                inner = a.arg.name if a.arg else "*"
-                add(f"aggregate pushdown: {a.func}({inner})")
-        elif stmt.distinct and len(stmt.items) == 1 and \
-                isinstance(stmt.items[0].expr, ast.Col) and \
-                stmt.items[0].expr.name not in ("_id", "*"):
-            # mirrors _select's Distinct dispatch guard exactly
-            add(f"PQL Distinct scan: {stmt.items[0].expr.name}")
-        else:
-            ob = stmt.order_by[0] if len(stmt.order_by) == 1 else None
-            if ob is not None and isinstance(ob.expr, ast.Col) and \
-                    ob.expr.name != "_id" and \
-                    idx.field(ob.expr.name) is not None and \
-                    self._field(idx, ob.expr.name).options.type.is_bsi:
-                d = " desc" if ob.desc else ""
-                add(f"Sort pushdown (device BSI sort): "
-                    f"{ob.expr.name}{d}, NULLS LAST")
-            elif stmt.order_by:
-                add("host sort")
-            if stmt.limit is not None:
-                add(f"limit {stmt.limit}"
-                    + (f" offset {stmt.offset}" if stmt.offset else ""))
-            add("Extract scan (device row materialization)")
-        return SQLResult(schema=[("plan", "string")], rows=out)
-
-    def _show_create_table(self, stmt: ast.ShowCreateTable) -> SQLResult:
-        """Canonical DDL round-trip: the emitted statement re-parses to
-        an equivalent table (sql3's SHOW CREATE TABLE)."""
-        idx = self._index(stmt.table)
-        defs = [f"_id {'string' if idx.keys else 'id'}"]
-        for f in idx.public_fields():
-            t = _sql_type(f)
-            d = f"{f.name} {t}"
-            o = f.options
-            if t == "decimal" and o.scale:
-                d += f"({o.scale})"
-            if t == "int":
-                if o.min is not None:
-                    d += f" min {o.min}"
-                if o.max is not None:
-                    d += f" max {o.max}"
-            if o.type == FieldType.TIME and o.time_quantum:
-                d += f" timequantum '{o.time_quantum}'"
-            defs.append(d)
-        ddl = f"CREATE TABLE {idx.name} ({', '.join(defs)})"
-        return SQLResult(schema=[("ddl", "string")], rows=[(ddl,)])
-
-    def _alter_table(self, stmt: ast.AlterTable) -> SQLResult:
-        """ALTER TABLE ADD/DROP/RENAME COLUMN (sql3/planner/
-        compilealtertable.go)."""
-        idx = self._index(stmt.table)
-        if stmt.op == "add":
-            cd = stmt.column
-            if cd.name == "_id":
-                raise SQLError("cannot add _id")
-            if idx.field(cd.name) is not None:
-                raise SQLError(f"column already exists: {cd.name}")
-            idx.create_field(cd.name, self._field_options(cd))
-        elif stmt.op == "drop":
-            if stmt.name == "_id":
-                raise SQLError("cannot drop _id")
-            if idx.field(stmt.name) is None:
-                raise SQLError(f"column not found: {stmt.name}")
-            idx.delete_field(stmt.name)
-        else:  # rename
-            if "_id" in (stmt.name, stmt.new_name):
-                raise SQLError("cannot rename _id")
-            try:
-                idx.rename_field(stmt.name, stmt.new_name)
-            except ValueError as e:
-                raise SQLError(str(e)) from e
-        self.holder.save_schema()
-        return SQLResult()
-
-    # -- DML ------------------------------------------------------------
+    # -- schema lookups shared by the modules ---------------------------
 
     def _index(self, name: str):
         idx = self.holder.index(name)
         if idx is None:
             raise SQLError(f"table not found: {name}")
         return idx
+
+    def _field(self, idx, name: str):
+        f = idx.field(name)
+        if f is None:
+            raise SQLError(f"column not found: {name}")
+        return f
 
     def _col_id(self, idx, v, create=True):
         if isinstance(v, str):
@@ -503,293 +252,7 @@ class SQLEngine:
                 f"table {idx.name} has string _id; got {v!r}")
         return int(v)
 
-    def _insert(self, stmt: ast.Insert) -> SQLResult:
-        idx = self._index(stmt.table)
-        if "_id" not in stmt.columns:
-            raise SQLError("INSERT requires an _id column")
-        id_pos = stmt.columns.index("_id")
-        fields = []
-        for c in stmt.columns:
-            if c == "_id":
-                fields.append(None)
-                continue
-            f = idx.field(c)
-            if f is None:
-                raise SQLError(f"column not found: {c}")
-            fields.append(f)
-        for row in stmt.rows:
-            self._apply_record(idx, fields, row, id_pos, stmt.replace)
-        return SQLResult()
-
-    def _apply_record(self, idx, fields, row, id_pos, replace):
-        """Write one record's values (shared by INSERT / BULK INSERT)."""
-        col = self._col_id(idx, row[id_pos])
-        if replace:
-            # full-record replace: drop existing values first
-            from pilosa_tpu.ops import bitmap as bm
-            shard, sc = divmod(col, idx.width)
-            mask = bm.from_columns([sc], idx.width)
-            for f in idx.fields.values():
-                for v in f.views.values():
-                    frag = v.fragment(shard)
-                    if frag is not None:
-                        frag.clear_columns(mask)
-        for f, v in zip(fields, row):
-            if f is None or v is None:
-                continue
-            t = f.options.type
-            if t.is_bsi:
-                f.set_value(col, v)
-            elif t == FieldType.BOOL:
-                f.set_bit(1 if v else 0, col)
-            else:
-                ts = None
-                if t == FieldType.TIME and isinstance(v, list) and \
-                        len(v) == 2 and \
-                        isinstance(v[0], (str, int)) and \
-                        not isinstance(v[0], bool) and \
-                        isinstance(v[1], list):
-                    # quantum tuple ('<timestamp>', (vals...)) —
-                    # opinsert.go:275's 2-member time-quantum form
-                    from pilosa_tpu.models import timeq
-                    try:
-                        ts = timeq.parse_time(v[0])
-                    except ValueError:
-                        raise SQLError(
-                            f"column {f.name}: bad quantum timestamp "
-                            f"{v[0]!r}")
-                    v = v[1]
-                vals = v if isinstance(v, list) else [v]
-                if t == FieldType.MUTEX and len(vals) > 1:
-                    raise SQLError(
-                        f"column {f.name} accepts a single value")
-                for item in vals:
-                    f.set_bit(self._row_id(f, item, create=True), col,
-                              timestamp=ts)
-        idx.mark_columns_exist([col])
-
-    def _bulk_insert(self, stmt: ast.BulkInsert) -> SQLResult:
-        """BULK INSERT: stream a CSV (file or inline payload) through
-        the same record-apply path as INSERT — the COPY/BULK INSERT
-        ingest statement (sql3/parser bulk insert, CSV subset).
-        Columns map positionally; empty cells are NULL; idset/
-        stringset cells may hold ';'-separated lists."""
-        import csv
-        import io
-
-        idx = self._index(stmt.table)
-        fields, id_pos = self._bulk_fields(idx, stmt.columns)
-        n = 0
-        for row in self._iter_bulk_rows(stmt, idx, fields):
-            self._apply_record(idx, fields, row, id_pos, replace=False)
-            n += 1
-        return SQLResult(schema=[("rows_inserted", "int")], rows=[(n,)])
-
-    def _bulk_fields(self, idx, columns):
-        """Resolve BULK INSERT target fields (+ the _id position)."""
-        if "_id" not in columns:
-            raise SQLError("BULK INSERT requires an _id column")
-        id_pos = columns.index("_id")
-        fields = []
-        for c in columns:
-            if c == "_id":
-                fields.append(None)
-                continue
-            f = idx.field(c)
-            if f is None:
-                raise SQLError(f"column not found: {c}")
-            fields.append(f)
-        return fields, id_pos
-
-    def _iter_bulk_rows(self, stmt, idx, fields):
-        """Yield type-converted rows from the CSV source — shared by
-        the local apply path and the DAX routed path."""
-        import csv
-        import io
-
-        id_pos = stmt.columns.index("_id")
-
-        def convert(f, text: str):
-            if text == "":
-                return None
-            if f is None:  # _id
-                return text if idx.keys else int(text)
-            t = f.options.type
-            if t == FieldType.INT or t == FieldType.TIMESTAMP:
-                return int(text) if t == FieldType.INT else text
-            if t == FieldType.DECIMAL:
-                from decimal import Decimal
-                return Decimal(text)
-            if t == FieldType.BOOL:
-                return text.strip().lower() in ("1", "true", "t", "yes")
-            if ";" in text:
-                items = text.split(";")
-                return [int(i) if not f.options.keys else i
-                        for i in items]
-            return text if f.options.keys else int(text)
-
-        if stmt.input == "FILE":
-            try:
-                fh = open(stmt.path, newline="")
-            except OSError as exc:
-                raise SQLError(
-                    f"BULK INSERT cannot read {stmt.path!r}: {exc}")
-        else:
-            fh = io.StringIO(stmt.payload or "")
-        with fh:
-            reader = csv.reader(fh)
-            for i, raw in enumerate(reader):
-                if i == 0 and stmt.header_row:
-                    continue
-                if not raw:
-                    continue
-                if len(raw) != len(stmt.columns):
-                    raise SQLError(
-                        f"CSV row {i + 1} has {len(raw)} fields, "
-                        f"expected {len(stmt.columns)}")
-                try:
-                    row = [convert(f, cell.strip())
-                           for f, cell in zip(fields, raw)]
-                except (ValueError, ArithmeticError) as exc:
-                    raise SQLError(
-                        f"CSV row {i + 1}: bad value ({exc})")
-                if row[id_pos] is None:
-                    raise SQLError(f"CSV row {i + 1} has empty _id")
-                yield row
-
-    def _row_id(self, f, v, create=False):
-        if isinstance(v, str):
-            tr = f.row_translator
-            if tr is None:
-                raise SQLError(
-                    f"column {f.name} holds ids, got string {v!r}")
-            if create:
-                return tr.create_keys(v)[v]
-            return tr.find_keys(v).get(v)
-        if f.options.keys:
-            raise SQLError(f"column {f.name} uses keys; got id {v!r}")
-        return int(v)
-
-    def _delete(self, stmt: ast.Delete) -> SQLResult:
-        idx = self._index(stmt.table)
-        filt = self._compile_where(idx, stmt.where)
-        self.executor._execute_call(idx, Call("Delete", children=[filt]),
-                                    None)
-        return SQLResult()
-
-    # -- WHERE → PQL ----------------------------------------------------
-
-    def _field(self, idx, name: str):
-        f = idx.field(name)
-        if f is None:
-            raise SQLError(f"column not found: {name}")
-        return f
-
-    def _compile_where(self, idx, where) -> Call:
-        """WHERE → PQL with host residue: conjuncts that compile to
-        PQL ops push down (the PlanOpPQLTableScan filter push); the
-        rest — scalar functions, arithmetic — evaluate row-wise over
-        the pushed result and fold back as a ConstRow of matching ids
-        (the reference evaluates non-pushable filters row-wise in
-        PlanOpFilter, sql3/planner/opfilter.go)."""
-        if where is None:
-            return Call("All")
-        where = self._fold_subqueries(where)
-        push, residue = self._split_where(where)
-        filt = self._where(idx, push) if push is not None else Call("All")
-        if residue is None:
-            return filt
-        ids = self._residue_ids(idx, filt, residue)
-        return Call("ConstRow", args={"columns": ids})
-
-    def _fold_subqueries(self, e):
-        """Replace scalar SubQuery nodes with their evaluated literal
-        (uncorrelated — they run once at compile time)."""
-        if isinstance(e, ast.SubQuery):
-            return ast.Lit(self._scalar_subquery(e.select))
-        if isinstance(e, ast.BinOp):
-            return ast.BinOp(e.op, self._fold_subqueries(e.left),
-                             self._fold_subqueries(e.right))
-        if isinstance(e, ast.Not):
-            return ast.Not(self._fold_subqueries(e.expr))
-        if isinstance(e, ast.Func):
-            return ast.Func(e.name,
-                            [self._fold_subqueries(x) for x in e.args])
-        if isinstance(e, ast.Between):
-            return ast.Between(self._fold_subqueries(e.col),
-                               self._fold_subqueries(e.lo),
-                               self._fold_subqueries(e.hi),
-                               negated=e.negated)
-        return e
-
-    _CMP_OPS = ("=", "!=", "<", "<=", ">", ">=", "like")
-
-    def _is_pushable(self, e) -> bool:
-        """True when `_where` can compile e to a PQL tree directly."""
-        if isinstance(e, ast.BinOp):
-            if e.op in ("and", "or"):
-                return self._is_pushable(e.left) and \
-                    self._is_pushable(e.right)
-            if e.op not in self._CMP_OPS:
-                return False  # arithmetic / concat
-            sides = (e.left, e.right)
-            return any(isinstance(s, ast.Col) for s in sides) and \
-                any(isinstance(s, ast.Lit) for s in sides)
-        if isinstance(e, ast.Not):
-            return self._is_pushable(e.expr)
-        if isinstance(e, (ast.InList, ast.InSelect, ast.IsNull)):
-            return isinstance(e.col, ast.Col)
-        if isinstance(e, ast.Between):
-            return isinstance(e.col, ast.Col) and \
-                isinstance(e.lo, ast.Lit) and isinstance(e.hi, ast.Lit)
-        if isinstance(e, ast.Func):
-            # SETCONTAINS* over (column, literal) become Row filters
-            if e.name == "RANGEQ":
-                return len(e.args) == 3 and \
-                    isinstance(e.args[0], ast.Col) and \
-                    all(isinstance(x, ast.Lit) for x in e.args[1:])
-            return e.name in ("SETCONTAINS", "SETCONTAINSANY",
-                              "SETCONTAINSALL") and len(e.args) == 2 \
-                and isinstance(e.args[0], ast.Col) \
-                and isinstance(e.args[1], ast.Lit)
-        return False
-
-    def _split_where(self, e):
-        """(pushable, residue) — split at top-level ANDs only."""
-        if self._is_pushable(e):
-            return e, None
-        if isinstance(e, ast.BinOp) and e.op == "and":
-            lp, lr = self._split_where(e.left)
-            rp, rr = self._split_where(e.right)
-            push = lp if rp is None else rp if lp is None else \
-                ast.BinOp("and", lp, rp)
-            res = lr if rr is None else rr if lr is None else \
-                ast.BinOp("and", lr, rr)
-            return push, res
-        return None, e
-
-    def _residue_ids(self, idx, filt: Call, residue) -> list[int]:
-        """Evaluate a host-only predicate over the rows matching the
-        pushed filter; return the surviving column ids."""
-        from pilosa_tpu.sql.funcs import Evaluator, _truthy, columns_in
-        cols = sorted(n for n in columns_in(residue) if n != "_id")
-        for n in cols:
-            self._field(idx, n)  # validate
-        c = Call("Extract", children=[filt] + [
-            Call("Rows", args={"_field": n}) for n in cols])
-        table = self.executor._execute_call(idx, c, None)
-        ev = Evaluator(udfs=self._udf_callables())
-        out = []
-        for entry in table.columns:
-            env = {n: self._to_sql_value(entry["rows"][i])
-                   for i, n in enumerate(cols)}
-            env["_id"] = entry.get("column_key", entry["column"])
-            v = ev.eval(residue, env)
-            # strict boolean context (funcs._truthy): a non-boolean
-            # predicate (WHERE region) is a type error, not truthiness
-            if v is not None and _truthy(v):
-                out.append(int(entry["column"]))
-        return out
+    # -- UDF registry ---------------------------------------------------
 
     def _udf_callables(self) -> dict:
         return {name: self._make_udf(defn)
@@ -818,1275 +281,10 @@ class SQLEngine:
             return ev.eval(stmt.body, env)
         return call
 
-    def _create_function(self, stmt: ast.CreateFunction) -> SQLResult:
-        from pilosa_tpu.sql.funcs import _ARITY
-        name = stmt.name.upper()
-        if name in _ARITY:
-            raise SQLError(
-                f"cannot redefine built-in function {stmt.name}")
-        if name in self._functions:
-            if stmt.if_not_exists:
-                return SQLResult()
-            raise SQLError(f"function already exists: {stmt.name}")
-        # body validation: parameters only (no table columns), calls
-        # only to builtins or PREVIOUSLY defined functions — combined
-        # with the captured-snapshot binding above, a body can never
-        # reach itself
-        params = {p for p, _t in stmt.params}
-        if len(params) != len(stmt.params):
-            raise SQLError("duplicate parameter name")
-        captured: dict[str, tuple] = {}
+    # -- legacy delegates (external callers: dax/queryer.py) ------------
 
-        def check(e):
-            if isinstance(e, ast.Col):
-                raise SQLError(
-                    "function bodies may reference only parameters")
-            if isinstance(e, ast.Var) and e.name not in params:
-                raise SQLError(f"unknown parameter @{e.name}")
-            if isinstance(e, ast.Func):
-                if e.name in self._functions:
-                    captured[e.name] = self._functions[e.name]
-                elif e.name not in _ARITY:
-                    raise SQLError(f"unknown function {e.name}")
-                for x in e.args:
-                    check(x)
-            for attr in ("left", "right", "expr", "col", "lo", "hi"):
-                sub = getattr(e, attr, None)
-                if sub is not None and not isinstance(sub, (str, int)):
-                    check(sub)
-        check(stmt.body)
-        self._functions[name] = (stmt, captured)
-        return SQLResult()
+    def _bulk_fields(self, idx, columns):
+        return self.stmts.bulk_fields(idx, columns)
 
-    @staticmethod
-    def _has_filter(filt: Call) -> bool:
-        """True unless filt is the no-op match-everything All()."""
-        return not (filt.name == "All" and not filt.args)
-
-    def _where(self, idx, e) -> Call:
-        if isinstance(e, ast.BinOp):
-            if e.op == "and":
-                return Call("Intersect", children=[
-                    self._where(idx, e.left), self._where(idx, e.right)])
-            if e.op == "or":
-                return Call("Union", children=[
-                    self._where(idx, e.left), self._where(idx, e.right)])
-            return self._comparison(idx, e)
-        if isinstance(e, ast.Not):
-            return Call("Not", children=[self._where(idx, e.expr)])
-        if isinstance(e, ast.InList):
-            return self._in_list(idx, e)
-        if isinstance(e, ast.InSelect):
-            # uncorrelated IN-subquery: materialize the subquery's
-            # single column, then compile as an IN list (the semi-join
-            # shape of sql3/planner subquery compilation)
-            vals = self._subquery_column(e.select)
-            if e.negated and any(v is None for v in vals):
-                # strict SQL: NOT IN against a list containing NULL is
-                # never TRUE (UNKNOWN for non-matches) -> empty result
-                return Call("ConstRow", args={"columns": []})
-            return self._in_list(idx, ast.InList(
-                e.col, [v for v in vals if v is not None],
-                negated=e.negated))
-        if isinstance(e, ast.Between):
-            name = self._col_name(e.col)
-            lo = e.lo.value if isinstance(e.lo, ast.Lit) else e.lo
-            hi = e.hi.value if isinstance(e.hi, ast.Lit) else e.hi
-            if e.negated:
-                # strict SQL: NULL NOT BETWEEN x AND y is UNKNOWN ->
-                # excluded.  The range union stays within not-null
-                # rows, unlike Not() which would admit NULLs.
-                return Call("Union", children=[
-                    Call("Row", args={name: Condition("<", lo)}),
-                    Call("Row", args={name: Condition(">", hi)})])
-            return Call("Row", args={name: Condition("><", [lo, hi])})
-        if isinstance(e, ast.IsNull):
-            return self._is_null(idx, e)
-        if isinstance(e, ast.Func) and e.name == "RANGEQ":
-            # RANGEQ(tq_col, from, to) -> time-ranged Rows filter
-            # (expressionpql.go:99; push-down only, like the
-            # reference — EvaluateRangeQ always errors)
-            name = self._col_name(e.args[0])
-            f = self._field(idx, name)
-            if f.options.type != FieldType.TIME:
-                raise SQLError("RANGEQ requires a timequantum column")
-            frm, to = e.args[1].value, e.args[2].value
-            if frm is None and to is None:
-                raise SQLError(
-                    "RANGEQ from and to cannot both be NULL")
-            args = {"_field": name}
-            if frm is not None:
-                args["from"] = frm
-            if to is not None:
-                args["to"] = to
-            return Call("UnionRows",
-                        children=[Call("Rows", args=args)])
-        if isinstance(e, ast.Func) and e.name.startswith("SETCONTAINS"):
-            # membership pushdown (inbuiltfunctionsset.go →
-            # expressionpql.go): SETCONTAINS(col, v) is Row(col=v);
-            # ANY unions, ALL intersects
-            name = self._col_name(e.args[0])
-            f = self._field(idx, name)
-            if f.options.type.is_bsi:
-                raise SQLError(f"{e.name} requires a set column")
-            val = e.args[1].value
-            if e.name == "SETCONTAINS":
-                vals = [val]
-            else:
-                vals = val if isinstance(val, list) else [val]
-            rows = [Call("Row", args={name: v}) for v in vals]
-            if not rows:
-                return Call("All") if e.name == "SETCONTAINSALL" \
-                    else Call("ConstRow", args={"columns": []})
-            if len(rows) == 1:
-                return rows[0]
-            return Call("Union" if e.name == "SETCONTAINSANY"
-                        else "Intersect", children=rows)
-        raise SQLError(f"unsupported WHERE expression {e!r}")
-
-    def _col_name(self, e) -> str:
-        if not isinstance(e, ast.Col):
-            raise SQLError(f"expected column, got {e!r}")
-        return e.name
-
-    def _subquery_column(self, sub: ast.Select) -> list:
-        """Execute an uncorrelated subquery; must yield one column."""
-        res = self._select(sub)
-        if len(res.schema) != 1:
-            raise SQLError("subquery must select exactly one column")
-        return [r[0] for r in res.rows]
-
-    def _scalar_subquery(self, sub: ast.Select):
-        """Scalar subquery: one column, at most one row (NULL if none)."""
-        vals = self._subquery_column(sub)
-        if len(vals) > 1:
-            raise SQLError("scalar subquery returned more than one row")
-        return vals[0] if vals else None
-
-    def _comparison(self, idx, e: ast.BinOp) -> Call:
-        # normalize literal-on-left (scalar subqueries were already
-        # folded to literals by _compile_where's _fold_subqueries pass)
-        left, right, op = e.left, e.right, e.op
-        if isinstance(left, ast.Lit) and isinstance(right, ast.Col):
-            left, right = right, left
-            op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
-        name = self._col_name(left)
-        if not isinstance(right, ast.Lit):
-            raise SQLError("comparison requires a literal")
-        val = right.value
-        if val is None:
-            # strict SQL: comparison with NULL is UNKNOWN -> matches
-            # nothing (use IS NULL for null tests)
-            return Call("ConstRow", args={"columns": []})
-        if name == "_id":
-            cid = self._col_id(idx, val, create=False)
-            cols = [cid] if cid is not None else []
-            # intersect with existence: a ConstRow bit for a missing
-            # record must not count
-            node = Call("Intersect", children=[
-                Call("ConstRow", args={"columns": cols}), Call("All")])
-            if op in ("=",):
-                return node
-            if op == "!=":
-                return Call("Not", children=[node])
-            raise SQLError("_id supports =, != and IN")
-        f = self._field(idx, name)
-        t = f.options.type
-        if op == "like":
-            if f.row_translator is None:
-                raise SQLError("LIKE requires a string column")
-            return Call("UnionRows", children=[
-                Call("Rows", args={"_field": name, "like": val})])
-        if t.is_bsi:
-            pql_op = {"=": "==", "!=": "!="}.get(op, op)
-            return Call("Row", args={name: Condition(pql_op, val)})
-        if t == FieldType.BOOL:
-            if op not in ("=", "!="):
-                raise SQLError("bool columns support = and !=")
-            node = Call("Row", args={name: bool(val)})
-            return Call("Not", children=[node]) if op == "!=" else node
-        # set / mutex / time: row membership
-        if op == "=":
-            return Call("Row", args={name: val})
-        if op == "!=":
-            return Call("Not", children=[Call("Row", args={name: val})])
-        raise SQLError(f"operator {op} not supported on {t.value} columns")
-
-    def _in_list(self, idx, e: ast.InList) -> Call:
-        name = self._col_name(e.col)
-        if name == "_id":
-            cols = []
-            for v in e.items:
-                cid = self._col_id(idx, v, create=False)
-                if cid is not None:
-                    cols.append(cid)
-            node = Call("Intersect", children=[
-                Call("ConstRow", args={"columns": cols}), Call("All")])
-        else:
-            f = self._field(idx, name)
-            if f.options.type.is_bsi:
-                children = [Call("Row", args={name: Condition("==", v)})
-                            for v in e.items]
-                node = Call("Union", children=children)
-                if e.negated:
-                    # strict SQL: NULL NOT IN (...) is UNKNOWN ->
-                    # excluded, so gate the complement on not-null
-                    return Call("Intersect", children=[
-                        Call("Row", args={name: Condition("!=", None)}),
-                        Call("Not", children=[node])])
-                return node
-            children = [Call("Row", args={name: v}) for v in e.items]
-            node = Call("Union", children=children)
-        return Call("Not", children=[node]) if e.negated else node
-
-    def _is_null(self, idx, e: ast.IsNull) -> Call:
-        name = self._col_name(e.col)
-        f = self._field(idx, name)
-        if f.options.type.is_bsi:
-            node = Call("Row", args={name: Condition(
-                "!=" if e.negated else "==", None)})
-            return node
-        # set-like: null = exists but no row in this field
-        union = Call("UnionRows", children=[
-            Call("Rows", args={"_field": name})])
-        if e.negated:
-            return union
-        return Call("Not", children=[union])
-
-    # -- SELECT ---------------------------------------------------------
-
-    def _select(self, stmt: ast.Select) -> SQLResult:
-        if not stmt.table:
-            return self._select_const(stmt)
-        if stmt.table in self._views:
-            return self._select_view(stmt)
-        if stmt.joins:
-            return self._select_join(stmt)
-        self._reject_foreign_quals(stmt)
-        idx = self._index(stmt.table)
-        filt = self._compile_where(idx, stmt.where)
-
-        # expand * into _id + all columns
-        items: list[ast.SelectItem] = []
-        for it in stmt.items:
-            if isinstance(it.expr, ast.Col) and it.expr.name == "*":
-                items.append(ast.SelectItem(ast.Col("_id"), "_id"))
-                items += [ast.SelectItem(ast.Col(f.name), f.name)
-                          for f in idx.public_fields()]
-            else:
-                items.append(it)
-
-        if stmt.having is not None and not stmt.group_by:
-            raise SQLError("HAVING requires GROUP BY")
-        aggs = [it for it in items if isinstance(it.expr, ast.Agg)]
-        if stmt.group_by:
-            return self._select_grouped(idx, stmt, items, filt)
-        if aggs:
-            if len(aggs) != len(items):
-                raise SQLError(
-                    "mixing aggregates and columns requires GROUP BY")
-            return self._select_aggregates(idx, stmt, items, filt)
-        if stmt.distinct and len(items) == 1 and \
-                isinstance(items[0].expr, ast.Col) and \
-                items[0].expr.name != "_id":
-            return self._select_distinct(idx, stmt, items[0], filt)
-        return self._select_rows(idx, stmt, items, filt)
-
-    def _select_const(self, stmt: ast.Select) -> SQLResult:
-        """FROM-less constant SELECT (sql3 allows e.g.
-        `select cast(1 as bool)`): items evaluate once, no table."""
-        from pilosa_tpu.sql.funcs import Evaluator
-        if stmt.where is not None or stmt.group_by or stmt.joins or \
-                stmt.having is not None:
-            raise SQLError("constant SELECT takes projections only")
-        ev = Evaluator(udfs=self._udf_callables())
-        schema, vals = [], []
-        for it in stmt.items:
-            e = self._fold_subqueries(it.expr)
-            # eval first: a Col reference errors here, so _expr_type
-            # (which only needs idx for Col lookups) runs idx-less
-            vals.append(self._to_sql_value(ev.eval(e, {})))
-            schema.append((self._name_of(it), self._expr_type(None, e)))
-        rows = self._limit_rows(stmt, [tuple(vals)])
-        return SQLResult(schema=schema, rows=rows)
-
-    def _copy(self, stmt: ast.Copy) -> SQLResult:
-        """COPY src TO dst (sql3 copy statement, defs_copy.go):
-        Index.clone_to owns the deep copy; a mid-copy failure never
-        strands a half-built table."""
-        if stmt.src in self._views:
-            raise SQLError("COPY supports tables, not views")
-        src = self.holder.index(stmt.src)
-        if src is None:
-            raise SQLError(f"table or view {stmt.src!r} not found")
-        if stmt.dst in self._views or \
-                self.holder.index(stmt.dst) is not None:
-            raise SQLError(f"table or view {stmt.dst!r} already exists")
-        dst = self.holder.create_index(stmt.dst, keys=src.keys)
-        try:
-            src.clone_to(dst)
-        except Exception:
-            self.holder.delete_index(stmt.dst)
-            raise
-        self.holder.save_schema()
-        return SQLResult()
-
-    def _select_view(self, stmt: ast.Select) -> SQLResult:
-        """Query a stored view: re-execute its select, then apply the
-        outer projection / ORDER BY / LIMIT by result-column name.
-        Outer WHERE/GROUP BY/aggregates over views are not supported
-        (the reference's planner expands views generally; this subset
-        is documented)."""
-        if stmt.where is not None or stmt.group_by or stmt.joins or \
-                stmt.having is not None or stmt.distinct:
-            raise SQLError(
-                "views support projection/ORDER BY/LIMIT only")
-        inner = self._views[stmt.table]
-        res = self._select(inner)
-        names = [s[0] for s in res.schema]
-        cols: list[int] = []
-        for it in stmt.items:
-            e = it.expr
-            if isinstance(e, ast.Col) and e.name == "*":
-                cols.extend(range(len(names)))
-                continue
-            if not isinstance(e, ast.Col):
-                raise SQLError("view projections must be columns")
-            if e.name not in names:
-                raise SQLError(
-                    f"column {e.name!r} not in view {stmt.table}")
-            cols.append(names.index(e.name))
-        schema = [res.schema[i] for i in cols]
-        rows = [tuple(r[i] for i in cols) for r in res.rows]
-        rows = self._order_rows(stmt, schema, rows)
-        rows = self._limit_rows(stmt, rows)
-        return SQLResult(schema=schema, rows=rows)
-
-    def _reject_foreign_quals(self, stmt: ast.Select):
-        """Non-join selects must not reference other tables: a bogus
-        qualifier would otherwise silently resolve to the bare name."""
-        def walk(e):
-            if isinstance(e, ast.Col):
-                if e.table is not None and e.table != stmt.table:
-                    raise SQLError(f"unknown table {e.table!r}")
-                return
-            if e is None or isinstance(e, (str, int, float, bool)):
-                return
-            for attr in ("left", "right", "expr", "col", "arg"):
-                sub = getattr(e, attr, None)
-                if sub is not None:
-                    walk(sub)
-        for it in stmt.items:
-            walk(it.expr)
-        walk(stmt.where)
-        walk(stmt.having)
-        for ob in stmt.order_by:
-            walk(ob.expr)
-
-    @staticmethod
-    def _ordinal_index(value: int, n: int) -> int:
-        """1-based ORDER BY projection ordinal -> 0-based index."""
-        i = value - 1
-        if not (0 <= i < n):
-            raise SQLError(f"ORDER BY position {value} out of range")
-        return i
-
-    @staticmethod
-    def _is_ordinal(e) -> bool:
-        return (isinstance(e, ast.Lit) and isinstance(e.value, int)
-                and not isinstance(e.value, bool))
-
-    @staticmethod
-    def _sorted_nulls_last(indices, key, desc: bool) -> list[int]:
-        """Stable sort of index list by key(i), NULLS LAST either
-        direction (the Sort pushdown's convention)."""
-        nn = [i for i in indices if key(i) is not None]
-        nulls = [i for i in indices if key(i) is None]
-        nn.sort(key=key, reverse=desc)
-        return nn + nulls
-
-    def _name_of(self, it: ast.SelectItem) -> str:
-        if it.alias:
-            return it.alias
-        e = it.expr
-        if isinstance(e, ast.Col):
-            return e.name
-        if isinstance(e, ast.Agg):
-            inner = e.arg.name if e.arg else "*"
-            d = "distinct " if e.distinct else ""
-            return f"{e.func}({d}{inner})"
-        if isinstance(e, ast.Func):
-            return e.name.lower()
-        return "expr"
-
-    def _expr_type(self, idx, e) -> str:
-        """Result SQL type of a scalar expression (the reference sets
-        ResultDataType during analysis, expressionanalyzercall.go)."""
-        from pilosa_tpu.sql.funcs import FUNC_TYPES
-        if isinstance(e, ast.Lit):
-            v = e.value
-            if isinstance(v, bool):
-                return "bool"
-            if isinstance(v, int):
-                return "int"
-            if v is None or isinstance(v, str):
-                return "string"
-            return "decimal"
-        if isinstance(e, ast.Col):
-            if e.name == "_id":
-                return "string" if idx.keys else "id"
-            return _sql_type(self._field(idx, e.name))
-        if isinstance(e, ast.Func):
-            if e.name == "CAST" and len(e.args) == 3 and \
-                    isinstance(e.args[1], ast.Lit):
-                return e.args[1].value
-            if e.name in self._udf_types():
-                return self._udf_types()[e.name]
-            return FUNC_TYPES.get(e.name, "string")
-        if isinstance(e, ast.BinOp):
-            if e.op == "||":
-                return "string"
-            if e.op in ("+", "-", "*", "/", "%"):
-                lt = self._expr_type(idx, e.left)
-                rt = self._expr_type(idx, e.right)
-                return "decimal" if "decimal" in (lt, rt) else "int"
-            return "bool"
-        return "bool"  # Not/IsNull/InList/Between
-
-    def _select_aggregates(self, idx, stmt, items, filt) -> SQLResult:
-        ex = self.executor
-        row_vals, schema = [], []
-        for it in items:
-            a: ast.Agg = it.expr
-            schema.append((self._name_of(it), self._agg_type(idx, a)))
-            row_vals.append(self._eval_agg(idx, a, filt))
-        return SQLResult(schema=schema, rows=[tuple(row_vals)])
-
-    def _agg_type(self, idx, a: ast.Agg) -> str:
-        if a.func == "count":
-            return "int"
-        if a.func in ("avg", "var", "corr"):
-            return "decimal"
-        f = self._field(idx, a.arg.name)
-        return _sql_type(f)
-
-    def _eval_agg(self, idx, a: ast.Agg, filt: Call):
-        ex = self.executor
-        has_filter = self._has_filter(filt)
-        fchildren = [filt] if has_filter else []
-        if a.func == "count" and a.arg is None:
-            return ex._execute_call(idx, Call(
-                "Count", children=[filt]), None)
-        if a.func == "count" and a.distinct:
-            res = ex._execute_call(idx, Call(
-                "Distinct", args={"_field": a.arg.name},
-                children=fchildren), None)
-            return len(res.values) if isinstance(res, DistinctValues) \
-                else res.count()
-        if a.func == "count":
-            # non-null count of the column
-            f = self._field(idx, a.arg.name)
-            if f.options.type.is_bsi:
-                nn = Call("Row", args={a.arg.name: Condition("!=", None)})
-            else:
-                nn = Call("UnionRows", children=[
-                    Call("Rows", args={"_field": a.arg.name})])
-            tree = Call("Intersect", children=[filt, nn]) if has_filter else nn
-            return ex._execute_call(idx, Call("Count", children=[tree]), None)
-        if a.func in ("sum", "min", "max", "avg"):
-            call_name = {"sum": "Sum", "min": "Min", "max": "Max",
-                         "avg": "Sum"}[a.func]
-            res = ex._execute_call(idx, Call(
-                call_name, args={"_field": a.arg.name},
-                children=fchildren), None)
-            if a.func == "avg":
-                return res.value / res.count if res.count else None
-            return res.value
-        if a.func == "percentile":
-            args = {"_field": a.arg.name, "nth": a.extra}
-            if has_filter:
-                args["filter"] = filt
-            res = ex._execute_call(idx, Call("Percentile", args=args), None)
-            return res.value if res is not None else None
-        if a.func in ("var", "corr"):
-            return self._eval_var_corr(idx, a, filt)
-        raise SQLError(f"unsupported aggregate {a.func}")
-
-    def _eval_var_corr(self, idx, a: ast.Agg, filt: Call):
-        """VAR(x): population variance; CORR(x, y): Pearson
-        correlation — both buffer the matching values like the
-        reference's aggregateVar/aggregateCorr (expressionagg.go:949,
-        1197) and return decimals at scale 6."""
-        from decimal import Decimal
-        if a.arg is None:
-            raise SQLError(f"{a.func} requires a column argument")
-        names = [a.arg.name]
-        if a.func == "corr":
-            names.append(self._col_name(a.extra))
-        for n in names:
-            f = self._field(idx, n)
-            if f.options.type not in (FieldType.INT, FieldType.DECIMAL):
-                raise SQLError(f"{a.func} requires a numeric column")
-        c = Call("Extract", children=[filt] + [
-            Call("Rows", args={"_field": n}) for n in names])
-        table = self.executor._execute_call(idx, c, None)
-        cols = [[], []]
-        for entry in table.columns:
-            vals = [entry["rows"][i] for i in range(len(names))]
-            if any(v is None for v in vals):
-                continue  # reference skips nil rows
-            for i, v in enumerate(vals):
-                cols[i].append(float(v))
-        xs = cols[0]
-        n = len(xs)
-        if n == 0:
-            return None
-        if a.func == "var":
-            mean = sum(xs) / n
-            var = sum((v - mean) ** 2 for v in xs) / n
-            return Decimal(f"{var:.6f}")
-        ys = cols[1]
-        sx, sy = sum(xs), sum(ys)
-        sxy = sum(x * y for x, y in zip(xs, ys))
-        sxx, syy = sum(x * x for x in xs), sum(y * y for y in ys)
-        # float rounding can push a variance term slightly negative
-        # for near-constant data; clamp so the sqrt stays real
-        vx = max(n * sxx - sx * sx, 0.0)
-        vy = max(n * syy - sy * sy, 0.0)
-        denom = (vx * vy) ** 0.5
-        if denom == 0:
-            return None
-        return Decimal(f"{(n * sxy - sx * sy) / denom:.6f}")
-
-    def _select_grouped(self, idx, stmt, items, filt) -> SQLResult:
-        group_cols = stmt.group_by
-        if any(self._field(idx, g).options.type.is_bsi
-               for g in group_cols):
-            # PQL GroupBy(Rows(...)) only walks set-like fields; int/
-            # decimal/timestamp group columns take the generic hashed
-            # path (sql3's non-pushdown PlanOpGroupBy)
-            return self._select_grouped_generic(idx, stmt, items, filt)
-        # validate items: group cols or aggregates
-        schema, getters = [], []
-        sum_field = None
-        for it in items:
-            e = it.expr
-            if isinstance(e, ast.Col):
-                if e.name not in group_cols:
-                    raise SQLError(
-                        f"column {e.name} must appear in GROUP BY")
-                gi = group_cols.index(e.name)
-                f = self._field(idx, e.name)
-                schema.append((self._name_of(it),
-                               "string" if f.options.keys else "id"))
-                getters.append(("group", gi))
-            elif isinstance(e, ast.Agg):
-                if e.func == "count" and e.arg is None:
-                    schema.append((self._name_of(it), "int"))
-                    getters.append(("count", None))
-                elif e.func in ("sum", "avg"):
-                    if sum_field is None:
-                        sum_field = e.arg.name
-                    elif sum_field != e.arg.name:
-                        raise SQLError(
-                            "only one SUM column per grouped query")
-                    schema.append((self._name_of(it), self._agg_type(idx, e)))
-                    getters.append((e.func, None))
-                else:
-                    raise SQLError(
-                        f"aggregate {e.func} not supported with GROUP BY")
-            else:
-                raise SQLError("invalid GROUP BY projection")
-        args = {}
-        has_filter = self._has_filter(filt)
-        if has_filter:
-            args["filter"] = filt
-        if sum_field is not None:
-            args["aggregate"] = Call("Sum", args={"_field": sum_field})
-        having = stmt.having
-        if having is not None:
-            args["having"] = self._compile_having(having)
-        call = Call("GroupBy", args=args, children=[
-            Call("Rows", args={"_field": g}) for g in group_cols])
-        groups = self.executor._execute_call(idx, call, None)
-        rows = []
-        for g in groups:
-            vals = []
-            for kind, gi in getters:
-                if kind == "group":
-                    ge = g.group[gi]
-                    vals.append(ge.get("row_key", ge["row_id"]))
-                elif kind == "count":
-                    vals.append(g.count)
-                elif kind == "sum":
-                    # SUM over only NULLs is NULL, not 0
-                    vals.append(g.agg if g.agg_count else None)
-                elif kind == "avg":
-                    vals.append(g.agg / g.agg_count if g.agg_count
-                                else None)
-            rows.append(tuple(vals))
-        rows = self._order_rows(stmt, schema, rows)
-        rows = self._limit_rows(stmt, rows)
-        return SQLResult(schema=schema, rows=rows)
-
-    def _select_grouped_generic(self, idx, stmt, items, filt) -> SQLResult:
-        """Hashed GROUP BY over materialized record values — the
-        fallback when a group column is BSI (sql3 planner's generic
-        PlanOpGroupBy instead of the PQL GroupBy pushdown)."""
-        group_cols = stmt.group_by
-        if not self.executor.supports_local_cells:
-            raise SQLError(
-                "GROUP BY on int/decimal/timestamp columns is not "
-                "supported on the DAX queryer yet")
-        schema, getters = [], []
-        agg_specs = []  # (func, col or None)
-        for it in items:
-            e = it.expr
-            if isinstance(e, ast.Col):
-                if e.name not in group_cols:
-                    raise SQLError(
-                        f"column {e.name} must appear in GROUP BY")
-                f = self._field(idx, e.name)
-                schema.append((self._name_of(it), _sql_type(f)))
-                getters.append(("group", group_cols.index(e.name)))
-            elif isinstance(e, ast.Agg):
-                if e.func == "count" and e.arg is None:
-                    schema.append((self._name_of(it), "int"))
-                    getters.append(("agg", len(agg_specs)))
-                    agg_specs.append(("count*", None))
-                elif e.func in ("count", "sum", "avg", "min", "max"):
-                    schema.append((self._name_of(it),
-                                   self._agg_type(idx, e)))
-                    getters.append(("agg", len(agg_specs)))
-                    agg_specs.append((e.func, e.arg.name))
-                else:
-                    raise SQLError(
-                        f"aggregate {e.func} not supported with GROUP BY")
-            else:
-                raise SQLError("invalid GROUP BY projection")
-
-        groups: dict[tuple, list] = {}
-        for rid in self._table_ids(idx, filt):
-            key = tuple(self._group_key(idx, g, rid) for g in group_cols)
-            groups.setdefault(key, []).append(rid)
-
-        rows = []
-        for key, rids in groups.items():
-            agg_vals = []
-            for func, col in agg_specs:
-                if func == "count*":
-                    agg_vals.append(len(rids))
-                    continue
-                vals = [self._cell_value(idx, col, r) for r in rids]
-                vals = [v for v in vals if v is not None]
-                if func == "count":
-                    agg_vals.append(len(vals))
-                elif not vals:
-                    agg_vals.append(None)
-                elif func == "sum":
-                    agg_vals.append(sum(vals))
-                elif func == "avg":
-                    agg_vals.append(sum(vals) / len(vals))
-                elif func == "min":
-                    agg_vals.append(min(vals))
-                elif func == "max":
-                    agg_vals.append(max(vals))
-            if stmt.having is not None and not self._generic_having_ok(
-                    stmt.having, len(rids), agg_specs, agg_vals):
-                continue
-            out = []
-            for kind, i in getters:
-                out.append(key[i] if kind == "group" else agg_vals[i])
-            rows.append(tuple(out))
-        rows = self._order_rows(stmt, schema, rows)
-        rows = self._limit_rows(stmt, rows)
-        return SQLResult(schema=schema, rows=rows)
-
-    def _group_key(self, idx, col: str, rid: int):
-        v = self._cell_value(idx, col, rid)
-        return tuple(sorted(v)) if isinstance(v, list) else v
-
-    def _generic_having_ok(self, having, count, agg_specs, agg_vals):
-        if not (isinstance(having, ast.BinOp)
-                and isinstance(having.left, ast.Agg)
-                and isinstance(having.right, ast.Lit)):
-            raise SQLError(
-                "HAVING supports COUNT(*)/SUM(col) comparisons")
-        a = having.left
-        if a.func == "count" and a.arg is None:
-            val = count
-        else:
-            for i, (func, col) in enumerate(agg_specs):
-                if func == a.func and col == (a.arg.name if a.arg
-                                              else None):
-                    val = agg_vals[i]
-                    break
-            else:
-                raise SQLError(
-                    "HAVING aggregate must appear in the projection")
-        if val is None:
-            return False
-        import operator
-        ops = {"=": operator.eq, "!=": operator.ne, "<": operator.lt,
-               "<=": operator.le, ">": operator.gt, ">=": operator.ge}
-        if having.op not in ops:
-            raise SQLError(f"HAVING operator {having.op!r} unsupported")
-        return ops[having.op](val, having.right.value)
-
-    def _compile_having(self, having) -> Call:
-        # HAVING COUNT(*) > n / SUM(col) > n → Condition(count/sum OP n)
-        if isinstance(having, ast.BinOp) and \
-                isinstance(having.left, ast.Agg):
-            a = having.left
-            key = "count" if a.func == "count" else "sum"
-            if not isinstance(having.right, ast.Lit):
-                raise SQLError("HAVING requires a literal bound")
-            op = {"=": "=="}.get(having.op, having.op)
-            return Call("Condition",
-                        args={key: Condition(op, having.right.value)})
-        raise SQLError("HAVING supports COUNT(*)/SUM(col) comparisons")
-
-    def _select_distinct(self, idx, stmt, item, filt) -> SQLResult:
-        name = item.expr.name
-        f = self._field(idx, name)
-        has_filter = self._has_filter(filt)
-        res = self.executor._execute_call(idx, Call(
-            "Distinct", args={"_field": name},
-            children=[filt] if has_filter else []), None)
-        if isinstance(res, DistinctValues):
-            values = res.values
-        else:
-            values = res.columns().tolist()
-            if f.options.keys:
-                values = f.row_translator.translate_ids(values)
-        rows = [(self._to_sql_value(v),) for v in values]
-        schema = [(self._name_of(item), _sql_type(f))]
-        sel = stmt
-        rows = self._order_rows(sel, schema, rows)
-        rows = self._limit_rows(sel, rows)
-        return SQLResult(schema=schema, rows=rows)
-
-    def _select_rows(self, idx, stmt, items, filt) -> SQLResult:
-        from pilosa_tpu.sql.funcs import Evaluator, columns_in
-        items = [ast.SelectItem(self._fold_subqueries(it.expr), it.alias)
-                 for it in items]
-        # classify projections: plain columns ride the Extract
-        # directly; scalar expressions evaluate row-wise over it
-        plans = []   # ("id",) | ("col", name) | ("expr", e)
-        ref_cols: set[str] = set()
-        for it in items:
-            e = it.expr
-            if isinstance(e, ast.Col):
-                if e.name == "_id":
-                    plans.append(("id",))
-                else:
-                    self._field(idx, e.name)
-                    ref_cols.add(e.name)
-                    plans.append(("col", e.name))
-            else:
-                for n in columns_in(e):
-                    if n != "_id":
-                        self._field(idx, n)
-                        ref_cols.add(n)
-                plans.append(("expr", e))
-        non_id = sorted(ref_cols)
-        names = [self._name_of(it) for it in items]
-        order_col = None
-        order_expr = None  # non-column ORDER BY key (host-evaluated)
-        multi_order = stmt.order_by and len(stmt.order_by) > 1
-        if multi_order:
-            # multi-key: materialize unordered, then host-sort with
-            # every key.  Keys need not be projected (defs_orderby's
-            # `order by foo asc, a_decimal asc`): unprojected sort
-            # columns ride the Extract, and exprs/ordinals/aliases
-            # evaluate per row.  LIMIT stays host-side (after sort).
-            for ob in stmt.order_by:
-                e = ob.expr
-                if isinstance(e, ast.Col) and e.name != "_id" and \
-                        idx.field(e.name) is not None:
-                    ref_cols.add(e.name)
-                elif not isinstance(e, (ast.Col, ast.Lit)):
-                    for n2 in columns_in(self._fold_subqueries(e)):
-                        if n2 != "_id":
-                            self._field(idx, n2)
-                            ref_cols.add(n2)
-            non_id = sorted(ref_cols)
-        order_ordinal = None  # ORDER BY <n> (1-based projection index)
-        if not multi_order and stmt.order_by:
-            ob = stmt.order_by[0]
-            if isinstance(ob.expr, ast.Col):
-                order_col = ob.expr.name
-            elif self._is_ordinal(ob.expr):
-                order_ordinal = self._ordinal_index(
-                    ob.expr.value, len(items))
-            else:
-                order_expr = self._fold_subqueries(ob.expr)
-                for n in columns_in(order_expr):
-                    if n != "_id":
-                        self._field(idx, n)
-                        ref_cols.add(n)
-                non_id = sorted(ref_cols)
-        # pushdown: ORDER BY on BSI column → Sort; plain LIMIT → Limit.
-        # LIMIT must stay host-side under DISTINCT (dedup shrinks the
-        # row set, so a pushed limit would under-return).
-        inner = filt
-        host_sort = False
-        order_alias = None  # ORDER BY a projected alias / output name
-        null_tail = None  # rows where the BSI sort column is NULL
-        if order_expr is not None:
-            host_sort = True
-        elif order_ordinal is not None:
-            order_alias = order_ordinal
-            host_sort = True
-        elif order_col is not None and order_col != "_id" and \
-                idx.field(order_col) is None and order_col in names:
-            order_alias = names.index(order_col)
-            host_sort = True
-        elif order_col is not None and order_col != "_id":
-            f = self._field(idx, order_col)
-            if f.options.type.is_bsi:
-                args = {"_field": order_col}
-                if stmt.order_by[0].desc:
-                    args["sort-desc"] = True
-                if stmt.limit is not None and not stmt.distinct:
-                    args["limit"] = stmt.limit + (stmt.offset or 0)
-                inner = Call("Sort", args=args, children=[filt])
-                # Sort yields only rows holding a value; NULL-valued
-                # rows are appended after (NULLS LAST)
-                nf = Call("Row", args={order_col: Condition("==", None)})
-                null_tail = Call("Intersect", children=[filt, nf]) \
-                    if self._has_filter(filt) else nf
-            else:
-                host_sort = True
-        elif order_col == "_id":
-            host_sort = stmt.order_by[0].desc  # asc is natural order
-        if not host_sort and not multi_order and order_col is None \
-                and stmt.limit is not None and not stmt.distinct:
-            inner = Call("Limit", args={
-                "limit": stmt.limit + (stmt.offset or 0)}, children=[filt])
-
-        extract_cols = list(non_id)
-        if host_sort and order_expr is None and order_alias is None \
-                and order_col != "_id" and order_col not in extract_cols:
-            extract_cols.append(order_col)  # fetched for sorting only
-        # multi-key ORDER BY: resolve every key to a per-row getter
-        # BEFORE executing anything, so a bad reference errors without
-        # paying for the scan.  Plans: ("ord" projection index | "id"
-        # | "col" extracted name | "alias" projection index | "expr"
-        # folded scalar)
-        mord = []
-        if multi_order:
-            for ob in stmt.order_by:
-                e = ob.expr
-                if self._is_ordinal(e):
-                    mord.append(
-                        ("ord", self._ordinal_index(e.value,
-                                                    len(items))))
-                elif isinstance(e, ast.Col) and e.name == "_id":
-                    mord.append(("id", None))
-                elif isinstance(e, ast.Col) and \
-                        idx.field(e.name) is not None:
-                    mord.append(("col", e.name))
-                elif isinstance(e, ast.Col):
-                    if e.name not in names:
-                        raise SQLError(
-                            f"ORDER BY column {e.name!r} not found")
-                    mord.append(("alias", names.index(e.name)))
-                else:
-                    mord.append(("expr", self._fold_subqueries(e)))
-
-        def run_extract(src):
-            c = Call("Extract", children=[src] + [
-                Call("Rows", args={"_field": n}) for n in extract_cols])
-            return self.executor._execute_call(idx, c, None)
-
-        table = run_extract(inner)
-        need_nulls = null_tail is not None and (
-            stmt.limit is None or stmt.distinct or
-            len(table.columns) < stmt.limit + (stmt.offset or 0))
-        if need_nulls:
-            table.columns.extend(run_extract(null_tail).columns)
-
-        schema = []
-        for it, plan in zip(items, plans):
-            if plan[0] == "id":
-                schema.append((self._name_of(it),
-                               "string" if idx.keys else "id"))
-            elif plan[0] == "col":
-                schema.append((self._name_of(it),
-                               _sql_type(self._field(idx, plan[1]))))
-            else:
-                schema.append((self._name_of(it),
-                               self._expr_type(idx, plan[1])))
-        ev = Evaluator(udfs=self._udf_callables())
-        need_env = (order_expr is not None
-                    or any(p[0] == "expr" for p in plans)
-                    or any(k == "expr" for k, _a in mord))
-        rows = []
-        sort_keys = []
-        mkeys = []
-        for entry in table.columns:
-            env = None
-            if need_env:
-                env = {n: self._to_sql_value(entry["rows"][i])
-                       for i, n in enumerate(extract_cols)}
-                env["_id"] = entry.get("column_key", entry["column"])
-            vals = []
-            for plan in plans:
-                if plan[0] == "id":
-                    vals.append(entry.get("column_key", entry["column"]))
-                elif plan[0] == "col":
-                    vals.append(self._to_sql_value(
-                        entry["rows"][extract_cols.index(plan[1])]))
-                else:
-                    vals.append(self._to_sql_value(
-                        ev.eval(plan[1], env)))
-            rows.append(tuple(vals))
-            if host_sort:
-                if order_expr is not None:
-                    k = ev.eval(order_expr, env)
-                elif order_alias is not None:
-                    k = vals[order_alias]
-                elif order_col == "_id":
-                    k = entry.get("column_key", entry["column"])
-                else:
-                    k = entry["rows"][extract_cols.index(order_col)]
-                if isinstance(k, list):  # set column: sort by first value
-                    k = sorted(k)[0] if k else None
-                sort_keys.append(k)
-            if multi_order:
-                mk = []
-                for kind, arg in mord:
-                    if kind == "ord" or kind == "alias":
-                        k = vals[arg]
-                    elif kind == "id":
-                        k = entry.get("column_key", entry["column"])
-                    elif kind == "col":
-                        k = entry["rows"][extract_cols.index(arg)]
-                    else:
-                        k = ev.eval(arg, env)
-                    if isinstance(k, list):
-                        k = sorted(k)[0] if k else None
-                    mk.append(k)
-                mkeys.append(mk)
-        if host_sort:
-            order = self._sorted_nulls_last(
-                range(len(rows)), lambda i: sort_keys[i],
-                stmt.order_by[0].desc)
-            rows = [rows[i] for i in order]
-        if multi_order:
-            # stable sorts applied last-key-first, NULLS LAST per key
-            order = list(range(len(rows)))
-            for ki in reversed(range(len(mord))):
-                order = self._sorted_nulls_last(
-                    order, lambda i: mkeys[i][ki],
-                    stmt.order_by[ki].desc)
-            rows = [rows[i] for i in order]
-        if stmt.distinct:
-            # spill-backed dedup: in-memory set until the threshold,
-            # then the on-disk extendible hash (sql3 opdistinct over
-            # bufferpool/extendiblehash)
-            import os
-            import tempfile
-            from pilosa_tpu.storage.extendiblehash import SpillSet
-            fd, spill_path = tempfile.mkstemp(suffix=".distinct")
-            os.close(fd)  # mkstemp (not mktemp): no TOCTOU on the name
-            spill = SpillSet(spill_path)
-            try:
-                deduped = []
-                for r in rows:
-                    if spill.add(_distinct_key(r)):
-                        deduped.append(r)
-                rows = deduped
-            finally:
-                spill.close()
-        rows = self._limit_rows(stmt, rows)
-        return SQLResult(schema=schema, rows=rows)
-
-    # -- INNER JOIN (sql3 opnestedloops.go nested-loop join) -----------
-
-    def _cell_value(self, idx, name: str, col_id: int):
-        """One column's value for one record id (join materialization).
-        BSI fields -> typed value or None; set-like -> row key/id (or
-        sorted list when multiple); _id -> the key (keyed tables) or
-        the id, matching what SELECT projects."""
-        if name == "_id":
-            if idx.keys and idx.column_translator is not None:
-                k = idx.column_translator.translate_ids([col_id])[0]
-                return k if k is not None else col_id
-            return col_id
-        f = self._field(idx, name)
-        shard, scol = divmod(col_id, f.width)
-        if f.options.type.is_bsi:
-            v = f.views.get(f.bsi_view)
-            frag = v.fragment(shard) if v else None
-            if frag is None or not frag.contains(0, scol):
-                return None
-            mag = sum(1 << i for i in range(f.bit_depth)
-                      if frag.contains(2 + i, scol))
-            return f.int_to_value(-mag if frag.contains(1, scol) else mag)
-        from pilosa_tpu.models.view import VIEW_STANDARD
-        view = f.views.get(VIEW_STANDARD)
-        frag = view.fragment(shard) if view else None
-        if frag is None:
-            return None
-        rows = [r for r in frag.row_ids if frag.contains(r, scol)]
-        if not rows:
-            return None
-        if f.options.type == FieldType.BOOL:
-            return rows[-1] == 1
-        if f.options.keys:
-            keys = f.row_translator.translate_ids(rows)
-            return keys[0] if len(keys) == 1 else sorted(keys)
-        return rows[0] if len(rows) == 1 else rows
-
-    def _table_ids(self, idx, filt) -> list:
-        res = self.executor._execute_call(idx, filt, None)
-        return [int(c) for c in res.columns()]
-
-    def _select_join(self, stmt: ast.Select) -> SQLResult:
-        """Nested-loop INNER / LEFT OUTER JOIN of two tables on column
-        equality.  The right side builds a hash of join-key -> record
-        ids; left records probe it (the hashed refinement of
-        opnestedloops.go's loop; LEFT JOIN per opnestedloops.go's
-        outer variant: a left record with no key match survives once
-        with NULL right-side values, and WHERE evaluates AFTER the
-        join).  WHERE may reference either table's columns."""
-        if not self.executor.supports_local_cells:
-            raise SQLError("JOIN is not supported on the DAX queryer yet")
-        if len(stmt.joins) != 1:
-            raise SQLError("a single JOIN is supported")
-        if stmt.group_by or stmt.having or stmt.distinct:
-            raise SQLError("JOIN with GROUP BY/HAVING/DISTINCT "
-                           "not supported yet")
-        join = stmt.joins[0]
-        lname, rname = stmt.table, join.table
-        if lname == rname:
-            raise SQLError("self-join requires table aliases "
-                           "(not supported)")
-        lidx, ridx = self._index(lname), self._index(rname)
-
-        def side_of(c: ast.Col) -> str:
-            if c.table is None:
-                raise SQLError("JOIN ON columns must be qualified "
-                               "(table.column)")
-            if c.table not in (lname, rname):
-                raise SQLError(f"unknown table in ON: {c.table}")
-            return c.table
-
-        jl, jr = join.left, join.right
-        if side_of(jl) == rname:
-            jl, jr = jr, jl
-        if side_of(jl) != lname or side_of(jr) != rname:
-            raise SQLError("JOIN ON must relate the two joined tables")
-
-        # projected columns; '*' expands to both tables' columns
-        items: list[tuple[str, str, str]] = []  # (out name, table, col)
-        for it in stmt.items:
-            e = it.expr
-            if isinstance(e, ast.Agg):
-                if e.func == "count" and e.arg is None:
-                    items.append((self._name_of(it), "", "count(*)"))
-                    continue
-                raise SQLError("JOIN supports only COUNT(*) aggregate")
-            if not isinstance(e, ast.Col):
-                raise SQLError("JOIN projections must be columns")
-            if e.name == "*":
-                items.append(("_id", lname, "_id"))
-                items += [(f.name, lname, f.name)
-                          for f in lidx.public_fields()]
-                items += [(f"{rname}._id", rname, "_id")]
-                items += [(f"{rname}.{f.name}", rname, f.name)
-                          for f in ridx.public_fields()]
-                continue
-            table = e.table or lname
-            if table not in (lname, rname):
-                raise SQLError(f"unknown table {table!r} in projection")
-            items.append((it.alias or (e.name if e.table is None else
-                                       f"{e.table}.{e.name}"),
-                          table, e.name))
-        if any(c == "count(*)" for _, _, c in items) and len(items) > 1:
-            raise SQLError(
-                "JOIN cannot mix COUNT(*) with other projections")
-
-        # WHERE: validate table qualifications up front; conditions
-        # evaluate on the joined row (qualified or left-default)
-        where = stmt.where
-
-        def walk(e):
-            if isinstance(e, ast.Col):
-                t = e.table or lname
-                if t not in (lname, rname):
-                    raise SQLError(f"unknown table {t!r} in WHERE")
-                return
-            for attr in ("left", "right", "expr", "col"):
-                sub = getattr(e, attr, None)
-                if sub is not None and not isinstance(
-                        sub, (str, int, float, bool)):
-                    walk(sub)
-        if where is not None:
-            walk(where)
-
-        all_call = Call("All")
-        left_ids = self._table_ids(lidx, all_call)
-        right_ids = self._table_ids(ridx, all_call)
-
-        # hash the right side by join-key value
-        rmap: dict = {}
-        for rid in right_ids:
-            v = self._cell_value(ridx, jr.name, rid)
-            if v is None:
-                continue
-            for key in (v if isinstance(v, list) else [v]):
-                rmap.setdefault(key, []).append(rid)
-
-        # memoize per (table, col, record): a left record matching k
-        # right rows would otherwise re-decode its cells k times
-        cell_cache: dict = {}
-
-        def cell(table, idx_, col, record_id):
-            if record_id is None:  # unmatched LEFT JOIN right side
-                return None
-            key = (table, col, record_id)
-            if key not in cell_cache:
-                cell_cache[key] = self._cell_value(idx_, col, record_id)
-            return cell_cache[key]
-
-        def joined_value(table, col, lid, rid):
-            if table == lname:
-                return cell(lname, lidx, col, lid)
-            return cell(rname, ridx, col, rid)
-
-        def where_ok(lid, rid):
-            if where is None:
-                return True
-            return bool(self._eval_join_expr(where, lname, rname,
-                                             lidx, ridx, lid, rid))
-
-        rows = []
-        count_only = items and items[0][2] == "count(*)" and \
-            len(items) == 1
-        n = 0
-        outer = join.outer
-
-        def emit(lid, rid):
-            nonlocal n
-            if count_only:
-                n += 1
-            else:
-                rows.append(tuple(joined_value(t, c, lid, rid)
-                                  for _, t, c in items))
-
-        for lid in left_ids:
-            lv = self._cell_value(lidx, jl.name, lid)
-            any_key_match = False
-            if lv is not None:
-                for key in (lv if isinstance(lv, list) else [lv]):
-                    for rid in rmap.get(key, ()):
-                        any_key_match = True
-                        if where_ok(lid, rid):
-                            emit(lid, rid)
-            if outer and not any_key_match and where_ok(lid, None):
-                emit(lid, None)
-        if count_only:
-            return SQLResult(schema=[(items[0][0], "int")], rows=[(n,)])
-        # typed schema: resolve each projected column's SQL type
-        schema = []
-        for name, t, c in items:
-            idx_ = lidx if t == lname else ridx
-            if c == "_id":
-                schema.append((name, "id"))
-            else:
-                schema.append((name, _sql_type(self._field(idx_, c))))
-        rows = self._order_rows(stmt, schema, rows)
-        rows = self._limit_rows(stmt, rows)
-        return SQLResult(schema=schema, rows=rows)
-
-    def _eval_join_expr(self, e, lname, rname, lidx, ridx, lid, rid):
-        """Evaluate a WHERE expression over one joined row."""
-        if isinstance(e, ast.Lit):
-            return e.value
-        if isinstance(e, ast.Col):
-            t = e.table or lname
-            rec = lid if t == lname else rid
-            if rec is None:  # unmatched LEFT JOIN side
-                return None
-            return self._cell_value(lidx if t == lname else ridx,
-                                    e.name, rec)
-        ev = lambda x: self._eval_join_expr(x, lname, rname, lidx,
-                                            ridx, lid, rid)
-        if isinstance(e, ast.BinOp):
-            if e.op == "and":
-                return ev(e.left) and ev(e.right)
-            if e.op == "or":
-                return ev(e.left) or ev(e.right)
-            l, r = ev(e.left), ev(e.right)
-            if l is None or r is None:
-                return False
-            if e.op == "=":
-                return l == r
-            if e.op in ("!=", "<>"):
-                return l != r
-            if e.op not in ("<", "<=", ">", ">="):
-                raise SQLError(f"JOIN WHERE operator {e.op!r} "
-                               "not supported")
-            try:
-                return {"<": l < r, "<=": l <= r,
-                        ">": l > r, ">=": l >= r}[e.op]
-            except TypeError:
-                raise SQLError(
-                    f"cannot compare {type(l).__name__} with "
-                    f"{type(r).__name__} in JOIN WHERE")
-        if isinstance(e, ast.Not):
-            return not ev(e.expr)
-        if isinstance(e, ast.IsNull):
-            return (ev(e.col) is None) != e.negated
-        raise SQLError(f"unsupported WHERE form in JOIN: {e!r}")
-
-    def _order_rows(self, stmt, schema, rows):
-        """Multi-key ORDER BY: stable sorts applied last-key-first,
-        NULLS LAST within each key's direction."""
-        if not stmt.order_by:
-            return rows
-        names = [s[0] for s in schema]
-        rows = list(rows)
-        for ob in reversed(stmt.order_by):
-            if self._is_ordinal(ob.expr):
-                i = self._ordinal_index(ob.expr.value, len(names))
-                order = self._sorted_nulls_last(
-                    range(len(rows)), lambda j: rows[j][i], ob.desc)
-                rows = [rows[j] for j in order]
-                continue
-            if isinstance(ob.expr, ast.Col) and ob.expr.table:
-                name = f"{ob.expr.table}.{ob.expr.name}"
-            elif isinstance(ob.expr, ast.Col):
-                name = ob.expr.name
-            else:
-                name = self._name_of(ast.SelectItem(ob.expr))
-            # unqualified names also match a unique qualified projection
-            matches = [i for i, n in enumerate(names)
-                       if n == name or ("." not in name
-                                        and n.split(".")[-1] == name)]
-            if len(matches) != 1:
-                raise SQLError(
-                    f"ORDER BY column {name!r} not in projection"
-                    if not matches else
-                    f"ORDER BY column {name!r} is ambiguous")
-            i = matches[0]
-            order = self._sorted_nulls_last(
-                range(len(rows)), lambda j: rows[j][i], ob.desc)
-            rows = [rows[j] for j in order]
-        return rows
-
-    def _limit_rows(self, stmt, rows):
-        off = stmt.offset or 0
-        if stmt.limit is not None:
-            return rows[off:off + stmt.limit]
-        return rows[off:] if off else rows
-
-    def _to_sql_value(self, v):
-        if isinstance(v, dt.datetime):
-            return v.isoformat()
-        if isinstance(v, list):
-            return v
-        return v
+    def _iter_bulk_rows(self, stmt, idx, fields):
+        return self.stmts.iter_bulk_rows(stmt, idx, fields)

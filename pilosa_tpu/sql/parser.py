@@ -45,6 +45,15 @@ class Parser:
                 f"expected {value or kind} at {got.pos}, got {got.value!r}")
         return t
 
+    def int_tok(self, what: str) -> int:
+        """An integer literal (TOP/LIMIT/OFFSET counts): a fractional
+        number is a SQL error, not a raw ValueError."""
+        t = self.expect("number")
+        try:
+            return int(t.value)
+        except ValueError:
+            raise SQLError(f"{what} requires an integer, got {t.value!r}")
+
     def kw(self, word) -> Token | None:
         return self.accept("keyword", word)
 
@@ -356,6 +365,15 @@ class Parser:
     def select(self):
         self.expect_kw("select")
         sel = ast.Select()
+        # TOP(n) — only TOP immediately followed by '(' is the clause
+        # (sql3/parser/parser.go:2376)
+        if self.peek().kind in ("keyword", "ident") and \
+                self.peek().value.lower() == "top" and \
+                self.peek(1).kind == "op" and self.peek(1).value == "(":
+            self.next()
+            self.expect("op", "(")
+            sel.top = self.int_tok("TOP")
+            self.expect("op", ")")
         sel.distinct = bool(self.kw("distinct"))
         while True:
             if self.accept("op", "*"):
@@ -431,9 +449,16 @@ class Parser:
                 if not self.accept("op", ","):
                     break
         if self.kw("limit"):
-            sel.limit = int(self.expect("number").value)
+            sel.limit = self.int_tok("LIMIT")
         if self.kw("offset"):
-            sel.offset = int(self.expect("number").value)
+            sel.offset = self.int_tok("OFFSET")
+        if sel.top is not None:
+            # defs_top.go: TOP and LIMIT conflict; otherwise TOP(n)
+            # behaves exactly as LIMIT n
+            if sel.limit is not None:
+                raise SQLError(
+                    "TOP and LIMIT cannot be used at the same time")
+            sel.limit = sel.top
         return sel
 
     # -- expressions ----------------------------------------------------

@@ -1,0 +1,234 @@
+"""The SELECT plan-op layer: one dispatch decision, two consumers.
+
+``plan_select`` inspects a parsed SELECT plus the schema — executing
+nothing — and returns the plan operator that will serve it.  Each op
+renders itself for EXPLAIN (``lines()``) and executes on demand
+(``run()``), so the strategy EXPLAIN prints is by construction the
+strategy execution takes (the reference builds the same PlanOperator
+tree for both, sql3/planner/executionplanner.go; EXPLAIN is
+PlanOperator.Plan(), sql3/planner/explain rendering).
+
+Operator set (the sql3/planner analogs):
+  ConstProjectOp    FROM-less projection
+  ViewExpandOp      stored-view re-execution
+  NestedLoopJoinOp  opnestedloops.go (hashed right side)
+  PQLGroupByOp      PlanOpPQLGroupBy pushdown / generic hashed
+  PQLAggregateOp    PlanOpPQLAggregate pushdown
+  DistinctScanOp    PlanOpPQLDistinctScan
+  ExtractScanOp     PlanOpPQLTableScan + sort/limit pushdown
+"""
+
+from __future__ import annotations
+
+from pilosa_tpu.pql.ast import Call
+from pilosa_tpu.sql import ast
+from pilosa_tpu.sql.common import SQLResult
+from pilosa_tpu.sql.lexer import SQLError
+from pilosa_tpu.sql.wherec import has_subquery, split_where
+
+_FILTER_PREFIX = "filter pushdown (PQL, shard-parallel device scan): "
+
+
+def _filter_lines(eng, idx, where) -> list[str]:
+    """EXPLAIN rendering of the WHERE pushdown WITHOUT executing —
+    subqueries fold at execution time, so a filter containing one
+    cannot be rendered without running it."""
+    if where is not None and has_subquery(where):
+        return [_FILTER_PREFIX
+                + "(contains subqueries — evaluated at execution time)"]
+    push = residue = None
+    if where is not None:
+        push, residue = split_where(where)
+    filt = eng.wherec.where_call(idx, push) if push is not None \
+        else Call("All")
+    out = [_FILTER_PREFIX + filt.to_pql()]
+    if residue is not None:
+        out.append("host residue filter: row-wise expression over the "
+                   "pushed result (ConstRow fold-back)")
+    return out
+
+
+class PlanOp:
+    """One SELECT strategy: EXPLAIN rendering + execution."""
+
+    def lines(self) -> list[str]:
+        raise NotImplementedError
+
+    def run(self) -> SQLResult:
+        raise NotImplementedError
+
+
+class ConstProjectOp(PlanOp):
+    def __init__(self, eng, stmt):
+        self.eng, self.stmt = eng, stmt
+
+    def lines(self):
+        return ["constant projection (no table)"]
+
+    def run(self):
+        return self.eng.select.select_const(self.stmt)
+
+
+class ViewExpandOp(PlanOp):
+    def __init__(self, eng, stmt):
+        self.eng, self.stmt = eng, stmt
+
+    def lines(self):
+        return [f"view expansion: {self.stmt.table}"]
+
+    def run(self):
+        return self.eng.select.select_view(self.stmt)
+
+
+class NestedLoopJoinOp(PlanOp):
+    def __init__(self, eng, stmt):
+        self.eng, self.stmt = eng, stmt
+
+    def lines(self):
+        out = []
+        for j in self.stmt.joins:
+            kind = "left outer" if j.outer else "inner"
+            out.append(
+                f"nested-loop {kind} join {self.stmt.table} x "
+                f"{j.table} on {j.left.name} = {j.right.name} "
+                "(hashed right side)")
+        return out
+
+    def run(self):
+        return self.eng.select.select_join(self.stmt)
+
+
+class _FilteredOp(PlanOp):
+    """Base for ops that compile the WHERE pushdown at run time."""
+
+    def __init__(self, eng, stmt, idx, items):
+        self.eng, self.stmt, self.idx, self.items = eng, stmt, idx, items
+
+    def _filt(self):
+        return self.eng.wherec.compile_where(self.idx, self.stmt.where)
+
+
+class PQLGroupByOp(_FilteredOp):
+    def __init__(self, eng, stmt, idx, items, generic: bool):
+        super().__init__(eng, stmt, idx, items)
+        self.generic = generic
+
+    def lines(self):
+        out = _filter_lines(self.eng, self.idx, self.stmt.where)
+        if self.generic:
+            out.append("generic hashed GROUP BY (BSI group column)")
+        else:
+            out.append(
+                "PQL GroupBy pushdown (stacked device program): "
+                + ", ".join(f"Rows({g})" for g in self.stmt.group_by))
+        return out
+
+    def run(self):
+        sel = self.eng.select
+        fn = sel.select_grouped_generic if self.generic \
+            else sel.select_grouped
+        return fn(self.idx, self.stmt, self.items, self._filt())
+
+
+class PQLAggregateOp(_FilteredOp):
+    def lines(self):
+        out = _filter_lines(self.eng, self.idx, self.stmt.where)
+        for it in self.items:
+            a = it.expr
+            inner = a.arg.name if a.arg else "*"
+            out.append(f"aggregate pushdown: {a.func}({inner})")
+        return out
+
+    def run(self):
+        return self.eng.select.select_aggregates(
+            self.idx, self.stmt, self.items, self._filt())
+
+
+class DistinctScanOp(_FilteredOp):
+    def lines(self):
+        out = _filter_lines(self.eng, self.idx, self.stmt.where)
+        out.append(f"PQL Distinct scan: {self.items[0].expr.name}")
+        return out
+
+    def run(self):
+        return self.eng.select.select_distinct(
+            self.idx, self.stmt, self.items[0], self._filt())
+
+
+class ExtractScanOp(_FilteredOp):
+    def lines(self):
+        stmt, idx = self.stmt, self.idx
+        out = _filter_lines(self.eng, idx, stmt.where)
+        ob = stmt.order_by[0] if len(stmt.order_by) == 1 else None
+        if ob is not None and isinstance(ob.expr, ast.Col) and \
+                ob.expr.name != "_id" and \
+                idx.field(ob.expr.name) is not None and \
+                self.eng._field(idx, ob.expr.name).options.type.is_bsi:
+            d = " desc" if ob.desc else ""
+            out.append(f"Sort pushdown (device BSI sort): "
+                       f"{ob.expr.name}{d}, NULLS LAST")
+        elif stmt.order_by:
+            out.append("host sort")
+        if stmt.limit is not None:
+            out.append(f"limit {stmt.limit}"
+                       + (f" offset {stmt.offset}" if stmt.offset
+                          else ""))
+        out.append("Extract scan (device row materialization)")
+        return out
+
+    def run(self):
+        return self.eng.select.select_rows(
+            self.idx, self.stmt, self.items, self._filt())
+
+
+def plan_select(eng, stmt: ast.Select) -> PlanOp:
+    """The single SELECT dispatch decision (executes nothing)."""
+    if not stmt.table:
+        return ConstProjectOp(eng, stmt)
+    if stmt.table in eng._views:
+        return ViewExpandOp(eng, stmt)
+    idx = eng._index(stmt.table)
+    if stmt.joins:
+        return NestedLoopJoinOp(eng, stmt)
+    eng.select.reject_foreign_quals(stmt)
+
+    # expand * into _id + all columns
+    items: list[ast.SelectItem] = []
+    for it in stmt.items:
+        if isinstance(it.expr, ast.Col) and it.expr.name == "*":
+            items.append(ast.SelectItem(ast.Col("_id"), "_id"))
+            items += [ast.SelectItem(ast.Col(f.name), f.name)
+                      for f in idx.public_fields()]
+        else:
+            items.append(it)
+
+    if stmt.having is not None and not stmt.group_by:
+        raise SQLError("HAVING requires GROUP BY")
+    aggs = [it for it in items if isinstance(it.expr, ast.Agg)]
+    if stmt.group_by:
+        # PQL GroupBy(Rows(...)) only walks set-like fields; int/
+        # decimal/timestamp group columns take the generic hashed
+        # path (sql3's non-pushdown PlanOpGroupBy)
+        generic = any(eng._field(idx, g).options.type.is_bsi
+                      for g in stmt.group_by)
+        return PQLGroupByOp(eng, stmt, idx, items, generic)
+    if aggs:
+        if len(aggs) != len(items):
+            raise SQLError(
+                "mixing aggregates and columns requires GROUP BY")
+        return PQLAggregateOp(eng, stmt, idx, items)
+    if stmt.distinct and len(items) == 1 and \
+            isinstance(items[0].expr, ast.Col) and \
+            items[0].expr.name != "_id":
+        return DistinctScanOp(eng, stmt, idx, items)
+    return ExtractScanOp(eng, stmt, idx, items)
+
+
+def explain(eng, stmt) -> SQLResult:
+    """EXPLAIN: the plan ops as rows, without executing (sql3
+    parseExplain + PlanOperator.Plan())."""
+    if isinstance(stmt, ast.Select):
+        rows = [(line,) for line in plan_select(eng, stmt).lines()]
+    else:
+        rows = [(type(stmt).__name__.lower(),)]
+    return SQLResult(schema=[("plan", "string")], rows=rows)

@@ -1,0 +1,963 @@
+"""SELECT execution strategies: each method here is the `run` body of
+one plan operator (pilosa_tpu/sql/plan.py) — aggregates, GROUP BY
+(PQL pushdown + generic hashed), DISTINCT scan, row extraction with
+sort/limit pushdown, nested-loop JOIN, views, and constant selects.
+
+Split out of engine.py (round 4).  The strategy split mirrors
+sql3/planner's operator set (PlanOpPQLAggregate / PlanOpPQLGroupBy /
+PlanOpPQLDistinctScan / PlanOpPQLTableScan / opnestedloops.go) with
+the fan-out collapsed into the stacked device executor.
+"""
+
+from __future__ import annotations
+
+from pilosa_tpu.executor import DistinctValues
+from pilosa_tpu.models import FieldType
+from pilosa_tpu.pql.ast import Call, Condition
+from pilosa_tpu.sql import ast
+from pilosa_tpu.sql.common import (
+    SQLResult,
+    distinct_key,
+    is_ordinal,
+    limit_rows,
+    name_of,
+    order_rows,
+    ordinal_index,
+    sorted_nulls_last,
+    sql_type_of,
+    to_sql_value,
+)
+from pilosa_tpu.sql.lexer import SQLError
+from pilosa_tpu.sql.wherec import col_name, has_filter
+
+
+class SelectExec:
+    """SELECT strategy bodies bound to one SQLEngine."""
+
+    def __init__(self, engine):
+        self.eng = engine
+
+    # -- validation -----------------------------------------------------
+
+    def reject_foreign_quals(self, stmt: ast.Select):
+        """Non-join selects must not reference other tables: a bogus
+        qualifier would otherwise silently resolve to the bare
+        name."""
+        def walk(e):
+            if isinstance(e, ast.Col):
+                if e.table is not None and e.table != stmt.table:
+                    raise SQLError(f"unknown table {e.table!r}")
+                return
+            if e is None or isinstance(e, (str, int, float, bool)):
+                return
+            for attr in ("left", "right", "expr", "col", "arg"):
+                sub = getattr(e, attr, None)
+                if sub is not None:
+                    walk(sub)
+        for it in stmt.items:
+            walk(it.expr)
+        walk(stmt.where)
+        walk(stmt.having)
+        for ob in stmt.order_by:
+            walk(ob.expr)
+
+    # -- type resolution ------------------------------------------------
+
+    def expr_type(self, idx, e) -> str:
+        """Result SQL type of a scalar expression (the reference sets
+        ResultDataType during analysis,
+        expressionanalyzercall.go)."""
+        from pilosa_tpu.sql.funcs import FUNC_TYPES
+        eng = self.eng
+        if isinstance(e, ast.Lit):
+            v = e.value
+            if isinstance(v, bool):
+                return "bool"
+            if isinstance(v, int):
+                return "int"
+            if v is None or isinstance(v, str):
+                return "string"
+            return "decimal"
+        if isinstance(e, ast.Col):
+            if e.name == "_id":
+                return "string" if idx.keys else "id"
+            return sql_type_of(eng._field(idx, e.name))
+        if isinstance(e, ast.Func):
+            if e.name == "CAST" and len(e.args) == 3 and \
+                    isinstance(e.args[1], ast.Lit):
+                return e.args[1].value
+            if e.name in eng._udf_types():
+                return eng._udf_types()[e.name]
+            return FUNC_TYPES.get(e.name, "string")
+        if isinstance(e, ast.BinOp):
+            if e.op == "||":
+                return "string"
+            if e.op in ("+", "-", "*", "/", "%"):
+                lt = self.expr_type(idx, e.left)
+                rt = self.expr_type(idx, e.right)
+                return "decimal" if "decimal" in (lt, rt) else "int"
+            return "bool"
+        return "bool"  # Not/IsNull/InList/Between
+
+    def agg_type(self, idx, a: ast.Agg) -> str:
+        if a.func == "count":
+            return "int"
+        if a.func in ("avg", "var", "corr"):
+            return "decimal"
+        f = self.eng._field(idx, a.arg.name)
+        return sql_type_of(f)
+
+    # -- aggregates -----------------------------------------------------
+
+    def select_aggregates(self, idx, stmt, items, filt) -> SQLResult:
+        row_vals, schema = [], []
+        for it in items:
+            a: ast.Agg = it.expr
+            schema.append((name_of(it), self.agg_type(idx, a)))
+            row_vals.append(self.eval_agg(idx, a, filt))
+        return SQLResult(schema=schema, rows=[tuple(row_vals)])
+
+    def eval_agg(self, idx, a: ast.Agg, filt: Call):
+        eng = self.eng
+        ex = eng.executor
+        hasf = has_filter(filt)
+        fchildren = [filt] if hasf else []
+        if a.func == "count" and a.arg is None:
+            return ex._execute_call(idx, Call(
+                "Count", children=[filt]), None)
+        if a.func == "count" and a.distinct:
+            res = ex._execute_call(idx, Call(
+                "Distinct", args={"_field": a.arg.name},
+                children=fchildren), None)
+            return len(res.values) if isinstance(res, DistinctValues) \
+                else res.count()
+        if a.func == "count":
+            # non-null count of the column
+            f = eng._field(idx, a.arg.name)
+            if f.options.type.is_bsi:
+                nn = Call("Row",
+                          args={a.arg.name: Condition("!=", None)})
+            else:
+                nn = Call("UnionRows", children=[
+                    Call("Rows", args={"_field": a.arg.name})])
+            tree = Call("Intersect", children=[filt, nn]) if hasf else nn
+            return ex._execute_call(idx, Call("Count", children=[tree]),
+                                    None)
+        if a.func in ("sum", "min", "max", "avg"):
+            call_name = {"sum": "Sum", "min": "Min", "max": "Max",
+                         "avg": "Sum"}[a.func]
+            res = ex._execute_call(idx, Call(
+                call_name, args={"_field": a.arg.name},
+                children=fchildren), None)
+            if a.func == "avg":
+                return res.value / res.count if res.count else None
+            return res.value
+        if a.func == "percentile":
+            args = {"_field": a.arg.name, "nth": a.extra}
+            if hasf:
+                args["filter"] = filt
+            res = ex._execute_call(idx, Call("Percentile", args=args),
+                                   None)
+            return res.value if res is not None else None
+        if a.func in ("var", "corr"):
+            return self.eval_var_corr(idx, a, filt)
+        raise SQLError(f"unsupported aggregate {a.func}")
+
+    def eval_var_corr(self, idx, a: ast.Agg, filt: Call):
+        """VAR(x): population variance; CORR(x, y): Pearson
+        correlation — both buffer the matching values like the
+        reference's aggregateVar/aggregateCorr (expressionagg.go:949,
+        1197) and return decimals at scale 6."""
+        from decimal import Decimal
+        eng = self.eng
+        if a.arg is None:
+            raise SQLError(f"{a.func} requires a column argument")
+        names = [a.arg.name]
+        if a.func == "corr":
+            names.append(col_name(a.extra))
+        for n in names:
+            f = eng._field(idx, n)
+            if f.options.type not in (FieldType.INT, FieldType.DECIMAL):
+                raise SQLError(f"{a.func} requires a numeric column")
+        c = Call("Extract", children=[filt] + [
+            Call("Rows", args={"_field": n}) for n in names])
+        table = eng.executor._execute_call(idx, c, None)
+        cols = [[], []]
+        for entry in table.columns:
+            vals = [entry["rows"][i] for i in range(len(names))]
+            if any(v is None for v in vals):
+                continue  # reference skips nil rows
+            for i, v in enumerate(vals):
+                cols[i].append(float(v))
+        xs = cols[0]
+        n = len(xs)
+        if n == 0:
+            return None
+        if a.func == "var":
+            mean = sum(xs) / n
+            var = sum((v - mean) ** 2 for v in xs) / n
+            return Decimal(f"{var:.6f}")
+        ys = cols[1]
+        sx, sy = sum(xs), sum(ys)
+        sxy = sum(x * y for x, y in zip(xs, ys))
+        sxx, syy = sum(x * x for x in xs), sum(y * y for y in ys)
+        # float rounding can push a variance term slightly negative
+        # for near-constant data; clamp so the sqrt stays real
+        vx = max(n * sxx - sx * sx, 0.0)
+        vy = max(n * syy - sy * sy, 0.0)
+        denom = (vx * vy) ** 0.5
+        if denom == 0:
+            return None
+        return Decimal(f"{(n * sxy - sx * sy) / denom:.6f}")
+
+    # -- GROUP BY -------------------------------------------------------
+
+    def select_grouped(self, idx, stmt, items, filt) -> SQLResult:
+        eng = self.eng
+        group_cols = stmt.group_by
+        # validate items: group cols or aggregates
+        schema, getters = [], []
+        sum_field = None
+        for it in items:
+            e = it.expr
+            if isinstance(e, ast.Col):
+                if e.name not in group_cols:
+                    raise SQLError(
+                        f"column {e.name} must appear in GROUP BY")
+                gi = group_cols.index(e.name)
+                f = eng._field(idx, e.name)
+                schema.append((name_of(it),
+                               "string" if f.options.keys else "id"))
+                getters.append(("group", gi))
+            elif isinstance(e, ast.Agg):
+                if e.func == "count" and e.arg is None:
+                    schema.append((name_of(it), "int"))
+                    getters.append(("count", None))
+                elif e.func in ("sum", "avg"):
+                    if sum_field is None:
+                        sum_field = e.arg.name
+                    elif sum_field != e.arg.name:
+                        raise SQLError(
+                            "only one SUM column per grouped query")
+                    schema.append((name_of(it), self.agg_type(idx, e)))
+                    getters.append((e.func, None))
+                else:
+                    raise SQLError(
+                        f"aggregate {e.func} not supported with "
+                        "GROUP BY")
+            else:
+                raise SQLError("invalid GROUP BY projection")
+        args = {}
+        if has_filter(filt):
+            args["filter"] = filt
+        if sum_field is not None:
+            args["aggregate"] = Call("Sum", args={"_field": sum_field})
+        having = stmt.having
+        if having is not None:
+            args["having"] = self.compile_having(having)
+        call = Call("GroupBy", args=args, children=[
+            Call("Rows", args={"_field": g}) for g in group_cols])
+        groups = eng.executor._execute_call(idx, call, None)
+        rows = []
+        for g in groups:
+            vals = []
+            for kind, gi in getters:
+                if kind == "group":
+                    ge = g.group[gi]
+                    vals.append(ge.get("row_key", ge["row_id"]))
+                elif kind == "count":
+                    vals.append(g.count)
+                elif kind == "sum":
+                    # SUM over only NULLs is NULL, not 0
+                    vals.append(g.agg if g.agg_count else None)
+                elif kind == "avg":
+                    vals.append(g.agg / g.agg_count if g.agg_count
+                                else None)
+            rows.append(tuple(vals))
+        rows = order_rows(stmt, schema, rows)
+        rows = limit_rows(stmt, rows)
+        return SQLResult(schema=schema, rows=rows)
+
+    def select_grouped_generic(self, idx, stmt, items,
+                               filt) -> SQLResult:
+        """Hashed GROUP BY over materialized record values — the
+        fallback when a group column is BSI (sql3 planner's generic
+        PlanOpGroupBy instead of the PQL GroupBy pushdown)."""
+        eng = self.eng
+        group_cols = stmt.group_by
+        if not eng.executor.supports_local_cells:
+            raise SQLError(
+                "GROUP BY on int/decimal/timestamp columns is not "
+                "supported on the DAX queryer yet")
+        schema, getters = [], []
+        agg_specs = []  # (func, col or None)
+        for it in items:
+            e = it.expr
+            if isinstance(e, ast.Col):
+                if e.name not in group_cols:
+                    raise SQLError(
+                        f"column {e.name} must appear in GROUP BY")
+                f = eng._field(idx, e.name)
+                schema.append((name_of(it), sql_type_of(f)))
+                getters.append(("group", group_cols.index(e.name)))
+            elif isinstance(e, ast.Agg):
+                if e.func == "count" and e.arg is None:
+                    schema.append((name_of(it), "int"))
+                    getters.append(("agg", len(agg_specs)))
+                    agg_specs.append(("count*", None))
+                elif e.func in ("count", "sum", "avg", "min", "max"):
+                    schema.append((name_of(it), self.agg_type(idx, e)))
+                    getters.append(("agg", len(agg_specs)))
+                    agg_specs.append((e.func, e.arg.name))
+                else:
+                    raise SQLError(
+                        f"aggregate {e.func} not supported with "
+                        "GROUP BY")
+            else:
+                raise SQLError("invalid GROUP BY projection")
+
+        groups: dict[tuple, list] = {}
+        for rid in self.table_ids(idx, filt):
+            key = tuple(self.group_key(idx, g, rid) for g in group_cols)
+            groups.setdefault(key, []).append(rid)
+
+        rows = []
+        for key, rids in groups.items():
+            agg_vals = []
+            for func, col in agg_specs:
+                if func == "count*":
+                    agg_vals.append(len(rids))
+                    continue
+                vals = [self.cell_value(idx, col, r) for r in rids]
+                vals = [v for v in vals if v is not None]
+                if func == "count":
+                    agg_vals.append(len(vals))
+                elif not vals:
+                    agg_vals.append(None)
+                elif func == "sum":
+                    agg_vals.append(sum(vals))
+                elif func == "avg":
+                    agg_vals.append(sum(vals) / len(vals))
+                elif func == "min":
+                    agg_vals.append(min(vals))
+                elif func == "max":
+                    agg_vals.append(max(vals))
+            if stmt.having is not None and not self.generic_having_ok(
+                    stmt.having, len(rids), agg_specs, agg_vals):
+                continue
+            out = []
+            for kind, i in getters:
+                out.append(key[i] if kind == "group" else agg_vals[i])
+            rows.append(tuple(out))
+        rows = order_rows(stmt, schema, rows)
+        rows = limit_rows(stmt, rows)
+        return SQLResult(schema=schema, rows=rows)
+
+    def group_key(self, idx, col: str, rid: int):
+        v = self.cell_value(idx, col, rid)
+        return tuple(sorted(v)) if isinstance(v, list) else v
+
+    def generic_having_ok(self, having, count, agg_specs, agg_vals):
+        if not (isinstance(having, ast.BinOp)
+                and isinstance(having.left, ast.Agg)
+                and isinstance(having.right, ast.Lit)):
+            raise SQLError(
+                "HAVING supports COUNT(*)/SUM(col) comparisons")
+        a = having.left
+        if a.func == "count" and a.arg is None:
+            val = count
+        else:
+            for i, (func, col) in enumerate(agg_specs):
+                if func == a.func and col == (a.arg.name if a.arg
+                                              else None):
+                    val = agg_vals[i]
+                    break
+            else:
+                raise SQLError(
+                    "HAVING aggregate must appear in the projection")
+        if val is None:
+            return False
+        import operator
+        ops = {"=": operator.eq, "!=": operator.ne, "<": operator.lt,
+               "<=": operator.le, ">": operator.gt, ">=": operator.ge}
+        if having.op not in ops:
+            raise SQLError(f"HAVING operator {having.op!r} unsupported")
+        return ops[having.op](val, having.right.value)
+
+    def compile_having(self, having) -> Call:
+        # HAVING COUNT(*) > n / SUM(col) > n → Condition(count/sum OP n)
+        if isinstance(having, ast.BinOp) and \
+                isinstance(having.left, ast.Agg):
+            a = having.left
+            key = "count" if a.func == "count" else "sum"
+            if not isinstance(having.right, ast.Lit):
+                raise SQLError("HAVING requires a literal bound")
+            op = {"=": "=="}.get(having.op, having.op)
+            return Call("Condition",
+                        args={key: Condition(op, having.right.value)})
+        raise SQLError("HAVING supports COUNT(*)/SUM(col) comparisons")
+
+    # -- DISTINCT scan --------------------------------------------------
+
+    def select_distinct(self, idx, stmt, item, filt) -> SQLResult:
+        eng = self.eng
+        name = item.expr.name
+        f = eng._field(idx, name)
+        res = eng.executor._execute_call(idx, Call(
+            "Distinct", args={"_field": name},
+            children=[filt] if has_filter(filt) else []), None)
+        if isinstance(res, DistinctValues):
+            values = res.values
+        else:
+            values = res.columns().tolist()
+            if f.options.keys:
+                values = f.row_translator.translate_ids(values)
+        rows = [(to_sql_value(v),) for v in values]
+        schema = [(name_of(item), sql_type_of(f))]
+        rows = order_rows(stmt, schema, rows)
+        rows = limit_rows(stmt, rows)
+        return SQLResult(schema=schema, rows=rows)
+
+    # -- row extraction -------------------------------------------------
+
+    def select_rows(self, idx, stmt, items, filt) -> SQLResult:
+        from pilosa_tpu.sql.funcs import Evaluator, columns_in
+        eng = self.eng
+        wc = eng.wherec
+        items = [ast.SelectItem(wc.fold_subqueries(it.expr), it.alias)
+                 for it in items]
+        # classify projections: plain columns ride the Extract
+        # directly; scalar expressions evaluate row-wise over it
+        plans = []   # ("id",) | ("col", name) | ("expr", e)
+        ref_cols: set[str] = set()
+        for it in items:
+            e = it.expr
+            if isinstance(e, ast.Col):
+                if e.name == "_id":
+                    plans.append(("id",))
+                else:
+                    eng._field(idx, e.name)
+                    ref_cols.add(e.name)
+                    plans.append(("col", e.name))
+            else:
+                for n in columns_in(e):
+                    if n != "_id":
+                        eng._field(idx, n)
+                        ref_cols.add(n)
+                plans.append(("expr", e))
+        non_id = sorted(ref_cols)
+        names = [name_of(it) for it in items]
+        order_col = None
+        order_expr = None  # non-column ORDER BY key (host-evaluated)
+        multi_order = stmt.order_by and len(stmt.order_by) > 1
+        if multi_order:
+            # multi-key: materialize unordered, then host-sort with
+            # every key.  Keys need not be projected (defs_orderby's
+            # `order by foo asc, a_decimal asc`): unprojected sort
+            # columns ride the Extract, and exprs/ordinals/aliases
+            # evaluate per row.  LIMIT stays host-side (after sort).
+            for ob in stmt.order_by:
+                e = ob.expr
+                if isinstance(e, ast.Col) and e.name != "_id" and \
+                        idx.field(e.name) is not None:
+                    ref_cols.add(e.name)
+                elif not isinstance(e, (ast.Col, ast.Lit)):
+                    for n2 in columns_in(wc.fold_subqueries(e)):
+                        if n2 != "_id":
+                            eng._field(idx, n2)
+                            ref_cols.add(n2)
+            non_id = sorted(ref_cols)
+        order_ordinal = None  # ORDER BY <n> (1-based projection index)
+        if not multi_order and stmt.order_by:
+            ob = stmt.order_by[0]
+            if isinstance(ob.expr, ast.Col):
+                order_col = ob.expr.name
+            elif is_ordinal(ob.expr):
+                order_ordinal = ordinal_index(ob.expr.value, len(items))
+            else:
+                order_expr = wc.fold_subqueries(ob.expr)
+                for n in columns_in(order_expr):
+                    if n != "_id":
+                        eng._field(idx, n)
+                        ref_cols.add(n)
+                non_id = sorted(ref_cols)
+        # pushdown: ORDER BY on BSI column → Sort; plain LIMIT →
+        # Limit.  LIMIT must stay host-side under DISTINCT (dedup
+        # shrinks the row set, so a pushed limit would under-return).
+        inner = filt
+        host_sort = False
+        order_alias = None  # ORDER BY a projected alias / output name
+        null_tail = None  # rows where the BSI sort column is NULL
+        if order_expr is not None:
+            host_sort = True
+        elif order_ordinal is not None:
+            order_alias = order_ordinal
+            host_sort = True
+        elif order_col is not None and order_col != "_id" and \
+                idx.field(order_col) is None and order_col in names:
+            order_alias = names.index(order_col)
+            host_sort = True
+        elif order_col is not None and order_col != "_id":
+            f = eng._field(idx, order_col)
+            if f.options.type.is_bsi:
+                args = {"_field": order_col}
+                if stmt.order_by[0].desc:
+                    args["sort-desc"] = True
+                if stmt.limit is not None and not stmt.distinct:
+                    args["limit"] = stmt.limit + (stmt.offset or 0)
+                inner = Call("Sort", args=args, children=[filt])
+                # Sort yields only rows holding a value; NULL-valued
+                # rows are appended after (NULLS LAST)
+                nf = Call("Row",
+                          args={order_col: Condition("==", None)})
+                null_tail = Call("Intersect", children=[filt, nf]) \
+                    if has_filter(filt) else nf
+            else:
+                host_sort = True
+        elif order_col == "_id":
+            host_sort = stmt.order_by[0].desc  # asc is natural order
+        if not host_sort and not multi_order and order_col is None \
+                and stmt.limit is not None and not stmt.distinct:
+            inner = Call("Limit", args={
+                "limit": stmt.limit + (stmt.offset or 0)},
+                children=[filt])
+
+        extract_cols = list(non_id)
+        if host_sort and order_expr is None and order_alias is None \
+                and order_col != "_id" and order_col not in extract_cols:
+            extract_cols.append(order_col)  # fetched for sorting only
+        # multi-key ORDER BY: resolve every key to a per-row getter
+        # BEFORE executing anything, so a bad reference errors without
+        # paying for the scan.  Plans: ("ord" projection index | "id"
+        # | "col" extracted name | "alias" projection index | "expr"
+        # folded scalar)
+        mord = []
+        if multi_order:
+            for ob in stmt.order_by:
+                e = ob.expr
+                if is_ordinal(e):
+                    mord.append(
+                        ("ord", ordinal_index(e.value, len(items))))
+                elif isinstance(e, ast.Col) and e.name == "_id":
+                    mord.append(("id", None))
+                elif isinstance(e, ast.Col) and \
+                        idx.field(e.name) is not None:
+                    mord.append(("col", e.name))
+                elif isinstance(e, ast.Col):
+                    if e.name not in names:
+                        raise SQLError(
+                            f"ORDER BY column {e.name!r} not found")
+                    mord.append(("alias", names.index(e.name)))
+                else:
+                    mord.append(("expr", wc.fold_subqueries(e)))
+
+        def run_extract(src):
+            c = Call("Extract", children=[src] + [
+                Call("Rows", args={"_field": n}) for n in extract_cols])
+            return eng.executor._execute_call(idx, c, None)
+
+        table = run_extract(inner)
+        need_nulls = null_tail is not None and (
+            stmt.limit is None or stmt.distinct or
+            len(table.columns) < stmt.limit + (stmt.offset or 0))
+        if need_nulls:
+            table.columns.extend(run_extract(null_tail).columns)
+
+        schema = []
+        for it, plan in zip(items, plans):
+            if plan[0] == "id":
+                schema.append((name_of(it),
+                               "string" if idx.keys else "id"))
+            elif plan[0] == "col":
+                schema.append((name_of(it),
+                               sql_type_of(eng._field(idx, plan[1]))))
+            else:
+                schema.append((name_of(it),
+                               self.expr_type(idx, plan[1])))
+        ev = Evaluator(udfs=eng._udf_callables())
+        need_env = (order_expr is not None
+                    or any(p[0] == "expr" for p in plans)
+                    or any(k == "expr" for k, _a in mord))
+        rows = []
+        sort_keys = []
+        mkeys = []
+        for entry in table.columns:
+            env = None
+            if need_env:
+                env = {n: to_sql_value(entry["rows"][i])
+                       for i, n in enumerate(extract_cols)}
+                env["_id"] = entry.get("column_key", entry["column"])
+            vals = []
+            for plan in plans:
+                if plan[0] == "id":
+                    vals.append(entry.get("column_key",
+                                          entry["column"]))
+                elif plan[0] == "col":
+                    vals.append(to_sql_value(
+                        entry["rows"][extract_cols.index(plan[1])]))
+                else:
+                    vals.append(to_sql_value(ev.eval(plan[1], env)))
+            rows.append(tuple(vals))
+            if host_sort:
+                if order_expr is not None:
+                    k = ev.eval(order_expr, env)
+                elif order_alias is not None:
+                    k = vals[order_alias]
+                elif order_col == "_id":
+                    k = entry.get("column_key", entry["column"])
+                else:
+                    k = entry["rows"][extract_cols.index(order_col)]
+                if isinstance(k, list):  # set column: sort by first
+                    k = sorted(k)[0] if k else None
+                sort_keys.append(k)
+            if multi_order:
+                mk = []
+                for kind, arg in mord:
+                    if kind == "ord" or kind == "alias":
+                        k = vals[arg]
+                    elif kind == "id":
+                        k = entry.get("column_key", entry["column"])
+                    elif kind == "col":
+                        k = entry["rows"][extract_cols.index(arg)]
+                    else:
+                        k = ev.eval(arg, env)
+                    if isinstance(k, list):
+                        k = sorted(k)[0] if k else None
+                    mk.append(k)
+                mkeys.append(mk)
+        if host_sort:
+            order = sorted_nulls_last(
+                range(len(rows)), lambda i: sort_keys[i],
+                stmt.order_by[0].desc)
+            rows = [rows[i] for i in order]
+        if multi_order:
+            # stable sorts applied last-key-first, NULLS LAST per key
+            order = list(range(len(rows)))
+            for ki in reversed(range(len(mord))):
+                order = sorted_nulls_last(
+                    order, lambda i: mkeys[i][ki],
+                    stmt.order_by[ki].desc)
+            rows = [rows[i] for i in order]
+        if stmt.distinct:
+            # spill-backed dedup: in-memory set until the threshold,
+            # then the on-disk extendible hash (sql3 opdistinct over
+            # bufferpool/extendiblehash)
+            import os
+            import tempfile
+            from pilosa_tpu.storage.extendiblehash import SpillSet
+            fd, spill_path = tempfile.mkstemp(suffix=".distinct")
+            os.close(fd)  # mkstemp (not mktemp): no TOCTOU on the name
+            spill = SpillSet(spill_path)
+            try:
+                deduped = []
+                for r in rows:
+                    if spill.add(distinct_key(r)):
+                        deduped.append(r)
+                rows = deduped
+            finally:
+                spill.close()
+        rows = limit_rows(stmt, rows)
+        return SQLResult(schema=schema, rows=rows)
+
+    # -- FROM-less / views ----------------------------------------------
+
+    def select_const(self, stmt: ast.Select) -> SQLResult:
+        """FROM-less constant SELECT (sql3 allows e.g.
+        `select cast(1 as bool)`): items evaluate once, no table."""
+        from pilosa_tpu.sql.funcs import Evaluator
+        eng = self.eng
+        if stmt.where is not None or stmt.group_by or stmt.joins or \
+                stmt.having is not None:
+            raise SQLError("constant SELECT takes projections only")
+        ev = Evaluator(udfs=eng._udf_callables())
+        schema, vals = [], []
+        for it in stmt.items:
+            e = eng.wherec.fold_subqueries(it.expr)
+            # eval first: a Col reference errors here, so expr_type
+            # (which only needs idx for Col lookups) runs idx-less
+            vals.append(to_sql_value(ev.eval(e, {})))
+            schema.append((name_of(it), self.expr_type(None, e)))
+        rows = limit_rows(stmt, [tuple(vals)])
+        return SQLResult(schema=schema, rows=rows)
+
+    def select_view(self, stmt: ast.Select) -> SQLResult:
+        """Query a stored view: re-execute its select, then apply the
+        outer projection / ORDER BY / LIMIT by result-column name.
+        Outer WHERE/GROUP BY/aggregates over views are not supported
+        (the reference's planner expands views generally; this subset
+        is documented)."""
+        eng = self.eng
+        if stmt.where is not None or stmt.group_by or stmt.joins or \
+                stmt.having is not None or stmt.distinct:
+            raise SQLError(
+                "views support projection/ORDER BY/LIMIT only")
+        inner = eng._views[stmt.table]
+        res = eng._select(inner)
+        names = [s[0] for s in res.schema]
+        cols: list[int] = []
+        for it in stmt.items:
+            e = it.expr
+            if isinstance(e, ast.Col) and e.name == "*":
+                cols.extend(range(len(names)))
+                continue
+            if not isinstance(e, ast.Col):
+                raise SQLError("view projections must be columns")
+            if e.name not in names:
+                raise SQLError(
+                    f"column {e.name!r} not in view {stmt.table}")
+            cols.append(names.index(e.name))
+        schema = [res.schema[i] for i in cols]
+        rows = [tuple(r[i] for i in cols) for r in res.rows]
+        rows = order_rows(stmt, schema, rows)
+        rows = limit_rows(stmt, rows)
+        return SQLResult(schema=schema, rows=rows)
+
+    # -- cell materialization (joins, generic GROUP BY) -----------------
+
+    def cell_value(self, idx, name: str, col_id: int):
+        """One column's value for one record id (join
+        materialization).  BSI fields -> typed value or None;
+        set-like -> row key/id (or sorted list when multiple); _id ->
+        the key (keyed tables) or the id, matching what SELECT
+        projects."""
+        eng = self.eng
+        if name == "_id":
+            if idx.keys and idx.column_translator is not None:
+                k = idx.column_translator.translate_ids([col_id])[0]
+                return k if k is not None else col_id
+            return col_id
+        f = eng._field(idx, name)
+        shard, scol = divmod(col_id, f.width)
+        if f.options.type.is_bsi:
+            v = f.views.get(f.bsi_view)
+            frag = v.fragment(shard) if v else None
+            if frag is None or not frag.contains(0, scol):
+                return None
+            mag = sum(1 << i for i in range(f.bit_depth)
+                      if frag.contains(2 + i, scol))
+            return f.int_to_value(
+                -mag if frag.contains(1, scol) else mag)
+        from pilosa_tpu.models.view import VIEW_STANDARD
+        view = f.views.get(VIEW_STANDARD)
+        frag = view.fragment(shard) if view else None
+        if frag is None:
+            return None
+        rows = [r for r in frag.row_ids if frag.contains(r, scol)]
+        if not rows:
+            return None
+        if f.options.type == FieldType.BOOL:
+            return rows[-1] == 1
+        if f.options.keys:
+            keys = f.row_translator.translate_ids(rows)
+            return keys[0] if len(keys) == 1 else sorted(keys)
+        return rows[0] if len(rows) == 1 else rows
+
+    def table_ids(self, idx, filt) -> list:
+        res = self.eng.executor._execute_call(idx, filt, None)
+        return [int(c) for c in res.columns()]
+
+    # -- JOIN (sql3 opnestedloops.go nested-loop join) ------------------
+
+    def select_join(self, stmt: ast.Select) -> SQLResult:
+        """Nested-loop INNER / LEFT OUTER JOIN of two tables on column
+        equality.  The right side builds a hash of join-key -> record
+        ids; left records probe it (the hashed refinement of
+        opnestedloops.go's loop; LEFT JOIN per opnestedloops.go's
+        outer variant: a left record with no key match survives once
+        with NULL right-side values, and WHERE evaluates AFTER the
+        join).  WHERE may reference either table's columns."""
+        eng = self.eng
+        if not eng.executor.supports_local_cells:
+            raise SQLError(
+                "JOIN is not supported on the DAX queryer yet")
+        if len(stmt.joins) != 1:
+            raise SQLError("a single JOIN is supported")
+        if stmt.group_by or stmt.having or stmt.distinct:
+            raise SQLError("JOIN with GROUP BY/HAVING/DISTINCT "
+                           "not supported yet")
+        join = stmt.joins[0]
+        lname, rname = stmt.table, join.table
+        if lname == rname:
+            raise SQLError("self-join requires table aliases "
+                           "(not supported)")
+        lidx, ridx = eng._index(lname), eng._index(rname)
+
+        def side_of(c: ast.Col) -> str:
+            if c.table is None:
+                raise SQLError("JOIN ON columns must be qualified "
+                               "(table.column)")
+            if c.table not in (lname, rname):
+                raise SQLError(f"unknown table in ON: {c.table}")
+            return c.table
+
+        jl, jr = join.left, join.right
+        if side_of(jl) == rname:
+            jl, jr = jr, jl
+        if side_of(jl) != lname or side_of(jr) != rname:
+            raise SQLError("JOIN ON must relate the two joined tables")
+
+        # projected columns; '*' expands to both tables' columns
+        items: list[tuple[str, str, str]] = []  # (out name, table, col)
+        for it in stmt.items:
+            e = it.expr
+            if isinstance(e, ast.Agg):
+                if e.func == "count" and e.arg is None:
+                    items.append((name_of(it), "", "count(*)"))
+                    continue
+                raise SQLError("JOIN supports only COUNT(*) aggregate")
+            if not isinstance(e, ast.Col):
+                raise SQLError("JOIN projections must be columns")
+            if e.name == "*":
+                items.append(("_id", lname, "_id"))
+                items += [(f.name, lname, f.name)
+                          for f in lidx.public_fields()]
+                items += [(f"{rname}._id", rname, "_id")]
+                items += [(f"{rname}.{f.name}", rname, f.name)
+                          for f in ridx.public_fields()]
+                continue
+            table = e.table or lname
+            if table not in (lname, rname):
+                raise SQLError(
+                    f"unknown table {table!r} in projection")
+            items.append((it.alias or (e.name if e.table is None else
+                                       f"{e.table}.{e.name}"),
+                          table, e.name))
+        if any(c == "count(*)" for _, _, c in items) and len(items) > 1:
+            raise SQLError(
+                "JOIN cannot mix COUNT(*) with other projections")
+
+        # WHERE: validate table qualifications up front; conditions
+        # evaluate on the joined row (qualified or left-default)
+        where = stmt.where
+
+        def walk(e):
+            if isinstance(e, ast.Col):
+                t = e.table or lname
+                if t not in (lname, rname):
+                    raise SQLError(f"unknown table {t!r} in WHERE")
+                return
+            for attr in ("left", "right", "expr", "col"):
+                sub = getattr(e, attr, None)
+                if sub is not None and not isinstance(
+                        sub, (str, int, float, bool)):
+                    walk(sub)
+        if where is not None:
+            walk(where)
+
+        all_call = Call("All")
+        left_ids = self.table_ids(lidx, all_call)
+        right_ids = self.table_ids(ridx, all_call)
+
+        # hash the right side by join-key value
+        rmap: dict = {}
+        for rid in right_ids:
+            v = self.cell_value(ridx, jr.name, rid)
+            if v is None:
+                continue
+            for key in (v if isinstance(v, list) else [v]):
+                rmap.setdefault(key, []).append(rid)
+
+        # memoize per (table, col, record): a left record matching k
+        # right rows would otherwise re-decode its cells k times
+        cell_cache: dict = {}
+
+        def cell(table, idx_, col, record_id):
+            if record_id is None:  # unmatched LEFT JOIN right side
+                return None
+            key = (table, col, record_id)
+            if key not in cell_cache:
+                cell_cache[key] = self.cell_value(idx_, col, record_id)
+            return cell_cache[key]
+
+        def joined_value(table, col, lid, rid):
+            if table == lname:
+                return cell(lname, lidx, col, lid)
+            return cell(rname, ridx, col, rid)
+
+        def where_ok(lid, rid):
+            if where is None:
+                return True
+            return bool(self.eval_join_expr(where, lname, rname,
+                                            lidx, ridx, lid, rid))
+
+        rows = []
+        count_only = items and items[0][2] == "count(*)" and \
+            len(items) == 1
+        n = 0
+        outer = join.outer
+
+        def emit(lid, rid):
+            nonlocal n
+            if count_only:
+                n += 1
+            else:
+                rows.append(tuple(joined_value(t, c, lid, rid)
+                                  for _, t, c in items))
+
+        for lid in left_ids:
+            lv = self.cell_value(lidx, jl.name, lid)
+            any_key_match = False
+            if lv is not None:
+                for key in (lv if isinstance(lv, list) else [lv]):
+                    for rid in rmap.get(key, ()):
+                        any_key_match = True
+                        if where_ok(lid, rid):
+                            emit(lid, rid)
+            if outer and not any_key_match and where_ok(lid, None):
+                emit(lid, None)
+        if count_only:
+            return SQLResult(schema=[(items[0][0], "int")],
+                             rows=[(n,)])
+        # typed schema: resolve each projected column's SQL type
+        schema = []
+        for name, t, c in items:
+            idx_ = lidx if t == lname else ridx
+            if c == "_id":
+                schema.append((name, "id"))
+            else:
+                schema.append((name,
+                               sql_type_of(eng._field(idx_, c))))
+        rows = order_rows(stmt, schema, rows)
+        rows = limit_rows(stmt, rows)
+        return SQLResult(schema=schema, rows=rows)
+
+    def eval_join_expr(self, e, lname, rname, lidx, ridx, lid, rid):
+        """Evaluate a WHERE expression over one joined row."""
+        if isinstance(e, ast.Lit):
+            return e.value
+        if isinstance(e, ast.Col):
+            t = e.table or lname
+            rec = lid if t == lname else rid
+            if rec is None:  # unmatched LEFT JOIN side
+                return None
+            return self.cell_value(lidx if t == lname else ridx,
+                                   e.name, rec)
+        ev = lambda x: self.eval_join_expr(x, lname, rname, lidx,
+                                           ridx, lid, rid)
+        if isinstance(e, ast.BinOp):
+            if e.op == "and":
+                return ev(e.left) and ev(e.right)
+            if e.op == "or":
+                return ev(e.left) or ev(e.right)
+            l, r = ev(e.left), ev(e.right)
+            if l is None or r is None:
+                return False
+            if e.op == "=":
+                return l == r
+            if e.op in ("!=", "<>"):
+                return l != r
+            if e.op not in ("<", "<=", ">", ">="):
+                raise SQLError(f"JOIN WHERE operator {e.op!r} "
+                               "not supported")
+            try:
+                return {"<": l < r, "<=": l <= r,
+                        ">": l > r, ">=": l >= r}[e.op]
+            except TypeError:
+                raise SQLError(
+                    f"cannot compare {type(l).__name__} with "
+                    f"{type(r).__name__} in JOIN WHERE")
+        if isinstance(e, ast.Not):
+            return not ev(e.expr)
+        if isinstance(e, ast.IsNull):
+            return (ev(e.col) is None) != e.negated
+        raise SQLError(f"unsupported WHERE form in JOIN: {e!r}")

@@ -1,0 +1,389 @@
+"""DDL + DML statement execution: CREATE/DROP/ALTER TABLE, SHOW,
+INSERT, BULK INSERT, DELETE, COPY, CREATE FUNCTION.
+
+Split out of engine.py (round 4).  Mirrors sql3/planner's
+compilecreatetable.go / compilealtertable.go / compileinsert.go /
+compilebulkinsert.go / compilecopy.go behavior on the TPU-native
+data model (Holder → Index → Field).
+"""
+
+from __future__ import annotations
+
+from pilosa_tpu.models import FieldOptions, FieldType, TimeQuantum
+from pilosa_tpu.pql.ast import Call
+from pilosa_tpu.sql import ast
+from pilosa_tpu.sql.common import SQLResult, sql_type_of
+from pilosa_tpu.sql.lexer import SQLError
+
+
+class StatementExec:
+    """DDL/DML executor bound to one SQLEngine."""
+
+    def __init__(self, engine):
+        self.eng = engine
+
+    # -- DDL ------------------------------------------------------------
+
+    def create_table(self, stmt: ast.CreateTable) -> SQLResult:
+        eng = self.eng
+        if stmt.name in eng._views:
+            raise SQLError(f"view exists: {stmt.name}")
+        if eng.holder.index(stmt.name) is not None:
+            if stmt.if_not_exists:
+                return SQLResult()
+            raise SQLError(f"table already exists: {stmt.name}")
+        # validate every column option before creating anything, so a
+        # bad column never leaves a half-created table behind
+        cols, seen = [], set()
+        for cd in stmt.columns:
+            if cd.name in seen:
+                raise SQLError(f"duplicate column name: {cd.name}")
+            seen.add(cd.name)
+            if cd.name == "_id":
+                continue
+            try:
+                cols.append((cd.name, self.field_options(cd)))
+            except ValueError as e:
+                raise SQLError(str(e)) from e
+        idx = eng.holder.create_index(stmt.name, keys=stmt.keys)
+        for name, opts in cols:
+            idx.create_field(name, opts)
+        eng.holder.save_schema()
+        return SQLResult()
+
+    def field_options(self, cd: ast.ColumnDef) -> FieldOptions:
+        t = cd.type
+        if t == "int":
+            return FieldOptions(type=FieldType.INT, min=cd.min,
+                                max=cd.max)
+        if t == "decimal":
+            return FieldOptions(type=FieldType.DECIMAL, scale=cd.scale)
+        if t == "timestamp":
+            return FieldOptions(type=FieldType.TIMESTAMP)
+        if t == "bool":
+            return FieldOptions(type=FieldType.BOOL)
+        if t == "id":
+            return FieldOptions(type=FieldType.MUTEX)
+        if t == "string":
+            return FieldOptions(type=FieldType.MUTEX, keys=True)
+        if t == "idset":
+            if cd.time_quantum:
+                return FieldOptions(
+                    type=FieldType.TIME,
+                    time_quantum=TimeQuantum(cd.time_quantum))
+            return FieldOptions(type=FieldType.SET)
+        if t == "stringset":
+            if cd.time_quantum:
+                return FieldOptions(
+                    type=FieldType.TIME,
+                    time_quantum=TimeQuantum(cd.time_quantum),
+                    keys=True)
+            return FieldOptions(type=FieldType.SET, keys=True)
+        raise SQLError(f"unknown column type {t!r}")
+
+    def drop_table(self, stmt: ast.DropTable) -> SQLResult:
+        eng = self.eng
+        if eng.holder.index(stmt.name) is None and not stmt.if_exists:
+            raise SQLError(f"table not found: {stmt.name}")
+        eng.holder.delete_index(stmt.name)
+        eng.holder.save_schema()
+        return SQLResult()
+
+    def show_columns(self, stmt: ast.ShowColumns) -> SQLResult:
+        idx = self.eng._index(stmt.table)
+        rows = [("_id", "string" if idx.keys else "id")]
+        rows += [(f.name, sql_type_of(f)) for f in idx.public_fields()]
+        return SQLResult(schema=[("name", "string"),
+                                 ("type", "string")], rows=rows)
+
+    def show_create_table(self, stmt: ast.ShowCreateTable) -> SQLResult:
+        """Canonical DDL round-trip: the emitted statement re-parses to
+        an equivalent table (sql3's SHOW CREATE TABLE)."""
+        idx = self.eng._index(stmt.table)
+        defs = [f"_id {'string' if idx.keys else 'id'}"]
+        for f in idx.public_fields():
+            t = sql_type_of(f)
+            d = f"{f.name} {t}"
+            o = f.options
+            if t == "decimal" and o.scale:
+                d += f"({o.scale})"
+            if t == "int":
+                if o.min is not None:
+                    d += f" min {o.min}"
+                if o.max is not None:
+                    d += f" max {o.max}"
+            if o.type == FieldType.TIME and o.time_quantum:
+                d += f" timequantum '{o.time_quantum}'"
+            defs.append(d)
+        ddl = f"CREATE TABLE {idx.name} ({', '.join(defs)})"
+        return SQLResult(schema=[("ddl", "string")], rows=[(ddl,)])
+
+    def alter_table(self, stmt: ast.AlterTable) -> SQLResult:
+        """ALTER TABLE ADD/DROP/RENAME COLUMN (sql3/planner/
+        compilealtertable.go)."""
+        eng = self.eng
+        idx = eng._index(stmt.table)
+        if stmt.op == "add":
+            cd = stmt.column
+            if cd.name == "_id":
+                raise SQLError("cannot add _id")
+            if idx.field(cd.name) is not None:
+                raise SQLError(f"column already exists: {cd.name}")
+            idx.create_field(cd.name, self.field_options(cd))
+        elif stmt.op == "drop":
+            if stmt.name == "_id":
+                raise SQLError("cannot drop _id")
+            if idx.field(stmt.name) is None:
+                raise SQLError(f"column not found: {stmt.name}")
+            idx.delete_field(stmt.name)
+        else:  # rename
+            if "_id" in (stmt.name, stmt.new_name):
+                raise SQLError("cannot rename _id")
+            try:
+                idx.rename_field(stmt.name, stmt.new_name)
+            except ValueError as e:
+                raise SQLError(str(e)) from e
+        eng.holder.save_schema()
+        return SQLResult()
+
+    def copy(self, stmt: ast.Copy) -> SQLResult:
+        """COPY src TO dst (sql3 copy statement, defs_copy.go):
+        Index.clone_to owns the deep copy; a mid-copy failure never
+        strands a half-built table."""
+        eng = self.eng
+        if stmt.src in eng._views:
+            raise SQLError("COPY supports tables, not views")
+        src = eng.holder.index(stmt.src)
+        if src is None:
+            raise SQLError(f"table or view {stmt.src!r} not found")
+        if stmt.dst in eng._views or \
+                eng.holder.index(stmt.dst) is not None:
+            raise SQLError(f"table or view {stmt.dst!r} already exists")
+        dst = eng.holder.create_index(stmt.dst, keys=src.keys)
+        try:
+            src.clone_to(dst)
+        except Exception:
+            eng.holder.delete_index(stmt.dst)
+            raise
+        eng.holder.save_schema()
+        return SQLResult()
+
+    # -- DML ------------------------------------------------------------
+
+    def insert(self, stmt: ast.Insert) -> SQLResult:
+        eng = self.eng
+        idx = eng._index(stmt.table)
+        if "_id" not in stmt.columns:
+            raise SQLError("INSERT requires an _id column")
+        id_pos = stmt.columns.index("_id")
+        fields = []
+        for c in stmt.columns:
+            if c == "_id":
+                fields.append(None)
+                continue
+            f = idx.field(c)
+            if f is None:
+                raise SQLError(f"column not found: {c}")
+            fields.append(f)
+        for row in stmt.rows:
+            self.apply_record(idx, fields, row, id_pos, stmt.replace)
+        return SQLResult()
+
+    def apply_record(self, idx, fields, row, id_pos, replace):
+        """Write one record's values (shared by INSERT / BULK
+        INSERT)."""
+        eng = self.eng
+        col = eng._col_id(idx, row[id_pos])
+        if replace:
+            # full-record replace: drop existing values first
+            from pilosa_tpu.ops import bitmap as bm
+            shard, sc = divmod(col, idx.width)
+            mask = bm.from_columns([sc], idx.width)
+            for f in idx.fields.values():
+                for v in f.views.values():
+                    frag = v.fragment(shard)
+                    if frag is not None:
+                        frag.clear_columns(mask)
+        for f, v in zip(fields, row):
+            if f is None or v is None:
+                continue
+            t = f.options.type
+            if t.is_bsi:
+                f.set_value(col, v)
+            elif t == FieldType.BOOL:
+                f.set_bit(1 if v else 0, col)
+            else:
+                ts = None
+                if t == FieldType.TIME and isinstance(v, list) and \
+                        len(v) == 2 and \
+                        isinstance(v[0], (str, int)) and \
+                        not isinstance(v[0], bool) and \
+                        isinstance(v[1], list):
+                    # quantum tuple ('<timestamp>', (vals...)) —
+                    # opinsert.go:275's 2-member time-quantum form
+                    from pilosa_tpu.models import timeq
+                    try:
+                        ts = timeq.parse_time(v[0])
+                    except ValueError:
+                        raise SQLError(
+                            f"column {f.name}: bad quantum timestamp "
+                            f"{v[0]!r}")
+                    v = v[1]
+                vals = v if isinstance(v, list) else [v]
+                if t == FieldType.MUTEX and len(vals) > 1:
+                    raise SQLError(
+                        f"column {f.name} accepts a single value")
+                for item in vals:
+                    f.set_bit(self.row_id(f, item, create=True), col,
+                              timestamp=ts)
+        idx.mark_columns_exist([col])
+
+    def bulk_insert(self, stmt: ast.BulkInsert) -> SQLResult:
+        """BULK INSERT: stream a CSV (file or inline payload) through
+        the same record-apply path as INSERT — the COPY/BULK INSERT
+        ingest statement (sql3/parser bulk insert, CSV subset).
+        Columns map positionally; empty cells are NULL; idset/
+        stringset cells may hold ';'-separated lists."""
+        idx = self.eng._index(stmt.table)
+        fields, id_pos = self.bulk_fields(idx, stmt.columns)
+        n = 0
+        for row in self.iter_bulk_rows(stmt, idx, fields):
+            self.apply_record(idx, fields, row, id_pos, replace=False)
+            n += 1
+        return SQLResult(schema=[("rows_inserted", "int")], rows=[(n,)])
+
+    def bulk_fields(self, idx, columns):
+        """Resolve BULK INSERT target fields (+ the _id position)."""
+        if "_id" not in columns:
+            raise SQLError("BULK INSERT requires an _id column")
+        id_pos = columns.index("_id")
+        fields = []
+        for c in columns:
+            if c == "_id":
+                fields.append(None)
+                continue
+            f = idx.field(c)
+            if f is None:
+                raise SQLError(f"column not found: {c}")
+            fields.append(f)
+        return fields, id_pos
+
+    def iter_bulk_rows(self, stmt, idx, fields):
+        """Yield type-converted rows from the CSV source — shared by
+        the local apply path and the DAX routed path."""
+        import csv
+        import io
+
+        id_pos = stmt.columns.index("_id")
+
+        def convert(f, text: str):
+            if text == "":
+                return None
+            if f is None:  # _id
+                return text if idx.keys else int(text)
+            t = f.options.type
+            if t == FieldType.INT or t == FieldType.TIMESTAMP:
+                return int(text) if t == FieldType.INT else text
+            if t == FieldType.DECIMAL:
+                from decimal import Decimal
+                return Decimal(text)
+            if t == FieldType.BOOL:
+                return text.strip().lower() in ("1", "true", "t", "yes")
+            if ";" in text:
+                items = text.split(";")
+                return [int(i) if not f.options.keys else i
+                        for i in items]
+            return text if f.options.keys else int(text)
+
+        if stmt.input == "FILE":
+            try:
+                fh = open(stmt.path, newline="")
+            except OSError as exc:
+                raise SQLError(
+                    f"BULK INSERT cannot read {stmt.path!r}: {exc}")
+        else:
+            fh = io.StringIO(stmt.payload or "")
+        with fh:
+            reader = csv.reader(fh)
+            for i, raw in enumerate(reader):
+                if i == 0 and stmt.header_row:
+                    continue
+                if not raw:
+                    continue
+                if len(raw) != len(stmt.columns):
+                    raise SQLError(
+                        f"CSV row {i + 1} has {len(raw)} fields, "
+                        f"expected {len(stmt.columns)}")
+                try:
+                    row = [convert(f, cell.strip())
+                           for f, cell in zip(fields, raw)]
+                except (ValueError, ArithmeticError) as exc:
+                    raise SQLError(
+                        f"CSV row {i + 1}: bad value ({exc})")
+                if row[id_pos] is None:
+                    raise SQLError(f"CSV row {i + 1} has empty _id")
+                yield row
+
+    def row_id(self, f, v, create=False):
+        if isinstance(v, str):
+            tr = f.row_translator
+            if tr is None:
+                raise SQLError(
+                    f"column {f.name} holds ids, got string {v!r}")
+            if create:
+                return tr.create_keys(v)[v]
+            return tr.find_keys(v).get(v)
+        if f.options.keys:
+            raise SQLError(f"column {f.name} uses keys; got id {v!r}")
+        return int(v)
+
+    def delete(self, stmt: ast.Delete) -> SQLResult:
+        eng = self.eng
+        idx = eng._index(stmt.table)
+        filt = eng.wherec.compile_where(idx, stmt.where)
+        eng.executor._execute_call(
+            idx, Call("Delete", children=[filt]), None)
+        return SQLResult()
+
+    # -- UDFs -----------------------------------------------------------
+
+    def create_function(self, stmt: ast.CreateFunction) -> SQLResult:
+        from pilosa_tpu.sql.funcs import _ARITY
+        eng = self.eng
+        name = stmt.name.upper()
+        if name in _ARITY:
+            raise SQLError(
+                f"cannot redefine built-in function {stmt.name}")
+        if name in eng._functions:
+            if stmt.if_not_exists:
+                return SQLResult()
+            raise SQLError(f"function already exists: {stmt.name}")
+        # body validation: parameters only (no table columns), calls
+        # only to builtins or PREVIOUSLY defined functions — combined
+        # with the captured-snapshot binding in engine._make_udf, a
+        # body can never reach itself
+        params = {p for p, _t in stmt.params}
+        if len(params) != len(stmt.params):
+            raise SQLError("duplicate parameter name")
+        captured: dict[str, tuple] = {}
+
+        def check(e):
+            if isinstance(e, ast.Col):
+                raise SQLError(
+                    "function bodies may reference only parameters")
+            if isinstance(e, ast.Var) and e.name not in params:
+                raise SQLError(f"unknown parameter @{e.name}")
+            if isinstance(e, ast.Func):
+                if e.name in eng._functions:
+                    captured[e.name] = eng._functions[e.name]
+                elif e.name not in _ARITY:
+                    raise SQLError(f"unknown function {e.name}")
+                for x in e.args:
+                    check(x)
+            for attr in ("left", "right", "expr", "col", "lo", "hi"):
+                sub = getattr(e, attr, None)
+                if sub is not None and not isinstance(sub, (str, int)):
+                    check(sub)
+        check(stmt.body)
+        eng._functions[name] = (stmt, captured)
+        return SQLResult()

@@ -872,6 +872,27 @@ CASES = [
     ("agg_var_empty_is_null",
      "SELECT var(qty) FROM orders WHERE qty > 999", [(None,)]),
 
+    # ---- TOP(n) (defs_top.go: TOP(n) == LIMIT n, conflict errors) -------
+    ("top_rows", "SELECT TOP(2) _id FROM orders ORDER BY _id",
+     ("ordered", [(1,), (2,)])),
+    ("top_equals_limit",
+     "SELECT TOP(1) count(*) FROM orders", [(6,)]),
+    ("top_with_groupby",
+     "SELECT TOP(10) region, count(*) FROM orders GROUP BY region",
+     [("west", 2), ("east", 2), ("north", 1), ("south", 1)]),
+    ("top_and_limit_conflict",
+     "SELECT TOP(1) count(*) FROM orders LIMIT 1",
+     ("error", "TOP and LIMIT")),
+    ("top_fractional_errors",
+     "SELECT TOP(2.5) _id FROM orders", ("error", "integer")),
+    ("limit_fractional_errors",
+     "SELECT _id FROM orders LIMIT 1.5", ("error", "integer")),
+    ("top_as_column_name",
+     # TOP not followed by '(' stays an ordinary projection position
+     "CREATE TABLE topt (_id id, qty int); "
+     "INSERT INTO topt (_id, qty) VALUES (1, 3); "
+     "SELECT TOP(1) qty FROM topt", [(3,)]),
+
     # ---- EXPLAIN --------------------------------------------------------
     ("explain_returns_plan_rows",
      "EXPLAIN SELECT count(*) FROM orders WHERE qty > 4",
