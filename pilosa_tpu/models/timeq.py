@@ -127,6 +127,18 @@ def parse_time(v) -> dt.datetime:
         return dt.datetime.fromtimestamp(int(v), tz=dt.timezone.utc).replace(
             tzinfo=None)
     s = str(v)
+    # RFC3339 forms: trailing Z / ±hh:mm offsets and fractional
+    # seconds normalize to naive UTC (time.go parses RFC3339; all
+    # engine timestamps are UTC-naive internally)
+    if "T" in s and (s.endswith("Z") or "+" in s[10:]
+                     or "-" in s[10:] or "." in s):
+        try:
+            d = dt.datetime.fromisoformat(s.replace("Z", "+00:00"))
+            if d.tzinfo is not None:
+                d = d.astimezone(dt.timezone.utc).replace(tzinfo=None)
+            return d
+        except ValueError:
+            pass
     for fmt in (TIME_FORMAT, "%Y-%m-%dT%H:%M:%S", "%Y-%m-%dT%H",
                 "%Y-%m-%d", "%Y-%m", "%Y"):
         try:

@@ -108,6 +108,7 @@ class Join:
     left: "Col"
     right: "Col"
     outer: bool = False
+    alias: str | None = None
 
 
 @dataclass
@@ -288,3 +289,5 @@ class Select:
     # (sql3/parser/parser.go:2376); kept for the TOP+LIMIT conflict
     # check
     top: int | None = None
+    # FROM table [AS] alias
+    table_alias: str | None = None

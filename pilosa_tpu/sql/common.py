@@ -106,9 +106,12 @@ def name_of(it: ast.SelectItem) -> str:
     return "expr"
 
 
-def order_rows(stmt, schema, rows):
+def order_rows(stmt, schema, rows, srcmap=None):
     """Multi-key ORDER BY over materialized rows: stable sorts applied
-    last-key-first, NULLS LAST within each key's direction."""
+    last-key-first, NULLS LAST within each key's direction.  `srcmap`
+    maps SOURCE column names to projection indexes for outputs
+    projected under an alias (`i1 AS c ... ORDER BY i1`,
+    defs_groupby)."""
     if not stmt.order_by:
         return rows
     names = [s[0] for s in schema]
@@ -130,6 +133,8 @@ def order_rows(stmt, schema, rows):
         matches = [i for i, n in enumerate(names)
                    if n == name or ("." not in name
                                     and n.split(".")[-1] == name)]
+        if not matches and srcmap and name in srcmap:
+            matches = [srcmap[name]]
         if len(matches) != 1:
             raise SQLError(
                 f"ORDER BY column {name!r} not in projection"
@@ -152,4 +157,9 @@ def limit_rows(stmt, rows):
 def to_sql_value(v):
     if isinstance(v, dt.datetime):
         return v.isoformat()
+    if isinstance(v, list) and not v:
+        # a set column with no members IS NULL (defs_null: `ids1 is
+        # null` is true for an empty set; defs_set: setcontains on it
+        # yields NULL)
+        return None
     return v

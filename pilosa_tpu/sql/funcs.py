@@ -284,8 +284,13 @@ def call_builtin(name: str, args: list):
             f"arguments, got {len(a)}")
 
     # NULL propagation (reference: every Evaluate* returns nil on a
-    # nil arg) — SET* handle their own nils (nil set = empty)
-    if not name.startswith("SETCONTAINS") and any(x is None for x in a):
+    # nil arg) — for SET* a NULL SET argument is NULL (defs_set
+    # setLiteralTests: setcontains(null-set, v) is NULL, not false),
+    # while a NULL member argument still probes the set
+    if name.startswith("SETCONTAINS"):
+        if a and a[0] is None:
+            return None
+    elif any(x is None for x in a):
         return None
 
     try:
@@ -524,6 +529,18 @@ class Evaluator:
             return None
         if op == "||":
             return _s(l, "||") + _s(r, "||")
+        if op in ("&", "|", "<<", ">>"):
+            li, ri = _i(l, op), _i(r, op)
+            if op == "&":
+                return li & ri
+            if op == "|":
+                return li | ri
+            if op in ("<<", ">>") and ri < 0:
+                raise SQLError(
+                    f"operator '{op}': negative shift count {ri}")
+            if op == "<<":
+                return li << ri
+            return li >> ri
         if op in ("+", "-", "*", "/", "%"):
             return _arith(op, l, r)
         if op == "like":
@@ -542,10 +559,15 @@ class Evaluator:
                 l = l.replace(tzinfo=dt.timezone.utc)
             else:
                 r = r.replace(tzinfo=dt.timezone.utc)
-        if op == "=":
-            return l == r
-        if op == "!=":
-            return l != r
+        if op in ("=", "!="):
+            # set columns compare as sets (defs_binops IDSet/StringSet
+            # equality); a scalar-decoded single-member set still
+            # equals its bracket-literal form
+            if isinstance(l, list) or isinstance(r, list):
+                ls = set(l) if isinstance(l, list) else {l}
+                rs = set(r) if isinstance(r, list) else {r}
+                return (ls == rs) if op == "=" else (ls != rs)
+            return (l == r) if op == "=" else (l != r)
         try:
             if op == "<":
                 return l < r
@@ -575,16 +597,45 @@ def _num(v, op):
     return v
 
 
+def _dec_scale(v) -> int:
+    return max(-v.as_tuple().exponent, 0) if isinstance(v, Decimal) \
+        else 0
+
+
 def _arith(op, l, r):
+    """Arithmetic with the reference's semantics (defs_binops.go):
+    int/int division truncates toward zero; any-decimal results
+    quantize to the max operand scale (20 / 12.34 -> 1.62 at
+    scale 2); zero divisors are analysis-style errors."""
+    from decimal import ROUND_DOWN
     l, r = _num(l, op), _num(r, op)
+    if r == 0 and op in ("/", "%"):
+        raise SQLError("divisor is equal to zero")
+    dec = isinstance(l, Decimal) or isinstance(r, Decimal)
+    if dec:
+        scale = max(_dec_scale(l), _dec_scale(r))
+        ld = l if isinstance(l, Decimal) else Decimal(l)
+        rd = r if isinstance(r, Decimal) else Decimal(r)
+        if op == "+":
+            out = ld + rd
+        elif op == "-":
+            out = ld - rd
+        elif op == "*":
+            out = ld * rd
+        elif op == "/":
+            out = ld / rd
+        else:
+            raise SQLError(
+                f"operator '%' incompatible with type "
+                f"'decimal({scale})'")
+        return out.quantize(Decimal(1).scaleb(-scale),
+                            rounding=ROUND_DOWN)
     if op == "+":
         return l + r
     if op == "-":
         return l - r
     if op == "*":
         return l * r
-    if r == 0 and op in ("/", "%"):
-        raise SQLError("division by zero")
     if op == "/":
         if isinstance(l, int) and isinstance(r, int):
             q = abs(l) // abs(r)  # Go-style trunc-toward-zero

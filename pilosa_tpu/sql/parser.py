@@ -288,13 +288,17 @@ class Parser:
                 replace = True
             self.expect_kw("into")
         table = self.expect("ident").value
-        cols = []
-        self.expect("op", "(")
-        while True:
-            cols.append(self.expect("ident").value)
-            if not self.accept("op", ","):
-                break
-        self.expect("op", ")")
+        # the column list is optional: INSERT INTO t VALUES (...) maps
+        # positionally to _id + fields in schema order
+        # (defs_delete.go's bare inserts)
+        cols = None
+        if self.accept("op", "("):
+            cols = []
+            while True:
+                cols.append(self.expect("ident").value)
+                if not self.accept("op", ","):
+                    break
+            self.expect("op", ")")
         self.expect_kw("values")
         rows = []
         while True:
@@ -305,7 +309,7 @@ class Parser:
                 if not self.accept("op", ","):
                     break
             self.expect("op", ")")
-            if len(row) != len(cols):
+            if cols is not None and len(row) != len(cols):
                 raise SQLError("VALUES arity mismatch")
             rows.append(row)
             if not self.accept("op", ","):
@@ -393,14 +397,16 @@ class Parser:
         has_from = bool(self.kw("from"))
         if has_from:
             sel.table = self.expect("ident").value
+            sel.table_alias = self._table_alias()
         while has_from:
             outer = False
 
-            def _at_left_join() -> bool:
-                # LEFT [OUTER] JOIN with left/outer as contextual
-                # keywords (still valid identifiers elsewhere)
+            def _at_ctx_join(word: str) -> bool:
+                # LEFT/FULL/RIGHT [OUTER] JOIN with the qualifier as a
+                # contextual keyword (still a valid identifier
+                # elsewhere)
                 t0, t1, t2 = self.peek(), self.peek(1), self.peek(2)
-                if not (t0.kind == "ident" and t0.value.lower() == "left"):
+                if not (t0.kind == "ident" and t0.value.lower() == word):
                     return False
                 if t1.kind == "keyword" and t1.value == "join":
                     return True
@@ -409,14 +415,19 @@ class Parser:
 
             if self.kw("inner"):
                 self.expect_kw("join")
-            elif _at_left_join():
+            elif _at_ctx_join("left"):
                 self.next()  # left
                 self.ctx_kw("outer")
                 self.expect_kw("join")
                 outer = True
+            elif _at_ctx_join("full") or _at_ctx_join("right"):
+                # parsed so the analysis error matches defs_join.go
+                kind = self.next().value.upper()
+                raise SQLError(f"{kind} join types are not supported")
             elif not self.kw("join"):
                 break
             jt = self.expect("ident").value
+            alias = self._table_alias()
             self.expect_kw("on")
             cond = self.expr()
             if not (isinstance(cond, ast.BinOp) and cond.op == "="
@@ -425,13 +436,16 @@ class Parser:
                 raise SQLError(
                     "JOIN ON must be column = column equality")
             sel.joins.append(ast.Join(jt, cond.left, cond.right,
-                                      outer=outer))
+                                      outer=outer, alias=alias))
         if self.kw("where"):
             sel.where = self.expr()
         if self.kw("group"):
             self.expect_kw("by")
             while True:
-                sel.group_by.append(self.expect("ident").value)
+                g = self.expect("ident").value
+                if self.accept("op", "."):
+                    g += "." + self.expect("ident").value
+                sel.group_by.append(g)
                 if not self.accept("op", ","):
                     break
         if self.kw("having"):
@@ -461,6 +475,19 @@ class Parser:
             sel.limit = sel.top
         return sel
 
+    # reserved words that must not be eaten as a bare table alias
+    _NO_ALIAS = {"left", "outer", "full", "right", "cross", "copy"}
+
+    def _table_alias(self) -> str | None:
+        """Optional table alias: AS name or a bare identifier
+        (sql3/parser tableOrSubquery aliases)."""
+        if self.kw("as"):
+            return self.expect("ident").value
+        t = self.peek()
+        if t.kind == "ident" and t.value.lower() not in self._NO_ALIAS:
+            return self.next().value
+        return None
+
     # -- expressions ----------------------------------------------------
 
     def expr(self):
@@ -484,14 +511,14 @@ class Parser:
         return self.cmp_expr()
 
     def cmp_expr(self):
-        left = self.add_expr()
+        left = self.bit_expr()
         t = self.peek()
         if t.kind == "op" and t.value in ("=", "!=", "<>", "<", "<=", ">",
                                           ">="):
             op = self.next().value
             if op == "<>":
                 op = "!="
-            return ast.BinOp(op, left, self.add_expr())
+            return ast.BinOp(op, left, self.bit_expr())
         if t.kind == "keyword":
             negated = False
             if t.value == "not":
@@ -530,6 +557,19 @@ class Parser:
                 self.expect_kw("null")
                 return ast.IsNull(left, negated=negated)
         return left
+
+    def bit_expr(self):
+        """<< >> & | — one level, left-assoc, binding tighter than
+        comparison and looser than + - (the SQLite-style placement
+        sql3/parser follows)."""
+        left = self.add_expr()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("<<", ">>", "&", "|"):
+                op = self.next().value
+                left = ast.BinOp(op, left, self.add_expr())
+            else:
+                return left
 
     def add_expr(self):
         """+ - and || (string concat) — the additive precedence level
@@ -595,8 +635,15 @@ class Parser:
             self.next()
             return ast.Lit({"true": True, "false": False,
                             "null": None}[t.value])
+        if t.kind == "op" and t.value == "[":
+            # bracket set literal as an expression ([1,2] IN lists,
+            # SETCONTAINS args); elements must be literals
+            return ast.Lit(self.literal_value())
         if t.kind == "var":
             return ast.Var(self.next().value)
+        if t.kind == "ident" and t.value.lower() in (
+                "current_timestamp", "current_date"):
+            return ast.Lit(self.literal_value())
         if t.kind == "ident":
             name = self.next().value
             if self.peek().kind == "op" and self.peek().value == "(":
@@ -643,9 +690,16 @@ class Parser:
         self.expect("op", "(")
         distinct = bool(self.kw("distinct"))
         if self.accept("op", "*"):
+            # only COUNT takes '*' (defs_aggregate: sum(*)/avg(*)/
+            # min(*) are analysis errors)
+            if func != "count":
+                raise SQLError(
+                    f"{func}: column reference expected, got '*'")
             arg = None
         else:
-            arg = ast.Col(self.expect("ident").value)
+            # aggregates accept arbitrary scalar expressions
+            # (defs_aggregate: sum(d1 + 5), avg(len(s1)), sum(1))
+            arg = self.expr()
         extra = None
         if func == "percentile":
             self.expect("op", ",")
@@ -677,6 +731,24 @@ class Parser:
                     break
             self.expect("op", ")")
             return items
+        if t.kind == "op" and t.value == "[":
+            # bracket set literal [1, 2] / ['a', 'b'] (sql3/parser
+            # exprList square-bracket form; [] is the empty set)
+            items = []
+            if not self.accept("op", "]"):
+                while True:
+                    items.append(self.literal_value())
+                    if not self.accept("op", ","):
+                        break
+                self.expect("op", "]")
+            return items
+        if t.kind == "ident" and t.value.lower() in (
+                "current_timestamp", "current_date"):
+            import datetime as dt
+            now = dt.datetime.utcnow().replace(microsecond=0)
+            if t.value.lower() == "current_date":
+                now = now.replace(hour=0, minute=0, second=0)
+            return now
         raise SQLError(f"expected literal at {t.pos}, got {t.value!r}")
 
 

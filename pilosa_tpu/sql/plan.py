@@ -181,16 +181,74 @@ class ExtractScanOp(_FilteredOp):
             self.idx, self.stmt, self.items, self._filt())
 
 
+def _rewrite_alias(e, alias: str, table: str):
+    """Replace Col qualifiers naming the FROM alias with the real
+    table, without descending into subqueries (they resolve their own
+    aliases when planned)."""
+    if isinstance(e, ast.Col):
+        if e.table == alias:
+            e.table = table
+        return
+    if e is None or isinstance(e, (ast.Lit, ast.Var, ast.SubQuery)):
+        return
+    if isinstance(e, ast.Func):
+        for x in e.args:
+            _rewrite_alias(x, alias, table)
+        return
+    if isinstance(e, ast.InSelect):
+        _rewrite_alias(e.col, alias, table)
+        return
+    for attr in ("left", "right", "expr", "col", "arg", "lo", "hi",
+                 "extra"):
+        sub = getattr(e, attr, None)
+        if sub is not None and not isinstance(sub, (str, int, float,
+                                                    bool)):
+            _rewrite_alias(sub, alias, table)
+
+
+def _normalize_alias(stmt: ast.Select):
+    """FROM t AS x on a single-table select: fold x.col -> t.col so
+    downstream validation and compilation see real table names."""
+    a, t = stmt.table_alias, stmt.table
+    for it in stmt.items:
+        _rewrite_alias(it.expr, a, t)
+    _rewrite_alias(stmt.where, a, t)
+    _rewrite_alias(stmt.having, a, t)
+    for ob in stmt.order_by:
+        _rewrite_alias(ob.expr, a, t)
+    stmt.group_by = [g[len(a) + 1:] if g.startswith(a + ".") else g
+                     for g in stmt.group_by]
+
+
 def plan_select(eng, stmt: ast.Select) -> PlanOp:
     """The single SELECT dispatch decision (executes nothing)."""
+    from pilosa_tpu.sql.typecheck import check_select
     if not stmt.table:
+        check_select(eng, None, stmt, stmt.items)
         return ConstProjectOp(eng, stmt)
     if stmt.table in eng._views:
         return ViewExpandOp(eng, stmt)
     idx = eng._index(stmt.table)
+    if stmt.group_by:
+        for it in stmt.items:
+            a = it.expr
+            if isinstance(a, ast.Agg) and a.func in (
+                    "min", "max", "percentile", "var", "corr"):
+                # defs_groupby.go analysis errors — applies to joined
+                # selects too
+                raise SQLError(f"aggregate '{a.func.upper()}()' "
+                               "not allowed in GROUP BY")
     if stmt.joins:
         return NestedLoopJoinOp(eng, stmt)
+    if stmt.table_alias:
+        _normalize_alias(stmt)
     eng.select.reject_foreign_quals(stmt)
+    # single-table GROUP BY entries may still carry the table
+    # qualifier (group by t.col)
+    stmt.group_by = [g[len(stmt.table) + 1:]
+                     if g.startswith(stmt.table + ".") else g
+                     for g in stmt.group_by]
+    check_select(eng, idx, stmt, stmt.items)
 
     # expand * into _id + all columns
     items: list[ast.SelectItem] = []
@@ -204,24 +262,69 @@ def plan_select(eng, stmt: ast.Select) -> PlanOp:
 
     if stmt.having is not None and not stmt.group_by:
         raise SQLError("HAVING requires GROUP BY")
-    aggs = [it for it in items if isinstance(it.expr, ast.Agg)]
+    agg_items = [it for it in items if _contains_agg(it.expr)]
     if stmt.group_by:
-        # PQL GroupBy(Rows(...)) only walks set-like fields; int/
-        # decimal/timestamp group columns take the generic hashed
-        # path (sql3's non-pushdown PlanOpGroupBy)
-        generic = any(eng._field(idx, g).options.type.is_bsi
-                      for g in stmt.group_by)
-        return PQLGroupByOp(eng, stmt, idx, items, generic)
-    if aggs:
-        if len(aggs) != len(items):
+        return PQLGroupByOp(eng, stmt, idx, items,
+                            _needs_generic_group(eng, idx, stmt,
+                                                 items))
+    if agg_items:
+        if len(agg_items) != len(items):
             raise SQLError(
                 "mixing aggregates and columns requires GROUP BY")
         return PQLAggregateOp(eng, stmt, idx, items)
     if stmt.distinct and len(items) == 1 and \
             isinstance(items[0].expr, ast.Col) and \
-            items[0].expr.name != "_id":
+            items[0].expr.name != "_id" and \
+            not _is_setlike(eng, idx, items[0].expr.name):
         return DistinctScanOp(eng, stmt, idx, items)
     return ExtractScanOp(eng, stmt, idx, items)
+
+
+def _contains_agg(e) -> bool:
+    if isinstance(e, ast.Agg):
+        return True
+    if isinstance(e, ast.BinOp):
+        return _contains_agg(e.left) or _contains_agg(e.right)
+    if isinstance(e, ast.Not):
+        return _contains_agg(e.expr)
+    if isinstance(e, ast.Func):
+        return any(_contains_agg(x) for x in e.args)
+    return False
+
+
+def _is_setlike(eng, idx, name: str) -> bool:
+    """SET/TIME columns hold multi-value cells: SQL DISTINCT and
+    GROUP BY treat the FULL set as the value (defs_groupby
+    groupBySetDistinctTests), so they cannot ride the member-wise
+    PQL Distinct/GroupBy pushdowns."""
+    from pilosa_tpu.models import FieldType
+    f = idx.field(name)
+    return f is not None and f.options.type in (FieldType.SET,
+                                                FieldType.TIME)
+
+
+def _needs_generic_group(eng, idx, stmt, items) -> bool:
+    """PQL GroupBy pushdown serves single-valued group columns
+    (mutex/bool) with count(*)/sum/avg aggregates; BSI group columns
+    (hashed groups), set-like group columns (full-set keys), and
+    other aggregate shapes take the generic hashed path (sql3's
+    non-pushdown PlanOpGroupBy)."""
+    from pilosa_tpu.models import FieldType
+    for g in stmt.group_by:
+        f = eng._field(idx, g)
+        if f.options.type not in (FieldType.MUTEX, FieldType.BOOL):
+            return True
+    for it in items:
+        e = it.expr
+        if isinstance(e, ast.Agg):
+            if e.func == "count" and e.arg is None:
+                continue
+            if e.func in ("sum", "avg") and \
+                    isinstance(e.arg, ast.Col) and \
+                    e.arg.name != "_id":
+                continue
+            return True
+    return False
 
 
 def explain(eng, stmt) -> SQLResult:

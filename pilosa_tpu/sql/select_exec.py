@@ -104,25 +104,95 @@ class SelectExec:
             return "int"
         if a.func in ("avg", "var", "corr"):
             return "decimal"
-        f = self.eng._field(idx, a.arg.name)
-        return sql_type_of(f)
+        if isinstance(a.arg, ast.Col):
+            if a.arg.name == "_id":
+                return "id"
+            f = self.eng._field(idx, a.arg.name)
+            return sql_type_of(f)
+        return self.expr_type(idx, a.arg) if a.arg is not None \
+            else "int"
 
     # -- aggregates -----------------------------------------------------
 
     def select_aggregates(self, idx, stmt, items, filt) -> SQLResult:
+        """Aggregate projections — plain aggregates AND aggregate
+        expressions (COUNT(*) + 10, defs_aggregate countTests): the
+        contained aggregates evaluate first, then the scalar
+        expression folds over their values."""
         row_vals, schema = [], []
         for it in items:
-            a: ast.Agg = it.expr
-            schema.append((name_of(it), self.agg_type(idx, a)))
-            row_vals.append(self.eval_agg(idx, a, filt))
+            e = it.expr
+            if isinstance(e, ast.Agg):
+                schema.append((name_of(it), self.agg_type(idx, e)))
+                row_vals.append(self.eval_agg(idx, e, filt))
+                continue
+            folded = self._fold_agg_values(idx, e, filt)
+            from pilosa_tpu.sql.funcs import Evaluator
+            ev = Evaluator(udfs=self.eng._udf_callables())
+            row_vals.append(to_sql_value(ev.eval(folded, {})))
+            schema.append((name_of(it), self.expr_type(idx, folded)))
         return SQLResult(schema=schema, rows=[tuple(row_vals)])
+
+    def _fold_agg_values(self, idx, e, filt):
+        """Deep-copy an expression with every Agg node replaced by its
+        evaluated literal."""
+        if isinstance(e, ast.Agg):
+            return ast.Lit(self.eval_agg(idx, e, filt))
+        if isinstance(e, ast.BinOp):
+            return ast.BinOp(e.op, self._fold_agg_values(idx, e.left,
+                                                         filt),
+                             self._fold_agg_values(idx, e.right, filt))
+        if isinstance(e, ast.Not):
+            return ast.Not(self._fold_agg_values(idx, e.expr, filt))
+        if isinstance(e, ast.Func):
+            return ast.Func(e.name, [self._fold_agg_values(idx, x, filt)
+                                     for x in e.args])
+        return e
+
+    @staticmethod
+    def _avg_quantize(total, n):
+        """AVG returns a scale-4 decimal (defs_aggregate avgTests:
+        avg(i1) -> 11.3333)."""
+        from decimal import ROUND_HALF_EVEN, Decimal
+        if n == 0:
+            return None
+        t = total if isinstance(total, Decimal) else Decimal(total)
+        return (t / n).quantize(Decimal("0.0001"),
+                                rounding=ROUND_HALF_EVEN)
+
+    def _agg_pushable(self, idx, a: ast.Agg) -> bool:
+        """True when the aggregate rides a single PQL call: plain
+        column args on matching field types.  Everything else — agg
+        over an expression, sum/avg/min/max on non-BSI fields, string
+        min/max — aggregates host-side over an Extract."""
+        if a.func == "count" and a.arg is None:
+            return True
+        if not isinstance(a.arg, ast.Col):
+            return False
+        name = a.arg.name
+        if name == "_id":
+            return a.func == "count" and not a.distinct
+        f = idx.field(name)
+        if f is None:
+            raise SQLError(f"column not found: {name}")
+        if a.func == "count":
+            return True
+        if a.func in ("sum", "min", "max", "avg", "percentile"):
+            return f.options.type.is_bsi
+        return a.func in ("var", "corr")
 
     def eval_agg(self, idx, a: ast.Agg, filt: Call):
         eng = self.eng
         ex = eng.executor
         hasf = has_filter(filt)
         fchildren = [filt] if hasf else []
-        if a.func == "count" and a.arg is None:
+        if not self._agg_pushable(idx, a):
+            return self._agg_generic(idx, a, filt)
+        if a.func == "count" and (
+                a.arg is None or (isinstance(a.arg, ast.Col)
+                                  and a.arg.name == "_id")):
+            # COUNT(_id) counts records — _id is never NULL
+            # (defs_aggregate countTests_2)
             return ex._execute_call(idx, Call(
                 "Count", children=[filt]), None)
         if a.func == "count" and a.distinct:
@@ -150,7 +220,7 @@ class SelectExec:
                 call_name, args={"_field": a.arg.name},
                 children=fchildren), None)
             if a.func == "avg":
-                return res.value / res.count if res.count else None
+                return self._avg_quantize(res.value, res.count)
             return res.value
         if a.func == "percentile":
             args = {"_field": a.arg.name, "nth": a.extra}
@@ -161,6 +231,52 @@ class SelectExec:
             return res.value if res is not None else None
         if a.func in ("var", "corr"):
             return self.eval_var_corr(idx, a, filt)
+        raise SQLError(f"unsupported aggregate {a.func}")
+
+    def _agg_generic(self, idx, a: ast.Agg, filt: Call):
+        """Host-side aggregation over an Extract: aggregates on
+        expressions (sum(d1 + 5), avg(len(s1))), literals (sum(1)),
+        and non-BSI columns (min(s1) lexicographic, avg(id1))."""
+        from pilosa_tpu.sql.funcs import Evaluator, columns_in
+        eng = self.eng
+        if a.arg is None:
+            raise SQLError(f"{a.func}: column reference expected")
+        cols = sorted(n for n in columns_in(a.arg) if n != "_id")
+        for n in cols:
+            eng._field(idx, n)
+        c = Call("Extract", children=[filt] + [
+            Call("Rows", args={"_field": n}) for n in cols])
+        table = eng.executor._execute_call(idx, c, None)
+        ev = Evaluator(udfs=eng._udf_callables())
+        vals = []
+        for entry in table.columns:
+            env = {n: to_sql_value(entry["rows"][i])
+                   for i, n in enumerate(cols)}
+            env["_id"] = entry.get("column_key", entry["column"])
+            v = ev.eval(a.arg, env)
+            if v is not None:
+                vals.append(v)
+        if a.func == "count":
+            if a.distinct:
+                return len({repr(tuple(sorted(v))
+                                 if isinstance(v, list) else v)
+                            for v in vals})
+            return len(vals)
+        if a.func in ("min", "max"):
+            # sets are not min/max-able; strings compare
+            # lexicographically (defs_aggregate minmaxTests_4)
+            vals = [v for v in vals if not isinstance(v, list)]
+            if not vals:
+                return None
+            return min(vals) if a.func == "min" else max(vals)
+        nums = [v for v in vals
+                if isinstance(v, (int, float)) or
+                type(v).__name__ == "Decimal"]
+        if a.func == "sum":
+            return sum(nums) if nums else None
+        if a.func == "avg":
+            return self._avg_quantize(sum(nums), len(nums)) \
+                if nums else None
         raise SQLError(f"unsupported aggregate {a.func}")
 
     def eval_var_corr(self, idx, a: ast.Agg, filt: Call):
@@ -260,6 +376,11 @@ class SelectExec:
         groups = eng.executor._execute_call(idx, call, None)
         rows = []
         for g in groups:
+            if sum_field is not None and not g.agg_count:
+                # a SUM/AVG aggregate drops groups with no aggregate
+                # rows (defs_groupby groupByTests_6; executor.go
+                # GroupBy aggregate filtering)
+                continue
             vals = []
             for kind, gi in getters:
                 if kind == "group":
@@ -268,15 +389,25 @@ class SelectExec:
                 elif kind == "count":
                     vals.append(g.count)
                 elif kind == "sum":
-                    # SUM over only NULLs is NULL, not 0
-                    vals.append(g.agg if g.agg_count else None)
+                    vals.append(g.agg)
                 elif kind == "avg":
-                    vals.append(g.agg / g.agg_count if g.agg_count
-                                else None)
+                    vals.append(self._avg_quantize(g.agg, g.agg_count))
             rows.append(tuple(vals))
-        rows = order_rows(stmt, schema, rows)
+        rows = order_rows(stmt, schema, rows,
+                          self._group_srcmap(stmt, items))
         rows = limit_rows(stmt, rows)
         return SQLResult(schema=schema, rows=rows)
+
+    @staticmethod
+    def _group_srcmap(stmt, items) -> dict:
+        """source column name -> projection index for aliased group
+        columns (ORDER BY i1 when projected as `i1 AS c`)."""
+        out = {}
+        for i, it in enumerate(items):
+            if isinstance(it.expr, ast.Col) and it.alias and \
+                    it.alias != it.expr.name:
+                out.setdefault(it.expr.name, i)
+        return out
 
     def select_grouped_generic(self, idx, stmt, items,
                                filt) -> SQLResult:
@@ -304,15 +435,19 @@ class SelectExec:
                 if e.func == "count" and e.arg is None:
                     schema.append((name_of(it), "int"))
                     getters.append(("agg", len(agg_specs)))
-                    agg_specs.append(("count*", None))
-                elif e.func in ("count", "sum", "avg", "min", "max"):
+                    agg_specs.append(("count*", None, False))
+                elif e.func in ("count", "sum", "avg"):
+                    if not isinstance(e.arg, ast.Col):
+                        raise SQLError(
+                            "GROUP BY aggregates take a column "
+                            "reference")
                     schema.append((name_of(it), self.agg_type(idx, e)))
                     getters.append(("agg", len(agg_specs)))
-                    agg_specs.append((e.func, e.arg.name))
+                    agg_specs.append((e.func, e.arg.name, e.distinct))
                 else:
                     raise SQLError(
-                        f"aggregate {e.func} not supported with "
-                        "GROUP BY")
+                        f"aggregate '{e.func.upper()}()' not allowed "
+                        "in GROUP BY")
             else:
                 raise SQLError("invalid GROUP BY projection")
 
@@ -324,38 +459,63 @@ class SelectExec:
         rows = []
         for key, rids in groups.items():
             agg_vals = []
-            for func, col in agg_specs:
+            for func, col, distinct in agg_specs:
                 if func == "count*":
                     agg_vals.append(len(rids))
                     continue
                 vals = [self.cell_value(idx, col, r) for r in rids]
                 vals = [v for v in vals if v is not None]
                 if func == "count":
-                    agg_vals.append(len(vals))
+                    if distinct:
+                        agg_vals.append(len({
+                            tuple(sorted(v)) if isinstance(v, list)
+                            else v for v in vals}))
+                    else:
+                        agg_vals.append(len(vals))
                 elif not vals:
                     agg_vals.append(None)
                 elif func == "sum":
                     agg_vals.append(sum(vals))
                 elif func == "avg":
-                    agg_vals.append(sum(vals) / len(vals))
-                elif func == "min":
-                    agg_vals.append(min(vals))
-                elif func == "max":
-                    agg_vals.append(max(vals))
+                    agg_vals.append(self._avg_quantize(sum(vals),
+                                                       len(vals)))
             if stmt.having is not None and not self.generic_having_ok(
                     stmt.having, len(rids), agg_specs, agg_vals):
                 continue
+            if any(func in ("sum", "avg") and agg_vals[i] is None
+                   for i, (func, _c, _d) in enumerate(agg_specs)):
+                # SUM/AVG drops groups with no aggregate rows
+                # (defs_groupby groupByTests_6)
+                continue
             out = []
             for kind, i in getters:
-                out.append(key[i] if kind == "group" else agg_vals[i])
+                if kind == "group":
+                    # set group keys canonicalized to tuples for
+                    # hashing; project back as lists
+                    out.append(list(key[i])
+                               if isinstance(key[i], tuple)
+                               else key[i])
+                else:
+                    out.append(agg_vals[i])
             rows.append(tuple(out))
-        rows = order_rows(stmt, schema, rows)
+        rows = order_rows(stmt, schema, rows,
+                          self._group_srcmap(stmt, items))
         rows = limit_rows(stmt, rows)
         return SQLResult(schema=schema, rows=rows)
 
     def group_key(self, idx, col: str, rid: int):
         v = self.cell_value(idx, col, rid)
-        return tuple(sorted(v)) if isinstance(v, list) else v
+        if isinstance(v, list):
+            return tuple(sorted(v))
+        if v is not None and col != "_id":
+            f = idx.field(col)
+            if f is not None and f.options.type in (FieldType.SET,
+                                                    FieldType.TIME):
+                # single-member sets decode as scalars; group keys
+                # stay sets (defs_groupby: ['b'] is a group of its
+                # own, not scalar 'b')
+                return (v,)
+        return v
 
     def generic_having_ok(self, having, count, agg_specs, agg_vals):
         if not (isinstance(having, ast.BinOp)
@@ -367,7 +527,7 @@ class SelectExec:
         if a.func == "count" and a.arg is None:
             val = count
         else:
-            for i, (func, col) in enumerate(agg_specs):
+            for i, (func, col, _d) in enumerate(agg_specs):
                 if func == a.func and col == (a.arg.name if a.arg
                                               else None):
                     val = agg_vals[i]
@@ -759,205 +919,319 @@ class SelectExec:
     # -- JOIN (sql3 opnestedloops.go nested-loop join) ------------------
 
     def select_join(self, stmt: ast.Select) -> SQLResult:
-        """Nested-loop INNER / LEFT OUTER JOIN of two tables on column
-        equality.  The right side builds a hash of join-key -> record
-        ids; left records probe it (the hashed refinement of
-        opnestedloops.go's loop; LEFT JOIN per opnestedloops.go's
-        outer variant: a left record with no key match survives once
-        with NULL right-side values, and WHERE evaluates AFTER the
-        join).  WHERE may reference either table's columns."""
+        """N-way nested-loop INNER / LEFT OUTER JOIN on column
+        equality, with table aliases, aggregates, GROUP BY, and
+        DISTINCT over the joined rows.
+
+        Each JOIN hashes its new side by join-key value and probes
+        the tuples built so far (the hashed refinement of
+        opnestedloops.go; LEFT JOIN per its outer variant: an
+        unmatched tuple survives once with a NULL new side, and WHERE
+        evaluates AFTER the join).  Sides are addressed by alias or —
+        when unambiguous — by real table name; unqualified columns
+        default to the left table (the first FROM entry)."""
         eng = self.eng
         if not eng.executor.supports_local_cells:
             raise SQLError(
                 "JOIN is not supported on the DAX queryer yet")
-        if len(stmt.joins) != 1:
-            raise SQLError("a single JOIN is supported")
-        if stmt.group_by or stmt.having or stmt.distinct:
-            raise SQLError("JOIN with GROUP BY/HAVING/DISTINCT "
-                           "not supported yet")
-        join = stmt.joins[0]
-        lname, rname = stmt.table, join.table
-        if lname == rname:
-            raise SQLError("self-join requires table aliases "
-                           "(not supported)")
-        lidx, ridx = eng._index(lname), eng._index(rname)
+        if stmt.having is not None and not stmt.group_by:
+            raise SQLError("HAVING requires GROUP BY")
 
-        def side_of(c: ast.Col) -> str:
-            if c.table is None:
-                raise SQLError("JOIN ON columns must be qualified "
-                               "(table.column)")
-            if c.table not in (lname, rname):
-                raise SQLError(f"unknown table in ON: {c.table}")
-            return c.table
+        # -- side registry ---------------------------------------------
+        sides: list[tuple[str, str, object]] = []  # (key, table, idx)
 
-        jl, jr = join.left, join.right
-        if side_of(jl) == rname:
-            jl, jr = jr, jl
-        if side_of(jl) != lname or side_of(jr) != rname:
-            raise SQLError("JOIN ON must relate the two joined tables")
+        def add_side(table, alias):
+            idx = eng._index(table)
+            key = alias or table
+            if any(k == key for k, _t, _i in sides):
+                raise SQLError(
+                    f"duplicate table name or alias {key!r} "
+                    "(alias the table)")
+            sides.append((key, table, idx))
+        add_side(stmt.table, stmt.table_alias)
+        for j in stmt.joins:
+            add_side(j.table, j.alias)
+        keymap = {k: i for i, (k, _t, _i) in enumerate(sides)}
+        by_table: dict[str, list[int]] = {}
+        for i, (_k, t, _i) in enumerate(sides):
+            by_table.setdefault(t, []).append(i)
 
-        # projected columns; '*' expands to both tables' columns
-        items: list[tuple[str, str, str]] = []  # (out name, table, col)
+        def side_index(qual: str, ctx: str) -> int:
+            if qual in keymap:
+                return keymap[qual]
+            hits = by_table.get(qual, [])
+            if len(hits) == 1:
+                return hits[0]
+            if hits:
+                raise SQLError(f"ambiguous table reference {qual!r}")
+            raise SQLError(f"unknown table {qual!r} in {ctx}")
+
+        def col_side(c: ast.Col, ctx: str) -> int:
+            return side_index(c.table, ctx) if c.table is not None \
+                else 0
+
+        def side_field_tinfo(si: int, name: str):
+            from pilosa_tpu.sql.typecheck import TInfo, field_tinfo
+            idx = sides[si][2]
+            if name == "_id":
+                return TInfo("string" if idx.keys else "id")
+            f = idx.field(name)
+            if f is None:
+                raise SQLError(f"column not found: {name}")
+            return field_tinfo(f)
+
+        # memoized cell decode per (side, col, record)
+        cell_cache: dict = {}
+
+        def cell(si: int, col: str, rid):
+            if rid is None:  # unmatched LEFT JOIN side
+                return None
+            key = (si, col, rid)
+            if key not in cell_cache:
+                cell_cache[key] = self.cell_value(sides[si][2], col,
+                                                  rid)
+            return cell_cache[key]
+
+        # -- build joined tuples (one record id per side) --------------
+        all_call = Call("All")
+        tuples: list[tuple] = [
+            (rid,) for rid in self.table_ids(sides[0][2], all_call)]
+        for ji, j in enumerate(stmt.joins):
+            new_si = ji + 1
+            jl, jr = j.left, j.right
+            for c in (jl, jr):
+                if not isinstance(c, ast.Col) or c.table is None:
+                    raise SQLError("JOIN ON columns must be "
+                                   "qualified (table.column)")
+            lsi = side_index(jl.table, "ON")
+            rsi = side_index(jr.table, "ON")
+            if rsi != new_si:
+                jl, jr, lsi, rsi = jr, jl, rsi, lsi
+            if rsi != new_si or lsi >= new_si:
+                raise SQLError("JOIN ON must relate the joined table "
+                               "to an earlier table")
+            # analysis: join keys must be equatable (defs_join.go
+            # Unmatched-columns case)
+            from pilosa_tpu.sql.typecheck import TypeChecker
+            tc = TypeChecker(eng)
+            tc._equatable(side_field_tinfo(lsi, jl.name),
+                          side_field_tinfo(rsi, jr.name))
+            ridx = sides[rsi][2]
+            rmap: dict = {}
+            for rid in self.table_ids(ridx, all_call):
+                v = self.cell_value(ridx, jr.name, rid)
+                if v is None:
+                    continue
+                for key in (v if isinstance(v, list) else [v]):
+                    rmap.setdefault(key, []).append(rid)
+            out = []
+            for t in tuples:
+                lv = cell(lsi, jl.name, t[lsi])
+                matched = False
+                if lv is not None:
+                    for key in (lv if isinstance(lv, list) else [lv]):
+                        for rid in rmap.get(key, ()):
+                            matched = True
+                            out.append(t + (rid,))
+                if j.outer and not matched:
+                    out.append(t + (None,))
+            tuples = out
+
+        # -- WHERE over joined tuples ----------------------------------
+        def jeval(e, tup):
+            if isinstance(e, ast.Lit):
+                return e.value
+            if isinstance(e, ast.Col):
+                si = col_side(e, "WHERE")
+                return cell(si, e.name, tup[si])
+            if isinstance(e, ast.Func):
+                from pilosa_tpu.sql.funcs import call_builtin
+                args = [jeval(x, tup) for x in e.args]
+                udf = eng._udf_callables().get(e.name)
+                return udf(args) if udf is not None \
+                    else call_builtin(e.name, args)
+            if isinstance(e, ast.Not):
+                v = jeval(e.expr, tup)
+                return None if v is None else not v
+            if isinstance(e, ast.IsNull):
+                return (jeval(e.col, tup) is None) != e.negated
+            if isinstance(e, ast.InList):
+                v = jeval(e.col, tup)
+                if v is None:
+                    return None
+                hit = v in e.items
+                return (not hit) if e.negated else hit
+            if isinstance(e, ast.Between):
+                v = jeval(e.col, tup)
+                lo, hi = jeval(e.lo, tup), jeval(e.hi, tup)
+                if None in (v, lo, hi):
+                    return None
+                hit = lo <= v <= hi
+                return (not hit) if e.negated else hit
+            if isinstance(e, ast.BinOp):
+                if e.op == "and":
+                    l, r = jeval(e.left, tup), jeval(e.right, tup)
+                    return bool(l) and bool(r)
+                if e.op == "or":
+                    l, r = jeval(e.left, tup), jeval(e.right, tup)
+                    return bool(l) or bool(r)
+                l, r = jeval(e.left, tup), jeval(e.right, tup)
+                if l is None or r is None:
+                    return False
+                try:
+                    if e.op == "=":
+                        return l == r
+                    if e.op in ("!=", "<>"):
+                        return l != r
+                    return {"<": l < r, "<=": l <= r, ">": l > r,
+                            ">=": l >= r}[e.op]
+                except (TypeError, KeyError):
+                    raise SQLError(
+                        f"JOIN WHERE operator {e.op!r} unsupported "
+                        f"for {type(l).__name__}/{type(r).__name__}")
+            raise SQLError(f"unsupported WHERE form in JOIN: {e!r}")
+
+        if stmt.where is not None:
+            tuples = [t for t in tuples if jeval(stmt.where, t)]
+
+        # -- projections -----------------------------------------------
+        # plans: ("col", si, name, out, type) | ("agg", Agg, out)
+        plans = []
+
+        def add_col(si, name, out):
+            t = side_field_tinfo(si, name)
+            plans.append(("col", si, name, out,
+                          "decimal" if t.kind == "decimal"
+                          else t.kind if name != "_id"
+                          else ("string" if sides[si][2].keys
+                                else "id")))
+
+        def star_side(si, qualify):
+            idx = sides[si][2]
+            pre = f"{sides[si][0]}." if qualify else ""
+            add_col(si, "_id", pre + "_id")
+            for f in idx.public_fields():
+                add_col(si, f.name, pre + f.name)
+
         for it in stmt.items:
             e = it.expr
             if isinstance(e, ast.Agg):
-                if e.func == "count" and e.arg is None:
-                    items.append((name_of(it), "", "count(*)"))
-                    continue
-                raise SQLError("JOIN supports only COUNT(*) aggregate")
-            if not isinstance(e, ast.Col):
-                raise SQLError("JOIN projections must be columns")
-            if e.name == "*":
-                items.append(("_id", lname, "_id"))
-                items += [(f.name, lname, f.name)
-                          for f in lidx.public_fields()]
-                items += [(f"{rname}._id", rname, "_id")]
-                items += [(f"{rname}.{f.name}", rname, f.name)
-                          for f in ridx.public_fields()]
-                continue
-            table = e.table or lname
-            if table not in (lname, rname):
+                plans.append(("agg", e, name_of(it)))
+            elif isinstance(e, ast.Col) and e.name == "*":
+                if e.table is not None:  # u.* — one side, plain names
+                    star_side(side_index(e.table, "projection"), False)
+                else:
+                    for si in range(len(sides)):
+                        star_side(si, si > 0)
+            elif isinstance(e, ast.Col):
+                si = col_side(e, "projection")
+                out = it.alias or (e.name if e.table is None
+                                   else f"{e.table}.{e.name}")
+                add_col(si, e.name, out)
+            else:
                 raise SQLError(
-                    f"unknown table {table!r} in projection")
-            items.append((it.alias or (e.name if e.table is None else
-                                       f"{e.table}.{e.name}"),
-                          table, e.name))
-        if any(c == "count(*)" for _, _, c in items) and len(items) > 1:
-            raise SQLError(
-                "JOIN cannot mix COUNT(*) with other projections")
+                    "JOIN projections must be columns or aggregates")
 
-        # WHERE: validate table qualifications up front; conditions
-        # evaluate on the joined row (qualified or left-default)
-        where = stmt.where
+        aggs = [p for p in plans if p[0] == "agg"]
+        group_cols: list[tuple[int, str]] = []
+        for g in stmt.group_by:
+            if "." in g:
+                qual, _, nm = g.partition(".")
+                group_cols.append((side_index(qual, "GROUP BY"), nm))
+            else:
+                group_cols.append((0, g))
+        for si, nm in group_cols:
+            side_field_tinfo(si, nm)  # validate
 
-        def walk(e):
-            if isinstance(e, ast.Col):
-                t = e.table or lname
-                if t not in (lname, rname):
-                    raise SQLError(f"unknown table {t!r} in WHERE")
-                return
-            for attr in ("left", "right", "expr", "col"):
-                sub = getattr(e, attr, None)
-                if sub is not None and not isinstance(
-                        sub, (str, int, float, bool)):
-                    walk(sub)
-        if where is not None:
-            walk(where)
-
-        all_call = Call("All")
-        left_ids = self.table_ids(lidx, all_call)
-        right_ids = self.table_ids(ridx, all_call)
-
-        # hash the right side by join-key value
-        rmap: dict = {}
-        for rid in right_ids:
-            v = self.cell_value(ridx, jr.name, rid)
-            if v is None:
-                continue
-            for key in (v if isinstance(v, list) else [v]):
-                rmap.setdefault(key, []).append(rid)
-
-        # memoize per (table, col, record): a left record matching k
-        # right rows would otherwise re-decode its cells k times
-        cell_cache: dict = {}
-
-        def cell(table, idx_, col, record_id):
-            if record_id is None:  # unmatched LEFT JOIN right side
+        def agg_value(a: ast.Agg, tups):
+            if a.func == "count" and a.arg is None:
+                return len(tups)
+            if a.arg is None:
+                raise SQLError(f"{a.func} requires a column argument")
+            si = col_side(a.arg, "aggregate")
+            vals = [cell(si, a.arg.name, t[si]) for t in tups]
+            vals = [v for v in vals if v is not None]
+            if a.func == "count":
+                if a.distinct:
+                    return len({v if not isinstance(v, list)
+                                else tuple(sorted(v)) for v in vals})
+                return len(vals)
+            if not vals:
                 return None
-            key = (table, col, record_id)
-            if key not in cell_cache:
-                cell_cache[key] = self.cell_value(idx_, col, record_id)
-            return cell_cache[key]
+            if a.func == "sum":
+                return sum(vals)
+            if a.func == "avg":
+                return self._avg_quantize(sum(vals), len(vals))
+            if a.func == "min":
+                return min(vals)
+            if a.func == "max":
+                return max(vals)
+            raise SQLError(
+                f"aggregate {a.func} not supported in JOIN")
 
-        def joined_value(table, col, lid, rid):
-            if table == lname:
-                return cell(lname, lidx, col, lid)
-            return cell(rname, ridx, col, rid)
+        def agg_sql_type(a: ast.Agg) -> str:
+            if a.func == "count":
+                return "int"
+            if a.func == "avg":
+                return "decimal"
+            si = col_side(a.arg, "aggregate")
+            return side_field_tinfo(si, a.arg.name).render().split(
+                "(")[0]
 
-        def where_ok(lid, rid):
-            if where is None:
-                return True
-            return bool(self.eval_join_expr(where, lname, rname,
-                                            lidx, ridx, lid, rid))
+        if aggs and not stmt.group_by:
+            if len(aggs) != len(plans):
+                raise SQLError(
+                    "mixing aggregates and columns requires GROUP BY")
+            schema = [(p[2], agg_sql_type(p[1])) for p in aggs]
+            rows = [tuple(agg_value(p[1], tuples) for p in aggs)]
+            return SQLResult(schema=schema, rows=rows)
 
-        rows = []
-        count_only = items and items[0][2] == "count(*)" and \
-            len(items) == 1
-        n = 0
-        outer = join.outer
+        if stmt.group_by:
+            groups: dict[tuple, list] = {}
+            for t in tuples:
+                key = tuple(self._canon_group(cell(si, nm, t[si]))
+                            for si, nm in group_cols)
+                groups.setdefault(key, []).append(t)
+            schema, rows = [], []
+            for p in plans:
+                if p[0] == "col":
+                    if (p[1], p[2]) not in group_cols:
+                        raise SQLError(
+                            f"column {p[3]} must appear in GROUP BY")
+                    schema.append((p[3], p[4]))
+                else:
+                    schema.append((p[2], agg_sql_type(p[1])))
+            for key, tups in groups.items():
+                vals = []
+                for p in plans:
+                    if p[0] == "col":
+                        kv = key[group_cols.index((p[1], p[2]))]
+                        # set group keys canonicalized to tuples for
+                        # hashing; project back as lists
+                        vals.append(list(kv) if isinstance(kv, tuple)
+                                    else kv)
+                    else:
+                        vals.append(agg_value(p[1], tups))
+                rows.append(tuple(vals))
+            rows = order_rows(stmt, schema, rows)
+            rows = limit_rows(stmt, rows)
+            return SQLResult(schema=schema, rows=rows)
 
-        def emit(lid, rid):
-            nonlocal n
-            if count_only:
-                n += 1
-            else:
-                rows.append(tuple(joined_value(t, c, lid, rid)
-                                  for _, t, c in items))
-
-        for lid in left_ids:
-            lv = self.cell_value(lidx, jl.name, lid)
-            any_key_match = False
-            if lv is not None:
-                for key in (lv if isinstance(lv, list) else [lv]):
-                    for rid in rmap.get(key, ()):
-                        any_key_match = True
-                        if where_ok(lid, rid):
-                            emit(lid, rid)
-            if outer and not any_key_match and where_ok(lid, None):
-                emit(lid, None)
-        if count_only:
-            return SQLResult(schema=[(items[0][0], "int")],
-                             rows=[(n,)])
-        # typed schema: resolve each projected column's SQL type
-        schema = []
-        for name, t, c in items:
-            idx_ = lidx if t == lname else ridx
-            if c == "_id":
-                schema.append((name, "id"))
-            else:
-                schema.append((name,
-                               sql_type_of(eng._field(idx_, c))))
+        schema = [(p[3], p[4]) for p in plans]
+        rows = [tuple(cell(p[1], p[2], t[p[1]]) for p in plans)
+                for t in tuples]
+        if stmt.distinct:
+            seen, deduped = set(), []
+            for r in rows:
+                k = distinct_key(r)
+                if k not in seen:
+                    seen.add(k)
+                    deduped.append(r)
+            rows = deduped
         rows = order_rows(stmt, schema, rows)
         rows = limit_rows(stmt, rows)
         return SQLResult(schema=schema, rows=rows)
 
-    def eval_join_expr(self, e, lname, rname, lidx, ridx, lid, rid):
-        """Evaluate a WHERE expression over one joined row."""
-        if isinstance(e, ast.Lit):
-            return e.value
-        if isinstance(e, ast.Col):
-            t = e.table or lname
-            rec = lid if t == lname else rid
-            if rec is None:  # unmatched LEFT JOIN side
-                return None
-            return self.cell_value(lidx if t == lname else ridx,
-                                   e.name, rec)
-        ev = lambda x: self.eval_join_expr(x, lname, rname, lidx,
-                                           ridx, lid, rid)
-        if isinstance(e, ast.BinOp):
-            if e.op == "and":
-                return ev(e.left) and ev(e.right)
-            if e.op == "or":
-                return ev(e.left) or ev(e.right)
-            l, r = ev(e.left), ev(e.right)
-            if l is None or r is None:
-                return False
-            if e.op == "=":
-                return l == r
-            if e.op in ("!=", "<>"):
-                return l != r
-            if e.op not in ("<", "<=", ">", ">="):
-                raise SQLError(f"JOIN WHERE operator {e.op!r} "
-                               "not supported")
-            try:
-                return {"<": l < r, "<=": l <= r,
-                        ">": l > r, ">=": l >= r}[e.op]
-            except TypeError:
-                raise SQLError(
-                    f"cannot compare {type(l).__name__} with "
-                    f"{type(r).__name__} in JOIN WHERE")
-        if isinstance(e, ast.Not):
-            return not ev(e.expr)
-        if isinstance(e, ast.IsNull):
-            return (ev(e.col) is None) != e.negated
-        raise SQLError(f"unsupported WHERE form in JOIN: {e!r}")
+    @staticmethod
+    def _canon_group(v):
+        return tuple(sorted(v)) if isinstance(v, list) else v

@@ -173,6 +173,16 @@ class StatementExec:
     def insert(self, stmt: ast.Insert) -> SQLResult:
         eng = self.eng
         idx = eng._index(stmt.table)
+        if stmt.columns is None:
+            # bare INSERT INTO t VALUES: positional over _id + fields
+            # in DECLARATION order (sql3 insert without a column
+            # list) — fields dict preserves CREATE TABLE order
+            from pilosa_tpu.models.index import EXISTENCE_FIELD
+            stmt.columns = ["_id"] + [n for n in idx.fields
+                                      if n != EXISTENCE_FIELD]
+            for row in stmt.rows:
+                if len(row) != len(stmt.columns):
+                    raise SQLError("VALUES arity mismatch")
         if "_id" not in stmt.columns:
             raise SQLError("INSERT requires an _id column")
         id_pos = stmt.columns.index("_id")

@@ -1,0 +1,289 @@
+"""Static expression type analysis — the
+sql3/planner/expressionanalyzer.go analog.
+
+Walks scalar expressions against the table schema BEFORE execution
+and raises the reference's analysis errors (defs_binops.go semantics,
+verified case-by-case against the conformance corpus):
+
+- ``= !=``  operand type families must match ("types 'int' and
+  'bool' are not equatable"); numerics (int/id/decimal) mix freely;
+  a STRING LITERAL compares against a timestamp column (coerced).
+- ``< <= > >=``  operands must each be orderable (numeric or
+  timestamp): the first non-orderable operand is reported
+  ("operator '<' incompatible with type 'bool'"); orderable but
+  mismatched families fall back to the not-equatable error.
+- ``& | << >>``  int/id only.
+- ``+ - * /``  numerics; result is decimal(max scale) when either
+  side is decimal, else int.
+- ``%``  int/id only (decimal excluded).
+- ``||``  strings only.
+
+NULL literals type-check against anything (comparisons yield UNKNOWN
+at runtime).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from decimal import Decimal
+
+from pilosa_tpu.models import FieldType
+from pilosa_tpu.sql import ast
+from pilosa_tpu.sql.lexer import SQLError
+
+NUMERIC = ("int", "id", "decimal")
+ORDERABLE = NUMERIC + ("timestamp",)
+
+
+@dataclass
+class TInfo:
+    kind: str          # int|id|decimal|bool|string|timestamp|idset|
+    #                    stringset|null|any
+    scale: int = 0     # decimal scale
+    literal: bool = False
+
+    def render(self) -> str:
+        if self.kind == "decimal":
+            return f"decimal({self.scale})"
+        return self.kind
+
+
+_FIELD_KIND = {
+    FieldType.INT: "int",
+    FieldType.DECIMAL: "decimal",
+    FieldType.TIMESTAMP: "timestamp",
+    FieldType.BOOL: "bool",
+}
+
+
+def field_tinfo(f) -> TInfo:
+    t = f.options.type
+    if t in _FIELD_KIND:
+        return TInfo(_FIELD_KIND[t], scale=f.options.scale or 0)
+    if t == FieldType.MUTEX:
+        return TInfo("string" if f.options.keys else "id")
+    return TInfo("stringset" if f.options.keys else "idset")
+
+
+_FUNC_KIND = None  # lazy: FUNC_TYPES from funcs.py
+
+
+def _func_tinfo(name: str, cast_args=None) -> TInfo:
+    global _FUNC_KIND
+    if _FUNC_KIND is None:
+        from pilosa_tpu.sql.funcs import FUNC_TYPES
+        _FUNC_KIND = FUNC_TYPES
+    if name == "CAST" and cast_args:
+        t = cast_args[1].value if isinstance(cast_args[1], ast.Lit) \
+            else "string"
+        s = cast_args[2].value if isinstance(cast_args[2], ast.Lit) \
+            else 0
+        return TInfo(t if t != "decimal" else "decimal", scale=s or 0)
+    return TInfo(_FUNC_KIND.get(name, "any"))
+
+
+class TypeChecker:
+    """Bound to one engine + optional index (None for FROM-less
+    selects)."""
+
+    def __init__(self, engine, idx=None, extra_cols: dict | None = None):
+        self.eng = engine
+        self.idx = idx
+        # name -> TInfo overrides (join envs, view columns)
+        self.extra = extra_cols or {}
+
+    def check(self, e) -> TInfo:
+        if e is None:
+            return TInfo("null")
+        if isinstance(e, ast.Lit):
+            return self._lit(e.value)
+        if isinstance(e, ast.Var):
+            return TInfo("any")
+        if isinstance(e, ast.SubQuery):
+            return TInfo("any")  # folded at execution time
+        if isinstance(e, ast.Col):
+            return self._col(e)
+        if isinstance(e, ast.Agg):
+            for sub in (e.arg,):
+                if isinstance(sub, ast.Col):
+                    self._col(sub)
+            if e.func == "count":
+                return TInfo("int")
+            if e.func in ("avg", "var", "corr"):
+                return TInfo("decimal", scale=6)
+            if isinstance(e.arg, ast.Col):
+                return self._col(e.arg)
+            return TInfo("any")
+        if isinstance(e, ast.Func):
+            for x in e.args:
+                self.check(x)
+            udf = self.eng._udf_types().get(e.name) \
+                if self.eng is not None else None
+            if udf is not None:
+                return TInfo(udf if udf != "decimal" else "decimal")
+            return _func_tinfo(e.name, e.args if e.name == "CAST"
+                               else None)
+        if isinstance(e, ast.Not):
+            self.check(e.expr)
+            return TInfo("bool")
+        if isinstance(e, ast.IsNull):
+            self.check(e.col)
+            return TInfo("bool")
+        if isinstance(e, (ast.InList, ast.InSelect)):
+            self.check(e.col)
+            return TInfo("bool")
+        if isinstance(e, ast.Between):
+            col = self.check(e.col)
+            if col.kind not in ORDERABLE + ("null", "any"):
+                # defs_between.go error shape
+                raise SQLError(f"type '{col.render()}' cannot be "
+                               "used as a range subscript")
+            for side in (e.lo, e.hi):
+                s = self.check(side)
+                self._equatable(col, s)
+            return TInfo("bool")
+        if isinstance(e, ast.BinOp):
+            return self._binop(e)
+        return TInfo("any")
+
+    # -- leaves ---------------------------------------------------------
+
+    def _lit(self, v) -> TInfo:
+        import datetime as dtm
+        if v is None:
+            return TInfo("null", literal=True)
+        if isinstance(v, bool):
+            return TInfo("bool", literal=True)
+        if isinstance(v, int):
+            return TInfo("int", literal=True)
+        if isinstance(v, Decimal):
+            return TInfo("decimal", scale=max(-v.as_tuple().exponent, 0),
+                         literal=True)
+        if isinstance(v, float):
+            return TInfo("decimal", scale=2, literal=True)
+        if isinstance(v, str):
+            return TInfo("string", literal=True)
+        if isinstance(v, dtm.datetime):
+            return TInfo("timestamp", literal=True)
+        if isinstance(v, list):
+            if all(isinstance(x, str) for x in v) and v:
+                return TInfo("stringset", literal=True)
+            return TInfo("idset", literal=True)
+        return TInfo("any", literal=True)
+
+    def _col(self, e: ast.Col) -> TInfo:
+        if e.name in self.extra:
+            return self.extra[e.name]
+        if e.name == "_id":
+            if self.idx is None:
+                raise SQLError("column not found: _id")
+            return TInfo("string" if self.idx.keys else "id")
+        if e.name == "*":
+            return TInfo("any")
+        if self.idx is None:
+            raise SQLError(f"column not found: {e.name}")
+        f = self.idx.field(e.name)
+        if f is None:
+            raise SQLError(f"column not found: {e.name}")
+        return field_tinfo(f)
+
+    # -- operators ------------------------------------------------------
+
+    @staticmethod
+    def _family(t: TInfo) -> str:
+        if t.kind in NUMERIC:
+            return "num"
+        return t.kind
+
+    def _coerced(self, l: TInfo, r: TInfo):
+        """Literal coercions before compatibility checks: a LITERAL
+        on one side adopts the other side's family where the engine
+        coerces at compile time — time strings / epoch ints against
+        timestamps (reference coerceValue), numeric strings against
+        BSI columns (this engine's documented extension, r03), and
+        member scalars against set columns (membership equality)."""
+        def adjust(a: TInfo, b: TInfo) -> TInfo:
+            if not a.literal:
+                return a
+            bf = self._family(b)
+            if a.kind == "string" and bf in ("timestamp", "num",
+                                             "stringset"):
+                return TInfo(b.kind, scale=b.scale, literal=True)
+            if a.kind == "int" and bf in ("timestamp", "idset"):
+                return TInfo(b.kind, literal=True)
+            # a bracket/tuple set literal matches either set family
+            if a.kind in ("idset", "stringset") and \
+                    bf in ("idset", "stringset"):
+                return TInfo(b.kind, literal=True)
+            return a
+        return adjust(l, r), adjust(r, l)
+
+    def _equatable(self, l: TInfo, r: TInfo):
+        if "null" in (l.kind, r.kind) or "any" in (l.kind, r.kind):
+            return
+        l, r = self._coerced(l, r)
+        if self._family(l) == self._family(r):
+            return
+        raise SQLError(f"types '{l.render()}' and '{r.render()}' "
+                       "are not equatable")
+
+    def _require(self, op: str, sides: list[TInfo], kinds: tuple):
+        for s in sides:
+            if s.kind in ("null", "any"):
+                continue
+            if s.kind not in kinds:
+                raise SQLError(f"operator '{op}' incompatible "
+                               f"with type '{s.render()}'")
+
+    def _binop(self, e: ast.BinOp) -> TInfo:
+        op = e.op
+        l, r = self.check(e.left), self.check(e.right)
+        OPS = op.upper() if op in ("and", "or") else op
+        if op in ("and", "or"):
+            self._require(OPS, [l, r], ("bool",))
+            return TInfo("bool")
+        if op in ("=", "!="):
+            self._equatable(l, r)
+            return TInfo("bool")
+        if op in ("<", "<=", ">", ">="):
+            lc, rc = self._coerced(l, r)
+            self._require(op, [lc, rc], ORDERABLE)
+            self._equatable(lc, rc)
+            return TInfo("bool")
+        if op in ("&", "|", "<<", ">>"):
+            self._require(op, [l, r], ("int", "id"))
+            return TInfo("int")
+        if op == "%":
+            self._require(op, [l, r], ("int", "id"))
+            return TInfo("int")
+        if op in ("+", "-", "*", "/"):
+            self._require(op, [l, r], NUMERIC)
+            if "decimal" in (l.kind, r.kind):
+                return TInfo("decimal", scale=max(l.scale, r.scale))
+            return TInfo("int")
+        if op == "||":
+            self._require(op, [l, r], ("string",))
+            return TInfo("string")
+        if op == "like":
+            self._require("LIKE", [l, r], ("string",))
+            return TInfo("bool")
+        return TInfo("any")
+
+
+def check_select(eng, idx, stmt, items) -> None:
+    """Type-check a SELECT's expressions against the schema (the
+    analyze pass the reference runs before planning)."""
+    tc = TypeChecker(eng, idx)
+    for it in items:
+        tc.check(it.expr)
+    if stmt.where is not None:
+        tc.check(stmt.where)
+    for ob in stmt.order_by:
+        e = ob.expr
+        if isinstance(e, ast.Lit):
+            continue  # projection ordinal
+        if isinstance(e, ast.Col) and (
+                idx is None or (e.name != "_id"
+                                and idx.field(e.name) is None)):
+            continue  # projection alias — resolved against outputs
+        tc.check(e)

@@ -276,6 +276,27 @@ class WhereCompiler:
             # nothing (use IS NULL for null tests)
             return Call("ConstRow", args={"columns": []})
         if name == "_id":
+            if op in ("<", "<=", ">", ">="):
+                # range predicates on _id (defs_delete: `where _id >
+                # 4`): materialize existing ids and filter — _id is
+                # not a BSI field, so there is no device range scan
+                if idx.keys:
+                    raise SQLError(
+                        "_id range predicates require an integer _id")
+                if isinstance(val, str):
+                    try:
+                        val = int(val)
+                    except ValueError:
+                        raise SQLError(
+                            f"_id bound must be numeric, got {val!r}")
+                import operator
+                cmp = {"<": operator.lt, "<=": operator.le,
+                       ">": operator.gt, ">=": operator.ge}[op]
+                res = eng.executor._execute_call(idx, Call("All"),
+                                                 None)
+                cols = [int(c) for c in res.columns()
+                        if cmp(int(c), val)]
+                return Call("ConstRow", args={"columns": cols})
             cid = eng._col_id(idx, val, create=False)
             cols = [cid] if cid is not None else []
             # intersect with existence: a ConstRow bit for a missing
