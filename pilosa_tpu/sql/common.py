@@ -137,7 +137,9 @@ def order_rows(stmt, schema, rows, srcmap=None):
             matches = [srcmap[name]]
         if len(matches) != 1:
             raise SQLError(
-                f"ORDER BY column {name!r} not in projection"
+                f"ORDER BY column {name!r} not in projection "
+                "(column reference, alias reference or column "
+                "position expected)"
                 if not matches else
                 f"ORDER BY column {name!r} is ambiguous")
         i = matches[0]
@@ -154,12 +156,32 @@ def limit_rows(stmt, rows):
     return rows[off:] if off else rows
 
 
+def rfc3339(d: dt.datetime) -> str:
+    """RFC3339 with a Z suffix — the reference's timestamp rendering
+    (naive datetimes are UTC throughout the engine)."""
+    if d.tzinfo is not None:
+        d = d.astimezone(dt.timezone.utc).replace(tzinfo=None)
+    s = d.isoformat()
+    return s + "Z"
+
+
 def to_sql_value(v):
+    """Output rendering: timestamps as RFC3339-Z strings, empty sets
+    as NULL."""
     if isinstance(v, dt.datetime):
-        return v.isoformat()
+        return rfc3339(v)
     if isinstance(v, list) and not v:
         # a set column with no members IS NULL (defs_null: `ids1 is
         # null` is true for an empty set; defs_set: setcontains on it
         # yields NULL)
+        return None
+    return v
+
+
+def to_env_value(v):
+    """Evaluator-environment value: empty sets are NULL, but
+    timestamps STAY datetimes so CAST/date functions see the typed
+    value, not its rendering."""
+    if isinstance(v, list) and not v:
         return None
     return v

@@ -149,12 +149,26 @@ class SQLEngine:
         if isinstance(stmt, ast.DropTable):
             return st.drop_table(stmt)
         if isinstance(stmt, ast.ShowTables):
+            # the reference's 9-column table listing
+            # (sql3/planner/compileshow.go; defs_sql1 show tables).
+            # No per-table audit metadata is tracked: _id/owner/
+            # updated_by/description are empty and timestamps are the
+            # epoch, as the reference emits for untracked fields.
             names = sorted(self.holder.indexes)
             if auth_check is not None:
                 names = [n for n in names
                          if self._can_read(auth_check, n)]
-            return SQLResult(schema=[("name", "string")],
-                             rows=[(n,) for n in names])
+            epoch = "1970-01-01T00:00:00"
+            return SQLResult(
+                schema=[("_id", "string"), ("name", "string"),
+                        ("owner", "string"), ("updated_by", "string"),
+                        ("created_at", "timestamp"),
+                        ("updated_at", "timestamp"), ("keys", "bool"),
+                        ("space_used", "int"),
+                        ("description", "string")],
+                rows=[(None, n, "", "", epoch, epoch,
+                       bool(self.holder.index(n).keys), 0, "")
+                      for n in names])
         if isinstance(stmt, ast.ShowColumns):
             return st.show_columns(stmt)
         if isinstance(stmt, ast.ShowCreateTable):

@@ -205,12 +205,20 @@ def _cast(v, t: str, scale: int):
         raise SQLError(
             msg or f"{type(v).__name__!s} cannot be cast to {t!r}")
     if t in ("idset", "stringset"):
+        # identity casts only (defs_cast: sets cast to themselves and
+        # to string; static analysis rejects the rest)
+        if isinstance(v, list):
+            return v
         no()
     if t in ("int", "id"):
         if isinstance(v, bool):
             out = int(v)
         elif isinstance(v, int):
             out = v
+        elif isinstance(v, dt.datetime):
+            # timestamp -> epoch seconds (defs_cast castTimestamp_0)
+            epoch = dt.datetime(1970, 1, 1, tzinfo=v.tzinfo)
+            out = int((v - epoch).total_seconds())
         elif isinstance(v, (float, Decimal)):
             out = int(v)  # truncate toward zero
         elif isinstance(v, str):
@@ -227,9 +235,8 @@ def _cast(v, t: str, scale: int):
         if isinstance(v, bool):
             return v
         if isinstance(v, int):
-            if v in (0, 1):
-                return bool(v)
-            no("bool cast requires 0 or 1")
+            # any non-zero int is true (defs_cast castInt_1)
+            return v != 0
         if isinstance(v, str):
             if v.lower() in ("true", "false"):
                 return v.lower() == "true"
@@ -250,7 +257,12 @@ def _cast(v, t: str, scale: int):
         if isinstance(v, bool):
             return "true" if v else "false"
         if isinstance(v, dt.datetime):
-            return v.isoformat()
+            from pilosa_tpu.sql.common import rfc3339
+            return rfc3339(v)
+        if isinstance(v, list):
+            # sets render as a JSON-style quoted list
+            # (defs_cast castIDSet_5: '["101","102"]')
+            return "[" + ",".join(f'"{m}"' for m in v) + "]"
         if isinstance(v, (int, float, Decimal, str)):
             return str(v)
         no()

@@ -305,16 +305,44 @@ class Parser:
             self.expect("op", "(")
             row = []
             while True:
-                row.append(self.literal_value())
+                row.append(self._insert_value())
                 if not self.accept("op", ","):
                     break
             self.expect("op", ")")
             if cols is not None and len(row) != len(cols):
-                raise SQLError("VALUES arity mismatch")
+                raise SQLError("mismatch in the count of expressions "
+                               "and target columns")
             rows.append(row)
             if not self.accept("op", ","):
                 break
         return ast.Insert(table, cols, rows, replace=replace)
+
+    def _insert_value(self):
+        """One VALUES cell: a literal, or a constant scalar
+        expression folded at parse time (defs_inserts: 40*10,
+        'foo' || 'bar', 1 > 2)."""
+        # fast path: plain literal / tuple / bracket-set / negative
+        t, t1 = self.peek(), self.peek(1)
+        terminator = t1.kind == "op" and t1.value in (",", ")")
+        if terminator and (
+                t.kind in ("number", "string") or
+                (t.kind == "keyword"
+                 and t.value in ("true", "false", "null"))):
+            return self.literal_value()
+        if t.kind == "op" and t.value in ("(", "["):
+            return self.literal_value()
+        if t.kind == "op" and t.value == "-" and \
+                t1.kind == "number":
+            return self.literal_value()
+        if t.kind == "ident" and t.value.lower() in (
+                "current_timestamp", "current_date"):
+            return self.literal_value()
+        # constant expression: parse and evaluate with no row context
+        e = self.expr()
+        if isinstance(e, ast.Lit):
+            return e.value
+        from pilosa_tpu.sql.funcs import Evaluator
+        return Evaluator().eval(e, {})
 
     def bulk_insert(self):
         """BULK INSERT INTO t (_id, a, b) FROM '<src>' WITH FORMAT

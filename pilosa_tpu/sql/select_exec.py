@@ -25,6 +25,7 @@ from pilosa_tpu.sql.common import (
     ordinal_index,
     sorted_nulls_last,
     sql_type_of,
+    to_env_value,
     to_sql_value,
 )
 from pilosa_tpu.sql.lexer import SQLError
@@ -124,7 +125,8 @@ class SelectExec:
             e = it.expr
             if isinstance(e, ast.Agg):
                 schema.append((name_of(it), self.agg_type(idx, e)))
-                row_vals.append(self.eval_agg(idx, e, filt))
+                row_vals.append(to_sql_value(
+                    self.eval_agg(idx, e, filt)))
                 continue
             folded = self._fold_agg_values(idx, e, filt)
             from pilosa_tpu.sql.funcs import Evaluator
@@ -167,6 +169,8 @@ class SelectExec:
         min/max — aggregates host-side over an Extract."""
         if a.func == "count" and a.arg is None:
             return True
+        if a.func in ("var", "corr"):
+            return True  # eval_var_corr takes arbitrary expressions
         if not isinstance(a.arg, ast.Col):
             return False
         name = a.arg.name
@@ -250,7 +254,7 @@ class SelectExec:
         ev = Evaluator(udfs=eng._udf_callables())
         vals = []
         for entry in table.columns:
-            env = {n: to_sql_value(entry["rows"][i])
+            env = {n: to_env_value(entry["rows"][i])
                    for i, n in enumerate(cols)}
             env["_id"] = entry.get("column_key", entry["column"])
             v = ev.eval(a.arg, env)
@@ -283,27 +287,39 @@ class SelectExec:
         """VAR(x): population variance; CORR(x, y): Pearson
         correlation — both buffer the matching values like the
         reference's aggregateVar/aggregateCorr (expressionagg.go:949,
-        1197) and return decimals at scale 6."""
+        1197) and return decimals at scale 6.  Args may be arbitrary
+        numeric expressions (var(len(s1)), defs_aggregate
+        varTests_6)."""
         from decimal import Decimal
+
+        from pilosa_tpu.sql.funcs import Evaluator, columns_in
         eng = self.eng
         if a.arg is None:
             raise SQLError(f"{a.func} requires a column argument")
-        names = [a.arg.name]
+        exprs = [a.arg]
         if a.func == "corr":
-            names.append(col_name(a.extra))
-        for n in names:
-            f = eng._field(idx, n)
-            if f.options.type not in (FieldType.INT, FieldType.DECIMAL):
-                raise SQLError(f"{a.func} requires a numeric column")
+            exprs.append(a.extra)
+        ref_cols = sorted({n for e in exprs for n in columns_in(e)
+                           if n != "_id"})
+        for n in ref_cols:
+            eng._field(idx, n)
         c = Call("Extract", children=[filt] + [
-            Call("Rows", args={"_field": n}) for n in names])
+            Call("Rows", args={"_field": n}) for n in ref_cols])
         table = eng.executor._execute_call(idx, c, None)
+        ev = Evaluator(udfs=eng._udf_callables())
         cols = [[], []]
         for entry in table.columns:
-            vals = [entry["rows"][i] for i in range(len(names))]
+            env = {n: to_env_value(entry["rows"][i])
+                   for i, n in enumerate(ref_cols)}
+            env["_id"] = entry.get("column_key", entry["column"])
+            vals = [ev.eval(e, env) for e in exprs]
             if any(v is None for v in vals):
                 continue  # reference skips nil rows
             for i, v in enumerate(vals):
+                if isinstance(v, bool) or not isinstance(
+                        v, (int, float, Decimal)):
+                    raise SQLError(
+                        f"{a.func} requires a numeric column")
                 cols[i].append(float(v))
         xs = cols[0]
         n = len(xs)
@@ -454,6 +470,12 @@ class SelectExec:
         groups: dict[tuple, list] = {}
         for rid in self.table_ids(idx, filt):
             key = tuple(self.group_key(idx, g, rid) for g in group_cols)
+            if any(k is None for k in key):
+                # records NULL in a group column form no group
+                # (defs_sql1 grouper: the NULL-color row is absent
+                # from `group by age, color`; matches the PQL
+                # GroupBy's member-based semantics)
+                continue
             groups.setdefault(key, []).append(rid)
 
         rows = []
@@ -744,7 +766,7 @@ class SelectExec:
         for entry in table.columns:
             env = None
             if need_env:
-                env = {n: to_sql_value(entry["rows"][i])
+                env = {n: to_env_value(entry["rows"][i])
                        for i, n in enumerate(extract_cols)}
                 env["_id"] = entry.get("column_key", entry["column"])
             vals = []

@@ -182,9 +182,15 @@ class StatementExec:
                                       if n != EXISTENCE_FIELD]
             for row in stmt.rows:
                 if len(row) != len(stmt.columns):
-                    raise SQLError("VALUES arity mismatch")
+                    raise SQLError(
+                        "mismatch in the count of expressions and "
+                        "target columns")
         if "_id" not in stmt.columns:
             raise SQLError("INSERT requires an _id column")
+        if len(stmt.columns) == 1:
+            # defs_inserts insertTest_11
+            raise SQLError("insert column list must have at least "
+                           "one non '_id' column specified")
         id_pos = stmt.columns.index("_id")
         fields = []
         for c in stmt.columns:
@@ -195,14 +201,30 @@ class StatementExec:
             if f is None:
                 raise SQLError(f"column not found: {c}")
             fields.append(f)
-        for row in stmt.rows:
-            self.apply_record(idx, fields, row, id_pos, stmt.replace)
+        for row_no, row in enumerate(stmt.rows, 1):
+            self.apply_record(idx, fields, row, id_pos, stmt.replace,
+                              row_no=row_no)
         return SQLResult()
 
-    def apply_record(self, idx, fields, row, id_pos, replace):
+    def apply_record(self, idx, fields, row, id_pos, replace,
+                     row_no: int = 1):
         """Write one record's values (shared by INSERT / BULK
         INSERT)."""
         eng = self.eng
+        # min/max constraint enforcement (defs_inserts: inserting a
+        # value outside the declared int bounds is an error, not a
+        # clamp)
+        for f, v in zip(fields, row):
+            if f is None or v is None:
+                continue
+            o = f.options
+            if o.type == FieldType.INT and isinstance(v, int) and \
+                    not isinstance(v, bool):
+                if (o.min is not None and v < o.min) or \
+                        (o.max is not None and v > o.max):
+                    raise SQLError(
+                        f"inserting value into column '{f.name}', "
+                        f"row {row_no}, value '{v}' out of range")
         col = eng._col_id(idx, row[id_pos])
         if replace:
             # full-record replace: drop existing values first

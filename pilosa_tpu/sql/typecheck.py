@@ -104,19 +104,13 @@ class TypeChecker:
         if isinstance(e, ast.Col):
             return self._col(e)
         if isinstance(e, ast.Agg):
-            for sub in (e.arg,):
-                if isinstance(sub, ast.Col):
-                    self._col(sub)
-            if e.func == "count":
-                return TInfo("int")
-            if e.func in ("avg", "var", "corr"):
-                return TInfo("decimal", scale=6)
-            if isinstance(e.arg, ast.Col):
-                return self._col(e.arg)
-            return TInfo("any")
+            return self._check_agg(e)
         if isinstance(e, ast.Func):
-            for x in e.args:
-                self.check(x)
+            arg_ts = [self.check(x) for x in e.args]
+            if e.name.startswith("SETCONTAINS"):
+                self._check_setcontains(e, arg_ts)
+            elif e.name == "CAST":
+                self._check_cast(e, arg_ts)
             udf = self.eng._udf_types().get(e.name) \
                 if self.eng is not None else None
             if udf is not None:
@@ -145,6 +139,108 @@ class TypeChecker:
         if isinstance(e, ast.BinOp):
             return self._binop(e)
         return TInfo("any")
+
+    def _check_agg(self, e: ast.Agg) -> TInfo:
+        """Aggregate argument analysis (defs_aggregate): COUNT takes
+        '*' or a column reference only; _id is barred from
+        sum/avg/min/max; sum/avg need a numeric expression."""
+        if e.func == "count":
+            if e.arg is not None and not isinstance(e.arg, ast.Col):
+                raise SQLError("count: column reference expected")
+            if isinstance(e.arg, ast.Col) and e.arg.name != "_id":
+                self._col(e.arg)
+            return TInfo("int")
+        argt = self.check(e.arg) if e.arg is not None else TInfo("any")
+        if isinstance(e.arg, ast.Col) and e.arg.name == "_id" and \
+                e.func in ("sum", "avg", "min", "max", "percentile"):
+            raise SQLError("_id column cannot be used in aggregate "
+                           f"function '{e.func}'")
+        if e.func in ("sum", "avg", "var", "corr") and \
+                argt.kind not in NUMERIC + ("null", "any"):
+            raise SQLError("integer or decimal expression expected")
+        if e.func == "corr" and isinstance(e.extra, ast.Col):
+            xt = self._col(e.extra)
+            if xt.kind not in NUMERIC + ("null", "any"):
+                raise SQLError(
+                    "integer or decimal expression expected")
+        if e.func in ("avg", "var", "corr"):
+            return TInfo("decimal", scale=6 if e.func != "avg" else 4)
+        if e.func in ("sum", "min", "max", "percentile"):
+            return argt
+        return TInfo("any")
+
+    _CASTABLE = {
+        # target -> allowed source kinds (defs_cast.go matrix)
+        "int": ("int", "id", "bool", "string", "timestamp"),
+        "id": ("id", "int", "string"),
+        "bool": ("bool", "int", "string"),
+        "decimal": ("decimal", "int", "id", "string"),
+        "string": ("string", "int", "id", "bool", "decimal",
+                   "timestamp", "idset", "stringset"),
+        "timestamp": ("timestamp", "int", "string"),
+        "idset": ("idset",),
+        "stringset": ("stringset",),
+    }
+
+    def _check_cast(self, e, arg_ts) -> None:
+        if len(arg_ts) != 3 or not isinstance(e.args[1], ast.Lit):
+            return
+        src, tgt = arg_ts[0], e.args[1].value
+        if src.kind in ("null", "any"):
+            return
+        allowed = self._CASTABLE.get(tgt)
+        if allowed is not None and src.kind not in allowed:
+            tgt_r = tgt
+            if tgt == "decimal" and isinstance(e.args[2], ast.Lit):
+                tgt_r = f"decimal({e.args[2].value or 0})"
+            raise SQLError(
+                f"'{src.render()}' cannot be cast to '{tgt_r}'")
+
+    def _check_setcontains(self, e, arg_ts) -> None:
+        """SETCONTAINS* analysis (defs_set_functions
+        setParameterTests): arg0 must be a set; SETCONTAINS compares
+        a member scalar, ANY/ALL compare a set; element families
+        must match."""
+        if len(arg_ts) != 2:
+            return  # arity handled at evaluation
+        s, v = arg_ts
+        # set literals validate their members
+        for i, x in enumerate(e.args):
+            if isinstance(x, ast.Lit) and isinstance(x.value, list):
+                vals = x.value
+                if any(m is None for m in vals) or not (
+                        all(isinstance(m, str) for m in vals) or
+                        all(isinstance(m, int) and
+                            not isinstance(m, bool) for m in vals)):
+                    raise SQLError(
+                        "set literal must contain ints or strings")
+        if s.kind in ("null", "any"):
+            if s.kind == "null":
+                raise SQLError("set expression expected")
+            return
+        if s.kind not in ("idset", "stringset"):
+            raise SQLError("set expression expected")
+        elem = "string" if s.kind == "stringset" else "id"
+        if v.kind in ("any",):
+            return
+        if e.name == "SETCONTAINS":
+            if v.kind == "null":
+                raise SQLError(f"types '{s.render()}' and 'void' "
+                               "are not equatable")
+            if v.kind in ("idset", "stringset") or \
+                    self._family(v) != self._family(TInfo(elem)):
+                raise SQLError(f"types '{s.render()}' and "
+                               f"'{v.render()}' are not equatable")
+        else:  # ANY / ALL take a set argument
+            if v.kind not in ("idset", "stringset"):
+                raise SQLError("set expression expected")
+            if isinstance(e.args[1], ast.Lit) and \
+                    e.args[1].value == []:
+                return  # the empty set matches either family
+            velem = "string" if v.kind == "stringset" else "id"
+            if self._family(TInfo(elem)) != self._family(TInfo(velem)):
+                raise SQLError(f"types '{elem}' and '{velem}' "
+                               "are not equatable")
 
     # -- leaves ---------------------------------------------------------
 

@@ -17,7 +17,7 @@ from __future__ import annotations
 from pilosa_tpu.models import FieldType
 from pilosa_tpu.pql.ast import Call, Condition
 from pilosa_tpu.sql import ast
-from pilosa_tpu.sql.common import to_sql_value
+from pilosa_tpu.sql.common import to_env_value
 from pilosa_tpu.sql.lexer import SQLError
 
 _CMP_OPS = ("=", "!=", "<", "<=", ">", ">=", "like")
@@ -147,7 +147,7 @@ class WhereCompiler:
         ev = Evaluator(udfs=eng._udf_callables())
         out = []
         for entry in table.columns:
-            env = {n: to_sql_value(entry["rows"][i])
+            env = {n: to_env_value(entry["rows"][i])
                    for i, n in enumerate(cols)}
             env["_id"] = entry.get("column_key", entry["column"])
             v = ev.eval(residue, env)

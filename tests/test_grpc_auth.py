@@ -263,7 +263,7 @@ def test_sql_authz_per_table(stack_auth=None):
         assert e.value.code() == grpc.StatusCode.PERMISSION_DENIED
         # SHOW TABLES only lists readable tables
         t = sql("SHOW TABLES", ["sales-ro"])
-        assert [r.columns[0].stringVal for r in t.rows] == ["sales"]
+        assert [r.columns[1].stringVal for r in t.rows] == ["sales"]
         # GetIndexes filters the same way
         fn = chan.unary_unary(
             "/proto.Pilosa/GetIndexes",
