@@ -14,6 +14,10 @@ class ColumnDef:
     min: int | None = None
     max: int | None = None
     time_quantum: str | None = None
+    # timestamp storage granularity + base (sql3 timeunit/epoch
+    # column options; defs_date_functions tables)
+    time_unit: str | None = None
+    epoch: str | None = None
 
 
 @dataclass

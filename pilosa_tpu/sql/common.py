@@ -32,6 +32,15 @@ _SQL_TYPE_FOR_FIELD = {
 }
 
 
+def declared_fields(idx) -> list:
+    """Public fields in CREATE TABLE declaration order (the fields
+    dict preserves insertion order) — SQL's `*` expansion and SHOW
+    COLUMNS order (defs_keyed select-all; Index.public_fields sorts
+    by name instead)."""
+    from pilosa_tpu.models.index import EXISTENCE_FIELD
+    return [f for n, f in idx.fields.items() if n != EXISTENCE_FIELD]
+
+
 def sql_type_of(f) -> str:
     """SQL type name for a field (sql3's WireQueryField data types)."""
     t = f.options.type

@@ -42,6 +42,10 @@ def _i(v, fn):
 def _ts(v, fn) -> dt.datetime:
     if isinstance(v, dt.datetime):
         return v
+    if isinstance(v, int) and not isinstance(v, bool):
+        # epoch-seconds coercion (defs_date_functions
+        # DateTimePartImplicitIntConversion)
+        return dt.datetime(1970, 1, 1) + dt.timedelta(seconds=v)
     if isinstance(v, str):
         try:
             return dt.datetime.fromisoformat(v.replace("Z", "+00:00"))
@@ -411,7 +415,27 @@ def _dispatch(name: str, a: list):
                     "Thursday", "Friday", "Saturday"][_weekday(d)]
         return str(v)
     if name == "DATE_TRUNC":
-        return _trunc(_s(a[0], name), _ts(a[1], name))
+        # returns the truncated PREFIX STRING, not a timestamp
+        # ('yy' -> '2012', 'mi' -> '2012-11-01T22:08';
+        # defs_date_functions dateTruncTests)
+        d = _ts(a[1], name)
+        iv = _s(a[0], name).upper()
+        fmt = {_IV_YEAR: "%Y", _IV_MONTH: "%Y-%m",
+               _IV_DAY: "%Y-%m-%d", _IV_HOUR: "%Y-%m-%dT%H",
+               _IV_MIN: "%Y-%m-%dT%H:%M",
+               _IV_SEC: "%Y-%m-%dT%H:%M:%S"}.get(iv)
+        if fmt is not None:
+            return d.strftime(fmt)
+        if iv == _IV_MS:
+            return d.strftime("%Y-%m-%dT%H:%M:%S.") + \
+                f"{d.microsecond // 1000:03d}"
+        if iv == _IV_US:
+            return d.strftime("%Y-%m-%dT%H:%M:%S.") + \
+                f"{d.microsecond:06d}"
+        if iv == _IV_NS:
+            return d.strftime("%Y-%m-%dT%H:%M:%S.") + \
+                f"{d.microsecond * 1000:09d}"
+        raise SQLError(f"invalid interval {a[0]!r} for DATE_TRUNC")
     if name == "DATETIMEADD":
         return _add(_s(a[0], name), _i(a[1], name), _ts(a[2], name))
     if name == "DATETIMEDIFF":
@@ -462,7 +486,7 @@ FUNC_TYPES = {
     "STR": "string", "DATETIMENAME": "string",
     "LEN": "int", "ASCII": "int", "CHARINDEX": "int",
     "DATETIMEPART": "int", "DATETIMEDIFF": "int",
-    "DATE_TRUNC": "timestamp", "DATETIMEADD": "timestamp",
+    "DATE_TRUNC": "string", "DATETIMEADD": "timestamp",
     "DATETIMEFROMPARTS": "timestamp", "TOTIMESTAMP": "timestamp",
     "SETCONTAINS": "bool", "SETCONTAINSANY": "bool",
     "SETCONTAINSALL": "bool",
@@ -506,7 +530,17 @@ class Evaluator:
             v = self.eval(e.col, env)
             if v is None:
                 return None
-            hit = v in e.items
+            items = e.items
+            if isinstance(v, dt.datetime):
+                # timestamp IN ('2012-...Z', ...): coerce the list
+                items = [_ts(x, "IN") if isinstance(x, str) else x
+                         for x in items]
+            elif isinstance(v, str) and any(
+                    isinstance(x, dt.datetime) for x in items):
+                v = _ts(v, "IN")
+            hit = v in items
+            if not hit and any(x is None for x in items):
+                return None  # strict SQL: x IN (..., NULL) is UNKNOWN
             return (not hit) if e.negated else hit
         if isinstance(e, ast.Between):
             v = self.eval(e.col, env)

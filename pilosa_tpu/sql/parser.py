@@ -143,12 +143,17 @@ class Parser:
             cd.scale = int(self.expect("number").value)
             self.expect("op", ")")
         # column constraints subset: min/max for int ("min"/"max"
-        # lex as keywords, "timequantum" as an ident)
+        # lex as keywords, "timequantum"/"timeunit"/"epoch" as idents)
         while self.peek().kind in ("ident", "keyword") and \
-                self.peek().value.lower() in ("min", "max", "timequantum"):
+                self.peek().value.lower() in (
+                    "min", "max", "timequantum", "timeunit", "epoch"):
             opt = self.next().value.lower()
             if opt == "timequantum":
                 cd.time_quantum = self.expect("string").value
+            elif opt == "timeunit":
+                cd.time_unit = self.expect("string").value
+            elif opt == "epoch":
+                cd.epoch = self.expect("string").value
             else:
                 neg = self.accept("op", "-") is not None
                 v = int(self.expect("number").value)

@@ -23,6 +23,7 @@ from __future__ import annotations
 from pilosa_tpu.pql.ast import Call
 from pilosa_tpu.sql import ast
 from pilosa_tpu.sql.common import SQLResult
+from pilosa_tpu.sql.common import declared_fields as _declared_fields
 from pilosa_tpu.sql.lexer import SQLError
 from pilosa_tpu.sql.wherec import has_subquery, split_where
 
@@ -256,7 +257,7 @@ def plan_select(eng, stmt: ast.Select) -> PlanOp:
         if isinstance(it.expr, ast.Col) and it.expr.name == "*":
             items.append(ast.SelectItem(ast.Col("_id"), "_id"))
             items += [ast.SelectItem(ast.Col(f.name), f.name)
-                      for f in idx.public_fields()]
+                      for f in _declared_fields(idx)]
         else:
             items.append(it)
 

@@ -17,6 +17,7 @@ from pilosa_tpu.pql.ast import Call, Condition
 from pilosa_tpu.sql import ast
 from pilosa_tpu.sql.common import (
     SQLResult,
+    declared_fields,
     distinct_key,
     is_ordinal,
     limit_rows,
@@ -1132,7 +1133,7 @@ class SelectExec:
             idx = sides[si][2]
             pre = f"{sides[si][0]}." if qualify else ""
             add_col(si, "_id", pre + "_id")
-            for f in idx.public_fields():
+            for f in declared_fields(idx):
                 add_col(si, f.name, pre + f.name)
 
         for it in stmt.items:

@@ -12,7 +12,8 @@ from __future__ import annotations
 from pilosa_tpu.models import FieldOptions, FieldType, TimeQuantum
 from pilosa_tpu.pql.ast import Call
 from pilosa_tpu.sql import ast
-from pilosa_tpu.sql.common import SQLResult, sql_type_of
+from pilosa_tpu.sql.common import (SQLResult, declared_fields,
+                                    sql_type_of)
 from pilosa_tpu.sql.lexer import SQLError
 
 
@@ -59,7 +60,26 @@ class StatementExec:
         if t == "decimal":
             return FieldOptions(type=FieldType.DECIMAL, scale=cd.scale)
         if t == "timestamp":
-            return FieldOptions(type=FieldType.TIMESTAMP)
+            kw = {}
+            if cd.time_unit is not None:
+                kw["time_unit"] = cd.time_unit
+            if cd.epoch is not None:
+                from pilosa_tpu.models import timeq
+                try:
+                    kw["epoch"] = timeq.parse_time(cd.epoch).replace(
+                        tzinfo=__import__("datetime").timezone.utc)
+                except ValueError as e:
+                    raise SQLError(
+                        f"invalid value {cd.epoch!r} for parameter "
+                        f"'epoch'") from e
+            try:
+                return FieldOptions(type=FieldType.TIMESTAMP, **kw)
+            except ValueError as e:
+                # reference message shape (defs_date_functions):
+                # invalid value 'x' for parameter 'timeunit'
+                raise SQLError(
+                    f"invalid value {cd.time_unit!r} for parameter "
+                    "'timeunit'") from e
         if t == "bool":
             return FieldOptions(type=FieldType.BOOL)
         if t == "id":
@@ -92,7 +112,8 @@ class StatementExec:
     def show_columns(self, stmt: ast.ShowColumns) -> SQLResult:
         idx = self.eng._index(stmt.table)
         rows = [("_id", "string" if idx.keys else "id")]
-        rows += [(f.name, sql_type_of(f)) for f in idx.public_fields()]
+        rows += [(f.name, sql_type_of(f))
+                 for f in declared_fields(idx)]
         return SQLResult(schema=[("name", "string"),
                                  ("type", "string")], rows=rows)
 
@@ -101,7 +122,7 @@ class StatementExec:
         an equivalent table (sql3's SHOW CREATE TABLE)."""
         idx = self.eng._index(stmt.table)
         defs = [f"_id {'string' if idx.keys else 'id'}"]
-        for f in idx.public_fields():
+        for f in declared_fields(idx):
             t = sql_type_of(f)
             d = f"{f.name} {t}"
             o = f.options

@@ -115,9 +115,13 @@ class WhereCompiler:
 
     def fold_subqueries(self, e):
         """Replace scalar SubQuery nodes with their evaluated literal
-        (uncorrelated — they run once at compile time)."""
+        and IN-subqueries with materialized IN lists (uncorrelated —
+        they run once at compile time)."""
         if isinstance(e, ast.SubQuery):
             return ast.Lit(self.scalar_subquery(e.select))
+        if isinstance(e, ast.InSelect):
+            return ast.InList(e.col, self.subquery_column(e.select),
+                              negated=e.negated)
         if isinstance(e, ast.BinOp):
             return ast.BinOp(e.op, self.fold_subqueries(e.left),
                              self.fold_subqueries(e.right))
@@ -333,6 +337,15 @@ class WhereCompiler:
 
     def in_list(self, idx, e: ast.InList) -> Call:
         eng = self.eng
+        # strict SQL NULL handling: NULL list members never match;
+        # NOT IN against a list containing NULL is never TRUE
+        # (UNKNOWN for non-matches) -> empty result
+        if any(v is None for v in e.items):
+            if e.negated:
+                return Call("ConstRow", args={"columns": []})
+            e = ast.InList(e.col, [v for v in e.items
+                                   if v is not None],
+                           negated=False)
         name = col_name(e.col)
         if name == "_id":
             cols = []

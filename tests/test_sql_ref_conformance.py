@@ -1,0 +1,91 @@
+"""Runner for the ported reference SQL conformance corpus
+(tests/sql_defs_ref.py; sql3/sql_test.go analog).
+
+Each FAMILY runs as ONE test: a fresh engine takes the family's
+setup (plus any sibling tables its SQL names — the reference's
+harness hosts every TableTest in one cluster), then the cases run IN
+ORDER so earlier DML is visible to later cases."""
+
+import re
+from decimal import Decimal
+
+import pytest
+
+from pilosa_tpu.models import Holder
+from pilosa_tpu.sql import SQLEngine, SQLError
+
+from tests.sql_defs_ref import FAMILIES
+
+W = 1 << 12
+
+
+def conv_exp(v):
+    if isinstance(v, tuple) and len(v) == 3 and v[0] == "DEC":
+        return Decimal(v[1]) / (10 ** v[2])
+    if isinstance(v, tuple) and len(v) == 2 and v[0] == "TS":
+        return v[1]
+    return v
+
+
+def canon(rows):
+    """Order-free multiset comparison; sets compare as sorted string
+    tuples, numerics through float, bools as ints (the reference's
+    CompareExactUnordered + SortStringKeys)."""
+    def cell(v):
+        if isinstance(v, list):
+            return tuple(sorted(map(str, v)))
+        if isinstance(v, Decimal):
+            return float(v)
+        if isinstance(v, bool):
+            return int(v)
+        return v
+    return sorted((tuple(cell(c) for c in r) for r in rows), key=repr)
+
+
+def _table_of(stmts):
+    m = re.match(r"CREATE TABLE (\S+)", stmts[0])
+    return m.group(1) if m else None
+
+
+def effective_setup(setup, sql):
+    """Own setup plus any sibling family's table named in the SQL."""
+    out = list(setup or [])
+    own = {_table_of(setup)} if setup else set()
+    for _n, s, _c in FAMILIES:
+        if not s:
+            continue
+        t = _table_of(s)
+        if t and t not in own and re.search(
+                r"\b" + re.escape(t) + r"\b", sql):
+            out.extend(s)
+            own.add(t)
+    return out
+
+
+@pytest.mark.parametrize(
+    "origin,setup,cases", FAMILIES, ids=[f[0] for f in FAMILIES])
+def test_reference_family(origin, setup, cases):
+    eng = SQLEngine(Holder(width=W))
+    all_sql = " ".join(sql for _n, sql, _e in cases)
+    seen = set()
+    for s in effective_setup(setup, all_sql):
+        if s not in seen:
+            seen.add(s)
+            eng.query(s)
+    for cname, sql, exp in cases:
+        if isinstance(exp, tuple) and exp and exp[0] == "error":
+            with pytest.raises(SQLError) as exc:
+                for _res in eng.query(sql):
+                    pass
+            assert exp[1].lower() in str(exc.value).lower(), \
+                (cname, exc.value)
+            continue
+        got = eng.query(sql)[-1].rows
+        expc = [tuple(conv_exp(c) for c in r) for r in exp]
+        assert canon(got) == canon(expc), (cname, got, expc)
+
+
+def test_corpus_size_bar():
+    """The verdict's round-4 bar: >= 600 ported reference cases."""
+    n = sum(len(c) for _o, _s, c in FAMILIES)
+    assert n >= 600, n
