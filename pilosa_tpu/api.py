@@ -254,7 +254,8 @@ class API:
 
     def import_bits(self, index: str, field: str, rows=None, cols=None,
                     row_keys=None, col_keys=None, timestamps=None,
-                    clear: bool = False) -> int:
+                    clear: bool = False,
+                    mark_exists: bool = True) -> int:
         self._check_writable()
         idx = self._index(index)
         f = idx.field(field)
@@ -272,7 +273,8 @@ class API:
                     n += bool(f.clear_bit(int(r), int(c)))
                 return n
             f.import_bits(rows, cols, timestamps)
-            idx.mark_columns_exist(cols)
+            if mark_exists:
+                idx.mark_columns_exist(cols)
         n = len(cols)
         metrics.IMPORTED_BITS.inc(n, index=index)
         return n
@@ -345,7 +347,8 @@ class API:
             return lk
 
     def import_values(self, index: str, field: str, cols=None, values=None,
-                      col_keys=None, clear: bool = False) -> int:
+                      col_keys=None, clear: bool = False,
+                      mark_exists: bool = True) -> int:
         self._check_writable()
         idx = self._index(index)
         f = idx.field(field)
@@ -364,8 +367,56 @@ class API:
                     n += bool(f.clear_value(int(c)))
                 return n
             f.import_values(cols, values)
-            idx.mark_columns_exist(cols)
+            if mark_exists:
+                idx.mark_columns_exist(cols)
         n = len(cols)
+        metrics.IMPORTED_BITS.inc(n, index=index)
+        return n
+
+    def mark_columns_exist(self, index: str, cols) -> None:
+        """Mark record existence once for a whole columnar batch —
+        the per-field imports skip it via mark_exists=False so N
+        fields don't re-mark the same ids N times (the ingest
+        hotspot measured r04)."""
+        self._index(index).mark_columns_exist(cols)
+
+    def import_columns(self, index: str, cols, bits: dict | None = None,
+                       values: dict | None = None,
+                       workers: int = 4) -> int:
+        """Columnar multi-field import: one shared column-id array,
+        `bits` mapping set/mutex field -> row-id array and `values`
+        mapping BSI field -> value array, imported with per-field
+        THREAD concurrency (the in-process analog of the reference's
+        per-ingester clone concurrency, idk/ingest.go:302 — fields
+        write disjoint fragments, and the numpy kernels release the
+        GIL).  Existence is marked once."""
+        from concurrent.futures import ThreadPoolExecutor
+        self._check_writable()
+        idx = self._index(index)
+        jobs = []
+        for fname, rows in (bits or {}).items():
+            f = idx.field(fname)
+            if f is None:
+                raise ApiError(f"field not found: {fname}", 404)
+            jobs.append((f.import_bits, (rows, cols, None)))
+        for fname, vals in (values or {}).items():
+            f = idx.field(fname)
+            if f is None:
+                raise ApiError(f"field not found: {fname}", 404)
+            jobs.append((f.import_values, (cols, vals)))
+        metrics.IMPORT_TOTAL.inc(index=index)
+        with self._import_lock(index):
+            if workers > 1 and len(jobs) > 1:
+                with ThreadPoolExecutor(max_workers=workers) as pool:
+                    futs = [pool.submit(fn, *args)
+                            for fn, args in jobs]
+                    for fu in futs:
+                        fu.result()
+            else:
+                for fn, args in jobs:
+                    fn(*args)
+            idx.mark_columns_exist(cols)
+        n = len(cols) * len(jobs)
         metrics.IMPORTED_BITS.inc(n, index=index)
         return n
 

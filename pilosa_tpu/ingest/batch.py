@@ -43,6 +43,80 @@ class Batch:
     def __len__(self):
         return len(self._records)
 
+    # -- columnar fast path --------------------------------------------
+
+    def add_columns(self, ids, columns: dict) -> None:
+        """Columnar bulk add: `ids` is an array of record ids and
+        `columns` maps field name -> aligned value array (None cells
+        = NULL).  The whole chunk stays numpy end-to-end — no
+        per-record dicts — which is what sustains the reference's
+        1B-row able ingest rate (batch.go:459's row-major loop is
+        amortized by Go; in Python the columnar form is the only way
+        to keep up).  Flushes immediately, independent of the
+        row-major buffer."""
+        import numpy as np
+        ids = np.asarray(ids)
+        if self.index_keys:
+            keys = [str(k) for k in ids.tolist()]
+            uniq = sorted(set(keys))
+            mapping = self.importer.create_keys(self.index, None, uniq)
+            cols = np.array([mapping[k] for k in keys],
+                            dtype=np.int64)
+        else:
+            cols = ids.astype(np.int64)
+        for fname, vals in columns.items():
+            fopts = self.schema.get(fname)
+            if fopts is None:
+                raise KeyError(f"unknown field {fname!r}")
+            ftype = fopts.get("type", "set")
+            if ftype in ("int", "decimal", "timestamp"):
+                arr = np.asarray(vals)
+                if arr.dtype.kind in "iuf" and ftype == "int":
+                    # numeric arrays ride through untouched
+                    self.imported += self.importer.import_values(
+                        self.index, fname, cols,
+                        arr.astype(np.int64), mark_exists=False)
+                    continue
+                arr = np.asarray(vals, dtype=object)
+                valid = np.array([v is not None for v in arr],
+                                 dtype=bool)
+                if valid.any():
+                    self.imported += self.importer.import_values(
+                        self.index, fname, cols[valid].tolist(),
+                        arr[valid].tolist(), mark_exists=False)
+                continue
+            if fopts.get("keys"):
+                arr = np.asarray(vals, dtype=object)
+                valid = np.array([v is not None for v in arr],
+                                 dtype=bool)
+                svals = [str(v) for v in arr[valid].tolist()]
+                uniq = sorted(set(svals))
+                mapping = self.importer.create_keys(
+                    self.index, fname, uniq)
+                # vectorized key -> id mapping via sorted lookup
+                uk = np.array(uniq)
+                uv = np.array([mapping[k] for k in uniq],
+                              dtype=np.int64)
+                rows = uv[np.searchsorted(uk, np.array(svals))]
+                self.imported += self.importer.import_bits(
+                    self.index, fname, rows.tolist(),
+                    cols[valid].tolist(), mark_exists=False)
+                continue
+            arr = np.asarray(vals)
+            if arr.dtype == object:
+                valid = np.array([v is not None for v in arr],
+                                 dtype=bool)
+                rows = arr[valid].astype(np.int64)
+                ccols = cols[valid]
+            else:
+                rows, ccols = arr.astype(np.int64), cols
+            if rows.size:
+                self.imported += self.importer.import_bits(
+                    self.index, fname, rows, ccols,
+                    mark_exists=False)
+        # existence marked ONCE for the chunk, not once per field
+        self.importer.mark_columns_exist(self.index, cols)
+
     def add(self, rec: Record) -> bool:
         """Add one record; returns True when the batch is now full
         (caller should flush — ErrBatchNowFull behavior batch.go:459)."""

@@ -13,12 +13,19 @@ class Importer:
     """Importer interface (importer.go:13)."""
 
     def import_bits(self, index: str, field: str, rows, cols,
-                    timestamps=None, clear: bool = False) -> int:
+                    timestamps=None, clear: bool = False,
+                    mark_exists: bool = True) -> int:
         raise NotImplementedError
 
     def import_values(self, index: str, field: str, cols, values,
-                      clear: bool = False) -> int:
+                      clear: bool = False,
+                      mark_exists: bool = True) -> int:
         raise NotImplementedError
+
+    def mark_columns_exist(self, index: str, cols) -> None:
+        """Batch-level existence marking (columnar fast path); the
+        default is a no-op for importers whose import_* always
+        mark."""
 
     def create_keys(self, index: str, field: str | None,
                     keys: list[str]) -> dict[str, int]:
@@ -35,13 +42,19 @@ class APIImporter(Importer):
         self.api = api
 
     def import_bits(self, index, field, rows, cols, timestamps=None,
-                    clear=False):
+                    clear=False, mark_exists=True):
         return self.api.import_bits(index, field, rows=rows, cols=cols,
-                                    timestamps=timestamps, clear=clear)
+                                    timestamps=timestamps, clear=clear,
+                                    mark_exists=mark_exists)
 
-    def import_values(self, index, field, cols, values, clear=False):
+    def import_values(self, index, field, cols, values, clear=False,
+                      mark_exists=True):
         return self.api.import_values(index, field, cols=cols,
-                                      values=values, clear=clear)
+                                      values=values, clear=clear,
+                                      mark_exists=mark_exists)
+
+    def mark_columns_exist(self, index, cols):
+        self.api.mark_columns_exist(index, cols)
 
     def create_keys(self, index, field, keys):
         ids = self.api.translate_keys(index, field, keys, create=True)

@@ -250,8 +250,9 @@ class Index:
     def mark_columns_exist(self, cols):
         if not self.track_existence:
             return
+        import numpy as np
         f = self._ensure_existence()
-        f.import_bits([0] * len(cols), cols)
+        f.import_bits(np.zeros(len(cols), dtype=np.int64), cols)
 
     def existence_row(self, shard: int):
         """Packed existence words for a shard (or None if untracked)."""
