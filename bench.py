@@ -118,24 +118,35 @@ def build_index(n_shards: int, topn_rows: int, seed: int = 7):
     # north-star fields + the "able" gauntlet trio (qa/scripts/perf/
     # able/ableTest.sh:63: GroupBy over 3 Rows fields with a Sum):
     # edu/gen/dom are disjoint-ish categorical rows, age is BSI
-    for fname, rows in (("a", [1]), ("b", [1]),
-                        ("t", list(range(topn_rows))),
-                        ("edu", list(range(6))),
-                        ("gen", list(range(2))),
-                        ("dom", list(range(5)))):
+    # "tr" mirrors "t" with the RANKED cache: filtered TopN on it
+    # scans only cache candidates (the reference's TopN strategy,
+    # cache.go:130) — measured against the exact full scan on "t"
+    for fname, rows, cache in (
+            ("a", [1], CACHE_TYPE_NONE), ("b", [1], CACHE_TYPE_NONE),
+            ("t", list(range(topn_rows)), CACHE_TYPE_NONE),
+            ("tr", list(range(topn_rows)), "ranked"),
+            ("edu", list(range(6)), CACHE_TYPE_NONE),
+            ("gen", list(range(2)), CACHE_TYPE_NONE),
+            ("dom", list(range(5)), CACHE_TYPE_NONE)):
         # cache_type none on the TopN field forces the stacked device
         # scan — an unfiltered TopN on a ranked-cache field would be
         # served by the host rank-cache merge instead, measuring the
         # wrong path (advisor r02)
-        f = idx.create_field(
-            fname, FieldOptions(cache_type=CACHE_TYPE_NONE))
+        f = idx.create_field(fname, FieldOptions(cache_type=cache))
         view = f.view(VIEW_STANDARD, create=True)
         for shard in range(n_shards):
             frag = view.fragment(shard, create=True)
             for r in rows:
-                w = rng.integers(0, 1 << 32, size=words, dtype=np.uint32)
+                if fname == "tr":
+                    # copy t's words so results compare exactly
+                    w = idx.field("t").view(VIEW_STANDARD) \
+                        .fragment(shard).row_words(r)
+                else:
+                    w = rng.integers(0, 1 << 32, size=words,
+                                     dtype=np.uint32)
                 frag.import_row_words(r, w)
-                cells += int(np.bitwise_count(w).sum())
+                cells += int(np.bitwise_count(
+                    np.asarray(w, dtype=np.uint32)).sum())
     # BSI age: random 7-bit magnitudes built directly as plane words
     # (the bulk-restore path; random planes = random values 0..127)
     age = idx.create_field("age", FieldOptions(
@@ -163,17 +174,29 @@ def run_queries(h, reps: int, label: str) -> dict[str, list[float]]:
     queries = {
         "count_intersect": "Count(Intersect(Row(a=1), Row(b=1)))",
         "topn": "TopN(t, n=10)",
+        # filtered TopN: exact full candidate scan (cache none) vs
+        # the ranked-cache-bounded scan (VERDICT r03 item 5) — same
+        # data, results asserted equal below
+        "topn_filtered": "TopN(t, Row(a=1), n=10)",
+        "topn_ranked_filtered": "TopN(tr, Row(a=1), n=10)",
         # the reference's own 1B-row gauntlet query shape
         # (qa/scripts/perf/able/ableTest.sh:63)
         "able_groupby": "GroupBy(Rows(edu), Rows(gen), Rows(dom), "
                         "aggregate=Sum(field=age))",
     }
     # warmup: compiles the stacked programs + uploads the tile stacks
+    warm = {}
     for name, q in queries.items():
         t0 = time.perf_counter()
         res = ex.execute("bench", q)
+        warm[name] = res
         log(f"[{label}] warm {name}: {time.perf_counter() - t0:.2f}s "
             f"(compile+upload) result={_preview(res)}")
+    # exactness: the ranked-cache-bounded filtered TopN must equal
+    # the full scan (same underlying rows; covering cache)
+    a = [(p.id, p.count) for p in warm["topn_filtered"][0]]
+    b = [(p.id, p.count) for p in warm["topn_ranked_filtered"][0]]
+    assert a == b, f"ranked TopN != exact TopN: {a} vs {b}"
     times: dict[str, list[float]] = {k: [] for k in queries}
     for _ in range(reps):
         for name, q in queries.items():

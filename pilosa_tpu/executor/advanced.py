@@ -114,6 +114,18 @@ class AdvancedOps:
                 return self._finish_topn(f, pairs, n, ids)
         row_ids = ([int(r) for r in ids] if ids is not None
                    else self._all_row_ids(idx, f, shards))
+        if (ids is None and call.name == "TopN"
+                and views == [VIEW_STANDARD]):
+            # ranked caches BOUND the candidate set for the filtered
+            # device scan — the reference's entire TopN strategy
+            # (fragment.top iterates cache candidates, fragment.go:
+            # 1317; cache.go:130): the (R,S,W) scan covers the
+            # cache's top rows instead of every row, trading the
+            # documented cache approximation for a candidate set
+            # independent of field cardinality
+            cand = self._candidate_rows_from_caches(idx, f, shards)
+            if cand is not None and len(cand) < len(row_ids):
+                row_ids = cand
         if not row_ids:
             return []
         if getattr(self, "use_stacked", False):
@@ -194,6 +206,24 @@ class AdvancedOps:
             for r, c in cache.top():
                 counts[r] = counts.get(r, 0) + c
         return [Pair(id=r, count=c) for r, c in counts.items() if c > 0]
+
+    def _candidate_rows_from_caches(self, idx, f, shards) -> list | None:
+        """Union of every shard cache's ranked rows (ascending id for
+        deterministic stacking); None when any fragment lacks a
+        cache (exact full scan stays)."""
+        v = f.views.get(VIEW_STANDARD)
+        if v is None:
+            return []
+        out: set[int] = set()
+        for shard in self._shard_list(idx, shards):
+            frag = v.fragment(shard)
+            if frag is None:
+                continue
+            cache = frag.row_cache()
+            if cache is None:
+                return None
+            out.update(r for r, _c in cache.top())
+        return sorted(out)
 
     def _finish_topn(self, f, pairs, n, ids):
         pairs.sort(key=lambda p: (-p.count, p.id))

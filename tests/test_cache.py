@@ -123,3 +123,34 @@ def test_lru_refresh_preserves_write_order():
     cache = f.row_cache()
     # first-written row evicted, last two survive
     assert set(cache.ids()) == {3, 1 << 20}
+
+
+def test_filtered_topn_bounded_by_ranked_cache(rng):
+    """Filtered TopN on a ranked-cache field scans only the cache's
+    candidate rows (fragment.go:1317 / cache.go:130 strategy): with a
+    covering cache the result is EXACTLY the full scan's."""
+    from pilosa_tpu.executor.executor import Executor
+    from pilosa_tpu.models.schema import CACHE_TYPE_NONE
+
+    rows = rng.integers(0, 30, size=800)
+    cols = rng.integers(0, 3 * WIDTH, size=800)
+
+    def build(**kw):
+        h = Holder(width=WIDTH)
+        idx = h.create_index("t")
+        fld = idx.create_field("f", FieldOptions(type=FieldType.SET,
+                                                 **kw))
+        g = idx.create_field("g", FieldOptions(type=FieldType.SET))
+        for r, c in zip(rows, cols):
+            fld.set_bit(int(r), int(c))
+            g.set_bit(int(c) % 2, int(c))
+        idx.mark_columns_exist([int(c) for c in cols])
+        return h
+
+    ha = build()  # default ranked cache (covering: 50k >> 30 rows)
+    hb = build(cache_type=CACHE_TYPE_NONE)  # exact full scan
+    ea, eb = Executor(ha), Executor(hb)
+    q = "TopN(f, Row(g=1), n=8)"
+    got = [(p.id, p.count) for p in ea.execute("t", q)[0]]
+    want = [(p.id, p.count) for p in eb.execute("t", q)[0]]
+    assert got == want
