@@ -351,3 +351,58 @@ def test_cluster_time_quantum_ranged_query_with_failover(cluster):
     assert cluster[1].query("t", ranged)["results"] == [3]
     cluster[2].pause()
     assert cluster[1].query("t", ranged)["results"] == [3]
+
+
+def test_cluster_nodes_each_with_device_submesh():
+    """Cluster x mesh composition (SURVEY §2.5's DCN analog;
+    executor.go:6392-6812 remote+local split): two ClusterNodes each
+    place their local shard stacks on their OWN 4-device submesh of
+    the 8 virtual devices.  Queries fan over HTTP between nodes (the
+    DCN hop) and reduce inside each node over its mesh via psum (the
+    ICI hop); results must equal the plain loop path."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    disco = InMemDisCo(lease_ttl=1.0)
+    nodes = [ClusterNode(f"m{i}", disco, holder=Holder(),
+                         replica_n=1, heartbeat_interval=0.2).open()
+             for i in range(2)]
+    try:
+        nodes[0].apply_schema(SCHEMA)
+        # each node owns a DISJOINT 4-device submesh
+        for i, n in enumerate(nodes):
+            n.api.executor.set_mesh(
+                Mesh(np.array(devs[4 * i:4 * i + 4]), ("shards",)))
+        # shards 0..11: jump-hash places 6,8,9 on m0, the rest on
+        # m1 — both submeshes participate
+        cols = [k * SHARD + k + 1 for k in range(12)]
+        vals = [10 * (k + 1) for k in range(12)]
+        nodes[0].import_bits("c", "f", [1] * len(cols), cols)
+        nodes[0].import_values("c", "v", cols, vals)
+        # placement really split across the two nodes
+        snap = nodes[0].snapshot()
+        groups = snap.shards_by_node("c", range(12))
+        assert sum(1 for g in groups.values() if g) == 2, groups
+        # cross-node queries: HTTP fan-out + per-node mesh reduce
+        r = nodes[1].query("c", "Count(Row(f=1))")
+        assert r["results"] == [len(cols)]
+        r = nodes[0].query("c", "Sum(Row(f=1), field=v)")
+        assert r["results"][0] == {"value": sum(vals),
+                                   "count": len(cols)}
+        r = nodes[1].query("c", "Row(f=1)")
+        assert r["results"][0]["columns"] == sorted(cols)
+        r = nodes[0].query("c", "TopN(f)")
+        assert r["results"][0][0]["count"] == len(cols)
+        # the mesh is genuinely attached on both nodes
+        for n in nodes:
+            assert n.api.executor.stacked.mesh is not None
+    finally:
+        for n in nodes:
+            try:
+                n.close()
+            except Exception:
+                pass
