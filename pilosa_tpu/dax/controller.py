@@ -39,6 +39,11 @@ class Controller:
         # table -> sorted shard ids registered for it
         self.tables: dict[str, set[int]] = {}
         self._versions: dict[str, int] = {}     # per-worker directive ver
+        # per-worker fingerprint of the last ENACTED directive content
+        # (schema + assignments): unchanged workers are skipped — the
+        # api_directive.go:172 diff, lifted to the push side so a
+        # rebalance only touches the workers whose jobs moved
+        self._pushed: dict[str, str] = {}
         self._lock = threading.RLock()
         self._poll_interval = poll_interval
         self._poll_stop = threading.Event()
@@ -50,12 +55,17 @@ class Controller:
     def register_worker(self, address: str, uri: str):
         with self._lock:
             self.workers[address] = uri
+            # a worker re-registering at the same address is FRESH
+            # (restart): drop the fingerprint so the delta-push does
+            # not skip its directive (review r04)
+            self._pushed.pop(address, None)
             self._rebalance_locked()
 
     def deregister_worker(self, address: str):
         with self._lock:
             self.workers.pop(address, None)
             self._versions.pop(address, None)
+            self._pushed.pop(address, None)
             self._rebalance_locked()
 
     # -- schema (dax/controller schemar) -------------------------------
@@ -124,25 +134,39 @@ class Controller:
         """Compute the plan under the lock, POST directives OUTSIDE it
         (a hung worker must not stall worker_for/add_shards for its
         whole HTTP timeout), then prune workers that refused."""
+        import hashlib
+        import json
         while True:
             plan = self._assignments_locked()
             targets = []
             for addr, asg in plan.items():
+                content = hashlib.sha256(json.dumps(
+                    [self.schema, asg],
+                    sort_keys=True).encode()).hexdigest()
+                if self._pushed.get(addr) == content:
+                    continue  # nothing changed for this worker
                 self._versions[addr] = self._versions.get(addr, 0) + 1
                 targets.append((addr, self.workers[addr], Directive(
                     address=addr, version=self._versions[addr],
-                    schema=self.schema, assignments=asg)))
+                    schema=self.schema, assignments=asg), content))
             self._lock.release()
             dead = []
+            ok = []
             try:
-                for addr, uri, d in targets:
+                for addr, uri, d, content in targets:
                     try:
                         self._client._request(uri, "POST", "/directive",
                                               d.to_dict())
+                        ok.append((addr, content))
                     except Exception:
                         dead.append(addr)
             finally:
                 self._lock.acquire()
+            for addr, content in ok:
+                # the worker may have been deregistered during the
+                # unlocked POST window — do not resurrect its entry
+                if addr in self.workers:
+                    self._pushed[addr] = content
             if not dead:
                 return
             for addr in dead:
@@ -150,6 +174,7 @@ class Controller:
                 # removing it reassigns its jobs to the survivors
                 self.workers.pop(addr, None)
                 self._versions.pop(addr, None)
+                self._pushed.pop(addr, None)
             if not self.workers:
                 return
 
@@ -185,5 +210,6 @@ class Controller:
                 for addr in dead:
                     self.workers.pop(addr, None)
                     self._versions.pop(addr, None)
+                    self._pushed.pop(addr, None)
                 self._rebalance_locked()
         return dead

@@ -194,6 +194,15 @@ def _groupby_kernel_shard_map(mesh, nf: int, has_planes: bool,
     return run
 
 
+def _zero_groupby_result(n_combos: int, depth: int, agg_field):
+    """(counts, agg) zeros for a provably-empty filter."""
+    zero_agg = None if agg_field is None else (
+        np.zeros(n_combos, dtype=np.int64),
+        np.zeros((n_combos, depth), dtype=np.int64),
+        np.zeros((n_combos, depth), dtype=np.int64))
+    return np.zeros(n_combos, dtype=np.int64), zero_agg
+
+
 def _groupby_kernel_jit(nf: int, has_planes: bool, signed: bool):
     key = (nf, has_planes, signed)
     fn = _GB_KERNEL_JIT.get(key)
@@ -859,24 +868,30 @@ class StackedEngine:
     # forces (tests exercise the interpreter path this way).
     _GROUPBY_KERNEL_MAX_COMBOS = 1024
 
-    def _groupby_kernel_ok(self, n_combos: int, n_shards: int) -> bool:
+    def _groupby_kernel_ok(self, n_combos: int, n_shards: int,
+                           has_filter: bool = False) -> bool:
         import os
         flag = os.environ.get("PILOSA_TPU_GROUPBY_KERNEL", "")
         if flag == "0" or self.host_only:
             return False
-        if n_combos > self._GROUPBY_KERNEL_MAX_COMBOS:
-            return False
-        if n_shards > _REDUCE_MAX_SHARDS:
-            # the kernel accumulates per-combo totals in int32 across
-            # shard tiles — same exactness bound as the in-program
-            # reduce; bigger fleets take the unreduced XLA path
-            return False
+        if self._n_total_devices() > 1:
+            # the shard_map wrapper keeps the strict bounds: no
+            # filter masking, int32 shard accumulation, one-hot
+            # combo lanes
+            if (has_filter or n_combos > self._GROUPBY_KERNEL_MAX_COMBOS
+                    or n_shards > _REDUCE_MAX_SHARDS):
+                return False
+        # single device: combos CHUNK through the kernel, shards
+        # chunk with int64 host accumulation, and filters AND into
+        # the first row stack before the kernel (r04 guard lift —
+        # big shapes no longer silently shed the 4x kernel win)
         if flag == "1":
             return True
         return jax.default_backend() == "tpu"
 
     def _groupby_kernel_path(self, idx, fields_rows, agg_field, skey,
-                             combos, depth: int, signed: bool):
+                             combos, depth: int, signed: bool,
+                             filt=None):
         from pilosa_tpu.obs.metrics import GROUPBY_KERNEL
         GROUPBY_KERNEL.inc()
         multi = self._n_total_devices() > 1
@@ -888,24 +903,58 @@ class StackedEngine:
                       if agg_field is not None else None)
             fn = _groupby_kernel_shard_map(
                 self.mesh, len(stacks), planes is not None, signed)
-        else:
+            sel = np.asarray(combos, dtype=np.int32).reshape(
+                len(combos), len(fields_rows))
+            if planes is None:
+                out = fn(tuple(stacks), sel)
+            else:
+                out = fn(tuple(stacks), sel, planes)
+            return self._groupby_kernel_unpack(out, len(combos),
+                                               depth, agg_field)
+        # single device: shard-chunked (int64 host accumulation past
+        # the int32-exact bound) x combo-chunked (one-hot lane bound)
+        # with an optional pre-ANDed filter mask (r04 guard lift)
+        fn = _groupby_kernel_jit(len(fields_rows),
+                                 agg_field is not None, signed)
+        k = len(combos)
+        ckn = self._GROUPBY_KERNEL_MAX_COMBOS
+        counts = np.zeros(k, dtype=np.int64)
+        agg = (np.zeros(k, dtype=np.int64),
+               np.zeros((k, depth), dtype=np.int64),
+               np.zeros((k, depth), dtype=np.int64)) \
+            if agg_field is not None else None
+        for slo in range(0, len(skey), _REDUCE_MAX_SHARDS):
+            sc = skey[slo:slo + _REDUCE_MAX_SHARDS]
             stacks = [self.rows_stack_for(idx, f, (VIEW_STANDARD,),
-                                          rl, skey)
+                                          rl, sc)
                       for f, rl in fields_rows]
-            planes = (self.plane_stack(idx, agg_field, skey)
+            if filt is not None:
+                fslice = filt[slo:slo + _REDUCE_MAX_SHARDS]
+                stacks = ([jnp.bitwise_and(stacks[0],
+                                           fslice[None, :, :])]
+                          + list(stacks[1:]))
+            planes = (self.plane_stack(idx, agg_field, sc)
                       if agg_field is not None else None)
-            fn = _groupby_kernel_jit(len(stacks), planes is not None,
-                                     signed)
-        sel = np.asarray(combos, dtype=np.int32).reshape(
-            len(combos), len(fields_rows))
-        if multi and planes is None:
-            out = fn(tuple(stacks), sel)
-        else:
-            out = fn(tuple(stacks), sel, planes)
+            for clo in range(0, k, ckn):
+                sel = np.asarray(
+                    combos[clo:clo + ckn], dtype=np.int32).reshape(
+                    -1, len(fields_rows))
+                out = fn(tuple(stacks), sel, planes)
+                kc = sel.shape[0]
+                c, a = self._groupby_kernel_unpack(out, kc, depth,
+                                                   agg_field)
+                counts[clo:clo + kc] += c
+                if a is not None:
+                    agg[0][clo:clo + kc] += a[0]
+                    agg[1][clo:clo + kc] += a[1]
+                    agg[2][clo:clo + kc] += a[2]
+        return counts, agg
+
+    @staticmethod
+    def _groupby_kernel_unpack(out, k: int, depth: int, agg_field):
         if agg_field is None:
             return np.asarray(out, dtype=np.int64), None
         flat = np.asarray(out, dtype=np.int64)
-        k = len(combos)
         counts, nn = flat[:k], flat[k:2 * k]
         pos = flat[2 * k:2 * k + k * depth].reshape(k, depth)
         neg = flat[2 * k + k * depth:].reshape(k, depth)
@@ -926,15 +975,22 @@ class StackedEngine:
         aligned with `combos`.
         """
         skey = tuple(shards)
-        # the gathered row stacks are resident all at once — bail to
-        # the bounded per-shard loop path when they would not fit the
-        # same byte budget the TopN candidate scan chunks to
+        n_combos = len(combos)
+        kernel = self._groupby_kernel_ok(
+            n_combos, len(skey), has_filter=filter_call is not None)
+        # memory budget: the XLA path gathers (R, S, W) stacks for
+        # the WHOLE shard set at once; the single-device kernel path
+        # materializes only (R, min(S, _REDUCE_MAX_SHARDS), W) per
+        # chunk (review r04 — the budget must not kill the very
+        # fleets the shard-chunk lift exists for)
         total_rows = sum(len(rl) for _, rl in fields_rows)
-        est = total_rows * max(len(skey), 1) * (idx.width // 8)
+        est_shards = len(skey)
+        if kernel and self._n_total_devices() == 1:
+            est_shards = min(est_shards, _REDUCE_MAX_SHARDS)
+        est = total_rows * max(est_shards, 1) * (idx.width // 8)
         if est > (1 << 31):
             raise Unstackable(
                 f"groupby row stacks ~{est >> 20} MiB exceed budget")
-        n_combos = len(combos)
         depth = agg_field.bit_depth if agg_field is not None else 0
         # when no fragment holds any sign-plane bit (row_ids is cached
         # per fragment version, so this is a dict sweep, not a scan),
@@ -948,11 +1004,23 @@ class StackedEngine:
                                 list(skey))
             signed = any(fr is not None and 1 in fr.row_ids
                          for fr in frags)
-        if filter_call is None and \
-                self._groupby_kernel_ok(n_combos, len(skey)):
+        if kernel:
+            filt = None
+            if filter_call is not None:
+                # materialize the filter ONCE as an (S, W) device
+                # stack (the XLA tree path), then AND it into the
+                # first row stack — every kernel term includes the
+                # combo intersection, so one mask filters counts and
+                # aggregates alike (r04 guard lift)
+                b0 = PlanBuilder(self, idx, list(skey), pre)
+                tree0 = b0.build(filter_call)
+                if tree0 == ("zeros",):
+                    return _zero_groupby_result(n_combos, depth,
+                                                agg_field)
+                filt = self._run(("words", tree0), b0)
             return self._groupby_kernel_path(
                 idx, fields_rows, agg_field, skey, combos, depth,
-                signed)
+                signed, filt=filt)
         b = PlanBuilder(self, idx, list(skey), pre)
         stack_is = tuple(
             b._add_leaf(self.rows_stack_for(
@@ -965,11 +1033,7 @@ class StackedEngine:
         if filter_call is not None:
             tree = b.build(filter_call)
             if tree == ("zeros",):
-                zero_agg = None if agg_field is None else (
-                    np.zeros(n_combos, dtype=np.int64),
-                    np.zeros((n_combos, depth), dtype=np.int64),
-                    np.zeros((n_combos, depth), dtype=np.int64))
-                return np.zeros(n_combos, dtype=np.int64), zero_agg
+                return _zero_groupby_result(n_combos, depth, agg_field)
         red = self._reduce_in_program(skey)
         plan = ("groupby", stack_is, planes_i, tree, red, signed)
         nf = len(fields_rows)

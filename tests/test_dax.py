@@ -253,3 +253,106 @@ def test_dax_sql_bulk_insert_and_sort_offset(dax):
     assert r["results"][0]["values"] == [20, 30, 40]
     r = q.sql("SELECT _id FROM b ORDER BY v LIMIT 2 OFFSET 1")
     assert [row[0] for row in r["data"]] == [SHARD + 1, 2 * SHARD + 1]
+
+
+def test_directive_push_is_delta(dax):
+    """Directives are content-diffed per worker (api_directive.go:172
+    lifted to the push side): registering a shard owned by ONE worker
+    must not re-push directives to the others."""
+    _seed(dax, n_shards=4)
+    before = {w.address: w.directive_version for w in dax.workers}
+    # one new shard: exactly one worker's assignment changes
+    addr, _ = dax.controller.worker_for("t", 17)
+    dax.controller.add_shards("t", [17])
+    changed = [w.address for w in dax.workers
+               if w.directive_version != before[w.address]]
+    assert changed == [addr], (changed, addr)
+
+
+def test_rebalance_under_load_no_data_loss(dax):
+    """3 workers, one killed MID-INGEST: the poller reassigns its
+    shards and every ACKNOWLEDGED write survives (write-log + replay;
+    poller/poller.go -> balancer -> api_directive.go:559 loadShard)."""
+    import threading
+    import time
+
+    dax.queryer.apply_schema(SCHEMA)
+    acked = []
+    stop = threading.Event()
+    errors = []
+
+    def ingest():
+        i = 0
+        while not stop.is_set() and i < 400:
+            col = (i % 8) * SHARD + i  # spread over 8 shards
+            try:
+                dax.queryer.import_bits("t", "f", [1], [col])
+                acked.append(col)
+            except Exception:
+                # unacknowledged mid-failover writes may be refused;
+                # the ingester retries next round (idk semantics)
+                time.sleep(0.01)
+            i += 1
+        stop.set()
+
+    t = threading.Thread(target=ingest)
+    t.start()
+    time.sleep(0.15)  # mid-ingest
+    victim = dax.workers[1]
+    dax.kill_worker(victim.address)
+    dax.controller.poll_once()
+    time.sleep(0.3)  # keep ingesting AFTER the failover too
+    stop.set()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    r = dax.queryer.query("t", "Row(f=1)")
+    got = set(r["results"][0]["columns"])
+    missing = [c for c in acked if c not in got]
+    assert not missing, f"{len(missing)} acknowledged writes lost"
+    assert len(acked) > 50  # the load was real
+
+
+def test_dax_sql_shape_support_matrix(dax):
+    """Which SQL shapes the DAX front end serves vs refuses (VERDICT
+    r03 item 8: enumerate them).  Served: filters, PQL aggregates,
+    single-value GROUP BY, DISTINCT, ORDER BY...LIMIT.  Refused
+    (schema-only holder, no local cells): JOIN, the generic hashed
+    GROUP BY over BSI columns, and keyed-row INSERT."""
+    from pilosa_tpu.sql import SQLError
+
+    dax.queryer.apply_schema({"indexes": [
+        {"name": "s", "fields": [
+            {"name": "g", "options": {"type": "mutex"}},
+            {"name": "n", "options": {"type": "int", "min": 0,
+                                      "max": 100}}]},
+        {"name": "s2", "fields": [
+            {"name": "m", "options": {"type": "int", "min": 0,
+                                      "max": 100}}]},
+    ]})
+    dax.queryer.sql("INSERT INTO s (_id, g, n) VALUES "
+                    "(1, 10, 5), (2, 20, 7), (3, 10, 9)")
+    served = [
+        ("SELECT count(*) FROM s", [[3]]),
+        ("SELECT count(*) FROM s WHERE n > 5", [[2]]),
+        ("SELECT sum(n) FROM s", [[21]]),
+        ("SELECT g, count(*) FROM s GROUP BY g", [[10, 2], [20, 1]]),
+        ("SELECT DISTINCT g FROM s", [[10], [20]]),
+        ("SELECT _id FROM s WHERE g = 10 ORDER BY _id LIMIT 1",
+         [[1]]),
+    ]
+    for q, want in served:
+        got = dax.queryer.sql(q)["data"]
+        assert sorted(map(repr, got)) == sorted(map(repr, want)), \
+            (q, got)
+    refused = [
+        # nested-loop JOIN needs local cell decode
+        "SELECT s._id FROM s JOIN s2 ON s.n = s2.m",
+        # BSI group column takes the generic hashed path (local cells)
+        "SELECT n, count(*) FROM s GROUP BY n",
+        # keyed-row INSERT routes via the cluster path, not DAX
+        "CREATE TABLE sk (_id id, k string); "
+        "INSERT INTO sk (_id, k) VALUES (1, 'x')",
+    ]
+    for q in refused:
+        with pytest.raises(SQLError):
+            dax.queryer.sql(q)

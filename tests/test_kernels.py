@@ -265,3 +265,70 @@ class TestGroupbySum:
         as_t = lambda res: [(tuple(g["row_id"] for g in r.group),
                              r.count, r.agg, r.agg_count) for r in res]
         assert as_t(got) == as_t(want)
+
+
+class TestGroupByKernelGuardLifts:
+    """r04 guard lifts: big combo spaces, big shard fleets, and
+    filter trees all keep the kernel path (single device) — chunked
+    and masked, results equal to the XLA scan."""
+
+    def _holder(self, rng, W):
+        from pilosa_tpu.models import FieldOptions, FieldType, Holder
+        h = Holder(width=W)
+        idx = h.create_index("i")
+        idx.create_field("g")
+        idx.create_field("d")
+        idx.create_field("flt")
+        idx.create_field("v", FieldOptions(type=FieldType.INT,
+                                           min=-50, max=50))
+        cols = list(range(0, 9 * W, 5))
+        idx.field("g").import_bits([c % 5 for c in cols], cols)
+        idx.field("d").import_bits([c % 4 for c in cols], cols)
+        idx.field("flt").import_bits([c % 2 for c in cols], cols)
+        vals = [int(v) for v in rng.integers(-50, 50,
+                                             size=len(cols))]
+        idx.field("v").import_values(cols, vals)
+        idx.mark_columns_exist(cols)
+        return h
+
+    def _cmp(self, h, q, monkeypatch):
+        from pilosa_tpu.executor import Executor
+        monkeypatch.delenv("PILOSA_TPU_GROUPBY_KERNEL",
+                           raising=False)
+        want = Executor(h).execute("i", q)[0]
+        monkeypatch.setenv("PILOSA_TPU_GROUPBY_KERNEL", "1")
+        got = Executor(h).execute("i", q)[0]
+        as_t = lambda res: [(tuple(g["row_id"] for g in r.group),
+                             r.count, r.agg, r.agg_count)
+                            for r in res]
+        assert as_t(got) == as_t(want)
+
+    def test_filter_tree_stays_on_kernel(self, rng, monkeypatch):
+        h = self._holder(rng, 1 << 12)
+        self._cmp(h, "GroupBy(Rows(g), Rows(d), filter=Row(flt=1), "
+                     "aggregate=Sum(field=v))", monkeypatch)
+
+    def test_combo_chunking_matches(self, rng, monkeypatch):
+        import pilosa_tpu.executor.stacked as stacked
+        monkeypatch.setattr(
+            stacked.StackedEngine, "_GROUPBY_KERNEL_MAX_COMBOS", 3)
+        h = self._holder(rng, 1 << 12)
+        # 5 x 4 = 20 combos >> the patched 3-combo kernel bound
+        self._cmp(h, "GroupBy(Rows(g), Rows(d), "
+                     "aggregate=Sum(field=v))", monkeypatch)
+
+    def test_shard_chunking_matches(self, rng, monkeypatch):
+        import pilosa_tpu.executor.stacked as stacked
+        monkeypatch.setattr(stacked, "_REDUCE_MAX_SHARDS", 2)
+        h = self._holder(rng, 1 << 12)  # 9 shards >> patched bound
+        self._cmp(h, "GroupBy(Rows(g), Rows(d), "
+                     "aggregate=Sum(field=v))", monkeypatch)
+
+    def test_all_lifts_composed(self, rng, monkeypatch):
+        import pilosa_tpu.executor.stacked as stacked
+        monkeypatch.setattr(
+            stacked.StackedEngine, "_GROUPBY_KERNEL_MAX_COMBOS", 4)
+        monkeypatch.setattr(stacked, "_REDUCE_MAX_SHARDS", 3)
+        h = self._holder(rng, 1 << 12)
+        self._cmp(h, "GroupBy(Rows(g), Rows(d), filter=Row(flt=0), "
+                     "aggregate=Sum(field=v))", monkeypatch)

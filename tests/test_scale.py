@@ -176,8 +176,25 @@ def test_groupby_kernel_gating():
     try:
         os.environ["PILOSA_TPU_GROUPBY_KERNEL"] = "1"
         assert eng._groupby_kernel_ok(60, 954)
-        assert not eng._groupby_kernel_ok(2000, 954)   # combo bound
-        assert not eng._groupby_kernel_ok(60, 2001)    # int32 bound
+        # r04 guard lifts (single device): big combo spaces chunk
+        # through the kernel, big fleets chunk shards with int64 host
+        # accumulation, filters AND into the row stacks
+        assert eng._groupby_kernel_ok(2000, 954)
+        assert eng._groupby_kernel_ok(60, 2001)
+        assert eng._groupby_kernel_ok(60, 954, has_filter=True)
+        # a mesh engine keeps the strict shard_map bounds
+        import numpy as _np
+        import jax as _jax
+        from jax.sharding import Mesh as _Mesh
+        if len(_jax.devices()) >= 2:
+            eng.mesh = _Mesh(_np.array(_jax.devices()[:2]),
+                             ("shards",))
+            assert eng._groupby_kernel_ok(60, 954)
+            assert not eng._groupby_kernel_ok(2000, 954)
+            assert not eng._groupby_kernel_ok(60, 2001)
+            assert not eng._groupby_kernel_ok(60, 954,
+                                              has_filter=True)
+            eng.mesh = None
         eng.host_only = True
         assert not eng._groupby_kernel_ok(60, 954)
         eng.host_only = False
