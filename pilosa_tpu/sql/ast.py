@@ -295,3 +295,6 @@ class Select:
     top: int | None = None
     # FROM table [AS] alias
     table_alias: str | None = None
+    # FROM (SELECT ...) [AS] alias — a derived table (sql3
+    # tableOrSubquery; defs_subquery)
+    from_select: "Select | None" = None

@@ -158,7 +158,7 @@ class SQLEngine:
             if auth_check is not None:
                 names = [n for n in names
                          if self._can_read(auth_check, n)]
-            epoch = "1970-01-01T00:00:00"
+            epoch = "1970-01-01T00:00:00Z"
             return SQLResult(
                 schema=[("_id", "string"), ("name", "string"),
                         ("owner", "string"), ("updated_by", "string"),

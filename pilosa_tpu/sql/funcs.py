@@ -195,6 +195,7 @@ _ARITY = {
     "TOTIMESTAMP": (1, 2),
     "SETCONTAINS": (2, 2), "SETCONTAINSANY": (2, 2),
     "SETCONTAINSALL": (2, 2),
+    "BITNOT": (1, 1),  # unary ! (defs_unops), ints only
     "CAST": (3, 3),  # (expr, type, scale) — built by the parser
 }
 
@@ -264,8 +265,12 @@ def _cast(v, t: str, scale: int):
             from pilosa_tpu.sql.common import rfc3339
             return rfc3339(v)
         if isinstance(v, list):
-            # sets render as a JSON-style quoted list
-            # (defs_cast castIDSet_5: '["101","102"]')
+            # idsets render Go-%v style '[101 102]'; stringsets render
+            # as a JSON-style quoted list '["a","b"]' (defs_cast
+            # castIDSet_5 / castStringSet_5)
+            if all(isinstance(m, int) and not isinstance(m, bool)
+                   for m in v):
+                return "[" + " ".join(str(m) for m in v) + "]"
             return "[" + ",".join(f'"{m}"' for m in v) + "]"
         if isinstance(v, (int, float, Decimal, str)):
             return str(v)
@@ -453,6 +458,8 @@ def _dispatch(name: str, a: list):
         return dt.datetime(1970, 1, 1) + dt.timedelta(
             seconds=_i(a[0], name) / _TIME_UNITS[unit])
 
+    if name == "BITNOT":
+        return ~_i(a[0], "!")
     if name == "CAST":
         return _cast(a[0], a[1], a[2])
 
@@ -489,7 +496,7 @@ FUNC_TYPES = {
     "DATE_TRUNC": "string", "DATETIMEADD": "timestamp",
     "DATETIMEFROMPARTS": "timestamp", "TOTIMESTAMP": "timestamp",
     "SETCONTAINS": "bool", "SETCONTAINSANY": "bool",
-    "SETCONTAINSALL": "bool",
+    "SETCONTAINSALL": "bool", "BITNOT": "int",
 }
 
 
@@ -547,6 +554,19 @@ class Evaluator:
             lo, hi = self.eval(e.lo, env), self.eval(e.hi, env)
             if v is None or lo is None or hi is None:
                 return None
+            if isinstance(v, dt.datetime):
+                # timestamp BETWEEN string/epoch-int bounds
+                # (defs_between); _ts coerces both
+                lo = _ts(lo, "BETWEEN") \
+                    if not isinstance(lo, dt.datetime) else lo
+                hi = _ts(hi, "BETWEEN") \
+                    if not isinstance(hi, dt.datetime) else hi
+                if v.tzinfo is None:
+                    v = v.replace(tzinfo=dt.timezone.utc)
+                if lo.tzinfo is None:
+                    lo = lo.replace(tzinfo=dt.timezone.utc)
+                if hi.tzinfo is None:
+                    hi = hi.replace(tzinfo=dt.timezone.utc)
             hit = lo <= v <= hi
             return (not hit) if e.negated else hit
         raise SQLError(f"unsupported expression {e!r}")

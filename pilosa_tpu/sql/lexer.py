@@ -28,7 +28,7 @@ _TOKEN_RE = re.compile(r"""
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
   | (?P<qident>"[^"]*")
   | (?P<string>'(?:''|[^'])*')
-  | (?P<op><>|!=|<=|>=|<<|>>|\|\||\||&|=|<|>|\(|\)|\[|\]|,|\*|\.|;|\+|-|/|%)
+  | (?P<op><>|!=|<=|>=|<<|>>|\|\||\||&|=|<|>|\(|\)|\[|\]|\{|\}|,|\*|\.|;|\+|-|/|%|!)
 """, re.VERBOSE)
 
 

@@ -130,6 +130,10 @@ class Parser:
             if not self.accept("op", ","):
                 break
         self.expect("op", ")")
+        # COMMENT 'text' table option (sql3 tableOption): parsed,
+        # stored nowhere — the engine keeps no table comments
+        if self.ctx_kw("comment"):
+            self.expect("string")
         return ast.CreateTable(name, cols, keys=keys,
                                if_not_exists=if_not_exists)
 
@@ -156,8 +160,13 @@ class Parser:
                 cd.epoch = self.expect("string").value
             else:
                 neg = self.accept("op", "-") is not None
-                v = int(self.expect("number").value)
+                tok = self.expect("number").value
+                v = (Decimal(tok) if "." in tok else int(tok))
                 setattr(cd, opt, -v if neg else v)
+        if cd.min is not None and cd.max is not None and \
+                cd.min > cd.max:
+            raise SQLError(f"{ctype} field min cannot be greater "
+                           "than max")
         return cd
 
     def copy_stmt(self):
@@ -334,7 +343,7 @@ class Parser:
                 (t.kind == "keyword"
                  and t.value in ("true", "false", "null"))):
             return self.literal_value()
-        if t.kind == "op" and t.value in ("(", "["):
+        if t.kind == "op" and t.value in ("(", "[", "{"):
             return self.literal_value()
         if t.kind == "op" and t.value == "-" and \
                 t1.kind == "number":
@@ -428,7 +437,17 @@ class Parser:
         # `SELECT 1 LIMIT 1` works and `SELECT 1 WHERE ...` errors in
         # the engine, not as a bogus "unsupported statement"
         has_from = bool(self.kw("from"))
-        if has_from:
+        if has_from and self.peek().kind == "op" and \
+                self.peek().value == "(":
+            # FROM (SELECT ...) [AS] alias — derived table
+            self.next()
+            if not (self.peek().kind == "keyword"
+                    and self.peek().value == "select"):
+                raise SQLError("expected SELECT in FROM subquery")
+            sel.from_select = self.select()
+            self.expect("op", ")")
+            sel.table_alias = self._table_alias()
+        elif has_from:
             sel.table = self.expect("ident").value
             sel.table_alias = self._table_alias()
         while has_from:
@@ -632,6 +651,12 @@ class Parser:
             if isinstance(e, ast.Lit) and isinstance(e.value, (int, Decimal)):
                 return ast.Lit(-e.value)
             return ast.BinOp("-", ast.Lit(0), e)
+        if self.accept("op", "+"):
+            # unary plus is the identity (defs_unops `select +i`)
+            return self.unary_expr()
+        if self.accept("op", "!"):
+            # bitwise complement, ints only (defs_unops: !10 -> -11)
+            return ast.Func("BITNOT", [self.unary_expr()])
         return self.primary()
 
     def primary(self):
@@ -682,6 +707,10 @@ class Parser:
             if self.peek().kind == "op" and self.peek().value == "(":
                 return self.func_call(name)
             if self.accept("op", "."):
+                if self.accept("op", "*"):
+                    # qualified star u.* (defs_join
+                    # join-select-start)
+                    return ast.Col("*", table=name)
                 return ast.Col(self.expect("ident").value, table=name)
             return ast.Col(name)
         raise SQLError(f"unexpected {t.value!r} at {t.pos}")
@@ -775,6 +804,17 @@ class Parser:
                         break
                 self.expect("op", "]")
             return items
+        if t.kind == "op" and t.value == "{":
+            # time-quantum pair literal {timestamp, [members]}
+            # (sql3/parser tupleExpr; defs_timequantum)
+            ts = self.literal_value()
+            self.expect("op", ",")
+            vals = self.literal_value()
+            self.expect("op", "}")
+            if not isinstance(vals, list):
+                raise SQLError(
+                    "time-quantum literal takes {timestamp, [set]}")
+            return [ts, vals]
         if t.kind == "ident" and t.value.lower() in (
                 "current_timestamp", "current_date"):
             import datetime as dt

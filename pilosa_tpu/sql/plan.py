@@ -81,6 +81,23 @@ class ViewExpandOp(PlanOp):
         return self.eng.select.select_view(self.stmt)
 
 
+class DerivedTableOp(PlanOp):
+    """FROM (SELECT ...): the inner select materializes, the outer
+    runs over its rows (sql3 tableOrSubquery; defs_subquery)."""
+
+    def __init__(self, eng, stmt):
+        self.eng, self.stmt = eng, stmt
+
+    def lines(self):
+        inner = plan_select(self.eng, self.stmt.from_select).lines()
+        return [f"derived table (FROM subquery): {line}"
+                for line in inner] + ["outer projection over the "
+                                      "materialized rows"]
+
+    def run(self):
+        return self.eng.select.select_derived(self.stmt)
+
+
 class NestedLoopJoinOp(PlanOp):
     def __init__(self, eng, stmt):
         self.eng, self.stmt = eng, stmt
@@ -224,6 +241,8 @@ def _normalize_alias(stmt: ast.Select):
 def plan_select(eng, stmt: ast.Select) -> PlanOp:
     """The single SELECT dispatch decision (executes nothing)."""
     from pilosa_tpu.sql.typecheck import check_select
+    if stmt.from_select is not None:
+        return DerivedTableOp(eng, stmt)
     if not stmt.table:
         check_select(eng, None, stmt, stmt.items)
         return ConstProjectOp(eng, stmt)

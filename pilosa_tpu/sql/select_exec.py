@@ -163,6 +163,29 @@ class SelectExec:
         return (t / n).quantize(Decimal("0.0001"),
                                 rounding=ROUND_HALF_EVEN)
 
+    def _agg_reduce(self, a: ast.Agg, vals):
+        """Reduce already-evaluated NON-NULL values for one
+        count/sum/avg/min/max aggregate — the single implementation
+        behind grouped, HAVING, and derived-table aggregation (three
+        drifting copies before r04 review)."""
+        if a.func == "count":
+            if a.distinct:
+                return len({tuple(sorted(map(str, v)))
+                            if isinstance(v, list) else v
+                            for v in vals})
+            return len(vals)
+        if not vals:
+            return None
+        if a.func == "sum":
+            return sum(vals)
+        if a.func == "avg":
+            return self._avg_quantize(sum(vals), len(vals))
+        if a.func == "min":
+            return min(vals)
+        if a.func == "max":
+            return max(vals)
+        raise SQLError(f"unsupported aggregate {a.func}")
+
     def _agg_pushable(self, idx, a: ast.Agg) -> bool:
         """True when the aggregate rides a single PQL call: plain
         column args on matching field types.  Everything else — agg
@@ -488,27 +511,23 @@ class SelectExec:
                     continue
                 vals = [self.cell_value(idx, col, r) for r in rids]
                 vals = [v for v in vals if v is not None]
-                if func == "count":
-                    if distinct:
-                        agg_vals.append(len({
-                            tuple(sorted(v)) if isinstance(v, list)
-                            else v for v in vals}))
-                    else:
-                        agg_vals.append(len(vals))
-                elif not vals:
-                    agg_vals.append(None)
-                elif func == "sum":
-                    agg_vals.append(sum(vals))
-                elif func == "avg":
-                    agg_vals.append(self._avg_quantize(sum(vals),
-                                                       len(vals)))
-            if stmt.having is not None and not self.generic_having_ok(
-                    stmt.having, len(rids), agg_specs, agg_vals):
-                continue
-            if any(func in ("sum", "avg") and agg_vals[i] is None
-                   for i, (func, _c, _d) in enumerate(agg_specs)):
-                # SUM/AVG drops groups with no aggregate rows
-                # (defs_groupby groupByTests_6)
+                agg_vals.append(self._agg_reduce(
+                    ast.Agg(func, ast.Col(col), distinct=distinct),
+                    vals))
+            if stmt.having is not None:
+                cache = {spec: agg_vals[i]
+                         for i, spec in enumerate(agg_specs)}
+                if not self.generic_having_ok(idx, stmt.having, rids,
+                                              cache):
+                    continue
+            if agg_specs and all(
+                    func in ("sum", "avg")
+                    for func, _c, _d in agg_specs) and all(
+                    v is None for v in agg_vals):
+                # a group whose ONLY aggregates are SUM/AVG with no
+                # rows is dropped (defs_groupby groupByTests_6); any
+                # count aggregate keeps it (groupByTests_8 keeps
+                # (0, None) groups)
                 continue
             out = []
             for kind, i in getters:
@@ -540,32 +559,66 @@ class SelectExec:
                 return (v,)
         return v
 
-    def generic_having_ok(self, having, count, agg_specs, agg_vals):
-        if not (isinstance(having, ast.BinOp)
-                and isinstance(having.left, ast.Agg)
-                and isinstance(having.right, ast.Lit)):
-            raise SQLError(
-                "HAVING supports COUNT(*)/SUM(col) comparisons")
-        a = having.left
+    def _group_agg_value(self, idx, a: ast.Agg, rids, cache=None):
+        """One aggregate over a group's record ids (HAVING — the
+        aggregate need not appear in the projection, defs_having);
+        projected aggregates come from the caller's cache instead of
+        re-reading every record's cells."""
         if a.func == "count" and a.arg is None:
-            val = count
-        else:
-            for i, (func, col, _d) in enumerate(agg_specs):
-                if func == a.func and col == (a.arg.name if a.arg
-                                              else None):
-                    val = agg_vals[i]
-                    break
-            else:
-                raise SQLError(
-                    "HAVING aggregate must appear in the projection")
-        if val is None:
-            return False
+            return len(rids)
+        if not isinstance(a.arg, ast.Col):
+            raise SQLError(
+                "HAVING aggregates take a column reference")
+        if cache is not None:
+            key = (a.func, a.arg.name, a.distinct)
+            if key in cache:
+                return cache[key]
+        vals = [self.cell_value(idx, a.arg.name, r) for r in rids]
+        vals = [v for v in vals if v is not None]
+        return self._agg_reduce(a, vals)
+
+    def generic_having_ok(self, idx, having, rids,
+                          cache=None) -> bool:
+        """Evaluate a HAVING expression for one group: aggregates
+        compute over the group (projected or not), with comparisons,
+        BETWEEN, and AND/OR/NOT (defs_having, defs_sql1
+        `having count(*) between 1 and 3`)."""
         import operator
         ops = {"=": operator.eq, "!=": operator.ne, "<": operator.lt,
-               "<=": operator.le, ">": operator.gt, ">=": operator.ge}
-        if having.op not in ops:
-            raise SQLError(f"HAVING operator {having.op!r} unsupported")
-        return ops[having.op](val, having.right.value)
+               "<=": operator.le, ">": operator.gt,
+               ">=": operator.ge}
+
+        def ev(e):
+            if isinstance(e, ast.Agg):
+                return self._group_agg_value(idx, e, rids, cache)
+            if isinstance(e, ast.Lit):
+                return e.value
+            if isinstance(e, ast.Not):
+                v = ev(e.expr)
+                return None if v is None else not v
+            if isinstance(e, ast.Between):
+                v, lo, hi = ev(e.col), ev(e.lo), ev(e.hi)
+                if None in (v, lo, hi):
+                    return None
+                hit = lo <= v <= hi
+                return (not hit) if e.negated else hit
+            if isinstance(e, ast.BinOp):
+                if e.op in ("and", "or"):
+                    l, r = ev(e.left), ev(e.right)
+                    if e.op == "and":
+                        return bool(l) and bool(r)
+                    return bool(l) or bool(r)
+                l, r = ev(e.left), ev(e.right)
+                if l is None or r is None:
+                    return None
+                if e.op not in ops:
+                    raise SQLError(
+                        f"HAVING operator {e.op!r} unsupported")
+                return ops[e.op](l, r)
+            raise SQLError(
+                "HAVING supports aggregate comparisons")
+        v = ev(having)
+        return v is not None and bool(v)
 
     def compile_having(self, having) -> Call:
         # HAVING COUNT(*) > n / SUM(col) > n → Condition(count/sum OP n)
@@ -863,6 +916,94 @@ class SelectExec:
         rows = limit_rows(stmt, [tuple(vals)])
         return SQLResult(schema=schema, rows=rows)
 
+    def select_derived(self, stmt: ast.Select) -> SQLResult:
+        """FROM (SELECT ...) [alias]: materialize the inner select,
+        then evaluate the outer WHERE / projections / aggregates /
+        DISTINCT / ORDER BY / LIMIT over its rows host-side (sql3
+        tableOrSubquery; defs_subquery's sum-over-grouped shape).
+        Qualified refs resolve by column name — the evaluator ignores
+        the alias qualifier."""
+        from pilosa_tpu.sql.funcs import Evaluator, _truthy
+        eng = self.eng
+        inner = eng._select(stmt.from_select)
+        names = [s[0] for s in inner.schema]
+        types = dict(inner.schema)
+        ev = Evaluator(udfs=eng._udf_callables())
+        envs = [dict(zip(names, r)) for r in inner.rows]
+        if stmt.where is not None:
+            w = eng.wherec.fold_subqueries(stmt.where)
+            keep = []
+            for env in envs:
+                v = ev.eval(w, env)
+                if v is not None and _truthy(v):
+                    keep.append(env)
+            envs = keep
+        if stmt.group_by or stmt.having is not None:
+            raise SQLError(
+                "GROUP BY over a FROM subquery is not supported")
+        # expand * to the inner columns
+        items = []
+        for it in stmt.items:
+            if isinstance(it.expr, ast.Col) and it.expr.name == "*":
+                items += [ast.SelectItem(ast.Col(n), n)
+                          for n in names]
+            else:
+                items.append(it)
+
+        def agg_eval(a: ast.Agg):
+            if a.func == "count" and a.arg is None:
+                return len(envs)
+            vals = [ev.eval(a.arg, env) for env in envs]
+            return self._agg_reduce(a, [v for v in vals
+                                        if v is not None])
+
+        def out_type(e) -> str:
+            if isinstance(e, ast.Col):
+                return types.get(e.name, "string")
+            if isinstance(e, ast.Agg):
+                if e.func == "count":
+                    return "int"
+                if e.func == "avg":
+                    return "decimal"
+                if isinstance(e.arg, ast.Col):
+                    return types.get(e.arg.name, "int")
+                return "int"
+            return "string"
+
+        aggish = [it for it in items
+                  if isinstance(it.expr, ast.Agg)]
+        if aggish:
+            if len(aggish) != len(items):
+                raise SQLError(
+                    "mixing aggregates and columns requires GROUP BY")
+            schema = [(name_of(it), out_type(it.expr))
+                      for it in items]
+            rows = limit_rows(stmt,
+                              [tuple(agg_eval(it.expr)
+                                     for it in items)])
+            return SQLResult(schema=schema, rows=rows)
+        schema = []
+        rows = []
+        for it in items:
+            e = it.expr
+            if isinstance(e, ast.Col) and e.name not in names:
+                raise SQLError(f"column not found: {e.name}")
+            schema.append((name_of(it), out_type(e)))
+        for env in envs:
+            rows.append(tuple(
+                to_sql_value(ev.eval(it.expr, env)) for it in items))
+        if stmt.distinct:
+            seen, dedup = set(), []
+            for r in rows:
+                k = distinct_key(r)
+                if k not in seen:
+                    seen.add(k)
+                    dedup.append(r)
+            rows = dedup
+        rows = order_rows(stmt, schema, rows)
+        rows = limit_rows(stmt, rows)
+        return SQLResult(schema=schema, rows=rows)
+
     def select_view(self, stmt: ast.Select) -> SQLResult:
         """Query a stored view: re-execute its select, then apply the
         outer projection / ORDER BY / LIMIT by result-column name.
@@ -1115,7 +1256,10 @@ class SelectExec:
             raise SQLError(f"unsupported WHERE form in JOIN: {e!r}")
 
         if stmt.where is not None:
-            tuples = [t for t in tuples if jeval(stmt.where, t)]
+            # uncorrelated subqueries fold to literals/IN lists first
+            # (defs_in: join WHERE with an IN-subquery)
+            folded_where = eng.wherec.fold_subqueries(stmt.where)
+            tuples = [t for t in tuples if jeval(folded_where, t)]
 
         # -- projections -----------------------------------------------
         # plans: ("col", si, name, out, type) | ("agg", Agg, out)

@@ -58,7 +58,8 @@ class StatementExec:
             return FieldOptions(type=FieldType.INT, min=cd.min,
                                 max=cd.max)
         if t == "decimal":
-            return FieldOptions(type=FieldType.DECIMAL, scale=cd.scale)
+            return FieldOptions(type=FieldType.DECIMAL, scale=cd.scale,
+                                min=cd.min, max=cd.max)
         if t == "timestamp":
             kw = {}
             if cd.time_unit is not None:
@@ -110,12 +111,37 @@ class StatementExec:
         return SQLResult()
 
     def show_columns(self, stmt: ast.ShowColumns) -> SQLResult:
+        """The reference's 14-column listing (defs_sql1 show
+        columns); untracked audit fields are empty/epoch."""
         idx = self.eng._index(stmt.table)
-        rows = [("_id", "string" if idx.keys else "id")]
-        rows += [(f.name, sql_type_of(f))
+        epoch = "1970-01-01T00:00:00Z"
+
+        def row(name, typ, o=None):
+            return (None, name, typ, epoch,
+                    bool(o.keys) if o is not None else bool(idx.keys),
+                    o.cache_type if o is not None else "",
+                    o.cache_size if o is not None else 0,
+                    o.scale if o is not None else 0,
+                    o.min if o is not None else None,
+                    o.max if o is not None else None,
+                    (o.time_unit if o is not None
+                     and typ == "timestamp" else ""),
+                    0,
+                    (str(o.time_quantum) if o is not None
+                     and o.time_quantum else ""),
+                    "")
+        rows = [row("_id", "string" if idx.keys else "id")]
+        rows += [row(f.name, sql_type_of(f), f.options)
                  for f in declared_fields(idx)]
-        return SQLResult(schema=[("name", "string"),
-                                 ("type", "string")], rows=rows)
+        return SQLResult(
+            schema=[("_id", "string"), ("name", "string"),
+                    ("type", "string"), ("created_at", "timestamp"),
+                    ("keys", "bool"), ("cache_type", "string"),
+                    ("cache_size", "int"), ("scale", "int"),
+                    ("min", "int"), ("max", "int"),
+                    ("timeunit", "string"), ("epoch", "int"),
+                    ("timequantum", "string"), ("ttl", "string")],
+            rows=rows)
 
     def show_create_table(self, stmt: ast.ShowCreateTable) -> SQLResult:
         """Canonical DDL round-trip: the emitted statement re-parses to
@@ -235,17 +261,26 @@ class StatementExec:
         # min/max constraint enforcement (defs_inserts: inserting a
         # value outside the declared int bounds is an error, not a
         # clamp)
+        from decimal import Decimal
         for f, v in zip(fields, row):
             if f is None or v is None:
                 continue
             o = f.options
-            if o.type == FieldType.INT and isinstance(v, int) and \
+            if o.type in (FieldType.INT, FieldType.DECIMAL) and \
+                    isinstance(v, (int, float, Decimal, str)) and \
                     not isinstance(v, bool):
-                if (o.min is not None and v < o.min) or \
-                        (o.max is not None and v > o.max):
+                try:
+                    dv = Decimal(str(v))
+                except ArithmeticError:
+                    continue  # typed-value errors surface on write
+                if (o.min is not None and dv < o.min) or \
+                        (o.max is not None and dv > o.max):
+                    shown = dv.normalize()
+                    if shown == shown.to_integral_value():
+                        shown = shown.quantize(Decimal(1))
                     raise SQLError(
                         f"inserting value into column '{f.name}', "
-                        f"row {row_no}, value '{v}' out of range")
+                        f"row {row_no}, value '{shown}' out of range")
         col = eng._col_id(idx, row[id_pos])
         if replace:
             # full-record replace: drop existing values first
@@ -282,6 +317,17 @@ class StatementExec:
                             f"column {f.name}: bad quantum timestamp "
                             f"{v[0]!r}")
                     v = v[1]
+                elif t == FieldType.TIME and not isinstance(v, list):
+                    # setq columns take a set or a {ts, [set]} pair,
+                    # never a bare scalar (defs_timequantum
+                    # timeQuantumTest_8)
+                    kind = ("string" if isinstance(v, str) else
+                            "bool" if isinstance(v, bool) else "int")
+                    setk = ("stringsetq" if f.options.keys
+                            else "idsetq")
+                    raise SQLError(
+                        f"an expression of type '{kind}' cannot be "
+                        f"assigned to type '{setk}'")
                 vals = v if isinstance(v, list) else [v]
                 if t == FieldType.MUTEX and len(vals) > 1:
                     raise SQLError(

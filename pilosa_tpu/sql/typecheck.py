@@ -123,7 +123,23 @@ class TypeChecker:
         if isinstance(e, ast.IsNull):
             self.check(e.col)
             return TInfo("bool")
-        if isinstance(e, (ast.InList, ast.InSelect)):
+        if isinstance(e, ast.InSelect):
+            col_t = self.check(e.col)
+            sub = e.select
+            # uncorrelated single-column subquery: its output type
+            # must be equatable with the probe column (defs_in
+            # notInTests_9: id IN (select string-col) errors)
+            if self.eng is not None and len(sub.items) == 1 and \
+                    isinstance(sub.items[0].expr, ast.Col) and \
+                    sub.items[0].expr.name not in ("*",):
+                inner_idx = self.eng.holder.index(sub.table)
+                if inner_idx is not None:
+                    c = sub.items[0].expr
+                    inner = TypeChecker(self.eng, inner_idx)
+                    self._equatable(
+                        col_t, inner._col(ast.Col(c.name)))
+            return TInfo("bool")
+        if isinstance(e, ast.InList):
             self.check(e.col)
             return TInfo("bool")
         if isinstance(e, ast.Between):
@@ -152,9 +168,14 @@ class TypeChecker:
             return TInfo("int")
         argt = self.check(e.arg) if e.arg is not None else TInfo("any")
         if isinstance(e.arg, ast.Col) and e.arg.name == "_id" and \
-                e.func in ("sum", "avg", "min", "max", "percentile"):
+                e.func in ("sum", "avg", "min", "max", "percentile",
+                           "var", "corr"):
             raise SQLError("_id column cannot be used in aggregate "
                            f"function '{e.func}'")
+        if e.func == "corr" and isinstance(e.extra, ast.Col) and \
+                e.extra.name == "_id":
+            raise SQLError("_id column cannot be used in aggregate "
+                           "function 'corr'")
         if e.func in ("sum", "avg", "var", "corr") and \
                 argt.kind not in NUMERIC + ("null", "any"):
             raise SQLError("integer or decimal expression expected")
@@ -173,7 +194,7 @@ class TypeChecker:
         # target -> allowed source kinds (defs_cast.go matrix)
         "int": ("int", "id", "bool", "string", "timestamp"),
         "id": ("id", "int", "string"),
-        "bool": ("bool", "int", "string"),
+        "bool": ("bool", "int", "id", "string"),
         "decimal": ("decimal", "int", "id", "string"),
         "string": ("string", "int", "id", "bool", "decimal",
                    "timestamp", "idset", "stringset"),
@@ -382,4 +403,8 @@ def check_select(eng, idx, stmt, items) -> None:
                 idx is None or (e.name != "_id"
                                 and idx.field(e.name) is None)):
             continue  # projection alias — resolved against outputs
-        tc.check(e)
+        t = tc.check(e)
+        if t.kind in ("idset", "stringset"):
+            # defs_orderby: sets are not orderable
+            raise SQLError("unable to sort a column of type "
+                           f"'{t.render()}'")

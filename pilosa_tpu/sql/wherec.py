@@ -332,6 +332,29 @@ class WhereCompiler:
             return Call("Row", args={name: val})
         if op == "!=":
             return Call("Not", children=[Call("Row", args={name: val})])
+        if op in ("<", "<=", ">", ">=") and t == FieldType.MUTEX \
+                and not f.options.keys:
+            # id-column range predicates (defs_filterpredicates
+            # `where id1 > 5`): enumerate the field's row ids and
+            # union the matching memberships — id values ARE row ids.
+            # Bounds compare EXACTLY (a 5.5 bound must not truncate
+            # to 5; review r04)
+            import operator
+            from decimal import Decimal, InvalidOperation
+            cmp = {"<": operator.lt, "<=": operator.le,
+                   ">": operator.gt, ">=": operator.ge}[op]
+            try:
+                bound = Decimal(str(val))
+            except (InvalidOperation, ValueError):
+                raise SQLError(
+                    f"id bound must be numeric, got {val!r}")
+            rows = [r for r in f.row_ids() if cmp(r, bound)]
+            if not rows:
+                return Call("ConstRow", args={"columns": []})
+            if len(rows) == 1:
+                return Call("Row", args={name: rows[0]})
+            return Call("Union", children=[
+                Call("Row", args={name: r}) for r in rows])
         raise SQLError(
             f"operator {op} not supported on {t.value} columns")
 

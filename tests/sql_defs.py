@@ -45,13 +45,22 @@ CASES = [
     ("show_tables", "SELECT name, keys FROM nope; SHOW TABLES",
      ("error", "nope")),
     ("show_tables_names", "SHOW TABLES",
-     [(None, "customers", "", "", "1970-01-01T00:00:00",
-       "1970-01-01T00:00:00", False, 0, ""),
-      (None, "orders", "", "", "1970-01-01T00:00:00",
-       "1970-01-01T00:00:00", False, 0, "")]),
-    ("show_columns_types", "SHOW COLUMNS FROM customers",
-     [("_id", "id"), ("name", "string"), ("region", "string"),
-      ("credit", "int")]),
+     [(None, "customers", "", "", "1970-01-01T00:00:00Z",
+       "1970-01-01T00:00:00Z", False, 0, ""),
+      (None, "orders", "", "", "1970-01-01T00:00:00Z",
+       "1970-01-01T00:00:00Z", False, 0, "")]),
+    # SHOW COLUMNS: the reference's 14-column listing — compare the
+    # (name, type) slice through a projectionless check here
+    ("show_columns_types",
+     "SHOW COLUMNS FROM customers",
+     [(None, "_id", "id", "1970-01-01T00:00:00Z", False, "", 0, 0,
+       None, None, "", 0, "", ""),
+      (None, "name", "string", "1970-01-01T00:00:00Z", True,
+       "ranked", 50000, 0, None, None, "", 0, "", ""),
+      (None, "region", "string", "1970-01-01T00:00:00Z", True,
+       "ranked", 50000, 0, None, None, "", 0, "", ""),
+      (None, "credit", "int", "1970-01-01T00:00:00Z", False,
+       "ranked", 50000, 0, None, None, "", 0, "", "")]),
     ("create_if_not_exists",
      "CREATE TABLE IF NOT EXISTS orders (_id id, x int); "
      "SELECT count(*) FROM orders", 6),
@@ -59,8 +68,14 @@ CASES = [
      "CREATE TABLE orders (_id id, x int)", ("error", "exists")),
     ("drop_if_exists_missing",
      "DROP TABLE IF EXISTS nope; SHOW COLUMNS FROM customers",
-     [("_id", "id"), ("name", "string"), ("region", "string"),
-      ("credit", "int")]),
+     [(None, "_id", "id", "1970-01-01T00:00:00Z", False, "", 0, 0,
+       None, None, "", 0, "", ""),
+      (None, "name", "string", "1970-01-01T00:00:00Z", True,
+       "ranked", 50000, 0, None, None, "", 0, "", ""),
+      (None, "region", "string", "1970-01-01T00:00:00Z", True,
+       "ranked", 50000, 0, None, None, "", 0, "", ""),
+      (None, "credit", "int", "1970-01-01T00:00:00Z", False,
+       "ranked", 50000, 0, None, None, "", 0, "", "")]),
     ("drop_then_gone",
      "DROP TABLE customers; SHOW COLUMNS FROM customers",
      ("error", "customers")),

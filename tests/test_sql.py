@@ -34,7 +34,8 @@ def rows(res):
 
 def test_show_tables_and_columns(eng):
     assert [r[1] for r in rows(eng.query_one("SHOW TABLES"))] == ["orders"]
-    cols = dict(rows(eng.query_one("SHOW COLUMNS FROM orders")))
+    cols = {r[1]: r[2]
+            for r in rows(eng.query_one("SHOW COLUMNS FROM orders"))}
     assert cols["qty"] == "int" and cols["region"] == "string"
     assert cols["tags"] == "stringset" and cols["price"] == "decimal"
     assert cols["_id"] == "id" and cols["paid"] == "bool"

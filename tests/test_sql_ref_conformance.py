@@ -23,7 +23,12 @@ def conv_exp(v):
     if isinstance(v, tuple) and len(v) == 3 and v[0] == "DEC":
         return Decimal(v[1]) / (10 ** v[2])
     if isinstance(v, tuple) and len(v) == 2 and v[0] == "TS":
-        return v[1]
+        # normalize to the engine's RFC3339-Z rendering
+        import datetime as _dt
+        d = _dt.datetime.fromisoformat(v[1].replace("Z", "+00:00"))
+        if d.tzinfo is not None:
+            d = d.astimezone(_dt.timezone.utc).replace(tzinfo=None)
+        return d.isoformat() + "Z"
     return v
 
 
@@ -82,6 +87,13 @@ def test_reference_family(origin, setup, cases):
             continue
         got = eng.query(sql)[-1].rows
         expc = [tuple(conv_exp(c) for c in r) for r in exp]
+        # ComparePartial (the reference's partial row compare):
+        # expected rows narrower than the result compare on the
+        # leading columns
+        if expc and got and all(len(r) < len(got[0]) for r in expc):
+            w = max(len(r) for r in expc)
+            got = [r[:w] for r in got]
+            expc = [r[:w] for r in expc]
         assert canon(got) == canon(expc), (cname, got, expc)
 
 
