@@ -298,3 +298,7 @@ class Select:
     # FROM (SELECT ...) [AS] alias — a derived table (sql3
     # tableOrSubquery; defs_subquery)
     from_select: "Select | None" = None
+    # WITH (flatten(col)) query hints: DISTINCT/GROUP BY on these
+    # set columns go member-wise (sql3 query hints;
+    # defs_groupby groupBySetDistinctTests)
+    flatten: list = field(default_factory=list)

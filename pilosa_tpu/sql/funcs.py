@@ -311,6 +311,11 @@ def call_builtin(name: str, args: list):
     if name.startswith("SETCONTAINS"):
         if a and a[0] is None:
             return None
+    elif name in ("FORMAT", "STR"):
+        # a NULL argument to FORMAT/STR is an ERROR, not NULL
+        # (defs_string_functions FormatNullArgument / StrNullArg)
+        if any(x is None for x in a):
+            raise SQLError(f"{name}: NULL argument")
     elif any(x is None for x in a):
         return None
 
@@ -340,11 +345,17 @@ def _dispatch(name: str, a: list):
         return len(_s(a[0], name))
     if name == "ASCII":
         s = _s(a[0], name)
-        if len(s) != 1:
-            raise SQLError("ASCII expects a single character")
+        # byte-length semantics (inbuiltfunctionsstring.go): a
+        # non-ASCII char is multi-byte in UTF-8 and rejected
+        if len(s) != 1 or ord(s) > 127:
+            raise SQLError(f"value {s!r} should be of the length 1")
         return ord(s)
     if name == "CHAR":
-        return chr(_i(a[0], name))
+        v = _i(a[0], name)
+        if not (0 <= v <= 255):
+            # inbuiltfunctionsstring.go: CHAR is a single byte
+            raise SQLError(f"value '{v}' out of range")
+        return chr(v)
     if name == "SPACE":
         return " " * _i(a[0], name)
     if name == "REPLICATE":
@@ -387,11 +398,13 @@ def _dispatch(name: str, a: list):
             return parts[0]
         return parts[pos] if pos < len(parts) else ""
     if name == "FORMAT":
-        # Go fmt.Sprintf-style; %d/%s/%f/%v subset via %-formatting
+        # Go fmt.Sprintf-style; %d/%s/%f/%v/%t subset via
+        # %-formatting
         fmt = _s(a[0], name)
         try:
-            return fmt.replace("%v", "%s") % tuple(
-                str(x) if isinstance(x, bool) else x for x in a[1:])
+            return fmt.replace("%v", "%s").replace("%t", "%s") % tuple(
+                ("true" if x else "false") if isinstance(x, bool)
+                else x for x in a[1:])
         except (TypeError, ValueError) as exc:
             raise SQLError(f"FORMAT: {exc}")
     if name == "STR":

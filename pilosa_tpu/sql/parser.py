@@ -489,6 +489,32 @@ class Parser:
                     "JOIN ON must be column = column equality")
             sel.joins.append(ast.Join(jt, cond.left, cond.right,
                                       outer=outer, alias=alias))
+        if has_from and self.kw("with"):
+            # WITH (hint(args), ...) query hints (sql3 tableOption
+            # hints; only flatten is known)
+            self.expect("op", "(")
+            while True:
+                hname = self.expect("ident").value
+                self.expect("op", "(")
+                args = []
+                if not self.accept("op", ")"):
+                    while True:
+                        args.append(self.expect("ident").value)
+                        if not self.accept("op", ","):
+                            break
+                    self.expect("op", ")")
+                if hname.lower() != "flatten":
+                    raise SQLError(
+                        f"unknown query hint '{hname}'")
+                if len(args) != 1:
+                    raise SQLError(
+                        "query hint 'flatten' expected 1 "
+                        "parameter(s) (column name), got "
+                        f"{len(args)} parameter(s)")
+                sel.flatten.append(args[0])
+                if not self.accept("op", ","):
+                    break
+            self.expect("op", ")")
         if self.kw("where"):
             sel.where = self.expr()
         if self.kw("group"):

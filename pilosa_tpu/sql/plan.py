@@ -292,10 +292,13 @@ def plan_select(eng, stmt: ast.Select) -> PlanOp:
             raise SQLError(
                 "mixing aggregates and columns requires GROUP BY")
         return PQLAggregateOp(eng, stmt, idx, items)
+    for fcol in stmt.flatten:
+        eng._field(idx, fcol)  # column 'foo' not found
     if stmt.distinct and len(items) == 1 and \
             isinstance(items[0].expr, ast.Col) and \
             items[0].expr.name != "_id" and \
-            not _is_setlike(eng, idx, items[0].expr.name):
+            (items[0].expr.name in stmt.flatten or
+             not _is_setlike(eng, idx, items[0].expr.name)):
         return DistinctScanOp(eng, stmt, idx, items)
     return ExtractScanOp(eng, stmt, idx, items)
 
@@ -332,6 +335,9 @@ def _needs_generic_group(eng, idx, stmt, items) -> bool:
     from pilosa_tpu.models import FieldType
     for g in stmt.group_by:
         f = eng._field(idx, g)
+        if f.options.type in (FieldType.SET, FieldType.TIME) and \
+                g in stmt.flatten:
+            continue  # flattened sets group member-wise (pushdown)
         if f.options.type not in (FieldType.MUTEX, FieldType.BOOL):
             return True
     for it in items:

@@ -425,7 +425,14 @@ class SelectExec:
             for kind, gi in getters:
                 if kind == "group":
                     ge = g.group[gi]
-                    vals.append(ge.get("row_key", ge["row_id"]))
+                    gv = ge.get("row_key", ge["row_id"])
+                    gf = eng._field(idx, group_cols[gi])
+                    if gf.options.type in (FieldType.SET,
+                                           FieldType.TIME):
+                        # member-wise (flattened) set group keys
+                        # project as single-member sets
+                        gv = [gv]
+                    vals.append(gv)
                 elif kind == "count":
                     vals.append(g.count)
                 elif kind == "sum":
@@ -648,7 +655,13 @@ class SelectExec:
             values = res.columns().tolist()
             if f.options.keys:
                 values = f.row_translator.translate_ids(values)
-        rows = [(to_sql_value(v),) for v in values]
+        if name in stmt.flatten and f.options.type in (
+                FieldType.SET, FieldType.TIME):
+            # flattened distinct members stay single-member SETS
+            # (defs_groupby groupBySetDistinctTests_4: [1], [2], ...)
+            rows = [([to_sql_value(v)],) for v in values]
+        else:
+            rows = [(to_sql_value(v),) for v in values]
         schema = [(name_of(item), sql_type_of(f))]
         rows = order_rows(stmt, schema, rows)
         rows = limit_rows(stmt, rows)

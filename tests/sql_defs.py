@@ -492,7 +492,7 @@ CASES = [
      [("west",)]),
     ("fn_ascii_multichar_errors",
      "SELECT ASCII(region) FROM orders WHERE _id = 1",
-     ("error", "single character")),
+     ("error", "should be of the length 1")),
     ("fn_arity_validated_before_null",
      # NULL args must not mask an arity error (r03 review)
      "INSERT INTO orders (_id, qty) VALUES (8, 1); "
