@@ -788,8 +788,13 @@ class Executor(AdvancedOps):
             tr = f.row_translator
             if tr is None:
                 raise ExecError("Rows(like=) requires a keyed field")
-            from pilosa_tpu.pql.like import like_regex
-            pat = like_regex(like)
+            # PQL Rows(like=) uses the key-filter matcher (like.go);
+            # the SQL WHERE planner passes _like_sql for the sql3
+            # scalar regex semantics instead
+            # (sql3/planner/expression.go:2991)
+            from pilosa_tpu.pql.like import like_regex, sql_like_regex
+            pat = (sql_like_regex(like) if call.arg("_like_sql")
+                   else like_regex(like))
             ids &= set(tr.match(lambda k: pat.match(k) is not None))
         out = sorted(ids)
         if previous is not None:

@@ -1,6 +1,20 @@
-"""LIKE-pattern matching shared by the PQL Rows(like=) path and the
-SQL residue evaluator (like.go:13 planLike semantics: ``%`` matches
-any run, ``_`` exactly one character)."""
+"""LIKE-pattern matching.
+
+The reference has TWO distinct matchers and we mirror both:
+
+- ``like_match`` — the key-filter matcher used by the PQL
+  Rows(like=) path (like.go:13 planLike/matchLike semantics:
+  case-sensitive, ``%`` matches any run, ``_`` exactly one
+  character).
+- ``sql_like_match`` — the SQL scalar operator
+  (sql3/planner/expression.go:2991 wildCardToRegexp: matching is
+  CASE-INSENSITIVE, ``%`` -> ``.*`` and ``_`` -> ``.+`` i.e. one OR
+  MORE characters — so ``'foo' LIKE '%f_'`` is true there even
+  though the key matcher rejects it; defs_like.go likeTests_6).
+  One deliberate deviation: the reference splices the pattern into
+  the regex unescaped, so regex metacharacters misbehave there; we
+  escape them.
+"""
 
 from __future__ import annotations
 
@@ -17,3 +31,15 @@ def like_regex(pattern: str) -> re.Pattern:
 
 def like_match(value: str, pattern: str) -> bool:
     return like_regex(pattern).match(value) is not None
+
+
+def sql_like_regex(pattern: str) -> re.Pattern:
+    return re.compile(
+        "^" + "".join(
+            ".*" if ch == "%" else ".+" if ch == "_"
+            else re.escape(ch) for ch in pattern) + "$",
+        re.DOTALL | re.IGNORECASE)
+
+
+def sql_like_match(value: str, pattern: str) -> bool:
+    return sql_like_regex(pattern).match(value) is not None

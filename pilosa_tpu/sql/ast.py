@@ -93,6 +93,7 @@ class Insert:
 class Delete:
     table: str
     where: Any = None
+    alias: str | None = None
 
 
 # --- expressions -----------------------------------------------------------
@@ -107,12 +108,16 @@ class Col:
 class Join:
     """JOIN clause (sql3 opnestedloops.go nested-loop join).  With
     outer=True it is a LEFT [OUTER] JOIN: unmatched left records
-    survive with NULL right-side values."""
-    table: str
-    left: "Col"
-    right: "Col"
+    survive with NULL right-side values.  left/right are None for a
+    comma join (FROM a, b — a cross product whose condition lives in
+    WHERE, sql3/parser parseSource); subquery holds a derived-table
+    side (FROM a, (SELECT ...) x)."""
+    table: str | None
+    left: "Col | None"
+    right: "Col | None"
     outer: bool = False
     alias: str | None = None
+    subquery: Any = None  # ast.Select for derived-table sides
 
 
 @dataclass
@@ -264,9 +269,13 @@ class OrderBy:
 
 @dataclass
 class BulkInsert:
-    """BULK INSERT ... FROM 'file' WITH FORMAT 'CSV' INPUT 'FILE'
-    (sql3/parser bulk-insert statement, CSV/file subset).  Columns map
-    positionally to CSV fields; header_row skips the first line."""
+    """BULK INSERT ... [MAP (...)] [TRANSFORM (...)] FROM 'file'|x'...'
+    WITH BATCHSIZE n FORMAT 'CSV' INPUT 'FILE'|'STREAM' (sql3/parser
+    bulk-insert statement).  Without MAP, columns map positionally to
+    CSV fields; with MAP, each entry is (source, kind, scale) where
+    source is a CSV position (int) or record path (str), and TRANSFORM
+    expressions (@N = mapped value N) produce the column values;
+    header_row skips the first line."""
     table: str
     columns: list[str]
     path: str = ""
@@ -275,6 +284,12 @@ class BulkInsert:
     header_row: bool = False
     # inline payload for INPUT 'STREAM': rows arrive as literal text
     payload: str | None = None
+    # MAP (src TYPE, ...): list of (source, kind, scale)
+    maps: list | None = None
+    # TRANSFORM (expr, ...): one expression per target column
+    transforms: list | None = None
+    batch_size: int | None = None
+    allow_missing: bool = False
 
 
 @dataclass

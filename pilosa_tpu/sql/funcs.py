@@ -728,8 +728,10 @@ def _arith(op, l, r):
 
 
 def _like(v, pattern) -> bool:
-    from pilosa_tpu.pql.like import like_match
-    return like_match(_s(v, "LIKE"), _s(pattern, "LIKE"))
+    # SQL scalar LIKE follows the sql3 planner's regex semantics, not
+    # the key-filter matcher (sql3/planner/expression.go:2991)
+    from pilosa_tpu.pql.like import sql_like_match
+    return sql_like_match(_s(v, "LIKE"), _s(pattern, "LIKE"))
 
 
 def columns_in(e, out: set | None = None) -> set:

@@ -24,8 +24,8 @@ KEYWORDS = {
 _TOKEN_RE = re.compile(r"""
     (?P<ws>\s+|--[^\n]*)
   | (?P<number>\d+\.\d*|\.\d+|\d+)
-  | (?P<var>@[A-Za-z_][A-Za-z0-9_]*)
-  | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<var>@(?:\d+|[A-Za-z_][A-Za-z0-9_]*))
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_-]*)
   | (?P<qident>"[^"]*")
   | (?P<string>'(?:''|[^'])*')
   | (?P<op><>|!=|<=|>=|<<|>>|\|\||\||&|=|<|>|\(|\)|\[|\]|\{|\}|,|\*|\.|;|\+|-|/|%|!)

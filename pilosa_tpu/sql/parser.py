@@ -358,11 +358,18 @@ class Parser:
         from pilosa_tpu.sql.funcs import Evaluator
         return Evaluator().eval(e, {})
 
+    _MAP_TYPES = {"id", "string", "int", "decimal", "bool",
+                  "timestamp", "stringset", "idset", "idsetq",
+                  "stringsetq"}
+
     def bulk_insert(self):
-        """BULK INSERT INTO t (_id, a, b) FROM '<src>' WITH FORMAT
-        'CSV' INPUT 'FILE'|'STREAM' [HEADER_ROW] (sql3/parser bulk-
-        insert, CSV subset; columns map positionally to CSV fields;
-        INPUT 'STREAM' takes the rows inline as the FROM string)."""
+        """BULK INSERT INTO t (cols...) [MAP (src TYPE, ...)]
+        [TRANSFORM (@N-expr, ...)] FROM '<src>'|x'<rows>' WITH
+        [BATCHSIZE n] FORMAT 'CSV' INPUT 'FILE'|'STREAM'
+        [HEADER_ROW] [ALLOW_MISSING_VALUES] (sql3/parser bulk-insert
+        grammar; defs_bulkinsert.go shapes).  Without MAP, columns map
+        positionally to CSV fields; MAP sources are CSV positions,
+        TRANSFORM expressions reference them as @N."""
         self.expect_kw("bulk")
         self.expect_kw("insert")
         self.expect_kw("into")
@@ -374,9 +381,46 @@ class Parser:
             if not self.accept("op", ","):
                 break
         self.expect("op", ")")
-        self.expect_kw("from")
-        src = self.expect("string").value
         stmt = ast.BulkInsert(table, cols)
+        if self.ctx_kw("map"):
+            stmt.maps = []
+            self.expect("op", "(")
+            while True:
+                t = self.next()
+                if t.kind == "number" and "." not in t.value:
+                    src = int(t.value)
+                elif t.kind == "string":
+                    src = t.value
+                else:
+                    raise SQLError(
+                        "MAP source must be a position or path")
+                ktok = self.next()
+                kind = ktok.value.lower()
+                if kind not in self._MAP_TYPES:
+                    raise SQLError(f"unknown MAP type {ktok.value!r}")
+                scale = None
+                if kind == "decimal" and self.accept("op", "("):
+                    scale = int(self.expect("number").value)
+                    self.expect("op", ")")
+                stmt.maps.append((src, kind, scale))
+                if not self.accept("op", ","):
+                    break
+            self.expect("op", ")")
+        if self.ctx_kw("transform"):
+            stmt.transforms = []
+            self.expect("op", "(")
+            while True:
+                stmt.transforms.append(self.expr())
+                if not self.accept("op", ","):
+                    break
+            self.expect("op", ")")
+        self.expect_kw("from")
+        if self.peek().kind == "ident" and \
+                self.peek().value.lower() == "x" and \
+                self.peek(1).kind == "string":
+            # x'...' inline blob (the reference's STREAM payload form)
+            self.next()
+        src = self.expect("string").value
         self.expect_kw("with")
         fmt = inp = None
         while True:
@@ -386,6 +430,10 @@ class Parser:
                 inp = self.expect("string").value.upper()
             elif self.ctx_kw("header_row"):
                 stmt.header_row = True
+            elif self.ctx_kw("batchsize"):
+                stmt.batch_size = int(self.expect("number").value)
+            elif self.ctx_kw("allow_missing_values"):
+                stmt.allow_missing = True
             else:
                 break
         if fmt != "CSV":
@@ -400,13 +448,21 @@ class Parser:
         return stmt
 
     def delete(self):
+        """DELETE FROM t [[AS] alias] [WHERE ...] (sql3/parser
+        parseDeleteStatement + parseQualifiedTableName; DELETE joins
+        are unsupported there too — defs_delete.go:121 keeps its join
+        case disabled)."""
         self.expect_kw("delete")
         self.expect_kw("from")
         table = self.expect("ident").value
+        alias = self._table_alias()
+        if (self.peek().kind == "keyword"
+                and self.peek().value in ("inner", "join")):
+            raise SQLError("joins are not supported in DELETE")
         where = None
         if self.kw("where"):
             where = self.expr()
-        return ast.Delete(table, where)
+        return ast.Delete(table, where, alias=alias)
 
     def select(self):
         self.expect_kw("select")
@@ -465,6 +521,15 @@ class Parser:
                 return (t1.kind == "ident" and t1.value.lower() == "outer"
                         and t2.kind == "keyword" and t2.value == "join")
 
+            if self.accept("op", ","):
+                # comma join: FROM a, b [, (SELECT ...) x] — a cross
+                # product; the join condition lives in WHERE
+                # (sql3/parser source lists; defs_join.go commajoin)
+                jt, sub = self._join_source()
+                sel.joins.append(ast.Join(jt, None, None,
+                                          alias=self._table_alias(),
+                                          subquery=sub))
+                continue
             if self.kw("inner"):
                 self.expect_kw("join")
             elif _at_ctx_join("left"):
@@ -478,7 +543,7 @@ class Parser:
                 raise SQLError(f"{kind} join types are not supported")
             elif not self.kw("join"):
                 break
-            jt = self.expect("ident").value
+            jt, sub = self._join_source()
             alias = self._table_alias()
             self.expect_kw("on")
             cond = self.expr()
@@ -488,7 +553,8 @@ class Parser:
                 raise SQLError(
                     "JOIN ON must be column = column equality")
             sel.joins.append(ast.Join(jt, cond.left, cond.right,
-                                      outer=outer, alias=alias))
+                                      outer=outer, alias=alias,
+                                      subquery=sub))
         if has_from and self.kw("with"):
             # WITH (hint(args), ...) query hints (sql3 tableOption
             # hints; only flatten is known)
@@ -555,6 +621,20 @@ class Parser:
 
     # reserved words that must not be eaten as a bare table alias
     _NO_ALIAS = {"left", "outer", "full", "right", "cross", "copy"}
+
+    def _join_source(self):
+        """One join source: a table name, or (SELECT ...) derived
+        table.  Returns (table_name, subselect) with exactly one
+        set."""
+        if self.peek().kind == "op" and self.peek().value == "(":
+            self.next()
+            if not (self.peek().kind == "keyword"
+                    and self.peek().value == "select"):
+                raise SQLError("expected SELECT in FROM subquery")
+            sub = self.select()
+            self.expect("op", ")")
+            return None, sub
+        return self.expect("ident").value, None
 
     def _table_alias(self) -> str | None:
         """Optional table alias: AS name or a bare identifier
@@ -678,8 +758,15 @@ class Parser:
                 return ast.Lit(-e.value)
             return ast.BinOp("-", ast.Lit(0), e)
         if self.accept("op", "+"):
-            # unary plus is the identity (defs_unops `select +i`)
-            return self.unary_expr()
+            # unary plus is the numeric identity: it still type-checks
+            # (defs_unops: `select +i` -> 10 but `select +ts` errors
+            # "operator '+' incompatible with type 'timestamp'")
+            e = self.unary_expr()
+            if isinstance(e, ast.Lit) and \
+                    isinstance(e.value, (int, Decimal)) and \
+                    not isinstance(e.value, bool):
+                return e
+            return ast.BinOp("+", ast.Lit(0), e)
         if self.accept("op", "!"):
             # bitwise complement, ints only (defs_unops: !10 -> -11)
             return ast.Func("BITNOT", [self.unary_expr()])

@@ -11,6 +11,8 @@ the fan-out collapsed into the stacked device executor.
 
 from __future__ import annotations
 
+import re
+
 from pilosa_tpu.executor import DistinctValues
 from pilosa_tpu.models import FieldType
 from pilosa_tpu.pql.ast import Call, Condition
@@ -1115,23 +1117,38 @@ class SelectExec:
             raise SQLError("HAVING requires GROUP BY")
 
         # -- side registry ---------------------------------------------
-        sides: list[tuple[str, str, object]] = []  # (key, table, idx)
+        # (key, table, idx, derived); derived = (rows, names, types)
+        # for a materialized (SELECT ...) side, else None
+        sides: list[tuple] = []
 
-        def add_side(table, alias):
-            idx = eng._index(table)
-            key = alias or table
-            if any(k == key for k, _t, _i in sides):
+        def add_side(table, alias, subquery=None):
+            if subquery is not None:
+                if not alias:
+                    raise SQLError(
+                        "a derived table in a join requires an alias")
+                inner = eng._select(subquery)
+                names = [s[0] for s in inner.schema]
+                types = dict(inner.schema)
+                derived = (inner.rows, names, types)
+                idx = None
+                key = alias
+            else:
+                idx = eng._index(table)
+                derived = None
+                key = alias or table
+            if any(s[0] == key for s in sides):
                 raise SQLError(
                     f"duplicate table name or alias {key!r} "
                     "(alias the table)")
-            sides.append((key, table, idx))
+            sides.append((key, table, idx, derived))
         add_side(stmt.table, stmt.table_alias)
         for j in stmt.joins:
-            add_side(j.table, j.alias)
-        keymap = {k: i for i, (k, _t, _i) in enumerate(sides)}
+            add_side(j.table, j.alias, j.subquery)
+        keymap = {s[0]: i for i, s in enumerate(sides)}
         by_table: dict[str, list[int]] = {}
-        for i, (_k, t, _i) in enumerate(sides):
-            by_table.setdefault(t, []).append(i)
+        for i, s in enumerate(sides):
+            if s[1] is not None:
+                by_table.setdefault(s[1], []).append(i)
 
         def side_index(qual: str, ctx: str) -> int:
             if qual in keymap:
@@ -1149,7 +1166,18 @@ class SelectExec:
 
         def side_field_tinfo(si: int, name: str):
             from pilosa_tpu.sql.typecheck import TInfo, field_tinfo
-            idx = sides[si][2]
+            _k, _t, idx, derived = sides[si]
+            if derived is not None:
+                _rows, names, types = derived
+                if name not in types:
+                    raise SQLError(f"column not found: {name}")
+                kind = types[name]
+                if kind.startswith("decimal"):
+                    # schema types may carry scale ("decimal(3)")
+                    m = re.match(r"decimal\((\d+)\)", kind)
+                    return TInfo("decimal",
+                                 scale=int(m.group(1)) if m else 2)
+                return TInfo(kind)
             if name == "_id":
                 return TInfo("string" if idx.keys else "id")
             f = idx.field(name)
@@ -1165,16 +1193,92 @@ class SelectExec:
                 return None
             key = (si, col, rid)
             if key not in cell_cache:
-                cell_cache[key] = self.cell_value(sides[si][2], col,
-                                                  rid)
+                _k, _t, idx, derived = sides[si]
+                if derived is not None:
+                    rows, names, _types = derived
+                    if col not in names:
+                        raise SQLError(f"column not found: {col}")
+                    cell_cache[key] = rows[rid][names.index(col)]
+                else:
+                    cell_cache[key] = self.cell_value(idx, col, rid)
             return cell_cache[key]
 
-        # -- build joined tuples (one record id per side) --------------
         all_call = Call("All")
-        tuples: list[tuple] = [
-            (rid,) for rid in self.table_ids(sides[0][2], all_call)]
+
+        def side_ids(si: int):
+            _k, _t, idx, derived = sides[si]
+            if derived is not None:
+                return range(len(derived[0]))
+            return self.table_ids(idx, all_call)
+
+        def where_equality_for(new_si: int):
+            """Find a top-level AND-tree conjunct col = col in WHERE
+            relating side new_si to an earlier side, so a comma join
+            can hash-join instead of building the cross product (the
+            conjunct stays in WHERE; re-evaluating it is harmless)."""
+            def conjuncts(e):
+                if isinstance(e, ast.BinOp) and e.op == "and":
+                    yield from conjuncts(e.left)
+                    yield from conjuncts(e.right)
+                else:
+                    yield e
+            if stmt.where is None:
+                return None
+            for c in conjuncts(stmt.where):
+                if not (isinstance(c, ast.BinOp) and c.op == "="
+                        and isinstance(c.left, ast.Col)
+                        and isinstance(c.right, ast.Col)
+                        and c.left.table is not None
+                        and c.right.table is not None):
+                    continue
+                try:
+                    lsi = side_index(c.left.table, "WHERE")
+                    rsi = side_index(c.right.table, "WHERE")
+                    kinds = {side_field_tinfo(lsi, c.left.name).kind,
+                             side_field_tinfo(rsi, c.right.name).kind}
+                except SQLError:
+                    continue  # validated later by the WHERE walk
+                if kinds & {"idset", "stringset"}:
+                    # sets hash by membership but WHERE re-evaluates
+                    # as equality — leave those to the cross product
+                    continue
+                if rsi == new_si and lsi < new_si:
+                    return c.left, c.right, lsi
+                if lsi == new_si and rsi < new_si:
+                    return c.right, c.left, rsi
+            return None
+
+        # -- build joined tuples (one record id per side) --------------
+        tuples: list[tuple] = [(rid,) for rid in side_ids(0)]
         for ji, j in enumerate(stmt.joins):
             new_si = ji + 1
+            if j.left is None:  # comma join
+                eq = where_equality_for(new_si)
+                if eq is not None:
+                    jl, jr, lsi = eq
+                    rmap: dict = {}
+                    for rid in side_ids(new_si):
+                        v = cell(new_si, jr.name, rid)
+                        if v is None:
+                            continue
+                        for key in (v if isinstance(v, list)
+                                    else [v]):
+                            rmap.setdefault(key, []).append(rid)
+                    out = []
+                    for t in tuples:
+                        lv = cell(lsi, jl.name, t[lsi])
+                        if lv is None:
+                            continue
+                        for key in (lv if isinstance(lv, list)
+                                    else [lv]):
+                            for rid in rmap.get(key, ()):
+                                out.append(t + (rid,))
+                    tuples = out
+                    continue
+                new_ids = list(side_ids(new_si))  # cross product;
+                tuples = [t + (rid,) for t in tuples  # WHERE filters
+                          for rid in new_ids]
+                continue
             jl, jr = j.left, j.right
             for c in (jl, jr):
                 if not isinstance(c, ast.Col) or c.table is None:
@@ -1193,10 +1297,9 @@ class SelectExec:
             tc = TypeChecker(eng)
             tc._equatable(side_field_tinfo(lsi, jl.name),
                           side_field_tinfo(rsi, jr.name))
-            ridx = sides[rsi][2]
             rmap: dict = {}
-            for rid in self.table_ids(ridx, all_call):
-                v = self.cell_value(ridx, jr.name, rid)
+            for rid in side_ids(rsi):
+                v = cell(rsi, jr.name, rid)
                 if v is None:
                     continue
                 for key in (v if isinstance(v, list) else [v]):
@@ -1280,15 +1383,20 @@ class SelectExec:
 
         def add_col(si, name, out):
             t = side_field_tinfo(si, name)
+            derived = sides[si][3]
             plans.append(("col", si, name, out,
                           "decimal" if t.kind == "decimal"
-                          else t.kind if name != "_id"
+                          else t.kind if name != "_id" or derived
                           else ("string" if sides[si][2].keys
                                 else "id")))
 
         def star_side(si, qualify):
-            idx = sides[si][2]
+            _k, _t, idx, derived = sides[si]
             pre = f"{sides[si][0]}." if qualify else ""
+            if derived is not None:
+                for n in derived[1]:
+                    add_col(si, n, pre + n)
+                return
             add_col(si, "_id", pre + "_id")
             for f in declared_fields(idx):
                 add_col(si, f.name, pre + f.name)

@@ -340,16 +340,85 @@ class StatementExec:
     def bulk_insert(self, stmt: ast.BulkInsert) -> SQLResult:
         """BULK INSERT: stream a CSV (file or inline payload) through
         the same record-apply path as INSERT — the COPY/BULK INSERT
-        ingest statement (sql3/parser bulk insert, CSV subset).
-        Columns map positionally; empty cells are NULL; idset/
-        stringset cells may hold ';'-separated lists."""
+        ingest statement (sql3/parser bulk insert; defs_bulkinsert.go
+        MAP/TRANSFORM shapes).  Without MAP, columns map positionally
+        and empty cells are NULL; idset/stringset cells may hold
+        ';'-separated lists.  With MAP, sources convert per the MAP
+        type and TRANSFORM expressions (@N) produce column values,
+        checked for assignment compatibility before any write."""
         idx = self.eng._index(stmt.table)
         fields, id_pos = self.bulk_fields(idx, stmt.columns)
-        n = 0
+        self.bulk_typecheck(stmt, idx, fields)
         for row in self.iter_bulk_rows(stmt, idx, fields):
             self.apply_record(idx, fields, row, id_pos, replace=False)
-            n += 1
-        return SQLResult(schema=[("rows_inserted", "int")], rows=[(n,)])
+        # like INSERT, the reference returns no result set
+        # (defs_bulkinsert.go ExpHdrs empty)
+        return SQLResult()
+
+    _BULK_ASSIGN_OK = {
+        "id": {"id", "int"},
+        "int": {"int"},
+        "decimal": {"decimal", "int"},
+        "string": {"string"},
+        "bool": {"bool"},
+        "timestamp": {"timestamp", "string", "int"},
+        "idset": {"idset", "idsetq", "id", "int"},
+        "stringset": {"stringset", "stringsetq", "string"},
+    }
+
+    def bulk_typecheck(self, stmt, idx, fields):
+        """MAP/TRANSFORM assignment compatibility (the reference's
+        bulk-insert analyze step; defs_bulkinsert.go expects e.g.
+        "an expression of type 'string' cannot be assigned to type
+        'int'")."""
+        if stmt.maps is None:
+            if stmt.transforms is not None:
+                raise SQLError("TRANSFORM requires a MAP clause")
+            return
+        from pilosa_tpu.sql.typecheck import (
+            TInfo, TypeChecker, field_tinfo)
+
+        def map_tinfo(i):
+            _src, kind, scale = stmt.maps[i]
+            return TInfo(kind,
+                         scale=scale if scale is not None else 0)
+
+        if stmt.transforms is not None:
+            if len(stmt.transforms) != len(stmt.columns):
+                raise SQLError(
+                    f"mismatch in the count of expressions: "
+                    f"{len(stmt.transforms)} transforms for "
+                    f"{len(stmt.columns)} columns")
+            srcs = []
+            for e in stmt.transforms:
+                if isinstance(e, ast.Var) and e.name.isdigit():
+                    n = int(e.name)
+                    if n >= len(stmt.maps):
+                        raise SQLError(f"unknown map reference @{n}")
+                    srcs.append(map_tinfo(n))
+                elif isinstance(e, ast.Lit):
+                    srcs.append(TypeChecker(self.eng, idx)._lit(e.value))
+                else:
+                    srcs.append(TInfo("any"))
+        else:
+            if len(stmt.maps) != len(stmt.columns):
+                raise SQLError(
+                    f"mismatch in the count of expressions: "
+                    f"{len(stmt.maps)} map values for "
+                    f"{len(stmt.columns)} columns")
+            srcs = [map_tinfo(i) for i in range(len(stmt.maps))]
+        for ci, (f, src) in enumerate(zip(fields, srcs)):
+            if f is None:
+                dst = TInfo("string" if idx.keys else "id")
+            else:
+                dst = field_tinfo(f)
+            if src.kind in ("any", "null"):
+                continue
+            ok = self._BULK_ASSIGN_OK.get(dst.kind, {dst.kind})
+            if src.kind not in ok:
+                raise SQLError(
+                    f"an expression of type '{src.render()}' cannot "
+                    f"be assigned to type '{dst.render()}'")
 
     def bulk_fields(self, idx, columns):
         """Resolve BULK INSERT target fields (+ the _id position)."""
@@ -394,6 +463,53 @@ class StatementExec:
                         for i in items]
             return text if f.options.keys else int(text)
 
+        def convert_map(text: str, kind: str, scale):
+            if text == "":
+                return None
+            if kind in ("id", "int"):
+                return int(text)
+            if kind == "decimal":
+                from decimal import Decimal
+                return Decimal(text)
+            if kind == "bool":
+                return text.strip().lower() in ("1", "true", "t",
+                                                "yes")
+            if kind in ("idset", "idsetq"):
+                return [int(i) for i in text.split(";")]
+            if kind in ("stringset", "stringsetq"):
+                return text.split(";")
+            return text  # string / timestamp pass through
+
+        if stmt.transforms is not None:
+            from pilosa_tpu.sql.funcs import Evaluator
+            transform_ev = Evaluator(udfs=self.eng._udf_callables())
+
+        def mapped_row(raw, row_no):
+            vals = []
+            for src, kind, scale in stmt.maps:
+                if not isinstance(src, int):
+                    raise SQLError(
+                        "MAP path sources require a record format "
+                        "(CSV maps by position)")
+                if src >= len(raw):
+                    if stmt.allow_missing:
+                        vals.append(None)
+                        continue
+                    raise SQLError(
+                        f"CSV row {row_no} has {len(raw)} fields, "
+                        f"map references position {src}")
+                try:
+                    vals.append(convert_map(raw[src].strip(), kind,
+                                            scale))
+                except (ValueError, ArithmeticError) as exc:
+                    raise SQLError(f"CSV row {row_no}: bad value "
+                                   f"({exc})")
+            if stmt.transforms is None:
+                return vals
+            env = {f"@{i}": v for i, v in enumerate(vals)}
+            return [transform_ev.eval(e, env)
+                    for e in stmt.transforms]
+
         if stmt.input == "FILE":
             try:
                 fh = open(stmt.path, newline="")
@@ -409,16 +525,19 @@ class StatementExec:
                     continue
                 if not raw:
                     continue
-                if len(raw) != len(stmt.columns):
+                if stmt.maps is not None:
+                    row = mapped_row(raw, i + 1)
+                elif len(raw) != len(stmt.columns):
                     raise SQLError(
                         f"CSV row {i + 1} has {len(raw)} fields, "
                         f"expected {len(stmt.columns)}")
-                try:
-                    row = [convert(f, cell.strip())
-                           for f, cell in zip(fields, raw)]
-                except (ValueError, ArithmeticError) as exc:
-                    raise SQLError(
-                        f"CSV row {i + 1}: bad value ({exc})")
+                else:
+                    try:
+                        row = [convert(f, cell.strip())
+                               for f, cell in zip(fields, raw)]
+                    except (ValueError, ArithmeticError) as exc:
+                        raise SQLError(
+                            f"CSV row {i + 1}: bad value ({exc})")
                 if row[id_pos] is None:
                     raise SQLError(f"CSV row {i + 1} has empty _id")
                 yield row
@@ -439,6 +558,26 @@ class StatementExec:
     def delete(self, stmt: ast.Delete) -> SQLResult:
         eng = self.eng
         idx = eng._index(stmt.table)
+        # qualified WHERE columns must name the target table or its
+        # alias — a bogus qualifier must not silently resolve
+        allowed = {stmt.table, stmt.alias} - {None}
+
+        def walk(e):
+            if isinstance(e, ast.Col):
+                if e.table is not None and e.table not in allowed:
+                    raise SQLError(f"unknown table {e.table!r}")
+                return
+            if e is None or isinstance(e, (str, int, float, bool)):
+                return
+            for attr in ("left", "right", "expr", "col", "arg",
+                         "lo", "hi", "args", "items"):
+                sub = getattr(e, attr, None)
+                if isinstance(sub, (list, tuple)):
+                    for s in sub:
+                        walk(s)
+                elif sub is not None:
+                    walk(sub)
+        walk(stmt.where)
         filt = eng.wherec.compile_where(idx, stmt.where)
         eng.executor._execute_call(
             idx, Call("Delete", children=[filt]), None)

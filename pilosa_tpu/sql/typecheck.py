@@ -111,6 +111,10 @@ class TypeChecker:
                 self._check_setcontains(e, arg_ts)
             elif e.name == "CAST":
                 self._check_cast(e, arg_ts)
+            elif e.name == "BITNOT":
+                # unary ! takes integers (defs_unops: "operator '!'
+                # incompatible with type 'decimal(2)'" etc.)
+                self._require("!", arg_ts, ("int", "id"))
             udf = self.eng._udf_types().get(e.name) \
                 if self.eng is not None else None
             if udf is not None:

@@ -317,8 +317,13 @@ class WhereCompiler:
         if op == "like":
             if f.row_translator is None:
                 raise SQLError("LIKE requires a string column")
+            # _like_sql: SQL WHERE uses the sql3 scalar regex
+            # semantics (case-insensitive, '_' = one or more chars),
+            # not the PQL key matcher — the reference never pushes
+            # LIKE into PQL (no LIKE in sql3/planner/expressionpql.go)
             return Call("UnionRows", children=[
-                Call("Rows", args={"_field": name, "like": val})])
+                Call("Rows", args={"_field": name, "like": val,
+                                   "_like_sql": True})])
         if t.is_bsi:
             pql_op = {"=": "==", "!=": "!="}.get(op, op)
             return Call("Row", args={name: Condition(pql_op, val)})
@@ -397,6 +402,14 @@ class WhereCompiler:
 
     def is_null(self, idx, e: ast.IsNull) -> Call:
         name = col_name(e.col)
+        if name == "_id":
+            # _id is a real column in NULL predicates and is never
+            # null (reference: sql3/planner handles _id directly;
+            # defs_null.go nullFilterTests expects no rows / all rows)
+            if e.negated:
+                return Call("All")
+            return Call("Difference", children=[Call("All"),
+                                                Call("All")])
         f = self.eng._field(idx, name)
         if f.options.type.is_bsi:
             return Call("Row", args={name: Condition(

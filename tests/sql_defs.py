@@ -355,10 +355,10 @@ CASES = [
      "BULK INSERT INTO orders (_id, tags) "
      "FROM '24,a;c' WITH FORMAT 'CSV' INPUT 'STREAM'; "
      "SELECT _id FROM orders WHERE tags = 'c'", [(3,), (4,), (6,), (24,)]),
-    ("bulk_insert_reports_count",
+    ("bulk_insert_returns_no_rows",
      "BULK INSERT INTO orders (_id, qty) "
      "FROM '30,1\n31,2\n32,3' WITH FORMAT 'CSV' INPUT 'STREAM'",
-     [(3,)]),
+     []),
     ("bulk_insert_arity_errors",
      "BULK INSERT INTO orders (_id, region, qty) "
      "FROM '25,x' WITH FORMAT 'CSV' INPUT 'STREAM'", ("error", "fields")),

@@ -419,3 +419,44 @@ def test_const_select_udf_schema_type(eng):
     res = eng.query_one("SELECT dbl(3)")
     assert res.schema == [("dbl", "int")]
     assert res.rows == [(6,)]
+
+
+def test_hyphenated_identifiers_go_faithful(eng):
+    """The reference scanner consumes '-' inside unquoted identifiers
+    (sql3/parser/scanner.go isUnquotedIdent) — so `un-keyed` is a
+    table name and UNSPACED subtraction like `qty-1` is a single
+    (unknown) identifier there too.  Pin both behaviors."""
+    eng.query("CREATE TABLE un-keyed (_id id, an_int int min 0 max 100)")
+    eng.query("INSERT INTO un-keyed (_id, an_int) VALUES (1, 7)")
+    assert rows(eng.query_one("SELECT an_int FROM un-keyed")) == [(7,)]
+    # spaced subtraction is arithmetic...
+    assert rows(eng.query_one(
+        "SELECT an_int - 1 FROM un-keyed")) == [(6,)]
+    # ...unspaced is one identifier, exactly like the reference
+    with pytest.raises(SQLError, match="an_int-1"):
+        eng.query("SELECT an_int-1 FROM un-keyed")
+
+
+def test_delete_alias_and_qualifier_validation(eng):
+    """DELETE FROM t alias parses; a WHERE qualifier naming an
+    unknown table errors instead of silently resolving."""
+    eng.query("CREATE TABLE deltest (_id id, qty int min 0 max 100)")
+    eng.query("INSERT INTO deltest (_id, qty) VALUES (1, 1), (2, 9)")
+    with pytest.raises(SQLError, match="unknown table"):
+        eng.query("DELETE FROM deltest a1 WHERE bogus.qty = 9")
+    eng.query("DELETE FROM deltest a1 WHERE a1.qty = 9")
+    assert rows(eng.query_one("SELECT _id FROM deltest")) == [(1,)]
+
+
+def test_where_like_uses_sql_scalar_semantics(eng):
+    """WHERE LIKE follows the sql3 scalar regex (case-insensitive,
+    '_' one-or-more; sql3/planner/expression.go:2991), matching the
+    projection operator — the reference never pushes LIKE into PQL."""
+    eng.query("CREATE TABLE liketest (_id id, s string)")
+    eng.query("INSERT INTO liketest (_id, s) VALUES (1, 'foo'), (2, 'f')")
+    assert rows(eng.query_one(
+        "SELECT _id FROM liketest WHERE s LIKE '%f_'")) == [(1,)]
+    assert rows(eng.query_one(
+        "SELECT _id FROM liketest WHERE s LIKE 'FOO'")) == [(1,)]
+    assert rows(eng.query_one(
+        "SELECT s LIKE '%f_' FROM liketest WHERE _id = 1")) == [(True,)]
