@@ -8,4 +8,6 @@ FLAGS="-O2 -DNDEBUG"
 [ "$1" = debug ] && FLAGS="-O0 -g -fsanitize=address,undefined"
 g++ -std=c++17 -shared -fPIC $FLAGS -Wall -Wextra \
     -o build/librbf_tpu.so rbf/rbf.cc
-echo "built build/librbf_tpu.so"
+g++ -std=c++17 -shared -fPIC $FLAGS -Wall -Wextra \
+    -o build/libingest_tpu.so ingest/scatter.cc
+echo "built build/librbf_tpu.so build/libingest_tpu.so"

@@ -1,0 +1,84 @@
+// Native ingest scatter kernels.
+//
+// The columnar import path (pilosa_tpu/ingest, API.import_columns)
+// is host-bound in numpy on two scatters that vectorize poorly:
+// np.bitwise_or.at (~40ns/bit) and the per-plane BSI column
+// selection.  The reference's equivalent hot loops are Go word
+// writes (fragment.go importValue / roaring container ops); these
+// are the same loops as tight C.  Loaded via ctypes
+// (pilosa_tpu/storage/native_ingest.py); every function has a numpy
+// fallback so the engine still runs without a toolchain.
+
+#include <cstdint>
+
+extern "C" {
+
+// OR a 1-bit at each column id into the packed word array.
+// cols must be < width; words has width/32 entries.
+void pt_or_bits(uint32_t *words, const int64_t *cols, int64_t n) {
+    for (int64_t j = 0; j < n; j++) {
+        int64_t c = cols[j];
+        words[c >> 5] |= (uint32_t)1 << (c & 31);
+    }
+}
+
+// Clear the bit at each column id.
+void pt_clear_bits(uint32_t *words, const int64_t *cols, int64_t n) {
+    for (int64_t j = 0; j < n; j++) {
+        int64_t c = cols[j];
+        words[c >> 5] &= ~((uint32_t)1 << (c & 31));
+    }
+}
+
+// Fused BSI plane fill with built-in last-write-wins: scratch is
+// (2 + depth) zeroed planes of plane_words uint32 each — plane 0 =
+// exists, plane 1 = sign, plane 2+i = magnitude bit i (fragment.go
+// BSI layout: bsiExistsBit, bsiSignBit, bsiOffsetBit).  Values are
+// scanned in REVERSE; a column whose exists bit is already set was
+// written by a later entry and is skipped, so callers need no
+// sort-based dedup.  One pass replaces depth+2 numpy select+scatter
+// passes plus an np.unique.
+void pt_bsi_fill(uint32_t *scratch, int64_t plane_words, int depth,
+                 const int64_t *cols, const int64_t *vals,
+                 int64_t n) {
+    uint32_t *exists = scratch;
+    uint32_t *sign = scratch + plane_words;
+    uint32_t *planes = scratch + 2 * plane_words;
+    for (int64_t j = n - 1; j >= 0; j--) {
+        int64_t c = cols[j];
+        int64_t w = c >> 5;
+        uint32_t bit = (uint32_t)1 << (c & 31);
+        if (exists[w] & bit) continue;  // a later write won
+        int64_t v = vals[j];
+        uint64_t mag = v < 0 ? (uint64_t)(-v) : (uint64_t)v;
+        exists[w] |= bit;
+        if (v < 0) sign[w] |= bit;
+        while (mag) {
+            int i = __builtin_ctzll(mag);
+            planes[(int64_t)i * plane_words + w] |= bit;
+            mag &= mag - 1;
+        }
+    }
+    (void)depth;
+}
+
+// Mutex/bool fill with built-in last-write-wins: rowidx[j] is the
+// dense index (0..n_rows-1) of entry j's row id; scratch is
+// (n_rows x plane_words) zeroed planes and written is one zeroed
+// plane that ends up holding every touched column (the
+// clear-then-set mask).  Reverse scan + skip gives last-write-wins
+// without the np.unique sort.
+void pt_mutex_fill(uint32_t *written, uint32_t *scratch,
+                   int64_t plane_words, const int64_t *rowidx,
+                   const int64_t *cols, int64_t n) {
+    for (int64_t j = n - 1; j >= 0; j--) {
+        int64_t c = cols[j];
+        int64_t w = c >> 5;
+        uint32_t bit = (uint32_t)1 << (c & 31);
+        if (written[w] & bit) continue;  // a later write won
+        written[w] |= bit;
+        scratch[rowidx[j] * plane_words + w] |= bit;
+    }
+}
+
+}  // extern "C"

@@ -33,7 +33,7 @@ def _place(table: str, shard: int, addrs: list[str]) -> str:
 
 
 class Controller:
-    def __init__(self, poll_interval: float = 1.0):
+    def __init__(self, poll_interval: float = 1.0, schemar=None):
         self.workers: dict[str, str] = {}       # address -> uri
         self.schema: dict = {}
         # table -> sorted shard ids registered for it
@@ -49,24 +49,50 @@ class Controller:
         self._poll_stop = threading.Event()
         self._poll_thread: threading.Thread | None = None
         self._client = InternalClient(timeout=5.0)
+        # durable state (dax/controller/schemar + Transactor): every
+        # registry mutation write-throughs; a restarted controller
+        # reloads the world and its next rebalance is a DELTA (the
+        # reloaded fingerprints skip workers whose jobs are unchanged)
+        self._schemar = schemar
+        if schemar is not None:
+            st = schemar.load()
+            self.workers = st["workers"]
+            self.schema = st["schema"]
+            self.tables = st["tables"]
+            self._versions = st["versions"]
+            self._pushed = st["pushed"]
+            for ix in self.schema.get("indexes", []):
+                self.tables.setdefault(ix["name"], set())
 
     # -- registry ------------------------------------------------------
 
     def register_worker(self, address: str, uri: str):
         with self._lock:
             self.workers[address] = uri
+            if self._schemar is not None:
+                self._schemar.save_worker(address, uri)
             # a worker re-registering at the same address is FRESH
             # (restart): drop the fingerprint so the delta-push does
-            # not skip its directive (review r04)
+            # not skip its directive (review r04) — in the schemar
+            # too, or a controller restart would reload the stale
+            # fingerprint and skip the fresh worker forever
             self._pushed.pop(address, None)
+            if self._schemar is not None:
+                self._schemar.save_worker_state(
+                    address, self._versions.get(address, 0), None)
             self._rebalance_locked()
 
     def deregister_worker(self, address: str):
         with self._lock:
-            self.workers.pop(address, None)
-            self._versions.pop(address, None)
-            self._pushed.pop(address, None)
+            self._drop_worker_locked(address)
             self._rebalance_locked()
+
+    def _drop_worker_locked(self, address: str):
+        self.workers.pop(address, None)
+        self._versions.pop(address, None)
+        self._pushed.pop(address, None)
+        if self._schemar is not None:
+            self._schemar.delete_worker(address)
 
     # -- schema (dax/controller schemar) -------------------------------
 
@@ -75,6 +101,8 @@ class Controller:
             self.schema = schema
             for ix in schema.get("indexes", []):
                 self.tables.setdefault(ix["name"], set())
+            if self._schemar is not None:
+                self._schemar.save_schema(schema)
             self._push_directives_locked()
 
     def drop_table(self, table: str):
@@ -87,6 +115,9 @@ class Controller:
                     "indexes": [ix for ix in
                                 self.schema.get("indexes", [])
                                 if ix.get("name") != table]}
+            if self._schemar is not None:
+                self._schemar.drop_table(table)
+                self._schemar.save_schema(self.schema)
             self._push_directives_locked()
 
     def add_shards(self, table: str, shards):
@@ -97,6 +128,8 @@ class Controller:
             if not new:
                 return
             have |= new
+            if self._schemar is not None:
+                self._schemar.add_shards(table, new)
             self._push_directives_locked()
 
     # -- balance (balancer/balancer.go) --------------------------------
@@ -167,14 +200,16 @@ class Controller:
                 # unlocked POST window — do not resurrect its entry
                 if addr in self.workers:
                     self._pushed[addr] = content
+                    if self._schemar is not None:
+                        self._schemar.save_worker_state(
+                            addr, self._versions.get(addr, 0),
+                            content)
             if not dead:
                 return
             for addr in dead:
                 # a worker that can't take its directive is gone;
                 # removing it reassigns its jobs to the survivors
-                self.workers.pop(addr, None)
-                self._versions.pop(addr, None)
-                self._pushed.pop(addr, None)
+                self._drop_worker_locked(addr)
             if not self.workers:
                 return
 
@@ -189,7 +224,10 @@ class Controller:
     def stop_poller(self):
         self._poll_stop.set()
         if self._poll_thread:
-            self._poll_thread.join(timeout=2)
+            # outlast the health-check HTTP timeout (5s): a caller
+            # about to close the schemar DB must not race a poll
+            # cycle still blocked on a dead worker
+            self._poll_thread.join(timeout=7)
 
     def _poll_loop(self):
         while not self._poll_stop.wait(self._poll_interval):
@@ -208,8 +246,6 @@ class Controller:
         if dead:
             with self._lock:
                 for addr in dead:
-                    self.workers.pop(addr, None)
-                    self._versions.pop(addr, None)
-                    self._pushed.pop(addr, None)
+                    self._drop_worker_locked(addr)
                 self._rebalance_locked()
         return dead

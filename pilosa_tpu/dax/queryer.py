@@ -56,8 +56,6 @@ class _RemoteExecutor(Executor):
     engine's planning (WHERE compilation, schema checks, key handling)
     works unchanged while the data plane stays remote."""
 
-    supports_local_cells = False  # fragments live on the workers
-
     def __init__(self, holder, queryer: "Queryer"):
         super().__init__(holder)
         self.queryer = queryer
@@ -162,9 +160,6 @@ class Queryer:
         stmts = parse_sql(statement)
         out = None
         for stmt in stmts:
-            if isinstance(stmt, sqlast.Select) and stmt.joins:
-                raise SQLError(
-                    "JOIN is not supported on the DAX queryer yet")
             eng = self._sql_engine()
             if isinstance(stmt, sqlast.CreateTable):
                 eng._execute(stmt)  # schema-only holder
@@ -188,6 +183,10 @@ class Queryer:
                 if idx is None:
                     raise SQLError(f"table not found: {stmt.table}")
                 fields, _ = eng._bulk_fields(idx, stmt.columns)
+                # same MAP/TRANSFORM analysis as the local engine —
+                # count mismatches and type incompatibilities must
+                # not silently insert partial records
+                eng._bulk_typecheck(stmt, idx, fields)
                 rows = list(eng._iter_bulk_rows(stmt, idx, fields))
                 out = self._sql_insert(sqlast.Insert(
                     stmt.table, stmt.columns, rows))

@@ -13,6 +13,7 @@ import os
 from pilosa_tpu.dax.computer import ComputeNode
 from pilosa_tpu.dax.controller import Controller
 from pilosa_tpu.dax.queryer import Queryer
+from pilosa_tpu.dax.schemar import Schemar
 from pilosa_tpu.dax.snapshotter import Snapshotter
 from pilosa_tpu.dax.writelogger import WriteLogger
 
@@ -22,13 +23,32 @@ class DAXService:
 
     def __init__(self, storage_dir: str, n_workers: int = 2,
                  poll_interval: float = 0.5):
+        self._storage_dir = storage_dir
+        self._poll_interval = poll_interval
         self.wl = WriteLogger(os.path.join(storage_dir, "writelog"))
         self.snaps = Snapshotter(os.path.join(storage_dir, "snapshots"))
-        self.controller = Controller(poll_interval=poll_interval)
+        self.controller = Controller(
+            poll_interval=poll_interval,
+            schemar=Schemar(os.path.join(storage_dir,
+                                         "controller.db")))
         self.queryer = Queryer(self.controller)
         self.workers: list[ComputeNode] = []
         for i in range(n_workers):
             self.add_worker(f"worker{i}")
+
+    def restart_controller(self):
+        """Kill the controller process-state and boot a fresh one from
+        the schemar DB (the reference's controller restart: schema +
+        job registry + directive versions survive in the SQL store).
+        Workers keep serving throughout."""
+        self.controller.stop_poller()
+        self.controller._schemar.close()
+        self.controller = Controller(
+            poll_interval=self._poll_interval,
+            schemar=Schemar(os.path.join(self._storage_dir,
+                                         "controller.db")))
+        self.queryer.controller = self.controller
+        return self.controller
 
     def add_worker(self, address: str) -> ComputeNode:
         w = ComputeNode(address, self.wl, self.snaps).open()

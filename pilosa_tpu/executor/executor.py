@@ -67,12 +67,6 @@ from pilosa_tpu.executor.stacked import StackedEngine, Unstackable
 
 
 class Executor(AdvancedOps):
-    # False on remote-shipping executors (DAX _RemoteExecutor) whose
-    # holder is schema-only: SQL paths that read cell values straight
-    # from local fragments must refuse rather than return silently
-    # empty data
-    supports_local_cells = True
-
     def __init__(self, holder: Holder):
         self.holder = holder
         # the mesh-integrated stacked engine (executor/stacked.py):

@@ -90,3 +90,29 @@ class HTTPImporter(Importer):
 
     def apply_schema(self, schema):
         self.client._request(self.host, "POST", "/schema", schema)
+
+    def import_columns(self, index, cols, bits=None, values=None):
+        """Columnar binary import over HTTP (POST
+        /index/{i}/import-columns, .npz payload) — the bulk path an
+        out-of-process ingester clone uses (idk/ingest.go:319's
+        per-clone shard imports)."""
+        import io
+        import urllib.request
+
+        import numpy as np
+        buf = io.BytesIO()
+        arrays = {"cols": np.asarray(cols, dtype=np.int64)}
+        for f, rows in (bits or {}).items():
+            arrays[f"bits/{f}"] = np.asarray(rows, dtype=np.int64)
+        for f, vals in (values or {}).items():
+            arrays[f"values/{f}"] = np.asarray(vals, dtype=np.int64)
+        np.savez(buf, **arrays)
+        base = self.host if "://" in self.host \
+            else f"http://{self.host}"
+        req = urllib.request.Request(
+            base.rstrip("/") + f"/index/{index}/import-columns",
+            data=buf.getvalue(), method="POST",
+            headers={"Content-Type": "application/octet-stream"})
+        import json as _json
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return _json.loads(r.read())["imported"]

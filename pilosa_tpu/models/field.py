@@ -138,7 +138,14 @@ class Field:
             return round(scaled)
         if t == FieldType.TIMESTAMP:
             if isinstance(value, str):
-                value = timeq.parse_time(value)
+                value = timeq.parse_time_ns(value)
+            elif isinstance(value, (int, float)) and \
+                    not isinstance(value, bool):
+                # integer literals are epoch SECONDS regardless of the
+                # column's timeunit (sql3 coerceValue; defs_inserts
+                # insertTimestampTest: 1672531200 into a 'ms' column
+                # reads back as 2023-01-01T00:00:00Z)
+                value = timeq.parse_time(int(value))
             if isinstance(value, dt.datetime):
                 return self.options.timestamp_to_int(value)
             return int(value)
@@ -229,9 +236,26 @@ class Field:
             self._max_seen, int(ivs.max()))
         view = self.view(self.bsi_view, create=True)
         shards = cols // self.width
-        order = np.argsort(shards, kind="stable")
-        cols_s, ivs_s, sh_s = cols[order], ivs[order], shards[order]
-        uniq, starts = np.unique(sh_s, return_index=True)
+        # pre-sorted batches (sequential-ids ingest) skip the sort;
+        # otherwise radix-sort a narrow key (int32 shard ids: 4 radix
+        # passes instead of 8 on int64)
+        if shards.size < 2 or bool((np.diff(shards) >= 0).all()):
+            cols_s, ivs_s, sh_s = cols, ivs, shards
+        else:
+            # numpy's stable sort is radix only for <=16-bit ints
+            # (int16 measured 4x int32); shard ids fit until 32Gi
+            # columns
+            key = shards.astype(np.int16) \
+                if int(shards.max()) < 32767 else shards
+            order = np.argsort(key, kind="stable")
+            cols_s, ivs_s, sh_s = (cols[order], ivs[order],
+                                   shards[order])
+        # group boundaries on sorted data via diff (np.unique
+        # re-sorts)
+        starts = np.flatnonzero(
+            np.r_[True, sh_s[1:] != sh_s[:-1]]) if sh_s.size else \
+            np.array([], dtype=np.int64)
+        uniq = sh_s[starts]
         bounds = np.append(starts[1:], sh_s.size)
         for shard, lo, hi in zip(uniq.tolist(), starts.tolist(),
                                  bounds.tolist()):
@@ -249,8 +273,14 @@ class Field:
         # ascending-ids ingest; a lexsort with rows as secondary key
         # measured SLOWER — it defeats the sortedness of cols, r04),
         # then contiguous slices per shard
-        order = np.argsort(shards, kind="stable")
-        rows_s, cols_s, sh_s = rows[order], cols[order], shards[order]
+        if shards.size < 2 or bool((np.diff(shards) >= 0).all()):
+            rows_s, cols_s, sh_s = rows, cols, shards
+        else:
+            key = shards.astype(np.int16) \
+                if int(shards.max()) < 32767 else shards
+            order = np.argsort(key, kind="stable")
+            rows_s, cols_s, sh_s = (rows[order], cols[order],
+                                    shards[order])
         # group boundaries on sorted data via diff (np.unique re-sorts)
         starts = np.flatnonzero(
             np.r_[True, sh_s[1:] != sh_s[:-1]]) if sh_s.size else \
@@ -262,20 +292,14 @@ class Field:
             frag = self.view(VIEW_STANDARD, create=True).fragment(
                 int(shard), create=True)
             if is_mutexish:
-                # vectorized clear-then-set: one clear_columns over
-                # the imported columns replaces the per-bit
-                # clear loop that was O(bits x rows) — measured as
-                # the whole ingest bottleneck (r04; batch.go:753's
-                # import path clears mutexes per-container too)
-                sc = cols_s[lo:hi] % self.width
-                sr = rows_s[lo:hi]
-                # last write per column wins within the batch
-                _u, first_rev = np.unique(sc[::-1], return_index=True)
-                keep = sc.size - 1 - first_rev
-                kc, kr = sc[keep], sr[keep]
-                from pilosa_tpu.ops import bitmap as bm
-                frag.clear_columns(bm.from_columns(kc, self.width))
-                frag.import_bits(kr, kc)
+                # clear-then-set with native last-write-wins (one
+                # reverse pass, pt_mutex_fill — replaces the per-bit
+                # clear loop that was O(bits x rows) in r03 and the
+                # np.unique dedup sort that dominated r04;
+                # batch.go:753's import path clears mutexes
+                # per-container too)
+                frag.import_mutex(rows_s[lo:hi],
+                                  cols_s[lo:hi] % self.width)
             else:
                 frag.import_bits(rows_s[lo:hi],
                                  cols_s[lo:hi] % self.width)

@@ -352,37 +352,80 @@ class Fragment:
             changed |= self.clear_bit(r, col)
         return changed
 
+    # mutex scratch planes are dense (128KB per distinct row): the
+    # native last-write-wins path only pays off for categorical
+    # cardinalities; high-cardinality mutexes take the sort path
+    _MUTEX_KERNEL_MAX_ROWS = 256
+
+    def import_mutex(self, rows, cols):
+        """Mutex/bool bulk write: clear-then-set with last-write-wins
+        per column in ONE native reverse pass (pt_mutex_fill) — no
+        np.unique sort (the r04 mutex-import hotspot)."""
+        rows = np.asarray(rows, dtype=np.int64)
+        cols = np.asarray(cols, dtype=np.int64)
+        assert rows.shape == cols.shape
+        if cols.size == 0:
+            return
+        if rows.min() >= 0 and rows.max() < 32767:
+            # O(n) distinct + inverse via bincount — no sort
+            cnt = np.bincount(rows)
+            uniq = np.flatnonzero(cnt)
+            inv_map = np.zeros(cnt.size, dtype=np.int64)
+            inv_map[uniq] = np.arange(uniq.size)
+            rowidx = inv_map[rows]
+        else:
+            uniq, rowidx = np.unique(rows, return_inverse=True)
+        if uniq.size > self._MUTEX_KERNEL_MAX_ROWS:
+            from pilosa_tpu.ops import bitmap as bm_
+            if cols.size > 1 and not bool((np.diff(cols) > 0).all()):
+                _u, first_rev = np.unique(cols[::-1],
+                                          return_index=True)
+                keep = cols.size - 1 - first_rev
+                cols, rows = cols[keep], rows[keep]
+            self.clear_columns(bm_.from_columns(cols, self.width))
+            self.import_bits(rows, cols)
+            return
+        from pilosa_tpu.storage import native_ingest as ni
+        written = bm.empty(self.width)
+        scratch = np.zeros((uniq.size, self.width // 32), np.uint32)
+        ni.mutex_fill(written, scratch, rowidx.astype(np.int64),
+                      cols)
+        self.clear_columns(written)
+        for k, r in enumerate(np.asarray(uniq,
+                                         dtype=np.int64).tolist()):
+            self._row_mut(int(r))[:] |= scratch[k]
+            self.touch(int(r))
+
     def import_values(self, cols, values, depth: int, clear: bool = False):
         """Bulk BSI write (fragment.importValue semantics): last-write-
-        wins per column, vectorized per plane."""
+        wins per column, filled by the fused native scatter kernel
+        (native/ingest/scatter.cc pt_bsi_fill) — one pass over the
+        values instead of depth+2 numpy select+scatter passes."""
         cols = np.asarray(cols, dtype=np.int64)
         vals = np.asarray(values, dtype=np.int64).reshape(-1)
         assert cols.shape == vals.shape
         if cols.size == 0:
             return
-        # last-write-wins dedup
-        _, rev_first = np.unique(cols[::-1], return_index=True)
-        keep = cols.size - 1 - rev_first
-        cols, vals = cols[keep], vals[keep]
-        touched = bm.from_columns(cols, self.width)
         if clear:
+            touched = bm.from_columns(cols, self.width)
             for r in range(2 + depth):
                 self._row_mut(r)[:] &= ~touched
                 self.touch(r)
             return
-        neg = vals < 0
-        mags = np.where(neg, np.negative(vals), vals).view(np.uint64)
-        assert int(mags.max()).bit_length() <= depth, \
+        assert int(np.abs(vals).max()).bit_length() <= depth, \
             "value magnitude exceeds bit depth"
+        from pilosa_tpu.storage import native_ingest as ni
+        scratch = np.zeros((2 + depth, self.width // 32), np.uint32)
+        ni.bsi_fill(scratch, cols, vals, depth)
+        touched = scratch[0]  # the exists plane IS the touched mask
         self._row_mut(0)[:] |= touched
         sign_words = self._row_mut(BSI_SIGN_BIT)
         sign_words &= ~touched
-        sign_words |= bm.from_columns(cols[neg], self.width)
+        sign_words |= scratch[1]
         for i in range(depth):
             plane = self._row_mut(BSI_OFFSET_BIT + i)
             plane &= ~touched
-            plane |= bm.from_columns(
-                cols[(mags >> np.uint64(i)) & np.uint64(1) == 1], self.width)
+            plane |= scratch[2 + i]
         for r in range(2 + depth):
             self.touch(r)
 

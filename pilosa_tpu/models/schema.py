@@ -96,16 +96,32 @@ class FieldOptions:
             raise ValueError("bool fields cannot have keys")
 
     def timestamp_to_int(self, ts: _dt.datetime) -> int:
+        from pilosa_tpu.models.timeq import ns_of
+        # sub-microsecond remainder (NsDatetime inputs carry 7-9
+        # fractional digits; plain datetimes contribute 0)
+        sub_us = ns_of(ts) - ts.microsecond * 1000
         if ts.tzinfo is None:
             ts = ts.replace(tzinfo=_dt.timezone.utc)
         delta = ts - self.epoch
         # integer math only: float total_seconds() corrupts ns units
         whole = delta.days * 86400 + delta.seconds
         unit = _TIME_UNITS[self.time_unit]
-        return whole * unit + delta.microseconds * unit // 10**6
+        frac_ns = delta.microseconds * 1000 + sub_us
+        return whole * unit + frac_ns * unit // 10**9
 
     def int_to_timestamp(self, v: int) -> _dt.datetime:
-        return self.epoch + _dt.timedelta(seconds=v / _TIME_UNITS[self.time_unit])
+        # integer math only — float seconds corrupt ns-unit values
+        from pilosa_tpu.models.timeq import NsDatetime
+        unit = _TIME_UNITS[self.time_unit]
+        whole, rem = divmod(int(v), unit)
+        ns = rem * (10**9 // unit)
+        # naive-UTC like the rest of the engine (parse_time
+        # normalizes offsets away; comparisons must stay homogeneous)
+        d = (self.epoch + _dt.timedelta(seconds=whole)).astimezone(
+            _dt.timezone.utc).replace(tzinfo=None)
+        if ns % 1000:
+            return NsDatetime.wrap(d, ns)
+        return d.replace(microsecond=ns // 1000)
 
     def to_dict(self) -> dict:
         d = {"type": self.type.value}

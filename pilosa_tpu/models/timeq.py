@@ -115,6 +115,92 @@ def views_by_time_range(name: str, start: dt.datetime, end: dt.datetime,
     return results
 
 
+class NsDatetime(dt.datetime):
+    """datetime subclass carrying the full nanosecond fraction in
+    ``nsec`` (0..999_999_999).  ``microsecond`` holds the truncated
+    value so datetime behavior is unchanged; the extra precision
+    exists for timeunit-'ns' columns (the reference stores epoch
+    nanoseconds; Go time.Time is ns-precise throughout).
+
+    Comparisons are ns-exact when an NsDatetime is on the LEFT (or
+    both sides); a plain datetime on the left compares at its own
+    microsecond precision — Python only consults the right operand
+    when the left returns NotImplemented."""
+
+    nsec = 0
+
+    @classmethod
+    def wrap(cls, d: dt.datetime, nsec: int) -> "NsDatetime":
+        nd = cls(d.year, d.month, d.day, d.hour, d.minute, d.second,
+                 nsec // 1000, tzinfo=d.tzinfo)
+        nd.nsec = nsec
+        return nd
+
+    @staticmethod
+    def _key(d: dt.datetime):
+        # a PLAIN datetime base — replace() would keep the subclass
+        # and recurse through these very comparison methods
+        base = dt.datetime(d.year, d.month, d.day, d.hour, d.minute,
+                           d.second, 0, tzinfo=d.tzinfo)
+        return (base, ns_of(d))
+
+    def __eq__(self, other):
+        if not isinstance(other, dt.datetime):
+            return NotImplemented
+        return self._key(self) == self._key(other)
+
+    def __ne__(self, other):
+        eq = self.__eq__(other)
+        return NotImplemented if eq is NotImplemented else not eq
+
+    def __lt__(self, other):
+        if not isinstance(other, dt.datetime):
+            return NotImplemented
+        return self._key(self) < self._key(other)
+
+    def __le__(self, other):
+        if not isinstance(other, dt.datetime):
+            return NotImplemented
+        return self._key(self) <= self._key(other)
+
+    def __gt__(self, other):
+        if not isinstance(other, dt.datetime):
+            return NotImplemented
+        return self._key(self) > self._key(other)
+
+    def __ge__(self, other):
+        if not isinstance(other, dt.datetime):
+            return NotImplemented
+        return self._key(self) >= self._key(other)
+
+    # µs-level hash so an NsDatetime with a whole-µs fraction hashes
+    # like the plain datetime it equals
+    __hash__ = dt.datetime.__hash__
+
+
+def ns_of(d: dt.datetime) -> int:
+    """Full fractional nanoseconds of a datetime (exact for
+    NsDatetime, microsecond-derived otherwise — including NsDatetime
+    copies from .replace()/arithmetic, which drop the instance
+    attribute back to the class default of 0)."""
+    ns = getattr(d, "nsec", 0)
+    return ns if ns else d.microsecond * 1000
+
+
+def parse_time_ns(v) -> dt.datetime:
+    """parse_time plus full fractional precision: 7-9 fractional
+    digits survive into an NsDatetime (fromisoformat truncates them
+    to microseconds)."""
+    import re as _re
+    d = parse_time(v)
+    if isinstance(v, str):
+        m = _re.search(r"\.(\d{7,9})(?=Z|[+-]\d\d:?\d\d|$)", v)
+        if m:
+            frac = (m.group(1) + "000000000")[:9]
+            return NsDatetime.wrap(d, int(frac))
+    return d
+
+
 def parse_time(v) -> dt.datetime:
     """Parse a PQL time literal (time.go parseTime/parsePartialTime).
 

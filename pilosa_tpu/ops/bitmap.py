@@ -47,9 +47,10 @@ def from_columns(cols, width: int = SHARD_WIDTH) -> np.ndarray:
     cols = np.asarray(cols, dtype=np.int64)
     if cols.size:
         assert cols.min() >= 0 and cols.max() < width, "column id out of range"
-        np.bitwise_or.at(
-            words, cols >> 5, _NP_WORD_DTYPE(1) << (cols & 31).astype(_NP_WORD_DTYPE)
-        )
+        # native or-scatter (~20x numpy's bitwise_or.at; falls back
+        # to it without a toolchain)
+        from pilosa_tpu.storage import native_ingest as ni
+        ni.or_bits(words, cols)
     return words
 
 

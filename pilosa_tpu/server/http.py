@@ -147,6 +147,8 @@ class Server:
                 self._delete_field))
         r(Route("POST", "/index/{index}/field/{field}/import",
                 self._post_import))
+        r(Route("POST", "/index/{index}/import-columns",
+                self._post_import_columns))
         r(Route("POST", "/internal/translate/{index}/keys/find",
                 self._post_translate_find))
         r(Route("POST", "/internal/translate/{index}/keys/create",
@@ -373,6 +375,32 @@ class Server:
         except PermissionError as e:
             raise ApiError(str(e), 403)
 
+    def _post_import_columns(self, req):
+        """Binary columnar import — the wire form of
+        API.import_columns for out-of-process ingesters (the
+        reference's IDK clones POST binary shard payloads the same
+        way, idk/ingest.go:319 -> ImportRoaringShard).  Body: an
+        .npz with 'cols' plus 'bits/<field>' row-id and
+        'values/<field>' value arrays."""
+        import io
+
+        import numpy as np
+        try:
+            z = np.load(io.BytesIO(req.raw()))
+        except Exception as e:
+            raise ApiError(f"malformed npz payload: {e}", 400)
+        with z:
+            if "cols" not in z.files:
+                raise ApiError("payload missing 'cols'", 400)
+            cols = z["cols"]
+            bits = {k.split("/", 1)[1]: z[k] for k in z.files
+                    if k.startswith("bits/")}
+            values = {k.split("/", 1)[1]: z[k] for k in z.files
+                      if k.startswith("values/")}
+        n = self.api.import_columns(req.vars["index"], cols,
+                                    bits=bits, values=values)
+        return {"imported": n}
+
     def _post_import_roaring(self, req):
         """Roaring import (route shape of /import-roaring in
         http_handler.go): {"rows": {rowID: base64-roaring}, "clear"}."""
@@ -564,6 +592,9 @@ def _make_handler(server: Server):
 
         def text(self) -> str:
             return (self._raw or b"").decode("utf-8", "replace")
+
+        def raw(self) -> bytes:
+            return self._raw or b""
 
         # dispatch --------------------------------------------------------
         def _handle(self, method: str):

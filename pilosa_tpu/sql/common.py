@@ -124,12 +124,39 @@ def order_rows(stmt, schema, rows, srcmap=None):
     if not stmt.order_by:
         return rows
     names = [s[0] for s in schema]
+    types = [s[1] for s in schema]
+
+    def keyfn(i):
+        # timestamp columns may already be RENDERED as RFC3339-Z
+        # strings whose lexicographic order diverges from the
+        # chronological one once fractions appear ('...41.5Z' sorts
+        # before '...41Z'); sort them by instant, not by string
+        if types[i] == "timestamp":
+            def k(j):
+                v = rows[j][i]
+                if isinstance(v, str):
+                    from pilosa_tpu.models.timeq import (
+                        ns_of,
+                        parse_time_ns,
+                    )
+                    try:
+                        d = parse_time_ns(v)
+                    except ValueError:
+                        return v
+                    return (d.replace(microsecond=0), ns_of(d))
+                if isinstance(v, dt.datetime):
+                    from pilosa_tpu.models.timeq import ns_of
+                    return (v.replace(microsecond=0), ns_of(v))
+                return v
+            return k
+        return lambda j: rows[j][i]
+
     rows = list(rows)
     for ob in reversed(stmt.order_by):
         if is_ordinal(ob.expr):
             i = ordinal_index(ob.expr.value, len(names))
             order = sorted_nulls_last(
-                range(len(rows)), lambda j: rows[j][i], ob.desc)
+                range(len(rows)), keyfn(i), ob.desc)
             rows = [rows[j] for j in order]
             continue
         if isinstance(ob.expr, ast.Col) and ob.expr.table:
@@ -153,7 +180,7 @@ def order_rows(stmt, schema, rows, srcmap=None):
                 f"ORDER BY column {name!r} is ambiguous")
         i = matches[0]
         order = sorted_nulls_last(
-            range(len(rows)), lambda j: rows[j][i], ob.desc)
+            range(len(rows)), keyfn(i), ob.desc)
         rows = [rows[j] for j in order]
     return rows
 
@@ -167,11 +194,17 @@ def limit_rows(stmt, rows):
 
 def rfc3339(d: dt.datetime) -> str:
     """RFC3339 with a Z suffix — the reference's timestamp rendering
-    (naive datetimes are UTC throughout the engine)."""
+    (naive datetimes are UTC throughout the engine; Go RFC3339Nano
+    trims trailing fraction zeros, so sub-microsecond values render
+    their full 9-digit fraction trimmed)."""
+    from pilosa_tpu.models.timeq import ns_of
+    ns = ns_of(d)
     if d.tzinfo is not None:
         d = d.astimezone(dt.timezone.utc).replace(tzinfo=None)
-    s = d.isoformat()
-    return s + "Z"
+    if ns % 1000:
+        base = d.replace(microsecond=0).isoformat()
+        return base + (".%09d" % ns).rstrip("0") + "Z"
+    return d.isoformat() + "Z"
 
 
 def to_sql_value(v):

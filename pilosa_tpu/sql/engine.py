@@ -300,5 +300,8 @@ class SQLEngine:
     def _bulk_fields(self, idx, columns):
         return self.stmts.bulk_fields(idx, columns)
 
+    def _bulk_typecheck(self, stmt, idx, fields):
+        return self.stmts.bulk_typecheck(stmt, idx, fields)
+
     def _iter_bulk_rows(self, stmt, idx, fields):
         return self.stmts.iter_bulk_rows(stmt, idx, fields)

@@ -48,10 +48,26 @@ def _ts(v, fn) -> dt.datetime:
         return dt.datetime(1970, 1, 1) + dt.timedelta(seconds=v)
     if isinstance(v, str):
         try:
-            return dt.datetime.fromisoformat(v.replace("Z", "+00:00"))
+            from pilosa_tpu.models.timeq import parse_time_ns
+            return parse_time_ns(v)
         except ValueError:
             pass
     raise SQLError(f"{fn} expects a timestamp, got {v!r}")
+
+
+def _ns_of(d: dt.datetime) -> int:
+    from pilosa_tpu.models.timeq import ns_of
+    return ns_of(d)
+
+
+def _with_frac(base: dt.datetime, ns: int) -> dt.datetime:
+    """Rebuild a timestamp from a seconds-level base + fractional
+    ns."""
+    from pilosa_tpu.models.timeq import NsDatetime
+    base = base.replace(microsecond=0)
+    if ns % 1000:
+        return NsDatetime.wrap(base, ns)
+    return base.replace(microsecond=ns // 1000)
 
 
 def _weekday(d: dt.datetime) -> int:
@@ -80,11 +96,11 @@ def _part(interval: str, d: dt.datetime):
     if iv == _IV_SEC:
         return d.second
     if iv == _IV_MS:
-        return d.microsecond // 1000
+        return _ns_of(d) // 10**6
     if iv == _IV_US:
-        return d.microsecond
+        return _ns_of(d) // 1000
     if iv == _IV_NS:
-        return d.microsecond * 1000
+        return _ns_of(d)
     raise SQLError(f"invalid interval {interval!r}")
 
 
@@ -104,8 +120,10 @@ def _trunc(interval: str, d: dt.datetime) -> dt.datetime:
     if iv == _IV_SEC:
         return d.replace(microsecond=0)
     if iv == _IV_MS:
-        return d.replace(microsecond=d.microsecond // 1000 * 1000)
-    if iv in (_IV_US, _IV_NS):
+        return _with_frac(d, _ns_of(d) // 10**6 * 10**6)
+    if iv == _IV_US:
+        return _with_frac(d, _ns_of(d) // 1000 * 1000)
+    if iv == _IV_NS:
         return d
     raise SQLError(f"invalid interval {interval!r} for DATE_TRUNC")
 
@@ -130,21 +148,27 @@ def _go_adddate(d: dt.datetime, years: int, months: int) -> dt.datetime:
 
 def _add(interval: str, n: int, d: dt.datetime) -> dt.datetime:
     iv = interval.upper()
+    frac = _ns_of(d)
     if iv == _IV_YEAR:
-        return _go_adddate(d, n, 0)
+        return _with_frac(_go_adddate(d, n, 0), frac)
     if iv == _IV_MONTH:
-        return _go_adddate(d, 0, n)
-    delta = {_IV_DAY: dt.timedelta(days=n),
-             _IV_WEEK: dt.timedelta(weeks=n),
-             _IV_HOUR: dt.timedelta(hours=n),
-             _IV_MIN: dt.timedelta(minutes=n),
-             _IV_SEC: dt.timedelta(seconds=n),
-             _IV_MS: dt.timedelta(milliseconds=n),
-             _IV_US: dt.timedelta(microseconds=n),
-             _IV_NS: dt.timedelta(microseconds=n // 1000)}.get(iv)
-    if delta is None:
+        return _with_frac(_go_adddate(d, 0, n), frac)
+    unit_ns = {_IV_DAY: 86_400 * 10**9,
+               _IV_WEEK: 7 * 86_400 * 10**9,
+               _IV_HOUR: 3_600 * 10**9,
+               _IV_MIN: 60 * 10**9,
+               _IV_SEC: 10**9,
+               _IV_MS: 10**6,
+               _IV_US: 10**3,
+               _IV_NS: 1}.get(iv)
+    if unit_ns is None:
         raise SQLError(f"invalid interval {interval!r} for DATETIMEADD")
-    return d + delta
+    # integer ns arithmetic so sub-microsecond precision survives
+    # (Go time.Time is ns-precise; defs_date_functions datetimeadd
+    # NS cases)
+    carry, frac = divmod(frac + n * unit_ns, 10**9)
+    return _with_frac(d.replace(microsecond=0)
+                      + dt.timedelta(seconds=carry), frac)
 
 
 def _diff(interval: str, a: dt.datetime, b: dt.datetime) -> int:
@@ -161,7 +185,9 @@ def _diff(interval: str, a: dt.datetime, b: dt.datetime) -> int:
            _IV_SEC: 1_000_000, _IV_MS: 1_000, _IV_US: 1}.get(iv)
     if div is None:
         if iv == _IV_NS:
-            return us * 1000
+            # exact: include each side's sub-microsecond remainder
+            return (us * 1000 + (_ns_of(b) - b.microsecond * 1000)
+                    - (_ns_of(a) - a.microsecond * 1000))
         raise SQLError(f"invalid interval {interval!r} for DATETIMEDIFF")
     return int(us // div)
 
@@ -312,10 +338,13 @@ def call_builtin(name: str, args: list):
         if a and a[0] is None:
             return None
     elif name in ("FORMAT", "STR"):
-        # a NULL argument to FORMAT/STR is an ERROR, not NULL
-        # (defs_string_functions FormatNullArgument / StrNullArg)
-        if any(x is None for x in a):
-            raise SQLError(f"{name}: NULL argument")
+        # FORMAT/STR: a NULL FIRST argument yields NULL, a NULL in
+        # any later argument is an error (defs_string_functions
+        # FormatNullString/StrNull vs FormatNullArgument/StrNullArg)
+        if a and a[0] is None:
+            return None
+        if any(x is None for x in a[1:]):
+            raise SQLError("null literal not allowed")
     elif any(x is None for x in a):
         return None
 
@@ -452,7 +481,7 @@ def _dispatch(name: str, a: list):
                 f"{d.microsecond:06d}"
         if iv == _IV_NS:
             return d.strftime("%Y-%m-%dT%H:%M:%S.") + \
-                f"{d.microsecond * 1000:09d}"
+                f"{_ns_of(d):09d}"
         raise SQLError(f"invalid interval {a[0]!r} for DATE_TRUNC")
     if name == "DATETIMEADD":
         return _add(_s(a[0], name), _i(a[1], name), _ts(a[2], name))
@@ -466,10 +495,14 @@ def _dispatch(name: str, a: list):
             raise SQLError(f"DATETIMEFROMPARTS: {exc}")
     if name == "TOTIMESTAMP":
         unit = _s(a[1], name) if len(a) > 1 else "s"
+        unit = {"µs": "us"}.get(unit, unit)  # Go's Microsecond alias
         if unit not in _TIME_UNITS:
             raise SQLError(f"invalid time unit {unit!r}")
-        return dt.datetime(1970, 1, 1) + dt.timedelta(
-            seconds=_i(a[0], name) / _TIME_UNITS[unit])
+        # integer math so ns-unit epochs stay exact
+        whole, rem = divmod(_i(a[0], name), _TIME_UNITS[unit])
+        ns = rem * (10**9 // _TIME_UNITS[unit])
+        return _with_frac(dt.datetime(1970, 1, 1)
+                          + dt.timedelta(seconds=whole), ns)
 
     if name == "BITNOT":
         return ~_i(a[0], "!")

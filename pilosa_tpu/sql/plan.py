@@ -105,10 +105,16 @@ class NestedLoopJoinOp(PlanOp):
     def lines(self):
         out = []
         for j in self.stmt.joins:
+            src = j.table if j.subquery is None else "(subquery)"
+            if j.left is None:  # comma join: condition lives in WHERE
+                out.append(
+                    f"comma join {self.stmt.table} x {src} "
+                    "(WHERE-equality hashed, else cross product)")
+                continue
             kind = "left outer" if j.outer else "inner"
             out.append(
                 f"nested-loop {kind} join {self.stmt.table} x "
-                f"{j.table} on {j.left.name} = {j.right.name} "
+                f"{src} on {j.left.name} = {j.right.name} "
                 "(hashed right side)")
         return out
 

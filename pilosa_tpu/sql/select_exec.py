@@ -347,26 +347,34 @@ class SelectExec:
                     raise SQLError(
                         f"{a.func} requires a numeric column")
                 cols[i].append(float(v))
+        def dec6(v: float) -> Decimal:
+            # pql.FromFloat64WithScale: int64(v * 10^6) TRUNCATES
+            # toward zero (var(id1) -> 2.916666, not .916667)
+            return Decimal(int(v * 10**6)).scaleb(-6)
+
         xs = cols[0]
         n = len(xs)
         if n == 0:
             return None
         if a.func == "var":
+            # same accumulation order as aggregateVar.Eval
             mean = sum(xs) / n
-            var = sum((v - mean) ** 2 for v in xs) / n
-            return Decimal(f"{var:.6f}")
+            var = 0.0
+            for v in xs:
+                var += (v - mean) * (v - mean)
+            return dec6(var / n)
         ys = cols[1]
         sx, sy = sum(xs), sum(ys)
         sxy = sum(x * y for x, y in zip(xs, ys))
         sxx, syy = sum(x * x for x in xs), sum(y * y for y in ys)
-        # float rounding can push a variance term slightly negative
-        # for near-constant data; clamp so the sqrt stays real
-        vx = max(n * sxx - sx * sx, 0.0)
-        vy = max(n * syy - sy * sy, 0.0)
-        denom = (vx * vy) ** 0.5
+        # aggregateCorr.Eval's exact expression shape: one sqrt over
+        # the product; clamp slightly-negative variance terms so the
+        # sqrt stays real (float noise on near-constant data)
+        prod = max((n * sxx - sx * sx) * (n * syy - sy * sy), 0.0)
+        denom = prod ** 0.5
         if denom == 0:
             return None
-        return Decimal(f"{(n * sxy - sx * sy) / denom:.6f}")
+        return dec6((n * sxy - sx * sy) / denom)
 
     # -- GROUP BY -------------------------------------------------------
 
@@ -465,10 +473,11 @@ class SelectExec:
         PlanOpGroupBy instead of the PQL GroupBy pushdown)."""
         eng = self.eng
         group_cols = stmt.group_by
-        if not eng.executor.supports_local_cells:
-            raise SQLError(
-                "GROUP BY on int/decimal/timestamp columns is not "
-                "supported on the DAX queryer yet")
+        # bulk column maps through the executor, bounded by the WHERE
+        # filter: one Extract per referenced column, so the path also
+        # serves the DAX queryer (schema-only holder, cells on the
+        # compute workers)
+        cells = self.cell_reader(idx, filt)
         schema, getters = [], []
         agg_specs = []  # (func, col or None)
         for it in items:
@@ -502,7 +511,8 @@ class SelectExec:
 
         groups: dict[tuple, list] = {}
         for rid in self.table_ids(idx, filt):
-            key = tuple(self.group_key(idx, g, rid) for g in group_cols)
+            key = tuple(self.group_key(idx, g, rid, cells=cells)
+                        for g in group_cols)
             if any(k is None for k in key):
                 # records NULL in a group column form no group
                 # (defs_sql1 grouper: the NULL-color row is absent
@@ -518,7 +528,7 @@ class SelectExec:
                 if func == "count*":
                     agg_vals.append(len(rids))
                     continue
-                vals = [self.cell_value(idx, col, r) for r in rids]
+                vals = [cells.get(col, r) for r in rids]
                 vals = [v for v in vals if v is not None]
                 agg_vals.append(self._agg_reduce(
                     ast.Agg(func, ast.Col(col), distinct=distinct),
@@ -527,7 +537,7 @@ class SelectExec:
                 cache = {spec: agg_vals[i]
                          for i, spec in enumerate(agg_specs)}
                 if not self.generic_having_ok(idx, stmt.having, rids,
-                                              cache):
+                                              cache, cells=cells):
                     continue
             if agg_specs and all(
                     func in ("sum", "avg")
@@ -554,8 +564,9 @@ class SelectExec:
         rows = limit_rows(stmt, rows)
         return SQLResult(schema=schema, rows=rows)
 
-    def group_key(self, idx, col: str, rid: int):
-        v = self.cell_value(idx, col, rid)
+    def group_key(self, idx, col: str, rid: int, cells=None):
+        v = cells.get(col, rid) if cells is not None \
+            else self.cell_value(idx, col, rid)
         if isinstance(v, list):
             return tuple(sorted(v))
         if v is not None and col != "_id":
@@ -568,7 +579,8 @@ class SelectExec:
                 return (v,)
         return v
 
-    def _group_agg_value(self, idx, a: ast.Agg, rids, cache=None):
+    def _group_agg_value(self, idx, a: ast.Agg, rids, cache=None,
+                         cells=None):
         """One aggregate over a group's record ids (HAVING — the
         aggregate need not appear in the projection, defs_having);
         projected aggregates come from the caller's cache instead of
@@ -582,12 +594,16 @@ class SelectExec:
             key = (a.func, a.arg.name, a.distinct)
             if key in cache:
                 return cache[key]
-        vals = [self.cell_value(idx, a.arg.name, r) for r in rids]
+        if cells is not None:
+            vals = [cells.get(a.arg.name, r) for r in rids]
+        else:
+            vals = [self.cell_value(idx, a.arg.name, r)
+                    for r in rids]
         vals = [v for v in vals if v is not None]
         return self._agg_reduce(a, vals)
 
     def generic_having_ok(self, idx, having, rids,
-                          cache=None) -> bool:
+                          cache=None, cells=None) -> bool:
         """Evaluate a HAVING expression for one group: aggregates
         compute over the group (projected or not), with comparisons,
         BETWEEN, and AND/OR/NOT (defs_having, defs_sql1
@@ -599,7 +615,8 @@ class SelectExec:
 
         def ev(e):
             if isinstance(e, ast.Agg):
-                return self._group_agg_value(idx, e, rids, cache)
+                return self._group_agg_value(idx, e, rids, cache,
+                                             cells=cells)
             if isinstance(e, ast.Lit):
                 return e.value
             if isinstance(e, ast.Not):
@@ -657,6 +674,10 @@ class SelectExec:
             values = res.columns().tolist()
             if f.options.keys:
                 values = f.row_translator.translate_ids(values)
+            elif f.options.type == FieldType.BOOL:
+                # bool rows are row-ids 0/1; project as real bools
+                # (defs_distinct distinctTests_2)
+                values = [bool(v) for v in values]
         if name in stmt.flatten and f.options.type in (
                 FieldType.SET, FieldType.TIME):
             # flattened distinct members stay single-member SETS
@@ -1053,6 +1074,61 @@ class SelectExec:
 
     # -- cell materialization (joins, generic GROUP BY) -----------------
 
+    def column_map(self, idx, name: str, filt: Call | None = None) \
+            -> dict:
+        """rid -> value for a column via one Extract through the
+        executor — the bulk, remote-capable form of cell_value (the
+        reference's DAX orchestrator likewise iterates Extract scans
+        over the compute nodes rather than reading cells,
+        dax/queryer/orchestrator.go:83,109).  `filt` bounds the scan
+        to the matching records (a selective WHERE must not decode
+        the whole column).  Values match cell_value: BSI
+        typed-or-None, bool True/False/None, single-member sets
+        collapse to scalars, keyed sets sort."""
+        eng = self.eng
+        filt = filt if filt is not None else Call("All")
+        if name == "_id":
+            c = Call("Extract", children=[filt])
+            table = eng.executor._execute_call(idx, c, None)
+            return {int(e["column"]): e.get("column_key",
+                                            e["column"])
+                    for e in table.columns}
+        f = eng._field(idx, name)
+        c = Call("Extract", children=[
+            filt, Call("Rows", args={"_field": name})])
+        table = eng.executor._execute_call(idx, c, None)
+        setlike = f.options.type in (FieldType.SET, FieldType.TIME,
+                                     FieldType.MUTEX)
+        out = {}
+        for e in table.columns:
+            v = e["rows"][0]
+            if setlike and isinstance(v, list):
+                if not v:
+                    v = None
+                elif len(v) == 1:
+                    v = v[0]
+                elif f.options.keys:
+                    v = sorted(v)
+            out[int(e["column"])] = v
+        return out
+
+    class _CellReader:
+        """Per-statement cache of column maps for one table."""
+
+        def __init__(self, ops, idx, filt=None):
+            self.ops, self.idx, self.filt = ops, idx, filt
+            self.maps: dict = {}
+
+        def get(self, name: str, rid):
+            m = self.maps.get(name)
+            if m is None:
+                m = self.ops.column_map(self.idx, name, self.filt)
+                self.maps[name] = m
+            return m.get(rid)
+
+    def cell_reader(self, idx, filt=None) -> "_CellReader":
+        return self._CellReader(self, idx, filt)
+
     def cell_value(self, idx, name: str, col_id: int):
         """One column's value for one record id (join
         materialization).  BSI fields -> typed value or None;
@@ -1110,9 +1186,6 @@ class SelectExec:
         when unambiguous — by real table name; unqualified columns
         default to the left table (the first FROM entry)."""
         eng = self.eng
-        if not eng.executor.supports_local_cells:
-            raise SQLError(
-                "JOIN is not supported on the DAX queryer yet")
         if stmt.having is not None and not stmt.group_by:
             raise SQLError("HAVING requires GROUP BY")
 
@@ -1185,23 +1258,24 @@ class SelectExec:
                 raise SQLError(f"column not found: {name}")
             return field_tinfo(f)
 
-        # memoized cell decode per (side, col, record)
-        cell_cache: dict = {}
+        # per-side bulk column maps (one Extract per referenced
+        # column through the executor, so joins also serve the DAX
+        # queryer — the orchestrator shape, not per-cell reads)
+        readers: dict[int, object] = {}
 
         def cell(si: int, col: str, rid):
             if rid is None:  # unmatched LEFT JOIN side
                 return None
-            key = (si, col, rid)
-            if key not in cell_cache:
-                _k, _t, idx, derived = sides[si]
-                if derived is not None:
-                    rows, names, _types = derived
-                    if col not in names:
-                        raise SQLError(f"column not found: {col}")
-                    cell_cache[key] = rows[rid][names.index(col)]
-                else:
-                    cell_cache[key] = self.cell_value(idx, col, rid)
-            return cell_cache[key]
+            _k, _t, idx, derived = sides[si]
+            if derived is not None:
+                rows, names, _types = derived
+                if col not in names:
+                    raise SQLError(f"column not found: {col}")
+                return rows[rid][names.index(col)]
+            rd = readers.get(si)
+            if rd is None:
+                rd = readers[si] = self.cell_reader(idx)
+            return rd.get(col, rid)
 
         all_call = Call("All")
 

@@ -282,20 +282,36 @@ class StatementExec:
                         f"inserting value into column '{f.name}', "
                         f"row {row_no}, value '{shown}' out of range")
         col = eng._col_id(idx, row[id_pos])
-        if replace:
-            # full-record replace: drop existing values first
+
+        def clear_field(f):
+            """Drop every stored value a field holds for this
+            record."""
             from pilosa_tpu.ops import bitmap as bm
             shard, sc = divmod(col, idx.width)
             mask = bm.from_columns([sc], idx.width)
+            for v in f.views.values():
+                frag = v.fragment(shard)
+                if frag is not None:
+                    frag.clear_columns(mask)
+
+        if replace:
+            # full-record replace: drop existing values first
             for f in idx.fields.values():
-                for v in f.views.values():
-                    frag = v.fragment(shard)
-                    if frag is not None:
-                        frag.clear_columns(mask)
+                clear_field(f)
         for f, v in zip(fields, row):
-            if f is None or v is None:
+            if f is None:
                 continue
             t = f.options.type
+            if v is None:
+                # an EXPLICIT null in the tuple clears bool/mutex
+                # state for the record (the reference's INSERT goes
+                # through the batcher's clear-then-set mutex path;
+                # defs_bool select-all2: re-inserting (2, null) over
+                # (2, true) reads back NULL)
+                if not replace and t in (FieldType.BOOL,
+                                         FieldType.MUTEX):
+                    clear_field(f)
+                continue
             if t.is_bsi:
                 f.set_value(col, v)
             elif t == FieldType.BOOL:
@@ -470,7 +486,12 @@ class StatementExec:
                 return int(text)
             if kind == "decimal":
                 from decimal import Decimal
-                return Decimal(text)
+                d = Decimal(text)
+                if scale is not None:
+                    # DECIMAL(n) MAP type: quantize to the declared
+                    # scale (half-even, like the storage layer)
+                    d = d.quantize(Decimal(1).scaleb(-scale))
+                return d
             if kind == "bool":
                 return text.strip().lower() in ("1", "true", "t",
                                                 "yes")

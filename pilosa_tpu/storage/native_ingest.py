@@ -1,0 +1,119 @@
+"""ctypes bindings for the native ingest scatter kernels
+(native/ingest/scatter.cc) with numpy fallbacks.
+
+The columnar import hot loops — bit scatter (np.bitwise_or.at) and
+the per-plane BSI fill — are word-at-a-time scatters that numpy
+cannot fuse; the C versions run ~10-20x faster.  Build is on demand
+like the RBF library (same build.sh, cached by mtime).
+"""
+
+from __future__ import annotations
+
+import ctypes as ct
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE = os.path.join(_ROOT, "native")
+_SO = os.path.join(_NATIVE, "build", "libingest_tpu.so")
+_SRC = os.path.join(_NATIVE, "ingest", "scatter.cc")
+
+_build_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+_I64 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_U32 = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+
+
+def _load():
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _build_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if not os.path.exists(_SO) or \
+                    os.path.getmtime(_SRC) > os.path.getmtime(_SO):
+                subprocess.run(
+                    ["sh", os.path.join(_NATIVE, "build.sh")],
+                    check=True, capture_output=True)
+            lib = ct.CDLL(_SO)
+            lib.pt_or_bits.argtypes = [_U32, _I64, ct.c_int64]
+            lib.pt_clear_bits.argtypes = [_U32, _I64, ct.c_int64]
+            lib.pt_bsi_fill.argtypes = [_U32, ct.c_int64, ct.c_int,
+                                        _I64, _I64, ct.c_int64]
+            lib.pt_mutex_fill.argtypes = [_U32, _U32, ct.c_int64,
+                                          _I64, _I64, ct.c_int64]
+            _lib = lib
+        except Exception:
+            _lib_failed = True  # no toolchain: numpy fallbacks
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def or_bits(words: np.ndarray, cols: np.ndarray) -> None:
+    """words[c>>5] |= 1 << (c&31) for every c (bitwise_or.at)."""
+    lib = _load()
+    cols = np.ascontiguousarray(cols, dtype=np.int64)
+    if lib is not None:
+        lib.pt_or_bits(words, cols, cols.size)
+        return
+    np.bitwise_or.at(words, cols >> 5,
+                     np.uint32(1) << (cols & 31).astype(np.uint32))
+
+
+def bsi_fill(scratch: np.ndarray, cols: np.ndarray,
+             vals: np.ndarray, depth: int) -> None:
+    """Fill a zeroed (2+depth, plane_words) scratch: plane 0 exists,
+    1 sign, 2+i magnitude bit i — one reverse pass over the values
+    with built-in last-write-wins per column."""
+    lib = _load()
+    cols = np.ascontiguousarray(cols, dtype=np.int64)
+    vals = np.ascontiguousarray(vals, dtype=np.int64)
+    if lib is not None:
+        lib.pt_bsi_fill(scratch.reshape(-1), scratch.shape[1], depth,
+                        cols, vals, cols.size)
+        return
+    # numpy fallback dedups explicitly (the kernel's reverse scan)
+    if cols.size > 1:
+        _, rev_first = np.unique(cols[::-1], return_index=True)
+        keep = cols.size - 1 - rev_first
+        cols, vals = cols[keep], vals[keep]
+    neg = vals < 0
+    mags = np.where(neg, -vals, vals).view(np.uint64)
+    or_bits(scratch[0], cols)
+    or_bits(scratch[1], cols[neg])
+    for i in range(depth):
+        sel = (mags >> np.uint64(i)) & np.uint64(1) == 1
+        or_bits(scratch[2 + i], cols[sel])
+
+
+def mutex_fill(written: np.ndarray, scratch: np.ndarray,
+               rowidx: np.ndarray, cols: np.ndarray) -> None:
+    """Fill a zeroed (n_rows, plane_words) scratch with one bit per
+    (dense row index, column), last write per column winning;
+    `written` collects every touched column (the clear-then-set
+    mask)."""
+    lib = _load()
+    rowidx = np.ascontiguousarray(rowidx, dtype=np.int64)
+    cols = np.ascontiguousarray(cols, dtype=np.int64)
+    if lib is not None:
+        lib.pt_mutex_fill(written, scratch.reshape(-1),
+                          scratch.shape[1], rowidx, cols, cols.size)
+        return
+    if cols.size > 1:
+        _, rev_first = np.unique(cols[::-1], return_index=True)
+        keep = cols.size - 1 - rev_first
+        cols, rowidx = cols[keep], rowidx[keep]
+    or_bits(written, cols)
+    for r in np.unique(rowidx):
+        or_bits(scratch[int(r)], cols[rowidx == r])

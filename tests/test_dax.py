@@ -204,11 +204,12 @@ def test_dax_sql_groupby_agg_and_replace(dax):
           "(3, 2, 5)")
     r = q.sql("SELECT r, sum(v) FROM g GROUP BY r")
     assert sorted(r["data"]) == [[1, 30], [2, 5]]
-    # clean error (not silent wrong data) for BSI group-by over DAX
-    import pytest as _pytest
+    import pytest as _pytest  # noqa: F401
     from pilosa_tpu.sql import SQLError
-    with _pytest.raises(SQLError):
-        q.sql("SELECT v, count(*) FROM g GROUP BY v")
+    # BSI group-by takes the generic hashed path, served over the
+    # fleet via bulk Extract column maps (r05; orchestrator.go shape)
+    r = q.sql("SELECT v, count(*) FROM g GROUP BY v")
+    assert sorted(r["data"]) == [[5, 1], [10, 1], [20, 1]]
     # REPLACE clears the old record
     q.sql("REPLACE INTO g (_id, r) VALUES (1, 2)")
     r = q.sql("SELECT count(*) FROM g WHERE r = 1")
@@ -314,10 +315,12 @@ def test_rebalance_under_load_no_data_loss(dax):
 
 def test_dax_sql_shape_support_matrix(dax):
     """Which SQL shapes the DAX front end serves vs refuses (VERDICT
-    r03 item 8: enumerate them).  Served: filters, PQL aggregates,
-    single-value GROUP BY, DISTINCT, ORDER BY...LIMIT.  Refused
-    (schema-only holder, no local cells): JOIN, the generic hashed
-    GROUP BY over BSI columns, and keyed-row INSERT."""
+    r03 item 8, r04 matrix, r05 flips).  Served: filters, PQL
+    aggregates, GROUP BY (including the generic hashed path over BSI
+    columns), JOIN, DISTINCT, ORDER BY...LIMIT — the local-cell
+    paths ride bulk Extract column maps over the compute fleet
+    (dax/queryer/orchestrator.go:83,109 shape).  Still refused:
+    keyed-row INSERT (routes via the cluster path)."""
     from pilosa_tpu.sql import SQLError
 
     dax.queryer.apply_schema({"indexes": [
@@ -344,11 +347,21 @@ def test_dax_sql_shape_support_matrix(dax):
         got = dax.queryer.sql(q)["data"]
         assert sorted(map(repr, got)) == sorted(map(repr, want)), \
             (q, got)
+    # r05: JOIN and the generic hashed GROUP BY are now SERVED via
+    # bulk Extract column maps (the orchestrator's full-scan shape,
+    # dax/queryer/orchestrator.go:83,109) — the r04 refusal rows flip
+    dax.queryer.sql("INSERT INTO s2 (_id, m) VALUES (1, 5), (2, 9)")
+    served2 = [
+        ("SELECT s._id FROM s JOIN s2 ON s.n = s2.m",
+         [[1], [3]]),
+        ("SELECT n, count(*) FROM s GROUP BY n",
+         [[5, 1], [7, 1], [9, 1]]),
+    ]
+    for q, want in served2:
+        got = dax.queryer.sql(q)["data"]
+        assert sorted(map(repr, got)) == sorted(map(repr, want)), \
+            (q, got)
     refused = [
-        # nested-loop JOIN needs local cell decode
-        "SELECT s._id FROM s JOIN s2 ON s.n = s2.m",
-        # BSI group column takes the generic hashed path (local cells)
-        "SELECT n, count(*) FROM s GROUP BY n",
         # keyed-row INSERT routes via the cluster path, not DAX
         "CREATE TABLE sk (_id id, k string); "
         "INSERT INTO sk (_id, k) VALUES (1, 'x')",
@@ -356,3 +369,80 @@ def test_dax_sql_shape_support_matrix(dax):
     for q in refused:
         with pytest.raises(SQLError):
             dax.queryer.sql(q)
+
+
+def test_controller_restart_loses_nothing(dax):
+    """Durable controller (dax/controller/schemar + Transactor
+    analog): kill the controller mid-workload — workers keep serving,
+    a fresh controller reloads schema/workers/jobs/versions from the
+    schemar DB, and its next rebalance is a DELTA (no re-push to
+    unchanged workers)."""
+    cols = _seed(dax)
+    before = dax.queryer.query("t", "Row(f=1)")
+    assert set(before["results"][0]["columns"]) == set(cols)
+
+    old = dax.controller
+    versions_before = {w.address: w.directive_version
+                      for w in dax.workers}
+
+    fresh = dax.restart_controller()
+    assert fresh is not old
+    # state reloaded: workers, schema tables, shard jobs
+    assert sorted(fresh.workers) == sorted(old.workers)
+    assert fresh.tables["t"] == set(range(6))
+    assert [ix["name"] for ix in fresh.schema["indexes"]] == ["t"]
+
+    # a no-op rebalance after restart is a delta: the reloaded
+    # fingerprints skip every unchanged worker (no directive push, so
+    # worker versions do not move)
+    fresh.poll_once()
+    assert {w.address: w.directive_version
+            for w in dax.workers} == versions_before
+
+    # the world still works end-to-end: reads, new shards, rebalance
+    after = dax.queryer.query("t", "Row(f=1)")
+    assert set(after["results"][0]["columns"]) == set(cols)
+    new_col = 7 * SHARD + 3
+    dax.queryer.import_bits("t", "f", [1], [new_col])
+    got = dax.queryer.query("t", "Row(f=1)")
+    assert new_col in set(got["results"][0]["columns"])
+    # the new shard's owner took a new directive; the others did not
+    moved = [w.address for w in dax.workers
+             if w.directive_version != versions_before[w.address]]
+    assert len(moved) == 1
+
+
+def test_controller_restart_after_worker_death(dax):
+    """Restart the controller, THEN kill a worker: the reloaded
+    registry still drives failover correctly."""
+    cols = _seed(dax)
+    fresh = dax.restart_controller()
+    victim = dax.workers[0]
+    dax.kill_worker(victim.address)
+    dead = fresh.poll_once()
+    assert victim.address in dead
+    r = dax.queryer.query("t", "Row(f=1)")
+    assert set(r["results"][0]["columns"]) == set(cols)
+
+
+def test_dax_bulk_insert_typechecks(dax):
+    """The DAX BULK INSERT route runs the same MAP/TRANSFORM analysis
+    as the local engine — a transform-count mismatch must error, not
+    insert partial records."""
+    from pilosa_tpu.sql import SQLError
+
+    dax.queryer.apply_schema({"indexes": [
+        {"name": "bt", "fields": [
+            {"name": "a", "options": {"type": "int", "min": 0,
+                                      "max": 100}}]}]})
+    with pytest.raises(SQLError, match="mismatch in the count"):
+        dax.queryer.sql(
+            "BULK INSERT INTO bt (_id, a) map (0 ID, 1 INT) "
+            "transform(@0) FROM x'1,5' "
+            "with format 'CSV' input 'STREAM'")
+    dax.queryer.sql(
+        "BULK INSERT INTO bt (_id, a) map (0 ID, 1 INT) "
+        "transform(@0, @1) FROM x'1,5\n2,7' "
+        "with format 'CSV' input 'STREAM'")
+    got = dax.queryer.sql("SELECT _id, a FROM bt")["data"]
+    assert sorted(map(tuple, got)) == [(1, 5), (2, 7)]

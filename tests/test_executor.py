@@ -253,7 +253,8 @@ def test_timestamp_field(holder, ex):
     got = cols(ex.execute("i", "Row(ts >= '2020-01-01T00:00')")[0])
     assert got == {1, 2}
     mn = ex.execute("i", "Min(field=ts)")[0]
-    assert mn.value == dt.datetime(2019, 3, 1, tzinfo=dt.timezone.utc)
+    # naive = UTC throughout the engine (schema.int_to_timestamp)
+    assert mn.value == dt.datetime(2019, 3, 1)
 
 
 def test_time_field_range(holder, ex):

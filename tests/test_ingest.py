@@ -229,3 +229,64 @@ def test_import_columns_api_parallel_and_serial_agree(api):
     for q in ("Count(All())", "Count(Row(a=1))", "Count(Row(b=4))",
               "Sum(field=v)"):
         assert e1.execute("p", q)[0] == e2.execute("p", q)[0], q
+
+
+def _mp_ingest_worker(uri, index, shard_lo, shard_hi, per_shard):
+    """Child-process ingester: disjoint shard range -> one server
+    (the IDK clone shape, idk/ingest.go:302,319)."""
+    import numpy as np
+
+    from pilosa_tpu.ingest.importer import HTTPImporter
+    W = 1 << 20
+    imp = HTTPImporter(uri)
+    total = 0
+    for shard in range(shard_lo, shard_hi):
+        cols = shard * W + np.arange(per_shard, dtype=np.int64)
+        total += imp.import_columns(
+            "mp", cols,
+            bits={"m": (cols % 7)},
+            values={"v": (cols % 1000)})
+    return total
+
+
+def test_multiprocess_sharded_ingest():
+    """N importer PROCESSES over disjoint shard ranges into one
+    server — the reference's IDK clone concurrency
+    (idk/ingest.go:302 m.clone() per ingester).  Validates the
+    deployment shape on this host; the measured single-process rate
+    ladder lives in BENCH_TPU_NOTES.md."""
+    import multiprocessing as mp
+
+    from pilosa_tpu.server import Server
+    srv = Server().start()
+    try:
+        uri = f"127.0.0.1:{srv.port}"
+        from pilosa_tpu.ingest.importer import HTTPImporter
+        HTTPImporter(uri).apply_schema({"indexes": [{
+            "name": "mp", "fields": [
+                {"name": "m", "options": {"type": "mutex"}},
+                {"name": "v", "options": {"type": "int", "min": 0,
+                                          "max": 1000}}]}]})
+        n_workers, shards_per, per_shard = 3, 2, 5000
+        ctx = mp.get_context("spawn")
+        with ctx.Pool(n_workers) as pool:
+            totals = pool.starmap(
+                _mp_ingest_worker,
+                [(uri, "mp", w * shards_per, (w + 1) * shards_per,
+                  per_shard) for w in range(n_workers)])
+        assert sum(totals) == n_workers * shards_per * per_shard * 2
+        # every shard landed, disjointly owned by its importer
+        import http.client
+        import json as _json
+        c = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                       timeout=30)
+        c.request("POST", "/index/mp/query",
+                  body=_json.dumps({"query": "Count(Row(m=0))"}))
+        got = _json.loads(c.getresponse().read())
+        c.close()
+        want = sum(1 for s in range(n_workers * shards_per)
+                   for i in range(per_shard)
+                   if (s * (1 << 20) + i) % 7 == 0)
+        assert got["results"][0] == want
+    finally:
+        srv.close()
