@@ -47,6 +47,28 @@ class InternalClient:
             raise RemoteError(resp.status, msg)
         return data
 
+    def _request_raw(self, uri: str, method: str, path: str,
+                     data: bytes, content_type: str):
+        """Binary-body request with the same auth headers and error
+        handling as _request (columnar import payloads)."""
+        host, _, port = uri.partition(":")
+        conn = http.client.HTTPConnection(host, int(port or 80),
+                                          timeout=self.timeout)
+        try:
+            conn.request(method, path, body=data,
+                         headers={"Content-Type": content_type,
+                                  **self.headers})
+            resp = conn.getresponse()
+            raw = resp.read()
+        finally:
+            conn.close()
+        out = json.loads(raw) if raw else None
+        if resp.status != 200:
+            msg = out.get("error", "") if isinstance(out, dict) \
+                else str(out)
+            raise RemoteError(resp.status, msg)
+        return out
+
     # executor.remoteExec's transport (executor.go:6392)
     def query_node(self, uri: str, index: str, pql: str,
                    shards: list[int] | None) -> dict:

@@ -69,17 +69,15 @@ class Controller:
     def register_worker(self, address: str, uri: str):
         with self._lock:
             self.workers[address] = uri
-            if self._schemar is not None:
-                self._schemar.save_worker(address, uri)
             # a worker re-registering at the same address is FRESH
             # (restart): drop the fingerprint so the delta-push does
-            # not skip its directive (review r04) — in the schemar
-            # too, or a controller restart would reload the stale
-            # fingerprint and skip the fresh worker forever
+            # not skip its directive (review r04) — atomically in the
+            # schemar too, or a controller restart could reload the
+            # stale fingerprint and skip the fresh worker forever
             self._pushed.pop(address, None)
             if self._schemar is not None:
-                self._schemar.save_worker_state(
-                    address, self._versions.get(address, 0), None)
+                self._schemar.register_worker(
+                    address, uri, self._versions.get(address, 0))
             self._rebalance_locked()
 
     def deregister_worker(self, address: str):

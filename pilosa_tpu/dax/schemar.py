@@ -88,6 +88,21 @@ class Schemar:
             "ON CONFLICT(address) DO UPDATE SET uri=excluded.uri",
             (address, uri)))
 
+    def register_worker(self, address: str, uri: str, version: int):
+        """Worker row + fingerprint reset in ONE transaction: a crash
+        between them must not strand a re-registered (fresh) worker
+        behind a stale persisted fingerprint."""
+        def run(db):
+            db.execute(
+                "INSERT INTO workers (address, uri) VALUES (?, ?) "
+                "ON CONFLICT(address) DO UPDATE SET "
+                "uri=excluded.uri", (address, uri))
+            db.execute(
+                "INSERT INTO worker_state (address, version, pushed) "
+                "VALUES (?, ?, NULL) ON CONFLICT(address) DO UPDATE "
+                "SET pushed=NULL", (address, version))
+        self._tx(run)
+
     def delete_worker(self, address: str):
         def run(db):
             db.execute("DELETE FROM workers WHERE address=?",

@@ -97,7 +97,6 @@ class HTTPImporter(Importer):
         out-of-process ingester clone uses (idk/ingest.go:319's
         per-clone shard imports)."""
         import io
-        import urllib.request
 
         import numpy as np
         buf = io.BytesIO()
@@ -107,12 +106,9 @@ class HTTPImporter(Importer):
         for f, vals in (values or {}).items():
             arrays[f"values/{f}"] = np.asarray(vals, dtype=np.int64)
         np.savez(buf, **arrays)
-        base = self.host if "://" in self.host \
-            else f"http://{self.host}"
-        req = urllib.request.Request(
-            base.rstrip("/") + f"/index/{index}/import-columns",
-            data=buf.getvalue(), method="POST",
-            headers={"Content-Type": "application/octet-stream"})
-        import json as _json
-        with urllib.request.urlopen(req, timeout=60) as r:
-            return _json.loads(r.read())["imported"]
+        # ride the shared client so auth headers and RemoteError
+        # handling match every other importer method
+        r = self.client._request_raw(
+            self.host, "POST", f"/index/{index}/import-columns",
+            buf.getvalue(), "application/octet-stream")
+        return r["imported"]

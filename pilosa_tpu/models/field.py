@@ -228,7 +228,9 @@ class Field:
                              dtype=np.int64)
         if cols.size == 0:
             return
-        mags = np.abs(ivs)
+        # uint64 magnitudes: np.abs is the identity on INT64_MIN
+        mags = np.where(ivs < 0, np.negative(ivs),
+                        ivs).view(np.uint64)
         self._grow_depth(int(mags.max()))
         self._min_seen = int(ivs.min()) if self._min_seen is None else min(
             self._min_seen, int(ivs.min()))

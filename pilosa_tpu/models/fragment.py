@@ -399,7 +399,7 @@ class Fragment:
     def import_values(self, cols, values, depth: int, clear: bool = False):
         """Bulk BSI write (fragment.importValue semantics): last-write-
         wins per column, filled by the fused native scatter kernel
-        (native/ingest/scatter.cc pt_bsi_fill) — one pass over the
+        (native/ingest/scatter.cc pt_bsi_fill_t) — one pass over the
         values instead of depth+2 numpy select+scatter passes."""
         cols = np.asarray(cols, dtype=np.int64)
         vals = np.asarray(values, dtype=np.int64).reshape(-1)
@@ -412,7 +412,12 @@ class Fragment:
                 self._row_mut(r)[:] &= ~touched
                 self.touch(r)
             return
-        assert int(np.abs(vals).max()).bit_length() <= depth, \
+        # uint64 view so INT64_MIN's magnitude (2^63) is seen — np.abs
+        # is the identity there and would let an out-of-depth value
+        # reach the native kernel's out-of-bounds plane write
+        mags = np.where(vals < 0, np.negative(vals),
+                        vals).view(np.uint64)
+        assert int(mags.max()).bit_length() <= depth, \
             "value magnitude exceeds bit depth"
         from pilosa_tpu.storage import native_ingest as ni
         scratch = np.zeros((2 + depth, self.width // 32), np.uint32)

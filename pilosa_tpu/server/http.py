@@ -389,6 +389,9 @@ class Server:
             z = np.load(io.BytesIO(req.raw()))
         except Exception as e:
             raise ApiError(f"malformed npz payload: {e}", 400)
+        if not isinstance(z, np.lib.npyio.NpzFile):
+            # a bare .npy body parses as an ndarray — still a 400
+            raise ApiError("payload must be an .npz archive", 400)
         with z:
             if "cols" not in z.files:
                 raise ApiError("payload missing 'cols'", 400)

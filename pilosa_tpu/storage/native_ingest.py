@@ -45,9 +45,8 @@ def _load():
                     check=True, capture_output=True)
             lib = ct.CDLL(_SO)
             lib.pt_or_bits.argtypes = [_U32, _I64, ct.c_int64]
-            lib.pt_clear_bits.argtypes = [_U32, _I64, ct.c_int64]
-            lib.pt_bsi_fill.argtypes = [_U32, ct.c_int64, ct.c_int,
-                                        _I64, _I64, ct.c_int64]
+            lib.pt_bsi_fill_t.argtypes = [_U32, ct.c_int64, _I64,
+                                          _I64, ct.c_int64]
             lib.pt_mutex_fill.argtypes = [_U32, _U32, ct.c_int64,
                                           _I64, _I64, ct.c_int64]
             _lib = lib
@@ -80,8 +79,13 @@ def bsi_fill(scratch: np.ndarray, cols: np.ndarray,
     cols = np.ascontiguousarray(cols, dtype=np.int64)
     vals = np.ascontiguousarray(vals, dtype=np.int64)
     if lib is not None:
-        lib.pt_bsi_fill(scratch.reshape(-1), scratch.shape[1], depth,
-                        cols, vals, cols.size)
+        n_planes, plane_words = scratch.shape
+        # interleaved fill (one cache line per value) + one
+        # vectorized transpose back to plane-major
+        scratch_t = np.zeros((plane_words, n_planes), np.uint32)
+        lib.pt_bsi_fill_t(scratch_t, n_planes, cols, vals,
+                          cols.size)
+        scratch[:] = scratch_t.T
         return
     # numpy fallback dedups explicitly (the kernel's reverse scan)
     if cols.size > 1:
