@@ -29,28 +29,15 @@ class InternalClient:
         self.headers = headers or {}  # e.g. Authorization bearer token
 
     def _request(self, uri: str, method: str, path: str, body=None):
-        host, _, port = uri.partition(":")
-        conn = http.client.HTTPConnection(host, int(port or 80),
-                                          timeout=self.timeout)
-        try:
-            conn.request(method, path,
-                         body=None if body is None else json.dumps(body),
-                         headers={"Content-Type": "application/json",
-                                  **self.headers})
-            resp = conn.getresponse()
-            raw = resp.read()
-        finally:
-            conn.close()
-        data = json.loads(raw) if raw else None
-        if resp.status != 200:
-            msg = data.get("error", "") if isinstance(data, dict) else str(data)
-            raise RemoteError(resp.status, msg)
-        return data
+        return self._request_raw(
+            uri, method, path,
+            None if body is None else json.dumps(body).encode(),
+            "application/json")
 
     def _request_raw(self, uri: str, method: str, path: str,
-                     data: bytes, content_type: str):
-        """Binary-body request with the same auth headers and error
-        handling as _request (columnar import payloads)."""
+                     data: bytes | None, content_type: str):
+        """One request (JSON or binary body) with auth headers and
+        RemoteError mapping."""
         host, _, port = uri.partition(":")
         conn = http.client.HTTPConnection(host, int(port or 80),
                                           timeout=self.timeout)

@@ -151,6 +151,8 @@ class Executor(AdvancedOps):
         if name in ("MinRow", "MaxRow"):
             return self._execute_minmax_row(idx, call, shards,
                                             name == "MinRow", pre)
+        if name == "FieldValue":
+            return self._execute_field_value(idx, call)
         if name == "Distinct":
             return self._execute_distinct(idx, call, shards, pre)
         if name == "Rows":
@@ -192,7 +194,11 @@ class Executor(AdvancedOps):
         Distinct row-id bitmaps can land outside the data shards)."""
         out = set(self._shard_list(idx, shards))
         if shards is None:
-            for res in pre.values():
+            for key, res in pre.items():
+                if isinstance(key, tuple):
+                    if key[0] == "constrow":  # translated column ids
+                        out.update(c // idx.width for c in res)
+                    continue
                 out.update(res.segments)
         return sorted(out)
 
@@ -222,13 +228,49 @@ class Executor(AdvancedOps):
                     continue
                 walk(v, False)
             if not is_root and c.name == "Distinct":
-                res = self._execute_distinct(idx, c, shards, pre, raw=True)
+                # index= redirects the Distinct to ANOTHER index — the
+                # cross-index Distinct join (executor.go:1820;
+                # defs_join.go distinctjoin PQL:
+                # Intersect(Distinct(Row(price > 10), index=orders,
+                # field=userid)))
+                didx, dshards, dpre = idx, shards, pre
+                iname = c.arg("index")
+                if iname and iname != idx.name:
+                    didx = self.holder.index(iname)
+                    if didx is None:
+                        raise ExecError(f"index not found: {iname}")
+                    # the foreign field's values become COLUMN ids
+                    # here, so only an unkeyed int field is coherent
+                    # — anything else would silently join garbage
+                    # (decimals dropped, keyed row ids mistaken for
+                    # columns)
+                    df = didx.field(c.arg("_field") or "")
+                    if df is None or \
+                            df.options.type != FieldType.INT or \
+                            df.options.keys:
+                        raise ExecError(
+                            "cross-index Distinct requires an "
+                            "unkeyed int field")
+                    dshards, dpre = None, {}
+                res = self._execute_distinct(didx, c, dshards, dpre,
+                                             raw=True)
                 if isinstance(res, DistinctValues):
-                    raise ExecError(
-                        "BSI Distinct cannot be nested as a bitmap call")
+                    if didx is idx:
+                        raise ExecError("BSI Distinct cannot be "
+                                        "nested as a bitmap call")
+                    # foreign int values are COLUMN ids here
+                    res = RowResult.from_columns(
+                        [v for v in res.values
+                         if isinstance(v, int) and v >= 0],
+                        idx.width)
                 pre[id(c)] = res
             elif not is_root and c.name == "UnionRows":
                 pre[id(c)] = self._execute_union_rows(idx, c, shards)
+            elif c.name == "ConstRow":
+                # translate string keys ONCE per query, not once per
+                # shard in the tree walk (preTranslate analog)
+                pre[("constrow", id(c))] = \
+                    self._constrow_cols(idx, c)
 
         walk(call, True)
         return pre
@@ -292,7 +334,10 @@ class Executor(AdvancedOps):
             return bm.shift(
                 self._bitmap_call_shard(idx, child, shard, pre), n)
         if name == "ConstRow":
-            cols = call.arg("columns", []) or []
+            cols = pre.get(("constrow", id(call))) \
+                if pre is not None else None
+            if cols is None:
+                cols = self._constrow_cols(idx, call)
             in_shard = [c % idx.width for c in cols
                         if c // idx.width == shard]
             return jnp.asarray(bm.from_columns(in_shard, idx.width))
@@ -598,42 +643,42 @@ class Executor(AdvancedOps):
         if f is None:
             raise ExecError(f"{call.name} requires a field")
         filter_call = call.children[0] if call.children else None
-        if self.use_stacked:
-            # one batched (R, S, W) scan for all candidate rows
-            # (fragment.minRow/maxRow were the last per-row dispatch)
-            try:
-                row_ids = self._all_row_ids(idx, f, shards)
-                if not row_ids:
-                    return Pair(id=0, count=0)
-                pairs = self._topnk_stacked(
-                    idx, f, row_ids, [VIEW_STANDARD], filter_call,
-                    shards, pre, ids=None)
-                if not pairs:
-                    return Pair(id=0, count=0)
-                best = (min if is_min else max)(pairs, key=lambda p: p.id)
-                return Pair(id=best.id, count=best.count)
-            except Unstackable:
-                pass
-        candidates: dict[int, int] = {}
+        # per-shard candidate, reduced by row-id preference — counts
+        # are NEVER summed across shards (reference reduceFn keeps
+        # ONE shard's pair, executor.go:1620), and an UNFILTERED call
+        # reports count=1 (a has-value flag, fragment.go:858 minRow:
+        # "if filter is nil, it returns minRowID, 1"; defs_keyed.go
+        # minrow expects (11, 1) though row 11 spans 3 records).  No
+        # stacked fast path: the cross-shard TopN sum would produce
+        # the aggregated count the reference never reports.
+        best: Pair | None = None
         for shard in self._shard_list(idx, shards):
             v = f.views.get(VIEW_STANDARD)
             frag = v.fragment(shard) if v else None
             if frag is None:
                 continue
-            filt = (self._bitmap_call_shard(idx, filter_call, shard, pre)
-                    if filter_call else None)
-            for row_id in frag.row_ids:
-                words = frag.device_row(row_id)
-                if filt is not None:
-                    c = int(bm.intersection_count(words, filt))
-                else:
-                    c = frag.row_count(row_id)
-                if c > 0:
-                    candidates[row_id] = candidates.get(row_id, 0) + c
-        if not candidates:
-            return Pair(id=0, count=0)
-        row = min(candidates) if is_min else max(candidates)
-        return Pair(id=row, count=candidates[row])
+            rows = sorted(frag.row_ids)
+            if not rows:
+                continue
+            if filter_call is None:
+                cand = Pair(id=rows[0] if is_min else rows[-1],
+                            count=1)
+            else:
+                filt = self._bitmap_call_shard(idx, filter_call,
+                                               shard, pre)
+                cand = None
+                for row_id in (rows if is_min else reversed(rows)):
+                    c = int(bm.intersection_count(
+                        frag.device_row(row_id), filt))
+                    if c > 0:
+                        cand = Pair(id=row_id, count=c)
+                        break
+                if cand is None:
+                    continue
+            if best is None or (cand.id < best.id if is_min
+                                else cand.id > best.id):
+                best = cand
+        return best if best is not None else Pair(id=0, count=0)
 
     # ------------------------------------------------------------------
     # Distinct / Rows / misc
@@ -901,6 +946,50 @@ class Executor(AdvancedOps):
         if name == "Delete":
             return self._execute_delete(idx, call, pre)
         raise ExecError(f"write call not yet supported: {name}")
+
+    def _execute_field_value(self, idx: Index, call: Call) -> ValCount:
+        """FieldValue(field=f, column=c): one column's BSI value as
+        ValCount(value, 1), count=0 when unset (executor.go:799
+        executeFieldValueCall; column keys translate like any read,
+        defs_keyed.go fieldvalue)."""
+        fname = call.arg("_field") or call.arg("field")
+        f = idx.field(fname) if fname else None
+        if f is None:
+            raise ExecError("FieldValue requires a field")
+        if not f.options.type.is_bsi:
+            raise ExecError(
+                "FieldValue requires an int/decimal/timestamp field")
+        col = call.arg("column")
+        if col is None:
+            raise ExecError("FieldValue requires a column")
+        cid = self._col_id(idx, col)
+        if cid is None:
+            return ValCount(value=None, count=0)
+        shard, scol = divmod(int(cid), idx.width)
+        v = f.views.get(f.bsi_view)
+        frag = v.fragment(shard) if v else None
+        if frag is None or not frag.contains(0, scol):
+            return ValCount(value=None, count=0)
+        mag = sum(1 << i for i in range(f.bit_depth)
+                  if frag.contains(2 + i, scol))
+        val = f.int_to_value(-mag if frag.contains(1, scol) else mag)
+        return ValCount(value=val, count=1)
+
+    def _constrow_cols(self, idx: Index, call: Call) -> list[int]:
+        """ConstRow columns with string keys translated (the
+        preTranslate analog, executor.go:6814: ConstRow over a keyed
+        index takes keys — Extract(ConstRow(columns=['two']), ...),
+        defs_keyed.go constrow).  Unknown keys match nothing."""
+        out = []
+        for c in call.arg("columns", []) or []:
+            if isinstance(c, str):
+                cid = self._col_id(idx, c)
+                if cid is None:
+                    continue
+                out.append(int(cid))
+            else:
+                out.append(int(c))
+        return out
 
     def _col_id(self, idx: Index, col, create: bool = False):
         """Resolve a column value (int id or string key) to an id.

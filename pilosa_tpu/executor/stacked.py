@@ -567,7 +567,8 @@ class PlanBuilder:
                 return sub
             return ("shift", n, sub)
         if name == "ConstRow":
-            cols = call.arg("columns", []) or []
+            # keyed-index key translation (preTranslate analog)
+            cols = self.engine.executor._constrow_cols(self.idx, call)
             width = self.idx.width
             per_shard = {}
             for c in cols:

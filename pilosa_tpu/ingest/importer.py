@@ -71,7 +71,8 @@ class HTTPImporter(Importer):
 
     def __init__(self, host: str, client=None):
         from pilosa_tpu.cluster.client import InternalClient
-        self.host = host
+        # InternalClient addresses are host:port; tolerate a scheme
+        self.host = host.split("://", 1)[-1]
         self.client = client or InternalClient()
 
     def import_bits(self, index, field, rows, cols, timestamps=None,

@@ -289,8 +289,10 @@ def test_min_max_row(holder, ex):
     idx = holder.create_index("i")
     idx.create_field("f")
     ex.execute("i", "Set(1, f=3)Set(2, f=3)Set(5, f=9)")
+    # unfiltered count is a has-value flag (fragment.go:858: "if
+    # filter is nil, it returns minRowID, 1"), not a column count
     p = ex.execute("i", "MinRow(f)")[0]
-    assert (p.id, p.count) == (3, 2)
+    assert (p.id, p.count) == (3, 1)
     p = ex.execute("i", "MaxRow(f)")[0]
     assert (p.id, p.count) == (9, 1)
 

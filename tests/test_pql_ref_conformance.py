@@ -1,0 +1,121 @@
+"""The reference def files' PQLTests, ported (REFERENCE TEST DATA:
+sql3/test/defs/ PQLTest entries — defs_join.go distinctjoin,
+defs_keyed.go minrow/maxrow/topk/rows/includescolumn/constrow/
+fieldvalue/unionrows, defs_unkeyed.go options — run against their
+TableTest setups through Executor.execute, the sql_test.go PQL
+path)."""
+
+import pytest
+
+from pilosa_tpu.models import Holder
+from pilosa_tpu.sql import SQLEngine
+
+W = 1 << 12
+
+
+def _engine(setups):
+    eng = SQLEngine(Holder(width=W))
+    for s in setups:
+        eng.query(s)
+    return eng
+
+
+KEYED_SETUP = [
+    "CREATE TABLE keyed (_id string, an_int int min 0 max 100, "
+    "an_id_set idset, an_id id, a_string string, "
+    "a_string_set stringset)",
+    "INSERT INTO keyed (_id, an_int, an_id_set, an_id, a_string, "
+    "a_string_set) VALUES "
+    "('one', 11, (11, 12, 13), 101, 'str1', ('a1', 'b1', 'c1')), "
+    "('two', 22, (11, 12, 23), 201, 'str2', ('a2', 'b2', 'c2')), "
+    "('three', 33, (11, 32, 33), 301, 'str3', ('a3', 'b3', 'c3')), "
+    "('four', 44, (41, 42, 43), 401, 'str4', ('a4', 'b4', 'c4'))",
+]
+
+
+@pytest.fixture(scope="module")
+def keyed():
+    return _engine(KEYED_SETUP)
+
+
+def _pairs(res):
+    if not isinstance(res, list):
+        res = [res]
+    return [(p.id, p.count) for p in res]
+
+
+def test_minrow(keyed):
+    # count is a has-value flag, not the row's column count
+    # (fragment.go:858: "if filter is nil, it returns minRowID, 1")
+    r = keyed.executor.execute("keyed", "MinRow(field=an_id_set)")[0]
+    assert (r.id, r.count) == (11, 1)
+
+
+def test_maxrow(keyed):
+    r = keyed.executor.execute("keyed", "MaxRow(field=an_id_set)")[0]
+    assert (r.id, r.count) == (43, 1)
+
+
+def test_topk(keyed):
+    r = keyed.executor.execute("keyed", "TopK(an_id_set, k=2)")[0]
+    assert _pairs(r) == [(11, 3), (12, 2)]
+
+
+def test_rows(keyed):
+    r = keyed.executor.execute("keyed", "Rows(field=an_id_set)")[0]
+    assert list(r) == [11, 12, 13, 23, 32, 33, 41, 42, 43]
+
+
+def test_includescolumn(keyed):
+    r = keyed.executor.execute(
+        "keyed", "IncludesColumn(Row(an_id_set=12), column='two')")[0]
+    assert r is True
+
+
+def test_constrow_extract_keyed(keyed):
+    # ConstRow takes column KEYS on a keyed index (preTranslate)
+    r = keyed.executor.execute(
+        "keyed", "Extract(ConstRow(columns=['two']), Rows(an_id))")[0]
+    assert [(e["column_key"], e["rows"][0]) for e in r.columns] == \
+        [("two", 201)]
+
+
+def test_fieldvalue(keyed):
+    r = keyed.executor.execute(
+        "keyed", "FieldValue(field=an_int, column='three')")[0]
+    assert (r.value, r.count) == (33, 1)
+
+
+def test_unionrows_count(keyed):
+    r = keyed.executor.execute(
+        "keyed", "Count(UnionRows(Rows(field=an_id_set)))")[0]
+    assert int(r) == 4
+
+
+def test_options_shards():
+    eng = _engine([
+        "CREATE TABLE unkeyed (_id id, an_id_set idset)",
+        f"INSERT INTO unkeyed (_id, an_id_set) VALUES (1, (1, 2)), "
+        f"({W + 2}, (1, 3))",
+    ])
+    # shard 0 only: the shard-1 record's bit is out of scope
+    r = eng.executor.execute(
+        "unkeyed", "Options(Count(Row(an_id_set=1)), shards=[0])")[0]
+    assert int(r) == 1
+
+
+def test_distinct_cross_index_join():
+    eng = _engine([
+        "CREATE TABLE users (_id id, name string, age int)",
+        "INSERT INTO users (_id, name, age) VALUES (0, 'a', 21), "
+        "(1, 'b', 18), (2, 'c', 28), (3, 'd', 34), (4, 'e', 36)",
+        "CREATE TABLE orders (_id id, userid int, price decimal(2))",
+        "INSERT INTO orders (_id, userid, price) VALUES "
+        "(0, 1, 9.99), (1, 0, 3.99), (2, 2, 14.99), (3, 3, 5.99), "
+        "(4, 1, 12.99), (5, 2, 1.99)",
+    ])
+    r = eng.executor.execute(
+        "users",
+        "Intersect(Distinct(Row(price > 10), index=orders, "
+        "field=userid))")[0]
+    assert sorted(int(c) for c in r.columns()) == [1, 2]
