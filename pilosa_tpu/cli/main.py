@@ -43,7 +43,7 @@ def _client(args):
 def cmd_server(args) -> int:
     from pilosa_tpu import config as cfgmod
     from pilosa_tpu.models.holder import Holder
-    from pilosa_tpu.obs.logger import StdLogger
+    from pilosa_tpu.obs.logger import StderrLogger
     from pilosa_tpu.server.http import Server
 
     # flags > env > config file > defaults (server/config.go layering)
@@ -64,7 +64,7 @@ def cmd_server(args) -> int:
         authz = (Authorizer.from_yaml(cfg.auth_policy)
                  if cfg.auth_policy else None)
         auth = (Authenticator(cfg.auth_secret.encode()), authz)
-    logger = StdLogger()
+    logger = StderrLogger()
     srv = Server(holder=holder, bind=cfg.bind, port=cfg.port,
                  logger=logger, auth=auth)
     srv.api.long_query_time = float(cfg.long_query_time)
@@ -256,6 +256,34 @@ def cmd_rbf(args) -> int:
     return 0
 
 
+def cmd_dax(args) -> int:
+    """Host the DAX services in one process — controller + queryer +
+    N compute workers over a shared storage dir (the reference's
+    `featurebase dax` single binary, dax/server/), with the
+    queryer's SQL surface on HTTP."""
+    import time as _time
+
+    from pilosa_tpu.dax.server import DAXService
+    from pilosa_tpu.obs.logger import StderrLogger
+
+    logger = StderrLogger()
+    svc = DAXService(args.data_dir, n_workers=args.workers)
+    front = svc.serve_queryer(bind=args.bind, port=args.port)
+    logger.info("dax queryer listening on %s:%d (%d workers, "
+                "storage %s)", args.bind, front.port, args.workers,
+                args.data_dir)
+    try:
+        svc.controller.start_poller()
+        while True:
+            _time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        front.close()
+        svc.close()
+    return 0
+
+
 def cmd_version(args) -> int:
     from pilosa_tpu import __version__
     print(__version__)
@@ -296,6 +324,18 @@ def build_parser() -> argparse.ArgumentParser:
                     help="log queries slower than this many seconds "
                          "(0 disables; server.go:201 analog)")
     sp.set_defaults(fn=cmd_server)
+
+    sp = sub.add_parser(
+        "dax", help="run the DAX services (controller + queryer + "
+                    "compute workers) in one process")
+    sp.add_argument("--data-dir", required=True,
+                    help="shared storage dir (write-log, snapshots, "
+                         "controller schemar)")
+    sp.add_argument("--workers", type=int, default=2)
+    sp.add_argument("--bind", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=0,
+                    help="queryer HTTP port (0 = ephemeral)")
+    sp.set_defaults(fn=cmd_dax)
 
     sp = sub.add_parser("backup", help="back up a live node")
     host_flags(sp)
@@ -353,6 +393,15 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    # honor an explicit JAX_PLATFORMS before any backend init: the
+    # axon sitecustomize force-selects the TPU platform via
+    # jax.config, overriding the env var, and a down tunnel then
+    # hangs the first jit for minutes (bench.py's probe does the
+    # same override)
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
     args = build_parser().parse_args(argv)
     try:
         return args.fn(args)

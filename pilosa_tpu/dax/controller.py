@@ -130,6 +130,16 @@ class Controller:
                 self._schemar.add_shards(table, new)
             self._push_directives_locked()
 
+    def status(self) -> dict:
+        """Locked snapshot for the queryer front's /dax/status."""
+        with self._lock:
+            return {
+                "workers": sorted(self.workers),
+                "assignments": self._assignments_locked(),
+                "tables": {t: sorted(s)
+                           for t, s in self.tables.items()},
+            }
+
     # -- balance (balancer/balancer.go) --------------------------------
 
     def assignments(self) -> dict[str, dict[str, list[int]]]:

@@ -70,7 +70,10 @@ class _RemoteExecutor(Executor):
 class Queryer:
     def __init__(self, controller: Controller):
         self.controller = controller
-        self._client = InternalClient()
+        # generous timeout: a worker's FIRST query jit-compiles the
+        # stacked program (~30-60s cold on a busy host) and must not
+        # be mistaken for a dead node
+        self._client = InternalClient(timeout=180.0)
         self._sql = None  # lazy: schema-only holder + engine
 
     # -- schema / ingest ----------------------------------------------

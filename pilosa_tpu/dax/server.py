@@ -36,6 +36,36 @@ class DAXService:
         for i in range(n_workers):
             self.add_worker(f"worker{i}")
 
+    def serve_queryer(self, bind: str = "127.0.0.1", port: int = 0):
+        """HTTP front for the queryer — the dax/server single-binary
+        surface: POST /sql (SQL over the fleet), POST
+        /queryer/{table} (PQL), GET /dax/status (workers +
+        assignments)."""
+        from pilosa_tpu.models.holder import Holder
+        from pilosa_tpu.server.http import Server
+
+        front = Server(holder=Holder(), bind=bind, port=port)
+
+        def front_sql(req):
+            # both body forms of the standard /sql endpoint: raw SQL
+            # text and {"sql": "..."}
+            body = req.json_lenient()
+            stmt = body.get("sql") if isinstance(body, dict) else None
+            return self.queryer.sql(stmt if stmt is not None
+                                    else req.text())
+
+        front.add_route("POST", "/sql", front_sql, override=True)
+        front.add_route(
+            "POST", "/queryer/{table}",
+            lambda req: self.queryer.query(
+                req.vars["table"], (req.json() or {}).get("query",
+                                                          "")))
+        front.add_route(
+            "GET", "/dax/status",
+            lambda req: self.controller.status())
+        self.queryer_front = front.start()
+        return self.queryer_front
+
     def restart_controller(self):
         """Kill the controller process-state and boot a fresh one from
         the schemar DB (the reference's controller restart: schema +
@@ -64,6 +94,13 @@ class DAXService:
                 w.close()
 
     def close(self):
+        front = getattr(self, "queryer_front", None)
+        if front is not None:
+            try:
+                front.close()
+            except Exception:
+                pass
+            self.queryer_front = None
         self.controller.stop_poller()
         for w in self.workers:
             try:

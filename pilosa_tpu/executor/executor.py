@@ -437,7 +437,10 @@ class Executor(AdvancedOps):
         if isinstance(v, str):
             if f.options.type == FieldType.TIMESTAMP:
                 try:
-                    v = timeq.parse_time(v)
+                    # ns-exact: parse_time would truncate 7-9 digit
+                    # fractions to microseconds and shift predicate
+                    # boundaries on timeunit-'ns' columns
+                    v = timeq.parse_time_ns(v)
                 except ValueError as e:
                     raise ExecError(str(e))
             else:

@@ -318,14 +318,19 @@ class Server:
             raise ApiError(f"not authorized for {need} on {index}", 403)
 
     def add_route(self, method: str, pattern: str, fn,
-                  admin_only: bool = True):
+                  admin_only: bool = True, override: bool = False):
         """Register an extra route (embedding services — DAX compute
         nodes hang /directive etc. off the same listener).  Injected
         routes default to admin-only under auth: the middleware's
         per-index rules don't know them, and cluster-internal control
-        surfaces must not be reachable with a mere read token."""
-        self._routes.append(Route(method, pattern, fn,
-                                  admin_only=admin_only))
+        surfaces must not be reachable with a mere read token.
+        override=True inserts AHEAD of the built-in surface (the DAX
+        queryer front serves /sql itself)."""
+        rt = Route(method, pattern, fn, admin_only=admin_only)
+        if override:
+            self._routes.insert(0, rt)
+        else:
+            self._routes.append(rt)
 
     def dispatch(self, method: str, path: str, req) -> tuple[int, object]:
         for rt in self._routes:

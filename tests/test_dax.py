@@ -446,3 +446,52 @@ def test_dax_bulk_insert_typechecks(dax):
         "with format 'CSV' input 'STREAM'")
     got = dax.queryer.sql("SELECT _id, a FROM bt")["data"]
     assert sorted(map(tuple, got)) == [(1, 5), (2, 7)]
+
+
+def test_queryer_http_front(dax):
+    """The dax single-binary surface: SQL + PQL + status over the
+    queryer's HTTP front (dax/server/ analog; `pilosa-tpu dax`
+    hosts this)."""
+    import http.client
+    import json as _json
+
+    cols = _seed(dax)
+    front = dax.serve_queryer()
+    try:
+        def req(method, path, body=None):
+            c = http.client.HTTPConnection("127.0.0.1", front.port,
+                                           timeout=30)
+            c.request(method, path, body=body)
+            out = _json.loads(c.getresponse().read())
+            c.close()
+            return out
+
+        r = req("POST", "/sql", "SELECT count(*) FROM t")
+        assert r["data"] == [[len(cols)]]
+        r = req("POST", "/queryer/t",
+                _json.dumps({"query": "Count(Row(f=1))"}))
+        assert r["results"][0] == len(cols)
+        st = req("GET", "/dax/status")
+        assert len(st["workers"]) == 3
+        assert st["tables"]["t"] == sorted(
+            c // SHARD for c in cols)
+    finally:
+        front.close()
+
+
+def test_queryer_front_json_sql_form(dax):
+    """The front's /sql accepts both body forms of the standard
+    endpoint: raw SQL text and {\"sql\": ...}; svc.close() tears the
+    front down."""
+    import http.client
+    import json as _json
+
+    _seed(dax, n_shards=2)
+    front = dax.serve_queryer()
+    c = http.client.HTTPConnection("127.0.0.1", front.port,
+                                   timeout=30)
+    c.request("POST", "/sql",
+              body=_json.dumps({"sql": "SELECT count(*) FROM t"}))
+    out = _json.loads(c.getresponse().read())
+    c.close()
+    assert out["data"] == [[2]]

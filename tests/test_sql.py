@@ -460,3 +460,23 @@ def test_where_like_uses_sql_scalar_semantics(eng):
         "SELECT _id FROM liketest WHERE s LIKE 'FOO'")) == [(1,)]
     assert rows(eng.query_one(
         "SELECT s LIKE '%f_' FROM liketest WHERE _id = 1")) == [(True,)]
+
+
+def test_ns_timestamp_predicate_boundaries(eng):
+    """WHERE bounds on timeunit-'ns' columns compare at full
+    nanosecond precision (Go time.Time is ns-exact; a µs-truncated
+    parse would shift every boundary)."""
+    eng.query("CREATE TABLE nsp (_id id, ts timestamp timeunit 'ns')")
+    eng.query("INSERT INTO nsp (_id, ts) VALUES "
+              "(1, '2012-11-01T22:08:41.100200300Z'), "
+              "(2, '2012-11-01T22:08:41.100200301Z')")
+    assert rows(eng.query_one(
+        "select _id from nsp where ts > "
+        "'2012-11-01T22:08:41.100200300Z'")) == [(2,)]
+    assert rows(eng.query_one(
+        "select _id from nsp where ts = "
+        "'2012-11-01T22:08:41.100200301Z'")) == [(2,)]
+    assert rows(eng.query_one(
+        "select _id from nsp where ts between "
+        "'2012-11-01T22:08:41.100200300Z' and "
+        "'2012-11-01T22:08:41.100200300Z'")) == [(1,)]
