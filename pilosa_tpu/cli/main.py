@@ -12,6 +12,7 @@ registration; implementations in ctl/):
     keygen            mint an HS256 auth token         (qa/fakeidp analog)
     rbf               inspect RBF shard files          (ctl/rbf.go)
     sql               fbsql interactive shell          (cli/cli.go)
+    dax               controller+queryer+workers       (dax/server/)
     version
 
 argparse instead of cobra; flags keep the reference's names where they
