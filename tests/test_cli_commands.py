@@ -1,0 +1,62 @@
+"""Spawn the REAL `pilosa-tpu` CLI as a subprocess — the operator
+surface (cmd/root.go analog).  The in-process suites never execute
+cmd_server/cmd_dax, which let a startup crash (a nonexistent logger
+import) ship unnoticed in round 4."""
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+def _spawn(args, env_extra=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **(env_extra or {}))
+    return subprocess.Popen(
+        [sys.executable, "-m", "pilosa_tpu.cli.main", *args],
+        env=env, stderr=subprocess.PIPE, text=True,
+        cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+
+
+def _req(port, method, path, body=None, timeout=180):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    c.request(method, path, body=body)
+    out = json.loads(c.getresponse().read())
+    c.close()
+    return out
+
+
+def test_server_command_serves_sql(tmp_path):
+    port = 10981
+    p = _spawn(["server", "--data-dir", str(tmp_path),
+                "--port", str(port), "--grpc-port", "-1"])
+    try:
+        deadline = time.time() + 120
+        while True:
+            try:
+                st = _req(port, "GET", "/status", timeout=5)
+                break
+            except OSError:
+                if time.time() > deadline:
+                    err = p.stderr.read() if p.poll() is not None \
+                        else "(still starting)"
+                    pytest.fail(f"server never listened: {err[-500:]}")
+                time.sleep(0.5)
+        assert st["state"] == "NORMAL"
+        _req(port, "POST", "/sql",
+             "CREATE TABLE t (_id id, n int min 0 max 100)")
+        _req(port, "POST", "/sql",
+             "INSERT INTO t VALUES (1, 5), (2, 9)")
+        out = _req(port, "POST", "/sql", "SELECT sum(n) FROM t")
+        assert out["data"] == [[14]]
+    finally:
+        p.send_signal(signal.SIGINT)
+        try:
+            p.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            p.kill()
