@@ -143,6 +143,12 @@ class TestSQLFuzz:
         ">=", "AND", "OR", "NOT", "NULL", "IN", "BETWEEN", "LIKE",
         "count", "sum", "UPPER", "SETCONTAINS", "RANGEQ", "int",
         "string", "timequantum", "'YMD'", ";", "min", "max", "bool",
+        # round-5 grammar surface: joins, BULK INSERT MAP/TRANSFORM,
+        # hyphen identifiers, ns timestamps, DELETE aliases
+        "JOIN", "INNER", "LEFT", "ON", "BULK", "MAP", "TRANSFORM",
+        "x'1,2'", "@0", "@1", "un-keyed", "DELETE", "a1", "DISTINCT",
+        "timestamp", "timeunit", "'ns'", "datetimeadd", "'%f_'",
+        "TOP", "HAVING", "WITH", "flatten", "BATCHSIZE", "u.",
     ]
 
     def test_parser_never_crashes(self, rng):
