@@ -729,7 +729,10 @@ def _json_value(v):
         # engine-level property — the wire is display-precision
         return float(v)
     if isinstance(v, dt.datetime):
-        return v.isoformat()
+        # RFC3339-Z (ns-aware) so wire values round-trip through
+        # parse_time_ns and render identically on the far side
+        from pilosa_tpu.sql.common import rfc3339
+        return rfc3339(v)
     if isinstance(v, np.ndarray):
         return [_json_value(x) for x in v.tolist()]
     if isinstance(v, (list, tuple)):

@@ -586,7 +586,24 @@ def _reduce(call, vals: list):
         present = [v for v in vals if v["count"] > 0]
         if not present:
             return {"value": None, "count": 0}
-        best = pick(v["value"] for v in present)
+
+        def instant_key(v):
+            # timestamps cross the wire as RFC3339-Z strings whose
+            # LEXICOGRAPHIC order diverges from the chronological one
+            # once fractions appear ('...00Z' sorts after
+            # '...00.5Z'); compare instants, not strings
+            if isinstance(v, str):
+                from pilosa_tpu.models.timeq import (
+                    NsDatetime,
+                    parse_time_ns,
+                )
+                try:
+                    d = parse_time_ns(v)
+                except ValueError:
+                    return v
+                return NsDatetime._key(d)
+            return v
+        best = pick((v["value"] for v in present), key=instant_key)
         return {"value": best,
                 "count": sum(v["count"] for v in present
                              if v["value"] == best)}
@@ -616,7 +633,22 @@ def _reduce(call, vals: list):
         out = set()
         for v in vals:
             out.update(v["values"])
-        return {"values": sorted(out)}
+        # chronological order for wire timestamps (see Min/Max note)
+        def dkey(v):
+            if isinstance(v, str) and "T" in v:
+                from pilosa_tpu.models.timeq import (
+                    NsDatetime,
+                    parse_time_ns,
+                )
+                try:
+                    return NsDatetime._key(parse_time_ns(v))
+                except ValueError:
+                    return v
+            return v
+        try:
+            return {"values": sorted(out, key=dkey)}
+        except TypeError:
+            return {"values": sorted(out, key=str)}
     if call_name == "GroupBy":
         merged = {}
         for v in vals:

@@ -26,6 +26,19 @@ from pilosa_tpu.dax.snapshotter import (
 from pilosa_tpu.dax.writelogger import WriteLogger
 
 
+def _strip_keys(schema: dict) -> dict:
+    """Worker-local schema with every keys flag cleared (ID-space
+    compute; the queryer owns translation)."""
+    out = {"indexes": []}
+    for ix in schema.get("indexes", []):
+        nix = dict(ix, keys=False)
+        nix["fields"] = [
+            dict(f, options=dict(f.get("options", {}), keys=False))
+            for f in ix.get("fields", [])]
+        out["indexes"].append(nix)
+    return out
+
+
 class ComputeNode:
     def __init__(self, address: str, writelogger: WriteLogger,
                  snapshotter: Snapshotter, bind: str = "127.0.0.1"):
@@ -68,7 +81,12 @@ class ComputeNode:
             if d.version <= self.directive_version:
                 return  # stale directive (api_directive.go version gate)
             if d.schema:
-                self.api.apply_schema(d.schema)
+                # workers run in pure ID space: key translation is a
+                # front-end (queryer) concern, exactly like the
+                # reference's Remote=true queries shipping
+                # pre-translated ids — so strip keys from the local
+                # mirror and return raw row ids in results
+                self.api.apply_schema(_strip_keys(d.schema))
             for table, want in d.assignments.items():
                 want = set(want)
                 have = self.held.get(table, set())

@@ -63,12 +63,136 @@ class _RemoteExecutor(Executor):
     def _execute_call(self, idx, call, shards, pre=None):
         # the queryer handles the Sort offset hoist and the
         # Extract(Sort) order-preserving split at the wire level
+        call = self._translate_call(idx, call)
         res = self.queryer.query(idx.name, call.to_pql())["results"][0]
-        return deserialize_result(call, res, idx.width)
+        return self._translate_result(
+            idx, call, deserialize_result(call, res, idx.width))
+
+    # -- front-end key translation (the reference orchestrator's
+    # preTranslate/translateResults split: workers run in ID space,
+    # string keys exist only here) --------------------------------------
+
+    def _translate_call(self, idx, call):
+        """Ship pre-translated row ids: string row values for keyed
+        fields become ids via the queryer-holder translators (an
+        unknown key matches nothing, FindKeys semantics)."""
+        from pilosa_tpu.pql.ast import Call
+
+        def conv(name, v):
+            f = idx.field(name)
+            if f is None or not f.options.keys or \
+                    not isinstance(v, str):
+                return v
+            rid = f.row_translator.find_keys(v).get(v)
+            return -1 if rid is None else int(rid)  # -1: no match
+
+        def walk(c):
+            args = {}
+            changed = False
+            for k, v in c.args.items():
+                nv = conv(k, v) if not isinstance(v, Call) \
+                    else walk(v)
+                changed |= nv is not v
+                args[k] = nv
+            kids = [walk(ch) for ch in c.children]
+            changed |= any(a is not b
+                           for a, b in zip(kids, c.children))
+            if not changed:
+                return c
+            return Call(c.name, args=args, children=kids)
+        return walk(call)
+
+    def _translate_result(self, idx, call, res):
+        """ids -> keys on results from the ID-space workers, using
+        the queryer-holder translators (translateResults analog,
+        executor.go:7519)."""
+        from decimal import Decimal
+
+        from pilosa_tpu.executor.results import (
+            ExtractedTable,
+            Pair,
+            ValCount,
+        )
+        from pilosa_tpu.models.schema import FieldType
+
+        def field_tr(fname):
+            f = idx.field(fname) if fname else None
+            if f is None or not f.options.keys:
+                return None, None
+            return f, f.row_translator
+
+        def requantize(f, v):
+            # decimals cross the wire as display floats; restore the
+            # exact engine type at the front
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                return Decimal(str(v)).quantize(
+                    Decimal(1).scaleb(-f.options.scale))
+            return v
+
+        if isinstance(res, ExtractedTable):
+            for i, fname in enumerate(res.fields):
+                f = idx.field(fname)
+                if f is None:
+                    continue
+                if f.options.type == FieldType.DECIMAL:
+                    for e in res.columns:
+                        e["rows"][i] = requantize(f, e["rows"][i])
+                    continue
+                _f, tr = field_tr(fname)
+                if tr is None:
+                    continue
+                for e in res.columns:
+                    v = e["rows"][i]
+                    if isinstance(v, list):
+                        e["rows"][i] = tr.translate_ids(v)
+                    elif isinstance(v, int) and \
+                            f.options.type == FieldType.MUTEX:
+                        e["rows"][i] = tr.translate_id(v)
+            return res
+        from pilosa_tpu.executor.results import DistinctValues
+        if isinstance(res, DistinctValues):
+            f = idx.field(call.arg("_field") or "")
+            if f is not None and \
+                    f.options.type == FieldType.DECIMAL:
+                res.values = [requantize(f, v) for v in res.values]
+            return res
+        if isinstance(res, ValCount):
+            f = idx.field(call.arg("_field") or "")
+            if f is not None and \
+                    f.options.type == FieldType.DECIMAL and \
+                    call.name != "Count":
+                res.value = requantize(f, res.value) \
+                    if res.value is not None else None
+            return res
+        if isinstance(res, list) and res and \
+                isinstance(res[0], Pair):
+            _f, tr = field_tr(call.arg("_field"))
+            if tr is not None:
+                keys = tr.translate_ids([p.id for p in res])
+                for p, k in zip(res, keys):
+                    p.key = k
+            return res
+        if isinstance(res, list) and res and \
+                hasattr(res[0], "group"):
+            for gc in res:
+                for entry in gc.group:
+                    f, tr = field_tr(entry.get("field"))
+                    if tr is not None and "row_key" not in entry:
+                        entry["row_key"] = tr.translate_id(
+                            entry["row_id"])
+            return res
+        return res
 
 
 class Queryer:
-    def __init__(self, controller: Controller):
+    def __init__(self, controller: Controller,
+                 translate_dir: str | None = None):
+        # translate_dir persists the front-end key translators (the
+        # keyed-field key->id maps workers never see); a restarted
+        # queryer over the same dir reloads them.  One active queryer
+        # at a time owns the dir (the reference's translation state
+        # likewise lives with the control plane, not the workers).
+        self.translate_dir = translate_dir
         self.controller = controller
         # generous timeout: a worker's FIRST query jit-compiles the
         # stacked program (~30-60s cold on a busy host) and must not
@@ -121,7 +245,8 @@ class Queryer:
         if self._sql is None:
             from pilosa_tpu.models.holder import Holder
             from pilosa_tpu.sql import SQLEngine
-            holder = Holder()
+            holder = Holder(path=self.translate_dir) \
+                if self.translate_dir else Holder()
             eng = SQLEngine(holder)
             eng.executor = _RemoteExecutor(holder, self)
             self._sql = eng
@@ -204,6 +329,10 @@ class Queryer:
 
     def _sql_insert(self, stmt) -> dict:
         """INSERT VALUES routed through owner imports (unkeyed ids)."""
+        import datetime as _dt
+        from decimal import Decimal as _D
+
+        from pilosa_tpu.sql.common import rfc3339 as _rfc3339
         from pilosa_tpu.sql.engine import SQLError
 
         eng = self._sql_engine()
@@ -233,9 +362,16 @@ class Queryer:
                     raise SQLError(f"column not found: {cname}")
                 t = f.options.type
                 if t.is_bsi:
+                    # ship USER values (JSON-able): the worker's
+                    # import does the single value_to_int conversion
+                    # — pre-scaling here double-scaled decimals
+                    f.value_to_int(v)  # validate/raise front-side
+                    wire = (str(v) if isinstance(v, _D)
+                            else _rfc3339(v)
+                            if isinstance(v, _dt.datetime) else v)
                     cs, vs = val_cols.setdefault(cname, ([], []))
                     cs.append(col)
-                    vs.append(f.value_to_int(v))
+                    vs.append(wire)
                 elif t.value == "bool":
                     rs, cs = bit_rows.setdefault(cname, ([], []))
                     rs.append(1 if v else 0)
@@ -245,9 +381,15 @@ class Queryer:
                     rs, cs = bit_rows.setdefault(cname, ([], []))
                     for item in vals:
                         if isinstance(item, str):
-                            raise SQLError(
-                                "keyed rows need the cluster path, "
-                                "not DAX yet")
+                            # keyed field rows translate at the FRONT
+                            # (queryer-holder translators); workers
+                            # run in ID space
+                            tr = f.row_translator
+                            if tr is None:
+                                raise SQLError(
+                                    f"column {cname} holds ids, got "
+                                    f"string {item!r}")
+                            item = tr.create_keys(item)[item]
                         rs.append(int(item))
                         cs.append(col)
         if replace_cols:

@@ -31,7 +31,9 @@ class DAXService:
             poll_interval=poll_interval,
             schemar=Schemar(os.path.join(storage_dir,
                                          "controller.db")))
-        self.queryer = Queryer(self.controller)
+        self.queryer = Queryer(
+            self.controller,
+            translate_dir=os.path.join(storage_dir, "queryer"))
         self.workers: list[ComputeNode] = []
         for i in range(n_workers):
             self.add_worker(f"worker{i}")
