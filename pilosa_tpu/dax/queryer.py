@@ -130,6 +130,15 @@ class _RemoteExecutor(Executor):
             return v
 
         if isinstance(res, ExtractedTable):
+            if idx.keys and idx.column_translator is not None:
+                # ID-space workers can't attach column keys; the
+                # front owns the column translator
+                ids = [int(e["column"]) for e in res.columns]
+                for e, k in zip(res.columns,
+                                idx.column_translator.translate_ids(
+                                    ids)):
+                    if k is not None:
+                        e["column_key"] = k
             for i, fname in enumerate(res.fields):
                 f = idx.field(fname)
                 if f is None:
@@ -339,9 +348,6 @@ class Queryer:
         idx = eng.holder.index(stmt.table)
         if idx is None:
             raise SQLError(f"table not found: {stmt.table}")
-        if idx.keys:
-            raise SQLError(
-                "keyed tables need the cluster path, not DAX yet")
         if "_id" not in stmt.columns:
             raise SQLError("INSERT requires an _id column")
         id_pos = stmt.columns.index("_id")
@@ -351,7 +357,8 @@ class Queryer:
         val_cols: dict[str, tuple[list, list]] = {}
         replace_cols: list[int] = []
         for row in stmt.rows:
-            col = int(row[id_pos])
+            # keyed _id translates at the front like field keys
+            col = int(eng._col_id(idx, row[id_pos]))
             if stmt.replace:
                 replace_cols.append(col)
             for cname, v in zip(stmt.columns, row):

@@ -319,8 +319,8 @@ def test_dax_sql_shape_support_matrix(dax):
     aggregates, GROUP BY (including the generic hashed path over BSI
     columns), JOIN, DISTINCT, ORDER BY...LIMIT — the local-cell
     paths ride bulk Extract column maps over the compute fleet
-    (dax/queryer/orchestrator.go:83,109 shape).  Still refused:
-    keyed-row INSERT (routes via the cluster path)."""
+    (dax/queryer/orchestrator.go:83,109 shape), and keyed fields /
+    keyed tables translate at the front (ID-space workers)."""
     from pilosa_tpu.sql import SQLError
 
     dax.queryer.apply_schema({"indexes": [
@@ -361,19 +361,17 @@ def test_dax_sql_shape_support_matrix(dax):
         got = dax.queryer.sql(q)["data"]
         assert sorted(map(repr, got)) == sorted(map(repr, want)), \
             (q, got)
-    # keyed FIELD rows now translate at the queryer (ID-space
-    # workers); only keyed-_id TABLES still route via the cluster
+    # r05: nothing left in the matrix refuses — keyed FIELD rows and
+    # keyed-_id TABLES both translate at the queryer (ID-space
+    # workers, front-end translators)
     dax.queryer.sql("CREATE TABLE sk (_id id, k string); "
                     "INSERT INTO sk (_id, k) VALUES (1, 'x')")
     got = dax.queryer.sql("SELECT _id FROM sk WHERE k = 'x'")["data"]
     assert got == [[1]]
-    refused = [
-        "CREATE TABLE sk2 (_id string, k int); "
-        "INSERT INTO sk2 (_id, k) VALUES ('a', 1)",
-    ]
-    for q in refused:
-        with pytest.raises(SQLError):
-            dax.queryer.sql(q)
+    dax.queryer.sql("CREATE TABLE sk2 (_id string, k int); "
+                    "INSERT INTO sk2 (_id, k) VALUES ('a', 1)")
+    got = dax.queryer.sql("SELECT _id FROM sk2 WHERE k = 1")["data"]
+    assert got == [["a"]]
 
 
 def test_controller_restart_loses_nothing(dax):
@@ -575,3 +573,25 @@ def test_keyed_translation_survives_service_restart(tmp_path):
                                            (3, "z")]
     finally:
         svc2.close()
+
+
+def test_dax_keyed_table_end_to_end(dax):
+    """Keyed-_id tables over the fleet: column keys mint at the
+    front, workers run in ID space, and results carry the keys back
+    (the defs_keyed shapes)."""
+    q = dax.queryer
+    q.sql("CREATE TABLE kt (_id string, an_int int min 0 max 100, "
+          "a_string string)")
+    q.sql("INSERT INTO kt (_id, an_int, a_string) VALUES "
+          "('one', 11, 'str1'), ('two', 22, 'str2'), "
+          "('three', 33, 'str3')")
+    got = q.sql("SELECT _id, an_int, a_string FROM kt")["data"]
+    assert sorted(map(tuple, got)) == [
+        ("one", 11, "str1"), ("three", 33, "str3"),
+        ("two", 22, "str2")]
+    assert q.sql("SELECT _id FROM kt WHERE an_int = 22")["data"] == \
+        [["two"]]
+    assert q.sql(
+        "SELECT _id FROM kt WHERE a_string = 'str3'")["data"] == \
+        [["three"]]
+    assert q.sql("SELECT count(*) FROM kt")["data"] == [[3]]
