@@ -96,6 +96,25 @@ def probe_backend() -> tuple[str, int]:
     return "cpu", 0
 
 
+def _disjoint_category_rows(rng, n_rows: int, words: int):
+    """Packed rows of a CATEGORICAL field: every column belongs to at
+    most one row (what real GROUP BY attributes look like — the able
+    gauntlet's edu/gen/dom are single-valued per record).  Built by
+    drawing ceil(log2 R) random bit-planes as each column's category
+    digit; digits >= n_rows mean "attribute absent" for that column."""
+    import numpy as np
+    bits = max(n_rows - 1, 0).bit_length()
+    planes = rng.integers(0, 1 << 32, size=(max(bits, 1), words),
+                          dtype=np.uint32)
+    rows = []
+    for r in range(n_rows):
+        acc = np.full(words, 0xFFFFFFFF, dtype=np.uint32)
+        for b in range(bits):
+            acc &= planes[b] if (r >> b) & 1 else ~planes[b]
+        rows.append(acc)
+    return rows
+
+
 def build_index(n_shards: int, topn_rows: int, seed: int = 7):
     """A real index populated through the bulk import path."""
     import numpy as np
@@ -115,19 +134,25 @@ def build_index(n_shards: int, topn_rows: int, seed: int = 7):
     words = SHARD_WIDTH // 32
     cells = 0
     t0 = time.perf_counter()
-    # north-star fields + the "able" gauntlet trio (qa/scripts/perf/
-    # able/ableTest.sh:63: GroupBy over 3 Rows fields with a Sum):
-    # edu/gen/dom are disjoint-ish categorical rows, age is BSI
+    # north-star fields + the "able" gauntlet categoricals (qa/
+    # scripts/perf/able/ableTest.sh:63: GroupBy over 3 Rows fields
+    # with a Sum): edu/gen/dom/reg are DISJOINT categorical rows (one
+    # category per column, like the reference's single-valued record
+    # attributes — also what qualifies them for the one-pass
+    # group-code GroupBy), age is BSI.  reg exists only for the
+    # combo-count sweep (2*5*6*4 = 240 combos at the top end).
     # "tr" mirrors "t" with the RANKED cache: filtered TopN on it
     # scans only cache candidates (the reference's TopN strategy,
     # cache.go:130) — measured against the exact full scan on "t"
+    categorical = {"edu": 6, "gen": 2, "dom": 5, "reg": 4}
     for fname, rows, cache in (
             ("a", [1], CACHE_TYPE_NONE), ("b", [1], CACHE_TYPE_NONE),
             ("t", list(range(topn_rows)), CACHE_TYPE_NONE),
             ("tr", list(range(topn_rows)), "ranked"),
             ("edu", list(range(6)), CACHE_TYPE_NONE),
             ("gen", list(range(2)), CACHE_TYPE_NONE),
-            ("dom", list(range(5)), CACHE_TYPE_NONE)):
+            ("dom", list(range(5)), CACHE_TYPE_NONE),
+            ("reg", list(range(4)), CACHE_TYPE_NONE)):
         # cache_type none on the TopN field forces the stacked device
         # scan — an unfiltered TopN on a ranked-cache field would be
         # served by the host rank-cache merge instead, measuring the
@@ -136,11 +161,16 @@ def build_index(n_shards: int, topn_rows: int, seed: int = 7):
         view = f.view(VIEW_STANDARD, create=True)
         for shard in range(n_shards):
             frag = view.fragment(shard, create=True)
+            cat_rows = (_disjoint_category_rows(
+                rng, categorical[fname], words)
+                if fname in categorical else None)
             for r in rows:
                 if fname == "tr":
                     # copy t's words so results compare exactly
                     w = idx.field("t").view(VIEW_STANDARD) \
                         .fragment(shard).row_words(r)
+                elif cat_rows is not None:
+                    w = cat_rows[r]
                 else:
                     w = rng.integers(0, 1 << 32, size=words,
                                      dtype=np.uint32)
@@ -183,6 +213,14 @@ def run_queries(h, reps: int, label: str) -> dict[str, list[float]]:
         # (qa/scripts/perf/able/ableTest.sh:63)
         "able_groupby": "GroupBy(Rows(edu), Rows(gen), Rows(dom), "
                         "aggregate=Sum(field=age))",
+        # combo-count sweep around the 60-combo gauntlet shape: the
+        # one-pass group-code path must hold roughly FLAT wall time
+        # from 10 to 240 combos (its traffic is O(S*W), combo-free),
+        # where the per-combo paths scale linearly in C
+        "groupby_c10": "GroupBy(Rows(gen), Rows(dom), "
+                       "aggregate=Sum(field=age))",
+        "groupby_c240": "GroupBy(Rows(edu), Rows(gen), Rows(dom), "
+                        "Rows(reg), aggregate=Sum(field=age))",
     }
     # warmup: compiles the stacked programs + uploads the tile stacks
     warm = {}
@@ -371,6 +409,13 @@ def main() -> None:
         "raw_wall_p50_1shard_ms": {k: round(v * 1e3, 3)
                                    for k, v in p50_tiny.items()},
         "net_device_p50_ms": {k: round(v, 3) for k, v in net_ms.items()},
+        # GroupBy combo-count sweep (one-pass group-code path):
+        # roughly flat in C is the acceptance signal
+        "groupby_combo_sweep_wall_p50_ms": {
+            "c10": round(p50["groupby_c10"] * 1e3, 3),
+            "c60": round(p50["able_groupby"] * 1e3, 3),
+            "c240": round(p50["groupby_c240"] * 1e3, 3),
+        },
     }
     if cal is not None:
         result["loop_calibrated_device_ms"] = {

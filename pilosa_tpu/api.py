@@ -380,6 +380,35 @@ class API:
         hotspot measured r04)."""
         self._index(index).mark_columns_exist(cols)
 
+    def clear_field_columns(self, index: str, field: str, cols,
+                            mark_exists: bool = True) -> int:
+        """Drop EVERY stored bit `field` holds for the given columns,
+        across all views — the record-level field clear an explicit
+        NULL in an INSERT tuple performs for bool/mutex fields
+        (statements.apply_record's clear_field, the reference
+        batcher's clear-then-set path).  mark_exists keeps the
+        record's existence: (id, NULL) still inserts the record."""
+        from pilosa_tpu.ops import bitmap as bm_ops
+        self._check_writable()
+        idx = self._index(index)
+        f = idx.field(field)
+        if f is None:
+            raise ApiError(f"field not found: {field}", 404)
+        by_shard: dict[int, list[int]] = {}
+        for c in cols:
+            by_shard.setdefault(int(c) // idx.width, []).append(
+                int(c) % idx.width)
+        with self._import_lock(index):
+            for shard, local in by_shard.items():
+                mask = bm_ops.from_columns(local, idx.width)
+                for v in f.views.values():
+                    frag = v.fragment(shard)
+                    if frag is not None:
+                        frag.clear_columns(mask)
+            if mark_exists:
+                idx.mark_columns_exist(cols)
+        return len(cols)
+
     def import_columns(self, index: str, cols, bits: dict | None = None,
                        values: dict | None = None,
                        workers: int = 4) -> int:

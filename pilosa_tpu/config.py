@@ -11,8 +11,12 @@ nested TOML tables flatten with ``_`` (``[auth] secret`` ->
 from __future__ import annotations
 
 import os
-import tomllib
 from dataclasses import dataclass, field, fields
+
+try:
+    import tomllib  # Python >= 3.11
+except ModuleNotFoundError:  # 3.10: only needed when a file is given
+    tomllib = None
 
 
 @dataclass
@@ -57,6 +61,48 @@ _TOML_KEYS = {
 ENV_PREFIX = "PILOSA_TPU_"
 
 
+def _parse_toml_minimal(text: str) -> dict:
+    """Fallback TOML subset parser for Python 3.10 (no stdlib
+    tomllib): ``[table]`` headers and scalar ``key = value`` pairs
+    (quoted strings, booleans, ints, floats) — exactly the shape of
+    this server's config files.  Anything fancier raises."""
+    doc: dict = {}
+    table = doc
+    for ln, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            table = doc
+            for part in line[1:-1].strip().split("."):
+                table = table.setdefault(part.strip(), {})
+            continue
+        if "=" not in line:
+            raise ValueError(f"config line {ln}: not key = value")
+        key, _, val = line.partition("=")
+        key, val = key.strip(), val.strip()
+        if not val or val.startswith("#"):
+            raise ValueError(f"config line {ln}: missing value")
+        if val[:1] in "\"'":
+            # quoted string: close at the MATCHING quote so '#' (and
+            # anything else) inside the value survives; a trailing
+            # comment after the close quote is dropped
+            end = val.find(val[0], 1)
+            if end < 0:
+                raise ValueError(f"config line {ln}: unclosed string")
+            table[key] = val[1:end]
+            continue
+        val = val.split("#", 1)[0].strip()
+        if val in ("true", "false"):
+            table[key] = val == "true"
+        else:
+            try:
+                table[key] = int(val)
+            except ValueError:
+                table[key] = float(val)  # raises on junk — good
+    return doc
+
+
 def _flatten(doc: dict, prefix: str = "") -> dict:
     out = {}
     for k, v in doc.items():
@@ -75,8 +121,12 @@ def load(path: str | None = None, env: dict | None = None,
     cfg = Config()
     names = {f.name for f in fields(Config)}
     if path:
-        with open(path, "rb") as f:
-            doc = tomllib.load(f)
+        if tomllib is not None:
+            with open(path, "rb") as f:
+                doc = tomllib.load(f)
+        else:
+            with open(path, "r", encoding="utf-8") as f:
+                doc = _parse_toml_minimal(f.read())
         flat = _flatten(doc)
         for tk, attr in _TOML_KEYS.items():
             if tk in flat:

@@ -156,6 +156,12 @@ class ComputeNode:
             return self.api.import_values(
                 e["table"], e["field"], cols=e["cols"],
                 values=e["values"])
+        if e["op"] == "clear":
+            # record-level field clear (explicit NULL in an INSERT
+            # tuple for bool/mutex) — logged like any write so
+            # snapshot+tail recovery replays it in order
+            return self.api.clear_field_columns(
+                e["table"], e["field"], cols=e["cols"])
         raise ValueError(f"unknown write-log op {e['op']!r}")
 
     # -- snapshotting (dax/snapshotter; checkpoint = snapshot + trunc) --

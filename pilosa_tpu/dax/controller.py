@@ -36,6 +36,9 @@ class Controller:
     def __init__(self, poll_interval: float = 1.0, schemar=None):
         self.workers: dict[str, str] = {}       # address -> uri
         self.schema: dict = {}
+        # bumped on every schema mutation (apply/drop/reload): cheap
+        # cache token for schema-derived facts (queryer keyedness)
+        self.schema_version = 0
         # table -> sorted shard ids registered for it
         self.tables: dict[str, set[int]] = {}
         self._versions: dict[str, int] = {}     # per-worker directive ver
@@ -58,6 +61,7 @@ class Controller:
             st = schemar.load()
             self.workers = st["workers"]
             self.schema = st["schema"]
+            self.schema_version += 1
             self.tables = st["tables"]
             self._versions = st["versions"]
             self._pushed = st["pushed"]
@@ -97,6 +101,7 @@ class Controller:
     def apply_schema(self, schema: dict):
         with self._lock:
             self.schema = schema
+            self.schema_version += 1
             for ix in schema.get("indexes", []):
                 self.tables.setdefault(ix["name"], set())
             if self._schemar is not None:
@@ -108,6 +113,7 @@ class Controller:
         directives so workers drop their held shards."""
         with self._lock:
             self.tables.pop(table, None)
+            self.schema_version += 1
             if self.schema:
                 self.schema = {
                     "indexes": [ix for ix in

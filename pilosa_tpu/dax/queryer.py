@@ -62,9 +62,12 @@ class _RemoteExecutor(Executor):
 
     def _execute_call(self, idx, call, shards, pre=None):
         # the queryer handles the Sort offset hoist and the
-        # Extract(Sort) order-preserving split at the wire level
+        # Extract(Sort) order-preserving split at the wire level;
+        # translate=False: this executor pre-translates the call and
+        # key-translates the decoded result OBJECTS itself
         call = self._translate_call(idx, call)
-        res = self.queryer.query(idx.name, call.to_pql())["results"][0]
+        res = self.queryer.query(idx.name, call.to_pql(),
+                                 translate=False)["results"][0]
         return self._translate_result(
             idx, call, deserialize_result(call, res, idx.width))
 
@@ -73,124 +76,153 @@ class _RemoteExecutor(Executor):
     # string keys exist only here) --------------------------------------
 
     def _translate_call(self, idx, call):
-        """Ship pre-translated row ids: string row values for keyed
-        fields become ids via the queryer-holder translators (an
-        unknown key matches nothing, FindKeys semantics)."""
-        from pilosa_tpu.pql.ast import Call
-
-        def conv(name, v):
-            f = idx.field(name)
-            if f is None or not f.options.keys or \
-                    not isinstance(v, str):
-                return v
-            rid = f.row_translator.find_keys(v).get(v)
-            return -1 if rid is None else int(rid)  # -1: no match
-
-        def walk(c):
-            args = {}
-            changed = False
-            for k, v in c.args.items():
-                nv = conv(k, v) if not isinstance(v, Call) \
-                    else walk(v)
-                changed |= nv is not v
-                args[k] = nv
-            kids = [walk(ch) for ch in c.children]
-            changed |= any(a is not b
-                           for a, b in zip(kids, c.children))
-            if not changed:
-                return c
-            return Call(c.name, args=args, children=kids)
-        return walk(call)
+        return translate_call_keys(idx, call)
 
     def _translate_result(self, idx, call, res):
-        """ids -> keys on results from the ID-space workers, using
-        the queryer-holder translators (translateResults analog,
-        executor.go:7519)."""
-        from decimal import Decimal
+        return translate_result_keys(idx, call, res)
 
-        from pilosa_tpu.executor.results import (
-            ExtractedTable,
-            Pair,
-            ValCount,
-        )
-        from pilosa_tpu.models.schema import FieldType
 
-        def field_tr(fname):
-            f = idx.field(fname) if fname else None
-            if f is None or not f.options.keys:
-                return None, None
-            return f, f.row_translator
+def translate_call_keys(idx, call):
+    """Ship pre-translated row ids: string row values for keyed
+    fields become ids via the queryer-holder translators (an unknown
+    key matches nothing, FindKeys semantics).  Handles bare strings,
+    lists of strings (Rows(ids=...) shapes), and Condition values —
+    keyed-shape raw PQL must never silently match nothing because a
+    worker compared a string against an ID-space row."""
+    from pilosa_tpu.pql.ast import Call, Condition
 
-        def requantize(f, v):
-            # decimals cross the wire as display floats; restore the
-            # exact engine type at the front
-            if isinstance(v, (int, float)) and not isinstance(v, bool):
-                return Decimal(str(v)).quantize(
-                    Decimal(1).scaleb(-f.options.scale))
+    def conv(name, v):
+        f = idx.field(name)
+        if f is None or not f.options.keys:
             return v
+        tr = f.row_translator
 
-        if isinstance(res, ExtractedTable):
-            if idx.keys and idx.column_translator is not None:
-                # ID-space workers can't attach column keys; the
-                # front owns the column translator
-                ids = [int(e["column"]) for e in res.columns]
-                for e, k in zip(res.columns,
-                                idx.column_translator.translate_ids(
-                                    ids)):
-                    if k is not None:
-                        e["column_key"] = k
-            for i, fname in enumerate(res.fields):
-                f = idx.field(fname)
-                if f is None:
-                    continue
-                if f.options.type == FieldType.DECIMAL:
-                    for e in res.columns:
-                        e["rows"][i] = requantize(f, e["rows"][i])
-                    continue
-                _f, tr = field_tr(fname)
-                if tr is None:
-                    continue
+        def one(x):
+            if not isinstance(x, str):
+                return x
+            rid = tr.find_keys(x).get(x)
+            return -1 if rid is None else int(rid)  # -1: no match
+
+        if isinstance(v, str):
+            return one(v)
+        if isinstance(v, list):
+            nv = [one(x) for x in v]
+            return v if all(a is b for a, b in zip(nv, v)) else nv
+        if isinstance(v, Condition):
+            cv = v.value
+            ncv = ([one(x) for x in cv] if isinstance(cv, list)
+                   else one(cv))
+            if ncv is cv:
+                return v
+            return Condition(v.op, ncv)
+        return v
+
+    def walk(c):
+        args = {}
+        changed = False
+        for k, v in c.args.items():
+            nv = conv(k, v) if not isinstance(v, Call) \
+                else walk(v)
+            changed |= nv is not v
+            args[k] = nv
+        kids = [walk(ch) for ch in c.children]
+        changed |= any(a is not b
+                       for a, b in zip(kids, c.children))
+        if not changed:
+            return c
+        return Call(c.name, args=args, children=kids)
+    return walk(call)
+
+
+def translate_result_keys(idx, call, res):
+    """ids -> keys on results from the ID-space workers, using
+    the queryer-holder translators (translateResults analog,
+    executor.go:7519)."""
+    from decimal import Decimal
+
+    from pilosa_tpu.executor.results import (
+        ExtractedTable,
+        Pair,
+        ValCount,
+    )
+    from pilosa_tpu.models.schema import FieldType
+
+    def field_tr(fname):
+        f = idx.field(fname) if fname else None
+        if f is None or not f.options.keys:
+            return None, None
+        return f, f.row_translator
+
+    def requantize(f, v):
+        # decimals cross the wire as display floats; restore the
+        # exact engine type at the front
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return Decimal(str(v)).quantize(
+                Decimal(1).scaleb(-f.options.scale))
+        return v
+
+    if isinstance(res, ExtractedTable):
+        if idx.keys and idx.column_translator is not None:
+            # ID-space workers can't attach column keys; the
+            # front owns the column translator
+            ids = [int(e["column"]) for e in res.columns]
+            for e, k in zip(res.columns,
+                            idx.column_translator.translate_ids(
+                                ids)):
+                if k is not None:
+                    e["column_key"] = k
+        for i, fname in enumerate(res.fields):
+            f = idx.field(fname)
+            if f is None:
+                continue
+            if f.options.type == FieldType.DECIMAL:
                 for e in res.columns:
-                    v = e["rows"][i]
-                    if isinstance(v, list):
-                        e["rows"][i] = tr.translate_ids(v)
-                    elif isinstance(v, int) and \
-                            f.options.type == FieldType.MUTEX:
-                        e["rows"][i] = tr.translate_id(v)
-            return res
-        from pilosa_tpu.executor.results import DistinctValues
-        if isinstance(res, DistinctValues):
-            f = idx.field(call.arg("_field") or "")
-            if f is not None and \
-                    f.options.type == FieldType.DECIMAL:
-                res.values = [requantize(f, v) for v in res.values]
-            return res
-        if isinstance(res, ValCount):
-            f = idx.field(call.arg("_field") or "")
-            if f is not None and \
-                    f.options.type == FieldType.DECIMAL and \
-                    call.name != "Count":
-                res.value = requantize(f, res.value) \
-                    if res.value is not None else None
-            return res
-        if isinstance(res, list) and res and \
-                isinstance(res[0], Pair):
-            _f, tr = field_tr(call.arg("_field"))
-            if tr is not None:
-                keys = tr.translate_ids([p.id for p in res])
-                for p, k in zip(res, keys):
-                    p.key = k
-            return res
-        if isinstance(res, list) and res and \
-                hasattr(res[0], "group"):
-            for gc in res:
-                for entry in gc.group:
-                    f, tr = field_tr(entry.get("field"))
-                    if tr is not None and "row_key" not in entry:
-                        entry["row_key"] = tr.translate_id(
-                            entry["row_id"])
-            return res
+                    e["rows"][i] = requantize(f, e["rows"][i])
+                continue
+            _f, tr = field_tr(fname)
+            if tr is None:
+                continue
+            for e in res.columns:
+                v = e["rows"][i]
+                if isinstance(v, list):
+                    e["rows"][i] = tr.translate_ids(v)
+                elif isinstance(v, int) and \
+                        f.options.type == FieldType.MUTEX:
+                    e["rows"][i] = tr.translate_id(v)
         return res
+    from pilosa_tpu.executor.results import DistinctValues
+    if isinstance(res, DistinctValues):
+        f = idx.field(call.arg("_field") or "")
+        if f is not None and \
+                f.options.type == FieldType.DECIMAL:
+            res.values = [requantize(f, v) for v in res.values]
+        return res
+    if isinstance(res, ValCount):
+        f = idx.field(call.arg("_field") or "")
+        if f is not None and \
+                f.options.type == FieldType.DECIMAL and \
+                call.name != "Count":
+            res.value = requantize(f, res.value) \
+                if res.value is not None else None
+        return res
+    if isinstance(res, list) and res and \
+            isinstance(res[0], Pair):
+        _f, tr = field_tr(call.arg("_field"))
+        if tr is not None:
+            keys = tr.translate_ids([p.id for p in res])
+            for p, k in zip(res, keys):
+                p.key = k
+        return res
+    if isinstance(res, list) and res and \
+            hasattr(res[0], "group"):
+        for gc in res:
+            for entry in gc.group:
+                f, tr = field_tr(entry.get("field"))
+                if tr is not None and "row_key" not in entry:
+                    entry["row_key"] = tr.translate_id(
+                        entry["row_id"])
+        return res
+    return res
 
 
 class Queryer:
@@ -208,6 +240,8 @@ class Queryer:
         # be mistaken for a dead node
         self._client = InternalClient(timeout=180.0)
         self._sql = None  # lazy: schema-only holder + engine
+        # table -> (controller schema_version, is_keyed)
+        self._keyed_cache: dict[str, tuple[int, bool]] = {}
 
     # -- schema / ingest ----------------------------------------------
 
@@ -220,33 +254,42 @@ class Queryer:
             groups.setdefault(int(c) // width, []).append(i)
         return groups
 
-    def import_bits(self, table: str, field: str, rows, cols) -> int:
+    def _import_fanout(self, table: str, field: str, cols,
+                       payload) -> int:
+        """Shared owner fan-out for every /dax/import write op:
+        group cols by shard, register the shards, POST one request
+        per owning worker.  payload(idxs) -> op-specific body
+        fields."""
         n = 0
         groups = self._group_by_shard(cols)
         self.controller.add_shards(table, groups.keys())
         for shard, idxs in groups.items():
             _, uri = self.controller.worker_for(table, shard)
-            r = self._client._request(uri, "POST", "/dax/import", {
-                "op": "bits", "table": table, "field": field,
-                "shard": shard,
-                "rows": [int(rows[i]) for i in idxs],
-                "cols": [int(cols[i]) for i in idxs]})
+            body = {"table": table, "field": field, "shard": shard,
+                    "cols": [int(cols[i]) for i in idxs]}
+            body.update(payload(idxs))
+            r = self._client._request(uri, "POST", "/dax/import", body)
             n += r["imported"]
         return n
 
+    def import_bits(self, table: str, field: str, rows, cols) -> int:
+        return self._import_fanout(
+            table, field, cols,
+            lambda idxs: {"op": "bits",
+                          "rows": [int(rows[i]) for i in idxs]})
+
     def import_values(self, table: str, field: str, cols, values) -> int:
-        n = 0
-        groups = self._group_by_shard(cols)
-        self.controller.add_shards(table, groups.keys())
-        for shard, idxs in groups.items():
-            _, uri = self.controller.worker_for(table, shard)
-            r = self._client._request(uri, "POST", "/dax/import", {
-                "op": "values", "table": table, "field": field,
-                "shard": shard,
-                "cols": [int(cols[i]) for i in idxs],
-                "values": [values[i] for i in idxs]})
-            n += r["imported"]
-        return n
+        return self._import_fanout(
+            table, field, cols,
+            lambda idxs: {"op": "values",
+                          "values": [values[i] for i in idxs]})
+
+    def clear_field(self, table: str, field: str, cols) -> int:
+        """Record-level field clear on the owning workers (explicit
+        NULL for a bool/mutex column — apply_record's clear_field
+        shipped over the wire, write-logged like any import)."""
+        return self._import_fanout(table, field, cols,
+                                   lambda idxs: {"op": "clear"})
 
     # -- SQL fronting (queryer.go:134 QuerySQL) -------------------------
 
@@ -355,6 +398,11 @@ class Queryer:
         # fan-out per field, not one RPC per (row, value)
         bit_rows: dict[str, tuple[list, list]] = {}
         val_cols: dict[str, tuple[list, list]] = {}
+        # bool/mutex hold ONE value per record: collapse duplicate
+        # rows for the same _id to the LAST action (set, or None =
+        # explicit-NULL clear) so the batched fan-out preserves
+        # apply_record's row-by-row order
+        single_last: dict[str, dict[int, object]] = {}
         replace_cols: list[int] = []
         for row in stmt.rows:
             # keyed _id translates at the front like field keys
@@ -362,12 +410,19 @@ class Queryer:
             if stmt.replace:
                 replace_cols.append(col)
             for cname, v in zip(stmt.columns, row):
-                if cname == "_id" or v is None:
+                if cname == "_id":
                     continue
                 f = idx.field(cname)
                 if f is None:
                     raise SQLError(f"column not found: {cname}")
                 t = f.options.type
+                if t.value in ("bool", "mutex"):
+                    single_last.setdefault(cname, {})[col] = v
+                    continue
+                if v is None:
+                    # NULL on set/BSI columns is a no-op, matching
+                    # apply_record (only bool/mutex state clears)
+                    continue
                 if t.is_bsi:
                     # ship USER values (JSON-able): the worker's
                     # import does the single value_to_int conversion
@@ -379,10 +434,6 @@ class Queryer:
                     cs, vs = val_cols.setdefault(cname, ([], []))
                     cs.append(col)
                     vs.append(wire)
-                elif t.value == "bool":
-                    rs, cs = bit_rows.setdefault(cname, ([], []))
-                    rs.append(1 if v else 0)
-                    cs.append(col)
                 else:
                     vals = v if isinstance(v, list) else [v]
                     rs, cs = bit_rows.setdefault(cname, ([], []))
@@ -405,6 +456,38 @@ class Queryer:
             cols_pql = ",".join(str(c) for c in replace_cols)
             self.query(stmt.table,
                        f"Delete(ConstRow(columns=[{cols_pql}]))")
+        for cname, colvals in single_last.items():
+            f = idx.field(cname)
+            clears = [c for c, v in colvals.items() if v is None]
+            rs, cs = [], []
+            for c, v in colvals.items():
+                if v is None:
+                    continue
+                if f.options.type.value == "bool":
+                    rs.append(1 if v else 0)
+                else:
+                    if isinstance(v, list):
+                        raise SQLError(
+                            f"column {cname} accepts a single value")
+                    if isinstance(v, str):
+                        tr = f.row_translator
+                        if tr is None:
+                            raise SQLError(
+                                f"column {cname} holds ids, got "
+                                f"string {v!r}")
+                        v = tr.create_keys(v)[v]
+                    rs.append(int(v))
+                cs.append(c)
+            if clears:
+                # an EXPLICIT null clears the record's bool/mutex
+                # state on the OWNING worker instead of being
+                # silently skipped (defs_bool select-all2: inserting
+                # (2, null) over (2, true) must read back NULL), and
+                # marks existence so a NULL-only record still
+                # inserts — exactly apply_record's local semantics
+                self.clear_field(stmt.table, cname, clears)
+            if cs:
+                self.import_bits(stmt.table, cname, rs, cs)
         for cname, (rs, cs) in bit_rows.items():
             self.import_bits(stmt.table, cname, rs, cs)
         for cname, (cs, vs) in val_cols.items():
@@ -413,8 +496,44 @@ class Queryer:
 
     # -- reads (orchestrator.go:83 Execute) ----------------------------
 
-    def query(self, table: str, pql: str) -> dict:
+    def _keyed_index(self, table: str):
+        """The schema-only mirror index for `table` IF any key
+        translation applies to it, else None.  Keyedness is memoized
+        by controller schema version so the common unkeyed raw-PQL
+        fan-out never pays the mirror refresh; keyed tables refresh
+        via _sql_engine (same path SQL fronting uses)."""
+        ver = self.controller.schema_version
+        ent = self._keyed_cache.get(table)
+        if ent is None or ent[0] != ver:
+            keyed = False
+            for ix in self.controller.schema.get("indexes", []):
+                if ix.get("name") == table:
+                    keyed = bool(ix.get("keys")) or any(
+                        f.get("options", {}).get("keys")
+                        for f in ix.get("fields", []))
+                    break
+            ent = (ver, keyed)
+            self._keyed_cache[table] = ent
+        if not ent[1]:
+            return None
+        return self._sql_engine().holder.index(table)
+
+    def query(self, table: str, pql: str,
+              translate: bool = True) -> dict:
+        """Raw-PQL fan-out.  Keyed-shape PQL routes through the same
+        translate_call_keys / translate_result_keys pair the SQL front
+        uses: string row values become ids BEFORE shipping (workers
+        run in pure ID space — an untranslated key would silently
+        match nothing) and result ids come back with their keys
+        attached.  translate=False is the internal ID-space entry used
+        by _RemoteExecutor, which does its own translation on the
+        richer result objects."""
         q = parse(pql)
+        idx = self._keyed_index(table) if translate else None
+        if idx is not None:
+            from pilosa_tpu.pql.ast import Query
+            q = Query(calls=[translate_call_keys(idx, c)
+                             for c in q.calls])
         # order-sensitive calls need call-level handling before the
         # fan-out (same contracts as ClusterExecutor): Extract(Sort)
         # splits; Sort hoists its offset to the merge
@@ -426,11 +545,15 @@ class Queryer:
                         and c.children[0].name == "Sort":
                     results.append(extract_of_sort_wire(
                         c, lambda cc: self.query(
-                            table, cc.to_pql())["results"][0]))
+                            table, cc.to_pql(),
+                            translate=False)["results"][0]))
                 else:
-                    results.append(
-                        self.query(table, c.to_pql())["results"][0])
-            return {"results": results}
+                    results.append(self.query(
+                        table, c.to_pql(),
+                        translate=False)["results"][0])
+            out = {"results": results}
+            return (self._translate_wire_results(idx, q, out)
+                    if idx is not None else out)
         shipped = [(_sort_call_for_shipping(c) if c.name == "Sort"
                     else c) for c in q.calls]
         pql = "".join(c.to_pql() for c in shipped)
@@ -452,7 +575,40 @@ class Queryer:
         partials = [r["results"] for r in
                     Pool(size=2).map(one, sorted(by_worker))]
         if not partials:
-            return {"results": [_empty_result(c) for c in q.calls]}
-        return {"results": [
-            _reduce(q.calls[ci], [p[ci] for p in partials])
-            for ci in range(len(q.calls))]}
+            out = {"results": [_empty_result(c) for c in q.calls]}
+        else:
+            out = {"results": [
+                _reduce(q.calls[ci], [p[ci] for p in partials])
+                for ci in range(len(q.calls))]}
+        return (self._translate_wire_results(idx, q, out)
+                if idx is not None else out)
+
+    def _translate_wire_results(self, idx, q, out: dict) -> dict:
+        """ids -> keys on the reduced WIRE results: deserialize each
+        call's JSON form into its result object, run the shared
+        translate_result_keys pass plus the single-node /query parity
+        bits (column keys on Row results, row keys from keyed Rows —
+        the ID-space workers can't attach either), re-serialize."""
+        from pilosa_tpu.api import serialize_result
+        from pilosa_tpu.executor.results import RowResult
+        translated = []
+        for call, wire in zip(q.calls, out["results"]):
+            res = deserialize_result(call, wire, idx.width)
+            res = translate_result_keys(idx, call, res)
+            if isinstance(res, RowResult):
+                if idx.keys and idx.column_translator is not None \
+                        and not getattr(res, "is_row_ids", False):
+                    res.keys = idx.column_translator.translate_ids(
+                        res.columns())
+            elif call.name == "Rows" and isinstance(res, list):
+                f = idx.field(call.arg("_field") or "")
+                if f is not None and f.options.keys \
+                        and f.row_translator is not None:
+                    keys = f.row_translator.translate_ids(
+                        [int(r) for r in res])
+                    # keyless ids (raw-id imports) fall back to the
+                    # id, matching the single-node _execute_rows
+                    res = [k if k is not None else r
+                           for k, r in zip(keys, res)]
+            translated.append(serialize_result(res))
+        return {"results": translated}

@@ -22,7 +22,6 @@ cross-chunk accumulation happens host-side in exact ints.
 
 from __future__ import annotations
 
-import itertools
 from fractions import Fraction
 
 import numpy as np
@@ -280,16 +279,22 @@ class AdvancedOps:
                 distinct_inner = (dc.children[0] if dc.children
                                   else None)
 
-        combos = list(itertools.product(*[range(len(rl))
-                                          for rl in row_lists]))
+        # combo enumeration: the full cartesian product as one (C, nf)
+        # index matrix in product order — the same matrix maps 1:1
+        # onto the one-pass engine's dense group-code space (each
+        # column is a digit, stacked.py/_combo_codes composes the
+        # power-of-two strides), so no per-combo Python exists on any
+        # path between here and the histogram gather.
+        combos = np.indices([len(rl) for rl in row_lists]) \
+            .reshape(len(row_lists), -1).T.astype(np.int64)
         shard_list = self._shard_list(idx, shards)
 
         # previous= paging (executor.go:8617 groupByIterator seek):
         # resume strictly after the given group, in product order —
         # resolved BEFORE any computation so a paged query evaluates
-        # only the requested tail of the combo space.
+        # only the requested tail of the combo space.  Vectorized
+        # lexicographic compare of the id tuples.
         previous = call.arg("previous")
-        start_ci = 0
         if previous is not None:
             if len(previous) != len(fields):
                 raise self._err(
@@ -306,15 +311,15 @@ class AdvancedOps:
                         raise self._err(f"previous= key not found: {p!r}")
                     p = found[p]
                 prev_ids.append(int(p))
-            prev_combo = tuple(prev_ids)
-            for ci, combo in enumerate(combos):
-                ids = tuple(rl[gi] for rl, gi in zip(row_lists, combo))
-                if ids > prev_combo:
-                    start_ci = ci
-                    break
-            else:
+            gt = np.zeros(len(combos), dtype=bool)
+            eq = np.ones(len(combos), dtype=bool)
+            for fi, (rl, pv) in enumerate(zip(row_lists, prev_ids)):
+                ids = np.asarray(rl, dtype=np.int64)[combos[:, fi]]
+                gt |= eq & (ids > pv)
+                eq &= ids == pv
+            if not gt.any():
                 return []
-        combos = combos[start_ci:]
+            combos = combos[int(np.argmax(gt)):]
 
         counts = agg_nn = agg_pos = agg_neg = None
         if getattr(self, "use_stacked", False) and distinct_field is None:

@@ -154,16 +154,135 @@ _NARY_OPS = {
 # dispatch per pad/transpose around the pallas_call)
 _GB_KERNEL_JIT: dict = {}
 
+# one-pass group-code GroupBy bounds: the dense code space is
+# 2^sum(ceil(log2 R_f)) — the host/XLA histogram tolerates up to 2^20
+# codes (a few MB of accumulator), the Pallas kernel's one-hot lane
+# axis and unrolled payload stay within VMEM/compile budgets below
+# 4096 codes x depth 16
+_ONEPASS_MAX_CODES = 1 << 20
+_ONEPASS_KERNEL_MAX_CODES = 4096
+_ONEPASS_KERNEL_MAX_DEPTH = 16
+
+
+def _code_space(fields_rows):
+    """Power-of-two digit layout of the dense group-code space:
+    returns (bits_per_field, shift_per_field, n_codes).  Field f's
+    digit (its row-list index) occupies bits [shift_f, shift_f+bits_f)
+    of the code; codes with a digit >= R_f simply never occur."""
+    bits = [bm.digit_bits(len(rl)) for _, rl in fields_rows]
+    shifts, acc = [], 0
+    for b in bits:
+        shifts.append(acc)
+        acc += b
+    return bits, shifts, 1 << acc
+
+
+def _combo_codes(shifts, combos_arr: np.ndarray) -> np.ndarray:
+    """Map combo index tuples (C, nf) -> dense group codes (C,)."""
+    codes = np.zeros(combos_arr.shape[0], dtype=np.int64)
+    for fi, sh in enumerate(shifts):
+        codes |= combos_arr[:, fi].astype(np.int64) << sh
+    return codes
+
+
+def _onepass_use_kernel(n_codes: int, depth: int) -> bool:
+    """Pallas groupby_onehot vs the XLA scatter reference for the
+    one-pass device program (CPU always interprets, so only TPU
+    backends route through the kernel)."""
+    return (jax.default_backend() == "tpu"
+            and n_codes <= _ONEPASS_KERNEL_MAX_CODES
+            and depth <= _ONEPASS_KERNEL_MAX_DEPTH)
+
+
+def _onepass_unpack(flat, n_codes: int, depth: int, has_planes: bool):
+    """Split the one-pass paths' single flat device fetch back into
+    (counts, nn, pos, neg) int64 over the dense code space."""
+    flat = np.asarray(flat, dtype=np.int64)
+    if not has_planes:
+        return flat[:n_codes], None, None, None
+    g = n_codes
+    counts, nn = flat[:g], flat[g:2 * g]
+    pos = flat[2 * g:2 * g + g * depth].reshape(g, depth)
+    neg = flat[2 * g + g * depth:].reshape(g, depth)
+    return counts, nn, pos, neg
+
+
+def _groupby_onepass_jit(use_kernel: bool, has_planes: bool,
+                         has_filter: bool, signed: bool, n_codes: int):
+    """Single-device jitted one-pass program: group-code stack in,
+    ONE flat histogram array out (one fetch round trip)."""
+    key = ("onepass", use_kernel, has_planes, has_filter, signed,
+           n_codes)
+    fn = _GB_KERNEL_JIT.get(key)
+    if fn is not None:
+        return fn
+
+    def run(cg, filt, planes):
+        cp, valid = cg[:, :-1], cg[:, -1]
+        if has_filter:
+            valid = jnp.bitwise_and(valid, filt)
+        gb = (kernels.groupby_onehot if use_kernel
+              else kernels.groupby_codes_xla)
+        c, n, p, g = gb(cp, valid, planes, n_codes, signed)
+        if not has_planes:
+            return c
+        return jnp.concatenate([c, n, p.ravel(), g.ravel()])
+
+    fn = jax.jit(run)
+    _GB_KERNEL_JIT[key] = fn
+    return fn
+
+
+def _groupby_onepass_shard_map(mesh, use_kernel: bool, has_planes: bool,
+                               has_filter: bool, signed: bool,
+                               n_codes: int):
+    """Mesh one-pass wrapper: every device histograms its local shard
+    slice of the flat-placed group-code stack, partial (K, G) tables
+    psum over the whole mesh — the histogram is combo-count-free, so
+    the collective payload is O(G), not O(C*S)."""
+    from jax.sharding import PartitionSpec as P
+
+    from pilosa_tpu.parallel.mesh import shard_map_nocheck
+
+    key = ("onepass_mesh", id(mesh), use_kernel, has_planes,
+           has_filter, signed, n_codes)
+    fn = _GB_KERNEL_JIT.get(key)
+    if fn is not None:
+        return fn
+    axes = ("rows", "shards")
+    in_specs = [P(axes, None, None)]
+    if has_filter:
+        in_specs.append(P(axes, None))
+    if has_planes:
+        in_specs.append(P(axes, None, None))
+
+    def body(cg, *rest):
+        filt = rest[0] if has_filter else None
+        planes = rest[-1] if has_planes else None
+        cp, valid = cg[:, :-1], cg[:, -1]
+        if filt is not None:
+            valid = jnp.bitwise_and(valid, filt)
+        gb = (kernels.groupby_onehot if use_kernel
+              else kernels.groupby_codes_xla)
+        c, n, p, g = gb(cp, valid, planes, n_codes, signed)
+        flat = c if not has_planes else jnp.concatenate(
+            [c, n, p.ravel(), g.ravel()])
+        return jax.lax.psum(flat, axes)
+
+    fn = jax.jit(shard_map_nocheck(
+        body, mesh=mesh, in_specs=tuple(in_specs), out_specs=P(None)))
+    _GB_KERNEL_JIT[key] = fn
+    return fn
+
 
 def _groupby_kernel_shard_map(mesh, nf: int, has_planes: bool,
                               signed: bool):
     """shard_map wrapper: every device runs the fused kernel on its
     local shard slice, partial results psum over the whole mesh —
     the kernel analog of the stacked engine's in-program reduce."""
-    from functools import partial
-
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from pilosa_tpu.parallel.mesh import shard_map_nocheck
 
     key = (id(mesh), nf, has_planes, signed)
     fn = _GB_KERNEL_JIT.get(key)
@@ -187,9 +306,8 @@ def _groupby_kernel_shard_map(mesh, nf: int, has_planes: bool,
                 list(stacks), sel, None, signed=signed)
             return jax.lax.psum(c, axes)
 
-    run = jax.jit(partial(
-        shard_map, mesh=mesh, in_specs=in_specs,
-        out_specs=P(None), check_vma=False)(body))
+    run = jax.jit(shard_map_nocheck(
+        body, mesh=mesh, in_specs=in_specs, out_specs=P(None)))
     _GB_KERNEL_JIT[key] = run
     return run
 
@@ -711,6 +829,14 @@ class StackedEngine:
         # commit); jit transfers them at call time.  Used by harnesses
         # that want the compiled program without touching a device.
         self.host_only = False
+        # (field, rows, shards) -> (fragment versions, bool): whether
+        # the row set is pairwise disjoint in the DATA — the gate for
+        # the one-pass group-code GroupBy (a column in two rows of one
+        # field belongs to two combos, which a per-column digit cannot
+        # express).  Version-guarded like the tile stacks; bounded
+        # FIFO so varied GroupBy row sets on a long-lived server
+        # can't grow it without limit (keys carry whole row tuples).
+        self._disjoint_cache: OrderedDict = OrderedDict()
 
     # -- mesh / placement ----------------------------------------------
 
@@ -860,6 +986,266 @@ class StackedEngine:
             self._run(("row_counts", rows_i, tree, red), b), dtype=np.int64)
         return out if red else out.sum(axis=1)
 
+    # -- one-pass group-code GroupBy ------------------------------------
+    # The histogram path reads every stack word and every BSI plane
+    # word exactly ONCE regardless of combo count (O(S*W) traffic vs
+    # the per-combo kernels' O(C*S*W)): columns decode to a dense
+    # group code composed from packed per-field digit planes, and
+    # counts + sign-split plane partials accumulate into a (K, G)
+    # table (MXU matmuls on TPU, the native C histogram on host, the
+    # XLA scatter reference elsewhere).  Requires each field's rows to
+    # be DISJOINT in the data (mutex/bool always are; set fields are
+    # checked and cached); overlapping rows fall back to the per-combo
+    # paths, as do sparse combo selections where C is small enough
+    # that per-combo work wins (paged tails, tiny products).
+
+    def _rows_disjoint(self, idx, f, row_ids, skey: tuple) -> bool:
+        """True iff no column is set in two of `row_ids` of f, checked
+        against the data (sum of per-row popcounts == popcount of the
+        union, per fragment) and cached by fragment versions."""
+        from pilosa_tpu.models.schema import FieldType
+        if f.options.type in (FieldType.MUTEX, FieldType.BOOL):
+            return True
+        row_key = tuple(int(r) for r in row_ids)
+        if len(set(row_key)) != len(row_key):
+            return False  # a duplicated row belongs to two combos
+        key = (idx.name, f.name, row_key, skey)
+        frags = self._frags(idx, f, VIEW_STANDARD, list(skey))
+        versions = self._versions(frags)
+        ent = self._disjoint_cache.get(key)
+        if ent is not None and ent[0] == versions:
+            return ent[1]
+        ok = True
+        for fr in frags:
+            if fr is None:
+                continue
+            acc = None
+            total = 0
+            for r in row_key:
+                wds = fr.row_words(r)
+                total += int(np.bitwise_count(wds).sum())
+                acc = wds.astype(np.uint32) if acc is None else acc | wds
+            if acc is not None and total != int(
+                    np.bitwise_count(acc).sum()):
+                ok = False
+                break
+        self._disjoint_cache[key] = (versions, ok)
+        while len(self._disjoint_cache) > 4096:
+            self._disjoint_cache.popitem(last=False)
+        return ok
+
+    def groupcode_stack(self, idx, fields_rows, skey: tuple,
+                        flat: bool = False, as_np: bool = False):
+        """(S, CB+1, W) cached group-code stack: CB packed code
+        bit-planes (each field's digit planes, stride-concatenated in
+        _code_space layout) plus the VALID plane last (AND of the
+        field unions — the columns that belong to some combo).  Built
+        host-side from fragment rows in one pass; placed like any
+        other leaf (flat=True: shard axis over ALL mesh devices for
+        the shard_map body; as_np=True: raw numpy for the host
+        histogram)."""
+        shards = list(skey)
+        fkey = tuple((f.name, tuple(int(r) for r in rl))
+                     for f, rl in fields_rows)
+        key = ("groupcodes", idx.name, fkey, skey, id(self.mesh),
+               flat, as_np)
+        per_field = [self._frags(idx, f, VIEW_STANDARD, shards)
+                     for f, _ in fields_rows]
+        versions = tuple(self._versions(fr) for fr in per_field)
+        bits, shifts, _n_codes = _code_space(fields_rows)
+        cb = sum(bits)
+
+        def build():
+            w = idx.width // 32
+            out = np.zeros((len(shards), cb + 1, w), dtype=np.uint32)
+            out[:, cb] = 0xFFFFFFFF
+            for (f, rl), frags, sh in zip(fields_rows, per_field,
+                                          shifts):
+                union = np.zeros((len(shards), w), np.uint32)
+                for si, fr in enumerate(frags):
+                    if fr is None:
+                        continue
+                    for di, r in enumerate(rl):
+                        wds = fr.row_words(int(r))
+                        union[si] |= wds
+                        b = 0
+                        while di >> b:
+                            if (di >> b) & 1:
+                                out[si, sh + b] |= wds
+                            b += 1
+                out[:, cb] &= union
+            if as_np or self.host_only:
+                return out
+            if self.mesh is None:
+                return jnp.asarray(out)
+            from pilosa_tpu.parallel.mesh import place_flat, place_shards
+            if flat:
+                return place_flat(self.mesh, out, shard_axis=0)
+            return place_shards(self.mesh, out, batch_axes=1)
+
+        return self.cache.get(key, versions, build)
+
+    def plane_stack_np(self, idx, field, skey: tuple):
+        """Host numpy twin of plane_stack for the native histogram
+        (no device round trip on CPU backends)."""
+        shards = list(skey)
+        depth = field.bit_depth
+        key = ("planes_np", idx.name, field.name, depth, skey)
+        frags = self._frags(idx, field, field.bsi_view, shards)
+        versions = self._versions(frags)
+
+        def build():
+            out = np.zeros((len(shards), 2 + depth, idx.width // 32),
+                           dtype=np.uint32)
+            for i, fr in enumerate(frags):
+                if fr is not None:
+                    for r in range(2 + depth):
+                        out[i, r] = fr.row_words(r)
+            return out
+
+        return self.cache.get(key, versions, build)
+
+    def _groupby_onepass_ok(self, idx, fields_rows, n_combos: int,
+                            depth: int, has_agg: bool,
+                            skey: tuple) -> bool:
+        """Gate + cost model for the one-pass histogram.
+        PILOSA_TPU_GROUPBY_ONEPASS=0 disables, =1 forces (still
+        requires disjoint rows — correctness, not cost)."""
+        import os
+        flag = os.environ.get("PILOSA_TPU_GROUPBY_ONEPASS", "")
+        if flag == "0":
+            return False
+        bits, _shifts, n_codes = _code_space(fields_rows)
+        if n_codes > _ONEPASS_MAX_CODES:
+            return False
+        # device paths accumulate the histogram in int32 in-program;
+        # the host path sums in int64 and has no shard bound
+        host = self.host_only or (self._n_total_devices() == 1
+                                  and jax.default_backend() != "tpu")
+        if not host and len(skey) > _REDUCE_MAX_SHARDS:
+            return False
+        if not all(self._rows_disjoint(idx, f, rl, skey)
+                   for f, rl in fields_rows):
+            return False
+        if flag == "1":
+            return True
+        # cost in packed-word ops per (shard, word): per-combo pays
+        # the full gather + popcount chain per combo; one-pass reads
+        # each stream once but pays a ~4x column-domain factor for
+        # the unpack/histogram of each payload row.  Sparse combo
+        # selections (paged tails, tiny products) stay per-combo.
+        agg_percombo = (2 + 2 * depth) if has_agg else 0
+        agg_onepass = (2 + depth) if has_agg else 0
+        cost_percombo = n_combos * (len(fields_rows) + 1 + agg_percombo)
+        cost_onepass = (sum(len(rl) for _, rl in fields_rows)
+                        + 4 * (sum(bits) + 1 + agg_onepass))
+        return cost_onepass < cost_percombo
+
+    def _groupby_onepass_path(self, idx, fields_rows, agg_field, skey,
+                              combos, depth: int, signed: bool,
+                              filter_call, pre):
+        """Run the one-pass histogram and gather the requested combos
+        out of the dense code space.  Returns the same (counts, agg)
+        shape as the per-combo paths — bit-exact partials included."""
+        from pilosa_tpu.obs.metrics import GROUPBY_ONEPASS
+        GROUPBY_ONEPASS.inc()
+        bits, shifts, n_codes = _code_space(fields_rows)
+        combos_arr = np.asarray(combos, dtype=np.int64).reshape(
+            len(combos), len(fields_rows))
+        codes = _combo_codes(shifts, combos_arr)
+        has_planes = agg_field is not None
+        filt = None
+        if filter_call is not None:
+            b0 = PlanBuilder(self, idx, list(skey), pre)
+            tree0 = b0.build(filter_call)
+            if tree0 == ("zeros",):
+                return _zero_groupby_result(len(combos), depth,
+                                            agg_field)
+            filt = self._run(("words", tree0), b0)
+        multi = self._n_total_devices() > 1
+        host = self.host_only or (not multi
+                                  and jax.default_backend() != "tpu")
+        if host:
+            counts, nn, pos, neg = self._groupby_onepass_host(
+                idx, fields_rows, agg_field, skey, n_codes, depth,
+                signed, filt)
+        elif multi:
+            cg = self.groupcode_stack(idx, fields_rows, skey,
+                                      flat=True)
+            planes = (self.plane_stack_flat(idx, agg_field, skey)
+                      if has_planes else None)
+            fn = _groupby_onepass_shard_map(
+                self.mesh, _onepass_use_kernel(n_codes, depth),
+                has_planes, filt is not None, signed, n_codes)
+            args = [cg]
+            if filt is not None:
+                # the filter tree ran under the 1D shard placement;
+                # re-pad it host-side to the flat layout's multiple
+                f_np = np.asarray(filt)[:len(skey)]
+                pad = cg.shape[0] - f_np.shape[0]
+                if pad:
+                    f_np = np.pad(f_np, ((0, pad), (0, 0)))
+                args.append(f_np)
+            if has_planes:
+                args.append(planes)
+            out = fn(*args)
+            counts, nn, pos, neg = _onepass_unpack(
+                out, n_codes, depth, has_planes)
+        else:
+            cg = self.groupcode_stack(idx, fields_rows, skey)
+            planes = (self.plane_stack(idx, agg_field, skey)
+                      if has_planes else None)
+            fn = _groupby_onepass_jit(
+                _onepass_use_kernel(n_codes, depth), has_planes,
+                filt is not None, signed, n_codes)
+            out = fn(cg, filt, planes)
+            counts, nn, pos, neg = _onepass_unpack(
+                out, n_codes, depth, has_planes)
+        sel_counts = counts[codes]
+        if not has_planes:
+            return sel_counts, None
+        return sel_counts, (nn[codes], pos[codes], neg[codes])
+
+    def _groupby_onepass_host(self, idx, fields_rows, agg_field, skey,
+                              n_codes: int, depth: int, signed: bool,
+                              filt):
+        """Host histogram: the native C kernel (numpy bincount without
+        a toolchain) per shard, shards fanned over a thread pool (the
+        ctypes call releases the GIL)."""
+        import os
+
+        from pilosa_tpu.storage import native_ingest as ni
+        from pilosa_tpu.taskpool import Pool
+
+        cg = np.asarray(self.groupcode_stack(idx, fields_rows, skey,
+                                             as_np=True))
+        planes = (np.asarray(self.plane_stack_np(idx, agg_field, skey))
+                  if agg_field is not None else None)
+        filt_np = (np.asarray(filt)[:len(skey)]
+                   if filt is not None else None)
+
+        def one(_pool, si):
+            c = np.zeros(n_codes, np.int64)
+            n_ = np.zeros(n_codes, np.int64)
+            p_ = np.zeros((n_codes, depth), np.int64)
+            g_ = np.zeros((n_codes, depth), np.int64)
+            valid = cg[si, -1]
+            if filt_np is not None:
+                valid = valid & filt_np[si]
+            ni.groupcode_hist(
+                cg[si, :-1], valid,
+                planes[si] if planes is not None else None,
+                n_codes, signed, c, n_, p_, g_)
+            return c, n_, p_, g_
+
+        size = max(1, min(8, os.cpu_count() or 1, cg.shape[0]))
+        parts = Pool(size=size).map(one, range(cg.shape[0]))
+        counts = sum(p[0] for p in parts)
+        if agg_field is None:
+            return counts, None, None, None
+        return (counts, sum(p[1] for p in parts),
+                sum(p[2] for p in parts), sum(p[3] for p in parts))
+
     # fused GroupBy kernel (ops/kernels.groupby_sum): default on a
     # single real TPU device — measured 4x faster than the XLA scan
     # at design scale (BENCH_TPU_NOTES r03).  Filter trees, big combo
@@ -977,6 +1363,28 @@ class StackedEngine:
         """
         skey = tuple(shards)
         n_combos = len(combos)
+        depth = agg_field.bit_depth if agg_field is not None else 0
+        # when no fragment holds any sign-plane bit (row_ids is cached
+        # per fragment version, so this is a dict sweep, not a scan),
+        # all paths skip the sign-split and negative popcounts
+        # entirely.  Checked against the DATA, not options.min — value
+        # writes are not range-enforced, so a declared min>=0 field
+        # can still hold negatives.
+        signed = False
+        if agg_field is not None:
+            frags = self._frags(idx, agg_field, agg_field.bsi_view,
+                                list(skey))
+            signed = any(fr is not None and 1 in fr.row_ids
+                         for fr in frags)
+        # one-pass group-code histogram: combo-count-independent
+        # traffic, no (R, S, W) gather at all (the group-code stack is
+        # (S, CB+1, W) with CB ~ log2 of the combo space)
+        if n_combos and self._groupby_onepass_ok(
+                idx, fields_rows, n_combos, depth,
+                agg_field is not None, skey):
+            return self._groupby_onepass_path(
+                idx, fields_rows, agg_field, skey, combos, depth,
+                signed, filter_call, pre)
         kernel = self._groupby_kernel_ok(
             n_combos, len(skey), has_filter=filter_call is not None)
         # memory budget: the XLA path gathers (R, S, W) stacks for
@@ -992,19 +1400,6 @@ class StackedEngine:
         if est > (1 << 31):
             raise Unstackable(
                 f"groupby row stacks ~{est >> 20} MiB exceed budget")
-        depth = agg_field.bit_depth if agg_field is not None else 0
-        # when no fragment holds any sign-plane bit (row_ids is cached
-        # per fragment version, so this is a dict sweep, not a scan),
-        # both paths skip the sign-split and negative popcounts
-        # entirely.  Checked against the DATA, not options.min — value
-        # writes are not range-enforced, so a declared min>=0 field
-        # can still hold negatives.
-        signed = False
-        if agg_field is not None:
-            frags = self._frags(idx, agg_field, agg_field.bsi_view,
-                                list(skey))
-            signed = any(fr is not None and 1 in fr.row_ids
-                         for fr in frags)
         if kernel:
             filt = None
             if filter_call is not None:
@@ -1180,7 +1575,7 @@ class StackedEngine:
                         row_ids, skey: tuple):
         """(R, S, W) with S sharded over ALL mesh devices, R
         replicated (the kernel gathers rows locally by sel)."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from pilosa_tpu.parallel.mesh import place_flat
         shards = list(skey)
         row_key = tuple(int(r) for r in row_ids)
         key = ("rowchunk_flat", idx.name, field.name, views, row_key,
@@ -1191,21 +1586,13 @@ class StackedEngine:
         def build():
             out = self._rows_stack_np(idx, per_view, row_key,
                                       len(shards))
-            n = self._n_total_devices()
-            s = out.shape[1]
-            if s % n:
-                out = np.concatenate(
-                    [out, np.zeros(
-                        (out.shape[0], n - s % n, out.shape[2]),
-                        dtype=out.dtype)], axis=1)
-            return jax.device_put(out, NamedSharding(
-                self.mesh, P(None, ("rows", "shards"), None)))
+            return place_flat(self.mesh, out, shard_axis=1)
 
         return self.cache.get(key, versions, build)
 
     def plane_stack_flat(self, idx, field, skey: tuple):
         """(S, P, W) planes with S sharded over ALL mesh devices."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from pilosa_tpu.parallel.mesh import place_flat
         shards = list(skey)
         depth = field.bit_depth
         key = ("planes_flat", idx.name, field.name, depth, skey,
@@ -1221,13 +1608,6 @@ class StackedEngine:
                 if fr is not None:
                     for r in range(2 + depth):
                         out[i, r] = fr.row_words(r)
-            n = self._n_total_devices()
-            if out.shape[0] % n:
-                pad = n - out.shape[0] % n
-                out = np.concatenate(
-                    [out, np.zeros((pad,) + out.shape[1:],
-                                   dtype=out.dtype)])
-            return jax.device_put(out, NamedSharding(
-                self.mesh, P(("rows", "shards"), None, None)))
+            return place_flat(self.mesh, out, shard_axis=0)
 
         return self.cache.get(key, versions, build)

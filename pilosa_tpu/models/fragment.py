@@ -414,11 +414,17 @@ class Fragment:
             return
         # uint64 view so INT64_MIN's magnitude (2^63) is seen — np.abs
         # is the identity there and would let an out-of-depth value
-        # reach the native kernel's out-of-bounds plane write
+        # reach the native kernel's out-of-bounds plane write.  An
+        # unconditional raise, not an assert: this guard must survive
+        # `python -O`, and the native kernel's own depth bound is a
+        # last-resort backstop, not an error report.
         mags = np.where(vals < 0, np.negative(vals),
                         vals).view(np.uint64)
-        assert int(mags.max()).bit_length() <= depth, \
-            "value magnitude exceeds bit depth"
+        max_bits = int(mags.max()).bit_length()
+        if max_bits > depth:
+            raise ValueError(
+                f"value magnitude needs {max_bits} bits, fragment "
+                f"depth is {depth}")
         from pilosa_tpu.storage import native_ingest as ni
         scratch = np.zeros((2 + depth, self.width // 32), np.uint32)
         ni.bsi_fill(scratch, cols, vals, depth)

@@ -12,6 +12,7 @@ coarse units exist (e.g. quantum "Y"), views overcover the range edges
 from __future__ import annotations
 
 import datetime as dt
+import re as _re
 
 from pilosa_tpu.models.schema import TimeQuantum
 
@@ -219,7 +220,15 @@ def parse_time(v) -> dt.datetime:
     if "T" in s and (s.endswith("Z") or "+" in s[10:]
                      or "-" in s[10:] or "." in s):
         try:
-            d = dt.datetime.fromisoformat(s.replace("Z", "+00:00"))
+            iso = s.replace("Z", "+00:00")
+            # pre-3.11 fromisoformat demands exactly 3 or 6
+            # fractional digits; normalize to 6 (sub-microsecond
+            # digits carry via parse_time_ns's NsDatetime wrapper)
+            iso = _re.sub(
+                r"\.(\d+)",
+                lambda m: "." + (m.group(1) + "000000")[:6], iso,
+                count=1)
+            d = dt.datetime.fromisoformat(iso)
             if d.tzinfo is not None:
                 d = d.astimezone(dt.timezone.utc).replace(tzinfo=None)
             return d

@@ -220,3 +220,6 @@ STACKED_QUERIES = registry.counter(
 GROUPBY_KERNEL = registry.counter(
     "pilosa_groupby_kernel_total",
     "GroupBy queries served by the fused Pallas kernel path")
+GROUPBY_ONEPASS = registry.counter(
+    "pilosa_groupby_onepass_total",
+    "GroupBy queries served by the one-pass group-code histogram")

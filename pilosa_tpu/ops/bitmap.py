@@ -192,5 +192,84 @@ def union_rows(rows):
 
 
 def intersect_rows(rows):
-    """AND-fold over axis 0."""
-    return jnp.bitwise_and.reduce(rows, axis=0)
+    """AND-fold over axis 0.
+
+    Explicit fold: jnp.bitwise_and.reduce seeds its reduction with
+    np.array(-1, dtype) — an OverflowError on unsigned dtypes under
+    numpy 2's strict conversion rules.
+    """
+    acc = rows[0]
+    for i in range(1, rows.shape[0]):
+        acc = jnp.bitwise_and(acc, rows[i])
+    return acc
+
+
+# Group-code planes (one-pass GroupBy) --------------------------------------
+#
+# A stack of R DISJOINT packed rows (no column in two rows) is exactly a
+# base-R digit per column; these helpers re-encode that digit as
+# ceil(log2 R) packed BIT-PLANES so the one-pass GroupBy histogram can
+# compose a dense group code per column without ever unpacking the row
+# stacks.  Work entirely with | and & so the same code serves numpy host
+# arrays and jnp device arrays.
+
+def digit_bits(n_rows: int) -> int:
+    """Bit-planes needed to encode a digit in [0, n_rows)."""
+    return max(int(n_rows) - 1, 0).bit_length()
+
+
+def digit_planes(rows):
+    """Disjoint row stack (R, ..., W) -> (digit_bits(R), ..., W) packed
+    digit planes: plane b = OR of rows whose index has bit b set, so a
+    column in row r carries the bits of r.  Caller guarantees
+    disjointness (overlap would OR two digits together)."""
+    import numpy as _np
+    r = rows.shape[0]
+    nbits = digit_bits(r)
+    xp = _np if isinstance(rows, _np.ndarray) else jnp
+    planes = []
+    for b in range(nbits):
+        acc = None
+        for i in range(r):
+            if (i >> b) & 1:
+                acc = rows[i] if acc is None else acc | rows[i]
+        planes.append(acc)
+    if not planes:
+        return xp.zeros((0,) + rows.shape[1:], dtype=rows.dtype)
+    return xp.stack(planes)
+
+
+def unpack_bits(words):
+    """Device bit-unpack: (..., W) uint32 -> (..., W*32) int32 0/1 per
+    column (column c = word c>>5, bit c&31)."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (words[..., None] >> shifts) & jnp.uint32(1)
+    return bits.reshape(*words.shape[:-1],
+                        words.shape[-1] * 32).astype(jnp.int32)
+
+
+def code_from_planes(planes):
+    """Bit-unpack + weighted recombine: (CB, ..., W) packed planes ->
+    (..., W*32) int32 per-column codes (plane b contributes bit b).
+    CB = 0 yields all-zero codes."""
+    cb = planes.shape[0]
+    if cb == 0:
+        return jnp.zeros(planes.shape[1:-1] + (planes.shape[-1] * 32,),
+                         dtype=jnp.int32)
+    code = unpack_bits(planes[0])
+    for b in range(1, cb):
+        code = code | (unpack_bits(planes[b]) << b)
+    return code
+
+
+def code_from_planes_np(planes: np.ndarray) -> np.ndarray:
+    """Host twin of code_from_planes (numpy, same layout)."""
+    planes = np.asarray(planes, dtype=np.uint32)
+    cb = planes.shape[0]
+    out_shape = planes.shape[1:-1] + (planes.shape[-1] * 32,)
+    code = np.zeros(out_shape, dtype=np.int32)
+    shifts = np.arange(32, dtype=np.uint32)
+    for b in range(cb):
+        bits = ((planes[b][..., None] >> shifts) & 1).astype(np.int32)
+        code |= bits.reshape(out_shape) << b
+    return code

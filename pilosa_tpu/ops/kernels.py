@@ -50,6 +50,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from pilosa_tpu.ops import bitmap as bm
+
 _LANES = 128          # TPU lane width (last-dim tile)
 _ROW_BLOCK = 8        # rows per grid step in batched kernels
 _WORD_BLOCK = 4096    # words per grid step in plane-stack kernels
@@ -485,6 +487,187 @@ def groupby_sum(stacks, sel, planes=None, signed=True):
     return counts, nn, pos, neg
 
 
+# ---------------------------------------------------------------------------
+# one-pass GroupBy: combo-independent group-code histogram
+# ---------------------------------------------------------------------------
+#
+# The fused per-combo kernel above re-reads every referenced stack row
+# and re-popcounts every BSI plane once PER COMBO — O(C*S*W) traffic.
+# The histogram formulation reads every word exactly once regardless of
+# combo count: per column, compose a dense group code from packed digit
+# planes (ops.bitmap.digit_planes — one digit per disjoint GroupBy
+# field), then accumulate counts and BSI sign-split plane partials into
+# a (K, G) table indexed by code.  groupby_onehot does the
+# accumulation with MXU matmuls (one-hot.T @ payload-bits);
+# groupby_codes_xla is the scatter-add XLA reference the kernel is
+# cross-checked against (and the mesh shard_map body).
+#
+# Output layout (shared): rows [counts, nn, pos_plane_0..d-1,
+# neg_plane_0..d-1] — identical per-plane sign-split partials to
+# groupby_sum / bsi.sum_counts, so host combination (exact Python-int
+# shift-add) is byte-for-byte the same across all GroupBy paths.
+
+
+def _gc_payload_rows(va, ex, sg, mag_bits, depth: int, signed: bool):
+    """Per-column 0/1 payload rows [count, nn, pos*depth, neg*depth]
+    from unpacked bit vectors (any common shape)."""
+    rows = [va]
+    if depth:
+        rows.append(ex)
+        posm = ex * (1 - sg) if signed else ex
+        for p in range(depth):
+            rows.append(mag_bits[p] * posm)
+        if signed:
+            negm = ex * sg
+            for p in range(depth):
+                rows.append(mag_bits[p] * negm)
+    return rows
+
+
+def groupby_codes_xla(code_planes, valid, planes=None, n_codes: int = 1,
+                      signed: bool = True):
+    """XLA reference for the one-pass GroupBy histogram.
+
+    code_planes: (S, CB, W) uint32 packed group-code bit-planes
+    (bitmap.digit_planes of each field, stride-concatenated);
+    valid: (S, W) uint32 mask of columns belonging to some combo
+    (AND of field unions, AND the filter); planes: (S, 2+depth, W)
+    BSI stack or None.  Returns (counts (G,), nn (G,), pos (G, depth),
+    neg (G, depth)) int32 over the FULL dense code space G = n_codes —
+    every input word is read exactly once, independent of combo count.
+    """
+    depth = 0 if planes is None else planes.shape[1] - 2
+    k = 1 if depth == 0 else 2 + (2 if signed else 1) * depth
+
+    def one_shard(acc, args):
+        cp, va_w = args[0], args[1]
+        pl_w = args[2] if depth else None
+        code = bm.code_from_planes(cp)                # (N,) int32
+        va = bm.unpack_bits(va_w)                     # (N,) 0/1
+        # invalid columns route to an overflow bucket sliced off below
+        seg = jnp.where(va == 1, code, n_codes)
+        ex = sg = None
+        mag = []
+        if depth:
+            ex = bm.unpack_bits(pl_w[0]) * va
+            sg = bm.unpack_bits(pl_w[1])
+            mag = [bm.unpack_bits(pl_w[2 + p]) for p in range(depth)]
+        rows = _gc_payload_rows(va, ex, sg, mag, depth, signed)
+        outs = [jnp.zeros(n_codes + 1, jnp.int32).at[seg].add(r)
+                for r in rows]
+        return acc + jnp.stack(outs)[:, :n_codes], None
+
+    init = jnp.zeros((k, n_codes), jnp.int32)
+    args = (code_planes, valid) + ((planes,) if depth else ())
+    acc, _ = jax.lax.scan(one_shard, init, args)
+    counts = acc[0]
+    if depth == 0:
+        return counts, None, None, None
+    nn = acc[1]
+    pos = acc[2:2 + depth].T                          # (G, depth)
+    neg = acc[2 + depth:].T if signed else jnp.zeros_like(pos)
+    return counts, nn, pos, neg
+
+
+def _gc_onehot_kernel(cb: int, depth: int, signed: bool, k: int,
+                      g_pad: int):
+    """Kernel body factory for groupby_onehot: per (shard, word-block)
+    grid step, decode the 32 bit positions of the block and accumulate
+    payload.T @ one-hot MXU matmuls into the VMEM-resident (K, G)
+    table.  Per-step partial sums are <= 32 * BW < 2^24 so the f32
+    MXU accumulator is exact; cross-step accumulation is int32."""
+
+    def kernel(cp_ref, va_ref, *refs):
+        pl_ref = refs[0] if depth else None
+        out_ref = refs[-1]
+        s, wi = pl.program_id(0), pl.program_id(1)
+
+        @pl.when((s == 0) & (wi == 0))
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        iota_g = jax.lax.broadcasted_iota(jnp.int32, (1, g_pad), 1)
+        acc = jnp.zeros_like(out_ref)
+        for j in range(32):
+            sh = jnp.uint32(j)
+            va = ((va_ref[0, :] >> sh) & 1).astype(jnp.int32)
+            code = jnp.zeros_like(va)
+            for b in range(cb):
+                code = code | (
+                    ((cp_ref[0, b, :] >> sh) & 1).astype(jnp.int32) << b)
+            ex = sg = None
+            mag = []
+            if depth:
+                ex = ((pl_ref[0, 0, :] >> sh) & 1).astype(jnp.int32) * va
+                sg = ((pl_ref[0, 1, :] >> sh) & 1).astype(jnp.int32)
+                mag = [((pl_ref[0, 2 + p, :] >> sh) & 1).astype(jnp.int32)
+                       for p in range(depth)]
+            rows = _gc_payload_rows(va, ex, sg, mag, depth, signed)
+            payload = jnp.stack(rows).astype(jnp.float32)      # (K, BW)
+            # invalid columns carry all-zero payload (every row has a
+            # `va` factor), so their arbitrary code contributes nothing
+            onehot = (code[:, None] == iota_g).astype(jnp.float32)
+            acc += jnp.dot(payload, onehot,
+                           preferred_element_type=jnp.float32
+                           ).astype(jnp.int32)
+        out_ref[...] += acc
+    return kernel
+
+
+def groupby_onehot(code_planes, valid, planes=None, n_codes: int = 1,
+                   signed: bool = True):
+    """One-pass GroupBy histogram with MXU accumulation.
+
+    Same contract as :func:`groupby_codes_xla` (bit-exact against it
+    and against groupby_sum over the same data — tests cross-check all
+    three).  Schedule: grid (S, W/BW) with NO combo axis — each stack
+    word, valid word, and plane word streams through VMEM exactly once
+    and the (K, G) histogram table stays VMEM-resident for the whole
+    grid, so HBM traffic is O(S*W) for ANY combo count.  The combo
+    dimension only exists inside a grid step as the one-hot lane axis
+    of a (K, BW) @ (BW, G) matmul — work the MXU does for free next to
+    the bandwidth-bound stream.
+    """
+    s_dim, cb, w_dim = code_planes.shape
+    if cb == 0:                        # all fields single-row: code 0
+        code_planes = jnp.zeros((s_dim, 1, w_dim), dtype=jnp.uint32)
+        cb = 1
+    depth = 0 if planes is None else planes.shape[1] - 2
+    k = 1 if depth == 0 else 2 + (2 if signed else 1) * depth
+    g_pad = max(-(-int(n_codes) // 128) * 128, 128)
+    # word block sized so the per-step (BW, G) one-hot stays ~2 MB f32
+    bw = min(w_dim, max(128, (1 << 19) // g_pad))
+    code_planes = _pad_axis(code_planes, 2, bw)
+    valid = _pad_axis(valid, 1, bw)
+    arrays = [code_planes, valid]
+    in_specs = [
+        pl.BlockSpec((1, cb, bw), lambda s, w: (s, 0, w)),
+        pl.BlockSpec((1, bw), lambda s, w: (s, w)),
+    ]
+    if depth:
+        planes = _pad_axis(planes, 2, bw)
+        arrays.append(planes)
+        in_specs.append(
+            pl.BlockSpec((1, 2 + depth, bw), lambda s, w: (s, 0, w)))
+    wpad = code_planes.shape[2]
+    out = pl.pallas_call(
+        _gc_onehot_kernel(cb, depth, signed, k, g_pad),
+        grid=(s_dim, wpad // bw),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((k, g_pad), lambda s, w: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((k, g_pad), jnp.int32),
+        interpret=_interpret(),
+    )(*arrays)
+    counts = out[0, :n_codes]
+    if depth == 0:
+        return counts, None, None, None
+    nn = out[1, :n_codes]
+    pos = out[2:2 + depth, :n_codes].T                 # (G, depth)
+    neg = (out[2 + depth:, :n_codes].T if signed
+           else jnp.zeros_like(pos))
+    return counts, nn, pos, neg
+
+
 def fused_query_counts(a, b, filt, rows):
     """Per-shard Count(Intersect) + TopK candidate counts.
 
@@ -503,5 +686,7 @@ __all__ = [
     "masked_popcount",
     "bsi_sum_counts",
     "groupby_sum",
+    "groupby_codes_xla",
+    "groupby_onehot",
     "fused_query_counts",
 ]

@@ -50,3 +50,42 @@ def place_shards(mesh: Mesh, tiles, batch_axes: int = 0):
             [tiles, np.zeros((pad,) + tiles.shape[1:], dtype=tiles.dtype)])
     sharding = NamedSharding(mesh, shard_spec(batch_axes))
     return jax.device_put(tiles, sharding)
+
+
+def shard_map_nocheck(body, mesh: Mesh, in_specs, out_specs):
+    """shard_map with replication checking off, across the JAX API
+    rename: new JAX exports jax.shard_map(check_vma=...), 0.4.x has
+    jax.experimental.shard_map.shard_map(check_rep=...)."""
+    try:
+        from jax import shard_map as sm
+        kwargs = {"check_vma": False}
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+        kwargs = {"check_rep": False}
+    return sm(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              **kwargs)
+
+
+def flat_spec(ndim: int, shard_axis: int = 0) -> P:
+    """PartitionSpec placing `shard_axis` over ALL mesh devices (both
+    mesh axes flattened) — the layout shard_map kernel bodies consume:
+    every device holds a contiguous slice of the shard axis and runs
+    the same per-shard program, partials psum over the whole mesh."""
+    return P(*([None] * shard_axis + [("rows", "shards")]
+               + [None] * (ndim - shard_axis - 1)))
+
+
+def place_flat(mesh: Mesh, tiles, shard_axis: int = 0):
+    """device_put with `shard_axis` zero-padded to a multiple of the
+    TOTAL device count and sharded over all of them (flat_spec).  Used
+    by the fused GroupBy kernel paths, where candidate rows replicate
+    and the shard axis is the only data-parallel axis."""
+    tiles = np.asarray(tiles)
+    n = int(mesh.devices.size)
+    s = tiles.shape[shard_axis]
+    if s % n:
+        widths = [(0, 0)] * tiles.ndim
+        widths[shard_axis] = (0, n - s % n)
+        tiles = np.pad(tiles, widths)
+    return jax.device_put(
+        tiles, NamedSharding(mesh, flat_spec(tiles.ndim, shard_axis)))

@@ -49,6 +49,10 @@ def _load():
                                           _I64, ct.c_int64]
             lib.pt_mutex_fill.argtypes = [_U32, _U32, ct.c_int64,
                                           _I64, _I64, ct.c_int64]
+            lib.pt_groupcode_hist.argtypes = [
+                _U32, ct.c_int64, _U32, ct.c_void_p, ct.c_int64,
+                ct.c_int64, ct.c_int64, ct.c_int64,
+                _I64, _I64, _I64, _I64]
             _lib = lib
         except Exception:
             _lib_failed = True  # no toolchain: numpy fallbacks
@@ -99,6 +103,51 @@ def bsi_fill(scratch: np.ndarray, cols: np.ndarray,
     for i in range(depth):
         sel = (mags >> np.uint64(i)) & np.uint64(1) == 1
         or_bits(scratch[2 + i], cols[sel])
+
+
+def groupcode_hist(code_planes: np.ndarray, valid: np.ndarray,
+                   bsi: np.ndarray | None, n_codes: int,
+                   signed: bool,
+                   counts: np.ndarray, nn: np.ndarray,
+                   pos: np.ndarray, neg: np.ndarray) -> None:
+    """One shard of the one-pass GroupBy histogram: accumulate counts
+    (n_codes,), nn (n_codes,) and sign-split per-plane partials
+    pos/neg (n_codes, depth) int64 in place.  code_planes (CB, W)
+    packed group-code bit-planes, valid (W,), bsi (2+depth, W) or
+    None.  Host twin of ops/kernels.groupby_onehot."""
+    code_planes = np.ascontiguousarray(code_planes, dtype=np.uint32)
+    valid = np.ascontiguousarray(valid, dtype=np.uint32)
+    depth = 0 if bsi is None else bsi.shape[0] - 2
+    lib = _load()
+    if lib is not None:
+        if bsi is not None:
+            bsi = np.ascontiguousarray(bsi, dtype=np.uint32)
+        lib.pt_groupcode_hist(
+            code_planes, code_planes.shape[0], valid,
+            None if bsi is None else bsi.ctypes.data, depth,
+            int(signed), valid.shape[0], int(n_codes),
+            counts, nn, pos, neg)
+        return
+    # numpy fallback: unpack + bincount per payload row
+    from pilosa_tpu.ops import bitmap as bmops
+    from pilosa_tpu.ops import bsi as bsi_ops
+    code = bmops.code_from_planes_np(code_planes)     # (W*32,)
+    va = bsi_ops.unpack_bits_np(valid)
+    counts += np.bincount(code[va], minlength=n_codes)[:n_codes]
+    if bsi is None:
+        return
+    ex = bsi_ops.unpack_bits_np(bsi[0]) & va
+    sg = bsi_ops.unpack_bits_np(bsi[1])
+    nn += np.bincount(code[ex], minlength=n_codes)[:n_codes]
+    posm = ex & ~sg if signed else ex
+    negm = ex & sg
+    for p in range(depth):
+        mb = bsi_ops.unpack_bits_np(bsi[2 + p])
+        pos[:, p] += np.bincount(code[mb & posm],
+                                 minlength=n_codes)[:n_codes]
+        if signed:
+            neg[:, p] += np.bincount(code[mb & negm],
+                                     minlength=n_codes)[:n_codes]
 
 
 def mutex_fill(written: np.ndarray, scratch: np.ndarray,

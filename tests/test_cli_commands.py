@@ -7,11 +7,20 @@ import http.client
 import json
 import os
 import signal
+import socket
 import subprocess
 import sys
 import time
 
 import pytest
+
+
+def _free_port() -> int:
+    """Probe a free TCP port instead of hardcoding one — a fixed port
+    races against parallel suites and anything already listening."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
 
 
 def _spawn(args, env_extra=None):
@@ -32,7 +41,7 @@ def _req(port, method, path, body=None, timeout=180):
 
 
 def test_server_command_serves_sql(tmp_path):
-    port = 10981
+    port = _free_port()
     p = _spawn(["server", "--data-dir", str(tmp_path),
                 "--port", str(port), "--grpc-port", "-1"])
     try:

@@ -284,7 +284,12 @@ def test_rebalance_under_load_no_data_loss(dax):
 
     def ingest():
         i = 0
-        while not stop.is_set() and i < 400:
+        deadline = time.time() + 30
+        # keep going past the stop signal until the load was REAL
+        # (>= 60 acks): a wall-clock window alone under-ingests on a
+        # contended box and fails the final load assertion flakily
+        while i < 400 and time.time() < deadline and \
+                (not stop.is_set() or len(acked) < 60):
             col = (i % 8) * SHARD + i  # spread over 8 shards
             try:
                 dax.queryer.import_bits("t", "f", [1], [col])
@@ -595,3 +600,60 @@ def test_dax_keyed_table_end_to_end(dax):
         "SELECT _id FROM kt WHERE a_string = 'str3'")["data"] == \
         [["three"]]
     assert q.sql("SELECT count(*) FROM kt")["data"] == [[3]]
+
+
+def test_dax_sql_bool_explicit_null_clears(dax):
+    """defs_bool select-all2 over the DAX front (ADVICE r05): an
+    explicit NULL in an INSERT tuple ships a clear for that (field,
+    column) to the owning worker — matching apply_record — instead of
+    being silently skipped, and NULL-only records still insert."""
+    q = dax.queryer
+    q.sql("CREATE TABLE singleboolfield (_id id, a_bool bool)")
+    q.sql("insert into singleboolfield (_id, a_bool) values "
+          "(1, true), (2, true), (3, false), (4, false), "
+          "(5, null), (6, null)")
+    out = q.sql("select * from singleboolfield")
+    assert out["data"] == [[1, True], [2, True], [3, False],
+                           [4, False], [5, None], [6, None]]
+    q.sql("insert into singleboolfield (_id, a_bool) values "
+          "(1, false), (2, null), (3, true), (4, null), "
+          "(5, false), (6, true)")
+    out = q.sql("select * from singleboolfield")
+    assert out["data"] == [[1, False], [2, None], [3, True],
+                           [4, None], [5, False], [6, True]]
+
+
+def test_dax_raw_pql_keyed_translation(dax):
+    """Raw keyed-shape PQL through Queryer.query routes via the
+    translate_call/translate_result pair (ADVICE r05): string row
+    values become ids before the ID-space fan-out, and result ids
+    come back with keys attached — it must not silently match
+    nothing."""
+    q = dax.queryer
+    q.sql("CREATE TABLE kt (_id id, tag stringset)")
+    q.sql("INSERT INTO kt (_id, tag) VALUES (1, ('a','b')), "
+          "(2, ('b'))")
+    assert q.query("kt", "Count(Row(tag='b'))")["results"] == [2]
+    assert q.query("kt", "Count(Row(tag='a'))")["results"] == [1]
+    # unknown key matches nothing (FindKeys semantics), not an error
+    assert q.query("kt", "Count(Row(tag='zzz'))")["results"] == [0]
+    pairs = q.query("kt", "TopN(tag, n=10)")["results"][0]
+    assert [(p["key"], p["count"]) for p in pairs] == \
+        [("b", 2), ("a", 1)]
+    # Rows on a keyed field returns keys (single-node parity)
+    assert q.query("kt", "Rows(tag)")["results"][0] == ["a", "b"]
+
+
+def test_dax_clear_op_replay_recovery(dax):
+    """The new "clear" write-log op replays like any write: kill the
+    owning worker after an explicit-NULL clear; the rebuilt worker
+    must come back with the clear applied, not the stale value."""
+    q = dax.queryer
+    q.sql("CREATE TABLE rb (_id id, b bool)")
+    q.sql("INSERT INTO rb (_id, b) VALUES (1, true)")
+    q.sql("INSERT INTO rb (_id, b) VALUES (1, null)")
+    owner_addr, _ = dax.controller.worker_for("rb", 0)
+    dax.kill_worker(owner_addr)
+    dax.controller.poll_once()
+    out = q.sql("select * from rb")
+    assert out["data"] == [[1, None]]

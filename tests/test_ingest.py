@@ -290,3 +290,57 @@ def test_multiprocess_sharded_ingest():
         assert got["results"][0] == want
     finally:
         srv.close()
+
+
+def test_import_values_int64_min_magnitude():
+    """INT64_MIN roundtrips through the bulk BSI import: its magnitude
+    2^63 only exists in uint64 (the native kernel's old signed
+    negation was UB there, and np.abs is the identity), and the plane
+    writes stay inside the declared depth."""
+    import numpy as np
+
+    from pilosa_tpu.models.fragment import Fragment
+    from pilosa_tpu.ops import bsi as bsi_ops
+
+    int64_min = -(1 << 63)
+    depth = 64
+    frag = Fragment("i", "v", "bsi", 0, width=1 << 12)
+    frag.import_values([5, 9], [int64_min, 3], depth)
+    planes = np.stack([frag.row_words(r) for r in range(2 + depth)])
+    cols, vals = bsi_ops.decode(planes)
+    assert cols.tolist() == [5, 9]
+    assert vals == [int64_min, 3]
+
+
+def test_import_values_numpy_fallback_int64_min(monkeypatch):
+    """Same roundtrip with the toolchain absent (numpy scatter)."""
+    import numpy as np
+
+    from pilosa_tpu.models.fragment import Fragment
+    from pilosa_tpu.ops import bsi as bsi_ops
+    from pilosa_tpu.storage import native_ingest as ni
+
+    monkeypatch.setattr(ni, "_lib", None)
+    monkeypatch.setattr(ni, "_lib_failed", True)
+    int64_min = -(1 << 63)
+    frag = Fragment("i", "v", "bsi", 0, width=1 << 12)
+    frag.import_values([7], [int64_min], 64)
+    planes = np.stack([frag.row_words(r) for r in range(66)])
+    cols, vals = bsi_ops.decode(planes)
+    assert cols.tolist() == [7] and vals == [int64_min]
+
+
+def test_import_values_depth_overflow_raises():
+    """An out-of-depth magnitude is an unconditional error, not an
+    assert that vanishes under python -O: it would otherwise reach the
+    native kernel as an out-of-bounds plane index."""
+    import pytest as _pytest
+
+    from pilosa_tpu.models.fragment import Fragment
+
+    frag = Fragment("i", "v", "bsi", 0, width=1 << 12)
+    with _pytest.raises(ValueError, match="bits"):
+        frag.import_values([1], [8], depth=3)
+    # INT64_MIN against a too-shallow field must also raise, not wrap
+    with _pytest.raises(ValueError, match="bits"):
+        frag.import_values([1], [-(1 << 63)], depth=63)
