@@ -330,6 +330,130 @@ def loop_calibrate(h, reps: int = 5) -> dict[str, float]:
     return out
 
 
+def attach_tpu_record(result: dict, path: str = None,
+                      tunnel_down: bool = False) -> dict:
+    """On a CPU-fallback run, carry the committed TPU record verbatim
+    (if any) under ``last_tpu_record`` so the round artifact stays
+    machine-verifiable when the tunnel is down (VERDICT r05 item 1).
+    Mutates and returns `result`."""
+    path = TPU_RECORD_PATH if path is None else path
+    try:
+        with open(path) as f:
+            result["last_tpu_record"] = json.load(f)
+    except FileNotFoundError:
+        pass
+    except (OSError, ValueError) as e:
+        result["last_tpu_record_error"] = f"{type(e).__name__}: {e}"
+    why = ("TPU tunnel unreachable at bench time" if tunnel_down
+           else "explicit CPU run (JAX_PLATFORMS=cpu)")
+    if "last_tpu_record" in result:
+        result["note"] = (
+            why + "; last_tpu_record is the committed raw record "
+            "of the most recent platform=tpu run of this same "
+            "script (see also BENCH_TPU_NOTES.md)")
+    else:
+        result["note"] = (
+            why + "; no committed TPU record exists yet — see "
+            "BENCH_TPU_NOTES.md for in-session records")
+    return result
+
+
+def serving_gauntlet(h, clients_list=(1, 8, 32),
+                     duration_s: float = 1.2) -> dict:
+    """Concurrent-serving A/B: QPS and p50/p99 per client count, with
+    the serving path (micro-batcher + versioned result cache,
+    executor/serving.py) ON vs OFF over the same holder and query mix.
+    The mix is a hot set of distinct read queries, the shape a serving
+    tier sees from dashboard fan-out — exactly what cross-query
+    dispatch coalescing and the result cache exist for."""
+    import statistics as stats
+    import threading
+
+    from pilosa_tpu.executor.executor import Executor
+
+    queries = [
+        "Count(Intersect(Row(a=1), Row(b=1)))",
+        "Count(Row(a=1))",
+        "Count(Row(b=1))",
+        "Count(Union(Row(a=1), Row(b=1)))",
+        "TopN(t, n=10)",
+        "TopN(t, Row(a=1), n=10)",
+        "Row(a=1)",
+        "Count(Row(age > 63))",
+        "Sum(Row(a=1), field=age)",
+        "Count(Xor(Row(a=1), Row(b=1)))",
+        "Count(Difference(Row(a=1), Row(b=1)))",
+        "Count(Row(age < 32))",
+    ]
+
+    # ONE executor per mode, shared across client counts: each
+    # Executor pins its own device tile stacks, and at 954 shards a
+    # fresh engine per (mode, clients) cell would multiply HBM
+    # residency 6x
+    ex_plain = Executor(h)
+    ex_srv = Executor(h)
+    ex_srv.enable_serving(window_s=0.001, max_batch=64,
+                          cache_bytes=64 << 20)
+
+    def run_mode(batched: bool, n_clients: int) -> dict:
+        call = ex_srv.execute_serving if batched else ex_plain.execute
+        for q in queries:  # warm: compile + tile-stack upload
+            call("bench", q)
+        lat: list[float] = []
+        lock = threading.Lock()
+        stop = time.perf_counter() + duration_s
+        barrier = threading.Barrier(n_clients)
+
+        def client(ci: int):
+            my: list[float] = []
+            barrier.wait()
+            i = ci
+            while time.perf_counter() < stop:
+                q = queries[i % len(queries)]
+                i += 1
+                t0 = time.perf_counter()
+                call("bench", q)
+                my.append(time.perf_counter() - t0)
+            with lock:
+                lat.extend(my)
+
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(n_clients)]
+        t_start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t_start
+        lat.sort()
+        n = len(lat)
+        return {
+            "requests": n,
+            "qps": round(n / wall, 1) if wall > 0 else 0.0,
+            "p50_ms": round(lat[n // 2] * 1e3, 3) if n else None,
+            "p99_ms": round(lat[min(n - 1, int(n * 0.99))] * 1e3, 3)
+            if n else None,
+            "mean_ms": round(stats.fmean(lat) * 1e3, 3) if n else None,
+        }
+
+    out: dict = {}
+    for nc in clients_list:
+        ab = {"unbatched": run_mode(False, nc),
+              "batched": run_mode(True, nc)}
+        ub, bt = ab["unbatched"]["qps"], ab["batched"]["qps"]
+        ab["qps_speedup"] = round(bt / ub, 2) if ub else None
+        out[f"c{nc}"] = ab
+        log(f"serving c{nc}: unbatched {ub} qps "
+            f"p99={ab['unbatched']['p99_ms']}ms | batched {bt} qps "
+            f"p99={ab['batched']['p99_ms']}ms "
+            f"({ab['qps_speedup']}x)")
+    from pilosa_tpu.obs import metrics as _m
+    out["batch_size_p50"] = round(
+        _m.SERVING_BATCH_SIZE.quantile(0.5), 2)
+    out["result_cache_hits"] = _m.RESULT_CACHE.value(outcome="hit")
+    return out
+
+
 def _preview(res):
     r = res[0]
     if isinstance(r, list):
@@ -361,6 +485,9 @@ def main() -> None:
 
     h, cells = build_index(n_shards, topn_rows)
     full = run_queries(h, reps, f"{n_shards}sh")
+    # concurrent-serving A/B: the dispatch-coalescing serving path
+    # (executor/serving.py) vs per-query execution, same holder
+    serving = serving_gauntlet(h)
     # RTT-independent device time for the sub-RTT north-star scans
     cal = loop_calibrate(h) if on_tpu else None
 
@@ -416,6 +543,9 @@ def main() -> None:
             "c60": round(p50["able_groupby"] * 1e3, 3),
             "c240": round(p50["groupby_c240"] * 1e3, 3),
         },
+        # concurrent-serving gauntlet: QPS + p50/p99 at 1/8/32
+        # clients, serving path (batcher + result cache) on vs off
+        "serving_gauntlet": serving,
     }
     if cal is not None:
         result["loop_calibrated_device_ms"] = {
@@ -437,24 +567,7 @@ def main() -> None:
     else:
         # carry the committed TPU record verbatim (if any) so the
         # round artifact stays machine-verifiable on CPU runs
-        try:
-            with open(TPU_RECORD_PATH) as f:
-                result["last_tpu_record"] = json.load(f)
-        except FileNotFoundError:
-            pass
-        except (OSError, ValueError) as e:
-            result["last_tpu_record_error"] = f"{type(e).__name__}: {e}"
-        why = ("TPU tunnel unreachable at bench time" if tunnel_down
-               else "explicit CPU run (JAX_PLATFORMS=cpu)")
-        if "last_tpu_record" in result:
-            result["note"] = (
-                why + "; last_tpu_record is the committed raw record "
-                "of the most recent platform=tpu run of this same "
-                "script (see also BENCH_TPU_NOTES.md)")
-        else:
-            result["note"] = (
-                why + "; no committed TPU record exists yet — see "
-                "BENCH_TPU_NOTES.md for in-session records")
+        attach_tpu_record(result, tunnel_down=tunnel_down)
     print(json.dumps(result))
 
 

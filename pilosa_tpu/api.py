@@ -119,8 +119,17 @@ class API:
             prev = _tr.push_thread_tracer(tracer)
         try:
             try:
-                results = self.executor.execute(index, pql, shards,
-                                                remote=remote)
+                if profile:
+                    # profiled queries need their spans on THIS thread
+                    # — the batch leader would swallow them.  The
+                    # long-query log alone does NOT bypass serving:
+                    # it still records durations for every query and
+                    # spans for the ones that execute in-thread.
+                    results = self.executor.execute(index, pql, shards,
+                                                    remote=remote)
+                else:
+                    results = self.executor.execute_serving(
+                        index, pql, shards, remote=remote)
             except (ExecError, ParseError, ValueError, KeyError) as e:
                 raise ApiError(str(e), 400)
         finally:

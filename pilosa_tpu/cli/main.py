@@ -67,7 +67,7 @@ def cmd_server(args) -> int:
         auth = (Authenticator(cfg.auth_secret.encode()), authz)
     logger = StderrLogger()
     srv = Server(holder=holder, bind=cfg.bind, port=cfg.port,
-                 logger=logger, auth=auth)
+                 logger=logger, auth=auth, config=cfg)
     srv.api.long_query_time = float(cfg.long_query_time)
     srv.api.logger = logger
     grpc_srv = None

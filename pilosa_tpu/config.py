@@ -33,6 +33,15 @@ class Config:
     # queries slower than this (seconds) go to the long-query log;
     # 0 disables (server.go:201 OptServerLongQueryTime)
     long_query_time: float = 0.0
+    # serving path (executor/serving.py): concurrent queries coalesce
+    # into one device dispatch per admission window, and repeated
+    # reads serve from a write-version-guarded result cache.
+    # Env-overridable like every knob (PILOSA_TPU_SERVING_BATCHING=0,
+    # PILOSA_TPU_SERVING_CACHE_MB=0, ...).
+    serving_batching: bool = True
+    serving_batch_window_ms: float = 1.0
+    serving_batch_max: int = 32
+    serving_cache_mb: int = 64
 
     def apply_kernel_setting(self):
         """Translate tpu_kernels into the Pallas dispatch env flag.
@@ -56,6 +65,10 @@ _TOML_KEYS = {
     "auth.policy": "auth_policy",
     "tpu.kernels": "tpu_kernels",
     "long-query-time": "long_query_time",
+    "serving.batching": "serving_batching",
+    "serving.batch-window-ms": "serving_batch_window_ms",
+    "serving.batch-max": "serving_batch_max",
+    "serving.cache-mb": "serving_cache_mb",
 }
 
 ENV_PREFIX = "PILOSA_TPU_"

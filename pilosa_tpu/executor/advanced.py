@@ -92,7 +92,14 @@ class AdvancedOps:
 
     # -- TopN / TopK ----------------------------------------------------
 
-    def _execute_topnk(self, idx, call: Call, shards, pre, n_key: str):
+    def _topnk_prepare(self, idx, call: Call, shards, pre, n_key: str):
+        """Host half of TopN/TopK: field/view resolution, the rank-
+        cache fast paths, and candidate-row selection.  Returns
+        ("done", result) when no device scan is needed, else
+        ("scan", f, views, row_ids, filter_call, n, ids).  Shared by
+        the per-query path below and the cross-query batcher
+        (executor/serving.py) so the fused scan stays bit-exact with
+        the solo one by construction."""
         fname = call.arg("_field")
         f = idx.field(fname) if fname else None
         if f is None:
@@ -110,7 +117,7 @@ class AdvancedOps:
             # to the exact scan when any fragment has no cache
             pairs = self._topn_from_caches(idx, f, shards)
             if pairs is not None:
-                return self._finish_topn(f, pairs, n, ids)
+                return ("done", self._finish_topn(f, pairs, n, ids))
         row_ids = ([int(r) for r in ids] if ids is not None
                    else self._all_row_ids(idx, f, shards))
         if (ids is None and call.name == "TopN"
@@ -126,7 +133,14 @@ class AdvancedOps:
             if cand is not None and len(cand) < len(row_ids):
                 row_ids = cand
         if not row_ids:
-            return []
+            return ("done", [])
+        return ("scan", f, views, row_ids, filter_call, n, ids)
+
+    def _execute_topnk(self, idx, call: Call, shards, pre, n_key: str):
+        prep = self._topnk_prepare(idx, call, shards, pre, n_key)
+        if prep[0] == "done":
+            return prep[1]
+        _, f, views, row_ids, filter_call, n, ids = prep
         if getattr(self, "use_stacked", False):
             try:
                 pairs = self._topnk_stacked(idx, f, row_ids, views,

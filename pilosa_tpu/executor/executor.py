@@ -76,6 +76,35 @@ class Executor(AdvancedOps):
         # fallback for trees the IR can't express.
         self.stacked = StackedEngine(self)
         self.use_stacked = True
+        # the serving front (executor/serving.py): cross-query
+        # micro-batching + versioned result cache.  None until a
+        # server (or bench) opts in via enable_serving().
+        self.serving = None
+
+    def enable_serving(self, window_s: float = 0.001,
+                       max_batch: int = 32,
+                       cache_bytes: int = 64 << 20,
+                       batching: bool = True):
+        """Attach the serving layer (executor/serving.py): concurrent
+        queries coalesce into one device dispatch per admission window
+        and repeated reads serve from the write-version-guarded result
+        cache.  Returns the layer for introspection."""
+        from pilosa_tpu.executor.serving import ServingLayer
+        self.serving = ServingLayer(self, window_s=window_s,
+                                    max_batch=max_batch,
+                                    cache_bytes=cache_bytes,
+                                    batching=batching)
+        return self.serving
+
+    def execute_serving(self, index_name: str, query: str | Query,
+                        shards: list[int] | None = None,
+                        remote: bool = False) -> list:
+        """Serving-path entry: routes through the micro-batcher +
+        result cache when enabled, else plain execute()."""
+        if self.serving is None:
+            return self.execute(index_name, query, shards, remote=remote)
+        return self.serving.execute(index_name, query, shards,
+                                    remote=remote)
 
     def set_mesh(self, mesh):
         """Place all shard stacks over a jax.sharding.Mesh; cross-

@@ -1,0 +1,747 @@
+"""Cross-query dispatch coalescing — the serving path.
+
+The committed TPU record shows the engine's scans are bandwidth-bound
+(~88% of v5e HBM peak) while WALL time is dispatch-bound: ~75 ms wall
+vs ~0.35 ms device for Count at 954 shards, one device dispatch per
+query.  Under concurrent load the per-query path therefore pays one
+full dispatch/RTT per request.  This module amortizes that cost the
+way TPU inference serving does (continuous batching, cf. Ragged Paged
+Attention in PAPERS.md):
+
+- ``QueryBatcher`` — concurrent in-flight queries over the same index
+  are admitted for a short window (default 1 ms, or until
+  ``max_batch``), their plans fused into ONE jitted program over a
+  shared tile-stack upload (stacked.py's "multi" plan kind: leaves are
+  deduplicated across queries by the shared ``PlanBuilder``), executed
+  as ONE device dispatch and demultiplexed back to the waiting handler
+  threads.  The admission lock is held only for queue flips; the
+  device runs while the next batch accumulates (continuous batching).
+
+- ``ResultCache`` — a versioned whole-query result cache keyed by the
+  plan fingerprint (index, canonical call repr, shard set) and guarded
+  by the write-versions of every fragment the query can read: any
+  host write bumps its fragment's version (models/fragment.py), so a
+  stale entry misses — and an explicit ``sweep()`` after serving-path
+  writes evicts exactly the entries whose snapshot no longer matches.
+  LRU byte-bounded like ``TileStackCache``.
+
+Consistency bar: a query admitted before a write either executes
+against a fragment-version snapshot that is still intact when its
+batch completes, or it is re-executed solo (the same consistency the
+unbatched path provides).  Anything the batcher cannot express falls
+back to ``Executor.execute`` — results are bit-exact by construction
+because candidate selection (TopN) and plan building are shared with
+the solo path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+from pilosa_tpu.executor.results import Pair, RowResult, ValCount
+from pilosa_tpu.executor.stacked import (
+    PlanBuilder,
+    Unstackable,
+    _compiled,
+)
+from pilosa_tpu.models.index import EXISTENCE_FIELD
+from pilosa_tpu.obs import metrics
+from pilosa_tpu.obs.tracing import start_span
+from pilosa_tpu.ops import kernels
+from pilosa_tpu.pql import parse
+from pilosa_tpu.pql.ast import Call, Query
+
+# the executor's own write-call table: one source of truth so the
+# serving layer's write routing can never drift from dispatch
+from pilosa_tpu.executor.executor import _WRITE_CALLS
+
+# bitmap-producing calls the stacked PlanBuilder can express without
+# per-query precompute (no Distinct/UnionRows/ConstRow leaves)
+_PURE_BITMAP = {"Row", "Range", "Union", "Intersect", "Difference",
+                "Xor", "Not", "All", "Shift"}
+
+# read calls whose results depend only on fragment contents (plus
+# append-only key translation) — the cacheable dispatch surface of
+# Executor._execute_call
+_READ_CALLS = _PURE_BITMAP | {
+    "Count", "Sum", "Min", "Max", "MinRow", "MaxRow", "Distinct",
+    "Rows", "UnionRows", "TopN", "TopK", "GroupBy", "Percentile",
+    "Sort", "Extract", "Limit", "IncludesColumn", "FieldValue",
+    "ConstRow",
+}
+
+
+class Uncacheable(Exception):
+    """Raised when a query's read set cannot be proven version-stable."""
+
+
+# ---------------------------------------------------------------------------
+# dependency tracking
+# ---------------------------------------------------------------------------
+
+def _dep_fields(idx, call: Call, out: set) -> None:
+    """Collect the field names a call tree can read, conservatively
+    (over-inclusion only widens invalidation; under-inclusion would be
+    a stale-read bug).  Raises Uncacheable for calls whose results
+    depend on state outside fragment versions."""
+    name = call.name
+    if name in _WRITE_CALLS or name not in _READ_CALLS:
+        raise Uncacheable(f"not a cacheable call: {name}")
+    if name == "Distinct":
+        iname = call.arg("index")
+        if iname is not None and iname != idx.name:
+            raise Uncacheable("cross-index Distinct")
+    if name == "ConstRow":
+        # keyed columns resolve through the index translator, whose
+        # key set can grow without any fragment version bump
+        if any(isinstance(c, str) for c in call.arg("columns", []) or []):
+            raise Uncacheable("ConstRow with string keys")
+    if name in ("Not", "All"):
+        out.add(EXISTENCE_FIELD)
+    k, cond = call.condition_field()
+    if k is not None:
+        out.add(k)
+        if cond is not None and cond.value is None:
+            out.add(EXISTENCE_FIELD)  # null predicates read existence
+    for key in ("_field", "field"):
+        v = call.args.get(key)
+        if isinstance(v, str):
+            out.add(v)
+    fk, _ = call.field_arg()
+    if fk is not None and idx.field(fk) is not None:
+        out.add(fk)
+    for v in call.args.values():
+        if isinstance(v, Call):
+            _dep_fields(idx, v, out)
+    for c in call.children:
+        _dep_fields(idx, c, out)
+
+
+def _write_fields(q: Query) -> set | None:
+    """Fields a write query touches (for the targeted cache sweep),
+    or None when the write's reach cannot be bounded (Delete removes
+    columns from every field).  Conservative: unknown shapes also
+    return None, which sweeps everything."""
+    fields: set = set()
+    for c in q.calls:
+        if c.name not in _WRITE_CALLS or c.name == "Delete":
+            return None
+        fk, _ = c.field_arg()
+        if fk is not None:
+            fields.add(fk)
+        v = c.args.get("_field")
+        if isinstance(v, str):
+            fields.add(v)
+    # Set marks column existence; Store may create the target field —
+    # both can stale existence-reading entries
+    fields.add(EXISTENCE_FIELD)
+    return fields
+
+
+def query_fields(idx, q: Query) -> frozenset:
+    """The field read-set of a whole query (Uncacheable if any call
+    escapes version tracking)."""
+    out: set = set()
+    for c in q.calls:
+        _dep_fields(idx, c, out)
+    return frozenset(out)
+
+
+def field_snapshot(idx, fields: frozenset) -> tuple:
+    """Version snapshot of every fragment the fields currently hold:
+    ((fname, vname, shard, frag.gen, version), ...).  A write bumps a
+    version; a new fragment/view/field changes the tuple's shape; a
+    deleted-and-recreated field gets fresh generation stamps (a
+    process-global monotonic counter — id() would be unsound, CPython
+    reuses freed addresses) — all compare unequal, so comparison-to-
+    snapshot is the staleness test."""
+    snap = []
+    for fname in sorted(fields):
+        f = idx.fields.get(fname)
+        if f is None:
+            snap.append((fname, None))
+            continue
+        for vname in sorted(f.views):
+            # .get, skipping None: a concurrent view/field deletion
+            # between the key listing and the lookup must produce a
+            # (correct) snapshot mismatch, not a KeyError in a read
+            v = f.views.get(vname)
+            if v is None:
+                continue
+            for shard in sorted(v.fragments):
+                fr = v.fragments.get(shard)
+                if fr is None:
+                    continue
+                snap.append((fname, vname, shard, fr.gen, fr.version))
+    return tuple(snap)
+
+
+def _result_nbytes(r) -> int:
+    """Rough byte estimate of one result for LRU accounting.  Every
+    container result type gets a size-proportional estimate — a flat
+    default would let large Extract/Distinct results slip under the
+    byte bound and grow the cache past its budget."""
+    from pilosa_tpu.executor.results import (
+        DistinctValues,
+        ExtractedTable,
+        GroupCount,
+        SortedRow,
+    )
+    if isinstance(r, RowResult):
+        return 64 + sum(int(w.nbytes) for w in r.segments.values()) + \
+            (len(r.keys) * 24 if r.keys else 0)
+    if isinstance(r, (list, tuple)):
+        return 48 + sum(_result_nbytes(x) for x in r)
+    if isinstance(r, dict):
+        return 64 + sum(48 + _result_nbytes(v) for v in r.values())
+    if isinstance(r, np.ndarray):
+        return int(r.nbytes)
+    if isinstance(r, DistinctValues):
+        return 48 + 24 * len(r.values)
+    if isinstance(r, SortedRow):
+        return 48 + 16 * (len(r.columns) + len(r.values))
+    if isinstance(r, GroupCount):
+        return 96 + 64 * len(r.group)
+    if isinstance(r, ExtractedTable):
+        return 96 + 48 * len(r.fields) + sum(
+            64 + 24 * len(c.get("rows", ()))
+            if isinstance(c, dict) else 64 for c in r.columns)
+    return 64
+
+
+# ---------------------------------------------------------------------------
+# versioned result cache
+# ---------------------------------------------------------------------------
+
+_MISS = object()
+
+
+class ResultCache:
+    """LRU byte-bounded whole-query result cache.
+
+    Entry: key -> (fields, snapshot, results, nbytes).  A lookup
+    recomputes the fields' current snapshot and misses (evicting the
+    entry) on any mismatch — so writes invalidate lazily, exactly the
+    entries whose read set they touched; ``sweep()`` performs the same
+    eviction eagerly after serving-path writes."""
+
+    def __init__(self, max_bytes: int = 64 << 20):
+        self.max_bytes = max_bytes
+        self._entries: OrderedDict[tuple, tuple] = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, idx, key, cur_snap: tuple | None = None):
+        """`cur_snap`, when given, must be field_snapshot() of the
+        entry's read set taken just now — callers that already walked
+        the fragments pass it to avoid a second walk."""
+        with self._lock:
+            ent = self._entries.get(key)
+        if ent is None:
+            with self._lock:
+                self.misses += 1
+            return _MISS
+        fields, snap, results, _nb = ent
+        # snapshot outside the lock: touches only holder structures
+        if (field_snapshot(idx, fields)
+                if cur_snap is None else cur_snap) != snap:
+            with self._lock:
+                cur = self._entries.get(key)
+                if cur is ent:
+                    self._entries.pop(key)
+                    self._bytes -= ent[3]
+                self.misses += 1
+            return _MISS
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+            self.hits += 1
+        return results
+
+    def put(self, key, fields: frozenset, snapshot: tuple, results):
+        nbytes = _result_nbytes(results)
+        if nbytes > self.max_bytes:
+            return
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[3]
+            self._entries[key] = (fields, snapshot, results, nbytes)
+            self._bytes += nbytes
+            while self._bytes > self.max_bytes and self._entries:
+                _, (_, _, _, nb) = self._entries.popitem(last=False)
+                self._bytes -= nb
+
+    def sweep(self, holder, touched: set | None = None) -> int:
+        """Evict exactly the entries whose snapshot is stale (called
+        after serving-path writes).  `touched` narrows the scan to
+        entries whose read set intersects the written fields — entries
+        a write cannot have staled are not re-snapshotted, so per-Set
+        sweep cost tracks relevance, not cache occupancy (lazy get-
+        time validation still covers every other write path).
+        Returns the eviction count."""
+        with self._lock:
+            items = list(self._entries.items())
+        evicted = 0
+        for key, ent in items:
+            if touched is not None and not (ent[0] & touched):
+                continue
+            idx = holder.index(key[0])
+            stale = idx is None or field_snapshot(idx, ent[0]) != ent[1]
+            if stale:
+                with self._lock:
+                    cur = self._entries.get(key)
+                    if cur is ent:
+                        self._entries.pop(key)
+                        self._bytes -= ent[3]
+                        evicted += 1
+        return evicted
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key) -> bool:
+        return key in self._entries
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher
+# ---------------------------------------------------------------------------
+
+class _Req:
+    """One in-flight batchable query."""
+
+    __slots__ = ("index", "idx", "q", "call", "kind", "shards", "skey",
+                 "fields", "key", "snapshot", "result", "error",
+                 "direct", "event")
+
+    def __init__(self, index, idx, q, call, kind, shards, skey,
+                 fields, key, snapshot):
+        self.index = index
+        self.idx = idx
+        self.q = q
+        self.call = call
+        self.kind = kind
+        self.shards = shards          # caller's shards arg (may be None)
+        self.skey = skey              # resolved shard tuple
+        self.fields = fields          # frozenset | None (uncacheable)
+        self.key = key
+        self.snapshot = snapshot      # admission-time version snapshot
+        self.result = None            # list of results when served
+        self.error = None
+        self.direct = False           # fall back to Executor.execute
+        self.event = threading.Event()
+
+
+class QueryBatcher:
+    """Leader/follower continuous batching.
+
+    The first thread to arrive while no leader is active becomes the
+    leader: it waits out the admission window (or until ``max_batch``
+    requests queue), flips the queue, and executes the fused batch
+    while the NEXT batch accumulates behind a new leader.  Followers
+    park on a per-request event.
+    """
+
+    def __init__(self, serving: "ServingLayer", window_s: float,
+                 max_batch: int):
+        self.serving = serving
+        self.window_s = window_s
+        self.max_batch = max_batch
+        self._cond = threading.Condition()
+        self._pending: list[_Req] = []
+        self._leader = False
+        self._inflight = 0  # batches currently executing
+
+    def run(self, req: _Req) -> None:
+        """Serve one request through the batch path; on return the
+        request carries ``result`` or ``error``."""
+        with self._cond:
+            self._pending.append(req)
+            metrics.SERVING_QUEUE_DEPTH.set(len(self._pending))
+            if self._leader:
+                if len(self._pending) >= self.max_batch:
+                    self._cond.notify_all()  # leader stops waiting
+                follower = True
+            else:
+                self._leader = True
+                follower = False
+        if follower:
+            req.event.wait()
+            return
+        t_lead = time.perf_counter()
+        deadline = t_lead + self.window_s
+        with self._cond:
+            # continuous batching: dispatch IMMEDIATELY when the
+            # device is idle (a lone request must not eat the window
+            # as pure latency); wait out the admission window only
+            # while another batch is executing — that is exactly when
+            # requests naturally accumulate
+            while (self._inflight > 0
+                   and len(self._pending) < self.max_batch):
+                rem = deadline - time.perf_counter()
+                if rem <= 0:
+                    break
+                self._cond.wait(rem)
+            batch = self._pending
+            self._pending = []
+            self._leader = False
+            self._inflight += 1
+            metrics.SERVING_QUEUE_DEPTH.set(0)
+        metrics.SERVING_BATCH_WAIT.observe(time.perf_counter() - t_lead)
+        metrics.SERVING_BATCH_SIZE.observe(len(batch))
+        try:
+            self.serving._run_batch(batch)
+        except Exception as e:  # belt-and-braces: never strand a waiter
+            for r in batch:
+                if r.result is None and r.error is None:
+                    r.error = e
+        finally:
+            with self._cond:
+                self._inflight -= 1
+                self._cond.notify_all()  # wake a window-waiting leader
+            for r in batch:
+                r.event.set()
+
+
+# ---------------------------------------------------------------------------
+# serving layer
+# ---------------------------------------------------------------------------
+
+class ServingLayer:
+    """Front of Executor for the HTTP/gRPC serving path: result cache
+    first, micro-batcher second, ``Executor.execute`` fallback always."""
+
+    def __init__(self, executor, window_s: float = 0.001,
+                 max_batch: int = 32, cache_bytes: int = 64 << 20,
+                 batching: bool = True):
+        self.executor = executor
+        self.batching = batching and max_batch > 1
+        self.cache = ResultCache(cache_bytes) if cache_bytes > 0 else None
+        self.batcher = QueryBatcher(self, window_s, max_batch)
+
+    # -- entry point ---------------------------------------------------
+
+    def execute(self, index: str, query, shards=None,
+                remote: bool = False) -> list:
+        ex = self.executor
+        if remote:
+            # node-to-node calls carry the _REMOTE contextvar, which a
+            # leader thread would not inherit — serve them solo
+            return ex.execute(index, query, shards, remote=True)
+        q = parse(query) if isinstance(query, str) else query
+        if any(c.name in _WRITE_CALLS for c in q.calls):
+            try:
+                return ex.execute(index, q, shards)
+            finally:
+                if self.cache is not None:
+                    self.cache.sweep(ex.holder, _write_fields(q))
+                    metrics.RESULT_CACHE.inc(outcome="write")
+        # span on the CALLER's thread so the long-query log keeps its
+        # executor.Execute root even for fused/cached serves (the
+        # direct fallback nests its own copy inside — the root name
+        # is what the log consumers pin on)
+        with start_span("executor.Execute", index=index):
+            return self._execute_read(ex, index, q, shards)
+
+    def _execute_read(self, ex, index, q, shards):
+        t0 = time.perf_counter()
+        route = "direct"
+        try:
+            idx = ex.holder.index(index)
+            if idx is None:  # canonical "index not found" error path
+                return ex.execute(index, q, shards)
+            key = (index, repr(q.calls),
+                   None if shards is None else tuple(sorted(shards)))
+            # the read set drives BOTH the cache guard and the
+            # batcher's mid-flight consistency re-check, so compute it
+            # even with the cache disabled
+            fields = None
+            try:
+                fields = query_fields(idx, q)
+            except Uncacheable:
+                if self.cache is not None:
+                    metrics.RESULT_CACHE.inc(outcome="bypass")
+            # ONE snapshot walk serves the cache guard, batch
+            # admission, and the miss-path store protocol (the walk is
+            # O(fields x views x shards) Python — at 954 shards it
+            # must not run three times per query)
+            snap = (field_snapshot(idx, fields)
+                    if fields is not None else None)
+            if self.cache is not None:
+                if fields is not None:
+                    res = self.cache.get(idx, key, cur_snap=snap)
+                    if res is not _MISS:
+                        route = "cached"
+                        metrics.RESULT_CACHE.inc(outcome="hit")
+                        metrics.QUERY_TOTAL.inc(index=index, status="ok")
+                        metrics.QUERY_DURATION.observe(
+                            time.perf_counter() - t0)
+                        return res
+                    metrics.RESULT_CACHE.inc(outcome="miss")
+            # classification pays a shard-list sort — skip it
+            # entirely in cache-only mode
+            req = (self._classify(index, idx, q, shards, fields, key,
+                                  snap)
+                   if self.batching else None)
+            if req is not None:
+                self.batcher.run(req)
+                if req.error is not None:
+                    raise req.error
+                if req.result is not None and not req.direct:
+                    route = "fused"
+                    metrics.QUERY_TOTAL.inc(index=index, status="ok")
+                    metrics.QUERY_DURATION.observe(
+                        time.perf_counter() - t0)
+                    return req.result
+                # fallback on THIS thread: failed/stale fused serves
+                # re-execute in parallel across their callers, not
+                # serially on the batch leader.  snap is stale here by
+                # definition — _exec_and_cache takes a fresh one.
+                snap = None
+            return self._exec_and_cache(index, idx, q, shards, fields,
+                                        key, snap)
+        finally:
+            metrics.SERVING_BATCHED.inc(route=route)
+            metrics.SERVING_LATENCY.observe(time.perf_counter() - t0)
+
+    # -- classification ------------------------------------------------
+
+    def _classify(self, index, idx, q: Query, shards, fields, key,
+                  snapshot=None):
+        """A _Req when the query can ride a fused batch, else None."""
+        if len(q.calls) != 1 or not getattr(self.executor,
+                                            "use_stacked", False):
+            return None
+        call = q.calls[0]
+        name = call.name
+        if name == "Count":
+            if len(call.children) != 1:
+                return None
+            kind, tree_call = "count", call.children[0]
+        elif name == "Sum":
+            kind = "sum"
+            tree_call = call.children[0] if call.children else None
+        elif name in ("TopN", "TopK"):
+            kind = "topn"
+            tree_call = call.children[0] if call.children else None
+        elif name in _PURE_BITMAP:
+            kind, tree_call = "words", call
+        else:
+            return None
+        if tree_call is not None and not _pure_tree(tree_call):
+            return None
+        skey = tuple(self.executor._shard_list(idx, shards))
+        if snapshot is None and fields is not None:
+            snapshot = field_snapshot(idx, fields)
+        return _Req(index, idx, q, call, kind, shards, skey, fields,
+                    key, snapshot)
+
+    # -- batch execution (leader thread) -------------------------------
+
+    def _run_batch(self, batch: list[_Req]) -> None:
+        # group by index IDENTITY, not name: two requests straddling a
+        # drop-and-recreate of the same index name must not share one
+        # PlanBuilder (reqs[0].idx would serve the other's query from
+        # the wrong generation's fragments)
+        groups: dict[tuple, list[_Req]] = {}
+        for r in batch:
+            groups.setdefault((id(r.idx), r.skey), []).append(r)
+        for reqs in groups.values():
+            self._run_group(reqs)
+        # post-pass: snapshot re-check.  Fallbacks are NOT executed
+        # here — the leader running every solo re-execution serially
+        # would hold all followers hostage; instead the request is
+        # marked direct and each CALLER thread re-executes its own
+        # query after its event fires (parallel, like batching off).
+        for r in batch:
+            if (not r.direct and r.error is None and r.result is not None
+                    and r.fields is not None
+                    and field_snapshot(r.idx, r.fields) != r.snapshot):
+                # a write landed while the batch was in flight: the
+                # fused result may span versions — re-execute solo
+                r.direct = True
+                r.result = None
+            if r.result is not None and not r.direct and \
+                    r.error is None and r.fields is not None and \
+                    self.cache is not None:
+                self.cache.put(r.key, r.fields, r.snapshot, r.result)
+
+    def _run_group(self, reqs: list[_Req]) -> None:
+        ex = self.executor
+        eng = ex.stacked
+        idx = reqs[0].idx
+        shards = list(reqs[0].skey)
+        b = PlanBuilder(eng, idx, shards, {})
+        subs, demuxes, pend = [], [], []
+        # canonical build order: leaf indices are assigned during
+        # build, so permutations of the same query set must BUILD in
+        # one order to share a compiled multi program (sorting only
+        # the finished subplans would leave arrival-dependent leaf
+        # numbering behind)
+        for r in sorted(reqs, key=lambda r: repr(r.call)):
+            if r.result is not None or r.error is not None:
+                continue
+            try:
+                built = self._build_sub(b, r, shards)
+            except Exception:
+                r.direct = True
+                continue
+            if built is None:
+                continue  # constant result already set on r
+            sub, demux = built
+            subs.append(sub)
+            demuxes.append(demux)
+            pend.append(r)
+        if not subs:
+            return
+        try:
+            kern = kernels.enabled() and not eng.host_only
+            fn = _compiled(("multi", tuple(subs)), kern=kern)
+            outs = fn(tuple(b.leaves), tuple(b.params))
+        except Exception:
+            for r in pend:
+                r.direct = True
+            return
+        for r, demux, out in zip(pend, demuxes, outs):
+            try:
+                r.result = demux(out)
+            except Exception:
+                r.direct = True
+                r.result = None
+
+    def _build_sub(self, b: PlanBuilder, r: _Req, shards: list[int]):
+        """(subplan, demux) for one request, or None after setting a
+        constant result.  Any exception → solo fallback (which also
+        reproduces the user-visible error faithfully)."""
+        ex = self.executor
+        eng = ex.stacked
+        idx = r.idx
+        red = eng._reduce_in_program(shards)
+        call = r.call
+        if r.kind == "count":
+            tree = b.build(call.children[0])
+            if tree == ("zeros",):
+                r.result = [0]
+                return None
+
+            def demux_count(out):
+                c = np.asarray(out, dtype=np.int64)
+                return [int(c) if red else int(c.sum())]
+            return ("count", tree, red), demux_count
+        if r.kind == "words":
+            tree = b.build(call)
+            if tree == ("zeros",):
+                r.result = [self._row_result(idx, shards, None)]
+                return None
+
+            def demux_words(out):
+                w = np.asarray(out)[: len(shards)]
+                return [self._row_result(idx, shards, w)]
+            return ("words", tree), demux_words
+        if r.kind == "sum":
+            fname = call.arg("_field")
+            if fname is None:
+                raise Unstackable("Sum without field")
+            f = ex._bsi_field(idx, fname)
+            planes_i = b._planes_leaf(f)
+            tree = None
+            if call.children:
+                tree = b.build(call.children[0])
+                if tree == ("zeros",):
+                    r.result = [ValCount(value=f.int_to_value(0), count=0)]
+                    return None
+
+            def demux_sum(out):
+                cnt, pos, neg = out
+                total, count = eng.bsi_sum_host(cnt, pos, neg, red)
+                return [ValCount(value=f.int_to_value(total),
+                                 count=count)]
+            return ("bsi_sum", planes_i, tree, red), demux_sum
+        if r.kind == "topn":
+            n_key = "n" if call.name == "TopN" else "k"
+            prep = ex._topnk_prepare(idx, call, r.shards, {}, n_key)
+            if prep[0] == "done":
+                r.result = [prep[1]]
+                return None
+            _, f, views, row_ids, filter_call, n, ids = prep
+            est = len(row_ids) * max(len(shards), 1) * (idx.width // 8)
+            if est > ex._ROWS_STACK_BUDGET:
+                raise Unstackable("TopN row stack over batch budget")
+            stack = eng.rows_stack_for(idx, f, tuple(views), row_ids,
+                                       tuple(shards))
+            rows_i = b._add_leaf(stack)
+            tree = (b.build(filter_call)
+                    if filter_call is not None else None)
+            if tree == ("zeros",):
+                pairs = ([Pair(id=rr, count=0) for rr in row_ids]
+                         if ids is not None else [])
+                r.result = [ex._finish_topn(f, pairs, n, ids)]
+                return None
+
+            def demux_topn(out):
+                c = np.asarray(out, dtype=np.int64)
+                if not red:
+                    c = c.sum(axis=1)
+                pairs = [Pair(id=rr, count=int(cc))
+                         for rr, cc in zip(row_ids, c)
+                         if cc > 0 or ids is not None]
+                return [ex._finish_topn(f, pairs, n, ids)]
+            return ("row_counts", rows_i, tree, red), demux_topn
+        raise Unstackable(f"unbatchable kind {r.kind}")
+
+    def _row_result(self, idx, shards: list[int], words) -> RowResult:
+        """Mirror Executor._bitmap_result + the translateResults key
+        attachment for a fused bitmap query."""
+        out = RowResult(idx.width)
+        if words is not None:
+            for i, shard in enumerate(shards):
+                if words[i].any():
+                    out.segments[shard] = words[i]
+        if idx.keys:
+            out.keys = idx.column_translator.translate_ids(out.columns())
+        return out
+
+    # -- solo path with cache store ------------------------------------
+
+    def _exec_and_cache(self, index, idx, q, shards, fields, key,
+                        snap=None):
+        """Solo execution with the store protocol: snapshot before,
+        execute, store only if the snapshot held.  `snap`, when
+        given, must have been taken pre-execution on this path."""
+        ex = self.executor
+        if self.cache is None or fields is None:
+            return ex.execute(index, q, shards)
+        if snap is None:
+            snap = field_snapshot(idx, fields)
+        results = ex.execute(index, q, shards)
+        # store only if no write raced the execution (a racing write
+        # would make the cached value's snapshot provenance unclear)
+        if field_snapshot(idx, fields) == snap:
+            self.cache.put(key, fields, snap, results)
+        return results
+
+
+def _pure_tree(call: Call) -> bool:
+    """True when a bitmap tree uses only calls the PlanBuilder can
+    express without per-query precompute or key-dependent leaves."""
+    if call.name not in _PURE_BITMAP:
+        return False
+    if any(isinstance(v, Call) for v in call.args.values()):
+        return False
+    return all(_pure_tree(c) for c in call.children)

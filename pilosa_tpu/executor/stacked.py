@@ -415,22 +415,23 @@ def _count_partials(tree, kern: bool):
         _as_stack(_eval(tree, leaves, params), leaves))
 
 
-def _compiled(plan, kern: bool = False):
-    """plan: ("words", tree) | ("count", tree, reduce)
-    | ("bsi_sum", planes_i, tree|None, reduce)
-    | ("row_counts", rows_i, tree|None, reduce).
-    One jitted fn per structure; `kern` routes resident-leaf hot ops
-    through the Pallas kernels.  With reduce=True the cross-shard sum
-    happens IN the program — under a mesh it lowers to a psum over ICI
-    (the jitted analog of mapReduce's reduceFn); int32-exact up to
-    _REDUCE_MAX_SHARDS shards, the caller's responsibility."""
-    sig = (repr(plan), kern)
-    with _JIT_LOCK:
-        fn = _JIT_CACHE.get(sig)
-        if fn is not None:
-            _JIT_CACHE.move_to_end(sig)
-            return fn
+def _plan_run(plan, kern: bool = False):
+    """Un-jitted `run(leaves, params)` for one plan (see _compiled).
+    Split out so the "multi" kind — the cross-query batcher's fused
+    program (executor/serving.py) — can compose several subplans into
+    ONE traced function sharing the leaf/param tuples."""
     kind = plan[0]
+    if kind == "multi":
+        # fused batch: every subplan evaluates in one program (one
+        # device dispatch for N concurrent queries).  groupby is
+        # excluded — its run() reads the combo selector from
+        # params[-1], which only a solo plan positions.
+        assert all(p[0] != "groupby" for p in plan[1])
+        runs = tuple(_plan_run(p, kern) for p in plan[1])
+
+        def run(leaves, params):
+            return tuple(r(leaves, params) for r in runs)
+        return run
     if kind == "words":
         tree = plan[1]
 
@@ -554,7 +555,26 @@ def _compiled(plan, kern: bool = False):
             return jnp.sum(c, axis=1) if reduce_ else c
     else:
         raise AssertionError(kind)
-    fn = jax.jit(run)
+    return run
+
+
+def _compiled(plan, kern: bool = False):
+    """plan: ("words", tree) | ("count", tree, reduce)
+    | ("bsi_sum", planes_i, tree|None, reduce)
+    | ("row_counts", rows_i, tree|None, reduce)
+    | ("multi", (subplan, ...)) — the batcher's fused program.
+    One jitted fn per structure; `kern` routes resident-leaf hot ops
+    through the Pallas kernels.  With reduce=True the cross-shard sum
+    happens IN the program — under a mesh it lowers to a psum over ICI
+    (the jitted analog of mapReduce's reduceFn); int32-exact up to
+    _REDUCE_MAX_SHARDS shards, the caller's responsibility."""
+    sig = (repr(plan), kern)
+    with _JIT_LOCK:
+        fn = _JIT_CACHE.get(sig)
+        if fn is not None:
+            _JIT_CACHE.move_to_end(sig)
+            return fn
+    fn = jax.jit(_plan_run(plan, kern))
     with _JIT_LOCK:
         _JIT_CACHE[sig] = fn
         while len(_JIT_CACHE) > _JIT_CACHE_MAX:
@@ -950,6 +970,18 @@ class StackedEngine:
         out = np.asarray(self._run(("words", tree), b))
         return out[: len(shards)]  # drop mesh padding shards
 
+    @staticmethod
+    def bsi_sum_host(cnt, pos, neg, red: bool) -> tuple[int, int]:
+        """Combine a ("bsi_sum", ...) program's outputs into exact
+        Python ints (shared by the solo path and the batcher demux)."""
+        pos = np.asarray(pos, dtype=np.int64)
+        neg = np.asarray(neg, dtype=np.int64)
+        if not red:
+            pos, neg = pos.sum(axis=0), neg.sum(axis=0)
+        total = sum((int(p) - int(n)) << i
+                    for i, (p, n) in enumerate(zip(pos, neg)))
+        return int(total), int(np.asarray(cnt, dtype=np.int64).sum())
+
     def bsi_sum(self, idx, field, filter_call, shards: list[int], pre):
         """Sum over `field` under an optional filter tree.  Per-plane
         popcounts reduce across shards in-program; the plane-weighted
@@ -963,13 +995,7 @@ class StackedEngine:
                 return 0, 0
         red = self._reduce_in_program(shards)
         cnt, pos, neg = self._run(("bsi_sum", planes_i, tree, red), b)
-        pos = np.asarray(pos, dtype=np.int64)
-        neg = np.asarray(neg, dtype=np.int64)
-        if not red:
-            pos, neg = pos.sum(axis=0), neg.sum(axis=0)
-        total = sum((int(p) - int(n)) << i
-                    for i, (p, n) in enumerate(zip(pos, neg)))
-        return int(total), int(np.asarray(cnt, dtype=np.int64).sum())
+        return self.bsi_sum_host(cnt, pos, neg, red)
 
     def row_counts(self, idx, rows_stack, filter_call, shards: list[int],
                    pre) -> np.ndarray:

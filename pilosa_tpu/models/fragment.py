@@ -43,6 +43,13 @@ import os as _os
 
 PARANOIA = _os.environ.get("PILOSA_TPU_PARANOIA") == "1"
 
+# process-global monotonic fragment generation: identity that NEVER
+# repeats across delete/recreate (unlike id(), whose freed addresses
+# CPython reuses) — the result cache keys staleness on (gen, version)
+import itertools as _it
+
+_FRAG_GEN = _it.count(1)
+
 
 class Fragment:
     """Host rows + device tile cache for one (index, field, view, shard)."""
@@ -63,6 +70,8 @@ class Fragment:
         # bumps it, and device-side stack caches (executor/stacked.py
         # TileStackCache) compare stamps to detect staleness
         self.version = 0
+        # unique-for-process-lifetime identity (see _FRAG_GEN)
+        self.gen = next(_FRAG_GEN)
         # row_ids is hot on TopN/Rows scans (954 shards x R rows of
         # .any() sweeps = ~GB of host traffic per query); cache it
         # under the same version stamp the device tile cache uses

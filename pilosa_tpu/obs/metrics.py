@@ -102,9 +102,14 @@ class Histogram(_Metric):
     kind = "histogram"
 
     def __init__(self, name: str, help_: str = "",
-                 buckets: tuple = _DEFAULT_BUCKETS):
+                 buckets: tuple = _DEFAULT_BUCKETS,
+                 quantiles: tuple = ()):
         super().__init__(name, help_)
         self.buckets = tuple(sorted(buckets))
+        # bucket-interpolated quantiles rendered as gauge series
+        # (`{name}_p50` etc.) so dashboards get p50/p95/p99 without a
+        # scrape-side histogram_quantile()
+        self.quantiles = tuple(quantiles)
         self._counts: dict[tuple, list[int]] = {}
         self._sum: dict[tuple, float] = {}
         self._n: dict[tuple, int] = {}
@@ -124,6 +129,26 @@ class Histogram(_Metric):
     def count(self, **labels) -> int:
         return self._n.get(_label_key(labels), 0)
 
+    def quantile(self, q: float, **labels) -> float:
+        """Bucket-interpolated quantile estimate (prometheus
+        histogram_quantile semantics: linear within the bucket; the
+        +Inf bucket clamps to the largest finite bound)."""
+        k = _label_key(labels)
+        with self._lock:
+            counts = list(self._counts.get(k, ()))
+            n = self._n.get(k, 0)
+        if not counts or n == 0:
+            return 0.0
+        target = q * n
+        cum, lo = 0.0, 0.0
+        for i, ub in enumerate(self.buckets):
+            c = counts[i]
+            if c and cum + c >= target:
+                return lo + (ub - lo) * (target - cum) / c
+            cum += c
+            lo = ub
+        return self.buckets[-1] if self.buckets else 0.0
+
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} histogram"]
@@ -141,6 +166,13 @@ class Histogram(_Metric):
             out.append(f"{self.name}_bucket{_fmt_labels(lk)} {ns[k]}")
             out.append(f"{self.name}_sum{_fmt_labels(k)} {sums[k]:g}")
             out.append(f"{self.name}_count{_fmt_labels(k)} {ns[k]}")
+        for q in self.quantiles:
+            qn = f"{self.name}_p{q * 100:g}"
+            out.append(f"# HELP {qn} {self.help} (q={q:g} estimate)")
+            out.append(f"# TYPE {qn} gauge")
+            for k in sorted(ns):
+                v = self.quantile(q, **dict(k))
+                out.append(f"{qn}{_fmt_labels(k)} {v:g}")
         return out
 
 
@@ -156,13 +188,20 @@ class MetricsRegistry:
         return self._get(name, Gauge, lambda: Gauge(name, help_))
 
     def histogram(self, name: str, help_: str = "",
-                  buckets: tuple = _DEFAULT_BUCKETS) -> Histogram:
+                  buckets: tuple = _DEFAULT_BUCKETS,
+                  quantiles: tuple = ()) -> Histogram:
         m = self._get(name, Histogram,
-                      lambda: Histogram(name, help_, buckets))
+                      lambda: Histogram(name, help_, buckets, quantiles))
         if m.buckets != tuple(sorted(buckets)):
             raise ValueError(
                 f"histogram {name} already registered with different "
                 f"buckets {m.buckets}")
+        if m.quantiles != tuple(quantiles):
+            # same contract as buckets: a silent drop would make the
+            # caller's _pNN gauge series never render
+            raise ValueError(
+                f"histogram {name} already registered with different "
+                f"quantiles {m.quantiles}")
         return m
 
     def _get(self, name, cls, factory=None):
@@ -223,3 +262,26 @@ GROUPBY_KERNEL = registry.counter(
 GROUPBY_ONEPASS = registry.counter(
     "pilosa_groupby_onepass_total",
     "GroupBy queries served by the one-pass group-code histogram")
+
+# -- serving path (executor/serving.py: micro-batcher + result cache) --
+SERVING_LATENCY = registry.histogram(
+    "pilosa_serving_latency_seconds",
+    "End-to-end serving-path query latency",
+    quantiles=(0.5, 0.95, 0.99))
+SERVING_BATCH_SIZE = registry.histogram(
+    "pilosa_serving_batch_size",
+    "Concurrent queries coalesced per admission window (batch occupancy)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+    quantiles=(0.5, 0.95, 0.99))
+SERVING_BATCH_WAIT = registry.histogram(
+    "pilosa_serving_batch_wait_seconds",
+    "Admission-window wait before a batch dispatches")
+SERVING_QUEUE_DEPTH = registry.gauge(
+    "pilosa_serving_queue_depth",
+    "Queries waiting for batch admission right now")
+RESULT_CACHE = registry.counter(
+    "pilosa_result_cache_total",
+    "Versioned result-cache lookups by outcome (hit/miss/bypass/write)")
+SERVING_BATCHED = registry.counter(
+    "pilosa_serving_batched_total",
+    "Serving-path queries by execution route (fused/direct/cached)")

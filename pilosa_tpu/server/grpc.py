@@ -224,10 +224,13 @@ class GRPCHandler:
 
     def _pql_results(self, request, ctx):
         """Raw executor results (api.query would JSON-serialize them;
-        the wire mapping here needs the typed result objects)."""
+        the wire mapping here needs the typed result objects).  Routed
+        through the serving layer: concurrent RPC handler threads
+        coalesce into shared device dispatches when it is enabled."""
         self._check(ctx, request.index, write=_pql_is_write(request.pql))
         try:
-            return self.api.executor.execute(request.index, request.pql)
+            return self.api.executor.execute_serving(
+                request.index, request.pql)
         except Exception as e:
             ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
 

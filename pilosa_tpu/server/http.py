@@ -51,11 +51,26 @@ class Server:
 
     def __init__(self, holder: Holder | None = None, bind: str = "127.0.0.1",
                  port: int = 0, logger: Logger | None = None,
-                 auth=None, api: API | None = None):
+                 auth=None, api: API | None = None, config=None):
         self._owns_holder = holder is None
         self.holder = holder if holder is not None else Holder()
         self.api = api if api is not None else API(self.holder)
         self.logger = logger or NopLogger()
+        # serving path (executor/serving.py): handler threads route
+        # queries through the cross-query micro-batcher + versioned
+        # result cache.  Defaults come from Config (env-overridable:
+        # PILOSA_TPU_SERVING_BATCHING=0 disables batching,
+        # PILOSA_TPU_SERVING_CACHE_MB=0 the cache).
+        if config is None:
+            from pilosa_tpu import config as cfgmod
+            config = cfgmod.load()
+        if self.api.executor.serving is None and (
+                config.serving_batching or config.serving_cache_mb > 0):
+            self.api.executor.enable_serving(
+                window_s=config.serving_batch_window_ms / 1e3,
+                max_batch=config.serving_batch_max,
+                cache_bytes=config.serving_cache_mb << 20,
+                batching=config.serving_batching)
         # (Authenticator, Authorizer | None) — enables the chkAuthZ
         # middleware in dispatch (http_handler.go chkAuthZ)
         self.auth = auth

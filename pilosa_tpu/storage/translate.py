@@ -12,9 +12,14 @@ and its partitioned index-key layout:
   columns therefore spread deterministically across the shard space,
   which on the TPU build is what spreads them across the device mesh.
 
-Persistence is an append-only JSONL log per store (storage layer v0;
-the native storage library will replace the file format, not the
-semantics).
+Persistence is an append-only JSONL log per store plus a
+snapshot-on-threshold compaction: once ``compact_threshold`` records
+accumulate in the tail log, the full state is written atomically to
+``<path>.snap`` and the log truncates — restart replays the compact
+snapshot + a bounded tail instead of the full append history, and a
+torn final log line (crash mid-append) is dropped rather than
+poisoning the store.  (Storage layer v0; the native storage library
+will replace the file format, not the semantics.)
 """
 
 from __future__ import annotations
@@ -27,6 +32,10 @@ import threading
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 DEFAULT_PARTITION_N = 256
+
+# tail-log records before the next snapshot compaction (0 disables)
+DEFAULT_COMPACT_THRESHOLD = int(os.environ.get(
+    "PILOSA_TPU_TRANSLATE_COMPACT_THRESHOLD", "100000"))
 
 _FNV_OFFSET = 0xCBF29CE484222325
 _FNV_PRIME = 0x100000001B3
@@ -77,32 +86,93 @@ class TranslateStore:
     def __init__(self, path: str | None = None, index: str = "",
                  partition_id: int = -1,
                  partition_n: int = DEFAULT_PARTITION_N,
-                 shard_width: int = SHARD_WIDTH):
+                 shard_width: int = SHARD_WIDTH,
+                 compact_threshold: int | None = None):
         self.path = path
         self.index = index
         self.partition_id = partition_id
         self.partition_n = partition_n
         self.shard_width = shard_width
         self.read_only = False
+        self.compact_threshold = (DEFAULT_COMPACT_THRESHOLD
+                                  if compact_threshold is None
+                                  else compact_threshold)
         self._by_key: dict[str, int] = {}
         self._by_id: dict[int, str] = {}
         self._max_id = 0
         self._lock = threading.RLock()
         self._log = None
+        self._tail_records = 0
         if path:
             self._open()
 
+    @property
+    def snap_path(self) -> str:
+        return self.path + ".snap"
+
     def _open(self):
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        torn = False
+        if os.path.exists(self.snap_path):
+            # the compact snapshot is written via tmp+rename, so it is
+            # either absent or complete — no torn-snapshot handling
+            with open(self.snap_path) as f:
+                snap = json.load(f)
+            for i, k in snap.get("entries", []):
+                self._set(int(i), k)
         if os.path.exists(self.path):
             with open(self.path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
+                lines = f.read().splitlines()
+            last = max((i for i, ln in enumerate(lines) if ln.strip()),
+                       default=-1)
+            for i, line in enumerate(lines):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
                     entry = json.loads(line)
-                    self._set(entry["id"], entry["key"])
+                except ValueError:
+                    if i == last:
+                        # torn tail: the process died mid-append; the
+                        # record never acked, dropping it is correct
+                        torn = True
+                        break
+                    raise
+                self._set(entry["id"], entry["key"])
+                self._tail_records += 1
         self._log = open(self.path, "a")
+        if torn or (self.compact_threshold
+                    and self._tail_records >= self.compact_threshold):
+            # compact now: a torn tail must not be appended after, and
+            # an over-threshold tail means the last run died between
+            # threshold and compaction
+            self._compact_locked()
+
+    def _append_locked(self, id_: int, key: str):
+        self._log.write(json.dumps({"id": id_, "key": key}) + "\n")
+        self._tail_records += 1
+
+    def _maybe_compact_locked(self):
+        if self.compact_threshold and \
+                self._tail_records >= self.compact_threshold:
+            self._compact_locked()
+
+    def _compact_locked(self):
+        """Write the full state atomically to the snapshot file and
+        truncate the tail log (holding the store lock)."""
+        tmp = self.snap_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"index": self.index,
+                       "partition": self.partition_id,
+                       "entries": [[i, k] for i, k in
+                                   sorted(self._by_id.items())]}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.snap_path)
+        if self._log:
+            self._log.close()
+        self._log = open(self.path, "w")  # truncate the replayed tail
+        self._tail_records = 0
 
     def close(self):
         if self._log:
@@ -137,11 +207,11 @@ class TranslateStore:
                         self.partition_n, self.shard_width)
                     self._set(id_, k)
                     if self._log:
-                        self._log.write(json.dumps(
-                            {"id": id_, "key": k}) + "\n")
+                        self._append_locked(id_, k)
                 out[k] = id_
             if self._log:
                 self._log.flush()
+                self._maybe_compact_locked()
         return out
 
     def force_set(self, id_: int, key: str):
@@ -149,8 +219,9 @@ class TranslateStore:
         with self._lock:
             self._set(id_, key)
             if self._log:
-                self._log.write(json.dumps({"id": id_, "key": key}) + "\n")
+                self._append_locked(id_, key)
                 self._log.flush()
+                self._maybe_compact_locked()
 
     def translate_id(self, id_: int) -> str | None:
         return self._by_id.get(id_)
@@ -191,12 +262,12 @@ class TranslateStore:
             self._max_id = 0
             for i, k in snap.get("entries", []):
                 self._set(int(i), k)
-            if self._log:  # rewrite the persisted log to match
-                self._log.close()
-                with open(self.path, "w") as f:
-                    for i, k in sorted(self._by_id.items()):
-                        f.write(json.dumps({"id": i, "key": k}) + "\n")
-                self._log = open(self.path, "a")
+            if self._log:
+                # persist via the compaction path: the on-disk
+                # snapshot + empty tail now reflect exactly the
+                # restored state (a stale .snap would otherwise
+                # resurrect keys the owner deleted)
+                self._compact_locked()
 
 
 class PartitionedTranslator:

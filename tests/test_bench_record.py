@@ -1,0 +1,32 @@
+"""bench.py TPU-record carry-over (VERDICT r05 item 1): a CPU-fallback
+run re-emits the committed BENCH_TPU_RECORD.json verbatim under
+``last_tpu_record`` so TPU evidence survives tunnel outages."""
+
+import json
+
+import bench
+
+
+def test_attach_tpu_record_present(tmp_path):
+    rec = {"metric": "m", "platform": "tpu", "value": 1.23}
+    p = tmp_path / "BENCH_TPU_RECORD.json"
+    p.write_text(json.dumps(rec))
+    out = bench.attach_tpu_record({"metric": "x"}, path=str(p),
+                                  tunnel_down=True)
+    assert out["last_tpu_record"] == rec
+    assert "tunnel unreachable" in out["note"]
+    assert "last_tpu_record is the committed raw record" in out["note"]
+
+
+def test_attach_tpu_record_missing(tmp_path):
+    out = bench.attach_tpu_record(
+        {"metric": "x"}, path=str(tmp_path / "nope.json"))
+    assert "last_tpu_record" not in out
+    assert "no committed TPU record" in out["note"]
+
+
+def test_attach_tpu_record_corrupt(tmp_path):
+    p = tmp_path / "BENCH_TPU_RECORD.json"
+    p.write_text("{truncated")
+    out = bench.attach_tpu_record({"metric": "x"}, path=str(p))
+    assert "JSONDecodeError" in out["last_tpu_record_error"]

@@ -224,3 +224,24 @@ class TestTesthook:
         db.close()
         assert not any(str(tmp_path) in d
                        for d in testhook.audit().get("rbf.DB", []))
+
+
+def test_histogram_quantiles_render():
+    r = MetricsRegistry()
+    lat = r.histogram("lat3", "latency", buckets=(0.01, 0.1, 1.0),
+                      quantiles=(0.5, 0.99))
+    for v in (0.005, 0.02, 0.05, 0.5, 0.9):
+        lat.observe(v)
+    # p50 falls in the (0.01, 0.1] bucket, interpolated
+    q = lat.quantile(0.5)
+    assert 0.01 < q <= 0.1
+    assert lat.quantile(0.99) <= 1.0
+    text = r.render_text()
+    assert "lat3_p50 " in text
+    assert "lat3_p99 " in text
+    assert "# TYPE lat3_p50 gauge" in text
+
+
+def test_histogram_quantile_empty_is_zero():
+    r = MetricsRegistry()
+    assert r.histogram("lat4", "x", quantiles=(0.5,)).quantile(0.5) == 0.0

@@ -1,0 +1,363 @@
+"""Serving-path tests — cross-query dispatch coalescing
+(executor/serving.py): micro-batcher fusion, the versioned result
+cache, and consistency under concurrent writes.
+
+Correctness bar (ISSUE 2): batched and cached execution is bit-exact
+vs per-query execution, a write to a referenced fragment evicts
+exactly the affected cache entries, and a query admitted before a
+write sees a consistent fragment-version snapshot or is re-executed.
+"""
+
+import random
+import threading
+
+import pytest
+
+from pilosa_tpu.api import serialize_result
+from pilosa_tpu.executor.executor import Executor
+from pilosa_tpu.executor.serving import (
+    ResultCache,
+    Uncacheable,
+    field_snapshot,
+    query_fields,
+)
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.models.schema import FieldOptions, FieldType
+from pilosa_tpu.obs import metrics
+from pilosa_tpu.pql import parse
+
+
+def build_holder(track_existence: bool = True) -> Holder:
+    h = Holder()
+    idx = h.create_index("i", track_existence=track_existence)
+    idx.create_field("a")
+    idx.create_field("b")
+    idx.create_field("v", FieldOptions(type=FieldType.INT,
+                                       min=0, max=1000))
+    ex = Executor(h)
+    for c in range(300):
+        ex.execute("i", f"Set({c}, a={c % 4})")
+        ex.execute("i", f"Set({c}, b={c % 6})")
+        ex.execute("i", f"Set({c}, v={(c * 7) % 97})")
+    return h
+
+
+@pytest.fixture(scope="module")
+def holder():
+    return build_holder()
+
+
+QUERIES = [
+    "Count(Row(a=1))",
+    "Count(Intersect(Row(a=1), Row(b=2)))",
+    "Count(Union(Row(a=0), Row(b=5)))",
+    "Count(Difference(Row(a=2), Row(b=1)))",
+    "Count(Xor(Row(a=3), Row(b=0)))",
+    "Count(Not(Row(a=1)))",
+    "Count(Row(v > 50))",
+    "Count(Row(v >= 12))",
+    "Count(Row(v == 14))",
+    "Row(a=2)",
+    "Union(Row(a=1), Row(b=3))",
+    "Intersect(Row(a=1), Row(v < 40))",
+    "TopN(a, n=3)",
+    "TopN(a, Row(b=1), n=2)",
+    "TopK(b, k=4)",
+    "Sum(Row(a=1), field=v)",
+    "Sum(field=v)",
+    "All()",
+]
+
+
+def results_of(ex, q, serving=False):
+    fn = ex.execute_serving if serving else ex.execute
+    return [serialize_result(r) for r in fn("i", q)]
+
+
+def test_solo_bit_exact(holder):
+    """Every query through the serving path (cache cold AND warm)
+    matches per-query execution exactly."""
+    plain = Executor(holder)
+    srv = Executor(holder)
+    srv.enable_serving(window_s=0.0, max_batch=8)
+    for q in QUERIES:
+        want = results_of(plain, q)
+        assert results_of(srv, q, serving=True) == want, q   # cold
+        assert results_of(srv, q, serving=True) == want, q   # cached
+
+
+def test_concurrent_batched_bit_exact(holder):
+    """N concurrent distinct queries fuse into shared dispatches and
+    every one demuxes to its own exact result."""
+    plain = Executor(holder)
+    srv = Executor(holder)
+    layer = srv.enable_serving(window_s=0.05, max_batch=64,
+                               cache_bytes=0)  # no cache: force fusion
+    want = {q: results_of(plain, q) for q in QUERIES}
+    batches_before = metrics.SERVING_BATCH_SIZE.count()
+    got = {}
+    lock = threading.Lock()
+    barrier = threading.Barrier(len(QUERIES))
+
+    def run(q):
+        barrier.wait()
+        r = results_of(srv, q, serving=True)
+        with lock:
+            got[q] = r
+
+    threads = [threading.Thread(target=run, args=(q,)) for q in QUERIES]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert got == want
+    # coalescing actually happened: fewer batches than queries
+    assert metrics.SERVING_BATCH_SIZE.count() - batches_before \
+        < len(QUERIES)
+    assert layer.cache is None
+
+
+def test_cache_hit_skips_execution(holder):
+    srv = Executor(holder)
+    layer = srv.enable_serving(window_s=0.0, max_batch=8)
+    q = "Count(Intersect(Row(a=1), Row(b=2)))"
+    first = results_of(srv, q, serving=True)
+    h0 = layer.cache.hits
+    assert results_of(srv, q, serving=True) == first
+    assert layer.cache.hits == h0 + 1
+
+
+def test_write_invalidates_exactly():
+    """Acceptance-pinned: a write to a referenced fragment evicts
+    exactly the entries that read it — other entries stay hot."""
+    h = build_holder(track_existence=False)
+    srv = Executor(h)
+    layer = srv.enable_serving(window_s=0.0, max_batch=8)
+    plain = Executor(h)
+    srv.execute_serving("i", "Count(Row(a=1))")
+    srv.execute_serving("i", "Count(Row(b=1))")
+    srv.execute_serving("i", "Sum(field=v)")
+    assert len(layer.cache) == 3
+    # write touches field a only (no existence field on this index)
+    srv.execute_serving("i", "Set(5000, a=1)")
+    keys = {k[1] for k in layer.cache._entries}
+    assert keys == {"[Count(Row(b=1))]", "[Sum(_field='v')]"}
+    # the evicted entry recomputes correctly, the survivors still hit
+    assert results_of(srv, "Count(Row(a=1))", serving=True) == \
+        results_of(plain, "Count(Row(a=1))")
+    h0 = layer.cache.hits
+    srv.execute_serving("i", "Count(Row(b=1))")
+    assert layer.cache.hits == h0 + 1
+
+
+def test_cache_misses_after_field_drop_and_recreate():
+    """Staleness must survive delete+recreate: fragment generation
+    stamps (not reusable id()s) key the snapshot."""
+    h = build_holder(track_existence=False)
+    srv = Executor(h)
+    layer = srv.enable_serving(window_s=0.0, max_batch=8)
+    q = "Count(Row(a=1))"
+    before = results_of(srv, q, serving=True)
+    assert before[0] > 0
+    idx = h.index("i")
+    idx.delete_field("a")
+    idx.create_field("a")
+    ex2 = Executor(h)
+    ex2.execute("i", "Set(1, a=1)")
+    got = results_of(srv, q, serving=True)
+    assert got == [1] != before
+    assert layer.cache.misses >= 2
+
+
+def test_cache_lazy_invalidation_on_direct_write(holder):
+    """Writes that bypass the serving layer (imports, direct
+    Executor.execute) still invalidate via the version guard."""
+    h = build_holder(track_existence=False)
+    srv = Executor(h)
+    layer = srv.enable_serving(window_s=0.0, max_batch=8)
+    q = "Count(Row(a=1))"
+    before = results_of(srv, q, serving=True)
+    Executor(h).execute("i", "Set(6000, a=1)")   # not via serving
+    after = results_of(srv, q, serving=True)
+    assert after[0] == before[0] + 1
+    assert layer.cache.misses >= 2
+
+
+def test_uncacheable_and_dep_walk(holder):
+    idx = holder.index("i")
+    fields = query_fields(idx, parse("Count(Intersect(Row(a=1), "
+                                     "Row(v > 3)))"))
+    assert {"a", "v"} <= set(fields)
+    # Not() reads the existence field
+    fields = query_fields(idx, parse("Count(Not(Row(a=1)))"))
+    assert "_exists" in fields
+    with pytest.raises(Uncacheable):
+        query_fields(idx, parse("Options(Row(a=1), shards=[0])"))
+
+
+def test_result_cache_lru_accounting():
+    c = ResultCache(max_bytes=1 << 10)
+    import numpy as np
+    from pilosa_tpu.executor.results import RowResult
+    h = build_holder(track_existence=False)
+    idx = h.index("i")
+    snap = field_snapshot(idx, frozenset(["a"]))
+    for i in range(64):
+        r = RowResult(idx.width)
+        r.segments[0] = np.zeros(16, dtype=np.uint32)
+        c.put(("i", f"q{i}", None), frozenset(["a"]), snap, [r])
+    assert c.nbytes <= c.max_bytes
+    assert c.nbytes == sum(e[3] for e in c._entries.values())
+
+
+def _worker_counts(srv, n_iters, out, errs):
+    try:
+        prev = -1
+        for _ in range(n_iters):
+            (n,) = srv.execute_serving("i", "Count(Row(a=9))")
+            # writes only ADD bits to row 9, so any version-consistent
+            # sequence of counts is non-decreasing; a torn or stale
+            # read would break monotonicity
+            assert n >= prev, (n, prev)
+            prev = n
+            out.append(n)
+    except Exception as e:  # pragma: no cover - failure reporting
+        errs.append(e)
+
+
+def test_stress_concurrent_reads_and_writes():
+    """Satellite: hammer Executor.execute_serving from N threads while
+    a writer interleaves Sets; assert version-consistent (monotone)
+    results and intact cache accounting afterwards."""
+    h = build_holder(track_existence=False)
+    srv = Executor(h)
+    layer = srv.enable_serving(window_s=0.0005, max_batch=16)
+    writer_ex = Executor(h)
+    n_writes, n_readers, n_iters = 120, 6, 40
+    errs: list = []
+    outs = [[] for _ in range(n_readers)]
+
+    def writer():
+        try:
+            for c in range(n_writes):
+                writer_ex.execute("i", f"Set({c}, a=9)")
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer)] + [
+        threading.Thread(target=_worker_counts,
+                         args=(srv, n_iters, outs[i], errs))
+        for i in range(n_readers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    # final state exact
+    (n,) = Executor(h).execute("i", "Count(Row(a=9))")
+    assert n == n_writes
+    # every reader converged to a value <= final, monotonically
+    for o in outs:
+        assert o == sorted(o)
+        assert 0 <= o[-1] <= n_writes
+    # cache accounting intact: no lost bytes, no over-budget pinning
+    eng = srv.stacked
+    assert eng.cache.nbytes <= eng.cache.max_bytes
+    with eng.cache._lock:
+        assert eng.cache.nbytes == sum(
+            e[2] for e in eng.cache._entries.values())
+    rc = layer.cache
+    with rc._lock:
+        assert rc.nbytes == sum(e[3] for e in rc._entries.values())
+    assert rc.nbytes <= rc.max_bytes
+    from pilosa_tpu.executor import stacked as stk
+    assert len(stk._JIT_CACHE) <= stk._JIT_CACHE_MAX
+
+
+def test_property_random_trees_with_writes():
+    """Seeded random bitmap/aggregate trees: serving (batched + cached)
+    vs per-query execution stays bit-exact across interleaved
+    writes."""
+    rng = random.Random(42)
+    h = build_holder()
+    plain = Executor(h)
+    srv = Executor(h)
+    srv.enable_serving(window_s=0.0, max_batch=8)
+
+    def tree(depth):
+        if depth <= 0 or rng.random() < 0.4:
+            f, r = rng.choice([("a", rng.randrange(4)),
+                               ("b", rng.randrange(6))])
+            if rng.random() < 0.25:
+                op = rng.choice([">", "<", ">=", "<=", "=="])
+                return f"Row(v {op} {rng.randrange(97)})"
+            return f"Row({f}={r})"
+        op = rng.choice(["Union", "Intersect", "Difference", "Xor"])
+        kids = ", ".join(tree(depth - 1)
+                         for _ in range(rng.randrange(2, 4)))
+        return f"{op}({kids})"
+
+    def query():
+        t = tree(2)
+        wrap = rng.randrange(4)
+        if wrap == 0:
+            return f"Count({t})"
+        if wrap == 1:
+            return f"TopN(a, {t}, n=3)"
+        if wrap == 2:
+            return f"Sum({t}, field=v)"
+        return t
+
+    for round_ in range(6):
+        for _ in range(12):
+            q = query()
+            want = results_of(plain, q)
+            assert results_of(srv, q, serving=True) == want, q
+            assert results_of(srv, q, serving=True) == want, q
+        # interleave writes (through serving: sweeps the cache)
+        for _ in range(5):
+            c = rng.randrange(400)
+            f, r = rng.choice([("a", rng.randrange(4)),
+                               ("b", rng.randrange(6))])
+            srv.execute_serving("i", f"Set({c}, {f}={r})")
+
+
+def test_metrics_endpoint_exports_serving_histograms():
+    """Satellite: p50/p95/p99 latency + batch occupancy reach the
+    existing /metrics endpoint."""
+    import http.client
+
+    from pilosa_tpu.server import Server
+
+    with Server() as s:
+        s.start()
+        c = http.client.HTTPConnection("127.0.0.1", s.port, timeout=10)
+        c.request("POST", "/index/m1", body="{}")
+        c.getresponse().read()
+        c.request("POST", "/index/m1/field/f", body="{}")
+        c.getresponse().read()
+        import json as _json
+        for q in ("Set(1, f=1)", "Count(Row(f=1))", "Count(Row(f=1))"):
+            c.request("POST", "/index/m1/query",
+                      body=_json.dumps({"query": q}),
+                      headers={"Content-Type": "application/json"})
+            r = c.getresponse()
+            assert r.status == 200, r.read()
+            r.read()
+        c.request("GET", "/metrics")
+        text = c.getresponse().read().decode()
+        c.close()
+    for needle in ("pilosa_serving_latency_seconds_p50",
+                   "pilosa_serving_latency_seconds_p95",
+                   "pilosa_serving_latency_seconds_p99",
+                   "pilosa_serving_batch_size",
+                   "pilosa_result_cache_total"):
+        assert needle in text, needle
+
+
+def test_http_server_serving_enabled_by_default():
+    from pilosa_tpu.server import Server
+
+    with Server() as s:
+        assert s.api.executor.serving is not None
+        assert s.api.executor.serving.cache is not None
