@@ -454,6 +454,127 @@ def serving_gauntlet(h, clients_list=(1, 8, 32),
     return out
 
 
+def mixed_rw_gauntlet(h, n_readers: int = 32,
+                      write_rates=(10, 100, 1000),
+                      duration_s: float = 1.2) -> dict:
+    """Mixed-workload serving: N concurrent readers + 1 writer doing
+    point writes at each target rate, A/B with the incremental stack
+    maintenance path (delta patching, executor/stacked.py) on vs off.
+    Without patching every point write invalidates whole device
+    stacks and the next read pays a full O(S*W) restack + upload;
+    with it the read pays an O(delta) patch.  Reports read p50/p99
+    and restacked-bytes-per-write from the TileStackCache counters —
+    the direct attribution of the write-path win."""
+    import statistics as stats
+    import threading
+
+    from pilosa_tpu.executor.executor import Executor
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    read_qs = [
+        "Count(Intersect(Row(a=1), Row(b=1)))",
+        "Count(Row(a=1))",
+        "TopN(t, n=10)",
+        "Sum(Row(a=1), field=age)",
+    ]
+    out: dict = {}
+    prev_flag = os.environ.get("PILOSA_TPU_STACK_PATCH")
+    try:
+        for patch_on in (True, False):
+            os.environ["PILOSA_TPU_STACK_PATCH"] = \
+                "1" if patch_on else "0"
+            ex = Executor(h)
+            cache = ex.stacked.cache
+            for q in read_qs:  # warm: compile + resident stacks
+                ex.execute("bench", q)
+            mode_key = "patch_on" if patch_on else "patch_off"
+            for rate in write_rates:
+                patched0, rebuilt0 = (cache.patched_bytes,
+                                      cache.rebuilt_bytes)
+                lat: list[float] = []
+                lock = threading.Lock()
+                writes = 0
+                stop_t = time.perf_counter() + duration_s
+                barrier = threading.Barrier(n_readers + 1)
+
+                def writer():
+                    nonlocal writes
+                    barrier.wait()
+                    period = 1.0 / rate
+                    nxt, i = time.perf_counter(), 0
+                    while time.perf_counter() < stop_t:
+                        # toggle pairs over advancing columns so
+                        # (nearly) every write flips a bit and bumps
+                        # the fragment version — a no-op Set would
+                        # invalidate nothing and measure nothing
+                        col = (i // 2) % SHARD_WIDTH
+                        op = "Set" if i % 2 == 0 else "Clear"
+                        ex.execute("bench", f"{op}({col}, a=1)")
+                        writes += 1
+                        i += 1
+                        nxt += period
+                        d = nxt - time.perf_counter()
+                        if d > 0:
+                            time.sleep(d)
+
+                def reader(ci: int):
+                    my: list[float] = []
+                    barrier.wait()
+                    i = ci
+                    while time.perf_counter() < stop_t:
+                        q = read_qs[i % len(read_qs)]
+                        i += 1
+                        t0 = time.perf_counter()
+                        ex.execute("bench", q)
+                        my.append(time.perf_counter() - t0)
+                    with lock:
+                        lat.extend(my)
+
+                threads = [threading.Thread(target=writer)] + [
+                    threading.Thread(target=reader, args=(ci,))
+                    for ci in range(n_readers)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                lat.sort()
+                n = len(lat)
+                pb = cache.patched_bytes - patched0
+                rb = cache.rebuilt_bytes - rebuilt0
+                cell = {
+                    "reads": n,
+                    "writes": writes,
+                    "read_p50_ms": round(lat[n // 2] * 1e3, 3)
+                    if n else None,
+                    "read_p99_ms": round(
+                        lat[min(n - 1, int(n * 0.99))] * 1e3, 3)
+                    if n else None,
+                    "read_mean_ms": round(stats.fmean(lat) * 1e3, 3)
+                    if n else None,
+                    "restacked_bytes_per_write": round(
+                        (pb + rb) / writes) if writes else None,
+                    "patched_bytes": pb,
+                    "rebuilt_bytes": rb,
+                }
+                out.setdefault(f"w{rate}", {})[mode_key] = cell
+                log(f"mixed-rw w{rate}/s {mode_key}: "
+                    f"p50={cell['read_p50_ms']}ms "
+                    f"p99={cell['read_p99_ms']}ms "
+                    f"restacked/write={cell['restacked_bytes_per_write']}B "
+                    f"({n} reads, {writes} writes)")
+    finally:
+        if prev_flag is None:
+            os.environ.pop("PILOSA_TPU_STACK_PATCH", None)
+        else:
+            os.environ["PILOSA_TPU_STACK_PATCH"] = prev_flag
+    for rate_key, ab in out.items():
+        on, off = ab.get("patch_on"), ab.get("patch_off")
+        if on and off and on["read_p50_ms"]:
+            ab["read_p50_speedup"] = round(
+                off["read_p50_ms"] / on["read_p50_ms"], 2)
+    return out
+
+
 def _preview(res):
     r = res[0]
     if isinstance(r, list):
@@ -488,6 +609,9 @@ def main() -> None:
     # concurrent-serving A/B: the dispatch-coalescing serving path
     # (executor/serving.py) vs per-query execution, same holder
     serving = serving_gauntlet(h)
+    # mixed read/write gauntlet: incremental stack maintenance
+    # (delta patching) A/B under 32 readers + 1 point writer
+    mixed = mixed_rw_gauntlet(h)
     # RTT-independent device time for the sub-RTT north-star scans
     cal = loop_calibrate(h) if on_tpu else None
 
@@ -546,6 +670,10 @@ def main() -> None:
         # concurrent-serving gauntlet: QPS + p50/p99 at 1/8/32
         # clients, serving path (batcher + result cache) on vs off
         "serving_gauntlet": serving,
+        # mixed read/write gauntlet: 32 readers + 1 point writer at
+        # 10/100/1000 writes/s, incremental stack maintenance (delta
+        # patching) on vs off — read p50/p99 + restacked bytes/write
+        "mixed_rw_gauntlet": mixed,
     }
     if cal is not None:
         result["loop_calibrated_device_ms"] = {

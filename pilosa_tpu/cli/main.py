@@ -56,6 +56,7 @@ def cmd_server(args) -> int:
         "long_query_time": args.long_query_time,
     })
     cfg.apply_kernel_setting()
+    cfg.apply_stack_settings()
     holder = Holder(path=cfg.data_dir) if cfg.data_dir else Holder()
     holder.load_schema()
     auth = None

@@ -42,6 +42,15 @@ class Config:
     serving_batch_window_ms: float = 1.0
     serving_batch_max: int = 32
     serving_cache_mb: int = 64
+    # incremental stack maintenance (executor/stacked.py delta
+    # patching + models/fragment.py delta log): patch device-resident
+    # stacks on write instead of rebuilding them.  delta-log-max
+    # bounds the per-fragment mutation log (older snapshots fall back
+    # to slice rebuilds); patch-max-frac is the dirty fraction past
+    # which one dense rebuild upload beats scattering runs.
+    stack_patch: bool = True
+    stack_delta_log_max: int = 256
+    stack_patch_max_frac: float = 0.5
 
     def apply_kernel_setting(self):
         """Translate tpu_kernels into the Pallas dispatch env flag.
@@ -51,6 +60,17 @@ class Config:
             os.environ["PILOSA_TPU_PALLAS"] = "1"
         elif self.tpu_kernels == "off":
             os.environ["PILOSA_TPU_PALLAS"] = "0"
+
+    def apply_stack_settings(self):
+        """Push the [stacked] knobs into the runtime modules (the env
+        flag for the A/B toggle, module globals for the numeric
+        bounds — both read dynamically by the hot paths)."""
+        os.environ["PILOSA_TPU_STACK_PATCH"] = \
+            "1" if self.stack_patch else "0"
+        from pilosa_tpu.executor import stacked
+        from pilosa_tpu.models import fragment
+        fragment.DELTA_LOG_MAX = int(self.stack_delta_log_max)
+        stacked._PATCH_MAX_FRAC = float(self.stack_patch_max_frac)
 
 
 # TOML key (possibly [table] key) -> Config attribute
@@ -69,6 +89,9 @@ _TOML_KEYS = {
     "serving.batch-window-ms": "serving_batch_window_ms",
     "serving.batch-max": "serving_batch_max",
     "serving.cache-mb": "serving_cache_mb",
+    "stacked.patch": "stack_patch",
+    "stacked.delta-log-max": "stack_delta_log_max",
+    "stacked.patch-max-frac": "stack_patch_max_frac",
 }
 
 ENV_PREFIX = "PILOSA_TPU_"

@@ -121,25 +121,74 @@ def _dep_fields(idx, call: Call, out: set) -> None:
         _dep_fields(idx, c, out)
 
 
-def _write_fields(q: Query) -> set | None:
-    """Fields a write query touches (for the targeted cache sweep),
-    or None when the write's reach cannot be bounded (Delete removes
-    columns from every field).  Conservative: unknown shapes also
-    return None, which sweeps everything."""
+def _write_targets(idx, q: Query) -> tuple[set | None, set | None]:
+    """(fields, shards) a write query touches — the targeted cache
+    sweep.  fields None: reach unbounded (Delete removes columns from
+    every field; unknown shapes likewise).  shards None: every shard
+    of the fields (Store/ClearRow span the whole row; keyed columns
+    resolve through the translator).  A point Set/Clear with integer
+    columns names exactly the (field, shard) slices its delta
+    dirtied — the sweep then compares only those fragments' stamps
+    instead of re-walking each entry's whole read set."""
     fields: set = set()
+    shards: set | None = set()
     for c in q.calls:
         if c.name not in _WRITE_CALLS or c.name == "Delete":
-            return None
+            return None, None
         fk, _ = c.field_arg()
         if fk is not None:
             fields.add(fk)
         v = c.args.get("_field")
         if isinstance(v, str):
             fields.add(v)
+        col = c.args.get("_col")
+        if (shards is not None and idx is not None
+                and c.name in ("Set", "Clear")
+                and isinstance(col, int)
+                and not isinstance(col, bool)):
+            shards.add(col // idx.width)
+        else:
+            shards = None
     # Set marks column existence; Store may create the target field —
     # both can stale existence-reading entries
     fields.add(EXISTENCE_FIELD)
-    return fields
+    return fields, shards
+
+
+def _slices_stale(idx, ent_fields: frozenset, snap: tuple,
+                  fields: set, shards: set) -> bool:
+    """Exact staleness of one cache entry against a POINT write:
+    compare only the written (field, shard) fragments' (gen, version)
+    stamps with the entry's snapshot — O(written slices), not
+    O(entry read set x views x shards).  Sound because the caller
+    knows the write touched nothing outside (fields x shards); every
+    other write path still hits the full-snapshot comparison at
+    get()-time."""
+    smap: dict = {}
+    absent: set = set()
+    for e in snap:
+        if len(e) == 2:
+            absent.add(e[0])
+        else:
+            smap[(e[0], e[1], e[2])] = (e[3], e[4])
+    for fname in fields & ent_fields:
+        f = idx.fields.get(fname)
+        if f is None:
+            if fname not in absent:
+                return True  # field vanished since the snapshot
+            continue
+        if fname in absent:
+            return True  # snapshotted as absent, exists now
+        for vname in list(f.views):
+            v = f.views.get(vname)
+            if v is None:
+                continue
+            for s in shards:
+                fr = v.fragments.get(s)
+                cur = None if fr is None else (fr.gen, fr.version)
+                if smap.get((fname, vname, s)) != cur:
+                    return True
+    return False
 
 
 def query_fields(idx, q: Query) -> frozenset:
@@ -278,22 +327,34 @@ class ResultCache:
                 _, (_, _, _, nb) = self._entries.popitem(last=False)
                 self._bytes -= nb
 
-    def sweep(self, holder, touched: set | None = None) -> int:
+    def sweep(self, holder, touched: set | None = None,
+              shards: set | None = None) -> int:
         """Evict exactly the entries whose snapshot is stale (called
         after serving-path writes).  `touched` narrows the scan to
-        entries whose read set intersects the written fields — entries
-        a write cannot have staled are not re-snapshotted, so per-Set
-        sweep cost tracks relevance, not cache occupancy (lazy get-
-        time validation still covers every other write path).
-        Returns the eviction count."""
+        entries whose read set intersects the written fields; `shards`
+        (a point write's delta naming exactly the (field, shard)
+        slices it dirtied) further narrows the staleness test to those
+        fragments' stamps — entries a write cannot have staled are not
+        re-snapshotted, so per-Set sweep cost tracks relevance, not
+        cache occupancy (lazy get-time validation still covers every
+        other write path).  Returns the eviction count."""
         with self._lock:
             items = list(self._entries.items())
         evicted = 0
         for key, ent in items:
             if touched is not None and not (ent[0] & touched):
                 continue
+            if (shards is not None and key[2] is not None
+                    and not (set(key[2]) & shards)):
+                continue  # explicit-shard query outside the write
             idx = holder.index(key[0])
-            stale = idx is None or field_snapshot(idx, ent[0]) != ent[1]
+            if idx is None:
+                stale = True
+            elif shards is not None and touched is not None:
+                stale = _slices_stale(idx, ent[0], ent[1], touched,
+                                      shards)
+            else:
+                stale = field_snapshot(idx, ent[0]) != ent[1]
             if stale:
                 with self._lock:
                     cur = self._entries.get(key)
@@ -450,7 +511,8 @@ class ServingLayer:
                 return ex.execute(index, q, shards)
             finally:
                 if self.cache is not None:
-                    self.cache.sweep(ex.holder, _write_fields(q))
+                    wf, ws = _write_targets(ex.holder.index(index), q)
+                    self.cache.sweep(ex.holder, wf, ws)
                     metrics.RESULT_CACHE.inc(outcome="write")
         # span on the CALLER's thread so the long-query log keeps its
         # executor.Execute root even for fused/cached serves (the

@@ -41,6 +41,7 @@ local split has the same shape: fast path plus fallback).
 
 from __future__ import annotations
 
+import os
 import threading
 from collections import OrderedDict
 
@@ -49,6 +50,7 @@ import jax
 import jax.numpy as jnp
 
 from pilosa_tpu.models.view import VIEW_STANDARD
+from pilosa_tpu.obs import metrics
 from pilosa_tpu.ops import bitmap as bm
 from pilosa_tpu.ops import bsi as bsi_ops
 from pilosa_tpu.ops import kernels
@@ -69,13 +71,34 @@ class Unstackable(Exception):
 # tile-stack cache
 # ---------------------------------------------------------------------------
 
+def _patch_enabled() -> bool:
+    """Incremental stack maintenance: stale device stacks are delta-
+    patched in place of a full host restack + re-upload.
+    PILOSA_TPU_STACK_PATCH=0 restores the rebuild-on-write behavior
+    (the bench A/B switch; config.py [stacked] patch)."""
+    return os.environ.get("PILOSA_TPU_STACK_PATCH", "1") != "0"
+
+
+# Dirty fraction past which patching loses to one contiguous rebuild
+# upload: scattering most of a stack word-run by word-run costs more
+# dispatch + scatter overhead than a single dense H2D transfer.
+_PATCH_MAX_FRAC = float(os.environ.get("PILOSA_TPU_PATCH_MAX_FRAC",
+                                       "0.5"))
+
+
 class TileStackCache:
     """LRU byte-bounded cache of device-resident shard stacks.
 
     An entry is keyed by (index, field, view-set, row, shards, mesh
-    epoch) and guarded by the tuple of contributing fragment versions:
-    any host write bumps the fragment version (models/fragment.py) and
-    the next access rebuilds just that stack.  Eviction is LRU over
+    epoch) and guarded by the tuple of contributing fragment
+    (gen, version) stamps: any host write bumps the fragment version
+    (models/fragment.py).  On a version mismatch the entry is first
+    offered to `patcher` — the incremental write path, which applies
+    the fragments' delta logs ON DEVICE (O(delta) upload) and falls
+    back to `build` (full host restack + O(S*W) upload) only when the
+    log can't prove coverage.  Builds and patches are single-flight
+    per key: concurrent misses on one key wait for the one builder
+    instead of stacking N identical uploads.  Eviction is LRU over
     bytes — the HBM analog of the reference's rank-cache residency
     policy (cache.go:130): hot query rows stay device-resident, cold
     ones re-upload on demand.
@@ -89,36 +112,82 @@ class TileStackCache:
         # servers; the LRU's linked list is not safe to mutate from
         # two handler threads at once
         self._lock = threading.Lock()
+        # per-key single-flight latches (key -> Event)
+        self._building: dict = {}
         self.hits = 0
-        self.misses = 0
+        self.misses = 0          # every non-hit access
+        self.patches = 0         # misses served by a delta patch
+        self.full_rebuilds = 0   # misses served by build()
+        self.patched_bytes = 0   # words uploaded via patch runs
+        self.rebuilt_bytes = 0   # full stack bytes re-uploaded
 
-    def get(self, key, versions: tuple, build):
-        with self._lock:
-            ent = self._entries.get(key)
-            if ent is not None and ent[0] == versions:
-                self._entries.move_to_end(key)
-                self.hits += 1
-                return ent[1]
-            self.misses += 1
-        arr = build()  # outside the lock: stack + device upload is slow
-        nbytes = int(np.prod(arr.shape)) * arr.dtype.itemsize
-        with self._lock:
-            old = self._entries.pop(key, None)
-            if old is not None:
-                self._bytes -= old[2]
-            if nbytes > self.max_bytes:
-                # an entry that alone exceeds the budget is never
-                # cached (it would pin the cache over budget forever);
-                # the caller still gets the freshly built stack
-                return arr
-            self._entries[key] = (versions, arr, nbytes)
-            self._bytes += nbytes
-            # the new entry is most-recent so it is popped last, and
-            # since nbytes <= max_bytes the loop stops before it
-            while self._bytes > self.max_bytes and self._entries:
-                _, (_, _, nb) = self._entries.popitem(last=False)
-                self._bytes -= nb
-        return arr
+    def get(self, key, versions: tuple, build, patcher=None):
+        while True:
+            with self._lock:
+                ent = self._entries.get(key)
+                if ent is not None and ent[0] == versions:
+                    self._entries.move_to_end(key)
+                    self.hits += 1
+                    metrics.STACK_CACHE.inc(outcome="hit")
+                    return ent[1]
+                ev = self._building.get(key)
+                if ev is None:
+                    ev = self._building[key] = threading.Event()
+                    stale = ent
+                    self.misses += 1
+                    metrics.STACK_CACHE.inc(outcome="miss")
+                    break
+            # single-flight: another thread is building/patching this
+            # key — wait for its result, then re-check (it may have
+            # built an older version than this access wants)
+            metrics.STACK_CACHE.inc(outcome="wait")
+            ev.wait()
+        try:
+            # build/patch OUTSIDE the lock: restack + upload is slow
+            arr = None
+            if stale is not None and patcher is not None:
+                try:
+                    patched = patcher(stale[1], stale[0])
+                except Exception:
+                    patched = None  # any patch failure → full rebuild
+                if patched is not None:
+                    arr, pbytes = patched
+                    with self._lock:  # single-flight is per-KEY only
+                        self.patches += 1
+                        self.patched_bytes += pbytes
+                    metrics.STACK_CACHE.inc(outcome="patch")
+                    metrics.STACK_MAINT_BYTES.inc(pbytes,
+                                                  kind="patched")
+            if arr is None:
+                arr = build()
+                nb = int(np.prod(arr.shape)) * arr.dtype.itemsize
+                with self._lock:
+                    self.full_rebuilds += 1
+                    self.rebuilt_bytes += nb
+                metrics.STACK_CACHE.inc(outcome="rebuild")
+                metrics.STACK_MAINT_BYTES.inc(nb, kind="rebuilt")
+            nbytes = int(np.prod(arr.shape)) * arr.dtype.itemsize
+            with self._lock:
+                old = self._entries.pop(key, None)
+                if old is not None:
+                    self._bytes -= old[2]
+                if nbytes > self.max_bytes:
+                    # an entry that alone exceeds the budget is never
+                    # cached (it would pin the cache over budget
+                    # forever); the caller still gets the fresh stack
+                    return arr
+                self._entries[key] = (versions, arr, nbytes)
+                self._bytes += nbytes
+                # the new entry is most-recent so it is popped last,
+                # and since nbytes <= max_bytes the loop stops first
+                while self._bytes > self.max_bytes and self._entries:
+                    _, (_, _, nb) = self._entries.popitem(last=False)
+                    self._bytes -= nb
+            return arr
+        finally:
+            with self._lock:
+                self._building.pop(key, None)
+            ev.set()
 
     def clear(self):
         with self._lock:
@@ -151,8 +220,27 @@ _NARY_OPS = {
 
 # jitted wrappers around kernels.groupby_sum keyed by static shape
 # facts (through a high-RTT tunnel, an un-jitted call pays one
-# dispatch per pad/transpose around the pallas_call)
-_GB_KERNEL_JIT: dict = {}
+# dispatch per pad/transpose around the pallas_call).  Bounded LRU
+# like _JIT_CACHE: a long-lived server sweeping GroupBy shapes must
+# not accumulate executables without limit.
+_GB_KERNEL_JIT: OrderedDict = OrderedDict()
+_GB_KERNEL_JIT_MAX = 128
+_GB_KERNEL_LOCK = threading.Lock()
+
+
+def _gb_jit_get(key):
+    with _GB_KERNEL_LOCK:
+        fn = _GB_KERNEL_JIT.get(key)
+        if fn is not None:
+            _GB_KERNEL_JIT.move_to_end(key)
+        return fn
+
+
+def _gb_jit_put(key, fn):
+    with _GB_KERNEL_LOCK:
+        _GB_KERNEL_JIT[key] = fn
+        while len(_GB_KERNEL_JIT) > _GB_KERNEL_JIT_MAX:
+            _GB_KERNEL_JIT.popitem(last=False)
 
 # one-pass group-code GroupBy bounds: the dense code space is
 # 2^sum(ceil(log2 R_f)) — the host/XLA histogram tolerates up to 2^20
@@ -213,7 +301,7 @@ def _groupby_onepass_jit(use_kernel: bool, has_planes: bool,
     ONE flat histogram array out (one fetch round trip)."""
     key = ("onepass", use_kernel, has_planes, has_filter, signed,
            n_codes)
-    fn = _GB_KERNEL_JIT.get(key)
+    fn = _gb_jit_get(key)
     if fn is not None:
         return fn
 
@@ -229,7 +317,7 @@ def _groupby_onepass_jit(use_kernel: bool, has_planes: bool,
         return jnp.concatenate([c, n, p.ravel(), g.ravel()])
 
     fn = jax.jit(run)
-    _GB_KERNEL_JIT[key] = fn
+    _gb_jit_put(key, fn)
     return fn
 
 
@@ -246,7 +334,7 @@ def _groupby_onepass_shard_map(mesh, use_kernel: bool, has_planes: bool,
 
     key = ("onepass_mesh", id(mesh), use_kernel, has_planes,
            has_filter, signed, n_codes)
-    fn = _GB_KERNEL_JIT.get(key)
+    fn = _gb_jit_get(key)
     if fn is not None:
         return fn
     axes = ("rows", "shards")
@@ -271,7 +359,7 @@ def _groupby_onepass_shard_map(mesh, use_kernel: bool, has_planes: bool,
 
     fn = jax.jit(shard_map_nocheck(
         body, mesh=mesh, in_specs=tuple(in_specs), out_specs=P(None)))
-    _GB_KERNEL_JIT[key] = fn
+    _gb_jit_put(key, fn)
     return fn
 
 
@@ -285,7 +373,7 @@ def _groupby_kernel_shard_map(mesh, nf: int, has_planes: bool,
     from pilosa_tpu.parallel.mesh import shard_map_nocheck
 
     key = (id(mesh), nf, has_planes, signed)
-    fn = _GB_KERNEL_JIT.get(key)
+    fn = _gb_jit_get(key)
     if fn is not None:
         return fn
     axes = ("rows", "shards")
@@ -308,7 +396,7 @@ def _groupby_kernel_shard_map(mesh, nf: int, has_planes: bool,
 
     run = jax.jit(shard_map_nocheck(
         body, mesh=mesh, in_specs=in_specs, out_specs=P(None)))
-    _GB_KERNEL_JIT[key] = run
+    _gb_jit_put(key, run)
     return run
 
 
@@ -323,7 +411,7 @@ def _zero_groupby_result(n_combos: int, depth: int, agg_field):
 
 def _groupby_kernel_jit(nf: int, has_planes: bool, signed: bool):
     key = (nf, has_planes, signed)
-    fn = _GB_KERNEL_JIT.get(key)
+    fn = _gb_jit_get(key)
     if fn is None:
         def run(stacks, sel, planes):
             c, n, p, g = kernels.groupby_sum(
@@ -335,7 +423,7 @@ def _groupby_kernel_jit(nf: int, has_planes: bool, signed: bool):
             return jnp.concatenate(
                 [c, n, p.ravel(), g.ravel()])
         fn = jax.jit(run)
-        _GB_KERNEL_JIT[key] = fn
+        _gb_jit_put(key, fn)
     return fn
 
 _BSI_CMP = {
@@ -831,6 +919,33 @@ def _decode_slice(planes, start, size):
     return bsi_ops.decode_device(sl)
 
 
+@jax.jit
+def _patch_program(stack, idxs, starts, data):
+    """Module-level jit (stable identity — one compile per (stack
+    shape, run shape) pair; run counts/widths are pow2-bucketed by
+    the caller so the shape space stays small): scatter padded word
+    runs into a resident stack of any leading shape through its
+    flattened (L, W) view."""
+    w = stack.shape[-1]
+    out = bm.patch_rows(stack.reshape(-1, w), idxs, starts, data)
+    return out.reshape(stack.shape)
+
+
+def _coalesce_runs(ranges, w: int):
+    """Sort + merge overlapping/adjacent (lo, hi) word runs, clamped
+    to [0, w)."""
+    runs: list[list[int]] = []
+    for lo, hi in sorted(ranges):
+        lo, hi = max(0, lo), min(hi, w)
+        if hi <= lo:
+            continue
+        if runs and lo <= runs[-1][1]:
+            runs[-1][1] = max(runs[-1][1], hi)
+        else:
+            runs.append([lo, hi])
+    return runs
+
+
 class StackedEngine:
     """Executes PQL call trees as stacked-shard device programs.
 
@@ -884,19 +999,149 @@ class StackedEngine:
         return [v.fragment(s) if v else None for s in shards]
 
     def _versions(self, frags) -> tuple:
-        return tuple(-1 if fr is None else fr.version for fr in frags)
+        """Per-fragment (gen, version) stamps, -1 for absent.  The
+        version detects writes; the gen detects drop/recreate — a
+        recreated fragment restarts its version counter, and without
+        the gen a matching count would false-hit the cache with the
+        old incarnation's stack (and would let the patch path apply
+        an empty delta over foreign data)."""
+        return tuple(-1 if fr is None else (fr.gen, fr.version)
+                     for fr in frags)
+
+    # -- incremental stack maintenance (delta patching) -----------------
+    # A cache entry's fragments each carry a bounded delta log
+    # (models/fragment.py): on a stale access the patcher maps logged
+    # (row, word-span) mutations onto the stack's LANES (one lane =
+    # one (leading-coords, W) row of the device array), re-reads just
+    # those word runs from the live fragments, and scatters them on
+    # device (_patch_program) — a write costs O(delta) upload instead
+    # of an O(S*W) restack.  A fragment whose log can't prove
+    # coverage (pre-window snapshot, appeared/vanished, recreated
+    # gen) compacts to whole-lane runs — the (shard, row) slice
+    # rebuild; only a dirty fraction above _PATCH_MAX_FRAC falls all
+    # the way back to build().
+
+    def _make_patcher(self, frags, lanes, new_versions, logical_lead,
+                      lane_words):
+        """TileStackCache patcher closure.
+
+        frags/lanes run parallel to the flat `new_versions` tuple:
+        ``lanes[i]`` maps fragment i's ROW ids to the logical lane
+        indices (flattened over `logical_lead`) that row feeds.
+        ``lane_words(lane)`` returns the lane's CURRENT full-width
+        host words.  Returns None when patching is disabled."""
+        if not _patch_enabled():
+            return None
+
+        def patcher(arr, old_versions):
+            if len(old_versions) != len(new_versions):
+                return None  # structural change: rebuild
+            dirty: dict[int, list | None] = {}
+            for fr, ov, nv, lmap in zip(frags, old_versions,
+                                        new_versions, lanes):
+                if ov == nv:
+                    continue
+                spans = None
+                if (fr is not None and ov != -1 and nv != -1
+                        and ov[0] == nv[0]):
+                    spans = fr.deltas_since(ov[1])
+                if spans is None:
+                    # compaction: whole-lane slice rebuild for every
+                    # lane this fragment feeds
+                    for lns in lmap.values():
+                        for ln in lns:
+                            dirty[ln] = None
+                    continue
+                for row, lo, hi in spans:
+                    for ln in lmap.get(row, ()):
+                        cur = dirty.get(ln, False)
+                        if cur is None:
+                            continue  # already whole-lane
+                        if cur is False:
+                            dirty[ln] = cur = []
+                        cur.append((lo, hi))
+            if not dirty:
+                # versions moved but no logged mutation touches this
+                # stack's rows: adopt the new versions as-is
+                return arr, 0
+            return self._apply_patch(arr, dirty, logical_lead,
+                                     lane_words)
+        return patcher
+
+    def _apply_patch(self, arr, dirty, logical_lead, lane_words):
+        """Apply dirty lane runs to a resident stack; (new_arr, bytes)
+        or None when a full rebuild is cheaper.  Runs pad to pow2
+        widths (content comes from the live rows, so widening is
+        free and correct) and batch per width so the shared jitted
+        scatter compiles once per bucket."""
+        w = arr.shape[-1]
+        lead_shape = arr.shape[:-1]   # device stacks may be mesh-padded
+        total_words = int(np.prod(logical_lead)) * w
+        segs = []                     # (flat padded lane, start, plen, lane)
+        patched_words = 0
+        for lane in sorted(dirty):
+            coords = np.unravel_index(lane, logical_lead)
+            flat = int(np.ravel_multi_index(coords, lead_shape))
+            runs = dirty[lane]
+            runs = [(0, w)] if runs is None else _coalesce_runs(runs, w)
+            for lo, hi in runs:
+                plen = min(1 << (hi - lo - 1).bit_length(), w)
+                start = min(lo, w - plen)
+                segs.append((flat, start, plen, lane))
+                patched_words += plen
+        if not segs:
+            return arr, 0
+        if patched_words > _PATCH_MAX_FRAC * total_words:
+            return None  # near-total patch: one dense upload wins
+        lane_cache: dict[int, np.ndarray] = {}
+
+        def words_of(lane):
+            cur = lane_cache.get(lane)
+            if cur is None:
+                cur = lane_cache[lane] = np.asarray(
+                    lane_words(lane), dtype=np.uint32)
+            return cur
+
+        by_len: dict[int, list] = {}
+        for flat, start, plen, lane in segs:
+            by_len.setdefault(plen, []).append((flat, start, lane))
+        if isinstance(arr, np.ndarray):
+            # host path: ONE fresh copy (resident host stacks are
+            # shared read-only with concurrent queries), then the host
+            # twin of the device scatter per width bucket
+            out = arr.reshape(-1, w).copy()
+            for plen, group in by_len.items():
+                idxs = np.array([f for f, _s, _l in group], np.int64)
+                starts = np.array([s for _f, s, _l in group], np.int64)
+                data = np.stack([words_of(lane)[start:start + plen]
+                                 for _f, start, lane in group])
+                bm.patch_rows_np(out, idxs, starts, data, out=out)
+            return out.reshape(arr.shape), patched_words * 4
+        for plen, group in sorted(by_len.items()):
+            n = len(group)
+            npad = 1 << max(n - 1, 0).bit_length()
+            idxs = np.zeros(npad, np.int32)
+            starts = np.zeros(npad, np.int32)
+            data = np.empty((npad, plen), np.uint32)
+            for k in range(npad):
+                flat, start, lane = group[min(k, n - 1)]
+                idxs[k], starts[k] = flat, start
+                data[k] = words_of(lane)[start:start + plen]
+            arr = _patch_program(arr, idxs, starts, data)
+        return arr, patched_words * 4
 
     def row_stack(self, idx, field, views: tuple[str, ...], row_id: int,
                   skey: tuple):
         """(S, W) device stack of one row, unioned across views."""
         shards = list(skey)
+        width = idx.width
         key = ("row", idx.name, field.name, views, row_id, skey,
                id(self.mesh))
         per_view = [self._frags(idx, field, vn, shards) for vn in views]
-        versions = tuple(self._versions(fr) for fr in per_view)
+        versions = tuple(v for frags in per_view
+                         for v in self._versions(frags))
 
         def build():
-            width = idx.width
             out = np.zeros((len(shards), width // 32), dtype=np.uint32)
             for frags in per_view:
                 for i, fr in enumerate(frags):
@@ -904,18 +1149,46 @@ class StackedEngine:
                         out[i] |= fr.row_words(row_id)
             return self.place(out)
 
-        return self.cache.get(key, versions, build)
+        def lane_words(si):
+            out = np.zeros(width // 32, dtype=np.uint32)
+            for frags in per_view:
+                fr = frags[si]
+                if fr is not None:
+                    out |= fr.row_words(row_id)
+            return out
+
+        frags_flat = [fr for frags in per_view for fr in frags]
+        lanes = [{row_id: (si,)} for _ in per_view
+                 for si in range(len(shards))]
+        patcher = self._make_patcher(frags_flat, lanes, versions,
+                                     (len(shards),), lane_words)
+        return self.cache.get(key, versions, build, patcher)
+
+    def _plane_lanes(self, frags, n_shards: int, depth: int, width: int):
+        """(lanes, lane_words) for an (S, 2+depth, W) plane stack:
+        lane = si*(2+depth) + plane-row."""
+        p = 2 + depth
+
+        def lane_words(lane):
+            si, r = divmod(lane, p)
+            fr = frags[si]
+            return (fr.row_words(r) if fr is not None
+                    else np.zeros(width // 32, dtype=np.uint32))
+
+        lanes = [{r: (si * p + r,) for r in range(p)}
+                 for si in range(n_shards)]
+        return lanes, lane_words
 
     def plane_stack(self, idx, field, skey: tuple):
         """(S, 2+depth, W) device stack of a BSI field's planes."""
         shards = list(skey)
         depth = field.bit_depth
+        width = idx.width
         key = ("planes", idx.name, field.name, depth, skey, id(self.mesh))
         frags = self._frags(idx, field, field.bsi_view, shards)
         versions = self._versions(frags)
 
         def build():
-            width = idx.width
             out = np.zeros((len(shards), 2 + depth, width // 32),
                            dtype=np.uint32)
             for i, fr in enumerate(frags):
@@ -924,7 +1197,12 @@ class StackedEngine:
                         out[i, r] = fr.row_words(r)
             return self.place(out)
 
-        return self.cache.get(key, versions, build)
+        lanes, lane_words = self._plane_lanes(frags, len(shards),
+                                              depth, width)
+        patcher = self._make_patcher(frags, lanes, versions,
+                                     (len(shards), 2 + depth),
+                                     lane_words)
+        return self.cache.get(key, versions, build, patcher)
 
     def existence_stack(self, idx, skey: tuple):
         from pilosa_tpu.models.index import EXISTENCE_FIELD
@@ -1077,7 +1355,8 @@ class StackedEngine:
                flat, as_np)
         per_field = [self._frags(idx, f, VIEW_STANDARD, shards)
                      for f, _ in fields_rows]
-        versions = tuple(self._versions(fr) for fr in per_field)
+        versions = tuple(v for fr in per_field
+                         for v in self._versions(fr))
         bits, shifts, _n_codes = _code_space(fields_rows)
         cb = sum(bits)
 
@@ -1109,7 +1388,52 @@ class StackedEngine:
                 return place_flat(self.mesh, out, shard_axis=0)
             return place_shards(self.mesh, out, batch_axes=1)
 
-        return self.cache.get(key, versions, build)
+        # delta patching: a write to row rl[di] of field fi dirties
+        # shard si's digit planes {sh_fi + b : bit b of di set} and
+        # its VALID plane (the AND of field unions); lane = si*(cb+1)
+        # + plane index
+        def lane_words(lane):
+            w = idx.width // 32
+            si, p = divmod(lane, cb + 1)
+            if p == cb:  # valid plane
+                out = np.full(w, 0xFFFFFFFF, dtype=np.uint32)
+                for (_f, rl), frags in zip(fields_rows, per_field):
+                    union = np.zeros(w, np.uint32)
+                    fr = frags[si]
+                    if fr is not None:
+                        for r in rl:
+                            union |= fr.row_words(int(r))
+                    out &= union
+                return out
+            for (_f, rl), frags, sh, nb in zip(fields_rows, per_field,
+                                               shifts, bits):
+                if sh <= p < sh + nb:
+                    b = p - sh
+                    out = np.zeros(w, np.uint32)
+                    fr = frags[si]
+                    if fr is not None:
+                        for di, r in enumerate(rl):
+                            if (di >> b) & 1:
+                                out |= fr.row_words(int(r))
+                    return out
+            return np.zeros(w, np.uint32)
+
+        frags_flat, lanes = [], []
+        for (_f, rl), frags, sh, nb in zip(fields_rows, per_field,
+                                           shifts, bits):
+            for si, fr in enumerate(frags):
+                frags_flat.append(fr)
+                lmap: dict[int, tuple] = {}
+                valid_lane = si * (cb + 1) + cb
+                for di, r in enumerate(rl):
+                    lns = tuple(si * (cb + 1) + sh + b
+                                for b in range(nb) if (di >> b) & 1)
+                    lmap[int(r)] = lmap.get(int(r), ()) + lns + \
+                        (valid_lane,)
+                lanes.append(lmap)
+        patcher = self._make_patcher(frags_flat, lanes, versions,
+                                     (len(shards), cb + 1), lane_words)
+        return self.cache.get(key, versions, build, patcher)
 
     def plane_stack_np(self, idx, field, skey: tuple):
         """Host numpy twin of plane_stack for the native histogram
@@ -1129,7 +1453,12 @@ class StackedEngine:
                         out[i, r] = fr.row_words(r)
             return out
 
-        return self.cache.get(key, versions, build)
+        lanes, lane_words = self._plane_lanes(frags, len(shards),
+                                              depth, idx.width)
+        patcher = self._make_patcher(frags, lanes, versions,
+                                     (len(shards), 2 + depth),
+                                     lane_words)
+        return self.cache.get(key, versions, build, patcher)
 
     def _groupby_onepass_ok(self, idx, fields_rows, n_combos: int,
                             depth: int, has_agg: bool,
@@ -1542,6 +1871,28 @@ class StackedEngine:
                         out[ri, si] |= fr.row_words(r)
         return out
 
+    def _rows_lanes(self, per_view, row_key, n_shards: int, width: int):
+        """(frags_flat, lanes, lane_words) for an (R, S, W) candidate-
+        row stack: lane = ri * S + si, shared by both placements."""
+        def lane_words(lane):
+            ri, si = divmod(lane, n_shards)
+            out = np.zeros(width // 32, dtype=np.uint32)
+            for frags in per_view:
+                fr = frags[si]
+                if fr is not None:
+                    out |= fr.row_words(row_key[ri])
+            return out
+
+        frags_flat, lanes = [], []
+        for frags in per_view:
+            for si, fr in enumerate(frags):
+                frags_flat.append(fr)
+                lmap: dict[int, tuple] = {}
+                for ri, r in enumerate(row_key):
+                    lmap[r] = lmap.get(r, ()) + (ri * n_shards + si,)
+                lanes.append(lmap)
+        return frags_flat, lanes, lane_words
+
     def rows_stack_for(self, idx, field, views: tuple[str, ...],
                        row_ids, skey: tuple):
         """(R, S, W) stacked candidate rows for the TopN/TopK scan.
@@ -1556,7 +1907,8 @@ class StackedEngine:
         key = ("rowchunk", idx.name, field.name, views, row_key, skey,
                id(self.mesh))
         per_view = [self._frags(idx, field, vn, shards) for vn in views]
-        versions = tuple(self._versions(fr) for fr in per_view)
+        versions = tuple(v for fr in per_view
+                         for v in self._versions(fr))
 
         def build():
             out = self._rows_stack_np(idx, per_view, row_key,
@@ -1585,7 +1937,12 @@ class StackedEngine:
             return jax.device_put(
                 out, NamedSharding(self.mesh, P("rows", "shards", None)))
 
-        return self.cache.get(key, versions, build)
+        frags_flat, lanes, lane_words = self._rows_lanes(
+            per_view, row_key, len(shards), idx.width)
+        patcher = self._make_patcher(frags_flat, lanes, versions,
+                                     (len(row_key), len(shards)),
+                                     lane_words)
+        return self.cache.get(key, versions, build, patcher)
 
     # -- flat placements for the mesh GroupBy kernel --------------------
     # The shard_map kernel path shards the SHARD axis over every mesh
@@ -1607,14 +1964,20 @@ class StackedEngine:
         key = ("rowchunk_flat", idx.name, field.name, views, row_key,
                skey, id(self.mesh))
         per_view = [self._frags(idx, field, vn, shards) for vn in views]
-        versions = tuple(self._versions(fr) for fr in per_view)
+        versions = tuple(v for fr in per_view
+                         for v in self._versions(fr))
 
         def build():
             out = self._rows_stack_np(idx, per_view, row_key,
                                       len(shards))
             return place_flat(self.mesh, out, shard_axis=1)
 
-        return self.cache.get(key, versions, build)
+        frags_flat, lanes, lane_words = self._rows_lanes(
+            per_view, row_key, len(shards), idx.width)
+        patcher = self._make_patcher(frags_flat, lanes, versions,
+                                     (len(row_key), len(shards)),
+                                     lane_words)
+        return self.cache.get(key, versions, build, patcher)
 
     def plane_stack_flat(self, idx, field, skey: tuple):
         """(S, P, W) planes with S sharded over ALL mesh devices."""
@@ -1636,4 +1999,9 @@ class StackedEngine:
                         out[i, r] = fr.row_words(r)
             return place_flat(self.mesh, out, shard_axis=0)
 
-        return self.cache.get(key, versions, build)
+        lanes, lane_words = self._plane_lanes(frags, len(shards),
+                                              depth, idx.width)
+        patcher = self._make_patcher(frags, lanes, versions,
+                                     (len(shards), 2 + depth),
+                                     lane_words)
+        return self.cache.get(key, versions, build, patcher)

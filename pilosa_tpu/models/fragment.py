@@ -50,6 +50,17 @@ import itertools as _it
 
 _FRAG_GEN = _it.count(1)
 
+# Bounded per-fragment delta log (LSM-flavored incremental stack
+# maintenance): every mutation appends a (version, row, word-span)
+# entry so device-resident stacks can be PATCHED instead of rebuilt
+# (executor/stacked.py).  The log is a sliding window — entries past
+# DELTA_LOG_MAX drop off the front and readers snapshotted before the
+# window fall back to a full slice rebuild.  Config knob:
+# PILOSA_TPU_DELTA_LOG_MAX (config.py [stacked] delta-log-max).
+DELTA_LOG_MAX = int(_os.environ.get("PILOSA_TPU_DELTA_LOG_MAX", "256"))
+
+from collections import deque as _deque
+
 
 class Fragment:
     """Host rows + device tile cache for one (index, field, view, shard)."""
@@ -72,6 +83,11 @@ class Fragment:
         self.version = 0
         # unique-for-process-lifetime identity (see _FRAG_GEN)
         self.gen = next(_FRAG_GEN)
+        # delta log: (version-after-mutation, row, word_lo, word_hi)
+        # spans covering versions in (_delta_floor, version] — the
+        # incremental-maintenance feed for device stack patching
+        self._delta_log: _deque = _deque()
+        self._delta_floor = 0
         # row_ids is hot on TopN/Rows scans (954 shards x R rows of
         # .any() sweeps = ~GB of host traffic per query); cache it
         # under the same version stamp the device tile cache uses
@@ -120,9 +136,11 @@ class Fragment:
 
     # -- host mutation ------------------------------------------------------
 
-    def _row_mut(self, row: int) -> np.ndarray:
+    def _row_mut(self, row: int, lo: int | None = None,
+                 hi: int | None = None) -> np.ndarray:
         """Mutable DENSE words for a row (densifying if needed) —
-        the bulk/word-level write path."""
+        the bulk/word-level write path.  `lo`/`hi` bound the word span
+        the caller is about to dirty (whole row when omitted)."""
         w = self._rows.get(row)
         if w is None:
             if row in self._sparse:
@@ -130,11 +148,14 @@ class Fragment:
             else:
                 w = bm.empty(self.width)
                 self._rows[row] = w
-        self._invalidate(row)
+        self._invalidate(row, lo, hi)
         return w
 
-    def _invalidate(self, row: int):
+    def _invalidate(self, row: int, lo: int | None = None,
+                    hi: int | None = None, record: bool = False):
         self.version += 1
+        if record:
+            self._record_delta(row, lo, hi)
         self._device.pop(row, None)
         self._planes_cache = None
         self.dirty_rows.add(row)
@@ -143,13 +164,62 @@ class Fragment:
             self._cache_stale.pop(row, None)
             self._cache_stale[row] = None
 
-    def touch(self, row: int):
+    def _record_delta(self, row: int, lo: int | None, hi: int | None):
+        """Append one (version, row, word-span) entry.  Deltas record
+        only at touch() time (the post-mutation invalidation), so one
+        mutation = one entry; the pre-invalidation bump is covered
+        because the post entry's version exceeds any reader snapshot
+        taken before it.  Entries are never merged: pulling an older
+        entry's span forward under a newer version would make every
+        snapshot in between re-patch that whole span (a point write
+        would inherit the row's import history).  Oldest entries drop
+        past DELTA_LOG_MAX, advancing the floor so pre-window readers
+        rebuild instead of patching."""
+        if lo is None:
+            lo, hi = 0, self.width // 32
+        log = self._delta_log
+        log.append((self.version, row, lo, hi))
+        while len(log) > DELTA_LOG_MAX:
+            # floor rises BEFORE the pop: a concurrent deltas_since
+            # that misses the popped entry re-checks the floor after
+            # its copy and bails instead of under-reporting
+            self._delta_floor = log[0][0]
+            log.popleft()
+
+    def deltas_since(self, version: int):
+        """Dirty (row, word_lo, word_hi) spans of every mutation after
+        `version`, or None when the log cannot prove coverage (the
+        snapshot predates the sliding window, or names a version this
+        incarnation never reached — a drop/recreate mismatch the
+        caller should already have screened via ``gen``)."""
+        if version < self._delta_floor or version > self.version:
+            return None
+        for _ in range(4):
+            try:
+                entries = list(self._delta_log)
+                break
+            except RuntimeError:  # writer mutated the deque mid-copy
+                continue
+        else:
+            return None  # contended: let the caller rebuild
+        if version < self._delta_floor:
+            # the window slid during the copy; `entries` may be
+            # missing dropped-but-needed spans — no coverage proof
+            return None
+        return [(r, lo, hi) for (v, r, lo, hi) in entries
+                if v > version]
+
+    def touch(self, row: int, lo: int | None = None,
+              hi: int | None = None):
         """Post-mutation invalidation.  ``_row_mut`` invalidates BEFORE
         handing out the mutable array; every mutator must also touch()
         AFTER the bytes land, or a concurrent reader that snapshots
         ``version`` between the two could cache pre-write data under
-        the post-write version forever."""
-        self._invalidate(row)
+        the post-write version forever.  The delta log records HERE
+        (post), one entry per mutation — the entry's version exceeds
+        any snapshot taken before the bytes landed, so it covers the
+        pre-invalidation bump too."""
+        self._invalidate(row, lo, hi, record=True)
         if PARANOIA:
             self.check_row(row)
 
@@ -200,32 +270,34 @@ class Fragment:
     def set_bit(self, row: int, col: int) -> bool:
         """Set one bit; returns True if it changed (fragment.setBit)."""
         assert 0 <= col < self.width
+        wi = col >> 5
         words = self._rows.get(row)
         if words is None:
             # sparse path: sorted-insert, promoting at the threshold
             # (the array-container write path, roaring/roaring.go:927)
             arr = self._sparse.get(row)
             if arr is None:
-                self._invalidate(row)
+                self._invalidate(row, wi, wi + 1)
                 self._sparse[row] = np.array([col], dtype=np.int64)
-                self.touch(row)
+                self.touch(row, wi, wi + 1)
                 return True
             i = int(np.searchsorted(arr, col))
             if i < arr.size and arr[i] == col:
                 return False
-            self._invalidate(row)
+            self._invalidate(row, wi, wi + 1)
             self._store_cols(row, np.insert(arr, i, col))
-            self.touch(row)
+            self.touch(row, wi, wi + 1)
             return True
-        w, b = col >> 5, np.uint32(1) << (col & 31)
-        if words[w] & b:
+        b = np.uint32(1) << (col & 31)
+        if words[wi] & b:
             return False
-        self._invalidate(row)
-        words[w] |= b
-        self.touch(row)
+        self._invalidate(row, wi, wi + 1)
+        words[wi] |= b
+        self.touch(row, wi, wi + 1)
         return True
 
     def clear_bit(self, row: int, col: int) -> bool:
+        wi = col >> 5
         words = self._rows.get(row)
         if words is None:
             arr = self._sparse.get(row)
@@ -234,16 +306,16 @@ class Fragment:
             i = int(np.searchsorted(arr, col))
             if i >= arr.size or arr[i] != col:
                 return False
-            self._invalidate(row)
+            self._invalidate(row, wi, wi + 1)
             self._sparse[row] = np.delete(arr, i)
-            self.touch(row)
+            self.touch(row, wi, wi + 1)
             return True
-        w, b = col >> 5, np.uint32(1) << (col & 31)
-        if not (words[w] & b):
+        b = np.uint32(1) << (col & 31)
+        if not (words[wi] & b):
             return False
-        self._invalidate(row)
-        words[w] &= ~b
-        self.touch(row)
+        self._invalidate(row, wi, wi + 1)
+        words[wi] &= ~b
+        self.touch(row, wi, wi + 1)
         return True
 
     def import_bits(self, rows, cols, clear: bool = False,
@@ -285,10 +357,13 @@ class Fragment:
                                  bounds.tolist()):
             r = int(r)
             sel = cols_s[lo_i:hi_i]
+            # dirty word span of this row's columns (delta-log hint)
+            wlo = int(sel.min()) >> 5
+            whi = (int(sel.max()) >> 5) + 1
             dense = self._rows.get(r)
             if dense is None and not clear:
                 arr = self._sparse.get(r)
-                self._invalidate(r)
+                self._invalidate(r, wlo, whi)
                 if arr is None and sel.size > SPARSE_MAX:
                     # straight to dense: union1d + store + densify
                     # re-sorts and re-scatters the same bits (ingest
@@ -298,23 +373,23 @@ class Fragment:
                     self._store_cols(r, np.unique(sel))
                 else:
                     self._store_cols(r, np.union1d(arr, sel))
-                self.touch(r)
+                self.touch(r, wlo, whi)
                 continue
             if dense is None and clear:
                 arr = self._sparse.get(r)
                 if arr is None:
                     continue
-                self._invalidate(r)
+                self._invalidate(r, wlo, whi)
                 self._sparse[r] = np.setdiff1d(arr, sel)
-                self.touch(r)
+                self.touch(r, wlo, whi)
                 continue
             mask = bm.from_columns(sel, self.width)
-            words = self._row_mut(r)
+            words = self._row_mut(r, wlo, whi)
             if clear:
                 words &= ~mask
             else:
                 words |= mask
-            self.touch(r)
+            self.touch(r, wlo, whi)
 
     def import_row_words(self, row: int, words) -> None:
         """Bulk dense-row import: OR pre-packed words into a row.
@@ -400,10 +475,12 @@ class Fragment:
         ni.mutex_fill(written, scratch, rowidx.astype(np.int64),
                       cols)
         self.clear_columns(written)
+        wlo = int(cols.min()) >> 5
+        whi = (int(cols.max()) >> 5) + 1
         for k, r in enumerate(np.asarray(uniq,
                                          dtype=np.int64).tolist()):
-            self._row_mut(int(r))[:] |= scratch[k]
-            self.touch(int(r))
+            self._row_mut(int(r), wlo, whi)[:] |= scratch[k]
+            self.touch(int(r), wlo, whi)
 
     def import_values(self, cols, values, depth: int, clear: bool = False):
         """Bulk BSI write (fragment.importValue semantics): last-write-
@@ -415,11 +492,13 @@ class Fragment:
         assert cols.shape == vals.shape
         if cols.size == 0:
             return
+        wlo = int(cols.min()) >> 5
+        whi = (int(cols.max()) >> 5) + 1
         if clear:
             touched = bm.from_columns(cols, self.width)
             for r in range(2 + depth):
-                self._row_mut(r)[:] &= ~touched
-                self.touch(r)
+                self._row_mut(r, wlo, whi)[:] &= ~touched
+                self.touch(r, wlo, whi)
             return
         # uint64 view so INT64_MIN's magnitude (2^63) is seen — np.abs
         # is the identity there and would let an out-of-depth value
@@ -438,37 +517,40 @@ class Fragment:
         scratch = np.zeros((2 + depth, self.width // 32), np.uint32)
         ni.bsi_fill(scratch, cols, vals, depth)
         touched = scratch[0]  # the exists plane IS the touched mask
-        self._row_mut(0)[:] |= touched
-        sign_words = self._row_mut(BSI_SIGN_BIT)
+        self._row_mut(0, wlo, whi)[:] |= touched
+        sign_words = self._row_mut(BSI_SIGN_BIT, wlo, whi)
         sign_words &= ~touched
         sign_words |= scratch[1]
         for i in range(depth):
-            plane = self._row_mut(BSI_OFFSET_BIT + i)
+            plane = self._row_mut(BSI_OFFSET_BIT + i, wlo, whi)
             plane &= ~touched
             plane |= scratch[2 + i]
         for r in range(2 + depth):
-            self.touch(r)
+            self.touch(r, wlo, whi)
 
     def clear_columns(self, mask_words: np.ndarray) -> bool:
         """Clear every bit in the masked columns across ALL rows
         (Delete-records path).  Returns True if anything changed."""
         mask = np.asarray(mask_words, dtype=np.uint32)
         inv = ~mask
+        nz = np.flatnonzero(mask)
+        wlo = int(nz[0]) if nz.size else 0
+        whi = int(nz[-1]) + 1 if nz.size else 0
         changed = False
         for r in list(self._rows):
             row = self._rows[r]
             if (row & mask).any():
-                self._row_mut(r)[:] = row & inv
-                self.touch(r)
+                self._row_mut(r, wlo, whi)[:] = row & inv
+                self.touch(r, wlo, whi)
                 changed = True
         for r in list(self._sparse):
             arr = self._sparse[r]
             hit = ((mask[arr >> 5] >> (arr & 31).astype(np.uint32))
                    & 1).astype(bool)
             if hit.any():
-                self._invalidate(r)
+                self._invalidate(r, wlo, whi)
                 self._sparse[r] = arr[~hit]
-                self.touch(r)
+                self.touch(r, wlo, whi)
                 changed = True
         return changed
 

@@ -263,6 +263,19 @@ GROUPBY_ONEPASS = registry.counter(
     "pilosa_groupby_onepass_total",
     "GroupBy queries served by the one-pass group-code histogram")
 
+# -- tile-stack maintenance (executor/stacked.py TileStackCache) --
+# Outcomes: hit (fresh entry), miss (any non-hit), patch (stale entry
+# delta-patched on device), rebuild (full host restack + upload),
+# wait (single-flight follower served by another thread's build).
+STACK_CACHE = registry.counter(
+    "pilosa_stack_cache_total",
+    "Tile-stack cache accesses by outcome (hit/miss/patch/rebuild/wait)")
+# patched vs rebuilt bytes attribute the write-path win directly: a
+# healthy patch path keeps patched ≪ rebuilt-equivalent stack bytes
+STACK_MAINT_BYTES = registry.counter(
+    "pilosa_stack_maintenance_bytes_total",
+    "Device stack maintenance traffic by kind (patched/rebuilt)")
+
 # -- serving path (executor/serving.py: micro-batcher + result cache) --
 SERVING_LATENCY = registry.histogram(
     "pilosa_serving_latency_seconds",

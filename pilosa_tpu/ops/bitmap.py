@@ -204,6 +204,41 @@ def intersect_rows(rows):
     return acc
 
 
+# Stack patching (incremental device-stack maintenance) ---------------------
+#
+# Device-resident shard stacks are PATCHED on write instead of rebuilt
+# (executor/stacked.py TileStackCache): a write's delta log names the
+# dirty (lane, word-range) runs, and these ops scatter the replacement
+# word runs into the resident array — O(delta) upload instead of an
+# O(S*W) host restack + transfer.
+
+def patch_rows(stack2d, idxs, starts, data):
+    """Scatter word runs into a (L, W) stack: run k replaces
+    ``stack2d[idxs[k], starts[k]:starts[k]+P]`` with ``data[k]``
+    (data is (N, P); every run must lie within one lane).  A
+    ``lax.scan`` of ``dynamic_update_slice`` so one jitted program
+    serves any run count of one padded width — duplicate runs are
+    safe (sequential, identical content)."""
+    def body(st, seg):
+        i, s, d = seg
+        return jax.lax.dynamic_update_slice(st, d[None, :], (i, s)), None
+    out, _ = jax.lax.scan(body, stack2d, (idxs, starts, data))
+    return out
+
+
+def patch_rows_np(stack2d: np.ndarray, idxs, starts,
+                  data: np.ndarray, out=None) -> np.ndarray:
+    """Host twin of patch_rows.  Copies by default (resident host
+    stacks are shared read-only with concurrent queries); pass a
+    scratch `out` to chain width buckets over one copy."""
+    if out is None:
+        out = stack2d.copy()
+    p = data.shape[1]
+    for k in range(len(idxs)):
+        out[int(idxs[k]), int(starts[k]):int(starts[k]) + p] = data[k]
+    return out
+
+
 # Group-code planes (one-pass GroupBy) --------------------------------------
 #
 # A stack of R DISJOINT packed rows (no column in two rows) is exactly a
