@@ -358,6 +358,67 @@ def attach_tpu_record(result: dict, path: str = None,
     return result
 
 
+SERVING_QUERIES = [
+    "Count(Intersect(Row(a=1), Row(b=1)))",
+    "Count(Row(a=1))",
+    "Count(Row(b=1))",
+    "Count(Union(Row(a=1), Row(b=1)))",
+    "TopN(t, n=10)",
+    "TopN(t, Row(a=1), n=10)",
+    "Row(a=1)",
+    "Count(Row(age > 63))",
+    "Sum(Row(a=1), field=age)",
+    "Count(Xor(Row(a=1), Row(b=1)))",
+    "Count(Difference(Row(a=1), Row(b=1)))",
+    "Count(Row(age < 32))",
+]
+
+
+def _client_storm(call, queries, n_clients: int,
+                  duration_s: float) -> dict:
+    """N barrier-synced client threads hammering `call` round-robin
+    over `queries` for `duration_s`; returns qps + latency summary."""
+    import statistics as stats
+    import threading
+
+    lat: list[float] = []
+    lock = threading.Lock()
+    stop = time.perf_counter() + duration_s
+    barrier = threading.Barrier(n_clients)
+
+    def client(ci: int):
+        my: list[float] = []
+        barrier.wait()
+        i = ci
+        while time.perf_counter() < stop:
+            q = queries[i % len(queries)]
+            i += 1
+            t0 = time.perf_counter()
+            call("bench", q)
+            my.append(time.perf_counter() - t0)
+        with lock:
+            lat.extend(my)
+
+    threads = [threading.Thread(target=client, args=(ci,))
+               for ci in range(n_clients)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    lat.sort()
+    n = len(lat)
+    return {
+        "requests": n,
+        "qps": round(n / wall, 1) if wall > 0 else 0.0,
+        "p50_ms": round(lat[n // 2] * 1e3, 3) if n else None,
+        "p99_ms": round(lat[min(n - 1, int(n * 0.99))] * 1e3, 3)
+        if n else None,
+        "mean_ms": round(stats.fmean(lat) * 1e3, 3) if n else None,
+    }
+
+
 def serving_gauntlet(h, clients_list=(1, 8, 32),
                      duration_s: float = 1.2) -> dict:
     """Concurrent-serving A/B: QPS and p50/p99 per client count, with
@@ -365,27 +426,14 @@ def serving_gauntlet(h, clients_list=(1, 8, 32),
     executor/serving.py) ON vs OFF over the same holder and query mix.
     The mix is a hot set of distinct read queries, the shape a serving
     tier sees from dashboard fan-out — exactly what cross-query
-    dispatch coalescing and the result cache exist for."""
-    import statistics as stats
-    import threading
-
+    dispatch coalescing and the result cache exist for.  Each mode
+    cell now carries the flight recorder's per-phase breakdown
+    (compile/upload/execute/wait) so future PRs can attribute wins
+    instead of reporting only end-to-end percentiles."""
     from pilosa_tpu.executor.executor import Executor
+    from pilosa_tpu.obs import flight
 
-    queries = [
-        "Count(Intersect(Row(a=1), Row(b=1)))",
-        "Count(Row(a=1))",
-        "Count(Row(b=1))",
-        "Count(Union(Row(a=1), Row(b=1)))",
-        "TopN(t, n=10)",
-        "TopN(t, Row(a=1), n=10)",
-        "Row(a=1)",
-        "Count(Row(age > 63))",
-        "Sum(Row(a=1), field=age)",
-        "Count(Xor(Row(a=1), Row(b=1)))",
-        "Count(Difference(Row(a=1), Row(b=1)))",
-        "Count(Row(age < 32))",
-    ]
-
+    queries = SERVING_QUERIES
     # ONE executor per mode, shared across client counts: each
     # Executor pins its own device tile stacks, and at 954 shards a
     # fresh engine per (mode, clients) cell would multiply HBM
@@ -394,64 +442,146 @@ def serving_gauntlet(h, clients_list=(1, 8, 32),
     ex_srv = Executor(h)
     ex_srv.enable_serving(window_s=0.001, max_batch=64,
                           cache_bytes=64 << 20)
+    prev_enabled = flight.recorder.enabled
+    prev_keep = flight.recorder._ring.maxlen
 
     def run_mode(batched: bool, n_clients: int) -> dict:
         call = ex_srv.execute_serving if batched else ex_plain.execute
         for q in queries:  # warm: compile + tile-stack upload
             call("bench", q)
-        lat: list[float] = []
-        lock = threading.Lock()
-        stop = time.perf_counter() + duration_s
-        barrier = threading.Barrier(n_clients)
-
-        def client(ci: int):
-            my: list[float] = []
-            barrier.wait()
-            i = ci
-            while time.perf_counter() < stop:
-                q = queries[i % len(queries)]
-                i += 1
-                t0 = time.perf_counter()
-                call("bench", q)
-                my.append(time.perf_counter() - t0)
-            with lock:
-                lat.extend(my)
-
-        threads = [threading.Thread(target=client, args=(ci,))
-                   for ci in range(n_clients)]
-        t_start = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        wall = time.perf_counter() - t_start
-        lat.sort()
-        n = len(lat)
-        return {
-            "requests": n,
-            "qps": round(n / wall, 1) if wall > 0 else 0.0,
-            "p50_ms": round(lat[n // 2] * 1e3, 3) if n else None,
-            "p99_ms": round(lat[min(n - 1, int(n * 0.99))] * 1e3, 3)
-            if n else None,
-            "mean_ms": round(stats.fmean(lat) * 1e3, 3) if n else None,
-        }
+        # ring sized for the window so the breakdown sees every record
+        flight.recorder.configure(enabled=True, keep=16384)
+        flight.recorder.clear()
+        cell = _client_storm(call, queries, n_clients, duration_s)
+        cell["phase_breakdown_ms"] = flight.phase_breakdown(
+            flight.recorder.recent(16384))
+        return cell
 
     out: dict = {}
-    for nc in clients_list:
-        ab = {"unbatched": run_mode(False, nc),
-              "batched": run_mode(True, nc)}
-        ub, bt = ab["unbatched"]["qps"], ab["batched"]["qps"]
-        ab["qps_speedup"] = round(bt / ub, 2) if ub else None
-        out[f"c{nc}"] = ab
-        log(f"serving c{nc}: unbatched {ub} qps "
-            f"p99={ab['unbatched']['p99_ms']}ms | batched {bt} qps "
-            f"p99={ab['batched']['p99_ms']}ms "
-            f"({ab['qps_speedup']}x)")
+    try:
+        for nc in clients_list:
+            ab = {"unbatched": run_mode(False, nc),
+                  "batched": run_mode(True, nc)}
+            ub, bt = ab["unbatched"]["qps"], ab["batched"]["qps"]
+            ab["qps_speedup"] = round(bt / ub, 2) if ub else None
+            out[f"c{nc}"] = ab
+            log(f"serving c{nc}: unbatched {ub} qps "
+                f"p99={ab['unbatched']['p99_ms']}ms | batched {bt} qps "
+                f"p99={ab['batched']['p99_ms']}ms "
+                f"({ab['qps_speedup']}x)")
+    finally:
+        flight.recorder.configure(enabled=prev_enabled, keep=prev_keep)
     from pilosa_tpu.obs import metrics as _m
     out["batch_size_p50"] = round(
         _m.SERVING_BATCH_SIZE.quantile(0.5), 2)
     out["result_cache_hits"] = _m.RESULT_CACHE.value(outcome="hit")
     return out
+
+
+def tracing_overhead_gauntlet(h, n_clients: int = 8,
+                              duration_s: float = 1.0,
+                              rounds: int = 3) -> dict:
+    """Flight-recorder overhead A/B on the serving gauntlet: the SAME
+    workload with the recorder enabled vs disabled, interleaved
+    (off/on per round) so clock drift cancels; best-of-rounds qps per
+    mode.  `overhead_pct` is the cost of leaving the recorder ON;
+    recorder-off is the shipped default-off-tracing cost the <2%
+    acceptance bound speaks to (NopTracer + inactive accumulators)."""
+    from pilosa_tpu.executor.executor import Executor
+    from pilosa_tpu.obs import flight
+
+    queries = SERVING_QUERIES
+    ex = Executor(h)
+    ex.enable_serving(window_s=0.001, max_batch=64,
+                      cache_bytes=64 << 20)
+    for q in queries:  # warm: compile + upload outside the A/B
+        ex.execute_serving("bench", q)
+    prev_enabled = flight.recorder.enabled
+    import statistics as stats
+    pair_overheads = []
+    best = {"off": 0.0, "on": 0.0}
+    p50s = {"off": [], "on": []}
+    try:
+        for _ in range(rounds):
+            qps = {}
+            for mode in ("off", "on"):
+                flight.recorder.configure(enabled=mode == "on")
+                flight.recorder.clear()
+                cell = _client_storm(ex.execute_serving, queries,
+                                     n_clients, duration_s)
+                qps[mode] = cell["qps"]
+                best[mode] = max(best[mode], cell["qps"])
+                if cell["p50_ms"]:
+                    p50s[mode].append(cell["p50_ms"])
+            if qps["off"]:
+                # back-to-back pairing cancels machine drift; the
+                # median across pairs kills scheduler outliers
+                pair_overheads.append(
+                    (qps["off"] - qps["on"]) / qps["off"] * 100)
+    finally:
+        flight.recorder.configure(enabled=prev_enabled)
+    overhead = (round(stats.median(pair_overheads), 2)
+                if pair_overheads else None)
+    p50_off = stats.median(p50s["off"]) if p50s["off"] else None
+    probe = flight_cost_probe()
+    out = {"recorder_off_qps": best["off"],
+           "recorder_on_qps": best["on"],
+           "overhead_pct": overhead,
+           **probe,
+           "recorder_off_fixed_cost_pct_of_p50": round(
+               probe["disabled_cycle_us_4t"] / (p50_off * 1e3) * 100, 3)
+           if p50_off else None}
+    log(f"tracing overhead: recorder off {best['off']} qps vs "
+        f"on {best['on']} qps ({overhead}% median on-overhead); "
+        f"fixed cycle cost on/off 4t = "
+        f"{probe['enabled_cycle_us_4t']}/"
+        f"{probe['disabled_cycle_us_4t']}us")
+    return out
+
+
+def flight_cost_probe(n: int = 20000, threads: int = 4) -> dict:
+    """Load-independent fixed cost of the flight instrumentation: the
+    begin/note/commit cycle timed solo and under `threads`-way
+    contention, recorder on and off.  Unlike the qps A/B (scheduler
+    noise swamps a ~5% effect on a shared 2-core box), these are
+    stable and directly catch the regressions the smoke gate exists
+    for — e.g. a contended lock reappearing on the hot path shows up
+    as ~10x in the 4-thread cycle cost (the convoy measured and fixed
+    in this PR), and the disabled cost bounds the always-on path the
+    <2% acceptance criterion speaks to."""
+    import threading
+
+    from pilosa_tpu.obs import flight
+
+    def cycle():
+        f = flight.begin("bench", "probe")
+        flight.note_phase("cache_lookup", 0.0001)
+        flight.commit(f, 0.0002, route="cached")
+
+    def storm(nthreads: int) -> float:
+        def worker():
+            for _ in range(n):
+                cycle()
+        ts = [threading.Thread(target=worker)
+              for _ in range(nthreads)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return (time.perf_counter() - t0) / (nthreads * n) * 1e6
+
+    prev = flight.recorder.enabled
+    try:
+        flight.recorder.configure(enabled=True)
+        on_1t, on_4t = storm(1), storm(threads)
+        flight.recorder.configure(enabled=False)
+        off_4t = storm(threads)
+    finally:
+        flight.recorder.configure(enabled=prev)
+    return {"enabled_cycle_us_1t": round(on_1t, 2),
+            "enabled_cycle_us_4t": round(on_4t, 2),
+            "disabled_cycle_us_4t": round(off_4t, 2)}
 
 
 def mixed_rw_gauntlet(h, n_readers: int = 32,
@@ -471,6 +601,8 @@ def mixed_rw_gauntlet(h, n_readers: int = 32,
     from pilosa_tpu.executor.executor import Executor
     from pilosa_tpu.shardwidth import SHARD_WIDTH
 
+    from pilosa_tpu.obs import flight
+
     read_qs = [
         "Count(Intersect(Row(a=1), Row(b=1)))",
         "Count(Row(a=1))",
@@ -479,6 +611,7 @@ def mixed_rw_gauntlet(h, n_readers: int = 32,
     ]
     out: dict = {}
     prev_flag = os.environ.get("PILOSA_TPU_STACK_PATCH")
+    prev_rec = (flight.recorder.enabled, flight.recorder._ring.maxlen)
     try:
         for patch_on in (True, False):
             os.environ["PILOSA_TPU_STACK_PATCH"] = \
@@ -491,6 +624,8 @@ def mixed_rw_gauntlet(h, n_readers: int = 32,
             for rate in write_rates:
                 patched0, rebuilt0 = (cache.patched_bytes,
                                       cache.rebuilt_bytes)
+                flight.recorder.configure(enabled=True, keep=16384)
+                flight.recorder.clear()
                 lat: list[float] = []
                 lock = threading.Lock()
                 writes = 0
@@ -555,6 +690,10 @@ def mixed_rw_gauntlet(h, n_readers: int = 32,
                         (pb + rb) / writes) if writes else None,
                     "patched_bytes": pb,
                     "rebuilt_bytes": rb,
+                    # per-phase attribution: under writes the A/B
+                    # should show the patch path's upload_ms shrink
+                    "phase_breakdown_ms": flight.phase_breakdown(
+                        flight.recorder.recent(16384)),
                 }
                 out.setdefault(f"w{rate}", {})[mode_key] = cell
                 log(f"mixed-rw w{rate}/s {mode_key}: "
@@ -567,6 +706,8 @@ def mixed_rw_gauntlet(h, n_readers: int = 32,
             os.environ.pop("PILOSA_TPU_STACK_PATCH", None)
         else:
             os.environ["PILOSA_TPU_STACK_PATCH"] = prev_flag
+        flight.recorder.configure(enabled=prev_rec[0],
+                                  keep=prev_rec[1])
     for rate_key, ab in out.items():
         on, off = ab.get("patch_on"), ab.get("patch_off")
         if on and off and on["read_p50_ms"]:
@@ -612,6 +753,9 @@ def main() -> None:
     # mixed read/write gauntlet: incremental stack maintenance
     # (delta patching) A/B under 32 readers + 1 point writer
     mixed = mixed_rw_gauntlet(h)
+    # flight-recorder overhead A/B (ISSUE 4 acceptance: recorder-off
+    # cost < 2% on the serving gauntlet, recorded machine-readably)
+    overhead = tracing_overhead_gauntlet(h)
     # RTT-independent device time for the sub-RTT north-star scans
     cal = loop_calibrate(h) if on_tpu else None
 
@@ -674,6 +818,10 @@ def main() -> None:
         # 10/100/1000 writes/s, incremental stack maintenance (delta
         # patching) on vs off — read p50/p99 + restacked bytes/write
         "mixed_rw_gauntlet": mixed,
+        # flight-recorder A/B: qps with the recorder on vs off and the
+        # resulting overhead percentage (check.sh gates a smoke
+        # version of this at tier-1 time)
+        "tracing_overhead": overhead,
     }
     if cal is not None:
         result["loop_calibrated_device_ms"] = {
@@ -699,7 +847,54 @@ def main() -> None:
     print(json.dumps(result))
 
 
+def overhead_smoke() -> int:
+    """check.sh tier-1 smoke (bench.py --overhead-smoke): a tiny
+    serving micro-bench with the flight recorder on vs off.  The HARD
+    gates are the stable fixed-cost probes (see flight_cost_probe —
+    the qps A/B jitters ±30% on a shared 2-core box, far above the
+    ~5% true effect, so it only backstops catastrophic regressions):
+
+    - disabled cycle (4-thread) <= PILOSA_TPU_OVERHEAD_OFF_MAX_US
+      (default 8us — measured ~1.2us; this is the always-on path the
+      <2% acceptance bound speaks to)
+    - enabled cycle (4-thread) <= PILOSA_TPU_OVERHEAD_ON_MAX_US
+      (default 60us — measured ~11us; a hot-path lock convoy shows
+      up here as ~10x)
+    - median qps overhead <= PILOSA_TPU_OVERHEAD_MAX_PCT (default 60)
+    """
+    import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    h, _ = build_index(2, 4)
+    out = tracing_overhead_gauntlet(h, n_clients=4, duration_s=0.6,
+                                    rounds=3)
+    lim_pct = float(os.environ.get("PILOSA_TPU_OVERHEAD_MAX_PCT", "60"))
+    lim_off = float(os.environ.get("PILOSA_TPU_OVERHEAD_OFF_MAX_US", "8"))
+    lim_on = float(os.environ.get("PILOSA_TPU_OVERHEAD_ON_MAX_US", "60"))
+    out["thresholds"] = {"qps_overhead_pct": lim_pct,
+                         "disabled_cycle_us": lim_off,
+                         "enabled_cycle_us": lim_on}
+    print(json.dumps({"metric": "tracing_overhead_smoke", **out}))
+    failures = []
+    if out["disabled_cycle_us_4t"] > lim_off:
+        failures.append(
+            f"disabled cycle {out['disabled_cycle_us_4t']}us > "
+            f"{lim_off}us")
+    if out["enabled_cycle_us_4t"] > lim_on:
+        failures.append(
+            f"enabled cycle {out['enabled_cycle_us_4t']}us > "
+            f"{lim_on}us")
+    if out["overhead_pct"] is not None and out["overhead_pct"] > lim_pct:
+        failures.append(
+            f"qps overhead {out['overhead_pct']}% > {lim_pct}%")
+    for msg in failures:
+        log("tracing-overhead smoke: " + msg)
+    return 1 if failures else 0
+
+
 if __name__ == "__main__":
+    if "--overhead-smoke" in sys.argv:
+        sys.exit(overhead_smoke())
     try:
         main()
     except Exception as e:  # clear failure JSON — never a bare crash

@@ -16,6 +16,18 @@ else
     echo "check.sh: ruff not installed — skipping lint" >&2
 fi
 
+echo "== tracing-overhead smoke =="
+# flight-recorder on-vs-off micro-bench (bench.py --overhead-smoke):
+# catches observability regressions (instrumentation creeping into
+# the hot path) at tier-1 time.  Hard gates are the stable fixed-cost
+# probes (PILOSA_TPU_OVERHEAD_{OFF,ON}_MAX_US); the scheduler-noisy
+# qps A/B is backstopped at PILOSA_TPU_OVERHEAD_MAX_PCT.
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python bench.py --overhead-smoke; then
+    echo "check.sh: tracing-overhead smoke failed" >&2
+    exit 1
+fi
+
 echo "== tier-1 (budget ${BUDGET}s) =="
 # per-run log (concurrent gates must not clobber each other);
 # no pipe around pytest: under plain sh a `... | tee` pipeline would

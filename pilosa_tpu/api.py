@@ -119,17 +119,15 @@ class API:
             prev = _tr.push_thread_tracer(tracer)
         try:
             try:
-                if profile:
-                    # profiled queries need their spans on THIS thread
-                    # — the batch leader would swallow them.  The
-                    # long-query log alone does NOT bypass serving:
-                    # it still records durations for every query and
-                    # spans for the ones that execute in-thread.
-                    results = self.executor.execute(index, pql, shards,
-                                                    remote=remote)
-                else:
-                    results = self.executor.execute_serving(
-                        index, pql, shards, remote=remote)
+                # Profile=true rides the serving path too: the query's
+                # TraceContext travels into the batch leader, which
+                # records the fused device phases (compile / upload /
+                # execute, per subquery) back into THIS thread's span
+                # tree (obs.tracing.capture_context / span_into) — a
+                # profiled query no longer forfeits batching, and its
+                # profile shows what the batch actually did.
+                results = self.executor.execute_serving(
+                    index, pql, shards, remote=remote)
             except (ExecError, ParseError, ValueError, KeyError) as e:
                 raise ApiError(str(e), 400)
         finally:

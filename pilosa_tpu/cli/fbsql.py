@@ -8,6 +8,8 @@ Backslash meta-commands follow the reference's psql-style set
     \\d             list tables            (SHOW TABLES)
     \\d <table>     describe a table       (SHOW COLUMNS)
     \\timing        toggle timing output
+    \\profile       toggle per-query span trees (Profile=true)
+    \\pql <index> <query>   run raw PQL against an index
     \\q             quit
 """
 
@@ -33,11 +35,24 @@ def _render(schema, rows, out=sys.stdout):
     print(f"({len(srows)} row{'s' if len(srows) != 1 else ''})", file=out)
 
 
+def _render_spans(spans, out, depth=0):
+    """Profile span tree, indented — the CLI face of the flight
+    recorder's device-phase attribution."""
+    for s in spans:
+        tags = s.get("tags", {})
+        tag_s = ("  " + " ".join(f"{k}={v}" for k, v in tags.items())
+                 if tags else "")
+        print(f"{'  ' * depth}{s['name']}: "
+              f"{s['duration_us'] / 1e3:.3f} ms{tag_s}", file=out)
+        _render_spans(s.get("children", []), out, depth + 1)
+
+
 class Shell:
     def __init__(self, host: str, client):
         self.host = host
         self.client = client
         self.timing = False
+        self.profile = False
 
     def execute(self, stmt: str, out=sys.stdout) -> bool:
         """Run one statement; returns False to exit the loop."""
@@ -70,11 +85,43 @@ class Shell:
             print(f"Timing is {'on' if self.timing else 'off'}.",
                   file=out)
             return True
+        if parts[0] == "\\profile":
+            self.profile = not self.profile
+            print(f"Profiling is {'on' if self.profile else 'off'}.",
+                  file=out)
+            return True
+        if parts[0] == "\\pql":
+            if len(parts) < 3:
+                print("usage: \\pql <index> <query>", file=out)
+                return True
+            return self._pql(parts[1], " ".join(parts[2:]), out)
         if parts[0] == "\\d":
             if len(parts) == 1:
                 return self.execute("SHOW TABLES", out)
             return self.execute(f"SHOW COLUMNS FROM {parts[1]}", out)
         print(f"unknown command {parts[0]!r}", file=out)
+        return True
+
+    def _pql(self, index: str, query: str, out) -> bool:
+        """Raw PQL with the shell's profile toggle: Profile=true
+        responses include the device-phase span tree."""
+        import json as _json
+
+        from pilosa_tpu.cluster.client import RemoteError
+        path = f"/index/{index}/query"
+        if self.profile:
+            path += "?profile=true"
+        try:
+            resp = self.client._request(self.host, "POST", path,
+                                        {"query": query})
+        except RemoteError as e:
+            print(f"ERROR: {e}", file=out)
+            return True
+        for r in resp.get("results", []):
+            print(_json.dumps(r), file=out)
+        if self.profile and resp.get("profile"):
+            print("-- profile --", file=out)
+            _render_spans(resp["profile"], out)
         return True
 
     def repl(self):

@@ -57,6 +57,7 @@ def cmd_server(args) -> int:
     })
     cfg.apply_kernel_setting()
     cfg.apply_stack_settings()
+    cfg.apply_flight_settings()
     holder = Holder(path=cfg.data_dir) if cfg.data_dir else Holder()
     holder.load_schema()
     auth = None
@@ -224,6 +225,13 @@ policy = ""      # YAML group->permission file (authz)
 [tpu]
 # pallas kernel dispatch: "auto" | "on" | "off"
 kernels = "auto"
+
+[flight]
+# query flight recorder: per-query phase records at /debug/queries
+# and /debug/trace (Perfetto).  recorder=false disables record
+# keeping; ring bounds how many records are kept.
+recorder = true
+ring = 512
 """
 
 

@@ -51,6 +51,13 @@ class Config:
     stack_patch: bool = True
     stack_delta_log_max: int = 256
     stack_patch_max_frac: float = 0.5
+    # query flight recorder (obs/flight.py): always-on per-query ring
+    # of phase-attributed records feeding /debug/queries and
+    # /debug/trace.  recorder=false disables record keeping (the
+    # tracing-overhead A/B switch; also PILOSA_TPU_FLIGHT=0);
+    # ring bounds how many records are kept.
+    flight_recorder: bool = True
+    flight_ring: int = 512
 
     def apply_kernel_setting(self):
         """Translate tpu_kernels into the Pallas dispatch env flag.
@@ -72,6 +79,12 @@ class Config:
         fragment.DELTA_LOG_MAX = int(self.stack_delta_log_max)
         stacked._PATCH_MAX_FRAC = float(self.stack_patch_max_frac)
 
+    def apply_flight_settings(self):
+        """Configure the process-global flight recorder ([flight])."""
+        from pilosa_tpu.obs import flight
+        flight.recorder.configure(enabled=self.flight_recorder,
+                                  keep=self.flight_ring)
+
 
 # TOML key (possibly [table] key) -> Config attribute
 _TOML_KEYS = {
@@ -92,6 +105,8 @@ _TOML_KEYS = {
     "stacked.patch": "stack_patch",
     "stacked.delta-log-max": "stack_delta_log_max",
     "stacked.patch-max-frac": "stack_patch_max_frac",
+    "flight.recorder": "flight_recorder",
+    "flight.ring": "flight_ring",
 }
 
 ENV_PREFIX = "PILOSA_TPU_"

@@ -35,7 +35,7 @@ from pilosa_tpu.executor.results import (
     ValCount,
 )
 from pilosa_tpu.models import timeq
-from pilosa_tpu.obs import metrics
+from pilosa_tpu.obs import flight, metrics
 from pilosa_tpu.obs.tracing import start_span
 from pilosa_tpu.models.field import FALSE_ROW, TRUE_ROW, Field
 from pilosa_tpu.models.holder import Holder
@@ -135,13 +135,19 @@ class Executor(AdvancedOps):
         # label only with names of real indexes: arbitrary client
         # strings would grow metric cardinality without bound
         known = idx is not None
+        # flight record for the SOLO path (no serving layer in front);
+        # begin() returns None when one is already open on this thread
+        # — the serving layer's direct fallback must not double-record
+        fl = flight.begin(index_name, query)
         try:
             if idx is None:
                 raise ExecError(f"index not found: {index_name}")
             q = parse(query) if isinstance(query, str) else query
             out = []
             # tracing.StartSpanFromContext analog (executor.go:6450)
-            with start_span("executor.Execute", index=index_name):
+            with start_span("executor.Execute", index=index_name) as sp:
+                if fl is not None:
+                    sp.set_tag("trace_id", fl["trace_id"])
                 for c in q.calls:
                     with start_span(f"executor.execute{c.name}"):
                         res = self._execute_call(idx, c, shards)
@@ -157,7 +163,10 @@ class Executor(AdvancedOps):
         finally:
             metrics.QUERY_TOTAL.inc(
                 index=index_name if known else "(unknown)", status=status)
-            metrics.QUERY_DURATION.observe(time.perf_counter() - t0)
+            dur = time.perf_counter() - t0
+            metrics.QUERY_DURATION.observe(dur)
+            flight.commit(fl, dur, route="solo",
+                          error=None if status == "ok" else status)
 
     # ------------------------------------------------------------------
     # dispatch

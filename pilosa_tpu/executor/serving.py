@@ -46,11 +46,19 @@ from pilosa_tpu.executor.results import Pair, RowResult, ValCount
 from pilosa_tpu.executor.stacked import (
     PlanBuilder,
     Unstackable,
+    _block,
     _compiled,
+    _dispatch_kind,
 )
 from pilosa_tpu.models.index import EXISTENCE_FIELD
-from pilosa_tpu.obs import metrics
-from pilosa_tpu.obs.tracing import start_span
+from pilosa_tpu.obs import flight, metrics
+from pilosa_tpu.obs.monitor import capture_exception
+from pilosa_tpu.obs.tracing import (
+    Span,
+    capture_context,
+    span_into,
+    start_span,
+)
 from pilosa_tpu.ops import kernels
 from pilosa_tpu.pql import parse
 from pilosa_tpu.pql.ast import Call, Query
@@ -77,6 +85,15 @@ _READ_CALLS = _PURE_BITMAP | {
 
 class Uncacheable(Exception):
     """Raised when a query's read set cannot be proven version-stable."""
+
+
+def _fingerprint(key) -> str:
+    """Stable short plan fingerprint of a cache key (index, canonical
+    call repr, shard set) — correlates flight records across runs,
+    unlike the salted builtin hash()."""
+    import hashlib
+    return hashlib.blake2b(repr(key).encode(),
+                           digest_size=8).hexdigest()
 
 
 # ---------------------------------------------------------------------------
@@ -389,7 +406,8 @@ class _Req:
 
     __slots__ = ("index", "idx", "q", "call", "kind", "shards", "skey",
                  "fields", "key", "snapshot", "result", "error",
-                 "direct", "event")
+                 "direct", "event", "ctx", "trace_id", "acc",
+                 "batch_size")
 
     def __init__(self, index, idx, q, call, kind, shards, skey,
                  fields, key, snapshot):
@@ -407,6 +425,15 @@ class _Req:
         self.error = None
         self.direct = False           # fall back to Executor.execute
         self.event = threading.Event()
+        # flight-recorder / tracing plumbing: the follower's captured
+        # trace context (obs.tracing.TraceContext — the leader records
+        # spans INTO it), its flight trace id, the leader-side phase
+        # accumulator merged back at commit, and the batch occupancy
+        # the leader stamped
+        self.ctx = None
+        self.trace_id = None
+        self.acc = None
+        self.batch_size = 1
 
 
 class QueryBatcher:
@@ -469,6 +496,12 @@ class QueryBatcher:
         try:
             self.serving._run_batch(batch)
         except Exception as e:  # belt-and-braces: never strand a waiter
+            # leader-thread failures are otherwise invisible to the
+            # followers' own monitoring — capture with the BATCH's
+            # trace ids so /debug/errors points at every affected query
+            capture_exception(
+                e, where="serving.batch", batch=len(batch),
+                trace_ids=[r.trace_id for r in batch if r.trace_id])
             for r in batch:
                 if r.result is None and r.error is None:
                     r.error = e
@@ -518,12 +551,18 @@ class ServingLayer:
         # executor.Execute root even for fused/cached serves (the
         # direct fallback nests its own copy inside — the root name
         # is what the log consumers pin on)
-        with start_span("executor.Execute", index=index):
-            return self._execute_read(ex, index, q, shards)
+        with start_span("executor.Execute", index=index) as root:
+            return self._execute_read(ex, index, q, shards, root)
 
-    def _execute_read(self, ex, index, q, shards):
+    def _execute_read(self, ex, index, q, shards, root=None):
         t0 = time.perf_counter()
         route = "direct"
+        fl = flight.begin(index, q)
+        if fl is not None and root is not None:
+            root.set_tag("trace_id", fl["trace_id"])
+        req = None
+        err = None
+        key = None
         try:
             idx = ex.holder.index(index)
             if idx is None:  # canonical "index not found" error path
@@ -534,6 +573,7 @@ class ServingLayer:
             # batcher's mid-flight consistency re-check, so compute it
             # even with the cache disabled
             fields = None
+            tc = time.perf_counter()
             try:
                 fields = query_fields(idx, q)
             except Uncacheable:
@@ -545,24 +585,34 @@ class ServingLayer:
             # must not run three times per query)
             snap = (field_snapshot(idx, fields)
                     if fields is not None else None)
-            if self.cache is not None:
-                if fields is not None:
-                    res = self.cache.get(idx, key, cur_snap=snap)
-                    if res is not _MISS:
-                        route = "cached"
-                        metrics.RESULT_CACHE.inc(outcome="hit")
-                        metrics.QUERY_TOTAL.inc(index=index, status="ok")
-                        metrics.QUERY_DURATION.observe(
-                            time.perf_counter() - t0)
-                        return res
-                    metrics.RESULT_CACHE.inc(outcome="miss")
+            cache_res = _MISS
+            if self.cache is not None and fields is not None:
+                cache_res = self.cache.get(idx, key, cur_snap=snap)
+            flight.note_phase("cache_lookup", time.perf_counter() - tc)
+            if cache_res is not _MISS:
+                route = "cached"
+                metrics.RESULT_CACHE.inc(outcome="hit")
+                metrics.QUERY_TOTAL.inc(index=index, status="ok")
+                metrics.QUERY_DURATION.observe(
+                    time.perf_counter() - t0)
+                return cache_res
+            if self.cache is not None and fields is not None:
+                metrics.RESULT_CACHE.inc(outcome="miss")
             # classification pays a shard-list sort — skip it
             # entirely in cache-only mode
             req = (self._classify(index, idx, q, shards, fields, key,
                                   snap)
                    if self.batching else None)
             if req is not None:
+                # cross-thread propagation: the leader records this
+                # request's device phases into the captured context
+                # (None when nothing traces — zero overhead)
+                req.ctx = capture_context()
+                if fl is not None:
+                    req.trace_id = fl["trace_id"]
+                tb = time.perf_counter()
                 self.batcher.run(req)
+                flight.note_phase("batch", time.perf_counter() - tb)
                 if req.error is not None:
                     raise req.error
                 if req.result is not None and not req.direct:
@@ -578,9 +628,22 @@ class ServingLayer:
                 snap = None
             return self._exec_and_cache(index, idx, q, shards, fields,
                                         key, snap)
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+            raise
         finally:
             metrics.SERVING_BATCHED.inc(route=route)
-            metrics.SERVING_LATENCY.observe(time.perf_counter() - t0)
+            dur = time.perf_counter() - t0
+            metrics.SERVING_LATENCY.observe(dur)
+            flight.commit(
+                fl, dur, route=route,
+                batch=req.batch_size if req is not None else 1,
+                error=err,
+                # fingerprinting reprs + hashes the whole key: only
+                # pay for it when a record is actually open
+                fingerprint=(_fingerprint(key)
+                             if fl is not None and key else None),
+                extra_acc=req.acc if req is not None else None)
 
     # -- classification ------------------------------------------------
 
@@ -623,6 +686,7 @@ class ServingLayer:
         # the wrong generation's fragments)
         groups: dict[tuple, list[_Req]] = {}
         for r in batch:
+            r.batch_size = len(batch)  # flight-record occupancy
             groups.setdefault((id(r.idx), r.skey), []).append(r)
         for reqs in groups.values():
             self._run_group(reqs)
@@ -659,11 +723,25 @@ class ServingLayer:
         for r in sorted(reqs, key=lambda r: repr(r.call)):
             if r.result is not None or r.error is not None:
                 continue
+            # per-request attribution ON the leader thread: stack
+            # fetches/uploads inside the build accumulate into THIS
+            # request's Acc, and spans graft into its TraceContext
+            r.acc = acc = flight.Acc()
+            prev = flight.push_acc(acc)
+            t0 = time.perf_counter()
             try:
-                built = self._build_sub(b, r, shards)
+                with span_into(r.ctx, "serving.plan",
+                               kind=r.kind):
+                    built = self._build_sub(b, r, shards)
             except Exception:
                 r.direct = True
                 continue
+            finally:
+                flight.pop_acc(prev)
+                stack_t = sum(v for k, v in acc.phases.items()
+                              if k.startswith("stack_"))
+                acc.add_phase("plan_build", max(
+                    time.perf_counter() - t0 - stack_t, 0.0))
             if built is None:
                 continue  # constant result already set on r
             sub, demux = built
@@ -672,20 +750,47 @@ class ServingLayer:
             pend.append(r)
         if not subs:
             return
+        # the SHARED phase: one fused dispatch serves every pending
+        # request, timed once and attributed (with a span copy) to
+        # each — a recompile of the multi program is tagged distinctly
+        # from a cached-executable dispatch
+        plan = ("multi", tuple(subs))
+        kern = kernels.enabled() and not eng.host_only
+        sig = (repr(plan), kern)  # multi-KB at high occupancy: once
+        kind = _dispatch_kind(sig, b.leaves, b.params)
+        sp = Span("serving.dispatch")
+        sp.tags.update(batch=len(pend), subqueries=len(subs),
+                       compile=kind == "compile")
+        t0 = time.perf_counter()
         try:
-            kern = kernels.enabled() and not eng.host_only
-            fn = _compiled(("multi", tuple(subs)), kern=kern)
-            outs = fn(tuple(b.leaves), tuple(b.params))
-        except Exception:
+            fn = _compiled(plan, kern=kern, sig=sig)
+            outs = _block(fn(tuple(b.leaves), tuple(b.params)))
+        except Exception as e:
+            # the fused program failing is a leader-side event the
+            # affected callers never see (they silently fall back) —
+            # surface it with every rider's trace id
+            capture_exception(
+                e, where="serving.fused_dispatch", batch=len(pend),
+                trace_ids=[r.trace_id for r in pend if r.trace_id])
             for r in pend:
                 r.direct = True
             return
+        finally:
+            sp.finish()
+        dt = time.perf_counter() - t0
+        for r in pend:
+            r.acc.add_phase(kind, dt)
+            if r.ctx is not None:
+                r.ctx.attach(sp.copy())
         for r, demux, out in zip(pend, demuxes, outs):
+            t1 = time.perf_counter()
             try:
-                r.result = demux(out)
+                with span_into(r.ctx, "serving.demux"):
+                    r.result = demux(out)
             except Exception:
                 r.direct = True
                 r.result = None
+            r.acc.add_phase("demux", time.perf_counter() - t1)
 
     def _build_sub(self, b: PlanBuilder, r: _Req, shards: list[int]):
         """(subplan, demux) for one request, or None after setting a

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import OrderedDict
 
 import numpy as np
@@ -50,7 +51,8 @@ import jax
 import jax.numpy as jnp
 
 from pilosa_tpu.models.view import VIEW_STANDARD
-from pilosa_tpu.obs import metrics
+from pilosa_tpu.obs import flight, metrics
+from pilosa_tpu.obs.tracing import start_span
 from pilosa_tpu.ops import bitmap as bm
 from pilosa_tpu.ops import bsi as bsi_ops
 from pilosa_tpu.ops import kernels
@@ -122,6 +124,22 @@ class TileStackCache:
         self.rebuilt_bytes = 0   # full stack bytes re-uploaded
 
     def get(self, key, versions: tuple, build, patcher=None):
+        """Fetch-or-build with flight/span attribution: every access
+        is timed and tagged with its outcome (hit / wait / patch /
+        rebuild) and the bytes it moved to the device, so a query's
+        flight record says exactly what its stacks cost."""
+        t0 = time.perf_counter()
+        with start_span("stacked.stack") as sp:
+            arr, outcome, moved = self._get(key, versions, build,
+                                            patcher)
+            sp.set_tag("outcome", outcome)
+            if moved:
+                sp.set_tag("bytes", moved)
+        flight.note_stack(outcome, moved, time.perf_counter() - t0)
+        return arr
+
+    def _get(self, key, versions: tuple, build, patcher=None):
+        waited = False
         while True:
             with self._lock:
                 ent = self._entries.get(key)
@@ -129,7 +147,7 @@ class TileStackCache:
                     self._entries.move_to_end(key)
                     self.hits += 1
                     metrics.STACK_CACHE.inc(outcome="hit")
-                    return ent[1]
+                    return ent[1], ("wait" if waited else "hit"), 0
                 ev = self._building.get(key)
                 if ev is None:
                     ev = self._building[key] = threading.Event()
@@ -141,10 +159,12 @@ class TileStackCache:
             # key — wait for its result, then re-check (it may have
             # built an older version than this access wants)
             metrics.STACK_CACHE.inc(outcome="wait")
+            waited = True
             ev.wait()
         try:
             # build/patch OUTSIDE the lock: restack + upload is slow
             arr = None
+            outcome, moved = "rebuild", 0
             if stale is not None and patcher is not None:
                 try:
                     patched = patcher(stale[1], stale[0])
@@ -152,6 +172,7 @@ class TileStackCache:
                     patched = None  # any patch failure → full rebuild
                 if patched is not None:
                     arr, pbytes = patched
+                    outcome, moved = "patch", pbytes
                     with self._lock:  # single-flight is per-KEY only
                         self.patches += 1
                         self.patched_bytes += pbytes
@@ -161,6 +182,7 @@ class TileStackCache:
             if arr is None:
                 arr = build()
                 nb = int(np.prod(arr.shape)) * arr.dtype.itemsize
+                moved = nb
                 with self._lock:
                     self.full_rebuilds += 1
                     self.rebuilt_bytes += nb
@@ -175,7 +197,7 @@ class TileStackCache:
                     # an entry that alone exceeds the budget is never
                     # cached (it would pin the cache over budget
                     # forever); the caller still gets the fresh stack
-                    return arr
+                    return arr, outcome, moved
                 self._entries[key] = (versions, arr, nbytes)
                 self._bytes += nbytes
                 # the new entry is most-recent so it is popped last,
@@ -183,7 +205,7 @@ class TileStackCache:
                 while self._bytes > self.max_bytes and self._entries:
                     _, (_, _, nb) = self._entries.popitem(last=False)
                     self._bytes -= nb
-            return arr
+            return arr, outcome, moved
         finally:
             with self._lock:
                 self._building.pop(key, None)
@@ -646,28 +668,103 @@ def _plan_run(plan, kern: bool = False):
     return run
 
 
-def _compiled(plan, kern: bool = False):
+def _compiled(plan, kern: bool = False, sig: tuple | None = None):
     """plan: ("words", tree) | ("count", tree, reduce)
     | ("bsi_sum", planes_i, tree|None, reduce)
     | ("row_counts", rows_i, tree|None, reduce)
     | ("multi", (subplan, ...)) — the batcher's fused program.
     One jitted fn per structure; `kern` routes resident-leaf hot ops
-    through the Pallas kernels.  With reduce=True the cross-shard sum
-    happens IN the program — under a mesh it lowers to a psum over ICI
-    (the jitted analog of mapReduce's reduceFn); int32-exact up to
-    _REDUCE_MAX_SHARDS shards, the caller's responsibility."""
-    sig = (repr(plan), kern)
+    through the Pallas kernels.  `sig` lets a caller that already
+    paid for repr(plan) — the multi-plan repr is multi-KB at high
+    batch occupancy — pass it in instead of rebuilding it.  With
+    reduce=True the cross-shard sum happens IN the program — under a
+    mesh it lowers to a psum over ICI (the jitted analog of
+    mapReduce's reduceFn); int32-exact up to _REDUCE_MAX_SHARDS
+    shards, the caller's responsibility."""
+    sig = (repr(plan), kern) if sig is None else sig
     with _JIT_LOCK:
         fn = _JIT_CACHE.get(sig)
         if fn is not None:
             _JIT_CACHE.move_to_end(sig)
             return fn
     fn = jax.jit(_plan_run(plan, kern))
+    evicted = []
     with _JIT_LOCK:
         _JIT_CACHE[sig] = fn
         while len(_JIT_CACHE) > _JIT_CACHE_MAX:
-            _JIT_CACHE.popitem(last=False)
+            evicted.append(_JIT_CACHE.popitem(last=False)[0])
+    for esig in evicted:
+        # an evicted jit wrapper WILL re-trace + recompile on its next
+        # dispatch — forget its shape keys so _dispatch_kind reports
+        # that as 'compile', not a cached 'execute'
+        _forget_dispatch_sig(esig)
     return fn
+
+
+# -- dispatch attribution (flight recorder) ---------------------------------
+# jax.jit compiles lazily per argument-shape signature, so "was this
+# dispatch a recompile?" is invisible from the wrapper.  We shadow
+# jit's cache key: the first time a (plan sig, arg shapes) pair is
+# dispatched the call traces + XLA-compiles and is attributed to the
+# "compile" phase; later dispatches of the same pair are "execute".
+# Bounded LRU, kept consistent with _JIT_CACHE: when a plan sig is
+# evicted there its shape keys are dropped here too (the next
+# dispatch really recompiles), so an entry surviving only ever
+# misclassifies a later dispatch as compile, never the other way.
+_SEEN_DISPATCH: OrderedDict = OrderedDict()
+_SEEN_DISPATCH_MAX = 4096
+_SEEN_LOCK = threading.Lock()
+
+
+def _forget_dispatch_sig(sig):
+    with _SEEN_LOCK:
+        for key in [k for k in _SEEN_DISPATCH if k[0] == sig]:
+            del _SEEN_DISPATCH[key]
+
+
+def _shape_key(arrs) -> tuple:
+    return tuple((getattr(a, "shape", None), str(getattr(a, "dtype", "")))
+                 for a in arrs)
+
+
+def _dispatch_kind(sig, leaves, params) -> str:
+    """'compile' on the first dispatch of (plan, arg shapes), else
+    'execute' — the flight recorder's recompile detector."""
+    key = (sig, _shape_key(leaves), _shape_key(params))
+    with _SEEN_LOCK:
+        if key in _SEEN_DISPATCH:
+            _SEEN_DISPATCH.move_to_end(key)
+            return "execute"
+        _SEEN_DISPATCH[key] = True
+        while len(_SEEN_DISPATCH) > _SEEN_DISPATCH_MAX:
+            _SEEN_DISPATCH.popitem(last=False)
+    return "compile"
+
+
+def _block(out):
+    """block_until_ready on any pytree of device/host arrays, so the
+    timed execute phase covers the device work, not just the async
+    dispatch.  Semantics-preserving: every caller converts the result
+    with np.asarray immediately after anyway."""
+    try:
+        return jax.block_until_ready(out)
+    except Exception:
+        return out
+
+
+def timed_dispatch(plan, kern, leaves, params):
+    """Run a plan's jitted program with flight/span attribution:
+    recompiles are timed distinctly from cached dispatches, and the
+    clock stops only when the device result is ready."""
+    sig = (repr(plan), kern)
+    fn = _compiled(plan, kern=kern, sig=sig)
+    kind = _dispatch_kind(sig, leaves, params)
+    t0 = time.perf_counter()
+    with start_span("stacked.dispatch", kind=plan[0],
+                    compile=kind == "compile"):
+        out = _block(fn(tuple(leaves), tuple(params)))
+    flight.note_phase(kind, time.perf_counter() - t0)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -1214,8 +1311,27 @@ class StackedEngine:
     # -- execution entry points ----------------------------------------
 
     def _run(self, plan, builder):
-        fn = _compiled(plan, kern=kernels.enabled() and not self.host_only)
-        return fn(tuple(builder.leaves), tuple(builder.params))
+        return timed_dispatch(
+            plan, kernels.enabled() and not self.host_only,
+            builder.leaves, builder.params)
+
+    def _build_timed(self, builder, call):
+        """PlanBuilder.build with plan-build attribution.  Stack/leaf
+        fetches inside the walk are attributed by TileStackCache.get
+        itself, so their share is subtracted here — plan_build is the
+        pure tree-walk cost."""
+        acc = flight.active_acc()
+        stack0 = (sum(v for k, v in acc.phases.items()
+                      if k.startswith("stack_")) if acc else 0.0)
+        t0 = time.perf_counter()
+        with start_span("stacked.plan_build", call=call.name):
+            tree = builder.build(call)
+        dt = time.perf_counter() - t0
+        if acc is not None:
+            dt -= sum(v for k, v in acc.phases.items()
+                      if k.startswith("stack_")) - stack0
+        flight.note_phase("plan_build", max(dt, 0.0))
+        return tree
 
     def _reduce_in_program(self, shards) -> bool:
         """In-program (ICI-collective) cross-shard reduce is int32-
@@ -1228,7 +1344,7 @@ class StackedEngine:
         if not shards:
             return 0
         b = PlanBuilder(self, idx, shards, pre)
-        tree = b.build(call)
+        tree = self._build_timed(b, call)
         if tree == ("zeros",):
             return 0
         red = self._reduce_in_program(shards)
@@ -1242,7 +1358,7 @@ class StackedEngine:
         if not shards:
             return None
         b = PlanBuilder(self, idx, shards, pre)
-        tree = b.build(call)
+        tree = self._build_timed(b, call)
         if tree == ("zeros",):
             return None
         out = np.asarray(self._run(("words", tree), b))
@@ -1795,8 +1911,9 @@ class StackedEngine:
             combos, dtype=np.int32).reshape(n_combos, nf)
         # pad combos re-count combo 0; their rows are dropped below
         sel_all = combo_idx.reshape(n_chunks, combo_chunk, nf)
-        fn = _compiled(plan, kern=kernels.enabled() and not self.host_only)
-        out = fn(tuple(b.leaves), tuple(b.params) + (sel_all,))
+        out = timed_dispatch(plan,
+                             kernels.enabled() and not self.host_only,
+                             b.leaves, tuple(b.params) + (sel_all,))
         if agg_field is None:
             c = np.asarray(out, dtype=np.int64)   # (n_chunks, C[, S])
             if not red:

@@ -19,9 +19,12 @@ from pilosa_tpu.obs.tracing import (
     ProfiledSpan,
     RecordingTracer,
     Span,
+    TraceContext,
     Tracer,
+    capture_context,
     get_tracer,
     set_tracer,
+    span_into,
     start_span,
 )
 
@@ -40,6 +43,9 @@ __all__ = [
     "RecordingTracer",
     "Span",
     "ProfiledSpan",
+    "TraceContext",
+    "capture_context",
+    "span_into",
     "get_tracer",
     "set_tracer",
     "start_span",

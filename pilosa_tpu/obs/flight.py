@@ -1,0 +1,313 @@
+"""Query flight recorder — always-on per-query phase attribution.
+
+The serving hot path (PRs 2-3) made a query's execution opaque from
+the outside: it may be fused into a leader's multi-program, served
+from the versioned result cache, satisfied by a patched device stack,
+or trigger a jit recompile — and ``/metrics`` aggregates can't say
+which happened to WHICH query.  This module keeps a bounded ring of
+per-query *flight records* (trace id, route, phase durations, cache
+outcomes, batch occupancy, bytes moved) cheap enough to leave on in
+production, feeding:
+
+- ``/debug/queries``  — recent records as JSON (server/http.py)
+- ``/debug/trace``    — the same records exported as Chrome
+  ``trace_event`` JSON, loadable in Perfetto / chrome://tracing
+- ``pilosa_query_phase_seconds`` histograms with exemplar trace ids
+  (obs/metrics.py)
+
+Attribution flows through thread-local :class:`Acc` accumulators: the
+serving layer pushes one per query, the deep layers (TileStackCache,
+the stacked dispatch) call :func:`note_phase`/:func:`note_stack`,
+which no-op in a few ns when no accumulator is active.  Work a batch
+LEADER performs for a follower is accumulated into a per-request Acc
+on the leader's thread and merged into the follower's record when its
+event fires (executor/serving.py) — the same cross-thread shape as
+``obs.tracing.TraceContext``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+
+# phases the leader stamps per fused request; also the BENCH JSON
+# breakdown axes (compile/upload/execute/wait)
+PHASES = ("plan_build", "compile", "execute", "demux", "cache_lookup",
+          "batch", "wait", "stack_hit", "stack_patch", "stack_rebuild",
+          "stack_wait")
+
+_tls = threading.local()
+
+
+class Acc:
+    """Per-query phase accumulator (seconds + stack-cache outcomes).
+    Plain mutable object — only ever touched by one thread at a time
+    (the owning request thread, or the leader while it serves the
+    request)."""
+
+    __slots__ = ("phases", "stack", "bytes_moved")
+
+    def __init__(self):
+        self.phases: dict[str, float] = {}
+        self.stack: dict[str, int] = {}
+        self.bytes_moved = 0
+
+    def add_phase(self, name: str, dt: float):
+        self.phases[name] = self.phases.get(name, 0.0) + dt
+
+    def add_stack(self, outcome: str, nbytes: int, dt: float):
+        self.stack[outcome] = self.stack.get(outcome, 0) + 1
+        self.bytes_moved += int(nbytes)
+        self.add_phase("stack_" + outcome, dt)
+
+    def merge(self, other: "Acc"):
+        for k, v in other.phases.items():
+            self.phases[k] = self.phases.get(k, 0.0) + v
+        for k, v in other.stack.items():
+            self.stack[k] = self.stack.get(k, 0) + v
+        self.bytes_moved += other.bytes_moved
+
+
+def push_acc(acc: Acc):
+    """Install `acc` as this thread's active accumulator; returns the
+    previous one to restore via pop_acc."""
+    prev = getattr(_tls, "acc", None)
+    _tls.acc = acc
+    return prev
+
+
+def pop_acc(prev):
+    _tls.acc = prev
+
+
+def active_acc() -> Acc | None:
+    return getattr(_tls, "acc", None)
+
+
+def note_phase(name: str, dt: float):
+    acc = getattr(_tls, "acc", None)
+    if acc is not None:
+        acc.add_phase(name, dt)
+
+
+def note_stack(outcome: str, nbytes: int, dt: float):
+    acc = getattr(_tls, "acc", None)
+    if acc is not None:
+        acc.add_stack(outcome, nbytes, dt)
+
+
+class FlightRecorder:
+    """Bounded ring of finished per-query flight records."""
+
+    def __init__(self, keep: int = 512, enabled: bool | None = None):
+        if enabled is None:
+            enabled = os.environ.get("PILOSA_TPU_FLIGHT", "1") != "0"
+        self.enabled = enabled
+        self._ring: deque[dict] = deque(maxlen=keep)
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+
+    def configure(self, enabled: bool | None = None,
+                  keep: int | None = None):
+        """Apply config knobs ([flight] in config.py).  Resizing
+        keeps the newest records."""
+        if enabled is not None:
+            self.enabled = bool(enabled)
+        if keep is not None and keep != self._ring.maxlen:
+            with self._lock:
+                self._ring = deque(self._ring, maxlen=int(keep))
+
+    def next_id(self) -> str:
+        return f"q{next(self._ids):x}"  # itertools.count: atomic
+
+    def record(self, rec: dict):
+        # LOCK-FREE hot path: deque.append with maxlen is atomic under
+        # the GIL, and a contended threading.Lock costs ~20us of GIL
+        # ping-pong per acquisition — measured to dominate the whole
+        # recorder at serving qps.  Readers snapshot with retry.
+        self._ring.append(rec)
+
+    def recent(self, n: int = 100) -> list[dict]:
+        """Newest-first records (the /debug/queries payload)."""
+        while True:
+            try:
+                items = list(self._ring)
+                break
+            except RuntimeError:
+                continue  # deque mutated mid-iteration: retry
+        return list(reversed(items))[: max(0, int(n))]
+
+    def clear(self):
+        self._ring.clear()
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    # -- Chrome trace_event export -------------------------------------
+
+    def chrome_trace(self, n: int = 100) -> dict:
+        """Recent records as the Chrome ``trace_event`` JSON object
+        format (loadable in Perfetto / chrome://tracing): one complete
+        ("ph": "X") event per query plus one per phase, on a per-query
+        virtual thread so concurrent queries render as parallel
+        tracks."""
+        events = []
+        for rec in self.recent(n):
+            ts = rec["start"] * 1e6          # epoch microseconds
+            dur = rec["duration_ms"] * 1e3
+            tid = rec["trace_id"]
+            args = {"index": rec.get("index", ""),
+                    "query": rec.get("query", ""),
+                    "route": rec.get("route", ""),
+                    "batch": rec.get("batch", 1)}
+            if rec.get("stack"):
+                args["stack"] = rec["stack"]
+            if rec.get("bytes_moved"):
+                args["bytes_moved"] = rec["bytes_moved"]
+            events.append({
+                "name": f"query:{rec.get('route', '?')}",
+                "cat": "query", "ph": "X", "pid": 1, "tid": tid,
+                "ts": ts, "dur": max(dur, 1.0), "args": args,
+            })
+            # phases render sequentially inside the query slice; we
+            # record durations (not offsets), so lay them end to end
+            # in PHASES order — relative widths are what matters
+            off = ts
+            for name in PHASES:
+                pdur = rec.get("phases", {}).get(name)
+                if not pdur:
+                    continue
+                events.append({
+                    "name": name, "cat": "phase", "ph": "X",
+                    "pid": 1, "tid": tid, "ts": off,
+                    "dur": max(pdur * 1e3, 0.5),
+                    "args": {"ms": round(pdur, 4)},
+                })
+                off += pdur * 1e3
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "otherData": {"source": "pilosa-tpu flight recorder"}}
+
+    def chrome_trace_json(self, n: int = 100) -> str:
+        return json.dumps(self.chrome_trace(n))
+
+
+# process-global recorder (the /debug surface and metrics exemplars
+# read this one); config.apply_flight_settings() reconfigures it
+recorder = FlightRecorder()
+
+
+def begin(index: str, query) -> dict | None:
+    """Open a flight record for this thread's query, or None when the
+    recorder is off or a record is already active (nested execute calls
+    — e.g. the serving layer's direct fallback re-entering
+    Executor.execute — must not double-record)."""
+    if not recorder.enabled or getattr(_tls, "rec", None) is not None:
+        return None
+    rec = {
+        "trace_id": recorder.next_id(),
+        "index": index,
+        "query": str(query)[:200],
+        "start": time.time(),
+        "acc": Acc(),
+    }
+    _tls.rec = rec
+    rec["prev_acc"] = push_acc(rec["acc"])
+    return rec
+
+
+def commit(rec: dict | None, duration_s: float, route: str = "solo",
+           batch: int = 1, error: str | None = None,
+           fingerprint: str | None = None,
+           extra_acc: Acc | None = None):
+    """Finish and ring-buffer a record opened by begin(); exports the
+    per-phase histograms with this record's trace id as exemplar."""
+    if rec is None:
+        return
+    acc: Acc = rec.pop("acc")
+    pop_acc(rec.pop("prev_acc"))
+    _tls.rec = None
+    if extra_acc is not None:
+        acc.merge(extra_acc)
+    # wait = time parked in the batcher not accounted to a device
+    # phase (admission window + other requests' share of the batch).
+    # Derived INTO acc.phases so it reaches the phase histogram, not
+    # just the record dict.
+    if "batch" in acc.phases:
+        accounted = sum(v for k, v in acc.phases.items()
+                        if k not in ("batch", "cache_lookup"))
+        acc.add_phase("wait",
+                      max(acc.phases["batch"] - accounted, 0.0))
+    phases = {k: round(v * 1e3, 4) for k, v in acc.phases.items()}
+    rec.update({
+        "duration_ms": round(duration_s * 1e3, 4),
+        "route": route,
+        "batch": int(batch),
+        "phases": phases,
+        "stack": dict(acc.stack),
+        "bytes_moved": acc.bytes_moved,
+    })
+    if error is not None:
+        rec["error"] = error[:200]
+    if fingerprint is not None:
+        rec["fingerprint"] = fingerprint
+    recorder.record(rec)
+    _buffer_phase_samples(acc, rec["trace_id"])
+
+
+# -- buffered phase-histogram export ----------------------------------------
+# A contended threading.Lock costs ~20us of GIL ping-pong per
+# acquisition; observing every phase of every query directly into the
+# shared histogram would convoy the serving threads.  Samples append
+# to a GLOBAL lock-free pending list (list.append is GIL-atomic) and
+# drain in one observe_batch() every _FLUSH_N samples — amortizing the
+# histogram lock ~64x.  Not per-thread: ThreadingHTTPServer spawns a
+# thread per connection, and thread-local buffers would die (samples
+# and all) with their threads.  /metrics rendering calls
+# flush_metrics() first, so a scrape always sees current samples; the
+# tiny race where a concurrent flush orphans an in-flight append loses
+# at most a sample or two — acceptable for a latency histogram, never
+# for the flight ring (which appends records directly).
+
+_FLUSH_N = 64
+_pending: list = []
+
+
+def flush_metrics():
+    """Drain the pending phase samples into the shared
+    pilosa_query_phase_seconds histogram (called on /metrics render
+    and by tests for determinism)."""
+    global _pending
+    buf, _pending = _pending, []
+    if buf:
+        from pilosa_tpu.obs import metrics
+        metrics.PHASE_DURATION.observe_batch(buf)
+
+
+def _buffer_phase_samples(acc: Acc, trace_id: str):
+    pend = _pending
+    for name, dt in acc.phases.items():
+        pend.append((dt, {"phase": name}, trace_id))
+    if len(pend) >= _FLUSH_N:
+        flush_metrics()
+
+
+def phase_breakdown(records: list[dict]) -> dict:
+    """Aggregate records into the BENCH JSON per-phase breakdown:
+    total ms by compile/upload/execute/wait (+ the rest verbatim)."""
+    out: dict[str, float] = {}
+    for rec in records:
+        for k, v in rec.get("phases", {}).items():
+            out[k] = out.get(k, 0.0) + v
+    agg = {
+        "compile_ms": round(out.pop("compile", 0.0), 3),
+        "execute_ms": round(out.pop("execute", 0.0), 3),
+        "upload_ms": round(out.pop("stack_rebuild", 0.0)
+                           + out.pop("stack_patch", 0.0), 3),
+        "wait_ms": round(out.pop("wait", 0.0), 3),
+    }
+    agg.update({k + "_ms": round(v, 3) for k, v in out.items()})
+    return agg

@@ -33,6 +33,18 @@ class Span:
         if self.end is None:
             self.end = time.perf_counter()
 
+    def copy(self) -> "Span":
+        """Deep copy of the finished subtree — a shared span (one
+        fused device dispatch serving N queries) is attached to every
+        requester's tree as its OWN copy, so no two trees alias."""
+        s = Span.__new__(Span)
+        s.name = self.name
+        s.tags = dict(self.tags)
+        s.start = self.start
+        s.end = self.end
+        s.children = [c.copy() for c in self.children]
+        return s
+
     @property
     def duration(self) -> float:
         return (self.end if self.end is not None
@@ -85,18 +97,27 @@ class Tracer:
 class NopTracer(Tracer):
     @contextmanager
     def span(self, name: str, **tags):
-        yield _NOP_SPAN
+        # a FRESH nop span per call: a single shared mutable instance
+        # would let any caller that appends children or pokes
+        # start/end corrupt every other caller's span (and leak the
+        # child list forever) — pinned by test_nop_span_not_shared
+        yield _NopSpan()
 
 
 class _NopSpan(Span):
+    """Inert span: mutators are no-ops, duration is frozen at 0."""
+
+    __slots__ = ()
+
     def __init__(self):
         super().__init__("nop")
+        self.end = self.start
 
     def set_tag(self, key: str, value):
         pass
 
-
-_NOP_SPAN = _NopSpan()
+    def finish(self):
+        pass
 
 _global = NopTracer()
 _tls = threading.local()
@@ -148,3 +169,89 @@ class RecordingTracer(Tracer):
                 self.roots.append(span)
                 if len(self.roots) > self.keep:
                     self.roots.pop(0)
+
+
+# ---------------------------------------------------------------------------
+# cross-thread trace-context propagation
+# ---------------------------------------------------------------------------
+# The serving batcher executes a follower's query on the LEADER's
+# thread (executor/serving.py); thread-local tracing would silently
+# drop every device phase of a fused Profile=true query.  A follower
+# captures a TraceContext (its tracer + innermost open span), carries
+# it into the leader, and the leader records spans INTO that context
+# from its own thread — the follower's span tree then includes the
+# leader-executed compile/upload/execute phases.
+
+_ATTACH_LOCK = threading.Lock()
+
+
+class TraceContext:
+    """Handle to another thread's (tracer, parent span)."""
+
+    __slots__ = ("tracer", "parent")
+
+    def __init__(self, tracer: Tracer, parent: Span | None):
+        self.tracer = tracer
+        self.parent = parent
+
+    def attach(self, span: Span):
+        """Graft a FINISHED span (tree) under the captured parent.
+        Safe from any thread: appends are serialized by a module lock
+        (the owning thread only ever appends too, never removes)."""
+        if self.parent is not None:
+            with _ATTACH_LOCK:
+                self.parent.children.append(span)
+        else:
+            self.tracer.on_finish(span, root=True)
+
+
+def capture_context() -> TraceContext | None:
+    """This thread's active trace context, or None when nothing
+    records (the common untraced case — callers skip all cross-thread
+    span work on None, keeping the disabled path overhead-free)."""
+    t = get_tracer()
+    if isinstance(t, NopTracer):
+        return None
+    st = t._stack()
+    return TraceContext(t, st[-1] if st else None)
+
+
+class _AttachTracer(Tracer):
+    """Thread-local tracer whose finished roots graft into a captured
+    TraceContext — spans opened via start_span() on the borrowed
+    thread (stack uploads, jit dispatch) land in the right tree."""
+
+    def __init__(self, ctx: TraceContext):
+        super().__init__()
+        self.ctx = ctx
+
+    def on_finish(self, span: Span, root: bool):
+        if root:
+            self.ctx.attach(span)
+
+
+_NOP_TRACER = NopTracer()
+
+
+@contextmanager
+def span_into(ctx: TraceContext | None, name: str, **tags):
+    """Open a span on THIS thread that records (with everything
+    start_span() nests inside it) into `ctx`'s tree.  With ctx=None
+    the body is SILENCED, not left on the thread's own tracer: a
+    traced batch leader serving an untraced follower must not adopt
+    the follower's inner spans (stack fetches etc.) into its own
+    profile tree."""
+    if ctx is None:
+        prev = push_thread_tracer(_NOP_TRACER)
+        try:
+            yield _NopSpan()
+        finally:
+            pop_thread_tracer(prev)
+        return
+    t = _AttachTracer(ctx)
+    prev = push_thread_tracer(t)
+    try:
+        with t.span(name, **tags) as s:
+            yield s
+    finally:
+        pop_thread_tracer(prev)

@@ -226,13 +226,37 @@ class GRPCHandler:
         """Raw executor results (api.query would JSON-serialize them;
         the wire mapping here needs the typed result objects).  Routed
         through the serving layer: concurrent RPC handler threads
-        coalesce into shared device dispatches when it is enabled."""
+        coalesce into shared device dispatches when it is enabled.
+
+        Profile=true rides the invocation metadata (the wire message
+        predates profiling): ``("profile", "true")`` returns the
+        device-phase span tree — the same shape as the HTTP
+        ``?profile=true`` response — as the ``profile-json`` trailing
+        metadata entry."""
         self._check(ctx, request.index, write=_pql_is_write(request.pql))
+        md = dict(ctx.invocation_metadata() or ())
+        profile = md.get("profile", "").lower() == "true"
+        tracer = prev = None
+        if profile:
+            import json as _json
+
+            from pilosa_tpu.obs import tracing as _tr
+            tracer = _tr.RecordingTracer()
+            prev = _tr.push_thread_tracer(tracer)
         try:
             return self.api.executor.execute_serving(
                 request.index, request.pql)
         except Exception as e:
             ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        finally:
+            if profile:
+                _tr.pop_thread_tracer(prev)
+                try:
+                    ctx.set_trailing_metadata((
+                        ("profile-json", _json.dumps(
+                            [s.to_dict() for s in tracer.roots])),))
+                except Exception:
+                    pass  # aborted context: never mask the status
 
     # -- PQL -----------------------------------------------------------
 

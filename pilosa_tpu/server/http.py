@@ -71,6 +71,7 @@ class Server:
                 max_batch=config.serving_batch_max,
                 cache_bytes=config.serving_cache_mb << 20,
                 batching=config.serving_batching)
+        config.apply_flight_settings()
         # (Authenticator, Authorizer | None) — enables the chkAuthZ
         # middleware in dispatch (http_handler.go chkAuthZ)
         self.auth = auth
@@ -180,8 +181,7 @@ class Server:
         r(Route("GET", "/query-history",
                 lambda req: self.api.query_history()))
         r(Route("GET", "/metrics", self._get_metrics))
-        r(Route("GET", "/metrics.json",
-                lambda req: metrics.registry.render_json()))
+        r(Route("GET", "/metrics.json", self._get_metrics_json))
         r(Route("GET", "/login", self._get_login))
         r(Route("GET", "/debug/errors", self._get_debug_errors))
         # profiling surface (http_handler.go:493-494 pprof/fgprof):
@@ -190,6 +190,11 @@ class Server:
         r(Route("GET", "/debug/allocs", self._get_debug_allocs))
         r(Route("GET", "/debug/long-queries",
                 lambda req: self.api.long_queries()))
+        # query flight recorder (obs/flight.py): recent per-query
+        # records as JSON, and as Chrome trace_event JSON loadable in
+        # Perfetto / chrome://tracing
+        r(Route("GET", "/debug/queries", self._get_debug_queries))
+        r(Route("GET", "/debug/trace", self._get_debug_trace))
         r(Route("GET", "/internal/diagnostics", self._get_diagnostics))
         r(Route("GET", "/internal/perf-counters",
                 self._get_perf_counters))
@@ -274,6 +279,22 @@ class Server:
         from pilosa_tpu.obs import profiler
         top = int(req.query.get("top", ["25"])[0])
         return RawResponse(profiler.heap_snapshot(top), "text/plain")
+
+    def _get_debug_queries(self, req):
+        """Recent flight records, newest first; ?n= bounds the count."""
+        from pilosa_tpu.obs import flight
+        n = int(req.query.get("n", ["100"])[0])
+        return {"enabled": flight.recorder.enabled,
+                "queries": flight.recorder.recent(n)}
+
+    def _get_debug_trace(self, req):
+        """Recent flight records as Chrome trace_event JSON — save
+        the body and open it in Perfetto (ui.perfetto.dev) or
+        chrome://tracing."""
+        from pilosa_tpu.obs import flight
+        n = int(req.query.get("n", ["100"])[0])
+        return RawResponse(flight.recorder.chrome_trace_json(n),
+                           "application/json")
 
     def _get_diagnostics(self, req):
         from pilosa_tpu import __version__
@@ -569,8 +590,25 @@ class Server:
         return {"standard": self.api.shard_max()}
 
     def _get_metrics(self, req):
+        from pilosa_tpu.obs import flight
+        flight.flush_metrics()  # drain buffered phase samples first
+        # exemplars are EXPLICITLY opt-in (?exemplars=1): the classic
+        # 0.0.4 text parser fails the whole scrape on a mid-line '#',
+        # and advertising OpenMetrics via Accept-header negotiation
+        # would be worse — Prometheus sends that header by default and
+        # its OpenMetrics parser rejects this exposition (no '# EOF',
+        # classic counter naming), failing every stock scrape
+        if req.query.get("exemplars", ["0"])[0] in ("1", "true"):
+            return RawResponse(
+                metrics.registry.render_text(openmetrics=True),
+                "text/plain; version=0.0.4")
         return RawResponse(metrics.registry.render_text(),
                            "text/plain; version=0.0.4")
+
+    def _get_metrics_json(self, req):
+        from pilosa_tpu.obs import flight
+        flight.flush_metrics()  # JSON scrapes see current data too
+        return metrics.registry.render_json()
 
 
 class RawResponse:

@@ -152,6 +152,30 @@ def test_fbsql_shell(node, capsys):
     assert "ERROR" in out3.getvalue()
 
 
+def test_fbsql_pql_and_profile(node):
+    """\\pql runs raw PQL; the \\profile toggle adds the device-phase
+    span tree to the rendered output (the CLI face of Profile=true)."""
+    srv, holder, host = node
+    _seed(srv.api)
+    from pilosa_tpu.cli.fbsql import Shell
+    from pilosa_tpu.cluster.client import InternalClient
+    sh = Shell(host, InternalClient())
+    out = io.StringIO()
+    sh.execute("\\pql b Count(Row(f=1))", out)
+    assert "3" in out.getvalue()
+    assert "-- profile --" not in out.getvalue()
+    out2 = io.StringIO()
+    sh.execute("\\profile", out2)
+    assert "Profiling is on" in out2.getvalue()
+    sh.execute("\\pql b Count(Row(f=1))", out2)
+    text = out2.getvalue()
+    assert "-- profile --" in text
+    assert "executor.Execute" in text and "ms" in text
+    out3 = io.StringIO()
+    sh.execute("\\pql", out3)
+    assert "usage:" in out3.getvalue()
+
+
 def test_exclusive_transaction_blocks_writes(node):
     """While an exclusive transaction is active, imports, PQL writes,
     and SQL writes are refused with 409 (the backup quiesce)."""

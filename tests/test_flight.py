@@ -1,0 +1,487 @@
+"""Query flight recorder tests (ISSUE 4): nop-span isolation,
+cross-thread trace-context propagation through the serving batcher,
+the per-query flight-record ring + Chrome trace export, the /debug
+endpoint surface (auth included), and monitor capture with batch
+trace ids."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from pilosa_tpu.api import API, serialize_result
+from pilosa_tpu.executor.executor import Executor
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.obs import flight, metrics
+from pilosa_tpu.obs.tracing import (
+    NopTracer,
+    RecordingTracer,
+    Span,
+    capture_context,
+    pop_thread_tracer,
+    push_thread_tracer,
+    span_into,
+    start_span,
+)
+
+
+def build_holder() -> Holder:
+    h = Holder()
+    idx = h.create_index("i", track_existence=True)
+    idx.create_field("a")
+    idx.create_field("b")
+    ex = Executor(h)
+    for c in range(200):
+        ex.execute("i", f"Set({c}, a={c % 3})")
+        ex.execute("i", f"Set({c}, b={c % 5})")
+    return h
+
+
+@pytest.fixture(scope="module")
+def holder():
+    return build_holder()
+
+
+# ---------------------------------------------------------------------------
+# satellite: nop spans must not share mutable state
+# ---------------------------------------------------------------------------
+
+def test_nop_span_not_shared():
+    t = NopTracer()
+    with t.span("x") as s1:
+        s1.children.append(Span("evil"))
+        s1.tags["k"] = "v"
+        s1.start = -1.0
+    with t.span("y") as s2:
+        # a fresh nop span every time: nothing leaked from s1
+        assert s2 is not s1
+        assert s2.children == []
+        assert "k" not in s2.tags
+        assert s2.start != -1.0
+        # duration is frozen: finish/set_tag are inert
+        s2.set_tag("a", 1)
+        s2.finish()
+        assert s2.duration == 0.0
+        assert s2.tags == {}
+
+
+def test_span_copy_is_deep():
+    s = Span("root")
+    s.set_tag("k", "v")
+    c = Span("child")
+    c.finish()
+    s.children.append(c)
+    s.finish()
+    cp = s.copy()
+    assert cp.to_dict() == s.to_dict()
+    cp.children.append(Span("extra"))
+    cp.tags["other"] = 1
+    assert len(s.children) == 1 and "other" not in s.tags
+
+
+# ---------------------------------------------------------------------------
+# cross-thread trace-context propagation
+# ---------------------------------------------------------------------------
+
+def test_capture_context_none_when_untraced():
+    assert capture_context() is None  # NopTracer default: zero work
+
+
+def test_span_into_grafts_across_threads():
+    tracer = RecordingTracer()
+    prev = push_thread_tracer(tracer)
+    try:
+        with start_span("root") as root:
+            ctx = capture_context()
+            assert ctx is not None and ctx.parent is root
+
+            def leader():
+                with span_into(ctx, "leader.work", batch=3):
+                    with start_span("leader.nested"):
+                        pass
+
+            t = threading.Thread(target=leader)
+            t.start()
+            t.join()
+        d = tracer.roots[0].to_dict()
+        assert d["name"] == "root"
+        names = [c["name"] for c in d["children"]]
+        assert "leader.work" in names
+        lw = d["children"][names.index("leader.work")]
+        assert lw["tags"] == {"batch": 3}
+        assert [c["name"] for c in lw["children"]] == ["leader.nested"]
+    finally:
+        pop_thread_tracer(prev)
+
+
+def test_span_into_none_silences_borrowed_thread():
+    """A traced batch leader serving an UNtraced follower must not
+    adopt the follower's inner spans into its own tree."""
+    tracer = RecordingTracer()
+    prev = push_thread_tracer(tracer)
+    try:
+        with start_span("root"):
+            with span_into(None, "follower.plan"):
+                with start_span("follower.inner"):
+                    pass
+        d = tracer.roots[0].to_dict()
+        assert "children" not in d, d
+    finally:
+        pop_thread_tracer(prev)
+
+
+def test_span_into_rootless_context_records_root():
+    tracer = RecordingTracer()
+    prev = push_thread_tracer(tracer)
+    try:
+        ctx = capture_context()  # no open span: parent is None
+    finally:
+        pop_thread_tracer(prev)
+    with span_into(ctx, "detached"):
+        pass
+    assert [s.name for s in tracer.roots] == ["detached"]
+
+
+# ---------------------------------------------------------------------------
+# flight records
+# ---------------------------------------------------------------------------
+
+def test_flight_record_routes_and_phases(holder):
+    ex = Executor(holder)
+    ex.enable_serving(window_s=0.0, max_batch=8)
+    flight.recorder.configure(enabled=True)
+    flight.recorder.clear()
+    ex.execute_serving("i", "Count(Row(a=1))")
+    ex.execute_serving("i", "Count(Row(a=1))")  # result-cache hit
+    recs = flight.recorder.recent(10)
+    assert len(recs) >= 2
+    hit, first = recs[0], recs[1]
+    assert hit["route"] == "cached"
+    assert "cache_lookup" in hit["phases"]
+    assert first["route"] in ("fused", "direct")
+    assert first["trace_id"] != hit["trace_id"]
+    assert first["index"] == "i"
+    assert first["query"].startswith("Count")
+    assert first["duration_ms"] > 0
+    if first["route"] == "fused":
+        # device phases stamped by the leader path, plus the derived
+        # wait (batch minus attributed phases) — which must also reach
+        # the phase histogram, not just the record dict
+        assert ("compile" in first["phases"]
+                or "execute" in first["phases"])
+        assert "wait" in first["phases"]
+        assert "fingerprint" in first
+        flight.flush_metrics()
+        assert metrics.PHASE_DURATION.count(phase="wait") > 0
+
+
+def test_flight_solo_path_records(holder):
+    ex = Executor(holder)  # no serving layer at all
+    flight.recorder.configure(enabled=True)
+    flight.recorder.clear()
+    ex.execute("i", "Count(Row(b=2))")
+    recs = flight.recorder.recent(5)
+    assert recs and recs[0]["route"] == "solo"
+    # the stacked engine attributed its dispatch
+    assert ("compile" in recs[0]["phases"]
+            or "execute" in recs[0]["phases"])
+
+
+def test_flight_disabled_records_nothing(holder):
+    ex = Executor(holder)
+    flight.recorder.configure(enabled=False)
+    try:
+        flight.recorder.clear()
+        ex.execute("i", "Count(Row(a=0))")
+        assert flight.recorder.recent(5) == []
+    finally:
+        flight.recorder.configure(enabled=True)
+
+
+def test_flight_ring_bounded():
+    flight.recorder.configure(enabled=True, keep=4)
+    try:
+        flight.recorder.clear()
+        for i in range(10):
+            flight.recorder.record({"trace_id": f"t{i}", "start": 0.0,
+                                    "duration_ms": 1.0, "phases": {}})
+        recs = flight.recorder.recent(100)
+        assert len(recs) == 4
+        assert recs[0]["trace_id"] == "t9"  # newest first
+    finally:
+        flight.recorder.configure(keep=512)
+
+
+def test_chrome_trace_is_valid_trace_event_json(holder):
+    ex = Executor(holder)
+    ex.enable_serving(window_s=0.0, max_batch=8)
+    flight.recorder.configure(enabled=True)
+    flight.recorder.clear()
+    ex.execute_serving("i", "Count(Intersect(Row(a=1), Row(b=1)))")
+    raw = flight.recorder.chrome_trace_json(50)
+    doc = json.loads(raw)  # must round-trip as strict JSON
+    evs = doc["traceEvents"]
+    assert evs, "no trace events exported"
+    for ev in evs:
+        # Chrome trace_event complete-event invariants
+        assert ev["ph"] == "X"
+        assert isinstance(ev["name"], str) and ev["name"]
+        assert isinstance(ev["ts"], (int, float))
+        assert ev["dur"] > 0
+        assert "pid" in ev and "tid" in ev
+    assert any(ev["cat"] == "query" for ev in evs)
+    assert doc["displayTimeUnit"] == "ms"
+
+
+def test_phase_histogram_exemplars(holder):
+    ex = Executor(holder)
+    flight.recorder.configure(enabled=True)
+    ex.execute("i", "Count(Row(a=2))")
+    flight.flush_metrics()  # drain this thread's buffered samples
+    assert metrics.PHASE_DURATION.count(phase="execute") + \
+        metrics.PHASE_DURATION.count(phase="compile") > 0
+    ex_val = (metrics.PHASE_DURATION.exemplar(phase="execute")
+              or metrics.PHASE_DURATION.exemplar(phase="compile"))
+    assert ex_val is not None and ex_val[1].startswith("q")
+    # exemplars render ONLY under OpenMetrics: the classic 0.0.4 text
+    # parser fails the whole scrape on a mid-line '#'
+    assert 'trace_id="q' in metrics.registry.render_text(
+        openmetrics=True)
+    assert 'trace_id="' not in metrics.registry.render_text()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: Profile=true fused into a concurrent batch
+# ---------------------------------------------------------------------------
+
+def _span_names(d, out):
+    out.append((d["name"], d.get("tags", {})))
+    for c in d.get("children", []):
+        _span_names(c, out)
+    return out
+
+
+def test_profile_fused_batch_multithreaded(holder):
+    """A Profile=true query fused into a concurrent batch returns a
+    span tree including its leader-executed device phases, attributed
+    per subquery (the PR's acceptance criterion)."""
+    api = API(holder)
+    api.executor.enable_serving(window_s=0.05, max_batch=64,
+                                cache_bytes=0)  # no cache: force fusion
+    plain = Executor(holder)
+    queries = [f"Count(Row(a={i % 3}))" for i in range(3)] + [
+        "Count(Intersect(Row(a=1), Row(b=1)))",
+        "Count(Union(Row(a=0), Row(b=4)))",
+        "Count(Row(b=2))",
+        "Count(Xor(Row(a=2), Row(b=3)))",
+        "Count(Difference(Row(a=1), Row(b=0)))",
+    ]
+    want = {q: [serialize_result(r) for r in plain.execute("i", q)]
+            for q in queries}
+
+    for _attempt in range(3):
+        got = {}
+        lock = threading.Lock()
+        barrier = threading.Barrier(len(queries))
+
+        def run(q):
+            barrier.wait()
+            resp = api.query("i", q, profile=True)
+            with lock:
+                got[q] = resp
+
+        threads = [threading.Thread(target=run, args=(q,))
+                   for q in queries]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # bit-exactness never bends for observability
+        assert {q: r["results"] for q, r in got.items()} == want
+        fused_trees = []
+        for q, resp in got.items():
+            prof = resp.get("profile")
+            assert prof and prof[0]["name"] == "executor.Execute"
+            spans = _span_names(prof[0], [])
+            names = [n for n, _t in spans]
+            if "serving.dispatch" in names:
+                fused_trees.append(spans)
+        # at least one query must have ridden a real (>=2) batch and
+        # carry the leader-executed device phases in ITS OWN tree
+        batched = []
+        for spans in fused_trees:
+            for name, tags in spans:
+                if name == "serving.dispatch" and tags.get("batch", 0) >= 2:
+                    batched.append((spans, tags))
+        if batched:
+            break
+    assert batched, "no profiled query ever fused into a >=2 batch"
+    spans, dtags = batched[0]
+    names = [n for n, _t in spans]
+    # per-subquery phases: plan + dispatch + demux all present, and
+    # the dispatch span says whether it compiled or hit the jit cache
+    assert "serving.plan" in names
+    assert "serving.demux" in names
+    assert "compile" in dtags and "subqueries" in dtags
+    # the fused subtree includes the trace-tagged root on the caller
+    assert any(n == "executor.Execute" for n in names)
+
+
+def test_profile_solo_still_works(holder):
+    api = API(holder)  # serving never enabled
+    resp = api.query("i", "Count(Row(a=1))", profile=True)
+    assert resp["profile"][0]["name"] == "executor.Execute"
+    kids = [c["name"] for c in resp["profile"][0].get("children", [])]
+    assert "executor.executeCount" in kids
+
+
+# ---------------------------------------------------------------------------
+# satellite: monitor capture with the batch's trace ids
+# ---------------------------------------------------------------------------
+
+def test_batch_failure_captured_with_trace_ids(holder):
+    from pilosa_tpu.obs.monitor import global_monitor
+
+    ex = Executor(holder)
+    layer = ex.enable_serving(window_s=0.0, max_batch=8, cache_bytes=0)
+    flight.recorder.configure(enabled=True)
+
+    def boom(batch):
+        raise RuntimeError("leader died mid-batch")
+
+    layer._run_batch = boom
+    before = len(global_monitor.recent())
+    with pytest.raises(RuntimeError, match="leader died"):
+        ex.execute_serving("i", "Count(Row(a=1))")
+    events = global_monitor.recent()
+    assert len(events) > before
+    ev = events[-1]
+    assert ev["type"] == "RuntimeError"
+    assert ev["where"] == "serving.batch"
+    assert ev["batch"] >= 1
+    assert ev["trace_ids"], "batch trace ids missing from capture"
+    # the failing query's own flight record carries the error too
+    recs = flight.recorder.recent(5)
+    assert recs and recs[0].get("error", "").startswith("RuntimeError")
+    assert recs[0]["trace_id"] in ev["trace_ids"]
+
+
+# ---------------------------------------------------------------------------
+# /debug endpoint surface
+# ---------------------------------------------------------------------------
+
+def _req(port, method, path, body=None, headers=None):
+    import http.client
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    data = json.dumps(body) if isinstance(body, (dict, list)) else body
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    c.request(method, path, body=data, headers=hdrs)
+    r = c.getresponse()
+    raw = r.read()
+    c.close()
+    try:
+        return r.status, json.loads(raw)
+    except json.JSONDecodeError:
+        return r.status, raw.decode()
+
+
+def test_debug_queries_and_trace_endpoints():
+    from pilosa_tpu.server.http import Server
+
+    flight.recorder.configure(enabled=True)
+    srv = Server().start()
+    try:
+        _req(srv.port, "POST", "/index/dq", {})
+        _req(srv.port, "POST", "/index/dq/field/f", {})
+        _req(srv.port, "POST", "/index/dq/query",
+             {"query": "Set(1, f=1)"})
+        _req(srv.port, "POST", "/index/dq/query",
+             {"query": "Count(Row(f=1))"})
+        st, d = _req(srv.port, "GET", "/debug/queries?n=50")
+        assert st == 200 and d["enabled"] is True
+        qs = d["queries"]
+        assert any(r["index"] == "dq" and r["query"].startswith("Count")
+                   for r in qs)
+        rec = next(r for r in qs if r["query"].startswith("Count"))
+        for field in ("trace_id", "route", "duration_ms", "phases",
+                      "batch", "start"):
+            assert field in rec, field
+        st, trace = _req(srv.port, "GET", "/debug/trace?n=50")
+        assert st == 200
+        assert isinstance(trace, dict) and trace["traceEvents"]
+        assert all(ev["ph"] == "X" for ev in trace["traceEvents"])
+        # /metrics: phase histograms flushed; exemplars only under a
+        # negotiated OpenMetrics Accept header
+        st, text = _req(srv.port, "GET", "/metrics")
+        assert st == 200
+        assert "pilosa_query_phase_seconds_bucket" in text
+        assert 'trace_id="' not in text
+        # Accept-header negotiation is deliberately NOT honored:
+        # stock Prometheus sends the OpenMetrics Accept header by
+        # default but would reject this exposition — exemplars are an
+        # explicit opt-in query param
+        st, text = _req(srv.port, "GET", "/metrics", headers={
+            "Accept": "application/openmetrics-text"})
+        assert st == 200 and 'trace_id="' not in text
+        st, text = _req(srv.port, "GET", "/metrics?exemplars=1")
+        assert st == 200 and 'trace_id="q' in text
+        # /metrics.json flushes too
+        st, j = _req(srv.port, "GET", "/metrics.json")
+        assert st == 200 and "pilosa_query_phase_seconds" in j
+    finally:
+        srv.close()
+
+
+def test_debug_endpoints_admin_gated():
+    from pilosa_tpu.server.authn import Authenticator, encode_jwt
+    from pilosa_tpu.server.authz import Authorizer
+    from pilosa_tpu.server.http import Server
+
+    secret = b"flight-test-secret"
+    authn = Authenticator(secret)
+    authz = Authorizer(user_groups={"readers": {"dq": "read"}},
+                       admin_group="admins")
+    srv = Server(auth=(authn, authz)).start()
+    try:
+        rtok = encode_jwt({"groups": ["readers"],
+                           "exp": time.time() + 60}, secret)
+        atok = encode_jwt({"groups": ["admins"],
+                           "exp": time.time() + 60}, secret)
+        for path in ("/debug/queries", "/debug/trace",
+                     "/debug/profile?seconds=0.05&hz=20",
+                     "/debug/allocs", "/debug/errors"):
+            st, _ = _req(srv.port, "GET", path)
+            assert st == 401, path             # no token
+            st, _ = _req(srv.port, "GET", path, headers={
+                "Authorization": f"Bearer {rtok}"})
+            assert st == 403, path             # read-only token
+            st, _ = _req(srv.port, "GET", path, headers={
+                "Authorization": f"Bearer {atok}"})
+            assert st == 200, path             # admin passes
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# config knobs
+# ---------------------------------------------------------------------------
+
+def test_flight_config_knobs(tmp_path):
+    from pilosa_tpu import config as cfgmod
+
+    p = tmp_path / "c.toml"
+    p.write_text("[flight]\nrecorder = false\nring = 9\n")
+    cfg = cfgmod.load(str(p), env={})
+    assert cfg.flight_recorder is False and cfg.flight_ring == 9
+    prev = (flight.recorder.enabled, flight.recorder._ring.maxlen)
+    try:
+        cfg.apply_flight_settings()
+        assert flight.recorder.enabled is False
+        assert flight.recorder._ring.maxlen == 9
+    finally:
+        flight.recorder.configure(enabled=prev[0], keep=prev[1])
+    # env wins over file (the standard layering)
+    cfg2 = cfgmod.load(str(p), env={"PILOSA_TPU_FLIGHT_RECORDER": "1"})
+    assert cfg2.flight_recorder is True

@@ -82,6 +82,37 @@ def test_grpc_index_crud_and_pql(stack):
     assert not got.indexes
 
 
+def test_grpc_profile_metadata(stack):
+    """Profile=true over gRPC: ("profile", "true") invocation metadata
+    returns the span tree as the profile-json trailing metadata entry
+    (the wire message predates profiling)."""
+    import json
+
+    api, srv, chan = stack
+    _unary(chan, "CreateIndex", pb.CreateIndexRequest(name="gp"),
+           pb.CreateIndexResponse)
+    api.create_field("gp", "f", {"type": "set"})
+    api.query("gp", "Set(1, f=7)")
+
+    fn = chan.unary_unary(
+        "/proto.Pilosa/QueryPQLUnary",
+        request_serializer=pb.QueryPQLRequest.SerializeToString,
+        response_deserializer=pb.TableResponse.FromString)
+    resp, call = fn.with_call(
+        pb.QueryPQLRequest(index="gp", pql="Count(Row(f=7))"),
+        metadata=(("profile", "true"),))
+    assert resp.rows[0].columns[0].uint64Val == 1
+    md = dict(call.trailing_metadata() or ())
+    spans = json.loads(md["profile-json"])
+    assert spans and spans[0]["name"] == "executor.Execute"
+    # without the metadata flag no profile rides along
+    resp, call = fn.with_call(
+        pb.QueryPQLRequest(index="gp", pql="Count(Row(f=7))"))
+    assert "profile-json" not in dict(call.trailing_metadata() or ())
+    _unary(chan, "DeleteIndex", pb.DeleteIndexRequest(name="gp"),
+           pb.DeleteIndexResponse)
+
+
 def test_grpc_sql(stack):
     api, srv, chan = stack
     table = _unary(chan, "QuerySQLUnary", pb.QuerySQLRequest(
