@@ -28,6 +28,17 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 
+echo "== memory-pressure smoke =="
+# HBM residency manager gate (bench.py --memory-smoke): budget
+# clamped below the working set -> queries stay bit-exact (paging
+# correctness) and injected RESOURCE_EXHAUSTED never escapes the
+# backstop (evict + retry, then host fallback)
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python bench.py --memory-smoke; then
+    echo "check.sh: memory-pressure smoke failed" >&2
+    exit 1
+fi
+
 echo "== tier-1 (budget ${BUDGET}s) =="
 # per-run log (concurrent gates must not clobber each other);
 # no pipe around pytest: under plain sh a `... | tee` pipeline would

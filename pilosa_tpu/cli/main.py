@@ -58,6 +58,7 @@ def cmd_server(args) -> int:
     cfg.apply_kernel_setting()
     cfg.apply_stack_settings()
     cfg.apply_flight_settings()
+    cfg.apply_memory_settings()
     holder = Holder(path=cfg.data_dir) if cfg.data_dir else Holder()
     holder.load_schema()
     auth = None
@@ -232,6 +233,22 @@ kernels = "auto"
 # keeping; ring bounds how many records are kept.
 recorder = true
 ring = 512
+
+[memory]
+# HBM residency manager: one process-wide device-byte budget shared
+# by the tile-stack / jit / result caches.  budget-bytes 0 = auto
+# (device memory minus headroom-frac; 8 GiB fallback without device
+# stats).  paged = page-granular stack eviction/patching; prefetch
+# warms predicted pages from the flight recorder; oom-retry and
+# host-fallback are the RESOURCE_EXHAUSTED backstop rungs.
+budget-bytes = 0
+headroom-frac = 0.1
+page-bytes = 4194304
+paged = true
+prefetch = true
+prefetch-interval-s = 0.5
+oom-retry = true
+host-fallback = true
 """
 
 

@@ -51,6 +51,22 @@ class Config:
     stack_patch: bool = True
     stack_delta_log_max: int = 256
     stack_patch_max_frac: float = 0.5
+    # HBM residency manager (pilosa_tpu/memory): one process-wide
+    # device-byte budget shared by the tile-stack/jit/result caches.
+    # budget-bytes 0 = auto (device memory_stats minus headroom-frac,
+    # 8 GiB fallback on backends without stats).  paged turns stack
+    # cache entries into fixed page-bytes device pages (sub-stack
+    # eviction + patching); prefetch warms predicted pages from the
+    # flight recorder off the hot path; oom-retry / host-fallback are
+    # the RESOURCE_EXHAUSTED backstop rungs.
+    memory_budget_bytes: int = 0
+    memory_headroom_frac: float = 0.1
+    memory_page_bytes: int = 4 << 20
+    memory_paged: bool = True
+    memory_prefetch: bool = True
+    memory_prefetch_interval_s: float = 0.5
+    memory_oom_retry: bool = True
+    memory_host_fallback: bool = True
     # query flight recorder (obs/flight.py): always-on per-query ring
     # of phase-attributed records feeding /debug/queries and
     # /debug/trace.  recorder=false disables record keeping (the
@@ -85,6 +101,18 @@ class Config:
         flight.recorder.configure(enabled=self.flight_recorder,
                                   keep=self.flight_ring)
 
+    def apply_memory_settings(self):
+        """Push the [memory] knobs into the process residency manager
+        (pilosa_tpu/memory: budget ledger, paged stacks, OOM
+        backstop)."""
+        from pilosa_tpu import memory
+        memory.configure(budget_bytes=self.memory_budget_bytes,
+                         headroom_frac=self.memory_headroom_frac,
+                         page_bytes=self.memory_page_bytes,
+                         paged=self.memory_paged,
+                         oom_retry=self.memory_oom_retry,
+                         host_fallback=self.memory_host_fallback)
+
 
 # TOML key (possibly [table] key) -> Config attribute
 _TOML_KEYS = {
@@ -107,6 +135,14 @@ _TOML_KEYS = {
     "stacked.patch-max-frac": "stack_patch_max_frac",
     "flight.recorder": "flight_recorder",
     "flight.ring": "flight_ring",
+    "memory.budget-bytes": "memory_budget_bytes",
+    "memory.headroom-frac": "memory_headroom_frac",
+    "memory.page-bytes": "memory_page_bytes",
+    "memory.paged": "memory_paged",
+    "memory.prefetch": "memory_prefetch",
+    "memory.prefetch-interval-s": "memory_prefetch_interval_s",
+    "memory.oom-retry": "memory_oom_retry",
+    "memory.host-fallback": "memory_host_fallback",
 }
 
 ENV_PREFIX = "PILOSA_TPU_"

@@ -293,15 +293,36 @@ class ResultCache:
     recomputes the fields' current snapshot and misses (evicting the
     entry) on any mismatch — so writes invalidate lazily, exactly the
     entries whose read set they touched; ``sweep()`` performs the same
-    eviction eagerly after serving-path writes."""
+    eviction eagerly after serving-path writes.
 
-    def __init__(self, max_bytes: int = 64 << 20):
+    Bytes also account through the process device-memory ledger
+    (pilosa_tpu/memory): the local ``max_bytes`` stays as this cache's
+    own cap, and under cross-cache pressure the ledger's reclaim
+    callback sheds the LRU tail here too — result bytes can no longer
+    silently stack on top of a full tile-stack budget."""
+
+    def __init__(self, max_bytes: int = 64 << 20, ledger=None):
+        from pilosa_tpu import memory
         self.max_bytes = max_bytes
         self._entries: OrderedDict[tuple, tuple] = OrderedDict()
         self._bytes = 0
         self._lock = threading.Lock()
+        self._client = (memory.ledger() if ledger is None
+                        else ledger).register(
+            "result_cache", reclaim=self._reclaim)
         self.hits = 0
         self.misses = 0
+
+    def _reclaim(self, need: int) -> int:
+        freed = 0
+        with self._lock:
+            while self._entries and freed < need:
+                _, (_f, _s, _r, nb) = self._entries.popitem(last=False)
+                self._bytes -= nb
+                freed += nb
+        if freed:
+            self._client.release(freed)
+        return freed
 
     def get(self, idx, key, cur_snap: tuple | None = None):
         """`cur_snap`, when given, must be field_snapshot() of the
@@ -317,12 +338,16 @@ class ResultCache:
         # snapshot outside the lock: touches only holder structures
         if (field_snapshot(idx, fields)
                 if cur_snap is None else cur_snap) != snap:
+            dropped = 0
             with self._lock:
                 cur = self._entries.get(key)
                 if cur is ent:
                     self._entries.pop(key)
                     self._bytes -= ent[3]
+                    dropped = ent[3]
                 self.misses += 1
+            if dropped:
+                self._client.release(dropped)
             return _MISS
         with self._lock:
             if key in self._entries:
@@ -334,15 +359,25 @@ class ResultCache:
         nbytes = _result_nbytes(results)
         if nbytes > self.max_bytes:
             return
+        # ledger reservation OUTSIDE our lock (reclaim may call back
+        # into _reclaim); denial = serve uncached, exactly like an
+        # entry over the local cap
+        if not self._client.reserve(nbytes):
+            return
+        released = 0
         with self._lock:
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= old[3]
+                released += old[3]
             self._entries[key] = (fields, snapshot, results, nbytes)
             self._bytes += nbytes
             while self._bytes > self.max_bytes and self._entries:
                 _, (_, _, _, nb) = self._entries.popitem(last=False)
                 self._bytes -= nb
+                released += nb
+        if released:
+            self._client.release(released)
 
     def sweep(self, holder, touched: set | None = None,
               shards: set | None = None) -> int:
@@ -373,18 +408,25 @@ class ResultCache:
             else:
                 stale = field_snapshot(idx, ent[0]) != ent[1]
             if stale:
+                dropped = 0
                 with self._lock:
                     cur = self._entries.get(key)
                     if cur is ent:
                         self._entries.pop(key)
                         self._bytes -= ent[3]
+                        dropped = ent[3]
                         evicted += 1
+                if dropped:
+                    self._client.release(dropped)
         return evicted
 
     def clear(self):
         with self._lock:
+            total = self._bytes
             self._entries.clear()
             self._bytes = 0
+        if total:
+            self._client.release(total)
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -528,6 +570,23 @@ class ServingLayer:
         self.batching = batching and max_batch > 1
         self.cache = ResultCache(cache_bytes) if cache_bytes > 0 else None
         self.batcher = QueryBatcher(self, window_s, max_batch)
+        self.prefetcher = None
+
+    def start_prefetcher(self, interval_s: float = 0.5):
+        """Warm predicted stack pages off the serving hot path
+        (memory/policy.py Prefetcher over the flight recorder's
+        per-query stack-outcome records).  Idempotent."""
+        if self.prefetcher is None:
+            from pilosa_tpu.memory.policy import Prefetcher
+            self.prefetcher = Prefetcher(
+                self.executor.stacked.cache,
+                interval_s=interval_s).start()
+        return self.prefetcher
+
+    def stop_prefetcher(self):
+        if self.prefetcher is not None:
+            self.prefetcher.stop()
+            self.prefetcher = None
 
     # -- entry point ---------------------------------------------------
 
@@ -764,7 +823,14 @@ class ServingLayer:
         t0 = time.perf_counter()
         try:
             fn = _compiled(plan, kern=kern, sig=sig)
-            outs = _block(fn(tuple(b.leaves), tuple(b.params)))
+            # OOM backstop: RESOURCE_EXHAUSTED on the fused program
+            # evicts via the ledger + retries once; a persistent OOM
+            # falls through to the per-rider direct path, where each
+            # solo dispatch carries its own host-fallback ladder —
+            # the batch degrades, no rider's query fails
+            from pilosa_tpu.memory import pressure
+            outs = pressure.guarded(
+                lambda: _block(fn(tuple(b.leaves), tuple(b.params))))
         except Exception as e:
             # the fused program failing is a leader-side event the
             # affected callers never see (they silently fall back) —

@@ -41,6 +41,8 @@ local split has the same shape: fast path plus fallback).
 
 from __future__ import annotations
 
+import hashlib
+import logging
 import os
 import threading
 import time
@@ -50,6 +52,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from pilosa_tpu import memory
+from pilosa_tpu.memory import pressure
+from pilosa_tpu.memory.pages import PagedStack, StackRecipe, page_lanes_for
 from pilosa_tpu.models.view import VIEW_STANDARD
 from pilosa_tpu.obs import flight, metrics
 from pilosa_tpu.obs.tracing import start_span
@@ -87,27 +92,51 @@ def _patch_enabled() -> bool:
 _PATCH_MAX_FRAC = float(os.environ.get("PILOSA_TPU_PATCH_MAX_FRAC",
                                        "0.5"))
 
+# Admission cap: one paged entry may RETAIN at most this fraction of
+# the budget; pages past the cap serve the query transiently and are
+# never reserved.  This is the scan resistance that makes paging beat
+# whole-stack eviction — a broad TopN's (R, S, W) block cannot evict
+# the hot working set to cache itself, it just streams its tail.
+_ENTRY_RESIDENT_FRAC = float(os.environ.get(
+    "PILOSA_TPU_MEMORY_ENTRY_FRAC", "0.5"))
+
+
+_log = logging.getLogger("pilosa_tpu.stacked")
+
 
 class TileStackCache:
-    """LRU byte-bounded cache of device-resident shard stacks.
+    """Budget-ledgered cache of device-resident shard stacks.
 
     An entry is keyed by (index, field, view-set, row, shards, mesh
     epoch) and guarded by the tuple of contributing fragment
     (gen, version) stamps: any host write bumps the fragment version
     (models/fragment.py).  On a version mismatch the entry is first
-    offered to `patcher` — the incremental write path, which applies
-    the fragments' delta logs ON DEVICE (O(delta) upload) and falls
-    back to `build` (full host restack + O(S*W) upload) only when the
-    log can't prove coverage.  Builds and patches are single-flight
-    per key: concurrent misses on one key wait for the one builder
-    instead of stacking N identical uploads.  Eviction is LRU over
-    bytes — the HBM analog of the reference's rank-cache residency
-    policy (cache.go:130): hot query rows stay device-resident, cold
-    ones re-upload on demand.
+    offered to the incremental write path, which applies the
+    fragments' delta logs ON DEVICE (O(delta) upload) and falls back
+    to a full host restack only when the log can't prove coverage.
+    Builds and patches are single-flight per key.
+
+    Residency (PR 5): bytes are accounted through the process-wide
+    budget ledger (pilosa_tpu/memory) instead of a private max_bytes —
+    pressure here can shed cold bytes in the jit/result caches and
+    vice versa.  On single-device placements entries are PAGED
+    (memory/pages.py): fixed-size lane-block device pages assembled
+    into the operand by a jitted gather, evicted and delta-patched per
+    page with cost-aware scoring (memory/policy.py) — a broad TopN no
+    longer evicts whole hot stacks, and a 2x-overcommitted working set
+    re-uploads only the pages a query actually lost.  ``max_bytes``
+    stays honored as a LOCAL cap when set (tests and explicit
+    operator bounds); None defers entirely to the ledger.
     """
 
-    def __init__(self, max_bytes: int = 8 << 30):
+    _MAX_RECIPES = 512
+    _MAX_WARNED = 1024
+
+    def __init__(self, max_bytes: int | None = None, ledger=None):
         self.max_bytes = max_bytes
+        self._ledger = memory.ledger() if ledger is None else ledger
+        self._client = self._ledger.register(
+            "stack_cache", reclaim=self._reclaim, cold_ts=self._cold_ts)
         self._entries: OrderedDict[tuple, tuple] = OrderedDict()
         self._bytes = 0
         # queries are served concurrently from the threaded HTTP/gRPC
@@ -116,45 +145,87 @@ class TileStackCache:
         self._lock = threading.Lock()
         # per-key single-flight latches (key -> Event)
         self._building: dict = {}
+        # prefetch recipes: key fingerprint -> (key, build, patcher,
+        # recipe) so the flight-recorder-fed prefetcher can rebuild
+        # evicted pages off the serving hot path (memory/policy.py)
+        self._recipes: OrderedDict = OrderedDict()
+        self._key_fps: dict = {}
+        self._warned_big: set = set()
         self.hits = 0
         self.misses = 0          # every non-hit access
         self.patches = 0         # misses served by a delta patch
-        self.full_rebuilds = 0   # misses served by build()
+        self.full_rebuilds = 0   # misses served by a full build
+        self.page_rebuilds = 0   # fresh entries with pages re-uploaded
+        self.too_big = 0         # entries alone exceeding the budget
         self.patched_bytes = 0   # words uploaded via patch runs
-        self.rebuilt_bytes = 0   # full stack bytes re-uploaded
+        self.rebuilt_bytes = 0   # full stack/page bytes re-uploaded
 
-    def get(self, key, versions: tuple, build, patcher=None):
+    def get(self, key, versions: tuple, build, patcher=None,
+            recipe=None):
         """Fetch-or-build with flight/span attribution: every access
         is timed and tagged with its outcome (hit / wait / patch /
-        rebuild) and the bytes it moved to the device, so a query's
-        flight record says exactly what its stacks cost."""
+        page_rebuild / rebuild) and the bytes it moved to the device,
+        so a query's flight record says exactly what its stacks cost.
+        `recipe` (memory/pages.py StackRecipe) opts the entry into
+        paged residency and prefetch."""
         t0 = time.perf_counter()
+        fp = (self._remember_recipe(key, build, patcher, recipe)
+              if recipe is not None else None)
         with start_span("stacked.stack") as sp:
             arr, outcome, moved = self._get(key, versions, build,
-                                            patcher)
+                                            patcher, recipe)
             sp.set_tag("outcome", outcome)
             if moved:
                 sp.set_tag("bytes", moved)
-        flight.note_stack(outcome, moved, time.perf_counter() - t0)
+        flight.note_stack(
+            outcome, moved, time.perf_counter() - t0,
+            key_fp=fp if outcome not in ("hit", "wait") else None)
         return arr
 
-    def _get(self, key, versions: tuple, build, patcher=None):
+    def _get(self, key, versions: tuple, build, patcher=None,
+             recipe=None):
         waited = False
         while True:
+            ps_hit = None
             with self._lock:
                 ent = self._entries.get(key)
-                if ent is not None and ent[0] == versions:
-                    self._entries.move_to_end(key)
-                    self.hits += 1
-                    metrics.STACK_CACHE.inc(outcome="hit")
-                    return ent[1], ("wait" if waited else "hit"), 0
-                ev = self._building.get(key)
-                if ev is None:
-                    ev = self._building[key] = threading.Event()
-                    stale = ent
-                    self.misses += 1
-                    metrics.STACK_CACHE.inc(outcome="miss")
-                    break
+                # a fresh-looking entry is only servable when no
+                # builder is mid-flight on this key: paged maintenance
+                # swaps pages in place, so a reader whose versions
+                # snapshot predates a racing write could otherwise
+                # assemble a half-patched stack (the whole-entry path
+                # never could — its patcher swapped array + stamp
+                # atomically).  Building keys take the wait path.
+                if (ent is not None and ent[0] == versions
+                        and key not in self._building):
+                    payload = ent[1]
+                    paged = isinstance(payload, PagedStack)
+                    if not paged or not payload.missing():
+                        self._entries.move_to_end(key)
+                        self.hits += 1
+                        metrics.STACK_CACHE.inc(outcome="hit")
+                        if not paged:
+                            # refresh the recency stamp the eviction
+                            # scorer reads for whole entries
+                            self._entries[key] = (
+                                ent[0], payload, ent[2], time.time())
+                            return (payload,
+                                    ("wait" if waited else "hit"), 0)
+                        # snapshot page refs under the lock so a
+                        # concurrent eviction can't yank one mid-gather
+                        ps_hit = (payload, list(payload.pages))
+                if ps_hit is None:
+                    ev = self._building.get(key)
+                    if ev is None:
+                        ev = self._building[key] = threading.Event()
+                        stale = ent
+                        self.misses += 1
+                        metrics.STACK_CACHE.inc(outcome="miss")
+                        break
+            if ps_hit is not None:
+                ps, arrs = ps_hit
+                return (self._assemble(ps, arrs),
+                        ("wait" if waited else "hit"), 0)
             # single-flight: another thread is building/patching this
             # key — wait for its result, then re-check (it may have
             # built an older version than this access wants)
@@ -163,58 +234,493 @@ class TileStackCache:
             ev.wait()
         try:
             # build/patch OUTSIDE the lock: restack + upload is slow
-            arr = None
-            outcome, moved = "rebuild", 0
-            if stale is not None and patcher is not None:
-                try:
-                    patched = patcher(stale[1], stale[0])
-                except Exception:
-                    patched = None  # any patch failure → full rebuild
-                if patched is not None:
-                    arr, pbytes = patched
-                    outcome, moved = "patch", pbytes
-                    with self._lock:  # single-flight is per-KEY only
-                        self.patches += 1
-                        self.patched_bytes += pbytes
-                    metrics.STACK_CACHE.inc(outcome="patch")
-                    metrics.STACK_MAINT_BYTES.inc(pbytes,
-                                                  kind="patched")
-            if arr is None:
-                arr = build()
-                nb = int(np.prod(arr.shape)) * arr.dtype.itemsize
-                moved = nb
-                with self._lock:
-                    self.full_rebuilds += 1
-                    self.rebuilt_bytes += nb
-                metrics.STACK_CACHE.inc(outcome="rebuild")
-                metrics.STACK_MAINT_BYTES.inc(nb, kind="rebuilt")
-            nbytes = int(np.prod(arr.shape)) * arr.dtype.itemsize
-            with self._lock:
-                old = self._entries.pop(key, None)
-                if old is not None:
-                    self._bytes -= old[2]
-                if nbytes > self.max_bytes:
-                    # an entry that alone exceeds the budget is never
-                    # cached (it would pin the cache over budget
-                    # forever); the caller still gets the fresh stack
-                    return arr, outcome, moved
-                self._entries[key] = (versions, arr, nbytes)
-                self._bytes += nbytes
-                # the new entry is most-recent so it is popped last,
-                # and since nbytes <= max_bytes the loop stops first
-                while self._bytes > self.max_bytes and self._entries:
-                    _, (_, _, nb) = self._entries.popitem(last=False)
-                    self._bytes -= nb
-            return arr, outcome, moved
+            if recipe is not None and memory.paged_enabled():
+                return self._serve_paged(key, versions, stale, recipe)
+            return self._serve_whole(key, versions, stale, build,
+                                     patcher)
         finally:
             with self._lock:
                 self._building.pop(key, None)
             ev.set()
 
+    # -- whole-entry path (mesh/host placements; paging disabled) -------
+
+    def _serve_whole(self, key, versions, stale, build, patcher):
+        arr = None
+        outcome, moved = "rebuild", 0
+        stale_whole = (stale is not None
+                       and not isinstance(stale[1], PagedStack))
+        if stale_whole and patcher is not None:
+            try:
+                patched = patcher(stale[1], stale[0])
+            except Exception:
+                patched = None  # any patch failure → full rebuild
+            if patched is not None:
+                arr, pbytes = patched
+                outcome, moved = "patch", pbytes
+                with self._lock:  # single-flight is per-KEY only
+                    self.patches += 1
+                    self.patched_bytes += pbytes
+                metrics.STACK_CACHE.inc(outcome="patch")
+                metrics.STACK_MAINT_BYTES.inc(pbytes, kind="patched")
+        if arr is None:
+            arr = build()
+            nb = int(np.prod(arr.shape)) * arr.dtype.itemsize
+            moved = nb
+            with self._lock:
+                self.full_rebuilds += 1
+                self.rebuilt_bytes += nb
+            metrics.STACK_CACHE.inc(outcome="rebuild")
+            metrics.STACK_MAINT_BYTES.inc(nb, kind="rebuilt")
+        nbytes = int(np.prod(arr.shape)) * arr.dtype.itemsize
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[2]
+        if old is not None and old[2]:
+            self._client.release(old[2])
+        cap = self._budget_cap()
+        if nbytes > cap:
+            # an entry that alone exceeds the budget is never cached
+            # (it would pin the cache over budget forever); the caller
+            # still gets the fresh stack — and the drop is no longer
+            # silent: counted + warned once per key
+            self._note_too_big(key, nbytes, cap)
+            return arr, outcome, moved
+        # ledger reservation OUTSIDE our lock: reclaim may call back
+        # into this cache's _reclaim, which takes the lock
+        if not self._client.reserve(nbytes):
+            metrics.STACK_CACHE.inc(outcome="denied")
+            return arr, outcome, moved
+        released = 0
+        with self._lock:
+            self._entries[key] = (versions, arr, nbytes, time.time())
+            self._bytes += nbytes
+            released = self._enforce_local_cap_locked()
+        if released:
+            self._client.release(released)
+        return arr, outcome, moved
+
+    # -- paged path (single-device placements) --------------------------
+
+    def _serve_paged(self, key, versions, stale, recipe: StackRecipe):
+        w = recipe.width_words
+        shape = tuple(recipe.logical_lead) + (w,)
+        lanes = recipe.lanes
+        pl = max(1, min(page_lanes_for(w), lanes))
+        ps = None
+        old_versions = None
+        if stale is not None and isinstance(stale[1], PagedStack):
+            cand = stale[1]
+            if cand.shape == shape and cand.page_lanes == pl:
+                ps, old_versions = cand, stale[0]
+        if ps is None and stale is not None:
+            # structural change or whole→paged transition: drop the
+            # old payload entirely
+            with self._lock:
+                cur = self._entries.get(key)
+                if cur is stale:
+                    self._entries.pop(key)
+                    self._bytes -= stale[2]
+            if stale[2]:
+                self._client.release(stale[2])
+        patched_b = 0
+        rebuilt_b = 0
+        # local page map: every page array this access touches, so the
+        # final assemble is immune to concurrent evictions (and pages
+        # the ledger denied residency for still serve this query)
+        local: dict[int, object] = {}
+        if ps is not None:
+            dirty = {} if old_versions == versions else (
+                self._deltas_or_none(recipe, old_versions))
+        # admission cap: retain at most this share of the budget per
+        # entry — the tail of an oversized scan streams transiently
+        # instead of evicting the hot working set
+        resident_cap = max(
+            int(_ENTRY_RESIDENT_FRAC * self._budget_cap()),
+            pl * w * 4)
+        if ps is None or dirty is None:
+            if ps is not None:
+                self._drop_pages(key, ps)
+            ps = PagedStack(shape, pl, weight=recipe.weight)
+            host = np.asarray(recipe.build_host(),
+                              dtype=np.uint32).reshape(-1, w)
+            retained = 0
+            for pi in range(ps.n_pages):
+                lo, hi = ps.lane_range(pi)
+                block = host[lo:hi]
+                if block.shape[0] < pl:
+                    block = np.concatenate(
+                        [block, np.zeros((pl - block.shape[0], w),
+                                         np.uint32)])
+                local[pi] = self._commit_block(block)
+                if (retained + ps.page_nbytes <= resident_cap
+                        and self._page_install(key, ps, pi,
+                                               local[pi])):
+                    retained += ps.page_nbytes
+            rebuilt_b = lanes * w * 4
+            outcome = "rebuild"
+            with self._lock:
+                self.full_rebuilds += 1
+                self.rebuilt_bytes += rebuilt_b
+            metrics.STACK_CACHE.inc(outcome="rebuild")
+            metrics.STACK_MAINT_BYTES.inc(rebuilt_b, kind="rebuilt")
+        else:
+            with self._lock:
+                for pi, p in enumerate(ps.pages):
+                    if p is not None:
+                        local[pi] = p
+            by_page: dict[int, dict] = {}
+            for lane, runs in dirty.items():
+                by_page.setdefault(lane // pl, {})[lane] = runs
+            fresh: set[int] = set()
+            retained = ps.resident_bytes()
+            for pi in range(ps.n_pages):
+                if pi not in local:
+                    block = ps.build_page_host(pi, recipe.lane_words)
+                    local[pi] = self._commit_block(block)
+                    if (retained + ps.page_nbytes <= resident_cap
+                            and self._page_install(key, ps, pi,
+                                                   local[pi])):
+                        retained += ps.page_nbytes
+                    rebuilt_b += ps.page_nbytes
+                    fresh.add(pi)
+            for pi, lanes_d in by_page.items():
+                if pi in fresh:
+                    continue  # rebuilt from live rows: already current
+                pb, rb = self._patch_page(key, ps, pi, lanes_d,
+                                          recipe, local)
+                patched_b += pb
+                rebuilt_b += rb
+            stale_entry = old_versions != versions
+            if stale_entry:
+                outcome = "patch"
+                with self._lock:
+                    self.patches += 1
+                    self.patched_bytes += patched_b
+                    self.rebuilt_bytes += rebuilt_b
+                metrics.STACK_CACHE.inc(outcome="patch")
+                if patched_b:
+                    metrics.STACK_MAINT_BYTES.inc(patched_b,
+                                                  kind="patched")
+                if rebuilt_b:
+                    metrics.STACK_MAINT_BYTES.inc(rebuilt_b,
+                                                  kind="rebuilt")
+            else:
+                outcome = "page_rebuild"
+                with self._lock:
+                    self.page_rebuilds += 1
+                    self.rebuilt_bytes += rebuilt_b
+                metrics.STACK_CACHE.inc(outcome="page_rebuild")
+                if rebuilt_b:
+                    metrics.STACK_MAINT_BYTES.inc(rebuilt_b,
+                                                  kind="rebuilt")
+        released = 0
+        with self._lock:
+            old = self._entries.get(key)
+            old_nb = old[2] if old is not None and old[1] is ps else 0
+            if old is not None and old[1] is not ps and old[1] is not None:
+                # someone else's payload can't be here (single-flight)
+                # unless versions raced; replace it
+                self._entries.pop(key)
+                self._bytes -= old[2]
+                released += old[2]
+            nb = ps.resident_bytes()
+            self._entries[key] = (versions, ps, nb, time.time())
+            self._entries.move_to_end(key)
+            self._bytes += nb - old_nb
+            released += self._enforce_local_cap_locked()
+        if released:
+            self._client.release(released)
+        arrs = [local[i] for i in range(ps.n_pages)]
+        return (self._assemble(ps, arrs), outcome,
+                patched_b + rebuilt_b)
+
+    @staticmethod
+    def _deltas_or_none(recipe: StackRecipe, old_versions):
+        if recipe.deltas_fn is None:
+            return None
+        try:
+            return recipe.deltas_fn(old_versions)
+        except Exception:
+            return None
+
+    def _commit_block(self, block: np.ndarray):
+        """Host page block → device, degrading to the host array when
+        even a single page can't be allocated (the OOM backstop then
+        re-executes on the CPU backend)."""
+        return pressure.guarded(lambda: jnp.asarray(block),
+                                host_fallback=lambda: block)
+
+    def _page_install(self, key, ps: PagedStack, pi: int, arr) -> bool:
+        """Retain one built page iff the ledger admits it; denied
+        pages serve this access transiently and rebuild next time."""
+        if not self._client.reserve(ps.page_nbytes):
+            metrics.STACK_CACHE.inc(outcome="denied")
+            return False
+        with self._lock:
+            ps.pages[pi] = arr
+            ps.last_access = time.time()
+            self._sync_entry_locked(key, ps)
+        metrics.STACK_PAGES.inc(event="build")
+        return True
+
+    def _patch_page(self, key, ps: PagedStack, pi: int, lanes_d: dict,
+                    recipe: StackRecipe, local: dict):
+        """Apply dirty lane runs to one resident page; returns
+        (patched_bytes, rebuilt_bytes).  Runs pad to pow2 widths and
+        batch per width so the shared jitted scatter compiles once per
+        bucket; a page dirtier than _PATCH_MAX_FRAC rebuilds wholesale
+        (one dense upload beats scattering most of it)."""
+        w = ps.width_words
+        lo0 = pi * ps.page_lanes
+        segs = []
+        patched_words = 0
+        for lane in sorted(lanes_d):
+            runs = lanes_d[lane]
+            runs = ([(0, w)] if runs is None
+                    else _coalesce_runs(runs, w))
+            for lo, hi in runs:
+                plen = min(1 << (hi - lo - 1).bit_length(), w)
+                start = min(lo, w - plen)
+                segs.append((lane - lo0, start, plen, lane))
+                patched_words += plen
+        if not segs:
+            return 0, 0
+        if patched_words > _PATCH_MAX_FRAC * ps.page_lanes * w:
+            block = ps.build_page_host(pi, recipe.lane_words)
+            arr = self._commit_block(block)
+            local[pi] = arr
+            self._page_replace(key, ps, pi, arr)
+            return 0, ps.page_nbytes
+        lane_cache: dict[int, np.ndarray] = {}
+
+        def words_of(lane):
+            cur = lane_cache.get(lane)
+            if cur is None:
+                cur = lane_cache[lane] = np.asarray(
+                    recipe.lane_words(lane), dtype=np.uint32)
+            return cur
+
+        arr = local[pi]
+        by_len: dict[int, list] = {}
+        for li, start, plen, lane in segs:
+            by_len.setdefault(plen, []).append((li, start, lane))
+        for plen, group in sorted(by_len.items()):
+            n = len(group)
+            npad = 1 << max(n - 1, 0).bit_length()
+            idxs = np.zeros(npad, np.int32)
+            starts = np.zeros(npad, np.int32)
+            data = np.empty((npad, plen), np.uint32)
+            for k in range(npad):
+                li, start, lane = group[min(k, n - 1)]
+                idxs[k], starts[k] = li, start
+                data[k] = words_of(lane)[start:start + plen]
+            arr = _patch_program(arr, idxs, starts, data)
+        local[pi] = arr
+        self._page_replace(key, ps, pi, arr)
+        metrics.STACK_PAGES.inc(event="patch")
+        return patched_words * 4, 0
+
+    def _page_replace(self, key, ps: PagedStack, pi: int, arr):
+        """Swap a page's array in place (patch/rebuild of a page that
+        was resident).  If a concurrent reclaim evicted the slot
+        meanwhile, this becomes an install (re-reserve)."""
+        with self._lock:
+            was = ps.pages[pi]
+            if was is not None:
+                ps.pages[pi] = arr
+                ps.last_access = time.time()
+                return
+        self._page_install(key, ps, pi, arr)
+
+    def _assemble(self, ps: PagedStack, arrs: list):
+        ps.touch()
+        if len(arrs) == 1 and ps.lanes == ps.page_lanes:
+            return arrs[0].reshape(ps.shape)
+        return bm.assemble_pages(tuple(arrs), ps.shape)
+
+    # -- budget / eviction ----------------------------------------------
+
+    def _budget_cap(self) -> int:
+        return (self.max_bytes if self.max_bytes is not None
+                else self._ledger.budget())
+
+    def _enforce_local_cap_locked(self) -> int:
+        """Shed down to the LOCAL max_bytes cap (no-op when None —
+        the ledger governs).  Returns bytes to release to the ledger
+        (caller releases outside the lock)."""
+        if self.max_bytes is None or self._bytes <= self.max_bytes:
+            return 0
+        return self._shed_locked(self._bytes - self.max_bytes)
+
+    def _shed_locked(self, need: int) -> int:
+        """Evict ~need bytes, ENTRY-concentrated: order entries by
+        cost-aware score (memory/policy.py — age / rebuild-weight /
+        frequency), then drain the victim's pages coldest-first,
+        stopping mid-entry the moment enough is freed.  Concentration
+        keeps sibling operands complete (spreading page evictions
+        across entries would break every operand at once — measured
+        pathological); the page-granular STOP is the paged win: the
+        marginal entry loses only the bytes pressure demanded, and
+        the next access restores just those pages.  Returns bytes
+        freed; the caller releases them to the ledger."""
+        from pilosa_tpu.memory import policy
+        freed = 0
+        now = time.time()
+        cands = []
+        for k, ent in self._entries.items():
+            payload = ent[1]
+            if isinstance(payload, PagedStack):
+                if not any(p is not None for p in payload.pages):
+                    continue
+                cands.append((payload.last_access, payload.weight,
+                              payload.hits, ("paged", k, payload)))
+            elif ent[2]:
+                cands.append((ent[3], 1.0, 1, ("whole", k, None)))
+        for _la, _w, _h, (kind, k, ps) in policy.victim_order(cands,
+                                                             now):
+            if freed >= need:
+                break
+            if kind == "whole":
+                ent = self._entries.pop(k, None)
+                if ent is not None:
+                    self._bytes -= ent[2]
+                    freed += ent[2]
+                continue
+            for pi, p in enumerate(ps.pages):
+                if freed >= need:
+                    break
+                if p is None:
+                    continue
+                ps.pages[pi] = None
+                freed += ps.page_nbytes
+                metrics.STACK_PAGES.inc(event="evict")
+            self._sync_entry_locked(k, ps)
+            if not any(p is not None for p in ps.pages):
+                # fully drained: drop the skeleton too, or distinct
+                # keys accumulate zombie entries forever on a
+                # long-lived server (pre-paging, byte pressure popped
+                # whole entries and bounded the dict implicitly)
+                self._entries.pop(k, None)
+        return freed
+
+    def _reclaim(self, need: int) -> int:
+        """Ledger reclaim callback (cross-client pressure)."""
+        with self._lock:
+            freed = self._shed_locked(int(need))
+        if freed:
+            self._client.release(freed)
+        return freed
+
+    def _cold_ts(self) -> float:
+        """Coldest resident page's timestamp (0 when whole entries —
+        no stamps — are present: conservatively coldest)."""
+        with self._lock:
+            ts = None
+            for ent in self._entries.values():
+                if isinstance(ent[1], PagedStack):
+                    ps = ent[1]
+                    if any(p is not None for p in ps.pages) and (
+                            ts is None or ps.last_access < ts):
+                        ts = ps.last_access
+                elif ent[2]:
+                    return 0.0
+            return ts or 0.0
+
+    def _sync_entry_locked(self, key, ps: PagedStack):
+        """Re-derive an entry's accounted bytes from its resident
+        pages (called after any page install/evict)."""
+        ent = self._entries.get(key)
+        if ent is not None and ent[1] is ps:
+            nb = ps.resident_bytes()
+            self._bytes += nb - ent[2]
+            self._entries[key] = (ent[0], ps, nb, ent[3])
+
+    def _drop_pages(self, key, ps: PagedStack):
+        freed = 0
+        with self._lock:
+            for pi, p in enumerate(ps.pages):
+                if p is not None:
+                    ps.pages[pi] = None
+                    freed += ps.page_nbytes
+            self._sync_entry_locked(key, ps)
+        if freed:
+            self._client.release(freed)
+
+    def _note_too_big(self, key, nbytes: int, cap: int):
+        with self._lock:
+            self.too_big += 1
+            warn = key not in self._warned_big
+            if warn:
+                self._warned_big.add(key)
+                while len(self._warned_big) > self._MAX_WARNED:
+                    self._warned_big.pop()
+        metrics.STACK_CACHE.inc(outcome="too_big")
+        if warn:
+            _log.warning(
+                "stack %r (%d bytes) alone exceeds the device budget "
+                "(%d bytes); it is rebuilt and served unretained on "
+                "every access", key, nbytes, cap)
+
+    # -- prefetch (memory/policy.py Prefetcher) -------------------------
+
+    def _remember_recipe(self, key, build, patcher, recipe) -> str:
+        with self._lock:
+            fp = self._key_fps.get(key)
+            if fp is None:
+                fp = hashlib.blake2b(repr(key).encode(),
+                                     digest_size=8).hexdigest()
+                self._key_fps[key] = fp
+            self._recipes[fp] = (key, build, patcher, recipe)
+            self._recipes.move_to_end(fp)
+            while len(self._recipes) > self._MAX_RECIPES:
+                _ofp, (okey, _b, _p, _r) = self._recipes.popitem(
+                    last=False)
+                self._key_fps.pop(okey, None)
+        return fp
+
+    def prewarm(self, fp: str) -> bool:
+        """Rebuild a key's missing pages from its recorded recipe at
+        CURRENT fragment versions — the prefetcher's warm target.
+        No-op (False) for unknown keys and fully-resident fresh
+        entries."""
+        with self._lock:
+            rec = self._recipes.get(fp)
+        if rec is None:
+            return False
+        key, build, patcher, recipe = rec
+        if recipe.alive_fn is not None and not recipe.alive_fn():
+            # the captured fields were dropped/recreated: no live
+            # query computes these (gen, version) stamps anymore, so
+            # warming would upload + budget-reserve dead data.  Drop
+            # the recipe so it stops pinning the old fragments too.
+            with self._lock:
+                if self._recipes.get(fp) is rec:
+                    self._recipes.pop(fp)
+                    self._key_fps.pop(key, None)
+            return False
+        try:
+            versions = recipe.versions_fn()
+        except Exception:
+            return False
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is not None and ent[0] == versions:
+                payload = ent[1]
+                if (not isinstance(payload, PagedStack)
+                        or not payload.missing()):
+                    return False
+        self.get(key, versions, build, patcher, recipe)
+        return True
+
     def clear(self):
         with self._lock:
+            total = self._bytes
             self._entries.clear()
             self._bytes = 0
+        if total:
+            self._client.release(total)
 
     @property
     def nbytes(self) -> int:
@@ -229,9 +735,62 @@ class TileStackCache:
 # across Executor instances (two engines over the same schema compile
 # identical programs); bounded so a long-lived server that sees many
 # distinct tree shapes doesn't accumulate executables forever.
-_JIT_CACHE: OrderedDict[str, object] = OrderedDict()
+# Entries are (fn, ledger_reserved_bytes): executables claim an
+# ESTIMATED per-entry device footprint from the process budget ledger
+# (their true HBM cost is opaque to the host), so pressure in the
+# stack caches can shed cold executables and vice versa; a denied
+# reservation still caches (reserved=0) — compilation reuse matters
+# more than exact accounting for these small buffers.
+_JIT_CACHE: OrderedDict[str, tuple] = OrderedDict()
 _JIT_CACHE_MAX = 256
 _JIT_LOCK = threading.Lock()
+_JIT_EST_BYTES = int(os.environ.get(
+    "PILOSA_TPU_JIT_ENTRY_EST_BYTES", str(64 << 10)))
+_JIT_CLIENT_LOCK = threading.Lock()
+_JIT_CLIENT = None
+
+
+def _jit_client():
+    global _JIT_CLIENT
+    with _JIT_CLIENT_LOCK:
+        if _JIT_CLIENT is None:
+            _JIT_CLIENT = memory.ledger().register(
+                "jit_cache", reclaim=_jit_reclaim)
+        return _JIT_CLIENT
+
+
+def _jit_reclaim(need: int) -> int:
+    """Ledger reclaim callback: shed LEDGERED executables, oldest
+    first, from both jit caches.  Zero-reserved entries are skipped —
+    evicting them frees no device bytes, only recompilation time."""
+    freed = 0
+    evicted_sigs = []
+    with _JIT_LOCK:
+        for sig in list(_JIT_CACHE):
+            if freed >= need:
+                break
+            if _JIT_CACHE[sig][1] <= 0:
+                continue
+            freed += _JIT_CACHE.pop(sig)[1]
+            evicted_sigs.append(sig)
+            metrics.JIT_CACHE.inc(cache="plan", event="evict")
+        metrics.JIT_CACHE_ENTRIES.set(len(_JIT_CACHE), cache="plan")
+    with _GB_KERNEL_LOCK:
+        for key in list(_GB_KERNEL_JIT):
+            if freed >= need:
+                break
+            if _GB_KERNEL_JIT[key][1] <= 0:
+                continue
+            freed += _GB_KERNEL_JIT.pop(key)[1]
+            metrics.JIT_CACHE.inc(cache="groupby_kernel",
+                                  event="evict")
+        metrics.JIT_CACHE_ENTRIES.set(len(_GB_KERNEL_JIT),
+                                      cache="groupby_kernel")
+    for sig in evicted_sigs:
+        _forget_dispatch_sig(sig)
+    if freed and _JIT_CLIENT is not None:
+        _JIT_CLIENT.release(freed)
+    return freed
 
 _NARY_OPS = {
     "union": bm.union,
@@ -252,17 +811,29 @@ _GB_KERNEL_LOCK = threading.Lock()
 
 def _gb_jit_get(key):
     with _GB_KERNEL_LOCK:
-        fn = _GB_KERNEL_JIT.get(key)
-        if fn is not None:
-            _GB_KERNEL_JIT.move_to_end(key)
-        return fn
+        ent = _GB_KERNEL_JIT.get(key)
+        if ent is None:
+            return None
+        _GB_KERNEL_JIT.move_to_end(key)
+        return ent[0]
 
 
 def _gb_jit_put(key, fn):
+    client = _jit_client()
+    reserved = (_JIT_EST_BYTES
+                if client.reserve(_JIT_EST_BYTES) else 0)
+    released = 0
     with _GB_KERNEL_LOCK:
-        _GB_KERNEL_JIT[key] = fn
+        _GB_KERNEL_JIT[key] = (fn, reserved)
+        metrics.JIT_CACHE.inc(cache="groupby_kernel", event="insert")
         while len(_GB_KERNEL_JIT) > _GB_KERNEL_JIT_MAX:
-            _GB_KERNEL_JIT.popitem(last=False)
+            released += _GB_KERNEL_JIT.popitem(last=False)[1][1]
+            metrics.JIT_CACHE.inc(cache="groupby_kernel",
+                                  event="evict")
+        metrics.JIT_CACHE_ENTRIES.set(len(_GB_KERNEL_JIT),
+                                      cache="groupby_kernel")
+    if released:
+        client.release(released)
 
 # one-pass group-code GroupBy bounds: the dense code space is
 # 2^sum(ceil(log2 R_f)) — the host/XLA histogram tolerates up to 2^20
@@ -683,16 +1254,27 @@ def _compiled(plan, kern: bool = False, sig: tuple | None = None):
     shards, the caller's responsibility."""
     sig = (repr(plan), kern) if sig is None else sig
     with _JIT_LOCK:
-        fn = _JIT_CACHE.get(sig)
-        if fn is not None:
+        ent = _JIT_CACHE.get(sig)
+        if ent is not None:
             _JIT_CACHE.move_to_end(sig)
-            return fn
+            return ent[0]
     fn = jax.jit(_plan_run(plan, kern))
+    client = _jit_client()
+    reserved = (_JIT_EST_BYTES
+                if client.reserve(_JIT_EST_BYTES) else 0)
     evicted = []
+    released = 0
     with _JIT_LOCK:
-        _JIT_CACHE[sig] = fn
+        _JIT_CACHE[sig] = (fn, reserved)
+        metrics.JIT_CACHE.inc(cache="plan", event="insert")
         while len(_JIT_CACHE) > _JIT_CACHE_MAX:
-            evicted.append(_JIT_CACHE.popitem(last=False)[0])
+            esig, (_efn, erb) = _JIT_CACHE.popitem(last=False)
+            evicted.append(esig)
+            released += erb
+            metrics.JIT_CACHE.inc(cache="plan", event="evict")
+        metrics.JIT_CACHE_ENTRIES.set(len(_JIT_CACHE), cache="plan")
+    if released:
+        client.release(released)
     for esig in evicted:
         # an evicted jit wrapper WILL re-trace + recompile on its next
         # dispatch — forget its shape keys so _dispatch_kind reports
@@ -755,14 +1337,21 @@ def _block(out):
 def timed_dispatch(plan, kern, leaves, params):
     """Run a plan's jitted program with flight/span attribution:
     recompiles are timed distinctly from cached dispatches, and the
-    clock stops only when the device result is ready."""
+    clock stops only when the device result is ready.  Dispatches run
+    under the OOM backstop (memory/pressure.py): RESOURCE_EXHAUSTED
+    triggers ledger-driven eviction + one retry, then a degraded-mode
+    re-execution of the SAME plan on the host CPU backend — a slow
+    answer instead of a failed query."""
     sig = (repr(plan), kern)
     fn = _compiled(plan, kern=kern, sig=sig)
     kind = _dispatch_kind(sig, leaves, params)
     t0 = time.perf_counter()
     with start_span("stacked.dispatch", kind=plan[0],
                     compile=kind == "compile"):
-        out = _block(fn(tuple(leaves), tuple(params)))
+        out = pressure.guarded(
+            lambda: _block(fn(tuple(leaves), tuple(params))),
+            host_fallback=lambda: pressure.run_host_plan(
+                plan, leaves, params))
     flight.note_phase(kind, time.perf_counter() - t0)
     return out
 
@@ -1028,6 +1617,44 @@ def _patch_program(stack, idxs, starts, data):
     return out.reshape(stack.shape)
 
 
+def _make_delta_fn(frags, lanes, new_versions):
+    """Dirty-lane derivation shared by the whole-entry patcher and
+    the paged residency path: ``deltas(old_versions)`` maps logged
+    fragment mutations onto stack LANES, returning {lane: [(lo, hi)
+    word runs]} (None value = whole lane — the fragment's delta log
+    couldn't prove coverage), {} when nothing relevant moved, or None
+    for structural changes that force a rebuild."""
+    def deltas(old_versions):
+        if len(old_versions) != len(new_versions):
+            return None  # structural change: rebuild
+        dirty: dict[int, list | None] = {}
+        for fr, ov, nv, lmap in zip(frags, old_versions,
+                                    new_versions, lanes):
+            if ov == nv:
+                continue
+            spans = None
+            if (fr is not None and ov != -1 and nv != -1
+                    and ov[0] == nv[0]):
+                spans = fr.deltas_since(ov[1])
+            if spans is None:
+                # compaction: whole-lane slice rebuild for every
+                # lane this fragment feeds
+                for lns in lmap.values():
+                    for ln in lns:
+                        dirty[ln] = None
+                continue
+            for row, lo, hi in spans:
+                for ln in lmap.get(row, ()):
+                    cur = dirty.get(ln, False)
+                    if cur is None:
+                        continue  # already whole-lane
+                    if cur is False:
+                        dirty[ln] = cur = []
+                    cur.append((lo, hi))
+        return dirty
+    return deltas
+
+
 def _coalesce_runs(ranges, w: int):
     """Sort + merge overlapping/adjacent (lo, hi) word runs, clamped
     to [0, w)."""
@@ -1053,9 +1680,12 @@ class StackedEngine:
     cross-shard reduction — the jitted analog of mapReduce's reduceFn.
     """
 
-    def __init__(self, executor, max_cache_bytes: int = 8 << 30):
+    def __init__(self, executor, max_cache_bytes: int | None = None):
         self.executor = executor
         self.mesh = None
+        # max_cache_bytes None (the default) defers byte bounds to the
+        # process-wide device-memory ledger (pilosa_tpu/memory); a
+        # value sets an additional LOCAL cap (tests, explicit bounds)
         self.cache = TileStackCache(max_cache_bytes)
         # host_only=True keeps leaf stacks as numpy (no eager device
         # commit); jit transfers them at call time.  Used by harnesses
@@ -1085,7 +1715,11 @@ class StackedEngine:
         if self.host_only:
             return arr
         if self.mesh is None:
-            return jnp.asarray(arr)
+            # OOM backstop: a failed upload degrades to the host array
+            # (jit re-attempts the transfer at dispatch, where the
+            # host-fallback ladder finishes the job)
+            return pressure.guarded(lambda: jnp.asarray(arr),
+                                    host_fallback=lambda: arr)
         from pilosa_tpu.parallel.mesh import place_shards
         return place_shards(self.mesh, arr, batch_axes=arr.ndim - 2)
 
@@ -1120,7 +1754,9 @@ class StackedEngine:
 
     def _make_patcher(self, frags, lanes, new_versions, logical_lead,
                       lane_words):
-        """TileStackCache patcher closure.
+        """TileStackCache patcher closure (the WHOLE-entry write
+        path; the paged path consumes ``_make_delta_fn`` directly via
+        its StackRecipe).
 
         frags/lanes run parallel to the flat `new_versions` tuple:
         ``lanes[i]`` maps fragment i's ROW ids to the logical lane
@@ -1129,34 +1765,12 @@ class StackedEngine:
         host words.  Returns None when patching is disabled."""
         if not _patch_enabled():
             return None
+        deltas = _make_delta_fn(frags, lanes, new_versions)
 
         def patcher(arr, old_versions):
-            if len(old_versions) != len(new_versions):
+            dirty = deltas(old_versions)
+            if dirty is None:
                 return None  # structural change: rebuild
-            dirty: dict[int, list | None] = {}
-            for fr, ov, nv, lmap in zip(frags, old_versions,
-                                        new_versions, lanes):
-                if ov == nv:
-                    continue
-                spans = None
-                if (fr is not None and ov != -1 and nv != -1
-                        and ov[0] == nv[0]):
-                    spans = fr.deltas_since(ov[1])
-                if spans is None:
-                    # compaction: whole-lane slice rebuild for every
-                    # lane this fragment feeds
-                    for lns in lmap.values():
-                        for ln in lns:
-                            dirty[ln] = None
-                    continue
-                for row, lo, hi in spans:
-                    for ln in lmap.get(row, ()):
-                        cur = dirty.get(ln, False)
-                        if cur is None:
-                            continue  # already whole-lane
-                        if cur is False:
-                            dirty[ln] = cur = []
-                        cur.append((lo, hi))
             if not dirty:
                 # versions moved but no logged mutation touches this
                 # stack's rows: adopt the new versions as-is
@@ -1227,6 +1841,47 @@ class StackedEngine:
             arr = _patch_program(arr, idxs, starts, data)
         return arr, patched_words * 4
 
+    def _pageable(self) -> bool:
+        """Paged residency (memory/pages.py) applies to plain
+        single-device placements; mesh shardings and host_only numpy
+        stacks keep whole-array entries."""
+        return self.mesh is None and not self.host_only
+
+    def _cached_stack(self, key, versions, build, *, frags, lanes,
+                      logical_lead, lane_words, width_words,
+                      build_host=None, versions_fn=None,
+                      weight: float = 1.0, pageable: bool = True,
+                      alive_fn=None):
+        """Shared fetch path for every stack builder: wires the
+        whole-entry patcher and, on pageable placements, the paged
+        StackRecipe (page-granular eviction/patching + prefetch)."""
+        patcher = self._make_patcher(frags, lanes, versions,
+                                     logical_lead, lane_words)
+        recipe = None
+        if pageable and self._pageable() and build_host is not None:
+            deltas_fn = None
+            if _patch_enabled() and versions_fn is not None:
+                # derive dirt against the LIVE versions at patch time,
+                # not the tuple captured when this recipe was built:
+                # the prefetcher replays stored recipes after later
+                # writes, and a captured snapshot would stamp fresh
+                # versions onto stale content (spans re-read live
+                # rows, so a stamp OLDER than the content only costs
+                # an extra idempotent patch — never staleness)
+                def deltas_fn(old_versions):
+                    return _make_delta_fn(
+                        frags, lanes, versions_fn())(old_versions)
+            recipe = StackRecipe(
+                logical_lead=tuple(logical_lead),
+                width_words=int(width_words),
+                lane_words=lane_words,
+                build_host=build_host,
+                versions_fn=versions_fn,
+                deltas_fn=deltas_fn,
+                weight=weight,
+                alive_fn=alive_fn)
+        return self.cache.get(key, versions, build, patcher, recipe)
+
     def row_stack(self, idx, field, views: tuple[str, ...], row_id: int,
                   skey: tuple):
         """(S, W) device stack of one row, unioned across views."""
@@ -1235,16 +1890,20 @@ class StackedEngine:
         key = ("row", idx.name, field.name, views, row_id, skey,
                id(self.mesh))
         per_view = [self._frags(idx, field, vn, shards) for vn in views]
-        versions = tuple(v for frags in per_view
+
+        def versions_fn():
+            return tuple(v for frags in per_view
                          for v in self._versions(frags))
 
-        def build():
+        versions = versions_fn()
+
+        def build_host():
             out = np.zeros((len(shards), width // 32), dtype=np.uint32)
             for frags in per_view:
                 for i, fr in enumerate(frags):
                     if fr is not None:
                         out[i] |= fr.row_words(row_id)
-            return self.place(out)
+            return out
 
         def lane_words(si):
             out = np.zeros(width // 32, dtype=np.uint32)
@@ -1257,9 +1916,13 @@ class StackedEngine:
         frags_flat = [fr for frags in per_view for fr in frags]
         lanes = [{row_id: (si,)} for _ in per_view
                  for si in range(len(shards))]
-        patcher = self._make_patcher(frags_flat, lanes, versions,
-                                     (len(shards),), lane_words)
-        return self.cache.get(key, versions, build, patcher)
+        return self._cached_stack(
+            key, versions, lambda: self.place(build_host()),
+            frags=frags_flat, lanes=lanes,
+            logical_lead=(len(shards),), lane_words=lane_words,
+            width_words=width // 32, build_host=build_host,
+            versions_fn=versions_fn,
+            alive_fn=lambda: idx.fields.get(field.name) is field)
 
     def _plane_lanes(self, frags, n_shards: int, depth: int, width: int):
         """(lanes, lane_words) for an (S, 2+depth, W) plane stack:
@@ -1285,21 +1948,25 @@ class StackedEngine:
         frags = self._frags(idx, field, field.bsi_view, shards)
         versions = self._versions(frags)
 
-        def build():
+        def build_host():
             out = np.zeros((len(shards), 2 + depth, width // 32),
                            dtype=np.uint32)
             for i, fr in enumerate(frags):
                 if fr is not None:
                     for r in range(2 + depth):
                         out[i, r] = fr.row_words(r)
-            return self.place(out)
+            return out
 
         lanes, lane_words = self._plane_lanes(frags, len(shards),
                                               depth, width)
-        patcher = self._make_patcher(frags, lanes, versions,
-                                     (len(shards), 2 + depth),
-                                     lane_words)
-        return self.cache.get(key, versions, build, patcher)
+        return self._cached_stack(
+            key, versions, lambda: self.place(build_host()),
+            frags=frags, lanes=lanes,
+            logical_lead=(len(shards), 2 + depth),
+            lane_words=lane_words, width_words=width // 32,
+            build_host=build_host,
+            versions_fn=lambda: self._versions(frags),
+            alive_fn=lambda: idx.fields.get(field.name) is field)
 
     def existence_stack(self, idx, skey: tuple):
         from pilosa_tpu.models.index import EXISTENCE_FIELD
@@ -1476,7 +2143,7 @@ class StackedEngine:
         bits, shifts, _n_codes = _code_space(fields_rows)
         cb = sum(bits)
 
-        def build():
+        def build_host():
             w = idx.width // 32
             out = np.zeros((len(shards), cb + 1, w), dtype=np.uint32)
             out[:, cb] = 0xFFFFFFFF
@@ -1495,6 +2162,10 @@ class StackedEngine:
                                 out[si, sh + b] |= wds
                             b += 1
                 out[:, cb] &= union
+            return out
+
+        def build():
+            out = build_host()
             if as_np or self.host_only:
                 return out
             if self.mesh is None:
@@ -1547,9 +2218,20 @@ class StackedEngine:
                     lmap[int(r)] = lmap.get(int(r), ()) + lns + \
                         (valid_lane,)
                 lanes.append(lmap)
-        patcher = self._make_patcher(frags_flat, lanes, versions,
-                                     (len(shards), cb + 1), lane_words)
-        return self.cache.get(key, versions, build, patcher)
+        # weight 4: a group-code page ORs every mapped row per lane —
+        # far costlier to restack per byte than a plain row page, so
+        # the cost-aware eviction policy holds its pages longer
+        return self._cached_stack(
+            key, versions, build,
+            frags=frags_flat, lanes=lanes,
+            logical_lead=(len(shards), cb + 1),
+            lane_words=lane_words, width_words=idx.width // 32,
+            build_host=build_host,
+            versions_fn=lambda: tuple(v for fr in per_field
+                                      for v in self._versions(fr)),
+            weight=4.0, pageable=not (flat or as_np),
+            alive_fn=lambda: all(idx.fields.get(f.name) is f
+                                 for f, _ in fields_rows))
 
     def plane_stack_np(self, idx, field, skey: tuple):
         """Host numpy twin of plane_stack for the native histogram
@@ -1571,10 +2253,14 @@ class StackedEngine:
 
         lanes, lane_words = self._plane_lanes(frags, len(shards),
                                               depth, idx.width)
-        patcher = self._make_patcher(frags, lanes, versions,
-                                     (len(shards), 2 + depth),
-                                     lane_words)
-        return self.cache.get(key, versions, build, patcher)
+        # host numpy twin: never paged (pages are a DEVICE residency
+        # unit), but still ledger-accounted via the whole-entry path
+        return self._cached_stack(
+            key, versions, build,
+            frags=frags, lanes=lanes,
+            logical_lead=(len(shards), 2 + depth),
+            lane_words=lane_words, width_words=idx.width // 32,
+            pageable=False)
 
     def _groupby_onepass_ok(self, idx, fields_rows, n_combos: int,
                             depth: int, has_agg: bool,
@@ -2056,10 +2742,24 @@ class StackedEngine:
 
         frags_flat, lanes, lane_words = self._rows_lanes(
             per_view, row_key, len(shards), idx.width)
-        patcher = self._make_patcher(frags_flat, lanes, versions,
-                                     (len(row_key), len(shards)),
-                                     lane_words)
-        return self.cache.get(key, versions, build, patcher)
+
+        def build_host():
+            return self._rows_stack_np(idx, per_view, row_key,
+                                       len(shards))
+
+        # the paged entry is WHY a broad TopN no longer evicts whole
+        # hot stacks: its (R, S, W) candidate block pages along R*S
+        # lanes, and budget pressure drops only the coldest page-sized
+        # row-blocks
+        return self._cached_stack(
+            key, versions, build,
+            frags=frags_flat, lanes=lanes,
+            logical_lead=(len(row_key), len(shards)),
+            lane_words=lane_words, width_words=idx.width // 32,
+            build_host=build_host,
+            versions_fn=lambda: tuple(v for fr in per_view
+                                      for v in self._versions(fr)),
+            alive_fn=lambda: idx.fields.get(field.name) is field)
 
     # -- flat placements for the mesh GroupBy kernel --------------------
     # The shard_map kernel path shards the SHARD axis over every mesh
@@ -2091,10 +2791,12 @@ class StackedEngine:
 
         frags_flat, lanes, lane_words = self._rows_lanes(
             per_view, row_key, len(shards), idx.width)
-        patcher = self._make_patcher(frags_flat, lanes, versions,
-                                     (len(row_key), len(shards)),
-                                     lane_words)
-        return self.cache.get(key, versions, build, patcher)
+        return self._cached_stack(
+            key, versions, build,
+            frags=frags_flat, lanes=lanes,
+            logical_lead=(len(row_key), len(shards)),
+            lane_words=lane_words, width_words=idx.width // 32,
+            pageable=False)
 
     def plane_stack_flat(self, idx, field, skey: tuple):
         """(S, P, W) planes with S sharded over ALL mesh devices."""
@@ -2118,7 +2820,9 @@ class StackedEngine:
 
         lanes, lane_words = self._plane_lanes(frags, len(shards),
                                               depth, idx.width)
-        patcher = self._make_patcher(frags, lanes, versions,
-                                     (len(shards), 2 + depth),
-                                     lane_words)
-        return self.cache.get(key, versions, build, patcher)
+        return self._cached_stack(
+            key, versions, build,
+            frags=frags, lanes=lanes,
+            logical_lead=(len(shards), 2 + depth),
+            lane_words=lane_words, width_words=idx.width // 32,
+            pageable=False)

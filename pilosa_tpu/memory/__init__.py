@@ -1,0 +1,105 @@
+"""HBM residency management — one budget for every device-byte owner.
+
+The reference bounds residency per index with its rank cache
+(cache.go:130); on a TPU the scarce resource is per-chip HBM shared by
+EVERY cache in the process.  Before this package, three independent
+byte-bounded LRUs (``TileStackCache``, the plan jit caches, the
+serving ``ResultCache``) each enforced a private ``max_bytes`` and
+could collectively over-commit the chip.  Now they register as
+*clients* of one process-wide :class:`~pilosa_tpu.memory.ledger.Ledger`
+(initialized from the real device memory minus a headroom, config
+``[memory]`` fallback otherwise) and reserve/release device bytes
+through it — pressure in one cache reclaims cold bytes in another via
+the clients' reclaim callbacks.
+
+Pieces:
+
+- ``ledger.py``   — the budget ledger (accounting + cross-client reclaim)
+- ``pages.py``    — paged device stacks: fixed-size lane-block pages
+  assembled into a query operand by a jitted gather, so eviction and
+  delta-patching operate per PAGE, not per whole stack (the Ragged
+  Paged Attention trick applied to bitmap tiles)
+- ``policy.py``   — cost-aware eviction scoring (rebuild-cost-per-byte
+  x recency, not pure LRU) + the flight-recorder-fed prefetcher
+- ``pressure.py`` — the OOM backstop: RESOURCE_EXHAUSTED triggers
+  ledger-driven eviction and one bounded retry, then a degraded-mode
+  host (CPU-backend) re-execution instead of a failed query
+
+Knobs land through config.py ``[memory]`` (``apply_memory_settings``)
+or the ``PILOSA_TPU_MEMORY_*`` environment variables read here.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from pilosa_tpu.memory.ledger import Ledger
+
+_DEFAULT_PAGE_BYTES = 4 << 20
+
+_lock = threading.Lock()
+_global: Ledger | None = None
+# module defaults; configure() overrides, env vars override both at
+# read time (the same precedence every other knob in this repo uses)
+_paged_default = True
+_page_bytes_default = _DEFAULT_PAGE_BYTES
+
+
+def ledger() -> Ledger:
+    """The process-wide budget ledger (created on first use)."""
+    global _global
+    with _lock:
+        if _global is None:
+            _global = Ledger()
+        return _global
+
+
+def configure(budget_bytes: int | None = None,
+              headroom_frac: float | None = None,
+              page_bytes: int | None = None,
+              paged: bool | None = None,
+              oom_retry: bool | None = None,
+              host_fallback: bool | None = None) -> Ledger:
+    """Apply ``[memory]`` config knobs to the process singletons.
+    ``budget_bytes=0`` means auto-detect from the device."""
+    global _paged_default, _page_bytes_default
+    led = ledger()
+    if headroom_frac is not None:
+        led.headroom_frac = float(headroom_frac)
+    if budget_bytes is not None:
+        led.set_budget(int(budget_bytes) if budget_bytes else None)
+    if page_bytes is not None and int(page_bytes) > 0:
+        _page_bytes_default = int(page_bytes)
+    if paged is not None:
+        _paged_default = bool(paged)
+    if oom_retry is not None or host_fallback is not None:
+        from pilosa_tpu.memory import pressure
+        if oom_retry is not None:
+            pressure.OOM_RETRY = bool(oom_retry)
+        if host_fallback is not None:
+            pressure.HOST_FALLBACK = bool(host_fallback)
+    return led
+
+
+def paged_enabled() -> bool:
+    """Paged stack-cache entries on/off (the bench A/B switch —
+    PILOSA_TPU_MEMORY_PAGED=0 restores whole-stack entries)."""
+    v = os.environ.get("PILOSA_TPU_MEMORY_PAGED")
+    if v is not None:
+        return v != "0"
+    return _paged_default
+
+
+def page_bytes() -> int:
+    """Fixed device-page size (bytes).  A page spans whole lanes of a
+    stack's flattened leading axis — shard-group x row-block."""
+    v = os.environ.get("PILOSA_TPU_MEMORY_PAGE_BYTES")
+    if v:
+        try:
+            n = int(v)
+            if n > 0:
+                return n
+        except ValueError:
+            pass
+    return _page_bytes_default

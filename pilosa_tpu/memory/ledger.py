@@ -1,0 +1,254 @@
+"""Device-memory budget ledger — one accountant for HBM bytes.
+
+Clients (the tile-stack cache, the jit caches, the serving result
+cache) register with a *reclaim callback* and account every resident
+device allocation through :meth:`Ledger.reserve` / :meth:`release`.
+The invariant the ledger maintains — and the concurrency tests pin —
+is that the accounted total NEVER exceeds the budget: a reservation
+that would cross it first drives reclaim across the OTHER clients
+(coldest first, requester last), and is denied outright when not
+enough cold bytes exist, in which case the caller serves its array
+transiently without retaining it.
+
+The budget resolves lazily on first pressure, in precedence order:
+explicit ``configure(budget_bytes=...)`` > the
+``PILOSA_TPU_MEMORY_BUDGET_BYTES`` env var > the real device memory
+(``jax.local_devices()[0].memory_stats()``) minus a headroom fraction
+> an 8 GiB fallback (matching the pre-ledger ``TileStackCache``
+bound).  Lazy because eagerly touching ``jax.local_devices()`` at
+construction would initialize the backend from every Executor ctor —
+including ones that never touch a device.
+
+Clients are held by WEAK reference: a garbage-collected cache (tests
+construct thousands of Executors) drops out of the accounting with its
+arrays, so the ledger can never leak dead caches or their bytes.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+
+from pilosa_tpu.obs import metrics
+
+_FALLBACK_BUDGET = 8 << 30
+_RECLAIM_ATTEMPTS = 3
+
+
+class Client:
+    """One registered device-byte owner.  ``reserve``/``release`` are
+    the only mutators; ``bytes`` is the client's accounted total."""
+
+    __slots__ = ("name", "_bytes", "_reclaim_cb", "_cold_ts_cb",
+                 "_ledger", "__weakref__")
+
+    def __init__(self, name: str, ledger: "Ledger", reclaim_cb=None,
+                 cold_ts_cb=None):
+        self.name = name
+        self._bytes = 0
+        self._reclaim_cb = reclaim_cb
+        self._cold_ts_cb = cold_ts_cb
+        self._ledger = ledger
+
+    @property
+    def bytes(self) -> int:
+        return self._bytes
+
+    def reserve(self, nbytes: int, trigger: str = "reserve") -> bool:
+        return self._ledger.reserve(self, nbytes, trigger=trigger)
+
+    def release(self, nbytes: int):
+        self._ledger.release(self, nbytes)
+
+    def cold_ts(self) -> float:
+        """Timestamp of this client's coldest resident entry (0 =
+        unknown, treated as coldest) — the cross-client reclaim
+        ordering hint."""
+        if self._cold_ts_cb is None:
+            return 0.0
+        try:
+            return float(self._cold_ts_cb())
+        except Exception:
+            return 0.0
+
+
+class Ledger:
+    def __init__(self, budget_bytes: int | None = None,
+                 headroom_frac: float = 0.1):
+        # explicit budget (configure/ctor); None = resolve lazily
+        self._explicit = (int(budget_bytes)
+                          if budget_bytes else None)
+        self.headroom_frac = float(headroom_frac)
+        self._budget: int | None = None
+        self._clients: list[weakref.ref] = []
+        self._lock = threading.Lock()
+
+    # -- registration ---------------------------------------------------
+
+    def register(self, name: str, reclaim=None, cold_ts=None) -> Client:
+        """Register a client.  ``reclaim(nbytes) -> freed`` evicts the
+        client's cold bytes under cross-client pressure (it must call
+        ``client.release`` for what it drops and report the total);
+        ``cold_ts() -> epoch seconds`` of its coldest entry orders the
+        reclaim sweep.  The ledger keeps only a weak reference."""
+        c = Client(name, self, reclaim_cb=reclaim, cold_ts_cb=cold_ts)
+        with self._lock:
+            self._clients.append(weakref.ref(c))
+        return c
+
+    def _live_locked(self) -> list[Client]:
+        live, refs = [], []
+        for r in self._clients:
+            c = r()
+            if c is not None:
+                live.append(c)
+                refs.append(r)
+        self._clients = refs
+        return live
+
+    # -- budget ---------------------------------------------------------
+
+    def set_budget(self, budget_bytes: int | None):
+        """Explicit budget (None = auto-detect on next use).  Shrinking
+        below the resident total reclaims down to the new bound."""
+        with self._lock:
+            self._explicit = (int(budget_bytes)
+                              if budget_bytes else None)
+            self._budget = self._explicit
+            total = sum(c._bytes for c in self._live_locked())
+            budget = self._budget
+        if budget is not None:
+            metrics.MEM_BUDGET.set(budget)
+            if total > budget:
+                self._reclaim(total - budget, requester=None,
+                              trigger="shrink")
+
+    def budget(self) -> int:
+        b = self._budget
+        if b is not None:
+            return b
+        # resolve OUTSIDE the lock: device init can be slow and must
+        # not block concurrent release() calls
+        b = self._detect()
+        with self._lock:
+            if self._budget is None:
+                self._budget = b
+            b = self._budget
+        metrics.MEM_BUDGET.set(b)
+        return b
+
+    def _detect(self) -> int:
+        if self._explicit:
+            return self._explicit
+        env = os.environ.get("PILOSA_TPU_MEMORY_BUDGET_BYTES")
+        if env:
+            try:
+                n = int(env)
+                if n > 0:
+                    return n
+            except ValueError:
+                pass
+        try:
+            import jax
+            stats = jax.local_devices()[0].memory_stats() or {}
+            limit = (stats.get("bytes_limit")
+                     or stats.get("bytes_reservable_limit"))
+            if limit:
+                return max(int(int(limit)
+                               * (1.0 - self.headroom_frac)), 1 << 20)
+        except Exception:
+            pass  # CPU backends report no stats — config fallback
+        return _FALLBACK_BUDGET
+
+    # -- accounting -----------------------------------------------------
+
+    @property
+    def total_bytes(self) -> int:
+        with self._lock:
+            return sum(c._bytes for c in self._live_locked())
+
+    def free_bytes(self) -> int:
+        return max(self.budget() - self.total_bytes, 0)
+
+    def reserve(self, client: Client, nbytes: int,
+                trigger: str = "reserve") -> bool:
+        """Account ``nbytes`` to ``client`` iff they fit the budget,
+        reclaiming cold bytes across clients first.  False = denied —
+        the caller must not retain the allocation."""
+        nbytes = int(nbytes)
+        if nbytes <= 0:
+            return True
+        budget = self.budget()  # resolve before taking the lock
+        if nbytes > budget:
+            metrics.MEM_DENIED.inc(client=client.name)
+            return False
+        for attempt in range(_RECLAIM_ATTEMPTS):
+            with self._lock:
+                total = sum(c._bytes for c in self._live_locked())
+                if total + nbytes <= budget:
+                    client._bytes += nbytes
+                    self._export_locked()
+                    return True
+                need = total + nbytes - budget
+            freed = self._reclaim(need, requester=client,
+                                  trigger=trigger)
+            if freed <= 0:
+                break
+        metrics.MEM_DENIED.inc(client=client.name)
+        return False
+
+    def release(self, client: Client, nbytes: int):
+        nbytes = int(nbytes)
+        if nbytes <= 0:
+            return
+        with self._lock:
+            client._bytes = max(client._bytes - nbytes, 0)
+            self._export_locked()
+
+    # -- reclaim --------------------------------------------------------
+
+    def _reclaim(self, need: int, requester: Client | None,
+                 trigger: str) -> int:
+        """Ask clients to shed ``need`` bytes: coldest clients first,
+        the requester LAST — pressure in one cache evicts cold bytes
+        in another before eating its own.  Callbacks run without the
+        ledger lock (they call release() as they evict)."""
+        metrics.MEM_RECLAIMS.inc(trigger=trigger)
+        with self._lock:
+            others = [c for c in self._live_locked()
+                      if c is not requester and c._reclaim_cb is not None
+                      and c._bytes > 0]
+            me = (requester if requester is not None
+                  and requester._reclaim_cb is not None else None)
+        others.sort(key=lambda c: c.cold_ts())
+        freed_total = 0
+        for c in others + ([me] if me is not None else []):
+            if freed_total >= need:
+                break
+            try:
+                freed = int(c._reclaim_cb(need - freed_total) or 0)
+            except Exception:
+                freed = 0
+            if freed > 0:
+                freed_total += freed
+                metrics.MEM_RECLAIMED.inc(freed, client=c.name)
+        return freed_total
+
+    def reclaim_frac(self, frac: float = 0.5,
+                     trigger: str = "oom") -> int:
+        """Shed a fraction of the resident total (the OOM backstop's
+        pressure-relief sweep); returns bytes requested."""
+        with self._lock:
+            total = sum(c._bytes for c in self._live_locked())
+        need = int(total * frac)
+        if need > 0:
+            self._reclaim(need, requester=None, trigger=trigger)
+        return need
+
+    def _export_locked(self):
+        per: dict[str, int] = {}
+        for c in self._live_locked():
+            per[c.name] = per.get(c.name, 0) + c._bytes
+        for name, nb in per.items():
+            metrics.MEM_RESIDENT.set(nb, client=name)

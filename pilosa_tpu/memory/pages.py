@@ -1,0 +1,147 @@
+"""Paged device stacks — sub-stack residency granularity.
+
+A tile-stack cache entry used to be ONE device array: a broad TopN's
+(R, S, W) candidate stack evicting meant losing the whole thing, and
+a byte-budget squeeze evicted entire hot stacks to fit one new one.
+Here an entry becomes a set of fixed-size *pages*: the stack's leading
+axes flatten to L lanes (one lane = one (leading-coords, W) row — a
+shard-group x row-block slab), and consecutive lanes group into pages
+of ``memory.page_bytes()`` each.  Pages are independent device arrays:
+
+- the query operand is assembled by a jitted gather
+  (``ops.bitmap.assemble_pages`` — concatenate + trim), so the engine
+  sees the same single array it always did;
+- eviction drops the COLDEST PAGES (memory/policy.py scoring), not
+  whole entries — a 2x-overcommitted working set re-uploads only the
+  pages a query actually lost;
+- delta patching (PR 3) applies per page: a point write scatters into
+  the one page holding its dirty lanes.
+
+This is the ragged-KV-cache paging trick (Ragged Paged Attention,
+PAPERS.md) applied to bitmap tiles; the roaring container (64Ki
+columns) is the reference's analogous fixed residency unit.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from pilosa_tpu import memory
+
+
+def page_lanes_for(width_words: int, itemsize: int = 4) -> int:
+    """Lanes per page: the largest whole-lane count fitting the
+    configured page size (>= 1 — a lane wider than the page still
+    pages lane-by-lane)."""
+    lane_bytes = max(int(width_words) * itemsize, 1)
+    return max(int(memory.page_bytes()) // lane_bytes, 1)
+
+
+@dataclass
+class StackRecipe:
+    """Everything the paged cache path needs to (re)build an entry at
+    page granularity, supplied by the stack builders in
+    executor/stacked.py:
+
+    - ``logical_lead``: the stack's leading shape (lanes = prod)
+    - ``width_words``:  trailing word-axis length
+    - ``lane_words(lane)``: the lane's CURRENT full-width host words
+      (re-read from live fragments — page rebuilds and patches share
+      one source of truth with the whole-stack patcher)
+    - ``build_host()``: the full host (lead..., W) array (bulk cold
+      builds beat L lane_words calls)
+    - ``versions_fn()``: the entry's CURRENT fragment stamp tuple
+      (prefetch warms against live versions, never a stale snapshot)
+    - ``deltas_fn(old_versions)``: dirty lane map (lane -> [(lo, hi)]
+      word runs, None value = whole lane) or None for structural
+      changes; absent when delta patching is disabled
+    - ``weight``: rebuild cost per byte relative to a plain row stack
+      (groupcode stacks OR many rows per lane — evicting their pages
+      costs more to restore, so the eviction policy holds them longer)
+    - ``alive_fn()``: False once the fields this recipe captured were
+      dropped/recreated — the prefetcher must not rebuild (and
+      budget-reserve) stacks no live query can ever hit
+    """
+
+    logical_lead: tuple
+    width_words: int
+    lane_words: object
+    build_host: object
+    versions_fn: object
+    deltas_fn: object = None
+    weight: float = 1.0
+    alive_fn: object = None
+
+    @property
+    def lanes(self) -> int:
+        n = 1
+        for d in self.logical_lead:
+            n *= int(d)
+        return n
+
+
+class PagedStack:
+    """One cache entry's resident pages + recency/frequency.
+
+    ``pages[i]`` is a device array of shape (page_lanes, W) (the last
+    page zero-padded) or None when evicted.  Slots are swapped only
+    under the owning cache's lock; readers snapshot the page list so
+    a concurrent eviction can never yank an array mid-gather (the
+    local reference keeps the buffer alive).  Recency/frequency are
+    ENTRY-level scalars: an operand always needs all its pages, so
+    per-page stamps would carry no signal (every access touches every
+    page) at O(n_pages) bookkeeping cost — eviction concentrates on
+    whole entries and drains their pages in index order."""
+
+    __slots__ = ("shape", "lanes", "page_lanes", "width_words",
+                 "weight", "pages", "last_access", "hits")
+
+    def __init__(self, shape: tuple, page_lanes: int,
+                 weight: float = 1.0):
+        self.shape = tuple(shape)
+        self.width_words = int(shape[-1])
+        n = 1
+        for d in shape[:-1]:
+            n *= int(d)
+        self.lanes = n
+        self.page_lanes = int(page_lanes)
+        self.weight = float(weight)
+        n_pages = -(-self.lanes // self.page_lanes)
+        self.pages: list = [None] * n_pages
+        self.last_access = time.time()
+        self.hits = 0
+
+    @property
+    def n_pages(self) -> int:
+        return len(self.pages)
+
+    @property
+    def page_nbytes(self) -> int:
+        return self.page_lanes * self.width_words * 4
+
+    def resident_bytes(self) -> int:
+        return sum(self.page_nbytes for p in self.pages
+                   if p is not None)
+
+    def missing(self) -> list[int]:
+        return [i for i, p in enumerate(self.pages) if p is None]
+
+    def lane_range(self, pi: int) -> tuple[int, int]:
+        lo = pi * self.page_lanes
+        return lo, min(lo + self.page_lanes, self.lanes)
+
+    def build_page_host(self, pi: int, lane_words) -> np.ndarray:
+        """Host words for one page (zero-padded past the last lane)."""
+        lo, hi = self.lane_range(pi)
+        block = np.zeros((self.page_lanes, self.width_words),
+                         dtype=np.uint32)
+        for k, lane in enumerate(range(lo, hi)):
+            block[k] = lane_words(lane)
+        return block
+
+    def touch(self, now: float | None = None):
+        self.last_access = time.time() if now is None else now
+        self.hits += 1

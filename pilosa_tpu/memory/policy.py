@@ -1,0 +1,126 @@
+"""Eviction scoring + the flight-recorder-fed prefetcher.
+
+Eviction is cost-aware, not pure LRU: a page that is cheap to restack
+(one fragment row re-read + one H2D page upload) should leave before
+an expensive group-code page of equal age (its lanes OR many rows
+per word).  Score = age / (rebuild-weight x frequency segment); the
+HIGHEST score evicts first.  The frequency term is segmented (probation
+vs protected, an SLRU in spirit): pages touched once are fair game,
+pages with repeated hits get a bounded boost rather than an unbounded
+counter that would pin formerly-hot garbage forever.
+
+The prefetcher closes the loop with the flight recorder (obs/flight.py):
+every non-hit stack access stamps its cache-key fingerprint + outcome
+into the query's flight record, so "keys that keep getting rebuilt"
+is a ring-buffer scan.  A background step warms those keys' missing
+pages OFF the serving hot path — but only while the ledger has real
+headroom (warming under pressure would evict the very pages queries
+are using)."""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+from pilosa_tpu.obs import metrics
+
+# prefetch only while this fraction of the budget is free: warming is
+# strictly speculative work and must never CAUSE eviction pressure
+MIN_FREE_FRAC = 0.25
+# outcomes that mark a key as "the cache keeps losing this" — the
+# prefetch predictor's positive signal
+_WARM_OUTCOMES = ("rebuild", "page_rebuild", "patch")
+
+
+def evict_score(age_s: float, weight: float, hits: int) -> float:
+    """Higher = evict sooner.  ``weight`` is rebuild cost per byte
+    relative to a plain row stack; ``hits`` feeds the bounded
+    frequency segment (log-damped, capped so one hot burst can't pin
+    a page forever)."""
+    freq = 1.0 + min(math.log1p(hits), 3.0)
+    return max(age_s, 1e-9) / (max(weight, 1e-6) * freq)
+
+
+def victim_order(candidates: list, now: float | None = None) -> list:
+    """Sort (last_access, weight, hits, payload) tuples most-evictable
+    first."""
+    now = time.time() if now is None else now
+    return sorted(
+        candidates,
+        key=lambda c: evict_score(now - c[0], c[1], c[2]),
+        reverse=True)
+
+
+class Prefetcher:
+    """Warms predicted stack pages from flight-recorder history.
+
+    ``step()`` is one synchronous pass (what tests drive);
+    ``start()`` runs it on a daemon thread every ``interval_s``.  The
+    cache side is ``TileStackCache.prewarm(fp)``, which replays the
+    recorded build recipe for a key fingerprint iff the entry is
+    missing pages — a no-op for fully-resident keys."""
+
+    def __init__(self, cache, recorder=None, ledger=None,
+                 interval_s: float = 0.5, max_warm: int = 4,
+                 window: int = 256):
+        from pilosa_tpu import memory
+        from pilosa_tpu.obs import flight
+        self.cache = cache
+        self.recorder = flight.recorder if recorder is None else recorder
+        self.ledger = memory.ledger() if ledger is None else ledger
+        self.interval_s = float(interval_s)
+        self.max_warm = int(max_warm)
+        self.window = int(window)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def step(self) -> int:
+        """One prediction + warm pass; returns keys warmed."""
+        budget = self.ledger.budget()
+        counts: dict[str, int] = {}
+        for rec in self.recorder.recent(self.window):
+            for fp, outcome in rec.get("stack_keys", ()):
+                if outcome in _WARM_OUTCOMES:
+                    counts[fp] = counts.get(fp, 0) + 1
+        warmed = 0
+        for fp, _n in sorted(counts.items(), key=lambda kv: -kv[1]):
+            if warmed >= self.max_warm:
+                break
+            if self.ledger.free_bytes() < MIN_FREE_FRAC * budget:
+                metrics.PREFETCH_TOTAL.inc(outcome="skipped_pressure")
+                break
+            try:
+                hit = self.cache.prewarm(fp)
+            except Exception:
+                metrics.PREFETCH_TOTAL.inc(outcome="error")
+                continue
+            if hit:
+                warmed += 1
+                metrics.PREFETCH_TOTAL.inc(outcome="warmed")
+            else:
+                metrics.PREFETCH_TOTAL.inc(outcome="noop")
+        return warmed
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.step()
+                except Exception:
+                    pass  # speculative work must never kill the loop
+
+        self._thread = threading.Thread(
+            target=loop, name="pilosa-tpu-prefetch", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2.0)

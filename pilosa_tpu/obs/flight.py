@@ -49,20 +49,31 @@ class Acc:
     (the owning request thread, or the leader while it serves the
     request)."""
 
-    __slots__ = ("phases", "stack", "bytes_moved")
+    __slots__ = ("phases", "stack", "bytes_moved", "keys")
+
+    # per-record stack-key cap: a pathological query touching hundreds
+    # of stacks must not bloat the ring
+    _MAX_KEYS = 32
 
     def __init__(self):
         self.phases: dict[str, float] = {}
         self.stack: dict[str, int] = {}
         self.bytes_moved = 0
+        # (key fingerprint, outcome) per NON-HIT stack access — the
+        # prefetcher's prediction signal (memory/policy.py): keys
+        # that keep rebuilding are keys worth warming
+        self.keys: list[tuple[str, str]] = []
 
     def add_phase(self, name: str, dt: float):
         self.phases[name] = self.phases.get(name, 0.0) + dt
 
-    def add_stack(self, outcome: str, nbytes: int, dt: float):
+    def add_stack(self, outcome: str, nbytes: int, dt: float,
+                  key_fp: str | None = None):
         self.stack[outcome] = self.stack.get(outcome, 0) + 1
         self.bytes_moved += int(nbytes)
         self.add_phase("stack_" + outcome, dt)
+        if key_fp is not None and len(self.keys) < self._MAX_KEYS:
+            self.keys.append((key_fp, outcome))
 
     def merge(self, other: "Acc"):
         for k, v in other.phases.items():
@@ -70,6 +81,9 @@ class Acc:
         for k, v in other.stack.items():
             self.stack[k] = self.stack.get(k, 0) + v
         self.bytes_moved += other.bytes_moved
+        room = self._MAX_KEYS - len(self.keys)
+        if room > 0 and other.keys:
+            self.keys.extend(other.keys[:room])
 
 
 def push_acc(acc: Acc):
@@ -94,10 +108,11 @@ def note_phase(name: str, dt: float):
         acc.add_phase(name, dt)
 
 
-def note_stack(outcome: str, nbytes: int, dt: float):
+def note_stack(outcome: str, nbytes: int, dt: float,
+               key_fp: str | None = None):
     acc = getattr(_tls, "acc", None)
     if acc is not None:
-        acc.add_stack(outcome, nbytes, dt)
+        acc.add_stack(outcome, nbytes, dt, key_fp=key_fp)
 
 
 class FlightRecorder:
@@ -249,6 +264,9 @@ def commit(rec: dict | None, duration_s: float, route: str = "solo",
         "phases": phases,
         "stack": dict(acc.stack),
         "bytes_moved": acc.bytes_moved,
+        # non-hit stack-key fingerprints feeding the prefetcher's
+        # prediction scan (memory/policy.py Prefetcher.step)
+        "stack_keys": list(acc.keys),
     })
     if error is not None:
         rec["error"] = error[:200]

@@ -328,15 +328,58 @@ GROUPBY_ONEPASS = registry.counter(
 # -- tile-stack maintenance (executor/stacked.py TileStackCache) --
 # Outcomes: hit (fresh entry), miss (any non-hit), patch (stale entry
 # delta-patched on device), rebuild (full host restack + upload),
-# wait (single-flight follower served by another thread's build).
+# page_rebuild (fresh entry, evicted pages re-uploaded), wait
+# (single-flight follower served by another thread's build), too_big
+# (entry alone exceeds the budget — served, never retained), denied
+# (ledger reservation refused under pressure — served transiently).
 STACK_CACHE = registry.counter(
     "pilosa_stack_cache_total",
-    "Tile-stack cache accesses by outcome (hit/miss/patch/rebuild/wait)")
+    "Tile-stack cache accesses by outcome (hit/miss/patch/rebuild/"
+    "page_rebuild/wait/too_big/denied)")
 # patched vs rebuilt bytes attribute the write-path win directly: a
 # healthy patch path keeps patched ≪ rebuilt-equivalent stack bytes
 STACK_MAINT_BYTES = registry.counter(
     "pilosa_stack_maintenance_bytes_total",
     "Device stack maintenance traffic by kind (patched/rebuilt)")
+
+# -- HBM residency (memory/: budget ledger, paged stacks, OOM backstop) --
+MEM_BUDGET = registry.gauge(
+    "pilosa_memory_budget_bytes",
+    "Device-memory budget the process ledger enforces")
+MEM_RESIDENT = registry.gauge(
+    "pilosa_memory_resident_bytes",
+    "Ledger-accounted resident device bytes by client")
+MEM_RECLAIMS = registry.counter(
+    "pilosa_memory_reclaim_total",
+    "Cross-client reclaim sweeps by trigger (reserve/oom/shrink)")
+MEM_RECLAIMED = registry.counter(
+    "pilosa_memory_reclaimed_bytes_total",
+    "Bytes shed under ledger pressure by client")
+MEM_DENIED = registry.counter(
+    "pilosa_memory_reserve_denied_total",
+    "Reservations denied (served transiently, not retained) by client")
+OOM_TOTAL = registry.counter(
+    "pilosa_device_oom_total",
+    "Device RESOURCE_EXHAUSTED events by outcome "
+    "(caught/retry_ok/host_fallback/raised)")
+STACK_PAGES = registry.counter(
+    "pilosa_stack_pages_total",
+    "Paged stack-cache page events (build/evict/patch)")
+PREFETCH_TOTAL = registry.counter(
+    "pilosa_prefetch_total",
+    "Prefetcher warm attempts by outcome "
+    "(warmed/noop/skipped_pressure/error)")
+
+# -- jit executable caches (executor/stacked.py _JIT_CACHE/_GB_KERNEL_JIT) --
+# the stack cache's counters shipped in PR 3; these caches used to
+# evict invisibly
+JIT_CACHE = registry.counter(
+    "pilosa_jit_cache_total",
+    "Jit executable cache events by cache (plan/groupby_kernel) and "
+    "event (insert/evict)")
+JIT_CACHE_ENTRIES = registry.gauge(
+    "pilosa_jit_cache_entries",
+    "Jit executable cache occupancy by cache")
 
 # -- serving path (executor/serving.py: micro-batcher + result cache) --
 SERVING_LATENCY = registry.histogram(

@@ -239,6 +239,43 @@ def patch_rows_np(stack2d: np.ndarray, idxs, starts,
     return out
 
 
+# Paged stack assembly (HBM residency manager) -------------------------------
+#
+# Stack cache entries live as fixed-size device PAGES (memory/pages.py)
+# so eviction under budget pressure drops cold page-granular slabs
+# instead of whole stacks; a query's operand is gathered back into one
+# array here.  jitted per (page count, page shape, logical shape) —
+# the shape space is tiny (pages are fixed-size, logical shapes are
+# the handful of stack layouts the engine builds).
+
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnums=(1,))
+def _assemble_pages_jit(pages, shape: tuple):
+    n_lanes = 1
+    for d in shape[:-1]:
+        n_lanes *= int(d)
+    flat = jnp.concatenate(pages, axis=0) if len(pages) > 1 else pages[0]
+    return flat[:n_lanes].reshape(shape)
+
+
+def assemble_pages(pages, shape: tuple):
+    """Concatenate page blocks (each (page_lanes, W)) along the lane
+    axis, trim the final page's padding, and restore the stack's
+    logical shape.  On device this is one fused copy; XLA drops the
+    slice when the lane count is already exact.  The page tuple pads
+    to a pow2 count by repeating the last page (the lane trim drops
+    the extras) so jax's per-shape executable cache grows log-, not
+    linearly, in page count across varying stack sizes."""
+    pages = tuple(pages)
+    n = len(pages)
+    npad = 1 << max(n - 1, 0).bit_length()
+    if npad != n:
+        pages = pages + (pages[-1],) * (npad - n)
+    return _assemble_pages_jit(pages, tuple(shape))
+
+
 # Group-code planes (one-pass GroupBy) --------------------------------------
 #
 # A stack of R DISJOINT packed rows (no column in two rows) is exactly a

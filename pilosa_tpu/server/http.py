@@ -72,6 +72,14 @@ class Server:
                 cache_bytes=config.serving_cache_mb << 20,
                 batching=config.serving_batching)
         config.apply_flight_settings()
+        # HBM residency manager ([memory]): budget ledger + paged
+        # stacks + OOM backstop; the prefetcher warms predicted stack
+        # pages from flight records off the serving hot path
+        config.apply_memory_settings()
+        if (self.api.executor.serving is not None
+                and config.memory_prefetch):
+            self.api.executor.serving.start_prefetcher(
+                interval_s=config.memory_prefetch_interval_s)
         # (Authenticator, Authorizer | None) — enables the chkAuthZ
         # middleware in dispatch (http_handler.go chkAuthZ)
         self.auth = auth
@@ -129,6 +137,8 @@ class Server:
     def close(self):
         from pilosa_tpu.obs import testhook
         testhook.closed("http.Server", self)
+        if self.api.executor.serving is not None:
+            self.api.executor.serving.stop_prefetcher()
         self._ticker_stop.set()
         if self._ticker_thread:
             self._ticker_thread.join(timeout=2)
