@@ -39,6 +39,17 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 
+echo "== chaos smoke =="
+# failure-tolerance gate (bench.py --chaos-smoke): kill + warm-start
+# rejoin of a worker under a concurrent read storm on an in-process
+# cluster -> zero failed queries, bit-exact results vs the fault-free
+# run, resync carried the while-down writes
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python bench.py --chaos-smoke; then
+    echo "check.sh: chaos smoke failed" >&2
+    exit 1
+fi
+
 echo "== tier-1 (budget ${BUDGET}s) =="
 # per-run log (concurrent gates must not clobber each other);
 # no pipe around pytest: under plain sh a `... | tee` pipeline would

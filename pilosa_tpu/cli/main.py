@@ -59,6 +59,7 @@ def cmd_server(args) -> int:
     cfg.apply_stack_settings()
     cfg.apply_flight_settings()
     cfg.apply_memory_settings()
+    cfg.apply_fault_settings()
     holder = Holder(path=cfg.data_dir) if cfg.data_dir else Holder()
     holder.load_schema()
     auth = None
@@ -217,6 +218,20 @@ grpc-port = 20101
 [cluster]
 name = "cluster0"
 replicas = 1
+# hedged replica reads: a fan-out RPC outlasting this delay fires a
+# second attempt at the next live replica, first response wins.
+# < 0 disables, 0 auto-derives from flight-recorder attempt records,
+# > 0 fixes the delay (milliseconds)
+hedge-ms = 0.0
+# default end-to-end query deadline (seconds, 0 = none); every RPC
+# attempt, hedge, and retry budgets from its remainder
+deadline-s = 0.0
+
+[faults]
+# fault-injection registry (obs/faults.py): arm named fault points at
+# startup for chaos drills — "point[@match][,times=N][,delay=MS]"
+# entries joined by ";", e.g. "rpc-delay@10101,delay=200,times=0"
+spec = ""
 
 [auth]
 # enable by setting a shared HS256 secret

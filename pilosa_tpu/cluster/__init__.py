@@ -27,8 +27,18 @@ from pilosa_tpu.cluster.disco import (
     NodeState,
 )
 from pilosa_tpu.cluster.snapshot import ClusterSnapshot
-from pilosa_tpu.cluster.client import InternalClient
-from pilosa_tpu.cluster.coordinator import ClusterExecutor, ClusterNode
+from pilosa_tpu.cluster.client import (
+    Deadline,
+    DeadlineExceeded,
+    InternalClient,
+    RemoteError,
+)
+from pilosa_tpu.cluster.coordinator import (
+    ClusterError,
+    ClusterExecutor,
+    ClusterNode,
+    LoadShedError,
+)
 from pilosa_tpu.cluster.txn import (
     Transaction,
     TransactionManager,
@@ -42,8 +52,13 @@ __all__ = [
     "NodeState",
     "ClusterSnapshot",
     "InternalClient",
+    "Deadline",
+    "DeadlineExceeded",
+    "RemoteError",
+    "ClusterError",
     "ClusterExecutor",
     "ClusterNode",
+    "LoadShedError",
     "Transaction",
     "TransactionManager",
 ]

@@ -6,62 +6,209 @@ route surface (the reference also reuses its handler routes with
 ``Remote=true``); connections are short-lived — cross-HOST traffic is
 rare by design (per-query fan-out only exists across slices, never
 across devices of one slice).
+
+Failure plane (ISSUE 6): every request carries a per-attempt CONNECT
+deadline and a per-attempt READ deadline (the reference's
+http.Client splits these the same way via DialContext vs overall
+timeout), both clamped by an optional end-to-end :class:`Deadline`
+the coordinator propagates from the caller's budget.  Idempotent
+reads retry transient failures (connection errors, timeouts,
+``RemoteError.retryable`` statuses) with jittered exponential backoff
+bounded by the deadline; writes never retry here — their replication
+contract lives in the coordinator.  The ``rpc-drop``/``rpc-delay``
+fault points (obs/faults.py) sit at the head of every attempt, so
+chaos tests strike exactly where real network faults do.
 """
 
 from __future__ import annotations
 
 import http.client
 import json
+import random
+import time
+
+from pilosa_tpu.obs import faults
+
+# statuses a healthy retry can clear: overload shedding and transient
+# gateway failures.  4xx application errors never retry.
+_RETRYABLE_STATUS = frozenset({429, 502, 503, 504})
+
+
+class Deadline:
+    """Absolute end-to-end budget carried through retries, failover
+    re-plans, and hedges; per-attempt socket budgets derive from
+    ``remaining()`` so one slow attempt can't silently eat the whole
+    budget of the attempts behind it."""
+
+    __slots__ = ("at",)
+
+    def __init__(self, seconds: float):
+        self.at = time.monotonic() + float(seconds)
+
+    def remaining(self) -> float:
+        return self.at - time.monotonic()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0
+
+
+class DeadlineExceeded(TimeoutError):
+    """The caller's end-to-end deadline ran out before (or during) an
+    attempt.  ``status`` maps it to HTTP 504 at the server boundary —
+    the caller's budget expired, which is neither a server bug (500)
+    nor a replica outage (503)."""
+
+    status = 504
 
 
 class RemoteError(Exception):
-    """The remote node answered with an error status."""
+    """The remote node answered with an error status.
 
-    def __init__(self, status: int, msg: str):
+    ``retryable`` classifies the failure for the retry loop: True for
+    load-shed/transient statuses (429/502/503/504 — another attempt
+    may land on a recovered or different backend), False for
+    application errors (a 400 retried is a 400 again)."""
+
+    def __init__(self, status: int, msg: str,
+                 retryable: bool | None = None):
         super().__init__(f"remote {status}: {msg}")
         self.status = status
+        self.retryable = (status in _RETRYABLE_STATUS
+                          if retryable is None else retryable)
+
+
+# transient failures the retry loop may clear (TimeoutError is an
+# OSError subclass since py3.10; HTTPException covers IncompleteRead)
+_TRANSIENT = (ConnectionError, OSError, http.client.HTTPException)
 
 
 class InternalClient:
     def __init__(self, timeout: float = 30.0,
-                 headers: dict | None = None):
-        self.timeout = timeout
+                 headers: dict | None = None,
+                 connect_timeout: float | None = None,
+                 retries: int = 2, backoff_s: float = 0.05):
+        self.timeout = timeout  # per-attempt READ deadline
+        # per-attempt CONNECT deadline: a refused/blackholed peer must
+        # fail fast so failover can re-plan — never wait a full read
+        # timeout to learn a socket won't open
+        self.connect_timeout = (min(5.0, timeout)
+                                if connect_timeout is None
+                                else connect_timeout)
+        self.retries = retries          # extra attempts, idempotent only
+        self.backoff_s = backoff_s      # first backoff; doubles, jittered
         self.headers = headers or {}  # e.g. Authorization bearer token
 
-    def _request(self, uri: str, method: str, path: str, body=None):
-        return self._request_raw(
-            uri, method, path,
-            None if body is None else json.dumps(body).encode(),
-            "application/json")
+    # -- one attempt -----------------------------------------------------
 
-    def _request_raw(self, uri: str, method: str, path: str,
-                     data: bytes | None, content_type: str):
-        """One request (JSON or binary body) with auth headers and
-        RemoteError mapping."""
+    def _attempt(self, uri: str, method: str, path: str,
+                 data: bytes | None, content_type: str | None,
+                 deadline: Deadline | None) -> tuple[int, bytes]:
+        detail = f"{uri}{path}"
+        if deadline is not None and deadline.expired():
+            # an exhausted budget means the attempt is never sent
+            raise DeadlineExceeded(
+                f"deadline exhausted before {method} {path}")
+        # faults between the pre-check and the budget math: an
+        # injected rpc-delay models network time and must count
+        # against the caller's deadline exactly as real slowness would
+        faults.fire("rpc-delay", detail)
+        faults.fire("rpc-drop", detail)
+        connect_t, read_t = self.connect_timeout, self.timeout
+        if deadline is not None:
+            rem = deadline.remaining()
+            if rem <= 0:
+                raise DeadlineExceeded(
+                    f"deadline exhausted during {method} {path}")
+            connect_t = min(connect_t, rem)
+            read_t = min(read_t, rem)
         host, _, port = uri.partition(":")
         conn = http.client.HTTPConnection(host, int(port or 80),
-                                          timeout=self.timeout)
+                                          timeout=connect_t)
         try:
-            conn.request(method, path, body=data,
-                         headers={"Content-Type": content_type,
-                                  **self.headers})
+            conn.connect()                      # connect deadline
+            conn.sock.settimeout(read_t)        # read deadline
+            headers = dict(self.headers)
+            if content_type is not None:
+                headers["Content-Type"] = content_type
+            conn.request(method, path, body=data, headers=headers)
             resp = conn.getresponse()
             raw = resp.read()
         finally:
             conn.close()
-        out = json.loads(raw) if raw else None
-        if resp.status != 200:
-            msg = out.get("error", "") if isinstance(out, dict) \
-                else str(out)
-            raise RemoteError(resp.status, msg)
-        return out
+        return resp.status, raw
+
+    def _roundtrip(self, uri: str, method: str, path: str,
+                   data: bytes | None, content_type: str | None,
+                   idempotent: bool = False,
+                   deadline: Deadline | None = None) -> bytes:
+        """Attempt + bounded jittered-backoff retry (idempotent only)
+        + RemoteError mapping.  Returns the raw 200 body."""
+        attempts = (self.retries + 1) if idempotent else 1
+        delay = self.backoff_s
+        last: Exception | None = None
+        # the loop runs to the LARGEST possible budget; the per-error
+        # `budget` below decides when a given failure class gives up
+        for a in range(self.retries + 1):
+            try:
+                status, raw = self._attempt(uri, method, path, data,
+                                            content_type, deadline)
+                if status != 200:
+                    try:
+                        msg = json.loads(raw).get("error", "")
+                    except Exception:
+                        msg = raw[:200].decode("utf-8", "replace")
+                    raise RemoteError(status, msg)
+                return raw
+            except DeadlineExceeded:
+                raise  # the budget is gone; backoff can't help
+            except (*_TRANSIENT, RemoteError) as e:
+                if isinstance(e, RemoteError) and not e.retryable:
+                    raise
+                last = e
+                # a refused connect reached the peer with ZERO bytes,
+                # so retrying is safe even for non-idempotent writes —
+                # and a momentary accept-queue overflow on an
+                # overloaded-but-live node (a storm concentrated by a
+                # peer's death) must not read as that node dying too
+                budget = (self.retries + 1
+                          if isinstance(e, ConnectionRefusedError)
+                          else attempts)
+                if a >= budget - 1:
+                    raise
+                # jittered exponential backoff: full jitter on top of
+                # the base so synchronized retry storms decorrelate
+                sleep = delay * (1.0 + random.random())
+                if deadline is not None and \
+                        deadline.remaining() <= sleep:
+                    raise
+                time.sleep(sleep)
+                delay *= 2
+        raise last  # unreachable; keeps the type checker honest
+
+    # -- JSON wrappers ---------------------------------------------------
+
+    def _request(self, uri: str, method: str, path: str, body=None,
+                 idempotent: bool = False,
+                 deadline: Deadline | None = None):
+        raw = self._roundtrip(
+            uri, method, path,
+            None if body is None else json.dumps(body).encode(),
+            "application/json", idempotent=idempotent,
+            deadline=deadline)
+        return json.loads(raw) if raw else None
 
     # executor.remoteExec's transport (executor.go:6392)
     def query_node(self, uri: str, index: str, pql: str,
-                   shards: list[int] | None) -> dict:
+                   shards: list[int] | None,
+                   idempotent: bool = False,
+                   deadline: Deadline | None = None) -> dict:
+        # idempotent=True only for READ fan-outs: retrying a routed
+        # write would be correct for the bits but can flip the
+        # changed-count answer (a Set retried reports False)
         return self._request(uri, "POST", f"/index/{index}/query",
                              {"query": pql, "shards": shards,
-                              "remote": True})
+                              "remote": True},
+                             idempotent=idempotent, deadline=deadline)
 
     def import_bits(self, uri: str, index: str, field: str, rows, cols,
                     timestamps=None, clear=False) -> int:
@@ -82,55 +229,28 @@ class InternalClient:
         return r["imported"]
 
     def create_keys(self, uri: str, index: str, field: str | None,
-                    keys: list[str]) -> list[int]:
+                    keys: list[str],
+                    deadline: Deadline | None = None) -> list[int]:
         q = f"?field={field}" if field else ""
         return self._request(
             uri, "POST", f"/internal/translate/{index}/keys/create{q}",
-            {"keys": keys})
+            {"keys": keys}, deadline=deadline)
 
     def status(self, uri: str) -> dict:
-        return self._request(uri, "GET", "/status")
+        return self._request(uri, "GET", "/status", idempotent=True)
 
     # -- raw binary transfers (backup/restore file streaming) ----------
 
-    def get_json(self, uri: str, path: str):
+    def get_json(self, uri: str, path: str,
+                 deadline: Deadline | None = None):
         """GET a JSON internal resource (sync/repair endpoints)."""
-        return json.loads(self.get_raw(uri, path))
+        return json.loads(self.get_raw(uri, path, deadline=deadline))
 
-    def get_raw(self, uri: str, path: str) -> bytes:
-        host, _, port = uri.partition(":")
-        conn = http.client.HTTPConnection(host, int(port or 80),
-                                          timeout=self.timeout)
-        try:
-            conn.request("GET", path, headers=self.headers)
-            resp = conn.getresponse()
-            raw = resp.read()
-        finally:
-            conn.close()
-        if resp.status != 200:
-            try:
-                msg = json.loads(raw).get("error", "")
-            except Exception:
-                msg = raw[:200].decode("utf-8", "replace")
-            raise RemoteError(resp.status, msg)
-        return raw
+    def get_raw(self, uri: str, path: str,
+                deadline: Deadline | None = None) -> bytes:
+        return self._roundtrip(uri, "GET", path, None, None,
+                               idempotent=True, deadline=deadline)
 
-    def post_raw(self, uri: str, path: str, data: bytes) -> None:
-        host, _, port = uri.partition(":")
-        conn = http.client.HTTPConnection(host, int(port or 80),
-                                          timeout=self.timeout)
-        try:
-            conn.request("POST", path, body=data,
-                         headers={"Content-Type":
-                                  "application/octet-stream",
-                                  **self.headers})
-            resp = conn.getresponse()
-            raw = resp.read()
-        finally:
-            conn.close()
-        if resp.status != 200:
-            try:
-                msg = json.loads(raw).get("error", "")
-            except Exception:
-                msg = raw[:200].decode("utf-8", "replace")
-            raise RemoteError(resp.status, msg)
+    def post_raw(self, uri: str, path: str, data: bytes) -> bytes:
+        return self._roundtrip(uri, "POST", path, data,
+                               "application/octet-stream")

@@ -17,12 +17,19 @@ format), mirroring how the reference reduces decoded protobuf rows.
 from __future__ import annotations
 
 import http.client
+import os
 import threading
 import time
 
-from pilosa_tpu.cluster.client import InternalClient, RemoteError
+from pilosa_tpu.cluster.client import (
+    Deadline,
+    DeadlineExceeded,
+    InternalClient,
+    RemoteError,
+)
 from pilosa_tpu.cluster.disco import DisCo, InMemDisCo, Node, NodeState
 from pilosa_tpu.cluster.snapshot import ClusterSnapshot
+from pilosa_tpu.obs import faults, flight, metrics
 from pilosa_tpu.pql import parse
 
 # network failures that trigger replica failover (executor.go:6505
@@ -34,18 +41,97 @@ _NET_ERRORS = (ConnectionError, OSError, TimeoutError,
 # pql.Call.IsWrite analog (mirrors executor._WRITE_CALLS)
 _WRITE_CALLS = {"Set", "Clear", "Store", "ClearRow", "Delete"}
 
+# calls whose cross-node reduce stays meaningful over a shard SUBSET —
+# the partial-result surface (Count under-counts, TopN ranks the live
+# subset; both are the standard degraded answers a serving tier ships)
+_PARTIAL_OK_CALLS = {"Count", "TopN", "TopK"}
+
+# (monotonic timestamp, seconds) memo for the auto-derived hedge delay
+_HEDGE_CACHE: tuple[float, float] | None = None
+
 
 class ClusterError(Exception):
     pass
 
 
-def _catch(fn, *args):
-    """Run fn, returning the exception instead of raising (pool tasks
-    settle independently; the caller sorts failures per node)."""
-    try:
-        return fn(*args)
-    except Exception as e:
-        return e
+class LoadShedError(ClusterError):
+    """Typed 503: a shard subset is durably down (no live replica) and
+    the caller did not opt into partial results — shed the query
+    instead of returning a silently wrong answer.  ``status`` rides to
+    the HTTP layer; ``missing_shards`` names the dead subset."""
+
+    status = 503
+    # Retry-After hint for the HTTP layer: one heartbeat interval is
+    # the soonest a dead replica's recovery (or a peer resync) can
+    # change the routing answer
+    retry_after_s = 1.0
+
+    def __init__(self, msg: str, missing_shards=()):
+        super().__init__(msg)
+        self.missing_shards = sorted(missing_shards)
+
+
+def derive_hedge_delay_s(factor: float = 3.0, lo_s: float = 0.005,
+                         hi_s: float = 1.0, default_s: float = 0.05,
+                         min_records: int = 32,
+                         min_node_records: int = 8) -> float:
+    """Hedge delay from the flight recorder: the FASTEST replica's
+    p95 attempt time — "if a healthy replica's p95 would have
+    answered by now, fire the hedge" (tail-at-scale's defer-to-p95
+    rule, tracked per node the way Cassandra's speculative retry
+    tracks per-replica latency), clamped to [lo, hi].
+
+    Deriving from the POOLED attempt distribution is poisonable: one
+    durably slow replica slows a third of all attempts, drags the
+    pooled p95 (and eventually the median) up to the fault latency,
+    and the hedge fires too late to rescue exactly the requests it
+    exists for.  The per-node MINIMUM stays anchored to the
+    healthiest replica no matter how many peers degrade; when ALL
+    replicas are slow (systemic overload, not a replica fault) the
+    delay rises with them and hedging stays rare.  Each node's score
+    is ``min(p95, factor x median)`` rather than bare p95: on a
+    host whose healthy latencies are themselves heavy-tailed (GC /
+    GIL / scheduler pauses), bare p95 would defer every hedge into
+    that noise tail — the median arm keeps the delay anchored to the
+    node's typical latency.  Falls back to the same score over the
+    pooled sample while per-node counts are thin, to whole-record
+    durations before fan-out attempts exist, and to ``default_s``
+    until enough samples accumulate."""
+    by_node: dict[str, list[float]] = {}
+    durs: list[float] = []
+    for r in flight.recorder.recent(512):
+        if r.get("error") is not None:
+            continue
+        # only CLUSTER records feed the derivation: under a mixed
+        # workload the ring is dominated by sub-ms solo / serving /
+        # dax records, and deriving from those would clamp the delay
+        # to the floor and hedge nearly every healthy fan-out
+        if r.get("route") != "cluster":
+            continue
+        durs.append(r.get("duration_ms", 0.0))
+        for a in r.get("attempts", ()):
+            # "*ok-local" attempts (in-process api.query legs) are
+            # excluded for the same reason: sub-ms locals would
+            # floor-clamp the delay and hedge every healthy fan-out
+            if str(a.get("outcome", "")).endswith("ok"):
+                by_node.setdefault(str(a.get("node", "")), []) \
+                    .append(a.get("ms", 0.0))
+    atts = [ms for lst in by_node.values() for ms in lst]
+    sample = atts if len(atts) >= min_records else durs
+    if len(sample) < min_records:
+        return default_s
+    def score(lst: list[float]) -> float:
+        lst.sort()
+        p95 = lst[min(len(lst) - 1, int(len(lst) * 0.95))]
+        return min(p95, factor * lst[len(lst) // 2])
+
+    node_scores = [score(lst) for lst in by_node.values()
+                   if len(lst) >= min_node_records]
+    if node_scores and sample is atts:
+        delay_ms = min(node_scores)
+    else:
+        delay_ms = score(sample)
+    return min(max(delay_ms / 1e3, lo_s), hi_s)
 
 
 class ClusterNode:
@@ -73,14 +159,40 @@ class ClusterNode:
         self._hb_interval = heartbeat_interval
         self._hb_stop = threading.Event()
         self._hb_thread: threading.Thread | None = None
+        self.warm_stats: dict | None = None  # set by open(warm=True)
         self.executor = ClusterExecutor(self)
 
     # -- lifecycle -----------------------------------------------------
 
-    def open(self):
-        """disCo.Start + serve + heartbeats (server.go:618)."""
+    def open(self, warm: bool = False):
+        """disCo.Start + serve + heartbeats (server.go:618).
+
+        ``warm=True`` is the REJOIN protocol (ROADMAP item 5): serve
+        infrastructure comes up first, then the node resyncs what it
+        missed from live peers (translate snapshots + fragment
+        block repair — repaired fragments append to their PR-3 delta
+        logs, so resident device stacks re-converge by O(delta)
+        patches, not full rebuilds) and prefills its stack/jit caches
+        by replaying the flight recorder's hottest recent queries,
+        and only THEN registers with disco and takes traffic."""
         self.server.start()
+        if warm:
+            self.warm_stats = {"sync": self.sync_from_peers(),
+                               "prefilled": self._prefill_from_flight()}
+            metrics.CLUSTER_EVENTS.inc(event="node_rejoin")
         self.disco.start(Node(id=self.node_id, uri=self.uri))
+        if warm:
+            # close the rejoin skip window: a replicated write landing
+            # between the bulk resync above and the disco registration
+            # saw this node DOWN and skipped it ("repaired at its next
+            # resync") — and this IS that next resync; writes after
+            # registration route here normally
+            try:
+                self.warm_stats["sync_post_register"] = \
+                    self.sync_from_peers()
+            except Exception as e:
+                self.server.logger.warn(
+                    "post-register resync failed: %s", e)
         self._hb_thread = threading.Thread(target=self._hb_loop,
                                            daemon=True)
         self._hb_thread.start()
@@ -88,9 +200,71 @@ class ClusterNode:
 
     def _hb_loop(self):
         while not self._hb_stop.wait(self._hb_interval):
-            self.disco.heartbeat(self.node_id)
+            if faults.take("node-crash", self.node_id):
+                # chaos: die mid-traffic — stop serving AND beating;
+                # peers mark us DOWN and fail queries over
+                self.pause()
+                return
+            if faults.take("heartbeat-stall", self.node_id):
+                # chaos: the asymmetric failure — still serving, but
+                # the lease ages out and peers route around us
+                continue
+            was_down = any(
+                nd.id == self.node_id and nd.state == NodeState.DOWN
+                for nd in self.disco.nodes())
+            if was_down:
+                # peers marked us DOWN while we kept running (stalled
+                # lease, transient refusal): replicated writes were
+                # skipped past us meanwhile, so resync from live peers
+                # BEFORE the beat revives us as a read owner
+                try:
+                    self.sync_from_peers()
+                    metrics.CLUSTER_EVENTS.inc(event="resync")
+                except Exception as e:
+                    self.server.logger.warn(
+                        "revival resync failed: %s", e)
+            revived = self.disco.heartbeat(self.node_id)
             if isinstance(self.disco, InMemDisCo):
                 self.disco.check_heartbeats()
+            if was_down or revived:
+                # close the revival skip window: a write landing
+                # between the resync above and the reviving beat still
+                # saw us DOWN and was skipped — pull it now that we
+                # are a read owner again.  ``revived and not
+                # was_down`` is the racing DOWN mark that landed
+                # between the was_down check and the beat: the beat
+                # revived us as a read owner with NO resync yet, so
+                # this one repairs whatever the skip window missed
+                try:
+                    self.sync_from_peers()
+                except Exception as e:
+                    self.server.logger.warn(
+                        "revival resync failed: %s", e)
+
+    def _prefill_from_flight(self, max_queries: int = 8) -> int:
+        """Warm-start cache prefill: replay the hottest recent READ
+        queries from the flight recorder against the local shards so
+        the first real queries after rejoin hit warm tile stacks and
+        compiled programs instead of paying cold rebuilds."""
+        counts: dict[tuple, int] = {}
+        for rec in flight.recorder.recent(512):
+            q, ix = rec.get("query", ""), rec.get("index", "")
+            if rec.get("error") is not None or not q or not ix:
+                continue
+            if any(w + "(" in q for w in _WRITE_CALLS):
+                continue
+            counts[(ix, q)] = counts.get((ix, q), 0) + 1
+        warmed = 0
+        hot = sorted(counts.items(), key=lambda kv: -kv[1])
+        for (ix, q), _n in hot[:max_queries]:
+            if self.api.holder.index(ix) is None:
+                continue
+            try:
+                self.api.query(ix, q)
+                warmed += 1
+            except Exception:
+                pass  # prefill is speculative; never block the rejoin
+        return warmed
 
     def pause(self):
         """Stop heartbeating AND serving (fault injection — the pumba
@@ -101,6 +275,12 @@ class ClusterNode:
         self._hb_stop.set()
         self.server.httpd.shutdown()
         self.server.httpd.server_close()
+        # the listener is permanently gone: tell the leak auditor now
+        # (a killed node's ClusterNode object is usually abandoned —
+        # close() would deregister the node id from disco, which after
+        # a same-id rejoin would deregister the REJOINED node)
+        from pilosa_tpu.obs import testhook
+        testhook.closed("http.Server", self.server)
 
     def close(self):
         self._hb_stop.set()
@@ -251,6 +431,47 @@ class ClusterNode:
 
     # -- writes (replicated) -------------------------------------------
 
+    def _import_replicated(self, index: str, shard: int, owners,
+                           send) -> int:
+        """Forward one shard's import to every replica; a failing
+        replica is marked DOWN and skipped as long as at least one
+        owner acks (the write contract of _execute_col_write /
+        api.go:651).  Returns the FIRST successful ack's changed
+        count (replica acks are duplicates, not additional bits).
+
+        WRITE failures mark DOWN for ANY network error, timeouts
+        included — unlike the read fan-out's ConnectionError-only
+        rule — because the skipped replica now DIVERGES and the DOWN
+        mark is the repair trigger: a node that is in fact alive
+        notices it on its own next heartbeat, runs sync_from_peers,
+        and revives (coordinator._hb_loop), costing one beat of read
+        traffic; a node that is dead repairs at warm rejoin.  Leaving
+        a timed-out replica STARTED would leave it silently stale
+        with no path that ever resyncs it."""
+        n = None
+        last_err = None
+        for node in owners:
+            try:
+                n_ = send(node)
+            except _NET_ERRORS as e:
+                last_err = e
+                self.disco.set_state(node.id, NodeState.DOWN)
+                metrics.CLUSTER_EVENTS.inc(event="replica_skip")
+                self.server.logger.warn(
+                    "import %s/shard %s skipped replica %s (%s); "
+                    "repaired at its next resync", index, shard,
+                    node.id, type(e).__name__)
+                continue
+            if n is None:
+                n = n_
+        if n is None:
+            if owners:
+                raise ClusterError(
+                    f"no live replica accepted import for "
+                    f"{index!r} shard {shard}: {last_err}")
+            return 0
+        return n
+
     def import_bits(self, index: str, field: str, rows, cols,
                     timestamps=None) -> int:
         """Route bits to shard owners; forward to all replicas
@@ -267,14 +488,10 @@ class ClusterNode:
             scols = [int(cols[i]) for i in idxs]
             stimes = ([timestamps[i] for i in idxs]
                       if timestamps is not None else None)
-            # count changed bits ONCE per shard — from the primary
-            # (first owner); replica writes are forwarded but their
-            # counts are duplicates, not additional bits (api.go:651)
-            for j, node in enumerate(snap.shard_nodes(index, shard)):
-                n_ = self._import_to(node, index, field, srows, scols,
-                                     stimes)
-                if j == 0:
-                    n += n_
+            n += self._import_replicated(
+                index, shard, snap.shard_nodes(index, shard),
+                lambda node: self._import_to(node, index, field, srows,
+                                             scols, stimes))
             shards_touched.add(shard)
         self.disco.add_shards(index, "", shards_touched)
         return n
@@ -290,15 +507,16 @@ class ClusterNode:
         for shard, idxs in groups.items():
             scols = [int(cols[i]) for i in idxs]
             svals = [values[i] for i in idxs]
-            for j, node in enumerate(snap.shard_nodes(index, shard)):
+
+            def send(node, scols=scols, svals=svals):
                 if node.id == self.node_id:
-                    n_ = self.api.import_values(index, field, cols=scols,
-                                                values=svals)
-                else:
-                    n_ = self._client().import_values(
-                        node.uri, index, field, scols, svals)
-                if j == 0:  # primary's count only (see import_bits)
-                    n += n_
+                    return self.api.import_values(
+                        index, field, cols=scols, values=svals)
+                return self._client().import_values(
+                    node.uri, index, field, scols, svals)
+
+            n += self._import_replicated(
+                index, shard, snap.shard_nodes(index, shard), send)
             shards_touched.add(shard)
         self.disco.add_shards(index, "", shards_touched)
         return n
@@ -329,12 +547,23 @@ class ClusterNode:
 
     # -- queries -------------------------------------------------------
 
-    def query(self, index: str, pql: str) -> dict:
-        return self.executor.execute(index, pql)
+    def query(self, index: str, pql: str,
+              deadline_s: float | None = None,
+              partial_ok: bool = False) -> dict:
+        return self.executor.execute(index, pql, deadline_s=deadline_s,
+                                     partial_ok=partial_ok)
 
 
 class ClusterExecutor:
-    """Shard fan-out over nodes + reduce over wire-format results."""
+    """Shard fan-out over nodes + reduce over wire-format results.
+
+    Failure plane (ISSUE 6): fan-out RPCs hedge to the next live
+    replica once they outlast a delay derived from flight-recorder
+    p99s (first response wins), an optional end-to-end deadline clamps
+    every attempt's budget, and a durably-down shard subset either
+    sheds the query with a typed 503 (:class:`LoadShedError`) or — for
+    Count/TopN with ``partial_ok`` — serves the live subset with the
+    missing shards flagged in the response."""
 
     def __init__(self, node: ClusterNode):
         self.node = node
@@ -344,35 +573,101 @@ class ClusterExecutor:
         return (call.name == "Extract" and call.children
                 and call.children[0].name == "Sort")
 
-    def execute(self, index: str, pql: str) -> dict:
+    @staticmethod
+    def _hedge_delay() -> float | None:
+        """Seconds before a fan-out RPC hedges to the next replica,
+        or None (disabled).  PILOSA_TPU_CLUSTER_HEDGE_MS: negative
+        disables, 0/unset auto-derives (derive_hedge_delay_s),
+        positive fixes the delay.  The derived value is cached for
+        1 s — it moves slowly, and the 512-record ring scan + sort
+        must not run on every fan-out (or per failover re-plan)."""
+        global _HEDGE_CACHE
+        v = float(os.environ.get("PILOSA_TPU_CLUSTER_HEDGE_MS",
+                                 "0") or 0)
+        if v < 0:
+            return None
+        if v > 0:
+            return v / 1e3
+        now = time.monotonic()
+        cached = _HEDGE_CACHE
+        if cached is not None and now - cached[0] < 1.0:
+            return cached[1]
+        d = derive_hedge_delay_s()
+        _HEDGE_CACHE = (now, d)
+        return d
+
+    @staticmethod
+    def _default_deadline() -> Deadline | None:
+        v = float(os.environ.get("PILOSA_TPU_CLUSTER_DEADLINE_S",
+                                 "0") or 0)
+        return Deadline(v) if v > 0 else None
+
+    def execute(self, index: str, pql: str,
+                deadline_s: float | None = None,
+                partial_ok: bool = False) -> dict:
+        """``deadline_s`` bounds the whole query end to end (every
+        attempt/hedge/retry budgets from its remainder); ``partial_ok``
+        opts Count/TopN/TopK queries into shard-subset answers when
+        shards are durably down — the response then carries
+        ``{"partial": {"missing_shards": [...]}}``."""
         q = parse(pql)
+        deadline = (Deadline(deadline_s) if deadline_s
+                    else self._default_deadline())
         if any(c.name in _WRITE_CALLS or self._is_extract_of_sort(c)
                or c.name == "Sort" for c in q.calls):
             # writes route per-call by placement (api.go:651-672);
             # Extract(Sort(...)) needs the order-preserving split and
             # Sort needs its offset hoisted to the merge — mixed
             # queries evaluate call-by-call in order
-            return {"results": [self._execute_call(index, c)
+            return {"results": [self._execute_call(index, c, deadline)
                                 for c in q.calls]}
         snap = self.node.snapshot()
         shards = sorted(self.node.disco.shards(index, ""))
         if not shards:
             # no data imported through the cluster path: run locally
             return self.node.api.query(index, pql)
-        partials = self._fan_out(snap, index, pql, shards)
-        # reduce call-by-call across nodes (streaming reduceFn analog)
-        results = []
-        for ci in range(len(q.calls)):
-            vals = [p[ci] for p in partials]
-            results.append(_reduce(q.calls[ci], vals))
-        return {"results": results}
+        partial = partial_ok and all(c.name in _PARTIAL_OK_CALLS
+                                     for c in q.calls)
+        # flight record for the fan-out (begin() no-ops when nested
+        # under a serving-layer record): per-node attempt timings land
+        # in the record's `attempts` field for /debug/queries
+        fl = flight.begin(index, pql)
+        t0 = time.perf_counter()
+        err = None
+        try:
+            missing: set[int] = set()
+            partials = self._fan_out(snap, index, pql, shards,
+                                     deadline=deadline, partial=partial,
+                                     missing=missing)
+            # reduce call-by-call across nodes (streaming reduceFn);
+            # partial mode with EVERY shard missing reduces to the
+            # call's zero value, never a meaningless None
+            results = []
+            for ci in range(len(q.calls)):
+                vals = [p[ci] for p in partials]
+                results.append(_reduce(q.calls[ci], vals) if vals
+                               else _empty_result(q.calls[ci]))
+            out = {"results": results}
+            if missing:
+                # explicit degradation flag: the caller can tell a
+                # partial Count from a complete one
+                out["partial"] = {"missing_shards": sorted(missing)}
+                metrics.CLUSTER_EVENTS.inc(event="partial")
+            return out
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            flight.commit(fl, time.perf_counter() - t0,
+                          route="cluster", error=err)
 
-    def _execute_call(self, index: str, call) -> object:
+    def _execute_call(self, index: str, call, deadline=None) -> object:
         """Execute ONE call with placement-aware routing."""
         if call.name not in _WRITE_CALLS:
             if self._is_extract_of_sort(call):
                 return extract_of_sort_wire(
-                    call, lambda c: self._execute_call(index, c))
+                    call, lambda c: self._execute_call(index, c,
+                                                       deadline))
             shipped = call
             if call.name == "Sort":
                 shipped = _sort_call_for_shipping(call)
@@ -381,10 +676,11 @@ class ClusterExecutor:
             if not shards:
                 return self.node.api.query(index, call.to_pql())["results"][0]
             partials = self._fan_out(snap, index, shipped.to_pql(),
-                                     shards)
+                                     shards, deadline=deadline)
             return _reduce(call, [p[0] for p in partials])
         if call.name in ("Set", "Clear"):
-            return self._execute_col_write(index, call)
+            return self._execute_col_write(index, call,
+                                           deadline=deadline)
         # Store/ClearRow/Delete touch every shard of the index: run on
         # every live node against its local shards, reduce with any().
         # Same failover contract as _execute_col_write: a node dying
@@ -397,8 +693,14 @@ class ClusterExecutor:
             if n.state != NodeState.STARTED:
                 continue
             try:
-                vals.append(self._run_on(snap, n.id, index, call.to_pql()))
+                vals.append(self._run_on(snap, n.id, index,
+                                         call.to_pql(),
+                                         deadline=deadline))
             except _NET_ERRORS as e:
+                if isinstance(e, DeadlineExceeded):
+                    raise
+                # write skip -> DOWN on ANY net error: the mark is the
+                # resync trigger (see _import_replicated)
                 last_err = e
                 self.node.disco.set_state(n.id, NodeState.DOWN)
         if not vals:
@@ -406,7 +708,8 @@ class ClusterExecutor:
                 f"no live node accepted {call.name}: {last_err}")
         return _reduce(call, vals)
 
-    def _execute_col_write(self, index: str, call) -> object:
+    def _execute_col_write(self, index: str, call,
+                           deadline=None) -> object:
         """Set/Clear: route to the column's shard owner + replicas and
         register the shard (the write half of executor.mapReduce +
         api.ImportRoaringShard's replica forwarding)."""
@@ -416,7 +719,8 @@ class ClusterExecutor:
             # (translate.go:103 partitioned stores): every node routes
             # the same key to the same store, so key->id assignment is
             # consistent cluster-wide; the call then ships BY ID.
-            col = self._translate_col_key(index, col)
+            col = self._translate_col_key(index, col,
+                                          deadline=deadline)
             if col is None:
                 return self.node.api.query(index, call.to_pql())["results"][0]
             call = type(call)(name=call.name,
@@ -428,10 +732,15 @@ class ClusterExecutor:
         last_err = None
         for n in snap.shard_nodes(index, shard):
             try:
-                vals.append(self._run_on(snap, n.id, index, call.to_pql()))
+                vals.append(self._run_on(snap, n.id, index,
+                                         call.to_pql(),
+                                         deadline=deadline))
             except _NET_ERRORS as e:
-                # a dead replica doesn't fail the write as long as one
-                # owner acks it (reads will fail over the same way)
+                if isinstance(e, DeadlineExceeded):
+                    raise
+                # a failing replica doesn't fail the write as long as
+                # one owner acks it; DOWN on ANY net error because the
+                # mark is the resync trigger (see _import_replicated)
                 last_err = e
                 self.node.disco.set_state(n.id, NodeState.DOWN)
         if not vals:
@@ -441,7 +750,7 @@ class ClusterExecutor:
         self.node.disco.add_shards(index, "", {shard})
         return _reduce(call, vals)
 
-    def _translate_col_key(self, index: str, key: str):
+    def _translate_col_key(self, index: str, key: str, deadline=None):
         """Create the key on its partition owner's store; returns the
         id, or None when the index has no column-key translation."""
         idx = self.node.api.holder.index(index)
@@ -455,73 +764,307 @@ class ClusterExecutor:
         if owner is None or owner.id == self.node.node_id:
             return idx.column_translator.create_keys(key)[key]
         # /internal/translate returns ids aligned with the keys list
-        got = self.node._client().create_keys(owner.uri, index, None, [key])
+        got = self.node._client().create_keys(owner.uri, index, None,
+                                              [key], deadline=deadline)
         return got[0]
 
-    def _run_on(self, snap, node_id: str, index: str, pql: str):
+    def _run_on(self, snap, node_id: str, index: str, pql: str,
+                deadline=None):
         # remote=True everywhere: routed calls carry pre-translated ids
         if node_id == self.node.node_id:
             return self.node.api.query(index, pql,
                                        remote=True)["results"][0]
         node = snap.node(node_id)
         return self.node._client().query_node(
-            node.uri, index, pql, None)["results"][0]
+            node.uri, index, pql, None,
+            deadline=deadline)["results"][0]
 
-    def _fan_out(self, snap, index, pql, shards,
-                 attempts: int = 3) -> list[list]:
-        """Group shards by owner and execute; when a node fails, mark
-        it DOWN and re-plan ONLY its shards against the remaining live
-        replicas — per-shard failover, never running a shard on a node
-        that doesn't own a replica of it (executor.go:6505-6518)."""
-        by_node = snap.shards_by_node(index, shards)
+    def _fan_out(self, snap, index, pql, shards, attempts: int = 3,
+                 deadline=None, partial: bool = False,
+                 missing: set | None = None,
+                 avoid: set | None = None) -> list[list]:
+        """Group shards by owner and execute; when a node fails,
+        re-plan ONLY its shards against the remaining live replicas —
+        per-shard failover, never running a shard on a node that
+        doesn't own a replica of it (executor.go:6505-6518).  Remote
+        groups hedge to the next replica past the hedge delay
+        (``_remote``).
+
+        DOWN marking is deliberately split from rerouting: only a
+        DEFINITIVE connection failure (refused/reset — the node's
+        socket is gone) marks the node DOWN cluster-wide; a timeout
+        merely adds it to this query's ``avoid`` set and reroutes.  An
+        overloaded-but-alive node must not be globally shot by one
+        slow query — detecting hung nodes is the heartbeat lease's
+        job, with better evidence.
+
+        ``partial``: shards with no live replica land in ``missing``
+        instead of failing the query; otherwise they raise a typed
+        :class:`LoadShedError`."""
+        avoid = set() if avoid is None else avoid
+        by_node = snap.shards_by_node(index, shards, exclude=avoid)
         partials: list[list] = []
         failed_shards: list[int] = []
         last_err = None
+        hedge_s = self._hedge_delay()
+        # pool workers run on their own threads: carry the caller's
+        # flight accumulator over so per-node attempt notes land in
+        # THIS query's record
+        acc = flight.active_acc()
 
         def one(pool, item):
             node_id, node_shards = item
-            node = snap.node(node_id)
-            if node_id == self.node.node_id:
-                return self.node.api.query(index, pql,
-                                           shards=node_shards)
-            with pool.blocked():  # RPC wait: let the pool grow
-                return self.node._client().query_node(
-                    node.uri, index, pql, node_shards)
+            prev = flight.push_acc(acc)
+            try:
+                if node_id == self.node.node_id:
+                    t0 = time.perf_counter()
+                    out = self.node.api.query(index, pql,
+                                              shards=node_shards)
+                    flight.note_attempt(node_id,
+                                        time.perf_counter() - t0,
+                                        "ok-local")
+                    return [out["results"]]
+                with pool.blocked():  # RPC wait: let the pool grow
+                    return self._remote(snap, index, pql, node_id,
+                                        node_shards, hedge_s,
+                                        deadline, avoid)
+            finally:
+                flight.pop_acc(prev)
 
-        from pilosa_tpu.taskpool import Pool
+        from pilosa_tpu.taskpool import Pool, TaskFailure
         jobs = sorted(by_node.items())
         pool = Pool(size=2)  # task.Pool default size (executor.go:6714)
-        outs = pool.map(lambda p, it: _catch(one, p, it), jobs)
+        outs = pool.map_settled(one, jobs)
         for (node_id, node_shards), out in zip(jobs, outs):
-            if isinstance(out, Exception):
-                if not isinstance(out, _NET_ERRORS):
-                    raise out
-                last_err = out
-                self.node.disco.set_state(node_id, NodeState.DOWN)
+            if isinstance(out, TaskFailure):
+                if isinstance(out.error, DeadlineExceeded):
+                    # the CALLER's budget expired — failover re-plans
+                    # can only re-expire it, and blaming replicas
+                    # (503 + failover metrics) would send clients
+                    # retrying a query that can never finish
+                    raise out.error
+                if not isinstance(out.error, _NET_ERRORS):
+                    raise out.error
+                last_err = out.error
+                avoid.add(node_id)
+                if isinstance(out.error, ConnectionError):
+                    # definitive death (refused/reset): cluster-wide
+                    self.node.disco.set_state(node_id, NodeState.DOWN)
+                metrics.CLUSTER_EVENTS.inc(event="failover")
                 failed_shards.extend(node_shards)
             else:
-                partials.append(out["results"])
+                partials.extend(out)
         if failed_shards:
-            if attempts <= 1:
-                raise ClusterError(
-                    f"replicas exhausted for shards "
-                    f"{failed_shards[:4]}...: {last_err}")
             # shards_by_node consults node state, so the DOWN mark
             # reroutes each failed shard to its next live replica; a
-            # shard with no live replica keeps its dead owner and the
-            # retry fails it for good
+            # shard with no live replica keeps its dead owner, and is
+            # either shed (typed 503) or flagged missing (partial)
             snap2 = self.node.snapshot()
             dead = {n.id for n in snap2.nodes
                     if n.state != NodeState.STARTED}
+            durably_down = set()
             for s in failed_shards:
                 owners = {n.id for n in snap2.shard_nodes(index, s)}
                 if owners <= dead:
-                    raise ClusterError(
-                        f"no live replica for shard {s}: {last_err}")
-            partials.extend(
-                self._fan_out(snap2, index, pql, failed_shards,
-                              attempts - 1))
+                    durably_down.add(s)
+            exhausted_live = (set(failed_shards) - durably_down
+                              if attempts <= 1 else set())
+            if durably_down or exhausted_live:
+                if not partial:
+                    # both shapes shed with a retryable 503, but the
+                    # text must not misdirect: exhausted retries on
+                    # LIVE replicas is overload, not replica death
+                    metrics.CLUSTER_EVENTS.inc(event="load_shed")
+                    shed = durably_down | exhausted_live
+                    what = ("replicas exhausted (live but failing)"
+                            if exhausted_live else "no live replica")
+                    raise LoadShedError(
+                        f"{what} for shards "
+                        f"{sorted(shed)[:4]}: {last_err}",
+                        missing_shards=shed)
+                if exhausted_live:
+                    # partial mode's contract covers DURABLY DOWN
+                    # shards only: overloaded-but-live replicas must
+                    # shed, not silently under-count a query an
+                    # immediate retry could answer completely
+                    metrics.CLUSTER_EVENTS.inc(event="load_shed")
+                    raise LoadShedError(
+                        "replicas exhausted (live but failing) for "
+                        f"shards {sorted(exhausted_live)[:4]}: "
+                        f"{last_err}",
+                        missing_shards=exhausted_live)
+                # served-partial (degraded-but-answered) counts as
+                # event="partial" once per query at response assembly
+                # in execute(), not per recursion level here
+                missing.update(durably_down)
+                failed_shards = [s for s in failed_shards
+                                 if s not in durably_down]
+            if failed_shards:
+                partials.extend(
+                    self._fan_out(snap2, index, pql, failed_shards,
+                                  attempts - 1, deadline=deadline,
+                                  partial=partial, missing=missing,
+                                  avoid=avoid))
         return partials
+
+    # -- hedged remote group RPC ---------------------------------------
+
+    def _remote(self, snap, index, pql, node_id, node_shards,
+                hedge_s, deadline, avoid=frozenset()) -> list[list]:
+        """One node-group RPC, hedged: if the primary attempt outlasts
+        ``hedge_s``, fire the same shards at their next live replicas
+        and take whichever side answers first (the loser's response is
+        discarded and its short-lived connection dropped).  Returns a
+        LIST of per-node results-lists — a hedge win may span several
+        replicas when the group's shards fail over to different
+        owners."""
+        node = snap.node(node_id)
+        client = self.node._client()
+        # NO client-level retry on the read fan-out — not even the
+        # refused-connect retry: replica failover + hedging ARE this
+        # path's retry mechanism, and same-node backoff would only
+        # delay the DOWN mark that reroutes traffic (and lose the
+        # hedge race, deferring the mark past the query's return)
+        client.retries = 0
+
+        def attempt(n, shards_):
+            t0 = time.perf_counter()
+            try:
+                out = client.query_node(n.uri, index, pql, shards_,
+                                        deadline=deadline)
+                flight.note_attempt(n.id, time.perf_counter() - t0,
+                                    "ok")
+                return out
+            except Exception:
+                flight.note_attempt(n.id, time.perf_counter() - t0,
+                                    "error")
+                raise
+
+        plain = hedge_s is None
+        alts: dict[str, list[int]] | None = {}
+        if not plain:
+            # hedge plan: next live replica per shard, primary
+            # excluded — and so are this query's already-failed nodes
+            # (``avoid``): a hedge aimed at the node that just timed
+            # out would stall on it again instead of rescuing.  Hedge
+            # ONLY when alternates cover the whole group — a
+            # half-covered hedge could win with a silently partial
+            # answer.
+            for s in node_shards:
+                owner = next(
+                    (n for n in snap.shard_nodes(index, s)
+                     if n.id != node_id and n.id not in avoid
+                     and n.state == NodeState.STARTED), None)
+                if owner is None:
+                    alts = None
+                    break
+                alts.setdefault(owner.id, []).append(s)
+        if plain or not alts:
+            return [attempt(node, node_shards)["results"]]
+
+        # the flight accumulator is thread-local: capture it so the
+        # primary/hedge worker threads' attempt notes land in the
+        # query's own record
+        acc = flight.active_acc()
+
+        cv = threading.Condition()
+        res: dict[str, tuple] = {}
+        hedge_won = threading.Event()
+        marked_down = threading.Lock()
+
+        def put(tag, val, err):
+            with cv:
+                res[tag] = (val, err)
+                cv.notify_all()
+
+        def mark_primary_down():
+            # once per RPC: the main thread's hedge-won branch and
+            # run_primary's late-failure branch can BOTH observe the
+            # dead primary — one failover event, not two (the
+            # non-blocking acquire is the atomic first-caller-wins)
+            if not marked_down.acquire(blocking=False):
+                return
+            self.node.disco.set_state(node.id, NodeState.DOWN)
+            metrics.CLUSTER_EVENTS.inc(event="failover")
+
+        def run_primary():
+            prev = flight.push_acc(acc)
+            try:
+                put("p", [attempt(node, node_shards)["results"]], None)
+            except Exception as e:
+                put("p", None, e)
+                if hedge_won.is_set() and isinstance(e,
+                                                     ConnectionError):
+                    # the hedge already answered the caller, so nobody
+                    # will raise this error into the failover path —
+                    # mark the DEFINITIVELY dead primary DOWN here or
+                    # the next query would re-discover it the slow way
+                    mark_primary_down()
+            finally:
+                flight.pop_acc(prev)
+
+        def run_hedge():
+            prev = flight.push_acc(acc)
+            try:
+                outs = []
+                for aid, ashards in sorted(alts.items()):
+                    if aid == self.node.node_id:
+                        t0 = time.perf_counter()
+                        outs.append(self.node.api.query(
+                            index, pql, shards=ashards)["results"])
+                        flight.note_attempt(
+                            aid, time.perf_counter() - t0,
+                            "hedge_ok-local")
+                    else:
+                        outs.append(
+                            attempt(snap.node(aid),
+                                    ashards)["results"])
+                put("h", outs, None)
+            except Exception as e:
+                put("h", None, e)
+            finally:
+                flight.pop_acc(prev)
+
+        threading.Thread(target=run_primary, daemon=True).start()
+        with cv:
+            cv.wait_for(lambda: "p" in res, timeout=hedge_s)
+            primary_done = "p" in res
+        if primary_done:
+            val, err = res["p"]
+            if err is None:
+                return val
+            raise err  # normal failover path handles it
+        metrics.CLUSTER_EVENTS.inc(event="hedge_fired")
+        threading.Thread(target=run_hedge, daemon=True).start()
+        # first success wins; both-failed raises the PRIMARY error so
+        # the caller's failover marks the right node DOWN
+        limit = client.timeout + hedge_s + 1.0
+        if deadline is not None:
+            limit = min(limit, max(deadline.remaining(), 0.0) + 0.5)
+        end = time.monotonic() + limit
+        with cv:
+            while True:
+                if "p" in res and res["p"][1] is None:
+                    winner = "p"
+                    break
+                if "h" in res and res["h"][1] is None:
+                    winner = "h"
+                    break
+                if "p" in res and "h" in res:
+                    raise res["p"][1]
+                rem = end - time.monotonic()
+                if rem <= 0:
+                    raise TimeoutError(
+                        f"hedged fan-out to {node_id} timed out")
+                cv.wait(rem)
+        if winner == "h":
+            metrics.CLUSTER_EVENTS.inc(event="hedge_won")
+            hedge_won.set()
+            if "p" in res and isinstance(res["p"][1], ConnectionError):
+                # the primary DEFINITIVELY failed (not just slow):
+                # mark it DOWN so the next snapshot routes around it
+                mark_primary_down()
+        return res[winner][0]
 
 
 # ----------------------------------------------------------------------
@@ -565,6 +1108,21 @@ def extract_of_sort_wire(call, run):
     by_col = {c.get("column"): c for c in table.get("columns", [])}
     table["columns"] = [by_col[c] for c in cols if c in by_col]
     return table
+
+
+def _empty_result(call):
+    """Zero-value for a call over zero shards — matches what a node
+    returns for an empty index (single-node semantics)."""
+    name = call.name
+    if name == "Count":
+        return 0
+    if name in ("Sum", "Min", "Max"):
+        return {"value": None if name != "Sum" else 0, "count": 0}
+    if name in ("TopN", "TopK", "Rows", "GroupBy"):
+        return []
+    if name == "Distinct":
+        return {"values": []}
+    return {"columns": []}
 
 
 def _reduce(call, vals: list):

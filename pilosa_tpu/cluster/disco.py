@@ -17,6 +17,8 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from pilosa_tpu.obs import metrics
+
 
 class NodeState:
     UNKNOWN = "UNKNOWN"
@@ -60,7 +62,10 @@ class DisCo:
     def nodes(self) -> list[Node]:
         raise NotImplementedError
 
-    def heartbeat(self, node_id: str):
+    def heartbeat(self, node_id: str) -> bool:
+        """Refresh the node's lease.  Returns True when the beat
+        REVIVED the node from DOWN — the caller owes a resync for the
+        writes peers skipped while it was marked dead."""
         raise NotImplementedError
 
     def set_state(self, node_id: str, state: str):
@@ -129,31 +134,48 @@ class InMemDisCo(DisCo):
         with self._lock:
             return sorted(self._nodes.values(), key=lambda n: n.id)
 
-    def heartbeat(self, node_id: str):
+    def heartbeat(self, node_id: str) -> bool:
         with self._lock:
             n = self._nodes.get(node_id)
             if n:
                 n.last_heartbeat = time.time()
+                metrics.HEARTBEAT_AGE.set(0.0, node=node_id)
                 if n.state == NodeState.DOWN:
+                    # a beat from a DOWN node is a rejoin (the lease
+                    # revival the etcd backend would observe)
                     n.state = NodeState.STARTED
+                    metrics.CLUSTER_EVENTS.inc(event="node_rejoin")
                     self._elect()
+                    return True
+        return False
 
     def set_state(self, node_id: str, state: str):
         with self._lock:
             n = self._nodes.get(node_id)
             if n:
+                if state != n.state:
+                    if state == NodeState.DOWN:
+                        metrics.CLUSTER_EVENTS.inc(event="node_down")
+                    elif n.state == NodeState.DOWN and \
+                            state == NodeState.STARTED:
+                        metrics.CLUSTER_EVENTS.inc(event="node_rejoin")
                 n.state = state
                 self._elect()
 
     def check_heartbeats(self) -> list[str]:
-        """Mark nodes DOWN whose lease expired; returns their ids."""
+        """Mark nodes DOWN whose lease expired; returns their ids.
+        Also exports each node's heartbeat age — the early-warning
+        gauge a dashboard watches before the lease actually expires."""
         now = time.time()
         downed = []
         with self._lock:
             for n in self._nodes.values():
+                metrics.HEARTBEAT_AGE.set(now - n.last_heartbeat,
+                                          node=n.id)
                 if n.state == NodeState.STARTED and \
                         now - n.last_heartbeat > self.lease_ttl:
                     n.state = NodeState.DOWN
+                    metrics.CLUSTER_EVENTS.inc(event="node_down")
                     downed.append(n.id)
             if downed:
                 self._elect()

@@ -55,14 +55,20 @@ class ClusterSnapshot:
                 return n
         return self.nodes[0] if self.nodes else None
 
-    def shards_by_node(self, index: str, shards) -> dict[str, list[int]]:
+    def shards_by_node(self, index: str, shards,
+                       exclude=frozenset()) -> dict[str, list[int]]:
         """Group shards by PRIMARY owner (executor.go:6416
-        shardsByNode) — the fan-out plan for one query."""
+        shardsByNode) — the fan-out plan for one query.  ``exclude``
+        is a query-local avoidance set (nodes that already failed an
+        attempt THIS query, e.g. by timeout, without being globally
+        DOWN): preferred-away-from, but still used when a shard has
+        no other live owner."""
         out: dict[str, list[int]] = {}
         for s in shards:
             owners = self.shard_nodes(index, s)
             live = [n for n in owners if n.state == NodeState.STARTED]
-            owner = (live or owners)[0]
+            fresh = [n for n in live if n.id not in exclude]
+            owner = (fresh or live or owners)[0]
             out.setdefault(owner.id, []).append(s)
         return out
 

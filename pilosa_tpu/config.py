@@ -67,6 +67,16 @@ class Config:
     memory_prefetch_interval_s: float = 0.5
     memory_oom_retry: bool = True
     memory_host_fallback: bool = True
+    # failure-tolerance plane (obs/faults.py + cluster hedging):
+    # fault-spec arms named fault points at startup
+    # ("point[@match][,times=N][,delay=MS];..." — obs/faults.py);
+    # hedge-ms < 0 disables hedged replica reads, 0 auto-derives the
+    # hedge delay from flight-recorder p99 records, > 0 fixes it;
+    # deadline-s is the default end-to-end cluster query deadline
+    # (0 = none; every RPC attempt/hedge/retry budgets from it).
+    fault_spec: str = ""
+    cluster_hedge_ms: float = 0.0
+    cluster_deadline_s: float = 0.0
     # query flight recorder (obs/flight.py): always-on per-query ring
     # of phase-attributed records feeding /debug/queries and
     # /debug/trace.  recorder=false disables record keeping (the
@@ -100,6 +110,33 @@ class Config:
         from pilosa_tpu.obs import flight
         flight.recorder.configure(enabled=self.flight_recorder,
                                   keep=self.flight_ring)
+
+    def apply_fault_settings(self):
+        """Arm config-specified fault points and publish the cluster
+        hedge/deadline knobs (read dynamically per fan-out by
+        cluster/coordinator.py, so a reconfigure applies live).
+        Test-armed faults (faults.inject) are never touched."""
+        from pilosa_tpu.obs import faults
+        # config.load already folds PILOSA_TPU_FAULT_SPEC into
+        # fault_spec: drop the import-time env arming before re-arming
+        # as config, or every env rule's budget doubles.  A Config
+        # carrying NO spec of its own (directly constructed, not
+        # load()-built) must leave the operator's env arming alone —
+        # clearing it here would silently disarm the chaos drill
+        if self.fault_spec:
+            faults.clear(source="env")
+        faults.configure(self.fault_spec)
+        # publish the knobs only when this Config actually carries a
+        # non-default value (config.load folds the env var in, so a
+        # loaded Config always does) — a directly-built default
+        # Config must not clobber an operator-set env override
+        for env, val, default in (
+                ("PILOSA_TPU_CLUSTER_HEDGE_MS",
+                 self.cluster_hedge_ms, 0.0),
+                ("PILOSA_TPU_CLUSTER_DEADLINE_S",
+                 self.cluster_deadline_s, 0.0)):
+            if val != default or env not in os.environ:
+                os.environ[env] = str(val)
 
     def apply_memory_settings(self):
         """Push the [memory] knobs into the process residency manager
@@ -135,6 +172,9 @@ _TOML_KEYS = {
     "stacked.patch-max-frac": "stack_patch_max_frac",
     "flight.recorder": "flight_recorder",
     "flight.ring": "flight_ring",
+    "faults.spec": "fault_spec",
+    "cluster.hedge-ms": "cluster_hedge_ms",
+    "cluster.deadline-s": "cluster_deadline_s",
     "memory.budget-bytes": "memory_budget_bytes",
     "memory.headroom-frac": "memory_headroom_frac",
     "memory.page-bytes": "memory_page_bytes",

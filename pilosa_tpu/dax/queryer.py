@@ -20,8 +20,11 @@ controller schema changes and routed imports.
 
 from __future__ import annotations
 
+import time
+
 from pilosa_tpu.cluster.client import InternalClient
 from pilosa_tpu.cluster.coordinator import (
+    _empty_result,
     _reduce,
     _sort_call_for_shipping,
     extract_of_sort_wire,
@@ -31,21 +34,6 @@ from pilosa_tpu.executor.executor import Executor
 from pilosa_tpu.executor.results import deserialize_result
 from pilosa_tpu.pql import parse
 from pilosa_tpu.shardwidth import SHARD_WIDTH
-
-
-def _empty_result(call):
-    """Zero-value for a call over zero shards — matches what a node
-    returns for an empty index (single-node semantics)."""
-    name = call.name
-    if name == "Count":
-        return 0
-    if name in ("Sum", "Min", "Max"):
-        return {"value": None if name != "Sum" else 0, "count": 0}
-    if name in ("TopN", "TopK", "Rows", "GroupBy"):
-        return []
-    if name == "Distinct":
-        return {"values": []}
-    return {"columns": []}
 
 
 class _RemoteExecutor(Executor):
@@ -565,15 +553,30 @@ class Queryer:
             addr, uri = self.controller.worker_for(table, s)
             by_worker.setdefault(addr, []).append(s)
             uris[addr] = uri
+        from pilosa_tpu.obs import faults, flight
         from pilosa_tpu.taskpool import Pool
 
         def one(pool, addr):
             with pool.blocked():  # RPC wait
-                return self._client.query_node(uris[addr], table, pql,
-                                               by_worker[addr])
+                faults.fire("dax-rpc", uris[addr])
+                t0 = time.perf_counter()
+                try:
+                    out = self._client.query_node(
+                        uris[addr], table, pql, by_worker[addr],
+                        idempotent=True)
+                    flight.note_attempt(addr,
+                                        time.perf_counter() - t0, "ok")
+                    return out
+                except Exception:
+                    flight.note_attempt(
+                        addr, time.perf_counter() - t0, "error")
+                    raise
 
-        partials = [r["results"] for r in
-                    Pool(size=2).map(one, sorted(by_worker))]
+        # Pool.map settles every sibling RPC before re-raising the
+        # first failure (by worker order), so one worker dying fails
+        # only THIS query — never the pool or mid-flight siblings
+        outs = Pool(size=2).map(one, sorted(by_worker))
+        partials = [r["results"] for r in outs]
         if not partials:
             out = {"results": [_empty_result(c) for c in q.calls]}
         else:

@@ -822,6 +822,11 @@ class ServingLayer:
                        compile=kind == "compile")
         t0 = time.perf_counter()
         try:
+            # chaos seam: an armed "serving-dispatch" fault fails the
+            # fused program exactly like a device-side error, driving
+            # every rider onto the per-caller direct fallback
+            from pilosa_tpu.obs import faults
+            faults.fire("serving-dispatch")
             fn = _compiled(plan, kern=kern, sig=sig)
             # OOM backstop: RESOURCE_EXHAUSTED on the fused program
             # evicts via the ledger + retries once; a persistent OOM

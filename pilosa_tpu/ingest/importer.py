@@ -109,7 +109,7 @@ class HTTPImporter(Importer):
         np.savez(buf, **arrays)
         # ride the shared client so auth headers and RemoteError
         # handling match every other importer method
-        r = self.client._request_raw(
-            self.host, "POST", f"/index/{index}/import-columns",
-            buf.getvalue(), "application/octet-stream")
-        return r["imported"]
+        import json
+        raw = self.client.post_raw(
+            self.host, f"/index/{index}/import-columns", buf.getvalue())
+        return json.loads(raw)["imported"]

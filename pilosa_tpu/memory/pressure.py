@@ -18,15 +18,17 @@ on the stacked/serving paths with the recovery ladder:
 ``inject_oom(n)`` is the test/CI seam: the next ``n`` guarded
 dispatches raise a synthetic RESOURCE_EXHAUSTED before running, which
 is how check.sh's memory-pressure smoke proves absorption without a
-real 16 GiB working set."""
+real 16 GiB working set.  Since ISSUE 6 the seam is a registered
+fault point (``device-oom`` in obs/faults.py) — this function is the
+backward-compatible wrapper, and the fault can equally be armed via
+the registry's config/env spec alongside the rpc/node faults."""
 
 from __future__ import annotations
 
 import gc
 import os
-import threading
 
-from pilosa_tpu.obs import metrics
+from pilosa_tpu.obs import faults, metrics
 
 # config [memory] / PILOSA_TPU_MEMORY_OOM_RETRY / _HOST_FALLBACK
 OOM_RETRY = os.environ.get("PILOSA_TPU_MEMORY_OOM_RETRY", "1") != "0"
@@ -36,32 +38,25 @@ HOST_FALLBACK = os.environ.get(
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
                 "Ran out of memory")
 
-_inject_lock = threading.Lock()
-_inject_remaining = 0
 _warned_degraded = False
 
 
 class InjectedOOM(RuntimeError):
-    """Synthetic RESOURCE_EXHAUSTED raised by the inject_oom test seam."""
+    """Synthetic RESOURCE_EXHAUSTED raised by the device-oom fault."""
 
 
 def inject_oom(n: int = 1):
     """Make the next ``n`` guarded dispatches fail with a synthetic
-    RESOURCE_EXHAUSTED (test / smoke hook)."""
-    global _inject_remaining
-    with _inject_lock:
-        _inject_remaining = int(n)
+    RESOURCE_EXHAUSTED (test / smoke hook).  Registry-backed: arms
+    the ``device-oom`` fault point, replacing any prior arming (the
+    original seam's set-not-add semantics, which the smokes rely on)."""
+    faults.clear("device-oom")
+    if int(n) > 0:
+        faults.inject("device-oom", times=int(n))
 
 
 def _take_injection() -> bool:
-    global _inject_remaining
-    if _inject_remaining <= 0:
-        return False
-    with _inject_lock:
-        if _inject_remaining <= 0:
-            return False
-        _inject_remaining -= 1
-        return True
+    return faults.take("device-oom")
 
 
 def is_oom(e: BaseException) -> bool:

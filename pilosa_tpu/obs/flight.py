@@ -49,11 +49,15 @@ class Acc:
     (the owning request thread, or the leader while it serves the
     request)."""
 
-    __slots__ = ("phases", "stack", "bytes_moved", "keys")
+    __slots__ = ("phases", "stack", "bytes_moved", "keys", "attempts")
 
     # per-record stack-key cap: a pathological query touching hundreds
     # of stacks must not bloat the ring
     _MAX_KEYS = 32
+    # per-record attempt cap (cluster fan-out: one entry per per-node
+    # RPC attempt incl. hedges — a 100-node fan-out must not bloat
+    # the ring either)
+    _MAX_ATTEMPTS = 32
 
     def __init__(self):
         self.phases: dict[str, float] = {}
@@ -63,6 +67,10 @@ class Acc:
         # prefetcher's prediction signal (memory/policy.py): keys
         # that keep rebuilding are keys worth warming
         self.keys: list[tuple[str, str]] = []
+        # per-node RPC attempt timings from the cluster fan-out
+        # (node, ms, outcome) incl. hedge attempts — what makes hedge
+        # delays debuggable at /debug/queries
+        self.attempts: list[tuple[str, float, str]] = []
 
     def add_phase(self, name: str, dt: float):
         self.phases[name] = self.phases.get(name, 0.0) + dt
@@ -75,6 +83,10 @@ class Acc:
         if key_fp is not None and len(self.keys) < self._MAX_KEYS:
             self.keys.append((key_fp, outcome))
 
+    def add_attempt(self, node: str, dt: float, outcome: str):
+        if len(self.attempts) < self._MAX_ATTEMPTS:
+            self.attempts.append((node, round(dt * 1e3, 3), outcome))
+
     def merge(self, other: "Acc"):
         for k, v in other.phases.items():
             self.phases[k] = self.phases.get(k, 0.0) + v
@@ -84,6 +96,9 @@ class Acc:
         room = self._MAX_KEYS - len(self.keys)
         if room > 0 and other.keys:
             self.keys.extend(other.keys[:room])
+        room = self._MAX_ATTEMPTS - len(self.attempts)
+        if room > 0 and other.attempts:
+            self.attempts.extend(other.attempts[:room])
 
 
 def push_acc(acc: Acc):
@@ -113,6 +128,14 @@ def note_stack(outcome: str, nbytes: int, dt: float,
     acc = getattr(_tls, "acc", None)
     if acc is not None:
         acc.add_stack(outcome, nbytes, dt, key_fp=key_fp)
+
+
+def note_attempt(node: str, dt: float, outcome: str):
+    """Record one cluster per-node RPC attempt (incl. hedges) into
+    the active record's ``attempts`` field."""
+    acc = getattr(_tls, "acc", None)
+    if acc is not None:
+        acc.add_attempt(node, dt, outcome)
 
 
 class FlightRecorder:
@@ -268,6 +291,12 @@ def commit(rec: dict | None, duration_s: float, route: str = "solo",
         # prediction scan (memory/policy.py Prefetcher.step)
         "stack_keys": list(acc.keys),
     })
+    if acc.attempts:
+        # per-node cluster attempt timings (hedges included) — only
+        # fan-out queries carry the field, so solo records stay small
+        rec["attempts"] = [
+            {"node": n, "ms": ms, "outcome": o}
+            for n, ms, o in acc.attempts]
     if error is not None:
         rec["error"] = error[:200]
     if fingerprint is not None:

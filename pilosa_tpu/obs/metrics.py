@@ -404,6 +404,19 @@ SERVING_BATCHED = registry.counter(
     "pilosa_serving_batched_total",
     "Serving-path queries by execution route (fused/direct/cached)")
 
+# -- failure-tolerance plane (obs/faults.py, cluster/) --
+CLUSTER_EVENTS = registry.counter(
+    "pilosa_cluster_events_total",
+    "Cluster failure-plane events "
+    "(node_down/node_rejoin/failover/hedge_fired/hedge_won/"
+    "load_shed/partial)")
+HEARTBEAT_AGE = registry.gauge(
+    "pilosa_cluster_heartbeat_age_seconds",
+    "Seconds since each node's last heartbeat (by node)")
+FAULTS_TOTAL = registry.counter(
+    "pilosa_fault_injections_total",
+    "Armed fault-point activations by point (obs/faults.py)")
+
 # -- flight recorder (obs/flight.py) --
 # One histogram per engine phase (labeled), with exemplar trace ids
 # pointing into /debug/queries: plan_build, compile (jit trace +

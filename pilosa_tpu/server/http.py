@@ -36,6 +36,14 @@ from pilosa_tpu.obs import metrics
 from pilosa_tpu.obs.logger import Logger, NopLogger
 
 
+class _Httpd(ThreadingHTTPServer):
+    # socketserver's default accept backlog is 5: under a client storm
+    # concentrated by a node death, an overloaded-but-ALIVE node
+    # starts refusing connects — which the cluster layer reads as
+    # ANOTHER node dying (refused = definitive death)
+    request_queue_size = 128
+
+
 class Route:
     def __init__(self, method: str, pattern: str, fn,
                  admin_only: bool = False):
@@ -72,6 +80,9 @@ class Server:
                 cache_bytes=config.serving_cache_mb << 20,
                 batching=config.serving_batching)
         config.apply_flight_settings()
+        # failure-tolerance plane: config/env-armed fault points +
+        # hedge/deadline knobs for the cluster fan-out
+        config.apply_fault_settings()
         # HBM residency manager ([memory]): budget ledger + paged
         # stacks + OOM backstop; the prefetcher warms predicted stack
         # pages from flight records off the serving hot path
@@ -86,7 +97,7 @@ class Server:
         self._routes: list[Route] = []
         self._register_routes()
         handler = _make_handler(self)
-        self.httpd = ThreadingHTTPServer((bind, port), handler)
+        self.httpd = _Httpd((bind, port), handler)
         self.port = self.httpd.server_address[1]
         self._thread: threading.Thread | None = None
         self._serving = False
@@ -205,6 +216,9 @@ class Server:
         # Perfetto / chrome://tracing
         r(Route("GET", "/debug/queries", self._get_debug_queries))
         r(Route("GET", "/debug/trace", self._get_debug_trace))
+        # fault-injection registry (obs/faults.py): armed rules with
+        # fire counts — the chaos-operator's view of what is live
+        r(Route("GET", "/debug/faults", self._get_debug_faults))
         r(Route("GET", "/internal/diagnostics", self._get_diagnostics))
         r(Route("GET", "/internal/perf-counters",
                 self._get_perf_counters))
@@ -306,6 +320,11 @@ class Server:
         return RawResponse(flight.recorder.chrome_trace_json(n),
                            "application/json")
 
+    def _get_debug_faults(self, req):
+        """Armed fault-point rules (obs/faults.py registry)."""
+        from pilosa_tpu.obs import faults
+        return {"faults": faults.active()}
+
     def _get_diagnostics(self, req):
         from pilosa_tpu import __version__
         from pilosa_tpu.obs.diagnostics import Diagnostics
@@ -392,6 +411,31 @@ class Server:
                 except ApiError as e:
                     return e.status, {"error": str(e)}
                 except Exception as e:  # keep the connection alive
+                    # typed status-carrying errors (LoadShedError 503,
+                    # DeadlineExceeded 504, RemoteError pass-through)
+                    # keep their semantics on the wire instead of
+                    # collapsing into 500 — clients distinguish
+                    # "shed, retry elsewhere" from "server bug"
+                    status = getattr(e, "status", None)
+                    if isinstance(status, int) and 400 <= status < 600:
+                        ra = getattr(e, "retry_after_s", None)
+                        if ra is not None:
+                            # a shed is retryable by contract — say
+                            # when (one heartbeat), per RFC 9110 §10.2.3
+                            req.extra_headers = {
+                                "Retry-After": str(max(1, round(ra)))}
+                        if status >= 500:
+                            # 5xx pass-throughs (a peer's RemoteError
+                            # 500, a shed) must not go dark in
+                            # monitoring even though the wire keeps
+                            # the typed status
+                            from pilosa_tpu.obs.monitor import (
+                                capture_exception,
+                            )
+                            capture_exception(e, path=path,
+                                              method=method)
+                        return status, {"error": str(e),
+                                        "type": type(e).__name__}
                     from pilosa_tpu.obs.monitor import capture_exception
                     capture_exception(e, path=path, method=method)
                     self.logger.error("http 500 on %s: %s", path, e)
@@ -674,6 +718,7 @@ def _make_handler(server: Server):
             # always drain the body: unread bytes on a keep-alive
             # connection would be parsed as the next request line
             self._raw = self._body()
+            self.extra_headers = {}  # reset across keep-alive requests
             status, result = server.dispatch(method, u.path, self)
             self._send(status, result)
             metrics.HTTP_REQUESTS.inc(
@@ -691,6 +736,8 @@ def _make_handler(server: Server):
             self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in getattr(self, "extra_headers", {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 

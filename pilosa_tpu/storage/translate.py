@@ -149,7 +149,19 @@ class TranslateStore:
             self._compact_locked()
 
     def _append_locked(self, id_: int, key: str):
-        self._log.write(json.dumps({"id": id_, "key": key}) + "\n")
+        line = json.dumps({"id": id_, "key": key}) + "\n"
+        from pilosa_tpu.obs import faults
+        if faults.take("torn-write", self.path or ""):
+            # chaos seam: a crash mid-append leaves a torn final line —
+            # write half the record, then die like the crash would:
+            # close the handle (no further appends may land after the
+            # torn tail, or the tear stops being the LAST line and
+            # restart recovery can no longer absorb it) and raise
+            self._log.write(line[: max(1, len(line) // 2)])
+            self._log.flush()
+            self._log.close()
+            raise faults.InjectedFault("torn-write", self.path or "")
+        self._log.write(line)
         self._tail_records += 1
 
     def _maybe_compact_locked(self):

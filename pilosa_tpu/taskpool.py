@@ -17,6 +17,24 @@ import threading
 from contextlib import contextmanager
 
 
+class TaskFailure:
+    """Typed settled-failure marker: the exception one pooled task
+    raised, with the item that produced it.  ``map_settled`` returns
+    these IN PLACE of results, so one crashing fan-out task fails only
+    its own request — the pool, its counters, and every sibling task
+    settle normally (worker-death containment)."""
+
+    __slots__ = ("item", "error")
+
+    def __init__(self, item, error: BaseException):
+        self.item = item
+        self.error = error
+
+    def __repr__(self):
+        return (f"TaskFailure(item={self.item!r}, "
+                f"error={type(self.error).__name__}: {self.error})")
+
+
 class Pool:
     def __init__(self, size: int = 2, max_size: int = 32):
         self.size = size
@@ -46,19 +64,35 @@ class Pool:
 
     def map(self, fn, items) -> list:
         """Run fn(item) for every item; order-preserving results.
+        The first exception (by item order) propagates after all
+        tasks settle."""
+        outs = self.map_settled(fn, items)
+        for o in outs:
+            if isinstance(o, TaskFailure):
+                raise o.error
+        return outs
+
+    def map_settled(self, fn, items) -> list:
+        """Run fn(item) for every item; order-preserving results with
+        per-item failures CONTAINED: a task whose fn raises settles as
+        a :class:`TaskFailure` (typed, carrying the item and the
+        exception) instead of poisoning the whole map — siblings run
+        to completion and the pool's counters stay balanced, so a
+        shared pool is reusable after any storm of task deaths.
 
         fn receives (pool, item) when it accepts two args, so tasks
-        can use pool.blocked() around their IO.  The first exception
-        (by item order) propagates after all tasks settle.
+        can use pool.blocked() around their IO.
         """
         items = list(items)
         results: list = [None] * len(items)
-        errors: list = [None] * len(items)
         it = iter(enumerate(items))
         it_lock = threading.Lock()
 
         import inspect
-        takes_pool = len(inspect.signature(fn).parameters) >= 2
+        try:
+            takes_pool = len(inspect.signature(fn).parameters) >= 2
+        except (TypeError, ValueError):
+            takes_pool = False  # uninspectable callable (C builtin)
 
         def worker():
             while True:
@@ -72,7 +106,7 @@ class Pool:
                 try:
                     results[i] = fn(self, item) if takes_pool else fn(item)
                 except BaseException as e:
-                    errors[i] = e
+                    results[i] = TaskFailure(item, e)
                 finally:
                     with self._lock:
                         self._active -= 1
@@ -101,9 +135,6 @@ class Pool:
             remaining = any(t.is_alive() for t in threads)
         for t in threads:
             t.join()
-        for e in errors:
-            if e is not None:
-                raise e
         return results
 
     def _spawn_count(self) -> int:

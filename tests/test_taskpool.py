@@ -1,11 +1,13 @@
-"""Adaptive worker pool tests (task/doc.go behavior)."""
+"""Adaptive worker pool tests (task/doc.go behavior) + worker-death
+containment (ISSUE 6): an exception escaping a pooled fan-out task
+fails only that request, typed, and never wedges the pool."""
 
 import threading
 import time
 
 import pytest
 
-from pilosa_tpu.taskpool import Pool
+from pilosa_tpu.taskpool import Pool, TaskFailure
 
 
 def test_pool_map_order_and_results():
@@ -56,3 +58,67 @@ def test_pool_concurrency_speedup():
     t0 = time.time()
     p.map(task, range(8))
     assert time.time() - t0 < 0.05 * 8  # faster than serial
+
+
+def test_map_settled_contains_failures_typed():
+    """One task dying fails ONLY its own slot, as a typed
+    TaskFailure; every sibling still returns its result."""
+    p = Pool(size=2)
+
+    def f(x):
+        if x % 3 == 0:
+            raise RuntimeError(f"dead-{x}")
+        return x * 10
+
+    outs = p.map_settled(f, range(7))
+    assert [o for o in outs if not isinstance(o, TaskFailure)] == \
+        [10, 20, 40, 50]
+    fails = [o for o in outs if isinstance(o, TaskFailure)]
+    assert [tf.item for tf in fails] == [0, 3, 6]
+    assert all(isinstance(tf.error, RuntimeError) for tf in fails)
+    assert "dead-0" in repr(fails[0])
+
+
+def test_pool_never_wedges_after_task_death():
+    """Counter balance under exceptions — including one raised INSIDE
+    a blocked() section — so a long-lived shared pool stays usable
+    after arbitrary task deaths."""
+    p = Pool(size=2, max_size=8)
+
+    def die_blocked(pool, i):
+        with pool.blocked():
+            raise ValueError("died while blocked")
+
+    outs = p.map_settled(die_blocked, range(6))
+    assert all(isinstance(o, TaskFailure) for o in outs)
+    assert p._active == 0 and p._blocked == 0
+    # the pool still works, including adaptive growth
+    barrier = threading.Barrier(2, timeout=5)
+
+    def needs_growth(pool, i):
+        with pool.blocked():
+            barrier.wait()
+        return i
+
+    assert Pool(size=1, max_size=8).map(needs_growth, [0, 1]) == [0, 1]
+    assert p.map(lambda x: x + 1, range(5)) == list(range(1, 6))
+    assert p._active == 0 and p._blocked == 0
+
+
+def test_map_settled_contains_base_exceptions():
+    """Even a BaseException (the KeyboardInterrupt shape) settles as
+    a TaskFailure instead of orphaning sibling tasks mid-flight."""
+    p = Pool(size=2)
+
+    def f(x):
+        if x == 1:
+            raise KeyboardInterrupt()
+        return x
+
+    outs = p.map_settled(f, range(3))
+    assert outs[0] == 0 and outs[2] == 2
+    assert isinstance(outs[1], TaskFailure)
+    assert isinstance(outs[1].error, KeyboardInterrupt)
+    # map() re-raises it faithfully
+    with pytest.raises(KeyboardInterrupt):
+        p.map(f, range(3))
