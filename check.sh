@@ -50,6 +50,21 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 
+echo "== write-storm smoke =="
+# streaming write plane gate (bench.py --write-smoke): a short
+# sustained-write burst through the coalescing window plane with one
+# injected kill-mid-window (wal-torn) + restart + replay ->
+# CORRECTNESS GATES ONLY: zero acked-record loss (bit-exact vs a
+# cold rebuild AND vs a fresh reopen from disk), the kill struck a
+# plane with acked state behind it, unacked batches replayed, the
+# restarted plane landed windows, zero read failures.  Latency
+# ratios are reported, never gated (small-box scheduler noise).
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python bench.py --write-smoke; then
+    echo "check.sh: write-storm smoke failed" >&2
+    exit 1
+fi
+
 echo "== tier-1 (budget ${BUDGET}s) =="
 # per-run log (concurrent gates must not clobber each other);
 # no pipe around pytest: under plain sh a `... | tee` pipeline would

@@ -30,6 +30,7 @@ from pilosa_tpu.executor.results import (
     ValCount,
 )
 from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.models.index import EXISTENCE_FIELD
 from pilosa_tpu.models.schema import FieldOptions
 from pilosa_tpu.obs import metrics
 from pilosa_tpu.obs.tracing import RecordingTracer, Tracer, start_span
@@ -259,6 +260,39 @@ class API:
     # imports (api.go:618 Import, api.go:1438 ImportValue)
     # ------------------------------------------------------------------
 
+    # distinct-shard cap past which an import's sweep falls back to
+    # field granularity: _slices_stale is O(fields x views x shards)
+    _SWEEP_SHARDS_MAX = 256
+
+    def sweep_import(self, index: str, fields, cols=None,
+                     shards: set | None = None,
+                     mark_exists: bool = False) -> None:
+        """Narrowed import-time result-cache sweep: evict exactly the
+        serving-cache entries whose read set intersects the (field,
+        shard) slices a bulk import dirtied — the import-path twin of
+        the PR 3 point-write ``_write_targets`` narrowing (entries
+        over other shards of the same fields keep serving).  No-op
+        without an attached serving cache; lazy get-time validation
+        still backstops every write path.  ``mark_exists`` folds
+        the existence field into the swept set — every import that
+        marked columns dirtied it too."""
+        serving = getattr(self.executor, "serving", None)
+        if serving is None or serving.cache is None:
+            return
+        idx = self.holder.index(index)
+        if idx is None:
+            return
+        fields = set(fields)
+        if mark_exists:
+            fields.add(EXISTENCE_FIELD)
+        if shards is None and cols is not None and len(cols):
+            u = np.unique(np.asarray(cols, dtype=np.int64)
+                          // idx.width)
+            if u.size <= self._SWEEP_SHARDS_MAX:
+                shards = {int(s) for s in u}
+        serving.cache.sweep(self.holder, fields, shards)
+        metrics.RESULT_CACHE.inc(outcome="write")
+
     def import_bits(self, index: str, field: str, rows=None, cols=None,
                     row_keys=None, col_keys=None, timestamps=None,
                     clear: bool = False,
@@ -278,12 +312,14 @@ class API:
                 n = 0
                 for r, c in zip(rows, cols):
                     n += bool(f.clear_bit(int(r), int(c)))
-                return n
-            f.import_bits(rows, cols, timestamps)
-            if mark_exists:
-                idx.mark_columns_exist(cols)
-        n = len(cols)
-        metrics.IMPORTED_BITS.inc(n, index=index)
+            else:
+                f.import_bits(rows, cols, timestamps)
+                if mark_exists:
+                    idx.mark_columns_exist(cols)
+                n = len(cols)
+                metrics.IMPORTED_BITS.inc(n, index=index)
+        self.sweep_import(index, {field}, cols,
+                          mark_exists=mark_exists and not clear)
         return n
 
     def import_roaring(self, index: str, field: str, shard: int,
@@ -329,6 +365,8 @@ class API:
             if not clear and touched:
                 idx.mark_columns_exist(touched)
         metrics.IMPORTED_BITS.inc(n, index=index)
+        self.sweep_import(index, {field}, shards={int(shard)},
+                          mark_exists=True)
         return n
 
     def export_roaring(self, index: str, field: str, shard: int,
@@ -372,12 +410,14 @@ class API:
                 n = 0
                 for c in cols:
                     n += bool(f.clear_value(int(c)))
-                return n
-            f.import_values(cols, values)
-            if mark_exists:
-                idx.mark_columns_exist(cols)
-        n = len(cols)
-        metrics.IMPORTED_BITS.inc(n, index=index)
+            else:
+                f.import_values(cols, values)
+                if mark_exists:
+                    idx.mark_columns_exist(cols)
+                n = len(cols)
+                metrics.IMPORTED_BITS.inc(n, index=index)
+        self.sweep_import(index, {field}, cols,
+                          mark_exists=mark_exists and not clear)
         return n
 
     def mark_columns_exist(self, index: str, cols) -> None:
@@ -386,6 +426,7 @@ class API:
         fields don't re-mark the same ids N times (the ingest
         hotspot measured r04)."""
         self._index(index).mark_columns_exist(cols)
+        self.sweep_import(index, set(), cols, mark_exists=True)
 
     def clear_field_columns(self, index: str, field: str, cols,
                             mark_exists: bool = True) -> int:
@@ -414,6 +455,8 @@ class API:
                         frag.clear_columns(mask)
             if mark_exists:
                 idx.mark_columns_exist(cols)
+        self.sweep_import(index, {field}, cols,
+                          mark_exists=mark_exists)
         return len(cols)
 
     def import_columns(self, index: str, cols, bits: dict | None = None,
@@ -454,6 +497,9 @@ class API:
             idx.mark_columns_exist(cols)
         n = len(cols) * len(jobs)
         metrics.IMPORTED_BITS.inc(n, index=index)
+        self.sweep_import(index,
+                          set(bits or {}) | set(values or {}),
+                          cols, mark_exists=True)
         return n
 
     def _translate_rows(self, f, rows, row_keys):

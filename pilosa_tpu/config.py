@@ -67,6 +67,18 @@ class Config:
     memory_prefetch_interval_s: float = 0.5
     memory_oom_retry: bool = True
     memory_host_fallback: bool = True
+    # streaming write plane (ingest/stream.py): concurrent mutations
+    # coalesce per (field, shard) into one bulk apply + ONE durable
+    # WAL-synced storage write per admission window; a submit acks
+    # only after the window landed.  queue / tenant-queue bound the
+    # admission backlog (shed = typed 503 + Retry-After); sync=false
+    # turns off the per-window durability barrier (ack = applied).
+    ingest_stream: bool = True
+    ingest_window_ms: float = 2.0
+    ingest_max_batch: int = 4096
+    ingest_queue: int = 8192
+    ingest_tenant_queue: int = 4096
+    ingest_sync: bool = True
     # failure-tolerance plane (obs/faults.py + cluster hedging):
     # fault-spec arms named fault points at startup
     # ("point[@match][,times=N][,delay=MS];..." — obs/faults.py);
@@ -172,6 +184,12 @@ _TOML_KEYS = {
     "stacked.patch-max-frac": "stack_patch_max_frac",
     "flight.recorder": "flight_recorder",
     "flight.ring": "flight_ring",
+    "ingest.stream": "ingest_stream",
+    "ingest.window-ms": "ingest_window_ms",
+    "ingest.max-batch": "ingest_max_batch",
+    "ingest.queue": "ingest_queue",
+    "ingest.tenant-queue": "ingest_tenant_queue",
+    "ingest.sync": "ingest_sync",
     "faults.spec": "fault_spec",
     "cluster.hedge-ms": "cluster_hedge_ms",
     "cluster.deadline-s": "cluster_deadline_s",

@@ -217,14 +217,27 @@ def query_fields(idx, q: Query) -> frozenset:
     return frozenset(out)
 
 
-def field_snapshot(idx, fields: frozenset) -> tuple:
+def _shard_set(shards) -> frozenset | None:
+    """An explicit-shards query arg as the snapshot restriction; None
+    (all shards) stays None."""
+    return None if shards is None else frozenset(
+        int(s) for s in shards)
+
+
+def field_snapshot(idx, fields: frozenset, shards=None) -> tuple:
     """Version snapshot of every fragment the fields currently hold:
     ((fname, vname, shard, frag.gen, version), ...).  A write bumps a
     version; a new fragment/view/field changes the tuple's shape; a
     deleted-and-recreated field gets fresh generation stamps (a
     process-global monotonic counter — id() would be unsound, CPython
     reuses freed addresses) — all compare unequal, so comparison-to-
-    snapshot is the staleness test."""
+    snapshot is the staleness test.
+
+    ``shards`` (a set) restricts the walk to those shards' fragments:
+    a query executed over an explicit shard subset reads nothing
+    outside it, so its cache entry must survive writes to OTHER
+    shards of the same fields — the (field, shard)-granular
+    invalidation bulk imports rely on."""
     snap = []
     for fname in sorted(fields):
         f = idx.fields.get(fname)
@@ -239,6 +252,8 @@ def field_snapshot(idx, fields: frozenset) -> tuple:
             if v is None:
                 continue
             for shard in sorted(v.fragments):
+                if shards is not None and shard not in shards:
+                    continue
                 fr = v.fragments.get(shard)
                 if fr is None:
                     continue
@@ -335,8 +350,10 @@ class ResultCache:
                 self.misses += 1
             return _MISS
         fields, snap, results, _nb = ent
-        # snapshot outside the lock: touches only holder structures
-        if (field_snapshot(idx, fields)
+        # snapshot outside the lock: touches only holder structures;
+        # narrowed to the entry's explicit shard subset (key[2]) so a
+        # write to another shard cannot stale it
+        if (field_snapshot(idx, fields, _shard_set(key[2]))
                 if cur_snap is None else cur_snap) != snap:
             dropped = 0
             with self._lock:
@@ -396,17 +413,22 @@ class ResultCache:
         for key, ent in items:
             if touched is not None and not (ent[0] & touched):
                 continue
-            if (shards is not None and key[2] is not None
-                    and not (set(key[2]) & shards)):
-                continue  # explicit-shard query outside the write
+            eshards = shards
+            if shards is not None and key[2] is not None:
+                # an explicit-shard entry can only be staled by the
+                # written shards it actually reads
+                eshards = shards & set(key[2])
+                if not eshards:
+                    continue  # entirely outside the write
             idx = holder.index(key[0])
             if idx is None:
                 stale = True
-            elif shards is not None and touched is not None:
+            elif eshards is not None and touched is not None:
                 stale = _slices_stale(idx, ent[0], ent[1], touched,
-                                      shards)
+                                      eshards)
             else:
-                stale = field_snapshot(idx, ent[0]) != ent[1]
+                stale = field_snapshot(idx, ent[0],
+                                       _shard_set(key[2])) != ent[1]
             if stale:
                 dropped = 0
                 with self._lock:
@@ -641,8 +663,11 @@ class ServingLayer:
             # ONE snapshot walk serves the cache guard, batch
             # admission, and the miss-path store protocol (the walk is
             # O(fields x views x shards) Python — at 954 shards it
-            # must not run three times per query)
-            snap = (field_snapshot(idx, fields)
+            # must not run three times per query); explicit-shard
+            # queries snapshot only their subset, so writes elsewhere
+            # never stale them
+            sset = _shard_set(shards)
+            snap = (field_snapshot(idx, fields, sset)
                     if fields is not None else None)
             cache_res = _MISS
             if self.cache is not None and fields is not None:
@@ -732,7 +757,7 @@ class ServingLayer:
             return None
         skey = tuple(self.executor._shard_list(idx, shards))
         if snapshot is None and fields is not None:
-            snapshot = field_snapshot(idx, fields)
+            snapshot = field_snapshot(idx, fields, _shard_set(shards))
         return _Req(index, idx, q, call, kind, shards, skey, fields,
                     key, snapshot)
 
@@ -757,7 +782,9 @@ class ServingLayer:
         for r in batch:
             if (not r.direct and r.error is None and r.result is not None
                     and r.fields is not None
-                    and field_snapshot(r.idx, r.fields) != r.snapshot):
+                    and field_snapshot(r.idx, r.fields,
+                                       _shard_set(r.shards))
+                    != r.snapshot):
                 # a write landed while the batch was in flight: the
                 # fused result may span versions — re-execute solo
                 r.direct = True
@@ -965,12 +992,13 @@ class ServingLayer:
         ex = self.executor
         if self.cache is None or fields is None:
             return ex.execute(index, q, shards)
+        sset = _shard_set(shards)
         if snap is None:
-            snap = field_snapshot(idx, fields)
+            snap = field_snapshot(idx, fields, sset)
         results = ex.execute(index, q, shards)
         # store only if no write raced the execution (a racing write
         # would make the cached value's snapshot provenance unclear)
-        if field_snapshot(idx, fields) == snap:
+        if field_snapshot(idx, fields, sset) == snap:
             self.cache.put(key, fields, snap, results)
         return results
 

@@ -1768,6 +1768,12 @@ class StackedEngine:
         deltas = _make_delta_fn(frags, lanes, new_versions)
 
         def patcher(arr, old_versions):
+            # chaos seam: an armed device-patch fault fails the
+            # in-place patch exactly like a device-side error would —
+            # the caller (_serve_whole) catches and falls back to a
+            # full rebuild, so the entry can never be half-patched
+            from pilosa_tpu.obs import faults
+            faults.fire("device-patch")
             dirty = deltas(old_versions)
             if dirty is None:
                 return None  # structural change: rebuild
@@ -1869,6 +1875,11 @@ class StackedEngine:
                 # rows, so a stamp OLDER than the content only costs
                 # an extra idempotent patch — never staleness)
                 def deltas_fn(old_versions):
+                    # same device-patch chaos seam as the whole-entry
+                    # patcher: _deltas_or_none catches and the paged
+                    # path rebuilds the dirty pages from live rows
+                    from pilosa_tpu.obs import faults
+                    faults.fire("device-patch")
                     return _make_delta_fn(
                         frags, lanes, versions_fn())(old_versions)
             recipe = StackRecipe(

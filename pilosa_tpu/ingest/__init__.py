@@ -11,6 +11,9 @@ Reference shapes re-built for the TPU engine:
   batch → import loop with per-worker clones and offset commits.
 - Sources (idk/csv, idk/datagen, idk/kafka): CSV files with typed
   headers, a seeded data generator, and a gated Kafka stub.
+- ``StreamWriter`` / ``StreamImporter`` (ingest/stream.py): the
+  crash-consistent streaming write plane — coalesced ingest windows,
+  durable acks, bounded-backlog backpressure.
 """
 
 from pilosa_tpu.ingest.batch import Batch, Record
@@ -21,6 +24,12 @@ from pilosa_tpu.ingest.sources import (
     DatagenSource,
     KafkaSource,
     Source,
+)
+from pilosa_tpu.ingest.stream import (
+    MutationError,
+    StreamImporter,
+    StreamWriter,
+    WriteBacklogError,
 )
 
 __all__ = [
@@ -33,4 +42,8 @@ __all__ = [
     "CSVSource",
     "DatagenSource",
     "KafkaSource",
+    "StreamWriter",
+    "StreamImporter",
+    "WriteBacklogError",
+    "MutationError",
 ]

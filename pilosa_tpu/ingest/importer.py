@@ -34,6 +34,13 @@ class Importer:
     def apply_schema(self, schema: dict):
         raise NotImplementedError
 
+    def sync(self, index: str) -> None:
+        """Durability barrier: when this returns, every record the
+        importer already accepted for `index` must survive a crash.
+        The Pipeline calls it BEFORE committing source offsets
+        (idk/ingest.go:1062 commit-after-land); default no-op for
+        importers without a durability story of their own."""
+
 
 class APIImporter(Importer):
     """In-process importer over the API facade."""
@@ -62,6 +69,14 @@ class APIImporter(Importer):
 
     def apply_schema(self, schema):
         self.api.apply_schema(schema)
+
+    def sync(self, index):
+        """Persist the index's dirty fragments (one RBF write tx per
+        shard + WAL fsync) so an offset commit after this call can
+        never acknowledge records a crash would lose."""
+        idx = self.api.holder.index(index)
+        if idx is not None:
+            idx.sync()
 
 
 class HTTPImporter(Importer):

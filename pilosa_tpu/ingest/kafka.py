@@ -38,6 +38,12 @@ class Broker:
         self.n_partitions = n_partitions
         self._topics: dict[str, list[list[bytes]]] = {}
         self._group_offsets: dict[tuple[str, str], dict[int, int]] = {}
+        # per-(group, topic) high-watermark of offsets ever DELIVERED
+        # to a consumer — the broker outlives a crashed consumer, so
+        # re-delivery below this mark is an observable replay (the
+        # pilosa_ingest_replayed_total signal a recovering ingester
+        # emits)
+        self._delivered: dict[tuple[str, str], dict[int, int]] = {}
         self._lock = threading.Lock()
 
     def create_topic(self, topic: str, n_partitions: int | None = None):
@@ -99,6 +105,17 @@ class Broker:
             self._group_offsets.setdefault((group, topic), {}).update(
                 {p: int(o) for p, o in offsets.items()})
 
+    def delivered_mark(self, group: str, topic: str, partition: int,
+                       offset: int) -> bool:
+        """Record that `offset` was delivered to `group`; True when it
+        had already been delivered before (a crash-recovery replay)."""
+        with self._lock:
+            d = self._delivered.setdefault((group, topic), {})
+            prev = d.get(partition, -1)
+            if offset > prev:
+                d[partition] = offset
+            return offset <= prev
+
     def head(self, topic: str, partition: int) -> int:
         """Next offset to be produced (the high watermark) — O(1)."""
         with self._lock:
@@ -127,6 +144,9 @@ class StreamSource(Source):
         self.poll_batch = poll_batch
         self._pending: list[tuple[int, int]] = []  # (partition, offset+1)
         self._yielded = 0
+        # records re-delivered because a previous consumer crashed
+        # before committing their offsets (broker-side watermark)
+        self.replayed = 0
 
     def _detect(self, obj: dict):
         """Schema detection from message values (idk schema detect)."""
@@ -156,7 +176,13 @@ class StreamSource(Source):
             for p in sorted(cursors):
                 got = self.broker.fetch(self.topic, p, cursors[p],
                                         self.poll_batch)
+                mark = getattr(self.broker, "delivered_mark", None)
                 for off, raw in got:
+                    if mark is not None and mark(self.group, self.topic,
+                                                 p, off):
+                        self.replayed += 1
+                        from pilosa_tpu.obs import metrics
+                        metrics.INGEST_REPLAYED.inc(topic=self.topic)
                     obj = json.loads(raw.decode())
                     if isinstance(obj.get("_id"), str):
                         self.id_keys = True
@@ -188,6 +214,12 @@ class StreamSource(Source):
         """
         if not self._pending or n <= 0:
             return
+        # chaos seam: die after the batch durably landed but BEFORE
+        # the offsets commit — the crash window exactly-once replay
+        # must absorb (the records re-deliver; applying them again is
+        # idempotent, so the replay is exactly-once observable)
+        from pilosa_tpu.obs import faults
+        faults.fire("crash-pre-commit", f"{self.topic}@{self.group}")
         done, self._pending = self._pending[:n], self._pending[n:]
         offsets: dict[int, int] = {}
         for p, upto in done:

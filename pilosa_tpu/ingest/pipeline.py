@@ -146,10 +146,17 @@ class Pipeline:
                 if block is not None:
                     self.allocator.commit(akey, session, block_i)
                     block, session = None, None
+                # durability barrier BEFORE the offset commit: an
+                # acknowledged record must survive a crash
+                # (idk/ingest.go:1062 commit-after-land).  A
+                # StreamImporter's flush already landed durably (acks
+                # imply sync) and its sync() is a no-op.
+                self.importer.sync(self.index)
                 self.source.commit(pending)
                 pending = 0
         b.flush()
         if block is not None:
             self.allocator.commit(akey, session, block_i)
+        self.importer.sync(self.index)
         self.source.commit(pending)
         return n

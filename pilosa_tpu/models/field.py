@@ -214,8 +214,24 @@ class Field:
         frag = v.fragment(shard) if v else None
         return frag.clear_value(shard_col, self.bit_depth) if frag else False
 
-    def import_values(self, cols, values):
-        """Bulk BSI import grouped by shard."""
+    def import_values(self, cols, values, clear: bool = False):
+        """Bulk BSI import grouped by shard.  ``clear`` drops every
+        stored value at the given columns (all 2+depth planes), the
+        bulk analog of clear_value — values are ignored."""
+        if clear:
+            cols = np.asarray(cols, dtype=np.int64)
+            v = self.view(self.bsi_view)
+            if v is None or cols.size == 0:
+                return
+            shards = cols // self.width
+            for shard in np.unique(shards).tolist():
+                frag = v.fragment(int(shard))
+                if frag is None:
+                    continue
+                sel = cols[shards == shard] % self.width
+                frag.import_values(sel, np.zeros(sel.size, np.int64),
+                                   self.bit_depth, clear=True)
+            return
         cols = np.asarray(cols, dtype=np.int64)
         va = np.asarray(values)
         if self.options.type == FieldType.INT and \
@@ -265,10 +281,24 @@ class Field:
             frag.import_values(cols_s[lo:hi] % self.width,
                                ivs_s[lo:hi], self.bit_depth)
 
-    def import_bits(self, rows, cols, timestamps=None):
-        """Bulk set-bit import grouped by shard (+ time views)."""
+    def import_bits(self, rows, cols, timestamps=None,
+                    clear: bool = False):
+        """Bulk set-bit import grouped by shard (+ time views).
+        ``clear`` clears the (row, col) pairs across EVERY view (the
+        bulk analog of clear_bit's all-view semantics)."""
         rows = np.asarray(rows, dtype=np.int64)
         cols = np.asarray(cols, dtype=np.int64)
+        if clear:
+            shards = cols // self.width
+            for shard in np.unique(shards).tolist():
+                sel = shards == shard
+                for v in self.views.values():
+                    frag = v.fragment(int(shard))
+                    if frag is not None:
+                        frag.import_bits(rows[sel],
+                                         cols[sel] % self.width,
+                                         clear=True)
+            return
         shards = cols // self.width
         is_mutexish = self.options.type in (FieldType.MUTEX, FieldType.BOOL)
         # one adaptive sort by shard (O(n) for the common

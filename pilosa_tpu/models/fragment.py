@@ -27,6 +27,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from pilosa_tpu.models.cache import make_cache
+from pilosa_tpu.obs import faults
 from pilosa_tpu.ops import bitmap as bm
 from pilosa_tpu.shardwidth import (
     BSI_OFFSET_BIT,
@@ -179,6 +180,15 @@ class Fragment:
             lo, hi = 0, self.width // 32
         log = self._delta_log
         log.append((self.version, row, lo, hi))
+        # chaos seam (write plane): die right AFTER the delta-log
+        # entry landed — the crash window between the in-memory
+        # append and any downstream durability (WAL sync, offset
+        # commit).  One dict lookup when nothing is armed — the
+        # detail f-string only builds behind the armed() guard.
+        if faults.armed("crash-post-append"):
+            faults.fire("crash-post-append",
+                        f"{self.index_name}/{self.field_name}/"
+                        f"{self.view_name}/{self.shard}")
         while len(log) > DELTA_LOG_MAX:
             # floor rises BEFORE the pop: a concurrent deltas_since
             # that misses the popped entry re-checks the floor after

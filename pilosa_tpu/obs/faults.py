@@ -29,6 +29,28 @@ Fault points wired through the stack (the point name is the contract;
 ``serving-dispatch``      serving fused dispatch: fail the multi-program so
                           every rider takes the per-caller direct fallback
 ``dax-rpc``               DAX queryer worker fan-out (detail: worker uri)
+``crash-post-append``     Fragment delta log: die right after the in-memory
+                          delta entry landed (detail:
+                          ``index/field/view/shard``) — the window between
+                          append and any durability
+``wal-torn``              IndexStorage WAL sync: commit, then truncate the
+                          shard WAL mid-frame and drop the handle — a crash
+                          while the commit's frames were partially on disk
+                          (detail: shard file path); native recovery drops
+                          the torn transaction on reopen
+``crash-pre-checkpoint``  IndexStorage WAL sync: die after the WAL fsync but
+                          before the checkpoint (same detail) — durable yet
+                          unacked, so replay must be idempotent
+``device-patch``          TileStackCache patcher (whole-entry + paged delta
+                          fn): fail the in-place device patch; the cache
+                          falls back to a rebuild from live rows
+``crash-pre-commit``      StreamSource.commit: die after the batch landed but
+                          before the consumer offsets commit (detail:
+                          ``topic@group``) — the exactly-once replay window
+``ingest-window-stall``   StreamWriter window loop: delay rules stall the
+                          admission window (backpressure drills); error
+                          rules crash the whole window pre-apply (detail:
+                          comma-joined index names)
 ========================  ====================================================
 
 Arming:
@@ -158,6 +180,14 @@ def _consume(point: str, detail: str) -> _Rule | None:
                         del _rules[point]
             return r
     return None
+
+
+def armed(point: str) -> bool:
+    """Lock-free check whether ANY rule is armed at a point (the
+    GIL-atomic dict lookup `_consume` fast-paths on).  For hot-path
+    seams whose fire() detail string is itself costly to build —
+    guard the construction, then fire normally."""
+    return point in _rules
 
 
 def take(point: str, detail: str = "") -> bool:

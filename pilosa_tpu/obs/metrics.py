@@ -404,6 +404,37 @@ SERVING_BATCHED = registry.counter(
     "pilosa_serving_batched_total",
     "Serving-path queries by execution route (fused/direct/cached)")
 
+# -- streaming write plane (ingest/stream.py + ingest/kafka.py) --
+INGEST_WINDOWS = registry.counter(
+    "pilosa_ingest_windows_total",
+    "Coalesced ingest windows by outcome (landed/failed)")
+INGEST_WINDOW_OCCUPANCY = registry.histogram(
+    "pilosa_ingest_window_occupancy",
+    "Concurrent submits coalesced per ingest window",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096),
+    quantiles=(0.5, 0.95, 0.99))
+INGEST_WINDOW_MUTATIONS = registry.histogram(
+    "pilosa_ingest_window_mutations",
+    "Individual mutations (bits/values) coalesced per ingest window",
+    buckets=(1, 8, 64, 512, 4096, 32768, 262144, 2097152),
+    quantiles=(0.5, 0.95, 0.99))
+INGEST_MUTATIONS = registry.counter(
+    "pilosa_ingest_mutations_total",
+    "Mutations durably landed through the streaming write plane")
+INGEST_ACK_LATENCY = registry.histogram(
+    "pilosa_ingest_ack_seconds",
+    "Submit-to-durable-ack latency through the write plane",
+    quantiles=(0.5, 0.95, 0.99))
+INGEST_SHED = registry.counter(
+    "pilosa_ingest_shed_total",
+    "Write submissions shed by backpressure (typed 503) by tenant")
+INGEST_REPLAYED = registry.counter(
+    "pilosa_ingest_replayed_total",
+    "Records re-delivered after a crash (offsets uncommitted) by topic")
+INGEST_QUEUE_DEPTH = registry.gauge(
+    "pilosa_ingest_queue_depth",
+    "Mutations waiting for window admission right now")
+
 # -- failure-tolerance plane (obs/faults.py, cluster/) --
 CLUSTER_EVENTS = registry.counter(
     "pilosa_cluster_events_total",

@@ -27,6 +27,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -91,6 +92,19 @@ class Server:
                 and config.memory_prefetch):
             self.api.executor.serving.start_prefetcher(
                 interval_s=config.memory_prefetch_interval_s)
+        # streaming write plane (ingest/stream.py): the batched
+        # /index/{i}/ingest endpoint coalesces concurrent mutations
+        # into durable windows; acks only after the WAL-synced land
+        self.stream = None
+        if config.ingest_stream:
+            from pilosa_tpu.ingest.stream import StreamWriter
+            self.stream = StreamWriter(
+                self.api,
+                window_s=config.ingest_window_ms / 1e3,
+                max_batch=config.ingest_max_batch,
+                queue_max=config.ingest_queue,
+                tenant_queue_max=config.ingest_tenant_queue,
+                sync=config.ingest_sync)
         # (Authenticator, Authorizer | None) — enables the chkAuthZ
         # middleware in dispatch (http_handler.go chkAuthZ)
         self.auth = auth
@@ -150,6 +164,8 @@ class Server:
         testhook.closed("http.Server", self)
         if self.api.executor.serving is not None:
             self.api.executor.serving.stop_prefetcher()
+        if self.stream is not None:
+            self.stream.close()
         self._ticker_stop.set()
         if self._ticker_thread:
             self._ticker_thread.join(timeout=2)
@@ -186,6 +202,7 @@ class Server:
                 self._post_import))
         r(Route("POST", "/index/{index}/import-columns",
                 self._post_import_columns))
+        r(Route("POST", "/index/{index}/ingest", self._post_ingest))
         r(Route("POST", "/internal/translate/{index}/keys/find",
                 self._post_translate_find))
         r(Route("POST", "/internal/translate/{index}/keys/create",
@@ -498,6 +515,67 @@ class Server:
         n = self.api.import_columns(req.vars["index"], cols,
                                     bits=bits, values=values)
         return {"imported": n}
+
+    def _post_ingest(self, req):
+        """Batched streaming ingest (the write-side analog of the
+        serving read batcher): every write in the body is admitted to
+        the coalescing window plane and the request returns only
+        after they all DURABLY landed — a 200 is an ack in the
+        commit-after-land sense.  Backlog over budget → typed 503
+        with Retry-After.  Body::
+
+            {"writes": [
+              {"field": f, "rows": [...], "columns": [...]},
+              {"field": f, "columns": [...], "values": [...]},
+              {"field": f, "rowKeys": [...], "columnKeys": [...]},
+            ]}
+        """
+        if self.stream is None:
+            raise ApiError("streaming ingest disabled "
+                           "([ingest] stream=false)", 400)
+        body = req.json() or {}
+        writes = body.get("writes")
+        if not isinstance(writes, list) or not writes:
+            raise ApiError("body must carry a non-empty 'writes' "
+                           "list", 400)
+        index = req.vars["index"]
+        muts = []
+        try:
+            for w in writes:
+                field = w.get("field")
+                if not field:
+                    raise ApiError("every write needs a field", 400)
+                cols = w.get("columns")
+                if w.get("columnKeys") is not None:
+                    cols = self.api.translate_keys(
+                        index, None, w["columnKeys"], create=True)
+                rows = w.get("rows")
+                if w.get("rowKeys") is not None:
+                    rows = self.api.translate_keys(
+                        index, field, w["rowKeys"], create=True)
+                try:
+                    muts.append(self.stream.submit(
+                        index, field, rows=rows, cols=cols,
+                        values=w.get("values"),
+                        timestamps=w.get("timestamps"),
+                        clear=bool(w.get("clear", False)),
+                        wait=False))
+                except (KeyError, ValueError) as e:
+                    raise ApiError(str(e), 400)
+            self.stream.wait(muts, timeout=60.0)
+        finally:
+            # never leave un-awaited mutations: a shed mid-list must
+            # still wait out the already-admitted ones (they land
+            # regardless; the client retry is idempotent).  ONE
+            # shared deadline across the list — a per-mutation 60 s
+            # against a stalled plane would pin this worker thread
+            # for 60 s x N
+            deadline = time.monotonic() + 60.0
+            for m in muts:
+                m.event.wait(
+                    timeout=max(0.0, deadline - time.monotonic()))
+        return {"landed": sum(m.n for m in muts),
+                "windows": len({m.window_id for m in muts})}
 
     def _post_import_roaring(self, req):
         """Roaring import (route shape of /import-roaring in

@@ -134,11 +134,31 @@ class IndexStorage:
 
     def write_fragments(self, frags) -> None:
         """Persist dirty rows of fragments belonging to ONE shard in a
-        single write transaction."""
+        single write transaction.
+
+        Crash seams (obs/faults.py), compiled into the production
+        sync path exactly where real crashes strike:
+
+        - ``wal-torn``: the process dies while the commit's WAL frames
+          are only partially on disk — enacted by committing, then
+          truncating the shard WAL's tail mid-frame and dropping the
+          handle (the dying process's state).  Native recovery
+          (rbf.cc wal_replay) detects the torn frame on reopen, drops
+          the whole uncommitted transaction, and truncates to the last
+          complete commit — the fragment reloads its pre-sync state
+          instead of garbage, and the stream replay re-syncs it.
+        - ``crash-pre-checkpoint``: the process dies after the WAL
+          fsync but before the checkpoint folds it into the main
+          file — the window IS durable (WAL replay recovers it) even
+          though it never acked; replay must therefore be idempotent.
+
+        Both raise, so ``dirty_rows`` stays set and the failed window
+        never acks."""
         if not frags:
             return
         shard = frags[0].shard
         db = self.db(shard)
+        path = self._shard_path(shard)
         with db.begin(write=True) as tx:
             for frag in frags:
                 assert frag.shard == shard
@@ -158,10 +178,30 @@ class IndexStorage:
                                 words[t * rbf.TILE_WORDS:
                                       (t + 1) * rbf.TILE_WORDS])
                             tx.put(name, row * tpr + t, tile)
+        from pilosa_tpu.obs import faults
+        if faults.take("wal-torn", path):
+            self._tear_wal(shard)
+            raise faults.InjectedFault("wal-torn", path)
+        faults.fire("crash-pre-checkpoint", path)
         for frag in frags:
             frag.dirty_rows.clear()
         if db.wal_size > CHECKPOINT_WAL_BYTES:
             db.checkpoint()  # best-effort; skipped if readers pinned
+
+    def _tear_wal(self, shard: int) -> None:
+        """Enact the wal-torn fault: close the shard's DB handle (the
+        dying process's file-descriptor state) and truncate the WAL
+        mid-frame so the final commit frame can never replay.  4 KiB
+        is half a page — always inside the last frame's meta image."""
+        with self._lock:
+            d = self._dbs.pop(shard, None)
+        if d is not None:
+            d.close()
+        wal = self._shard_path(shard) + ".wal"
+        if os.path.exists(wal):
+            sz = os.path.getsize(wal)
+            if sz:
+                os.truncate(wal, max(0, sz - 4096))
 
     def delete_field_bitmaps(self, field: str) -> None:
         prefix = field + "/"

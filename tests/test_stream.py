@@ -1,0 +1,600 @@
+"""Streaming write plane tests (ingest/stream.py + the crash seams).
+
+The contract under test is the reference's durability bar
+(idk/ingest.go commit-after-land): an acked mutation is durable, a
+crash at ANY write seam — delta-log append, WAL sync (torn or
+pre-checkpoint), device patch, offset commit — never loses an acked
+record, and replaying the unacked tail converges bit-exact with a
+cold rebuild without observably double-applying anything.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.api import API
+from pilosa_tpu.executor.executor import Executor
+from pilosa_tpu.ingest import APIImporter, Pipeline
+from pilosa_tpu.ingest.kafka import Broker, StreamSource
+from pilosa_tpu.ingest.stream import (
+    MutationError,
+    StreamCrashed,
+    StreamImporter,
+    StreamWriter,
+    WriteBacklogError,
+)
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.obs import faults, metrics
+
+SCHEMA = {"indexes": [{"name": "w", "fields": [
+    {"name": "f", "options": {"type": "set"}},
+    {"name": "g", "options": {"type": "set"}},
+    {"name": "v", "options": {"type": "int", "min": 0,
+                              "max": 1 << 20}},
+]}]}
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def make_api(path=None):
+    h = Holder(path=str(path) if path is not None else None)
+    api = API(h)
+    api.apply_schema(SCHEMA)
+    return api
+
+
+def holder_state(h, index="w") -> dict:
+    """Bit-exact fragment fingerprint of one index: block checksums
+    of every non-empty fragment (representation-independent)."""
+    out = {}
+    idx = h.index(index)
+    for fname in sorted(idx.fields):
+        f = idx.fields[fname]
+        for vname in sorted(f.views):
+            v = f.views[vname]
+            for shard in sorted(v.fragments):
+                cs = v.fragments[shard].block_checksums()
+                if cs:
+                    out[(fname, vname, shard)] = cs
+    return out
+
+
+def reopen(path) -> Holder:
+    h = Holder(path=str(path))
+    h.load_schema()
+    return h
+
+
+# ---------------------------------------------------------------------------
+# window semantics
+# ---------------------------------------------------------------------------
+
+def test_concurrent_submits_coalesce_into_windows():
+    api = make_api()
+    w = StreamWriter(api, window_s=0.02, sync=False).start()
+    try:
+        n_threads = 8
+        errs = []
+
+        def client(i):
+            try:
+                w.submit("w", "f", rows=[i, i],
+                         cols=[i * 7, i * 7 + 1])
+                w.submit("w", "v", cols=[i * 7], values=[i])
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        # 16 submits coalesced into far fewer windows (one window
+        # per ~window_s while the plane is busy)
+        assert w.windows_landed < 2 * n_threads
+        assert w.mutations_landed == n_threads * 3
+        ex = Executor(api.holder)
+        for i in range(n_threads):
+            assert ex.execute("w", f"Count(Row(f={i}))")[0] == 2
+        [vc] = ex.execute("w", "Sum(field=v)")
+        assert vc.value == sum(range(n_threads))
+    finally:
+        w.close()
+
+
+def test_cross_kind_ordering_within_one_window():
+    """set → clear → set of one bit admitted to a single window must
+    keep arrival order (group splitting on op change)."""
+    api = make_api()
+    w = StreamWriter(api, window_s=0.05, sync=False).start()
+    try:
+        m1 = w.submit("w", "f", rows=[1], cols=[3], wait=False)
+        m2 = w.submit("w", "f", rows=[1], cols=[3], clear=True,
+                      wait=False)
+        m3 = w.submit("w", "f", rows=[1], cols=[3], wait=False)
+        w.wait([m1, m2, m3])
+        assert m1.window_id == m2.window_id == m3.window_id
+        ex = Executor(api.holder)
+        assert ex.execute("w", "Count(Row(f=1))")[0] == 1
+        # and the mirror ordering ends cleared
+        m4 = w.submit("w", "f", rows=[2], cols=[4], wait=False)
+        m5 = w.submit("w", "f", rows=[2], cols=[4], clear=True,
+                      wait=False)
+        w.wait([m4, m5])
+        assert Executor(api.holder).execute(
+            "w", "Count(Row(f=2))")[0] == 0
+    finally:
+        w.close()
+
+
+def test_ack_implies_durable(tmp_path):
+    api = make_api(tmp_path)
+    w = StreamWriter(api, window_s=0.001).start()
+    try:
+        w.submit("w", "f", rows=[1, 2], cols=[5, 70005])
+        w.submit("w", "v", cols=[5, 9], values=[42, 7])
+    finally:
+        w.close()
+    want = holder_state(api.holder)
+    api.holder.close()
+    h2 = reopen(tmp_path)
+    try:
+        assert holder_state(h2) == want
+        ex = Executor(h2)
+        assert ex.execute("w", "Count(Row(f=1))")[0] == 1
+        [vc] = ex.execute("w", "Sum(field=v)")
+        assert vc.value == 49
+    finally:
+        h2.close()
+
+
+# ---------------------------------------------------------------------------
+# backpressure
+# ---------------------------------------------------------------------------
+
+def test_backpressure_sheds_firehose_not_point_writes():
+    api = make_api()
+    # stall every window so the backlog cannot drain
+    faults.inject("ingest-window-stall", times=0, delay_s=0.1,
+                  error=False)
+    w = StreamWriter(api, window_s=0.0, max_batch=4,
+                     queue_max=64, tenant_queue_max=4,
+                     sync=False).start()
+    try:
+        shed = None
+        admitted = []
+        for i in range(64):
+            try:
+                admitted.append(w.submit(
+                    "w", "f", rows=[1], cols=[i], tenant="firehose",
+                    wait=False))
+            except WriteBacklogError as e:
+                shed = e
+                break
+        assert shed is not None, "firehose never shed"
+        assert shed.status == 503 and shed.retry_after_s > 0
+        assert metrics.INGEST_SHED.value(tenant="firehose") >= 1
+        # the point writer's own queue is empty: still admitted
+        pt = w.submit("w", "g", rows=[1], cols=[0], tenant="pt",
+                      wait=False)
+        faults.clear("ingest-window-stall")
+        w.wait(admitted + [pt], timeout=30)
+    finally:
+        faults.clear("ingest-window-stall")
+        w.close()
+
+
+def test_tenant_fairness_round_robin_drain():
+    """A full firehose queue must not monopolize a window: the drain
+    round-robins across tenants, so the point write rides the FIRST
+    window after admission."""
+    api = make_api()
+    # every window stalls 100 ms, so windows land one at a time and
+    # the backlog drains slowly enough to observe ordering
+    faults.inject("ingest-window-stall", times=0, delay_s=0.1,
+                  error=False)
+    w = StreamWriter(api, window_s=0.0, max_batch=8,
+                     queue_max=1024, sync=False).start()
+    try:
+        fire = [w.submit("w", "f", rows=[1], cols=[i],
+                         tenant="firehose", wait=False)
+                for i in range(64)]
+        pt = w.submit("w", "g", rows=[1], cols=[0], tenant="pt",
+                      wait=False)
+        w.wait([pt], timeout=30)
+        # the point write landed while most of the firehose backlog
+        # (queued ahead of it) was still waiting — round-robin drain
+        assert any(not m.event.is_set() for m in fire)
+        faults.clear("ingest-window-stall")
+        w.wait(fire, timeout=30)
+        assert pt.window_id < max(m.window_id for m in fire)
+    finally:
+        faults.clear("ingest-window-stall")
+        w.close()
+
+
+# ---------------------------------------------------------------------------
+# crash seams (satellite: every write seam armed + exercised)
+# ---------------------------------------------------------------------------
+
+def _produce(broker, topic, n, seed=0):
+    """Deterministic record stream; returns the expected final
+    per-record values (LWW per _id)."""
+    rng = np.random.default_rng(seed)
+    for i in range(n):
+        broker.produce(topic, {"_id": int(i),
+                               "f": int(rng.integers(0, 5)),
+                               "v": int(rng.integers(0, 1000))},
+                       key=i)
+
+
+def _run_pipeline(api, broker, topic, group, batch_size=8,
+                  stream=True):
+    schema = {"f": {"type": "set"},
+              "v": {"type": "int", "min": 0, "max": 1 << 20}}
+    src = StreamSource(broker, topic, group=group, schema=schema)
+    if stream:
+        writer = StreamWriter(api, window_s=0.0).start()
+        imp = StreamImporter(api, writer)
+    else:
+        writer = None
+        imp = APIImporter(api)
+    p = Pipeline(src, imp, "w", batch_size=batch_size)
+    try:
+        n = p.run()
+    finally:
+        if writer is not None:
+            writer.close()
+    return n, src
+
+
+def _cold_rebuild(broker, topic):
+    """Apply every record exactly once to a fresh holder — the
+    convergence oracle."""
+    api = make_api()
+    _run_pipeline(api, broker, topic, group="cold", stream=False)
+    return holder_state(api.holder)
+
+
+@pytest.mark.parametrize("seam,batch_size", [
+    ("crash-post-append", 8),
+    ("crash-post-append", 3),
+    ("wal-torn", 8),
+    ("wal-torn", 5),
+    ("crash-pre-checkpoint", 8),
+    ("crash-pre-checkpoint", 3),
+    ("crash-pre-commit", 8),
+    ("crash-pre-commit", 5),
+])
+def test_crash_seam_restart_converges(tmp_path, seam, batch_size):
+    """Kill the ingester at each write seam (varying the batch size
+    varies WHERE in the stream the first activation lands), restart
+    from the committed offsets, and assert the replay converges
+    bit-exact with a cold rebuild — an acked batch is never
+    observably double-applied, an unacked one is never lost."""
+    broker = Broker(n_partitions=2)
+    _produce(broker, "t", 40, seed=batch_size)
+    api = make_api(tmp_path)
+    faults.inject(seam, times=1)
+    crashed = False
+    try:
+        _run_pipeline(api, broker, "t", group="g")
+    except Exception:
+        crashed = True
+    assert crashed, f"{seam} never fired"
+    assert metrics.FAULTS_TOTAL.value(point=seam) >= 1
+    api.holder.close()
+
+    # restart: reopen from disk, resume from committed offsets
+    h2 = reopen(tmp_path)
+    api2 = API(h2)
+    try:
+        _, src2 = _run_pipeline(api2, broker, "t", group="g")
+        got = holder_state(h2)
+        want = _cold_rebuild(broker, "t")
+        assert got == want, f"restart diverged after {seam}"
+        # offsets ended at the heads: everything acked exactly once
+        committed = broker.committed("g", "t")
+        for p in broker.partitions("t"):
+            assert committed.get(p, 0) == broker.head("t", p)
+        if seam in ("crash-pre-checkpoint", "crash-pre-commit"):
+            # the crashed batch WAS durable/applied — its replay is
+            # the idempotence the exactly-once observation rests on
+            assert src2.replayed > 0
+    finally:
+        h2.close()
+
+
+def test_plain_pipeline_commit_after_land(tmp_path):
+    """The non-streaming Pipeline path syncs before committing
+    offsets too (Importer.sync barrier): a WAL torn during that sync
+    leaves the offsets uncommitted, so restart re-delivers."""
+    broker = Broker(n_partitions=1)
+    _produce(broker, "t", 12, seed=1)
+    api = make_api(tmp_path)
+    faults.inject("wal-torn", times=1)
+    with pytest.raises(Exception):
+        _run_pipeline(api, broker, "t", group="g", batch_size=4,
+                      stream=False)
+    api.holder.close()
+    h2 = reopen(tmp_path)
+    api2 = API(h2)
+    try:
+        _, src2 = _run_pipeline(api2, broker, "t", group="g",
+                                stream=False)
+        assert src2.replayed > 0  # the unacked batch re-delivered
+        assert holder_state(h2) == _cold_rebuild(broker, "t")
+    finally:
+        h2.close()
+
+
+def test_torn_wal_sync_detected_and_resynced(tmp_path):
+    """Satellite pin: a torn fragment WAL sync must surface the
+    crash, reload as the last durable state (never garbage), and
+    re-sync cleanly on the next write."""
+    api = make_api(tmp_path)
+    idx = api.holder.index("w")
+    api.import_bits("w", "f", rows=[1] * 3, cols=[1, 2, 3])
+    idx.sync()
+    durable = holder_state(api.holder)
+    api.import_bits("w", "f", rows=[1] * 2, cols=[4, 5])
+    faults.inject("wal-torn", times=1)
+    with pytest.raises(faults.InjectedFault):
+        idx.sync()
+    # the failed sync left dirty_rows set (retry/replay will rewrite)
+    frag = idx.fields["f"].views["standard"].fragments[0]
+    assert frag.dirty_rows
+    api.holder.close()
+
+    h2 = reopen(tmp_path)
+    try:
+        # torn tail dropped: exactly the pre-tear durable state
+        assert holder_state(h2) == durable
+        ex = Executor(h2)
+        assert sorted(ex.execute("w", "Row(f=1)")[0].columns()) == \
+            [1, 2, 3]
+        # re-sync on restore: replaying the lost write lands clean
+        api2 = API(h2)
+        api2.import_bits("w", "f", rows=[1] * 2, cols=[4, 5])
+        h2.index("w").sync()
+    finally:
+        h2.close()
+    h3 = reopen(tmp_path)
+    try:
+        assert sorted(Executor(h3).execute(
+            "w", "Row(f=1)")[0].columns()) == [1, 2, 3, 4, 5]
+    finally:
+        h3.close()
+
+
+def test_crash_pre_checkpoint_is_durable(tmp_path):
+    """Dying between the WAL fsync and the checkpoint loses nothing:
+    recovery replays the WAL."""
+    api = make_api(tmp_path)
+    idx = api.holder.index("w")
+    api.import_bits("w", "f", rows=[1] * 3, cols=[1, 2, 3])
+    faults.inject("crash-pre-checkpoint", times=1)
+    with pytest.raises(faults.InjectedFault):
+        idx.sync()
+    api.holder.close()
+    h2 = reopen(tmp_path)
+    try:
+        assert sorted(Executor(h2).execute(
+            "w", "Row(f=1)")[0].columns()) == [1, 2, 3]
+    finally:
+        h2.close()
+
+
+def test_device_patch_fault_falls_back_to_rebuild():
+    """An armed device-patch fault fails the in-place patch exactly
+    like a device error; the stack cache rebuilds from live rows and
+    the query stays bit-exact."""
+    api = make_api()
+    ex = Executor(api.holder)
+    api.import_bits("w", "f", rows=[1] * 64, cols=list(range(64)))
+    assert ex.execute("w", "Count(Row(f=1))")[0] == 64
+    api.import_bits("w", "f", rows=[1], cols=[100])
+    rebuilds0 = metrics.STACK_CACHE.value(outcome="rebuild") + \
+        metrics.STACK_CACHE.value(outcome="page_rebuild")
+    faults.inject("device-patch", times=0)  # every patch attempt
+    try:
+        assert ex.execute("w", "Count(Row(f=1))")[0] == 65
+        api.import_bits("w", "f", rows=[1], cols=[101])
+        assert ex.execute("w", "Count(Row(f=1))")[0] == 66
+    finally:
+        faults.clear("device-patch")
+    assert metrics.FAULTS_TOTAL.value(point="device-patch") >= 1
+    assert (metrics.STACK_CACHE.value(outcome="rebuild")
+            + metrics.STACK_CACHE.value(outcome="page_rebuild")) \
+        > rebuilds0
+    # and with the fault cleared the patch path works again
+    api.import_bits("w", "f", rows=[1], cols=[102])
+    assert ex.execute("w", "Count(Row(f=1))")[0] == 67
+
+
+def test_data_error_poisons_window_not_plane():
+    """A malformed value fails ITS window with a typed 400 and the
+    plane keeps landing everyone else's writes — one bad request must
+    never 503 every tenant until a process restart (DoS)."""
+    api = make_api()
+    w = StreamWriter(api, window_s=0.0, sync=False).start()
+    try:
+        poisoned0 = metrics.INGEST_WINDOWS.value(outcome="poisoned")
+        with pytest.raises(MutationError) as ei:
+            w.submit("w", "v", cols=[1], values=["not-an-int"])
+        assert ei.value.status == 400
+        assert w.failed is None  # the plane survived
+        assert metrics.INGEST_WINDOWS.value(
+            outcome="poisoned") > poisoned0
+        # the next window lands normally
+        assert w.submit("w", "f", rows=[1], cols=[5]) == 1
+        assert Executor(api.holder).execute(
+            "w", "Count(Row(f=1))")[0] == 1
+    finally:
+        w.close()
+
+
+def test_field_dropped_mid_window_poisons_not_crashes():
+    """A field dropped between admission and apply fails the window
+    (typed 400), not the plane — an admin op racing a stream is a
+    data error, not a storage crash."""
+    api = make_api()
+    # stall the window so the drop lands between admission and apply
+    faults.inject("ingest-window-stall", times=1, delay_s=0.2,
+                  error=False)
+    w = StreamWriter(api, window_s=0.0, sync=False).start()
+    try:
+        m = w.submit("w", "g", rows=[1], cols=[3], wait=False)
+        api.holder.index("w").delete_field("g")
+        with pytest.raises(MutationError):
+            w.wait([m], timeout=30)
+        assert w.failed is None
+        assert w.submit("w", "f", rows=[1], cols=[5]) == 1
+    finally:
+        faults.clear("ingest-window-stall")
+        w.close()
+
+
+# ---------------------------------------------------------------------------
+# replay accounting
+# ---------------------------------------------------------------------------
+
+def test_broker_delivered_watermark_counts_replays():
+    b = Broker(n_partitions=1)
+    for i in range(6):
+        b.produce("t", {"_id": i, "f": 1}, key=i)
+    s1 = StreamSource(b, "t", group="g")
+    recs = list(s1)
+    assert len(recs) == 6 and s1.replayed == 0
+    s1.commit(3)  # ack half, then "crash"
+    s2 = StreamSource(b, "t", group="g")
+    assert len(list(s2)) == 3
+    assert s2.replayed == 3  # all three re-deliveries counted
+
+
+# ---------------------------------------------------------------------------
+# import-time result-cache narrowing (satellite)
+# ---------------------------------------------------------------------------
+
+def test_import_sweep_narrowed_to_dirtied_shards():
+    api = make_api()
+    W = api.holder.index("w").width
+    api.import_bits("w", "f", rows=[1, 1], cols=[3, W + 4])
+    serving = api.executor.enable_serving(window_s=0.0, max_batch=1,
+                                          batching=False)
+    q = "Count(Row(f=1))"
+    # prime one entry per shard restriction + the unrestricted one
+    assert api.executor.execute_serving("w", q, shards=[0]) == [1]
+    assert api.executor.execute_serving("w", q, shards=[1]) == [1]
+    assert api.executor.execute_serving("w", q) == [2]
+    assert len(serving.cache) == 3
+    hits0 = serving.cache.hits
+    # a bulk import into shard 1 ONLY: the shard-0 entry survives
+    api.import_bits("w", "f", rows=[1], cols=[W + 9])
+    assert len(serving.cache) == 1  # shard-1 + unrestricted evicted
+    assert api.executor.execute_serving("w", q, shards=[0]) == [1]
+    assert serving.cache.hits == hits0 + 1  # served from cache
+    # correctness: the dirtied slices re-execute
+    assert api.executor.execute_serving("w", q, shards=[1]) == [2]
+    assert api.executor.execute_serving("w", q) == [3]
+    # an import into shard 0 evicts the surviving entry too
+    api.import_bits("w", "f", rows=[1], cols=[7])
+    assert api.executor.execute_serving("w", q, shards=[0]) == [2]
+
+
+def test_stream_windows_sweep_result_cache():
+    """Writes through the window plane evict exactly the dirtied
+    slices of the serving cache."""
+    api = make_api()
+    W = api.holder.index("w").width
+    serving = api.executor.enable_serving(window_s=0.0, max_batch=1,
+                                          batching=False)
+    api.import_bits("w", "f", rows=[1, 1], cols=[3, W + 4])
+    q = "Count(Row(f=1))"
+    assert api.executor.execute_serving("w", q, shards=[0]) == [1]
+    assert api.executor.execute_serving("w", q, shards=[1]) == [1]
+    w = StreamWriter(api, window_s=0.0, sync=False).start()
+    try:
+        w.submit("w", "f", rows=[1], cols=[W + 11])
+    finally:
+        w.close()
+    hits0 = serving.cache.hits
+    assert api.executor.execute_serving("w", q, shards=[0]) == [1]
+    assert serving.cache.hits == hits0 + 1  # shard-0 entry survived
+    assert api.executor.execute_serving("w", q, shards=[1]) == [2]
+
+
+# ---------------------------------------------------------------------------
+# observability + transport
+# ---------------------------------------------------------------------------
+
+def test_ingest_metrics_and_flight_records():
+    from pilosa_tpu.obs import flight
+    api = make_api()
+    landed0 = metrics.INGEST_WINDOWS.value(outcome="landed")
+    prev = (flight.recorder.enabled, flight.recorder._ring.maxlen)
+    flight.recorder.configure(enabled=True, keep=256)
+    try:
+        w = StreamWriter(api, window_s=0.001, sync=False).start()
+        try:
+            w.submit("w", "f", rows=[1, 1, 1], cols=[1, 2, 3])
+        finally:
+            w.close()
+        assert metrics.INGEST_WINDOWS.value(outcome="landed") > landed0
+        assert metrics.INGEST_MUTATIONS.value() >= 3
+        assert metrics.INGEST_ACK_LATENCY.count() >= 1
+        text = metrics.registry.render_text()
+        for name in ("pilosa_ingest_windows_total",
+                     "pilosa_ingest_window_occupancy",
+                     "pilosa_ingest_window_mutations",
+                     "pilosa_ingest_ack_seconds",
+                     "pilosa_ingest_queue_depth"):
+            assert name in text, name
+        recs = [r for r in flight.recorder.recent(50)
+                if r.get("route") == "ingest"]
+        assert recs and recs[0]["mutations"] >= 3
+        assert "apply" in recs[0]["phases"]
+    finally:
+        flight.recorder.configure(enabled=prev[0], keep=prev[1])
+
+
+def test_http_ingest_endpoint():
+    import http.client
+
+    from pilosa_tpu.server import Server
+    srv = Server().start()
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                       timeout=30)
+
+        def post(path, body):
+            c.request("POST", path, body=json.dumps(body))
+            r = c.getresponse()
+            return r.status, json.loads(r.read())
+
+        st, _ = post("/schema", SCHEMA)
+        assert st == 200
+        st, out = post("/index/w/ingest", {"writes": [
+            {"field": "f", "rows": [1, 1], "columns": [3, 9]},
+            {"field": "v", "columns": [3], "values": [5]},
+        ]})
+        assert st == 200 and out["landed"] == 3
+        st, out = post("/index/w/query",
+                       {"query": "Count(Row(f=1))"})
+        assert st == 200 and out["results"] == [2]
+        # malformed: missing field
+        st, out = post("/index/w/ingest",
+                       {"writes": [{"rows": [1], "columns": [1]}]})
+        assert st == 400
+        c.close()
+    finally:
+        srv.close()
