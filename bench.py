@@ -1,2024 +1,16 @@
-"""Headline benchmark — the north-star queries through the REAL engine.
+#!/usr/bin/env python
+"""Headline benchmark entrypoint.
 
-Unlike round 1 (which timed a hand-written fused kernel over synthetic
-arrays), this drives ``Executor.execute()`` end-to-end: PQL text in,
-parser → stacked plan compiler (executor/stacked.py) → one jitted
-device program per tree → exact host reduction.  The index is real —
-Holder/Index/Field/Fragment populated through the bulk dense-row
-import path (``Fragment.import_row_words``, the dense analog of the
-reference's ImportRoaring restore path; the reference's own 1B-row
-"able" gauntlet likewise restores pre-built data rather than per-bit
-ingest, qa/scripts/perf/able/able.yaml).
-
-Workload (BASELINE.json north star; reference harnesses
-qa/scripts/perf/able/ableTest.sh:63, cmd/pilosa-bench/main.go:25-60):
-``Count(Intersect(Row(a=1), Row(b=1)))`` and ``TopN(t, n=10)`` over
-~1e9 columns (954 shards x 2^20), ~1e9 set cells in a/b.
-
-Methodology notes (all measured, nothing assumed):
-- The dev harness reaches the chip through a network tunnel with a
-  multi-ms per-dispatch RTT.  We therefore time the SAME engine path
-  twice — at full scale and on a tiny 1-shard index — and subtract:
-  both runs issue identical dispatch sequences, so the difference is
-  pure device scan time.  Raw wall numbers are printed to stderr.
-- Backend init is probed in a SUBPROCESS with a timeout and retried
-  with backoff (round 1 lost its only perf evidence to one init
-  crash); if the TPU never comes up the bench falls back to CPU with
-  the platform recorded in the metric name.
-- v5e-16 equivalent: the scan is shard-data-parallel (the stacked
-  engine shards the same program over a mesh — tests/test_stacked.py
-  proves the mesh path; only one chip is physically reachable here),
-  so 16-chip time is device_time x chips/16, labeled as an equivalent.
-
-Prints ONE JSON line:
-    {"metric": ..., "value": p50_ms, "unit": "ms", "vs_baseline": ...}
-vs_baseline > 1.0 means the 10 ms north-star target is beaten.
+The suite itself lives in the ``bench/`` package (one module per
+gauntlet family, shared harness in bench/common.py — see
+bench/main.py for the map); this shim keeps the historical
+``python bench.py [--*-smoke]`` invocation working alongside
+``python -m bench``.
 """
 
-from __future__ import annotations
-
-import json
-import os
-import statistics
-import subprocess
 import sys
-import time
 
-NORTH_STAR_MS = 10.0
-NORTH_STAR_CHIPS = 16
-PROBE_TIMEOUT_S = 240
-PROBE_ATTEMPTS = 3
-PROBE_BACKOFF_S = 30
-
-# Committed, machine-readable record of the most recent successful
-# platform=tpu run (VERDICT r03 item 1): written on every TPU success,
-# re-emitted verbatim under ``last_tpu_record`` when the tunnel is down
-# at bench time so the round artifact always carries the TPU evidence.
-TPU_RECORD_PATH = os.path.join(
-    os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_RECORD.json")
-
-
-def log(msg: str) -> None:
-    print(msg, file=sys.stderr, flush=True)
-
-
-def probe_backend() -> tuple[str, int]:
-    """Initialize JAX in a subprocess (a hung TPU init cannot wedge
-    the bench) with retries; returns (platform, n_devices)."""
-    # the site customization force-selects the TPU platform through
-    # jax.config, overriding the env var — honor an explicit
-    # JAX_PLATFORMS (CPU smoke runs) by overriding it back
-    code = ("import os, jax;\n"
-            "p = os.environ.get('JAX_PLATFORMS');\n"
-            "jax.config.update('jax_platforms', p) if p else None;\n"
-            "d = jax.devices(); print(d[0].platform, len(d))")
-    for attempt in range(1, PROBE_ATTEMPTS + 1):
-        try:
-            out = subprocess.run(
-                [sys.executable, "-c", code], capture_output=True,
-                text=True, timeout=PROBE_TIMEOUT_S)
-            if out.returncode == 0 and out.stdout.strip():
-                platform, n = out.stdout.split()
-                log(f"backend probe ok: {platform} x{n} "
-                    f"(attempt {attempt})")
-                return platform, int(n)
-            log(f"backend probe attempt {attempt} rc={out.returncode}: "
-                f"{out.stderr.strip()[-300:]}")
-        except subprocess.TimeoutExpired:
-            log(f"backend probe attempt {attempt} timed out "
-                f"({PROBE_TIMEOUT_S}s)")
-        if attempt < PROBE_ATTEMPTS:
-            time.sleep(PROBE_BACKOFF_S)
-    # TPU unreachable: run the engine on CPU so the round still has an
-    # engine-path record, clearly labeled
-    log("TPU backend unavailable after retries — falling back to CPU")
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    return "cpu", 0
-
-
-def _disjoint_category_rows(rng, n_rows: int, words: int):
-    """Packed rows of a CATEGORICAL field: every column belongs to at
-    most one row (what real GROUP BY attributes look like — the able
-    gauntlet's edu/gen/dom are single-valued per record).  Built by
-    drawing ceil(log2 R) random bit-planes as each column's category
-    digit; digits >= n_rows mean "attribute absent" for that column."""
-    import numpy as np
-    bits = max(n_rows - 1, 0).bit_length()
-    planes = rng.integers(0, 1 << 32, size=(max(bits, 1), words),
-                          dtype=np.uint32)
-    rows = []
-    for r in range(n_rows):
-        acc = np.full(words, 0xFFFFFFFF, dtype=np.uint32)
-        for b in range(bits):
-            acc &= planes[b] if (r >> b) & 1 else ~planes[b]
-        rows.append(acc)
-    return rows
-
-
-def build_index(n_shards: int, topn_rows: int, seed: int = 7):
-    """A real index populated through the bulk import path."""
-    import numpy as np
-    from pilosa_tpu.models.holder import Holder
-    from pilosa_tpu.models.view import VIEW_STANDARD
-    from pilosa_tpu.shardwidth import SHARD_WIDTH
-
-    from pilosa_tpu.models.schema import (
-        CACHE_TYPE_NONE,
-        FieldOptions,
-        FieldType,
-    )
-
-    rng = np.random.default_rng(seed)
-    h = Holder()  # full 2^20-column shards
-    idx = h.create_index("bench", track_existence=False)
-    words = SHARD_WIDTH // 32
-    cells = 0
-    t0 = time.perf_counter()
-    # north-star fields + the "able" gauntlet categoricals (qa/
-    # scripts/perf/able/ableTest.sh:63: GroupBy over 3 Rows fields
-    # with a Sum): edu/gen/dom/reg are DISJOINT categorical rows (one
-    # category per column, like the reference's single-valued record
-    # attributes — also what qualifies them for the one-pass
-    # group-code GroupBy), age is BSI.  reg exists only for the
-    # combo-count sweep (2*5*6*4 = 240 combos at the top end).
-    # "tr" mirrors "t" with the RANKED cache: filtered TopN on it
-    # scans only cache candidates (the reference's TopN strategy,
-    # cache.go:130) — measured against the exact full scan on "t"
-    categorical = {"edu": 6, "gen": 2, "dom": 5, "reg": 4}
-    for fname, rows, cache in (
-            ("a", [1], CACHE_TYPE_NONE), ("b", [1], CACHE_TYPE_NONE),
-            ("t", list(range(topn_rows)), CACHE_TYPE_NONE),
-            ("tr", list(range(topn_rows)), "ranked"),
-            ("edu", list(range(6)), CACHE_TYPE_NONE),
-            ("gen", list(range(2)), CACHE_TYPE_NONE),
-            ("dom", list(range(5)), CACHE_TYPE_NONE),
-            ("reg", list(range(4)), CACHE_TYPE_NONE)):
-        # cache_type none on the TopN field forces the stacked device
-        # scan — an unfiltered TopN on a ranked-cache field would be
-        # served by the host rank-cache merge instead, measuring the
-        # wrong path (advisor r02)
-        f = idx.create_field(fname, FieldOptions(cache_type=cache))
-        view = f.view(VIEW_STANDARD, create=True)
-        for shard in range(n_shards):
-            frag = view.fragment(shard, create=True)
-            cat_rows = (_disjoint_category_rows(
-                rng, categorical[fname], words)
-                if fname in categorical else None)
-            for r in rows:
-                if fname == "tr":
-                    # copy t's words so results compare exactly
-                    w = idx.field("t").view(VIEW_STANDARD) \
-                        .fragment(shard).row_words(r)
-                elif cat_rows is not None:
-                    w = cat_rows[r]
-                else:
-                    w = rng.integers(0, 1 << 32, size=words,
-                                     dtype=np.uint32)
-                frag.import_row_words(r, w)
-                cells += int(np.bitwise_count(
-                    np.asarray(w, dtype=np.uint32)).sum())
-    # BSI age: random 7-bit magnitudes built directly as plane words
-    # (the bulk-restore path; random planes = random values 0..127)
-    age = idx.create_field("age", FieldOptions(
-        type=FieldType.INT, min=0, max=127))
-    aview = age.view(age.bsi_view, create=True)
-    for shard in range(n_shards):
-        frag = aview.fragment(shard, create=True)
-        frag.import_row_words(0, np.full(words, 0xFFFFFFFF,
-                                         dtype=np.uint32))  # exists
-        cells += SHARD_WIDTH
-        for plane in range(7):
-            w = rng.integers(0, 1 << 32, size=words, dtype=np.uint32)
-            frag.import_row_words(2 + plane, w)
-            cells += int(np.bitwise_count(w).sum())
-    log(f"index built: {n_shards} shards x {SHARD_WIDTH} cols, "
-        f"{cells / 1e9:.2f}e9 cells, {time.perf_counter() - t0:.1f}s host")
-    return h, cells
-
-
-def run_queries(h, reps: int, label: str) -> dict[str, list[float]]:
-    """Time the two north-star queries through Executor.execute."""
-    from pilosa_tpu.executor.executor import Executor
-
-    ex = Executor(h)
-    queries = {
-        "count_intersect": "Count(Intersect(Row(a=1), Row(b=1)))",
-        "topn": "TopN(t, n=10)",
-        # filtered TopN: exact full candidate scan (cache none) vs
-        # the ranked-cache-bounded scan (VERDICT r03 item 5) — same
-        # data, results asserted equal below
-        "topn_filtered": "TopN(t, Row(a=1), n=10)",
-        "topn_ranked_filtered": "TopN(tr, Row(a=1), n=10)",
-        # the reference's own 1B-row gauntlet query shape
-        # (qa/scripts/perf/able/ableTest.sh:63)
-        "able_groupby": "GroupBy(Rows(edu), Rows(gen), Rows(dom), "
-                        "aggregate=Sum(field=age))",
-        # combo-count sweep around the 60-combo gauntlet shape: the
-        # one-pass group-code path must hold roughly FLAT wall time
-        # from 10 to 240 combos (its traffic is O(S*W), combo-free),
-        # where the per-combo paths scale linearly in C
-        "groupby_c10": "GroupBy(Rows(gen), Rows(dom), "
-                       "aggregate=Sum(field=age))",
-        "groupby_c240": "GroupBy(Rows(edu), Rows(gen), Rows(dom), "
-                        "Rows(reg), aggregate=Sum(field=age))",
-    }
-    # warmup: compiles the stacked programs + uploads the tile stacks
-    warm = {}
-    for name, q in queries.items():
-        t0 = time.perf_counter()
-        res = ex.execute("bench", q)
-        warm[name] = res
-        log(f"[{label}] warm {name}: {time.perf_counter() - t0:.2f}s "
-            f"(compile+upload) result={_preview(res)}")
-    # exactness: the ranked-cache-bounded filtered TopN must equal
-    # the full scan (same underlying rows; covering cache)
-    a = [(p.id, p.count) for p in warm["topn_filtered"][0]]
-    b = [(p.id, p.count) for p in warm["topn_ranked_filtered"][0]]
-    assert a == b, f"ranked TopN != exact TopN: {a} vs {b}"
-    times: dict[str, list[float]] = {k: [] for k in queries}
-    for _ in range(reps):
-        for name, q in queries.items():
-            t0 = time.perf_counter()
-            ex.execute("bench", q)
-            times[name].append(time.perf_counter() - t0)
-    for name, ts in times.items():
-        log(f"[{label}] {name}: p50={statistics.median(ts)*1e3:.2f}ms "
-            f"min={min(ts)*1e3:.2f}ms max={max(ts)*1e3:.2f}ms")
-    return times
-
-
-def loop_calibrate(h, reps: int = 5) -> dict[str, float]:
-    """Per-execution DEVICE time (ms) of the two north-star scans,
-    measured RTT-independently: one dispatch runs the scan `iters`
-    times in a lax.fori_loop whose carry perturbs the input by an
-    opaque zero (so XLA cannot hoist the loop-invariant body), and
-    per-iteration time = (t_iters - t_1) / (iters - 1).  Needed
-    because the tunnel's per-dispatch RTT jitter (±6 ms between runs)
-    now exceeds the sub-RTT device scan itself, making the
-    full-vs-tiny wall subtraction go negative (measured r03)."""
-    import jax
-    import jax.numpy as jnp
-    from pilosa_tpu.executor.executor import Executor
-    from pilosa_tpu.models.view import VIEW_STANDARD
-    from pilosa_tpu.ops import bitmap as bm
-
-    ex = Executor(h)
-    idx = h.index("bench")
-    eng = ex.stacked
-    fa, fb, ft = idx.field("a"), idx.field("b"), idx.field("t")
-    shards = tuple(ft.views[VIEW_STANDARD].shards)
-    a = eng.row_stack(idx, fa, (VIEW_STANDARD,), 1, shards)
-    b = eng.row_stack(idx, fb, (VIEW_STANDARD,), 1, shards)
-    t_rows = sorted({r for s in shards
-                     for r in ft.views[VIEW_STANDARD]
-                     .fragment(s).row_ids})
-    rows = eng.rows_stack_for(idx, ft, (VIEW_STANDARD,), t_rows, shards)
-
-    @jax.jit
-    def count_loop(aa0, bb, n):
-        def body(_i, carry):
-            acc, aa = carry
-            z = (acc & 0).astype(jnp.uint32)  # opaque zero: no hoist
-            aa = aa.at[0, 0].add(z)
-            c = jnp.sum(bm.count(jnp.bitwise_and(aa, bb)))
-            return acc + c.astype(jnp.int32), aa
-        acc, _ = jax.lax.fori_loop(0, n, body, (jnp.int32(0), aa0))
-        return acc
-
-    @jax.jit
-    def rows_loop(rr0, n):
-        r = rr0.shape[0]
-        def body(_i, carry):
-            acc, rr = carry
-            z = (acc[0] & 0).astype(jnp.uint32)
-            rr = rr.at[0, 0, 0].add(z)
-            c = jnp.sum(bm.count(rr), axis=1).astype(jnp.int32)
-            return acc + c, rr
-        acc, _ = jax.lax.fori_loop(
-            0, n, body, (jnp.zeros(r, jnp.int32), rr0))
-        return acc
-
-    import numpy as np
-    out = {}
-    # n_big sized so loop compute >> the tunnel's RTT jitter; every
-    # timed call uses a FRESH n (the tunnel layer can serve repeated
-    # identical (executable, args) dispatches from a cache — measured:
-    # repeats return in 0.03 ms against a ~75 ms RTT), and timing is
-    # a VALUE fetch (block_until_ready does not block through the
-    # tunnel).  Correct per-iteration counts were verified: the
-    # returned accumulator scales exactly linearly with n (mod 2^32).
-    for name, fn, args, n_big in (
-            ("count_intersect", count_loop, (a, b), 1024),
-            ("topn", rows_loop, (rows,), 256)):
-        np.asarray(fn(*args, 7))  # compile + warm
-        fresh = iter(range(1, 1000))
-
-        def med(base, k):
-            ts = []
-            for _ in range(reps):
-                n = base + next(fresh)  # never repeat an n
-                t0 = time.perf_counter()
-                np.asarray(fn(*args, n))
-                ts.append(time.perf_counter() - t0)
-            return statistics.median(ts)
-        t_small = med(0, 0)       # n in [1, reps]: ~pure RTT
-        t_big = med(n_big, 0)     # n_big + small offsets
-        per_iter = (t_big - t_small) / n_big
-        out[name] = max(per_iter * 1e3, 1e-3)
-        log(f"loop-calibrated {name}: {out[name]:.4f}ms/scan "
-            f"(slope over {n_big} in-program iterations)")
-    return out
-
-
-def attach_tpu_record(result: dict, path: str = None,
-                      tunnel_down: bool = False) -> dict:
-    """On a CPU-fallback run, carry the committed TPU record verbatim
-    (if any) under ``last_tpu_record`` so the round artifact stays
-    machine-verifiable when the tunnel is down (VERDICT r05 item 1).
-    Mutates and returns `result`."""
-    path = TPU_RECORD_PATH if path is None else path
-    try:
-        with open(path) as f:
-            result["last_tpu_record"] = json.load(f)
-    except FileNotFoundError:
-        pass
-    except (OSError, ValueError) as e:
-        result["last_tpu_record_error"] = f"{type(e).__name__}: {e}"
-    why = ("TPU tunnel unreachable at bench time" if tunnel_down
-           else "explicit CPU run (JAX_PLATFORMS=cpu)")
-    if "last_tpu_record" in result:
-        result["note"] = (
-            why + "; last_tpu_record is the committed raw record "
-            "of the most recent platform=tpu run of this same "
-            "script (see also BENCH_TPU_NOTES.md)")
-    else:
-        result["note"] = (
-            why + "; no committed TPU record exists yet — see "
-            "BENCH_TPU_NOTES.md for in-session records")
-    return result
-
-
-SERVING_QUERIES = [
-    "Count(Intersect(Row(a=1), Row(b=1)))",
-    "Count(Row(a=1))",
-    "Count(Row(b=1))",
-    "Count(Union(Row(a=1), Row(b=1)))",
-    "TopN(t, n=10)",
-    "TopN(t, Row(a=1), n=10)",
-    "Row(a=1)",
-    "Count(Row(age > 63))",
-    "Sum(Row(a=1), field=age)",
-    "Count(Xor(Row(a=1), Row(b=1)))",
-    "Count(Difference(Row(a=1), Row(b=1)))",
-    "Count(Row(age < 32))",
-]
-
-
-def _client_storm(call, queries, n_clients: int,
-                  duration_s: float) -> dict:
-    """N barrier-synced client threads hammering `call` round-robin
-    over `queries` for `duration_s`; returns qps + latency summary."""
-    import statistics as stats
-    import threading
-
-    lat: list[float] = []
-    lock = threading.Lock()
-    stop = time.perf_counter() + duration_s
-    barrier = threading.Barrier(n_clients)
-
-    def client(ci: int):
-        my: list[float] = []
-        barrier.wait()
-        i = ci
-        while time.perf_counter() < stop:
-            q = queries[i % len(queries)]
-            i += 1
-            t0 = time.perf_counter()
-            call("bench", q)
-            my.append(time.perf_counter() - t0)
-        with lock:
-            lat.extend(my)
-
-    threads = [threading.Thread(target=client, args=(ci,))
-               for ci in range(n_clients)]
-    t_start = time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall = time.perf_counter() - t_start
-    lat.sort()
-    n = len(lat)
-    return {
-        "requests": n,
-        "qps": round(n / wall, 1) if wall > 0 else 0.0,
-        "p50_ms": round(lat[n // 2] * 1e3, 3) if n else None,
-        "p99_ms": round(lat[min(n - 1, int(n * 0.99))] * 1e3, 3)
-        if n else None,
-        "mean_ms": round(stats.fmean(lat) * 1e3, 3) if n else None,
-    }
-
-
-def serving_gauntlet(h, clients_list=(1, 8, 32),
-                     duration_s: float = 1.2) -> dict:
-    """Concurrent-serving A/B: QPS and p50/p99 per client count, with
-    the serving path (micro-batcher + versioned result cache,
-    executor/serving.py) ON vs OFF over the same holder and query mix.
-    The mix is a hot set of distinct read queries, the shape a serving
-    tier sees from dashboard fan-out — exactly what cross-query
-    dispatch coalescing and the result cache exist for.  Each mode
-    cell now carries the flight recorder's per-phase breakdown
-    (compile/upload/execute/wait) so future PRs can attribute wins
-    instead of reporting only end-to-end percentiles."""
-    from pilosa_tpu.executor.executor import Executor
-    from pilosa_tpu.obs import flight
-
-    queries = SERVING_QUERIES
-    # ONE executor per mode, shared across client counts: each
-    # Executor pins its own device tile stacks, and at 954 shards a
-    # fresh engine per (mode, clients) cell would multiply HBM
-    # residency 6x
-    ex_plain = Executor(h)
-    ex_srv = Executor(h)
-    ex_srv.enable_serving(window_s=0.001, max_batch=64,
-                          cache_bytes=64 << 20)
-    prev_enabled = flight.recorder.enabled
-    prev_keep = flight.recorder._ring.maxlen
-
-    def run_mode(batched: bool, n_clients: int) -> dict:
-        call = ex_srv.execute_serving if batched else ex_plain.execute
-        for q in queries:  # warm: compile + tile-stack upload
-            call("bench", q)
-        # ring sized for the window so the breakdown sees every record
-        flight.recorder.configure(enabled=True, keep=16384)
-        flight.recorder.clear()
-        cell = _client_storm(call, queries, n_clients, duration_s)
-        cell["phase_breakdown_ms"] = flight.phase_breakdown(
-            flight.recorder.recent(16384))
-        return cell
-
-    out: dict = {}
-    try:
-        for nc in clients_list:
-            ab = {"unbatched": run_mode(False, nc),
-                  "batched": run_mode(True, nc)}
-            ub, bt = ab["unbatched"]["qps"], ab["batched"]["qps"]
-            ab["qps_speedup"] = round(bt / ub, 2) if ub else None
-            out[f"c{nc}"] = ab
-            log(f"serving c{nc}: unbatched {ub} qps "
-                f"p99={ab['unbatched']['p99_ms']}ms | batched {bt} qps "
-                f"p99={ab['batched']['p99_ms']}ms "
-                f"({ab['qps_speedup']}x)")
-    finally:
-        flight.recorder.configure(enabled=prev_enabled, keep=prev_keep)
-    from pilosa_tpu.obs import metrics as _m
-    out["batch_size_p50"] = round(
-        _m.SERVING_BATCH_SIZE.quantile(0.5), 2)
-    out["result_cache_hits"] = _m.RESULT_CACHE.value(outcome="hit")
-    return out
-
-
-def tracing_overhead_gauntlet(h, n_clients: int = 8,
-                              duration_s: float = 1.0,
-                              rounds: int = 3) -> dict:
-    """Flight-recorder overhead A/B on the serving gauntlet: the SAME
-    workload with the recorder enabled vs disabled, interleaved
-    (off/on per round) so clock drift cancels; best-of-rounds qps per
-    mode.  `overhead_pct` is the cost of leaving the recorder ON;
-    recorder-off is the shipped default-off-tracing cost the <2%
-    acceptance bound speaks to (NopTracer + inactive accumulators)."""
-    from pilosa_tpu.executor.executor import Executor
-    from pilosa_tpu.obs import flight
-
-    queries = SERVING_QUERIES
-    ex = Executor(h)
-    ex.enable_serving(window_s=0.001, max_batch=64,
-                      cache_bytes=64 << 20)
-    for q in queries:  # warm: compile + upload outside the A/B
-        ex.execute_serving("bench", q)
-    prev_enabled = flight.recorder.enabled
-    import statistics as stats
-    pair_overheads = []
-    best = {"off": 0.0, "on": 0.0}
-    p50s = {"off": [], "on": []}
-    try:
-        for _ in range(rounds):
-            qps = {}
-            for mode in ("off", "on"):
-                flight.recorder.configure(enabled=mode == "on")
-                flight.recorder.clear()
-                cell = _client_storm(ex.execute_serving, queries,
-                                     n_clients, duration_s)
-                qps[mode] = cell["qps"]
-                best[mode] = max(best[mode], cell["qps"])
-                if cell["p50_ms"]:
-                    p50s[mode].append(cell["p50_ms"])
-            if qps["off"]:
-                # back-to-back pairing cancels machine drift; the
-                # median across pairs kills scheduler outliers
-                pair_overheads.append(
-                    (qps["off"] - qps["on"]) / qps["off"] * 100)
-    finally:
-        flight.recorder.configure(enabled=prev_enabled)
-    overhead = (round(stats.median(pair_overheads), 2)
-                if pair_overheads else None)
-    p50_off = stats.median(p50s["off"]) if p50s["off"] else None
-    probe = flight_cost_probe()
-    out = {"recorder_off_qps": best["off"],
-           "recorder_on_qps": best["on"],
-           "overhead_pct": overhead,
-           **probe,
-           "recorder_off_fixed_cost_pct_of_p50": round(
-               probe["disabled_cycle_us_4t"] / (p50_off * 1e3) * 100, 3)
-           if p50_off else None}
-    log(f"tracing overhead: recorder off {best['off']} qps vs "
-        f"on {best['on']} qps ({overhead}% median on-overhead); "
-        f"fixed cycle cost on/off 4t = "
-        f"{probe['enabled_cycle_us_4t']}/"
-        f"{probe['disabled_cycle_us_4t']}us")
-    return out
-
-
-def flight_cost_probe(n: int = 20000, threads: int = 4) -> dict:
-    """Load-independent fixed cost of the flight instrumentation: the
-    begin/note/commit cycle timed solo and under `threads`-way
-    contention, recorder on and off.  Unlike the qps A/B (scheduler
-    noise swamps a ~5% effect on a shared 2-core box), these are
-    stable and directly catch the regressions the smoke gate exists
-    for — e.g. a contended lock reappearing on the hot path shows up
-    as ~10x in the 4-thread cycle cost (the convoy measured and fixed
-    in this PR), and the disabled cost bounds the always-on path the
-    <2% acceptance criterion speaks to."""
-    import threading
-
-    from pilosa_tpu.obs import flight
-
-    def cycle():
-        f = flight.begin("bench", "probe")
-        flight.note_phase("cache_lookup", 0.0001)
-        flight.commit(f, 0.0002, route="cached")
-
-    def storm(nthreads: int) -> float:
-        def worker():
-            for _ in range(n):
-                cycle()
-        ts = [threading.Thread(target=worker)
-              for _ in range(nthreads)]
-        t0 = time.perf_counter()
-        for t in ts:
-            t.start()
-        for t in ts:
-            t.join()
-        return (time.perf_counter() - t0) / (nthreads * n) * 1e6
-
-    prev = flight.recorder.enabled
-    try:
-        flight.recorder.configure(enabled=True)
-        on_1t, on_4t = storm(1), storm(threads)
-        flight.recorder.configure(enabled=False)
-        off_4t = storm(threads)
-    finally:
-        flight.recorder.configure(enabled=prev)
-    return {"enabled_cycle_us_1t": round(on_1t, 2),
-            "enabled_cycle_us_4t": round(on_4t, 2),
-            "disabled_cycle_us_4t": round(off_4t, 2)}
-
-
-def mixed_rw_gauntlet(h, n_readers: int = 32,
-                      write_rates=(10, 100, 1000),
-                      duration_s: float = 1.2) -> dict:
-    """Mixed-workload serving: N concurrent readers + 1 writer doing
-    point writes at each target rate, A/B with the incremental stack
-    maintenance path (delta patching, executor/stacked.py) on vs off.
-    Without patching every point write invalidates whole device
-    stacks and the next read pays a full O(S*W) restack + upload;
-    with it the read pays an O(delta) patch.  Reports read p50/p99
-    and restacked-bytes-per-write from the TileStackCache counters —
-    the direct attribution of the write-path win."""
-    import statistics as stats
-    import threading
-
-    from pilosa_tpu.executor.executor import Executor
-    from pilosa_tpu.shardwidth import SHARD_WIDTH
-
-    from pilosa_tpu.obs import flight
-
-    read_qs = [
-        "Count(Intersect(Row(a=1), Row(b=1)))",
-        "Count(Row(a=1))",
-        "TopN(t, n=10)",
-        "Sum(Row(a=1), field=age)",
-    ]
-    out: dict = {}
-    prev_flag = os.environ.get("PILOSA_TPU_STACK_PATCH")
-    prev_rec = (flight.recorder.enabled, flight.recorder._ring.maxlen)
-    try:
-        for patch_on in (True, False):
-            os.environ["PILOSA_TPU_STACK_PATCH"] = \
-                "1" if patch_on else "0"
-            ex = Executor(h)
-            cache = ex.stacked.cache
-            for q in read_qs:  # warm: compile + resident stacks
-                ex.execute("bench", q)
-            mode_key = "patch_on" if patch_on else "patch_off"
-            for rate in write_rates:
-                patched0, rebuilt0 = (cache.patched_bytes,
-                                      cache.rebuilt_bytes)
-                flight.recorder.configure(enabled=True, keep=16384)
-                flight.recorder.clear()
-                lat: list[float] = []
-                lock = threading.Lock()
-                writes = 0
-                stop_t = time.perf_counter() + duration_s
-                barrier = threading.Barrier(n_readers + 1)
-
-                def writer():
-                    nonlocal writes
-                    barrier.wait()
-                    period = 1.0 / rate
-                    nxt, i = time.perf_counter(), 0
-                    while time.perf_counter() < stop_t:
-                        # toggle pairs over advancing columns so
-                        # (nearly) every write flips a bit and bumps
-                        # the fragment version — a no-op Set would
-                        # invalidate nothing and measure nothing
-                        col = (i // 2) % SHARD_WIDTH
-                        op = "Set" if i % 2 == 0 else "Clear"
-                        ex.execute("bench", f"{op}({col}, a=1)")
-                        writes += 1
-                        i += 1
-                        nxt += period
-                        d = nxt - time.perf_counter()
-                        if d > 0:
-                            time.sleep(d)
-
-                def reader(ci: int):
-                    my: list[float] = []
-                    barrier.wait()
-                    i = ci
-                    while time.perf_counter() < stop_t:
-                        q = read_qs[i % len(read_qs)]
-                        i += 1
-                        t0 = time.perf_counter()
-                        ex.execute("bench", q)
-                        my.append(time.perf_counter() - t0)
-                    with lock:
-                        lat.extend(my)
-
-                threads = [threading.Thread(target=writer)] + [
-                    threading.Thread(target=reader, args=(ci,))
-                    for ci in range(n_readers)]
-                for t in threads:
-                    t.start()
-                for t in threads:
-                    t.join()
-                lat.sort()
-                n = len(lat)
-                pb = cache.patched_bytes - patched0
-                rb = cache.rebuilt_bytes - rebuilt0
-                cell = {
-                    "reads": n,
-                    "writes": writes,
-                    "read_p50_ms": round(lat[n // 2] * 1e3, 3)
-                    if n else None,
-                    "read_p99_ms": round(
-                        lat[min(n - 1, int(n * 0.99))] * 1e3, 3)
-                    if n else None,
-                    "read_mean_ms": round(stats.fmean(lat) * 1e3, 3)
-                    if n else None,
-                    "restacked_bytes_per_write": round(
-                        (pb + rb) / writes) if writes else None,
-                    "patched_bytes": pb,
-                    "rebuilt_bytes": rb,
-                    # per-phase attribution: under writes the A/B
-                    # should show the patch path's upload_ms shrink
-                    "phase_breakdown_ms": flight.phase_breakdown(
-                        flight.recorder.recent(16384)),
-                }
-                out.setdefault(f"w{rate}", {})[mode_key] = cell
-                log(f"mixed-rw w{rate}/s {mode_key}: "
-                    f"p50={cell['read_p50_ms']}ms "
-                    f"p99={cell['read_p99_ms']}ms "
-                    f"restacked/write={cell['restacked_bytes_per_write']}B "
-                    f"({n} reads, {writes} writes)")
-    finally:
-        if prev_flag is None:
-            os.environ.pop("PILOSA_TPU_STACK_PATCH", None)
-        else:
-            os.environ["PILOSA_TPU_STACK_PATCH"] = prev_flag
-        flight.recorder.configure(enabled=prev_rec[0],
-                                  keep=prev_rec[1])
-    for rate_key, ab in out.items():
-        on, off = ab.get("patch_on"), ab.get("patch_off")
-        if on and off and on["read_p50_ms"]:
-            ab["read_p50_speedup"] = round(
-                off["read_p50_ms"] / on["read_p50_ms"], 2)
-    return out
-
-
-def _index_state(h, index: str) -> dict:
-    """Bit-exact fingerprint of one index: block checksums of every
-    non-empty fragment (representation-independent)."""
-    out = {}
-    idx = h.index(index)
-    for fname in sorted(idx.fields):
-        f = idx.fields[fname]
-        for vname in sorted(f.views):
-            v = f.views[vname]
-            for shard in sorted(v.fragments):
-                cs = v.fragments[shard].block_checksums()
-                if cs:
-                    out[(fname, vname, shard)] = cs
-    return out
-
-
-def write_storm_gauntlet(n_readers: int = 32, n_writers: int = 4,
-                         post_crash_s: float = 4.0,
-                         rate_target: int = 50000,
-                         batch_cols: int = 8192,
-                         pipeline_depth: int = 4,
-                         crash_after_windows: int = 3) -> dict:
-    """ISSUE 7 acceptance: a sustained multi-writer mutation storm at
-    ``rate_target`` mutations/s through the streaming write plane
-    (coalesced windows, durable acks, pipelined client batches) while
-    ``n_readers`` hammer the read path — and the process is KILLED
-    mid-window (armed wal-torn fault tears a shard WAL during a
-    window's sync) and restarted from disk, writers replaying their
-    unacked batches.  The crash trigger is PROGRESS-based, not
-    wall-clock: the fault arms only after ``crash_after_windows``
-    windows durably landed, so the kill always strikes a plane with
-    real acked state behind it (a wall-clock trigger on a starved box
-    kills window #1 and proves nothing).  Bars:
-
-    - ZERO acknowledged-record loss: the final state (and a fresh
-      reopen from disk) is bit-exact vs a cold rebuild that applies
-      every ACKED batch exactly once — so replayed unacked batches
-      converged idempotently and nothing acked went missing;
-    - read p99 under the storm within 2x of the read-only baseline
-      (reported always; hard-gated only on TPU/large-box runs — on a
-      2-core GIL host the ratio is scheduler noise);
-    - the crash actually exercised replay (failed window + replayed
-      batches > 0) and the restarted plane landed windows of its own.
-
-    Writers pipeline ``pipeline_depth`` batches in flight (submit
-    wait=False, journal on ack) — per-tenant FIFO admission + arrival-
-    order window groups keep each writer's batches landing in submit
-    order, so the unacked tail at the crash is a contiguous suffix
-    and replaying it in order preserves last-write-wins.  Batches are
-    deterministic (no RNG): a replayed submission is bitwise the
-    original, and value-batch columns stride a coprime so no two
-    batches close enough to share a window collide.
-    """
-    import shutil
-    import tempfile
-    import threading
-    from collections import deque
-
-    import numpy as np
-
-    from pilosa_tpu.api import API
-    from pilosa_tpu.ingest.stream import StreamWriter, WriteBacklogError
-    from pilosa_tpu.models.holder import Holder
-    from pilosa_tpu.obs import faults
-    from pilosa_tpu.shardwidth import SHARD_WIDTH
-
-    W = SHARD_WIDTH
-    INDEX = "ws"
-    SPAN = 200000  # live column range per shard
-    n_shards = max(2 * n_writers, 8)
-    datadir = tempfile.mkdtemp(prefix="pilosa_write_storm_")
-    schema = {"indexes": [{"name": INDEX, "fields": [
-        {"name": "f", "options": {"type": "set"}},
-        {"name": "v", "options": {"type": "int", "min": 0,
-                                  "max": 1 << 20}}]}]}
-    read_qs = ["Count(Row(f=1))",
-               "Count(Intersect(Row(f=1), Row(f=2)))",
-               "Sum(field=v)"]
-    out: dict = {"readers": n_readers, "writers": n_writers,
-                 "rate_target": rate_target, "batch_cols": batch_cols,
-                 "pipeline_depth": pipeline_depth}
-    state: dict = {}
-    state_lock = threading.Lock()
-    restart_done = threading.Event()
-    stop = threading.Event()
-    abort = threading.Event()  # driver gave up — writers bail out
-
-    def open_plane(fresh: bool):
-        h = Holder(path=datadir)
-        api = API(h)
-        if fresh:
-            api.apply_schema(schema)
-        else:
-            h.load_schema()
-        # readers ride the PR 2 serving layer on the API's OWN
-        # executor — the production read plane (fused dispatch +
-        # versioned result cache), and the executor whose cache the
-        # write plane's narrowed per-window sweeps actually target
-        api.executor.enable_serving(window_s=0.001, max_batch=64,
-                                    cache_bytes=64 << 20)
-        wtr = StreamWriter(api, window_s=0.002, max_batch=1 << 14,
-                           queue_max=1 << 15).start()
-        with state_lock:
-            state["holder"], state["api"] = h, api
-            state["writer"], state["ex"] = wtr, api.executor
-        return h, api, wtr
-
-    h, api, wtr = open_plane(fresh=True)
-    # seed the read set: rows 1..3 across the shard space
-    for s in range(n_shards):
-        cols = [s * W + k for k in range(64)]
-        api.import_bits(INDEX, "f",
-                        [1 + (k % 3) for k in range(64)], cols)
-        api.import_values(INDEX, "v", cols,
-                          [(c % 997) for c in cols])
-    h.index(INDEX).sync()
-    ex0 = state["ex"]
-    for q in read_qs:  # warm compiles + stacks
-        ex0.execute_serving(INDEX, q)
-
-    # -- readers (event-driven: one storm helper serves the baseline
-    # and the full-duration storm) -----------------------------------
-    def read_storm(stop_ev):
-        lat: list[float] = []
-        fails = [0]
-        lk = threading.Lock()
-        bar = threading.Barrier(n_readers)
-
-        def reader(ci):
-            my = []
-            myf = 0
-            bar.wait()
-            i = ci
-            while not stop_ev.is_set():
-                q = read_qs[i % len(read_qs)]
-                i += 1
-                t0 = time.perf_counter()
-                try:
-                    with state_lock:
-                        ex = state["ex"]
-                    ex.execute_serving(INDEX, q)
-                except Exception:
-                    myf += 1
-                my.append(time.perf_counter() - t0)
-            with lk:
-                lat.extend(my)
-                fails[0] += myf
-        ths = [threading.Thread(target=reader, args=(ci,))
-               for ci in range(n_readers)]
-        for t in ths:
-            t.start()
-        return ths, lat, fails
-
-    bstop = threading.Event()
-    ths, base_lat, base_fails = read_storm(bstop)
-    time.sleep(1.5)
-    bstop.set()
-    for t in ths:
-        t.join()
-    base_p99 = _pct(base_lat, 0.99)
-    out["baseline"] = {"reads": len(base_lat), "failed": base_fails[0],
-                       "p50_ms": _pct(base_lat, 0.5),
-                       "p99_ms": base_p99}
-
-    # -- the storm -----------------------------------------------------
-    journals: list[list] = [[] for _ in range(n_writers)]
-    replays = [0] * n_writers
-    sheds = [0] * n_writers
-    werrs: list = [None] * n_writers
-
-    def make_entry(wi: int, seq: int):
-        """Deterministic batch #seq of writer wi: disjoint shard pair
-        per writer, columns stride 7 (coprime with SPAN) so a batch
-        never self-collides and value batches near enough to coalesce
-        into one window never overlap (LWW stays well-defined)."""
-        base = (2 * wi + (seq % 2)) * W
-        off = ((seq * batch_cols + np.arange(batch_cols)) * 7) % SPAN
-        if seq % 3 == 2:
-            return ("v", None, base + off, (off * 31 + seq) % 1000)
-        return ("f", 8 + (off % 4), base + off, None)
-
-    def writer(wi: int):
-        tenant = f"w{wi}"
-        # offered load carries 25% headroom over the bar so the
-        # measured sustained rate is plane-limited, not pacing-
-        # limited (pacing at exactly the bar can only ever show
-        # <100% of it — open-loop load-testing practice)
-        period = batch_cols * n_writers / (1.25 * max(rate_target, 1))
-        inflight: deque = deque()  # (entry, Mutation) in submit order
-
-        def submit_entry(entry):
-            kind, rows, cols, vals = entry
-            with state_lock:
-                w = state["writer"]
-            if kind == "v":
-                return w.submit(INDEX, "v", cols=cols, values=vals,
-                                tenant=tenant, wait=False)
-            return w.submit(INDEX, "f", rows=rows, cols=cols,
-                            tenant=tenant, wait=False)
-
-        def resubmit(entry):
-            """Submit with shed-retry + crash-wait; None iff aborted.
-            Deadline-bounded so a plane that never recovers surfaces
-            as a writer error instead of hanging the gauntlet."""
-            t0 = time.perf_counter()
-            while not abort.is_set():
-                if time.perf_counter() - t0 > 120:
-                    raise TimeoutError("plane never recovered")
-                try:
-                    return submit_entry(entry)
-                except WriteBacklogError as e:
-                    sheds[wi] += 1
-                    time.sleep(min(e.retry_after_s, 0.25))
-                except Exception:
-                    # plane (still) dead — wait out the restart
-                    restart_done.wait(timeout=60)
-                    time.sleep(0.02)
-            return None
-
-        def recover():
-            """The plane died under our in-flight batches: wait out
-            the restart, then replay every unacked batch in order —
-            the client half of the exactly-once contract (per-tenant
-            FIFO acks make the unacked tail a contiguous suffix)."""
-            replays[wi] += len(inflight)
-            restart_done.wait(timeout=120)
-            old = list(inflight)
-            inflight.clear()
-            for entry, _m in old:
-                m = resubmit(entry)
-                if m is None:
-                    return
-                inflight.append((entry, m))
-
-        def await_oldest():
-            entry, m = inflight[0]
-            if not m.event.wait(timeout=120):
-                raise TimeoutError("ack never arrived")
-            if m.error is not None:
-                recover()
-                return
-            journals[wi].append(entry)  # acked ⇒ journaled
-            inflight.popleft()
-
-        try:
-            nxt = time.perf_counter()
-            seq = 0
-            while not stop.is_set() and not abort.is_set():
-                while len(inflight) >= pipeline_depth:
-                    await_oldest()
-                entry = make_entry(wi, seq)
-                m = resubmit(entry)
-                if m is None:
-                    return
-                inflight.append((entry, m))
-                seq += 1
-                # pace toward rate_target; after a stall (crash +
-                # restart) allow a bounded catch-up burst only
-                nxt = max(nxt + period,
-                          time.perf_counter() - 5 * period)
-                d = nxt - time.perf_counter()
-                if d > 0:
-                    time.sleep(d)
-            while inflight and not abort.is_set():
-                await_oldest()
-        except Exception as e:  # pragma: no cover - diagnostics
-            werrs[wi] = f"{type(e).__name__}: {e}"
-
-    events: dict = {}
-
-    def crash_driver():
-        try:
-            with state_lock:
-                wtr1 = state["writer"]
-            t0 = time.perf_counter()
-            # warm mark: the sustained rate is measured from AFTER
-            # the first window landed — the cold ramp (first
-            # compiles, first stack/cache fills) is not "sustained"
-            while wtr1.windows_landed < 1:
-                if time.perf_counter() - t0 > 90:
-                    raise RuntimeError(
-                        "no window landed in 90s — nothing to "
-                        "crash into")
-                time.sleep(0.005)
-            t_warm = time.perf_counter()
-            landed_warm = wtr1.mutations_landed
-            # progress trigger: arm only once the plane has durable
-            # acked windows behind it AND the writers have journaled
-            # a full pipeline turn of acks (so the kill puts real
-            # acknowledged state at risk and the pre-crash rate is a
-            # measured steady state, not a cold start)
-            min_acked = n_writers * pipeline_depth
-            while (wtr1.windows_landed < crash_after_windows
-                   or sum(len(j) for j in journals) < min_acked
-                   or time.perf_counter() - t_warm < 2.5):
-                if time.perf_counter() - t0 > 90:
-                    raise RuntimeError(
-                        f"only {wtr1.windows_landed} windows / "
-                        f"{sum(len(j) for j in journals)} acked "
-                        f"batches in 90s — nothing to crash into")
-                time.sleep(0.005)
-            events["windows_before_crash"] = wtr1.windows_landed
-            # landed = durably synced AND acked to submitters (the
-            # plane fires the ack events before bumping the counter);
-            # the journals lag one pipeline turn behind under load,
-            # so they undercount the sustained rate
-            events["landed_before_crash"] = \
-                wtr1.mutations_landed - landed_warm
-            events["acked_before_crash"] = sum(
-                len(j) for j in journals) * batch_cols
-            events["precrash_wall_s"] = time.perf_counter() - t_warm
-            faults.inject("wal-torn", match=datadir, times=1)
-            t1 = time.perf_counter()
-            while wtr1.failed is None:
-                if time.perf_counter() - t1 > 60:
-                    raise RuntimeError("wal-torn never fired")
-                time.sleep(0.005)
-            events["crash_detect_s"] = time.perf_counter() - t1
-            # restart: drop the dead process's state, reopen from
-            # disk (native WAL recovery drops the torn tx), resume
-            t2 = time.perf_counter()
-            with state_lock:
-                old_h = state["holder"]
-            old_h.close()
-            open_plane(fresh=False)
-            events["restart_ms"] = round(
-                (time.perf_counter() - t2) * 1e3, 1)
-            events["restarted_at"] = time.perf_counter()
-        except Exception as e:
-            out["driver_error"] = f"{type(e).__name__}: {e}"
-            abort.set()
-        finally:
-            restart_done.set()
-
-    wths = [threading.Thread(target=writer, args=(wi,))
-            for wi in range(n_writers)]
-    drv = threading.Thread(target=crash_driver)
-    t_storm0 = time.perf_counter()
-    rths, storm_lat, storm_fails = read_storm(stop)
-    for t in wths:
-        t.start()
-    drv.start()
-    restart_done.wait(timeout=240)
-    # post-crash phase: keep the storm up until the RESTARTED plane
-    # proved productive (landed its own windows) or the budget ran out
-    t_post = time.perf_counter()
-    while time.perf_counter() - t_post < max(post_crash_s, 1.0):
-        if abort.is_set():
-            break
-        with state_lock:
-            wcur = state["writer"]
-        if (wcur is not wtr
-                and wcur.windows_landed >= crash_after_windows
-                and time.perf_counter() - t_post >= post_crash_s / 2):
-            break
-        time.sleep(0.05)
-    stop.set()
-    for t in wths:  # drain their in-flight tails (windows keep landing)
-        t.join()
-    drv.join()
-    storm_wall = time.perf_counter() - t_storm0
-    for t in rths:
-        t.join()
-    with state_lock:
-        w2, h2 = state["writer"], state["holder"]
-    w2.close()  # drain + final sync
-
-    acked = sum(len(j) for j in journals) * batch_cols
-    post_landed = w2.windows_landed if w2 is not wtr else 0
-    storm_p99 = _pct(storm_lat, 0.99)
-    out["storm"] = {
-        "reads": len(storm_lat), "read_failed": storm_fails[0],
-        "read_p50_ms": _pct(storm_lat, 0.5), "read_p99_ms": storm_p99,
-        "acked_mutations": acked,
-        "mutations_per_s": round(acked / storm_wall, 1),
-        "windows_landed": wtr.windows_landed + post_landed,
-        "windows_failed": wtr.windows_failed + (
-            w2.windows_failed if w2 is not wtr else 0),
-        "windows_landed_post_restart": post_landed,
-        "mutations_per_window": round(
-            (wtr.mutations_landed + (
-                w2.mutations_landed if w2 is not wtr else 0))
-            / max(1, wtr.windows_landed + post_landed), 1),
-        "replayed_batches": sum(replays),
-        "backpressure_sheds": sum(sheds),
-    }
-    if "precrash_wall_s" in events and events["precrash_wall_s"] > 0:
-        # steady-state rate before the kill (the restart's dead time
-        # — crash detect + reopen — dilutes the overall average)
-        out["storm"]["sustained_pre_crash_per_s"] = round(
-            events["landed_before_crash"]
-            / events["precrash_wall_s"], 1)
-    t_end = events.pop("restarted_at", None)
-    if t_end is not None and w2 is not wtr:
-        post_wall = storm_wall - (t_end - t_storm0)
-        if post_wall > 0:
-            out["storm"]["sustained_post_restart_per_s"] = round(
-                w2.mutations_landed / post_wall, 1)
-    out["events_s"] = {k: round(v, 3) if isinstance(v, float) else v
-                       for k, v in events.items()}
-    out["writer_errors"] = [e for e in werrs if e]
-    out["read_p99_over_baseline"] = round(
-        (storm_p99 or 0.0) / (base_p99 or 1e-3), 2)
-
-    # -- convergence: live state vs cold rebuild vs fresh reopen ------
-    got = _index_state(h2, INDEX)
-    cold = Holder()
-    capi = API(cold)
-    capi.apply_schema(schema)
-    for s in range(n_shards):
-        cols = [s * W + k for k in range(64)]
-        capi.import_bits(INDEX, "f",
-                         [1 + (k % 3) for k in range(64)], cols)
-        capi.import_values(INDEX, "v", cols,
-                           [(c % 997) for c in cols])
-    for j in journals:
-        for kind, rows, cols, vals in j:
-            if kind == "v":
-                capi.import_values(INDEX, "v", cols, vals)
-            else:
-                capi.import_bits(INDEX, "f", rows, cols)
-    out["bit_exact_vs_cold_rebuild"] = got == _index_state(cold, INDEX)
-    h2.close()
-    h3 = Holder(path=datadir)
-    h3.load_schema()
-    out["reopen_bit_exact"] = _index_state(h3, INDEX) == got
-    h3.close()
-    out["acked_record_loss"] = 0 if (
-        out["bit_exact_vs_cold_rebuild"]
-        and out["reopen_bit_exact"]) else None
-    faults.clear("wal-torn")
-    shutil.rmtree(datadir, ignore_errors=True)
-    log(f"write-storm: {out['storm']['mutations_per_s']}/s acked "
-        f"overall, "
-        f"{out['storm'].get('sustained_pre_crash_per_s')}/s "
-        f"pre-crash ({acked} mutations, "
-        f"{out['storm']['windows_landed']} windows, "
-        f"{sum(replays)} replayed batches after kill, "
-        f"{post_landed} windows post-restart), read p99 "
-        f"{storm_p99}ms = {out['read_p99_over_baseline']}x baseline, "
-        f"bit-exact={out['bit_exact_vs_cold_rebuild']} "
-        f"reopen={out['reopen_bit_exact']}")
-    return out
-
-
-# the memory-pressure suites run every north-star query shape
-# (Count/Row/TopN/GroupBy/Sum) so "bit-exact under a clamped budget"
-# covers the whole read surface, not one lucky path
-_MEM_QUERIES = [
-    "Count(Intersect(Row(a=1), Row(b=1)))",
-    "Count(Row(b=1))",
-    "TopN(t, n=10)",
-    "Sum(Row(a=1), field=age)",
-    "GroupBy(Rows(edu), Rows(gen), Rows(dom), "
-    "aggregate=Sum(field=age))",
-]
-
-
-def memory_pressure_gauntlet(h, ratios=(0.5, 1.0, 2.0),
-                             reps: int = 3) -> dict:
-    """HBM residency A/B: run the query suite with the device budget
-    clamped so the working set is 0.5x / 1x / 2x the budget, paged
-    stack entries (memory/pages.py) vs whole-stack entries.  Reports
-    hit rate, restacked bytes/query (the direct cost of eviction
-    granularity — at 2x overcommit paged eviction must beat
-    whole-stack on this) and read p50/p99, asserting every result
-    stays bit-exact vs the unbounded run (paging correctness)."""
-    import gc
-
-    from pilosa_tpu import memory
-    from pilosa_tpu.executor.executor import Executor
-
-    out: dict = {}
-    prev_paged = os.environ.get("PILOSA_TPU_MEMORY_PAGED")
-    prev_page_bytes = os.environ.get("PILOSA_TPU_MEMORY_PAGE_BYTES")
-    try:
-        # page ~ one shard-row lane group well below the smallest
-        # stack so the A/B measures granularity, not page quantization
-        os.environ["PILOSA_TPU_MEMORY_PAGE_BYTES"] = str(512 << 10)
-        os.environ["PILOSA_TPU_MEMORY_PAGED"] = "1"
-        memory.configure(budget_bytes=1 << 40)  # unbounded baseline
-        ex0 = Executor(h)
-        baseline = [repr(ex0.execute("bench", q)) for q in _MEM_QUERIES]
-        ws = int(ex0.stacked.cache.nbytes)
-        out["working_set_bytes"] = ws
-        del ex0
-        gc.collect()
-        for ratio in ratios:
-            budget = max(int(ws / ratio), 1 << 20)
-            cell_key = f"ws_{ratio:g}x_budget"
-            for paged in (True, False):
-                os.environ["PILOSA_TPU_MEMORY_PAGED"] = \
-                    "1" if paged else "0"
-                memory.configure(budget_bytes=budget)
-                ex = Executor(h)
-                cache = ex.stacked.cache
-                for q, want in zip(_MEM_QUERIES, baseline):  # warm
-                    got = repr(ex.execute("bench", q))
-                    assert got == want, \
-                        f"budget-clamped result drift: {q}"
-                p0, r0 = cache.patched_bytes, cache.rebuilt_bytes
-                h0, m0 = cache.hits, cache.misses
-                lat: list[float] = []
-                # skewed serving shape: the small hot stacks run 3x
-                # per round, the broad TopN candidate scan once —
-                # real traffic is zipf-ish, and this is exactly the
-                # pattern where whole-stack eviction loses (a broad
-                # scan evicts the hot set wholesale; paged admission
-                # streams its tail).  GroupBy stays in the exactness
-                # warm pass but out of the pressure loop: on CPU it
-                # runs the host-histogram path whose numpy twins are
-                # whole entries in BOTH modes — churning them would
-                # measure the host path, not eviction granularity.
-                hot = [(q, w) for q, w in zip(_MEM_QUERIES, baseline)
-                       if "TopN" not in q and "GroupBy" not in q]
-                cold = [(q, w) for q, w in zip(_MEM_QUERIES, baseline)
-                        if "TopN" in q]
-                for _ in range(reps):
-                    for q, want in hot * 3 + cold:
-                        t0 = time.perf_counter()
-                        got = repr(ex.execute("bench", q))
-                        lat.append(time.perf_counter() - t0)
-                        assert got == want, \
-                            f"budget-clamped result drift: {q}"
-                lat.sort()
-                nq = len(lat)
-                restacked = (cache.patched_bytes - p0
-                             + cache.rebuilt_bytes - r0)
-                accesses = (cache.hits - h0) + (cache.misses - m0)
-                cell = {
-                    "budget_bytes": budget,
-                    "queries": nq,
-                    "hit_rate": round(
-                        (cache.hits - h0) / max(accesses, 1), 3),
-                    "restacked_bytes_per_query": round(restacked / nq),
-                    "p50_ms": round(lat[nq // 2] * 1e3, 3),
-                    "p99_ms": round(
-                        lat[min(nq - 1, int(nq * 0.99))] * 1e3, 3),
-                }
-                mode = "paged" if paged else "whole"
-                out.setdefault(cell_key, {})[mode] = cell
-                log(f"mem-pressure {cell_key} {mode}: "
-                    f"hit={cell['hit_rate']} "
-                    f"restacked/q={cell['restacked_bytes_per_query']}B "
-                    f"p50={cell['p50_ms']}ms")
-                del ex
-                gc.collect()
-            ab = out[cell_key]
-            ab["restacked_ratio_whole_over_paged"] = round(
-                ab["whole"]["restacked_bytes_per_query"]
-                / max(ab["paged"]["restacked_bytes_per_query"], 1), 2)
-    finally:
-        for var, prev in (("PILOSA_TPU_MEMORY_PAGED", prev_paged),
-                          ("PILOSA_TPU_MEMORY_PAGE_BYTES",
-                           prev_page_bytes)):
-            if prev is None:
-                os.environ.pop(var, None)
-            else:
-                os.environ[var] = prev
-        memory.configure(budget_bytes=0)  # back to auto
-    return out
-
-
-# ---------------------------------------------------------------------------
-# chaos gauntlet (ISSUE 6): kill/rejoin + hedged-read A/B over a real
-# in-process cluster (3 ClusterNodes with HTTP RPC between them)
-# ---------------------------------------------------------------------------
-
-CHAOS_QUERIES = [
-    "Count(Row(f=1))",
-    "Count(Row(f=2))",
-    "Row(f=2)",
-    "Sum(Row(f=1), field=v)",
-    "TopN(f, n=3)",
-    "Count(Union(Row(f=1), Row(f=2)))",
-    "Count(Intersect(Row(f=1), Row(f=3)))",
-]
-
-
-def _build_cluster(n_nodes: int = 3, replica_n: int = 2,
-                   n_shards: int = 6, cols_per_shard: int = 64,
-                   lease_ttl: float = 5.0):
-    """In-process ClusterNode ring (real HTTP data plane between
-    nodes) populated through the replicated import path.  The lease
-    sits well above this box's GIL scheduling jitter — at 32 storm
-    clients a starved heartbeat thread must not false-DOWN a healthy
-    node (kill detection does not depend on the lease: a dead node's
-    closed socket fails over on connection-refused immediately)."""
-    from pilosa_tpu.cluster import ClusterNode, InMemDisCo
-    from pilosa_tpu.models.holder import Holder
-    from pilosa_tpu.shardwidth import SHARD_WIDTH
-
-    disco = InMemDisCo(lease_ttl=lease_ttl)
-    holders = [Holder() for _ in range(n_nodes)]
-    nodes = [ClusterNode(f"node{i}", disco, holder=holders[i],
-                         replica_n=replica_n,
-                         heartbeat_interval=0.2).open()
-             for i in range(n_nodes)]
-    nodes[0].apply_schema({"indexes": [{"name": "c", "fields": [
-        {"name": "f", "options": {"type": "set"}},
-        {"name": "v", "options": {"type": "int", "min": 0,
-                                  "max": 1 << 20}}]}]})
-    rows, cols, vals = [], [], []
-    for s in range(n_shards):
-        for i in range(cols_per_shard):
-            col = s * SHARD_WIDTH + (i * 9973) % SHARD_WIDTH
-            rows.append(1 + (i % 3))
-            cols.append(col)
-            vals.append((col * 7) % 1000)
-    nodes[0].import_bits("c", "f", rows, cols)
-    nodes[0].import_values("c", "v", cols, vals)
-    return nodes, holders, disco
-
-
-def _chaos_storm(node, queries, expected, n_clients: int,
-                 duration_s: float) -> dict:
-    """N client threads hammering the cluster query path; every
-    response is checked bit-exact against `expected` and timestamped
-    so event-window percentiles can be carved out afterwards."""
-    import threading
-
-    lock = threading.Lock()
-    lat: list[tuple[float, float]] = []  # (t_end, dt)
-    failed = 0
-    mismatched = 0
-    stop = time.perf_counter() + duration_s
-    barrier = threading.Barrier(n_clients)
-
-    def client(ci: int):
-        nonlocal failed, mismatched
-        my: list[tuple[float, float]] = []
-        my_failed = my_mis = 0
-        barrier.wait()
-        i = ci
-        while time.perf_counter() < stop:
-            q = queries[i % len(queries)]
-            i += 1
-            t0 = time.perf_counter()
-            try:
-                r = node.query("c", q)
-                if r["results"] != expected[q] or "partial" in r:
-                    my_mis += 1
-            except Exception:
-                my_failed += 1
-            my.append((time.perf_counter(), time.perf_counter() - t0))
-        with lock:
-            lat.extend(my)
-            failed += my_failed
-            mismatched += my_mis
-
-    threads = [threading.Thread(target=client, args=(ci,))
-               for ci in range(n_clients)]
-    t_start = time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall = time.perf_counter() - t_start
-    return {"lat": lat, "failed": failed, "mismatched": mismatched,
-            "wall": wall}
-
-
-def _pct(durs: list[float], q: float) -> float | None:
-    if not durs:
-        return None
-    durs = sorted(durs)
-    return round(durs[min(len(durs) - 1, int(len(durs) * q))] * 1e3, 3)
-
-
-def _storm_cell(storm: dict) -> dict:
-    durs = [d for _, d in storm["lat"]]
-    return {"requests": len(durs),
-            "failed": storm["failed"],
-            "mismatched": storm["mismatched"],
-            "qps": round(len(durs) / storm["wall"], 1)
-            if storm["wall"] > 0 else 0.0,
-            "p50_ms": _pct(durs, 0.5), "p99_ms": _pct(durs, 0.99)}
-
-
-def chaos_gauntlet(n_clients: int = 32, duration_s: float = 6.0,
-                   kill_at_s: float = 1.5,
-                   rejoin_at_s: float = 3.5) -> dict:
-    """The ROADMAP item 5 acceptance run: the mixed read gauntlet at
-    ``n_clients`` while one worker is KILLED mid-traffic (node-crash
-    fault through its heartbeat loop) and REJOINED via the warm-start
-    protocol (peer resync + flight-recorder cache prefill before
-    taking traffic).  Zero failed queries and a bounded p99 spike in
-    the kill→rejoin event window are the acceptance bars; writes made
-    while the victim is down prove the resync carried real deltas."""
-    import threading
-
-    from pilosa_tpu.cluster import ClusterNode
-    from pilosa_tpu.obs import faults, flight, metrics as _m
-
-    nodes, holders, disco = _build_cluster()
-    prev_rec = (flight.recorder.enabled, flight.recorder._ring.maxlen)
-    flight.recorder.configure(enabled=True, keep=4096)
-    out: dict = {"clients": n_clients, "duration_s": duration_s}
-    ev_names = ("node_down", "node_rejoin", "failover",
-                "hedge_fired", "hedge_won", "load_shed")
-    # snapshot so the cell reports THIS gauntlet's events, not the
-    # process-cumulative counters (other gauntlets run first)
-    ev0 = {e: _m.CLUSTER_EVENTS.value(event=e) for e in ev_names}
-    try:
-        expected = {q: nodes[0].query("c", q)["results"]
-                    for q in CHAOS_QUERIES}
-        for q in CHAOS_QUERIES:  # warm: per-node compile + stacks
-            nodes[0].query("c", q)
-        # fault-free baseline over the same cluster
-        base = _chaos_storm(nodes[0], CHAOS_QUERIES, expected,
-                            n_clients, duration_s=1.5)
-        out["baseline"] = _storm_cell(base)
-
-        events: dict[str, float] = {}
-
-        def driver():
-            try:
-                _driver()
-            except Exception as e:
-                # a failed kill/rejoin must surface as ITSELF in the
-                # cell (and fail the smoke), not as misleading
-                # downstream assertions about resync/exactness
-                out["driver_error"] = f"{type(e).__name__}: {e}"
-
-        def _driver():
-            from pilosa_tpu.cluster import InternalClient
-            t0 = time.perf_counter()
-            time.sleep(kill_at_s)
-            # kill: armed node-crash fires in the victim's heartbeat
-            # loop — it pauses (socket closed, beats stop) mid-traffic
-            faults.inject("node-crash", match="node2")
-            # wait until the socket is really gone before the
-            # while-down write: a write the victim still acks would
-            # leave the rejoin resync nothing to prove
-            probe = InternalClient(timeout=0.5, retries=0)
-            for _ in range(100):
-                try:
-                    probe.status(nodes[2].uri)
-                    time.sleep(0.05)
-                except Exception:
-                    break
-            events["kill"] = time.perf_counter() - t0
-            # writes while the victim is down: the rejoin resync must
-            # carry them (row 9 is outside the read mix, so reads stay
-            # bit-exact throughout)
-            from pilosa_tpu.shardwidth import SHARD_WIDTH
-            down_cols = [s * SHARD_WIDTH + 5 for s in range(6)]
-            nodes[0].import_bits("c", "f", [9] * len(down_cols),
-                                 down_cols)
-            time.sleep(max(rejoin_at_s - kill_at_s, 0.1))
-            t_r = time.perf_counter()
-            rejoined = ClusterNode("node2", disco, holder=holders[2],
-                                   replica_n=2,
-                                   heartbeat_interval=0.2)
-            rejoined.open(warm=True)
-            nodes[2] = rejoined
-            events["rejoin"] = time.perf_counter() - t0
-            events["warm_start_ms"] = round(
-                (time.perf_counter() - t_r) * 1e3, 1)
-            out["rejoin"] = {**(rejoined.warm_stats or {}),
-                             "warm_start_ms": events["warm_start_ms"]}
-
-        drv = threading.Thread(target=driver)
-        t_storm0 = time.perf_counter()
-        drv.start()
-        storm = _chaos_storm(nodes[0], CHAOS_QUERIES, expected,
-                             n_clients, duration_s)
-        drv.join()
-        cell = _storm_cell(storm)
-        # event window: kill → 1 s after the rejoin completed
-        w0 = t_storm0 + events.get("kill", 0.0)
-        w1 = t_storm0 + events.get("rejoin", duration_s) + 1.0
-        win = [d for t, d in storm["lat"] if w0 <= t <= w1]
-        cell["event_window_p99_ms"] = _pct(win, 0.99)
-        base_p99 = out["baseline"]["p99_ms"] or 1e-3
-        cell["event_window_p99_spike"] = round(
-            (cell["event_window_p99_ms"] or 0.0) / base_p99, 2)
-        out["chaos"] = cell
-        out["events_s"] = {k: round(v, 3) for k, v in events.items()
-                           if k != "warm_start_ms"}
-        # the rejoined node serves: fan-out THROUGH it stays exact,
-        # and the while-down write is visible cluster-wide
-        post = {q: nodes[2].query("c", q)["results"]
-                for q in CHAOS_QUERIES}
-        out["post_rejoin_exact"] = post == expected
-        out["resync_write_visible"] = \
-            nodes[2].query("c", "Count(Row(f=9))")["results"][0] == 6
-        out["cluster_events"] = {
-            e: _m.CLUSTER_EVENTS.value(event=e) - ev0[e]
-            for e in ev_names}
-        log(f"chaos c{n_clients}: {cell['requests']} reqs "
-            f"failed={cell['failed']} mism={cell['mismatched']} "
-            f"window p99={cell['event_window_p99_ms']}ms "
-            f"({cell['event_window_p99_spike']}x baseline "
-            f"{base_p99}ms)")
-    finally:
-        faults.clear("node-crash")
-        flight.recorder.configure(enabled=prev_rec[0],
-                                  keep=prev_rec[1])
-        for n in nodes:
-            try:
-                n.close()
-            except Exception:
-                pass
-    return out
-
-
-def hedge_ab_gauntlet(n_clients: int = 2, duration_s: float = 5.0,
-                      delay_ms: float = 200.0) -> dict:
-    """Hedged-read A/B (ISSUE 6 acceptance): with a ``delay_ms``
-    rpc-delay injected on ONE replica, read p99 without hedging grows
-    by the full injected delay; with hedging (delay auto-derived from
-    flight-recorder attempt records) it must come back to within 2x
-    of the no-fault baseline — bit-exact in both arms.  Low client
-    count on purpose: the A/B measures LATENCY restoration, and on a
-    GIL-bound CPU host extra clients turn hedge RPCs into scheduler
-    noise that swamps the per-request signal (on TPU serving hosts
-    the RPC threads park in sockets, not the GIL).  Every arm runs an
-    UNMEASURED pre-storm first: p99 over a few hundred requests is
-    within a whisker of the sample max, so one cold-path straggler —
-    a late compile, the hedged arm still converging its auto-derived
-    delay from an empty flight ring — flips the cell; the measured
-    storm must see steady state only."""
-    from pilosa_tpu.obs import faults, flight, metrics as _m
-
-    nodes, _holders, _disco = _build_cluster()
-    prev_rec = (flight.recorder.enabled, flight.recorder._ring.maxlen)
-    prev_hedge = os.environ.get("PILOSA_TPU_CLUSTER_HEDGE_MS")
-    flight.recorder.configure(enabled=True, keep=4096)
-    out: dict = {"clients": n_clients, "delay_injected_ms": delay_ms}
-    try:
-        expected = {q: nodes[0].query("c", q)["results"]
-                    for q in CHAOS_QUERIES}
-        for _ in range(3):  # warm: per-node compile + stacks
-            for q in CHAOS_QUERIES:
-                nodes[0].query("c", q)
-        # baseline (no fault, hedging moot) — also populates the
-        # flight ring the auto-derived hedge delay reads from
-        os.environ["PILOSA_TPU_CLUSTER_HEDGE_MS"] = "-1"
-        _chaos_storm(nodes[0], CHAOS_QUERIES, expected,
-                     n_clients, duration_s=1.5)  # unmeasured
-        base = _chaos_storm(nodes[0], CHAOS_QUERIES, expected,
-                            n_clients, duration_s)
-        out["baseline"] = _storm_cell(base)
-        # the slow replica: every RPC to node1 pays delay_ms
-        victim_uri = nodes[1].uri
-        faults.inject("rpc-delay", match=victim_uri, times=0,
-                      delay_s=delay_ms / 1e3)
-        # delta base: only hedges fired by THIS A/B's arms count
-        fired0 = _m.CLUSTER_EVENTS.value(event="hedge_fired")
-        won0 = _m.CLUSTER_EVENTS.value(event="hedge_won")
-        for mode, hedge_env in (("nohedge", "-1"), ("hedged", "0")):
-            os.environ["PILOSA_TPU_CLUSTER_HEDGE_MS"] = hedge_env
-            # fresh ring per arm: the hedged arm's auto-derived delay
-            # must converge from ITS OWN attempt records, not inherit
-            # the nohedge arm's delay-poisoned tail
-            flight.recorder.clear()
-            # unmeasured convergence pre-storm (same length per arm):
-            # lets the hedged arm derive its delay from real attempt
-            # records before the measured window opens
-            _chaos_storm(nodes[0], CHAOS_QUERIES, expected,
-                         n_clients, duration_s=1.5)
-            storm = _chaos_storm(nodes[0], CHAOS_QUERIES, expected,
-                                 n_clients, duration_s)
-            out[mode] = _storm_cell(storm)
-        base_p99 = out["baseline"]["p99_ms"] or 1e-3
-        out["hedged_p99_over_baseline"] = round(
-            (out["hedged"]["p99_ms"] or 0.0) / base_p99, 2)
-        out["nohedge_p99_over_baseline"] = round(
-            (out["nohedge"]["p99_ms"] or 0.0) / base_p99, 2)
-        out["hedges"] = {
-            "fired": _m.CLUSTER_EVENTS.value(event="hedge_fired")
-            - fired0,
-            "won": _m.CLUSTER_EVENTS.value(event="hedge_won") - won0}
-        log(f"hedge A/B: baseline p99={base_p99}ms | "
-            f"delay {delay_ms}ms nohedge "
-            f"p99={out['nohedge']['p99_ms']}ms | hedged "
-            f"p99={out['hedged']['p99_ms']}ms "
-            f"({out['hedged_p99_over_baseline']}x baseline)")
-    finally:
-        faults.clear("rpc-delay")
-        if prev_hedge is None:
-            os.environ.pop("PILOSA_TPU_CLUSTER_HEDGE_MS", None)
-        else:
-            os.environ["PILOSA_TPU_CLUSTER_HEDGE_MS"] = prev_hedge
-        flight.recorder.configure(enabled=prev_rec[0],
-                                  keep=prev_rec[1])
-        for n in nodes:
-            try:
-                n.close()
-            except Exception:
-                pass
-    return out
-
-
-def _preview(res):
-    r = res[0]
-    if isinstance(r, list):
-        return [(p.id, p.count) if hasattr(p, "id")
-                else (tuple(g["row_id"] for g in p.group), p.count)
-                for p in r[:3]]
-    return r
-
-
-def main() -> None:
-    platform, probe_n = probe_backend()
-    # probe_backend returns n=0 ONLY on the tunnel-failure fallback;
-    # an explicit JAX_PLATFORMS=cpu smoke run reports its real device
-    # count
-    tunnel_down = platform == "cpu" and probe_n == 0
-    import jax
-    if platform == "cpu":
-        # override the site customization's forced TPU selection
-        jax.config.update("jax_platforms", "cpu")
-    devs = jax.devices()
-    platform = devs[0].platform
-    n_chips = len(devs) if platform != "cpu" else 1
-    on_tpu = platform not in ("cpu",)
-
-    n_shards = int(os.environ.get(
-        "PILOSA_BENCH_SHARDS", "954" if on_tpu else "8"))
-    topn_rows = int(os.environ.get("PILOSA_BENCH_TOPN_ROWS", "8"))
-    reps = 20 if on_tpu else 5
-
-    h, cells = build_index(n_shards, topn_rows)
-    full = run_queries(h, reps, f"{n_shards}sh")
-    # concurrent-serving A/B: the dispatch-coalescing serving path
-    # (executor/serving.py) vs per-query execution, same holder
-    serving = serving_gauntlet(h)
-    # mixed read/write gauntlet: incremental stack maintenance
-    # (delta patching) A/B under 32 readers + 1 point writer
-    mixed = mixed_rw_gauntlet(h)
-    # flight-recorder overhead A/B (ISSUE 4 acceptance: recorder-off
-    # cost < 2% on the serving gauntlet, recorded machine-readably)
-    overhead = tracing_overhead_gauntlet(h)
-    # HBM residency gauntlet: paged vs whole-stack eviction under a
-    # clamped device budget at 0.5x/1x/2x overcommit, bit-exactness
-    # asserted throughout
-    mem_pressure = memory_pressure_gauntlet(h)
-    # chaos gauntlet (ISSUE 6): kill + warm-start rejoin of a worker
-    # under the 32-client mixed gauntlet on a real in-process cluster,
-    # plus the hedged-read A/B against an injected slow replica
-    chaos = chaos_gauntlet()
-    hedge_ab = hedge_ab_gauntlet()
-    # write-storm gauntlet (ISSUE 7): multi-writer mutation storm
-    # through the streaming write plane with a kill-mid-window +
-    # restart + replay, acked-loss and bit-exact convergence asserted
-    write_storm = write_storm_gauntlet()
-    # RTT-independent device time for the sub-RTT north-star scans
-    cal = loop_calibrate(h) if on_tpu else None
-
-    # dispatch-floor calibration: same engine path, 1 shard, so the
-    # wall-time difference is pure device scan time at scale
-    h_tiny, _ = build_index(1, topn_rows)
-    tiny = run_queries(h_tiny, reps, "1sh")
-
-    p50 = {k: statistics.median(v) for k, v in full.items()}
-    p50_tiny = {k: statistics.median(v) for k, v in tiny.items()}
-    net_ms = {k: max((p50[k] - p50_tiny[k]) * 1e3, 1e-3) for k in p50}
-    # the headline tracks the NORTH-STAR pair (BASELINE.json:
-    # Count(Intersect)+TopK); able_groupby reports alongside.  On TPU
-    # the loop-calibrated device times are authoritative — the wall
-    # subtraction is noise-dominated once a scan is under the tunnel's
-    # per-dispatch RTT jitter
-    if cal is not None:
-        workload_ms = cal["count_intersect"] + cal["topn"]
-    else:
-        workload_ms = net_ms["count_intersect"] + net_ms["topn"]
-    equiv16_ms = workload_ms * (n_chips / NORTH_STAR_CHIPS)
-    wall_ms = sum(p50.values()) * 1e3
-
-    log(f"platform={platform} chips={n_chips} shards={n_shards} "
-        f"cells={cells/1e9:.2f}e9")
-    log(f"net device p50: count_intersect={net_ms['count_intersect']:.3f}ms "
-        f"topn={net_ms['topn']:.3f}ms workload={workload_ms:.3f}ms "
-        f"(wall p50 incl tunnel dispatch: {wall_ms:.1f}ms)")
-    log(f"v5e-16 equivalent (shard-parallel, {n_chips} chip measured): "
-        f"{equiv16_ms:.3f}ms vs north star {NORTH_STAR_MS}ms")
-
-    suffix = "" if on_tpu else "_cpu_fallback"
-    result = {
-        "metric": ("engine_count_intersect_plus_topn_p50_v5e16_equiv"
-                   + suffix),
-        "value": round(equiv16_ms, 4),
-        "unit": "ms",
-        "vs_baseline": round(NORTH_STAR_MS / equiv16_ms, 3),
-        # raw, unextrapolated record (VERDICT r02 item 1c): platform,
-        # scale, and wall p50s incl. tunnel dispatch for both runs
-        "platform": platform,
-        "chips": n_chips,
-        "shards": n_shards,
-        "cells": cells,
-        "raw_wall_p50_ms": {k: round(v * 1e3, 3) for k, v in p50.items()},
-        "raw_wall_p50_1shard_ms": {k: round(v * 1e3, 3)
-                                   for k, v in p50_tiny.items()},
-        "net_device_p50_ms": {k: round(v, 3) for k, v in net_ms.items()},
-        # GroupBy combo-count sweep (one-pass group-code path):
-        # roughly flat in C is the acceptance signal
-        "groupby_combo_sweep_wall_p50_ms": {
-            "c10": round(p50["groupby_c10"] * 1e3, 3),
-            "c60": round(p50["able_groupby"] * 1e3, 3),
-            "c240": round(p50["groupby_c240"] * 1e3, 3),
-        },
-        # concurrent-serving gauntlet: QPS + p50/p99 at 1/8/32
-        # clients, serving path (batcher + result cache) on vs off
-        "serving_gauntlet": serving,
-        # mixed read/write gauntlet: 32 readers + 1 point writer at
-        # 10/100/1000 writes/s, incremental stack maintenance (delta
-        # patching) on vs off — read p50/p99 + restacked bytes/write
-        "mixed_rw_gauntlet": mixed,
-        # flight-recorder A/B: qps with the recorder on vs off and the
-        # resulting overhead percentage (check.sh gates a smoke
-        # version of this at tier-1 time)
-        "tracing_overhead": overhead,
-        # memory-pressure gauntlet: working set at 0.5x/1x/2x of the
-        # device budget, paged vs whole-stack eviction A/B (hit rate,
-        # restacked bytes/query, p50/p99) — ISSUE 5 acceptance is the
-        # restacked ratio > 1 at the 2x overcommit point
-        "memory_pressure_gauntlet": mem_pressure,
-        # chaos gauntlet: worker killed + warm-start-rejoined under
-        # the 32-client mixed gauntlet (ISSUE 6 acceptance: zero
-        # failed queries, bounded event-window p99 spike) and the
-        # hedged-read A/B vs a 200 ms slow replica (hedging restores
-        # p99 toward the no-fault baseline, bit-exact in both arms)
-        "chaos_gauntlet": chaos,
-        "hedge_ab_gauntlet": hedge_ab,
-        # write-storm gauntlet: sustained coalesced ingest at the
-        # 50k mutations/s bar with a kill-mid-window + restart —
-        # zero acked-record loss, bit-exact vs cold rebuild, read
-        # p99 vs the read-only baseline (latency ratio hard-gated
-        # only on TPU/large-box runs)
-        "write_storm_gauntlet": write_storm,
-    }
-    if cal is not None:
-        result["loop_calibrated_device_ms"] = {
-            k: round(v, 4) for k, v in cal.items()}
-    if on_tpu:
-        # persist the full raw record so future fallback runs can
-        # re-emit real TPU evidence machine-readably (VERDICT r03 #1);
-        # temp+rename so a kill mid-dump never strands truncated JSON
-        record = dict(result)
-        record["timestamp_utc"] = time.strftime(
-            "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-        record["reps"] = reps
-        tmp = TPU_RECORD_PATH + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(record, f, indent=1, sort_keys=True)
-            f.write("\n")
-        os.replace(tmp, TPU_RECORD_PATH)
-        log(f"TPU record written to {TPU_RECORD_PATH}")
-    else:
-        # carry the committed TPU record verbatim (if any) so the
-        # round artifact stays machine-verifiable on CPU runs
-        attach_tpu_record(result, tunnel_down=tunnel_down)
-    print(json.dumps(result))
-
-
-def overhead_smoke() -> int:
-    """check.sh tier-1 smoke (bench.py --overhead-smoke): a tiny
-    serving micro-bench with the flight recorder on vs off.  The HARD
-    gates are the stable fixed-cost probes (see flight_cost_probe —
-    the qps A/B jitters ±30% on a shared 2-core box, far above the
-    ~5% true effect, so it only backstops catastrophic regressions):
-
-    - disabled cycle (4-thread) <= PILOSA_TPU_OVERHEAD_OFF_MAX_US
-      (default 8us — measured ~1.2us; this is the always-on path the
-      <2% acceptance bound speaks to)
-    - enabled cycle (4-thread) <= PILOSA_TPU_OVERHEAD_ON_MAX_US
-      (default 60us — measured ~11us; a hot-path lock convoy shows
-      up here as ~10x)
-    - median qps overhead <= PILOSA_TPU_OVERHEAD_MAX_PCT (default 60)
-    """
-    import jax
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    h, _ = build_index(2, 4)
-    out = tracing_overhead_gauntlet(h, n_clients=4, duration_s=0.6,
-                                    rounds=3)
-    lim_pct = float(os.environ.get("PILOSA_TPU_OVERHEAD_MAX_PCT", "60"))
-    lim_off = float(os.environ.get("PILOSA_TPU_OVERHEAD_OFF_MAX_US", "8"))
-    lim_on = float(os.environ.get("PILOSA_TPU_OVERHEAD_ON_MAX_US", "60"))
-    out["thresholds"] = {"qps_overhead_pct": lim_pct,
-                         "disabled_cycle_us": lim_off,
-                         "enabled_cycle_us": lim_on}
-    print(json.dumps({"metric": "tracing_overhead_smoke", **out}))
-    failures = []
-    if out["disabled_cycle_us_4t"] > lim_off:
-        failures.append(
-            f"disabled cycle {out['disabled_cycle_us_4t']}us > "
-            f"{lim_off}us")
-    if out["enabled_cycle_us_4t"] > lim_on:
-        failures.append(
-            f"enabled cycle {out['enabled_cycle_us_4t']}us > "
-            f"{lim_on}us")
-    if out["overhead_pct"] is not None and out["overhead_pct"] > lim_pct:
-        failures.append(
-            f"qps overhead {out['overhead_pct']}% > {lim_pct}%")
-    for msg in failures:
-        log("tracing-overhead smoke: " + msg)
-    return 1 if failures else 0
-
-
-def memory_smoke() -> int:
-    """check.sh tier-1 smoke (bench.py --memory-smoke): clamp the
-    device budget below the working set and prove the residency
-    manager's acceptance bar cheaply —
-
-    - every query shape (Count/Row/TopN/GroupBy/Sum) stays BIT-EXACT
-      vs the unbounded run across repeated rounds (paging + eviction
-      correctness under genuine pressure);
-    - the accounted resident bytes never exceed the clamped budget;
-    - an injected RESOURCE_EXHAUSTED is absorbed (evict + retry), a
-      double injection degrades to the host engine — neither fails
-      the query, and the ladder's terminal 'raised' counter stays 0.
-    """
-    import gc
-
-    import jax
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    from pilosa_tpu import memory
-    from pilosa_tpu.executor.executor import Executor
-    from pilosa_tpu.memory import pressure
-    from pilosa_tpu.obs import metrics
-
-    h, _ = build_index(2, 4)
-    failures: list[str] = []
-    try:
-        memory.configure(budget_bytes=1 << 40)
-        ex0 = Executor(h)
-        baseline = [repr(ex0.execute("bench", q)) for q in _MEM_QUERIES]
-        ws = int(ex0.stacked.cache.nbytes)
-        del ex0
-        gc.collect()
-        budget = max(ws // 2, 1 << 20)
-        memory.configure(budget_bytes=budget)
-        ex = Executor(h)
-        cache = ex.stacked.cache
-        for _ in range(3):
-            for q, want in zip(_MEM_QUERIES, baseline):
-                got = repr(ex.execute("bench", q))
-                if got != want:
-                    failures.append(f"result drift under budget: {q}")
-            if cache.nbytes > budget:
-                failures.append(
-                    f"cache over budget: {cache.nbytes} > {budget}")
-        if memory.ledger().total_bytes > budget:
-            failures.append("ledger total exceeded the clamped budget")
-        raised0 = metrics.OOM_TOTAL.value(outcome="raised")
-        for inject, rung in ((1, "evict+retry"), (2, "host fallback")):
-            pressure.inject_oom(inject)
-            try:
-                got = repr(ex.execute("bench", _MEM_QUERIES[0]))
-                if got != baseline[0]:
-                    failures.append(f"OOM {rung} result drift")
-            except Exception as e:  # the whole point is NO escape
-                failures.append(f"injected OOM escaped ({rung}): {e}")
-        if metrics.OOM_TOTAL.value(outcome="raised") > raised0:
-            failures.append("OOM passed the backstop unabsorbed")
-        out = {
-            "metric": "memory_pressure_smoke",
-            "working_set_bytes": ws,
-            "budget_bytes": budget,
-            "stack_hits": cache.hits,
-            "stack_misses": cache.misses,
-            "oom_absorbed": {
-                "retry_ok": metrics.OOM_TOTAL.value(outcome="retry_ok"),
-                "host_fallback": metrics.OOM_TOTAL.value(
-                    outcome="host_fallback"),
-            },
-            "failures": failures,
-        }
-        print(json.dumps(out))
-    finally:
-        memory.configure(budget_bytes=0)  # back to auto
-    for msg in failures:
-        log("memory-pressure smoke: " + msg)
-    return 1 if failures else 0
-
-
-def chaos_smoke() -> int:
-    """check.sh tier-1 smoke (bench.py --chaos-smoke): a short
-    kill/rejoin run on a small in-process cluster proving the ISSUE 6
-    acceptance bars cheaply —
-
-    - ZERO failed queries while a worker dies (node-crash fault
-      through its heartbeat loop) and warm-start-rejoins under a
-      concurrent read storm;
-    - every response BIT-EXACT vs the fault-free expectations (and
-      never silently partial);
-    - the rejoin resync actually carried the writes made while the
-      victim was down (block repair > 0, write visible through the
-      rejoined node).
-    """
-    import jax
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    out = chaos_gauntlet(
-        n_clients=int(os.environ.get("PILOSA_TPU_CHAOS_CLIENTS", "8")),
-        duration_s=float(os.environ.get(
-            "PILOSA_TPU_CHAOS_DURATION_S", "4")),
-        kill_at_s=1.0, rejoin_at_s=2.2)
-    failures: list[str] = []
-    if out.get("driver_error"):
-        # the kill/rejoin driver's own failure is the root cause —
-        # lead with it instead of the downstream resync assertions
-        failures.append("chaos driver failed: " + out["driver_error"])
-    chaos = out.get("chaos", {})
-    if chaos.get("failed", 1):
-        failures.append(f"{chaos.get('failed')} queries failed during "
-                        "kill/rejoin (acceptance: zero)")
-    if chaos.get("mismatched", 1):
-        failures.append(f"{chaos.get('mismatched')} responses diverged "
-                        "from the fault-free results")
-    if not out.get("post_rejoin_exact"):
-        failures.append("post-rejoin fan-out through the rejoined "
-                        "node diverged")
-    if not out.get("resync_write_visible"):
-        failures.append("write made while the victim was down is not "
-                        "visible after warm-start resync")
-    if not (out.get("rejoin", {}).get("sync", {}) or {}).get("blocks"):
-        failures.append("warm-start resync repaired zero fragment "
-                        "blocks (expected the while-down write)")
-    out["failures"] = failures
-    print(json.dumps({"metric": "chaos_smoke", **out}))
-    for msg in failures:
-        log("chaos smoke: " + msg)
-    return 1 if failures else 0
-
-
-def write_smoke() -> int:
-    """check.sh tier-1 smoke (bench.py --write-smoke): a short
-    sustained-write burst through the streaming write plane with one
-    injected kill-mid-window (wal-torn) + restart + replay, proving
-    the ISSUE 7 acceptance bars cheaply — CORRECTNESS GATES ONLY
-    (zero acked-record loss, bit-exact convergence vs a cold rebuild
-    and vs a fresh reopen, replay actually exercised, zero read
-    failures); the read-latency ratio is reported but never gated on
-    a small box (scheduler noise swamps it).
-    """
-    import jax
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    out = write_storm_gauntlet(
-        n_readers=int(os.environ.get("PILOSA_TPU_WRITE_READERS", "8")),
-        n_writers=int(os.environ.get("PILOSA_TPU_WRITE_WRITERS", "2")),
-        post_crash_s=float(os.environ.get(
-            "PILOSA_TPU_WRITE_DURATION_S", "2")),
-        crash_after_windows=2,
-        rate_target=int(os.environ.get(
-            "PILOSA_TPU_WRITE_RATE", "50000")))
-    failures: list[str] = []
-    if out.get("driver_error"):
-        failures.append("crash driver failed: " + out["driver_error"])
-    if out.get("writer_errors"):
-        failures.append("writer errors: "
-                        + "; ".join(out["writer_errors"]))
-    storm = out.get("storm", {})
-    if not out.get("bit_exact_vs_cold_rebuild"):
-        failures.append("restarted state diverged from the cold "
-                        "rebuild (acked-record loss or replay "
-                        "double-apply)")
-    if not out.get("reopen_bit_exact"):
-        failures.append("fresh reopen from disk diverged (acked "
-                        "writes not durable)")
-    if storm.get("acked_mutations", 0) <= 0:
-        failures.append("zero mutations acked — the plane never "
-                        "landed a window")
-    if out.get("events_s", {}).get("windows_before_crash", 0) < 1:
-        failures.append("kill struck before any window landed — "
-                        "nothing acked was ever at risk")
-    if storm.get("windows_failed", 0) < 1:
-        failures.append("no window failed — the kill never happened")
-    if storm.get("replayed_batches", 0) < 1:
-        failures.append("no batch replayed — recovery untested")
-    if storm.get("windows_landed_post_restart", 0) < 1:
-        failures.append("restarted plane never landed a window — "
-                        "recovery unproductive")
-    if storm.get("read_failed", 1):
-        failures.append(f"{storm.get('read_failed')} reads failed "
-                        "during the kill/restart")
-    out["failures"] = failures
-    print(json.dumps({"metric": "write_storm_smoke", **out}))
-    for msg in failures:
-        log("write-storm smoke: " + msg)
-    return 1 if failures else 0
-
+from bench.main import dispatch
 
 if __name__ == "__main__":
-    if "--overhead-smoke" in sys.argv:
-        sys.exit(overhead_smoke())
-    if "--memory-smoke" in sys.argv:
-        sys.exit(memory_smoke())
-    if "--chaos-smoke" in sys.argv:
-        sys.exit(chaos_smoke())
-    if "--write-smoke" in sys.argv:
-        sys.exit(write_smoke())
-    try:
-        main()
-    except Exception as e:  # clear failure JSON — never a bare crash
-        print(json.dumps({
-            "metric": "engine_count_intersect_plus_topn_p50_v5e16_equiv",
-            "value": None, "unit": "ms", "vs_baseline": None,
-            "error": f"{type(e).__name__}: {e}"[:400],
-        }))
-        raise
+    sys.exit(dispatch(sys.argv))

@@ -1,0 +1,6 @@
+import sys
+
+from bench.main import dispatch
+
+if __name__ == "__main__":
+    sys.exit(dispatch(sys.argv))

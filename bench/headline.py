@@ -1,0 +1,144 @@
+"""North-star headline queries through the real engine: wall p50s at
+full scale and 1 shard (dispatch-floor subtraction) plus the
+RTT-independent loop-calibrated device times."""
+
+from __future__ import annotations
+
+import statistics
+import time
+
+from bench.common import _preview, log
+
+
+def run_queries(h, reps: int, label: str) -> dict[str, list[float]]:
+    """Time the two north-star queries through Executor.execute."""
+    from pilosa_tpu.executor.executor import Executor
+
+    ex = Executor(h)
+    queries = {
+        "count_intersect": "Count(Intersect(Row(a=1), Row(b=1)))",
+        "topn": "TopN(t, n=10)",
+        # filtered TopN: exact full candidate scan (cache none) vs
+        # the ranked-cache-bounded scan (VERDICT r03 item 5) — same
+        # data, results asserted equal below
+        "topn_filtered": "TopN(t, Row(a=1), n=10)",
+        "topn_ranked_filtered": "TopN(tr, Row(a=1), n=10)",
+        # the reference's own 1B-row gauntlet query shape
+        # (qa/scripts/perf/able/ableTest.sh:63)
+        "able_groupby": "GroupBy(Rows(edu), Rows(gen), Rows(dom), "
+                        "aggregate=Sum(field=age))",
+        # combo-count sweep around the 60-combo gauntlet shape: the
+        # one-pass group-code path must hold roughly FLAT wall time
+        # from 10 to 240 combos (its traffic is O(S*W), combo-free),
+        # where the per-combo paths scale linearly in C
+        "groupby_c10": "GroupBy(Rows(gen), Rows(dom), "
+                       "aggregate=Sum(field=age))",
+        "groupby_c240": "GroupBy(Rows(edu), Rows(gen), Rows(dom), "
+                        "Rows(reg), aggregate=Sum(field=age))",
+    }
+    # warmup: compiles the stacked programs + uploads the tile stacks
+    warm = {}
+    for name, q in queries.items():
+        t0 = time.perf_counter()
+        res = ex.execute("bench", q)
+        warm[name] = res
+        log(f"[{label}] warm {name}: {time.perf_counter() - t0:.2f}s "
+            f"(compile+upload) result={_preview(res)}")
+    # exactness: the ranked-cache-bounded filtered TopN must equal
+    # the full scan (same underlying rows; covering cache)
+    a = [(p.id, p.count) for p in warm["topn_filtered"][0]]
+    b = [(p.id, p.count) for p in warm["topn_ranked_filtered"][0]]
+    assert a == b, f"ranked TopN != exact TopN: {a} vs {b}"
+    times: dict[str, list[float]] = {k: [] for k in queries}
+    for _ in range(reps):
+        for name, q in queries.items():
+            t0 = time.perf_counter()
+            ex.execute("bench", q)
+            times[name].append(time.perf_counter() - t0)
+    for name, ts in times.items():
+        log(f"[{label}] {name}: p50={statistics.median(ts)*1e3:.2f}ms "
+            f"min={min(ts)*1e3:.2f}ms max={max(ts)*1e3:.2f}ms")
+    return times
+
+
+def loop_calibrate(h, reps: int = 5) -> dict[str, float]:
+    """Per-execution DEVICE time (ms) of the two north-star scans,
+    measured RTT-independently: one dispatch runs the scan `iters`
+    times in a lax.fori_loop whose carry perturbs the input by an
+    opaque zero (so XLA cannot hoist the loop-invariant body), and
+    per-iteration time = (t_iters - t_1) / (iters - 1).  Needed
+    because the tunnel's per-dispatch RTT jitter (±6 ms between runs)
+    now exceeds the sub-RTT device scan itself, making the
+    full-vs-tiny wall subtraction go negative (measured r03)."""
+    import jax
+    import jax.numpy as jnp
+    from pilosa_tpu.executor.executor import Executor
+    from pilosa_tpu.models.view import VIEW_STANDARD
+    from pilosa_tpu.ops import bitmap as bm
+
+    ex = Executor(h)
+    idx = h.index("bench")
+    eng = ex.stacked
+    fa, fb, ft = idx.field("a"), idx.field("b"), idx.field("t")
+    shards = tuple(ft.views[VIEW_STANDARD].shards)
+    a = eng.row_stack(idx, fa, (VIEW_STANDARD,), 1, shards)
+    b = eng.row_stack(idx, fb, (VIEW_STANDARD,), 1, shards)
+    t_rows = sorted({r for s in shards
+                     for r in ft.views[VIEW_STANDARD]
+                     .fragment(s).row_ids})
+    rows = eng.rows_stack_for(idx, ft, (VIEW_STANDARD,), t_rows, shards)
+
+    @jax.jit
+    def count_loop(aa0, bb, n):
+        def body(_i, carry):
+            acc, aa = carry
+            z = (acc & 0).astype(jnp.uint32)  # opaque zero: no hoist
+            aa = aa.at[0, 0].add(z)
+            c = jnp.sum(bm.count(jnp.bitwise_and(aa, bb)))
+            return acc + c.astype(jnp.int32), aa
+        acc, _ = jax.lax.fori_loop(0, n, body, (jnp.int32(0), aa0))
+        return acc
+
+    @jax.jit
+    def rows_loop(rr0, n):
+        r = rr0.shape[0]
+        def body(_i, carry):
+            acc, rr = carry
+            z = (acc[0] & 0).astype(jnp.uint32)
+            rr = rr.at[0, 0, 0].add(z)
+            c = jnp.sum(bm.count(rr), axis=1).astype(jnp.int32)
+            return acc + c, rr
+        acc, _ = jax.lax.fori_loop(
+            0, n, body, (jnp.zeros(r, jnp.int32), rr0))
+        return acc
+
+    import numpy as np
+    out = {}
+    # n_big sized so loop compute >> the tunnel's RTT jitter; every
+    # timed call uses a FRESH n (the tunnel layer can serve repeated
+    # identical (executable, args) dispatches from a cache — measured:
+    # repeats return in 0.03 ms against a ~75 ms RTT), and timing is
+    # a VALUE fetch (block_until_ready does not block through the
+    # tunnel).  Correct per-iteration counts were verified: the
+    # returned accumulator scales exactly linearly with n (mod 2^32).
+    for name, fn, args, n_big in (
+            ("count_intersect", count_loop, (a, b), 1024),
+            ("topn", rows_loop, (rows,), 256)):
+        np.asarray(fn(*args, 7))  # compile + warm
+        fresh = iter(range(1, 1000))
+
+        def med(base, k):
+            ts = []
+            for _ in range(reps):
+                n = base + next(fresh)  # never repeat an n
+                t0 = time.perf_counter()
+                np.asarray(fn(*args, n))
+                ts.append(time.perf_counter() - t0)
+            return statistics.median(ts)
+        t_small = med(0, 0)       # n in [1, reps]: ~pure RTT
+        t_big = med(n_big, 0)     # n_big + small offsets
+        per_iter = (t_big - t_small) / n_big
+        out[name] = max(per_iter * 1e3, 1e-3)
+        log(f"loop-calibrated {name}: {out[name]:.4f}ms/scan "
+            f"(slope over {n_big} in-program iterations)")
+    return out

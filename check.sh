@@ -11,7 +11,7 @@ BUDGET="${CHECK_BUDGET_S:-870}"
 
 if command -v ruff >/dev/null 2>&1; then
     echo "== ruff =="
-    ruff check pilosa_tpu tests bench.py || exit 1
+    ruff check pilosa_tpu tests bench.py bench || exit 1
 else
     echo "check.sh: ruff not installed — skipping lint" >&2
 fi
@@ -62,6 +62,20 @@ echo "== write-storm smoke =="
 if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     python bench.py --write-smoke; then
     echo "check.sh: write-storm smoke failed" >&2
+    exit 1
+fi
+
+echo "== ragged smoke =="
+# ragged dispatch + QoS admission gate (bench.py --ragged-smoke):
+# mixed-index traffic through the fused page-table program +
+# admission scheduler — CORRECTNESS-ONLY hard gates (bit-exact vs
+# solo, zero failed, backpressure sheds as typed 503 + Retry-After,
+# the ragged path actually engaged); latency/dispatch ratios are
+# recorded in the BENCH JSON, never asserted (2-core-box flake rule —
+# the committed BENCH_r08 gauntlet run asserts the ratios).
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python bench.py --ragged-smoke; then
+    echo "check.sh: ragged smoke failed" >&2
     exit 1
 fi
 

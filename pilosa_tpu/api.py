@@ -102,10 +102,13 @@ class API:
     # ------------------------------------------------------------------
 
     def query(self, index: str, pql: str, shards: list[int] | None = None,
-              profile: bool = False, remote: bool = False) -> dict:
+              profile: bool = False, remote: bool = False,
+              qos=None) -> dict:
         """PQL query (api.go:209 API.Query).  Returns the full
         QueryResponse dict: {"results": [...]} (+"profile" spans when
-        requested, tracing/tracing.go:22-50 behavior)."""
+        requested, tracing/tracing.go:22-50 behavior).  ``qos``
+        (executor/sched.py QoS) carries the request's tenant/priority/
+        deadline admission intent from the transport headers."""
         t0 = time.time()
         from pilosa_tpu.pql import is_write_query
         if is_write_query(pql):
@@ -128,7 +131,7 @@ class API:
                 # profiled query no longer forfeits batching, and its
                 # profile shows what the batch actually did.
                 results = self.executor.execute_serving(
-                    index, pql, shards, remote=remote)
+                    index, pql, shards, remote=remote, qos=qos)
             except (ExecError, ParseError, ValueError, KeyError) as e:
                 raise ApiError(str(e), 400)
         finally:

@@ -42,6 +42,21 @@ class Config:
     serving_batch_window_ms: float = 1.0
     serving_batch_max: int = 32
     serving_cache_mb: int = 64
+    # ragged paged dispatch + QoS admission (executor/ragged.py,
+    # executor/sched.py): ragged fuses a whole mixed batch — different
+    # indexes and shard subsets — into ONE page-table device program;
+    # admission classes keep point reads ahead of heavy analytics
+    # (heavy-slots bounds concurrent heavy queries, queue-max bounds
+    # the wait queue, overflow sheds typed 503 + Retry-After).
+    # tenant-weights ("analytics:4,adhoc:1") weight the per-tenant
+    # fair queue; default-deadline-ms applies to requests that carried
+    # no X-Pilosa-Deadline-Ms of their own (0 = none).
+    serving_ragged: bool = True
+    serving_admission: bool = True
+    serving_heavy_slots: int = 2
+    serving_queue_max: int = 128
+    serving_tenant_weights: str = ""
+    serving_default_deadline_ms: float = 0.0
     # incremental stack maintenance (executor/stacked.py delta
     # patching + models/fragment.py delta log): patch device-resident
     # stacks on write instead of rebuilding them.  delta-log-max
@@ -179,6 +194,12 @@ _TOML_KEYS = {
     "serving.batch-window-ms": "serving_batch_window_ms",
     "serving.batch-max": "serving_batch_max",
     "serving.cache-mb": "serving_cache_mb",
+    "serving.ragged": "serving_ragged",
+    "serving.admission": "serving_admission",
+    "serving.heavy-slots": "serving_heavy_slots",
+    "serving.queue-max": "serving_queue_max",
+    "serving.tenant-weights": "serving_tenant_weights",
+    "serving.default-deadline-ms": "serving_default_deadline_ms",
     "stacked.patch": "stack_patch",
     "stacked.delta-log-max": "stack_delta_log_max",
     "stacked.patch-max-frac": "stack_patch_max_frac",

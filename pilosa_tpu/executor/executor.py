@@ -84,27 +84,33 @@ class Executor(AdvancedOps):
     def enable_serving(self, window_s: float = 0.001,
                        max_batch: int = 32,
                        cache_bytes: int = 64 << 20,
-                       batching: bool = True):
+                       batching: bool = True, **qos_kwargs):
         """Attach the serving layer (executor/serving.py): concurrent
         queries coalesce into one device dispatch per admission window
-        and repeated reads serve from the write-version-guarded result
-        cache.  Returns the layer for introspection."""
+        (ragged cross-index page-table fusion when possible,
+        executor/ragged.py) and repeated reads serve from the
+        write-version-guarded result cache.  ``qos_kwargs`` forward to
+        the admission scheduler (ragged/admission/heavy_slots/
+        queue_max/tenant_weights/default_deadline_ms).  Returns the
+        layer for introspection."""
         from pilosa_tpu.executor.serving import ServingLayer
         self.serving = ServingLayer(self, window_s=window_s,
                                     max_batch=max_batch,
                                     cache_bytes=cache_bytes,
-                                    batching=batching)
+                                    batching=batching, **qos_kwargs)
         return self.serving
 
     def execute_serving(self, index_name: str, query: str | Query,
                         shards: list[int] | None = None,
-                        remote: bool = False) -> list:
-        """Serving-path entry: routes through the micro-batcher +
-        result cache when enabled, else plain execute()."""
+                        remote: bool = False, qos=None) -> list:
+        """Serving-path entry: routes through the admission scheduler
+        + micro-batcher + result cache when enabled, else plain
+        execute().  ``qos`` (executor/sched.py QoS) carries the
+        request's tenant/priority/deadline intent."""
         if self.serving is None:
             return self.execute(index_name, query, shards, remote=remote)
         return self.serving.execute(index_name, query, shards,
-                                    remote=remote)
+                                    remote=remote, qos=qos)
 
     def set_mesh(self, mesh):
         """Place all shard stacks over a jax.sharding.Mesh; cross-

@@ -1,0 +1,750 @@
+"""Ragged paged dispatch — ONE fused device program for heterogeneous
+serving traffic.
+
+The PR 2 batcher fuses only queries over the same (index, shard set):
+a mixed batch — point Counts next to TopNs over different indexes and
+shard subsets — pays one "multi" dispatch per group, and every group
+boundary is a device round trip.  Since PR 5 made device stacks
+fixed-size lane-block PAGES, the Ragged Paged Attention trick
+(PAPERS.md, arxiv 2604.15464) applies directly: instead of padding
+per group, drive one kernel over a *page table* —
+
+- every group's plan is built as usual (the shared ``PlanBuilder``),
+  but under ``stacked.raw_pages()`` its stack leaves come back as
+  :class:`PageView` handles (the cache's raw page arrays) instead of
+  assembled operands;
+- pages of every query land in per-(page_lanes, width) *buckets*; a
+  flat page-index array per operand (contiguous ``arange`` today —
+  the layout survives future page dedup/subsetting) gathers each
+  operand out of its bucket INSIDE the fused program (the "ragged"
+  plan kind in stacked.py inlines the concat+gather so one
+  concatenate is shared per bucket; ``ops.bitmap.concat_gather`` is
+  the single-operand reference implementation of the same contract),
+  so the per-access assemble dispatch disappears too;
+- single-leaf Counts — the dominant point-read shape — skip operand
+  materialization entirely: their lanes concatenate into one segment
+  family reduced by ``ops.bitmap.segment_count`` (popcount +
+  segment-sum, one pass at raw memory bandwidth — the Buddy-RAM
+  bound, arxiv 1611.09988);
+- every other subplan kind (tree counts, words, bsi_sum, row_counts)
+  evaluates exactly as in the "multi" plan over the combined
+  virtual+direct leaf space, so results are bit-exact by construction.
+
+Page layout and segment ids ride as runtime *params* while the plan
+stays a static int tuple: two batches with the same structural shape
+(tree shapes, lane counts, bucket layout) share one compiled
+executable even when their page tables differ, and pow2 padding of
+page counts, gather arrays, and segment counts keeps the shape space
+log-bounded across varying batch compositions.
+
+Consistency is inherited unchanged from the serving layer: the
+post-batch snapshot re-check (executor/serving.py ``_run_batch``)
+re-executes any rider whose fragment-version snapshot moved while the
+fused program ran.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+from pilosa_tpu.executor.stacked import (
+    PageView,
+    PlanBuilder,
+    _block,
+    _compiled,
+    _dispatch_kind,
+    raw_pages,
+)
+from pilosa_tpu.memory import pressure
+from pilosa_tpu.obs import flight, metrics
+from pilosa_tpu.obs.monitor import capture_exception
+from pilosa_tpu.obs.tracing import Span, span_into
+from pilosa_tpu.ops import kernels
+
+
+class RaggedUnbuildable(Exception):
+    """A subplan the ragged program cannot express (falls back to the
+    per-group path)."""
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+# ---------------------------------------------------------------------------
+# IR remapping (group-local leaf/param indices -> fused global space)
+# ---------------------------------------------------------------------------
+
+def _remap_tree(node, lmap, poff):
+    k = node[0]
+    if k == "leaf":
+        return ("leaf", lmap[node[1]])
+    if k == "zeros":
+        return node
+    if k == "nary":
+        return ("nary", node[1],
+                tuple(_remap_tree(c, lmap, poff) for c in node[2]))
+    if k == "not":
+        return ("not", lmap[node[1]], _remap_tree(node[2], lmap, poff))
+    if k == "shift":
+        return ("shift", node[1], _remap_tree(node[2], lmap, poff))
+    if k == "bsi_cmp":
+        return ("bsi_cmp", lmap[node[1]], node[2],
+                node[3] + poff, node[4] + poff)
+    if k == "bsi_between":
+        return ("bsi_between", lmap[node[1]], node[2] + poff,
+                node[3] + poff, node[4] + poff, node[5] + poff)
+    if k == "bsi_notnull":
+        return ("bsi_notnull", lmap[node[1]])
+    if k == "bsi_null":
+        return ("bsi_null", lmap[node[1]], lmap[node[2]])
+    raise RaggedUnbuildable(f"unknown IR node {k}")
+
+
+def _remap_sub(sub, lmap, poff):
+    kind = sub[0]
+    if kind == "count":
+        return ("count", _remap_tree(sub[1], lmap, poff), sub[2])
+    if kind == "words":
+        return ("words", _remap_tree(sub[1], lmap, poff))
+    if kind == "bsi_sum":
+        tree = None if sub[2] is None else _remap_tree(sub[2], lmap,
+                                                       poff)
+        return ("bsi_sum", lmap[sub[1]], tree, sub[3])
+    if kind == "row_counts":
+        tree = None if sub[2] is None else _remap_tree(sub[2], lmap,
+                                                       poff)
+        return ("row_counts", lmap[sub[1]], tree, sub[3])
+    raise RaggedUnbuildable(f"unraggable sub kind {kind}")
+
+
+# ---------------------------------------------------------------------------
+# program assembly
+# ---------------------------------------------------------------------------
+
+class RaggedProgram:
+    """Accumulates per-group (PlanBuilder, subplans) contributions and
+    finalizes them into ONE ``("ragged", ...)`` plan + leaf/param
+    tuples.  Groups stay what they were (one PlanBuilder per
+    (index identity, shard set)); the program is what fuses across
+    them."""
+
+    # a segment family below this size gains nothing over a plain
+    # count subplan (XLA fuses either way); at >= 2 the family shares
+    # one popcount pass and its executable survives composition churn
+    _SEG_MIN = 2
+
+    def __init__(self):
+        # (page_lanes, width_words) -> accumulated page arrays
+        self.buckets: OrderedDict[tuple, list] = OrderedDict()
+        self.vleaves: list = []   # (bucket_key, lane_idx, n, shape)
+        self.direct: list = []
+        self.params: list = []
+        # (entries, lmap, poff) per group; lmap: local leaf index ->
+        # ("v", vleaf_i) | ("d", direct_i); an entry is
+        # (riders, subplan, demux, slot_key) — riders may be empty
+        # for a canonical slot absent from this batch (the sub still
+        # evaluates, keeping the plan composition-stable); slot_key
+        # feeds the cross-batch program cache's demux table
+        self.groups: list = []
+
+    def add_group(self, builder: PlanBuilder, entries: list):
+        """`entries`: [(riders, subplan, demux, slot_key), ...] built
+        against `builder` (its leaves may be PageView handles —
+        raw_pages)."""
+        poff = len(self.params)
+        self.params.extend(builder.params)
+        lmap: dict = {}
+        for i, leaf in enumerate(builder.leaves):
+            if isinstance(leaf, PageView):
+                key = (leaf.page_lanes, leaf.width_words)
+                pages = self.buckets.setdefault(key, [])
+                base = len(pages) * leaf.page_lanes
+                pages.extend(leaf.pages)
+                lane_idx = (base + np.arange(leaf.lanes)).astype(
+                    np.int32)
+                lmap[i] = ("v", len(self.vleaves))
+                self.vleaves.append((key, lane_idx, leaf.lanes,
+                                     leaf.shape))
+            else:
+                lmap[i] = ("d", len(self.direct))
+                self.direct.append(leaf)
+        self.groups.append((entries, lmap, poff))
+
+    def _add_param(self, arr: np.ndarray, pad_value) -> int:
+        """Append a pow2-padded int32 param array; returns its index."""
+        n = arr.shape[0]
+        npad = _pow2(max(n, 1))
+        if npad != n:
+            arr = np.concatenate(
+                [arr, np.full(npad - n, pad_value, np.int32)])
+        self.params.append(np.ascontiguousarray(arr, dtype=np.int32))
+        return len(self.params) - 1
+
+    def finalize(self):
+        """(plan, leaves, params, served, table) or None when nothing
+        was built.  ``served``: [(req, demux, extract), ...] where
+        extract is ("plain", sub_i) or ("seg", sub_i, slot);
+        ``table``: slot_key -> (demux, extract) — the cross-batch
+        program cache's rider-mapping surface."""
+        if not any(entries for entries, _l, _p in self.groups):
+            return None
+        # -- segment-count families: single-leaf reduced Counts whose
+        # leaf is paged coalesce per bucket into one segment reduce
+        families: OrderedDict[tuple, list] = OrderedDict()
+        seg_entry: dict = {}      # id(entry tuple) -> (bucket, slot)
+        for entries, lmap, _poff in self.groups:
+            for ent in entries:
+                sub = ent[1]
+                if (sub[0] == "count" and sub[2]
+                        and sub[1][0] == "leaf"
+                        and lmap.get(sub[1][1], ("", 0))[0] == "v"):
+                    vkey, lane_idx, _n, _shape = \
+                        self.vleaves[lmap[sub[1][1]][1]]
+                    families.setdefault(vkey, []).append(
+                        (ent, lane_idx))
+        for vkey, members in list(families.items()):
+            if len(members) < self._SEG_MIN:
+                del families[vkey]
+                continue
+            for slot, (ent, _li) in enumerate(members):
+                seg_entry[id(ent)] = (vkey, slot)
+        # -- keep only the virtual leaves some surviving (non-segment)
+        # subplan actually reads: a leaf consumed solely by a segment
+        # family never materializes — its lanes reduce straight out of
+        # the bucket gather
+        def _refs(sub) -> set:
+            """LOCAL leaf indices a subplan reads."""
+            out: set = set()
+
+            def walk(node):
+                k = node[0]
+                if k == "leaf":
+                    out.add(node[1])
+                elif k == "nary":
+                    for c in node[2]:
+                        walk(c)
+                elif k == "not":
+                    out.add(node[1])
+                    walk(node[2])
+                elif k == "shift":
+                    walk(node[2])
+                elif k in ("bsi_cmp", "bsi_between", "bsi_notnull"):
+                    out.add(node[1])
+                elif k == "bsi_null":
+                    out.add(node[1])
+                    out.add(node[2])
+            if sub[0] in ("bsi_sum", "row_counts"):
+                out.add(sub[1])
+                if sub[2] is not None:
+                    walk(sub[2])
+            else:
+                walk(sub[1])
+            return out
+
+        plain: list = []          # (ent, lmap, poff) in batch order
+        kept: set[int] = set()
+        for entries, lmap, poff in self.groups:
+            for ent in entries:
+                if id(ent) in seg_entry:
+                    continue
+                plain.append((ent, lmap, poff))
+                for li in _refs(ent[1]):
+                    tag, i = lmap[li]
+                    if tag == "v":
+                        kept.add(i)
+        vkeep = sorted(kept)
+        vre = {vi: k for k, vi in enumerate(vkeep)}
+        # -- leaf layout: bucket pages (pow2-padded) first, direct
+        # after.  Only buckets something references survive — a failed
+        # subplan build can leave orphan page leaves behind, and an
+        # unused bucket would still pay its in-program concatenate.
+        used_keys = {self.vleaves[vi][0] for vi in vkeep} \
+            | set(families.keys())
+        bucket_meta: list = []
+        bucket_id: dict = {}
+        cur = 0
+        leaves: list = []
+        for key, pages in self.buckets.items():
+            if key not in used_keys:
+                continue
+            npad = _pow2(max(len(pages), 1))
+            padded = pages + [pages[-1]] * (npad - len(pages))
+            bucket_id[key] = len(bucket_meta)
+            bucket_meta.append((cur, npad))
+            leaves.extend(padded)
+            cur += npad
+        nv = len(vkeep)
+        leaves.extend(self.direct)
+        # -- virtual-leaf meta + gather params
+        vmeta: list = []
+        for vi in vkeep:
+            key, lane_idx, n, shape = self.vleaves[vi]
+            gi = self._add_param(lane_idx, lane_idx[-1])
+            vmeta.append((bucket_id[key], gi, int(n), tuple(shape)))
+        # -- final lmaps + subs.  Unreferenced virtual leaves map to
+        # None: _remap_sub only touches indices a sub actually reads,
+        # so a None ever surfacing in a plan is a planner bug that
+        # fails loudly at repr/jit time rather than mis-indexing.
+        # Identical remapped subplans DEDUPE to one executed sub with
+        # several riders: round-robin client mixes put the same query
+        # in one batch many times, and without dedupe every
+        # multiplicity would be a distinct plan (compile churn) doing
+        # duplicate device work.
+        subs: list = []
+        served: list = []
+        table: dict = {}
+        sub_ix: dict = {}
+        for ent, lmap, poff in plain:
+            final = {}
+            for li, (tag, i) in lmap.items():
+                final[li] = vre.get(i) if tag == "v" else nv + i
+            riders, sub, demux, slot_key = ent
+            rsub = _remap_sub(sub, final, poff)
+            i = sub_ix.get(rsub)
+            if i is None:
+                subs.append(rsub)
+                i = sub_ix[rsub] = len(subs) - 1
+            if slot_key is not None:
+                table[slot_key] = (demux, ("plain", i))
+            for r in riders:
+                served.append((r, demux, ("plain", i)))
+        for vkey, members in families.items():
+            # duplicate calls share one leaf (PlanBuilder dedupe), so
+            # their lane_idx object is shared — one segment slot
+            # serves every rider of that call
+            slot_of: dict[int, int] = {}
+            uniq: list = []
+            member_slots: list = []
+            for ent, li in members:
+                s = slot_of.get(id(li))
+                if s is None:
+                    s = slot_of[id(li)] = len(uniq)
+                    uniq.append(li)
+                member_slots.append((ent, s))
+            nseg = len(uniq)
+            npad_seg = _pow2(nseg + 1)   # +1 dump slot for padding
+            lane_cat = np.concatenate(uniq)
+            seg_ids = np.concatenate(
+                [np.full(li.shape[0], slot, np.int32)
+                 for slot, li in enumerate(uniq)])
+            # pad lanes to pow2 pointing at the dump segment so the
+            # executable shape survives composition churn
+            gi = self._add_param(lane_cat, lane_cat[-1])
+            si = self._add_param(seg_ids, nseg)
+            subs.append(("segcount", bucket_id[vkey], gi, si,
+                         npad_seg))
+            for ent, slot in member_slots:
+                riders, _sub, demux, slot_key = ent
+                if slot_key is not None:
+                    table[slot_key] = (demux,
+                                       ("seg", len(subs) - 1, slot))
+                for r in riders:
+                    served.append((r, demux,
+                                   ("seg", len(subs) - 1, slot)))
+        if not subs:
+            return None
+        plan = ("ragged", tuple(bucket_meta), tuple(vmeta),
+                tuple(subs))
+        return plan, leaves, self.params, served, table
+
+
+# ---------------------------------------------------------------------------
+# canonical composition (composition hysteresis)
+# ---------------------------------------------------------------------------
+# A fused program compiles per batch COMPOSITION, and free-running
+# traffic produces endlessly novel compositions: a fast dispatch
+# admits a small random batch, that one-off composition compiles for
+# hundreds of milliseconds, the backlog forms a full batch, and the
+# system oscillates between "warm full batch" and "novel small batch"
+# — compile throughput, not serving.  The fix is hysteresis: the
+# layer keeps a CANONICAL slot set of RECURRING (index, shards,
+# query) items, LRU-bounded, and every batch dispatches the one
+# canonical program.  Present riders demux their slots; absent slots
+# still evaluate (their operands are resident cache hits and their
+# bulk work is bandwidth-trivial) so the plan tuple — and therefore
+# the compiled executable — is IDENTICAL from batch to batch.
+# Steady state is literally one fused program, the ROADMAP item 1
+# shape; composition changes (a hot query joining, an idle slot
+# aging out, a dropped index) recompile exactly once.
+#
+# PROBATION keeps one-off queries out: a key joins the canonical set
+# only after appearing in a SECOND batch within the probation window
+# (a random ad-hoc query must not force a full canonical recompile).
+# Non-canonical riders ride a separate EXTRAS program — a per-batch
+# composition fused like the canonical one, whose compile churn is
+# confined to exactly the traffic that churns.
+
+_CANON_MAX = 96        # max canonical slots (absent-slot work bound)
+_CANON_IDLE = 64       # batches a slot may sit unused before aging out
+_CANON_PROBATION = 32  # window (batches) for the second sighting
+_SEEN_MAX = 512        # probation bookkeeping bound
+
+
+class _Slot:
+    __slots__ = ("idx", "index_name", "skey", "shards", "kind",
+                 "call", "last_used")
+
+    def __init__(self, r, batch_no):
+        self.idx = r.idx
+        self.index_name = r.index
+        self.skey = r.skey
+        self.shards = r.shards
+        self.kind = r.kind
+        self.call = r.call
+        self.last_used = batch_no
+
+
+class _ShimReq:
+    """Stand-in for a canonical slot absent from this batch: just
+    enough of the _Req surface for ServingLayer._build_sub."""
+
+    __slots__ = ("idx", "call", "kind", "shards", "skey", "result",
+                 "error", "direct", "ctx")
+
+    def __init__(self, slot: _Slot):
+        self.idx = slot.idx
+        self.call = slot.call
+        self.kind = slot.kind
+        self.shards = slot.shards
+        self.skey = slot.skey
+        self.result = None
+        self.error = None
+        self.direct = False
+        self.ctx = None
+
+
+class CanonicalComposition:
+    """The layer's slot set + probation bookkeeping + the lock
+    guarding them (concurrent batches overlap under continuous
+    batching)."""
+
+    def __init__(self):
+        self.slots: OrderedDict[tuple, _Slot] = OrderedDict()
+        self.seen: OrderedDict[tuple, int] = OrderedDict()
+        self.batch_no = 0
+        self.lock = __import__("threading").Lock()
+        # cross-batch program cache: (slot fingerprint, mutation
+        # epoch, plan, leaves, params, table, consts).  Valid while
+        # the slot set AND the global mutation epoch
+        # (models/fragment.py) are unchanged — a read-heavy steady
+        # state then skips plan building entirely and pays ONE
+        # dispatch per batch; any write anywhere invalidates
+        # conservatively (the per-fragment stamps remain the precise
+        # staleness authority via the post-batch snapshot re-check).
+        # Holding `leaves` pins the canonical working set's device
+        # pages between batches — bounded by _CANON_MAX slots.
+        self.cached = None
+
+    def fold(self, layer, groups: dict) -> list:
+        """Register the batch's requests (promoting recurring keys
+        out of probation), age out idle/dead slots, and return a
+        stable-ordered snapshot of the slot list.  Riders whose key
+        is still on probation ride the extras program."""
+        holder = layer.executor.holder
+        with self.lock:
+            self.batch_no += 1
+            for reqs in groups.values():
+                for r in reqs:
+                    key = (id(r.idx), r.skey, r.kind, repr(r.call))
+                    slot = self.slots.get(key)
+                    if slot is not None:
+                        slot.last_used = self.batch_no
+                        continue
+                    last = self.seen.get(key)
+                    if (last is not None
+                            and 0 < self.batch_no - last
+                            <= _CANON_PROBATION):
+                        # second sighting in a different recent
+                        # batch: promote — it's recurring traffic
+                        self.slots[key] = _Slot(r, self.batch_no)
+                        self.seen.pop(key, None)
+                    else:
+                        self.seen[key] = self.batch_no
+                        self.seen.move_to_end(key)
+                        while len(self.seen) > _SEEN_MAX:
+                            self.seen.popitem(last=False)
+            for key, slot in list(self.slots.items()):
+                if (self.batch_no - slot.last_used > _CANON_IDLE
+                        or holder.index(slot.index_name)
+                        is not slot.idx):
+                    del self.slots[key]
+            while len(self.slots) > _CANON_MAX:
+                key = min(self.slots,
+                          key=lambda k: self.slots[k].last_used)
+                del self.slots[key]
+            # stable order: groups by (index name, skey), slots by
+            # call repr — identical slot sets build identical plans
+            slots = sorted(
+                self.slots.values(),
+                key=lambda s: (s.index_name, s.skey, s.kind,
+                               repr(s.call)))
+            fp = tuple(sorted(self.slots))
+            return slots, fp
+
+    def drop(self, slot_keys):
+        with self.lock:
+            for key in slot_keys:
+                self.slots.pop(key, None)
+            self.cached = None
+
+
+# ---------------------------------------------------------------------------
+# batch execution (called by ServingLayer._run_batch on the leader)
+# ---------------------------------------------------------------------------
+
+def run_ragged(layer, groups: dict) -> None:
+    """Plan, dispatch, and demux EVERY group of the batch through the
+    ONE canonical fused program.  Mirrors the per-group leader
+    protocol (serving._run_group): per-request plan/build
+    attribution, the serving-dispatch chaos seam, the OOM backstop,
+    and the mark-direct-on-failure fallback — a failed fused program
+    degrades every rider to its caller-thread solo path, never to an
+    error."""
+    import pilosa_tpu.models.fragment as _frag
+    eng = layer.executor.stacked
+    canon = getattr(layer, "_ragged_canon", None)
+    if canon is None:
+        canon = layer._ragged_canon = CanonicalComposition()
+    slots, fp = canon.fold(layer, groups)
+    # epoch read BEFORE any build/serve decision: a write landing
+    # mid-build leaves a stamp older than the live epoch, so the next
+    # batch rebuilds (and this batch's riders are covered by the
+    # post-batch snapshot re-check either way)
+    epoch = _frag.mutation_epoch()
+    # riders by slot key, build order canonical within each group
+    by_key: OrderedDict[tuple, list] = OrderedDict()
+    for reqs in groups.values():
+        for r in reqs:
+            if r.result is None and r.error is None:
+                by_key.setdefault(
+                    (id(r.idx), r.skey, r.kind, repr(r.call)),
+                    []).append(r)
+    # -- canonical program: serve from the cross-batch cache when the
+    # slot set and data are unchanged, else rebuild + re-cache ------
+    with canon.lock:
+        cached = canon.cached
+        if cached is not None and (cached[0] != fp
+                                   or cached[1] != epoch):
+            cached = None
+    if cached is not None:
+        _serve_cached(layer, eng, cached, by_key, len(groups))
+    else:
+        slot_groups: OrderedDict[tuple, list] = OrderedDict()
+        for s in slots:
+            slot_groups.setdefault((id(s.idx), s.skey), []).append(s)
+        work = []
+        for (_gid, skey), gslots in slot_groups.items():
+            pairs = [(slot, by_key.pop(
+                (id(slot.idx), slot.skey, slot.kind, repr(slot.call)),
+                [])) for slot in gslots]
+            work.append((gslots[0].idx, skey, pairs))
+        if work:
+            payload = _plan_and_dispatch(layer, eng, work,
+                                         len(groups), canon=canon,
+                                         program="canonical")
+            if payload is not None:
+                with canon.lock:
+                    # only cache if no slot died during the build
+                    # (drop() cleared cached and changed the set)
+                    if tuple(sorted(canon.slots)) == fp:
+                        canon.cached = (fp, epoch) + payload
+    # -- extras program: probation riders (one-off / not-yet-
+    # recurring queries) fuse into their own per-batch composition,
+    # so their compile churn never touches the canonical executable
+    if by_key:
+        ework: OrderedDict[tuple, list] = OrderedDict()
+        for key, riders in by_key.items():
+            if not riders:
+                continue
+            r0 = riders[0]
+            ework.setdefault((id(r0.idx), r0.skey), []).append(
+                (_Slot(r0, 0), riders))
+        work2 = [(pairs[0][1][0].idx, skey, pairs)
+                 for (_gid, skey), pairs in ework.items()]
+        if work2:
+            _plan_and_dispatch(layer, eng, work2, len(groups),
+                               canon=None, program="extras")
+
+
+def _plan_and_dispatch(layer, eng, work, n_groups: int,
+                       canon=None, program: str = "canonical"):
+    """Build ONE ragged program over `work` — [(idx, skey,
+    [(slot, riders), ...]), ...] in stable order — dispatch it, and
+    demux every rider.  `canon` given: a build failure evicts the
+    slot from the canonical set, and a successful build returns the
+    (plan, leaves, params, table, consts) payload for the
+    cross-batch program cache (None otherwise)."""
+    prog = RaggedProgram()
+    dead_keys: list = []
+    consts: dict = {}
+    for idx, skey, pairs in work:
+        shards = list(skey)
+        b = PlanBuilder(eng, idx, shards, {})
+        entries = []
+        for slot, riders in pairs:
+            slot_key = ((id(slot.idx), slot.skey, slot.kind,
+                         repr(slot.call))
+                        if canon is not None else None)
+            target = riders[0] if riders else _ShimReq(slot)
+            acc = flight.Acc()
+            for r in riders:
+                r.acc = flight.Acc()
+            if riders:
+                riders[0].acc = acc
+            prev = flight.push_acc(acc)
+            t0 = time.perf_counter()
+            try:
+                with raw_pages(), span_into(target.ctx,
+                                            "serving.plan",
+                                            kind=slot.kind):
+                    built = layer._build_sub(b, target, shards)
+            except Exception:
+                # unbuildable now (data/schema drift): the slot
+                # leaves the canonical set and its riders fall back
+                for r in riders:
+                    r.direct = True
+                if slot_key is not None:
+                    dead_keys.append(slot_key)
+                continue
+            finally:
+                flight.pop_acc(prev)
+                stack_t = sum(v for k, v in acc.phases.items()
+                              if k.startswith("stack_"))
+                acc.add_phase("plan_build", max(
+                    time.perf_counter() - t0 - stack_t, 0.0))
+            if built is None:
+                # constant result: share it across riders (the
+                # result cache shares result objects the same way)
+                for r in riders[1:]:
+                    r.result = target.result
+                if slot_key is not None:
+                    consts[slot_key] = target.result
+                continue
+            entries.append((riders, built[0], built[1], slot_key))
+        if entries:
+            prog.add_group(b, entries)
+    if canon is not None and dead_keys:
+        canon.drop(dead_keys)
+    cacheable = canon is not None and not dead_keys
+    fin = prog.finalize()
+    if fin is None:
+        # a program of constants alone is still cacheable
+        return ((None, None, None, {}, consts)
+                if cacheable and consts else None)
+    plan, leaves, params, served, table = fin
+    payload = ((plan, leaves, params, table, consts)
+               if cacheable else None)
+    if not served:
+        # no rider this batch — skip the dispatch but keep the built
+        # program for the cache (the next batch serves from it)
+        return payload
+    kern = kernels.enabled() and not eng.host_only
+    sig = (repr(plan), kern)
+    kind = _dispatch_kind(sig, leaves, params)
+    sp = Span("serving.dispatch")
+    sp.tags.update(batch=len(served), subqueries=len(plan[3]),
+                   ragged=True, program=program, groups=n_groups,
+                   compile=kind == "compile")
+    t0 = time.perf_counter()
+    try:
+        # same chaos seam + OOM backstop as the per-group dispatch
+        from pilosa_tpu.obs import faults
+        faults.fire("serving-dispatch")
+        fn = _compiled(plan, kern=kern, sig=sig)
+        outs = pressure.guarded(
+            lambda: _block(fn(tuple(leaves), tuple(params))))
+    except Exception as e:
+        capture_exception(
+            e, where="serving.ragged_dispatch", batch=len(served),
+            trace_ids=[r.trace_id for r, _d, _e in served
+                       if r.trace_id])
+        for r, _d, _e in served:
+            r.direct = True
+        return
+    finally:
+        sp.finish()
+    metrics.SERVING_DISPATCH.inc(kind="ragged")
+    dt = time.perf_counter() - t0
+    for r, _d, _e in served:
+        r.acc.add_phase(kind, dt)
+        if r.ctx is not None:
+            r.ctx.attach(sp.copy())
+    for r, demux, ext in served:
+        out = outs[ext[1]] if ext[0] == "plain" else \
+            outs[ext[1]][ext[2]]
+        t1 = time.perf_counter()
+        try:
+            with span_into(r.ctx, "serving.demux"):
+                r.result = demux(out)
+        except Exception:
+            r.direct = True
+            r.result = None
+        r.acc.add_phase("demux", time.perf_counter() - t1)
+    return payload
+
+
+def _serve_cached(layer, eng, cached, by_key, n_groups: int) -> None:
+    """Serve this batch's canonical riders from the cross-batch
+    program cache: no plan building, no leaf fetches — map each rider
+    to its slot's demux/extract, run the ONE cached fused program,
+    demux.  Keys the cache doesn't know stay in `by_key` for the
+    extras program."""
+    _fp, _epoch, plan, leaves, params, table, consts = cached
+    served: list = []
+    for key in list(by_key):
+        if key in consts:
+            for r in by_key.pop(key):
+                r.acc = flight.Acc()
+                r.result = consts[key]
+        elif table and key in table:
+            demux, ext = table[key]
+            for r in by_key.pop(key):
+                r.acc = flight.Acc()
+                served.append((r, demux, ext))
+    if not served or plan is None:
+        return
+    kern = kernels.enabled() and not eng.host_only
+    sig = (repr(plan), kern)
+    kind = _dispatch_kind(sig, leaves, params)
+    sp = Span("serving.dispatch")
+    sp.tags.update(batch=len(served), subqueries=len(plan[3]),
+                   ragged=True, program="canonical-cached",
+                   groups=n_groups, compile=kind == "compile")
+    t0 = time.perf_counter()
+    try:
+        from pilosa_tpu.obs import faults
+        faults.fire("serving-dispatch")
+        fn = _compiled(plan, kern=kern, sig=sig)
+        outs = pressure.guarded(
+            lambda: _block(fn(tuple(leaves), tuple(params))))
+    except Exception as e:
+        capture_exception(
+            e, where="serving.ragged_dispatch", batch=len(served),
+            trace_ids=[r.trace_id for r, _d, _e in served
+                       if r.trace_id])
+        for r, _d, _e in served:
+            r.direct = True
+        return
+    finally:
+        sp.finish()
+    metrics.SERVING_DISPATCH.inc(kind="ragged")
+    dt = time.perf_counter() - t0
+    for r, _d, _e in served:
+        r.acc.add_phase(kind, dt)
+        if r.ctx is not None:
+            r.ctx.attach(sp.copy())
+    for r, demux, ext in served:
+        out = outs[ext[1]] if ext[0] == "plain" else \
+            outs[ext[1]][ext[2]]
+        t1 = time.perf_counter()
+        try:
+            with span_into(r.ctx, "serving.demux"):
+                r.result = demux(out)
+        except Exception:
+            r.direct = True
+            r.result = None
+        r.acc.add_phase("demux", time.perf_counter() - t1)

@@ -519,6 +519,14 @@ class QueryBatcher:
         self._pending: list[_Req] = []
         self._leader = False
         self._inflight = 0  # batches currently executing
+        # serialize=True (the ragged canonical program, set by
+        # ServingLayer): at most ONE batch executes at a time and the
+        # next leader waits for it rather than for a wall-clock
+        # window.  The canonical program computes every canonical
+        # slot per dispatch, so overlapping batches would multiply
+        # that fixed cost for ~no extra riders — serializing maximizes
+        # occupancy per dispatch, which is the whole amortization.
+        self.serialize = False
 
     def run(self, req: _Req) -> None:
         """Serve one request through the batch path; on return the
@@ -546,6 +554,12 @@ class QueryBatcher:
             # requests naturally accumulate
             while (self._inflight > 0
                    and len(self._pending) < self.max_batch):
+                if self.serialize:
+                    # wait out the in-flight batch itself (notified on
+                    # completion), not a wall-clock window — arrivals
+                    # during the dispatch become the next full batch
+                    self._cond.wait(0.05)
+                    continue
                 rem = deadline - time.perf_counter()
                 if rem <= 0:
                     break
@@ -582,17 +596,50 @@ class QueryBatcher:
 # ---------------------------------------------------------------------------
 
 class ServingLayer:
-    """Front of Executor for the HTTP/gRPC serving path: result cache
-    first, micro-batcher second, ``Executor.execute`` fallback always."""
+    """Front of Executor for the HTTP/gRPC serving path: QoS admission
+    first (executor/sched.py), result cache second, micro-batcher
+    (per-group or ragged cross-index fused dispatch) third,
+    ``Executor.execute`` fallback always."""
 
     def __init__(self, executor, window_s: float = 0.001,
                  max_batch: int = 32, cache_bytes: int = 64 << 20,
-                 batching: bool = True):
+                 batching: bool = True, ragged: bool | None = None,
+                 admission: bool | None = None, heavy_slots: int = 2,
+                 queue_max: int = 128, tenant_weights=None,
+                 default_deadline_ms: float = 0.0):
+        import os
+
+        from pilosa_tpu.executor import sched as _sched
         self.executor = executor
         self.batching = batching and max_batch > 1
         self.cache = ResultCache(cache_bytes) if cache_bytes > 0 else None
         self.batcher = QueryBatcher(self, window_s, max_batch)
         self.prefetcher = None
+        # ragged cross-index page-table dispatch (executor/ragged.py):
+        # one fused device program per batch instead of one per
+        # (index, shards) group.  Env-overridable for the bench A/B.
+        env_r = os.environ.get("PILOSA_TPU_SERVING_RAGGED")
+        if ragged is None:
+            ragged = True
+        if env_r is not None:
+            ragged = env_r != "0"
+        self.ragged = ragged
+        # QoS admission (executor/sched.py): point reads bypass, heavy
+        # reads pass a bounded weighted-fair gate, overflow sheds 503
+        env_a = os.environ.get("PILOSA_TPU_SERVING_ADMISSION")
+        if admission is None:
+            admission = True
+        if env_a is not None:
+            admission = env_a != "0"
+        weights = (tenant_weights
+                   if isinstance(tenant_weights, dict)
+                   else _sched.parse_weights(tenant_weights))
+        self.sched = _sched.AdmissionScheduler(
+            heavy_slots=heavy_slots, queue_max=queue_max,
+            tenant_weights=weights) if admission else None
+        self.default_deadline_ms = float(default_deadline_ms or 0.0)
+        # one canonical dispatch at a time (see QueryBatcher)
+        self.batcher.serialize = self.ragged
 
     def start_prefetcher(self, interval_s: float = 0.5):
         """Warm predicted stack pages off the serving hot path
@@ -613,7 +660,8 @@ class ServingLayer:
     # -- entry point ---------------------------------------------------
 
     def execute(self, index: str, query, shards=None,
-                remote: bool = False) -> list:
+                remote: bool = False, qos=None) -> list:
+        from pilosa_tpu.executor import sched as _sched
         ex = self.executor
         if remote:
             # node-to-node calls carry the _REMOTE contextvar, which a
@@ -628,17 +676,59 @@ class ServingLayer:
                     wf, ws = _write_targets(ex.holder.index(index), q)
                     self.cache.sweep(ex.holder, wf, ws)
                     metrics.RESULT_CACHE.inc(outcome="write")
+        # default deadline: a [serving] default-deadline-ms applies to
+        # every request that carried no deadline of its own — a
+        # tenant/priority header must not opt a request out of the
+        # operator's configured budget
+        if self.default_deadline_ms > 0:
+            if qos is None:
+                qos = _sched.QoS.make(
+                    deadline_ms=self.default_deadline_ms)
+            elif qos.deadline_s is None:
+                dflt = _sched.QoS.make(
+                    deadline_ms=self.default_deadline_ms)
+                qos.deadline_ms = dflt.deadline_ms
+                qos.deadline_s = dflt.deadline_s
+        cls = _sched.classify(q, qos)
+        # a dead-on-arrival deadline sheds regardless of class — the
+        # client stopped waiting, executing would only burn device time
+        if (qos is not None and qos.deadline_s is not None
+                and time.monotonic() > qos.deadline_s):
+            metrics.ADMISSION_TOTAL.inc(**{"class": cls,
+                                           "outcome": "expired"})
+            raise _sched.ServingDeadlineExceeded(
+                "deadline expired before execution")
         # span on the CALLER's thread so the long-query log keeps its
         # executor.Execute root even for fused/cached serves (the
         # direct fallback nests its own copy inside — the root name
         # is what the log consumers pin on)
+        if cls == _sched.CLASS_HEAVY and self.sched is not None:
+            # bounded heavy concurrency + weighted per-tenant fair
+            # queueing: a GroupBy storm can no longer occupy every
+            # engine thread, so point reads never queue behind it
+            with self.sched.heavy_slot(qos):
+                with start_span("executor.Execute", index=index) as root:
+                    return self._execute_read(ex, index, q, shards,
+                                              root, qos=qos, cls=cls)
+        metrics.ADMISSION_TOTAL.inc(**{"class": cls,
+                                       "outcome": "admitted"})
         with start_span("executor.Execute", index=index) as root:
-            return self._execute_read(ex, index, q, shards, root)
+            return self._execute_read(ex, index, q, shards, root,
+                                      qos=qos, cls=cls)
 
-    def _execute_read(self, ex, index, q, shards, root=None):
+    def _execute_read(self, ex, index, q, shards, root=None, qos=None,
+                      cls=None):
         t0 = time.perf_counter()
         route = "direct"
         fl = flight.begin(index, q)
+        if fl is not None:
+            # QoS attribution: every serving-path record names its
+            # tenant, admission class, and deadline budget so
+            # /debug/queries can answer "whose query, how urgent"
+            fl["tenant"] = qos.tenant if qos is not None else "default"
+            fl["priority"] = cls or "point"
+            if qos is not None and qos.deadline_ms is not None:
+                fl["deadline_ms"] = round(float(qos.deadline_ms), 1)
         if fl is not None and root is not None:
             root.set_tag("trace_id", fl["trace_id"])
         req = None
@@ -772,8 +862,25 @@ class ServingLayer:
         for r in batch:
             r.batch_size = len(batch)  # flight-record occupancy
             groups.setdefault((id(r.idx), r.skey), []).append(r)
-        for reqs in groups.values():
-            self._run_group(reqs)
+        # ragged cross-index dispatch: ONE fused page-table program
+        # serves every group (executor/ragged.py) — a planning failure
+        # degrades to the per-group path, a dispatch failure marks the
+        # riders direct (both non-fatal, like _run_group's own ladder).
+        # Mesh placements keep per-group programs: concatenating
+        # differently-sharded operands in one program is not expressible.
+        ragged_done = False
+        if (self.ragged and groups
+                and self.executor.stacked.mesh is None):
+            try:
+                from pilosa_tpu.executor import ragged as _ragged
+                _ragged.run_ragged(self, groups)
+                ragged_done = True
+            except Exception as e:
+                capture_exception(e, where="serving.ragged_plan",
+                                  batch=len(batch))
+        if not ragged_done:
+            for reqs in groups.values():
+                self._run_group(reqs)
         # post-pass: snapshot re-check.  Fallbacks are NOT executed
         # here — the leader running every solo re-execution serially
         # would hold all followers hostage; instead the request is
@@ -875,6 +982,7 @@ class ServingLayer:
             return
         finally:
             sp.finish()
+        metrics.SERVING_DISPATCH.inc(kind="group")
         dt = time.perf_counter() - t0
         for r in pend:
             r.acc.add_phase(kind, dt)

@@ -104,6 +104,58 @@ _ENTRY_RESIDENT_FRAC = float(os.environ.get(
 _log = logging.getLogger("pilosa_tpu.stacked")
 
 
+# -- raw page views (ragged page-table dispatch) ----------------------------
+# The ragged serving plane (executor/ragged.py) fuses queries over
+# DIFFERENT indexes/shard subsets into one device program by taking
+# the cache's PagedStack pages directly as program operands and
+# gathering them through a page table INSIDE the fused program —
+# skipping the per-access assemble_pages dispatch entirely.  A caller
+# opts in with the raw_pages() context: stack fetches on this thread
+# then return PageView handles (a safe snapshot of the entry's page
+# arrays) instead of assembled arrays.  Everything else about the
+# fetch — versions, single-flight, patching, ledger accounting — is
+# identical, so a PageView is exactly as fresh as the assembled array
+# would have been.
+
+_RAW_TLS = threading.local()
+
+
+class PageView:
+    """Raw paged payload of one stack-cache entry: the page arrays a
+    ragged program gathers through its page table.  ``pages`` is a
+    local snapshot (references keep the buffers alive against
+    concurrent eviction, the same contract as the assemble path);
+    the last page is zero-padded past ``lanes``."""
+
+    __slots__ = ("shape", "lanes", "page_lanes", "pages")
+
+    def __init__(self, shape: tuple, lanes: int, page_lanes: int,
+                 pages: list):
+        self.shape = tuple(shape)
+        self.lanes = int(lanes)
+        self.page_lanes = int(page_lanes)
+        self.pages = list(pages)
+
+    @property
+    def width_words(self) -> int:
+        return int(self.shape[-1])
+
+
+class raw_pages:
+    """Context manager: stack fetches on this thread return PageView
+    handles for paged entries (whole/host entries still return plain
+    arrays — the ragged planner treats those as direct leaves)."""
+
+    def __enter__(self):
+        self._prev = getattr(_RAW_TLS, "on", False)
+        _RAW_TLS.on = True
+        return self
+
+    def __exit__(self, *exc):
+        _RAW_TLS.on = self._prev
+        return False
+
+
 class TileStackCache:
     """Budget-ledgered cache of device-resident shard stacks.
 
@@ -536,6 +588,11 @@ class TileStackCache:
 
     def _assemble(self, ps: PagedStack, arrs: list):
         ps.touch()
+        if getattr(_RAW_TLS, "on", False):
+            # ragged page-table dispatch: hand the caller the raw page
+            # snapshot — the fused program gathers them itself, so the
+            # per-access assemble dispatch is skipped entirely
+            return PageView(ps.shape, ps.lanes, ps.page_lanes, arrs)
         if len(arrs) == 1 and ps.lanes == ps.page_lanes:
             return arrs[0].reshape(ps.shape)
         return bm.assemble_pages(tuple(arrs), ps.shape)
@@ -1112,6 +1169,45 @@ def _plan_run(plan, kern: bool = False):
 
         def run(leaves, params):
             return tuple(r(leaves, params) for r in runs)
+        return run
+    if kind == "ragged":
+        # the cross-index page-table program (executor/ragged.py):
+        #   ("ragged", buckets, vmeta, subs)
+        # leaves = per-bucket page arrays first, then direct leaves;
+        # buckets = ((leaf_start, n_pages), ...) one per (page_lanes,
+        # W) shape class; vmeta = ((bucket, gather_param, n_lanes,
+        # shape), ...) — virtual leaves materialized by ONE in-program
+        # gather each; subs evaluate over the combined virtual+direct
+        # leaf space like "multi", except ("segcount", bucket, gparam,
+        # sparam, nseg) entries reduce a whole family of single-leaf
+        # Counts through one popcount+segment-sum without ever
+        # materializing their operands.
+        buckets, vmeta, subs = plan[1], plan[2], plan[3]
+        ndirect = (buckets[-1][0] + buckets[-1][1]) if buckets else 0
+        runs = tuple(None if s[0] == "segcount" else _plan_run(s, kern)
+                     for s in subs)
+
+        def run(leaves, params):
+            flats = []
+            for start, npages in buckets:
+                ps = leaves[start:start + npages]
+                flats.append(jnp.concatenate(ps, axis=0)
+                             if npages > 1 else ps[0])
+            vl = []
+            for b, gi, n, shape in vmeta:
+                g = flats[b][params[gi]]        # (Lpad, W) gather
+                vl.append(g[:n].reshape(shape))
+            all_leaves = tuple(vl) + tuple(leaves[ndirect:])
+            outs = []
+            for s, r in zip(subs, runs):
+                if r is None:
+                    _k, b, gi, si, nseg = s
+                    lanes = flats[b][params[gi]]
+                    outs.append(bm.segment_count(lanes, params[si],
+                                                 nseg))
+                else:
+                    outs.append(r(all_leaves, params))
+            return tuple(outs)
         return run
     if kind == "words":
         tree = plan[1]

@@ -88,8 +88,17 @@ class Field:
                     continue
                 _, end = span
                 if (now - end).total_seconds() > self.options.ttl:
-                    self.views.pop(name)
+                    v = self.views.pop(name)
                     removed.append(name)
+                    # invalidate derived state: stack-cache patchers
+                    # and prefetch recipes hold DIRECT references to
+                    # these fragments, and their (gen, version) stamps
+                    # would otherwise never move again — a cached
+                    # device stack (or result snapshot) could keep
+                    # serving the expired quantum forever.  A bumped
+                    # gen makes every derived stamp compare stale.
+                    for fr in v.fragments.values():
+                        fr.bump_gen()
                     if self.storage is not None:
                         # also reclaim the persisted bitmaps, or the
                         # expired view resurrects on the next open

@@ -51,6 +51,29 @@ import itertools as _it
 
 _FRAG_GEN = _it.count(1)
 
+# Process-global MUTATION EPOCH: bumped on every fragment version
+# bump, fragment creation, gen retirement, and schema-level deletion
+# (models/index.py, models/holder.py).  A single monotonic int lets a
+# reader answer "did ANY data change since I built this?" in one
+# load — the ragged serving plane (executor/ragged.py) caches its
+# canonical fused program against it, so read-heavy steady state
+# skips per-batch plan rebuilds entirely while any write anywhere
+# conservatively invalidates.  Plain int += under the GIL: the bump
+# rides paths that already take the fragment's locks, and a torn read
+# can only ever UNDER-read (forcing a spurious rebuild, never a stale
+# serve — the per-fragment (gen, version) stamps stay the precise
+# staleness authority).
+_MUT_EPOCH = 0
+
+
+def bump_mutation_epoch():
+    global _MUT_EPOCH
+    _MUT_EPOCH += 1
+
+
+def mutation_epoch() -> int:
+    return _MUT_EPOCH
+
 # Bounded per-fragment delta log (LSM-flavored incremental stack
 # maintenance): every mutation appends a (version, row, word-span)
 # entry so device-resident stacks can be PATCHED instead of rebuilt
@@ -84,6 +107,7 @@ class Fragment:
         self.version = 0
         # unique-for-process-lifetime identity (see _FRAG_GEN)
         self.gen = next(_FRAG_GEN)
+        bump_mutation_epoch()  # a new fragment changes read results
         # delta log: (version-after-mutation, row, word_lo, word_hi)
         # spans covering versions in (_delta_floor, version] — the
         # incremental-maintenance feed for device stack patching
@@ -154,6 +178,15 @@ class Fragment:
 
     def _invalidate(self, row: int, lo: int | None = None,
                     hi: int | None = None, record: bool = False):
+        # epoch BEFORE version: a reader preempting the writer between
+        # the two sees a moved epoch with the old version (spurious
+        # rebuild — safe); the reverse order would let a cached fused
+        # program pass its epoch check against a version that already
+        # moved (stale serve).  Content safety holds because mutators
+        # invalidate both BEFORE handing out the row (here) and AFTER
+        # the bytes land (touch) — the post-landing bump is the one a
+        # mid-write builder's stamp is compared against.
+        bump_mutation_epoch()
         self.version += 1
         if record:
             self._record_delta(row, lo, hi)
@@ -232,6 +265,17 @@ class Fragment:
         self._invalidate(row, lo, hi, record=True)
         if PARANOIA:
             self.check_row(row)
+
+    def bump_gen(self):
+        """Retire this fragment's cache identity: every derived
+        (gen, version) stamp — tile stacks, result-cache snapshots,
+        prefetch recipes — compares unequal afterwards.  Called when
+        the fragment leaves the live tree without being destroyed
+        (TTL view expiry, models/field.py): closures holding a direct
+        reference would otherwise keep reading unchanged stamps and
+        serve the expired view's data forever."""
+        bump_mutation_epoch()  # before the gen moves — see _invalidate
+        self.gen = next(_FRAG_GEN)
 
     def check_row(self, row: int):
         """Paranoia assert for one row's representation invariants."""

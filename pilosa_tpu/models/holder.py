@@ -44,6 +44,8 @@ class Holder:
         return self.indexes.get(name)
 
     def delete_index(self, name: str):
+        from pilosa_tpu.models.fragment import bump_mutation_epoch
+        bump_mutation_epoch()  # see Index.delete_field
         with self._lock:
             idx = self.indexes.pop(name, None)
             if idx is None:

@@ -190,6 +190,10 @@ class Index:
             f = self.fields.pop(name, None)
             if f is None:
                 return
+            # deletion changes read results without any fragment
+            # touch(): cached fused programs must observe it
+            from pilosa_tpu.models.fragment import bump_mutation_epoch
+            bump_mutation_epoch()
             if self.storage is not None:
                 self.storage.delete_field_bitmaps(name)
             # drop the field's key-translator files too, or a recreated

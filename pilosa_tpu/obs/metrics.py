@@ -404,6 +404,21 @@ SERVING_BATCHED = registry.counter(
     "pilosa_serving_batched_total",
     "Serving-path queries by execution route (fused/direct/cached)")
 
+# -- ragged dispatch + QoS admission (executor/ragged.py, sched.py) --
+SERVING_DISPATCH = registry.counter(
+    "pilosa_serving_dispatch_total",
+    "Fused serving device dispatches by kind (ragged = one cross-"
+    "index page-table program per batch; group = one multi program "
+    "per (index, shards) group)")
+ADMISSION_TOTAL = registry.counter(
+    "pilosa_serving_admission_total",
+    "Serving admission decisions by class (point/heavy) and outcome "
+    "(admitted/shed/expired)")
+TENANT_QUEUE_DEPTH = registry.gauge(
+    "pilosa_serving_tenant_queue_depth",
+    "Heavy-class queries queued per tenant in the weighted fair "
+    "queue right now")
+
 # -- streaming write plane (ingest/stream.py + ingest/kafka.py) --
 INGEST_WINDOWS = registry.counter(
     "pilosa_ingest_windows_total",

@@ -276,6 +276,54 @@ def assemble_pages(pages, shape: tuple):
     return _assemble_pages_jit(pages, tuple(shape))
 
 
+# Ragged segment reductions (page-table dispatch) ---------------------------
+#
+# The ragged serving plane (executor/ragged.py) drives ONE device
+# program over a page table assembled from many queries' PagedStack
+# pages: a flat page array is gathered into per-query lane segments
+# and reduced per segment.  These are the segment primitives; they are
+# plain jnp functions so the ragged plan kind composes them inside one
+# jitted program (the Ragged Paged Attention shape from PAPERS.md —
+# ragged per-query page lists + segment ids instead of per-group
+# padding).
+
+def concat_gather(pages, lane_idx):
+    """Page-table gather: concatenate page blocks (each (page_lanes,
+    W)) into the flat bucket lane space and gather ``lane_idx`` rows
+    out of it — the materialization of one ragged operand.  The
+    caller pow2-pads both the page tuple (repeating the last page)
+    and ``lane_idx`` (repeating the last index) so the executable
+    cache grows log-, not linearly, in batch composition.  This is
+    the REFERENCE implementation of the contract (pinned by
+    tests/test_ragged.py); the fused "ragged" plan kind
+    (executor/stacked.py _plan_run) inlines the same graph so that
+    operands of one bucket share a single concatenate."""
+    pages = tuple(pages)
+    flat = jnp.concatenate(pages, axis=0) if len(pages) > 1 else pages[0]
+    return flat[jnp.asarray(lane_idx)]
+
+
+def segment_count(lanes, seg_ids, num_segments: int):
+    """Per-segment popcount totals of a flat (L, W) lane block:
+    popcount each lane, then segment-sum by ``seg_ids`` — N point
+    Counts over different indexes/shard subsets reduce in ONE pass.
+    int32-exact while a segment spans < 2^11 full shards (counts
+    < 2^20 per lane), the same bound as the in-program cross-shard
+    reduce (executor/stacked.py _REDUCE_MAX_SHARDS)."""
+    pc = count(lanes)                                  # (L,) int32
+    return jax.ops.segment_sum(pc, jnp.asarray(seg_ids),
+                               num_segments=num_segments)
+
+
+def segment_count_np(lanes: np.ndarray, seg_ids, num_segments: int):
+    """Host twin of segment_count (numpy, exact int64)."""
+    pc = np.bitwise_count(np.asarray(lanes, dtype=np.uint32)).sum(
+        axis=-1).astype(np.int64)
+    out = np.zeros(num_segments, dtype=np.int64)
+    np.add.at(out, np.asarray(seg_ids), pc)
+    return out
+
+
 # Group-code planes (one-pass GroupBy) --------------------------------------
 #
 # A stack of R DISJOINT packed rows (no column in two rows) is exactly a

@@ -236,6 +236,20 @@ class GRPCHandler:
         self._check(ctx, request.index, write=_pql_is_write(request.pql))
         md = dict(ctx.invocation_metadata() or ())
         profile = md.get("profile", "").lower() == "true"
+        # QoS admission intent rides the metadata like profile does
+        # (("tenant", ...), ("priority", ...), ("deadline-ms", ...)) —
+        # the gRPC twin of the X-Pilosa-* HTTP headers
+        qos = None
+        if any(k in md for k in ("tenant", "priority", "deadline-ms")):
+            from pilosa_tpu.executor.sched import QoS
+            try:
+                dl = (float(md["deadline-ms"])
+                      if "deadline-ms" in md else None)
+            except ValueError:
+                dl = None
+            qos = QoS.make(tenant=md.get("tenant"),
+                           priority=md.get("priority"),
+                           deadline_ms=dl)
         tracer = prev = None
         if profile:
             import json as _json
@@ -245,8 +259,16 @@ class GRPCHandler:
             prev = _tr.push_thread_tracer(tracer)
         try:
             return self.api.executor.execute_serving(
-                request.index, request.pql)
+                request.index, request.pql, qos=qos)
         except Exception as e:
+            # typed QoS outcomes keep their wire semantics: a shed is
+            # RESOURCE_EXHAUSTED (retryable), an expired deadline is
+            # DEADLINE_EXCEEDED — not a client argument error
+            status = getattr(e, "status", None)
+            if status == 503:
+                ctx.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+            if status == 504:
+                ctx.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
             ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         finally:
             if profile:

@@ -79,7 +79,13 @@ class Server:
                 window_s=config.serving_batch_window_ms / 1e3,
                 max_batch=config.serving_batch_max,
                 cache_bytes=config.serving_cache_mb << 20,
-                batching=config.serving_batching)
+                batching=config.serving_batching,
+                ragged=config.serving_ragged,
+                admission=config.serving_admission,
+                heavy_slots=config.serving_heavy_slots,
+                queue_max=config.serving_queue_max,
+                tenant_weights=config.serving_tenant_weights,
+                default_deadline_ms=config.serving_default_deadline_ms)
         config.apply_flight_settings()
         # failure-tolerance plane: config/env-armed fault points +
         # hedge/deadline knobs for the cluster fan-out
@@ -155,6 +161,14 @@ class Server:
                 if removed:
                     self.logger.info("ttl removed %d views",
                                      len(removed))
+                    # an expired quantum view invalidates derived
+                    # state: the dropped fragments' gens were bumped
+                    # (models/field.py), and the serving result cache
+                    # is swept eagerly so no cached Row/Count keeps
+                    # serving the expired window
+                    srv = self.api.executor.serving
+                    if srv is not None and srv.cache is not None:
+                        srv.cache.sweep(self.holder)
                 self.holder.sync()
             except Exception as e:
                 self.logger.error("maintenance tick failed: %s", e)
@@ -473,7 +487,8 @@ class Server:
             shards = None
         profile = req.query.get("profile", ["false"])[0] == "true"
         return self.api.query(req.vars["index"], pql, shards, profile,
-                              remote=remote)
+                              remote=remote,
+                              qos=_qos_from_headers(req.headers))
 
     def _post_sql(self, req):
         body = req.json_lenient()
@@ -741,6 +756,28 @@ class Server:
         from pilosa_tpu.obs import flight
         flight.flush_metrics()  # JSON scrapes see current data too
         return metrics.registry.render_json()
+
+
+def _qos_from_headers(headers):
+    """QoS admission intent from the request headers:
+
+        X-Pilosa-Tenant:      fair-queueing tenant (default "default")
+        X-Pilosa-Priority:    "point" | "heavy" class override
+        X-Pilosa-Deadline-Ms: client's total latency budget
+
+    None when no QoS header is present (the serving layer then applies
+    its configured defaults)."""
+    tenant = headers.get("X-Pilosa-Tenant")
+    priority = headers.get("X-Pilosa-Priority")
+    deadline = headers.get("X-Pilosa-Deadline-Ms")
+    if tenant is None and priority is None and deadline is None:
+        return None
+    from pilosa_tpu.executor.sched import QoS
+    try:
+        dl = float(deadline) if deadline is not None else None
+    except ValueError:
+        dl = None
+    return QoS.make(tenant=tenant, priority=priority, deadline_ms=dl)
 
 
 class RawResponse:

@@ -1,0 +1,374 @@
+"""Ragged paged dispatch tests (executor/ragged.py): one fused
+page-table device program serving heterogeneous batches — mixed
+indexes, mixed shard subsets, mixed Count/Row/Sum/TopN kinds —
+bit-exact vs solo execution, on host and jit engines, under
+concurrent writes (the stale-snapshot re-execution path included)."""
+
+import random
+import threading
+
+import pytest
+
+from pilosa_tpu import memory
+from pilosa_tpu.api import serialize_result
+from pilosa_tpu.executor.executor import Executor
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.models.schema import FieldOptions, FieldType
+from pilosa_tpu.obs import metrics
+
+
+def build_mixed_holder() -> Holder:
+    """Two indexes with different shard counts and field shapes —
+    the heterogeneous-traffic fixture."""
+    h = Holder()
+    a = h.create_index("alpha", track_existence=True)
+    a.create_field("a")
+    a.create_field("b")
+    a.create_field("v", FieldOptions(type=FieldType.INT,
+                                     min=0, max=1000))
+    b = h.create_index("beta", track_existence=False)
+    b.create_field("c")
+    b.create_field("w", FieldOptions(type=FieldType.INT,
+                                     min=-50, max=500))
+    ex = Executor(h)
+    w = a.width
+    for i in range(240):
+        col = (i * 9973) % (3 * w)          # 3 shards
+        ex.execute("alpha", f"Set({col}, a={i % 4})")
+        ex.execute("alpha", f"Set({col}, b={i % 6})")
+        ex.execute("alpha", f"Set({col}, v={(i * 7) % 97})")
+    for i in range(180):
+        col = (i * 7919) % (5 * w)          # 5 shards
+        ex.execute("beta", f"Set({col}, c={i % 3})")
+        ex.execute("beta", f"Set({col}, w={(i * 11) % 300 - 40})")
+    return h
+
+
+@pytest.fixture(scope="module")
+def holder():
+    return build_mixed_holder()
+
+
+MIXED = [
+    ("alpha", "Count(Row(a=1))", None),
+    ("alpha", "Count(Row(b=2))", None),
+    ("beta", "Count(Row(c=0))", None),
+    ("beta", "Count(Row(c=2))", None),
+    ("alpha", "Count(Intersect(Row(a=1), Row(b=2)))", None),
+    ("alpha", "Count(Union(Row(a=0), Row(b=5)))", None),
+    ("beta", "Count(Union(Row(c=0), Row(c=1)))", None),
+    ("alpha", "Row(a=2)", None),
+    ("beta", "Row(c=1)", None),
+    ("alpha", "Sum(Row(a=1), field=v)", None),
+    ("beta", "Sum(field=w)", None),
+    ("alpha", "Count(Row(v > 50))", None),
+    ("beta", "Count(Row(w > 100))", None),
+    ("beta", "Count(Row(w < 0))", None),
+    ("alpha", "TopN(a, n=3)", None),
+    ("beta", "TopN(c, n=2)", None),
+    # explicit shard subsets: same index, different skey -> its own
+    # group, fused into the same ragged program
+    ("alpha", "Count(Row(a=1))", [0, 1]),
+    ("alpha", "Count(Row(a=1))", [2]),
+    ("beta", "Count(Row(c=0))", [0, 2, 4]),
+    ("alpha", "Count(Not(Row(a=1)))", None),
+]
+
+
+def run_concurrent(srv, items):
+    got = {}
+    lock = threading.Lock()
+    bar = threading.Barrier(len(items))
+
+    def one(k):
+        idx, q, shards = k
+        bar.wait()
+        r = [serialize_result(x)
+             for x in srv.execute_serving(idx, q, shards)]
+        with lock:
+            got[k] = r
+
+    keyed = [(i, q, tuple(s) if s else None) for i, q, s in items]
+    ts = [threading.Thread(target=one, args=(k,)) for k in keyed]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return got
+
+
+def solo_expect(plain, items):
+    return {(i, q, tuple(s) if s else None):
+            [serialize_result(x) for x in plain.execute(i, q, s)]
+            for i, q, s in items}
+
+
+@pytest.mark.parametrize("host_only", [False, True])
+def test_mixed_batch_bit_exact_one_dispatch(holder, host_only):
+    """The whole mixed-index batch fuses into ONE ragged dispatch and
+    every query demuxes to its exact solo result — on the jit engine
+    and the host-only engine."""
+    plain = Executor(holder)
+    plain.stacked.host_only = host_only
+    srv = Executor(holder)
+    srv.stacked.host_only = host_only
+    layer = srv.enable_serving(window_s=0.05, max_batch=64,
+                               cache_bytes=0, admission=False)
+    assert layer.ragged
+    want = solo_expect(plain, MIXED)
+    r0 = metrics.SERVING_DISPATCH.value(kind="ragged")
+    got = run_concurrent(srv, MIXED)
+    assert got == want
+    assert metrics.SERVING_DISPATCH.value(kind="ragged") > r0
+
+
+def test_ragged_off_matches(holder):
+    """A/B sanity: the per-group path serves the same batch
+    identically (the bench A/B's control arm)."""
+    plain = Executor(holder)
+    srv = Executor(holder)
+    srv.enable_serving(window_s=0.05, max_batch=64, cache_bytes=0,
+                       ragged=False, admission=False)
+    g0 = metrics.SERVING_DISPATCH.value(kind="group")
+    got = run_concurrent(srv, MIXED)
+    assert got == solo_expect(plain, MIXED)
+    assert metrics.SERVING_DISPATCH.value(kind="group") > g0
+
+
+def test_multipage_page_table(holder):
+    """Small pages force real multi-page page tables: the fused
+    gather must reassemble multi-page operands exactly."""
+    prev = memory.page_bytes()
+    memory.configure(page_bytes=64 << 10)
+    try:
+        plain = Executor(holder)
+        srv = Executor(holder)
+        srv.enable_serving(window_s=0.05, max_batch=64,
+                           cache_bytes=0, admission=False)
+        got = run_concurrent(srv, MIXED)
+        assert got == solo_expect(plain, MIXED)
+    finally:
+        memory.configure(page_bytes=prev)
+
+
+def test_segment_ops_bit_exact():
+    """ops/bitmap.py segment primitives: page-table gather + segment
+    popcount reduce match the numpy twin, padding contract included."""
+    import numpy as np
+
+    from pilosa_tpu.ops import bitmap as bm
+
+    rng = np.random.default_rng(3)
+    pages = [rng.integers(0, 1 << 32, size=(4, 8), dtype=np.uint32)
+             for _ in range(3)]
+    # pow2-pad the page tuple by repeating the last page
+    padded = tuple(pages) + (pages[-1],)
+    lane_idx = np.array([0, 5, 11, 2, 7, 7, 3, 3], np.int32)
+    got = np.asarray(bm.concat_gather(padded, lane_idx))
+    flat = np.concatenate(pages)
+    assert (got == flat[lane_idx]).all()
+    seg_ids = np.array([0, 0, 1, 1, 2, 2, 3, 3], np.int32)
+    counts = np.asarray(bm.segment_count(got, seg_ids, 5))
+    want = bm.segment_count_np(flat[lane_idx], seg_ids, 5)
+    assert (counts[:5] == want).all()
+    # the dump segment (no lanes mapped) stays zero
+    assert counts[4] == 0 == want[4]
+
+
+def test_raw_pages_view(holder):
+    """stacked.raw_pages(): a paged stack fetch returns a PageView
+    whose pages concatenate to the assembled operand."""
+    import numpy as np
+
+    from pilosa_tpu.executor import stacked as stk
+    from pilosa_tpu.models.view import VIEW_STANDARD
+
+    ex = Executor(holder)
+    idx = holder.index("alpha")
+    f = idx.field("a")
+    skey = tuple(sorted(idx.available_shards))
+    whole = np.asarray(ex.stacked.row_stack(
+        idx, f, (VIEW_STANDARD,), 1, skey))
+    with stk.raw_pages():
+        pv = ex.stacked.row_stack(idx, f, (VIEW_STANDARD,), 1, skey)
+    assert isinstance(pv, stk.PageView)
+    flat = np.concatenate([np.asarray(p) for p in pv.pages])
+    got = flat[: pv.lanes].reshape(pv.shape)
+    assert (got == whole).all()
+    # outside the context the same fetch assembles again
+    again = np.asarray(ex.stacked.row_stack(
+        idx, f, (VIEW_STANDARD,), 1, skey))
+    assert (again == whole).all()
+
+
+def test_property_random_mixed_batches_with_writes():
+    """Seeded random mixed-index/mixed-shard batches of
+    Count/Row/Sum/TopN stay bit-exact vs solo execution while writes
+    interleave between rounds."""
+    rng = random.Random(7)
+    h = build_mixed_holder()
+    plain = Executor(h)
+    srv = Executor(h)
+    srv.enable_serving(window_s=0.02, max_batch=64, cache_bytes=0,
+                       admission=False)
+    writer = Executor(h)
+
+    def tree(index, depth):
+        fields = ([("a", 4), ("b", 6)] if index == "alpha"
+                  else [("c", 3)])
+        if depth <= 0 or rng.random() < 0.45:
+            if rng.random() < 0.3:
+                vf = "v" if index == "alpha" else "w"
+                op = rng.choice([">", "<", ">=", "<=", "=="])
+                return f"Row({vf} {op} {rng.randrange(-20, 120)})"
+            f, r = rng.choice(fields)
+            return f"Row({f}={rng.randrange(r)})"
+        op = rng.choice(["Union", "Intersect", "Difference", "Xor"])
+        kids = ", ".join(tree(index, depth - 1)
+                         for _ in range(rng.randrange(2, 4)))
+        return f"{op}({kids})"
+
+    def query(index):
+        t = tree(index, 2)
+        wrap = rng.randrange(5)
+        if wrap == 0:
+            return f"Count({t})"
+        if wrap == 1:
+            tf = "a" if index == "alpha" else "c"
+            return f"TopN({tf}, {t}, n=3)"
+        if wrap == 2:
+            vf = "v" if index == "alpha" else "w"
+            return f"Sum({t}, field=vf)".replace("vf", vf)
+        if wrap == 3:
+            return t
+        return f"Count({t})"
+
+    n_shards = {"alpha": 3, "beta": 5}
+    for round_ in range(5):
+        items = []
+        for _ in range(10):
+            index = rng.choice(["alpha", "beta"])
+            shards = None
+            if rng.random() < 0.3:
+                shards = sorted(rng.sample(
+                    range(n_shards[index]),
+                    rng.randrange(1, n_shards[index] + 1)))
+            items.append((index, query(index), shards))
+        # dedupe (same (index, query, shards) twice would race the
+        # dict; results identical anyway)
+        items = list({(i, q, tuple(s) if s else None): (i, q, s)
+                      for i, q, s in items}.values())
+        want = solo_expect(plain, items)
+        got = run_concurrent(srv, items)
+        assert got == want, f"round {round_}"
+        for _ in range(6):
+            index = rng.choice(["alpha", "beta"])
+            col = rng.randrange(n_shards[index] * h.index(index).width)
+            f = rng.choice(["a", "b"] if index == "alpha" else ["c"])
+            writer.execute(index, f"Set({col}, {f}={rng.randrange(3)})")
+
+
+def test_monotone_counts_under_concurrent_writes():
+    """The stale-snapshot re-execution path: readers hammering the
+    ragged serving path while a writer adds bits must never see a
+    torn or stale (non-monotone) count."""
+    h = build_mixed_holder()
+    srv = Executor(h)
+    srv.enable_serving(window_s=0.001, max_batch=32, cache_bytes=0,
+                       admission=False)
+    writer = Executor(h)
+    n_writes, n_readers, n_iters = 80, 6, 30
+    errs: list = []
+
+    def write():
+        try:
+            for c in range(n_writes):
+                writer.execute("alpha", f"Set({c}, a=9)")
+                writer.execute("beta", f"Set({c}, c=9)")
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    def read(index, row):
+        try:
+            prev = -1
+            for _ in range(n_iters):
+                (n,) = srv.execute_serving(
+                    index, f"Count(Row({row}=9))")
+                assert n >= prev, (index, n, prev)
+                prev = n
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=write)] + [
+        threading.Thread(target=read,
+                         args=("alpha", "a") if i % 2 else
+                         ("beta", "c"))
+        for i in range(n_readers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    (na,) = Executor(h).execute("alpha", "Count(Row(a=9))")
+    (nb,) = Executor(h).execute("beta", "Count(Row(c=9))")
+    assert na == n_writes and nb == n_writes
+
+
+def _run_one_batch(layer, items):
+    """Drive ONE deterministic batch through the leader protocol
+    (bypassing the timing-dependent admission window)."""
+    from pilosa_tpu.pql import parse
+
+    reqs = []
+    for index, q, shards in items:
+        idx = layer.executor.holder.index(index)
+        r = layer._classify(index, idx, parse(q), shards, None,
+                            (index, q, None))
+        assert r is not None, (index, q)
+        reqs.append(r)
+    layer._run_batch(reqs)
+    out = {}
+    for (index, q, shards), r in zip(items, reqs):
+        assert r.error is None and not r.direct and \
+            r.result is not None, (index, q)
+        out[(index, q, tuple(shards) if shards else None)] = [
+            serialize_result(x) for x in r.result]
+    return out
+
+
+def test_canonical_composition_stabilizes_executable(holder):
+    """Composition hysteresis: once the canonical slot set covers the
+    traffic, EVERY batch — whatever subset of the mix it carries —
+    dispatches the same fused program.  After the union plan exists,
+    re-running either sub-composition compiles nothing new."""
+    from pilosa_tpu.executor import stacked as stk
+
+    srv = Executor(holder)
+    layer = srv.enable_serving(window_s=0.05, max_batch=64,
+                               cache_bytes=0, admission=False)
+    plain = Executor(holder)
+    batch1 = [("alpha", "Count(Row(a=0))", None),
+              ("alpha", "Count(Row(a=1))", None),
+              ("beta", "Count(Row(c=0))", None)]
+    batch2 = [("alpha", "Count(Row(b=1))", None),
+              ("alpha", "Count(Row(b=3))", None),
+              ("beta", "Count(Row(c=2))", None)]
+    # first sighting rides the extras program (probation); the second
+    # sighting promotes into the canonical set
+    assert _run_one_batch(layer, batch1) == solo_expect(plain, batch1)
+    assert len(layer._ragged_canon.slots) == 0
+    assert _run_one_batch(layer, batch1) == solo_expect(plain, batch1)
+    assert len(layer._ragged_canon.slots) == 3
+    assert _run_one_batch(layer, batch2) == solo_expect(plain, batch2)
+    assert _run_one_batch(layer, batch2) == solo_expect(plain, batch2)
+    assert len(layer._ragged_canon.slots) == 6
+    union_sigs = {s for s in stk._JIT_CACHE
+                  if s[0].startswith("('ragged'")}
+    assert union_sigs
+    # steady state: both compositions now ride the ONE union plan —
+    # no new executable for either sub-composition
+    assert _run_one_batch(layer, batch1) == solo_expect(plain, batch1)
+    assert _run_one_batch(layer, batch2) == solo_expect(plain, batch2)
+    assert {s for s in stk._JIT_CACHE
+            if s[0].startswith("('ragged'")} == union_sigs
+    assert len(layer._ragged_canon.slots) == 6

@@ -1,0 +1,345 @@
+"""QoS admission tests (executor/sched.py): classes, the bounded
+heavy gate, weighted per-tenant fair queueing, backpressure (typed
+503 + Retry-After), deadlines (typed 504), and the transport/flight/
+metrics plumbing."""
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from pilosa_tpu.executor.executor import Executor
+from pilosa_tpu.executor.sched import (
+    CLASS_HEAVY,
+    CLASS_POINT,
+    AdmissionScheduler,
+    QoS,
+    ServingDeadlineExceeded,
+    ServingShedError,
+    classify,
+    parse_weights,
+)
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.obs import metrics
+from pilosa_tpu.pql import parse
+
+
+def test_classify():
+    assert classify(parse("Count(Row(a=1))"), None) == CLASS_POINT
+    assert classify(parse("Sum(Row(a=1), field=v)"), None) \
+        == CLASS_POINT
+    assert classify(parse("Row(a=1)"), None) == CLASS_POINT
+    assert classify(parse(
+        "GroupBy(Rows(a), aggregate=Sum(field=v))"), None) \
+        == CLASS_HEAVY
+    assert classify(parse("TopN(a, n=3)"), None) == CLASS_HEAVY
+    assert classify(parse("Extract(All(), Rows(a))"), None) \
+        == CLASS_HEAVY
+    # nested heavy call inside an arg tree
+    assert classify(parse("Count(Distinct(field=v))"), None) \
+        == CLASS_HEAVY
+    # explicit priority overrides the classifier both ways
+    assert classify(parse("Count(Row(a=1))"),
+                    QoS.make(priority="heavy")) == CLASS_HEAVY
+    assert classify(parse("TopN(a, n=3)"),
+                    QoS.make(priority="point")) == CLASS_POINT
+
+
+def test_parse_weights():
+    assert parse_weights("a:4, b:1") == {"a": 4.0, "b": 1.0}
+    assert parse_weights("") == {}
+    assert parse_weights(None) == {}
+    # malformed entries are dropped, not fatal
+    assert parse_weights("a:4,junk,b:zero,c:2") == {"a": 4.0,
+                                                    "c": 2.0}
+
+
+def test_heavy_gate_bounds_concurrency():
+    sched = AdmissionScheduler(heavy_slots=2, queue_max=64)
+    peak = [0]
+    running = [0]
+    lock = threading.Lock()
+
+    def worker():
+        with sched.heavy_slot(None):
+            with lock:
+                running[0] += 1
+                peak[0] = max(peak[0], running[0])
+            time.sleep(0.02)
+            with lock:
+                running[0] -= 1
+
+    ts = [threading.Thread(target=worker) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert peak[0] <= 2
+    assert sched.queued() == 0
+
+
+def test_weighted_fair_queue_grant_order():
+    """Stride scheduling: with one slot busy, a weight-4 tenant's
+    queued requests drain ~4x faster than a weight-1 tenant's."""
+    sched = AdmissionScheduler(heavy_slots=1, queue_max=64,
+                               tenant_weights={"big": 4.0,
+                                               "small": 1.0})
+    order: list[str] = []
+    lock = threading.Lock()
+    blocker = sched.heavy_slot(None)
+    blocker.__enter__()          # occupy the only slot
+
+    def worker(tenant):
+        with sched.heavy_slot(QoS.make(tenant=tenant)):
+            with lock:
+                order.append(tenant)
+            time.sleep(0.002)
+
+    ts = []
+    # enqueue big first so dict iteration ties break deterministically
+    for i in range(8):
+        t = threading.Thread(target=worker,
+                             args=("big" if i % 2 == 0 else "small",))
+        ts.append(t)
+        t.start()
+        time.sleep(0.01)         # FIFO enqueue order
+    assert sched.queued() == 8
+    blocker.__exit__(None, None, None)
+    for t in ts:
+        t.join()
+    # first five grants: at least four to the weight-4 tenant
+    assert order.count("big") == 4 and order.count("small") == 4
+    assert order[:5].count("big") >= 4, order
+    # drained tenants leave no per-tenant state behind (the tenant
+    # header is client-controlled — retained entries would leak)
+    assert not sched._queues and not sched._passes
+
+
+def test_backpressure_shed_typed_503():
+    sched = AdmissionScheduler(heavy_slots=1, queue_max=2)
+    blocker = sched.heavy_slot(None)
+    blocker.__enter__()
+    def queue_one():
+        with sched.heavy_slot(None):
+            time.sleep(0.01)
+
+    waiters = []
+    for _ in range(2):
+        t = threading.Thread(target=queue_one)
+        t.start()
+        waiters.append(t)
+    for _ in range(100):
+        if sched.queued() == 2:
+            break
+        time.sleep(0.005)
+    assert sched.queued() == 2
+    shed0 = metrics.ADMISSION_TOTAL.value(**{"class": "heavy",
+                                             "outcome": "shed"})
+    with pytest.raises(ServingShedError) as ei:
+        with sched.heavy_slot(None):
+            pass
+    assert ei.value.status == 503
+    assert ei.value.retry_after_s > 0
+    assert metrics.ADMISSION_TOTAL.value(
+        **{"class": "heavy", "outcome": "shed"}) == shed0 + 1
+    blocker.__exit__(None, None, None)
+    for t in waiters:
+        t.join()
+
+
+def test_deadline_expiry_504():
+    sched = AdmissionScheduler(heavy_slots=1, queue_max=8)
+    # dead on arrival
+    qos = QoS.make(deadline_ms=0.001)
+    time.sleep(0.002)
+    with pytest.raises(ServingDeadlineExceeded) as ei:
+        with sched.heavy_slot(qos):
+            pass
+    assert ei.value.status == 504
+    # expires while queued
+    blocker = sched.heavy_slot(None)
+    blocker.__enter__()
+    t0 = time.perf_counter()
+    with pytest.raises(ServingDeadlineExceeded):
+        with sched.heavy_slot(QoS.make(deadline_ms=50)):
+            pass
+    assert time.perf_counter() - t0 < 5.0
+    assert sched.queued() == 0    # the abandoned ticket was reaped
+    blocker.__exit__(None, None, None)
+
+
+def build_holder():
+    h = Holder()
+    idx = h.create_index("i", track_existence=False)
+    idx.create_field("a")
+    from pilosa_tpu.models.schema import FieldOptions, FieldType
+    idx.create_field("v", FieldOptions(type=FieldType.INT,
+                                       min=0, max=1000))
+    ex = Executor(h)
+    for c in range(120):
+        ex.execute("i", f"Set({c}, a={c % 4})")
+        ex.execute("i", f"Set({c}, v={(c * 7) % 97})")
+    return h
+
+
+def test_point_reads_bypass_saturated_heavy_gate():
+    """With every heavy slot occupied, a point read still executes
+    immediately — the acceptance behavior behind the gauntlet's
+    point-p99-under-GroupBy-storm bar."""
+    h = build_holder()
+    srv = Executor(h)
+    layer = srv.enable_serving(window_s=0.0, max_batch=8,
+                               heavy_slots=1, queue_max=4)
+    blocker = layer.sched.heavy_slot(None)
+    blocker.__enter__()          # saturate the heavy gate
+    try:
+        t0 = time.perf_counter()
+        (n,) = srv.execute_serving("i", "Count(Row(a=1))")
+        assert n == 30
+        assert time.perf_counter() - t0 < 5.0
+    finally:
+        blocker.__exit__(None, None, None)
+
+
+def test_default_deadline_applies_to_tenant_only_qos():
+    """Regression: a request carrying only a tenant header must still
+    inherit the operator's default-deadline-ms — QoS headers don't
+    opt a request out of the configured budget."""
+    h = build_holder()
+    srv = Executor(h)
+    layer = srv.enable_serving(window_s=0.0, max_batch=8,
+                               heavy_slots=1, queue_max=4,
+                               default_deadline_ms=60.0)
+    blocker = layer.sched.heavy_slot(None)
+    blocker.__enter__()          # saturate: heavy queries must queue
+    try:
+        t0 = time.perf_counter()
+        with pytest.raises(ServingDeadlineExceeded):
+            srv.execute_serving("i", "TopN(a, n=3)",
+                                qos=QoS.make(tenant="acme"))
+        assert time.perf_counter() - t0 < 5.0
+    finally:
+        blocker.__exit__(None, None, None)
+
+
+def test_heavy_query_end_to_end_shed():
+    h = build_holder()
+    srv = Executor(h)
+    layer = srv.enable_serving(window_s=0.0, max_batch=8,
+                               heavy_slots=1, queue_max=1)
+    blocker = layer.sched.heavy_slot(None)
+    blocker.__enter__()
+    done = threading.Event()
+
+    def queued_one():
+        srv.execute_serving("i", "TopN(a, n=3)")
+        done.set()
+
+    t = threading.Thread(target=queued_one)
+    t.start()
+    for _ in range(200):
+        if layer.sched.queued() == 1:
+            break
+        time.sleep(0.005)
+    try:
+        with pytest.raises(ServingShedError):
+            srv.execute_serving("i", "TopN(a, n=2)")
+    finally:
+        blocker.__exit__(None, None, None)
+        t.join()
+    assert done.is_set()
+
+
+def test_http_headers_shed_retry_after_and_flight_fields():
+    """End to end over HTTP: X-Pilosa-* headers drive admission, a
+    shed renders as 503 + Retry-After, an expired deadline as 504,
+    and /debug/queries records carry tenant/priority/deadline_ms."""
+    from pilosa_tpu import config as cfgmod
+    from pilosa_tpu.server import Server
+
+    cfg = cfgmod.Config(serving_heavy_slots=1, serving_queue_max=1)
+    with Server(config=cfg) as s:
+        s.start()
+        c = http.client.HTTPConnection("127.0.0.1", s.port,
+                                       timeout=10)
+
+        def post(path, body, headers=None):
+            hdrs = {"Content-Type": "application/json"}
+            hdrs.update(headers or {})
+            c.request("POST", path, body=json.dumps(body),
+                      headers=hdrs)
+            r = c.getresponse()
+            return r.status, dict(r.getheaders()), r.read()
+
+        st, _h, _b = post("/index/q1", {})
+        assert st == 200
+        st, _h, _b = post("/index/q1/field/f", {})
+        assert st == 200
+        st, _h, _b = post("/index/q1/query", {"query": "Set(1, f=1)"})
+        assert st == 200
+        # a point read with QoS headers lands a flight record with
+        # tenant/priority/deadline_ms
+        st, _h, _b = post(
+            "/index/q1/query", {"query": "Count(Row(f=1))"},
+            {"X-Pilosa-Tenant": "acme",
+             "X-Pilosa-Deadline-Ms": "5000"})
+        assert st == 200
+        c.request("GET", "/debug/queries?n=10")
+        recs = json.loads(c.getresponse().read())["queries"]
+        mine = [r for r in recs if r.get("tenant") == "acme"]
+        assert mine, recs
+        assert mine[0]["priority"] == "point"
+        assert mine[0]["deadline_ms"] == 5000.0
+        # saturate the single heavy slot, fill the queue of 1, then a
+        # further heavy query sheds 503 + Retry-After on the wire
+        layer = s.api.executor.serving
+        blocker = layer.sched.heavy_slot(None)
+        blocker.__enter__()
+        results = {}
+
+        def queued_query():
+            c2 = http.client.HTTPConnection("127.0.0.1", s.port,
+                                            timeout=30)
+            c2.request("POST", "/index/q1/query",
+                       body=json.dumps({"query": "TopN(f, n=2)"}),
+                       headers={"Content-Type": "application/json"})
+            results["queued"] = c2.getresponse().status
+            c2.close()
+
+        t = threading.Thread(target=queued_query)
+        t.start()
+        for _ in range(200):
+            if layer.sched.queued() == 1:
+                break
+            time.sleep(0.005)
+        try:
+            st, hdrs, body = post("/index/q1/query",
+                                  {"query": "TopN(f, n=1)"})
+            assert st == 503, body
+            assert "Retry-After" in hdrs
+            assert json.loads(body)["type"] == "ServingShedError"
+        finally:
+            blocker.__exit__(None, None, None)
+            t.join()
+        assert results["queued"] == 200
+        # deadline expiring while QUEUED (gate saturated, queue
+        # empty): typed 504
+        blocker = layer.sched.heavy_slot(None)
+        blocker.__enter__()
+        try:
+            st, _h, body = post(
+                "/index/q1/query", {"query": "TopN(f, n=1)"},
+                {"X-Pilosa-Deadline-Ms": "40"})
+            assert st == 504, body
+        finally:
+            blocker.__exit__(None, None, None)
+        # admission + tenant-depth metrics reach /metrics
+        c.request("GET", "/metrics")
+        text = c.getresponse().read().decode()
+        c.close()
+    assert "pilosa_serving_admission_total" in text
+    assert 'outcome="shed"' in text
+    assert "pilosa_serving_tenant_queue_depth" in text
+    assert "pilosa_serving_dispatch_total" in text

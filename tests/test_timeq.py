@@ -158,6 +158,55 @@ def test_ttl_view_removal():
     assert f2.remove_expired_views() == []
 
 
+def test_ttl_expiry_invalidates_derived_state():
+    """Regression (ISSUE 8 satellite): TTL view expiry must
+    invalidate derived state — the dropped fragments' gens are bumped
+    (so closures in the tile-stack/prefetch planes holding direct
+    fragment references see stale stamps) and a serving-ResultCache
+    sweep evicts entries whose read set covered the expired quantum,
+    so a cached ranged Count can't keep serving the expired window."""
+    import datetime as dt
+    from pilosa_tpu.executor.executor import Executor
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.models.schema import (
+        FieldOptions,
+        FieldType,
+        TimeQuantum,
+    )
+
+    h = Holder()
+    idx = h.create_index("ttl2", track_existence=False)
+    f = idx.create_field("ev", FieldOptions(
+        type=FieldType.TIME, time_quantum=TimeQuantum("YMD"),
+        ttl=86400.0))
+    old = dt.datetime(2021, 3, 1, 12)
+    f.set_bit(1, 10, timestamp=old)
+    f.set_bit(1, 11, timestamp=old)
+    old_frags = [fr for name, v in f.views.items()
+                 if name.startswith("standard_2021")
+                 for fr in v.fragments.values()]
+    assert old_frags
+    gens_before = [fr.gen for fr in old_frags]
+
+    srv = Executor(h)
+    layer = srv.enable_serving(window_s=0.0, max_batch=8)
+    q = "Count(Row(ev=1, from='2021-03-01T00:00', to='2021-03-03T00:00'))"
+    (before,) = srv.execute_serving("ttl2", q)
+    assert before == 2
+    assert len(layer.cache) == 1
+
+    removed = f.remove_expired_views()
+    assert any(v.startswith("standard_2021") for v in removed)
+    # gens bumped: every derived (gen, version) stamp is now stale
+    assert all(fr.gen != g for fr, g in zip(old_frags, gens_before))
+    # the eager sweep (what the server's maintenance tick runs after
+    # a removal) evicts the stale entry outright
+    assert layer.cache.sweep(h) == 1
+    assert len(layer.cache) == 0
+    (after,) = srv.execute_serving("ttl2", q)
+    assert after == 0
+
+
 def test_ttl_removal_persists(tmp_path):
     """Expired views are deleted from storage too — a reopen must not
     resurrect them."""
