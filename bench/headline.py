@@ -10,9 +10,13 @@ import time
 from bench.common import _preview, log
 
 
-def run_queries(h, reps: int, label: str) -> dict[str, list[float]]:
-    """Time the two north-star queries through Executor.execute."""
+def run_queries(h, reps: int, label: str):
+    """Time the two north-star queries through Executor.execute.
+    Returns (per-query wall times, windowed roofline attribution) —
+    the headline cells emit achieved-GB/s + fraction-of-peak per op
+    family (ISSUE 10; ROADMAP item 3's acceptance as live data)."""
     from pilosa_tpu.executor.executor import Executor
+    from pilosa_tpu.obs import roofline
 
     ex = Executor(h)
     queries = {
@@ -49,16 +53,26 @@ def run_queries(h, reps: int, label: str) -> dict[str, list[float]]:
     a = [(p.id, p.count) for p in warm["topn_filtered"][0]]
     b = [(p.id, p.count) for p in warm["topn_ranked_filtered"][0]]
     assert a == b, f"ranked TopN != exact TopN: {a} vs {b}"
+    # roofline window over the MEASURED reps only (the warm pass's
+    # compile dispatches never note, but its stack uploads ran there)
+    roofline.ensure_peak()  # blocking probe: one-time, pre-timing
+    snap0 = roofline.snapshot()
     times: dict[str, list[float]] = {k: [] for k in queries}
     for _ in range(reps):
         for name, q in queries.items():
             t0 = time.perf_counter()
             ex.execute("bench", q)
             times[name].append(time.perf_counter() - t0)
+    rl = roofline.window(snap0, roofline.snapshot())
     for name, ts in times.items():
         log(f"[{label}] {name}: p50={statistics.median(ts)*1e3:.2f}ms "
             f"min={min(ts)*1e3:.2f}ms max={max(ts)*1e3:.2f}ms")
-    return times
+    for op, ent in rl.get("ops", {}).items():
+        log(f"[{label}] roofline {op}: {ent['gbps']} GB/s"
+            + (f" ({ent['fraction']:.1%} of "
+               f"{rl['peak_gbps']} GB/s peak)"
+               if "fraction" in ent else ""))
+    return times, rl
 
 
 def loop_calibrate(h, reps: int = 5) -> dict[str, float]:

@@ -65,7 +65,7 @@ def main() -> None:
     reps = 20 if on_tpu else 5
 
     h, cells = build_index(n_shards, topn_rows)
-    full = run_queries(h, reps, f"{n_shards}sh")
+    full, full_roofline = run_queries(h, reps, f"{n_shards}sh")
     # concurrent-serving A/B: the dispatch-coalescing serving path
     # (executor/serving.py) vs per-query execution, same holder
     serving = serving_gauntlet(h)
@@ -100,7 +100,7 @@ def main() -> None:
     # dispatch-floor calibration: same engine path, 1 shard, so the
     # wall-time difference is pure device scan time at scale
     h_tiny, _ = build_index(1, topn_rows)
-    tiny = run_queries(h_tiny, reps, "1sh")
+    tiny, _tiny_roofline = run_queries(h_tiny, reps, "1sh")
 
     p50 = {k: statistics.median(v) for k, v in full.items()}
     p50_tiny = {k: statistics.median(v) for k, v in tiny.items()}
@@ -142,6 +142,12 @@ def main() -> None:
         "raw_wall_p50_1shard_ms": {k: round(v * 1e3, 3)
                                    for k, v in p50_tiny.items()},
         "net_device_p50_ms": {k: round(v, 3) for k, v in net_ms.items()},
+        # roofline attribution over the headline reps (ISSUE 10):
+        # achieved GB/s + fraction-of-peak per op family, against the
+        # measured STREAM-style peak — ROADMAP item 3's "within 4x of
+        # the bandwidth bound" as recorded data (never asserted on
+        # the CPU fallback)
+        "roofline_headline": full_roofline,
         # GroupBy combo-count sweep (one-pass group-code path):
         # roughly flat in C is the acceptance signal
         "groupby_combo_sweep_wall_p50_ms": {

@@ -43,6 +43,9 @@ def serving_gauntlet(h, clients_list=(1, 8, 32),
     prev_enabled = flight.recorder.enabled
     prev_keep = flight.recorder._ring.maxlen
 
+    from pilosa_tpu.obs import roofline
+    roofline.ensure_peak()  # one-time blocking probe, outside cells
+
     def run_mode(batched: bool, n_clients: int) -> dict:
         call = ex_srv.execute_serving if batched else ex_plain.execute
         for q in queries:  # warm: compile + tile-stack upload
@@ -50,9 +53,14 @@ def serving_gauntlet(h, clients_list=(1, 8, 32),
         # ring sized for the window so the breakdown sees every record
         flight.recorder.configure(enabled=True, keep=16384)
         flight.recorder.clear()
+        rl0 = roofline.snapshot()
         cell = _client_storm(call, queries, n_clients, duration_s)
         cell["phase_breakdown_ms"] = flight.phase_breakdown(
             flight.recorder.recent(16384))
+        # per-cell roofline: achieved GB/s + fraction-of-peak per op
+        # over this cell's dispatches (ISSUE 10; recorded, not
+        # asserted — CPU numbers are honest-but-humble host bandwidth)
+        cell["roofline"] = roofline.window(rl0, roofline.snapshot())
         return cell
 
     out: dict = {}
@@ -122,6 +130,7 @@ def tracing_overhead_gauntlet(h, n_clients: int = 8,
                 if pair_overheads else None)
     p50_off = stats.median(p50s["off"]) if p50s["off"] else None
     probe = flight_cost_probe()
+    probe.update(roofline_cost_probe())
     out = {"recorder_off_qps": best["off"],
            "recorder_on_qps": best["on"],
            "overhead_pct": overhead,
@@ -180,6 +189,66 @@ def flight_cost_probe(n: int = 20000, threads: int = 4) -> dict:
     return {"enabled_cycle_us_1t": round(on_1t, 2),
             "enabled_cycle_us_4t": round(on_4t, 2),
             "disabled_cycle_us_4t": round(off_4t, 2)}
+
+
+def roofline_cost_probe(n: int = 8000, threads: int = 4) -> dict:
+    """Fixed cost of trace propagation + roofline attribution
+    (ISSUE 10 acceptance), same STABLE-probe style as
+    flight_cost_probe.  The enabled cycle is the full remote-leg
+    shape a cluster RPC pays: inherit the caller's trace id, record
+    one span under a pushed tracer, serialize it to wire form
+    (span_to_wire), run a flight begin/commit with one per-dispatch
+    roofline.note.  Shares the PR 4 <=60us budget — a lock convoy,
+    an accidental peak probe, or serialization blowup shows up here
+    as a 10-1000x jump the qps A/B would drown in scheduler noise."""
+    import threading
+
+    from pilosa_tpu.obs import flight, roofline
+    from pilosa_tpu.obs import tracing as _tr
+
+    def cycle():
+        # the PRODUCTION remote-leg scaffold (flight.remote_leg is
+        # what server/http.py runs per traced RPC), so the gate
+        # measures the real code path, not a probe-local imitation
+        with flight.remote_leg("qprobe", keep=4):
+            f = flight.begin("bench", "probe")
+            with _tr.start_span("rpc:probe", node="probe"):
+                # a dedicated op label: the synthetic notes must not
+                # fold into a real op family's bandwidth gauge
+                roofline.note("probe", 1 << 20, 0.001)
+            flight.commit(f, 0.0002, route="cached")
+
+    def storm(nthreads: int) -> float:
+        def worker():
+            for _ in range(n):
+                cycle()
+        ts = [threading.Thread(target=worker)
+              for _ in range(nthreads)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return (time.perf_counter() - t0) / (nthreads * n) * 1e6
+
+    prev_rec = flight.recorder.enabled
+    # fraction-branch cost included via a fake peak; swap_state
+    # restores EXACTLY what was there (enabled flag and peak,
+    # including unset) so later bench cells never attribute against
+    # the probe's made-up denominator
+    prev_state = roofline.swap_state(
+        enabled=True,
+        peak_bytes_per_s=roofline.peak_or_none() or 1e9)
+    try:
+        flight.recorder.configure(enabled=True)
+        on_4t = storm(threads)
+        roofline.configure(enabled=False)
+        off_4t = storm(threads)
+    finally:
+        roofline.swap_state(*prev_state)
+        flight.recorder.configure(enabled=prev_rec)
+    return {"roofline_on_cycle_us_4t": round(on_4t, 2),
+            "roofline_off_cycle_us_4t": round(off_4t, 2)}
 
 
 def mixed_rw_gauntlet(h, n_readers: int = 32,
@@ -328,6 +397,11 @@ def overhead_smoke() -> int:
       (default 60us — measured ~11us; a hot-path lock convoy shows
       up here as ~10x)
     - median qps overhead <= PILOSA_TPU_OVERHEAD_MAX_PCT (default 60)
+    - roofline-attribution cycle (flight cycle + per-dispatch note,
+      4-thread, attribution ON) <= PILOSA_TPU_ROOFLINE_ON_MAX_US
+      (default 60us — the ISSUE 10 acceptance budget; an accidental
+      peak probe or lock convoy on the dispatch path shows as
+      1000x)
     """
     apply_platform()
     h, _ = build_index(2, 4)
@@ -336,9 +410,12 @@ def overhead_smoke() -> int:
     lim_pct = float(os.environ.get("PILOSA_TPU_OVERHEAD_MAX_PCT", "60"))
     lim_off = float(os.environ.get("PILOSA_TPU_OVERHEAD_OFF_MAX_US", "8"))
     lim_on = float(os.environ.get("PILOSA_TPU_OVERHEAD_ON_MAX_US", "60"))
+    lim_roof = float(os.environ.get("PILOSA_TPU_ROOFLINE_ON_MAX_US",
+                                    "60"))
     out["thresholds"] = {"qps_overhead_pct": lim_pct,
                          "disabled_cycle_us": lim_off,
-                         "enabled_cycle_us": lim_on}
+                         "enabled_cycle_us": lim_on,
+                         "roofline_on_cycle_us": lim_roof}
     print(json.dumps({"metric": "tracing_overhead_smoke", **out}))
     failures = []
     if out["disabled_cycle_us_4t"] > lim_off:
@@ -349,6 +426,10 @@ def overhead_smoke() -> int:
         failures.append(
             f"enabled cycle {out['enabled_cycle_us_4t']}us > "
             f"{lim_on}us")
+    if out["roofline_on_cycle_us_4t"] > lim_roof:
+        failures.append(
+            f"roofline-attribution cycle "
+            f"{out['roofline_on_cycle_us_4t']}us > {lim_roof}us")
     if out["overhead_pct"] is not None and out["overhead_pct"] > lim_pct:
         failures.append(
             f"qps overhead {out['overhead_pct']}% > {lim_pct}%")

@@ -20,8 +20,11 @@ echo "== tracing-overhead smoke =="
 # flight-recorder on-vs-off micro-bench (bench.py --overhead-smoke):
 # catches observability regressions (instrumentation creeping into
 # the hot path) at tier-1 time.  Hard gates are the stable fixed-cost
-# probes (PILOSA_TPU_OVERHEAD_{OFF,ON}_MAX_US); the scheduler-noisy
-# qps A/B is backstopped at PILOSA_TPU_OVERHEAD_MAX_PCT.
+# probes (PILOSA_TPU_OVERHEAD_{OFF,ON}_MAX_US) plus the roofline-
+# attribution probe (flight cycle + per-dispatch bandwidth note with
+# attribution enabled vs disabled, PILOSA_TPU_ROOFLINE_ON_MAX_US —
+# the ISSUE 10 trace-propagation + attribution budget); the
+# scheduler-noisy qps A/B is backstopped at PILOSA_TPU_OVERHEAD_MAX_PCT.
 if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     python bench.py --overhead-smoke; then
     echo "check.sh: tracing-overhead smoke failed" >&2

@@ -60,6 +60,8 @@ def cmd_server(args) -> int:
     cfg.apply_flight_settings()
     cfg.apply_memory_settings()
     cfg.apply_fault_settings()
+    cfg.apply_roofline_settings()
+    cfg.apply_slo_settings()
     holder = Holder(path=cfg.data_dir) if cfg.data_dir else Holder()
     holder.load_schema()
     auth = None

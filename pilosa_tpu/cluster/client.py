@@ -102,7 +102,8 @@ class InternalClient:
 
     def _attempt(self, uri: str, method: str, path: str,
                  data: bytes | None, content_type: str | None,
-                 deadline: Deadline | None) -> tuple[int, bytes]:
+                 deadline: Deadline | None,
+                 extra_headers: dict | None = None) -> tuple[int, bytes]:
         detail = f"{uri}{path}"
         if deadline is not None and deadline.expired():
             # an exhausted budget means the attempt is never sent
@@ -128,6 +129,8 @@ class InternalClient:
             conn.connect()                      # connect deadline
             conn.sock.settimeout(read_t)        # read deadline
             headers = dict(self.headers)
+            if extra_headers:
+                headers.update(extra_headers)
             if content_type is not None:
                 headers["Content-Type"] = content_type
             conn.request(method, path, body=data, headers=headers)
@@ -140,7 +143,8 @@ class InternalClient:
     def _roundtrip(self, uri: str, method: str, path: str,
                    data: bytes | None, content_type: str | None,
                    idempotent: bool = False,
-                   deadline: Deadline | None = None) -> bytes:
+                   deadline: Deadline | None = None,
+                   extra_headers: dict | None = None) -> bytes:
         """Attempt + bounded jittered-backoff retry (idempotent only)
         + RemoteError mapping.  Returns the raw 200 body."""
         attempts = (self.retries + 1) if idempotent else 1
@@ -151,7 +155,8 @@ class InternalClient:
         for a in range(self.retries + 1):
             try:
                 status, raw = self._attempt(uri, method, path, data,
-                                            content_type, deadline)
+                                            content_type, deadline,
+                                            extra_headers)
                 if status != 200:
                     try:
                         msg = json.loads(raw).get("error", "")
@@ -189,26 +194,42 @@ class InternalClient:
 
     def _request(self, uri: str, method: str, path: str, body=None,
                  idempotent: bool = False,
-                 deadline: Deadline | None = None):
+                 deadline: Deadline | None = None,
+                 extra_headers: dict | None = None):
         raw = self._roundtrip(
             uri, method, path,
             None if body is None else json.dumps(body).encode(),
             "application/json", idempotent=idempotent,
-            deadline=deadline)
+            deadline=deadline, extra_headers=extra_headers)
         return json.loads(raw) if raw else None
 
     # executor.remoteExec's transport (executor.go:6392)
     def query_node(self, uri: str, index: str, pql: str,
                    shards: list[int] | None,
                    idempotent: bool = False,
-                   deadline: Deadline | None = None) -> dict:
+                   deadline: Deadline | None = None,
+                   trace_id: str | None = None,
+                   span_parent: str | None = None) -> dict:
         # idempotent=True only for READ fan-outs: retrying a routed
         # write would be correct for the bits but can flip the
         # changed-count answer (a Set retried reports False)
+        #
+        # cross-node tracing (ISSUE 10): the caller's trace id + open
+        # span ride as headers; the remote attaches them via its
+        # TraceContext machinery and returns its serialized child
+        # spans in the response's "trace" trailer, which the
+        # coordinator grafts into its own record (per-node Perfetto
+        # lanes at /debug/trace)
+        headers = None
+        if trace_id is not None:
+            headers = {"X-Pilosa-Trace-Id": trace_id}
+            if span_parent:
+                headers["X-Pilosa-Span-Parent"] = span_parent
         return self._request(uri, "POST", f"/index/{index}/query",
                              {"query": pql, "shards": shards,
                               "remote": True},
-                             idempotent=idempotent, deadline=deadline)
+                             idempotent=idempotent, deadline=deadline,
+                             extra_headers=headers)
 
     def import_bits(self, uri: str, index: str, field: str, rows, cols,
                     timestamps=None, clear=False) -> int:

@@ -161,6 +161,14 @@ class ClusterNode:
         self._hb_thread: threading.Thread | None = None
         self.warm_stats: dict | None = None  # set by open(warm=True)
         self.executor = ClusterExecutor(self)
+        # federated observability (ISSUE 10): coordinator-side views
+        # that fan out to live peers with per-node timeouts and merge.
+        # add_route defaults to admin_only, and /debug/* paths gate on
+        # admin in _check_auth anyway — same contract as local /debug.
+        self.server.add_route("GET", "/debug/cluster/queries",
+                              self._debug_cluster_queries)
+        self.server.add_route("GET", "/debug/cluster/metrics",
+                              self._debug_cluster_metrics)
 
     # -- lifecycle -----------------------------------------------------
 
@@ -429,6 +437,115 @@ class ClusterNode:
                 repaired += 1
         return repaired
 
+    # -- federated observability (ISSUE 10) ----------------------------
+
+    def _federate(self, path: str, timeout_s: float):
+        """GET ``path`` from every live PEER with a per-node deadline
+        (PR 6 plumbing); returns ({node_id: payload}, [unreachable]).
+        A slow or dead peer costs its timeout, never the request —
+        the caller flags the response partial instead."""
+        snap = self.snapshot()
+        peers = [n for n in snap.nodes
+                 if n.state == NodeState.STARTED
+                 and n.id != self.node_id]
+        if not peers:
+            return {}, []
+        client = self._client()
+        from pilosa_tpu.taskpool import Pool, TaskFailure
+
+        def one(pool, n):
+            with pool.blocked():  # RPC wait: let the pool grow
+                return client.get_json(n.uri, path,
+                                       deadline=Deadline(timeout_s))
+
+        outs = Pool(size=4).map_settled(one, peers)
+        got, unreachable = {}, []
+        for n, out in zip(peers, outs):
+            if isinstance(out, TaskFailure):
+                unreachable.append(n.id)
+            else:
+                got[n.id] = out
+        return got, sorted(unreachable)
+
+    def _debug_cluster_queries(self, req):
+        """Cluster-wide flight view: fan out /debug/queries to live
+        nodes, merge records keyed by trace id — one entry shows the
+        coordinator's fan-out record (with per-node ``attempts``)
+        next to every node's leg records under the same id.  Query
+        params: ``limit``/``n``, ``timeout_ms`` (per-node),
+        ``trace_id`` (single-trace filter)."""
+        q = req.query
+        limit = int(q.get("limit", q.get("n", ["100"]))[0])
+        timeout_s = float(q.get("timeout_ms", ["1000"])[0]) / 1e3
+        want_tid = q.get("trace_id", [None])[0]
+        # a single-trace lookup must search each node's WHOLE ring —
+        # truncating to the newest `limit` first would hide any trace
+        # older than the last N queries
+        fetch = 1 << 17 if want_tid else limit
+        per_node = {self.node_id: flight.recorder.recent(fetch)}
+        got, unreachable = self._federate(
+            f"/debug/queries?limit={fetch}", timeout_s)
+        for nid, payload in got.items():
+            per_node[nid] = (payload or {}).get("queries", [])
+        merged: dict[str, dict] = {}
+        for nid in sorted(per_node):
+            for rec in per_node[nid]:
+                tid = rec.get("trace_id")
+                if tid is None or (want_tid and tid != want_tid):
+                    continue
+                ent = merged.get(tid)
+                if ent is None:
+                    ent = merged[tid] = {"trace_id": tid, "nodes": {},
+                                         "start": rec.get("start", 0)}
+                ent["nodes"].setdefault(nid, []).append(rec)
+                ent["start"] = min(ent["start"],
+                                   rec.get("start", ent["start"]))
+                if rec.get("route") == "cluster" and \
+                        "coordinator" not in ent:
+                    # the fan-out record IS the merged entry's spine:
+                    # per-node attempts (hedges included) live here.
+                    # First sighting wins — an in-process test cluster
+                    # shares one ring, so every node reports it
+                    ent["coordinator"] = nid
+                    if rec.get("attempts"):
+                        ent["attempts"] = rec["attempts"]
+        entries = sorted(merged.values(),
+                         key=lambda e: -e.get("start", 0))[:limit]
+        return {"queries": entries,
+                "nodes": sorted(per_node),
+                "unreachable": unreachable,
+                "partial": bool(unreachable)}
+
+    def _debug_cluster_metrics(self, req):
+        """Cluster-wide metrics: fan out /metrics.json to live nodes
+        and sum series point-wise (counters/gauges add; histograms
+        add count+sum) under ``aggregate``, with each node's raw
+        payload under ``per_node``.  ``timeout_ms`` bounds each
+        node's fetch; unreachable nodes flag the response partial."""
+        timeout_s = float(
+            req.query.get("timeout_ms", ["1000"])[0]) / 1e3
+        flight.flush_metrics()  # local scrape sees current samples
+        per_node = {self.node_id: metrics.registry.render_json()}
+        got, unreachable = self._federate("/metrics.json", timeout_s)
+        per_node.update(got)
+        agg: dict = {}
+        for doc in per_node.values():
+            for name, series in (doc or {}).items():
+                dst = agg.setdefault(name, {})
+                for labels, val in series.items():
+                    if isinstance(val, dict):  # histogram {count,sum}
+                        cur = dst.setdefault(
+                            labels, {"count": 0, "sum": 0.0})
+                        cur["count"] += val.get("count", 0)
+                        cur["sum"] += val.get("sum", 0.0)
+                    else:
+                        dst[labels] = dst.get(labels, 0.0) + val
+        return {"aggregate": agg,
+                "nodes": sorted(per_node),
+                "per_node": per_node,
+                "unreachable": unreachable,
+                "partial": bool(unreachable)}
+
     # -- writes (replicated) -------------------------------------------
 
     def _import_replicated(self, index: str, shard: int, owners,
@@ -554,6 +671,21 @@ class ClusterNode:
                                      partial_ok=partial_ok)
 
 
+class _TraceProp:
+    """Per-query trace propagation bundle for the fan-out: the flight
+    trace id + parent span name ride every node RPC as headers, and
+    ``ctx`` (the caller's TraceContext, when tracing) receives the
+    remote span trees so Profile=true cluster queries show per-node
+    work in their own tree."""
+
+    __slots__ = ("trace_id", "parent", "ctx")
+
+    def __init__(self, trace_id, parent, ctx):
+        self.trace_id = trace_id
+        self.parent = parent
+        self.ctx = ctx
+
+
 class ClusterExecutor:
     """Shard fan-out over nodes + reduce over wire-format results.
 
@@ -602,6 +734,60 @@ class ClusterExecutor:
                                  "0") or 0)
         return Deadline(v) if v > 0 else None
 
+    @staticmethod
+    def _trace_prop(fl) -> _TraceProp | None:
+        """Build the fan-out's trace-propagation bundle: the flight
+        trace id (this fan-out's record, or an enclosing one), plus
+        the caller's open tracing context when Profile=true."""
+        from pilosa_tpu.obs import tracing as _tr
+        tid = (fl["trace_id"] if fl is not None
+               else flight.current_trace_id())
+        ctx = _tr.capture_context()
+        if tid is None and ctx is None:
+            return None
+        parent = (ctx.parent.name
+                  if ctx is not None and ctx.parent is not None
+                  else None)
+        return _TraceProp(tid, parent, ctx)
+
+    def _local_leg(self, index, pql, shards, tprop):
+        """The coordinator's own shard group, executed like a remote
+        leg observability-wise: the query inherits the fan-out's
+        trace id (its flight record merges by id), and its span tree
+        is captured and stored as this node's lane — symmetric with
+        what remote nodes return in their response trailer."""
+        if tprop is None or tprop.trace_id is None:
+            return self.node.api.query(index, pql, shards=shards)
+        with flight.remote_leg(tprop.trace_id) as (tracer, spans):
+            out = self.node.api.query(index, pql, shards=shards)
+        if spans:
+            # anchor on the live root's absolute start (wire spans
+            # carry only relative offsets)
+            flight.note_node_spans(self.node.node_id, spans,
+                                   tracer.roots[0].start)
+        return out
+
+    def _graft_remote_trace(self, out, node_id, tprop, t0):
+        """Pop a remote response's "trace" trailer and graft it: into
+        the flight record's node lanes always, and into the caller's
+        span tree when one is open (Profile=true).  Anchored at the
+        attempt's departure on the caller clock — the honest
+        alignment without cross-host clock sync."""
+        if not isinstance(out, dict):
+            return
+        tr = out.pop("trace", None)
+        if not tr or tprop is None:
+            return
+        spans = tr.get("spans") or ()
+        if not spans:
+            return
+        node = str(tr.get("node") or node_id)
+        flight.note_node_spans(node, list(spans), t0)
+        if tprop.ctx is not None:
+            from pilosa_tpu.obs import tracing as _tr
+            for w in spans:
+                tprop.ctx.attach(_tr.span_from_wire(w, t0))
+
     def execute(self, index: str, pql: str,
                 deadline_s: float | None = None,
                 partial_ok: bool = False) -> dict:
@@ -632,13 +818,14 @@ class ClusterExecutor:
         # under a serving-layer record): per-node attempt timings land
         # in the record's `attempts` field for /debug/queries
         fl = flight.begin(index, pql)
+        tprop = self._trace_prop(fl)
         t0 = time.perf_counter()
         err = None
         try:
             missing: set[int] = set()
             partials = self._fan_out(snap, index, pql, shards,
                                      deadline=deadline, partial=partial,
-                                     missing=missing)
+                                     missing=missing, tprop=tprop)
             # reduce call-by-call across nodes (streaming reduceFn);
             # partial mode with EVERY shard missing reduces to the
             # call's zero value, never a meaningless None
@@ -782,7 +969,8 @@ class ClusterExecutor:
     def _fan_out(self, snap, index, pql, shards, attempts: int = 3,
                  deadline=None, partial: bool = False,
                  missing: set | None = None,
-                 avoid: set | None = None) -> list[list]:
+                 avoid: set | None = None,
+                 tprop: _TraceProp | None = None) -> list[list]:
         """Group shards by owner and execute; when a node fails,
         re-plan ONLY its shards against the remaining live replicas —
         per-shard failover, never running a shard on a node that
@@ -818,8 +1006,8 @@ class ClusterExecutor:
             try:
                 if node_id == self.node.node_id:
                     t0 = time.perf_counter()
-                    out = self.node.api.query(index, pql,
-                                              shards=node_shards)
+                    out = self._local_leg(index, pql, node_shards,
+                                          tprop)
                     flight.note_attempt(node_id,
                                         time.perf_counter() - t0,
                                         "ok-local")
@@ -827,7 +1015,7 @@ class ClusterExecutor:
                 with pool.blocked():  # RPC wait: let the pool grow
                     return self._remote(snap, index, pql, node_id,
                                         node_shards, hedge_s,
-                                        deadline, avoid)
+                                        deadline, avoid, tprop)
             finally:
                 flight.pop_acc(prev)
 
@@ -904,13 +1092,14 @@ class ClusterExecutor:
                     self._fan_out(snap2, index, pql, failed_shards,
                                   attempts - 1, deadline=deadline,
                                   partial=partial, missing=missing,
-                                  avoid=avoid))
+                                  avoid=avoid, tprop=tprop))
         return partials
 
     # -- hedged remote group RPC ---------------------------------------
 
     def _remote(self, snap, index, pql, node_id, node_shards,
-                hedge_s, deadline, avoid=frozenset()) -> list[list]:
+                hedge_s, deadline, avoid=frozenset(),
+                tprop: _TraceProp | None = None) -> list[list]:
         """One node-group RPC, hedged: if the primary attempt outlasts
         ``hedge_s``, fire the same shards at their next live replicas
         and take whichever side answers first (the loser's response is
@@ -927,17 +1116,32 @@ class ClusterExecutor:
         # hedge race, deferring the mark past the query's return)
         client.retries = 0
 
-        def attempt(n, shards_):
+        def attempt(n, shards_, note_gate=None):
+            # note_gate: ONE attempt row per hedged primary RPC — the
+            # non-blocking acquire is the atomic first-writer-wins
+            # between the primary's own completion note and the
+            # hedge-win path's "outstanding" note (either alone could
+            # otherwise race the other into a duplicate row)
             t0 = time.perf_counter()
+
+            def note(outcome):
+                if note_gate is None or \
+                        note_gate.acquire(blocking=False):
+                    flight.note_attempt(
+                        n.id, time.perf_counter() - t0, outcome)
+
             try:
-                out = client.query_node(n.uri, index, pql, shards_,
-                                        deadline=deadline)
-                flight.note_attempt(n.id, time.perf_counter() - t0,
-                                    "ok")
+                out = client.query_node(
+                    n.uri, index, pql, shards_, deadline=deadline,
+                    trace_id=(tprop.trace_id if tprop is not None
+                              else None),
+                    span_parent=(tprop.parent if tprop is not None
+                                 else None))
+                self._graft_remote_trace(out, n.id, tprop, t0)
+                note("ok")
                 return out
             except Exception:
-                flight.note_attempt(n.id, time.perf_counter() - t0,
-                                    "error")
+                note("error")
                 raise
 
         plain = hedge_s is None
@@ -971,6 +1175,7 @@ class ClusterExecutor:
         res: dict[str, tuple] = {}
         hedge_won = threading.Event()
         marked_down = threading.Lock()
+        primary_note = threading.Lock()  # one attempt row, see attempt()
 
         def put(tag, val, err):
             with cv:
@@ -990,7 +1195,9 @@ class ClusterExecutor:
         def run_primary():
             prev = flight.push_acc(acc)
             try:
-                put("p", [attempt(node, node_shards)["results"]], None)
+                put("p", [attempt(node, node_shards,
+                                  note_gate=primary_note)["results"]],
+                    None)
             except Exception as e:
                 put("p", None, e)
                 if hedge_won.is_set() and isinstance(e,
@@ -1010,8 +1217,8 @@ class ClusterExecutor:
                 for aid, ashards in sorted(alts.items()):
                     if aid == self.node.node_id:
                         t0 = time.perf_counter()
-                        outs.append(self.node.api.query(
-                            index, pql, shards=ashards)["results"])
+                        outs.append(self._local_leg(
+                            index, pql, ashards, tprop)["results"])
                         flight.note_attempt(
                             aid, time.perf_counter() - t0,
                             "hedge_ok-local")
@@ -1025,6 +1232,7 @@ class ClusterExecutor:
             finally:
                 flight.pop_acc(prev)
 
+        t_p0 = time.perf_counter()
         threading.Thread(target=run_primary, daemon=True).start()
         with cv:
             cv.wait_for(lambda: "p" in res, timeout=hedge_s)
@@ -1060,6 +1268,18 @@ class ClusterExecutor:
         if winner == "h":
             metrics.CLUSTER_EVENTS.inc(event="hedge_won")
             hedge_won.set()
+            if "p" not in res and \
+                    primary_note.acquire(blocking=False):
+                # the primary is STILL in flight as the hedge answers
+                # the caller — its own attempt note would land after
+                # the record commits and be lost.  Note it now as
+                # "outstanding" so /debug/trace shows the slow
+                # primary racing the hedge in parallel (the picture
+                # hedging exists to produce); the gate keeps this and
+                # the primary's own eventual note to ONE row
+                flight.note_attempt(
+                    node.id, time.perf_counter() - t_p0,
+                    "outstanding")
             if "p" in res and isinstance(res["p"][1], ConnectionError):
                 # the primary DEFINITIVELY failed (not just slow):
                 # mark it DOWN so the next snapshot routes around it

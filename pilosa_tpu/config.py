@@ -111,6 +111,25 @@ class Config:
     # ring bounds how many records are kept.
     flight_recorder: bool = True
     flight_ring: int = 512
+    # roofline attribution (obs/roofline.py): join bytes-touched with
+    # device execute time per op family into achieved-GB/s and
+    # fraction-of-peak gauges.  peak-gbps 0 = measure a STREAM-style
+    # probe at startup (PILOSA_TPU_PEAK_GBPS also overrides);
+    # attribution=false drops the per-dispatch note entirely (the
+    # overhead-smoke A/B switch, also PILOSA_TPU_ROOFLINE=0).
+    roofline_attribution: bool = True
+    roofline_peak_gbps: float = 0.0
+    # SLO burn-rate plane (obs/slo.py): latency-ms + latency-objective
+    # define the latency SLO ("latency-objective of queries answer
+    # under latency-ms"); availability-objective bounds the typed-
+    # error fraction (503 sheds, 504 deadlines, partial results).
+    # windows is the multi-window burn-rate set ("5m,1h,6h" or bare
+    # seconds), evaluated at /debug/slo and exported as
+    # pilosa_slo_burn_rate{slo,window}.
+    slo_latency_ms: float = 250.0
+    slo_latency_objective: float = 0.99
+    slo_availability_objective: float = 0.999
+    slo_windows: str = "5m,1h,6h"
 
     def apply_kernel_setting(self):
         """Translate tpu_kernels into the Pallas dispatch env flag.
@@ -165,6 +184,32 @@ class Config:
             if val != default or env not in os.environ:
                 os.environ[env] = str(val)
 
+    def apply_roofline_settings(self):
+        """Configure roofline attribution ([roofline]) and kick the
+        peak-bandwidth probe on a background thread (startup must not
+        block ~50 ms on a STREAM probe).  A default-True config must
+        not override an operator's PILOSA_TPU_ROOFLINE env
+        kill-switch — leave the module resolving from env in that
+        case (same contract as the hedge/deadline knobs in
+        apply_fault_settings)."""
+        from pilosa_tpu.obs import roofline
+        enabled = self.roofline_attribution
+        if enabled and "PILOSA_TPU_ROOFLINE" in os.environ:
+            enabled = None  # env kill-switch stays in charge
+        roofline.configure(enabled=enabled,
+                           peak_gbps=self.roofline_peak_gbps or None)
+        if roofline.enabled():
+            roofline.ensure_peak(block=False)
+
+    def apply_slo_settings(self):
+        """Build the process SLO tracker from the [slo] knobs."""
+        from pilosa_tpu.obs import slo
+        slo.configure(
+            latency_ms=self.slo_latency_ms,
+            latency_objective=self.slo_latency_objective,
+            availability_objective=self.slo_availability_objective,
+            windows=self.slo_windows)
+
     def apply_memory_settings(self):
         """Push the [memory] knobs into the process residency manager
         (pilosa_tpu/memory: budget ledger, paged stacks, OOM
@@ -205,6 +250,12 @@ _TOML_KEYS = {
     "stacked.patch-max-frac": "stack_patch_max_frac",
     "flight.recorder": "flight_recorder",
     "flight.ring": "flight_ring",
+    "roofline.attribution": "roofline_attribution",
+    "roofline.peak-gbps": "roofline_peak_gbps",
+    "slo.latency-ms": "slo_latency_ms",
+    "slo.latency-objective": "slo_latency_objective",
+    "slo.availability-objective": "slo_availability_objective",
+    "slo.windows": "slo_windows",
     "ingest.stream": "ingest_stream",
     "ingest.window-ms": "ingest_window_ms",
     "ingest.max-batch": "ingest_max_batch",

@@ -56,7 +56,7 @@ from pilosa_tpu import memory
 from pilosa_tpu.memory import pressure
 from pilosa_tpu.memory.pages import PagedStack, StackRecipe, page_lanes_for
 from pilosa_tpu.models.view import VIEW_STANDARD
-from pilosa_tpu.obs import flight, metrics
+from pilosa_tpu.obs import flight, metrics, roofline
 from pilosa_tpu.obs.tracing import start_span
 from pilosa_tpu.ops import bitmap as bm
 from pilosa_tpu.ops import bsi as bsi_ops
@@ -1430,6 +1430,13 @@ def _block(out):
         return out
 
 
+# plan kind -> roofline op family (obs/roofline.py): the per-op
+# labels behind pilosa_device_bandwidth_{gbps,fraction}{op}
+_ROOF_OPS = {"count": "count", "words": "row", "row_counts": "topn",
+             "bsi_sum": "sum", "groupby": "groupby", "multi": "multi",
+             "ragged": "ragged", "row_counts_flat": "topn"}
+
+
 def timed_dispatch(plan, kern, leaves, params):
     """Run a plan's jitted program with flight/span attribution:
     recompiles are timed distinctly from cached dispatches, and the
@@ -1441,6 +1448,7 @@ def timed_dispatch(plan, kern, leaves, params):
     sig = (repr(plan), kern)
     fn = _compiled(plan, kern=kern, sig=sig)
     kind = _dispatch_kind(sig, leaves, params)
+    oom0 = metrics.OOM_TOTAL.total(outcome="caught")
     t0 = time.perf_counter()
     with start_span("stacked.dispatch", kind=plan[0],
                     compile=kind == "compile"):
@@ -1448,7 +1456,19 @@ def timed_dispatch(plan, kern, leaves, params):
             lambda: _block(fn(tuple(leaves), tuple(params))),
             host_fallback=lambda: pressure.run_host_plan(
                 plan, leaves, params))
-    flight.note_phase(kind, time.perf_counter() - t0)
+    dt = time.perf_counter() - t0
+    flight.note_phase(kind, dt)
+    if kind == "execute" and \
+            metrics.OOM_TOTAL.total(outcome="caught") == oom0:
+        # roofline attribution: operand bytes touched / device time,
+        # per op family.  Cached-executable CLEAN dispatches only —
+        # a compile dispatch's wall time is trace+XLA, and a dispatch
+        # that tripped the OOM ladder (eviction sweep + retry or the
+        # degraded host re-execution) measures recovery, not memory
+        # traffic; either would poison the achieved-bandwidth gauge.
+        roofline.note(_ROOF_OPS.get(plan[0], plan[0]),
+                      sum(getattr(a, "nbytes", 0) for a in leaves),
+                      dt)
     return out
 
 
@@ -2429,6 +2449,12 @@ class StackedEngine:
         multi = self._n_total_devices() > 1
         host = self.host_only or (not multi
                                   and jax.default_backend() != "tpu")
+        # roofline attribution: the one-pass histogram dispatches its
+        # own jitted/native programs (not timed_dispatch), so the
+        # bytes-touched x device-time join notes here per arm —
+        # operand = group-code stack + BSI planes + filter words.
+        # _dispatch_kind keeps first-dispatch compiles out of the
+        # bandwidth gauge, exactly like timed_dispatch.
         if host:
             counts, nn, pos, neg = self._groupby_onepass_host(
                 idx, fields_rows, agg_field, skey, n_codes, depth,
@@ -2452,7 +2478,17 @@ class StackedEngine:
                 args.append(f_np)
             if has_planes:
                 args.append(planes)
-            out = fn(*args)
+            sig = ("onepass_mesh", has_planes, filt is not None,
+                   signed, n_codes)
+            kind = _dispatch_kind(sig, args, ())
+            t0 = time.perf_counter()
+            out = _block(fn(*args))
+            dt = time.perf_counter() - t0
+            flight.note_phase(kind, dt)
+            if kind == "execute":
+                roofline.note(
+                    "groupby",
+                    sum(getattr(a, "nbytes", 0) for a in args), dt)
             counts, nn, pos, neg = _onepass_unpack(
                 out, n_codes, depth, has_planes)
         else:
@@ -2462,7 +2498,18 @@ class StackedEngine:
             fn = _groupby_onepass_jit(
                 _onepass_use_kernel(n_codes, depth), has_planes,
                 filt is not None, signed, n_codes)
-            out = fn(cg, filt, planes)
+            sig = ("onepass", has_planes, filt is not None, signed,
+                   n_codes)
+            args = [a for a in (cg, filt, planes) if a is not None]
+            kind = _dispatch_kind(sig, args, ())
+            t0 = time.perf_counter()
+            out = _block(fn(cg, filt, planes))
+            dt = time.perf_counter() - t0
+            flight.note_phase(kind, dt)
+            if kind == "execute":
+                roofline.note(
+                    "groupby",
+                    sum(getattr(a, "nbytes", 0) for a in args), dt)
             counts, nn, pos, neg = _onepass_unpack(
                 out, n_codes, depth, has_planes)
         sel_counts = counts[codes]
@@ -2487,6 +2534,12 @@ class StackedEngine:
                   if agg_field is not None else None)
         filt_np = (np.asarray(filt)[:len(skey)]
                    if filt is not None else None)
+        # roofline: the native hist streams these operands once; no
+        # compile arm to exclude — the C kernel always "executes"
+        op_bytes = (cg.nbytes
+                    + (planes.nbytes if planes is not None else 0)
+                    + (filt_np.nbytes if filt_np is not None else 0))
+        t0 = time.perf_counter()
 
         def one(_pool, si):
             c = np.zeros(n_codes, np.int64)
@@ -2504,6 +2557,9 @@ class StackedEngine:
 
         size = max(1, min(8, os.cpu_count() or 1, cg.shape[0]))
         parts = Pool(size=size).map(one, range(cg.shape[0]))
+        dt = time.perf_counter() - t0
+        flight.note_phase("execute", dt)
+        roofline.note("groupby", op_bytes, dt)
         counts = sum(p[0] for p in parts)
         if agg_field is None:
             return counts, None, None, None

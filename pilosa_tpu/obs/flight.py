@@ -33,6 +33,7 @@ import os
 import threading
 import time
 from collections import deque
+from contextlib import contextmanager
 
 # phases the leader stamps per fused request; also the BENCH JSON
 # breakdown axes (compile/upload/execute/wait)
@@ -49,7 +50,8 @@ class Acc:
     (the owning request thread, or the leader while it serves the
     request)."""
 
-    __slots__ = ("phases", "stack", "bytes_moved", "keys", "attempts")
+    __slots__ = ("phases", "stack", "bytes_moved", "keys", "attempts",
+                 "t0", "node_spans", "ops")
 
     # per-record stack-key cap: a pathological query touching hundreds
     # of stacks must not bloat the ring
@@ -58,6 +60,10 @@ class Acc:
     # RPC attempt incl. hedges — a 100-node fan-out must not bloat
     # the ring either)
     _MAX_ATTEMPTS = 32
+    # per-record cap on per-node span-tree payloads (cluster trace
+    # propagation, ISSUE 10): legs past the cap keep their timings in
+    # `attempts` but drop the span detail
+    _MAX_NODE_SPANS = 16
 
     def __init__(self):
         self.phases: dict[str, float] = {}
@@ -68,9 +74,21 @@ class Acc:
         # that keep rebuilding are keys worth warming
         self.keys: list[tuple[str, str]] = []
         # per-node RPC attempt timings from the cluster fan-out
-        # (node, ms, outcome) incl. hedge attempts — what makes hedge
-        # delays debuggable at /debug/queries
-        self.attempts: list[tuple[str, float, str]] = []
+        # (node, ms, outcome, start-offset ms) incl. hedge attempts —
+        # what makes hedge delays debuggable at /debug/queries, and
+        # what renders hedges as parallel spans in /debug/trace
+        self.attempts: list[tuple[str, float, str, float]] = []
+        # this record's perf_counter origin: attempt/node-span offsets
+        # are relative to it so /debug/trace can lay legs out in time
+        self.t0 = time.perf_counter()
+        # per-node serialized span trees returned in RPC trailers
+        # (obs.tracing.span_to_wire): [{"node", "anchor_off_us",
+        # "spans"}] — the coordinator's one-timeline-with-node-lanes
+        # Perfetto view
+        self.node_spans: list[dict] = []
+        # op-family roofline shares: op -> [bytes touched, execute s]
+        # (obs/roofline.py note() feeds this per device dispatch)
+        self.ops: dict[str, list] = {}
 
     def add_phase(self, name: str, dt: float):
         self.phases[name] = self.phases.get(name, 0.0) + dt
@@ -85,7 +103,26 @@ class Acc:
 
     def add_attempt(self, node: str, dt: float, outcome: str):
         if len(self.attempts) < self._MAX_ATTEMPTS:
-            self.attempts.append((node, round(dt * 1e3, 3), outcome))
+            off = max(time.perf_counter() - self.t0 - dt, 0.0)
+            self.attempts.append((node, round(dt * 1e3, 3), outcome,
+                                  round(off * 1e3, 3)))
+
+    def add_node_spans(self, node: str, spans: list,
+                       anchor_perf: float):
+        if spans and len(self.node_spans) < self._MAX_NODE_SPANS:
+            self.node_spans.append({
+                "node": node,
+                "anchor_off_us": max(
+                    int((anchor_perf - self.t0) * 1e6), 0),
+                "spans": spans,
+            })
+
+    def add_op(self, op: str, nbytes: int, dt: float):
+        st = self.ops.get(op)
+        if st is None:
+            st = self.ops[op] = [0, 0.0]
+        st[0] += int(nbytes)
+        st[1] += dt
 
     def merge(self, other: "Acc"):
         for k, v in other.phases.items():
@@ -99,6 +136,16 @@ class Acc:
         room = self._MAX_ATTEMPTS - len(self.attempts)
         if room > 0 and other.attempts:
             self.attempts.extend(other.attempts[:room])
+        room = self._MAX_NODE_SPANS - len(self.node_spans)
+        if room > 0 and other.node_spans:
+            self.node_spans.extend(other.node_spans[:room])
+        for op, (b, s) in other.ops.items():
+            st = self.ops.get(op)
+            if st is None:
+                self.ops[op] = [b, s]
+            else:
+                st[0] += b
+                st[1] += s
 
 
 def push_acc(acc: Acc):
@@ -136,6 +183,72 @@ def note_attempt(node: str, dt: float, outcome: str):
     acc = getattr(_tls, "acc", None)
     if acc is not None:
         acc.add_attempt(node, dt, outcome)
+
+
+def note_node_spans(node: str, spans: list, anchor_perf: float):
+    """Record a remote (or local-leg) serialized span tree returned
+    in an RPC trailer, anchored at the caller-clock instant the
+    attempt left (cluster/coordinator.py)."""
+    acc = getattr(_tls, "acc", None)
+    if acc is not None:
+        acc.add_node_spans(node, spans, anchor_perf)
+
+
+def note_op(op: str, nbytes: int, dt: float):
+    """Record one device dispatch's roofline share (bytes touched +
+    execute seconds) by op family (obs/roofline.py calls this)."""
+    acc = getattr(_tls, "acc", None)
+    if acc is not None:
+        acc.add_op(op, nbytes, dt)
+
+
+def inherit_trace(trace_id: str | None):
+    """Adopt a REMOTE caller's trace id for the next record this
+    thread opens (RPC trace propagation: the X-Pilosa-Trace-Id header
+    / gRPC trace-id metadata land here, so a remote leg's flight
+    record joins the coordinator's under one cluster-wide id).
+    Returns the previous value to restore via pop_inherit."""
+    prev = getattr(_tls, "inherit", None)
+    _tls.inherit = trace_id
+    return prev
+
+
+def pop_inherit(prev):
+    _tls.inherit = prev
+
+
+def current_trace_id() -> str | None:
+    """The trace id of this thread's active (or inherited) flight
+    record, or None — the log-correlation stamp (obs/logger.py)."""
+    rec = getattr(_tls, "rec", None)
+    if rec is not None:
+        return rec["trace_id"]
+    return getattr(_tls, "inherit", None)
+
+
+@contextmanager
+def remote_leg(trace_id: str, keep: int = 8):
+    """The remote-leg scaffold every trace-propagating RPC surface
+    shares (server/http.py, cluster/coordinator.py's local leg, the
+    overhead probe): inherit the caller's trace id so this thread's
+    flight record joins it, record the leg's spans on a thread-local
+    tracer, and on exit serialize the roots to wire form.  Yields
+    ``(tracer, spans)`` — ``spans`` fills AFTER the body exits (wire
+    dicts for the response trailer); ``tracer.roots`` keeps the live
+    Span objects for callers that need absolute anchors.  One
+    implementation so a fix to the pop-ordering or wire shape cannot
+    drift between surfaces."""
+    from pilosa_tpu.obs import tracing as _tr
+    spans: list[dict] = []
+    prev_inh = inherit_trace(trace_id)
+    tracer = _tr.RecordingTracer(keep=keep)
+    prev = _tr.push_thread_tracer(tracer)
+    try:
+        yield tracer, spans
+    finally:
+        _tr.pop_thread_tracer(prev)
+        pop_inherit(prev_inh)
+        spans.extend(_tr.span_to_wire(s) for s in tracer.roots)
 
 
 class FlightRecorder:
@@ -192,8 +305,25 @@ class FlightRecorder:
         format (loadable in Perfetto / chrome://tracing): one complete
         ("ph": "X") event per query plus one per phase, on a per-query
         virtual thread so concurrent queries render as parallel
-        tracks."""
+        tracks.  Cluster fan-out records additionally render one
+        PROCESS LANE per node (``pid`` + a process_name metadata
+        event): per-node RPC attempts — hedges as parallel spans —
+        and the span trees each node returned in its response
+        trailer, all under the query's one trace id."""
         events = []
+        # pid 1 is the serving process itself; cluster legs get one
+        # pid per node so Perfetto renders per-node lanes
+        node_pids: dict[str, int] = {}
+
+        def pid_for(node: str) -> int:
+            p = node_pids.get(node)
+            if p is None:
+                p = node_pids[node] = len(node_pids) + 2
+                events.append({"name": "process_name", "ph": "M",
+                               "pid": p,
+                               "args": {"name": f"node:{node}"}})
+            return p
+
         for rec in self.recent(n):
             ts = rec["start"] * 1e6          # epoch microseconds
             dur = rec["duration_ms"] * 1e3
@@ -226,6 +356,40 @@ class FlightRecorder:
                     "args": {"ms": round(pdur, 4)},
                 })
                 off += pdur * 1e3
+            # cluster fan-out: per-node attempt slices (true start
+            # offsets — a hedge renders in parallel with the primary
+            # attempt it raced) ...
+            for a in rec.get("attempts", ()):
+                events.append({
+                    "name": f"attempt:{a.get('outcome', '?')}",
+                    "cat": "attempt", "ph": "X",
+                    "pid": pid_for(str(a.get("node", "?"))),
+                    "tid": tid,
+                    "ts": ts + a.get("t_off_ms", 0.0) * 1e3,
+                    "dur": max(a.get("ms", 0.0) * 1e3, 0.5),
+                    "args": {"trace_id": tid,
+                             "node": a.get("node"),
+                             "outcome": a.get("outcome")},
+                })
+            # ... and the span trees each leg returned in its
+            # response trailer, re-anchored on the coordinator clock
+            for ent in rec.get("node_spans", ()):
+                pid = pid_for(str(ent.get("node", "?")))
+                base = ts + ent.get("anchor_off_us", 0)
+                stack = list(ent.get("spans", ()))
+                while stack:
+                    w = stack.pop()
+                    ev = {"name": str(w.get("name", "span")),
+                          "cat": "node", "ph": "X", "pid": pid,
+                          "tid": tid,
+                          "ts": base + w.get("off_us", 0),
+                          "dur": max(w.get("dur_us", 0), 0.5),
+                          "args": {"trace_id": tid,
+                                   "node": ent.get("node")}}
+                    if w.get("tags"):
+                        ev["args"]["tags"] = w["tags"]
+                    events.append(ev)
+                    stack.extend(w.get("children", ()))
         return {"traceEvents": events, "displayTimeUnit": "ms",
                 "otherData": {"source": "pilosa-tpu flight recorder"}}
 
@@ -245,13 +409,18 @@ def begin(index: str, query) -> dict | None:
     Executor.execute — must not double-record)."""
     if not recorder.enabled or getattr(_tls, "rec", None) is not None:
         return None
+    inherited = getattr(_tls, "inherit", None)
     rec = {
-        "trace_id": recorder.next_id(),
+        "trace_id": inherited or recorder.next_id(),
         "index": index,
         "query": str(query)[:200],
         "start": time.time(),
         "acc": Acc(),
     }
+    if inherited:
+        # a remote leg of a cluster fan-out: same id as the
+        # coordinator's record so /debug/cluster/queries merges them
+        rec["inherited"] = True
     _tls.rec = rec
     rec["prev_acc"] = push_acc(rec["acc"])
     return rec
@@ -293,10 +462,32 @@ def commit(rec: dict | None, duration_s: float, route: str = "solo",
     })
     if acc.attempts:
         # per-node cluster attempt timings (hedges included) — only
-        # fan-out queries carry the field, so solo records stay small
+        # fan-out queries carry the field, so solo records stay small.
+        # t_off_ms = start offset inside the query, so /debug/trace
+        # renders hedges as genuinely PARALLEL spans
         rec["attempts"] = [
-            {"node": n, "ms": ms, "outcome": o}
-            for n, ms, o in acc.attempts]
+            {"node": n, "ms": ms, "outcome": o, "t_off_ms": off}
+            for n, ms, o, off in acc.attempts]
+    if acc.node_spans:
+        # per-node span trees from RPC trailers (+ the local leg) —
+        # the /debug/trace node lanes
+        rec["node_spans"] = list(acc.node_spans)
+    if acc.ops:
+        # roofline share: bytes touched / execute time per op family,
+        # with achieved GB/s (+ fraction once the peak probe landed)
+        from pilosa_tpu.obs import roofline
+        peak = roofline.peak_or_none()
+        rl = {}
+        for op, (b, s) in acc.ops.items():
+            if s <= 0:
+                continue
+            ent = {"bytes": b, "ms": round(s * 1e3, 4),
+                   "gbps": round(b / s / 1e9, 4)}
+            if peak:
+                ent["fraction"] = round((b / s) / peak, 5)
+            rl[op] = ent
+        if rl:
+            rec["roofline"] = rl
     if error is not None:
         rec["error"] = error[:200]
     if fingerprint is not None:

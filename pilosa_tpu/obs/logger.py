@@ -13,6 +13,16 @@ DEBUG, INFO, WARN, ERROR = 10, 20, 30, 40
 _LEVEL_NAMES = {DEBUG: "DEBUG", INFO: "INFO", WARN: "WARN", ERROR: "ERROR"}
 
 
+def _active_trace_id() -> str | None:
+    """This thread's flight trace id (function-level import: logger
+    must stay importable before/without the obs.flight module)."""
+    try:
+        from pilosa_tpu.obs import flight
+        return flight.current_trace_id()
+    except Exception:
+        return None
+
+
 class Logger:
     """Leveled, %-formatted logger writing one line per call."""
 
@@ -31,6 +41,13 @@ class Logger:
         prefix = f"{ts} {_LEVEL_NAMES[level]:5s}"
         if self.name:
             prefix += f" [{self.name}]"
+        # log/trace correlation (ISSUE 10): a line emitted while a
+        # flight record (or an inherited RPC trace id) is active on
+        # this thread carries that id, so logs grep straight to the
+        # matching /debug/queries record and Perfetto lane
+        trace = _active_trace_id()
+        if trace:
+            prefix += f" trace={trace}"
         with self._lock:
             self.stream.write(f"{prefix} {msg}\n")
 

@@ -60,6 +60,15 @@ class Counter(_Metric):
     def value(self, **labels) -> float:
         return self._vals.get(_label_key(labels), 0.0)
 
+    def total(self, **labels) -> float:
+        """Sum across every label set CONTAINING the given labels
+        (all series when none given) — the SLO plane sums typed-error
+        counters across their free labels (class, tenant, ...)."""
+        sub = set(labels.items())
+        with self._lock:
+            return sum(v for k, v in self._vals.items()
+                       if sub <= set(k))
+
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} counter"]
@@ -164,6 +173,31 @@ class Histogram(_Metric):
 
     def count(self, **labels) -> int:
         return self._n.get(_label_key(labels), 0)
+
+    def count_le(self, v: float, **labels) -> float:
+        """Estimated observations <= v (linear interpolation within
+        v's bucket, prometheus histogram_quantile's inverse) — the SLO
+        plane's good-event count at the latency threshold.  A
+        threshold at/past the last finite bound counts only the
+        finite buckets: +Inf-bucket observations are indistinguishable
+        from arbitrarily slow ones and must stay "bad", or a 60s
+        outlier would vanish under a 10s threshold."""
+        k = _label_key(labels)
+        with self._lock:
+            counts = list(self._counts.get(k, ()))
+            n = self._n.get(k, 0)
+        if not counts or n == 0:
+            return 0.0
+        cum, lo = 0.0, 0.0
+        for i, ub in enumerate(self.buckets):
+            c = counts[i]
+            if v < ub:
+                # v inside this bucket: linear share of its count
+                frac = (v - lo) / (ub - lo) if ub > lo else 0.0
+                return cum + c * max(0.0, min(1.0, frac))
+            cum += c
+            lo = ub
+        return cum  # overflow-bucket observations stay > v
 
     def quantile(self, q: float, **labels) -> float:
         """Bucket-interpolated quantile estimate (prometheus
@@ -475,3 +509,29 @@ PHASE_DURATION = registry.histogram(
     "pilosa_query_phase_seconds",
     "Per-query engine phase durations by phase (flight recorder)",
     quantiles=(0.5, 0.95, 0.99))
+
+# -- roofline attribution (obs/roofline.py) --
+# bytes-touched / execute-seconds per op family, against a measured
+# (STREAM-style probe) or configured peak — ROADMAP item 3's "within
+# 4x of the bandwidth bound" as a readable gauge
+DEVICE_BW_GBPS = registry.gauge(
+    "pilosa_device_bandwidth_gbps",
+    "Achieved device memory bandwidth per op family "
+    "(operand bytes / execute-phase seconds, cumulative)")
+DEVICE_BW_FRACTION = registry.gauge(
+    "pilosa_device_bandwidth_fraction",
+    "Fraction of peak device bandwidth achieved per op family")
+DEVICE_PEAK_GBPS = registry.gauge(
+    "pilosa_device_peak_gbps",
+    "Peak device bandwidth (PILOSA_TPU_PEAK_GBPS override or the "
+    "measured STREAM-style startup probe)")
+
+# -- SLO burn-rate plane (obs/slo.py) --
+SLO_BURN_RATE = registry.gauge(
+    "pilosa_slo_burn_rate",
+    "Error-budget burn rate per SLO and window (1.0 = spending the "
+    "budget exactly at the sustainable rate)")
+SLO_BUDGET_REMAINING = registry.gauge(
+    "pilosa_slo_error_budget_remaining",
+    "Error-budget fraction left over the longest configured window "
+    "per SLO")

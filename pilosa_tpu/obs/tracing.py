@@ -233,6 +233,47 @@ class _AttachTracer(Tracer):
 _NOP_TRACER = NopTracer()
 
 
+# ---------------------------------------------------------------------------
+# cross-NODE span serialization (ISSUE 10)
+# ---------------------------------------------------------------------------
+# Span.start is time.perf_counter() — a node-local monotonic clock
+# that means nothing on another host.  A span tree crosses an RPC as
+# OFFSETS relative to its own root's start; the receiving coordinator
+# re-anchors the tree at the moment it observed the attempt leave
+# (caller clock), which is the honest alignment available without
+# cross-host clock sync (skew shows as at most the connect latency).
+
+def span_to_wire(span: Span, base: float | None = None) -> dict:
+    """Serialize a finished span tree for an RPC trailer.  Every
+    ``off_us`` in the tree is relative to the SAME base (the root
+    span's start by default), so the receiver shifts the whole tree
+    with one anchor."""
+    if base is None:
+        base = span.start
+    d = {"name": span.name,
+         "off_us": int((span.start - base) * 1e6),
+         "dur_us": int(span.duration * 1e6)}
+    if span.tags:
+        d["tags"] = dict(span.tags)
+    if span.children:
+        d["children"] = [span_to_wire(c, base) for c in span.children]
+    return d
+
+
+def span_from_wire(d: dict, anchor: float) -> Span:
+    """Rebuild a Span tree from its wire form, anchored at ``anchor``
+    (this process's perf_counter timeline) — lets a remote tree graft
+    into a local tracer via TraceContext.attach."""
+    s = Span.__new__(Span)
+    s.name = str(d.get("name", "remote"))
+    s.tags = dict(d.get("tags", {}))
+    s.start = anchor + d.get("off_us", 0) / 1e6
+    s.end = s.start + d.get("dur_us", 0) / 1e6
+    s.children = [span_from_wire(c, anchor)
+                  for c in d.get("children", ())]
+    return s
+
+
 @contextmanager
 def span_into(ctx: TraceContext | None, name: str, **tags):
     """Open a span on THIS thread that records (with everything

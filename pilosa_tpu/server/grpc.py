@@ -250,13 +250,26 @@ class GRPCHandler:
             qos = QoS.make(tenant=md.get("tenant"),
                            priority=md.get("priority"),
                            deadline_ms=dl)
-        tracer = prev = None
-        if profile:
+        # cross-node trace propagation (ISSUE 10): ("trace-id", ...)
+        # metadata is the gRPC twin of the X-Pilosa-Trace-Id header —
+        # the query's flight record inherits the caller's id and the
+        # serialized span tree returns as "trace-json" trailing
+        # metadata (the response-trailer form HTTP carries in-body).
+        # Inlined rather than flight.remote_leg (the canonical
+        # scaffold the HTTP leg uses) because ONE tracer here serves
+        # both the profile-json and trace-json trailers and trailer
+        # assembly must happen inside the abort-safe finally.
+        trace_id = md.get("trace-id")
+        tracer = prev = prev_inh = None
+        if profile or trace_id is not None:
             import json as _json
 
             from pilosa_tpu.obs import tracing as _tr
             tracer = _tr.RecordingTracer()
             prev = _tr.push_thread_tracer(tracer)
+        if trace_id is not None:
+            from pilosa_tpu.obs import flight as _fl
+            prev_inh = _fl.inherit_trace(trace_id)
         try:
             return self.api.executor.execute_serving(
                 request.index, request.pql, qos=qos)
@@ -271,12 +284,22 @@ class GRPCHandler:
                 ctx.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
             ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         finally:
-            if profile:
+            if trace_id is not None:
+                _fl.pop_inherit(prev_inh)
+            if tracer is not None:
                 _tr.pop_thread_tracer(prev)
+                trailers = []
+                if profile:
+                    trailers.append(("profile-json", _json.dumps(
+                        [s.to_dict() for s in tracer.roots])))
+                if trace_id is not None:
+                    node = getattr(self.api, "name", "") or "local"
+                    trailers.append(("trace-json", _json.dumps(
+                        {"node": node,
+                         "spans": [_tr.span_to_wire(s)
+                                   for s in tracer.roots]})))
                 try:
-                    ctx.set_trailing_metadata((
-                        ("profile-json", _json.dumps(
-                            [s.to_dict() for s in tracer.roots])),))
+                    ctx.set_trailing_metadata(tuple(trailers))
                 except Exception:
                     pass  # aborted context: never mask the status
 

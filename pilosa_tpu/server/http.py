@@ -49,6 +49,7 @@ class Route:
     def __init__(self, method: str, pattern: str, fn,
                  admin_only: bool = False):
         self.method = method
+        self.pattern = pattern  # kept for route-surface introspection
         self.re = re.compile("^" + re.sub(
             r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern) + "$")
         self.fn = fn
@@ -94,6 +95,13 @@ class Server:
         # stacks + OOM backstop; the prefetcher warms predicted stack
         # pages from flight records off the serving hot path
         config.apply_memory_settings()
+        # roofline attribution ([roofline]): per-op achieved-GB/s vs a
+        # measured/configured peak; the STREAM-style probe runs once
+        # on a background thread so first queries never wait on it
+        config.apply_roofline_settings()
+        # SLO burn-rate plane ([slo]): the maintenance ticker below
+        # feeds its sample ring
+        config.apply_slo_settings()
         if (self.api.executor.serving is not None
                 and config.memory_prefetch):
             self.api.executor.serving.start_prefetcher(
@@ -170,6 +178,10 @@ class Server:
                     if srv is not None and srv.cache is not None:
                         srv.cache.sweep(self.holder)
                 self.holder.sync()
+                # SLO sample ring: one cumulative reading per tick so
+                # burn-rate windows have history between scrapes
+                from pilosa_tpu.obs import slo
+                slo.tick()
             except Exception as e:
                 self.logger.error("maintenance tick failed: %s", e)
 
@@ -247,6 +259,9 @@ class Server:
         # Perfetto / chrome://tracing
         r(Route("GET", "/debug/queries", self._get_debug_queries))
         r(Route("GET", "/debug/trace", self._get_debug_trace))
+        # SLO burn-rate plane (obs/slo.py): multi-window error-budget
+        # burn over the latency histogram + typed-error counters
+        r(Route("GET", "/debug/slo", self._get_debug_slo))
         # fault-injection registry (obs/faults.py): armed rules with
         # fire counts — the chaos-operator's view of what is live
         r(Route("GET", "/debug/faults", self._get_debug_faults))
@@ -336,11 +351,42 @@ class Server:
         return RawResponse(profiler.heap_snapshot(top), "text/plain")
 
     def _get_debug_queries(self, req):
-        """Recent flight records, newest first; ?n= bounds the count."""
+        """Recent flight records, newest first.  Filters (ISSUE 10 —
+        a 4k-record ring must stay greppable from curl):
+
+            ?limit=N (alias ?n=)  newest N AFTER filtering
+            ?route=fused|cached|direct|solo|cluster|ingest
+            ?tenant=NAME          serving-path tenant attribution
+            ?since_ms=EPOCH_MS    records started at/after this time
+        """
         from pilosa_tpu.obs import flight
-        n = int(req.query.get("n", ["100"])[0])
+        q = req.query
+        limit = int(q.get("limit", q.get("n", ["100"]))[0])
+        route = q.get("route", [None])[0]
+        tenant = q.get("tenant", [None])[0]
+        since_ms = q.get("since_ms", [None])[0]
+        # scan the whole ring, filter, then truncate — "matched" is
+        # the pre-truncation count so curl users see how much more a
+        # bigger limit would return (a debug endpoint can afford the
+        # full-ring walk)
+        recs = flight.recorder.recent(len(flight.recorder))
+        if route is not None:
+            recs = [r for r in recs if r.get("route") == route]
+        if tenant is not None:
+            recs = [r for r in recs if r.get("tenant") == tenant]
+        if since_ms is not None:
+            cut = float(since_ms) / 1e3
+            recs = [r for r in recs if r.get("start", 0.0) >= cut]
         return {"enabled": flight.recorder.enabled,
-                "queries": flight.recorder.recent(n)}
+                "matched": len(recs),
+                "queries": recs[:max(0, limit)]}
+
+    def _get_debug_slo(self, req):
+        """SLO burn rates (obs/slo.py): samples the typed-error
+        counters + latency histogram now and evaluates every
+        configured window."""
+        from pilosa_tpu.obs import slo
+        return slo.get().evaluate()
 
     def _get_debug_trace(self, req):
         """Recent flight records as Chrome trace_event JSON — save
@@ -486,9 +532,31 @@ class Server:
             pql = req.text()
             shards = None
         profile = req.query.get("profile", ["false"])[0] == "true"
-        return self.api.query(req.vars["index"], pql, shards, profile,
-                              remote=remote,
-                              qos=_qos_from_headers(req.headers))
+        trace_id = req.headers.get("X-Pilosa-Trace-Id")
+        if trace_id is None:
+            return self.api.query(req.vars["index"], pql, shards,
+                                  profile, remote=remote,
+                                  qos=_qos_from_headers(req.headers))
+        # cross-node trace propagation (ISSUE 10): this node is a
+        # remote leg of a cluster fan-out.  The query's flight record
+        # inherits the coordinator's trace id (so the rings merge at
+        # /debug/cluster/queries), the leg executes under ONE
+        # recording span — attached to this handler thread via the
+        # same thread-tracer machinery Profile=true uses — and the
+        # serialized tree returns in the response's "trace" trailer
+        # for the coordinator's per-node Perfetto lanes.
+        from pilosa_tpu.obs import flight
+        parent = req.headers.get("X-Pilosa-Span-Parent", "")
+        node = getattr(self.api, "name", "") or "local"
+        with flight.remote_leg(trace_id) as (tracer, spans):
+            with tracer.span(f"rpc:{req.vars['index']}", node=node,
+                             **({"parent": parent} if parent else {})):
+                resp = self.api.query(
+                    req.vars["index"], pql, shards, profile,
+                    remote=remote, qos=_qos_from_headers(req.headers))
+        if spans:
+            resp["trace"] = {"node": node, "spans": spans}
+        return resp
 
     def _post_sql(self, req):
         body = req.json_lenient()

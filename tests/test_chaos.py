@@ -172,7 +172,7 @@ def test_client_retries_refused_connect_even_for_writes():
 
     class C(InternalClient):
         def _attempt(self, uri, method, path, data, content_type,
-                     deadline):
+                     deadline, extra_headers=None):
             calls.append(path)
             if len(calls) == 1:
                 raise ConnectionRefusedError(111, "refused")

@@ -1,0 +1,324 @@
+"""Cluster-scope observability tests (ISSUE 10): cross-node trace
+propagation through the real HTTP data plane, hedged attempts as
+parallel node lanes in /debug/trace, the federated
+/debug/cluster/{queries,metrics} views with per-node timeouts +
+partial flagging, and the gRPC trace-metadata twin.
+
+Same real-harness rule as test_chaos: ClusterNodes serve actual HTTP
+between each other, so trace headers and response trailers cross
+genuine sockets."""
+
+import json
+import time
+
+import pytest
+
+from pilosa_tpu.cluster import ClusterNode, InMemDisCo
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.obs import faults, flight, metrics
+
+SHARD = 1 << 20
+
+SCHEMA = {"indexes": [{"name": "c", "fields": [
+    {"name": "f", "options": {"type": "set"}},
+]}]}
+
+
+@pytest.fixture(autouse=True)
+def _clean(request):
+    faults.clear()
+    prev = (flight.recorder.enabled, flight.recorder._ring.maxlen)
+    flight.recorder.configure(enabled=True, keep=256)
+    yield
+    faults.clear()
+    flight.recorder.clear()  # node lanes must not leak across tests
+    flight.recorder.configure(enabled=prev[0], keep=prev[1])
+
+
+@pytest.fixture()
+def hedge_off(monkeypatch):
+    monkeypatch.setenv("PILOSA_TPU_CLUSTER_HEDGE_MS", "-1")
+
+
+def _mk_cluster(n=3, replica_n=2, lease_ttl=5.0, hb=5.0):
+    disco = InMemDisCo(lease_ttl=lease_ttl)
+    holders = [Holder() for _ in range(n)]
+    nodes = [ClusterNode(f"node{i}", disco, holder=holders[i],
+                         replica_n=replica_n,
+                         heartbeat_interval=hb).open()
+             for i in range(n)]
+    return disco, holders, nodes
+
+
+def _close_all(nodes):
+    for nd in nodes:
+        try:
+            nd.close()
+        except Exception:
+            pass
+
+
+def _seed(nodes, n_shards=4, per_shard=8):
+    nodes[0].apply_schema(SCHEMA)
+    rows, cols = [], []
+    for s in range(n_shards):
+        for i in range(per_shard):
+            rows.append(1)
+            cols.append(s * SHARD + i * 31)
+    nodes[0].import_bits("c", "f", rows, cols)
+
+
+def _req(port, method, path, body=None, headers=None):
+    import http.client
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+    data = json.dumps(body) if isinstance(body, (dict, list)) else body
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    c.request(method, path, body=data, headers=hdrs)
+    r = c.getresponse()
+    raw = r.read()
+    c.close()
+    try:
+        return r.status, json.loads(raw)
+    except json.JSONDecodeError:
+        return r.status, raw.decode()
+
+
+def _cluster_rec():
+    return next(r for r in flight.recorder.recent(50)
+                if r.get("route") == "cluster")
+
+
+# ---------------------------------------------------------------------------
+# cross-node trace propagation
+# ---------------------------------------------------------------------------
+
+def test_cross_node_trace_propagation(hedge_off):
+    disco, _holders, nodes = _mk_cluster()
+    try:
+        _seed(nodes)
+        flight.recorder.clear()
+        out = nodes[0].query("c", "Count(Row(f=1))")
+        assert out["results"] == [32]
+        rec = _cluster_rec()
+        # every leg's span tree came home: the remote nodes' trees
+        # rode the response trailer, the local leg recorded in place
+        lanes = {e["node"] for e in rec.get("node_spans", ())}
+        assert len(lanes) >= 2, rec.get("node_spans")
+        remote = [e for e in rec["node_spans"]
+                  if e["node"] != "node0"]
+        assert remote, "no remote span tree came back"
+        root = remote[0]["spans"][0]
+        # the remote wrapped its execution in one rpc span whose
+        # children are the engine's own spans
+        assert root["name"].startswith("rpc:")
+        child_names = [c["name"] for c in root.get("children", ())]
+        assert "executor.Execute" in child_names
+        assert root.get("tags", {}).get("node") == remote[0]["node"]
+        # remote legs' own flight records inherited the trace id —
+        # the merge key for /debug/cluster/queries
+        same = [r for r in flight.recorder.recent(50)
+                if r.get("trace_id") == rec["trace_id"]]
+        assert len(same) >= 2
+        assert any(r.get("inherited") for r in same)
+    finally:
+        _close_all(nodes)
+
+
+def test_response_carries_no_trace_without_header(hedge_off):
+    """A plain client query must not pay (or see) span serialization
+    — the trailer only exists when the caller asked via header."""
+    disco, _holders, nodes = _mk_cluster(n=1, replica_n=1)
+    try:
+        nodes[0].apply_schema(SCHEMA)
+        nodes[0].api.query("c", "Set(1, f=1)")
+        st, d = _req(nodes[0].server.port, "POST", "/index/c/query",
+                     {"query": "Count(Row(f=1))"})
+        assert st == 200 and "trace" not in d
+        st, d = _req(nodes[0].server.port, "POST", "/index/c/query",
+                     {"query": "Count(Row(f=1))", "remote": True},
+                     headers={"X-Pilosa-Trace-Id": "qcanary",
+                              "X-Pilosa-Span-Parent": "exec"})
+        assert st == 200 and d["trace"]["spans"]
+        assert d["trace"]["spans"][0]["tags"]["parent"] == "exec"
+        # the remote-leg record joined the caller's trace id
+        rec = next(r for r in flight.recorder.recent(20)
+                   if r.get("trace_id") == "qcanary")
+        assert rec.get("inherited") is True
+    finally:
+        _close_all(nodes)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: hedged read renders as per-node lanes under one trace id
+# ---------------------------------------------------------------------------
+
+def test_hedged_query_one_timeline_with_node_lanes(monkeypatch):
+    """ISSUE 10 acceptance: an in-process 3-node cluster serves one
+    query with a hedged replica read; the coordinator's /debug/trace
+    carries spans from >=2 nodes under one trace id, and
+    /debug/cluster/queries returns the merged flight record with the
+    per-node attempts."""
+    disco, _holders, nodes = _mk_cluster()
+    try:
+        _seed(nodes)
+        monkeypatch.setenv("PILOSA_TPU_CLUSTER_HEDGE_MS", "-1")
+        expect = nodes[0].query("c", "Count(Row(f=1))")["results"]
+        # stall every RPC to node1 2s; hedge fires at a fixed 25ms
+        faults.inject("rpc-delay", match=nodes[1].uri, times=0,
+                      delay_s=2.0)
+        monkeypatch.setenv("PILOSA_TPU_CLUSTER_HEDGE_MS", "25")
+        won0 = metrics.CLUSTER_EVENTS.value(event="hedge_won")
+        flight.recorder.clear()
+        r = nodes[0].query("c", "Count(Row(f=1))")
+        assert r["results"] == expect
+        assert metrics.CLUSTER_EVENTS.value(event="hedge_won") > won0
+        rec = _cluster_rec()
+        tid = rec["trace_id"]
+        # the hedge attempt is visible with its true start offset —
+        # the "parallel span" the Perfetto lane renders
+        assert any(a["outcome"].startswith("hedge")
+                   or a["t_off_ms"] > 0 for a in rec["attempts"])
+
+        # coordinator's /debug/trace: one timeline, >=2 node lanes
+        st, trace = _req(nodes[0].server.port, "GET",
+                         "/debug/trace?n=50")
+        assert st == 200
+        evs = trace["traceEvents"]
+        lane_name = {e["pid"]: e["args"]["name"] for e in evs
+                     if e.get("ph") == "M"}
+        node_pids = {e["pid"] for e in evs
+                     if e.get("ph") == "X" and e.get("tid") == tid
+                     and e.get("cat") in ("node", "attempt")}
+        lane_nodes = {lane_name.get(p) for p in node_pids}
+        assert len(lane_nodes) >= 2, (lane_nodes, node_pids)
+
+        # federated /debug/cluster/queries: ONE merged entry for the
+        # trace id, per-node attempts on its spine.  The rpc-delay
+        # fault sits on node1's whole uri — disarm it so the
+        # federation fan-out itself isn't the thing being stalled
+        faults.clear()
+        st, fed = _req(nodes[0].server.port, "GET",
+                       f"/debug/cluster/queries?trace_id={tid}")
+        assert st == 200 and fed["partial"] is False
+        assert sorted(fed["nodes"]) == ["node0", "node1", "node2"]
+        ent = next(e for e in fed["queries"]
+                   if e["trace_id"] == tid)
+        assert ent["attempts"], ent
+        assert {a["node"] for a in ent["attempts"]} & \
+            {"node0", "node1", "node2"}
+        assert ent["nodes"], "merged entry lost its per-node records"
+    finally:
+        _close_all(nodes)
+
+
+# ---------------------------------------------------------------------------
+# federation: per-node timeouts + partial flagging
+# ---------------------------------------------------------------------------
+
+def test_federated_queries_flags_dead_node_partial(hedge_off):
+    disco, _holders, nodes = _mk_cluster()
+    try:
+        _seed(nodes)
+        nodes[0].query("c", "Count(Row(f=1))")
+        nodes[2].pause()  # socket gone: refused, not hung
+        st, fed = _req(nodes[0].server.port, "GET",
+                       "/debug/cluster/queries?timeout_ms=500")
+        assert st == 200
+        assert fed["partial"] is True
+        assert fed["unreachable"] == ["node2"]
+        assert "node0" in fed["nodes"] and "node1" in fed["nodes"]
+        assert fed["queries"], "live nodes' records still merge"
+    finally:
+        _close_all(nodes)
+
+
+def test_federated_metrics_aggregate(hedge_off):
+    disco, _holders, nodes = _mk_cluster(n=2, replica_n=1)
+    try:
+        _seed(nodes, n_shards=2)
+        nodes[0].query("c", "Count(Row(f=1))")
+        st, fed = _req(nodes[0].server.port, "GET",
+                       "/debug/cluster/metrics")
+        assert st == 200 and fed["partial"] is False
+        agg = fed["aggregate"]
+        assert "pilosa_query_total" in agg
+        # histograms merge as {count, sum}
+        hist = agg["pilosa_query_duration_seconds"]
+        ent = next(iter(hist.values()))
+        assert set(ent) == {"count", "sum"} and ent["count"] > 0
+        assert set(fed["per_node"]) == {"node0", "node1"}
+    finally:
+        _close_all(nodes)
+
+
+# ---------------------------------------------------------------------------
+# gRPC twin: trace-id metadata -> trace-json trailing metadata
+# ---------------------------------------------------------------------------
+
+def test_grpc_trace_metadata():
+    grpc = pytest.importorskip("grpc")
+    from pilosa_tpu.api import API
+    from pilosa_tpu.server.grpc import GRPCServer
+    from pilosa_tpu.server.proto import pb
+
+    holder = Holder()
+    api = API(holder)
+    srv = GRPCServer(api, bind="127.0.0.1:0").start()
+    chan = grpc.insecure_channel(srv.uri)
+    try:
+        api.create_index("g")
+        api.create_field("g", "f", {"type": "set"})
+        api.query("g", "Set(1, f=7)")
+        fn = chan.unary_unary(
+            "/proto.Pilosa/QueryPQLUnary",
+            request_serializer=pb.QueryPQLRequest.SerializeToString,
+            response_deserializer=pb.TableResponse.FromString)
+        flight.recorder.clear()
+        resp, call = fn.with_call(
+            pb.QueryPQLRequest(index="g", pql="Count(Row(f=7))"),
+            metadata=(("trace-id", "qgrpc1"),))
+        assert resp.rows[0].columns[0].uint64Val == 1
+        md = dict(call.trailing_metadata() or ())
+        tr = json.loads(md["trace-json"])
+        assert tr["spans"] and tr["spans"][0]["name"] == \
+            "executor.Execute"
+        rec = next(r for r in flight.recorder.recent(20)
+                   if r.get("trace_id") == "qgrpc1")
+        assert rec.get("inherited") is True
+        # without the metadata no trailer rides along
+        _resp, call = fn.with_call(
+            pb.QueryPQLRequest(index="g", pql="Count(Row(f=7))"))
+        assert "trace-json" not in dict(call.trailing_metadata() or ())
+    finally:
+        chan.close()
+        srv.stop()
+        holder.close()
+
+
+# ---------------------------------------------------------------------------
+# attempts render with true start offsets (parallel, not stacked)
+# ---------------------------------------------------------------------------
+
+def test_attempt_offsets_monotone_in_record(hedge_off):
+    disco, _holders, nodes = _mk_cluster(n=2, replica_n=1)
+    try:
+        _seed(nodes, n_shards=2)
+        flight.recorder.clear()
+        nodes[0].query("c", "Count(Row(f=1))")
+        rec = _cluster_rec()
+        for a in rec["attempts"]:
+            assert a["t_off_ms"] >= 0
+            assert a["ms"] >= 0
+        # the chrome export places each attempt at start offset
+        doc = flight.recorder.chrome_trace(20)
+        att = [e for e in doc["traceEvents"]
+               if e.get("cat") == "attempt"
+               and e.get("tid") == rec["trace_id"]]
+        assert att, "attempts missing from the chrome export"
+        q = next(e for e in doc["traceEvents"]
+                 if e.get("cat") == "query"
+                 and e["tid"] == rec["trace_id"])
+        assert all(e["ts"] >= q["ts"] for e in att)
+    finally:
+        _close_all(nodes)

@@ -1,0 +1,232 @@
+"""Debug-surface satellites (ISSUE 10): the admin-gating sweep over
+EVERY /debug route (cluster + slo endpoints included), the
+README<->registry metrics doc-sync gate, /debug/queries filter
+params, and the logger's trace-id stamp."""
+
+import io
+import json
+import re
+import time
+
+import pytest
+
+from pilosa_tpu.obs import flight, metrics
+
+
+def _req(port, method, path, body=None, headers=None):
+    import http.client
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+    data = json.dumps(body) if isinstance(body, (dict, list)) else body
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    c.request(method, path, body=data, headers=hdrs)
+    r = c.getresponse()
+    raw = r.read()
+    c.close()
+    try:
+        return r.status, json.loads(raw)
+    except json.JSONDecodeError:
+        return r.status, raw.decode()
+
+
+# ---------------------------------------------------------------------------
+# admin-gating sweep: every /debug route honors _check_auth
+# ---------------------------------------------------------------------------
+
+# routes that need fast query params to avoid slow default collection
+_PARAMS = {"/debug/profile": "?seconds=0.05&hz=20"}
+
+
+def _debug_get_routes(server):
+    """Every parameterless GET /debug/* route the server exposes —
+    a future endpoint registers itself into this sweep for free."""
+    return sorted(rt.pattern for rt in server._routes
+                  if rt.method == "GET"
+                  and rt.pattern.startswith("/debug")
+                  and "{" not in rt.pattern)
+
+
+@pytest.fixture(scope="module")
+def auth_cluster():
+    from pilosa_tpu.cluster import ClusterNode, InMemDisCo
+    from pilosa_tpu.server.authn import Authenticator, encode_jwt
+    from pilosa_tpu.server.authz import Authorizer
+
+    secret = b"debug-sweep-secret"
+    authn = Authenticator(secret)
+    authz = Authorizer(user_groups={"readers": {"dq": "read"}},
+                       admin_group="admins")
+    atok = encode_jwt({"groups": ["admins"],
+                       "exp": time.time() + 300}, secret)
+    rtok = encode_jwt({"groups": ["readers"],
+                       "exp": time.time() + 300}, secret)
+    disco = InMemDisCo(lease_ttl=30)
+    node = ClusterNode("node0", disco, replica_n=1,
+                       heartbeat_interval=30,
+                       auth=(authn, authz), auth_token=atok).open()
+    yield node, atok, rtok
+    node.close()
+
+
+def test_debug_route_surface_includes_new_endpoints(auth_cluster):
+    node, _atok, _rtok = auth_cluster
+    routes = _debug_get_routes(node.server)
+    for want in ("/debug/slo", "/debug/cluster/queries",
+                 "/debug/cluster/metrics", "/debug/queries",
+                 "/debug/trace", "/debug/faults"):
+        assert want in routes, routes
+
+
+def test_every_debug_route_is_admin_gated(auth_cluster):
+    """One sweep over the LIVE route table: no token -> 401, a
+    read-only token -> 403, admin -> serves.  A future /debug
+    endpoint that forgets gating fails here without a new test."""
+    node, atok, rtok = auth_cluster
+    port = node.server.port
+    for pattern in _debug_get_routes(node.server):
+        path = pattern + _PARAMS.get(pattern, "")
+        st, _ = _req(port, "GET", path)
+        assert st == 401, (pattern, st)
+        st, _ = _req(port, "GET", path, headers={
+            "Authorization": f"Bearer {rtok}"})
+        assert st == 403, (pattern, st)
+        st, _ = _req(port, "GET", path, headers={
+            "Authorization": f"Bearer {atok}"})
+        assert st == 200, (pattern, st)
+
+
+# ---------------------------------------------------------------------------
+# doc-sync: README metrics inventory <-> registry
+# ---------------------------------------------------------------------------
+
+_QUANTILE_SUFFIX = re.compile(r"_(p50|p95|p99|bucket|sum|count)$")
+
+
+def _readme_metric_names() -> set[str]:
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "README.md"), encoding="utf-8") as f:
+        text = f.read()
+    names = set()
+    for m in re.finditer(r"\bpilosa_[a-z0-9_]+", text):
+        name = _QUANTILE_SUFFIX.sub("", m.group(0))
+        if name == "pilosa_tpu":  # the package path, not a metric
+            continue
+        names.add(name)
+    return names
+
+
+def _registry_metric_names() -> set[str]:
+    return {n for n in metrics.registry._metrics
+            if n.startswith("pilosa_")}
+
+
+def test_readme_metrics_inventory_in_sync():
+    """Every registered metric appears in the README inventory and
+    every pilosa_* metric the README mentions exists — the inventory
+    has been hand-maintained across 9 PRs and WILL drift."""
+    readme = _readme_metric_names()
+    registry = _registry_metric_names()
+    missing_from_readme = registry - readme
+    assert not missing_from_readme, (
+        f"metrics registered but absent from the README inventory: "
+        f"{sorted(missing_from_readme)}")
+    ghosts = readme - registry
+    assert not ghosts, (
+        f"README names metrics that no code registers: "
+        f"{sorted(ghosts)}")
+
+
+# ---------------------------------------------------------------------------
+# /debug/queries filter params
+# ---------------------------------------------------------------------------
+
+def test_debug_queries_filters_over_http():
+    from pilosa_tpu.server.http import Server
+
+    prev = (flight.recorder.enabled, flight.recorder._ring.maxlen)
+    flight.recorder.configure(enabled=True, keep=256)
+    srv = Server().start()
+    try:
+        flight.recorder.clear()
+        _req(srv.port, "POST", "/index/df", {})
+        _req(srv.port, "POST", "/index/df/field/f", {})
+        _req(srv.port, "POST", "/index/df/query",
+             {"query": "Set(1, f=1)"})
+        cut_ms = int(time.time() * 1000)
+        time.sleep(0.01)
+        for i in range(3):
+            _req(srv.port, "POST", "/index/df/query",
+                 {"query": f"Count(Row(f={i}))"},
+                 headers={"X-Pilosa-Tenant": "acme"})
+        # limit
+        st, d = _req(srv.port, "GET", "/debug/queries?limit=2")
+        assert st == 200 and len(d["queries"]) == 2
+        assert d["matched"] >= 3
+        # route filter: the Set went through the write path, Counts
+        # through the serving read path — no write record matches
+        st, d = _req(srv.port, "GET",
+                     "/debug/queries?route=cached&limit=100")
+        assert st == 200
+        assert all(r["route"] == "cached" for r in d["queries"])
+        # tenant filter
+        st, d = _req(srv.port, "GET",
+                     "/debug/queries?tenant=acme&limit=100")
+        assert st == 200 and d["queries"]
+        assert all(r["tenant"] == "acme" for r in d["queries"])
+        assert all(r["query"].startswith("Count")
+                   for r in d["queries"])
+        st, d = _req(srv.port, "GET",
+                     "/debug/queries?tenant=nobody")
+        assert st == 200 and d["queries"] == [] and d["matched"] == 0
+        # since_ms: epoch-ms lower bound drops the earlier Set
+        st, d = _req(srv.port, "GET",
+                     f"/debug/queries?since_ms={cut_ms}&limit=100")
+        assert st == 200 and d["queries"]
+        assert all(r["start"] * 1000 >= cut_ms for r in d["queries"])
+        assert not any(r["query"].startswith("Set")
+                       for r in d["queries"])
+        # combined: filters AND
+        st, d = _req(srv.port, "GET",
+                     "/debug/queries?tenant=acme&limit=1")
+        assert len(d["queries"]) == 1 and d["matched"] >= 3
+    finally:
+        srv.close()
+        flight.recorder.clear()
+        flight.recorder.configure(enabled=prev[0], keep=prev[1])
+
+
+# ---------------------------------------------------------------------------
+# logger trace-id stamp
+# ---------------------------------------------------------------------------
+
+def test_logger_stamps_active_trace_id():
+    from pilosa_tpu.obs.logger import Logger
+
+    buf = io.StringIO()
+    lg = Logger(stream=buf)
+    lg.info("before any record")
+    rec = flight.begin("i", "Count(All())")
+    assert rec is not None
+    lg.info("inside the record")
+    flight.commit(rec, 0.001)
+    lg.info("after commit")
+    lines = buf.getvalue().splitlines()
+    assert "trace=" not in lines[0]
+    assert f"trace={rec['trace_id']}" in lines[1]
+    # the stamp sits in the prefix, before the message
+    assert lines[1].index("trace=") < lines[1].index("inside")
+    assert "trace=" not in lines[2]
+
+
+def test_logger_stamps_inherited_trace_id():
+    from pilosa_tpu.obs.logger import Logger
+
+    buf = io.StringIO()
+    lg = Logger(stream=buf)
+    prev = flight.inherit_trace("qremote7")
+    try:
+        lg.warn("remote leg log line")
+    finally:
+        flight.pop_inherit(prev)
+    assert "trace=qremote7" in buf.getvalue()

@@ -224,13 +224,16 @@ def test_chrome_trace_is_valid_trace_event_json(holder):
     evs = doc["traceEvents"]
     assert evs, "no trace events exported"
     for ev in evs:
-        # Chrome trace_event complete-event invariants
-        assert ev["ph"] == "X"
+        # Chrome trace_event invariants: complete events ("X") plus
+        # the process_name metadata ("M") cluster node lanes emit
+        assert ev["ph"] in ("X", "M")
         assert isinstance(ev["name"], str) and ev["name"]
+        if ev["ph"] == "M":
+            continue
         assert isinstance(ev["ts"], (int, float))
         assert ev["dur"] > 0
         assert "pid" in ev and "tid" in ev
-    assert any(ev["cat"] == "query" for ev in evs)
+    assert any(ev.get("cat") == "query" for ev in evs)
     assert doc["displayTimeUnit"] == "ms"
 
 
@@ -411,7 +414,8 @@ def test_debug_queries_and_trace_endpoints():
         st, trace = _req(srv.port, "GET", "/debug/trace?n=50")
         assert st == 200
         assert isinstance(trace, dict) and trace["traceEvents"]
-        assert all(ev["ph"] == "X" for ev in trace["traceEvents"])
+        assert all(ev["ph"] in ("X", "M")
+                   for ev in trace["traceEvents"])
         # /metrics: phase histograms flushed; exemplars only under a
         # negotiated OpenMetrics Accept header
         st, text = _req(srv.port, "GET", "/metrics")

@@ -1,0 +1,207 @@
+"""SLO burn-rate plane tests (ISSUE 10): window parsing, burn-rate
+math over synthetic counter readings, the live metrics-backed
+tracker, config knobs, and the /debug/slo + gauge surface."""
+
+import json
+import time
+
+import pytest
+
+from pilosa_tpu.obs import metrics, slo
+
+
+def test_parse_windows_units_and_garbage():
+    assert slo.parse_windows("5m,1h") == [("5m", 300.0), ("1h", 3600.0)]
+    assert slo.parse_windows("300,60") == [("60", 60.0), ("300", 300.0)]
+    assert slo.parse_windows("2h,junk,30s") == [("30s", 30.0),
+                                                ("2h", 7200.0)]
+    # empty/hopeless spec falls back to the standard multi-window set
+    assert [w for w, _ in slo.parse_windows("")] == ["5m", "1h", "6h"]
+
+
+class _FedTracker(slo.SloTracker):
+    """Tracker with injectable cumulative readings."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.feed = []
+
+    def _read(self):
+        return self.feed.pop(0)
+
+
+def test_burn_rate_math_over_windows():
+    t = _FedTracker(latency_ms=100, latency_objective=0.99,
+                    availability_objective=0.999, windows="60s")
+    now = time.time()
+    t._t0 = now - 120  # old enough that the window reads covered
+    # sample 1 (60s ago): 1000 total, 990 good, 0 errors
+    t.feed = [(now - 59, 1000.0, 990.0, 0.0, 0.0)]
+    t.sample()
+    # evaluation reading: +1000 completions (980 good), +2 raised
+    t.feed = [(now, 2000.0, 1970.0, 2.0, 0.0)]
+    out = t.evaluate()
+    lat = out["slos"]["latency"]["windows"]["60s"]
+    # 20/1000 bad at a 1% budget -> burn 2.0
+    assert lat["burn_rate"] == pytest.approx(2.0, rel=0.01)
+    assert lat["window_covered"] is True
+    av = out["slos"]["availability"]["windows"]["60s"]
+    # 2 raised / 1002 requests at a 0.1% budget -> burn ~2.0
+    assert av["burn_rate"] == pytest.approx(1.996, rel=0.01)
+    assert metrics.SLO_BURN_RATE.value(
+        slo="latency", window="60s") == pytest.approx(2.0, rel=0.01)
+    # longest (only) window drives budget remaining
+    assert out["slos"]["latency"]["budget_remaining"] == 0.0
+    assert out["slos"]["availability"]["budget_remaining"] == 0.0
+
+
+def test_partial_results_count_bad_without_inflating_denominator():
+    """A served-partial query COMPLETED (it sits in the latency
+    histogram's total); it must spend availability budget exactly
+    once, not also pad the denominator."""
+    t = _FedTracker(availability_objective=0.99, windows="60s")
+    now = time.time()
+    t._t0 = now - 120
+    t.feed = [(now - 59, 0.0, 0.0, 0.0, 0.0)]
+    t.sample()
+    # 100 completions, ALL served partial, nothing raised
+    t.feed = [(now, 100.0, 100.0, 0.0, 100.0)]
+    out = t.evaluate()
+    av = out["slos"]["availability"]["windows"]["60s"]
+    # 100 bad / 100 requests at a 1% budget -> burn 100, not 50
+    assert av["burn_rate"] == pytest.approx(100.0, rel=0.01)
+    assert av["total"] == 100
+
+
+def test_burn_rate_zero_traffic_is_zero():
+    t = _FedTracker(windows="60s")
+    now = time.time()
+    t.feed = [(now - 30, 50.0, 50.0, 0.0, 0.0),
+              (now, 50.0, 50.0, 0.0, 0.0)]
+    t.sample()
+    out = t.evaluate()
+    lat = out["slos"]["latency"]["windows"]["60s"]
+    assert lat["burn_rate"] == 0.0 and lat["total"] == 0
+
+
+def test_live_tracker_reads_real_counters():
+    """The default _read joins the query-duration histogram with the
+    typed-error counters the serving layers already export — raised
+    errors (sheds) and degraded answers (partials) kept separate."""
+    t = slo.SloTracker(latency_ms=1e6)  # everything is "good"
+    _now, total0, good0, raised0, degraded0 = t._read()
+    metrics.QUERY_DURATION.observe(0.001)
+    metrics.ADMISSION_TOTAL.inc(**{"class": "point",
+                                   "outcome": "shed"})
+    metrics.CLUSTER_EVENTS.inc(event="partial")
+    _now, total1, good1, raised1, degraded1 = t._read()
+    assert total1 == total0 + 1
+    assert good1 >= good0 + 1 - 1e-6
+    assert raised1 == raised0 + 1
+    assert degraded1 == degraded0 + 1
+
+
+def test_count_le_interpolates():
+    h = metrics.Histogram("slo_test_hist", buckets=(0.1, 1.0))
+    for v in (0.05, 0.05, 0.5, 2.0):
+        h.observe(v)
+    assert h.count_le(0.1) == pytest.approx(2.0)
+    # 0.55 sits mid-bucket (0.1, 1.0]: 2 + 0.5 * 1
+    assert h.count_le(0.55) == pytest.approx(2.5)
+    # at/past the last finite bound, overflow-bucket observations
+    # stay "bad": the 2.0s outlier must never vanish under a >=1.0s
+    # threshold it may well have blown
+    assert h.count_le(1.0) == 3.0
+    assert h.count_le(10.0) == 3.0
+    assert h.count_le(0.0) == 0.0
+
+
+def test_counter_total_sums_matching_labels():
+    c = metrics.Counter("slo_test_counter")
+    c.inc(2, kind="a", tenant="x")
+    c.inc(3, kind="a", tenant="y")
+    c.inc(5, kind="b", tenant="x")
+    assert c.total(kind="a") == 5
+    assert c.total(tenant="x") == 7
+    assert c.total() == 10
+    assert c.total(kind="zzz") == 0
+
+
+def test_config_knobs_and_apply(tmp_path):
+    from pilosa_tpu import config as cfgmod
+
+    p = tmp_path / "c.toml"
+    p.write_text("[slo]\nlatency-ms = 50.0\n"
+                 "latency-objective = 0.95\n"
+                 "availability-objective = 0.99\n"
+                 "windows = \"30s,5m\"\n"
+                 "[roofline]\nattribution = false\n"
+                 "peak-gbps = 900.0\n")
+    cfg = cfgmod.load(str(p), env={})
+    assert cfg.slo_latency_ms == 50.0
+    assert cfg.slo_latency_objective == 0.95
+    assert cfg.slo_windows == "30s,5m"
+    assert cfg.roofline_attribution is False
+    assert cfg.roofline_peak_gbps == 900.0
+    cfg.apply_slo_settings()
+    t = slo.get()
+    assert t.latency_ms == 50.0
+    assert [w for w, _ in t.windows] == ["30s", "5m"]
+    # env wins over file
+    cfg2 = cfgmod.load(str(p), env={"PILOSA_TPU_SLO_LATENCY_MS": "75"})
+    assert cfg2.slo_latency_ms == 75.0
+    # restore process defaults for later tests
+    cfgmod.Config().apply_slo_settings()
+    from pilosa_tpu.obs import roofline
+    roofline.configure(enabled=True)
+
+
+def test_debug_slo_endpoint_and_gauges():
+    from pilosa_tpu.server.http import Server
+
+    srv = Server().start()
+    try:
+        import http.client
+        _req = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=10)
+        _req.request("POST", "/index/si",
+                     body=json.dumps({}),
+                     headers={"Content-Type": "application/json"})
+        _req.getresponse().read()
+        _req.close()
+
+        def get(path):
+            c = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                           timeout=10)
+            c.request("GET", path)
+            r = c.getresponse()
+            raw = r.read()
+            c.close()
+            return r.status, raw
+
+        # drive a little traffic so the histogram has observations
+        for _ in range(3):
+            c = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                           timeout=10)
+            c.request("POST", "/index/si/query",
+                      body=json.dumps({"query": "Count(All())"}),
+                      headers={"Content-Type": "application/json"})
+            c.getresponse().read()
+            c.close()
+        st, raw = get("/debug/slo")
+        assert st == 200
+        d = json.loads(raw)
+        assert set(d["slos"]) == {"latency", "availability"}
+        assert d["windows"] == ["5m", "1h", "6h"]
+        for name in ("latency", "availability"):
+            w = d["slos"][name]["windows"]
+            assert w, d  # at least one window evaluated
+            for cell in w.values():
+                assert cell["burn_rate"] >= 0
+        # the gauges render at /metrics
+        st, raw = get("/metrics")
+        text = raw.decode()
+        assert "pilosa_slo_burn_rate" in text
+        assert "pilosa_slo_error_budget_remaining" in text
+    finally:
+        srv.close()
