@@ -75,6 +75,87 @@ def run_queries(h, reps: int, label: str):
     return times, rl
 
 
+def groupby_fused_ab(h, reps: int, on_tpu: bool) -> dict:
+    """Fused-vs-onehot(-vs-XLA) one-pass GroupBy kernel A/B over the
+    combo sweep (C in {10, 60, 240}) — ISSUE 11 bench satellite.
+
+    Every arm runs the SAME queries through the real engine with the
+    one-pass arm forced (PILOSA_TPU_GROUPBY_ONEPASS_ARM) and records
+    wall p50 plus the per-cell roofline window (achieved GB/s +
+    fraction-of-peak for op=groupby, derived from each arm's own
+    single-pass traffic model).  On the 2-core CPU box the kernels
+    only interpret, so the sweep shrinks to a 2-shard subset and the
+    HARD GATE IS CORRECTNESS ONLY: all arms bit-exact (latency and
+    roofline are recorded, never asserted).  On TPU the sweep runs at
+    full scale and the fused arm's fraction is the ROADMAP item 2
+    acceptance cell."""
+    import os
+
+    from pilosa_tpu.executor.executor import Executor
+    from pilosa_tpu.models.view import VIEW_STANDARD
+    from pilosa_tpu.obs import roofline
+
+    queries = {
+        "c10": "GroupBy(Rows(gen), Rows(dom), "
+               "aggregate=Sum(field=age))",
+        "c60": "GroupBy(Rows(edu), Rows(gen), Rows(dom), "
+               "aggregate=Sum(field=age))",
+        "c240": "GroupBy(Rows(edu), Rows(gen), Rows(dom), Rows(reg), "
+                "aggregate=Sum(field=age))",
+    }
+    idx = h.index("bench")
+    all_shards = sorted(idx.field("gen").views[VIEW_STANDARD].shards)
+    shards = all_shards if on_tpu else all_shards[:2]
+    arms = ("fused", "onehot") if on_tpu else ("fused", "onehot",
+                                               "xla")
+    roofline.ensure_peak()
+    as_t = lambda res: [(tuple(g["row_id"] for g in r.group), r.count,
+                         r.agg, r.agg_count) for r in res]
+    out = {"shards": len(shards), "reps": reps,
+           "correctness_only": not on_tpu, "arms": {}}
+    oracle: dict[str, list] = {}
+    prev = os.environ.get("PILOSA_TPU_GROUPBY_ONEPASS_ARM")
+    try:
+        for arm in arms:
+            os.environ["PILOSA_TPU_GROUPBY_ONEPASS_ARM"] = arm
+            ex = Executor(h)
+            cells = {}
+            for name, q in queries.items():
+                res = ex.execute("bench", q, shards)  # compile+warm
+                tup = as_t(res[0])
+                if name not in oracle:
+                    oracle[name] = tup
+                # the hard gate: every arm bit-exact vs the first
+                assert tup == oracle[name], \
+                    f"groupby A/B mismatch: arm={arm} cell={name}"
+                snap0 = roofline.snapshot()
+                ts = []
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    ex.execute("bench", q, shards)
+                    ts.append(time.perf_counter() - t0)
+                rl = roofline.window(snap0, roofline.snapshot())
+                cell = {"wall_p50_ms":
+                        round(statistics.median(ts) * 1e3, 3)}
+                gb = rl.get("ops", {}).get("groupby")
+                if gb is not None:
+                    cell["roofline"] = gb
+                cells[name] = cell
+                log(f"[gb-ab {arm}] {name}: "
+                    f"p50={cell['wall_p50_ms']}ms"
+                    + (f" {gb['gbps']} GB/s"
+                       + (f" ({gb['fraction']:.1%} of peak)"
+                          if 'fraction' in gb else "")
+                       if gb else ""))
+            out["arms"][arm] = cells
+    finally:
+        if prev is None:
+            os.environ.pop("PILOSA_TPU_GROUPBY_ONEPASS_ARM", None)
+        else:
+            os.environ["PILOSA_TPU_GROUPBY_ONEPASS_ARM"] = prev
+    return out
+
+
 def loop_calibrate(h, reps: int = 5) -> dict[str, float]:
     """Per-execution DEVICE time (ms) of the two north-star scans,
     measured RTT-independently: one dispatch runs the scan `iters`

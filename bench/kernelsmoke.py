@@ -1,0 +1,151 @@
+"""Kernel interpret-mode smoke — the check.sh gate for the fused
+single-pass GroupBy kernel family (ISSUE 11 CI satellite).
+
+A kernel regression must fail fast WITHOUT TPU hardware, so this
+smoke runs the Pallas kernels in interpret mode on a small fixture
+and hard-gates bit-exactness only (never latency):
+
+1. kernel level — groupby_fused == groupby_codes_xla == groupby_onehot
+   on a random signed fixture; the Min/Max presence-walk table ==
+   the scatter reference; the value-histogram byproduct == its XLA
+   twin and naive decode (Distinct + Range counts included);
+2. engine level — the fused arm forced through the REAL engine equals
+   the host shard loop for GroupBy Sum/Min/Max, and the value-hist
+   fast paths answer Min/Max/Distinct queries identically.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from bench.common import log
+
+
+def _fail(msg: str) -> int:
+    log(f"KERNEL SMOKE FAIL: {msg}")
+    return 1
+
+
+def kernel_smoke() -> int:
+    import jax.numpy as jnp
+
+    from pilosa_tpu.ops import bitmap as bm
+    from pilosa_tpu.ops import bsi
+    from pilosa_tpu.ops import kernels
+
+    rng = np.random.default_rng(0xF05ED)
+    s_dim, w, depth = 3, 16, 5
+    width = w * 32
+    nf_rows = (5, 3)
+
+    # -- fixture: disjoint categorical fields + signed BSI ----------
+    assigns, row_stacks = [], []
+    for nr in nf_rows:
+        assign = rng.integers(-1, nr, size=(s_dim, width))
+        rows = np.zeros((nr, s_dim, w), np.uint32)
+        for s in range(s_dim):
+            for r in range(nr):
+                rows[r, s] = bm.from_columns(
+                    np.nonzero(assign[s] == r)[0], width)
+        assigns.append(assign)
+        row_stacks.append(rows)
+    vals = rng.integers(-(2**depth) + 1, 2**depth, size=(s_dim, width))
+    ex = rng.integers(0, 2, size=(s_dim, width)).astype(bool)
+    planes = np.stack([
+        bsi.encode(np.nonzero(ex[s])[0], vals[s][ex[s]], depth=depth,
+                   width=width) for s in range(s_dim)])
+    bits = [max(nr - 1, 0).bit_length() for nr in nf_rows]
+    n_codes = 1 << sum(bits)
+    cp = np.concatenate([np.asarray(bm.digit_planes(r))
+                         for r in row_stacks]).transpose(1, 0, 2)
+    valid = np.full((s_dim, w), 0xFFFFFFFF, np.uint32)
+    for rows in row_stacks:
+        u = rows[0].copy()
+        for r in rows[1:]:
+            u |= r
+        valid &= u
+    args = (jnp.asarray(cp), jnp.asarray(valid), jnp.asarray(planes),
+            n_codes, True)
+
+    # -- 1a: histogram three-way ------------------------------------
+    ref = [np.asarray(v) for v in kernels.groupby_codes_xla(*args)]
+    fused = [np.asarray(v) for v in kernels.groupby_fused(*args)]
+    onehot = [np.asarray(v) for v in kernels.groupby_onehot(*args)]
+    for r, f, o in zip(ref, fused, onehot):
+        if not (np.array_equal(r, f) and np.array_equal(r, o)):
+            return _fail("fused/onehot histogram != XLA reference")
+    log("kernel smoke: fused == onehot == xla histogram")
+
+    # -- 1b: Min/Max presence-walk table ----------------------------
+    mm_ref = np.asarray(
+        kernels.groupby_codes_xla(*args, minmax=True)[4])
+    mm_fused = np.asarray(kernels.groupby_fused(*args, minmax=True)[4])
+    if not np.array_equal(mm_ref, mm_fused):
+        return _fail("fused Min/Max table != scatter reference")
+    log("kernel smoke: fused minmax table == reference")
+
+    # -- 1c: value-histogram byproduct (Range/Distinct) -------------
+    pos, neg = kernels.bsi_value_hist(jnp.asarray(planes))
+    posr, negr = kernels.bsi_value_hist(jnp.asarray(planes),
+                                        use_kernel=False)
+    if not (np.array_equal(np.asarray(pos), np.asarray(posr))
+            and np.array_equal(np.asarray(neg), np.asarray(negr))):
+        return _fail("fused value hist != XLA reference")
+    vv = vals[ex]
+    if kernels.distinct_from_hist(pos, neg) != sorted(set(vv.tolist())):
+        return _fail("Distinct byproduct != naive decode")
+    lo, hi = -7, 9
+    if kernels.range_count_from_hist(pos, neg, lo, hi) != int(
+            ((vv >= lo) & (vv <= hi)).sum()):
+        return _fail("Range byproduct != naive decode")
+    log("kernel smoke: value-hist Range/Distinct byproduct exact")
+
+    # -- 2: fused arm through the REAL engine -----------------------
+    import os
+
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.models import FieldOptions, FieldType, Holder
+
+    W = 1 << 12
+    h = Holder(width=W)
+    idx = h.create_index("k")
+    idx.create_field("g", FieldOptions(type=FieldType.MUTEX))
+    idx.create_field("d", FieldOptions(type=FieldType.MUTEX))
+    idx.create_field("v", FieldOptions(type=FieldType.INT,
+                                       min=-40, max=40))
+    cols = list(range(0, 4 * W, 3))
+    idx.field("g").import_bits([c % 4 for c in cols], cols)
+    idx.field("d").import_bits([(c // 4) % 3 for c in cols], cols)
+    idx.field("v").import_values(
+        cols, [int(v) for v in rng.integers(-40, 40, size=len(cols))])
+    idx.mark_columns_exist(cols)
+    as_t = lambda res: [(tuple(g["row_id"] for g in r.group), r.count,
+                         r.agg, r.agg_count) for r in res]
+    queries = ("GroupBy(Rows(g), Rows(d), aggregate=Sum(field=v))",
+               "GroupBy(Rows(g), Rows(d), aggregate=Min(field=v))",
+               "GroupBy(Rows(g), aggregate=Max(field=v))")
+    os.environ["PILOSA_TPU_GROUPBY_ONEPASS_ARM"] = "fused"
+    try:
+        for q in queries:
+            got = Executor(h).execute("k", q)[0]
+            loop = Executor(h)
+            loop.use_stacked = False
+            want = loop.execute("k", q)[0]
+            if as_t(got) != as_t(want):
+                return _fail(f"engine fused arm mismatch: {q}")
+        ex2 = Executor(h)
+        loop = Executor(h)
+        loop.use_stacked = False
+        for q in ("Min(field=v)", "Max(field=v)"):
+            g0, w0 = ex2.execute("k", q)[0], loop.execute("k", q)[0]
+            if (g0.value, g0.count) != (w0.value, w0.count):
+                return _fail(f"value-hist {q} mismatch")
+        if ex2.execute("k", "Distinct(field=v)")[0].values != \
+                loop.execute("k", "Distinct(field=v)")[0].values:
+            return _fail("value-hist Distinct mismatch")
+    finally:
+        os.environ.pop("PILOSA_TPU_GROUPBY_ONEPASS_ARM", None)
+    log("kernel smoke: engine fused GroupBy Sum/Min/Max + "
+        "Min/Max/Distinct byproducts bit-exact")
+    log("KERNEL SMOKE PASS")
+    return 0

@@ -82,6 +82,19 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 
+echo "== kernel interpret-mode smoke =="
+# fused single-pass GroupBy kernel gate (bench.py --kernel-smoke):
+# the fused int8 MXU kernel + Min/Max presence walk + Range/Distinct
+# value-hist byproduct run in Pallas interpret mode on a small
+# fixture and must be bit-exact vs the XLA scatter reference and the
+# host shard loop — a kernel regression fails fast without TPU
+# hardware (correctness-only; latency never gated here)
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python bench.py --kernel-smoke; then
+    echo "check.sh: kernel interpret-mode smoke failed" >&2
+    exit 1
+fi
+
 echo "== tier-1 (budget ${BUDGET}s) =="
 # per-run log (concurrent gates must not clobber each other);
 # no pipe around pytest: under plain sh a `... | tee` pipeline would

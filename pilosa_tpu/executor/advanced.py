@@ -268,13 +268,16 @@ class AdvancedOps:
         filter_call = call.arg("filter")
         agg_call = call.arg("aggregate")
         agg_field = distinct_field = distinct_inner = None
+        agg_op = "sum"
         if agg_call is not None:
             if not isinstance(agg_call, Call) or agg_call.name not in (
-                    "Sum", "Count"):
-                raise self._err("GroupBy aggregate must be Sum(...) or "
+                    "Sum", "Count", "Min", "Max"):
+                raise self._err("GroupBy aggregate must be Sum(...), "
+                                "Min(...), Max(...) or "
                                 "Count(Distinct(...))")
-            if agg_call.name == "Sum":
+            if agg_call.name in ("Sum", "Min", "Max"):
                 agg_field = self._bsi_field(idx, agg_call.arg("_field"))
+                agg_op = agg_call.name.lower()
             else:
                 # Count(Distinct(field=D)) (executor.go:3918 aggregate
                 # dispatch): per group, the number of distinct values
@@ -335,20 +338,27 @@ class AdvancedOps:
                 return []
             combos = combos[int(np.argmax(gt)):]
 
-        counts = agg_nn = agg_pos = agg_neg = None
+        counts = agg_nn = agg_pos = agg_neg = agg_vals = None
         if getattr(self, "use_stacked", False) and distinct_field is None:
             try:
                 counts, agg = self.stacked.groupby(
                     idx, list(zip(fields, row_lists)), filter_call,
-                    agg_field, shard_list, pre, combos)
-                if agg is not None:
+                    agg_field, shard_list, pre, combos, agg_op=agg_op)
+                if agg is not None and agg_op in ("min", "max"):
+                    agg_nn, agg_vals = agg
+                elif agg is not None:
                     agg_nn, agg_pos, agg_neg = agg
             except Unstackable:
                 counts = None
         if counts is None:
-            counts, agg_nn, agg_pos, agg_neg = self._groupby_loop(
-                idx, fields, row_lists, combos, filter_call, agg_field,
-                shard_list, pre)
+            if agg_op in ("min", "max"):
+                counts, agg_nn, agg_vals = self._groupby_minmax_loop(
+                    idx, fields, row_lists, combos, filter_call,
+                    agg_field, shard_list, pre, agg_op)
+            else:
+                counts, agg_nn, agg_pos, agg_neg = self._groupby_loop(
+                    idx, fields, row_lists, combos, filter_call,
+                    agg_field, shard_list, pre)
 
         distinct_counts = None
         if distinct_field is not None:
@@ -356,8 +366,19 @@ class AdvancedOps:
                 idx, fields, row_lists, combos, counts, filter_call,
                 distinct_inner, distinct_field, shard_list, pre)
 
-        having = call.arg("having")
-        limit = call.arg("limit")
+        return self._assemble_groupby(
+            fields, row_lists, combos, counts, agg_field, agg_op,
+            agg_nn, agg_pos, agg_neg, agg_vals, distinct_counts,
+            call.arg("having"), call.arg("limit"))
+
+    def _assemble_groupby(self, fields, row_lists, combos, counts,
+                          agg_field, agg_op, agg_nn, agg_pos, agg_neg,
+                          agg_vals, distinct_counts, having, limit):
+        """GroupCount assembly shared by the solo path and the
+        serving/ragged batched demux: zero-count combos drop, keys
+        translate, aggregates combine (Sum from sign-split plane
+        partials; Min/Max from per-group values; Count(Distinct) from
+        its own sweep), having/limit apply in combo order."""
         out = []
         for ci, combo in enumerate(combos):
             cnt = int(counts[ci])
@@ -370,7 +391,13 @@ class AdvancedOps:
                     entry["row_key"] = f.row_translator.translate_id(rl[gi])
                 group.append(entry)
             agg = agg_count = None
-            if agg_field is not None:
+            if agg_field is not None and agg_op in ("min", "max"):
+                agg_count = int(agg_nn[ci])
+                # a group whose columns all lack a value has no
+                # min/max (reference fragment.min/max empty scope)
+                agg = (agg_field.int_to_value(int(agg_vals[ci]))
+                       if agg_count else None)
+            elif agg_field is not None:
                 total = sum((int(p) - int(g)) << b for b, (p, g) in
                             enumerate(zip(agg_pos[ci], agg_neg[ci])))
                 agg = agg_field.int_to_value(total)
@@ -434,6 +461,64 @@ class AdvancedOps:
                     agg_pos[i:i + chunk] += np.asarray(pos_pc, dtype=np.int64)
                     agg_neg[i:i + chunk] += np.asarray(neg_pc, dtype=np.int64)
         return counts, agg_nn, agg_pos, agg_neg
+
+    def _groupby_minmax_loop(self, idx, fields, row_lists, combos,
+                             filter_call, agg_field, shard_list, pre,
+                             agg_op: str):
+        """Host fallback for GroupBy aggregate=Min/Max — full
+        generality (overlapping rows, any depth, any filter tree):
+        per shard, decode the BSI values once and reduce each combo's
+        member columns in numpy.  The one-pass fused tile walk
+        (stacked.groupby agg_op=min/max) is the fast path; this loop
+        is the semantics oracle it is pinned against."""
+        from pilosa_tpu.ops import bsi as bsi_ops
+        counts = np.zeros(len(combos), dtype=np.int64)
+        agg_nn = np.zeros(len(combos), dtype=np.int64)
+        agg_vals = np.zeros(len(combos), dtype=np.int64)
+        reduce_ = np.minimum if agg_op == "min" else np.maximum
+        combo_idx = np.array(combos, dtype=np.int64)
+        for shard in shard_list:
+            filt = (self._bitmap_call_shard(idx, filter_call, shard,
+                                            pre)
+                    if filter_call is not None else None)
+            filt_bits = (bsi_ops.unpack_bits_np(np.asarray(filt))
+                         .astype(bool) if filt is not None else None)
+            tiles_per_field = [
+                self._row_tiles(f, shard, rl, [VIEW_STANDARD])
+                for f, rl in zip(fields, row_lists)]
+            tile_bits = [bsi_ops.unpack_bits_np(
+                np.asarray(t)).astype(bool) for t in tiles_per_field]
+            v = agg_field.views.get(agg_field.bsi_view)
+            frag = v.fragment(shard) if v else None
+            ex = vals = None
+            if frag is not None:
+                planes = np.asarray(
+                    frag.device_planes(agg_field.bit_depth))
+                ex = bsi_ops.unpack_bits_np(planes[0]).astype(bool)
+                sg = bsi_ops.unpack_bits_np(planes[1]).astype(bool)
+                mag = np.zeros(ex.shape, np.int64)
+                for p in range(agg_field.bit_depth):
+                    mag |= bsi_ops.unpack_bits_np(
+                        planes[2 + p]).astype(np.int64) << p
+                vals = np.where(sg, -mag, mag)
+            for ci in range(len(combos)):
+                sel = tile_bits[0][combo_idx[ci, 0]]
+                for fi in range(1, len(fields)):
+                    sel = sel & tile_bits[fi][combo_idx[ci, fi]]
+                if filt_bits is not None:
+                    sel = sel & filt_bits
+                counts[ci] += int(sel.sum())
+                if ex is None:
+                    continue
+                sele = sel & ex
+                n = int(sele.sum())
+                if not n:
+                    continue
+                best = int(reduce_.reduce(vals[sele]))
+                agg_vals[ci] = (best if agg_nn[ci] == 0
+                                else int(reduce_(agg_vals[ci], best)))
+                agg_nn[ci] += n
+        return counts, agg_nn, agg_vals
 
     def _groupby_count_distinct(self, idx, fields, row_lists, combos,
                                 counts, filter_call, inner_filter,

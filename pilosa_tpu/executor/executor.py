@@ -662,6 +662,20 @@ class Executor(AdvancedOps):
         if fname is None:
             raise ExecError(f"{call.name} requires field=")
         f = self._bsi_field(idx, fname)
+        if self.use_stacked:
+            # fused value-histogram fast path (ISSUE 11 byproduct):
+            # one single-pass tile walk over the plane stack instead
+            # of a per-shard min/max plane walk each
+            try:
+                filter_call = (call.children[0] if call.children
+                               else None)
+                pos, neg = self.stacked.bsi_value_hist(
+                    idx, f, filter_call, self._shard_list(idx, shards),
+                    pre)
+                metrics.STACKED_QUERIES.inc(path="stacked")
+                return self._minmax_from_hist(f, pos, neg, is_min)
+            except Unstackable:
+                metrics.STACKED_QUERIES.inc(path="loop")
         best, count = None, 0
         op = bsi_ops.min_op if is_min else bsi_ops.max_op
         for shard in self._shard_list(idx, shards):
@@ -681,6 +695,32 @@ class Executor(AdvancedOps):
         if best is None:
             return ValCount(value=None, count=0)
         return ValCount(value=f.int_to_value(best), count=count)
+
+    @staticmethod
+    def _minmax_from_hist(f, pos, neg, is_min: bool) -> ValCount:
+        """Min/Max + attaining count straight out of the fused value
+        histogram: the extreme nonzero code, negatives preferred for
+        Min / non-negatives for Max (fragment.min/max semantics)."""
+        pnz, nnz = np.nonzero(pos)[0], np.nonzero(neg)[0]
+        if is_min:
+            if nnz.size:
+                mag = int(nnz[-1])
+                return ValCount(value=f.int_to_value(-mag),
+                                count=int(neg[mag]))
+            if pnz.size:
+                mag = int(pnz[0])
+                return ValCount(value=f.int_to_value(mag),
+                                count=int(pos[mag]))
+        else:
+            if pnz.size:
+                mag = int(pnz[-1])
+                return ValCount(value=f.int_to_value(mag),
+                                count=int(pos[mag]))
+            if nnz.size:
+                mag = int(nnz[0])
+                return ValCount(value=f.int_to_value(-mag),
+                                count=int(neg[mag]))
+        return ValCount(value=None, count=0)
 
     def _execute_minmax_row(self, idx: Index, call: Call, shards,
                             is_min: bool, pre=None) -> Pair:
@@ -805,9 +845,20 @@ class Executor(AdvancedOps):
     def _distinct_bsi_stacked(self, idx: Index, f: Field, call: Call,
                               shards, pre) -> DistinctValues:
         """Distinct over a BSI field on the stacked engine
-        (executor.go:2034 re-designed): filter tree as one stacked
-        program, values via the chunked device decode, uniquing in
-        vectorized numpy."""
+        (executor.go:2034 re-designed): the fused value histogram
+        when the dense value space fits (ISSUE 11 — distinct values
+        are the nonzero codes of ONE single-pass tile walk, no
+        per-column decode at all), else filter tree as one stacked
+        program + the chunked device decode, uniquing in numpy."""
+        try:
+            pos, neg = self.stacked.bsi_value_hist(
+                idx, f, call.children[0] if call.children else None,
+                self._shard_list(idx, shards), pre)
+            return DistinctValues(values=sorted(
+                f.int_to_value(v)
+                for v in kernels.distinct_from_hist(pos, neg)))
+        except Unstackable:
+            pass                      # depth over the dense bound
         skey = tuple(self._shard_list(idx, shards))
         filt_words = None
         if call.children:

@@ -118,6 +118,14 @@ def _remap_sub(sub, lmap, poff):
         tree = None if sub[2] is None else _remap_tree(sub[2], lmap,
                                                        poff)
         return ("row_counts", lmap[sub[1]], tree, sub[3])
+    if kind == "gb_hist":
+        # one-pass GroupBy histogram rider (ISSUE 11): the group-code
+        # stack and BSI plane leaves gather through the same page
+        # table as every other operand
+        tree = None if sub[2] is None else _remap_tree(sub[2], lmap,
+                                                       poff)
+        planes = None if sub[3] is None else lmap[sub[3]]
+        return ("gb_hist", lmap[sub[1]], tree, planes) + sub[4:]
     raise RaggedUnbuildable(f"unraggable sub kind {kind}")
 
 
@@ -241,6 +249,12 @@ class RaggedProgram:
                 out.add(sub[1])
                 if sub[2] is not None:
                     walk(sub[2])
+            elif sub[0] == "gb_hist":
+                out.add(sub[1])
+                if sub[2] is not None:
+                    walk(sub[2])
+                if sub[3] is not None:
+                    out.add(sub[3])
             else:
                 walk(sub[1])
             return out

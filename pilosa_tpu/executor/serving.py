@@ -839,6 +839,26 @@ class ServingLayer:
         elif name in ("TopN", "TopK"):
             kind = "topn"
             tree_call = call.children[0] if call.children else None
+        elif name == "GroupBy":
+            # batchable subset (ISSUE 11): Rows children over plain
+            # fields, optional pure filter tree, optional Sum
+            # aggregate — the shapes the one-pass "gb_hist" subplan
+            # expresses.  previous=/having=/limit= and
+            # Min/Max/Count(Distinct) aggregates stay solo.
+            if any(call.arg(k) is not None
+                   for k in ("previous", "having", "limit")):
+                return None
+            if not call.children or any(
+                    c.name != "Rows" or c.children
+                    or set(c.args) - {"_field"}
+                    for c in call.children):
+                return None
+            agg = call.arg("aggregate")
+            if agg is not None and (
+                    not isinstance(agg, Call) or agg.name != "Sum"
+                    or agg.children or agg.arg("_field") is None):
+                return None
+            kind, tree_call = "groupby", call.arg("filter")
         elif name in _PURE_BITMAP:
             kind, tree_call = "words", call
         else:
@@ -1076,7 +1096,97 @@ class ServingLayer:
                          if cc > 0 or ids is not None]
                 return [ex._finish_topn(f, pairs, n, ids)]
             return ("row_counts", rows_i, tree, red), demux_topn
+        if r.kind == "groupby":
+            return self._build_groupby_sub(b, r, shards)
         raise Unstackable(f"unbatchable kind {r.kind}")
+
+    def _build_groupby_sub(self, b: PlanBuilder, r: _Req,
+                           shards: list[int]):
+        """One-pass GroupBy as a batched subplan: the group-code stack
+        and BSI planes become shared leaves (PageView pages under the
+        ragged program) and the histogram evaluates inside the fused
+        device program — a GroupBy rider costs the batch ONE
+        single-pass tile walk, not its own dispatch (ISSUE 11)."""
+        from pilosa_tpu.executor.stacked import (
+            _code_space,
+            _combo_codes,
+            _onepass_arm,
+            _onepass_unpack,
+        )
+        from pilosa_tpu.obs.metrics import GROUPBY_FUSED, GROUPBY_ONEPASS
+
+        ex = self.executor
+        eng = ex.stacked
+        idx = r.idx
+        call = r.call
+        if eng.host_only:
+            raise Unstackable("groupby batch needs a device program")
+        fields, row_lists = [], []
+        for rc in call.children:
+            fname = rc.arg("_field")
+            f = idx.field(fname) if fname else None
+            if f is None:
+                raise Unstackable("Rows requires a valid field")
+            fields.append(f)
+            row_lists.append(ex._rows_ids(idx, rc, r.shards))
+        if any(not rl for rl in row_lists):
+            r.result = [[]]
+            return None
+        agg_call = call.arg("aggregate")
+        agg_field = (ex._bsi_field(idx, agg_call.arg("_field"))
+                     if agg_call is not None else None)
+        depth = agg_field.bit_depth if agg_field is not None else 0
+        fields_rows = list(zip(fields, row_lists))
+        combos = np.indices([len(rl) for rl in row_lists]) \
+            .reshape(len(row_lists), -1).T.astype(np.int64)
+        skey = tuple(shards)
+        if not eng._groupby_onepass_ok(
+                idx, fields_rows, len(combos), depth,
+                agg_field is not None, skey):
+            raise Unstackable("groupby shape not one-pass batchable")
+        bits, shifts, n_codes = _code_space(fields_rows)
+        codes = _combo_codes(shifts, combos)
+        arm = _onepass_arm(n_codes, depth)
+        if eng._n_total_devices() > 1 and arm != "xla":
+            # mirror the solo path's mesh guard: a pallas_call over
+            # mesh-sharded leaves inside the fused multi program would
+            # force a gather (or fail to lower and demote every rider
+            # in the batch); the scatter reference shards under GSPMD
+            arm = "xla"
+        signed = False
+        if agg_field is not None:
+            frags = eng._frags(idx, agg_field, agg_field.bsi_view,
+                               list(skey))
+            signed = any(fr is not None and 1 in fr.row_ids
+                         for fr in frags)
+        filter_call = call.arg("filter")
+        tree = None
+        if filter_call is not None:
+            tree = b.build(filter_call)
+            if tree == ("zeros",):
+                r.result = [[]]
+                return None
+        cg_i = b._groupcode_leaf(fields_rows)
+        planes_i = (b._planes_leaf(agg_field)
+                    if agg_field is not None else None)
+        GROUPBY_ONEPASS.inc()
+        if arm == "fused":
+            GROUPBY_FUSED.inc(path="batched")
+        has_planes = agg_field is not None
+
+        def demux_groupby(out):
+            counts, nn, pos, neg = _onepass_unpack(
+                np.asarray(out), n_codes, depth, has_planes)
+            agg_nn = agg_pos = agg_neg = None
+            if has_planes:
+                agg_nn, agg_pos, agg_neg = nn[codes], pos[codes], \
+                    neg[codes]
+            return [ex._assemble_groupby(
+                fields, row_lists, combos, counts[codes], agg_field,
+                "sum", agg_nn, agg_pos, agg_neg, None, None, None,
+                None)]
+        return (("gb_hist", cg_i, tree, planes_i, n_codes, signed,
+                 arm), demux_groupby)
 
     def _row_result(self, idx, shards: list[int], words) -> RowResult:
         """Mirror Executor._bitmap_result + the translateResults key

@@ -923,34 +923,69 @@ def _combo_codes(shifts, combos_arr: np.ndarray) -> np.ndarray:
     return codes
 
 
-def _onepass_use_kernel(n_codes: int, depth: int) -> bool:
-    """Pallas groupby_onehot vs the XLA scatter reference for the
-    one-pass device program (CPU always interprets, so only TPU
-    backends route through the kernel)."""
-    return (jax.default_backend() == "tpu"
-            and n_codes <= _ONEPASS_KERNEL_MAX_CODES
-            and depth <= _ONEPASS_KERNEL_MAX_DEPTH)
+def _onepass_arm(n_codes: int, depth: int,
+                 minmax: bool = False) -> str:
+    """Which one-pass device program serves the histogram:
+
+    - "fused"  — the int8 MXU popcount-accumulate single-pass kernel
+      (groupby_fused; the default on TPU, ISSUE 11)
+    - "onehot" — the first-generation f32 one-hot matmul kernel (the
+      A/B arm; PILOSA_TPU_GROUPBY_FUSED=0, no Min/Max support)
+    - "xla"    — the scatter-add reference (the bit-exactness oracle
+      and the off-TPU default: CPU would only interpret the kernels)
+
+    PILOSA_TPU_GROUPBY_ONEPASS_ARM forces an arm outright (bench A/B
+    and the interpret-mode test/smoke paths use it)."""
+    import os
+    over_bounds = (n_codes > _ONEPASS_KERNEL_MAX_CODES
+                   or depth > _ONEPASS_KERNEL_MAX_DEPTH)
+    forced = os.environ.get("PILOSA_TPU_GROUPBY_ONEPASS_ARM", "")
+    if forced in ("fused", "onehot", "xla"):
+        # forcing never lifts the kernel size caps: a 2^20-code
+        # value-hist under a forced fused arm would build a ~128 MB
+        # per-chunk one-hot — route oversized shapes to the reference
+        if forced != "xla" and over_bounds:
+            return "xla"
+        # onehot has no Min/Max table — the reference serves those
+        return "xla" if forced == "onehot" and minmax else forced
+    if jax.default_backend() != "tpu" or over_bounds:
+        return "xla"
+    if os.environ.get("PILOSA_TPU_GROUPBY_FUSED", "") == "0":
+        return "xla" if minmax else "onehot"
+    return "fused"
 
 
-def _onepass_unpack(flat, n_codes: int, depth: int, has_planes: bool):
+def _onepass_gb(arm: str):
+    """The arm's histogram callable (shared by jit + shard_map)."""
+    return {"fused": kernels.groupby_fused,
+            "onehot": kernels.groupby_onehot,
+            "xla": kernels.groupby_codes_xla}[arm]
+
+
+def _onepass_unpack(flat, n_codes: int, depth: int, has_planes: bool,
+                    minmax: bool = False):
     """Split the one-pass paths' single flat device fetch back into
-    (counts, nn, pos, neg) int64 over the dense code space."""
+    (counts, nn, pos, neg[, mm]) int64 over the dense code space."""
     flat = np.asarray(flat, dtype=np.int64)
     if not has_planes:
         return flat[:n_codes], None, None, None
     g = n_codes
     counts, nn = flat[:g], flat[g:2 * g]
     pos = flat[2 * g:2 * g + g * depth].reshape(g, depth)
-    neg = flat[2 * g + g * depth:].reshape(g, depth)
-    return counts, nn, pos, neg
+    end = 2 * g + 2 * g * depth
+    neg = flat[2 * g + g * depth:end].reshape(g, depth)
+    if not minmax:
+        return counts, nn, pos, neg
+    return counts, nn, pos, neg, flat[end:].reshape(4, g)
 
 
-def _groupby_onepass_jit(use_kernel: bool, has_planes: bool,
-                         has_filter: bool, signed: bool, n_codes: int):
+def _groupby_onepass_jit(arm: str, has_planes: bool,
+                         has_filter: bool, signed: bool, n_codes: int,
+                         minmax: bool = False):
     """Single-device jitted one-pass program: group-code stack in,
     ONE flat histogram array out (one fetch round trip)."""
-    key = ("onepass", use_kernel, has_planes, has_filter, signed,
-           n_codes)
+    key = ("onepass", arm, has_planes, has_filter, signed,
+           n_codes, minmax)
     fn = _gb_jit_get(key)
     if fn is not None:
         return fn
@@ -959,8 +994,12 @@ def _groupby_onepass_jit(use_kernel: bool, has_planes: bool,
         cp, valid = cg[:, :-1], cg[:, -1]
         if has_filter:
             valid = jnp.bitwise_and(valid, filt)
-        gb = (kernels.groupby_onehot if use_kernel
-              else kernels.groupby_codes_xla)
+        gb = _onepass_gb(arm)
+        if minmax:
+            c, n, p, g, mm = gb(cp, valid, planes, n_codes, signed,
+                                minmax=True)
+            return jnp.concatenate(
+                [c, n, p.ravel(), g.ravel(), mm.ravel()])
         c, n, p, g = gb(cp, valid, planes, n_codes, signed)
         if not has_planes:
             return c
@@ -971,18 +1010,19 @@ def _groupby_onepass_jit(use_kernel: bool, has_planes: bool,
     return fn
 
 
-def _groupby_onepass_shard_map(mesh, use_kernel: bool, has_planes: bool,
+def _groupby_onepass_shard_map(mesh, arm: str, has_planes: bool,
                                has_filter: bool, signed: bool,
                                n_codes: int):
     """Mesh one-pass wrapper: every device histograms its local shard
     slice of the flat-placed group-code stack, partial (K, G) tables
     psum over the whole mesh — the histogram is combo-count-free, so
-    the collective payload is O(G), not O(C*S)."""
+    the collective payload is O(G), not O(C*S).  (Min/Max tables
+    combine with max/min, not psum — mesh callers stay on Sum.)"""
     from jax.sharding import PartitionSpec as P
 
     from pilosa_tpu.parallel.mesh import shard_map_nocheck
 
-    key = ("onepass_mesh", id(mesh), use_kernel, has_planes,
+    key = ("onepass_mesh", id(mesh), arm, has_planes,
            has_filter, signed, n_codes)
     fn = _gb_jit_get(key)
     if fn is not None:
@@ -1000,8 +1040,7 @@ def _groupby_onepass_shard_map(mesh, use_kernel: bool, has_planes: bool,
         cp, valid = cg[:, :-1], cg[:, -1]
         if filt is not None:
             valid = jnp.bitwise_and(valid, filt)
-        gb = (kernels.groupby_onehot if use_kernel
-              else kernels.groupby_codes_xla)
+        gb = _onepass_gb(arm)
         c, n, p, g = gb(cp, valid, planes, n_codes, signed)
         flat = c if not has_planes else jnp.concatenate(
             [c, n, p.ravel(), g.ravel()])
@@ -1050,12 +1089,18 @@ def _groupby_kernel_shard_map(mesh, nf: int, has_planes: bool,
     return run
 
 
-def _zero_groupby_result(n_combos: int, depth: int, agg_field):
+def _zero_groupby_result(n_combos: int, depth: int, agg_field,
+                         agg_op: str = "sum"):
     """(counts, agg) zeros for a provably-empty filter."""
-    zero_agg = None if agg_field is None else (
-        np.zeros(n_combos, dtype=np.int64),
-        np.zeros((n_combos, depth), dtype=np.int64),
-        np.zeros((n_combos, depth), dtype=np.int64))
+    if agg_field is None:
+        zero_agg = None
+    elif agg_op in ("min", "max"):
+        zero_agg = (np.zeros(n_combos, dtype=np.int64),
+                    np.zeros(n_combos, dtype=np.int64))
+    else:
+        zero_agg = (np.zeros(n_combos, dtype=np.int64),
+                    np.zeros((n_combos, depth), dtype=np.int64),
+                    np.zeros((n_combos, depth), dtype=np.int64))
     return np.zeros(n_combos, dtype=np.int64), zero_agg
 
 
@@ -1246,6 +1291,30 @@ def _plan_run(plan, kern: bool = False):
                 return (jnp.sum(cnt), jnp.sum(pos, axis=0),
                         jnp.sum(neg, axis=0))         # scalar, (P,), (P,)
             return cnt, pos, neg
+    elif kind == "gb_hist":
+        # plan: ("gb_hist", cg_i, tree|None, planes_i|None, n_codes,
+        #        signed, arm) — the one-pass group-code histogram as a
+        #        BATCHABLE subplan (ISSUE 11): a GroupBy rider inside
+        #        a fused "multi"/"ragged" program evaluates the same
+        #        single-pass tile walk as the solo one-pass path (arm
+        #        picks fused/onehot/xla at build time), and the demux
+        #        gathers its combos out of the flat (K*G,) table.
+        #        Unlike "groupby" it reads nothing from params[-1], so
+        #        it composes with any other subplan.
+        cg_i, tree, planes_i, n_codes, signed, arm = plan[1:7]
+
+        def run(leaves, params):
+            cg = leaves[cg_i]                     # (S, CB+1, W)
+            cp, valid = cg[:, :-1], cg[:, -1]
+            if tree is not None:
+                filt = _as_stack(_eval(tree, leaves, params), leaves)
+                valid = jnp.bitwise_and(valid, filt)
+            planes = leaves[planes_i] if planes_i is not None else None
+            c, n, p, g = _onepass_gb(arm)(cp, valid, planes, n_codes,
+                                          signed)
+            if planes_i is None:
+                return c
+            return jnp.concatenate([c, n, p.ravel(), g.ravel()])
     elif kind == "groupby":
         # plan: ("groupby", (stack_i, ...), planes_i|None, tree|None,
         #        reduce) — executeGroupByShard (executor.go:3918) as one
@@ -1437,6 +1506,30 @@ _ROOF_OPS = {"count": "count", "words": "row", "row_counts": "topn",
              "ragged": "ragged", "row_counts_flat": "topn"}
 
 
+def _plan_hbm_bytes(plan, leaves, params) -> int:
+    """Bytes one dispatch of `plan` actually streams through HBM.
+
+    Default: every operand leaf crosses once (true for the tree/scan
+    programs XLA fuses into one pass).  The per-combo "groupby" scan
+    is the exception — it gathers (C, S, W) combo masks and re-reads
+    them once per payload pass, so its traffic comes from the
+    schedule's model (kernels.groupby_scan_hbm_bytes), not from the
+    operand sizes; without this the old arm's dispatches under-note
+    and the groupby bandwidth gauge is fiction (ISSUE 11 satellite)."""
+    if plan[0] == "groupby":
+        stack_is, planes_i, tree = plan[1], plan[2], plan[3]
+        sel_all = params[-1]                    # (n_chunks, C, nf)
+        n_combos = int(sel_all.shape[0] * sel_all.shape[1])
+        s0 = leaves[stack_is[0]]
+        n_shards, width_words = s0.shape[1], s0.shape[2]
+        depth = (leaves[planes_i].shape[1] - 2
+                 if planes_i is not None else 0)
+        return kernels.groupby_scan_hbm_bytes(
+            n_shards, width_words, n_combos, len(stack_is), depth,
+            signed=plan[5], has_filter=tree is not None)
+    return sum(getattr(a, "nbytes", 0) for a in leaves)
+
+
 def timed_dispatch(plan, kern, leaves, params):
     """Run a plan's jitted program with flight/span attribution:
     recompiles are timed distinctly from cached dispatches, and the
@@ -1467,8 +1560,7 @@ def timed_dispatch(plan, kern, leaves, params):
         # degraded host re-execution) measures recovery, not memory
         # traffic; either would poison the achieved-bandwidth gauge.
         roofline.note(_ROOF_OPS.get(plan[0], plan[0]),
-                      sum(getattr(a, "nbytes", 0) for a in leaves),
-                      dt)
+                      _plan_hbm_bytes(plan, leaves, params), dt)
     return out
 
 
@@ -1527,6 +1619,17 @@ class PlanBuilder:
         return self._cached_leaf(
             ("planes", self.idx.name, field.name, field.bit_depth),
             lambda: self.engine.plane_stack(self.idx, field, self.skey))
+
+    def _groupcode_leaf(self, fields_rows) -> int:
+        """(S, CB+1, W) group-code stack leaf for a batched one-pass
+        GroupBy subplan ("gb_hist") — pageable like any other stack,
+        so under raw_pages() it rides the ragged page-table program."""
+        fkey = tuple((f.name, tuple(int(r) for r in rl))
+                     for f, rl in fields_rows)
+        return self._cached_leaf(
+            ("groupcodes", self.idx.name, fkey),
+            lambda: self.engine.groupcode_stack(self.idx, fields_rows,
+                                                self.skey))
 
     def _existence_leaf(self) -> int:
         if not self.idx.track_existence:
@@ -2185,6 +2288,87 @@ class StackedEngine:
         cnt, pos, neg = self._run(("bsi_sum", planes_i, tree, red), b)
         return self.bsi_sum_host(cnt, pos, neg, red)
 
+    # value-hist depth bounds: the dense signed-value space is
+    # 2^(depth+1) codes (sign rides as the top code bit) — the fused
+    # kernel's one-hot axis caps at _ONEPASS_KERNEL_MAX_CODES, the
+    # XLA/host histograms at _ONEPASS_MAX_CODES
+    _VALUEHIST_MAX_DEPTH = 19
+
+    def bsi_value_hist(self, idx, field, filter_call,
+                       shards: list[int], pre):
+        """Fused per-VALUE histogram over `field`'s BSI planes under
+        an optional filter tree — the Range/Distinct byproduct of the
+        single-pass GroupBy tile walk (kernels.bsi_value_hist): one
+        pass over the plane stack yields counts per signed value,
+        from which Distinct, Min/Max, and Range counts derive with no
+        per-column decode.  Returns (pos (2^depth,), neg (2^depth,))
+        int64; raises Unstackable past the dense-histogram depth
+        bound (callers keep the decode-stream fallback)."""
+        depth = field.bit_depth
+        if depth > self._VALUEHIST_MAX_DEPTH or depth < 1:
+            raise Unstackable("value histogram depth bound")
+        skey = tuple(shards)
+        if not skey:
+            z = np.zeros(1 << depth, np.int64)
+            return z, z.copy()
+        filt = None
+        if filter_call is not None:
+            filt = self.words(idx, filter_call, list(skey), pre)
+            if filt is None:            # statically-empty filter
+                z = np.zeros(1 << depth, np.int64)
+                return z, z.copy()
+        n_codes = 1 << (depth + 1)
+        multi = self._n_total_devices() > 1
+        op_bytes = 4 * len(skey) * (idx.width // 32) * (
+            (2 + depth) + (1 if filt is not None else 0))
+        if self._onepass_host(multi) or multi:
+            # host native/numpy arm (and the mesh fan-in: one pass
+            # either way, partials summed in host ints).  The
+            # code-plane layout mirrors kernels.bsi_value_hist — the
+            # single owner of the transform — sign plane as the top
+            # code bit, exists AND filter as validity.
+            from pilosa_tpu.storage import native_ingest as ni
+            planes = np.asarray(self.plane_stack_np(idx, field, skey))
+            t0 = time.perf_counter()
+            counts = np.zeros(n_codes, np.int64)
+            nn_d = np.zeros(n_codes, np.int64)
+            zd = np.zeros((n_codes, 0), np.int64)
+            ones = np.uint32(0xFFFFFFFF)
+            for si in range(planes.shape[0]):
+                cp = np.concatenate([planes[si, 2:], planes[si, 1:2]])
+                valid = planes[si, 0] & (
+                    np.asarray(filt)[si] if filt is not None else ones)
+                ni.groupcode_hist(cp, valid, None, n_codes, True,
+                                  counts, nn_d, zd, zd)
+            dt = time.perf_counter() - t0
+            flight.note_phase("execute", dt)
+            roofline.note("vhist", op_bytes, dt)
+        else:
+            arm = _onepass_arm(n_codes, 0)
+            key = ("vhist", arm, filt is not None, depth, n_codes)
+            fn = _gb_jit_get(key)
+            if fn is None:
+                def run(planes, filt):
+                    # the planes-to-code layout lives in ONE place —
+                    # kernels.bsi_value_hist; only the arm varies here
+                    pos, neg = kernels.bsi_value_hist(
+                        planes, filt, gb=_onepass_gb(arm))
+                    return jnp.concatenate([pos, neg])
+                fn = jax.jit(run)
+                _gb_jit_put(key, fn)
+            planes = self.plane_stack(idx, field, skey)
+            fd = jnp.asarray(filt) if filt is not None else None
+            kind = _dispatch_kind(key, [planes] + (
+                [fd] if fd is not None else []), ())
+            t0 = time.perf_counter()
+            counts = np.asarray(_block(fn(planes, fd)),
+                                dtype=np.int64)
+            dt = time.perf_counter() - t0
+            flight.note_phase(kind, dt)
+            if kind == "execute":
+                roofline.note("vhist", op_bytes, dt)
+        return counts[: 1 << depth], counts[1 << depth:]
+
     def row_counts(self, idx, rows_stack, filter_call, shards: list[int],
                    pre) -> np.ndarray:
         """(R,) exact intersection counts of candidate-row stacks
@@ -2389,6 +2573,20 @@ class StackedEngine:
             lane_words=lane_words, width_words=idx.width // 32,
             pageable=False)
 
+    def _onepass_host(self, multi: bool) -> bool:
+        """Whether the one-pass histogram runs on the host (native C /
+        numpy) instead of a device program.  A forced device arm
+        (PILOSA_TPU_GROUPBY_ONEPASS_ARM — bench A/B, interpret-mode
+        tests) overrides the CPU-backend host preference but never
+        host_only harnesses."""
+        import os
+        if self.host_only:
+            return True
+        if os.environ.get("PILOSA_TPU_GROUPBY_ONEPASS_ARM", "") in (
+                "fused", "onehot", "xla"):
+            return False
+        return not multi and jax.default_backend() != "tpu"
+
     def _groupby_onepass_ok(self, idx, fields_rows, n_combos: int,
                             depth: int, has_agg: bool,
                             skey: tuple) -> bool:
@@ -2404,8 +2602,7 @@ class StackedEngine:
             return False
         # device paths accumulate the histogram in int32 in-program;
         # the host path sums in int64 and has no shard bound
-        host = self.host_only or (self._n_total_devices() == 1
-                                  and jax.default_backend() != "tpu")
+        host = self._onepass_host(self._n_total_devices() > 1)
         if not host and len(skey) > _REDUCE_MAX_SHARDS:
             return False
         if not all(self._rows_disjoint(idx, f, rl, skey)
@@ -2427,12 +2624,17 @@ class StackedEngine:
 
     def _groupby_onepass_path(self, idx, fields_rows, agg_field, skey,
                               combos, depth: int, signed: bool,
-                              filter_call, pre):
+                              filter_call, pre, agg_op: str = "sum"):
         """Run the one-pass histogram and gather the requested combos
         out of the dense code space.  Returns the same (counts, agg)
-        shape as the per-combo paths — bit-exact partials included."""
-        from pilosa_tpu.obs.metrics import GROUPBY_ONEPASS
+        shape as the per-combo paths — bit-exact partials included.
+        ``agg_op`` "min"/"max" additionally pulls the per-group
+        magnitude Min/Max table out of the SAME tile walk (fused
+        kernel presence walks / XLA scatter / numpy twin) and returns
+        (counts, (nn, values)) instead of Sum partials."""
+        from pilosa_tpu.obs.metrics import GROUPBY_FUSED, GROUPBY_ONEPASS
         GROUPBY_ONEPASS.inc()
+        minmax = agg_op in ("min", "max")
         bits, shifts, n_codes = _code_space(fields_rows)
         combos_arr = np.asarray(combos, dtype=np.int64).reshape(
             len(combos), len(fields_rows))
@@ -2444,28 +2646,41 @@ class StackedEngine:
             tree0 = b0.build(filter_call)
             if tree0 == ("zeros",):
                 return _zero_groupby_result(len(combos), depth,
-                                            agg_field)
+                                            agg_field, agg_op)
             filt = self._run(("words", tree0), b0)
         multi = self._n_total_devices() > 1
-        host = self.host_only or (not multi
-                                  and jax.default_backend() != "tpu")
+        host = self._onepass_host(multi)
         # roofline attribution: the one-pass histogram dispatches its
         # own jitted/native programs (not timed_dispatch), so the
-        # bytes-touched x device-time join notes here per arm —
-        # operand = group-code stack + BSI planes + filter words.
-        # _dispatch_kind keeps first-dispatch compiles out of the
-        # bandwidth gauge, exactly like timed_dispatch.
+        # bytes-touched x device-time join notes here per arm.
+        # Bytes come from the single-pass traffic model (each tile
+        # crosses VMEM once — kernels.groupby_onepass_hbm_bytes), NOT
+        # from summing operand array sizes: the flat mesh placement
+        # pads shards and the old per-arg sum credited that padding
+        # (and any plane re-reads) as fresh traffic.  _dispatch_kind
+        # keeps first-dispatch compiles out of the bandwidth gauge,
+        # exactly like timed_dispatch.
+        op_bytes = kernels.groupby_onepass_hbm_bytes(
+            len(skey), idx.width // 32, sum(bits),
+            depth if has_planes else 0, filt is not None)
+        mm = None
         if host:
-            counts, nn, pos, neg = self._groupby_onepass_host(
+            out = self._groupby_onepass_host(
                 idx, fields_rows, agg_field, skey, n_codes, depth,
-                signed, filt)
-        elif multi:
+                signed, filt, minmax=minmax, op_bytes=op_bytes)
+            counts, nn, pos, neg = out[:4]
+            if minmax:
+                mm = out[4]
+        elif multi and not minmax:
+            arm = _onepass_arm(n_codes, depth)
+            if arm == "fused":
+                GROUPBY_FUSED.inc(path="onepass_mesh")
             cg = self.groupcode_stack(idx, fields_rows, skey,
                                       flat=True)
             planes = (self.plane_stack_flat(idx, agg_field, skey)
                       if has_planes else None)
             fn = _groupby_onepass_shard_map(
-                self.mesh, _onepass_use_kernel(n_codes, depth),
+                self.mesh, arm,
                 has_planes, filt is not None, signed, n_codes)
             args = [cg]
             if filt is not None:
@@ -2478,7 +2693,7 @@ class StackedEngine:
                 args.append(f_np)
             if has_planes:
                 args.append(planes)
-            sig = ("onepass_mesh", has_planes, filt is not None,
+            sig = ("onepass_mesh", arm, has_planes, filt is not None,
                    signed, n_codes)
             kind = _dispatch_kind(sig, args, ())
             t0 = time.perf_counter()
@@ -2486,20 +2701,30 @@ class StackedEngine:
             dt = time.perf_counter() - t0
             flight.note_phase(kind, dt)
             if kind == "execute":
-                roofline.note(
-                    "groupby",
-                    sum(getattr(a, "nbytes", 0) for a in args), dt)
+                roofline.note("groupby", op_bytes, dt)
             counts, nn, pos, neg = _onepass_unpack(
                 out, n_codes, depth, has_planes)
         else:
+            # single device — or a mesh Min/Max, which needs max/min
+            # combination and so runs the single-jit program over the
+            # whole (mesh-sharded) stack (Min/Max traffic is the same
+            # single pass; fleets beyond the reduce bound were gated)
+            arm = _onepass_arm(n_codes, depth, minmax=minmax)
+            if multi and arm == "fused":
+                # a pallas_call over a mesh-sharded operand would
+                # force a gather; the scatter reference shards under
+                # GSPMD — keep the rare mesh Min/Max on it
+                arm = "xla"
+            if arm == "fused":
+                GROUPBY_FUSED.inc(path="onepass")
             cg = self.groupcode_stack(idx, fields_rows, skey)
             planes = (self.plane_stack(idx, agg_field, skey)
                       if has_planes else None)
             fn = _groupby_onepass_jit(
-                _onepass_use_kernel(n_codes, depth), has_planes,
-                filt is not None, signed, n_codes)
-            sig = ("onepass", has_planes, filt is not None, signed,
-                   n_codes)
+                arm, has_planes,
+                filt is not None, signed, n_codes, minmax=minmax)
+            sig = ("onepass", arm, has_planes, filt is not None,
+                   signed, n_codes, minmax)
             args = [a for a in (cg, filt, planes) if a is not None]
             kind = _dispatch_kind(sig, args, ())
             t0 = time.perf_counter()
@@ -2507,22 +2732,28 @@ class StackedEngine:
             dt = time.perf_counter() - t0
             flight.note_phase(kind, dt)
             if kind == "execute":
-                roofline.note(
-                    "groupby",
-                    sum(getattr(a, "nbytes", 0) for a in args), dt)
-            counts, nn, pos, neg = _onepass_unpack(
-                out, n_codes, depth, has_planes)
+                roofline.note("groupby", op_bytes, dt)
+            out = _onepass_unpack(out, n_codes, depth, has_planes,
+                                  minmax=minmax)
+            counts, nn, pos, neg = out[:4]
+            if minmax:
+                mm = out[4]
         sel_counts = counts[codes]
         if not has_planes:
             return sel_counts, None
+        if minmax:
+            vals, _has = kernels.minmax_from_table(mm, depth, agg_op)
+            return sel_counts, (nn[codes], vals[codes])
         return sel_counts, (nn[codes], pos[codes], neg[codes])
 
     def _groupby_onepass_host(self, idx, fields_rows, agg_field, skey,
                               n_codes: int, depth: int, signed: bool,
-                              filt):
+                              filt, minmax: bool = False,
+                              op_bytes: int | None = None):
         """Host histogram: the native C kernel (numpy bincount without
         a toolchain) per shard, shards fanned over a thread pool (the
-        ctypes call releases the GIL)."""
+        ctypes call releases the GIL).  ``minmax`` adds the numpy
+        Min/Max magnitude-table twin to the same per-shard walk."""
         import os
 
         from pilosa_tpu.storage import native_ingest as ni
@@ -2534,11 +2765,13 @@ class StackedEngine:
                   if agg_field is not None else None)
         filt_np = (np.asarray(filt)[:len(skey)]
                    if filt is not None else None)
-        # roofline: the native hist streams these operands once; no
-        # compile arm to exclude — the C kernel always "executes"
-        op_bytes = (cg.nbytes
-                    + (planes.nbytes if planes is not None else 0)
-                    + (filt_np.nbytes if filt_np is not None else 0))
+        if op_bytes is None:
+            # the native hist streams these operands once — the same
+            # single-pass traffic model as the device arms
+            op_bytes = (cg.nbytes
+                        + (planes.nbytes if planes is not None else 0)
+                        + (filt_np.nbytes if filt_np is not None else 0))
+        big = 1 << depth
         t0 = time.perf_counter()
 
         def one(_pool, si):
@@ -2553,7 +2786,16 @@ class StackedEngine:
                 cg[si, :-1], valid,
                 planes[si] if planes is not None else None,
                 n_codes, signed, c, n_, p_, g_)
-            return c, n_, p_, g_
+            mm = None
+            if minmax:
+                mm = np.stack([
+                    np.full(n_codes, -1, np.int64),
+                    np.full(n_codes, big, np.int64),
+                    np.full(n_codes, -1, np.int64),
+                    np.full(n_codes, big, np.int64)])
+                ni.groupcode_minmax(cg[si, :-1], valid, planes[si],
+                                    n_codes, signed, mm)
+            return c, n_, p_, g_, mm
 
         size = max(1, min(8, os.cpu_count() or 1, cg.shape[0]))
         parts = Pool(size=size).map(one, range(cg.shape[0]))
@@ -2563,8 +2805,17 @@ class StackedEngine:
         counts = sum(p[0] for p in parts)
         if agg_field is None:
             return counts, None, None, None
-        return (counts, sum(p[1] for p in parts),
-                sum(p[2] for p in parts), sum(p[3] for p in parts))
+        out = (counts, sum(p[1] for p in parts),
+               sum(p[2] for p in parts), sum(p[3] for p in parts))
+        if not minmax:
+            return out
+        mm = parts[0][4]
+        for p in parts[1:]:
+            mm = np.stack([np.maximum(mm[0], p[4][0]),
+                           np.minimum(mm[1], p[4][1]),
+                           np.maximum(mm[2], p[4][2]),
+                           np.minimum(mm[3], p[4][3])])
+        return out + (mm,)
 
     # fused GroupBy kernel (ops/kernels.groupby_sum): default on a
     # single real TPU device — measured 4x faster than the XLA scan
@@ -2602,6 +2853,13 @@ class StackedEngine:
         from pilosa_tpu.obs.metrics import GROUPBY_KERNEL
         GROUPBY_KERNEL.inc()
         multi = self._n_total_devices() > 1
+        # roofline: the per-combo kernel's schedule reads each
+        # referenced stack row once PER REFERENCING COMBO and the
+        # plane block once total — its own traffic model, distinct
+        # from both the one-pass walk and the XLA scan (ISSUE 11)
+        op_bytes = kernels.groupby_percombo_hbm_bytes(
+            len(skey), idx.width // 32, len(combos),
+            len(fields_rows), depth if agg_field is not None else 0)
         if multi:
             stacks = [self.rows_stack_flat(idx, f, (VIEW_STANDARD,),
                                            rl, skey)
@@ -2612,10 +2870,20 @@ class StackedEngine:
                 self.mesh, len(stacks), planes is not None, signed)
             sel = np.asarray(combos, dtype=np.int32).reshape(
                 len(combos), len(fields_rows))
+            sig = ("gbkernel_mesh", len(stacks), planes is not None,
+                   signed)
+            kind = _dispatch_kind(
+                sig, stacks + ([planes] if planes is not None else []),
+                (sel,))
+            t0 = time.perf_counter()
             if planes is None:
-                out = fn(tuple(stacks), sel)
+                out = _block(fn(tuple(stacks), sel))
             else:
-                out = fn(tuple(stacks), sel, planes)
+                out = _block(fn(tuple(stacks), sel, planes))
+            dt = time.perf_counter() - t0
+            flight.note_phase(kind, dt)
+            if kind == "execute":
+                roofline.note("groupby", op_bytes, dt)
             return self._groupby_kernel_unpack(out, len(combos),
                                                depth, agg_field)
         # single device: shard-chunked (int64 host accumulation past
@@ -2630,6 +2898,10 @@ class StackedEngine:
                np.zeros((k, depth), dtype=np.int64),
                np.zeros((k, depth), dtype=np.int64)) \
             if agg_field is not None else None
+        # dispatch timing spans the whole chunk sweep; a compile on
+        # ANY chunk keeps the sweep out of the bandwidth gauge
+        dispatch_s = 0.0
+        compiled_any = False
         for slo in range(0, len(skey), _REDUCE_MAX_SHARDS):
             sc = skey[slo:slo + _REDUCE_MAX_SHARDS]
             stacks = [self.rows_stack_for(idx, f, (VIEW_STANDARD,),
@@ -2646,7 +2918,15 @@ class StackedEngine:
                 sel = np.asarray(
                     combos[clo:clo + ckn], dtype=np.int32).reshape(
                     -1, len(fields_rows))
-                out = fn(tuple(stacks), sel, planes)
+                sig = ("gbkernel", len(fields_rows),
+                       agg_field is not None, signed)
+                args = list(stacks) + (
+                    [planes] if planes is not None else [])
+                if _dispatch_kind(sig, args, (sel,)) == "compile":
+                    compiled_any = True
+                t0 = time.perf_counter()
+                out = _block(fn(tuple(stacks), sel, planes))
+                dispatch_s += time.perf_counter() - t0
                 kc = sel.shape[0]
                 c, a = self._groupby_kernel_unpack(out, kc, depth,
                                                    agg_field)
@@ -2655,6 +2935,10 @@ class StackedEngine:
                     agg[0][clo:clo + kc] += a[0]
                     agg[1][clo:clo + kc] += a[1]
                     agg[2][clo:clo + kc] += a[2]
+        flight.note_phase("compile" if compiled_any else "execute",
+                          dispatch_s)
+        if not compiled_any:
+            roofline.note("groupby", op_bytes, dispatch_s)
         return counts, agg
 
     @staticmethod
@@ -2669,7 +2953,7 @@ class StackedEngine:
 
     def groupby(self, idx, fields_rows, filter_call, agg_field,
                 shards: list[int], pre, combos,
-                combo_chunk: int = 8):
+                combo_chunk: int = 8, agg_op: str = "sum"):
         """GroupBy on the stacked engine: the given combos (index
         tuples into each field's row list — the caller enumerates and
         pages them) evaluated as chunked device programs over gathered
@@ -2679,8 +2963,12 @@ class StackedEngine:
 
         fields_rows: [(field, row_ids), ...].  Returns (counts (C,)
         int64, None | (nn (C,), pos (C, P), neg (C, P)) int64 arrays)
-        aligned with `combos`.
-        """
+        aligned with `combos`.  ``agg_op`` "min"/"max" (per-group BSI
+        Min/Max — served ONLY by the one-pass fused tile walk, whose
+        presence-mask Min/Max table falls out of the same single
+        pass) returns (counts, (nn (C,), values (C,))) instead;
+        shapes the one-pass gate refuses raise Unstackable so the
+        caller's host loop keeps full generality."""
         skey = tuple(shards)
         n_combos = len(combos)
         depth = agg_field.bit_depth if agg_field is not None else 0
@@ -2696,6 +2984,19 @@ class StackedEngine:
                                 list(skey))
             signed = any(fr is not None and 1 in fr.row_ids
                          for fr in frags)
+        # Min/Max aggregates only exist on the one-pass fused walk
+        # (the per-combo kernels and XLA scan have no Min/Max table);
+        # anything the gate refuses goes back to the caller's loop
+        if agg_op in ("min", "max"):
+            if (not n_combos
+                    or not self._groupby_onepass_ok(
+                        idx, fields_rows, n_combos, depth, True, skey)
+                    or depth > _ONEPASS_KERNEL_MAX_DEPTH):
+                raise Unstackable("groupby min/max needs the one-pass "
+                                  "histogram gate")
+            return self._groupby_onepass_path(
+                idx, fields_rows, agg_field, skey, combos, depth,
+                signed, filter_call, pre, agg_op=agg_op)
         # one-pass group-code histogram: combo-count-independent
         # traffic, no (R, S, W) gather at all (the group-code stack is
         # (S, CB+1, W) with CB ~ log2 of the combo space)

@@ -358,6 +358,10 @@ GROUPBY_KERNEL = registry.counter(
 GROUPBY_ONEPASS = registry.counter(
     "pilosa_groupby_onepass_total",
     "GroupBy queries served by the one-pass group-code histogram")
+GROUPBY_FUSED = registry.counter(
+    "pilosa_groupby_fused_total",
+    "One-pass GroupBy dispatches served by the fused int8 MXU "
+    "single-pass kernel, by path (onepass/onepass_mesh/batched)")
 
 # -- tile-stack maintenance (executor/stacked.py TileStackCache) --
 # Outcomes: hit (fresh entry), miss (any non-hit), patch (stale entry

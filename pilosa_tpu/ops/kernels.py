@@ -525,7 +525,7 @@ def _gc_payload_rows(va, ex, sg, mag_bits, depth: int, signed: bool):
 
 
 def groupby_codes_xla(code_planes, valid, planes=None, n_codes: int = 1,
-                      signed: bool = True):
+                      signed: bool = True, minmax: bool = False):
     """XLA reference for the one-pass GroupBy histogram.
 
     code_planes: (S, CB, W) uint32 packed group-code bit-planes
@@ -535,9 +535,15 @@ def groupby_codes_xla(code_planes, valid, planes=None, n_codes: int = 1,
     BSI stack or None.  Returns (counts (G,), nn (G,), pos (G, depth),
     neg (G, depth)) int32 over the FULL dense code space G = n_codes —
     every input word is read exactly once, independent of combo count.
+    With ``minmax=True`` (requires planes) additionally returns the
+    (4, G) [max_mag_pos, min_mag_pos, max_mag_neg, min_mag_neg] table
+    via scatter-max/min — the oracle for groupby_fused's presence-walk
+    Min/Max (identities -1 / 1<<depth; see minmax_from_table).
     """
     depth = 0 if planes is None else planes.shape[1] - 2
+    assert not (minmax and depth == 0), "minmax requires BSI planes"
     k = 1 if depth == 0 else 2 + (2 if signed else 1) * depth
+    big = 1 << depth
 
     def one_shard(acc, args):
         cp, va_w = args[0], args[1]
@@ -555,18 +561,51 @@ def groupby_codes_xla(code_planes, valid, planes=None, n_codes: int = 1,
         rows = _gc_payload_rows(va, ex, sg, mag, depth, signed)
         outs = [jnp.zeros(n_codes + 1, jnp.int32).at[seg].add(r)
                 for r in rows]
-        return acc + jnp.stack(outs)[:, :n_codes], None
+        hist_acc = acc[0] if minmax else acc
+        hist_acc = hist_acc + jnp.stack(outs)[:, :n_codes]
+        if not minmax:
+            return hist_acc, None
+        mag_val = jnp.zeros_like(code)
+        for p in range(depth):
+            mag_val = mag_val | (mag[p] << p)
+        posm = ex * (1 - sg) if signed else ex
+        negm = ex * sg if signed else jnp.zeros_like(ex)
+
+        def side(mask):
+            sm = jnp.where(mask == 1, seg, n_codes)
+            mx = jnp.full(n_codes + 1, -1, jnp.int32
+                          ).at[sm].max(mag_val)[:n_codes]
+            mn = jnp.full(n_codes + 1, big, jnp.int32
+                          ).at[sm].min(mag_val)[:n_codes]
+            return mx, mn
+
+        mxp, mnp_ = side(posm)
+        mxn, mnn = side(negm)
+        mm = jnp.stack([jnp.maximum(acc[1][0], mxp),
+                        jnp.minimum(acc[1][1], mnp_),
+                        jnp.maximum(acc[1][2], mxn),
+                        jnp.minimum(acc[1][3], mnn)])
+        return (hist_acc, mm), None
 
     init = jnp.zeros((k, n_codes), jnp.int32)
+    if minmax:
+        mm0 = jnp.stack([jnp.full(n_codes, -1, jnp.int32),
+                         jnp.full(n_codes, big, jnp.int32),
+                         jnp.full(n_codes, -1, jnp.int32),
+                         jnp.full(n_codes, big, jnp.int32)])
+        init = (init, mm0)
     args = (code_planes, valid) + ((planes,) if depth else ())
     acc, _ = jax.lax.scan(one_shard, init, args)
+    acc, mm = acc if minmax else (acc, None)
     counts = acc[0]
     if depth == 0:
         return counts, None, None, None
     nn = acc[1]
     pos = acc[2:2 + depth].T                          # (G, depth)
     neg = acc[2 + depth:].T if signed else jnp.zeros_like(pos)
-    return counts, nn, pos, neg
+    if not minmax:
+        return counts, nn, pos, neg
+    return counts, nn, pos, neg, mm
 
 
 def _gc_onehot_kernel(cb: int, depth: int, signed: bool, k: int,
@@ -616,7 +655,10 @@ def _gc_onehot_kernel(cb: int, depth: int, signed: bool, k: int,
 
 def groupby_onehot(code_planes, valid, planes=None, n_codes: int = 1,
                    signed: bool = True):
-    """One-pass GroupBy histogram with MXU accumulation.
+    """One-pass GroupBy histogram with f32 MXU accumulation (the
+    first-generation one-pass kernel; superseded by the int8
+    :func:`groupby_fused` path but kept as a measured alternative and
+    A/B arm).
 
     Same contract as :func:`groupby_codes_xla` (bit-exact against it
     and against groupby_sum over the same data — tests cross-check all
@@ -668,6 +710,362 @@ def groupby_onehot(code_planes, valid, planes=None, n_codes: int = 1,
     return counts, nn, pos, neg
 
 
+# ---------------------------------------------------------------------------
+# fused single-pass GroupBy: int8 MXU popcount-accumulate
+# ---------------------------------------------------------------------------
+#
+# Second-generation one-pass kernel (ISSUE 11).  groupby_onehot above
+# unrolls the 32 bit positions of each word block and pays one f32
+# (K, BW) @ (BW, G) matmul PER BIT — 32 MXU launches per tile, with
+# f32 one-hot operands 4x the bytes they need.  groupby_fused flattens
+# bit-position chunks into the contraction axis and accumulates the
+# whole (K, G) histogram with int8 @ int8 -> int32 MXU dots — a
+# popcount computed by the matrix unit (the dot of two 0/1 int8
+# vectors IS popcount(a & b)), 4x the MXU throughput of the f32 path
+# and a handful of launches per tile instead of 32.  Each (lanes,
+# words) stack tile crosses VMEM exactly once and simultaneously
+# yields:
+#
+#   - the group-code histogram (counts),
+#   - validity counts (nn) and per-group BSI Sum sign-split plane
+#     partials (pos/neg) — identical layout to groupby_codes_xla,
+#   - optionally per-group Min/Max, via per-group plane-PRESENCE
+#     masks: an MSB->LSB candidate walk where "does any candidate in
+#     group g have magnitude bit p" is one int8 mat-vec against the
+#     same one-hot, and the per-column candidate narrowing gathers the
+#     presence bit back through the transposed one-hot,
+#   - and (as a byproduct of the same tile walk) fused Range/Distinct
+#     over BSI planes: bsi_value_hist() below runs THIS kernel with
+#     the magnitude+sign planes as the code planes, so the dense
+#     per-value histogram — distinct values, min/max, and arbitrary
+#     range counts — falls out of one single-pass walk.
+#
+# Exactness: per-chunk partial sums are <= bc*BW*32 < 2^24 terms of
+# {0, 1} products accumulated in int32 — exact; cross-tile
+# accumulation is int32 (callers bound shards like the other paths).
+
+
+def _gb_fused_kernel(cb: int, depth: int, signed: bool, k: int,
+                     g_pad: int, bw: int, bc: int, minmax: bool):
+    """Kernel body factory.  Per (shard, word-block) grid step the 32
+    bit positions are processed in chunks of `bc`; each chunk is one
+    flattened (bc*bw,) column axis shared by the int8 payload matmul
+    and (when requested) the Min/Max presence walks."""
+
+    def kernel(cp_ref, va_ref, *refs):
+        pl_ref = refs[0] if depth else None
+        i = 1 if depth else 0
+        out_ref = refs[i]
+        mm_ref = refs[i + 1] if minmax else None
+        s, wi = pl.program_id(0), pl.program_id(1)
+
+        @pl.when((s == 0) & (wi == 0))
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+            if minmax:
+                big = jnp.int32(1 << depth)
+                ident = jnp.stack([
+                    jnp.full((g_pad,), -1, jnp.int32),
+                    jnp.full((g_pad,), big, jnp.int32),
+                    jnp.full((g_pad,), -1, jnp.int32),
+                    jnp.full((g_pad,), big, jnp.int32)])
+                mm_ref[...] = ident
+
+        iota_g = jax.lax.broadcasted_iota(jnp.int32, (1, g_pad), 1)
+        acc = jnp.zeros((k, g_pad), jnp.int32)
+        big = 1 << depth
+        mxp = jnp.full((g_pad,), -1, jnp.int32)
+        mnp_ = jnp.full((g_pad,), big, jnp.int32)
+        mxn = jnp.full((g_pad,), -1, jnp.int32)
+        mnn = jnp.full((g_pad,), big, jnp.int32)
+        for c in range(0, 32, bc):
+            sh = (jax.lax.broadcasted_iota(jnp.uint32, (bc, 1), 0)
+                  + jnp.uint32(c))
+
+            def bits(w, sh=sh):
+                # (bw,) uint32 -> (bc*bw,) 0/1 int32 — positions
+                # [c, c+bc) of every word, flattened bit-major
+                return ((w[None, :] >> sh)
+                        & jnp.uint32(1)).astype(jnp.int32).reshape(-1)
+
+            va = bits(va_ref[0])
+            code = jnp.zeros_like(va)
+            for b in range(cb):
+                code = code | (bits(cp_ref[0, b]) << b)
+            ex = sg = None
+            mag = []
+            if depth:
+                ex = bits(pl_ref[0, 0]) * va
+                sg = bits(pl_ref[0, 1])
+                mag = [bits(pl_ref[0, 2 + p]) for p in range(depth)]
+            rows = _gc_payload_rows(va, ex, sg, mag, depth, signed)
+            payload = jnp.stack(rows).astype(jnp.int8)   # (K, bc*bw)
+            # invalid columns carry all-zero payload (every row has a
+            # `va` factor), so their arbitrary code contributes 0
+            onehot = (code[:, None] == iota_g).astype(jnp.int8)
+            acc += jnp.dot(payload, onehot,
+                           preferred_element_type=jnp.int32)
+            if minmax:
+                posm = ex * (1 - sg) if signed else ex
+                negm = ex * sg if signed else None
+
+                def gdot(col_vec):
+                    # per-group popcount of a 0/1 column mask: one
+                    # int8 mat-vec against the shared one-hot
+                    return jnp.dot(
+                        col_vec.astype(jnp.int8).reshape(1, -1),
+                        onehot,
+                        preferred_element_type=jnp.int32)[0]
+
+                def cdot(g_vec):
+                    # presence bit gathered back per column through
+                    # the transposed one-hot
+                    return jnp.dot(
+                        onehot, g_vec.astype(jnp.int8).reshape(-1, 1),
+                        preferred_element_type=jnp.int32)[:, 0]
+
+                def walk_max(candm):
+                    alive = gdot(candm)
+                    out = jnp.zeros((g_pad,), jnp.int32)
+                    cand = candm
+                    for p in range(depth - 1, -1, -1):
+                        pres = (gdot(cand * mag[p]) > 0)
+                        out = out | (pres.astype(jnp.int32) << p)
+                        pres_c = cdot(pres.astype(jnp.int32)) > 0
+                        cand = cand * jnp.where(pres_c, mag[p], 1)
+                    return jnp.where(alive > 0, out, -1)
+
+                def walk_min(candm):
+                    alive = gdot(candm)
+                    out = jnp.zeros((g_pad,), jnp.int32)
+                    cand = candm
+                    for p in range(depth - 1, -1, -1):
+                        cnt_all = gdot(cand)
+                        cnt_with = gdot(cand * mag[p])
+                        zpres = (cnt_all - cnt_with) > 0
+                        forced1 = jnp.logical_and(
+                            jnp.logical_not(zpres), cnt_all > 0)
+                        out = out | (forced1.astype(jnp.int32) << p)
+                        zp_c = cdot(zpres.astype(jnp.int32)) > 0
+                        cand = cand * jnp.where(zp_c, 1 - mag[p], 1)
+                    return jnp.where(alive > 0, out, big)
+
+                mxp = jnp.maximum(mxp, walk_max(posm))
+                mnp_ = jnp.minimum(mnp_, walk_min(posm))
+                if signed:
+                    mxn = jnp.maximum(mxn, walk_max(negm))
+                    mnn = jnp.minimum(mnn, walk_min(negm))
+        out_ref[...] += acc
+        if minmax:
+            cur = mm_ref[...]
+            mm_ref[...] = jnp.stack([
+                jnp.maximum(cur[0], mxp), jnp.minimum(cur[1], mnp_),
+                jnp.maximum(cur[2], mxn), jnp.minimum(cur[3], mnn)])
+    return kernel
+
+
+def groupby_fused(code_planes, valid, planes=None, n_codes: int = 1,
+                  signed: bool = True, minmax: bool = False):
+    """Fused single-pass GroupBy histogram — int8 MXU
+    popcount-accumulate (the ISSUE 11 tentpole kernel).
+
+    Same contract as :func:`groupby_codes_xla` (bit-exact against it,
+    against groupby_onehot, and against the host twins — the property
+    suite cross-checks all of them).  Returns (counts, nn, pos, neg)
+    and, with ``minmax=True`` (requires planes), additionally a
+    (4, G) int32 table [max_mag_pos, min_mag_pos, max_mag_neg,
+    min_mag_neg] with identities (-1 / 1<<depth) marking empty sides —
+    combine with :func:`minmax_from_table`.
+
+    Schedule: grid (S, W/BW) with NO combo axis — every code plane,
+    valid word, and BSI plane word streams through VMEM exactly once
+    and the (K, G) table (+ (4, G) Min/Max table) stays VMEM-resident
+    for the whole walk.  The combo dimension exists only inside a grid
+    step as the one-hot axis of int8 matmuls the MXU does for free
+    next to the bandwidth-bound stream.
+    """
+    s_dim, cb, w_dim = code_planes.shape
+    if cb == 0:                        # all fields single-row: code 0
+        code_planes = jnp.zeros((s_dim, 1, w_dim), dtype=jnp.uint32)
+        cb = 1
+    depth = 0 if planes is None else planes.shape[1] - 2
+    assert not (minmax and depth == 0), "minmax requires BSI planes"
+    k = 1 if depth == 0 else 2 + (2 if signed else 1) * depth
+    g_pad = max(-(-int(n_codes) // 128) * 128, 128)
+    # word block + bit-chunk sized so the per-chunk int8 one-hot
+    # (bc*bw, G) stays ~2 MB; bc divides 32 so chunks tile the word
+    bw = max(128, min(2048, w_dim))
+    bc = max(1, min(32, (1 << 21) // (bw * g_pad)))
+    while 32 % bc:
+        bc -= 1
+    code_planes = _pad_axis(code_planes, 2, bw)
+    valid = _pad_axis(valid, 1, bw)
+    arrays = [code_planes, valid]
+    in_specs = [
+        pl.BlockSpec((1, cb, bw), lambda s, w: (s, 0, w)),
+        pl.BlockSpec((1, bw), lambda s, w: (s, w)),
+    ]
+    if depth:
+        planes = _pad_axis(planes, 2, bw)
+        arrays.append(planes)
+        in_specs.append(
+            pl.BlockSpec((1, 2 + depth, bw), lambda s, w: (s, 0, w)))
+    wpad = code_planes.shape[2]
+    fixed = lambda s, w: (0, 0)
+    out_specs = [pl.BlockSpec((k, g_pad), fixed)]
+    out_shape = [jax.ShapeDtypeStruct((k, g_pad), jnp.int32)]
+    if minmax:
+        out_specs.append(pl.BlockSpec((4, g_pad), fixed))
+        out_shape.append(jax.ShapeDtypeStruct((4, g_pad), jnp.int32))
+    out = pl.pallas_call(
+        _gb_fused_kernel(cb, depth, signed, k, g_pad, bw, bc, minmax),
+        grid=(s_dim, wpad // bw),
+        in_specs=in_specs,
+        out_specs=out_specs if minmax else out_specs[0],
+        out_shape=out_shape if minmax else out_shape[0],
+        interpret=_interpret(),
+    )(*arrays)
+    hist = out[0] if minmax else out
+    counts = hist[0, :n_codes]
+    if depth == 0:
+        return counts, None, None, None
+    nn = hist[1, :n_codes]
+    pos = hist[2:2 + depth, :n_codes].T                # (G, depth)
+    neg = (hist[2 + depth:, :n_codes].T if signed
+           else jnp.zeros_like(pos))
+    if not minmax:
+        return counts, nn, pos, neg
+    return counts, nn, pos, neg, out[1][:, :n_codes]
+
+
+def minmax_from_table(mm, depth: int, op: str):
+    """Host combiner for the (4, G) Min/Max magnitude table (fused
+    kernel or XLA reference): per group, ``max = max_mag_pos`` when
+    any non-negative member exists else ``-min_mag_neg``; ``min =
+    -max_mag_neg`` when any negative member exists else
+    ``min_mag_pos``.  Returns (values (G,) int64, has (G,) bool)."""
+    mm = np.asarray(mm, dtype=np.int64)
+    big = 1 << depth
+    mxp, mnp_, mxn, mnn = mm[0], mm[1], mm[2], mm[3]
+    if op == "max":
+        vals = np.where(mxp >= 0, mxp, -mnn)
+        has = (mxp >= 0) | (mnn < big)
+    else:
+        vals = np.where(mxn >= 0, -mxn, mnp_)
+        has = (mxn >= 0) | (mnp_ < big)
+    return vals, has
+
+
+def bsi_value_hist(planes, filter_words=None, signed: bool = True,
+                   use_kernel: bool = True, gb=None):
+    """Fused per-VALUE histogram over a BSI plane stack — the
+    Range/Distinct byproduct of the single-pass tile walk.
+
+    planes: (S, 2+depth, W) uint32, filter_words: (S, W) or None.
+    Treats the magnitude planes plus the SIGN plane as a group code
+    (sign is the top code bit), so one run of the fused GroupBy kernel
+    yields counts per signed value: returns (pos (2^depth,) int32,
+    neg (2^depth,) int32) — pos[v] = columns with value +v, neg[v] =
+    columns with value -v.  Derive Distinct (codes with count > 0),
+    Min/Max (extreme nonzero codes), and Range counts
+    (:func:`range_count_from_hist`) without decoding a single column.
+
+    This function is the ONE owner of the planes-to-code layout
+    (sign plane as the top code bit, exists AND filter as validity);
+    `gb` overrides the histogram arm (any groupby_* callable) so the
+    executor's arm selection reuses the same transform.  The host
+    twin (executor/stacked.py's native arm) mirrors this layout —
+    keep them in lockstep.
+    """
+    depth = planes.shape[1] - 2
+    ex = planes[:, 0]
+    valid = (ex if filter_words is None
+             else jnp.bitwise_and(ex, filter_words))
+    cp = jnp.concatenate(
+        [planes[:, 2:], planes[:, 1:2]], axis=1)     # (S, depth+1, W)
+    n_codes = 1 << (depth + 1)
+    if gb is None:
+        gb = groupby_fused if use_kernel else groupby_codes_xla
+    counts, _, _, _ = gb(cp, valid, None, n_codes, signed)
+    return counts[: 1 << depth], counts[1 << depth:]
+
+
+def range_count_from_hist(pos, neg, lo: int, hi: int) -> int:
+    """Columns whose value lies in [lo, hi] — exact, from the fused
+    value histogram (pos/neg magnitude counts)."""
+    pos = np.asarray(pos, dtype=np.int64)
+    neg = np.asarray(neg, dtype=np.int64)
+    total = 0
+    if hi >= 0:
+        total += int(pos[max(lo, 0):hi + 1].sum())
+    if lo < 0:
+        nlo, nhi = max(-hi, 1), -lo           # magnitudes of negatives
+        if nlo <= nhi:
+            total += int(neg[nlo:nhi + 1].sum())
+    return total
+
+
+def distinct_from_hist(pos, neg) -> list[int]:
+    """Sorted distinct signed values present in the fused value
+    histogram.  A -0 cannot occur (the encoder signs only v < 0)."""
+    pos = np.asarray(pos)
+    neg = np.asarray(neg)
+    vals = [-int(v) for v in np.nonzero(neg)[0][::-1] if v > 0]
+    vals += [int(v) for v in np.nonzero(pos)[0]]
+    return vals
+
+
+# ---------------------------------------------------------------------------
+# HBM traffic models — the roofline plane's bytes-touched source
+# ---------------------------------------------------------------------------
+#
+# pilosa_device_bandwidth_fraction{op=groupby} is only honest if each
+# dispatch notes the bytes ITS schedule actually streams: the fused
+# single-pass kernel reads every tile once, while the per-combo arms
+# re-read stack rows per referencing combo and the XLA scan
+# re-materializes gathered combo masks per payload pass.  Crediting
+# the one-pass path with the per-combo arms' re-read traffic (or vice
+# versa) would inflate (deflate) the fraction.  These models are the
+# single source the executor arms note from (ISSUE 11 satellite).
+
+
+def groupby_onepass_hbm_bytes(n_shards: int, width_words: int,
+                              code_bits: int, depth: int = 0,
+                              has_filter: bool = False) -> int:
+    """Single-pass tile walk: (code planes + valid plane) + BSI stack
+    + filter words each cross VMEM exactly once — independent of combo
+    count, and counted WITHOUT mesh padding rows."""
+    per_shard = (code_bits + 1) + ((2 + depth) if depth else 0) \
+        + (1 if has_filter else 0)
+    return 4 * n_shards * width_words * per_shard
+
+
+def groupby_percombo_hbm_bytes(n_shards: int, width_words: int,
+                               n_combos: int, nf: int,
+                               depth: int = 0) -> int:
+    """groupby_sum kernel schedule: each referenced stack row is read
+    once per referencing combo (combos innermost in the grid), the
+    plane block once per (shard, word) tile — i.e. ONCE total."""
+    return 4 * n_shards * width_words * (
+        n_combos * nf + ((2 + depth) if depth else 0))
+
+
+def groupby_scan_hbm_bytes(n_shards: int, width_words: int,
+                           n_combos: int, nf: int, depth: int = 0,
+                           signed: bool = True,
+                           has_filter: bool = False) -> int:
+    """XLA per-combo scan traffic: gathered (C, S, W) combo masks
+    materialize and are re-read once per payload pass (exists mask +
+    one sign-split mask read per magnitude plane) — the multi-pass
+    traffic the one-pass kernels exist to remove."""
+    w = 4 * n_shards * width_words
+    b = n_combos * nf * w + (w if has_filter else 0)
+    if depth:
+        b += (2 + depth) * w
+        b += n_combos * w * (1 + (2 if signed else 1) * depth)
+    return b
+
+
 def fused_query_counts(a, b, filt, rows):
     """Per-shard Count(Intersect) + TopK candidate counts.
 
@@ -688,5 +1086,13 @@ __all__ = [
     "groupby_sum",
     "groupby_codes_xla",
     "groupby_onehot",
+    "groupby_fused",
+    "minmax_from_table",
+    "bsi_value_hist",
+    "range_count_from_hist",
+    "distinct_from_hist",
+    "groupby_onepass_hbm_bytes",
+    "groupby_percombo_hbm_bytes",
+    "groupby_scan_hbm_bytes",
     "fused_query_counts",
 ]

@@ -150,6 +150,36 @@ def groupcode_hist(code_planes: np.ndarray, valid: np.ndarray,
                                      minlength=n_codes)[:n_codes]
 
 
+def groupcode_minmax(code_planes: np.ndarray, valid: np.ndarray,
+                     bsi: np.ndarray, n_codes: int, signed: bool,
+                     mm: np.ndarray) -> None:
+    """One shard of the per-group Min/Max magnitude table: accumulate
+    mm (4, n_codes) int64 rows [max_mag_pos, min_mag_pos, max_mag_neg,
+    min_mag_neg] in place (caller pre-fills identities -1 / 1<<depth).
+    Host numpy twin of the fused kernel's presence-walk Min/Max
+    (ops/kernels.groupby_fused(minmax=True) / minmax_from_table)."""
+    from pilosa_tpu.ops import bitmap as bmops
+    from pilosa_tpu.ops import bsi as bsi_ops
+    depth = bsi.shape[0] - 2
+    code = bmops.code_from_planes_np(
+        np.ascontiguousarray(code_planes, dtype=np.uint32))
+    va = bsi_ops.unpack_bits_np(
+        np.ascontiguousarray(valid, dtype=np.uint32))
+    ex = bsi_ops.unpack_bits_np(bsi[0]) & va
+    sg = bsi_ops.unpack_bits_np(bsi[1])
+    mag = np.zeros(code.shape, np.int64)
+    for p in range(depth):
+        mag |= bsi_ops.unpack_bits_np(bsi[2 + p]).astype(np.int64) << p
+    posm = (ex & ~sg if signed else ex).astype(bool)
+    negm = (ex & sg).astype(bool) if signed else np.zeros_like(posm)
+    inb = code < n_codes
+    for row, op, mask in ((0, np.maximum, posm), (1, np.minimum, posm),
+                          (2, np.maximum, negm), (3, np.minimum, negm)):
+        sel = mask & inb
+        if sel.any():
+            op.at(mm[row], code[sel], mag[sel])
+
+
 def mutex_fill(written: np.ndarray, scratch: np.ndarray,
                rowidx: np.ndarray, cols: np.ndarray) -> None:
     """Fill a zeroed (n_rows, plane_words) scratch with one bit per

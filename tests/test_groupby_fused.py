@@ -1,0 +1,448 @@
+"""Fused single-pass GroupBy kernel family (ISSUE 11) — property
+suite pinning the int8 MXU popcount-accumulate kernel bit-exact
+against the XLA scatter reference and the numpy host twins, across
+signed BSI edge cases (negative sums, extreme magnitudes, all-invalid
+groups, empty combos), plus the Min/Max presence-walk table, the
+value-histogram Range/Distinct byproduct, and the serving/ragged
+batched path.  Everything runs under Pallas interpret mode on the CPU
+test mesh, so tier-1 exercises the kernel without TPU hardware.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.ops import bitmap as bm
+from pilosa_tpu.ops import bsi
+from pilosa_tpu.ops import kernels
+
+
+def _category_field(rng, n_rows, s_dim, width):
+    """(rows (R, S, W) uint32, per-column assignment (S, width)) with
+    each column in at most one row — categorical (disjoint) data."""
+    assign = rng.integers(-1, n_rows, size=(s_dim, width))
+    rows = np.zeros((n_rows, s_dim, width // 32), np.uint32)
+    for s in range(s_dim):
+        for r in range(n_rows):
+            rows[r, s] = bm.from_columns(
+                np.nonzero(assign[s] == r)[0], width)
+    return rows, assign
+
+
+def _fixture(rng, nf_rows, depth, s_dim=3, w=16, signed=True,
+             all_invalid=False, extreme=False):
+    """Random group-code stack + BSI planes + the naive per-column
+    ground truth arrays."""
+    import jax.numpy as jnp
+    width = w * 32
+    fields = [_category_field(rng, nr, s_dim, width) for nr in nf_rows]
+    lo = -(2 ** depth) + 1 if signed else 0
+    vals = rng.integers(lo, 2 ** depth, size=(s_dim, width))
+    if extreme:
+        # saturate magnitudes at the depth bound (all-ones planes)
+        ext = rng.integers(0, 2, size=(s_dim, width)).astype(bool)
+        vals[ext] = np.where(rng.integers(0, 2, size=int(ext.sum())),
+                             2 ** depth - 1,
+                             lo if signed else 0)
+    ex = rng.integers(0, 2, size=(s_dim, width)).astype(bool)
+    planes = np.stack([
+        bsi.encode(np.nonzero(ex[s])[0], vals[s][ex[s]],
+                   depth=depth, width=width) for s in range(s_dim)])
+    bits = [max(nr - 1, 0).bit_length() for nr in nf_rows]
+    n_codes = 1 << sum(bits)
+    cp = np.concatenate(
+        [np.asarray(bm.digit_planes(rows)) for rows, _ in fields]
+    ).transpose(1, 0, 2) if sum(bits) else \
+        np.zeros((s_dim, 0, w), np.uint32)
+    if all_invalid:
+        valid = np.zeros((s_dim, w), np.uint32)
+    else:
+        valid = np.full((s_dim, w), 0xFFFFFFFF, np.uint32)
+        for rows, _ in fields:
+            u = rows[0].copy()
+            for r in rows[1:]:
+                u |= r
+            valid &= u
+    args = (jnp.asarray(cp), jnp.asarray(valid), jnp.asarray(planes),
+            n_codes, signed)
+    return args, fields, vals, ex, bits, width
+
+
+class TestFusedKernelBitExact:
+    """groupby_fused == groupby_codes_xla == groupby_onehot == numpy
+    host twin, over randomized trials + named edge cases."""
+
+    CASES = [
+        # (nf_rows, depth, signed, all_invalid, extreme)
+        ((5, 3), 4, True, False, False),
+        ((4,), 6, False, False, False),
+        ((3, 2, 4), 3, True, False, False),
+        ((5, 3), 4, True, True, False),       # all-invalid groups
+        ((6,), 7, True, False, True),         # extreme magnitudes
+        ((2, 2), 1, True, False, False),      # depth-1 negative sums
+    ]
+
+    @pytest.mark.parametrize("case", CASES)
+    def test_fused_vs_references(self, rng, case):
+        nf_rows, depth, signed, all_invalid, extreme = case
+        args, *_ = _fixture(rng, nf_rows, depth, signed=signed,
+                            all_invalid=all_invalid, extreme=extreme)
+        ref = [np.asarray(v) for v in kernels.groupby_codes_xla(*args)]
+        fused = [np.asarray(v) for v in kernels.groupby_fused(*args)]
+        onehot = [np.asarray(v) for v in kernels.groupby_onehot(*args)]
+        for r, f, o in zip(ref, fused, onehot):
+            np.testing.assert_array_equal(r, f)
+            np.testing.assert_array_equal(r, o)
+
+    @pytest.mark.parametrize("trial", range(4))
+    def test_randomized_property(self, rng, trial):
+        """Random shapes/depths/signedness; fused == XLA == numpy
+        twin (the native_ingest numpy fallback histogram)."""
+        from pilosa_tpu.storage import native_ingest as ni
+        nf = int(rng.integers(1, 4))
+        nf_rows = tuple(int(rng.integers(1, 7)) for _ in range(nf))
+        depth = int(rng.integers(1, 9))
+        signed = bool(rng.integers(0, 2))
+        args, *_ = _fixture(rng, nf_rows, depth, signed=signed,
+                            w=int(rng.integers(1, 4)) * 8)
+        cp, valid, planes, n_codes, _ = args
+        ref = [np.asarray(v)
+               for v in kernels.groupby_codes_xla(*args)]
+        fused = [np.asarray(v) for v in kernels.groupby_fused(*args)]
+        for r, f in zip(ref, fused):
+            np.testing.assert_array_equal(r, f)
+        # numpy host twin, shard by shard
+        c = np.zeros(n_codes, np.int64)
+        n_ = np.zeros(n_codes, np.int64)
+        p_ = np.zeros((n_codes, depth), np.int64)
+        g_ = np.zeros((n_codes, depth), np.int64)
+        cp_np, va_np, pl_np = (np.asarray(cp), np.asarray(valid),
+                               np.asarray(planes))
+        prev = (ni._lib, ni._lib_failed)
+        ni._lib, ni._lib_failed = None, True
+        try:
+            for s in range(cp_np.shape[0]):
+                # numpy fallback forced so the twin itself is covered
+                ni.groupcode_hist(cp_np[s], va_np[s], pl_np[s],
+                                  n_codes, signed, c, n_, p_, g_)
+        finally:
+            ni._lib, ni._lib_failed = prev
+        np.testing.assert_array_equal(ref[0], c)
+        np.testing.assert_array_equal(ref[1], n_)
+        np.testing.assert_array_equal(ref[2], p_)
+        np.testing.assert_array_equal(ref[3], g_)
+
+    def test_empty_combo_space(self, rng):
+        """Single-row fields (cb == 0 code planes) still histogram —
+        the whole index is combo 0."""
+        args, *_ = _fixture(rng, (1,), 3)
+        ref = [np.asarray(v) for v in kernels.groupby_codes_xla(*args)]
+        fused = [np.asarray(v) for v in kernels.groupby_fused(*args)]
+        for r, f in zip(ref, fused):
+            np.testing.assert_array_equal(r, f)
+
+    def test_counts_only(self, rng):
+        """No BSI planes: the (1, G) counts table alone."""
+        import jax.numpy as jnp
+        args, *_ = _fixture(rng, (4, 3), 2)
+        cp, valid = args[0], args[1]
+        n_codes = args[3]
+        cx = np.asarray(kernels.groupby_codes_xla(
+            cp, jnp.asarray(valid), None, n_codes)[0])
+        cf = np.asarray(kernels.groupby_fused(
+            cp, jnp.asarray(valid), None, n_codes)[0])
+        np.testing.assert_array_equal(cx, cf)
+
+
+class TestFusedMinMax:
+    """The per-group Min/Max plane-presence walk vs the scatter
+    reference, the numpy twin, and naive ground truth."""
+
+    @pytest.mark.parametrize("signed,depth", [(True, 4), (False, 5),
+                                              (True, 1)])
+    def test_table_three_way(self, rng, signed, depth):
+        from pilosa_tpu.storage import native_ingest as ni
+        nf_rows = (4, 3)
+        args, fields, vals, ex, bits, width = _fixture(
+            rng, nf_rows, depth, signed=signed)
+        ref = kernels.groupby_codes_xla(*args, minmax=True)
+        fused = kernels.groupby_fused(*args, minmax=True)
+        np.testing.assert_array_equal(np.asarray(ref[4]),
+                                      np.asarray(fused[4]))
+        # numpy twin
+        cp, valid, planes, n_codes, _ = args
+        big = 1 << depth
+        mm = np.stack([np.full(n_codes, -1, np.int64),
+                       np.full(n_codes, big, np.int64),
+                       np.full(n_codes, -1, np.int64),
+                       np.full(n_codes, big, np.int64)])
+        for s in range(np.asarray(cp).shape[0]):
+            ni.groupcode_minmax(np.asarray(cp)[s], np.asarray(valid)[s],
+                                np.asarray(planes)[s], n_codes, signed,
+                                mm)
+        np.testing.assert_array_equal(np.asarray(ref[4]), mm)
+        # naive per-combo ground truth through minmax_from_table
+        import itertools
+        vmax, hasmax = kernels.minmax_from_table(mm, depth, "max")
+        vmin, hasmin = kernels.minmax_from_table(mm, depth, "min")
+        shifts = np.cumsum([0] + bits[:-1])
+        s_dim = np.asarray(cp).shape[0]
+        for combo in itertools.product(*[range(n) for n in nf_rows]):
+            code = sum(ci << sh for ci, sh in zip(combo, shifts))
+            sel = np.ones((s_dim, width), bool)
+            for (rows, assign), ci in zip(fields, combo):
+                sel &= assign == ci
+            vv = vals[sel & ex]
+            if len(vv):
+                assert hasmax[code] and hasmin[code]
+                assert vmax[code] == vv.max()
+                assert vmin[code] == vv.min()
+            else:
+                assert not hasmax[code] and not hasmin[code]
+
+
+class TestValueHistByproduct:
+    """Range/Distinct/MinMax out of the fused value histogram."""
+
+    @pytest.mark.parametrize("depth,filtered", [(4, False), (6, True),
+                                                (1, False)])
+    def test_hist_vs_decode(self, rng, depth, filtered):
+        import jax.numpy as jnp
+        s_dim, w = 2, 16
+        width = w * 32
+        vals = rng.integers(-(2**depth) + 1, 2**depth,
+                            size=(s_dim, width))
+        ex = rng.integers(0, 2, size=(s_dim, width)).astype(bool)
+        planes = np.stack([
+            bsi.encode(np.nonzero(ex[s])[0], vals[s][ex[s]],
+                       depth=depth, width=width)
+            for s in range(s_dim)])
+        filt = (rng.integers(0, 2**32, size=(s_dim, w),
+                             dtype=np.uint32) if filtered else None)
+        fj = jnp.asarray(filt) if filt is not None else None
+        pos, neg = kernels.bsi_value_hist(jnp.asarray(planes), fj)
+        posr, negr = kernels.bsi_value_hist(jnp.asarray(planes), fj,
+                                            use_kernel=False)
+        np.testing.assert_array_equal(np.asarray(pos),
+                                      np.asarray(posr))
+        np.testing.assert_array_equal(np.asarray(neg),
+                                      np.asarray(negr))
+        sel = ex.copy()
+        if filt is not None:
+            fbits = np.stack([
+                np.asarray(bsi.unpack_bits_np(filt[s]))
+                for s in range(s_dim)])
+            sel &= fbits
+        vv = vals[sel]
+        for v in range(2 ** depth):
+            assert int(pos[v]) == int((vv == v).sum())
+            want_neg = int((vv == -v).sum()) if v > 0 else 0
+            assert int(neg[v]) == want_neg
+        assert kernels.distinct_from_hist(pos, neg) == \
+            sorted(set(vv.tolist()))
+        lo, hi = int(vals.min()) + 1, int(vals.max()) - 1
+        assert kernels.range_count_from_hist(pos, neg, lo, hi) == \
+            int(((vv >= lo) & (vv <= hi)).sum())
+
+
+def _engine(rng, W, signed=True):
+    from pilosa_tpu.models import FieldOptions, FieldType, Holder
+    h = Holder(width=W)
+    idx = h.create_index("i")
+    idx.create_field("g", FieldOptions(type=FieldType.MUTEX))
+    idx.create_field("d", FieldOptions(type=FieldType.MUTEX))
+    idx.create_field("flt")
+    lo = -50 if signed else 0
+    idx.create_field("v", FieldOptions(type=FieldType.INT,
+                                       min=lo, max=50))
+    cols = list(range(0, 9 * W, 3))
+    idx.field("g").import_bits([c % 5 for c in cols], cols)
+    idx.field("d").import_bits([(c // 5) % 4 for c in cols], cols)
+    idx.field("flt").import_bits([c % 2 for c in cols], cols)
+    idx.field("v").import_values(
+        cols, [int(v) for v in rng.integers(lo, 50, size=len(cols))])
+    idx.mark_columns_exist(cols)
+    return h
+
+
+def _as_t(res):
+    return [(tuple(g["row_id"] for g in r.group), r.count, r.agg,
+             r.agg_count) for r in res]
+
+
+QUERIES = [
+    "GroupBy(Rows(g), Rows(d))",
+    "GroupBy(Rows(g), Rows(d), aggregate=Sum(field=v))",
+    "GroupBy(Rows(g), Rows(d), filter=Row(flt=1), "
+    "aggregate=Sum(field=v))",
+    "GroupBy(Rows(g), aggregate=Min(field=v))",
+    "GroupBy(Rows(g), Rows(d), aggregate=Max(field=v))",
+    "GroupBy(Rows(g), Rows(d), filter=Row(flt=0), "
+    "aggregate=Min(field=v))",
+]
+
+
+class TestEngineFusedArm:
+    """The fused arm forced through the REAL engine (interpret mode)
+    == the host loop, across Sum/Min/Max/filters/signedness."""
+
+    @pytest.mark.parametrize("signed", [True, False])
+    def test_engine_bit_exact(self, rng, monkeypatch, signed):
+        from pilosa_tpu.executor import Executor
+        h = _engine(rng, 1 << 12, signed=signed)
+        for q in QUERIES:
+            monkeypatch.setenv("PILOSA_TPU_GROUPBY_ONEPASS_ARM",
+                               "fused")
+            got = Executor(h).execute("i", q)[0]
+            monkeypatch.delenv("PILOSA_TPU_GROUPBY_ONEPASS_ARM")
+            ex_loop = Executor(h)
+            ex_loop.use_stacked = False
+            want = ex_loop.execute("i", q)[0]
+            assert _as_t(got) == _as_t(want), q
+
+    def test_fused_metric_counts(self, rng, monkeypatch):
+        from pilosa_tpu.executor import Executor
+        from pilosa_tpu.obs.metrics import GROUPBY_FUSED
+        h = _engine(rng, 1 << 12)
+        before = GROUPBY_FUSED.total()
+        monkeypatch.setenv("PILOSA_TPU_GROUPBY_ONEPASS_ARM", "fused")
+        Executor(h).execute(
+            "i", "GroupBy(Rows(g), Rows(d), aggregate=Sum(field=v))")
+        assert GROUPBY_FUSED.total() > before
+
+    def test_minmax_falls_back_on_overlap(self, rng, monkeypatch):
+        """Overlapping rows refuse the one-pass gate; Min/Max must
+        still answer correctly via the host loop."""
+        from pilosa_tpu.models import FieldOptions, FieldType, Holder
+        from pilosa_tpu.executor import Executor
+        W = 1 << 12
+        h = Holder(width=W)
+        idx = h.create_index("i")
+        idx.create_field("g")          # SET field — overlap allowed
+        idx.create_field("v", FieldOptions(type=FieldType.INT,
+                                           min=-50, max=50))
+        cols = list(range(0, 3 * W, 5))
+        idx.field("g").import_bits([c % 3 for c in cols], cols)
+        extra = cols[::4]
+        idx.field("g").import_bits([(c % 3 + 1) % 3 for c in extra],
+                                   extra)
+        idx.field("v").import_values(
+            cols, [int(v) for v in rng.integers(-50, 50,
+                                                size=len(cols))])
+        idx.mark_columns_exist(cols)
+        q = "GroupBy(Rows(g), aggregate=Max(field=v))"
+        got = Executor(h).execute("i", q)[0]
+        ex_loop = Executor(h)
+        ex_loop.use_stacked = False
+        assert _as_t(got) == _as_t(ex_loop.execute("i", q)[0])
+
+    def test_minmax_distinct_queries_fused(self, rng, monkeypatch):
+        """Min/Max/Distinct standalone queries ride the value-hist
+        byproduct (fused arm forced) and equal the shard loop."""
+        from pilosa_tpu.executor import Executor
+        h = _engine(rng, 1 << 12)
+        monkeypatch.setenv("PILOSA_TPU_GROUPBY_ONEPASS_ARM", "fused")
+        ex = Executor(h)
+        ex_loop = Executor(h)
+        ex_loop.use_stacked = False
+        for q in ("Min(field=v)", "Max(field=v)",
+                  "Min(Row(flt=1), field=v)"):
+            got, want = ex.execute("i", q)[0], \
+                ex_loop.execute("i", q)[0]
+            assert (got.value, got.count) == (want.value, want.count)
+        gd = ex.execute("i", "Distinct(field=v)")[0]
+        wd = ex_loop.execute("i", "Distinct(field=v)")[0]
+        assert gd.values == wd.values
+
+
+class TestBatchedGroupBy:
+    """GroupBy riders inside the fused serving batch (the ragged
+    "gb_hist" subplan) — bit-exact vs solo, served by the one fused
+    program."""
+
+    def test_batched_vs_solo(self, rng):
+        import threading
+
+        from pilosa_tpu.executor import Executor
+        from pilosa_tpu.obs import metrics
+        h = _engine(rng, 1 << 12)
+        qs = ["GroupBy(Rows(g), Rows(d), aggregate=Sum(field=v))",
+              "GroupBy(Rows(g), Rows(d))",
+              "GroupBy(Rows(g), Rows(d), filter=Row(flt=1), "
+              "aggregate=Sum(field=v))",
+              "Count(Intersect(Row(g=1), Row(d=1)))"]
+        solo = [Executor(h).execute("i", q) for q in qs]
+        ex = Executor(h)
+        ex.enable_serving(window_s=0.02, max_batch=16)
+        d0 = metrics.SERVING_DISPATCH.total(kind="ragged")
+        results = [None] * 8
+
+        def worker(k):
+            results[k] = ex.execute_serving("i", qs[k % len(qs)])
+
+        ts = [threading.Thread(target=worker, args=(k,))
+              for k in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for k in range(8):
+            got, want = results[k], solo[k % len(qs)]
+            if qs[k % len(qs)].startswith("GroupBy"):
+                assert _as_t(got[0]) == _as_t(want[0]), k
+            else:
+                assert got == want, k
+        assert metrics.SERVING_DISPATCH.total(kind="ragged") > d0
+
+    def test_unbatchable_shapes_stay_solo(self, rng):
+        """previous=/having=/Min-aggregate GroupBys fall back to the
+        solo path and stay correct under serving."""
+        from pilosa_tpu.executor import Executor
+        h = _engine(rng, 1 << 12)
+        ex = Executor(h)
+        ex.enable_serving(window_s=0.001, max_batch=8)
+        for q in ("GroupBy(Rows(g), Rows(d), previous=[2, 1], "
+                  "aggregate=Sum(field=v))",
+                  "GroupBy(Rows(g), aggregate=Min(field=v))",
+                  "GroupBy(Rows(g), Rows(d), limit=3)"):
+            got = ex.execute_serving("i", q)
+            want = Executor(h).execute("i", q)
+            assert _as_t(got[0]) == _as_t(want[0]), q
+
+
+class TestRooflineBytesModel:
+    """The honest per-arm bytes accounting (ISSUE 11 satellite): each
+    GroupBy arm notes ITS schedule's traffic, and the single-pass
+    model is combo-count-free while the scan model is not."""
+
+    def test_models_ordering(self):
+        one = kernels.groupby_onepass_hbm_bytes(8, 1024, 6, depth=8)
+        per = kernels.groupby_percombo_hbm_bytes(8, 1024, 60, 3,
+                                                 depth=8)
+        scan = kernels.groupby_scan_hbm_bytes(8, 1024, 60, 3, depth=8)
+        assert one < per < scan
+        # one-pass traffic is independent of combo count
+        assert one == kernels.groupby_onepass_hbm_bytes(
+            8, 1024, 6, depth=8)
+        assert kernels.groupby_scan_hbm_bytes(
+            8, 1024, 240, 3, depth=8) > scan
+
+    def test_onepass_note_uses_model(self, rng, monkeypatch):
+        """The engine's one-pass dispatch notes exactly the
+        single-pass model bytes (not operand-array sums)."""
+        from pilosa_tpu.executor import Executor
+        from pilosa_tpu.obs import roofline
+        h = _engine(rng, 1 << 12)
+        notes = []
+        monkeypatch.setattr(
+            roofline, "note",
+            lambda op, b, s: notes.append((op, b)))
+        Executor(h).execute(
+            "i", "GroupBy(Rows(g), Rows(d), aggregate=Sum(field=v))")
+        gb = [b for op, b in notes if op == "groupby"]
+        assert gb, notes
+        idx = h.index("i")
+        n_shards = len(idx.field("g").views["standard"].shards)
+        depth = idx.field("v").bit_depth
+        want = kernels.groupby_onepass_hbm_bytes(
+            n_shards, idx.width // 32, 3 + 2, depth)
+        assert gb[-1] == want, (gb, want)
