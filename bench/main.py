@@ -42,6 +42,7 @@ from bench.serving import (
     serving_gauntlet,
     tracing_overhead_gauntlet,
 )
+from bench.statsbench import stats_ab_gauntlet, stats_smoke
 from bench.writes import write_smoke, write_storm_gauntlet
 
 
@@ -101,6 +102,11 @@ def main() -> None:
     build_events_index(h, 3)
     ragged = ragged_gauntlet(h, bench_shards=n_shards,
                              events_shards=3)
+    # stats-fed vs static admission A/B (ISSUE 12): heavy-slot
+    # misclassification rate with the statistics catalog classifying
+    # by measured fingerprint cost vs the static kind walk — the
+    # catalog's load-bearing acceptance cell, bit-exact hard-gated
+    stats_ab = stats_ab_gauntlet()
     # RTT-independent device time for the sub-RTT north-star scans
     cal = loop_calibrate(h) if on_tpu else None
 
@@ -200,6 +206,9 @@ def main() -> None:
         # ragged + QoS gauntlet (ISSUE 8): dispatches/query A/B,
         # point-p99-under-GroupBy-storm A/B, typed backpressure
         "ragged_gauntlet": ragged,
+        # statistics-catalog A/B (ISSUE 12): misclassification rate
+        # stats-fed vs static admission, bit-exact across arms
+        "stats_ab_gauntlet": stats_ab,
     }
     if cal is not None:
         result["loop_calibrated_device_ms"] = {
@@ -269,6 +278,8 @@ def dispatch(argv) -> int:
         return ragged_smoke()
     if "--kernel-smoke" in argv:
         return kernel_smoke()
+    if "--stats-smoke" in argv:
+        return stats_smoke()
     try:
         main()
     except Exception as e:  # clear failure JSON — never a bare crash

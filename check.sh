@@ -82,6 +82,20 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 
+echo "== stats smoke =="
+# statistics-catalog gate (bench.py --stats-smoke): fixed-cost probe
+# for the per-dispatch stats note (<=8us disabled / <=60us enabled,
+# same style as the PR 4/9 probes) + correctness gates — stats-on vs
+# stats-off bit-exact, restart reloads a non-empty catalog with equal
+# cost estimates, and the stats-fed admission arm never misclassifies
+# more than the static arm (rates recorded in BENCH JSON, improvement
+# asserted only as non-regression on the 2-core box)
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python bench.py --stats-smoke; then
+    echo "check.sh: stats smoke failed" >&2
+    exit 1
+fi
+
 echo "== kernel interpret-mode smoke =="
 # fused single-pass GroupBy kernel gate (bench.py --kernel-smoke):
 # the fused int8 MXU kernel + Min/Max presence walk + Range/Distinct

@@ -321,6 +321,15 @@ class API:
                     idx.mark_columns_exist(cols)
                 n = len(cols)
                 metrics.IMPORTED_BITS.inc(n, index=index)
+        if not clear:
+            # statistics catalog: incremental per-field row
+            # cardinality + shard-skew maintenance (no-op with
+            # PILOSA_TPU_STATS=0).  OUTSIDE the import lock — the
+            # note does its own np.unique + flushed tail append, and
+            # concurrent importers must not queue behind stats I/O
+            from pilosa_tpu.obs import stats as _stats
+            _stats.note_ingest(index, field, rows=rows, cols=cols,
+                               width=idx.width)
         self.sweep_import(index, {field}, cols,
                           mark_exists=mark_exists and not clear)
         return n
@@ -419,6 +428,13 @@ class API:
                     idx.mark_columns_exist(cols)
                 n = len(cols)
                 metrics.IMPORTED_BITS.inc(n, index=index)
+        if not clear:
+            # statistics catalog: value min/max + shard skew from the
+            # BSI ingest path (outside the import lock, see
+            # import_bits)
+            from pilosa_tpu.obs import stats as _stats
+            _stats.note_ingest(index, field, cols=cols,
+                               values=values, width=idx.width)
         self.sweep_import(index, {field}, cols,
                           mark_exists=mark_exists and not clear)
         return n

@@ -17,6 +17,7 @@ format), mirroring how the reference reduces decoded protobuf rows.
 from __future__ import annotations
 
 import http.client
+import json
 import os
 import threading
 import time
@@ -96,26 +97,41 @@ def derive_hedge_delay_s(factor: float = 3.0, lo_s: float = 0.005,
     node's typical latency.  Falls back to the same score over the
     pooled sample while per-node counts are thin, to whole-record
     durations before fan-out attempts exist, and to ``default_s``
-    until enough samples accumulate."""
-    by_node: dict[str, list[float]] = {}
-    durs: list[float] = []
-    for r in flight.recorder.recent(512):
-        if r.get("error") is not None:
-            continue
-        # only CLUSTER records feed the derivation: under a mixed
-        # workload the ring is dominated by sub-ms solo / serving /
-        # dax records, and deriving from those would clamp the delay
-        # to the floor and hedge nearly every healthy fan-out
-        if r.get("route") != "cluster":
-            continue
-        durs.append(r.get("duration_ms", 0.0))
-        for a in r.get("attempts", ()):
-            # "*ok-local" attempts (in-process api.query legs) are
-            # excluded for the same reason: sub-ms locals would
-            # floor-clamp the delay and hedge every healthy fan-out
-            if str(a.get("outcome", "")).endswith("ok"):
-                by_node.setdefault(str(a.get("node", "")), []) \
-                    .append(a.get("ms", 0.0))
+    until enough samples accumulate.
+
+    Sample source: the statistics catalog (obs/stats.py) when it
+    holds enough per-node attempt history — the catalog PERSISTS
+    those distributions, so a freshly restarted coordinator hedges
+    with calibrated delays from its first query instead of sitting
+    on ``default_s`` until the in-memory ring refills.  The flight
+    ring scan stays as the stats-disabled fallback."""
+    from pilosa_tpu.obs import stats as _stats
+    got = _stats.hedge_samples(min_records=min_records)
+    if got is not None:
+        by_node = {n: list(v) for n, v in got[0].items()}
+        durs = list(got[1])
+    else:
+        by_node = {}
+        durs = []
+        for r in flight.recorder.recent(512):
+            if r.get("error") is not None:
+                continue
+            # only CLUSTER records feed the derivation: under a mixed
+            # workload the ring is dominated by sub-ms solo / serving
+            # / dax records, and deriving from those would clamp the
+            # delay to the floor and hedge nearly every healthy
+            # fan-out
+            if r.get("route") != "cluster":
+                continue
+            durs.append(r.get("duration_ms", 0.0))
+            for a in r.get("attempts", ()):
+                # "*ok-local" attempts (in-process api.query legs)
+                # are excluded for the same reason: sub-ms locals
+                # would floor-clamp the delay and hedge every
+                # healthy fan-out
+                if str(a.get("outcome", "")).endswith("ok"):
+                    by_node.setdefault(str(a.get("node", "")), []) \
+                        .append(a.get("ms", 0.0))
     atts = [ms for lst in by_node.values() for ms in lst]
     sample = atts if len(atts) >= min_records else durs
     if len(sample) < min_records:
@@ -169,6 +185,8 @@ class ClusterNode:
                               self._debug_cluster_queries)
         self.server.add_route("GET", "/debug/cluster/metrics",
                               self._debug_cluster_metrics)
+        self.server.add_route("GET", "/debug/cluster/stats",
+                              self._debug_cluster_stats)
 
     # -- lifecycle -----------------------------------------------------
 
@@ -473,18 +491,39 @@ class ClusterNode:
         coordinator's fan-out record (with per-node ``attempts``)
         next to every node's leg records under the same id.  Query
         params: ``limit``/``n``, ``timeout_ms`` (per-node),
-        ``trace_id`` (single-trace filter)."""
+        ``trace_id`` (single-trace filter), plus the per-node
+        /debug/queries filters — ``route``/``tenant``/``since_ms``
+        PASS THROUGH to every node and apply identically to the
+        coordinator's own ring (server/http.py
+        filter_flight_records, one implementation)."""
+        from urllib.parse import urlencode
+
+        from pilosa_tpu.server.http import filter_flight_records
         q = req.query
         limit = int(q.get("limit", q.get("n", ["100"]))[0])
         timeout_s = float(q.get("timeout_ms", ["1000"])[0]) / 1e3
         want_tid = q.get("trace_id", [None])[0]
+        route = q.get("route", [None])[0]
+        tenant = q.get("tenant", [None])[0]
+        since_ms = q.get("since_ms", [None])[0]
         # a single-trace lookup must search each node's WHOLE ring —
         # truncating to the newest `limit` first would hide any trace
-        # older than the last N queries
-        fetch = 1 << 17 if want_tid else limit
-        per_node = {self.node_id: flight.recorder.recent(fetch)}
+        # older than the last N queries; same for the filters (the
+        # per-node endpoint filters THEN truncates, and the local leg
+        # must apply identically)
+        filtered = (route is not None or tenant is not None
+                    or since_ms is not None)
+        fetch = 1 << 17 if (want_tid or filtered) else limit
+        per_node = {self.node_id: filter_flight_records(
+            flight.recorder.recent(fetch), route=route,
+            tenant=tenant, since_ms=since_ms)}
+        params = {"limit": fetch}
+        for k, v in (("route", route), ("tenant", tenant),
+                     ("since_ms", since_ms)):
+            if v is not None:
+                params[k] = v
         got, unreachable = self._federate(
-            f"/debug/queries?limit={fetch}", timeout_s)
+            "/debug/queries?" + urlencode(params), timeout_s)
         for nid, payload in got.items():
             per_node[nid] = (payload or {}).get("queries", [])
         merged: dict[str, dict] = {}
@@ -541,6 +580,68 @@ class ClusterNode:
                     else:
                         dst[labels] = dst.get(labels, 0.0) + val
         return {"aggregate": agg,
+                "nodes": sorted(per_node),
+                "per_node": per_node,
+                "unreachable": unreachable,
+                "partial": bool(unreachable)}
+
+    def _debug_cluster_stats(self, req):
+        """Cluster-wide statistics catalog: fan out /debug/stats to
+        live nodes (filters ``index``/``fingerprint``/``limit`` PASS
+        THROUGH to every node and apply to the local catalog — same
+        contract as /debug/cluster/queries, from day one) and merge:
+        per-fingerprint profiles aggregate n-weighted across nodes,
+        regressions union with node attribution, each node's raw
+        payload under ``per_node``.  ``timeout_ms`` bounds each
+        node's fetch."""
+        from urllib.parse import urlencode
+
+        from pilosa_tpu.obs import stats
+        q = req.query
+        timeout_s = float(q.get("timeout_ms", ["1000"])[0]) / 1e3
+        index = q.get("index", [None])[0]
+        fingerprint = q.get("fingerprint", [None])[0]
+        limit = q.get("limit", [None])[0]
+        per_node = {self.node_id: stats.get().payload(
+            index=index, fingerprint=fingerprint,
+            limit=int(limit) if limit is not None else None)}
+        params = {k: v for k, v in (("index", index),
+                                    ("fingerprint", fingerprint),
+                                    ("limit", limit))
+                  if v is not None}
+        path = "/debug/stats" + ("?" + urlencode(params)
+                                 if params else "")
+        got, unreachable = self._federate(path, timeout_s)
+        per_node.update(got)
+        profiles: dict[str, dict] = {}
+        regressions: list[dict] = []
+        # an IN-PROCESS test cluster shares one process-global
+        # catalog, so every node would report the identical payload
+        # and the n-weighted merge would multiply each profile by the
+        # node count — aggregate each distinct payload once (same
+        # first-sighting-wins shape as the cluster-queries merge)
+        seen_docs: set = set()
+        for nid in sorted(per_node):
+            doc = per_node[nid] or {}
+            digest = json.dumps(doc, sort_keys=True, default=str)
+            if digest in seen_docs:
+                continue
+            seen_docs.add(digest)
+            for fp, p in (doc.get("runtime") or {}).items():
+                agg = profiles.setdefault(
+                    fp, {"n": 0, "ms": 0.0, "nodes": 0})
+                n = int(p.get("n", 0))
+                if agg["n"] + n > 0:
+                    agg["ms"] = round(
+                        (agg["ms"] * agg["n"]
+                         + float(p.get("ms", 0.0)) * n)
+                        / (agg["n"] + n), 4)
+                agg["n"] += n
+                agg["nodes"] += 1
+            for reg in doc.get("regressions") or ():
+                regressions.append({**reg, "node": nid})
+        return {"aggregate": {"profiles": profiles,
+                              "regressions": regressions},
                 "nodes": sorted(per_node),
                 "per_node": per_node,
                 "unreachable": unreachable,

@@ -119,6 +119,25 @@ class Config:
     # overhead-smoke A/B switch, also PILOSA_TPU_ROOFLINE=0).
     roofline_attribution: bool = True
     roofline_peak_gbps: float = 0.0
+    # statistics catalog (obs/stats.py + storage/stats_store.py):
+    # persisted flight/roofline telemetry driving the engine's cost
+    # decisions (cost gates, admission classing, cache eviction,
+    # hedge derivation) plus the per-fingerprint regression sentinel.
+    # enabled=false (or PILOSA_TPU_STATS=0 — the bench A/B lever)
+    # reverts every consumer to its static heuristic, bit-exact.
+    # The runtime plane samples FLIGHT RECORDS: disabling the flight
+    # recorder ([flight] recorder=false) stops profile/sentinel/
+    # hedge accumulation (the ingest-fed data plane keeps working).
+    # persist=false keeps the catalog memory-only; snapshot-interval-s
+    # is the tmp+rename snapshot cadence; heavy-cost-ms is the
+    # measured-cost admission threshold; regression-ratio /
+    # regression-min-samples arm the sentinel.
+    stats_enabled: bool = True
+    stats_persist: bool = True
+    stats_snapshot_interval_s: float = 60.0
+    stats_heavy_cost_ms: float = 5.0
+    stats_regression_ratio: float = 3.0
+    stats_regression_min_samples: int = 6
     # SLO burn-rate plane (obs/slo.py): latency-ms + latency-objective
     # define the latency SLO ("latency-objective of queries answer
     # under latency-ms"); availability-objective bounds the typed-
@@ -201,6 +220,27 @@ class Config:
         if roofline.enabled():
             roofline.ensure_peak(block=False)
 
+    def apply_stats_settings(self, data_dir: str | None = None):
+        """Configure the process statistics catalog ([stats]).  An
+        operator's PILOSA_TPU_STATS env kill-switch outranks a
+        default-True config (same contract as apply_roofline_settings);
+        persistence lands under ``data_dir`` (the holder's path) when
+        one exists — memory-only otherwise."""
+        from pilosa_tpu.obs import stats
+        enabled = self.stats_enabled
+        if enabled and "PILOSA_TPU_STATS" in os.environ:
+            enabled = None  # env kill-switch stays in charge
+        base = data_dir if data_dir is not None else (self.data_dir
+                                                      or None)
+        path = (os.path.join(base, "stats.jsonl")
+                if (self.stats_persist and base) else None)
+        stats.configure(
+            enabled=enabled, path=path,
+            heavy_cost_ms=self.stats_heavy_cost_ms,
+            regression_ratio=self.stats_regression_ratio,
+            regression_min_samples=self.stats_regression_min_samples,
+            snapshot_interval_s=self.stats_snapshot_interval_s)
+
     def apply_slo_settings(self):
         """Build the process SLO tracker from the [slo] knobs."""
         from pilosa_tpu.obs import slo
@@ -252,6 +292,12 @@ _TOML_KEYS = {
     "flight.ring": "flight_ring",
     "roofline.attribution": "roofline_attribution",
     "roofline.peak-gbps": "roofline_peak_gbps",
+    "stats.enabled": "stats_enabled",
+    "stats.persist": "stats_persist",
+    "stats.snapshot-interval-s": "stats_snapshot_interval_s",
+    "stats.heavy-cost-ms": "stats_heavy_cost_ms",
+    "stats.regression-ratio": "stats_regression_ratio",
+    "stats.regression-min-samples": "stats_regression_min_samples",
     "slo.latency-ms": "slo_latency_ms",
     "slo.latency-objective": "slo_latency_objective",
     "slo.availability-objective": "slo_availability_objective",

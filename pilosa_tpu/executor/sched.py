@@ -97,11 +97,35 @@ class QoS:
                    deadline_s=dl)
 
 
-def classify(q: Query, qos: QoS | None) -> str:
-    """Admission class of a read query: explicit priority wins, else
-    any heavy call in the tree makes the query heavy."""
+def classify(q: Query, qos: QoS | None,
+             fingerprint: str | None = None) -> str:
+    """Admission class of a read query.  Explicit priority wins.
+    Next, MEASURED cost: when the statistics catalog (obs/stats.py)
+    holds a warm profile for this plan fingerprint, the class is the
+    estimated cost against ``[stats] heavy-cost-ms`` — a GroupBy that
+    measures cheap (tiny combo space, or always cache-served) rides
+    the point lane; a Count that measures expensive gates like the
+    heavy query it is.  Query KIND is the cold-start fallback: any
+    heavy call in the tree makes the query heavy.  Class choice only
+    affects scheduling, never results.
+
+    Known tradeoff: the estimate folds in batches, so after a cache
+    invalidation a BURST of a cached-cheap-but-expensive-to-compute
+    fingerprint (up to one fold batch, ~32 records, per wave) can
+    ride the point lane before the estimate re-adapts — bounded, and
+    accepted in exchange for not burning heavy slots on sub-ms
+    cache-served queries (the measured misclassification win)."""
     if qos is not None and qos.priority in (CLASS_POINT, CLASS_HEAVY):
         return qos.priority
+    if fingerprint is not None:
+        from pilosa_tpu.obs import stats
+        est = stats.est_cost_ms(fingerprint)
+        if est is not None:
+            cls = (CLASS_HEAVY if est >= stats.heavy_cost_ms()
+                   else CLASS_POINT)
+            metrics.STATS_ADMISSION.inc(**{"source": "profile",
+                                           "class": cls})
+            return cls
 
     def heavy(call) -> bool:
         if call.name in _HEAVY_CALLS:
@@ -110,8 +134,14 @@ def classify(q: Query, qos: QoS | None) -> str:
             heavy(v) for v in call.args.values()
             if hasattr(v, "children"))
 
-    return CLASS_HEAVY if any(heavy(c) for c in q.calls) \
+    cls = CLASS_HEAVY if any(heavy(c) for c in q.calls) \
         else CLASS_POINT
+    if fingerprint is not None:
+        # the catalog was consulted but had no warm profile — count
+        # the fallback so the misclassification A/B is attributable
+        metrics.STATS_ADMISSION.inc(**{"source": "static",
+                                       "class": cls})
+    return cls
 
 
 class _Ticket:

@@ -36,6 +36,7 @@ the solo path.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import OrderedDict
@@ -52,6 +53,7 @@ from pilosa_tpu.executor.stacked import (
 )
 from pilosa_tpu.models.index import EXISTENCE_FIELD
 from pilosa_tpu.obs import flight, metrics
+from pilosa_tpu.obs import stats as _stats
 from pilosa_tpu.obs.monitor import capture_exception
 from pilosa_tpu.obs.tracing import (
     Span,
@@ -302,9 +304,16 @@ _MISS = object()
 
 
 class ResultCache:
-    """LRU byte-bounded whole-query result cache.
+    """LRU byte-bounded whole-query result cache, recompute-cost
+    aware: entries carry the measured/estimated cost of recomputing
+    them (statistics catalog, obs/stats.py), and eviction drops the
+    cheapest-to-recompute entry among the LRU window — a hot
+    expensive GroupBy survives pressure that flushes point Counts.
+    With the catalog disabled every cost is None and eviction is
+    pure LRU (the PILOSA_TPU_STATS=0 A/B arm).
 
-    Entry: key -> (fields, snapshot, results, nbytes).  A lookup
+    Entry: key -> (fields, snapshot, results, nbytes, cost_ms).  A
+    lookup
     recomputes the fields' current snapshot and misses (evicting the
     entry) on any mismatch — so writes invalidate lazily, exactly the
     entries whose read set they touched; ``sweep()`` performs the same
@@ -328,13 +337,37 @@ class ResultCache:
         self.hits = 0
         self.misses = 0
 
+    # cost-aware eviction scans this many LRU-end entries for the
+    # cheapest recompute; small so eviction stays O(1)-ish
+    _EVICT_WINDOW = 8
+
+    def _evict_one_locked(self, exclude=None) -> int:
+        """Drop one entry (caller holds the lock): the cheapest
+        recompute cost among the _EVICT_WINDOW oldest (None cost =
+        no evidence = first out; all-None degrades to LRU).
+        ``exclude`` protects the entry a put() just inserted — a
+        cheap newcomer must not evict ITSELF (it would pin expensive
+        entries forever and give the hottest cheap query a 0% hit
+        rate).  Returns the freed bytes (0 = nothing evictable)."""
+        window = [(k, e) for k, e in itertools.islice(
+            self._entries.items(), self._EVICT_WINDOW)
+            if k != exclude]
+        if not window:
+            return 0
+        best = min(range(len(window)),
+                   key=lambda i: (window[i][1][4]
+                                  if window[i][1][4] is not None
+                                  else -1.0, i))
+        key, ent = window[best]
+        self._entries.pop(key)
+        self._bytes -= ent[3]
+        return ent[3]
+
     def _reclaim(self, need: int) -> int:
         freed = 0
         with self._lock:
             while self._entries and freed < need:
-                _, (_f, _s, _r, nb) = self._entries.popitem(last=False)
-                self._bytes -= nb
-                freed += nb
+                freed += self._evict_one_locked()
         if freed:
             self._client.release(freed)
         return freed
@@ -349,7 +382,7 @@ class ResultCache:
             with self._lock:
                 self.misses += 1
             return _MISS
-        fields, snap, results, _nb = ent
+        fields, snap, results, _nb, _cost = ent
         # snapshot outside the lock: touches only holder structures;
         # narrowed to the entry's explicit shard subset (key[2]) so a
         # write to another shard cannot stale it
@@ -372,7 +405,12 @@ class ResultCache:
             self.hits += 1
         return results
 
-    def put(self, key, fields: frozenset, snapshot: tuple, results):
+    def put(self, key, fields: frozenset, snapshot: tuple, results,
+            cost_ms: float | None = None):
+        """``cost_ms`` is the entry's recompute cost (fingerprint
+        profile estimate, or the duration just measured) — the
+        cost-aware eviction's ranking signal; None with the stats
+        catalog disabled keeps pure LRU semantics."""
         nbytes = _result_nbytes(results)
         if nbytes > self.max_bytes:
             return
@@ -387,12 +425,14 @@ class ResultCache:
             if old is not None:
                 self._bytes -= old[3]
                 released += old[3]
-            self._entries[key] = (fields, snapshot, results, nbytes)
+            self._entries[key] = (fields, snapshot, results, nbytes,
+                                  cost_ms)
             self._bytes += nbytes
             while self._bytes > self.max_bytes and self._entries:
-                _, (_, _, _, nb) = self._entries.popitem(last=False)
-                self._bytes -= nb
-                released += nb
+                freed = self._evict_one_locked(exclude=key)
+                if not freed:  # only the new entry left: it fits
+                    break      # (nbytes <= max_bytes guard above)
+                released += freed
         if released:
             self._client.release(released)
 
@@ -689,7 +729,16 @@ class ServingLayer:
                     deadline_ms=self.default_deadline_ms)
                 qos.deadline_ms = dflt.deadline_ms
                 qos.deadline_s = dflt.deadline_s
-        cls = _sched.classify(q, qos)
+        # cost-based admission (obs/stats.py): classify by the plan
+        # fingerprint's MEASURED cost profile when the catalog is warm
+        # (query kind stays the cold-start fallback inside classify)
+        key = None
+        fp = None
+        if _stats.enabled():
+            key = (index, repr(q.calls),
+                   None if shards is None else tuple(sorted(shards)))
+            fp = _fingerprint(key)
+        cls = _sched.classify(q, qos, fingerprint=fp)
         # a dead-on-arrival deadline sheds regardless of class — the
         # client stopped waiting, executing would only burn device time
         if (qos is not None and qos.deadline_s is not None
@@ -709,15 +758,17 @@ class ServingLayer:
             with self.sched.heavy_slot(qos):
                 with start_span("executor.Execute", index=index) as root:
                     return self._execute_read(ex, index, q, shards,
-                                              root, qos=qos, cls=cls)
+                                              root, qos=qos, cls=cls,
+                                              key=key, fp=fp)
         metrics.ADMISSION_TOTAL.inc(**{"class": cls,
                                        "outcome": "admitted"})
         with start_span("executor.Execute", index=index) as root:
             return self._execute_read(ex, index, q, shards, root,
-                                      qos=qos, cls=cls)
+                                      qos=qos, cls=cls, key=key,
+                                      fp=fp)
 
     def _execute_read(self, ex, index, q, shards, root=None, qos=None,
-                      cls=None):
+                      cls=None, key=None, fp=None):
         t0 = time.perf_counter()
         route = "direct"
         fl = flight.begin(index, q)
@@ -733,13 +784,13 @@ class ServingLayer:
             root.set_tag("trace_id", fl["trace_id"])
         req = None
         err = None
-        key = None
         try:
             idx = ex.holder.index(index)
             if idx is None:  # canonical "index not found" error path
                 return ex.execute(index, q, shards)
-            key = (index, repr(q.calls),
-                   None if shards is None else tuple(sorted(shards)))
+            if key is None:  # stats-off path: execute() skipped it
+                key = (index, repr(q.calls),
+                       None if shards is None else tuple(sorted(shards)))
             # the read set drives BOTH the cache guard and the
             # batcher's mid-flight consistency re-check, so compute it
             # even with the cache disabled
@@ -813,10 +864,12 @@ class ServingLayer:
                 fl, dur, route=route,
                 batch=req.batch_size if req is not None else 1,
                 error=err,
-                # fingerprinting reprs + hashes the whole key: only
-                # pay for it when a record is actually open
-                fingerprint=(_fingerprint(key)
-                             if fl is not None and key else None),
+                # reuse the admission fingerprint (stats path) —
+                # repr+hash of the whole key must not be paid twice;
+                # with stats off, pay it only when a record is open
+                fingerprint=(fp if fp is not None else
+                             (_fingerprint(key)
+                              if fl is not None and key else None)),
                 extra_acc=req.acc if req is not None else None)
 
     # -- classification ------------------------------------------------
@@ -919,7 +972,9 @@ class ServingLayer:
             if r.result is not None and not r.direct and \
                     r.error is None and r.fields is not None and \
                     self.cache is not None:
-                self.cache.put(r.key, r.fields, r.snapshot, r.result)
+                self.cache.put(r.key, r.fields, r.snapshot, r.result,
+                               cost_ms=self._recompute_cost(r.key,
+                                                            r.acc))
 
     def _run_group(self, reqs: list[_Req]) -> None:
         ex = self.executor
@@ -1213,12 +1268,33 @@ class ServingLayer:
         sset = _shard_set(shards)
         if snap is None:
             snap = field_snapshot(idx, fields, sset)
+        t0 = time.perf_counter()
         results = ex.execute(index, q, shards)
+        cost = None
+        if _stats.enabled():
+            cost = _stats.est_recompute_ms(_fingerprint(key))
+            if cost is None:  # cold fingerprint: the run we just paid
+                cost = (time.perf_counter() - t0) * 1e3
         # store only if no write raced the execution (a racing write
         # would make the cached value's snapshot provenance unclear)
         if field_snapshot(idx, fields, sset) == snap:
-            self.cache.put(key, fields, snap, results)
+            self.cache.put(key, fields, snap, results, cost_ms=cost)
         return results
+
+    @staticmethod
+    def _recompute_cost(key, acc) -> float | None:
+        """Recompute-cost hint for a cache entry: the fingerprint
+        profile's NON-CACHED estimate (est_recompute_ms — the serve
+        EWMA would be talked down to ~0 by the cache's own hits for
+        exactly the entries most worth keeping), else the
+        leader-attributed phase time of the serve that produced it;
+        None (pure LRU) with the statistics catalog disabled."""
+        if not _stats.enabled():
+            return None
+        cost = _stats.est_recompute_ms(_fingerprint(key))
+        if cost is None and acc is not None:
+            cost = sum(acc.phases.values()) * 1e3
+        return cost
 
 
 def _pure_tree(call: Call) -> bool:

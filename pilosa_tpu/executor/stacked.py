@@ -56,7 +56,7 @@ from pilosa_tpu import memory
 from pilosa_tpu.memory import pressure
 from pilosa_tpu.memory.pages import PagedStack, StackRecipe, page_lanes_for
 from pilosa_tpu.models.view import VIEW_STANDARD
-from pilosa_tpu.obs import flight, metrics, roofline
+from pilosa_tpu.obs import flight, metrics, roofline, stats
 from pilosa_tpu.obs.tracing import start_span
 from pilosa_tpu.ops import bitmap as bm
 from pilosa_tpu.ops import bsi as bsi_ops
@@ -84,6 +84,16 @@ def _patch_enabled() -> bool:
     PILOSA_TPU_STACK_PATCH=0 restores the rebuild-on-write behavior
     (the bench A/B switch; config.py [stacked] patch)."""
     return os.environ.get("PILOSA_TPU_STACK_PATCH", "1") != "0"
+
+
+def _patch_max_frac() -> float:
+    """Dirty fraction past which one dense rebuild upload beats
+    scattering runs: the MEASURED patch-vs-rebuild break-even from
+    the statistics catalog once both arms have real volume
+    (stats.patch_break_even_frac), else the static default below —
+    threshold choice only, results identical either way."""
+    f = stats.patch_break_even_frac()
+    return _PATCH_MAX_FRAC if f is None else f
 
 
 # Dirty fraction past which patching loses to one contiguous rebuild
@@ -539,7 +549,7 @@ class TileStackCache:
                 patched_words += plen
         if not segs:
             return 0, 0
-        if patched_words > _PATCH_MAX_FRAC * ps.page_lanes * w:
+        if patched_words > _patch_max_frac() * ps.page_lanes * w:
             block = ps.build_page_host(pi, recipe.lane_words)
             arr = self._commit_block(block)
             local[pi] = arr
@@ -913,6 +923,28 @@ def _code_space(fields_rows):
         shifts.append(acc)
         acc += b
     return bits, shifts, 1 << acc
+
+
+def _groupby_unit_costs(fields_rows, n_combos: int, depth: int,
+                        has_agg: bool, n_shards: int,
+                        width_words: int) -> tuple[float, float]:
+    """(one-pass units, per-combo units) in packed-word ops: the
+    one-pass-vs-per-combo cost model shared by the gate
+    (_groupby_onepass_ok) and the stats-catalog rate calibration
+    (stats.note_gate at the execution sites).  Per-combo pays the
+    full gather + popcount chain per combo; one-pass reads each
+    stream once but pays a ~4x column-domain factor for the
+    unpack/histogram of each payload row.  Sparse combo selections
+    (paged tails, tiny products) stay per-combo under the static
+    1:1 rates."""
+    bits, _shifts, _n_codes = _code_space(fields_rows)
+    agg_percombo = (2 + 2 * depth) if has_agg else 0
+    agg_onepass = (2 + depth) if has_agg else 0
+    per_combo = n_combos * (len(fields_rows) + 1 + agg_percombo)
+    one_pass = (sum(len(rl) for _, rl in fields_rows)
+                + 4 * (sum(bits) + 1 + agg_onepass))
+    scale = max(n_shards, 1) * max(width_words, 1)
+    return float(one_pass * scale), float(per_combo * scale)
 
 
 def _combo_codes(shifts, combos_arr: np.ndarray) -> np.ndarray:
@@ -2027,7 +2059,7 @@ class StackedEngine:
                 patched_words += plen
         if not segs:
             return arr, 0
-        if patched_words > _PATCH_MAX_FRAC * total_words:
+        if patched_words > _patch_max_frac() * total_words:
             return None  # near-total patch: one dense upload wins
         lane_cache: dict[int, np.ndarray] = {}
 
@@ -2367,7 +2399,17 @@ class StackedEngine:
             flight.note_phase(kind, dt)
             if kind == "execute":
                 roofline.note("vhist", op_bytes, dt)
-        return counts[: 1 << depth], counts[1 << depth:]
+        pos_h, neg_h = counts[: 1 << depth], counts[1 << depth:]
+        if filter_call is None and \
+                set(skey) >= set(idx.available_shards):
+            # data-stats harvest (obs/stats.py): an UNFILTERED value
+            # histogram over the FULL shard set is the field's value
+            # distribution — persist the summary for free.  A
+            # filtered one describes the filter, and a shard-subset
+            # one (cluster leg, shards= restriction) describes a
+            # slice — neither may pose as the field
+            stats.note_value_hist(idx.name, field.name, pos_h, neg_h)
+        return pos_h, neg_h
 
     def row_counts(self, idx, rows_stack, filter_call, shards: list[int],
                    pre) -> np.ndarray:
@@ -2587,6 +2629,16 @@ class StackedEngine:
             return False
         return not multi and jax.default_backend() != "tpu"
 
+    def _groupby_unit_model(self, idx, fields_rows, n_combos: int,
+                            depth: int, has_agg: bool,
+                            skey: tuple) -> tuple[float, float]:
+        """(one-pass units, per-combo units) for this shape — the
+        same unit model the gate compares, exposed so the execution
+        sites can note measured seconds against it."""
+        return _groupby_unit_costs(fields_rows, n_combos, depth,
+                                   has_agg, len(skey),
+                                   idx.width // 32)
+
     def _groupby_onepass_ok(self, idx, fields_rows, n_combos: int,
                             depth: int, has_agg: bool,
                             skey: tuple) -> bool:
@@ -2610,17 +2662,17 @@ class StackedEngine:
             return False
         if flag == "1":
             return True
-        # cost in packed-word ops per (shard, word): per-combo pays
-        # the full gather + popcount chain per combo; one-pass reads
-        # each stream once but pays a ~4x column-domain factor for
-        # the unpack/histogram of each payload row.  Sparse combo
-        # selections (paged tails, tiny products) stay per-combo.
-        agg_percombo = (2 + 2 * depth) if has_agg else 0
-        agg_onepass = (2 + depth) if has_agg else 0
-        cost_percombo = n_combos * (len(fields_rows) + 1 + agg_percombo)
-        cost_onepass = (sum(len(rl) for _, rl in fields_rows)
-                        + 4 * (sum(bits) + 1 + agg_onepass))
-        return cost_onepass < cost_percombo
+        cost_onepass, cost_percombo = _groupby_unit_costs(
+            fields_rows, n_combos, depth, has_agg, len(skey),
+            idx.width // 32)
+        # measured seconds-per-unit per arm from the statistics
+        # catalog (stats.note_gate at the execution sites below);
+        # (1.0, 1.0) — the static unit model — until both arms have
+        # samples or with PILOSA_TPU_STATS=0.  Plan choice only:
+        # results are bit-exact on either arm by construction.
+        r_one, r_combo = stats.gate_rates("groupby_onepass",
+                                          "groupby_percombo")
+        return cost_onepass * r_one < cost_percombo * r_combo
 
     def _groupby_onepass_path(self, idx, fields_rows, agg_field, skey,
                               combos, depth: int, signed: bool,
@@ -2994,18 +3046,36 @@ class StackedEngine:
                     or depth > _ONEPASS_KERNEL_MAX_DEPTH):
                 raise Unstackable("groupby min/max needs the one-pass "
                                   "histogram gate")
-            return self._groupby_onepass_path(
+            t_arm = time.perf_counter()
+            out = self._groupby_onepass_path(
                 idx, fields_rows, agg_field, skey, combos, depth,
                 signed, filter_call, pre, agg_op=agg_op)
+            stats.note_gate(
+                "groupby_onepass",
+                self._groupby_unit_model(idx, fields_rows, n_combos,
+                                         depth, True, skey)[0],
+                time.perf_counter() - t_arm)
+            return out
         # one-pass group-code histogram: combo-count-independent
         # traffic, no (R, S, W) gather at all (the group-code stack is
         # (S, CB+1, W) with CB ~ log2 of the combo space)
         if n_combos and self._groupby_onepass_ok(
                 idx, fields_rows, n_combos, depth,
                 agg_field is not None, skey):
-            return self._groupby_onepass_path(
+            # measured-rate calibration for the cost gate: note this
+            # arm's wall seconds against its unit model so the next
+            # gate decision compares measured ms, not constants
+            t_arm = time.perf_counter()
+            out = self._groupby_onepass_path(
                 idx, fields_rows, agg_field, skey, combos, depth,
                 signed, filter_call, pre)
+            stats.note_gate(
+                "groupby_onepass",
+                self._groupby_unit_model(idx, fields_rows, n_combos,
+                                         depth, agg_field is not None,
+                                         skey)[0],
+                time.perf_counter() - t_arm)
+            return out
         kernel = self._groupby_kernel_ok(
             n_combos, len(skey), has_filter=filter_call is not None)
         # memory budget: the XLA path gathers (R, S, W) stacks for
@@ -3022,6 +3092,9 @@ class StackedEngine:
             raise Unstackable(
                 f"groupby row stacks ~{est >> 20} MiB exceed budget")
         if kernel:
+            # gate-rate envelope opens before the filter dispatch:
+            # every arm's sample must bracket the same cost scope
+            t_arm = time.perf_counter()
             filt = None
             if filter_call is not None:
                 # materialize the filter ONCE as an (S, W) device
@@ -3035,9 +3108,21 @@ class StackedEngine:
                     return _zero_groupby_result(n_combos, depth,
                                                 agg_field)
                 filt = self._run(("words", tree0), b0)
-            return self._groupby_kernel_path(
+            out = self._groupby_kernel_path(
                 idx, fields_rows, agg_field, skey, combos, depth,
                 signed, filt=filt)
+            stats.note_gate(
+                "groupby_percombo",
+                self._groupby_unit_model(idx, fields_rows, n_combos,
+                                         depth, agg_field is not None,
+                                         skey)[1],
+                time.perf_counter() - t_arm)
+            return out
+        # gate-rate envelope starts HERE so the XLA arm's sample
+        # brackets the same cost scope as the one-pass/kernel sites
+        # (stack build + plan + dispatch + unpack) — mixed envelopes
+        # would systematically skew the measured gate rates
+        t_arm = time.perf_counter()
         b = PlanBuilder(self, idx, list(skey), pre)
         stack_is = tuple(
             b._add_leaf(self.rows_stack_for(
@@ -3064,11 +3149,22 @@ class StackedEngine:
         out = timed_dispatch(plan,
                              kernels.enabled() and not self.host_only,
                              b.leaves, tuple(b.params) + (sel_all,))
+
+        def note_arm():
+            stats.note_gate(
+                "groupby_percombo",
+                self._groupby_unit_model(idx, fields_rows, n_combos,
+                                         depth,
+                                         agg_field is not None,
+                                         skey)[1],
+                time.perf_counter() - t_arm)
+
         if agg_field is None:
             c = np.asarray(out, dtype=np.int64)   # (n_chunks, C[, S])
             if not red:
                 c = c.sum(axis=-1)
             counts = c.reshape(-1)[:n_combos]
+            note_arm()
             return counts, None
         if red:
             # one flat (2*K + 2*K*P,) fetch, split by layout
@@ -3090,6 +3186,7 @@ class StackedEngine:
         # (n_chunks, P, C) -> (n_chunks*C, P)
         pos = p_.transpose(0, 2, 1).reshape(-1, depth)[:n_combos]
         neg = g_.transpose(0, 2, 1).reshape(-1, depth)[:n_combos]
+        note_arm()
         return counts, (nn, pos, neg)
 
     # shards decoded per device call in decode_stream: bounds the

@@ -494,6 +494,11 @@ def commit(rec: dict | None, duration_s: float, route: str = "solo",
         rec["fingerprint"] = fingerprint
     recorder.record(rec)
     _buffer_phase_samples(acc, rec["trace_id"])
+    # statistics catalog (obs/stats.py): one enabled check + a
+    # lock-free pending append; profile folding is amortized off the
+    # hot path (same budget class as the phase-sample buffer above)
+    from pilosa_tpu.obs import stats as _stats
+    _stats.note_flight(rec)
 
 
 # -- buffered phase-histogram export ----------------------------------------

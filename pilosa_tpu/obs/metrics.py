@@ -174,6 +174,11 @@ class Histogram(_Metric):
     def count(self, **labels) -> int:
         return self._n.get(_label_key(labels), 0)
 
+    def sum(self, **labels) -> float:
+        """Cumulative observed sum for a label set — the statistics
+        catalog derives measured per-byte costs from phase sums."""
+        return self._sum.get(_label_key(labels), 0.0)
+
     def count_le(self, v: float, **labels) -> float:
         """Estimated observations <= v (linear interpolation within
         v's bucket, prometheus histogram_quantile's inverse) — the SLO
@@ -529,6 +534,30 @@ DEVICE_PEAK_GBPS = registry.gauge(
     "pilosa_device_peak_gbps",
     "Peak device bandwidth (PILOSA_TPU_PEAK_GBPS override or the "
     "measured STREAM-style startup probe)")
+
+# -- statistics catalog (obs/stats.py + storage/stats_store.py) --
+# persisted flight/roofline telemetry feeding the engine's cost
+# decisions; the sentinel gauge carries the window/baseline ratio
+# while a fingerprint regresses and 0 after recovery
+STATS_FOLDS = registry.counter(
+    "pilosa_stats_folds_total",
+    "Flight records folded into the statistics catalog")
+STATS_PROFILES = registry.gauge(
+    "pilosa_stats_profiles",
+    "Plan-fingerprint profiles the statistics catalog tracks")
+STATS_PERSIST = registry.counter(
+    "pilosa_stats_persist_total",
+    "Statistics-store events "
+    "(snapshot/tail/load/torn_drop/corrupt_drop)")
+STATS_ADMISSION = registry.counter(
+    "pilosa_stats_admission_total",
+    "Cost-based admission classifications by source (profile = "
+    "measured fingerprint cost; static = query-kind fallback) and "
+    "class")
+PERF_REGRESSION = registry.gauge(
+    "pilosa_perf_regression",
+    "Per-fingerprint perf-regression sentinel: current-window / "
+    "baseline ratio while firing, 0 after recovery")
 
 # -- SLO burn-rate plane (obs/slo.py) --
 SLO_BURN_RATE = registry.gauge(

@@ -1,0 +1,883 @@
+"""Statistics catalog — persisted flight/roofline telemetry that
+drives the engine's cost decisions.
+
+ROADMAP item 3 names the goal: "the observability plane becomes the
+optimizer's statistics catalog".  Before this module every
+flight/roofline signal died with the process and every engine
+decision ran on static heuristics.  The catalog keeps two planes:
+
+- **Data stats** — per-(index, field) row cardinality, per-shard bit
+  counts (shard skew), BSI value summaries harvested for free from
+  the single-pass ``bsi_value_hist`` byproduct.  Maintained
+  incrementally from the ingest path (api.import_bits/import_values)
+  and persisted through a tail log of ingest events.
+
+- **Runtime stats** — per-plan-fingerprint profiles (EWMA of
+  duration, execute-phase device time, bytes streamed, batch
+  occupancy, cache-hit rate) folded in from finished flight records
+  OFF the hot path (lock-free pending append, batch fold), plus
+  per-node cluster attempt latencies and measured cost-gate rates.
+
+Consumers (the catalog is load-bearing, not decorative):
+
+- ``executor/stacked.py`` — the one-pass-vs-per-combo GroupBy gate
+  scales its unit model by measured seconds-per-unit for each arm
+  (:func:`gate_rates`), and the patch-vs-rebuild dirty-fraction
+  threshold becomes the measured break-even
+  (:func:`patch_break_even_frac`) instead of a constant.
+- ``executor/sched.py`` — admission classifies by estimated cost
+  (:func:`est_cost_ms` from the fingerprint profile) with the
+  query-kind walk as the cold-start fallback.
+- ``executor/serving.py`` — ResultCache eviction prefers keeping
+  high-recompute-cost entries.
+- ``cluster/coordinator.py`` — hedge-delay derivation reads the
+  persisted per-node attempt distributions, so hedging is calibrated
+  from the first post-restart query.
+
+A **regression sentinel** compares each fingerprint's fast window
+EWMA against its frozen baseline and exports
+``pilosa_perf_regression{fingerprint,metric}`` (the ratio while
+firing, 0 after recovery).
+
+Kill-switch: ``PILOSA_TPU_STATS=0`` (or ``[stats] enabled=false``)
+disables the whole plane — every consumer falls back to its static
+heuristic, bit-exact by construction (stats only steer plan/schedule
+choices, never results).  Persistence: ``storage/stats_store.py``
+(tmp+rename snapshot + torn-tail-dropping JSONL tail).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+
+from pilosa_tpu.obs import metrics
+
+# fold the pending flight records every N appends (amortizes the
+# catalog lock the same way flight.py amortizes the histogram lock)
+_FOLD_N = 32
+# bounded tables: profiles LRU-evict past this, per-node attempt
+# rings and ingest row sets are capped below
+_MAX_PROFILES = 512
+_MAX_NODE_SAMPLES = 256
+_MAX_CLUSTER_DURS = 512
+_ROWS_CAP = 8192
+
+_enabled: bool | None = None  # None -> resolve from env on each ask
+
+
+def enabled() -> bool:
+    if _enabled is not None:
+        return _enabled
+    return os.environ.get("PILOSA_TPU_STATS", "1") != "0"
+
+
+def _ewma(prev: float | None, v: float, alpha: float) -> float:
+    if prev is None:
+        return v
+    return prev + alpha * (v - prev)
+
+
+class FieldStats:
+    """Data-plane stats for one (index, field)."""
+
+    __slots__ = ("rows", "rows_capped", "shard_bits", "vmin", "vmax",
+                 "vcount", "vhist")
+
+    def __init__(self):
+        self.rows: set[int] = set()
+        self.rows_capped = False
+        self.shard_bits: dict[int, int] = {}
+        self.vmin: float | None = None
+        self.vmax: float | None = None
+        self.vcount = 0
+        self.vhist: dict | None = None
+
+    def note(self, rows, shard_bits: dict, vmin=None, vmax=None,
+             vcount: int = 0):
+        for r in rows:
+            if len(self.rows) >= _ROWS_CAP:
+                self.rows_capped = True
+                break
+            self.rows.add(int(r))
+        for s, n in shard_bits.items():
+            s = int(s)
+            self.shard_bits[s] = self.shard_bits.get(s, 0) + int(n)
+        if vmin is not None:
+            self.vmin = vmin if self.vmin is None else min(self.vmin,
+                                                           vmin)
+        if vmax is not None:
+            self.vmax = vmax if self.vmax is None else max(self.vmax,
+                                                           vmax)
+        self.vcount += int(vcount)
+
+    def skew(self) -> float | None:
+        """max-shard / mean-shard bit-count ratio (1.0 = perfectly
+        even) — the shard-skew input to cost estimation."""
+        if not self.shard_bits:
+            return None
+        vals = list(self.shard_bits.values())
+        mean = sum(vals) / len(vals)
+        return round(max(vals) / mean, 4) if mean > 0 else None
+
+    def payload(self) -> dict:
+        out = {"rows": len(self.rows), "rows_capped": self.rows_capped,
+               "shards": len(self.shard_bits),
+               "bits": sum(self.shard_bits.values())}
+        skew = self.skew()
+        if skew is not None:
+            out["shard_skew"] = skew
+        if self.vcount:
+            out["values"] = {"count": self.vcount, "min": self.vmin,
+                             "max": self.vmax}
+        if self.vhist is not None:
+            out["value_hist"] = dict(self.vhist)
+        return out
+
+    def to_state(self) -> dict:
+        return {"rows": sorted(self.rows),
+                "rows_capped": self.rows_capped,
+                "shard_bits": {str(k): v
+                               for k, v in self.shard_bits.items()},
+                "vmin": self.vmin, "vmax": self.vmax,
+                "vcount": self.vcount, "vhist": self.vhist}
+
+    @classmethod
+    def from_state(cls, st: dict) -> "FieldStats":
+        fs = cls()
+        fs.rows = {int(r) for r in st.get("rows", ())}
+        fs.rows_capped = bool(st.get("rows_capped"))
+        fs.shard_bits = {int(k): int(v)
+                         for k, v in st.get("shard_bits", {}).items()}
+        fs.vmin = st.get("vmin")
+        fs.vmax = st.get("vmax")
+        fs.vcount = int(st.get("vcount", 0))
+        fs.vhist = st.get("vhist")
+        return fs
+
+
+class FingerprintProfile:
+    """Runtime-plane profile for one plan fingerprint.  ``ms`` is the
+    steady cost estimate (mid EWMA); ``fast_ms`` / ``base_ms`` are
+    the sentinel pair — the baseline FREEZES while a regression fires
+    so the fault can't be absorbed into it."""
+
+    __slots__ = ("n", "ms", "exec_ms", "recompute_ms", "bytes",
+                 "batch", "hits", "total", "fast_ms", "base_ms",
+                 "firing")
+
+    def __init__(self):
+        self.n = 0
+        self.ms: float | None = None
+        self.exec_ms: float | None = None
+        # EWMA over NON-cached serves only: what this plan costs to
+        # actually COMPUTE.  `ms` (all serves, cache hits included)
+        # is the admission signal — serving a cached entry costs the
+        # engine nothing, so it may ride the point lane; recompute_ms
+        # is the cache-eviction signal — the cache's own hits must
+        # not talk it into evicting its most valuable entries.
+        self.recompute_ms: float | None = None
+        self.bytes: float | None = None
+        self.batch: float | None = None
+        self.hits = 0
+        self.total = 0
+        self.fast_ms: float | None = None
+        self.base_ms: float | None = None
+        self.firing = False
+
+    def fold(self, rec: dict, ratio: float = 3.0,
+             min_samples: int = 6):
+        d = float(rec.get("duration_ms", 0.0))
+        phases = rec.get("phases", {}) or {}
+        self.n += 1
+        self.total += 1
+        if rec.get("route") == "cached":
+            self.hits += 1
+        else:
+            self.recompute_ms = _ewma(self.recompute_ms, d, 0.2)
+        self.ms = _ewma(self.ms, d, 0.2)
+        self.exec_ms = _ewma(
+            self.exec_ms,
+            float(phases.get("execute", 0.0))
+            + float(phases.get("compile", 0.0)), 0.2)
+        self.bytes = _ewma(self.bytes,
+                           float(rec.get("bytes_moved", 0)), 0.2)
+        self.batch = _ewma(self.batch, float(rec.get("batch", 1)), 0.2)
+        self.fast_ms = _ewma(self.fast_ms, d, 0.5)
+        # sentinel detection PER RECORD, before the baseline updates:
+        # batch-folded slow samples must not drip into the baseline
+        # faster than the comparison runs, or a sustained slowdown
+        # could be absorbed without ever crossing the ratio
+        if self.base_ms is not None and self.base_ms >= 0.01 \
+                and self.n >= min_samples:
+            self.firing = (self.fast_ms / self.base_ms) >= ratio
+        # baseline skips the first samples (cold compile / cold cache
+        # would seed it 100x high and the sentinel could never fire)
+        # and FREEZES while a regression fires (the fault must not be
+        # absorbed into the baseline it is measured against)
+        if not self.firing and self.n > 3:
+            self.base_ms = _ewma(self.base_ms, d, 0.05)
+
+    def payload(self) -> dict:
+        out = {"n": self.n,
+               "ms": round(self.ms or 0.0, 4),
+               "execute_ms": round(self.exec_ms or 0.0, 4),
+               "bytes": int(self.bytes or 0),
+               "batch": round(self.batch or 1.0, 2),
+               "cache_hit_rate": round(self.hits / self.total, 4)
+               if self.total else 0.0}
+        if self.base_ms is not None:
+            out["baseline_ms"] = round(self.base_ms, 4)
+            out["window_ms"] = round(self.fast_ms or 0.0, 4)
+        if self.firing:
+            out["regressing"] = True
+        return out
+
+    def to_state(self) -> dict:
+        return {"n": self.n, "ms": self.ms, "exec_ms": self.exec_ms,
+                "recompute_ms": self.recompute_ms,
+                "bytes": self.bytes, "batch": self.batch,
+                "hits": self.hits, "total": self.total,
+                "fast_ms": self.fast_ms, "base_ms": self.base_ms}
+
+    @classmethod
+    def from_state(cls, st: dict) -> "FingerprintProfile":
+        p = cls()
+        p.n = int(st.get("n", 0))
+        p.ms = st.get("ms")
+        p.exec_ms = st.get("exec_ms")
+        p.recompute_ms = st.get("recompute_ms")
+        p.bytes = st.get("bytes")
+        p.batch = st.get("batch")
+        p.hits = int(st.get("hits", 0))
+        p.total = int(st.get("total", 0))
+        p.fast_ms = st.get("fast_ms")
+        p.base_ms = st.get("base_ms")
+        return p
+
+
+class StatsCatalog:
+    """The process statistics catalog: data + runtime planes, the
+    regression sentinel, and the persistence glue."""
+
+    def __init__(self, path: str | None = None,
+                 heavy_cost_ms: float = 5.0,
+                 regression_ratio: float = 3.0,
+                 regression_min_samples: int = 6,
+                 snapshot_interval_s: float = 60.0):
+        self.heavy_cost_ms = float(heavy_cost_ms)
+        self.regression_ratio = float(regression_ratio)
+        self.regression_min_samples = int(regression_min_samples)
+        self.snapshot_interval_s = float(snapshot_interval_s)
+        self._lock = threading.Lock()
+        # serializes (apply event + tail append) against (state
+        # capture + snapshot): without it an ingest event landing
+        # between the two halves of a save could be stamped as
+        # folded-into-the-snapshot and truncated while the snapshot
+        # predates it — lost from persistence
+        self._persist_mu = threading.Lock()
+        self._fields: dict[tuple[str, str], FieldStats] = {}
+        self._profiles: OrderedDict[str, FingerprintProfile] = \
+            OrderedDict()
+        self._node_ms: dict[str, deque] = {}
+        self._cluster_durs: deque = deque(maxlen=_MAX_CLUSTER_DURS)
+        # gate-arm rates: op -> (EWMA sec-per-unit, samples, t_mono)
+        self._gate_rates: dict[str, tuple[float, int, float]] = {}
+        # lock-free pending list (list.append is GIL-atomic): flight
+        # records queue here and fold in batches off the hot path
+        self._pending: list[dict] = []
+        self._patch_memo: tuple[float, float | None] | None = None
+        self._last_save = time.monotonic()
+        self.store = None
+        self.store_path: str | None = None  # survives detach_store
+        self.loaded_from_disk = False
+        if path:
+            self._open_store(path)
+
+    # -- persistence ---------------------------------------------------
+
+    def _open_store(self, path: str):
+        from pilosa_tpu.storage.stats_store import StatsStore
+        self.store = StatsStore(path)
+        self.store_path = path
+        state, events, torn = self.store.load()
+        if state is not None:
+            self._load_state(state)
+            self.loaded_from_disk = True
+        for ev in events:
+            self._apply_event(ev)
+            self.loaded_from_disk = True
+        if torn or self.store.tail_over_threshold():
+            # recompact immediately: a torn tail must not be appended
+            # after, and an over-threshold tail means the last run
+            # died between threshold and compaction
+            self.store.compact(self._state())
+
+    def _state(self) -> dict:
+        with self._lock:
+            return {
+                "v": 1,
+                "fields": {f"{i}\x00{f}": fs.to_state()
+                           for (i, f), fs in self._fields.items()},
+                "profiles": {fp: p.to_state()
+                             for fp, p in self._profiles.items()},
+                "nodes": {n: [round(v, 3) for v in dq]
+                          for n, dq in self._node_ms.items()},
+                "cluster_durs": [round(v, 3)
+                                 for v in self._cluster_durs],
+                "gates": {op: [r, n]
+                          for op, (r, n, _t)
+                          in self._gate_rates.items()},
+            }
+
+    def _load_state(self, st: dict):
+        with self._lock:
+            for key, fst in st.get("fields", {}).items():
+                i, _, f = key.partition("\x00")
+                self._fields[(i, f)] = FieldStats.from_state(fst)
+            for fp, pst in st.get("profiles", {}).items():
+                self._profiles[fp] = FingerprintProfile.from_state(pst)
+            for n, lst in st.get("nodes", {}).items():
+                self._node_ms[n] = deque(
+                    (float(v) for v in lst), maxlen=_MAX_NODE_SAMPLES)
+            # REPLACE, don't extend: a same-path reopen after a
+            # detach would otherwise duplicate every persisted
+            # duration on top of the in-memory copy
+            self._cluster_durs.clear()
+            self._cluster_durs.extend(
+                float(v) for v in st.get("cluster_durs", ()))
+            now = time.monotonic()
+            for op, (r, n) in st.get("gates", {}).items():
+                # ages don't persist: loaded rates count as fresh so
+                # post-restart gate decisions equal pre-restart ones,
+                # then age out normally if the arm never runs again
+                self._gate_rates[op] = (float(r), int(n), now)
+
+    def save(self):
+        """Snapshot the full catalog state (tmp+rename; the
+        ``stats-snapshot`` fault seam crashes mid-write without ever
+        exposing a half-written file).  The persist mutex makes
+        (state capture, watermark stamp) atomic against concurrent
+        ingest notes."""
+        if self.store is None:
+            return
+        self.fold()
+        with self._persist_mu:
+            self.store.compact(self._state())
+        self._last_save = time.monotonic()
+
+    def maybe_save(self):
+        if self.store is None:
+            return
+        if (time.monotonic() - self._last_save
+                >= self.snapshot_interval_s
+                or self.store.tail_over_threshold()):
+            self.save()
+
+    def detach_store(self):
+        """Close and drop the persistence store (the owning server
+        is shutting down): later notes stay in memory instead of
+        appending to a dead server's file — or a deleted data dir."""
+        with self._persist_mu:
+            if self.store is not None:
+                self.store.close()
+                self.store = None
+                self.loaded_from_disk = False
+
+    def close(self):
+        if self.store is not None:
+            self.store.close()
+
+    # -- data plane (ingest path) --------------------------------------
+
+    def note_ingest(self, index: str, field: str, rows=None,
+                    cols=None, values=None, width: int = 1 << 20):
+        """Fold one import call into the field's data stats and
+        append the event to the persistence tail.  Called from
+        api.import_bits/import_values after the write landed."""
+        import numpy as np
+        ev: dict = {"t": "ingest", "i": index, "f": field}
+        if rows is not None and len(rows):
+            # vectorized: a bulk import passes millions of entries and
+            # this sits on the ingest path — no Python per-bit loops
+            uniq = np.unique(np.asarray(rows).astype(np.int64))
+            ev["rows"] = [int(r) for r in uniq[:_ROWS_CAP]]
+        if cols is not None and len(cols):
+            sh, cnt = np.unique(
+                np.asarray(cols).astype(np.int64) // width,
+                return_counts=True)
+            ev["sb"] = {str(int(s)): int(c)
+                        for s, c in zip(sh, cnt)}
+        if values is not None and len(values):
+            va = np.asarray(values)
+            if va.dtype.kind in "iu":
+                ev["vmin"], ev["vmax"] = int(va.min()), int(va.max())
+                ev["vn"] = int(va.size)
+            elif va.dtype.kind == "f":
+                ev["vmin"] = float(va.min())
+                ev["vmax"] = float(va.max())
+                ev["vn"] = int(va.size)
+        with self._persist_mu:
+            self._apply_event(ev)
+            if self.store is not None:
+                self.store.append(ev)
+
+    def _apply_event(self, ev: dict):
+        if ev.get("t") != "ingest":
+            return
+        key = (str(ev.get("i", "")), str(ev.get("f", "")))
+        with self._lock:
+            fs = self._fields.get(key)
+            if fs is None:
+                fs = self._fields[key] = FieldStats()
+            fs.note(ev.get("rows", ()), ev.get("sb", {}),
+                    vmin=ev.get("vmin"), vmax=ev.get("vmax"),
+                    vcount=ev.get("vn", 0))
+
+    def note_value_hist(self, index: str, field: str, pos, neg):
+        """Harvest the single-pass ``bsi_value_hist`` byproduct: a
+        per-value histogram just computed on the query path becomes
+        the field's value-distribution summary for free."""
+        import numpy as np
+        pos = np.asarray(pos)
+        neg = np.asarray(neg)
+        pnz = np.flatnonzero(pos)
+        nnz = np.flatnonzero(neg)
+        summary = {
+            "depth": int(pos.shape[0]).bit_length() - 1,
+            "count": int(pos.sum() + neg.sum()),
+            "distinct": int(len(pnz) + len(nnz)),
+        }
+        if len(pnz) or len(nnz):
+            summary["min"] = (-int(nnz.max()) if len(nnz)
+                              else int(pnz.min()))
+            summary["max"] = (int(pnz.max()) if len(pnz)
+                              else -int(nnz.min()))
+        key = (index, field)
+        with self._lock:
+            fs = self._fields.get(key)
+            if fs is None:
+                fs = self._fields[key] = FieldStats()
+            fs.vhist = summary
+
+    def field_stats(self, index: str, field: str) -> dict | None:
+        with self._lock:
+            fs = self._fields.get((index, field))
+            return fs.payload() if fs is not None else None
+
+    # -- runtime plane (flight fold) -----------------------------------
+
+    def note_flight(self, rec: dict):
+        """Queue one finished flight record for folding (lock-free
+        append; amortized batch fold)."""
+        pend = self._pending
+        pend.append(rec)
+        if len(pend) >= _FOLD_N:
+            self.fold()
+
+    def fold(self):
+        """Drain the pending records into the profiles / node tables
+        and run the sentinel over the touched fingerprints.  The
+        pending swap happens under the catalog lock: fold() is
+        reachable concurrently (query threads at _FOLD_N, the
+        maintenance ticker, /debug/stats), and an unlocked two-target
+        swap would let two folders drain the SAME buffer — every
+        record double-folded.  note_flight's append stays lock-free;
+        an append that captured the list mid-swap can lose that one
+        record, the same accepted race as flight.py's sample buffer."""
+        with self._lock:
+            buf, self._pending = self._pending, []
+        if not buf:
+            return
+        touched: list[str] = []
+        evicted_firing: list[str] = []
+        with self._lock:
+            for rec in buf:
+                fp = rec.get("fingerprint")
+                if fp is not None and rec.get("error") is None:
+                    p = self._profiles.get(fp)
+                    if p is None:
+                        p = self._profiles[fp] = FingerprintProfile()
+                        while len(self._profiles) > _MAX_PROFILES:
+                            ofp, op = self._profiles.popitem(
+                                last=False)
+                            if op.firing:
+                                # the gauge would otherwise stay at
+                                # its last nonzero ratio forever —
+                                # _sentinel can't clear a profile
+                                # that no longer exists
+                                evicted_firing.append(ofp)
+                    else:
+                        self._profiles.move_to_end(fp)
+                    p.fold(rec, ratio=self.regression_ratio,
+                           min_samples=self.regression_min_samples)
+                    touched.append(fp)
+                if rec.get("route") == "cluster" and \
+                        rec.get("error") is None:
+                    self._cluster_durs.append(
+                        float(rec.get("duration_ms", 0.0)))
+                    for a in rec.get("attempts", ()):
+                        if not str(a.get("outcome", "")).endswith("ok"):
+                            continue
+                        node = str(a.get("node", ""))
+                        dq = self._node_ms.get(node)
+                        if dq is None:
+                            dq = self._node_ms[node] = deque(
+                                maxlen=_MAX_NODE_SAMPLES)
+                        dq.append(float(a.get("ms", 0.0)))
+            n_profiles = len(self._profiles)
+        metrics.STATS_FOLDS.inc(len(buf))
+        metrics.STATS_PROFILES.set(n_profiles)
+        for fp in evicted_firing:
+            metrics.PERF_REGRESSION.set(0.0, fingerprint=fp,
+                                        metric="duration_ms")
+        for fp in set(touched):
+            self._sentinel(fp)
+
+    # -- regression sentinel -------------------------------------------
+
+    def _sentinel(self, fp: str):
+        """Export one fingerprint's sentinel state (detection ran
+        per-record inside FingerprintProfile.fold) as
+        ``pilosa_perf_regression{fingerprint,metric}``: the ratio
+        while firing, an explicit 0 once it recovers — a gauge
+        series exists only for fingerprints that have ever fired, so
+        label cardinality tracks incidents, not traffic."""
+        with self._lock:
+            p = self._profiles.get(fp)
+            if p is None:
+                return
+            base, fast, firing = p.base_ms, p.fast_ms, p.firing
+        if firing and base:
+            metrics.PERF_REGRESSION.set(round(fast / base, 3),
+                                        fingerprint=fp,
+                                        metric="duration_ms")
+        elif metrics.PERF_REGRESSION.value(fingerprint=fp,
+                                           metric="duration_ms"):
+            metrics.PERF_REGRESSION.set(0.0, fingerprint=fp,
+                                        metric="duration_ms")
+
+    def regressions(self) -> list[dict]:
+        self.fold()
+        out = []
+        with self._lock:
+            items = list(self._profiles.items())
+        for fp, p in items:
+            if p.firing and p.base_ms:
+                out.append({"fingerprint": fp, "metric": "duration_ms",
+                            "baseline_ms": round(p.base_ms, 4),
+                            "window_ms": round(p.fast_ms or 0.0, 4),
+                            "ratio": round((p.fast_ms or 0.0)
+                                           / p.base_ms, 3)})
+        return out
+
+    # -- consumers -----------------------------------------------------
+
+    def profile(self, fingerprint: str) -> FingerprintProfile | None:
+        with self._lock:
+            return self._profiles.get(fingerprint)
+
+    def est_cost_ms(self, fingerprint: str) -> float | None:
+        """Estimated SERVE cost for a plan fingerprint (cache hits
+        included — the admission signal: a reliably cache-served
+        query costs the engine nothing and may ride the point lane;
+        after an invalidation the estimate re-adapts within a few
+        direct serves), or None below the confidence floor."""
+        with self._lock:
+            p = self._profiles.get(fingerprint)
+            if p is None or p.n < 3 or p.ms is None:
+                return None
+            return p.ms
+
+    def est_recompute_ms(self, fingerprint: str) -> float | None:
+        """Estimated RECOMPUTE cost (non-cached serves only) — the
+        cache-eviction signal: the cache's own sub-ms hits must not
+        talk the estimate down for exactly the entries most worth
+        keeping."""
+        with self._lock:
+            p = self._profiles.get(fingerprint)
+            if p is None:
+                return None
+            return p.recompute_ms
+
+    # a gate arm unsampled this long falls back to the static unit
+    # model, letting the model-preferred arm run (and re-calibrate):
+    # the anti-latch for "the losing arm never gets new samples"
+    _GATE_STALE_S = 600.0
+
+    def note_gate(self, op: str, units: float, seconds: float):
+        """Fold one measured cost-gate arm execution (e.g.
+        ``groupby_onepass``): EWMA of seconds-per-unit against the
+        gate's own unit model, so the gate compares measured rates
+        instead of assuming 1:1.  A sample >10x the current rate
+        (a recompile riding the wall time, a GC pause) folds with a
+        much smaller alpha — one outlier must not flip the gate onto
+        the slower arm and latch there."""
+        if units <= 0 or seconds <= 0:
+            return
+        sample = seconds / units
+        with self._lock:
+            rate, n, _t = self._gate_rates.get(op, (None, 0, 0.0))
+            alpha = 0.3
+            if rate is not None and sample > 10.0 * rate:
+                alpha = 0.05
+            self._gate_rates[op] = (_ewma(rate, sample, alpha),
+                                    n + 1, time.monotonic())
+
+    def gate_rates(self, op_a: str, op_b: str,
+                   min_samples: int = 3) -> tuple[float, float]:
+        """Measured seconds-per-unit for two gate arms, or (1.0, 1.0)
+        — the static-model fallback — until BOTH arms have enough
+        FRESH samples (an arm the gate stopped choosing ages out, so
+        a wrong rate cannot latch forever)."""
+        with self._lock:
+            ra = self._gate_rates.get(op_a)
+            rb = self._gate_rates.get(op_b)
+        now = time.monotonic()
+        for r in (ra, rb):
+            if r is None or r[1] < min_samples or not r[0] \
+                    or now - r[2] > self._GATE_STALE_S:
+                return 1.0, 1.0
+        return ra[0], rb[0]
+
+    def patch_break_even_frac(self) -> float | None:
+        """Measured patch-vs-rebuild break-even dirty fraction from
+        the maintenance counters (bytes patched/rebuilt vs the
+        stack_patch/stack_rebuild phase time): patching wins while
+        dirty_bytes * cost_per_patched_byte < total_bytes *
+        cost_per_rebuilt_byte, i.e. frac* = c_rebuild / c_patch.
+        None (→ static threshold) until both arms have real volume.
+        Memoized 1 s — this sits on the write path."""
+        now = time.monotonic()
+        memo = self._patch_memo
+        if memo is not None and now - memo[0] < 1.0:
+            return memo[1]
+        from pilosa_tpu.obs import flight
+        flight.flush_metrics()
+        patched_b = metrics.STACK_MAINT_BYTES.value(kind="patched")
+        rebuilt_b = metrics.STACK_MAINT_BYTES.value(kind="rebuilt")
+        patch_s = metrics.PHASE_DURATION.sum(phase="stack_patch")
+        reb_s = metrics.PHASE_DURATION.sum(phase="stack_rebuild")
+        out = None
+        if patched_b >= (1 << 18) and rebuilt_b >= (1 << 18) \
+                and patch_s > 1e-3 and reb_s > 1e-3:
+            c_patch = patch_s / patched_b
+            c_rebuild = reb_s / rebuilt_b
+            out = min(max(c_rebuild / c_patch, 0.05), 0.95)
+        self._patch_memo = (now, out)
+        return out
+
+    def hedge_samples(self, min_records: int = 32):
+        """Per-node attempt samples + cluster durations for the
+        hedge-delay derivation, or None when the catalog holds too
+        few to beat the in-memory flight ring."""
+        self.fold()
+        with self._lock:
+            by_node = {n: list(dq) for n, dq in self._node_ms.items()
+                       if dq}
+            durs = list(self._cluster_durs)
+        atts = sum(len(v) for v in by_node.values())
+        if atts < min_records and len(durs) < min_records:
+            return None
+        return by_node, durs
+
+    # -- introspection (/debug/stats) ----------------------------------
+
+    def payload(self, index: str | None = None,
+                fingerprint: str | None = None,
+                limit: int | None = None) -> dict:
+        self.fold()
+        with self._lock:
+            fields = {f"{i}/{f}": fs.payload()
+                      for (i, f), fs in sorted(self._fields.items())
+                      if index is None or i == index}
+            profs = [(fp, p.payload())
+                     for fp, p in reversed(self._profiles.items())
+                     if fingerprint is None or fp == fingerprint]
+            nodes = {n: {"n": len(dq),
+                         "p50_ms": round(sorted(dq)[len(dq) // 2], 3)}
+                     for n, dq in sorted(self._node_ms.items()) if dq}
+            gates = {op: {"sec_per_unit": r, "n": n}
+                     for op, (r, n, _t)
+                     in sorted(self._gate_rates.items())}
+        if limit is not None:
+            profs = profs[: max(0, int(limit))]
+        out = {
+            "enabled": enabled(),
+            "data": fields,
+            "runtime": dict(profs),
+            "nodes": nodes,
+            "gates": gates,
+            "regressions": self.regressions(),
+            "knobs": {
+                "heavy_cost_ms": self.heavy_cost_ms,
+                "regression_ratio": self.regression_ratio,
+                "regression_min_samples": self.regression_min_samples,
+            },
+        }
+        if self.store is not None:
+            out["store"] = {"path": self.store.path,
+                            "loaded": self.loaded_from_disk,
+                            "tail_records": self.store.tail_records}
+        return out
+
+    def clear(self):
+        """Test seam: forget everything in memory (disk untouched)."""
+        with self._lock:
+            self._fields.clear()
+            self._profiles.clear()
+            self._node_ms.clear()
+            self._cluster_durs.clear()
+            self._gate_rates.clear()
+            self._pending = []
+            self._patch_memo = None
+            self.loaded_from_disk = False
+
+
+# ---------------------------------------------------------------------------
+# process-global catalog + module-level hot-path entries
+# ---------------------------------------------------------------------------
+
+_catalog: StatsCatalog | None = None
+_cat_lock = threading.Lock()
+
+
+def get() -> StatsCatalog:
+    # double-checked fast path: get() sits on the per-query hot path
+    # (note_flight, est_cost_ms, gate_rates) — steady state must not
+    # contend on the creation mutex (the global read is GIL-atomic)
+    global _catalog
+    cat = _catalog
+    if cat is not None:
+        return cat
+    with _cat_lock:
+        if _catalog is None:
+            _catalog = StatsCatalog()
+        return _catalog
+
+
+def configure(enabled: bool | None = None, path: str | None = None,
+              heavy_cost_ms: float | None = None,
+              regression_ratio: float | None = None,
+              regression_min_samples: int | None = None,
+              snapshot_interval_s: float | None = None) -> StatsCatalog:
+    """Apply the [stats] config knobs.  ``enabled=None`` leaves the
+    env kill-switch (PILOSA_TPU_STATS) in charge.  A path CHANGE
+    reopens the store (loading its persisted state); the in-memory
+    planes are preserved across reconfigures."""
+    global _enabled, _catalog
+    _enabled = enabled
+    cat = get()
+    if heavy_cost_ms is not None:
+        cat.heavy_cost_ms = float(heavy_cost_ms)
+    if regression_ratio is not None:
+        cat.regression_ratio = float(regression_ratio)
+    if regression_min_samples is not None:
+        cat.regression_min_samples = int(regression_min_samples)
+    if snapshot_interval_s is not None:
+        cat.snapshot_interval_s = float(snapshot_interval_s)
+    if path is not None:
+        if cat.store_path != path:
+            # a DIFFERENT data dir: the catalog follows the store —
+            # carrying another dir's in-memory state forward would
+            # write one holder's stats into another's file
+            if cat.store is not None:
+                cat.store.close()
+                cat.store = None
+            cat.clear()
+            cat._open_store(path)
+        elif cat.store is None:
+            # same path, store detached (owning server closed):
+            # reattach and reload the snapshot we saved then
+            cat._open_store(path)
+    return cat
+
+
+def swap(catalog: StatsCatalog | None) -> StatsCatalog | None:
+    """Test seam: replace the process catalog, returning the prior
+    one so fixtures can restore exactly what they found."""
+    global _catalog
+    with _cat_lock:
+        prev, _catalog = _catalog, catalog
+    return prev
+
+
+def note_flight(rec: dict):
+    """Hot-path entry (flight.commit): one enabled check + one
+    lock-free list append; folding is amortized."""
+    if not enabled():
+        return
+    get().note_flight(rec)
+
+
+def note_ingest(index: str, field: str, rows=None, cols=None,
+                values=None, width: int = 1 << 20):
+    if not enabled():
+        return
+    try:
+        get().note_ingest(index, field, rows=rows, cols=cols,
+                          values=values, width=width)
+    except Exception:
+        pass  # stats must never fail a write
+
+
+def note_value_hist(index: str, field: str, pos, neg):
+    if not enabled():
+        return
+    try:
+        get().note_value_hist(index, field, pos, neg)
+    except Exception:
+        pass
+
+
+def note_gate(op: str, units: float, seconds: float):
+    if not enabled():
+        return
+    get().note_gate(op, units, seconds)
+
+
+def gate_rates(op_a: str, op_b: str) -> tuple[float, float]:
+    if not enabled():
+        return 1.0, 1.0
+    return get().gate_rates(op_a, op_b)
+
+
+def patch_break_even_frac() -> float | None:
+    if not enabled():
+        return None
+    return get().patch_break_even_frac()
+
+
+def est_cost_ms(fingerprint: str) -> float | None:
+    if not enabled():
+        return None
+    return get().est_cost_ms(fingerprint)
+
+
+def est_recompute_ms(fingerprint: str) -> float | None:
+    if not enabled():
+        return None
+    return get().est_recompute_ms(fingerprint)
+
+
+def heavy_cost_ms() -> float:
+    return get().heavy_cost_ms
+
+
+def hedge_samples(min_records: int = 32):
+    if not enabled():
+        return None
+    return get().hedge_samples(min_records=min_records)
+
+
+def tick():
+    """Maintenance-ticker hook (server/http.py): fold pending
+    records, refresh the sentinel, persist on the snapshot cadence."""
+    try:
+        cat = get()
+        cat.fold()
+        cat.maybe_save()
+    except Exception:
+        pass  # the stats plane must never take the ticker down

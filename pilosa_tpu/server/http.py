@@ -102,6 +102,11 @@ class Server:
         # SLO burn-rate plane ([slo]): the maintenance ticker below
         # feeds its sample ring
         config.apply_slo_settings()
+        # statistics catalog ([stats]): persisted flight/roofline
+        # telemetry feeding the cost gates, admission classing, cache
+        # eviction, and hedge derivation; persisted under the
+        # holder's data dir so a restarted node plans warm
+        config.apply_stats_settings(data_dir=self.holder.path)
         if (self.api.executor.serving is not None
                 and config.memory_prefetch):
             self.api.executor.serving.start_prefetcher(
@@ -182,12 +187,37 @@ class Server:
                 # burn-rate windows have history between scrapes
                 from pilosa_tpu.obs import slo
                 slo.tick()
+                # statistics catalog: fold pending flight records,
+                # refresh the regression sentinel, snapshot on cadence
+                from pilosa_tpu.obs import stats
+                stats.tick()
             except Exception as e:
                 self.logger.error("maintenance tick failed: %s", e)
 
     def close(self):
         from pilosa_tpu.obs import testhook
         testhook.closed("http.Server", self)
+        # persist the statistics catalog on clean shutdown — a node
+        # restarted inside the snapshot interval must still plan
+        # warm (no-op when persistence is off) — and DETACH the
+        # store when it lives under this server's data dir: later
+        # process activity must not append into a dead server's file
+        # (or a deleted tmp dir in tests)
+        from pilosa_tpu.obs import stats
+        try:
+            cat = stats.get()
+            cat.save()
+            # detach only when THIS server's data dir owns the store:
+            # in a multi-server process the last-configured server
+            # owns it, each server detaches its own on close (so no
+            # appends outlive the owning dir), and detaching another
+            # live server's store here would orphan its persistence —
+            # nothing reattaches outside Server.__init__
+            if cat.store is not None and self.holder.path and \
+                    cat.store.path.startswith(self.holder.path):
+                cat.detach_store()
+        except Exception as e:
+            self.logger.warn("stats snapshot on close failed: %s", e)
         if self.api.executor.serving is not None:
             self.api.executor.serving.stop_prefetcher()
         if self.stream is not None:
@@ -262,6 +292,9 @@ class Server:
         # SLO burn-rate plane (obs/slo.py): multi-window error-budget
         # burn over the latency histogram + typed-error counters
         r(Route("GET", "/debug/slo", self._get_debug_slo))
+        # statistics catalog (obs/stats.py): per-field data stats +
+        # per-fingerprint runtime profiles + the regression sentinel
+        r(Route("GET", "/debug/stats", self._get_debug_stats))
         # fault-injection registry (obs/faults.py): armed rules with
         # fire counts — the chaos-operator's view of what is live
         r(Route("GET", "/debug/faults", self._get_debug_faults))
@@ -362,21 +395,15 @@ class Server:
         from pilosa_tpu.obs import flight
         q = req.query
         limit = int(q.get("limit", q.get("n", ["100"]))[0])
-        route = q.get("route", [None])[0]
-        tenant = q.get("tenant", [None])[0]
-        since_ms = q.get("since_ms", [None])[0]
         # scan the whole ring, filter, then truncate — "matched" is
         # the pre-truncation count so curl users see how much more a
         # bigger limit would return (a debug endpoint can afford the
         # full-ring walk)
-        recs = flight.recorder.recent(len(flight.recorder))
-        if route is not None:
-            recs = [r for r in recs if r.get("route") == route]
-        if tenant is not None:
-            recs = [r for r in recs if r.get("tenant") == tenant]
-        if since_ms is not None:
-            cut = float(since_ms) / 1e3
-            recs = [r for r in recs if r.get("start", 0.0) >= cut]
+        recs = filter_flight_records(
+            flight.recorder.recent(len(flight.recorder)),
+            route=q.get("route", [None])[0],
+            tenant=q.get("tenant", [None])[0],
+            since_ms=q.get("since_ms", [None])[0])
         return {"enabled": flight.recorder.enabled,
                 "matched": len(recs),
                 "queries": recs[:max(0, limit)]}
@@ -387,6 +414,20 @@ class Server:
         configured window."""
         from pilosa_tpu.obs import slo
         return slo.get().evaluate()
+
+    def _get_debug_stats(self, req):
+        """Statistics catalog (obs/stats.py): data stats per
+        (index, field), runtime profiles per plan fingerprint, gate
+        rates, per-node attempt summaries, and the active perf
+        regressions.  Filters: ?index= ?fingerprint= ?limit=N
+        (newest-N profiles)."""
+        from pilosa_tpu.obs import stats
+        q = req.query
+        limit = q.get("limit", [None])[0]
+        return stats.get().payload(
+            index=q.get("index", [None])[0],
+            fingerprint=q.get("fingerprint", [None])[0],
+            limit=int(limit) if limit is not None else None)
 
     def _get_debug_trace(self, req):
         """Recent flight records as Chrome trace_event JSON — save
@@ -824,6 +865,22 @@ class Server:
         from pilosa_tpu.obs import flight
         flight.flush_metrics()  # JSON scrapes see current data too
         return metrics.registry.render_json()
+
+
+def filter_flight_records(recs: list, route=None, tenant=None,
+                          since_ms=None) -> list:
+    """The /debug/queries filter predicates (route / tenant /
+    since_ms) — ONE implementation shared with the federated
+    /debug/cluster/queries (cluster/coordinator.py) so the merged
+    endpoint applies exactly what the per-node endpoint does."""
+    if route is not None:
+        recs = [r for r in recs if r.get("route") == route]
+    if tenant is not None:
+        recs = [r for r in recs if r.get("tenant") == tenant]
+    if since_ms is not None:
+        cut = float(since_ms) / 1e3
+        recs = [r for r in recs if r.get("start", 0.0) >= cut]
+    return recs
 
 
 def _qos_from_headers(headers):

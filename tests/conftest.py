@@ -36,6 +36,23 @@ def rng():
     return np.random.default_rng(0x5EED)
 
 
+@pytest.fixture(autouse=True)
+def _fresh_stats_catalog():
+    """Per-test isolation of the process statistics catalog
+    (obs/stats.py).  Fingerprint profiles are keyed by
+    (index, query, shards), and test files reuse the same tiny index
+    and query names — a cost profile learned under one test's load
+    would reclassify another test's admission (e.g. a point read
+    profiled slow earlier would class HEAVY and block forever on a
+    deliberately saturated gate).  Clearing the in-memory planes per
+    test keeps the stats integration exercised within each test with
+    no cross-test order dependence; stats tests that need
+    persistence swap in their own catalog."""
+    from pilosa_tpu.obs import stats
+    stats.get().clear()
+    yield
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _leak_audit():
     """Session-end resource audit (testhook/auditor.go): every rbf

@@ -72,8 +72,9 @@ def test_debug_route_surface_includes_new_endpoints(auth_cluster):
     node, _atok, _rtok = auth_cluster
     routes = _debug_get_routes(node.server)
     for want in ("/debug/slo", "/debug/cluster/queries",
-                 "/debug/cluster/metrics", "/debug/queries",
-                 "/debug/trace", "/debug/faults"):
+                 "/debug/cluster/metrics", "/debug/cluster/stats",
+                 "/debug/queries", "/debug/trace", "/debug/faults",
+                 "/debug/stats"):
         assert want in routes, routes
 
 
@@ -135,6 +136,137 @@ def test_readme_metrics_inventory_in_sync():
     assert not ghosts, (
         f"README names metrics that no code registers: "
         f"{sorted(ghosts)}")
+
+
+# ---------------------------------------------------------------------------
+# doc-sync: README /debug endpoint inventory <-> live route table
+# ---------------------------------------------------------------------------
+
+_DEBUG_PATH = re.compile(r"(?<![\w/])/debug/[a-z][a-z0-9/-]*[a-z0-9]")
+
+
+def _readme_debug_paths() -> set[str]:
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "README.md"), encoding="utf-8") as f:
+        text = f.read()
+    return {m.group(0) for m in _DEBUG_PATH.finditer(text)}
+
+
+def test_readme_debug_endpoint_inventory_in_sync(auth_cluster):
+    """BOTH ways (ISSUE 12): every /debug route the live table serves
+    (cluster endpoints included) appears in the README, and every
+    /debug path the README mentions is actually served — a new
+    endpoint cannot ship undocumented, and docs cannot name ghosts.
+    Gating rides the existing sweep: the same live route table feeds
+    test_every_debug_route_is_admin_gated, so an endpoint cannot
+    ship ungated either."""
+    node, _atok, _rtok = auth_cluster
+    routes = set(_debug_get_routes(node.server))
+    readme = _readme_debug_paths()
+    undocumented = routes - readme
+    assert not undocumented, (
+        f"/debug routes served but absent from the README: "
+        f"{sorted(undocumented)}")
+    ghosts = readme - routes
+    assert not ghosts, (
+        f"README names /debug paths no route serves: "
+        f"{sorted(ghosts)}")
+
+
+# ---------------------------------------------------------------------------
+# federated filter passthrough (ISSUE 12 satellite)
+# ---------------------------------------------------------------------------
+
+def test_cluster_queries_federation_passes_filters(auth_cluster):
+    """/debug/cluster/queries applies the per-node /debug/queries
+    filters (route/tenant/since_ms) instead of ignoring them — the
+    PR 9 merged endpoint dropped them on the floor."""
+    node, atok, _rtok = auth_cluster
+    port = node.server.port
+    hdrs = {"Authorization": f"Bearer {atok}"}
+    prev = (flight.recorder.enabled, flight.recorder._ring.maxlen)
+    flight.recorder.configure(enabled=True, keep=256)
+    try:
+        _req(port, "POST", "/index/fq", {}, headers=hdrs)
+        _req(port, "POST", "/index/fq/field/f", {}, headers=hdrs)
+        _req(port, "POST", "/index/fq/query",
+             {"query": "Set(1, f=1)"}, headers=hdrs)
+        cut_ms = int(time.time() * 1000)
+        time.sleep(0.01)
+        for i in range(3):
+            _req(port, "POST", "/index/fq/query",
+                 {"query": f"Count(Row(f={i}))"},
+                 headers={**hdrs, "X-Pilosa-Tenant": "acme"})
+
+        def recs_of(d):
+            return [r for ent in d["queries"]
+                    for rs in ent["nodes"].values() for r in rs]
+
+        st, d = _req(port, "GET",
+                     "/debug/cluster/queries?tenant=acme&limit=100",
+                     headers=hdrs)
+        assert st == 200 and d["queries"]
+        assert all(r["tenant"] == "acme" for r in recs_of(d))
+        st, d = _req(port, "GET",
+                     "/debug/cluster/queries?tenant=nobody&limit=100",
+                     headers=hdrs)
+        assert st == 200 and d["queries"] == []
+        st, d = _req(
+            port, "GET",
+            f"/debug/cluster/queries?since_ms={cut_ms}&limit=100",
+            headers=hdrs)
+        assert st == 200 and d["queries"]
+        assert all(r["start"] * 1000 >= cut_ms for r in recs_of(d))
+        st, d = _req(port, "GET",
+                     "/debug/cluster/queries?route=cached&limit=100",
+                     headers=hdrs)
+        assert st == 200
+        assert all(r["route"] == "cached" for r in recs_of(d))
+    finally:
+        flight.recorder.configure(enabled=prev[0], keep=prev[1])
+
+
+def test_cluster_stats_federation_and_filters(auth_cluster):
+    """/debug/cluster/stats federates the per-node catalogs and
+    passes the index/fingerprint/limit filters through — supported
+    from day one (ISSUE 12)."""
+    from pilosa_tpu.obs import stats
+
+    node, atok, _rtok = auth_cluster
+    port = node.server.port
+    hdrs = {"Authorization": f"Bearer {atok}"}
+    cat = stats.get()
+    cat.note_ingest("csi", "f", rows=[0, 1], cols=[1, 2],
+                    width=1 << 20)
+    cat.note_ingest("other", "g", rows=[0], cols=[3], width=1 << 20)
+    for _ in range(4):
+        cat.note_flight({"fingerprint": "fedfp1", "route": "direct",
+                         "duration_ms": 1.0, "phases": {},
+                         "batch": 1, "bytes_moved": 0})
+        cat.note_flight({"fingerprint": "fedfp2", "route": "direct",
+                         "duration_ms": 2.0, "phases": {},
+                         "batch": 1, "bytes_moved": 0})
+    cat.fold()
+    st, d = _req(port, "GET", "/debug/cluster/stats", headers=hdrs)
+    assert st == 200
+    assert d["nodes"] == ["node0"] and not d["partial"]
+    assert "fedfp1" in d["aggregate"]["profiles"]
+    assert d["aggregate"]["profiles"]["fedfp1"]["n"] >= 4
+    # index filter narrows the data plane
+    st, d = _req(port, "GET", "/debug/cluster/stats?index=csi",
+                 headers=hdrs)
+    local = d["per_node"]["node0"]
+    assert "csi/f" in local["data"] and "other/g" not in local["data"]
+    # fingerprint filter narrows the runtime plane
+    st, d = _req(port, "GET",
+                 "/debug/cluster/stats?fingerprint=fedfp2",
+                 headers=hdrs)
+    assert list(d["aggregate"]["profiles"]) == ["fedfp2"]
+    # limit caps the profile listing
+    st, d = _req(port, "GET", "/debug/cluster/stats?limit=1",
+                 headers=hdrs)
+    assert len(d["per_node"]["node0"]["runtime"]) == 1
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,482 @@
+"""Statistics catalog (ISSUE 12): persistence round-trip + torn-tail
++ crash-mid-snapshot, the regression sentinel fire/clear cycle,
+stats-fed cost decisions (admission classing, cost gates, cache
+eviction, hedge derivation) with the PILOSA_TPU_STATS=0 kill-switch
+as the bit-exact A/B lever, and warm post-restart planning."""
+
+import json
+import os
+
+import pytest
+
+from pilosa_tpu.api import API
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.obs import faults, flight, metrics, stats
+
+
+@pytest.fixture()
+def catalog(tmp_path):
+    """A fresh persisted catalog installed as the process global,
+    restored (and the env kill-switch cleared) afterwards."""
+    cat = stats.StatsCatalog(path=str(tmp_path / "stats.jsonl"),
+                             regression_min_samples=6)
+    prev = stats.swap(cat)
+    prev_env = os.environ.pop("PILOSA_TPU_STATS", None)
+    prev_enabled = stats._enabled
+    stats._enabled = None
+    yield cat
+    stats._enabled = prev_enabled
+    if prev_env is not None:
+        os.environ["PILOSA_TPU_STATS"] = prev_env
+    cat.close()
+    stats.swap(prev)
+    faults.clear()
+
+
+def _mini_api(shards=2):
+    h = Holder()
+    api = API(h)
+    api.create_index("si")
+    api.create_field("si", "f", {"type": "set"})
+    api.create_field("si", "g", {"type": "set"})
+    rows, cols = [], []
+    for s in range(shards):
+        for c in range(64):
+            rows.append(c % 3)
+            cols.append(s * h.width + c)
+    api.import_bits("si", "f", rows=rows, cols=cols)
+    api.import_bits("si", "g", rows=[r + 10 for r in rows], cols=cols)
+    return api
+
+
+def _cluster_rec(node, ms):
+    return {"trace_id": "t", "route": "cluster", "duration_ms": ms,
+            "start": 0.0, "batch": 1, "phases": {}, "bytes_moved": 0,
+            "attempts": [{"node": node, "ms": ms, "outcome": "ok",
+                          "t_off_ms": 0.0}]}
+
+
+# ---------------------------------------------------------------------------
+# persistence
+# ---------------------------------------------------------------------------
+
+def test_snapshot_round_trip(catalog, tmp_path):
+    """Full-state snapshot round-trip: data stats, profiles, node
+    attempts, and gate rates all survive a 'restart' (fresh catalog
+    over the same path)."""
+    catalog.note_ingest("si", "f", rows=[0, 1, 2],
+                        cols=[1, 2, 1 << 20], width=1 << 20)
+    for i in range(8):
+        catalog.note_flight({"fingerprint": "fp1", "route": "direct",
+                             "duration_ms": 2.0 + i * 0.1,
+                             "phases": {"execute": 1.0}, "batch": 1,
+                             "bytes_moved": 100})
+    for _ in range(40):
+        catalog.note_flight(_cluster_rec("n1", 10.0))
+        catalog.note_flight(_cluster_rec("n2", 40.0))
+    catalog.note_gate("groupby_onepass", 1000.0, 0.002)
+    catalog.save()
+
+    cat2 = stats.StatsCatalog(path=str(tmp_path / "stats.jsonl"))
+    assert cat2.loaded_from_disk
+    assert cat2.field_stats("si", "f")["rows"] == 3
+    assert cat2.field_stats("si", "f")["shards"] == 2
+    p = cat2.profile("fp1")
+    assert p is not None and p.n == 8
+    assert abs(p.ms - catalog.profile("fp1").ms) < 1e-9
+    assert cat2.hedge_samples() is not None
+    assert cat2._gate_rates["groupby_onepass"][1] == 1
+    cat2.close()
+
+
+def test_tail_replay_and_torn_tail_recompact(catalog, tmp_path):
+    """Ingest events land in the tail log; a torn final line (crash
+    mid-append) is dropped on restart and the store recompacts
+    immediately — the next load replays no tail at all."""
+    catalog.note_ingest("si", "f", rows=[0], cols=[5], width=1 << 20)
+    catalog.note_ingest("si", "f", rows=[1], cols=[6], width=1 << 20)
+    path = tmp_path / "stats.jsonl"
+    # simulate the crash: append half an event line
+    with open(path, "a") as f:
+        f.write('{"t": "ingest", "i": "si", "f": "f", "ro')
+    cat2 = stats.StatsCatalog(path=str(path))
+    fs = cat2.field_stats("si", "f")
+    assert fs["rows"] == 2  # the torn third event is dropped
+    # immediate recompaction: tail truncated, snapshot holds the state
+    assert cat2.store.tail_records == 0
+    assert os.path.getsize(path) == 0
+    with open(str(path) + ".snap") as f:
+        snap = json.load(f)
+    assert snap["fields"]
+    cat2.close()
+    # a third open serves the same state from the snapshot alone
+    cat3 = stats.StatsCatalog(path=str(path))
+    assert cat3.field_stats("si", "f")["rows"] == 2
+    cat3.close()
+
+
+def test_crash_mid_snapshot_never_serves_half_file(catalog, tmp_path):
+    """The stats-snapshot fault point crashes the compactor mid-write:
+    the tmp file is torn, the rename never happens, and the catalog
+    keeps serving the previous complete snapshot."""
+    catalog.note_ingest("si", "f", rows=[0, 1], cols=[1, 2],
+                        width=1 << 20)
+    catalog.save()  # good snapshot with 2 rows
+    catalog.note_ingest("si", "f", rows=[2], cols=[3], width=1 << 20)
+    faults.inject("stats-snapshot", times=1)
+    with pytest.raises(faults.InjectedFault):
+        catalog.save()
+    path = tmp_path / "stats.jsonl"
+    # the torn tmp is left behind; the real snapshot is the old one
+    with open(str(path) + ".snap") as f:
+        snap = json.load(f)  # parses: complete, not torn
+    cat2 = stats.StatsCatalog(path=str(path))
+    # 2 rows from the intact snapshot + the third from the tail log
+    # (appended before the crash) — nothing lost, nothing half-read
+    assert cat2.field_stats("si", "f")["rows"] == 3
+    assert snap["fields"]
+    cat2.close()
+
+
+def test_corrupt_store_fails_open(catalog, tmp_path):
+    """Externally corrupted stats files must never refuse a boot:
+    a corrupt snapshot loads as empty, a corrupt NON-final tail line
+    is dropped (the rest replays) and the store recompacts — stats
+    are advisory telemetry, not correctness state."""
+    catalog.note_ingest("si", "f", rows=[0], cols=[5], width=1 << 20)
+    catalog.note_ingest("si", "f", rows=[1], cols=[6], width=1 << 20)
+    catalog.save()
+    catalog.note_ingest("si", "f", rows=[2], cols=[7], width=1 << 20)
+    path = tmp_path / "stats.jsonl"
+    # corrupt the snapshot AND wedge a garbage line mid-tail
+    with open(str(path) + ".snap", "r+") as f:
+        f.seek(5)
+        f.write("\x00GARBAGE")
+    with open(path) as f:
+        good_tail = f.read()
+    with open(path, "w") as f:
+        f.write("{not json at all\n" + good_tail)
+    cat2 = stats.StatsCatalog(path=str(path))   # must not raise
+    # snapshot state lost (corrupt), surviving tail event replayed
+    assert cat2.field_stats("si", "f")["bits"] == 1
+    # recompacted: a third open serves the same without the damage
+    cat3 = stats.StatsCatalog(path=str(path))
+    assert cat3.field_stats("si", "f")["bits"] == 1
+    cat3.close()
+    cat2.close()
+
+
+def test_snapshot_rename_crash_does_not_double_replay(catalog,
+                                                      tmp_path):
+    """A crash BETWEEN the snapshot rename and the tail truncation
+    leaves the already-folded tail behind; the sequence watermark
+    (_tail_seq / event \"q\") must keep the reload from replaying it
+    on top of the snapshot — additive data stats would double."""
+    catalog.note_ingest("si", "f", rows=[0], cols=[5], width=1 << 20)
+    path = tmp_path / "stats.jsonl"
+    with open(path) as f:
+        stale_tail = f.read()  # the seq-1 event, pre-compaction
+    catalog.save()  # snapshot folds it and truncates the tail
+    # simulate the crash window: the old tail reappears untruncated
+    with open(path, "w") as f:
+        f.write(stale_tail)
+    cat2 = stats.StatsCatalog(path=str(path))
+    assert cat2.field_stats("si", "f")["bits"] == 1  # not 2
+    # and a NEW event after the reload still lands (fresh sequence)
+    cat2.note_ingest("si", "f", rows=[1], cols=[6], width=1 << 20)
+    assert cat2.field_stats("si", "f")["bits"] == 2
+    cat2.close()
+
+
+# ---------------------------------------------------------------------------
+# regression sentinel
+# ---------------------------------------------------------------------------
+
+def test_sentinel_fires_on_injected_slowdown_and_clears(catalog):
+    """A serving-dispatch delay fault slows one fingerprint:
+    pilosa_perf_regression fires within the configured window (6
+    samples here) and clears after recovery; the clean fingerprint
+    stays silent throughout."""
+    api = _mini_api()
+    api.executor.enable_serving(ragged=False, cache_bytes=0)
+    for _ in range(12):
+        api.query("si", "Count(Row(f=0))")
+        api.query("si", "Count(Row(g=10))")
+    catalog.fold()
+    fp = flight.recorder.recent(2)[0]["fingerprint"]
+    assert not catalog.regressions()
+    faults.inject("serving-dispatch", delay_s=0.03, times=12,
+                  error=False)
+    for _ in range(12):
+        api.query("si", "Count(Row(f=0))")  # only THIS one slows
+    catalog.fold()
+    regs = catalog.regressions()
+    assert len(regs) == 1
+    slow_fp = regs[0]["fingerprint"]
+    assert regs[0]["ratio"] >= catalog.regression_ratio
+    assert metrics.PERF_REGRESSION.value(
+        fingerprint=slow_fp, metric="duration_ms") > 0
+    # recovery: the fault budget is exhausted; the window EWMA falls
+    # back toward the (frozen) baseline and the sentinel clears
+    for _ in range(16):
+        api.query("si", "Count(Row(f=0))")
+    catalog.fold()
+    assert not catalog.regressions()
+    assert metrics.PERF_REGRESSION.value(
+        fingerprint=slow_fp, metric="duration_ms") == 0.0
+    assert fp is not None  # both fingerprints were live
+
+
+# ---------------------------------------------------------------------------
+# stats-fed decisions: bit-exact A/B + behavior
+# ---------------------------------------------------------------------------
+
+_QUERIES = ("Count(Row(f=0))", "Row(f=1)",
+            "GroupBy(Rows(field=f))",
+            "TopN(f, n=2)",
+            "Count(Intersect(Row(f=0), Row(g=10)))")
+
+
+def test_stats_on_vs_off_bit_exact(catalog):
+    """The kill-switch A/B: every query result is identical with the
+    catalog enabled and disabled — stats steer plan and schedule
+    choices only, never results."""
+    api = _mini_api()
+    api.executor.enable_serving(ragged=False)
+    on = []
+    for _ in range(3):
+        on.extend(json.dumps(api.query("si", q), sort_keys=True,
+                             default=str) for q in _QUERIES)
+    os.environ["PILOSA_TPU_STATS"] = "0"
+    try:
+        assert not stats.enabled()
+        off = []
+        for _ in range(3):
+            off.extend(json.dumps(api.query("si", q), sort_keys=True,
+                                  default=str) for q in _QUERIES)
+    finally:
+        del os.environ["PILOSA_TPU_STATS"]
+    assert on == off
+
+
+def test_admission_classifies_by_measured_cost(catalog):
+    """A kind-heavy query (GroupBy) whose measured cost is tiny rides
+    the point lane once its profile is warm; the static fallback
+    classes it heavy."""
+    from pilosa_tpu.executor import sched
+    from pilosa_tpu.executor.serving import _fingerprint
+    from pilosa_tpu.pql import parse
+
+    catalog.heavy_cost_ms = 5.0
+    q = parse("GroupBy(Rows(field=f))")
+    key = ("si", repr(q.calls), None)
+    fp = _fingerprint(key)
+    # cold: static kind walk says heavy
+    assert sched.classify(q, None, fingerprint=fp) == sched.CLASS_HEAVY
+    # warm a cheap profile
+    for _ in range(6):
+        catalog.note_flight({"fingerprint": fp, "route": "fused",
+                             "duration_ms": 0.4, "phases": {},
+                             "batch": 1, "bytes_moved": 0})
+    catalog.fold()
+    assert sched.classify(q, None, fingerprint=fp) == sched.CLASS_POINT
+    # an expensive profile flips it back
+    for _ in range(12):
+        catalog.note_flight({"fingerprint": fp, "route": "direct",
+                             "duration_ms": 80.0, "phases": {},
+                             "batch": 1, "bytes_moved": 0})
+    catalog.fold()
+    assert sched.classify(q, None, fingerprint=fp) == sched.CLASS_HEAVY
+    # explicit priority still outranks the profile
+    qos = sched.QoS.make(priority="point")
+    assert sched.classify(q, qos, fingerprint=fp) == sched.CLASS_POINT
+
+
+def test_cache_hits_do_not_erode_recompute_estimate(catalog):
+    """Serve-cost and recompute-cost are separate estimates: a run
+    of sub-ms cache hits drags the admission estimate down (correct
+    — serving a cached entry costs nothing) but must NOT touch the
+    recompute estimate the cache's own eviction ranks by."""
+    fp = "split-fp"
+    for _ in range(4):
+        catalog.note_flight({"fingerprint": fp, "route": "direct",
+                             "duration_ms": 80.0, "phases": {},
+                             "batch": 1, "bytes_moved": 0})
+    for _ in range(40):
+        catalog.note_flight({"fingerprint": fp, "route": "cached",
+                             "duration_ms": 0.1, "phases": {},
+                             "batch": 1, "bytes_moved": 0})
+    catalog.fold()
+    assert catalog.est_cost_ms(fp) < 5.0        # admission: cheap
+    assert catalog.est_recompute_ms(fp) == 80.0  # eviction: honest
+
+
+def test_gate_rate_outlier_and_staleness(catalog):
+    """One compile-laden wall-time outlier folds with a damped alpha
+    (cannot flip the gate), and an arm unsampled past the staleness
+    window drops the pair back to the static model."""
+    for _ in range(4):
+        catalog.note_gate("a", 1000.0, 0.001)   # 1e-6 s/unit
+        catalog.note_gate("b", 1000.0, 0.002)
+    ra0, _ = catalog.gate_rates("a", "b")
+    catalog.note_gate("a", 1000.0, 1.0)         # 1000x outlier
+    ra1, _ = catalog.gate_rates("a", "b")
+    assert ra1 < 100 * ra0  # damped, not EWMA(0.3)-absorbed
+    # staleness: age arm "a" past the window -> static fallback
+    with catalog._lock:
+        r, n, _t = catalog._gate_rates["a"]
+        catalog._gate_rates["a"] = (
+            r, n, _t - catalog._GATE_STALE_S - 1)
+    assert catalog.gate_rates("a", "b") == (1.0, 1.0)
+
+
+def test_result_cache_eviction_prefers_high_cost(catalog):
+    """Under byte pressure the cache evicts the cheapest-to-recompute
+    entry among the LRU window, not blindly the oldest; with no costs
+    (stats off) it stays pure LRU."""
+    import numpy as np
+
+    from pilosa_tpu.executor.serving import ResultCache
+
+    def payload():
+        return np.zeros(64, dtype=np.int64)  # 512 accounted bytes
+
+    cache = ResultCache(max_bytes=2200)  # ~4 entries
+    idx_keys = [("i", f"q{i}", None) for i in range(8)]
+    # oldest entry is EXPENSIVE, the rest cheap
+    cache.put(idx_keys[0], frozenset(), (), payload(), cost_ms=500.0)
+    for k in idx_keys[1:]:
+        cache.put(k, frozenset(), (), payload(), cost_ms=0.2)
+    assert idx_keys[0] in cache          # survived despite being LRU
+    assert idx_keys[1] not in cache      # a cheap one went instead
+    # pure-LRU arm: no costs -> strict insertion-order eviction
+    lru = ResultCache(max_bytes=2200)
+    for k in idx_keys:
+        lru.put(k, frozenset(), (), payload())
+    assert idx_keys[0] not in lru
+    assert idx_keys[-1] in lru
+
+
+def test_groupby_gate_uses_measured_rates(catalog):
+    """The one-pass-vs-per-combo gate flips when measured rates say
+    the static unit model is wrong by a large factor — and the
+    decision is identical after a catalog restart (warm planning)."""
+    from pilosa_tpu.executor.stacked import _groupby_unit_costs
+
+    api = _mini_api()
+    idx = api.holder.index("si")
+    eng = api.executor.stacked
+    f = idx.field("f")
+    fields_rows = [(f, [0, 1, 2])]
+    skey = (0, 1)
+    base = eng._groupby_onepass_ok(idx, fields_rows, 3, 0, False, skey)
+    one_u, combo_u = _groupby_unit_costs(fields_rows, 3, 0, False,
+                                         len(skey), idx.width // 32)
+    # static model: tiny combo products stay per-combo
+    assert base is False
+    # measured: one-pass units are (falsely, for the test) 1000x
+    # cheaper per unit than per-combo units -> the gate flips
+    for _ in range(4):
+        catalog.note_gate("groupby_onepass", one_u, one_u * 1e-9)
+        catalog.note_gate("groupby_percombo", combo_u, combo_u * 1e-6)
+    assert eng._groupby_onepass_ok(idx, fields_rows, 3, 0, False,
+                                   skey) is True
+    # persistence: a restarted catalog makes the SAME decision
+    catalog.save()
+    cat2 = stats.StatsCatalog(path=catalog.store.path)
+    prev = stats.swap(cat2)
+    try:
+        assert eng._groupby_onepass_ok(idx, fields_rows, 3, 0, False,
+                                       skey) is True
+    finally:
+        stats.swap(catalog)
+        cat2.close()
+
+
+def test_hedge_delay_from_persisted_stats(catalog, tmp_path):
+    """Hedge-delay derivation reads the catalog's per-node attempt
+    distributions — and a freshly restarted catalog derives the SAME
+    delay (no cold-start default window)."""
+    from pilosa_tpu.cluster.coordinator import derive_hedge_delay_s
+
+    flight.recorder.clear()
+    # without stats samples and an empty ring: the cold default
+    assert derive_hedge_delay_s(default_s=0.05) == 0.05
+    for i in range(40):
+        catalog.note_flight(_cluster_rec("fastnode", 8.0 + (i % 5)))
+        catalog.note_flight(_cluster_rec("slownode", 200.0))
+    catalog.fold()
+    warm = derive_hedge_delay_s(default_s=0.05)
+    # anchored to the healthy replica, not the 200 ms one
+    assert 0.005 <= warm <= 0.02
+    catalog.save()
+    cat2 = stats.StatsCatalog(path=str(tmp_path / "stats.jsonl"))
+    prev = stats.swap(cat2)
+    try:
+        assert derive_hedge_delay_s(default_s=0.05) == warm
+    finally:
+        stats.swap(catalog)
+        cat2.close()
+
+
+def test_patch_break_even_requires_volume(catalog, monkeypatch):
+    """The measured patch-vs-rebuild threshold stays None (static
+    fallback) until both arms have real byte volume, then equals the
+    measured per-byte-cost ratio (injected readings — the real
+    counters are process-cumulative)."""
+    vols = {"patched": 0.0, "rebuilt": 0.0}
+    sums = {"stack_patch": 0.0, "stack_rebuild": 0.0}
+    monkeypatch.setattr(metrics.STACK_MAINT_BYTES, "value",
+                        lambda **kw: vols[kw["kind"]])
+    monkeypatch.setattr(metrics.PHASE_DURATION, "sum",
+                        lambda **kw: sums[kw["phase"]])
+    catalog._patch_memo = None
+    assert catalog.patch_break_even_frac() is None
+    vols.update(patched=float(4 << 20), rebuilt=float(8 << 20))
+    sums.update(stack_patch=0.2, stack_rebuild=0.1)
+    catalog._patch_memo = None  # drop the 1s memo
+    f = catalog.patch_break_even_frac()
+    # c_patch = 0.2s/4MiB, c_rebuild = 0.1s/8MiB -> break-even 0.25
+    assert f is not None and abs(f - 0.25) < 1e-9
+
+
+# ---------------------------------------------------------------------------
+# warm restart end to end + /debug/stats
+# ---------------------------------------------------------------------------
+
+def test_restarted_server_serves_reloaded_catalog(catalog, tmp_path):
+    """/debug/stats on a restarted server serves the reloaded
+    catalog: profiles and data stats from the previous 'life' are
+    present before any query runs."""
+    import http.client
+
+    from pilosa_tpu.server.http import Server
+
+    api = _mini_api()
+    api.executor.enable_serving(ragged=False)
+    for _ in range(8):
+        api.query("si", "Count(Row(f=0))")
+    catalog.fold()
+    assert catalog.payload()["runtime"]
+    catalog.save()
+
+    # 'restart': a fresh catalog over the same path behind a server
+    cat2 = stats.StatsCatalog(path=str(tmp_path / "stats.jsonl"))
+    stats.swap(cat2)
+    try:
+        srv = Server().start()
+        try:
+            c = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                           timeout=10)
+            c.request("GET", "/debug/stats")
+            body = json.loads(c.getresponse().read())
+            c.close()
+        finally:
+            srv.close()
+        assert body["enabled"] is True
+        assert body["runtime"], "reloaded profiles must be served"
+        assert body["data"].get("si/f", {}).get("rows", 0) > 0
+        assert body["store"]["loaded"] is True
+    finally:
+        stats.swap(catalog)
+        cat2.close()
