@@ -42,6 +42,7 @@ from bench.serving import (
     serving_gauntlet,
     tracing_overhead_gauntlet,
 )
+from bench.sqlbench import sql_gauntlet, sql_smoke
 from bench.statsbench import stats_ab_gauntlet, stats_smoke
 from bench.writes import write_smoke, write_storm_gauntlet
 
@@ -107,6 +108,10 @@ def main() -> None:
     # by measured fingerprint cost vs the static kind walk — the
     # catalog's load-bearing acceptance cell, bit-exact hard-gated
     stats_ab = stats_ab_gauntlet()
+    # SQL serving gauntlet (ISSUE 13): 32 clients of mixed
+    # point-lookup/join/GROUP BY via /sql, pushdown-vs-host A/B,
+    # bit-exact hard-gated, fused-route + /debug/queries evidence
+    sql_g = sql_gauntlet()
     # RTT-independent device time for the sub-RTT north-star scans
     cal = loop_calibrate(h) if on_tpu else None
 
@@ -209,6 +214,12 @@ def main() -> None:
         # statistics-catalog A/B (ISSUE 12): misclassification rate
         # stats-fed vs static admission, bit-exact across arms
         "stats_ab_gauntlet": stats_ab,
+        # SQL serving gauntlet (ISSUE 13): QPS/p99 pushdown-vs-host,
+        # >=5x QPS is the acceptance ratio, bit-exact hard-gated,
+        # statements visible at /debug/queries as route-"sql" records
+        # with fused inner dispatches and per-statement planner
+        # pushdown decisions
+        "sql_gauntlet": sql_g,
     }
     if cal is not None:
         result["loop_calibrated_device_ms"] = {
@@ -280,6 +291,8 @@ def dispatch(argv) -> int:
         return kernel_smoke()
     if "--stats-smoke" in argv:
         return stats_smoke()
+    if "--sql-smoke" in argv:
+        return sql_smoke()
     try:
         main()
     except Exception as e:  # clear failure JSON — never a bare crash

@@ -96,6 +96,21 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 
+echo "== sql smoke =="
+# SQL serving gate (bench.py --sql-smoke, bench/sqlbench.py):
+# CORRECTNESS-ONLY gates on the 2-core box — pushdown engaged on
+# eligible statements (route-"sql" flight records with fused inner
+# dispatches + planner decisions), both arms bit-exact vs the
+# precomputed host answer key, sheds/deadlines on /sql typed
+# 503/504 (Retry-After on sheds), zero failed.  QPS/latency ratios
+# are recorded in BENCH JSON, never asserted here (the committed
+# gauntlet run carries the >=5x acceptance).
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python bench.py --sql-smoke; then
+    echo "check.sh: sql smoke failed" >&2
+    exit 1
+fi
+
 echo "== kernel interpret-mode smoke =="
 # fused single-pass GroupBy kernel gate (bench.py --kernel-smoke):
 # the fused int8 MXU kernel + Min/Max presence walk + Range/Distinct

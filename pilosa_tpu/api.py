@@ -66,7 +66,10 @@ class API:
         self.holder = holder
         self.name = name
         self.executor = Executor(holder)
-        self.sql_engine = SQLEngine(holder)
+        # SQL shares the API's executor (ISSUE 13): one serving
+        # layer, one stack/result cache, one HBM ledger client for
+        # both query surfaces
+        self.sql_engine = SQLEngine(holder, executor=self.executor)
         self.start_time = time.time()
         self._history: list[QueryHistoryEntry] = []
         self._hist_lock = threading.Lock()
@@ -143,17 +146,20 @@ class API:
         self._record_history(index, pql, t0, tracer)
         return resp
 
-    def sql(self, statement: str, auth_check=None) -> dict:
+    def sql(self, statement: str, auth_check=None, qos=None) -> dict:
         """SQL query (http_handler.go:1440 /sql).  Returns
         {"schema": {"fields": [...]}, "data": [...]} like the
         reference's SQL response shape.  auth_check, when set, gates
-        each statement's table access (Authorizer.sql_check)."""
+        each statement's table access (Authorizer.sql_check).  ``qos``
+        carries the /sql request's tenant/priority/deadline admission
+        intent (executor/sched.py QoS); typed shed/deadline errors
+        (503/504) propagate to the transport with their status."""
         metrics.SQL_TOTAL.inc()
         t0 = time.time()
         try:
             res = self.sql_engine.query_one(
                 statement, auth_check=auth_check,
-                write_guard=self._check_writable)
+                write_guard=self._check_writable, qos=qos)
         except (ExecError, SQLError, ParseError, ValueError, KeyError) as e:
             raise ApiError(str(e), 400)
         self._record_history("", statement, t0)

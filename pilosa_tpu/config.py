@@ -138,6 +138,13 @@ class Config:
     stats_heavy_cost_ms: float = 5.0
     stats_regression_ratio: float = 3.0
     stats_regression_min_samples: int = 6
+    # SQL serving plane (sql/costplan.py + sql/engine.py): pushdown
+    # routes SELECT plan operators through the fused serving plane
+    # (batcher, ragged dispatch, QoS admission, result cache) with
+    # the catalog-fed cost-based planner; false — or the
+    # PILOSA_TPU_SQL_PUSHDOWN=0 env kill-switch, the bench A/B
+    # lever — reverts SQL to the solo host path, bit-exact.
+    sql_pushdown: bool = True
     # SLO burn-rate plane (obs/slo.py): latency-ms + latency-objective
     # define the latency SLO ("latency-objective of queries answer
     # under latency-ms"); availability-objective bounds the typed-
@@ -241,6 +248,15 @@ class Config:
             regression_min_samples=self.stats_regression_min_samples,
             snapshot_interval_s=self.stats_snapshot_interval_s)
 
+    def apply_sql_settings(self):
+        """Configure the SQL serving plane ([sql]).  The default-True
+        config leaves the PILOSA_TPU_SQL_PUSHDOWN env kill-switch in
+        charge (it is the bench A/B lever and may flip at runtime);
+        an explicit pushdown=false pins the host path."""
+        from pilosa_tpu.sql import costplan
+        costplan.configure(
+            enabled=None if self.sql_pushdown else False)
+
     def apply_slo_settings(self):
         """Build the process SLO tracker from the [slo] knobs."""
         from pilosa_tpu.obs import slo
@@ -298,6 +314,7 @@ _TOML_KEYS = {
     "stats.heavy-cost-ms": "stats_heavy_cost_ms",
     "stats.regression-ratio": "stats_regression_ratio",
     "stats.regression-min-samples": "stats_regression_min_samples",
+    "sql.pushdown": "sql_pushdown",
     "slo.latency-ms": "slo_latency_ms",
     "slo.latency-objective": "slo_latency_objective",
     "slo.availability-objective": "slo_availability_objective",

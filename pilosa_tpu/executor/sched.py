@@ -144,6 +144,44 @@ def classify(q: Query, qos: QoS | None,
     return cls
 
 
+def classify_sql(stmt, qos: QoS | None,
+                 fingerprint: str | None = None) -> str:
+    """Per-statement admission class for the SQL serving path
+    (ISSUE 13): explicit priority wins, then the statement
+    fingerprint's MEASURED cost from the statistics catalog (same
+    ``[stats] heavy-cost-ms`` threshold as PQL classify), and the
+    statement SHAPE as the cold-start fallback — joins, GROUP BY,
+    aggregates, DISTINCT, and unbounded extracts are heavy; bounded
+    single-table projections ride the point lane.  Class choice only
+    affects scheduling, never results."""
+    if qos is not None and qos.priority in (CLASS_POINT, CLASS_HEAVY):
+        return qos.priority
+    if fingerprint is not None:
+        from pilosa_tpu.obs import stats
+        est = stats.est_cost_ms(fingerprint)
+        if est is not None:
+            cls = (CLASS_HEAVY if est >= stats.heavy_cost_ms()
+                   else CLASS_POINT)
+            metrics.STATS_ADMISSION.inc(**{"source": "profile",
+                                           "class": cls})
+            return cls
+    from pilosa_tpu.sql import ast as _ast
+    point_where = (isinstance(stmt.where, _ast.BinOp)
+                   and stmt.where.op == "="
+                   and isinstance(stmt.where.left, _ast.Col)
+                   and stmt.where.left.name == "_id")
+    heavy = bool(
+        stmt.joins or stmt.group_by or stmt.having is not None
+        or stmt.distinct or stmt.from_select is not None
+        or any(isinstance(it.expr, _ast.Agg) for it in stmt.items)
+        or (stmt.limit is None and stmt.table and not point_where))
+    cls = CLASS_HEAVY if heavy else CLASS_POINT
+    if fingerprint is not None:
+        metrics.STATS_ADMISSION.inc(**{"source": "static",
+                                       "class": cls})
+    return cls
+
+
 class _Ticket:
     __slots__ = ("granted", "abandoned")
 

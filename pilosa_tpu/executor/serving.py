@@ -293,6 +293,11 @@ def _result_nbytes(r) -> int:
         return 96 + 48 * len(r.fields) + sum(
             64 + 24 * len(c.get("rows", ()))
             if isinstance(c, dict) else 64 for c in r.columns)
+    if hasattr(r, "schema") and hasattr(r, "rows"):
+        # SQLResult (duck-typed: serving must not import the sql
+        # layer) — cached SQL statements size by their row payload
+        return 96 + 48 * len(r.schema) + sum(
+            48 + 24 * len(row) for row in r.rows)
     return 64
 
 
@@ -731,10 +736,18 @@ class ServingLayer:
                 qos.deadline_s = dflt.deadline_s
         # cost-based admission (obs/stats.py): classify by the plan
         # fingerprint's MEASURED cost profile when the catalog is warm
-        # (query kind stays the cold-start fallback inside classify)
+        # (query kind stays the cold-start fallback inside classify).
+        # An explicit priority override skips the hash — classify
+        # returns before reading it, and SQL's inner calls (always
+        # explicit point) would otherwise pay a blake2b over a
+        # possibly-huge ConstRow repr per call; _execute_read
+        # recomputes the key (and commit the fingerprint) when a
+        # flight record actually consumes them
         key = None
         fp = None
-        if _stats.enabled():
+        if _stats.enabled() and not (
+                qos is not None and qos.priority in (
+                    _sched.CLASS_POINT, _sched.CLASS_HEAVY)):
             key = (index, repr(q.calls),
                    None if shards is None else tuple(sorted(shards)))
             fp = _fingerprint(key)
@@ -858,6 +871,12 @@ class ServingLayer:
             raise
         finally:
             metrics.SERVING_BATCHED.inc(route=route)
+            if fl is None:
+                # nested under an open record (a SQL statement's
+                # inner PQL dispatch): stamp this serve's route into
+                # the parent so /debug/queries shows which of a
+                # statement's calls rode the fused plane
+                flight.note_route(route)
             dur = time.perf_counter() - t0
             metrics.SERVING_LATENCY.observe(dur)
             flight.commit(

@@ -170,6 +170,20 @@ def note_phase(name: str, dt: float):
         acc.add_phase(name, dt)
 
 
+def note_route(route: str, cap: int = 32):
+    """Record a nested serving-path route (fused/cached/direct) into
+    the thread's ACTIVE record's ``serving_routes`` list — how a SQL
+    statement record (route "sql") shows which of its inner PQL
+    dispatches rode the fused plane.  No-op without an open record;
+    capped so a many-call statement cannot grow a record without
+    bound."""
+    rec = getattr(_tls, "rec", None)
+    if rec is not None:
+        routes = rec.setdefault("serving_routes", [])
+        if len(routes) < cap:
+            routes.append(route)
+
+
 def note_stack(outcome: str, nbytes: int, dt: float,
                key_fp: str | None = None):
     acc = getattr(_tls, "acc", None)

@@ -346,6 +346,17 @@ QUERY_DURATION = registry.histogram(
     "pilosa_query_duration_seconds", "PQL query latency")
 SQL_TOTAL = registry.counter(
     "pilosa_sql_total", "Total SQL queries executed")
+SQL_PUSHDOWN = registry.counter(
+    "pilosa_sql_pushdown_total",
+    "SQL planner operator decisions: op (count/sum/groupby/distinct/"
+    "extract/join/...) by outcome (pushdown = rides the fused "
+    "serving plane; host = solo host-side execution)")
+SQL_PLAN_COST = registry.histogram(
+    "pilosa_sql_plan_cost_ms",
+    "SQL statement planning cost in milliseconds (parse-to-plan-op, "
+    "cost-based decisions included)",
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0,
+             50.0, 100.0))
 IMPORT_TOTAL = registry.counter(
     "pilosa_import_total", "Total import requests")
 IMPORTED_BITS = registry.counter(

@@ -193,7 +193,10 @@ class FingerprintProfile:
         phases = rec.get("phases", {}) or {}
         self.n += 1
         self.total += 1
-        if rec.get("route") == "cached":
+        if rec.get("route") == "cached" or rec.get("cached"):
+            # rec["cached"]: a statement-cache-served SQL record —
+            # route stays "sql" for /debug/queries, but the serve
+            # cost the engine paid is a cache hit's
             self.hits += 1
         else:
             self.recompute_ms = _ewma(self.recompute_ms, d, 0.2)
@@ -466,6 +469,29 @@ class StatsCatalog:
         with self._lock:
             fs = self._fields.get((index, field))
             return fs.payload() if fs is not None else None
+
+    def est_index_rows(self, index: str) -> float | None:
+        """Estimated record count of one index for the SQL cost
+        planner (sql/costplan.py): the existence field's bit count
+        when the ingest path noted it (authoritative — one bit per
+        live record), else the widest field's bit count as a lower
+        bound.  None when the catalog holds nothing for the index
+        (the planner then keeps its static decision)."""
+        # EXISTENCE_FIELD's literal name, not the models import: the
+        # obs plane must not import the model layer at call time
+        exists_key = (index, "_exists")
+        with self._lock:
+            fs = self._fields.get(exists_key)
+            if fs is not None and fs.shard_bits:
+                return float(sum(fs.shard_bits.values()))
+            best = None
+            for (i, _f), st in self._fields.items():
+                if i != index or not st.shard_bits:
+                    continue
+                n = sum(st.shard_bits.values())
+                if best is None or n > best:
+                    best = n
+            return float(best) if best is not None else None
 
     # -- runtime plane (flight fold) -----------------------------------
 

@@ -194,6 +194,11 @@ class GRPCHandler:
     def __init__(self, api, sql_engine=None, auth=None):
         self.api = api
         if sql_engine is None:
+            # share the API's engine (and with it the serving-enabled
+            # executor + its caches): gRPC SQL must not client the
+            # HBM ledger a second time (ISSUE 13 satellite)
+            sql_engine = getattr(api, "sql_engine", None)
+        if sql_engine is None:
             from pilosa_tpu.sql.engine import SQLEngine
             sql_engine = SQLEngine(api.holder)
         self.sql = sql_engine

@@ -25,6 +25,7 @@ hot path (queries run on-device); a C++ server would buy nothing here.
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
 import time
@@ -102,6 +103,9 @@ class Server:
         # SLO burn-rate plane ([slo]): the maintenance ticker below
         # feeds its sample ring
         config.apply_slo_settings()
+        # SQL serving plane ([sql]): SELECT statements ride the fused
+        # serving plane with the catalog-fed cost-based planner
+        config.apply_sql_settings()
         # statistics catalog ([stats]): persisted flight/roofline
         # telemetry feeding the cost gates, admission classing, cache
         # eviction, and hedge derivation; persisted under the
@@ -214,7 +218,11 @@ class Server:
             # live server's store here would orphan its persistence —
             # nothing reattaches outside Server.__init__
             if cat.store is not None and self.holder.path and \
-                    cat.store.path.startswith(self.holder.path):
+                    cat.store.path.startswith(
+                        os.path.join(self.holder.path, "")):
+                # the trailing separator makes this a DIRECTORY
+                # check: /data/node1 must not claim /data/node10's
+                # store and orphan a sibling server's persistence
                 cat.detach_store()
         except Exception as e:
             self.logger.warn("stats snapshot on close failed: %s", e)
@@ -607,7 +615,12 @@ class Server:
             auth_check = self.auth[1].sql_check(
                 req.auth_claims.get("groups", []))
         try:
-            return self.api.sql(stmt, auth_check=auth_check)
+            # the same QoS headers the PQL surface honors
+            # (X-Pilosa-Tenant / -Priority / -Deadline-Ms): SELECT
+            # statements admit through sched.py with per-statement
+            # cost classes; shed/deadline render as typed 503/504
+            return self.api.sql(stmt, auth_check=auth_check,
+                                qos=_qos_from_headers(req.headers))
         except PermissionError as e:
             raise ApiError(str(e), 403)
 

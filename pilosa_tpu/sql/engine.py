@@ -32,9 +32,14 @@ subquery materialization (wherec.fold_subqueries).
 
 from __future__ import annotations
 
+import threading
+import time
+
 from pilosa_tpu.executor import Executor
 from pilosa_tpu.models import Holder
-from pilosa_tpu.sql import ast, plan
+from pilosa_tpu.obs import flight, metrics
+from pilosa_tpu.obs import stats as _stats
+from pilosa_tpu.sql import ast, costplan, plan
 from pilosa_tpu.sql.common import SQLResult
 from pilosa_tpu.sql.lexer import SQLError
 from pilosa_tpu.sql.parser import parse_sql
@@ -46,9 +51,19 @@ __all__ = ["SQLEngine", "SQLError", "SQLResult"]
 
 
 class SQLEngine:
-    def __init__(self, holder: Holder):
+    def __init__(self, holder: Holder, executor: Executor | None = None):
         self.holder = holder
-        self.executor = Executor(holder)
+        # SHARE the server's serving-enabled executor when one exists
+        # (ISSUE 13 satellite): SQL and PQL then see the same stack /
+        # result caches and the HBM ledger is cliented once.  A
+        # private executor survives only for embedded/standalone use.
+        self.executor = executor if executor is not None \
+            else Executor(holder)
+        # per-thread serving state: the statement's derived QoS for
+        # inner calls, and a reentrancy flag so nested selects
+        # (views, derived tables, subqueries) skip the statement-
+        # level admission/cache/flight wrapper
+        self._tls = threading.local()
         # name -> stored Select (sql3 CREATE VIEW); views re-execute
         # on read
         self._views: dict[str, ast.Select] = {}
@@ -111,38 +126,74 @@ class SQLEngine:
     # -- entry points ---------------------------------------------------
 
     def query(self, sql: str, auth_check=None,
-              write_guard=None) -> list[SQLResult]:
+              write_guard=None, qos=None) -> list[SQLResult]:
         """Execute statements.
 
         auth_check(table_or_None, "read"|"write") raises on denial —
         the SQL-side authz hook (the reference resolves table names
         during planning and consults authz per table).  write_guard()
         is called once when any statement writes (the exclusive-
-        transaction read-only gate).
+        transaction read-only gate).  ``qos`` (executor/sched.py QoS)
+        carries the request's tenant/priority/deadline admission
+        intent from the /sql transport headers.
         """
         from pilosa_tpu.executor.executor import ExecError
         try:
             stmts = parse_sql(sql)
-            if write_guard is not None and any(
-                    perm == "write"
-                    for s in stmts
-                    for _t, perm in self._stmt_accesses(s)):
+            writes = any(perm == "write"
+                         for s in stmts
+                         for _t, perm in self._stmt_accesses(s))
+            if write_guard is not None and writes:
                 write_guard()
             if auth_check is not None:
                 for stmt in stmts:
                     for table, perm in self._stmt_accesses(stmt):
                         auth_check(table, perm)
-            return [self._execute(stmt, auth_check) for stmt in stmts]
+            try:
+                return [self._execute(stmt, auth_check, qos=qos)
+                        for stmt in stmts]
+            finally:
+                if writes:
+                    # eager sweep after SQL writes, narrowed to the
+                    # written tables' fields (the serving layer's own
+                    # write-path narrowing; lazy get-time snapshot
+                    # validation still backstops correctness)
+                    serving = getattr(self.executor, "serving", None)
+                    if serving is not None and serving.cache is not None:
+                        serving.cache.sweep(
+                            self.holder,
+                            self._written_fields(stmts))
         except ExecError as e:  # surface executor errors as SQL errors
             raise SQLError(str(e)) from e
 
     def query_one(self, sql: str, auth_check=None,
-                  write_guard=None) -> SQLResult:
-        return self.query(sql, auth_check, write_guard)[-1]
+                  write_guard=None, qos=None) -> SQLResult:
+        return self.query(sql, auth_check, write_guard, qos=qos)[-1]
+
+    def _written_fields(self, stmts) -> set | None:
+        """Field names the batch's write statements can touch (every
+        field of each written table, plus existence) — the result-
+        cache sweep's `touched` narrowing.  None (sweep everything)
+        when a written table cannot be resolved (DDL that dropped
+        it, schema statements)."""
+        from pilosa_tpu.models.index import EXISTENCE_FIELD
+        out: set = set()
+        for s in stmts:
+            for table, perm in self._stmt_accesses(s):
+                if perm != "write":
+                    continue
+                if table is None:
+                    return None
+                idx = self.holder.index(table)
+                if idx is None:
+                    return None
+                out.update(idx.fields)
+        out.add(EXISTENCE_FIELD)
+        return out
 
     # -- statement dispatch ---------------------------------------------
 
-    def _execute(self, stmt, auth_check=None) -> SQLResult:
+    def _execute(self, stmt, auth_check=None, qos=None) -> SQLResult:
         st = self.stmts
         if isinstance(stmt, ast.CreateTable):
             return st.create_table(stmt)
@@ -234,11 +285,157 @@ class SQLEngine:
         if isinstance(stmt, ast.Delete):
             return st.delete(stmt)
         if isinstance(stmt, ast.Select):
-            return self._select(stmt)
+            return self._select(stmt, qos=qos)
         raise SQLError(f"unsupported statement {type(stmt).__name__}")
 
-    def _select(self, stmt: ast.Select) -> SQLResult:
-        return plan.plan_select(self, stmt).run()
+    # -- SELECT through the serving plane (ISSUE 13) --------------------
+
+    def _select(self, stmt: ast.Select, qos=None) -> SQLResult:
+        serving = getattr(self.executor, "serving", None)
+        pushdown = costplan.enabled()
+        t0 = time.perf_counter()
+        op = plan.plan_select(self, stmt)
+        metrics.SQL_PLAN_COST.observe(
+            (time.perf_counter() - t0) * 1e3)
+        if (serving is None or not pushdown
+                or getattr(self._tls, "active", False)):
+            # host path: standalone engines, the PILOSA_TPU_SQL_
+            # PUSHDOWN=0 kill-switch, and nested selects (views /
+            # derived tables / subqueries — the OUTER statement
+            # already owns admission, cache, and the flight record)
+            if not getattr(self._tls, "active", False):
+                for opname, _outcome in op.decisions():
+                    metrics.SQL_PUSHDOWN.inc(op=opname, outcome="host")
+            return op.run()
+        return self._select_serving(serving, op, stmt, qos)
+
+    def _select_serving(self, serving, op, stmt,
+                        qos) -> SQLResult:
+        """Production SELECT: per-statement cost-classed admission
+        (executor/sched.py), the versioned result cache keyed by
+        canonicalized statement + read-set snapshot, inner PQL calls
+        routed through the fused serving plane, and a route-"sql"
+        flight record carrying the plan fingerprint and the planner's
+        pushdown decisions."""
+        from pilosa_tpu.executor import sched as _sched
+        canon = costplan.canonical(stmt)
+        fp = costplan.fingerprint(stmt.table or "", canon)
+        cls = _sched.classify_sql(stmt, qos, fingerprint=fp)
+        if (qos is not None and qos.deadline_s is not None
+                and time.monotonic() > qos.deadline_s):
+            metrics.ADMISSION_TOTAL.inc(**{"class": cls,
+                                           "outcome": "expired"})
+            raise _sched.ServingDeadlineExceeded(
+                "deadline expired before SQL execution")
+        if cls == _sched.CLASS_HEAVY and serving.sched is not None:
+            with serving.sched.heavy_slot(qos):
+                return self._run_select(serving, op, stmt, qos, canon,
+                                        fp, cls)
+        metrics.ADMISSION_TOTAL.inc(**{"class": cls,
+                                       "outcome": "admitted"})
+        return self._run_select(serving, op, stmt, qos, canon, fp, cls)
+
+    def _run_select(self, serving, op, stmt, qos, canon: str,
+                    fp: str, cls: str) -> SQLResult:
+        from pilosa_tpu.executor import sched as _sched
+        from pilosa_tpu.executor.serving import _MISS, field_snapshot
+        t0 = time.perf_counter()
+        decisions = op.decisions()
+        # single-table statements cache in the serving ResultCache,
+        # guarded by the read-set's fragment-version snapshot — the
+        # same staleness contract PQL entries carry, so writes
+        # invalidate SQL results exactly like PQL ones
+        idx = getattr(op, "idx", None)
+        key = fields = snap = None
+        if idx is not None and serving.cache is not None:
+            fields = costplan.stmt_read_fields(self, idx, stmt)
+            if fields is not None:
+                key = (idx.name, "sql\x00" + canon, None)
+                snap = field_snapshot(idx, fields)
+                hit = serving.cache.get(idx, key, cur_snap=snap)
+                if hit is not _MISS:
+                    metrics.RESULT_CACHE.inc(outcome="hit")
+                    self._commit_sql_flight(
+                        stmt, canon, fp, cls, qos, decisions,
+                        time.perf_counter() - t0, routes=["cached"])
+                    return hit
+                metrics.RESULT_CACHE.inc(outcome="miss")
+        fl = flight.begin(stmt.table or "", canon)
+        inner = _sched.QoS(
+            tenant=qos.tenant if qos is not None else "default",
+            priority=_sched.CLASS_POINT,
+            deadline_ms=qos.deadline_ms if qos is not None else None,
+            deadline_s=qos.deadline_s if qos is not None else None)
+        self._tls.qos = inner
+        self._tls.active = True
+        err = None
+        try:
+            res = op.run()
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            self._tls.active = False
+            self._tls.qos = None
+            dur = time.perf_counter() - t0
+            if fl is not None:
+                fl["tenant"] = qos.tenant if qos is not None \
+                    else "default"
+                fl["priority"] = cls
+                fl["pushdown"] = [{"op": o, "outcome": oc}
+                                  for o, oc in decisions]
+                flight.commit(fl, dur, route="sql", fingerprint=fp,
+                              error=err)
+            for o, oc in decisions:
+                metrics.SQL_PUSHDOWN.inc(op=o, outcome=oc)
+        if key is not None and field_snapshot(idx, fields) == snap:
+            # store only if no write raced the execution (the PQL
+            # store protocol); recompute cost from the fingerprint
+            # profile, else the duration just paid
+            cost = None
+            if _stats.enabled():
+                cost = _stats.est_recompute_ms(fp)
+                if cost is None:
+                    cost = dur * 1e3
+            serving.cache.put(key, fields, snap, res, cost_ms=cost)
+        return res
+
+    def _commit_sql_flight(self, stmt, canon, fp, cls, qos, decisions,
+                           dur: float, routes=None):
+        """A standalone route-"sql" flight record for serves that ran
+        no inner executor call (statement-cache hits)."""
+        fl = flight.begin(stmt.table or "", canon)
+        if fl is None:
+            return
+        fl["tenant"] = qos.tenant if qos is not None else "default"
+        fl["priority"] = cls
+        fl["pushdown"] = [{"op": o, "outcome": oc}
+                          for o, oc in decisions]
+        if routes:
+            fl["serving_routes"] = list(routes)
+        # keep route="sql" (the /debug/queries contract) but mark the
+        # serve cached so the statistics catalog's recompute-cost
+        # EWMA — the cache-eviction signal — is not talked down by
+        # the cache's own sub-ms hits (stats.FingerprintProfile.fold)
+        fl["cached"] = True
+        flight.commit(fl, dur, route="sql", fingerprint=fp)
+
+    def run_call(self, idx, call):
+        """Route one read call through the production serving plane
+        (admission already happened at statement level, so inner
+        calls ride the point lane): cross-query fused batching, the
+        ragged page-table program, and the PQL result cache all apply
+        to SQL's pushed operators.  Falls back to the solo executor
+        without a serving layer or with the pushdown kill-switch
+        thrown — bit-exact either way, because the serving path's
+        fallback IS the solo path."""
+        serving = getattr(self.executor, "serving", None)
+        if serving is None or not costplan.enabled():
+            return self.executor._execute_call(idx, call, None)
+        from pilosa_tpu.pql.ast import Query
+        qos = getattr(self._tls, "qos", None)
+        return serving.execute(idx.name, Query(calls=[call]), None,
+                               qos=qos)[0]
 
     # -- schema lookups shared by the modules ---------------------------
 

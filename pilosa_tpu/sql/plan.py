@@ -50,13 +50,21 @@ def _filter_lines(eng, idx, where) -> list[str]:
 
 
 class PlanOp:
-    """One SELECT strategy: EXPLAIN rendering + execution."""
+    """One SELECT strategy: EXPLAIN rendering + execution +
+    pushdown accounting."""
 
     def lines(self) -> list[str]:
         raise NotImplementedError
 
     def run(self) -> SQLResult:
         raise NotImplementedError
+
+    def decisions(self) -> list[tuple[str, str]]:
+        """(operator, outcome) planner decisions for the flight
+        record and ``pilosa_sql_pushdown_total``: outcome "pushdown"
+        = the operator rides PQL on the fused serving plane, "host" =
+        it executes host-side over materialized rows."""
+        return []
 
 
 class ConstProjectOp(PlanOp):
@@ -65,6 +73,9 @@ class ConstProjectOp(PlanOp):
 
     def lines(self):
         return ["constant projection (no table)"]
+
+    def decisions(self):
+        return [("const", "host")]
 
     def run(self):
         return self.eng.select.select_const(self.stmt)
@@ -76,6 +87,9 @@ class ViewExpandOp(PlanOp):
 
     def lines(self):
         return [f"view expansion: {self.stmt.table}"]
+
+    def decisions(self):
+        return [("view", "host")]
 
     def run(self):
         return self.eng.select.select_view(self.stmt)
@@ -97,13 +111,27 @@ class DerivedTableOp(PlanOp):
     def run(self):
         return self.eng.select.select_derived(self.stmt)
 
+    def decisions(self):
+        return [("derived", "host")]
+
 
 class NestedLoopJoinOp(PlanOp):
-    def __init__(self, eng, stmt):
+    def __init__(self, eng, stmt, order_note: str | None = None):
         self.eng, self.stmt = eng, stmt
+        # the cost planner's join-order decision (sql/costplan.py):
+        # non-None when catalog cardinalities reordered the joins
+        self.order_note = order_note
+
+    def decisions(self):
+        out = [("join", "host")]
+        out.append(("join_order",
+                    "catalog" if self.order_note else "static"))
+        return out
 
     def lines(self):
         out = []
+        if self.order_note:
+            out.append(f"join order ({self.order_note})")
         for j in self.stmt.joins:
             src = j.table if j.subquery is None else "(subquery)"
             if j.left is None:  # comma join: condition lives in WHERE
@@ -153,8 +181,24 @@ class PQLGroupByOp(_FilteredOp):
             else sel.select_grouped
         return fn(self.idx, self.stmt, self.items, self._filt())
 
+    def decisions(self):
+        return [("groupby", "host" if self.generic else "pushdown")]
+
 
 class PQLAggregateOp(_FilteredOp):
+    def decisions(self):
+        sel = self.eng.select
+        out = []
+        for it in self.items:
+            e = it.expr
+            if isinstance(e, ast.Agg):
+                out.append((f"agg_{e.func}",
+                            "pushdown" if sel._agg_pushable(self.idx, e)
+                            else "host"))
+            else:
+                out.append(("agg_expr", "host"))
+        return out
+
     def lines(self):
         out = _filter_lines(self.eng, self.idx, self.stmt.where)
         for it in self.items:
@@ -171,8 +215,17 @@ class PQLAggregateOp(_FilteredOp):
 class DistinctScanOp(_FilteredOp):
     def lines(self):
         out = _filter_lines(self.eng, self.idx, self.stmt.where)
-        out.append(f"PQL Distinct scan: {self.items[0].expr.name}")
+        name = self.items[0].expr.name
+        f = self.idx.field(name)
+        if f is not None and f.options.type.is_bsi:
+            out.append(f"PQL Distinct scan: {name} "
+                       "(fused bsi_value_hist single-pass)")
+        else:
+            out.append(f"PQL Distinct scan: {name}")
         return out
+
+    def decisions(self):
+        return [("distinct", "pushdown")]
 
     def run(self):
         return self.eng.select.select_distinct(
@@ -198,6 +251,21 @@ class ExtractScanOp(_FilteredOp):
                        + (f" offset {stmt.offset}" if stmt.offset
                           else ""))
         out.append("Extract scan (device row materialization)")
+        return out
+
+    def decisions(self):
+        out = [("extract", "pushdown")]
+        stmt, idx = self.stmt, self.idx
+        if stmt.order_by:
+            ob = stmt.order_by[0] if len(stmt.order_by) == 1 else None
+            bsi_sort = (ob is not None and isinstance(ob.expr, ast.Col)
+                        and ob.expr.name != "_id"
+                        and idx.field(ob.expr.name) is not None
+                        and idx.field(ob.expr.name)
+                        .options.type.is_bsi)
+            out.append(("sort", "pushdown" if bsi_sort else "host"))
+        if stmt.distinct:
+            out.append(("distinct", "host"))
         return out
 
     def run(self):
@@ -265,7 +333,13 @@ def plan_select(eng, stmt: ast.Select) -> PlanOp:
                 raise SQLError(f"aggregate '{a.func.upper()}()' "
                                "not allowed in GROUP BY")
     if stmt.joins:
-        return NestedLoopJoinOp(eng, stmt)
+        # cost-based join order (sql/costplan.py): catalog
+        # cardinalities reorder safe star-shaped inner joins so the
+        # smallest hash sides build first; cold catalog / unsafe
+        # shapes keep the written order (the static plan)
+        from pilosa_tpu.sql import costplan
+        note = costplan.order_joins(eng, stmt)
+        return NestedLoopJoinOp(eng, stmt, order_note=note)
     if stmt.table_alias:
         _normalize_alias(stmt)
     eng.select.reject_foreign_quals(stmt)

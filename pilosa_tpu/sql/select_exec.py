@@ -213,7 +213,6 @@ class SelectExec:
 
     def eval_agg(self, idx, a: ast.Agg, filt: Call):
         eng = self.eng
-        ex = eng.executor
         hasf = has_filter(filt)
         fchildren = [filt] if hasf else []
         if not self._agg_pushable(idx, a):
@@ -223,12 +222,12 @@ class SelectExec:
                                   and a.arg.name == "_id")):
             # COUNT(_id) counts records — _id is never NULL
             # (defs_aggregate countTests_2)
-            return ex._execute_call(idx, Call(
-                "Count", children=[filt]), None)
+            return eng.run_call(idx, Call(
+                "Count", children=[filt]))
         if a.func == "count" and a.distinct:
-            res = ex._execute_call(idx, Call(
+            res = eng.run_call(idx, Call(
                 "Distinct", args={"_field": a.arg.name},
-                children=fchildren), None)
+                children=fchildren))
             return len(res.values) if isinstance(res, DistinctValues) \
                 else res.count()
         if a.func == "count":
@@ -241,14 +240,14 @@ class SelectExec:
                 nn = Call("UnionRows", children=[
                     Call("Rows", args={"_field": a.arg.name})])
             tree = Call("Intersect", children=[filt, nn]) if hasf else nn
-            return ex._execute_call(idx, Call("Count", children=[tree]),
-                                    None)
+            return eng.run_call(idx, Call("Count",
+                                          children=[tree]))
         if a.func in ("sum", "min", "max", "avg"):
             call_name = {"sum": "Sum", "min": "Min", "max": "Max",
                          "avg": "Sum"}[a.func]
-            res = ex._execute_call(idx, Call(
+            res = eng.run_call(idx, Call(
                 call_name, args={"_field": a.arg.name},
-                children=fchildren), None)
+                children=fchildren))
             if a.func == "avg":
                 return self._avg_quantize(res.value, res.count)
             return res.value
@@ -256,8 +255,8 @@ class SelectExec:
             args = {"_field": a.arg.name, "nth": a.extra}
             if hasf:
                 args["filter"] = filt
-            res = ex._execute_call(idx, Call("Percentile", args=args),
-                                   None)
+            res = eng.run_call(idx, Call("Percentile",
+                                         args=args))
             return res.value if res is not None else None
         if a.func in ("var", "corr"):
             return self.eval_var_corr(idx, a, filt)
@@ -276,7 +275,7 @@ class SelectExec:
             eng._field(idx, n)
         c = Call("Extract", children=[filt] + [
             Call("Rows", args={"_field": n}) for n in cols])
-        table = eng.executor._execute_call(idx, c, None)
+        table = eng.run_call(idx, c)
         ev = Evaluator(udfs=eng._udf_callables())
         vals = []
         for entry in table.columns:
@@ -331,7 +330,7 @@ class SelectExec:
             eng._field(idx, n)
         c = Call("Extract", children=[filt] + [
             Call("Rows", args={"_field": n}) for n in ref_cols])
-        table = eng.executor._execute_call(idx, c, None)
+        table = eng.run_call(idx, c)
         ev = Evaluator(udfs=eng._udf_callables())
         cols = [[], []]
         for entry in table.columns:
@@ -423,7 +422,7 @@ class SelectExec:
             args["having"] = self.compile_having(having)
         call = Call("GroupBy", args=args, children=[
             Call("Rows", args={"_field": g}) for g in group_cols])
-        groups = eng.executor._execute_call(idx, call, None)
+        groups = eng.run_call(idx, call)
         rows = []
         for g in groups:
             if sum_field is not None and not g.agg_count:
@@ -665,9 +664,9 @@ class SelectExec:
         eng = self.eng
         name = item.expr.name
         f = eng._field(idx, name)
-        res = eng.executor._execute_call(idx, Call(
+        res = eng.run_call(idx, Call(
             "Distinct", args={"_field": name},
-            children=[filt] if has_filter(filt) else []), None)
+            children=[filt] if has_filter(filt) else []))
         if isinstance(res, DistinctValues):
             values = res.values
         else:
@@ -826,7 +825,7 @@ class SelectExec:
         def run_extract(src):
             c = Call("Extract", children=[src] + [
                 Call("Rows", args={"_field": n}) for n in extract_cols])
-            return eng.executor._execute_call(idx, c, None)
+            return eng.run_call(idx, c)
 
         table = run_extract(inner)
         need_nulls = null_tail is not None and (
@@ -911,23 +910,43 @@ class SelectExec:
                     stmt.order_by[ki].desc)
             rows = [rows[i] for i in order]
         if stmt.distinct:
-            # spill-backed dedup: in-memory set until the threshold,
-            # then the on-disk extendible hash (sql3 opdistinct over
-            # bufferpool/extendiblehash)
-            import os
-            import tempfile
-            from pilosa_tpu.storage.extendiblehash import SpillSet
-            fd, spill_path = tempfile.mkstemp(suffix=".distinct")
-            os.close(fd)  # mkstemp (not mktemp): no TOCTOU on the name
-            spill = SpillSet(spill_path)
-            try:
+            # single-BSI-column DISTINCT dedups in memory: the value
+            # space is the bsi_value_hist's (bounded by 2^depth), so
+            # the distinct set can never outgrow what the fused
+            # histogram already answers — spilling those to the
+            # on-disk extendible hash bought durability nothing needs
+            # (ISSUE 13 satellite; DistinctScanOp serves the shape
+            # directly when the planner can prove it)
+            single_bsi = (len(plans) == 1 and plans[0][0] == "col"
+                          and eng._field(idx, plans[0][1])
+                          .options.type.is_bsi)
+            if single_bsi:
+                seen: set = set()
                 deduped = []
                 for r in rows:
-                    if spill.add(distinct_key(r)):
+                    k = distinct_key(r)
+                    if k not in seen:
+                        seen.add(k)
                         deduped.append(r)
                 rows = deduped
-            finally:
-                spill.close()
+            else:
+                # spill-backed dedup: in-memory set until the
+                # threshold, then the on-disk extendible hash (sql3
+                # opdistinct over bufferpool/extendiblehash)
+                import os
+                import tempfile
+                from pilosa_tpu.storage.extendiblehash import SpillSet
+                fd, spill_path = tempfile.mkstemp(suffix=".distinct")
+                os.close(fd)  # mkstemp, not mktemp: no name TOCTOU
+                spill = SpillSet(spill_path)
+                try:
+                    deduped = []
+                    for r in rows:
+                        if spill.add(distinct_key(r)):
+                            deduped.append(r)
+                    rows = deduped
+                finally:
+                    spill.close()
         rows = limit_rows(stmt, rows)
         return SQLResult(schema=schema, rows=rows)
 
@@ -1089,14 +1108,14 @@ class SelectExec:
         filt = filt if filt is not None else Call("All")
         if name == "_id":
             c = Call("Extract", children=[filt])
-            table = eng.executor._execute_call(idx, c, None)
+            table = eng.run_call(idx, c)
             return {int(e["column"]): e.get("column_key",
                                             e["column"])
                     for e in table.columns}
         f = eng._field(idx, name)
         c = Call("Extract", children=[
             filt, Call("Rows", args={"_field": name})])
-        table = eng.executor._execute_call(idx, c, None)
+        table = eng.run_call(idx, c)
         setlike = f.options.type in (FieldType.SET, FieldType.TIME,
                                      FieldType.MUTEX)
         out = {}
@@ -1168,7 +1187,7 @@ class SelectExec:
         return rows[0] if len(rows) == 1 else rows
 
     def table_ids(self, idx, filt) -> list:
-        res = self.eng.executor._execute_call(idx, filt, None)
+        res = self.eng.run_call(idx, filt)
         return [int(c) for c in res.columns()]
 
     # -- JOIN (sql3 opnestedloops.go nested-loop join) ------------------

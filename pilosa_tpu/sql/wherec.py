@@ -147,7 +147,7 @@ class WhereCompiler:
             eng._field(idx, n)  # validate
         c = Call("Extract", children=[filt] + [
             Call("Rows", args={"_field": n}) for n in cols])
-        table = eng.executor._execute_call(idx, c, None)
+        table = eng.run_call(idx, c)
         ev = Evaluator(udfs=eng._udf_callables())
         out = []
         for entry in table.columns:
@@ -296,8 +296,7 @@ class WhereCompiler:
                 import operator
                 cmp = {"<": operator.lt, "<=": operator.le,
                        ">": operator.gt, ">=": operator.ge}[op]
-                res = eng.executor._execute_call(idx, Call("All"),
-                                                 None)
+                res = eng.run_call(idx, Call("All"))
                 cols = [int(c) for c in res.columns()
                         if cmp(int(c), val)]
                 return Call("ConstRow", args={"columns": cols})

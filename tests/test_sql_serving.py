@@ -1,0 +1,462 @@
+"""SQL on the fused serving plane (ISSUE 13): shared executor, the
+pushdown kill-switch A/B, the catalog-fed cost-based planner's
+test-pinned decision flips, the 32-thread concurrent property suite
+under interleaved writes, per-statement admission (typed 503/504 on
+/sql), the statement result cache, route-"sql" flight records, and
+the DISTINCT value-hist-vs-spill bit-exactness pin."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from pilosa_tpu.api import API
+from pilosa_tpu.models import Holder
+from pilosa_tpu.obs import flight, stats
+from pilosa_tpu.sql import costplan
+from pilosa_tpu.sql.engine import SQLEngine
+
+W = 1 << 10
+
+
+def _seed(api_or_eng):
+    run = (api_or_eng.sql if isinstance(api_or_eng, API)
+           else lambda s: api_or_eng.query_one(s))
+    run("create table t (_id id, i1 int, s1 string, m1 int, w1 int)")
+    run("insert into t (_id, i1, s1, m1, w1) values "
+        "(1, 5, 'a', 2, 1), (2, 7, 'b', 2, 1), (3, 5, 'c', 3, 1), "
+        "(4, 9, 'a', 3, 1), (5, 2, 'b', 2, 1), (6, 7, 'c', 4, 1)")
+    run("create table u (_id id, k1 int, lbl string)")
+    run("insert into u (_id, k1, lbl) values "
+        "(1, 2, 'x'), (2, 3, 'y'), (3, 4, 'z')")
+
+
+# statements whose read set the storm's writer never touches (it
+# mutates only w1 bits on existing records, so existence is stable)
+STABLE_STMTS = [
+    "select count(*) from t",
+    "select count(*), sum(i1) from t where m1 = 2",
+    "select _id, i1 from t where _id = 3",
+    "select distinct i1 from t",
+    "select m1, count(*), sum(i1) from t group by m1",
+    "select t.i1, u.lbl from t inner join u on t.m1 = u.k1 "
+    "where u.k1 = 2",
+    "select count(*) from t inner join u on t.m1 = u.k1",
+    "select i1 from t where i1 > 4 order by i1 desc limit 3",
+    "select avg(i1) from t",
+]
+
+
+def _rows(api, sql, **kw):
+    return api.sql(sql, **kw)["data"]
+
+
+@pytest.fixture
+def serving_api():
+    h = Holder(width=W)
+    api = API(h)
+    api.executor.enable_serving()
+    _seed(api)
+    yield api
+
+
+def test_sql_engine_shares_server_executor():
+    """Satellite: SQLEngine no longer constructs a second Executor —
+    API's SQL engine IS the API executor's client, so both surfaces
+    share the serving layer, stack cache, and ledger client."""
+    api = API(Holder(width=W))
+    assert api.sql_engine.executor is api.executor
+    from pilosa_tpu.server.grpc import GRPCHandler
+    gh = GRPCHandler(api)
+    assert gh.sql is api.sql_engine
+    # standalone engines still own a private executor
+    h2 = Holder(width=W)
+    eng = SQLEngine(h2)
+    assert eng.executor is not api.executor
+    assert eng.executor.holder is h2
+
+
+def test_pushdown_killswitch_ab_bit_exact(serving_api, monkeypatch):
+    """PILOSA_TPU_SQL_PUSHDOWN=0 reverts to the solo host path with
+    identical results for the whole statement matrix."""
+    api = serving_api
+    pushed = [_rows(api, s) for s in STABLE_STMTS]
+    monkeypatch.setenv("PILOSA_TPU_SQL_PUSHDOWN", "0")
+    host = [_rows(api, s) for s in STABLE_STMTS]
+    assert pushed == host
+    monkeypatch.delenv("PILOSA_TPU_SQL_PUSHDOWN")
+    again = [_rows(api, s) for s in STABLE_STMTS]
+    assert again == pushed
+
+
+def test_sql_flight_record_shape(serving_api):
+    """Every served SELECT leaves a route-"sql" record carrying the
+    plan fingerprint, the planner's pushdown decisions, and the inner
+    dispatches' serving routes (fused/cached/direct) — the
+    /debug/queries visibility the acceptance names."""
+    api = serving_api
+    prev = (flight.recorder.enabled, flight.recorder._ring.maxlen)
+    flight.recorder.configure(enabled=True, keep=128)
+    flight.recorder.clear()
+    try:
+        _rows(api, "select count(*), sum(i1) from t where m1 = 2")
+        recs = [r for r in flight.recorder.recent(32)
+                if r.get("route") == "sql"]
+        assert recs, "no sql flight record"
+        rec = recs[0]
+        assert rec["fingerprint"]
+        ops = {d["op"]: d["outcome"] for d in rec["pushdown"]}
+        assert ops == {"agg_count": "pushdown", "agg_sum": "pushdown"}
+        # the inner Count/Sum rode the serving plane (fused when
+        # batched, direct/cached otherwise — never absent)
+        assert rec.get("serving_routes"), rec
+        assert set(rec["serving_routes"]) <= {"fused", "cached",
+                                              "direct"}
+        assert rec["priority"] in ("point", "heavy")
+    finally:
+        flight.recorder.configure(enabled=prev[0], keep=prev[1])
+
+
+def test_sql_statement_cache_hit_and_write_invalidation(serving_api):
+    api = serving_api
+    srv = api.executor.serving
+    q = "select m1, count(*), sum(i1) from t group by m1"
+    first = _rows(api, q)
+    h0 = srv.cache.hits
+    assert _rows(api, q) == first
+    assert srv.cache.hits > h0, "second serve missed the statement cache"
+    # a write to a read-set field invalidates the entry
+    api.sql("insert into t (_id, i1, m1, w1) values (7, 100, 2, 1)")
+    after = _rows(api, q)
+    assert after != first
+    host = SQLEngine(api.holder)  # solo host-path recompute
+    assert sorted(after) == sorted(
+        [list(r) for r in host.query_one(q).rows])
+
+
+def test_planner_join_order_flips_under_injected_stats(serving_api):
+    """The cost-based planner's decisions change under injected
+    catalog stats (test-pinned, like PR 12's gate-flip test): with a
+    cold catalog the written join order stands; with injected
+    cardinalities the smaller side hashes first — bit-exact either
+    way; the kill-switch pins the static order."""
+    api = serving_api
+    q = ("select count(*) from t "
+         "inner join u on t.m1 = u.k1 "
+         "inner join t as t2 on t.m1 = t2.m1")
+    baseline = _rows(api, q)
+
+    def explain_lines():
+        return [r[0] for r in _rows(api, "explain " + q)]
+
+    assert not any(l.startswith("join order (catalog")
+                   for l in explain_lines()), "cold catalog reordered"
+    cat = stats.get()
+    # u measures MUCH bigger than t: the t2 side should hash first
+    cat.note_ingest("u", "k1", rows=[0], cols=list(range(4000)))
+    cat.note_ingest("t", "m1", rows=[0], cols=list(range(4)))
+    lines = explain_lines()
+    assert lines[0].startswith("join order (catalog:"), lines
+    assert lines[0].index("t2~") < lines[0].index("u~"), lines
+    assert _rows(api, q) == baseline  # reordered plan, same rows
+    # flip the injected stats: the written order is already optimal,
+    # so the planner keeps it (no reorder note)
+    cat.clear()
+    cat.note_ingest("u", "k1", rows=[0], cols=list(range(3)))
+    cat.note_ingest("t", "m1", rows=[0], cols=list(range(400)))
+    lines = explain_lines()
+    assert not lines[0].startswith("join order (catalog"), lines
+    assert _rows(api, q) == baseline
+    # kill-switch: planner reverts to the static order
+    os.environ["PILOSA_TPU_SQL_PUSHDOWN"] = "0"
+    try:
+        assert not any(l.startswith("join order (catalog")
+                       for l in explain_lines())
+        assert _rows(api, q) == baseline
+    finally:
+        del os.environ["PILOSA_TPU_SQL_PUSHDOWN"]
+
+
+def test_distinct_value_hist_bit_exact_vs_spill_path(serving_api):
+    """Satellite: eligible single-column DISTINCT rides the fused
+    bsi_value_hist (DistinctScanOp); the on-disk SpillSet arm —
+    forced through ExtractScanOp — must agree bit-for-bit, including
+    past the planner's preferred route."""
+    from pilosa_tpu.sql import ast, plan
+    from pilosa_tpu.sql.parser import parse_sql
+    api = serving_api
+    # widen the value set so the spill arm does real dedup work
+    vals = ", ".join(f"({i + 10}, {i % 97}, 5, 1)" for i in range(300))
+    api.sql("insert into t (_id, i1, m1, w1) values " + vals)
+    eng = api.sql_engine
+    for q in ("select distinct i1 from t",
+              "select distinct i1 from t where m1 = 5",
+              "select distinct i1 from t order by i1 desc limit 7"):
+        stmt = parse_sql(q)[0]
+        op = plan.plan_select(eng, stmt)
+        assert isinstance(op, plan.DistinctScanOp), (q, type(op))
+        assert op.decisions() == [("distinct", "pushdown")]
+        hist_rows = op.run().rows
+        # the spill arm: the same statement forced through the
+        # Extract scan + dedup path
+        stmt2 = parse_sql(q)[0]
+        items = [ast.SelectItem(ast.Col("i1"), "i1")]
+        spill_rows = plan.ExtractScanOp(
+            eng, stmt2, eng._index("t"), items).run().rows
+        assert sorted(hist_rows) == sorted(spill_rows), q
+        if "order by" in q:
+            assert hist_rows == spill_rows  # ordering + limit agree
+
+
+def test_single_bsi_distinct_extract_path_skips_spill(serving_api,
+                                                      monkeypatch):
+    """The forced Extract arm of a single-BSI-column DISTINCT dedups
+    in memory (the value space is the histogram's) — SpillSet is
+    never opened for it."""
+    from pilosa_tpu.sql import ast, plan
+    from pilosa_tpu.sql.parser import parse_sql
+    from pilosa_tpu.storage import extendiblehash
+    api = serving_api
+    opened = []
+    orig = extendiblehash.SpillSet
+
+    class Spy(orig):
+        def __init__(self, *a, **kw):
+            opened.append(a)
+            super().__init__(*a, **kw)
+
+    monkeypatch.setattr(extendiblehash, "SpillSet", Spy)
+    eng = api.sql_engine
+    stmt = parse_sql("select distinct i1 from t")[0]
+    items = [ast.SelectItem(ast.Col("i1"), "i1")]
+    rows = plan.ExtractScanOp(eng, stmt, eng._index("t"), items).run()
+    assert rows.rows and not opened
+    # multi-column DISTINCT still spills
+    stmt2 = parse_sql("select distinct i1, m1 from t")[0]
+    items2 = [ast.SelectItem(ast.Col("i1"), "i1"),
+              ast.SelectItem(ast.Col("m1"), "m1")]
+    plan.ExtractScanOp(eng, stmt2, eng._index("t"), items2).run()
+    assert opened
+
+
+def test_sql_deadline_and_shed_typed_errors(serving_api):
+    """Per-statement admission on the SQL path: a dead-on-arrival
+    deadline sheds 504-typed before execution; a full heavy queue
+    sheds 503-typed with a retry hint."""
+    from pilosa_tpu.executor.sched import (
+        QoS,
+        ServingDeadlineExceeded,
+        ServingShedError,
+    )
+    api = serving_api
+    qos = QoS.make(deadline_ms=0.000001)
+    time.sleep(0.002)
+    with pytest.raises(ServingDeadlineExceeded):
+        api.sql_engine.query_one(
+            "select m1, count(*) from t group by m1", qos=qos)
+    # saturate the heavy gate: tiny queue, slots held by a sleeper
+    srv = api.executor.serving
+    srv.sched.heavy_slots = 1
+    srv.sched.queue_max = 1
+    slot = srv.sched.heavy_slot(None)
+    slot.__enter__()
+    try:
+        blocked = threading.Thread(
+            target=lambda: api.sql_engine.query_one(
+                "select m1, count(*) from t group by m1"))
+        blocked.start()
+        for _ in range(100):  # wait until the queued ticket lands
+            if srv.sched.queued():
+                break
+            time.sleep(0.01)
+        with pytest.raises(ServingShedError):
+            api.sql_engine.query_one(
+                "select i1, count(*) from t group by i1")
+    finally:
+        slot.__exit__(None, None, None)
+        blocked.join(timeout=10)
+
+
+def test_sql_http_headers_and_typed_statuses():
+    """/sql honors the QoS headers and renders shed/deadline as
+    typed 503/504 (Retry-After on sheds)."""
+    from pilosa_tpu.server.http import Server
+    h = Holder(width=W)
+    with Server(holder=h, port=0).start() as srv:
+        def req(path, body, headers=None):
+            import http.client
+            c = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                           timeout=15)
+            hdrs = {"Content-Type": "application/json"}
+            hdrs.update(headers or {})
+            c.request("POST", path, body=json.dumps(body),
+                      headers=hdrs)
+            r = c.getresponse()
+            raw = r.read()
+            c.close()
+            return r.status, json.loads(raw)
+
+        st, _ = req("/sql", {"sql": "create table t (_id id, i1 int)"})
+        assert st == 200
+        st, _ = req("/sql", {"sql": "insert into t (_id, i1) "
+                                    "values (1, 5), (2, 7)"})
+        assert st == 200
+        st, out = req("/sql", {"sql": "select sum(i1) from t"},
+                      headers={"X-Pilosa-Tenant": "acme"})
+        assert st == 200 and out["data"] == [[12]]
+        st, out = req("/sql",
+                      {"sql": "select i1, count(*) from t group by i1"},
+                      headers={"X-Pilosa-Deadline-Ms": "0.000001"})
+        assert st == 504 and out["type"] == "ServingDeadlineExceeded"
+
+
+def test_concurrent_sql_property_suite_32_threads():
+    """Satellite: 32 threads of randomized point-lookups / joins /
+    GROUP BYs under interleaved writes.  The writer toggles w1 bits
+    on existing records only, so the stable statement matrix has a
+    write-independent answer: every concurrent serving-path result
+    must equal the solo host path's, and the w1-reading statement
+    must observe one of the two quiesced states.  After the storm a
+    full pushdown-on/off A/B re-checks the matrix bit-exact."""
+    _run_concurrent_suite(n_threads=32, iters=3)
+
+
+def _run_concurrent_suite(n_threads: int, iters: int):
+    import random
+    h = Holder(width=W)
+    api = API(h)
+    api.executor.enable_serving()
+    _seed(api)
+    host = SQLEngine(h)  # private solo engine = the host reference
+
+    def host_rows(q):
+        from pilosa_tpu.api import _json_value
+        prev = os.environ.get("PILOSA_TPU_SQL_PUSHDOWN")
+        os.environ["PILOSA_TPU_SQL_PUSHDOWN"] = "0"
+        try:
+            # the same wire serialization api.sql applies, so host
+            # and serving rows compare in one domain (Decimal->float)
+            return [[_json_value(v) for v in r]
+                    for r in host.query_one(q).rows]
+        finally:
+            if prev is None:
+                del os.environ["PILOSA_TPU_SQL_PUSHDOWN"]
+            else:
+                os.environ["PILOSA_TPU_SQL_PUSHDOWN"] = prev
+
+    expected = {q: host_rows(q) for q in STABLE_STMTS}
+    wq = "select count(w1) from t where w1 = 1"
+    # the two states the Set/Clear toggle oscillates between
+    w_states = []
+    api.executor.execute("t", "Clear(1, w1=1)")
+    w_states.append(host_rows(wq))
+    api.executor.execute("t", "Set(1, w1=1)")
+    w_states.append(host_rows(wq))
+
+    errors: list = []
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            op = "Clear" if i % 2 == 0 else "Set"
+            api.executor.execute("t", f"{op}(1, w1=1)")
+            i += 1
+            time.sleep(0.001)
+
+    def reader(seed):
+        rng = random.Random(seed)
+        try:
+            for _ in range(iters):
+                q = rng.choice(STABLE_STMTS)
+                got = _rows(api, q)
+                want = expected[q]
+                if sorted(map(repr, got)) != sorted(map(repr, want)):
+                    errors.append((q, got, want))
+                gw = _rows(api, wq)
+                if gw not in w_states:
+                    errors.append((wq, gw, w_states))
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append((type(e).__name__, str(e), None))
+
+    wt = threading.Thread(target=writer)
+    wt.start()
+    threads = [threading.Thread(target=reader, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    stop.set()
+    wt.join(timeout=10)
+    assert not errors, errors[:3]
+    # quiesced pushdown-on/off A/B over the full matrix
+    api.executor.execute("t", "Set(1, w1=1)")
+    for q in STABLE_STMTS + [wq]:
+        assert sorted(map(repr, _rows(api, q))) == sorted(
+            map(repr, host_rows(q))), q
+
+
+def test_pushdown_metrics_and_plan_cost_histogram(serving_api):
+    from pilosa_tpu.obs import metrics
+    api = serving_api
+    c = metrics.SQL_PUSHDOWN
+    before = c.value(op="agg_count", outcome="pushdown")
+    _rows(api, "select count(*) from t")
+    assert c.value(op="agg_count", outcome="pushdown") == before + 1
+    # m1 is BSI, so GROUP BY m1 takes the generic hashed (host) arm
+    gb = c.value(op="groupby", outcome="host")
+    _rows(api, "select m1, count(*) from t group by m1")
+    assert c.value(op="groupby", outcome="host") == gb + 1
+    os.environ["PILOSA_TPU_SQL_PUSHDOWN"] = "0"
+    try:
+        hb = c.value(op="agg_sum", outcome="host")
+        _rows(api, "select sum(i1) from t")
+        assert c.value(op="agg_sum", outcome="host") == hb + 1
+    finally:
+        del os.environ["PILOSA_TPU_SQL_PUSHDOWN"]
+    assert metrics.SQL_PLAN_COST.count() > 0
+
+
+def test_udf_statements_escape_the_statement_cache(serving_api):
+    """A SELECT referencing a UDF must not cache: the function body
+    lives in the engine registry, which no fragment version tracks —
+    DROP + CREATE with a new body would otherwise serve stale rows
+    (review finding, reproduced live)."""
+    api = serving_api
+    api.sql("create function dbl(@x int) returns int as (@x + 1)")
+    q = "select _id, dbl(i1) from t where _id = 1"
+    assert _rows(api, q) == [["1", 6]] or _rows(api, q) == [[1, 6]]
+    api.sql("drop function dbl")
+    api.sql("create function dbl(@x int) returns int as (@x * 2)")
+    got = _rows(api, q)
+    assert got in ([["1", 10]], [[1, 10]]), got
+    # builtin-only expressions still cache
+    idx = api.sql_engine._index("t")
+    from pilosa_tpu.sql.parser import parse_sql
+    stmt = parse_sql("select upper(s1) from t")[0]
+    assert costplan.stmt_read_fields(api.sql_engine, idx, stmt) \
+        is not None
+    stmt2 = parse_sql(q)[0]
+    assert costplan.stmt_read_fields(api.sql_engine, idx, stmt2) is None
+
+
+def test_costplan_read_fields_and_canonical():
+    h = Holder(width=W)
+    eng = SQLEngine(h)
+    eng.query("create table t (_id id, i1 int, s1 string)")
+    idx = eng._index("t")
+    from pilosa_tpu.sql.parser import parse_sql
+    stmt = parse_sql("select i1 from t where s1 = 'a'")[0]
+    fields = costplan.stmt_read_fields(eng, idx, stmt)
+    assert fields == frozenset({"i1", "s1", "_exists"})
+    # whitespace/case variants share one canonical form
+    a = costplan.canonical(parse_sql("select i1 from t")[0])
+    b = costplan.canonical(parse_sql("SELECT   i1   FROM t")[0])
+    assert a == b
+    # subqueries escape the single-index snapshot guard
+    stmt2 = parse_sql(
+        "select i1 from t where i1 in (select i1 from t)")[0]
+    assert costplan.stmt_read_fields(eng, idx, stmt2) is None
