@@ -36,6 +36,7 @@ from bench.headline import groupby_fused_ab, loop_calibrate, run_queries
 from bench.kernelsmoke import kernel_smoke
 from bench.memory import memory_pressure_gauntlet, memory_smoke
 from bench.ragged import build_events_index, ragged_gauntlet, ragged_smoke
+from bench.rebalance import rebalance_gauntlet, rebalance_smoke
 from bench.serving import (
     mixed_rw_gauntlet,
     overhead_smoke,
@@ -112,6 +113,12 @@ def main() -> None:
     # point-lookup/join/GROUP BY via /sql, pushdown-vs-host A/B,
     # bit-exact hard-gated, fused-route + /debug/queries evidence
     sql_g = sql_gauntlet()
+    # scale-out chaos gauntlet (ISSUE 14): a third node joins a live
+    # 2-node cluster under the 32-client mixed storm — epoch-fenced
+    # shard migration with zero failed/mismatched, while-transfer
+    # writes bit-exact on the recipient, then a drain under the same
+    # gates
+    rebalance = rebalance_gauntlet()
     # RTT-independent device time for the sub-RTT north-star scans
     cal = loop_calibrate(h) if on_tpu else None
 
@@ -220,6 +227,12 @@ def main() -> None:
         # with fused inner dispatches and per-statement planner
         # pushdown decisions
         "sql_gauntlet": sql_g,
+        # scale-out chaos gauntlet (ISSUE 14): live join + drain of a
+        # node under the 32-client mixed storm — zero failed/
+        # mismatched hard gates, while-transfer writes bit-exact on
+        # the recipient vs cold rebuild, event-window p99 spike vs
+        # baseline, owner-invariant probe sampled throughout
+        "rebalance_gauntlet": rebalance,
     }
     if cal is not None:
         result["loop_calibrated_device_ms"] = {
@@ -293,6 +306,8 @@ def dispatch(argv) -> int:
         return stats_smoke()
     if "--sql-smoke" in argv:
         return sql_smoke()
+    if "--rebalance-smoke" in argv:
+        return rebalance_smoke()
     try:
         main()
     except Exception as e:  # clear failure JSON — never a bare crash

@@ -53,6 +53,23 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 
+echo "== rebalance chaos smoke =="
+# online-resharding gate (bench.py --rebalance-smoke,
+# bench/rebalance.py): a third node joins a live 2-node cluster
+# under a mixed read+write storm with a one-shot
+# transfer-interrupted fault armed -> CORRECTNESS-ONLY gates (2-core
+# rule): the interrupted migration resumed, zero failed / zero
+# mismatched queries, while-transfer writes bit-exact on the
+# recipient vs a cold rebuild, no epoch with zero or two write
+# owners (invariant probe sampled through the storm), then a clean
+# drain under the same gates.  p99 spike is recorded in the JSON,
+# never asserted here.
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python bench.py --rebalance-smoke; then
+    echo "check.sh: rebalance smoke failed" >&2
+    exit 1
+fi
+
 echo "== write-storm smoke =="
 # streaming write plane gate (bench.py --write-smoke): a short
 # sustained-write burst through the coalescing window plane with one

@@ -92,6 +92,10 @@ class API:
         # backup holds one while streaming files (ctl/backup.go:30)
         from pilosa_tpu.cluster.txn import TransactionManager
         self.txns = TransactionManager()
+        # online-resharding write fence (cluster/rebalance.py
+        # FenceTable), installed by ClusterNode; None on plain
+        # single-node servers — every check below is a no-op then
+        self.fences = None
 
     def _check_writable(self):
         """Writes are refused while an exclusive transaction is active
@@ -99,6 +103,91 @@ class API:
         if self.txns.exclusive_active():
             raise ApiError(
                 "cluster is read-only: exclusive transaction active", 409)
+
+    # -- online-resharding fence seams (ISSUE 14) ----------------------
+
+    def _fence_import(self, index: str, cols):
+        """Import-path fence admission: MOVED shards raise the typed
+        410 redirect (nothing was applied — re-issuing at the new
+        owner is safe), FENCING shards wait out the flip, and the
+        import registers IN FLIGHT until its finalizer runs — the
+        controller's drain ("every write admitted under the old epoch
+        finished on the donor") waits on exactly this registration,
+        so a write that slipped past the check still lands in the
+        delta log before the final chase ships it.  Returns the
+        finalizer, or None on non-cluster servers.
+
+        Registration is UNCONDITIONAL on cluster nodes (not gated on
+        a fence being armed): a write admitted moments BEFORE the
+        fence begins must already be visible to the drain barrier."""
+        if self.fences is None:
+            return None
+        width = self.holder.width
+        shards = ({int(c) // width for c in cols}
+                  if cols is not None and len(cols) else set())
+        tok = self.fences.enter_write(index, shards)
+        return lambda: self.fences.exit_write(tok)
+
+    def _fenced_import(self, index: str, cols):
+        """Context-manager form of :meth:`_fence_import` — the one
+        place the admit/register/finalize protocol lives for every
+        import-shaped write surface (a site that skips it silently
+        breaks the rebalance drain barrier)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            done = self._fence_import(index, cols)
+            try:
+                yield
+            finally:
+                if done is not None:
+                    done()
+        return guard()
+
+    def _fence_read_shards(self, index: str, shards):
+        """Read-side fence admission: MOVED shards redirect/re-plan,
+        and the read registers in flight so RELEASE cannot pop the
+        donor's fragments under a running scan (a mid-scan free would
+        silently under-count — caught by the concurrent-storm drill).
+        Returns the finalizer, or None on non-cluster servers.
+
+        Registration is UNCONDITIONAL on cluster nodes: a read
+        admitted BEFORE the fence begins can outlive the whole
+        fence→flip→release window on a loaded box, and gating the
+        registration on an armed fence made exactly those reads
+        invisible to the release drain (reproduced as an undercount
+        in the back-to-back join+drain hammer)."""
+        if self.fences is None:
+            return None
+        tok = self.fences.enter_read(index, shards)
+        return lambda: self.fences.exit_read(tok)
+
+    def _fence_write_query(self, index: str, pql: str):
+        """PQL-write fence guard: admit (blocking out a FENCING flip,
+        410-ing MOVED shards) and register the write in flight so the
+        controller's drain is a real barrier.  Returns a finalizer,
+        or None on non-cluster servers (registration is unconditional
+        on cluster nodes — see _fence_import).  With no fence armed
+        the write registers as the index WILDCARD (drains always wait
+        on wildcards, so the barrier stays exact) instead of paying a
+        second PQL parse on every steady-state write."""
+        if self.fences is None:
+            return None
+        shards = set()
+        if self.fences.active():
+            try:
+                from pilosa_tpu.pql import parse
+                q = parse(pql) if isinstance(pql, str) else pql
+                for c in q.calls:
+                    col = c.args.get("_col")
+                    if isinstance(col, int) \
+                            and not isinstance(col, bool):
+                        shards.add(col // self.holder.width)
+            except Exception:
+                pass  # unparseable -> executor raises its own 400
+        tok = self.fences.enter_write(index, shards)
+        return lambda: self.fences.exit_write(tok)
 
     # ------------------------------------------------------------------
     # queries
@@ -114,8 +203,20 @@ class API:
         deadline admission intent from the transport headers."""
         t0 = time.time()
         from pilosa_tpu.pql import is_write_query
+        fence_done = None
         if is_write_query(pql):
             self._check_writable()
+            # online-resharding fence (ISSUE 14): a write to a MOVED
+            # shard answers 410 + new owner, a write racing a FENCE
+            # flip blocks until the flip resolves, and the write
+            # registers in flight so the controller's drain barrier
+            # covers it (no-op on unfenced nodes)
+            fence_done = self._fence_write_query(index, pql)
+        else:
+            # reads of a MOVED shard redirect/re-plan instead of
+            # serving the donor's released (or stale) copy; live
+            # reads register so RELEASE drains them first
+            fence_done = self._fence_read_shards(index, shards)
         tracer = None
         # a slow-query threshold records spans for every query so the
         # long-query log can include per-phase timings (server.go:201)
@@ -140,6 +241,8 @@ class API:
         finally:
             if want_trace:
                 _tr.pop_thread_tracer(prev)
+            if fence_done is not None:
+                fence_done()
         resp = {"results": [serialize_result(r) for r in results]}
         if profile and tracer.roots:
             resp["profile"] = [s.to_dict() for s in tracer.roots]
@@ -316,7 +419,8 @@ class API:
         cols = self._translate_cols(idx, cols, col_keys)
         if len(rows) != len(cols):
             raise ApiError("rows and columns length mismatch", 400)
-        with self._import_lock(index):
+        with self._fenced_import(index, cols), \
+                self._import_lock(index):
             if clear:
                 n = 0
                 for r, c in zip(rows, cols):
@@ -356,22 +460,23 @@ class API:
         metrics.IMPORT_TOTAL.inc(index=index)
         n = 0
         touched = []
-        with self._import_lock(index):
+        with self._fenced_import(index, [int(shard) * idx.width]), \
+                self._import_lock(index):
             for row_s, blob in rows.items():
                 row = int(row_s)
-                data = base64.b64decode(blob) if isinstance(blob, str) \
-                    else blob
+                data = base64.b64decode(blob) \
+                    if isinstance(blob, str) else blob
                 try:
                     cols = roaring.decode(data)
                 except Exception as e:
                     # truncated buffers raise struct.error/ValueError
                     # from the codec internals — all client-input 400s
-                    raise ApiError(f"bad roaring data for row {row}: {e}",
-                                   400)
+                    raise ApiError(
+                        f"bad roaring data for row {row}: {e}", 400)
                 if cols.size and int(cols.max()) >= idx.width:
                     raise ApiError(
-                        f"column {int(cols.max())} exceeds shard width",
-                        400)
+                        f"column {int(cols.max())} exceeds shard "
+                        f"width", 400)
                 abs_cols = cols.astype(np.int64) + shard * idx.width
                 if clear:
                     for c in abs_cols:
@@ -423,7 +528,8 @@ class API:
             raise ApiError("values required", 400)
         if len(values) != len(cols):
             raise ApiError("columns and values length mismatch", 400)
-        with self._import_lock(index):
+        with self._fenced_import(index, cols), \
+                self._import_lock(index):
             if clear:
                 n = 0
                 for c in cols:
@@ -450,7 +556,8 @@ class API:
         the per-field imports skip it via mark_exists=False so N
         fields don't re-mark the same ids N times (the ingest
         hotspot measured r04)."""
-        self._index(index).mark_columns_exist(cols)
+        with self._fenced_import(index, cols):
+            self._index(index).mark_columns_exist(cols)
         self.sweep_import(index, set(), cols, mark_exists=True)
 
     def clear_field_columns(self, index: str, field: str, cols,
@@ -471,7 +578,8 @@ class API:
         for c in cols:
             by_shard.setdefault(int(c) // idx.width, []).append(
                 int(c) % idx.width)
-        with self._import_lock(index):
+        with self._fenced_import(index, cols), \
+                self._import_lock(index):
             for shard, local in by_shard.items():
                 mask = bm_ops.from_columns(local, idx.width)
                 for v in f.views.values():
@@ -509,7 +617,8 @@ class API:
                 raise ApiError(f"field not found: {fname}", 404)
             jobs.append((f.import_values, (cols, vals)))
         metrics.IMPORT_TOTAL.inc(index=index)
-        with self._import_lock(index):
+        with self._fenced_import(index, cols), \
+                self._import_lock(index):
             if workers > 1 and len(jobs) > 1:
                 with ThreadPoolExecutor(max_workers=workers) as pool:
                     futs = [pool.submit(fn, *args)
@@ -710,6 +819,11 @@ class API:
     def _fragment_or_404(self, index, field, view, shard, create=False):
         idx = self._index_or_404(index)
         f = idx.field(field)
+        if f is None and create and field == EXISTENCE_FIELD:
+            # transfer/repair write path: a fresh recipient has no
+            # existence field until its first local mark — create it
+            # so shipped _exists fragments land
+            f = idx._ensure_existence()
         if f is None:
             raise ApiError(f"field not found: {field}", 404)
         v = f.view(view, create=create)
@@ -769,6 +883,69 @@ class API:
             rows[int(r)] = np.frombuffer(raw, dtype=np.uint32)
         frag.set_block_rows(int(block), rows)
         return {"block": int(block), "rows": len(rows)}
+
+    # ------------------------------------------------------------------
+    # online resharding transfer surface (ISSUE 14): SNAPSHOT-COPY
+    # resumes on block checksums, DELTA-CHASE replays the PR 3 delta
+    # log above the copied version as current row contents
+    # ------------------------------------------------------------------
+
+    def _fragment_or_none(self, index, field, view, shard):
+        idx = self.holder.index(index)
+        f = idx.field(field) if idx is not None else None
+        v = f.views.get(view) if f is not None else None
+        return v.fragment(int(shard)) if v is not None else None
+
+    def fragment_state(self, index: str, field: str, view: str,
+                       shard: int) -> dict:
+        """One round-trip COPY bootstrap: the donor fragment's
+        (gen, version) captured BEFORE the block reads — so a chase
+        from ``version`` covers every write concurrent with the
+        copy — plus its block checksums for the resumable diff."""
+        frag = self._fragment_or_none(index, field, view, shard)
+        if frag is None:
+            return {"absent": True}
+        gen, version = frag.gen, frag.version
+        return {"gen": gen, "version": version,
+                "checksums": {str(b): d
+                              for b, d in frag.block_checksums().items()}}
+
+    def fragment_deltas(self, index: str, field: str, view: str,
+                        shard: int, since: int) -> dict:
+        """DELTA-CHASE feed: the current contents of every row the
+        delta log names above ``since``.  ``covered=False`` means the
+        log cannot prove coverage (overflowed window / version from
+        another incarnation) and the caller must fall back to a
+        checksum-diff round."""
+        frag = self._fragment_or_none(index, field, view, shard)
+        if frag is None:
+            return {"absent": True}
+        gen, version, count, rows = frag.delta_export(int(since))
+        if rows is None:
+            return {"covered": False, "gen": gen, "version": version}
+        import base64
+        import zlib
+        payload = {str(r): base64.b64encode(
+                       zlib.compress(
+                           np.ascontiguousarray(w).tobytes())).decode()
+                   for r, w in rows.items()}
+        return {"covered": True, "gen": gen, "version": version,
+                "count": count, "rows": payload}
+
+    def fragment_set_rows(self, index: str, field: str, view: str,
+                          shard: int, payload: dict) -> dict:
+        """Recipient-side chase apply: replace whole rows with the
+        donor's current contents (idempotent, always-forward)."""
+        import base64
+        import zlib
+        frag = self._fragment_or_404(index, field, view, shard,
+                                     create=True)
+        rows = payload.get("rows", payload)
+        for r, b64 in rows.items():
+            raw = zlib.decompress(base64.b64decode(b64))
+            frag.set_row_words(int(r),
+                              np.frombuffer(raw, dtype=np.uint32))
+        return {"rows": len(rows)}
 
     # ------------------------------------------------------------------
     # translation (api.go:929-1038 data streaming analogs)

@@ -19,7 +19,7 @@ Two distinct scales of "distributed" exist in this framework:
   exclusive transactions (transaction.go).
 """
 
-from pilosa_tpu.cluster.hash import jump_hash
+from pilosa_tpu.cluster.hash import jump_hash, placement_diff, roster_diff
 from pilosa_tpu.cluster.disco import (
     DisCo,
     InMemDisCo,
@@ -32,6 +32,13 @@ from pilosa_tpu.cluster.client import (
     DeadlineExceeded,
     InternalClient,
     RemoteError,
+    ShardMovedError,
+)
+from pilosa_tpu.cluster.rebalance import (
+    FenceTable,
+    RebalanceController,
+    RebalanceError,
+    RebalancePlan,
 )
 from pilosa_tpu.cluster.coordinator import (
     ClusterError,
@@ -46,6 +53,13 @@ from pilosa_tpu.cluster.txn import (
 
 __all__ = [
     "jump_hash",
+    "placement_diff",
+    "roster_diff",
+    "ShardMovedError",
+    "FenceTable",
+    "RebalanceController",
+    "RebalanceError",
+    "RebalancePlan",
     "DisCo",
     "InMemDisCo",
     "Node",

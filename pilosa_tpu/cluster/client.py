@@ -67,7 +67,13 @@ class RemoteError(Exception):
     ``retryable`` classifies the failure for the retry loop: True for
     load-shed/transient statuses (429/502/503/504 — another attempt
     may land on a recovered or different backend), False for
-    application errors (a 400 retried is a 400 again)."""
+    application errors (a 400 retried is a 400 again).  A 410 carries
+    the rebalance redirect hints when the peer sent them:
+    ``new_owner`` (the ``X-Pilosa-New-Owner`` URI) and
+    ``moved_shards`` — retryable-with-REDIRECT, which the typed
+    wrappers below (query_node, import_bits/-values) apply bounded to
+    one hop; before ShardMovedError existed an ownership flip mid-RPC
+    surfaced as a phantom no-live-replica 503."""
 
     def __init__(self, status: int, msg: str,
                  retryable: bool | None = None):
@@ -75,6 +81,49 @@ class RemoteError(Exception):
         self.status = status
         self.retryable = (status in _RETRYABLE_STATUS
                           if retryable is None else retryable)
+        self.new_owner: str | None = None     # URI from the 410 header
+        self.new_owner_id: str | None = None
+        self.moved_shards: list[int] | None = None
+
+
+class ShardMovedError(Exception):
+    """Typed 410: this node no longer owns the addressed shard(s) —
+    an online rebalance fenced them and flipped ownership while the
+    request was in flight.  Carries the redirect target so clients
+    retry transparently against the new owner (one hop) and
+    coordinators re-plan from a fresh placement snapshot instead of
+    shedding a phantom 503.
+
+    ``owner_uri`` may be None during the brief FENCING window's
+    resolution (ownership still settling): that is a pure
+    re-plan-with-fresh-snapshot signal, not a redirect."""
+
+    status = 410
+
+    def __init__(self, index: str, shards, owner_id: str | None = None,
+                 owner_uri: str | None = None):
+        self.index = index
+        self.shards = sorted(int(s) for s in shards)
+        self.owner_id = owner_id
+        self.owner_uri = owner_uri
+        where = (f" -> {owner_id or owner_uri}"
+                 if (owner_id or owner_uri) else " (replan)")
+        super().__init__(
+            f"shard(s) {self.shards[:4]} of {index!r} moved{where}")
+
+    @property
+    def extra_headers(self) -> dict:
+        """Wire headers the HTTP layer attaches to the 410."""
+        return ({"X-Pilosa-New-Owner": self.owner_uri}
+                if self.owner_uri else {})
+
+    @property
+    def error_fields(self) -> dict:
+        """Extra JSON fields for the 410 body (client re-parse)."""
+        out: dict = {"moved_shards": self.shards, "index": self.index}
+        if self.owner_id:
+            out["new_owner_id"] = self.owner_id
+        return out
 
 
 # transient failures the retry loop may clear (TimeoutError is an
@@ -103,7 +152,8 @@ class InternalClient:
     def _attempt(self, uri: str, method: str, path: str,
                  data: bytes | None, content_type: str | None,
                  deadline: Deadline | None,
-                 extra_headers: dict | None = None) -> tuple[int, bytes]:
+                 extra_headers: dict | None = None,
+                 ) -> tuple[int, bytes, dict]:
         detail = f"{uri}{path}"
         if deadline is not None and deadline.expired():
             # an exhausted budget means the attempt is never sent
@@ -138,7 +188,7 @@ class InternalClient:
             raw = resp.read()
         finally:
             conn.close()
-        return resp.status, raw
+        return resp.status, raw, resp.headers
 
     def _roundtrip(self, uri: str, method: str, path: str,
                    data: bytes | None, content_type: str | None,
@@ -154,15 +204,28 @@ class InternalClient:
         # `budget` below decides when a given failure class gives up
         for a in range(self.retries + 1):
             try:
-                status, raw = self._attempt(uri, method, path, data,
-                                            content_type, deadline,
-                                            extra_headers)
+                status, raw, hdrs = self._attempt(
+                    uri, method, path, data, content_type, deadline,
+                    extra_headers)
                 if status != 200:
+                    body = {}
                     try:
-                        msg = json.loads(raw).get("error", "")
+                        body = json.loads(raw)
+                        msg = body.get("error", "")
                     except Exception:
                         msg = raw[:200].decode("utf-8", "replace")
-                    raise RemoteError(status, msg)
+                    err = RemoteError(status, msg)
+                    if status == 410:
+                        # rebalance redirect hints (ShardMovedError
+                        # on the peer): the typed wrappers decide
+                        # whether a one-hop redirect is safe
+                        err.new_owner = hdrs.get("X-Pilosa-New-Owner")
+                        if isinstance(body, dict):
+                            err.new_owner_id = body.get("new_owner_id")
+                            ms = body.get("moved_shards")
+                            if isinstance(ms, list):
+                                err.moved_shards = [int(s) for s in ms]
+                    raise err
                 return raw
             except DeadlineExceeded:
                 raise  # the budget is gone; backoff can't help
@@ -209,7 +272,8 @@ class InternalClient:
                    idempotent: bool = False,
                    deadline: Deadline | None = None,
                    trace_id: str | None = None,
-                   span_parent: str | None = None) -> dict:
+                   span_parent: str | None = None,
+                   _redirected: bool = False) -> dict:
         # idempotent=True only for READ fan-outs: retrying a routed
         # write would be correct for the bits but can flip the
         # changed-count answer (a Set retried reports False)
@@ -225,11 +289,46 @@ class InternalClient:
             headers = {"X-Pilosa-Trace-Id": trace_id}
             if span_parent:
                 headers["X-Pilosa-Span-Parent"] = span_parent
-        return self._request(uri, "POST", f"/index/{index}/query",
-                             {"query": pql, "shards": shards,
-                              "remote": True},
-                             idempotent=idempotent, deadline=deadline,
-                             extra_headers=headers)
+        try:
+            return self._request(uri, "POST", f"/index/{index}/query",
+                                 {"query": pql, "shards": shards,
+                                  "remote": True},
+                                 idempotent=idempotent,
+                                 deadline=deadline,
+                                 extra_headers=headers)
+        except RemoteError as e:
+            # rebalance redirect (ShardMovedError on the peer): safe
+            # ONLY when the new owner covers the WHOLE request —
+            # re-issuing a multi-shard leg whose shards split across
+            # owners would silently serve empty fragments for the
+            # shards the target doesn't hold; those raise up to the
+            # coordinator's re-plan instead.  One hop, ever.
+            if (not _redirected and e.status == 410 and e.new_owner
+                    and e.new_owner != uri and shards is not None
+                    and e.moved_shards is not None
+                    and set(shards) <= set(e.moved_shards)):
+                return self.query_node(
+                    e.new_owner, index, pql, shards,
+                    idempotent=idempotent, deadline=deadline,
+                    trace_id=trace_id, span_parent=span_parent,
+                    _redirected=True)
+            raise
+
+    def _import_redirected(self, uri: str, index: str, field: str,
+                           body: dict) -> int:
+        """POST one shard-group import, following a single rebalance
+        redirect hop.  Imports are idempotent (set-bits OR in,
+        BSI/mutex are last-write-wins) and the 410 means the donor
+        applied NOTHING, so re-issuing at the new owner is safe."""
+        path = f"/index/{index}/field/{field}/import"
+        try:
+            r = self._request(uri, "POST", path, body)
+        except RemoteError as e:
+            if e.status == 410 and e.new_owner and e.new_owner != uri:
+                r = self._request(e.new_owner, "POST", path, body)
+            else:
+                raise
+        return r["imported"]
 
     def import_bits(self, uri: str, index: str, field: str, rows, cols,
                     timestamps=None, clear=False) -> int:
@@ -237,17 +336,14 @@ class InternalClient:
                 "columns": list(map(int, cols)), "clear": clear}
         if timestamps is not None:
             body["timestamps"] = timestamps
-        r = self._request(uri, "POST",
-                          f"/index/{index}/field/{field}/import", body)
-        return r["imported"]
+        return self._import_redirected(uri, index, field, body)
 
     def import_values(self, uri: str, index: str, field: str, cols,
                       values, clear=False) -> int:
-        r = self._request(uri, "POST",
-                          f"/index/{index}/field/{field}/import",
-                          {"columns": list(map(int, cols)),
-                           "values": list(values), "clear": clear})
-        return r["imported"]
+        return self._import_redirected(
+            uri, index, field,
+            {"columns": list(map(int, cols)),
+             "values": list(values), "clear": clear})
 
     def create_keys(self, uri: str, index: str, field: str | None,
                     keys: list[str],

@@ -27,8 +27,10 @@ from pilosa_tpu.cluster.client import (
     DeadlineExceeded,
     InternalClient,
     RemoteError,
+    ShardMovedError,
 )
 from pilosa_tpu.cluster.disco import DisCo, InMemDisCo, Node, NodeState
+from pilosa_tpu.cluster.rebalance import FenceTable
 from pilosa_tpu.cluster.snapshot import ClusterSnapshot
 from pilosa_tpu.obs import faults, flight, metrics
 from pilosa_tpu.pql import parse
@@ -187,10 +189,26 @@ class ClusterNode:
                               self._debug_cluster_metrics)
         self.server.add_route("GET", "/debug/cluster/stats",
                               self._debug_cluster_stats)
+        # online resharding (ISSUE 14): the donor-side write fence
+        # plus the control RPCs the RebalanceController drives over
+        # the node-to-node data plane, and the per-shard transfer
+        # state at /debug/rebalance
+        self.api.fences = FenceTable()
+        self.last_rebalance: dict | None = None
+        self.server.add_route("POST", "/internal/rebalance/fence",
+                              self._post_rebalance_fence)
+        self.server.add_route("POST", "/internal/rebalance/drain",
+                              self._post_rebalance_drain)
+        self.server.add_route("POST", "/internal/rebalance/release",
+                              self._post_rebalance_release)
+        self.server.add_route("POST", "/internal/rebalance/clear",
+                              self._post_rebalance_clear)
+        self.server.add_route("GET", "/debug/rebalance",
+                              self._get_debug_rebalance)
 
     # -- lifecycle -----------------------------------------------------
 
-    def open(self, warm: bool = False):
+    def open(self, warm: bool = False, member: bool = True):
         """disCo.Start + serve + heartbeats (server.go:618).
 
         ``warm=True`` is the REJOIN protocol (ROADMAP item 5): serve
@@ -200,13 +218,20 @@ class ClusterNode:
         logs, so resident device stacks re-converge by O(delta)
         patches, not full rebuilds) and prefills its stack/jit caches
         by replaying the flight recorder's hottest recent queries,
-        and only THEN registers with disco and takes traffic."""
+        and only THEN registers with disco and takes traffic.
+
+        ``member=False`` is the live-JOIN protocol (ISSUE 14): the
+        node registers live (serves, heartbeats, receives transfers)
+        but stays OUT of the placement roster — it owns nothing until
+        a RebalanceController migrates its share and commits the new
+        roster."""
         self.server.start()
         if warm:
             self.warm_stats = {"sync": self.sync_from_peers(),
                                "prefilled": self._prefill_from_flight()}
             metrics.CLUSTER_EVENTS.inc(event="node_rejoin")
-        self.disco.start(Node(id=self.node_id, uri=self.uri))
+        self.disco.start(Node(id=self.node_id, uri=self.uri),
+                         member=member)
         if warm:
             # close the rejoin skip window: a replicated write landing
             # between the bulk resync above and the disco registration
@@ -226,6 +251,10 @@ class ClusterNode:
 
     def _hb_loop(self):
         while not self._hb_stop.wait(self._hb_interval):
+            # age out MOVED fences once no stale pre-flip snapshot
+            # can still route here — keeping them forever would pin
+            # the armed-fence slow path onto every write
+            self.api.fences.sweep_moved()
             if faults.take("node-crash", self.node_id):
                 # chaos: die mid-traffic — stop serving AND beating;
                 # peers mark us DOWN and fail queries over
@@ -318,7 +347,145 @@ class ClusterNode:
     # -- placement -----------------------------------------------------
 
     def snapshot(self) -> ClusterSnapshot:
-        return ClusterSnapshot(self.disco.nodes(), self.replica_n)
+        # roster + overlays in ONE atomic read: a commit swaps them
+        # together, and observing one side pre-commit with the other
+        # post-commit would route a moved shard to its old owner
+        roster, overlays = self.disco.placement()
+        return ClusterSnapshot(self.disco.nodes(), self.replica_n,
+                               roster=roster, overlays=overlays)
+
+    # -- online resharding (ISSUE 14) ----------------------------------
+
+    def rebalance_join(self, node_id: str, **kw) -> dict:
+        """Live scale-out: migrate the joining node's jump-hash share
+        to it (it must be open(member=False) already), then commit
+        the grown roster.  Returns the plan summary."""
+        from pilosa_tpu.cluster.rebalance import RebalanceController
+        ctl = RebalanceController(self, **kw)
+        plan = ctl.run(ctl.plan_join(node_id))
+        self.last_rebalance = plan.to_dict()
+        return self.last_rebalance
+
+    def rebalance_drain(self, node_id: str, **kw) -> dict:
+        """Live scale-in: migrate everything off ``node_id`` and
+        commit the shrunk roster; the node can then close with no
+        data loss."""
+        from pilosa_tpu.cluster.rebalance import RebalanceController
+        ctl = RebalanceController(self, **kw)
+        plan = ctl.run(ctl.plan_drain(node_id))
+        self.last_rebalance = plan.to_dict()
+        return self.last_rebalance
+
+    # donor-side control RPCs the RebalanceController drives --------------
+
+    def _post_rebalance_fence(self, req):
+        body = req.json() or {}
+        index = body.get("index", "")
+        shard = int(body.get("shard", -1))
+        action = body.get("action", "")
+        f = self.api.fences
+        if action == "begin":
+            f.begin(index, shard)
+        elif action == "replan":
+            f.resolve_replan(index, shard)
+        elif action == "moved":
+            f.set_moved(index, shard, body.get("owner_id", ""),
+                        body.get("owner_uri", ""))
+        elif action == "lift":
+            f.lift(index, shard)
+        else:
+            from pilosa_tpu.api import ApiError
+            raise ApiError(f"unknown fence action {action!r}", 400)
+        return {"index": index, "shard": shard, "action": action}
+
+    def _post_rebalance_drain(self, req):
+        """Block until every write admitted before the fence finished
+        on this node: the in-flight PQL-write counter drains, then
+        the index import lock round-trips (bulk imports + ingest
+        windows hold it while applying)."""
+        body = req.json() or {}
+        index = body.get("index", "")
+        timeout_s = float(body.get("timeout_s", 10.0))
+        shards = body.get("shards")
+        drained = self.api.fences.drain_writes(index, shards=shards,
+                                               timeout_s=timeout_s)
+        with self.api._import_lock(index):
+            pass
+        return {"index": index, "drained": bool(drained)}
+
+    def _post_rebalance_clear(self, req):
+        """This node is acquiring the shard (transfer recipient):
+        drop any stale MOVED fence from a previous epoch."""
+        body = req.json() or {}
+        self.api.fences.clear(body.get("index", ""),
+                              int(body.get("shard", -1)))
+        return {}
+
+    def _post_rebalance_release(self, req):
+        """RELEASE: drop the moved shard's fragments — serving-cache
+        entries touching the shard are swept (scoped, never a full
+        flush), fragment gens retire so device stack pages die
+        through the HBM ledger, and the persisted shard file (when
+        storage-backed) is deleted."""
+        body = req.json() or {}
+        index = body.get("index", "")
+        shard = int(body.get("shard", -1))
+        idx = self.api.holder.index(index)
+        if idx is None:
+            return {"released": 0, "drained": True}
+        # readers that passed the fence check before the flip may
+        # still be scanning these fragments — freeing them mid-scan
+        # would silently under-count; the fence already 410s new
+        # reads, so this drains in one bounded wait.  A timeout means
+        # a scan is STILL running: refuse to free (the caller retries
+        # the release; ownership already flipped, so keeping the
+        # donor's copy a little longer is only memory, never wrongness)
+        if not self.api.fences.drain_reads(
+                index, [shard],
+                timeout_s=float(body.get("timeout_s", 10.0))):
+            return {"released": 0, "drained": False}
+        serving = getattr(self.api.executor, "serving", None)
+        if serving is not None and serving.cache is not None:
+            serving.cache.sweep_shards(index, {shard})
+        released = 0
+        freed = 0
+        with self.api._import_lock(index):
+            for f in idx.fields.values():
+                for v in f.views.values():
+                    frag = v.fragments.get(shard)
+                    if frag is None:
+                        continue
+                    freed += frag.memory_bytes()
+                    # gen retires BEFORE the pop: every derived stamp
+                    # (tile stacks, result snapshots, prefetch
+                    # recipes) compares unequal from here on
+                    frag.bump_gen()
+                    v.fragments.pop(shard, None)
+                    released += 1
+            if idx.storage is not None:
+                try:
+                    idx.storage.drop_shard(shard)
+                except Exception as e:
+                    self.server.logger.warn(
+                        "release: shard %s file drop failed: %s",
+                        shard, e)
+        metrics.REBALANCE_BYTES.inc(freed, kind="released")
+        return {"released": released, "bytes": freed,
+                "drained": True}
+
+    def _get_debug_rebalance(self, req):
+        """Per-shard transfer state: this node's live fences, the
+        cluster's placement roster/epoch/overlays, and the last
+        controller run this node drove."""
+        return {
+            "node": self.node_id,
+            "fences": self.api.fences.payload(),
+            "roster": self.disco.roster(),
+            "placement_epoch": self.disco.placement_epoch(),
+            "overlays": {str(p): ov for p, ov in
+                         sorted(self.disco.overlays().items())},
+            "controller": self.last_rebalance,
+        }
 
     # -- rejoin resync (holder.go:1488-1715 + fragment.go checksums) ---
 
@@ -668,9 +835,22 @@ class ClusterNode:
         with no path that ever resyncs it."""
         n = None
         last_err = None
+        moved = None
         for node in owners:
             try:
                 n_ = send(node)
+            except ShardMovedError as e:
+                # a rebalance flipped this replica's ownership away
+                # mid-import (the local-apply path raises it typed):
+                # not a death — note it and keep going, then force a
+                # RE-PLAN below even if another replica acked
+                moved = e
+                continue
+            except RemoteError as e:
+                if e.status == 410:
+                    moved = e
+                    continue
+                raise
             except _NET_ERRORS as e:
                 last_err = e
                 self.disco.set_state(node.id, NodeState.DOWN)
@@ -682,6 +862,17 @@ class ClusterNode:
                 continue
             if n is None:
                 n = n_
+        if moved is not None:
+            # NEVER settle for a partial ack when a fence skipped a
+            # replica: the fenced copy is the one the final chase
+            # ships to the recipient, so a write applied only on the
+            # other (not-yet-fenced) replica would silently miss the
+            # new owner.  Re-planning re-sends to the settled owner
+            # set — imports are idempotent, so replicas that already
+            # applied just re-apply harmlessly.
+            raise moved if isinstance(moved, ShardMovedError) \
+                else ShardMovedError(index, [shard],
+                                     owner_uri=moved.new_owner)
         if n is None:
             if owners:
                 raise ClusterError(
@@ -690,37 +881,68 @@ class ClusterNode:
             return 0
         return n
 
+    def _import_shard_replan(self, index: str, shard: int, send,
+                             snap_box: list | None = None,
+                             tries: int = 4) -> int:
+        """One shard's replicated import with moved-shard re-planning:
+        an ownership flip that raced the routing snapshot re-resolves
+        against a fresh one (the overlay/roster already names the new
+        owner) instead of failing the import.  ``snap_box`` is the
+        caller's shared single-element snapshot holder (one snapshot
+        per bulk import, not per shard group) — a refresh taken here
+        lands back in the box, so the caller's REMAINING groups plan
+        against the settled placement instead of each re-discovering
+        the flip with a doomed send plus a backoff sleep."""
+        box = snap_box if snap_box is not None else [None]
+        last: ShardMovedError | None = None
+        for attempt in range(tries):
+            if box[0] is None:
+                box[0] = self.snapshot()
+            try:
+                return self._import_replicated(
+                    index, shard, box[0].shard_nodes(index, shard),
+                    send)
+            except ShardMovedError as e:
+                last = e
+                box[0] = None  # re-plan against a fresh placement
+                # FENCING resolutions settle within the fence window;
+                # re-snapshot after a short beat
+                time.sleep(0.01 * (attempt + 1))
+        raise last
+
     def import_bits(self, index: str, field: str, rows, cols,
                     timestamps=None) -> int:
         """Route bits to shard owners; forward to all replicas
         synchronously (api.go:651-672)."""
-        snap = self.snapshot()
         groups: dict[int, list[int]] = {}
         width = self.api.holder.width
         for i, c in enumerate(cols):
             groups.setdefault(int(c) // width, []).append(i)
         n = 0
+        snap_box = [self.snapshot()]  # shared; refreshed on 410
         shards_touched = set()
         for shard, idxs in groups.items():
             srows = [int(rows[i]) for i in idxs]
             scols = [int(cols[i]) for i in idxs]
             stimes = ([timestamps[i] for i in idxs]
                       if timestamps is not None else None)
-            n += self._import_replicated(
-                index, shard, snap.shard_nodes(index, shard),
-                lambda node: self._import_to(node, index, field, srows,
-                                             scols, stimes))
+            n += self._import_shard_replan(
+                index, shard,
+                lambda node, srows=srows, scols=scols, stimes=stimes:
+                self._import_to(node, index, field, srows, scols,
+                                stimes),
+                snap_box=snap_box)
             shards_touched.add(shard)
         self.disco.add_shards(index, "", shards_touched)
         return n
 
     def import_values(self, index: str, field: str, cols, values) -> int:
-        snap = self.snapshot()
         groups: dict[int, list[int]] = {}
         width = self.api.holder.width
         for i, c in enumerate(cols):
             groups.setdefault(int(c) // width, []).append(i)
         n = 0
+        snap_box = [self.snapshot()]  # shared; refreshed on 410
         shards_touched = set()
         for shard, idxs in groups.items():
             scols = [int(cols[i]) for i in idxs]
@@ -733,8 +955,8 @@ class ClusterNode:
                 return self._client().import_values(
                     node.uri, index, field, scols, svals)
 
-            n += self._import_replicated(
-                index, shard, snap.shard_nodes(index, shard), send)
+            n += self._import_shard_replan(index, shard, send,
+                                           snap_box=snap_box)
             shards_touched.add(shard)
         self.disco.add_shards(index, "", shards_touched)
         return n
@@ -1015,28 +1237,54 @@ class ClusterExecutor:
                               args={**call.args, "_col": int(col)},
                               children=call.children)
         shard = int(col) // self.node.api.holder.width
-        snap = self.node.snapshot()
-        vals = []
         last_err = None
-        for n in snap.shard_nodes(index, shard):
-            try:
-                vals.append(self._run_on(snap, n.id, index,
-                                         call.to_pql(),
-                                         deadline=deadline))
-            except _NET_ERRORS as e:
-                if isinstance(e, DeadlineExceeded):
+        moved_err = None
+        for attempt in range(4):
+            snap = self.node.snapshot()
+            vals = []
+            moved_err = None
+            for n in snap.shard_nodes(index, shard):
+                try:
+                    vals.append(self._run_on(snap, n.id, index,
+                                             call.to_pql(),
+                                             deadline=deadline))
+                except ShardMovedError as e:
+                    # ownership flipped under this replica mid-write:
+                    # skip it — the other owners (dual/recipient)
+                    # still carry the write, else re-plan below
+                    moved_err = e
+                except RemoteError as e:
+                    if e.status == 410:
+                        moved_err = e
+                        continue
                     raise
-                # a failing replica doesn't fail the write as long as
-                # one owner acks it; DOWN on ANY net error because the
-                # mark is the resync trigger (see _import_replicated)
-                last_err = e
-                self.node.disco.set_state(n.id, NodeState.DOWN)
-        if not vals:
-            raise ClusterError(
-                f"no live replica accepted write for shard {shard}: "
-                f"{last_err}")
-        self.node.disco.add_shards(index, "", {shard})
-        return _reduce(call, vals)
+                except _NET_ERRORS as e:
+                    if isinstance(e, DeadlineExceeded):
+                        raise
+                    # a failing replica doesn't fail the write as
+                    # long as one owner acks it; DOWN on ANY net
+                    # error because the mark is the resync trigger
+                    # (see _import_replicated)
+                    last_err = e
+                    self.node.disco.set_state(n.id, NodeState.DOWN)
+            if vals and moved_err is None:
+                self.node.disco.add_shards(index, "", {shard})
+                return _reduce(call, vals)
+            if moved_err is None:
+                break
+            # a fence skipped at least one routed owner: even with
+            # another replica's ack in hand the write must RE-PLAN
+            # against a fresh snapshot — the fenced (authoritative,
+            # about-to-be-chased) copy missed it, so settling for the
+            # partial ack would lose the write on the new owner.
+            # Set/Clear re-apply idempotently on replicas that
+            # already took it.
+            time.sleep(0.01 * (attempt + 1))
+        if moved_err is not None:
+            raise moved_err
+        raise ClusterError(
+            f"no live replica accepted write for shard {shard}: "
+            f"{last_err}")
 
     def _translate_col_key(self, index: str, key: str, deadline=None):
         """Create the key on its partition owner's store; returns the
@@ -1124,6 +1372,7 @@ class ClusterExecutor:
         jobs = sorted(by_node.items())
         pool = Pool(size=2)  # task.Pool default size (executor.go:6714)
         outs = pool.map_settled(one, jobs)
+        moved_shards: list[int] = []
         for (node_id, node_shards), out in zip(jobs, outs):
             if isinstance(out, TaskFailure):
                 if isinstance(out.error, DeadlineExceeded):
@@ -1132,6 +1381,18 @@ class ClusterExecutor:
                     # (503 + failover metrics) would send clients
                     # retrying a query that can never finish
                     raise out.error
+                if isinstance(out.error, ShardMovedError) or (
+                        isinstance(out.error, RemoteError)
+                        and out.error.status == 410):
+                    # a rebalance flipped ownership mid-query (the
+                    # one-hop client redirect only covers fully-moved
+                    # legs): the node is ALIVE and still owns its
+                    # other shards — re-plan this leg from a fresh
+                    # snapshot, no DOWN mark, no avoid entry.  This
+                    # used to surface as a phantom no-live-replica
+                    # 503 (ISSUE 14 satellite).
+                    moved_shards.extend(node_shards)
+                    continue
                 if not isinstance(out.error, _NET_ERRORS):
                     raise out.error
                 last_err = out.error
@@ -1143,6 +1404,18 @@ class ClusterExecutor:
                 failed_shards.extend(node_shards)
             else:
                 partials.extend(out)
+        if moved_shards:
+            if attempts <= 1:
+                raise LoadShedError(
+                    "ownership still settling for shards "
+                    f"{sorted(moved_shards)[:4]} after re-plans",
+                    missing_shards=moved_shards)
+            snap_m = self.node.snapshot()
+            partials.extend(
+                self._fan_out(snap_m, index, pql, moved_shards,
+                              attempts - 1, deadline=deadline,
+                              partial=partial, missing=missing,
+                              avoid=avoid, tprop=tprop))
         if failed_shards:
             # shards_by_node consults node state, so the DOWN mark
             # reroutes each failed shard to its next live replica; a

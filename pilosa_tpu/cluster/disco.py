@@ -49,7 +49,11 @@ class DisCo:
     object; one Python class states that more directly)."""
 
     # lifecycle
-    def start(self, node: Node):
+    def start(self, node: Node, member: bool = True):
+        """Register a node.  ``member=False`` registers it as a LIVE
+        but placement-EXCLUDED node (it serves, heartbeats, and can
+        receive transfers, but owns nothing until a rebalance commits
+        it into the roster) — the join half of online resharding."""
         raise NotImplementedError
 
     def close(self, node_id: str):
@@ -85,6 +89,41 @@ class DisCo:
     def add_shards(self, index: str, field: str, shards: set[int]):
         raise NotImplementedError
 
+    # Placement (online resharding, ISSUE 14).  The ROSTER is the
+    # ordered bucket->node list jump-hash placement runs over —
+    # distinct from live membership so a joining node can serve
+    # transfers before it owns anything.  OVERLAYS are per-partition
+    # ownership overrides a live migration installs: phase "dual"
+    # (donor + recipient both replicate — the transition ADDS
+    # availability) and phase "moved" (the epoch-stamped ownership
+    # flip).  Backends without resharding support return None/{} and
+    # placement falls back to sorted live membership.
+    def roster(self) -> list[str] | None:
+        return None
+
+    def placement(self) -> tuple[list[str] | None, dict[int, dict]]:
+        """(roster, overlays) read ATOMICALLY — snapshots must never
+        observe a committed roster with pre-commit overlays (or vice
+        versa), or a moved shard transiently routes to its OLD owner.
+        Backends override with one locked read."""
+        return self.roster(), self.overlays()
+
+    def set_roster(self, node_ids: list[str]):
+        raise NotImplementedError
+
+    def placement_epoch(self) -> int:
+        return 0
+
+    def overlays(self) -> dict[int, dict]:
+        return {}
+
+    def set_overlay(self, partition: int, owners: list[str],
+                    phase: str, mut_epoch: int = 0) -> int:
+        raise NotImplementedError
+
+    def clear_overlay(self, partition: int):
+        raise NotImplementedError
+
 
 class InMemDisCo(DisCo):
     """Single-process registry shared by all nodes of an in-process
@@ -101,16 +140,35 @@ class InMemDisCo(DisCo):
         self._shards: dict[tuple[str, str], set[int]] = {}
         self._lock = threading.RLock()
         self.lease_ttl = lease_ttl
+        # placement roster: ordered bucket->node-id list (INSERTION
+        # order, not sorted — jump-hash minimal movement requires a
+        # join to append a NEW bucket, never to reshuffle the mapping
+        # of surviving ones)
+        self._roster: list[str] = []
+        # partition -> {"owners": [...], "phase": "dual"|"moved",
+        #               "epoch": int, "mut_epoch": int}
+        self._overlays: dict[int, dict] = {}
+        self._epoch = 0
 
     # lifecycle --------------------------------------------------------
-    def start(self, node: Node):
+    def start(self, node: Node, member: bool = True):
         with self._lock:
             node.state = NodeState.STARTED
             node.last_heartbeat = time.time()
             self._nodes[node.id] = node
+            if member and node.id not in self._roster:
+                self._roster.append(node.id)
             self._elect()
 
     def close(self, node_id: str):
+        # the ROSTER entry survives a close: while the node is gone
+        # the snapshot filters the unknown id and partitions
+        # transiently remap — exactly what pre-roster sorted-
+        # membership placement did — but a BOUNCE (close + re-open
+        # with the same id) restores the original placement instead
+        # of permanently reordering the roster.  Removal from
+        # placement is the rebalance controller's job (drain commits
+        # a roster without the node; its plans prune ghost entries).
         with self._lock:
             self._nodes.pop(node_id, None)
             self._elect()
@@ -198,3 +256,52 @@ class InMemDisCo(DisCo):
     def add_shards(self, index: str, field: str, shards: set[int]):
         with self._lock:
             self._shards.setdefault((index, field), set()).update(shards)
+
+    # Placement (online resharding) ------------------------------------
+    def roster(self) -> list[str] | None:
+        with self._lock:
+            return list(self._roster)
+
+    def placement(self) -> tuple[list[str] | None, dict[int, dict]]:
+        with self._lock:
+            return (list(self._roster),
+                    {p: dict(ov) for p, ov in self._overlays.items()})
+
+    def set_roster(self, node_ids: list[str]):
+        """Commit a new placement roster — the rebalance epilogue.
+        Clears the overlays atomically with the swap: the controller
+        only commits once every moved partition's overlay owners EQUAL
+        the new roster's jump placement, so routing is identical one
+        instruction before and after (no epoch where a shard routes
+        to zero or two disagreeing owners)."""
+        with self._lock:
+            self._roster = list(node_ids)
+            self._overlays.clear()
+            self._epoch += 1
+
+    def placement_epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def overlays(self) -> dict[int, dict]:
+        with self._lock:
+            return {p: dict(ov) for p, ov in self._overlays.items()}
+
+    def set_overlay(self, partition: int, owners: list[str],
+                    phase: str, mut_epoch: int = 0) -> int:
+        """Install/advance one partition's ownership overlay; the
+        "moved" flip is what the mutation-epoch stamp records.
+        Returns the placement epoch after the write."""
+        with self._lock:
+            self._epoch += 1
+            self._overlays[int(partition)] = {
+                "owners": list(owners), "phase": phase,
+                "epoch": self._epoch, "mut_epoch": int(mut_epoch)}
+            return self._epoch
+
+    def clear_overlay(self, partition: int):
+        """Roll a partition back to roster placement (a migration
+        aborted before its flip)."""
+        with self._lock:
+            if self._overlays.pop(int(partition), None) is not None:
+                self._epoch += 1

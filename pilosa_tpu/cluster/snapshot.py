@@ -2,11 +2,28 @@
 
 Reference: disco/snapshot.go (``ClusterSnapshot``, PartitionToNodes
 :54, ShardToShardPartition :64, ``DefaultPartitionN = 256`` :15) and
-cluster.go:107-230.  Placement is a pure function of (sorted node
-list, partitionN, replicaN): shard → fnv-hash partition → jump-hash
+cluster.go:107-230.  Placement is a pure function of (placement
+roster, partitionN, replicaN): shard → fnv-hash partition → jump-hash
 primary node, replicas on the following nodes in ring order.  The
 executor takes ONE snapshot per query so a concurrent membership
 change can't split a query across two placements.
+
+Online resharding (ISSUE 14) adds two inputs:
+
+- ``roster``: the ORDERED bucket→node-id list placement runs over
+  (disco-owned).  A joining node is live-but-unrostered until its
+  shards migrated; jump-hash minimal movement holds because a join
+  appends a bucket instead of re-sorting the mapping.  Without a
+  roster the snapshot falls back to sorted live membership (the
+  pre-resharding behavior, and the behavior of ad-hoc snapshots
+  built straight from a node list).
+- ``overlays``: per-partition ownership overrides a live migration
+  installs.  Phase ``dual`` APPENDS the recipients to the jump-hash
+  owners — donor stays primary, the recipient is one more replica, so
+  hedged reads treat the mid-transfer shard as replicated on both and
+  writes forward to both (the transition *adds* availability).  Phase
+  ``moved`` is the fence flip: the overlay owners replace the jump
+  owners outright.
 """
 
 from __future__ import annotations
@@ -23,10 +40,22 @@ DEFAULT_PARTITION_N = 256
 
 class ClusterSnapshot:
     def __init__(self, nodes: list[Node], replica_n: int = 1,
-                 partition_n: int = DEFAULT_PARTITION_N):
+                 partition_n: int = DEFAULT_PARTITION_N,
+                 roster: list[str] | None = None,
+                 overlays: dict[int, dict] | None = None):
         self.nodes = sorted(nodes, key=lambda n: n.id)
-        self.replica_n = max(1, min(replica_n, len(self.nodes) or 1))
+        self._by_id = {n.id: n for n in self.nodes}
+        if roster:
+            # roster entries for nodes that vanished from membership
+            # are skipped: placement math must only ever name nodes a
+            # query could actually reach
+            self.order = [self._by_id[i] for i in roster
+                          if i in self._by_id]
+        else:
+            self.order = list(self.nodes)
+        self.replica_n = max(1, min(replica_n, len(self.order) or 1))
         self.partition_n = partition_n
+        self.overlays = overlays or {}
 
     def shard_partition(self, index: str, shard: int) -> int:
         return shard_to_shard_partition(index, shard, self.partition_n)
@@ -34,13 +63,32 @@ class ClusterSnapshot:
     def key_partition(self, index: str, key: str) -> int:
         return key_to_key_partition(index, key, self.partition_n)
 
-    def partition_nodes(self, partition: int) -> list[Node]:
-        """Primary + replicas for a partition (PartitionToNodes)."""
-        if not self.nodes:
+    def _base_nodes(self, partition: int) -> list[Node]:
+        if not self.order:
             return []
-        primary = jump_hash(partition, len(self.nodes))
-        return [self.nodes[(primary + i) % len(self.nodes)]
+        primary = jump_hash(partition, len(self.order))
+        return [self.order[(primary + i) % len(self.order)]
                 for i in range(self.replica_n)]
+
+    def partition_nodes(self, partition: int) -> list[Node]:
+        """Primary + replicas for a partition (PartitionToNodes),
+        overlay-aware: a "moved" partition routes to its overlay
+        owners outright; a "dual" one keeps the jump owners primary
+        and appends the overlay recipients as extra replicas."""
+        ov = self.overlays.get(partition)
+        if ov is not None and ov.get("phase") == "moved":
+            owners = [self._by_id[i] for i in ov.get("owners", ())
+                      if i in self._by_id]
+            if owners:
+                return owners
+            # every overlay owner left membership: fall through to
+            # roster placement rather than returning "nobody"
+        base = self._base_nodes(partition)
+        if ov is not None and ov.get("phase") == "dual":
+            have = {n.id for n in base}
+            base = base + [self._by_id[i] for i in ov.get("owners", ())
+                           if i in self._by_id and i not in have]
+        return base
 
     def shard_nodes(self, index: str, shard: int) -> list[Node]:
         """Nodes owning a shard, primary first (ShardNodes)."""

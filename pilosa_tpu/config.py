@@ -104,6 +104,14 @@ class Config:
     fault_spec: str = ""
     cluster_hedge_ms: float = 0.0
     cluster_deadline_s: float = 0.0
+    # online resharding (cluster/rebalance.py): chase-lag is the
+    # delta-span backlog under which DELTA-CHASE hands off to the
+    # FENCE (smaller = shorter write-blocked window, more chase
+    # rounds); max-rounds bounds chase/copy retry loops;
+    # fence-timeout-s bounds the drain + blocked-writer wait.
+    cluster_rebalance_chase_lag: int = 8
+    cluster_rebalance_max_rounds: int = 12
+    cluster_rebalance_fence_timeout_s: float = 10.0
     # query flight recorder (obs/flight.py): always-on per-query ring
     # of phase-attributed records feeding /debug/queries and
     # /debug/trace.  recorder=false disables record keeping (the
@@ -206,7 +214,13 @@ class Config:
                 ("PILOSA_TPU_CLUSTER_HEDGE_MS",
                  self.cluster_hedge_ms, 0.0),
                 ("PILOSA_TPU_CLUSTER_DEADLINE_S",
-                 self.cluster_deadline_s, 0.0)):
+                 self.cluster_deadline_s, 0.0),
+                ("PILOSA_TPU_REBALANCE_CHASE_LAG",
+                 self.cluster_rebalance_chase_lag, 8),
+                ("PILOSA_TPU_REBALANCE_MAX_ROUNDS",
+                 self.cluster_rebalance_max_rounds, 12),
+                ("PILOSA_TPU_REBALANCE_FENCE_TIMEOUT_S",
+                 self.cluster_rebalance_fence_timeout_s, 10.0)):
             if val != default or env not in os.environ:
                 os.environ[env] = str(val)
 
@@ -328,6 +342,10 @@ _TOML_KEYS = {
     "faults.spec": "fault_spec",
     "cluster.hedge-ms": "cluster_hedge_ms",
     "cluster.deadline-s": "cluster_deadline_s",
+    "cluster.rebalance-chase-lag": "cluster_rebalance_chase_lag",
+    "cluster.rebalance-max-rounds": "cluster_rebalance_max_rounds",
+    "cluster.rebalance-fence-timeout-s":
+        "cluster_rebalance_fence_timeout_s",
     "memory.budget-bytes": "memory_budget_bytes",
     "memory.headroom-frac": "memory_headroom_frac",
     "memory.page-bytes": "memory_page_bytes",

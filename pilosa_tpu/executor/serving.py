@@ -487,6 +487,37 @@ class ResultCache:
                     self._client.release(dropped)
         return evicted
 
+    def sweep_shards(self, index: str, shards: set[int]) -> int:
+        """Online-resharding FENCE/RELEASE sweep: evict exactly the
+        entries of ``index`` whose read set can touch the moved
+        shards — an explicit-shard entry only when its shard subset
+        intersects them, a whole-index entry always (it could have
+        read the moved shard).  Unconditional on snapshot equality:
+        the donor's fragments are about to leave, and a cached result
+        covering the shard would otherwise keep serving answers that
+        miss the recipient's new writes.  Entries over OTHER shards
+        (and other indexes) survive — a rebalance must never flush
+        the whole cache (test-pinned)."""
+        with self._lock:
+            items = list(self._entries.items())
+        evicted = 0
+        for key, ent in items:
+            if key[0] != index:
+                continue
+            if key[2] is not None and not (set(key[2]) & shards):
+                continue
+            dropped = 0
+            with self._lock:
+                cur = self._entries.get(key)
+                if cur is ent:
+                    self._entries.pop(key)
+                    self._bytes -= ent[3]
+                    dropped = ent[3]
+                    evicted += 1
+            if dropped:
+                self._client.release(dropped)
+        return evicted
+
     def clear(self):
         with self._lock:
             total = self._bytes

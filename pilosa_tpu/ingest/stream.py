@@ -398,6 +398,135 @@ class StreamWriter:
             touched_fields.add(m.field)
             if m.n:
                 shard_sets.append(m.cols // idx.width)
+        # online-resharding reroute (ISSUE 14): a fence flipping right
+        # now is waited out; mutations addressing shards that MOVED
+        # forward to the new owner's import surface instead of landing
+        # in the donor's released storage; the remaining local apply
+        # registers in flight so the controller's drain barrier covers
+        # this window (a shard fenced after this point still lands in
+        # the donor's delta log before the final chase ships it)
+        fences = getattr(self.api, "fences", None)
+        fence_done = None
+        if fences is not None:
+            if fences.active():
+                all_shards = ({int(s) for arr in shard_sets
+                               for s in np.unique(arr)}
+                              if shard_sets else set())
+                fences.await_writable(index, all_shards)
+                moved = fences.moved_map(index)
+                if moved:
+                    # n stays as admitted: forwarded mutations landed
+                    # too, just on the new owner
+                    groups, exist_cols = self._reroute_moved(
+                        idx, index, groups, exist_cols, moved)
+            # registration is UNCONDITIONAL on cluster nodes (same
+            # contract as api._fence_import): a window admitted just
+            # before a fence arms must already be visible to the
+            # drain barrier, or its writes land after the final chase
+            tok = fences.enter_write(index, set())
+            fence_done = lambda: fences.exit_write(tok)  # noqa: E731
+        try:
+            self._apply_groups(idx, index, groups, exist_cols,
+                               touched_fields)
+        finally:
+            if fence_done is not None:
+                fence_done()
+        # narrowed result-cache sweep: exactly the (field, shard)
+        # slices this window dirtied (satellite of the PR 3 point-
+        # write narrowing, shared with the API import paths)
+        shards = None
+        if shard_sets:
+            u = np.unique(np.concatenate(shard_sets))
+            shards = ({int(s) for s in u} if u.size <= 256 else None)
+        self.api.sweep_import(index, touched_fields, shards=shards)
+        return n
+
+    def _reroute_moved(self, idx, index: str, groups, exist_cols,
+                       moved: dict):
+        """Split every group's columns on the moved-shard table:
+        moved subsets forward to their new owner over the node data
+        plane (the recipient's import path marks existence and acks
+        durability there), the local remainder applies here.  A
+        forwarding failure poisons the window (typed error to its
+        submitters; the client's retry re-routes against the settled
+        placement) instead of crashing the plane."""
+        from pilosa_tpu.cluster.client import InternalClient
+        client = InternalClient()
+        moved_shards = np.asarray(sorted(moved), dtype=np.int64)
+        kept_groups: list[list[Mutation]] = []
+        try:
+            for group in groups:
+                kept: list[Mutation] = []
+                for m in group:
+                    if not m.n:
+                        kept.append(m)
+                        continue
+                    shard_of = m.cols // idx.width
+                    mask = np.isin(shard_of, moved_shards)
+                    if not mask.any():
+                        kept.append(m)
+                        continue
+                    for s in np.unique(shard_of[mask]):
+                        owner_id, owner_uri = moved[int(s)]
+                        sel = shard_of == s
+                        if m.kind == "values":
+                            client.import_values(
+                                owner_uri, index, m.field,
+                                m.cols[sel],
+                                np.asarray(m.values)[sel].tolist(),
+                                clear=m.clear)
+                        else:
+                            tss = None
+                            if m.timestamps is not None:
+                                tss = [m.timestamps[i] for i in
+                                       np.flatnonzero(sel)]
+                            client.import_bits(
+                                owner_uri, index, m.field,
+                                m.rows[sel], m.cols[sel],
+                                timestamps=tss, clear=m.clear)
+                    keep_mask = ~mask
+                    if keep_mask.any():
+                        m.cols = m.cols[keep_mask]
+                        if m.kind == "values":
+                            m.values = np.asarray(m.values)[keep_mask]
+                        else:
+                            m.rows = m.rows[keep_mask]
+                            if m.timestamps is not None:
+                                m.timestamps = [
+                                    m.timestamps[i] for i in
+                                    np.flatnonzero(keep_mask)]
+                        kept.append(m)
+                if kept:
+                    kept_groups.append(kept)
+            kept_exist: list[np.ndarray] = []
+            for arr in exist_cols:
+                shard_of = arr // idx.width
+                mask = np.isin(shard_of, moved_shards)
+                if mask.any():
+                    for s in np.unique(shard_of[mask]):
+                        owner_id, owner_uri = moved[int(s)]
+                        sel = arr[shard_of == s]
+                        try:
+                            client.import_bits(
+                                owner_uri, index, EXISTENCE_FIELD,
+                                [0] * len(sel), sel)
+                        except Exception:
+                            # a bare existence mark for a shard the
+                            # recipient has not materialized yet: the
+                            # next real write there marks it anyway
+                            pass
+                    if (~mask).any():
+                        kept_exist.append(arr[~mask])
+                else:
+                    kept_exist.append(arr)
+        except ValueError:
+            raise
+        except Exception as e:
+            raise ValueError(f"moved-shard forward failed: {e}") from e
+        return kept_groups, kept_exist
+
+    def _apply_groups(self, idx, index: str, groups, exist_cols,
+                      touched_fields: set) -> None:
         with self.api._import_lock(index):
             for group in groups:
                 f = idx.field(group[0].field)
@@ -427,15 +556,6 @@ class StreamWriter:
             if exist_cols:
                 idx.mark_columns_exist(np.concatenate(exist_cols))
                 touched_fields.add(EXISTENCE_FIELD)
-        # narrowed result-cache sweep: exactly the (field, shard)
-        # slices this window dirtied (satellite of the PR 3 point-
-        # write narrowing, shared with the API import paths)
-        shards = None
-        if shard_sets:
-            u = np.unique(np.concatenate(shard_sets))
-            shards = ({int(s) for s in u} if u.size <= 256 else None)
-        self.api.sweep_import(index, touched_fields, shards=shards)
-        return n
 
     def _poison(self, batch: list[Mutation], e: BaseException):
         """Fail one window's mutations on a data error; the plane

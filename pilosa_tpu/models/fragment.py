@@ -252,6 +252,25 @@ class Fragment:
         return [(r, lo, hi) for (v, r, lo, hi) in entries
                 if v > version]
 
+    def delta_export(self, since: int):
+        """Transfer-unit export for online resharding (DELTA-CHASE):
+        the CURRENT packed words of every row the delta log names
+        above ``since``, or None when the log cannot prove coverage
+        (the caller falls back to a block-checksum diff round).
+        Returns ``(gen, version, span_count, {row: words})`` with
+        ``version`` captured BEFORE the span collection so a write
+        racing the export re-ships next round instead of vanishing.
+        Shipping current contents (not historical patches) makes the
+        replay idempotent and always-forward — exactly the property
+        that lets a crashed chase resume from any round."""
+        gen, version = self.gen, self.version
+        spans = self.deltas_since(int(since))
+        if spans is None:
+            return gen, version, None, None
+        rows = sorted({int(r) for r, _lo, _hi in spans})
+        return gen, version, len(spans), {r: self.row_words(r)
+                                          for r in rows}
+
     def touch(self, row: int, lo: int | None = None,
               hi: int | None = None):
         """Post-mutation invalidation.  ``_row_mut`` invalidates BEFORE

@@ -51,6 +51,19 @@ Fault points wired through the stack (the point name is the contract;
                           admission window (backpressure drills); error
                           rules crash the whole window pre-apply (detail:
                           comma-joined index names)
+``transfer-interrupted``  Rebalance SNAPSHOT-COPY / DELTA-CHASE: the
+                          transfer dies between block/row pushes (detail:
+                          ``index/field/view/shard->recipient``) — proves
+                          a crashed migration resumes or rolls back with
+                          the donor still the one write owner
+``recipient-died``        Rebalance block push: the recipient vanishes
+                          mid-copy (detail: ``uri index/field/...``) —
+                          same rollback contract as transfer-interrupted
+``fence-crash``           RebalanceController: die after the donor fences
+                          (writes blocked) but BEFORE the ownership flip
+                          (detail: ``partition=N``) — rollback must lift
+                          the fences so blocked writers proceed on the
+                          donor, and no epoch has zero or two owners
 ========================  ====================================================
 
 Arming:

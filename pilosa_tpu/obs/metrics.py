@@ -510,6 +510,17 @@ CLUSTER_EVENTS = registry.counter(
     "Cluster failure-plane events "
     "(node_down/node_rejoin/failover/hedge_fired/hedge_won/"
     "load_shed/partial)")
+# -- online resharding (cluster/rebalance.py) --
+REBALANCE_TOTAL = registry.counter(
+    "pilosa_rebalance_total",
+    "Online-rebalance state-machine transitions by phase "
+    "(copy/chase/fence/release/commit) and outcome "
+    "(ok/error/rolled_back)")
+REBALANCE_BYTES = registry.counter(
+    "pilosa_rebalance_bytes_total",
+    "Bytes moved by live shard migration by kind (copied = "
+    "snapshot blocks, delta_replayed = chase rows, released = "
+    "donor fragment bytes freed)")
 HEARTBEAT_AGE = registry.gauge(
     "pilosa_cluster_heartbeat_age_seconds",
     "Seconds since each node's last heartbeat (by node)")

@@ -360,6 +360,41 @@ class Server:
                     req.vars["index"], req.vars["field"],
                     req.vars["view"], int(req.vars["shard"]),
                     int(req.vars["b"]))))
+        # online-resharding transfer surface (ISSUE 14): resumable
+        # block push (SNAPSHOT-COPY), the copy bootstrap state, and
+        # the delta-log chase feed/apply (DELTA-CHASE)
+        r(Route("POST",
+                "/internal/fragment/{index}/{field}/{view}/{shard}"
+                "/block/{b}",
+                lambda req: self.api.fragment_set_block(
+                    req.vars["index"], req.vars["field"],
+                    req.vars["view"], int(req.vars["shard"]),
+                    int(req.vars["b"]), req.json() or {})))
+        r(Route("GET",
+                "/internal/fragment/{index}/{field}/{view}/{shard}"
+                "/state",
+                lambda req: self.api.fragment_state(
+                    req.vars["index"], req.vars["field"],
+                    req.vars["view"], int(req.vars["shard"]))))
+        r(Route("GET",
+                "/internal/fragment/{index}/{field}/{view}/{shard}"
+                "/deltas",
+                lambda req: self.api.fragment_deltas(
+                    req.vars["index"], req.vars["field"],
+                    req.vars["view"], int(req.vars["shard"]),
+                    int(req.query.get("since", ["0"])[0]))))
+        r(Route("POST",
+                "/internal/fragment/{index}/{field}/{view}/{shard}"
+                "/rows",
+                lambda req: self.api.fragment_set_rows(
+                    req.vars["index"], req.vars["field"],
+                    req.vars["view"], int(req.vars["shard"]),
+                    req.json() or {})))
+        r(Route("POST",
+                "/internal/translate/{index}/field/{field}/restore",
+                lambda req: self.api.field_translate_restore(
+                    req.vars["index"], req.vars["field"],
+                    req.json() or {})))
         r(Route("GET", "/internal/backup/manifest",
                 lambda req: self.api.backup_manifest()))
         r(Route("GET", "/internal/backup/file", self._get_backup_file))
@@ -550,6 +585,18 @@ class Server:
                             # when (one heartbeat), per RFC 9110 §10.2.3
                             req.extra_headers = {
                                 "Retry-After": str(max(1, round(ra)))}
+                        # typed redirect/annotation surfaces
+                        # (ShardMovedError's X-Pilosa-New-Owner +
+                        # moved_shards body fields): the error type
+                        # itself says what to attach
+                        hdrs = getattr(e, "extra_headers", None)
+                        if hdrs:
+                            req.extra_headers.update(hdrs)
+                        extra = getattr(e, "error_fields", None)
+                        if extra:
+                            return status, {"error": str(e),
+                                            "type": type(e).__name__,
+                                            **extra}
                         if status >= 500:
                             # 5xx pass-throughs (a peer's RemoteError
                             # 500, a shed) must not go dark in

@@ -220,6 +220,20 @@ class IndexStorage:
                 if tx.has_bitmap(name):
                     tx.delete_bitmap(name)
 
+    def drop_shard(self, shard: int) -> None:
+        """Delete ONE shard's persisted file + WAL (online-resharding
+        RELEASE: the donor no longer owns the shard, so keeping the
+        file would resurrect a stale copy on restart).  A later write
+        to the shard simply recreates a fresh file."""
+        with self._lock:
+            d = self._dbs.pop(shard, None)
+        if d is not None:
+            d.close()
+        p = self._shard_path(shard)
+        for f in (p, p + ".wal"):
+            if os.path.exists(f):
+                os.remove(f)
+
     # -- lifecycle -------------------------------------------------------
 
     def checkpoint_all(self) -> None:

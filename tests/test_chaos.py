@@ -176,7 +176,7 @@ def test_client_retries_refused_connect_even_for_writes():
             calls.append(path)
             if len(calls) == 1:
                 raise ConnectionRefusedError(111, "refused")
-            return 200, b'{"imported": 3}'
+            return 200, b'{"imported": 3}', {}
 
     c = C(retries=2, backoff_s=0.001)
     assert c.import_bits("x:1", "i", "f", [1], [2]) == 3  # POST, retried
