@@ -33,6 +33,7 @@ from bench.common import (
     probe_backend,
 )
 from bench.headline import groupby_fused_ab, loop_calibrate, run_queries
+from bench.incidents import incident_smoke
 from bench.kernelsmoke import kernel_smoke
 from bench.memory import memory_pressure_gauntlet, memory_smoke
 from bench.ragged import build_events_index, ragged_gauntlet, ragged_smoke
@@ -308,6 +309,8 @@ def dispatch(argv) -> int:
         return sql_smoke()
     if "--rebalance-smoke" in argv:
         return rebalance_smoke()
+    if "--incident-smoke" in argv:
+        return incident_smoke()
     try:
         main()
     except Exception as e:  # clear failure JSON — never a bare crash

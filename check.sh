@@ -99,6 +99,22 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 
+echo "== incident smoke =="
+# incident-forensics gate (bench.py --incident-smoke,
+# bench/incidents.py): an injected serving-dispatch stall under a
+# client storm -> exactly one deduped watchdog-stall bundle persisted
+# with thread stacks + flight records, ZERO failed queries while
+# capture runs (capture is off the hot path by construction); the
+# fixed-cost probes gate the per-stamp watchdog cycle
+# (PILOSA_TPU_WATCHDOG_STAMP_MAX_US, <=8us — same budget class as
+# the tracing probes) and the rate-limited report() cycle
+# (PILOSA_TPU_INCIDENT_REPORT_MAX_US).
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python bench.py --incident-smoke; then
+    echo "check.sh: incident smoke failed" >&2
+    exit 1
+fi
+
 echo "== stats smoke =="
 # statistics-catalog gate (bench.py --stats-smoke): fixed-cost probe
 # for the per-dispatch stats note (<=8us disabled / <=60us enabled,

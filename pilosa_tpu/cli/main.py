@@ -62,6 +62,7 @@ def cmd_server(args) -> int:
     cfg.apply_fault_settings()
     cfg.apply_roofline_settings()
     cfg.apply_slo_settings()
+    cfg.apply_watchdog_settings()
     holder = Holder(path=cfg.data_dir) if cfg.data_dir else Holder()
     holder.load_schema()
     auth = None
@@ -266,6 +267,33 @@ prefetch = true
 prefetch-interval-s = 0.5
 oom-retry = true
 host-fallback = true
+
+[incidents]
+# incident forensics: anomaly-triggered black-box bundles (SLO burn,
+# perf regression, watchdog stall, OOM trip, batch-leader exception,
+# ingest crash) persisted under dir (default <data-dir>/incidents),
+# rate-limited per trigger and size-bounded per bundle; profile*
+# drive the always-on continuous profiler attached to every bundle
+enabled = true
+dir = ""
+min-interval-s = 60.0
+max-bundles = 32
+max-bundle-bytes = 1048576
+slo-burn-threshold = 8.0
+profile = true
+profile-hz = 7.0
+profile-window-s = 10.0
+profile-windows = 6
+log-ring = 512
+
+[watchdog]
+# stall watchdogs: progress-stamped deadlines on the long-running
+# loops (serving batcher, ingest window, rebalance controller,
+# maintenance ticker, heartbeats); a loop wedged past deadline-s
+# fires pilosa_watchdog_stalls_total{loop} + an incident bundle
+enabled = true
+interval-s = 1.0
+deadline-s = 10.0
 """
 
 

@@ -189,6 +189,11 @@ class ClusterNode:
                               self._debug_cluster_metrics)
         self.server.add_route("GET", "/debug/cluster/stats",
                               self._debug_cluster_stats)
+        # incident forensics federation (ISSUE 15): cluster-wide
+        # bundle listing with node attribution — a coordinator-side
+        # operator finds every node's black boxes from one curl
+        self.server.add_route("GET", "/debug/cluster/incidents",
+                              self._debug_cluster_incidents)
         # online resharding (ISSUE 14): the donor-side write fence
         # plus the control RPCs the RebalanceController drives over
         # the node-to-node data plane, and the per-shard transfer
@@ -250,19 +255,31 @@ class ClusterNode:
         return self
 
     def _hb_loop(self):
+        # stall watchdog (obs/watchdog.py): armed through each beat
+        # body (a beat wedged inside sync_from_peers is a stall with
+        # that phase named), idle across the inter-beat wait
+        from pilosa_tpu.obs import watchdog
+        watch = watchdog.register(f"heartbeat:{self.node_id}")
         while not self._hb_stop.wait(self._hb_interval):
+            watch.stamp("beat")
             # age out MOVED fences once no stale pre-flip snapshot
             # can still route here — keeping them forever would pin
             # the armed-fence slow path onto every write
             self.api.fences.sweep_moved()
             if faults.take("node-crash", self.node_id):
                 # chaos: die mid-traffic — stop serving AND beating;
-                # peers mark us DOWN and fail queries over
+                # peers mark us DOWN and fail queries over (the dead
+                # node's watch deregisters — a corpse is not a stall)
+                watchdog.deregister(watch.name)
                 self.pause()
                 return
             if faults.take("heartbeat-stall", self.node_id):
                 # chaos: the asymmetric failure — still serving, but
-                # the lease ages out and peers route around us
+                # the lease ages out and peers route around us.
+                # idle() — the skipped beat is an injected LEASE
+                # fault, not a wedged loop; the watchdog covers the
+                # loop body, peers' heartbeat-age gauge covers this
+                watch.idle()
                 continue
             was_down = any(
                 nd.id == self.node_id and nd.state == NodeState.DOWN
@@ -272,6 +289,7 @@ class ClusterNode:
                 # lease, transient refusal): replicated writes were
                 # skipped past us meanwhile, so resync from live peers
                 # BEFORE the beat revives us as a read owner
+                watch.stamp("resync")
                 try:
                     self.sync_from_peers()
                     metrics.CLUSTER_EVENTS.inc(event="resync")
@@ -290,11 +308,13 @@ class ClusterNode:
                 # between the was_down check and the beat: the beat
                 # revived us as a read owner with NO resync yet, so
                 # this one repairs whatever the skip window missed
+                watch.stamp("resync")
                 try:
                     self.sync_from_peers()
                 except Exception as e:
                     self.server.logger.warn(
                         "revival resync failed: %s", e)
+            watch.idle()  # inter-beat wait is parked, not stalled
 
     def _prefill_from_flight(self, max_queries: int = 8) -> int:
         """Warm-start cache prefill: replay the hottest recent READ
@@ -341,6 +361,8 @@ class ClusterNode:
         self._hb_stop.set()
         if self._hb_thread:
             self._hb_thread.join(timeout=2)
+        from pilosa_tpu.obs import watchdog
+        watchdog.deregister(f"heartbeat:{self.node_id}")
         self.disco.close(self.node_id)
         self.server.close()
 
@@ -811,6 +833,52 @@ class ClusterNode:
                               "regressions": regressions},
                 "nodes": sorted(per_node),
                 "per_node": per_node,
+                "unreachable": unreachable,
+                "partial": bool(unreachable)}
+
+    def _debug_cluster_incidents(self, req):
+        """Cluster-wide incident listing: fan out /debug/incidents to
+        live nodes (``limit`` passes through and applies to the local
+        manager identically), merge bundle metadata with node
+        attribution, newest first.  An in-process test cluster shares
+        ONE process-global manager, so every node reports the same
+        bundles — merge by bundle id, first sighting wins (same shape
+        as the cluster-stats dedup).  ``timeout_ms`` bounds each
+        node's fetch; full bundles stay a per-node fetch
+        (``/debug/incidents?id=`` on the reporting node — the
+        listing carries which node to ask)."""
+        from pilosa_tpu.obs import incidents
+        q = req.query
+        limit = int(q.get("limit", ["50"])[0])
+        timeout_s = float(q.get("timeout_ms", ["1000"])[0]) / 1e3
+        per_node = {self.node_id: incidents.get().payload(limit)}
+        got, unreachable = self._federate(
+            f"/debug/incidents?limit={limit}", timeout_s)
+        per_node.update(got)
+        merged: dict[str, dict] = {}
+        stalls: list[dict] = []
+        seen_watch: set = set()
+        for nid in sorted(per_node):
+            doc = per_node[nid] or {}
+            for m in doc.get("incidents") or ():
+                iid = m.get("id")
+                if iid and iid not in merged:
+                    merged[iid] = {**m, "node": nid}
+            for w in doc.get("watchdog") or ():
+                # dedupe IDENTICAL rows only — an in-process test
+                # cluster shares one registry so every node reports
+                # byte-equal entries; distinct per-node state in a
+                # real multi-process cluster (different age/armed/
+                # stalls for the same loop name) must all survive
+                key = json.dumps(w, sort_keys=True, default=str)
+                if key not in seen_watch:
+                    seen_watch.add(key)
+                    stalls.append({**w, "node": nid})
+        entries = sorted(merged.values(),
+                         key=lambda m: -m.get("time", 0))[:limit]
+        return {"incidents": entries,
+                "watchdog": stalls,
+                "nodes": sorted(per_node),
                 "unreachable": unreachable,
                 "partial": bool(unreachable)}
 

@@ -464,6 +464,11 @@ class RebalanceController:
         # chase round while a storm is also snapshotting per query
         self._nodes_view: dict[str, tuple[str, str]] = {}
         self.partition_n = node.snapshot().partition_n
+        # stall watchdog (obs/watchdog.py): a migration wedged on a
+        # dead recipient or a drain that never converges is a named
+        # stall with the stuck phase, not a silently hung rebalance
+        from pilosa_tpu.obs import watchdog
+        self.watch = watchdog.register("rebalance-controller")
 
     # -- planning ------------------------------------------------------
 
@@ -589,6 +594,7 @@ class RebalanceController:
         diverged = sorted(b for b in set(theirs) | set(mine)
                           if theirs.get(b) != mine.get(b))
         for b in diverged:
+            self.watch.stamp("copy")
             # chaos seams: the transfer dies mid-copy (controller or
             # network), or the recipient dies under the push — the
             # gauntlet proves either resumes or rolls back with the
@@ -611,6 +617,7 @@ class RebalanceController:
         new_since, remaining_count); a gen flip or log overflow falls
         back to a fresh checksum-diff copy round."""
         base = self._frag_path(index, field, view, shard)
+        self.watch.stamp("chase")
         d = self._get(src_uri, base + "/deltas?since=" + str(since))
         if d.get("absent"):
             return gen, since, 0
@@ -710,6 +717,7 @@ class RebalanceController:
             # old owner (replicas included), so no old replica can
             # solely ack a racing write the chase will never see
             plan.phases[p] = "fence"
+            self.watch.stamp("fence")
             donor_uris = [self._uri(d) for d in donors]
             for d_uri in donor_uris:
                 for (index, shard) in pairs:
@@ -865,6 +873,7 @@ class RebalanceController:
     def _finalize_partition(self, plan: RebalancePlan, p: int) -> None:
         """dual -> moved: recipient-only routing, donor fences answer
         410, donor drains and RELEASES the shard's pages."""
+        self.watch.stamp("release")
         self._refresh_nodes()
         replica_n = self.node.replica_n
         old = self._owners(plan.roster_old, p, replica_n)
@@ -954,6 +963,7 @@ class RebalanceController:
         self.plan = plan
         plan.state = "running"
         t0 = time.perf_counter()
+        self.watch.stamp("plan")
         try:
             if plan.op == "join":
                 self._push_schema(plan.node_id)
@@ -980,6 +990,7 @@ class RebalanceController:
                                         outcome="error")
             raise
         finally:
+            self.watch.idle()
             plan.duration_s = round(time.perf_counter() - t0, 3)
         return plan
 

@@ -153,6 +153,38 @@ class Config:
     # PILOSA_TPU_SQL_PUSHDOWN=0 env kill-switch, the bench A/B
     # lever — reverts SQL to the solo host path, bit-exact.
     sql_pushdown: bool = True
+    # incident forensics plane (obs/incidents.py + obs/watchdog.py +
+    # obs/profiler.py continuous ring + obs/logger.py log ring):
+    # anomaly triggers (SLO burn over slo-burn-threshold, the perf
+    # sentinel, watchdog stalls, OOM-ladder trips, batch-leader
+    # exceptions, ingest crashes) each capture ONE rate-limited
+    # (min-interval-s), size-bounded (max-bundle-bytes) black-box
+    # bundle persisted tmp+fsync+rename under dir (default
+    # <data-dir>/incidents; empty + no data dir = memory-only ring).
+    # enabled=false — or PILOSA_TPU_INCIDENTS=0 — kills the plane.
+    # profile* drive the always-on continuous profiler whose window
+    # ring rides in every bundle; log-ring sizes the log tail.
+    incidents_enabled: bool = True
+    incidents_dir: str = ""
+    incidents_min_interval_s: float = 60.0
+    incidents_max_bundles: int = 32
+    incidents_max_bundle_bytes: int = 1 << 20
+    incidents_slo_burn_threshold: float = 8.0
+    incidents_profile: bool = True
+    incidents_profile_hz: float = 7.0
+    incidents_profile_window_s: float = 10.0
+    incidents_profile_windows: int = 6
+    incidents_log_ring: int = 512
+    # stall watchdogs (obs/watchdog.py): progress-stamped deadlines
+    # on the serving batch leader, ingest window drain, rebalance
+    # controller, maintenance ticker, and heartbeat loops.  A loop
+    # armed past deadline-s fires pilosa_watchdog_stalls_total{loop}
+    # + a watchdog-stall incident naming the stuck phase; interval-s
+    # paces the monitor.  enabled=false (or PILOSA_TPU_WATCHDOG=0)
+    # disarms detection; the stamps themselves stay (~sub-us).
+    watchdog_enabled: bool = True
+    watchdog_interval_s: float = 1.0
+    watchdog_deadline_s: float = 10.0
     # SLO burn-rate plane (obs/slo.py): latency-ms + latency-objective
     # define the latency SLO ("latency-objective of queries answer
     # under latency-ms"); availability-objective bounds the typed-
@@ -271,6 +303,53 @@ class Config:
         costplan.configure(
             enabled=None if self.sql_pushdown else False)
 
+    def apply_watchdog_settings(self):
+        """Configure the stall-watchdog monitor ([watchdog]).  The
+        PILOSA_TPU_WATCHDOG env kill-switch outranks a default-True
+        config (same contract as apply_roofline_settings)."""
+        from pilosa_tpu.obs import watchdog
+        enabled = self.watchdog_enabled
+        if enabled and "PILOSA_TPU_WATCHDOG" in os.environ:
+            enabled = None  # env kill-switch stays in charge
+        watchdog.configure(enabled=enabled,
+                           interval_s=self.watchdog_interval_s,
+                           deadline_s=self.watchdog_deadline_s)
+
+    def apply_incident_settings(self, data_dir: str | None = None):
+        """Configure the incident forensics plane ([incidents]):
+        bundle manager (persistence under ``data_dir``/incidents when
+        one exists — memory-only otherwise), the continuous profiler,
+        and the log-ring size.  The PILOSA_TPU_INCIDENTS env
+        kill-switch outranks a default-True config."""
+        from pilosa_tpu.obs import incidents, logger, profiler
+        enabled = self.incidents_enabled
+        if enabled and "PILOSA_TPU_INCIDENTS" in os.environ:
+            enabled = None  # env kill-switch stays in charge
+        base = data_dir if data_dir is not None else (self.data_dir
+                                                     or None)
+        dir = self.incidents_dir or (
+            os.path.join(base, "incidents") if base else None)
+        snap = {f.name: getattr(self, f.name)
+                for f in fields(Config)
+                if "secret" not in f.name}  # bundles must not leak auth
+        # dir=None leaves the manager's current dir alone (a
+        # path-less embedded server must not detach a data-dir'd
+        # sibling's persistence — same contract as stats paths)
+        incidents.configure(
+            enabled=enabled, dir=dir,
+            min_interval_s=self.incidents_min_interval_s,
+            max_bundles=self.incidents_max_bundles,
+            max_bundle_bytes=self.incidents_max_bundle_bytes,
+            slo_burn_threshold=self.incidents_slo_burn_threshold,
+            config_snapshot=snap)
+        on = (incidents.enabled() if enabled is None
+              else bool(enabled)) and self.incidents_profile
+        profiler.configure_continuous(
+            enabled=on, hz=self.incidents_profile_hz,
+            window_s=self.incidents_profile_window_s,
+            keep=self.incidents_profile_windows)
+        logger.ring.configure(int(self.incidents_log_ring))
+
     def apply_slo_settings(self):
         """Build the process SLO tracker from the [slo] knobs."""
         from pilosa_tpu.obs import slo
@@ -329,6 +408,20 @@ _TOML_KEYS = {
     "stats.regression-ratio": "stats_regression_ratio",
     "stats.regression-min-samples": "stats_regression_min_samples",
     "sql.pushdown": "sql_pushdown",
+    "incidents.enabled": "incidents_enabled",
+    "incidents.dir": "incidents_dir",
+    "incidents.min-interval-s": "incidents_min_interval_s",
+    "incidents.max-bundles": "incidents_max_bundles",
+    "incidents.max-bundle-bytes": "incidents_max_bundle_bytes",
+    "incidents.slo-burn-threshold": "incidents_slo_burn_threshold",
+    "incidents.profile": "incidents_profile",
+    "incidents.profile-hz": "incidents_profile_hz",
+    "incidents.profile-window-s": "incidents_profile_window_s",
+    "incidents.profile-windows": "incidents_profile_windows",
+    "incidents.log-ring": "incidents_log_ring",
+    "watchdog.enabled": "watchdog_enabled",
+    "watchdog.interval-s": "watchdog_interval_s",
+    "watchdog.deadline-s": "watchdog_deadline_s",
     "slo.latency-ms": "slo_latency_ms",
     "slo.latency-objective": "slo_latency_objective",
     "slo.availability-objective": "slo_availability_objective",

@@ -647,6 +647,16 @@ class QueryBatcher:
             metrics.SERVING_QUEUE_DEPTH.set(0)
         metrics.SERVING_BATCH_WAIT.observe(time.perf_counter() - t_lead)
         metrics.SERVING_BATCH_SIZE.observe(len(batch))
+        # watchdog arming (obs/watchdog.py): the leader is entering
+        # the fused dispatch — a dispatch wedged past the deadline is
+        # a named stall ("serving-batcher"/"dispatch"), not a silent
+        # latency cliff.  begin/end TOKENS, not stamp/idle: under
+        # load a full batch dispatches while another is still in
+        # flight (the wait loop exits at max_batch even with
+        # inflight > 0), and a healthy leader finishing must not
+        # disarm or re-stamp away a wedged sibling — staleness is
+        # judged against the OLDEST in-flight dispatch.
+        wd_tok = self.serving.watch.begin("dispatch")
         try:
             self.serving._run_batch(batch)
         except Exception as e:  # belt-and-braces: never strand a waiter
@@ -656,10 +666,20 @@ class QueryBatcher:
             capture_exception(
                 e, where="serving.batch", batch=len(batch),
                 trace_ids=[r.trace_id for r in batch if r.trace_id])
+            # incident trigger (obs/incidents.py): an unhandled batch-
+            # leader exception strands no waiter (the loop below fails
+            # them typed) but is a serving-plane fault worth a bundle
+            from pilosa_tpu.obs import incidents
+            incidents.report(
+                "batch-leader-exception", detail=type(e).__name__,
+                context={"message": str(e)[:300], "batch": len(batch),
+                         "trace_ids": [r.trace_id for r in batch
+                                       if r.trace_id][:16]})
             for r in batch:
                 if r.result is None and r.error is None:
                     r.error = e
         finally:
+            self.serving.watch.end(wd_tok)
             with self._cond:
                 self._inflight -= 1
                 self._cond.notify_all()  # wake a window-waiting leader
@@ -716,6 +736,11 @@ class ServingLayer:
         self.default_deadline_ms = float(default_deadline_ms or 0.0)
         # one canonical dispatch at a time (see QueryBatcher)
         self.batcher.serialize = self.ragged
+        # stall watchdog on the batch-leader dispatch (obs/watchdog.py;
+        # registration is idempotent by name — serving layers are
+        # rebuilt freely in-process and the loop identity is the name)
+        from pilosa_tpu.obs import watchdog
+        self.watch = watchdog.register("serving-batcher")
 
     def start_prefetcher(self, interval_s: float = 0.5):
         """Warm predicted stack pages off the serving hot path

@@ -148,6 +148,11 @@ class StreamWriter:
         self.windows_failed = 0
         self.mutations_landed = 0
         self.sheds = 0
+        # stall watchdog on the window drain (obs/watchdog.py) —
+        # idle while parked on the condition, armed through a land;
+        # in-process multi-plane tests share the name (loop identity)
+        from pilosa_tpu.obs import watchdog
+        self.watch = watchdog.register("ingest-window")
 
     # -- lifecycle -----------------------------------------------------
 
@@ -257,6 +262,7 @@ class StreamWriter:
 
     def _loop(self):
         while True:
+            self.watch.idle()  # parked waiting for work ≠ stalled
             with self._cond:
                 while self._pending == 0 and not self._closed:
                     self._cond.wait()
@@ -267,11 +273,13 @@ class StreamWriter:
             # commit); a lone submit pays at most window_s extra
             if self.window_s > 0:
                 time.sleep(self.window_s)
+            self.watch.stamp("drain")
             batch = self._drain()
             if batch:
                 try:
                     self._land(batch)
                 except BaseException as e:
+                    self.watch.idle()
                     self._crash(e, batch)
                     return  # the plane is dead; restart + replay
 
@@ -310,6 +318,7 @@ class StreamWriter:
         phases: dict[str, float] = {}
         total_n = 0
         ta = time.perf_counter()
+        self.watch.stamp("apply")
         try:
             for index, muts in by_index.items():
                 total_n += self._apply_index(index, muts)
@@ -324,6 +333,7 @@ class StreamWriter:
         phases["apply"] = time.perf_counter() - ta
         if self.sync:
             ts = time.perf_counter()
+            self.watch.stamp("sync")
             for index in by_index:
                 idx = self.api.holder.index(index)
                 if idx is not None:
@@ -588,6 +598,14 @@ class StreamWriter:
                 m.event.set()
         from pilosa_tpu.obs.monitor import capture_exception
         capture_exception(e, where="ingest.window")
+        # incident trigger (obs/incidents.py): the write plane dying
+        # is the canonical restart-and-replay event — bundle the
+        # stacks/flight/metrics state the post-mortem needs
+        from pilosa_tpu.obs import incidents
+        incidents.report("ingest-crash", detail=type(e).__name__,
+                         context={"message": str(e)[:300],
+                                  "batch": len(batch),
+                                  "queued": len(queued)})
 
 
 class StreamImporter(Importer):

@@ -98,6 +98,13 @@ def guarded(run, host_fallback=None):
         if not is_oom(e):
             raise
         metrics.OOM_TOTAL.inc(outcome="caught")
+        # incident trigger (obs/incidents.py): an OOM-ladder trip is
+        # exactly the moment whose residency/flight state an operator
+        # needs later — capture one rate-limited bundle off this
+        # thread before the relief sweep mutates the evidence
+        from pilosa_tpu.obs import incidents
+        incidents.report("device-oom", detail=type(e).__name__,
+                         context={"message": str(e)[:300]})
         relieve()
         if OOM_RETRY:
             try:

@@ -130,3 +130,28 @@ class PerformanceCounters:
 
 
 performance_counters = PerformanceCounters()
+
+# process-global collector: the server maintenance ticker refreshes
+# it (server/http.py) so incident bundles carry a host snapshot that
+# predates the anomaly — phone-home stays OFF (send=None means no
+# reporting thread and no egress; only the in-process payload is kept)
+collector = Diagnostics()
+
+
+def collect() -> dict:
+    """One collection pass (ticker hook): refresh and return the
+    host/runtime payload.  Never raises — a broken /proc read must
+    not take the ticker down."""
+    try:
+        collector.flush()
+        return collector.last_payload or {}
+    except Exception:
+        return {}
+
+
+def host_snapshot() -> dict:
+    """The newest collected host payload (incident bundles attach
+    this); collects on demand when the ticker has not run yet."""
+    if collector.last_payload is not None:
+        return collector.last_payload
+    return collect()

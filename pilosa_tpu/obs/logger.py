@@ -1,16 +1,59 @@
 """Leveled logger with a nop default (reference: logger/logger.go —
 ``Logger`` interface with Printf-style Debugf/Infof/Warnf/Errorf and a
-``NopLogger``; we keep the same four levels and the nop)."""
+``NopLogger``; we keep the same four levels and the nop), plus a
+bounded in-memory ring of recent emitted lines — the black-box log
+tail incident bundles attach (obs/incidents.py) and
+``/debug/logs?limit=`` serves.  Lines keep their ``trace=`` stamps,
+so a bundle's tail greps straight to its flight records."""
 
 from __future__ import annotations
 
 import sys
 import threading
 import time
+from collections import deque
 from typing import IO
 
 DEBUG, INFO, WARN, ERROR = 10, 20, 30, 40
 _LEVEL_NAMES = {DEBUG: "DEBUG", INFO: "INFO", WARN: "WARN", ERROR: "ERROR"}
+
+
+class LogRing:
+    """Bounded ring of recently emitted log lines.  The append is
+    lock-free (deque with maxlen is GIL-atomic) — same budget class
+    as the flight recorder's record ring."""
+
+    def __init__(self, keep: int = 512):
+        self._ring: deque[str] = deque(maxlen=keep)
+
+    def record(self, line: str) -> None:
+        self._ring.append(line)
+
+    def recent(self, limit: int = 200) -> list[str]:
+        """Newest-last lines (reads retry across a concurrent
+        append, like flight.FlightRecorder.recent)."""
+        while True:
+            try:
+                items = list(self._ring)
+                break
+            except RuntimeError:
+                continue
+        return items[-max(0, int(limit)):]
+
+    def configure(self, keep: int) -> None:
+        if keep != self._ring.maxlen:
+            self._ring = deque(self._ring, maxlen=int(keep))
+
+    def clear(self) -> None:
+        self._ring.clear()
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+
+# process-global ring: every Logger instance feeds it (the nop logger
+# emits nothing, so it records nothing); [incidents] log-ring sizes it
+ring = LogRing()
 
 
 def _active_trace_id() -> str | None:
@@ -48,8 +91,10 @@ class Logger:
         trace = _active_trace_id()
         if trace:
             prefix += f" trace={trace}"
+        line = f"{prefix} {msg}"
+        ring.record(line)
         with self._lock:
-            self.stream.write(f"{prefix} {msg}\n")
+            self.stream.write(line + "\n")
 
     def debug(self, fmt: str, *args):
         self._log(DEBUG, fmt, *args)

@@ -581,6 +581,18 @@ PERF_REGRESSION = registry.gauge(
     "Per-fingerprint perf-regression sentinel: current-window / "
     "baseline ratio while firing, 0 after recovery")
 
+# -- incident forensics plane (obs/incidents.py + obs/watchdog.py) --
+INCIDENTS_TOTAL = registry.counter(
+    "pilosa_incidents_total",
+    "Incident-bundle events by trigger (slo-burn/perf-regression/"
+    "watchdog-stall/device-oom/batch-leader-exception/ingest-crash) "
+    "and outcome (captured/suppressed/error)")
+WATCHDOG_STALLS = registry.counter(
+    "pilosa_watchdog_stalls_total",
+    "Stall-watchdog detections by loop (serving-batcher/"
+    "ingest-window/rebalance-controller/maintenance-ticker/"
+    "heartbeat:*)")
+
 # -- SLO burn-rate plane (obs/slo.py) --
 SLO_BURN_RATE = registry.gauge(
     "pilosa_slo_burn_rate",

@@ -184,10 +184,16 @@ class SloTracker:
                 remaining = max(0.0, 1.0 - w["burn_rate"])
                 slos[name]["budget_remaining"] = round(remaining, 4)
                 metrics.SLO_BUDGET_REMAINING.set(remaining, slo=name)
-        return {"slos": slos,
-                "windows": [label for label, _ in self.windows],
-                "samples": len(self._samples),
-                "uptime_s": round(now - self._t0, 1)}
+        payload = {"slos": slos,
+                   "windows": [label for label, _ in self.windows],
+                   "samples": len(self._samples),
+                   "uptime_s": round(now - self._t0, 1)}
+        # incident trigger (obs/incidents.py): a burn rate at/over
+        # the configured threshold on a COVERED window captures one
+        # rate-limited black-box bundle — detection becomes evidence
+        from pilosa_tpu.obs import incidents
+        incidents.note_slo(payload)
+        return payload
 
 
 # process-global tracker; config.apply_slo_settings() rebuilds it

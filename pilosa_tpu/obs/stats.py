@@ -580,6 +580,17 @@ class StatsCatalog:
             metrics.PERF_REGRESSION.set(round(fast / base, 3),
                                         fingerprint=fp,
                                         metric="duration_ms")
+            # incident trigger (obs/incidents.py): the sentinel firing
+            # captures one rate-limited bundle carrying the flight
+            # records / stacks / profile of the regressing window —
+            # repeated exports dedupe inside the rate-limit window
+            from pilosa_tpu.obs import incidents
+            incidents.report(
+                "perf-regression", detail=fp,
+                context={"fingerprint": fp,
+                         "baseline_ms": round(base, 4),
+                         "window_ms": round(fast or 0.0, 4),
+                         "ratio": round((fast or 0.0) / base, 3)})
         elif metrics.PERF_REGRESSION.value(fingerprint=fp,
                                            metric="duration_ms"):
             metrics.PERF_REGRESSION.set(0.0, fingerprint=fp,

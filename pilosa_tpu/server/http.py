@@ -111,6 +111,13 @@ class Server:
         # eviction, and hedge derivation; persisted under the
         # holder's data dir so a restarted node plans warm
         config.apply_stats_settings(data_dir=self.holder.path)
+        # incident forensics plane ([incidents] + [watchdog]):
+        # anomaly-triggered black-box bundles persisted under the
+        # data dir, stall watchdogs on every long-running loop, and
+        # the always-on continuous profiler whose ring rides along
+        # in every bundle
+        config.apply_watchdog_settings()
+        config.apply_incident_settings(data_dir=self.holder.path)
         if (self.api.executor.serving is not None
                 and config.memory_prefetch):
             self.api.executor.serving.start_prefetcher(
@@ -172,7 +179,13 @@ class Server:
         self._ticker_thread.start()
 
     def _tick_loop(self):
+        # stall watchdog: the ticker drives TTL sweeps, flushes, SLO
+        # sampling, and stats persistence — a tick wedged on a dead
+        # disk must be a named stall, not silently stale telemetry
+        from pilosa_tpu.obs import watchdog
+        watch = watchdog.register("maintenance-ticker")
         while not self._ticker_stop.wait(self.maintenance_interval):
+            watch.stamp("tick")
             try:
                 removed = self.holder.remove_expired_views()
                 if removed:
@@ -195,8 +208,16 @@ class Server:
                 # refresh the regression sentinel, snapshot on cadence
                 from pilosa_tpu.obs import stats
                 stats.tick()
+                # host/runtime stats (obs/diagnostics.py): refresh the
+                # dormant collector so every incident bundle carries a
+                # host snapshot that PREDATES its anomaly (phone-home
+                # stays off — collection is in-process only)
+                from pilosa_tpu.obs import diagnostics
+                diagnostics.collect()
             except Exception as e:
                 self.logger.error("maintenance tick failed: %s", e)
+            finally:
+                watch.idle()
 
     def close(self):
         from pilosa_tpu.obs import testhook
@@ -306,6 +327,12 @@ class Server:
         # fault-injection registry (obs/faults.py): armed rules with
         # fire counts — the chaos-operator's view of what is live
         r(Route("GET", "/debug/faults", self._get_debug_faults))
+        # incident forensics plane (obs/incidents.py): black-box
+        # bundle listing + fetch, the watchdog registry riding along
+        r(Route("GET", "/debug/incidents", self._get_debug_incidents))
+        # recent log-line ring (obs/logger.py) — the tail every
+        # incident bundle attaches, served live for correlation
+        r(Route("GET", "/debug/logs", self._get_debug_logs))
         r(Route("GET", "/internal/diagnostics", self._get_diagnostics))
         r(Route("GET", "/internal/perf-counters",
                 self._get_perf_counters))
@@ -413,12 +440,57 @@ class Server:
 
     def _get_debug_profile(self, req):
         """fgprof-style wall-clock stack sample; ?seconds=&hz= bound
-        the collection (defaults 2s @ 100Hz, capped at 30s)."""
+        the collection (defaults 2s @ 100Hz, capped at 30s).
+        ?format=collapsed drops the header comment and attaches the
+        body as a download — pure folded-stack lines for flamegraph
+        tooling (flamegraph.pl / speedscope / inferno).  ?ring=1
+        serves the CONTINUOUS profiler's merged ring instead of
+        sampling live — the profile that was already running when
+        something went wrong."""
         from pilosa_tpu.obs import profiler
-        seconds = min(30.0, float(req.query.get("seconds", ["2"])[0]))
-        hz = min(1000, int(req.query.get("hz", ["100"])[0]))
-        return RawResponse(profiler.sample_stacks(seconds, hz),
-                           "text/plain")
+        collapsed = req.query.get(
+            "format", [""])[0] == "collapsed"
+        if req.query.get("ring", ["0"])[0] in ("1", "true"):
+            c = profiler.continuous
+            if c is None:
+                raise ApiError("continuous profiler disabled "
+                               "([incidents] profile=false)", 400)
+            body = c.folded()
+        else:
+            seconds = min(30.0, float(
+                req.query.get("seconds", ["2"])[0]))
+            hz = min(1000, int(req.query.get("hz", ["100"])[0]))
+            body = profiler.sample_stacks(seconds, hz,
+                                          collapsed=collapsed)
+        if collapsed:
+            req.extra_headers["Content-Disposition"] = (
+                "attachment; filename=pilosa-profile.folded")
+        return RawResponse(body, "text/plain")
+
+    def _get_debug_incidents(self, req):
+        """Incident bundles (obs/incidents.py): the newest-first
+        metadata listing plus the live watchdog registry, or ONE full
+        bundle via ?id= (404 when unknown — never a half bundle; torn
+        tmp files are invisible to both paths)."""
+        from pilosa_tpu.obs import incidents
+        iid = req.query.get("id", [None])[0]
+        if iid is not None:
+            bundle = incidents.get().fetch(iid)
+            if bundle is None:
+                raise ApiError(f"no such incident: {iid}", 404)
+            return bundle
+        limit = int(req.query.get("limit", ["50"])[0])
+        return incidents.get().payload(limit)
+
+    def _get_debug_logs(self, req):
+        """Recent log lines (obs/logger.py ring), oldest first —
+        ?limit=N bounds the tail (default 200)."""
+        from pilosa_tpu.obs import logger
+        limit = int(req.query.get("limit", ["200"])[0])
+        lines = logger.ring.recent(limit)
+        return {"lines": lines, "returned": len(lines),
+                "kept": len(logger.ring),
+                "capacity": logger.ring._ring.maxlen}
 
     def _get_debug_allocs(self, req):
         """tracemalloc heap snapshot (pprof allocs analog)."""

@@ -1,0 +1,633 @@
+"""Incident forensics plane (ISSUE 15): anomaly-triggered black-box
+bundles (obs/incidents.py), stall watchdogs (obs/watchdog.py), the
+continuous profiler ring, the log-tail ring, and the /debug surfacing
+— each trigger yields exactly one deduped bundle, capture never
+serves a half bundle, and serving stays unharmed while capture runs.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from pilosa_tpu.executor.executor import Executor
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.obs import faults, incidents, logger, profiler, watchdog
+
+
+@pytest.fixture
+def mgr(tmp_path):
+    """Fresh persisted manager swapped in for the test; the process
+    manager (and whatever the suite's servers configured on it) is
+    restored untouched."""
+    m = incidents.IncidentManager(dir=str(tmp_path / "incidents"),
+                                  min_interval_s=60.0)
+    prev = incidents.swap(m)
+    yield m
+    m.wait_idle(10)
+    incidents.swap(prev)
+
+
+def build_holder() -> Holder:
+    h = Holder()
+    idx = h.create_index("i", track_existence=False)
+    idx.create_field("a")
+    ex = Executor(h)
+    for c in range(64):
+        ex.execute("i", f"Set({c}, a={c % 4})")
+    return h
+
+
+# ---------------------------------------------------------------------------
+# bundle capture: dedupe, contents, size bound, crash seam
+# ---------------------------------------------------------------------------
+
+def test_each_trigger_one_deduped_bundle(mgr):
+    """Every trigger fired twice inside the rate-limit window yields
+    exactly ONE captured bundle + one suppressed count."""
+    trig = ("slo-burn", "perf-regression", "watchdog-stall",
+            "device-oom", "batch-leader-exception", "ingest-crash")
+    for t in trig:
+        assert incidents.report(t, detail="first") is True
+        assert incidents.report(t, detail="second") is False
+    assert mgr.wait_idle(10)
+    got = mgr.list(limit=100)
+    assert sorted(m["trigger"] for m in got) == sorted(trig)
+    assert all(mgr.suppressed[t] == 1 for t in trig)
+    # rate limiting is per trigger: distinct triggers never dedupe
+    # against each other (asserted by the full listing above)
+
+
+def test_bundle_contents_and_persistence(mgr):
+    lg = logger.Logger(stream=open(os.devnull, "w"))
+    lg.info("incident-test log line %d", 7)
+    incidents.report("manual", detail="contents",
+                     context={"answer": 42})
+    assert mgr.wait_idle(10)
+    meta = mgr.list()[0]
+    assert meta["persisted"] is True
+    b = mgr.fetch(meta["id"])
+    # the black-box inventory the ISSUE names
+    for key in ("stacks", "flight", "trace", "metrics", "stats",
+                "faults", "host", "log_tail", "profile"):
+        assert key in b, key
+    assert b["context"]["answer"] == 42
+    assert any("MainThread" in s["name"] for s in b["stacks"])
+    assert any("incident-test log line 7" in ln
+               for ln in b["log_tail"])
+    assert "num_cpu" in b["host"]
+    # the persisted file is the complete bundle (tmp+fsync+rename)
+    path = os.path.join(mgr.dir, meta["id"] + ".json")
+    with open(path) as f:
+        on_disk = json.load(f)
+    assert on_disk["id"] == b["id"]
+    assert on_disk["trigger"] == "manual"
+
+
+def test_bundle_size_bound_enforced(mgr):
+    """An over-budget bundle shrinks its biggest sections until it
+    fits — never grows without bound, never loses its identity."""
+    mgr.max_bundle_bytes = 50_000
+    # deterministic section sizes: heavyweight collectors stubbed
+    # small (instance attrs shadow the staticmethods), the log tail
+    # stuffed far past the bound
+    mgr._metrics_dump = lambda: {"stub": 1}
+    mgr._stats_excerpt = lambda: {"stub": 1}
+    mgr._trace_excerpt = lambda: {"traceEvents": []}
+    prev_keep = logger.ring._ring.maxlen
+    logger.ring.configure(512)
+    try:
+        for i in range(300):
+            logger.ring.record(f"line {i} " + "x" * 2000)
+        incidents.report("manual", detail="big")
+        assert mgr.wait_idle(10)
+        b = mgr.fetch(mgr.list()[0]["id"])
+        assert b["bundle_bytes"] <= 50_000
+        assert b.get("truncated") is True
+        assert b["trigger"] == "manual" and b["stacks"]
+        assert len(b["log_tail"]) < 200  # the fat section shrank
+        path = os.path.join(mgr.dir, b["id"] + ".json")
+        assert os.path.getsize(path) <= 50_000 + 256
+    finally:
+        logger.ring.configure(prev_keep)
+
+
+def test_crash_mid_capture_never_serves_half_bundle(mgr):
+    """The incident-write fault seam dies after half the tmp file:
+    no .json lands, the listing serves nothing torn, and the next
+    capture (fault exhausted) persists normally."""
+    mgr.min_interval_s = 0.0
+    faults.inject("incident-write", times=1)
+    try:
+        incidents.report("manual", detail="torn")
+        assert mgr.wait_idle(10)
+        files = os.listdir(mgr.dir)
+        assert not any(f.endswith(".json") for f in files)
+        # the in-memory bundle is complete (capture finished; only
+        # persistence died) and is flagged unpersisted
+        meta = mgr.list()[0]
+        assert meta["persisted"] is False
+        assert mgr.fetch(meta["id"])["detail"] == "torn"
+        # fault consumed: the next bundle persists, and its prune
+        # sweeps the torn tmp debris
+        incidents.report("manual", detail="after")
+        assert mgr.wait_idle(10)
+        files = os.listdir(mgr.dir)
+        assert sum(f.endswith(".json") for f in files) == 1
+        assert not any(f.endswith(".tmp") for f in files)
+    finally:
+        faults.clear("incident-write")
+
+
+def test_disk_retention_prunes_oldest(mgr):
+    mgr.min_interval_s = 0.0
+    mgr.max_bundles = 3
+    for i in range(6):
+        incidents.report("manual", detail=f"n{i}")
+    assert mgr.wait_idle(15)
+    files = [f for f in os.listdir(mgr.dir) if f.endswith(".json")]
+    assert len(files) == 3
+
+
+def test_report_disabled_plane_is_noop(mgr):
+    prev = incidents._enabled
+    incidents._enabled = False
+    try:
+        assert incidents.report("manual", "off") is False
+    finally:
+        incidents._enabled = prev
+    assert mgr.list() == []
+
+
+# ---------------------------------------------------------------------------
+# watchdog: detection, episodes, quiet on healthy loops
+# ---------------------------------------------------------------------------
+
+def test_watchdog_fires_once_per_episode_and_stays_quiet(mgr):
+    mgr.min_interval_s = 0.0
+    # manual scans drive detection deterministically — the background
+    # monitor must not race them for the episode
+    watchdog.configure(enabled=False)
+    w = watchdog.register("test-loop", deadline_s=0.05)
+    healthy = watchdog.register("healthy-loop", deadline_s=10.0)
+    try:
+        healthy.stamp("fine")
+        w.stamp("phase-a")
+        time.sleep(0.12)
+        fired = watchdog.scan()
+        assert [f["loop"] for f in fired] == ["test-loop"]
+        assert fired[0]["phase"] == "phase-a"
+        assert fired[0]["overdue_s"] > 0.05
+        # the stuck thread's live stack is the evidence
+        assert "test_incidents" in fired[0]["stack"]
+        # same episode: no re-report until the loop stamps again
+        assert watchdog.scan() == []
+        w.stamp("phase-b")
+        time.sleep(0.12)
+        assert [f["phase"] for f in watchdog.scan()] == ["phase-b"]
+        # idle loops never stall
+        w.idle()
+        time.sleep(0.12)
+        assert watchdog.scan() == []
+        assert mgr.wait_idle(10)
+        got = [m for m in mgr.list(100)
+               if m["trigger"] == "watchdog-stall"]
+        assert len(got) == 2  # one per episode
+        assert healthy.stalls == 0
+    finally:
+        watchdog.deregister("test-loop")
+        watchdog.deregister("healthy-loop")
+        watchdog.configure(enabled=True)
+
+
+def test_watchdog_token_model_survives_overlapping_dispatchers(mgr):
+    """The serving batcher overlaps dispatches under load (a full
+    batch dispatches while another is in flight): a healthy leader
+    finishing must not disarm or re-stamp away a wedged sibling —
+    staleness is judged against the OLDEST in-flight token."""
+    mgr.min_interval_s = 0.0
+    watchdog.configure(enabled=False)
+    w = watchdog.register("tok-loop", deadline_s=0.05)
+    try:
+        wedged = w.begin("dispatch")
+        time.sleep(0.01)
+        healthy = w.begin("dispatch")
+        w.end(healthy)  # sibling completes; the wedge stays armed
+        time.sleep(0.12)
+        fired = watchdog.scan()
+        assert [f["loop"] for f in fired] == ["tok-loop"]
+        assert fired[0]["phase"] == "dispatch"
+        ent = [d for d in watchdog.watches()
+               if d["loop"] == "tok-loop"][0]
+        assert ent["armed"] and ent["stalled"]
+        w.end(wedged)
+        time.sleep(0.12)
+        assert watchdog.scan() == []  # all tokens ended: disarmed
+        ent = [d for d in watchdog.watches()
+               if d["loop"] == "tok-loop"][0]
+        assert not ent["armed"]
+    finally:
+        watchdog.deregister("tok-loop")
+        watchdog.configure(enabled=True)
+
+
+def test_watchdog_registry_payload():
+    w = watchdog.register("payload-loop", deadline_s=5.0)
+    try:
+        w.stamp("busy")
+        ent = [d for d in watchdog.watches()
+               if d["loop"] == "payload-loop"][0]
+        assert ent["phase"] == "busy" and ent["armed"]
+        assert not ent["stalled"]
+    finally:
+        watchdog.deregister("payload-loop")
+
+
+def test_watchdog_fires_on_injected_serving_dispatch_delay(mgr):
+    """The acceptance drill: a delayed fused dispatch (the
+    serving-dispatch fault seam) wedges the batch leader past its
+    deadline — the background monitor names the stall, captures one
+    bundle, and the query itself still succeeds (delay, not error)."""
+    h = build_holder()
+    ex = Executor(h)
+    ex.enable_serving(window_s=0.0, max_batch=8, ragged=False,
+                      admission=False)
+    # lower THE serving watch's deadline + re-pace the monitor
+    watchdog.register("serving-batcher", deadline_s=0.05)
+    watchdog.configure(enabled=True, interval_s=0.02)
+    faults.inject("serving-dispatch", delay_s=0.4, times=1)
+    try:
+        res = ex.execute_serving("i", "Count(Row(a=1))")
+        assert res == [16]
+        assert mgr.wait_idle(10)
+        got = [m for m in mgr.list(100)
+               if m["trigger"] == "watchdog-stall"]
+        assert len(got) == 1
+        b = mgr.fetch(got[0]["id"])
+        assert b["context"]["loop"] == "serving-batcher"
+        assert b["context"]["phase"] == "dispatch"
+        # a healthy follow-up query leaves the watchdog quiet
+        before = [d for d in watchdog.watches()
+                  if d["loop"] == "serving-batcher"][0]["stalls"]
+        assert ex.execute_serving("i", "Count(Row(a=2))") == [16]
+        time.sleep(0.1)
+        after = [d for d in watchdog.watches()
+                 if d["loop"] == "serving-batcher"][0]["stalls"]
+        assert after == before
+    finally:
+        faults.clear("serving-dispatch")
+        watchdog.register("serving-batcher", deadline_s=10.0)
+        watchdog.configure(interval_s=1.0)
+
+
+# ---------------------------------------------------------------------------
+# the other production triggers
+# ---------------------------------------------------------------------------
+
+def test_oom_ladder_trip_triggers_incident(mgr):
+    from pilosa_tpu.memory import pressure
+    pressure.inject_oom(1)
+    assert pressure.guarded(lambda: 42) == 42  # absorbed by retry
+    assert mgr.wait_idle(10)
+    got = [m for m in mgr.list(100) if m["trigger"] == "device-oom"]
+    assert len(got) == 1
+    assert "InjectedOOM" in got[0]["detail"]
+
+
+def test_slo_burn_over_threshold_triggers_incident(mgr):
+    from pilosa_tpu.obs import slo
+    mgr.slo_burn_threshold = 8.0
+    tr = slo.SloTracker(latency_ms=100.0, windows="5m")
+    now = time.time()
+    # a covered 5m window whose delta is 1000 queries, all slow
+    tr._samples.append((now - 295.0, 1000.0, 1000.0, 0.0, 0.0))
+    tr._read = lambda: (time.time(), 2000.0, 1000.0, 0.0, 0.0)
+    payload = tr.evaluate()
+    burn = payload["slos"]["latency"]["windows"]["5m"]["burn_rate"]
+    assert burn >= 8.0
+    assert mgr.wait_idle(10)
+    got = [m for m in mgr.list(100) if m["trigger"] == "slo-burn"]
+    assert len(got) == 1
+    b = mgr.fetch(got[0]["id"])
+    assert b["context"]["slo"] == "latency"
+    # an UNCOVERED window never pages: fresh tracker, 10s of samples
+    # against a 5m window (memory-only so the persisted first bundle
+    # cannot bleed into the listing)
+    mgr.clear()
+    mgr.dir = None
+    tr2 = slo.SloTracker(latency_ms=100.0, windows="5m")
+    tr2._samples.append((now - 10.0, 100.0, 100.0, 0.0, 0.0))
+    tr2._read = lambda: (time.time(), 200.0, 100.0, 0.0, 0.0)
+    tr2.evaluate()
+    assert mgr.wait_idle(10)
+    assert [m for m in mgr.list(100)
+            if m["trigger"] == "slo-burn"] == []
+
+
+def test_perf_regression_sentinel_triggers_incident(mgr):
+    from pilosa_tpu.obs import stats
+    cat = stats.StatsCatalog(regression_ratio=3.0,
+                             regression_min_samples=4)
+    prev = stats.swap(cat)
+    try:
+        rec = {"fingerprint": "regfp", "route": "direct",
+               "phases": {}, "batch": 1, "bytes_moved": 0}
+        for _ in range(10):
+            cat.note_flight({**rec, "duration_ms": 1.0})
+        cat.fold()
+        for _ in range(6):
+            cat.note_flight({**rec, "duration_ms": 30.0})
+        cat.fold()
+        assert cat.regressions(), "sentinel should fire"
+        assert mgr.wait_idle(10)
+        got = [m for m in mgr.list(100)
+               if m["trigger"] == "perf-regression"]
+        assert len(got) == 1
+        assert got[0]["detail"] == "regfp"
+    finally:
+        stats.swap(prev)
+
+
+def test_batch_leader_exception_triggers_incident(mgr):
+    h = build_holder()
+    ex = Executor(h)
+    layer = ex.enable_serving(window_s=0.0, max_batch=8,
+                              ragged=False, admission=False,
+                              cache_bytes=0)
+
+    def boom(batch):
+        raise RuntimeError("leader died mid-batch")
+
+    layer._run_batch = boom
+    with pytest.raises(RuntimeError):
+        ex.execute_serving("i", "Count(Row(a=1))")
+    assert mgr.wait_idle(10)
+    got = [m for m in mgr.list(100)
+           if m["trigger"] == "batch-leader-exception"]
+    assert len(got) == 1
+    b = mgr.fetch(got[0]["id"])
+    assert "leader died" in b["context"]["message"]
+
+
+def test_ingest_crash_triggers_incident(mgr):
+    from pilosa_tpu.api import API
+    from pilosa_tpu.ingest.stream import StreamCrashed, StreamWriter
+    h = build_holder()
+    api = API(h)
+    w = StreamWriter(api, window_s=0.0)
+    faults.inject("ingest-window-stall", times=1)
+    try:
+        with pytest.raises(StreamCrashed):
+            w.submit("i", "a", rows=[0], cols=[1])
+        # the submitter unblocks BEFORE _crash finishes reporting —
+        # join the dead plane's thread so the report is enqueued
+        w._thread.join(5)
+        assert mgr.wait_idle(10)
+        got = [m for m in mgr.list(100)
+               if m["trigger"] == "ingest-crash"]
+        assert len(got) == 1
+    finally:
+        faults.clear("ingest-window-stall")
+        w.close()
+
+
+# ---------------------------------------------------------------------------
+# serving unharmed while capture runs
+# ---------------------------------------------------------------------------
+
+def test_zero_failed_queries_during_capture(mgr):
+    """Capture runs off the hot path: a storm of queries riding the
+    serving layer while bundles capture concurrently — zero failures,
+    bit-exact answers."""
+    mgr.min_interval_s = 0.0
+    h = build_holder()
+    ex = Executor(h)
+    ex.enable_serving(window_s=0.0, max_batch=8, ragged=False,
+                      admission=False)
+    expect = ex.execute("i", "Count(Row(a=1))")
+    errors: list = []
+    stop = threading.Event()
+
+    def storm():
+        while not stop.is_set():
+            try:
+                if ex.execute_serving("i", "Count(Row(a=1))") != expect:
+                    errors.append("mismatch")
+            except Exception as e:
+                errors.append(e)
+
+    ts = [threading.Thread(target=storm) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for i in range(10):
+        incidents.report("manual", detail=f"storm-{i}")
+        time.sleep(0.02)
+    stop.set()
+    for t in ts:
+        t.join()
+    assert errors == []
+    assert mgr.wait_idle(10)
+    assert len([m for m in mgr.list(100)
+                if m["trigger"] == "manual"]) == 10
+
+
+# ---------------------------------------------------------------------------
+# continuous profiler + folded output satellites
+# ---------------------------------------------------------------------------
+
+def test_sample_stacks_thread_names_and_collapsed():
+    # a named helper thread guarantees a sampleable stack (the
+    # sampling thread itself — MainThread here — is excluded)
+    stop = threading.Event()
+    t = threading.Thread(target=stop.wait, name="sampled-worker")
+    t.start()
+    try:
+        out = profiler.sample_stacks(seconds=0.05, hz=100)
+    finally:
+        stop.set()
+        t.join()
+    assert out.startswith("#")  # default keeps the header
+    assert "thread:sampled-worker" in out
+    collapsed = profiler.sample_stacks(seconds=0.05, hz=100,
+                                       collapsed=True)
+    assert not collapsed.startswith("#")
+    assert "thread:" in collapsed
+    # collapsed format: every line is "stack count"
+    for line in collapsed.strip().splitlines():
+        assert line.rsplit(" ", 1)[1].isdigit()
+
+
+def test_continuous_profiler_ring():
+    p = profiler.ContinuousProfiler(hz=200, window_s=0.08, keep=3)
+    p.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if len(p.windows()) >= 2 and p.windows()[0]["samples"]:
+                break
+            time.sleep(0.05)
+        wins = p.windows()
+        assert len(wins) >= 2
+        assert wins[0]["samples"] > 0
+        assert any("thread:" in ln for w in wins
+                   for ln in w["folded"])
+        assert len(wins) <= 4  # keep=3 (+ the in-progress window)
+        assert "thread:" in p.folded()
+    finally:
+        p.stop()
+
+
+def test_bundle_attaches_profile_windows(mgr):
+    prev = profiler.continuous
+    p = profiler.ContinuousProfiler(hz=200, window_s=0.05, keep=3)
+    profiler.continuous = p.start()
+    try:
+        time.sleep(0.2)
+        incidents.report("manual", detail="with-profile")
+        assert mgr.wait_idle(10)
+        b = mgr.fetch(mgr.list()[0]["id"])
+        assert b["profile"], "continuous windows must ride the bundle"
+        assert any("thread:" in ln for w in b["profile"]
+                   for ln in w["folded"])
+    finally:
+        p.stop()
+        profiler.continuous = prev
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface + federation + gating
+# ---------------------------------------------------------------------------
+
+def _req(port, method, path, body=None, headers=None):
+    import http.client
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+    data = json.dumps(body) if isinstance(body, (dict, list)) else body
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    c.request(method, path, body=data, headers=hdrs)
+    r = c.getresponse()
+    raw = r.read()
+    disp = r.getheader("Content-Disposition")
+    c.close()
+    try:
+        return r.status, json.loads(raw), disp
+    except json.JSONDecodeError:
+        return r.status, raw.decode(), disp
+
+
+def test_debug_incidents_http_and_federation(tmp_path):
+    from pilosa_tpu.cluster import ClusterNode, InMemDisCo
+    node = ClusterNode("n0", InMemDisCo(lease_ttl=30), replica_n=1,
+                       heartbeat_interval=30).open()
+    m = incidents.IncidentManager(dir=str(tmp_path / "inc"),
+                                  min_interval_s=0.0)
+    prev = incidents.swap(m)
+    try:
+        incidents.report("manual", detail="over-http")
+        assert m.wait_idle(10)
+        port = node.server.port
+        st, d, _ = _req(port, "GET", "/debug/incidents")
+        assert st == 200 and d["enabled"] is not None
+        assert len(d["incidents"]) == 1
+        assert any(w["loop"] == "heartbeat:n0"
+                   for w in d["watchdog"])
+        iid = d["incidents"][0]["id"]
+        st, b, _ = _req(port, "GET", f"/debug/incidents?id={iid}")
+        assert st == 200 and b["id"] == iid and b["stacks"]
+        st, _d, _ = _req(port, "GET", "/debug/incidents?id=nope")
+        assert st == 404
+        # federation: same bundle, node-attributed, deduped
+        st, d, _ = _req(port, "GET", "/debug/cluster/incidents")
+        assert st == 200 and not d["partial"]
+        assert [e["id"] for e in d["incidents"]] == [iid]
+        assert d["incidents"][0]["node"] == "n0"
+        # log ring over HTTP
+        node.server.logger  # NopLogger: feed the ring directly
+        logger.ring.record("http-tail-line")
+        st, d, _ = _req(port, "GET", "/debug/logs?limit=50")
+        assert st == 200 and "http-tail-line" in d["lines"][-1]
+        # collapsed profile download mode
+        st, body, disp = _req(
+            port, "GET",
+            "/debug/profile?seconds=0.05&hz=20&format=collapsed")
+        assert st == 200 and not body.startswith("#")
+        assert disp and "attachment" in disp
+    finally:
+        incidents.swap(prev)
+        node.close()
+
+
+def test_debug_incidents_auth_gating():
+    from pilosa_tpu.server.authn import Authenticator, encode_jwt
+    from pilosa_tpu.server.authz import Authorizer
+    from pilosa_tpu.server.http import Server
+
+    secret = b"incident-secret"
+    authn = Authenticator(secret)
+    authz = Authorizer(user_groups={"readers": {"i": "read"}},
+                       admin_group="admins")
+    atok = encode_jwt({"groups": ["admins"],
+                       "exp": time.time() + 300}, secret)
+    rtok = encode_jwt({"groups": ["readers"],
+                       "exp": time.time() + 300}, secret)
+    srv = Server(auth=(authn, authz)).start()
+    try:
+        for path in ("/debug/incidents", "/debug/logs"):
+            st, _, _ = _req(srv.port, "GET", path)
+            assert st == 401, path
+            st, _, _ = _req(srv.port, "GET", path, headers={
+                "Authorization": f"Bearer {rtok}"})
+            assert st == 403, path
+            st, _, _ = _req(srv.port, "GET", path, headers={
+                "Authorization": f"Bearer {atok}"})
+            assert st == 200, path
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# config plumbing
+# ---------------------------------------------------------------------------
+
+def test_config_knobs_reach_the_planes(tmp_path):
+    from pilosa_tpu import config as cfgmod
+
+    cfg = cfgmod.Config()
+    cfg.incidents_min_interval_s = 7.0
+    cfg.incidents_max_bundles = 5
+    cfg.incidents_max_bundle_bytes = 123456
+    cfg.incidents_slo_burn_threshold = 3.5
+    cfg.incidents_profile = False
+    cfg.incidents_log_ring = 99
+    cfg.watchdog_interval_s = 0.5
+    cfg.watchdog_deadline_s = 4.0
+    m = incidents.IncidentManager()
+    prev = incidents.swap(m)
+    prev_keep = logger.ring._ring.maxlen
+    try:
+        cfg.apply_incident_settings(data_dir=str(tmp_path))
+        cfg.apply_watchdog_settings()
+        assert m.min_interval_s == 7.0
+        assert m.max_bundles == 5
+        assert m.max_bundle_bytes == 123456
+        assert m.slo_burn_threshold == 3.5
+        assert m.dir == os.path.join(str(tmp_path), "incidents")
+        # secrets never enter the bundle's config snapshot
+        assert m.config_snapshot
+        assert not any("secret" in k for k in m.config_snapshot)
+        assert logger.ring._ring.maxlen == 99
+        assert watchdog._interval_s == 0.5
+        assert watchdog._default_deadline_s == 4.0
+    finally:
+        incidents.swap(prev)
+        logger.ring.configure(prev_keep)
+        watchdog.configure(interval_s=1.0, deadline_s=10.0)
+        # the suite's continuous profiler stays as the servers set it
+        cfg2 = cfgmod.Config()
+        profiler.configure_continuous(
+            enabled=cfg2.incidents_profile,
+            hz=cfg2.incidents_profile_hz,
+            window_s=cfg2.incidents_profile_window_s,
+            keep=cfg2.incidents_profile_windows)
