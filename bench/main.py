@@ -38,6 +38,7 @@ from bench.kernelsmoke import kernel_smoke
 from bench.memory import memory_pressure_gauntlet, memory_smoke
 from bench.ragged import build_events_index, ragged_gauntlet, ragged_smoke
 from bench.rebalance import rebalance_gauntlet, rebalance_smoke
+from bench.sparse import sparse_format_ab_gauntlet, sparse_smoke
 from bench.serving import (
     mixed_rw_gauntlet,
     overhead_smoke,
@@ -120,6 +121,11 @@ def main() -> None:
     # writes bit-exact on the recipient, then a drain under the same
     # gates
     rebalance = rebalance_gauntlet()
+    # sparse-format skewed gauntlet (ISSUE 16): Zipfian index (<=1%
+    # dense rows) served with the container-adaptive paged layout on
+    # vs off — bit-exact hard-gated, ledger-bytes + Count/TopN p50
+    # ratios recorded (never asserted on the CPU fallback)
+    sparse_ab = sparse_format_ab_gauntlet()
     # RTT-independent device time for the sub-RTT north-star scans
     cal = loop_calibrate(h) if on_tpu else None
 
@@ -234,6 +240,10 @@ def main() -> None:
         # the recipient vs cold rebuild, event-window p99 spike vs
         # baseline, owner-invariant probe sampled throughout
         "rebalance_gauntlet": rebalance,
+        # sparse-format A/B (ISSUE 16): working-set-per-ledger-byte
+        # and Count/TopN p50 ratios, packed-page evidence
+        # (pilosa_stack_pages_total{encoding=packed} delta per arm)
+        "sparse_format_ab": sparse_ab,
     }
     if cal is not None:
         result["loop_calibrated_device_ms"] = {
@@ -311,6 +321,8 @@ def dispatch(argv) -> int:
         return rebalance_smoke()
     if "--incident-smoke" in argv:
         return incident_smoke()
+    if "--sparse-smoke" in argv:
+        return sparse_smoke()
     try:
         main()
     except Exception as e:  # clear failure JSON — never a bare crash

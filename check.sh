@@ -144,6 +144,20 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 
+echo "== sparse-format smoke =="
+# container-adaptive device format gate (bench.py --sparse-smoke,
+# bench/sparse.py): a Zipfian battery must be BIT-EXACT between the
+# sparse arm and the PILOSA_TPU_SPARSE_FORMAT=0 dense arm, packed
+# pages must actually build (pilosa_stack_pages_total{encoding=packed}
+# moves), and a write landing on a packed page must re-encode and
+# stay exact.  Compression/latency ratios are recorded in the JSON,
+# never asserted here (the committed gauntlet run carries them).
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python bench.py --sparse-smoke; then
+    echo "check.sh: sparse-format smoke failed" >&2
+    exit 1
+fi
+
 echo "== kernel interpret-mode smoke =="
 # fused single-pass GroupBy kernel gate (bench.py --kernel-smoke):
 # the fused int8 MXU kernel + Min/Max presence walk + Range/Distinct

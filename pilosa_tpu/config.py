@@ -66,6 +66,14 @@ class Config:
     stack_patch: bool = True
     stack_delta_log_max: int = 256
     stack_patch_max_frac: float = 0.5
+    # container-adaptive device format (memory/encode.py): per page
+    # block pick dense / packed-array / run encoding.  sparse-format
+    # false = all-dense (the A/B arm, env twin
+    # PILOSA_TPU_SPARSE_FORMAT); sparse-dense-frac is the entry
+    # threshold — a sparse candidate must be <= this fraction of the
+    # dense page's bytes to leave the dense format.
+    stack_sparse_format: bool = True
+    stack_sparse_dense_frac: float = 0.5
     # HBM residency manager (pilosa_tpu/memory): one process-wide
     # device-byte budget shared by the tile-stack/jit/result caches.
     # budget-bytes 0 = auto (device memory_stats minus headroom-frac,
@@ -212,10 +220,14 @@ class Config:
         bounds — both read dynamically by the hot paths)."""
         os.environ["PILOSA_TPU_STACK_PATCH"] = \
             "1" if self.stack_patch else "0"
+        os.environ["PILOSA_TPU_SPARSE_FORMAT"] = \
+            "1" if self.stack_sparse_format else "0"
         from pilosa_tpu.executor import stacked
+        from pilosa_tpu.memory import encode
         from pilosa_tpu.models import fragment
         fragment.DELTA_LOG_MAX = int(self.stack_delta_log_max)
         stacked._PATCH_MAX_FRAC = float(self.stack_patch_max_frac)
+        encode.configure(dense_frac=self.stack_sparse_dense_frac)
 
     def apply_flight_settings(self):
         """Configure the process-global flight recorder ([flight])."""
@@ -397,6 +409,8 @@ _TOML_KEYS = {
     "stacked.patch": "stack_patch",
     "stacked.delta-log-max": "stack_delta_log_max",
     "stacked.patch-max-frac": "stack_patch_max_frac",
+    "stacked.sparse-format": "stack_sparse_format",
+    "stacked.sparse-dense-frac": "stack_sparse_dense_frac",
     "flight.recorder": "flight_recorder",
     "flight.ring": "flight_ring",
     "roofline.attribution": "roofline_attribution",

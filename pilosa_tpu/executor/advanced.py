@@ -195,7 +195,13 @@ class AdvancedOps:
         counts: dict[int, int] = {}
         for i in range(0, len(row_ids), chunk):
             rows = row_ids[i:i + chunk]
-            stack = eng.rows_stack_for(idx, f, tuple(views), rows, skey)
+            # sparse_raw: on pageable placements the candidate stack
+            # arrives as a PageView so an unfiltered scan can serve
+            # straight from encode-time lane popcounts (row_counts
+            # decodes it per page when a filter tree needs the tiles)
+            with eng.sparse_raw():
+                stack = eng.rows_stack_for(idx, f, tuple(views), rows,
+                                           skey)
             got = eng.row_counts(idx, stack, filter_call, list(skey), pre)
             for r, c in zip(rows, got):
                 counts[r] = int(c)

@@ -171,7 +171,11 @@ class RaggedProgram:
                 key = (leaf.page_lanes, leaf.width_words)
                 pages = self.buckets.setdefault(key, [])
                 base = len(pages) * leaf.page_lanes
-                pages.extend(leaf.pages)
+                # per-page decode-to-dense boundary: the fused gather
+                # program indexes a homogeneous dense page pool, so
+                # container-encoded pages (memory/encode.py) expand
+                # here — page identity and lane mapping unchanged
+                pages.extend(leaf.dense_pages())
                 lane_idx = (base + np.arange(leaf.lanes)).astype(
                     np.int32)
                 lmap[i] = ("v", len(self.vleaves))

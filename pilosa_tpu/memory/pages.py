@@ -120,10 +120,16 @@ class PagedStack:
 
     @property
     def page_nbytes(self) -> int:
+        """DENSE byte size of one page — the fixed upper bound.
+        Resident accounting uses each page's TRUE byte size instead
+        (``resident_bytes``): container-encoded pages
+        (memory/encode.py) are smaller, and charging the ledger their
+        dense-tile estimate would waste exactly the capacity the
+        sparse format buys."""
         return self.page_lanes * self.width_words * 4
 
     def resident_bytes(self) -> int:
-        return sum(self.page_nbytes for p in self.pages
+        return sum(int(p.nbytes) for p in self.pages
                    if p is not None)
 
     def missing(self) -> list[int]:

@@ -51,7 +51,7 @@ class Acc:
     request)."""
 
     __slots__ = ("phases", "stack", "bytes_moved", "keys", "attempts",
-                 "t0", "node_spans", "ops")
+                 "t0", "node_spans", "ops", "pages")
 
     # per-record stack-key cap: a pathological query touching hundreds
     # of stacks must not bloat the ring
@@ -89,6 +89,14 @@ class Acc:
         # op-family roofline shares: op -> [bytes touched, execute s]
         # (obs/roofline.py note() feeds this per device dispatch)
         self.ops: dict[str, list] = {}
+        # page-encoding mix of the stack operands this query touched
+        # (encoding -> page count; memory/encode.py container kinds) —
+        # how a record shows which arm served it, packed or dense
+        self.pages: dict[str, int] = {}
+
+    def add_pages(self, mix: dict):
+        for k, v in mix.items():
+            self.pages[k] = self.pages.get(k, 0) + int(v)
 
     def add_phase(self, name: str, dt: float):
         self.phases[name] = self.phases.get(name, 0.0) + dt
@@ -146,6 +154,7 @@ class Acc:
             else:
                 st[0] += b
                 st[1] += s
+        self.add_pages(other.pages)
 
 
 def push_acc(acc: Acc):
@@ -206,6 +215,14 @@ def note_node_spans(node: str, spans: list, anchor_perf: float):
     acc = getattr(_tls, "acc", None)
     if acc is not None:
         acc.add_node_spans(node, spans, anchor_perf)
+
+
+def note_pages(mix: dict):
+    """Record the page-encoding mix of one stack operand fetch
+    (executor/stacked.py _assemble) into the active record."""
+    acc = getattr(_tls, "acc", None)
+    if acc is not None:
+        acc.add_pages(mix)
 
 
 def note_op(op: str, nbytes: int, dt: float):
@@ -486,6 +503,10 @@ def commit(rec: dict | None, duration_s: float, route: str = "solo",
         # per-node span trees from RPC trailers (+ the local leg) —
         # the /debug/trace node lanes
         rec["node_spans"] = list(acc.node_spans)
+    if acc.pages:
+        # page-encoding mix of the stack operands touched (sparse
+        # device format, memory/encode.py): packed vs dense served
+        rec["page_mix"] = dict(acc.pages)
     if acc.ops:
         # roofline share: bytes touched / execute time per op family,
         # with achieved GB/s (+ fraction once the peak probe landed)

@@ -418,7 +418,12 @@ OOM_TOTAL = registry.counter(
     "(caught/retry_ok/host_fallback/raised)")
 STACK_PAGES = registry.counter(
     "pilosa_stack_pages_total",
-    "Paged stack-cache page events (build/evict/patch)")
+    "Paged stack-cache page events (build/evict/patch) by page "
+    "encoding (dense/packed/run)")
+PAGE_ENCODE = registry.counter(
+    "pilosa_page_encode_total",
+    "Page encoding decisions by from/to container kind and reason "
+    "(build/drift/patch)")
 PREFETCH_TOTAL = registry.counter(
     "pilosa_prefetch_total",
     "Prefetcher warm attempts by outcome "

@@ -84,7 +84,7 @@ class FieldStats:
     """Data-plane stats for one (index, field)."""
 
     __slots__ = ("rows", "rows_capped", "shard_bits", "vmin", "vmax",
-                 "vcount", "vhist")
+                 "vcount", "vhist", "encodings")
 
     def __init__(self):
         self.rows: set[int] = set()
@@ -94,6 +94,11 @@ class FieldStats:
         self.vmax: float | None = None
         self.vcount = 0
         self.vhist: dict | None = None
+        # device-format decisions for this field's pages (kind ->
+        # count; memory/encode.py): the /debug/stats per-field
+        # encoding breakdown.  Process-lifetime tallies — not
+        # persisted through ingest events, only snapshots.
+        self.encodings: dict[str, int] = {}
 
     def note(self, rows, shard_bits: dict, vmin=None, vmax=None,
              vcount: int = 0):
@@ -134,6 +139,8 @@ class FieldStats:
                              "max": self.vmax}
         if self.vhist is not None:
             out["value_hist"] = dict(self.vhist)
+        if self.encodings:
+            out["encodings"] = dict(self.encodings)
         return out
 
     def to_state(self) -> dict:
@@ -142,7 +149,8 @@ class FieldStats:
                 "shard_bits": {str(k): v
                                for k, v in self.shard_bits.items()},
                 "vmin": self.vmin, "vmax": self.vmax,
-                "vcount": self.vcount, "vhist": self.vhist}
+                "vcount": self.vcount, "vhist": self.vhist,
+                "encodings": dict(self.encodings)}
 
     @classmethod
     def from_state(cls, st: dict) -> "FieldStats":
@@ -155,6 +163,8 @@ class FieldStats:
         fs.vmax = st.get("vmax")
         fs.vcount = int(st.get("vcount", 0))
         fs.vhist = st.get("vhist")
+        fs.encodings = {str(k): int(v)
+                        for k, v in (st.get("encodings") or {}).items()}
         return fs
 
 
@@ -464,6 +474,34 @@ class StatsCatalog:
             if fs is None:
                 fs = self._fields[key] = FieldStats()
             fs.vhist = summary
+
+    def note_page_encoding(self, index: str, field: str, kind: str):
+        """Tally one device-format decision for a field's pages
+        (executor/stacked.py _commit_page) — the /debug/stats
+        per-field encoding breakdown."""
+        key = (index, field)
+        with self._lock:
+            fs = self._fields.get(key)
+            if fs is None:
+                fs = self._fields[key] = FieldStats()
+            fs.encodings[kind] = fs.encodings.get(kind, 0) + 1
+
+    def field_density(self, index: str, field: str,
+                      width_bits: int) -> float | None:
+        """Estimated set-bit density of one (row, shard) slab of the
+        field — the encoder's skip-the-scan hint for clearly-dense
+        fields (memory/encode.py).  None when the catalog can't say
+        (no ingest stats, or the row set hit its cap — a capped set
+        would overestimate density and wrongly pin sparse fields
+        dense)."""
+        with self._lock:
+            fs = self._fields.get((index, field))
+            if (fs is None or fs.rows_capped or not fs.rows
+                    or not fs.shard_bits or width_bits <= 0):
+                return None
+            total = sum(fs.shard_bits.values())
+            slots = len(fs.rows) * len(fs.shard_bits) * width_bits
+        return total / slots if slots > 0 else None
 
     def field_stats(self, index: str, field: str) -> dict | None:
         with self._lock:
@@ -867,6 +905,25 @@ def note_value_hist(index: str, field: str, pos, neg):
         get().note_value_hist(index, field, pos, neg)
     except Exception:
         pass
+
+
+def note_page_encoding(index: str, field: str, kind: str):
+    if not enabled():
+        return
+    try:
+        get().note_page_encoding(index, field, kind)
+    except Exception:
+        pass  # stats must never fail a page build
+
+
+def field_density(index: str, field: str,
+                  width_bits: int) -> float | None:
+    if not enabled():
+        return None
+    try:
+        return get().field_density(index, field, width_bits)
+    except Exception:
+        return None
 
 
 def note_gate(op: str, units: float, seconds: float):

@@ -324,6 +324,93 @@ def segment_count_np(lanes: np.ndarray, seg_ids, num_segments: int):
     return out
 
 
+# Sparse page encodings (container-adaptive device format) ------------------
+#
+# memory/encode.py stores sparse stack-cache pages as sorted set-bit
+# COORDINATES (packed) or word-granular all-ones RUNS + a residual
+# coordinate tail (run) — the roaring array/run containers mapped onto
+# the fixed page unit.  These are the device arms: a jitted gather-
+# expand back to the dense (page_lanes, W) block for operand
+# boundaries that need dense tiles, and count kernels that consume
+# the coordinates natively (no expand).  All inputs are pow2-padded
+# with out-of-range sentinels (coordinate >= page bits, run start >=
+# page words), which the scatter/gather arms drop by construction —
+# so the executable cache grows log-, not linearly, in payload size.
+
+@_partial(jax.jit, static_argnums=(1, 2))
+def _expand_coords_jit(coords, page_lanes: int, width_words: int):
+    n_words = page_lanes * width_words
+    flat = jnp.zeros((n_words,), dtype=jnp.uint32)
+    word_idx = (coords >> jnp.uint32(5)).astype(jnp.int32)
+    vals = jnp.uint32(1) << (coords & jnp.uint32(31))
+    # coordinates are unique set bits, so add == or; sentinel pads
+    # index past n_words and mode="drop" discards them exactly
+    flat = flat.at[word_idx].add(vals, mode="drop")
+    return flat.reshape(page_lanes, width_words)
+
+
+def expand_coords(coords, page_lanes: int, width_words: int):
+    """Packed coordinate page -> dense (page_lanes, W) uint32 block."""
+    return _expand_coords_jit(jnp.asarray(coords), int(page_lanes),
+                              int(width_words))
+
+
+@_partial(jax.jit, static_argnums=(3, 4))
+def _expand_runs_jit(starts, lens, coords, page_lanes: int,
+                     width_words: int):
+    n_words = page_lanes * width_words
+    base = _expand_coords_jit(coords, page_lanes,
+                              width_words).reshape(-1)
+    w = jnp.arange(n_words, dtype=jnp.int32)
+    # runs are sorted and disjoint: the covering candidate is the last
+    # run starting at or before w (sentinel starts sort past every w)
+    j = jnp.clip(jnp.searchsorted(starts, w, side="right") - 1,
+                 0, starts.shape[0] - 1)
+    inside = (w >= starts[j]) & (w < starts[j] + lens[j])
+    flat = jnp.where(inside, jnp.uint32(0xFFFFFFFF), base)
+    return flat.reshape(page_lanes, width_words)
+
+
+def expand_runs(starts, lens, coords, page_lanes: int,
+                width_words: int):
+    """Run page (all-ones word runs + residual coordinates) -> dense
+    (page_lanes, W) uint32 block."""
+    return _expand_runs_jit(jnp.asarray(starts), jnp.asarray(lens),
+                            jnp.asarray(coords), int(page_lanes),
+                            int(width_words))
+
+
+def packed_count(coords, total_bits: int):
+    """Set-bit count of a packed coordinate page (sentinel-aware)."""
+    return jnp.sum((jnp.asarray(coords)
+                    < jnp.uint32(total_bits)).astype(jnp.int32))
+
+
+def packed_segment_count(coords, lane_bits: int, num_lanes: int):
+    """Per-lane set-bit counts of a packed page: each coordinate's
+    lane is coord // lane_bits; sentinel coordinates land past
+    num_lanes and drop.  The packed twin of segment_count."""
+    lane = (jnp.asarray(coords) // jnp.uint32(lane_bits)).astype(
+        jnp.int32)
+    return jnp.zeros((num_lanes,), jnp.int32).at[lane].add(
+        1, mode="drop")
+
+
+def packed_intersect_count(coords, dense_words, total_bits: int):
+    """popcount(expand(coords) & dense) WITHOUT expanding: gather the
+    dense operand word under each coordinate and test its bit — the
+    packed intersect-count arm (roaring array-vs-bitmap galloping
+    intersection, collapsed to a gather)."""
+    coords = jnp.asarray(coords)
+    flat = jnp.asarray(dense_words).reshape(-1)
+    wi = jnp.minimum((coords >> jnp.uint32(5)).astype(jnp.int32),
+                     flat.shape[0] - 1)
+    bits = (flat[wi] >> (coords & jnp.uint32(31))) & jnp.uint32(1)
+    valid = coords < jnp.uint32(total_bits)
+    return jnp.sum(jnp.where(valid, bits,
+                             jnp.uint32(0)).astype(jnp.int32))
+
+
 # Group-code planes (one-pass GroupBy) --------------------------------------
 #
 # A stack of R DISJOINT packed rows (no column in two rows) is exactly a

@@ -93,11 +93,27 @@ class _Tokens:
         return self.i >= len(self.toks)
 
 
+# Parsed-query memo (prepared-statement analog): serving storms repeat
+# a small vocabulary of statements, and parse cost (~0.1ms) is pure
+# fixed overhead on the host fast paths.  Safe to share: nothing
+# mutates a Query/Call after parse (the executor only reads args and
+# attaches results to its own RowResult objects).  Bounded by wholesale
+# clear — ad-hoc queries (literal ids inlined) just miss.
+_PARSE_MEMO: dict[str, Query] = {}
+_PARSE_MEMO_MAX = 512
+
+
 def parse(text: str) -> Query:
+    q = _PARSE_MEMO.get(text)
+    if q is not None:
+        return q
     toks = _Tokens(text)
     q = Query()
     while not toks.at_end():
         q.calls.append(_parse_call(toks))
+    if len(_PARSE_MEMO) >= _PARSE_MEMO_MAX:
+        _PARSE_MEMO.clear()
+    _PARSE_MEMO[text] = q
     return q
 
 

@@ -17,8 +17,9 @@ def srv():
     s.close()
 
 
-def req(srv, method, path, body=None):
-    c = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+def req(srv, method, path, body=None, timeout=10):
+    c = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                   timeout=timeout)
     data = json.dumps(body) if isinstance(body, (dict, list)) else body
     c.request(method, path, body=data,
               headers={"Content-Type": "application/json"})
@@ -193,13 +194,17 @@ def test_404(srv):
 
 def test_debug_profile_endpoints(srv):
     """pprof/fgprof analogs (http_handler.go:493-494): stack sampler,
-    heap snapshot, slow-query ring."""
-    st, body = req(srv, "GET", "/debug/profile?seconds=0.2&hz=50")
+    heap snapshot, slow-query ring.  Generous client timeouts: the
+    0.2s sampling window and the tracemalloc snapshot both stretch by
+    an order of magnitude when the full suite loads the 1-CPU CI box
+    (GIL starvation), and a tight timeout here flakes."""
+    st, body = req(srv, "GET", "/debug/profile?seconds=0.2&hz=50",
+                   timeout=60)
     assert st == 200 and "stack samples" in body
-    st, body = req(srv, "GET", "/debug/allocs")
+    st, body = req(srv, "GET", "/debug/allocs", timeout=60)
     assert st == 200 and ("tracemalloc" in body or "heap:" in body)
     # second call must produce a real snapshot
-    st, body = req(srv, "GET", "/debug/allocs")
+    st, body = req(srv, "GET", "/debug/allocs", timeout=60)
     assert st == 200 and "heap:" in body
 
 

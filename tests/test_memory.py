@@ -267,7 +267,11 @@ def test_paged_bit_exact_under_budget_clamp(restore_memory):
 def test_page_eviction_rebuilds_only_missing_pages(monkeypatch):
     """A fresh entry with evicted pages restores ONLY those pages
     (outcome page_rebuild, moved < full size) — the sub-stack
-    granularity the whole PR is about."""
+    granularity the whole PR is about.  Pinned dense: the byte
+    arithmetic below assumes pages at their fixed dense size (the
+    sparse device format's variable-size accounting has its own
+    suite, tests/test_sparse_format.py)."""
+    monkeypatch.setenv("PILOSA_TPU_SPARSE_FORMAT", "0")
     monkeypatch.setenv("PILOSA_TPU_MEMORY_PAGE_BYTES", "8192")
     h = _build(n_shards=16)
     ex = Executor(h)
@@ -294,7 +298,11 @@ def test_page_eviction_rebuilds_only_missing_pages(monkeypatch):
 
 
 def test_patch_applies_to_single_page(monkeypatch):
-    """A point write patches the one page holding its lane."""
+    """A point write patches the one page holding its lane.  Pinned
+    dense: patched-byte bounds assume the dense word-scatter arm
+    (an encoded page rebuilds instead — tests/test_sparse_format.py
+    covers that path)."""
+    monkeypatch.setenv("PILOSA_TPU_SPARSE_FORMAT", "0")
     monkeypatch.setenv("PILOSA_TPU_MEMORY_PAGE_BYTES", "8192")
     h = _build(n_shards=16)
     ex = Executor(h)
@@ -436,7 +444,12 @@ def test_jit_cache_eviction_counted():
 # OOM backstop
 # ---------------------------------------------------------------------------
 
-def test_injected_oom_absorbed_by_retry():
+def test_injected_oom_absorbed_by_retry(monkeypatch):
+    # pinned dense: under the sparse device format a cached
+    # Count(Row) serves from host popcounts with NO device dispatch,
+    # so the armed injection would never fire (and would leak into
+    # the next test's first guarded call)
+    monkeypatch.setenv("PILOSA_TPU_SPARSE_FORMAT", "0")
     h = _build(n_shards=4)
     ex = Executor(h)
     want = ex.execute("i", "Count(Row(f=1))")[0]

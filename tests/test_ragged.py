@@ -177,10 +177,13 @@ def test_segment_ops_bit_exact():
 
 def test_raw_pages_view(holder):
     """stacked.raw_pages(): a paged stack fetch returns a PageView
-    whose pages concatenate to the assembled operand."""
+    whose pages — decoded at the container boundary, some may be
+    packed/run-encoded (memory/encode.py) — concatenate to the
+    assembled operand."""
     import numpy as np
 
     from pilosa_tpu.executor import stacked as stk
+    from pilosa_tpu.memory import encode
     from pilosa_tpu.models.view import VIEW_STANDARD
 
     ex = Executor(holder)
@@ -192,7 +195,8 @@ def test_raw_pages_view(holder):
     with stk.raw_pages():
         pv = ex.stacked.row_stack(idx, f, (VIEW_STANDARD,), 1, skey)
     assert isinstance(pv, stk.PageView)
-    flat = np.concatenate([np.asarray(p) for p in pv.pages])
+    flat = np.concatenate([np.asarray(encode.to_dense(p))
+                           for p in pv.pages])
     got = flat[: pv.lanes].reshape(pv.shape)
     assert (got == whole).all()
     # outside the context the same fetch assembles again

@@ -27,9 +27,14 @@ def _build(holder, n_shards=64, rows=4, seed=0):
 
 
 class TestCachePressure:
-    def test_eviction_keeps_answers_exact(self):
+    def test_eviction_keeps_answers_exact(self, monkeypatch):
         """A cache far too small for the working set thrashes but
-        never returns stale or wrong results."""
+        never returns stale or wrong results.  Pinned to the dense
+        format: the byte budget below is sized against DENSE stacks,
+        and container-encoded sparse stacks fit without thrashing
+        (sparse-arm eviction pressure is covered by
+        tests/test_sparse_format.py)."""
+        monkeypatch.setenv("PILOSA_TPU_SPARSE_FORMAT", "0")
         holder = Holder(width=W)
         idx, cols = _build(holder, n_shards=16)
         ex = Executor(holder)
