@@ -36,6 +36,11 @@ from bench.headline import groupby_fused_ab, loop_calibrate, run_queries
 from bench.incidents import incident_smoke
 from bench.kernelsmoke import kernel_smoke
 from bench.memory import memory_pressure_gauntlet, memory_smoke
+from bench.multichip import (
+    force_host_devices,
+    multichip_gauntlet,
+    multichip_smoke,
+)
 from bench.ragged import build_events_index, ragged_gauntlet, ragged_smoke
 from bench.rebalance import rebalance_gauntlet, rebalance_smoke
 from bench.sparse import sparse_format_ab_gauntlet, sparse_smoke
@@ -126,6 +131,24 @@ def main() -> None:
     # vs off — bit-exact hard-gated, ledger-bytes + Count/TopN p50
     # ratios recorded (never asserted on the CPU fallback)
     sparse_ab = sparse_format_ab_gauntlet()
+    # multi-chip serving gauntlet (ISSUE 17): the mesh-sharded fused
+    # program at 1/2/4/8 devices.  On TPU the live device set is the
+    # mesh; on the CPU fallback the sweep needs 8 FORCED host devices,
+    # which must be configured before the backend initializes — hence
+    # the subprocess arm (--multichip-bench prints only the cell)
+    if n_chips >= 2:
+        multichip = multichip_gauntlet()
+    else:
+        import subprocess as _sp
+        try:
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            out = _sp.run([sys.executable, "bench.py",
+                           "--multichip-bench"], capture_output=True,
+                          text=True, timeout=1800, env=env)
+            multichip = json.loads(out.stdout.strip().splitlines()[-1])
+        except Exception as e:
+            multichip = {"skipped":
+                         f"{type(e).__name__}: {e}"[:200]}
     # RTT-independent device time for the sub-RTT north-star scans
     cal = loop_calibrate(h) if on_tpu else None
 
@@ -244,6 +267,11 @@ def main() -> None:
         # and Count/TopN p50 ratios, packed-page evidence
         # (pilosa_stack_pages_total{encoding=packed} delta per arm)
         "sparse_format_ab": sparse_ab,
+        # multi-chip serving (ISSUE 17): 1->N scaling curve with
+        # per-device roofline windows + per-device ledger occupancy,
+        # bit-exact hard-gated in every arm; the >=0.7x-linear TPU
+        # acceptance is a labeled projection until hardware lands
+        "multichip_gauntlet": multichip,
     }
     if cal is not None:
         result["loop_calibrated_device_ms"] = {
@@ -323,6 +351,15 @@ def dispatch(argv) -> int:
         return incident_smoke()
     if "--sparse-smoke" in argv:
         return sparse_smoke()
+    if "--multichip-smoke" in argv:
+        return multichip_smoke()
+    if "--multichip-bench" in argv:
+        # subprocess arm of the full bench: forces 8 host devices
+        # (must precede backend init, hence its own process) and
+        # prints ONLY the gauntlet cell JSON on stdout
+        force_host_devices(8)
+        print(json.dumps(multichip_gauntlet()))
+        return 0
     try:
         main()
     except Exception as e:  # clear failure JSON — never a bare crash

@@ -158,6 +158,24 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 
+echo "== multichip smoke =="
+# mesh-sharded serving gate (bench.py --multichip-smoke,
+# bench/multichip.py): 8 FORCED host devices (the flag must precede
+# backend init — the smoke owns its process), the mixed ragged
+# gauntlet served with the serving mesh at 8 devices vs the 1-device
+# arm UNDER INTERLEAVED WRITES — bit-exact across arms and vs solo
+# execution once quiesced, zero failed, the ragged_mesh program
+# actually dispatched (not a silent single-device fallback), and no
+# mesh dispatch leaking into the 1-device arm.  Scaling/latency is
+# recorded in the BENCH JSON, never asserted here (forced host
+# devices share one memory bus; the TPU curve is a labeled
+# projection until hardware lands).
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python bench.py --multichip-smoke; then
+    echo "check.sh: multichip smoke failed" >&2
+    exit 1
+fi
+
 echo "== kernel interpret-mode smoke =="
 # fused single-pass GroupBy kernel gate (bench.py --kernel-smoke):
 # the fused int8 MXU kernel + Min/Max presence walk + Range/Distinct

@@ -59,6 +59,7 @@ def cmd_server(args) -> int:
     cfg.apply_stack_settings()
     cfg.apply_flight_settings()
     cfg.apply_memory_settings()
+    cfg.apply_placement_settings()
     cfg.apply_fault_settings()
     cfg.apply_roofline_settings()
     cfg.apply_slo_settings()

@@ -112,6 +112,17 @@ class Config:
     fault_spec: str = ""
     cluster_hedge_ms: float = 0.0
     cluster_deadline_s: float = 0.0
+    # mesh-sharded serving (memory/placement.py): mesh-devices > 1
+    # splits the paged working set over the first N local devices —
+    # every (index, shard) gets a sticky owner balanced by live
+    # per-device ledger bytes, and the fused ragged program runs as
+    # ONE shard_map with in-program psum/scatter combines.  0/1 = off
+    # (the exact single-device behavior).  The env twin
+    # PILOSA_TPU_MESH_DEVICES outranks the config (bench A/B lever).
+    # placement-pin force-places shards ("idx/3=1,idx/*=0"; env twin
+    # PILOSA_TPU_PLACEMENT_PIN) — pins override the balancer.
+    cluster_mesh_devices: int = 0
+    cluster_placement_pin: str = ""
     # online resharding (cluster/rebalance.py): chase-lag is the
     # delta-span backlog under which DELTA-CHASE hands off to the
     # FENCE (smaller = shorter write-blocked window, more chase
@@ -371,6 +382,17 @@ class Config:
             availability_objective=self.slo_availability_objective,
             windows=self.slo_windows)
 
+    def apply_placement_settings(self):
+        """Push the [cluster] serving-mesh knobs into the placement
+        module (memory/placement.py).  Env twins
+        (PILOSA_TPU_MESH_DEVICES / PILOSA_TPU_PLACEMENT_PIN) are read
+        dynamically by the module and outrank these values; configure
+        bumps the placement epoch only when something changed."""
+        from pilosa_tpu.memory import placement
+        placement.configure(
+            mesh_devices=self.cluster_mesh_devices,
+            pin=self.cluster_placement_pin)
+
     def apply_memory_settings(self):
         """Push the [memory] knobs into the process residency manager
         (pilosa_tpu/memory: budget ledger, paged stacks, OOM
@@ -447,6 +469,8 @@ _TOML_KEYS = {
     "ingest.tenant-queue": "ingest_tenant_queue",
     "ingest.sync": "ingest_sync",
     "faults.spec": "fault_spec",
+    "cluster.mesh-devices": "cluster_mesh_devices",
+    "cluster.placement-pin": "cluster_placement_pin",
     "cluster.hedge-ms": "cluster_hedge_ms",
     "cluster.deadline-s": "cluster_deadline_s",
     "cluster.rebalance-chase-lag": "cluster_rebalance_chase_lag",

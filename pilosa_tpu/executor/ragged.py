@@ -58,7 +58,7 @@ from pilosa_tpu.executor.stacked import (
     _dispatch_kind,
     raw_pages,
 )
-from pilosa_tpu.memory import pressure
+from pilosa_tpu.memory import encode, pressure
 from pilosa_tpu.obs import flight, metrics
 from pilosa_tpu.obs.monitor import capture_exception
 from pilosa_tpu.obs.tracing import Span, span_into
@@ -145,10 +145,22 @@ class RaggedProgram:
     # one popcount pass and its executable survives composition churn
     _SEG_MIN = 2
 
-    def __init__(self):
-        # (page_lanes, width_words) -> accumulated page arrays
+    def __init__(self, ndev: int = 1):
+        # serving-mesh width (memory/placement.py); > 1 puts the
+        # program in MESH mode: pages accumulate per owner device and
+        # finalize() emits a ("ragged_mesh", ...) plan whose cross-
+        # device combines run inside the compiled shard_map program
+        self.ndev = int(ndev)
+        self.mesh = self.ndev > 1
+        # (page_lanes, width_words) -> accumulated page arrays; in
+        # mesh mode, a list of per-device page lists instead (pages
+        # stay committed on their placement owner — the pool assembly
+        # in _finalize_mesh never moves a byte between devices)
         self.buckets: OrderedDict[tuple, list] = OrderedDict()
-        self.vleaves: list = []   # (bucket_key, lane_idx, n, shape)
+        # non-mesh vleaf: (bucket_key, lane_idx, n, shape)
+        # mesh vleaf:     (bucket_key, pool_row, lane_dev, n, shape,
+        #                  shard_axis, group_i)
+        self.vleaves: list = []
         self.direct: list = []
         self.params: list = []
         # (entries, lmap, poff) per group; lmap: local leaf index ->
@@ -158,16 +170,30 @@ class RaggedProgram:
         # evaluates, keeping the plan composition-stable); slot_key
         # feeds the cross-batch program cache's demux table
         self.groups: list = []
+        # mesh bookkeeping: per-group shard owner maps (int32 (S,))
+        # and the per-device page-encoding mix (flight/roofline
+        # attribution of what each chip actually streams)
+        self.group_owners: list = []
+        self.dev_mix: list = [dict() for _ in range(self.ndev)]
 
-    def add_group(self, builder: PlanBuilder, entries: list):
+    def add_group(self, builder: PlanBuilder, entries: list,
+                  owners=None):
         """`entries`: [(riders, subplan, demux, slot_key), ...] built
         against `builder` (its leaves may be PageView handles —
-        raw_pages)."""
+        raw_pages).  ``owners``: per-shard serving-mesh owner slots
+        (int32, len(builder.shards)) — required in mesh mode.
+        Raises :class:`RaggedUnbuildable` when the group can't enter
+        the mesh program (whole/host-served operands have no device
+        layout); the caller degrades those riders to the solo path."""
         poff = len(self.params)
         self.params.extend(builder.params)
+        gidx = len(self.groups)
         lmap: dict = {}
         for i, leaf in enumerate(builder.leaves):
             if isinstance(leaf, PageView):
+                if self.mesh:
+                    lmap[i] = ("v", self._add_mesh_leaf(leaf, gidx))
+                    continue
                 key = (leaf.page_lanes, leaf.width_words)
                 pages = self.buckets.setdefault(key, [])
                 base = len(pages) * leaf.page_lanes
@@ -182,9 +208,52 @@ class RaggedProgram:
                 self.vleaves.append((key, lane_idx, leaf.lanes,
                                      leaf.shape))
             else:
+                if self.mesh:
+                    raise RaggedUnbuildable(
+                        "direct (whole/host) leaf under mesh")
                 lmap[i] = ("d", len(self.direct))
                 self.direct.append(leaf)
         self.groups.append((entries, lmap, poff))
+        self.group_owners.append(owners)
+
+    def _add_mesh_leaf(self, leaf: PageView, gidx: int) -> int:
+        """Accumulate one PageView's pages into per-device bucket
+        pools; returns the vleaf index.  ``pool_row[lane]`` is the
+        lane's row in its owner device's (pool pages x page_lanes)
+        flattened pool — valid after finalize's zero-page padding
+        because pad pages append strictly AFTER real ones."""
+        if leaf.page_device is None or leaf.shard_axis is None:
+            raise RaggedUnbuildable("unplaced PageView under mesh")
+        key = (leaf.page_lanes, leaf.width_words)
+        per_dev = self.buckets.setdefault(
+            key, [[] for _ in range(self.ndev)])
+        pages = leaf.dense_pages()   # decode-to-dense ON the owner:
+        # jnp ops on device-committed encoded payloads stay committed
+        slot = np.empty(len(pages), dtype=np.int64)
+        for pi, page in enumerate(pages):
+            d = int(leaf.page_device[pi])
+            if not 0 <= d < self.ndev:
+                raise RaggedUnbuildable("owner slot outside mesh")
+            slot[pi] = len(per_dev[d])
+            per_dev[d].append(page)
+            mk = encode.page_kind(leaf.pages[pi])
+            self.dev_mix[d][mk] = self.dev_mix[d].get(mk, 0) + 1
+        lane_page = leaf.lane_page.astype(np.int64)
+        pool_row = (slot[lane_page] * leaf.page_lanes
+                    + leaf.lane_slot.astype(np.int64))
+        lane_dev = np.asarray(leaf.page_device,
+                              dtype=np.int32)[lane_page]
+        self.vleaves.append((key, pool_row, lane_dev, leaf.lanes,
+                             leaf.shape, leaf.shard_axis, gidx))
+        return len(self.vleaves) - 1
+
+    def _add_mesh_param(self, arr: np.ndarray) -> int:
+        """Append one per-device (ndev, X) int32 index param —
+        sharded P("dev") into the compiled program, one row per
+        device.  X is already pow2-bounded by the callers (local
+        shard widths and pool paddings are pow2)."""
+        self.params.append(np.ascontiguousarray(arr, dtype=np.int32))
+        return len(self.params) - 1
 
     def _add_param(self, arr: np.ndarray, pad_value) -> int:
         """Append a pow2-padded int32 param array; returns its index."""
@@ -197,11 +266,12 @@ class RaggedProgram:
         return len(self.params) - 1
 
     def finalize(self):
-        """(plan, leaves, params, served, table) or None when nothing
-        was built.  ``served``: [(req, demux, extract), ...] where
-        extract is ("plain", sub_i) or ("seg", sub_i, slot);
-        ``table``: slot_key -> (demux, extract) — the cross-batch
-        program cache's rider-mapping surface."""
+        """(plan, leaves, params, served, table, meshinfo) or None
+        when nothing was built.  ``served``: [(req, demux, extract),
+        ...] where extract is ("plain", sub_i) or ("seg", sub_i,
+        slot); ``table``: slot_key -> (demux, extract) — the cross-
+        batch program cache's rider-mapping surface; ``meshinfo``:
+        per-device attribution (mesh mode; None otherwise)."""
         if not any(entries for entries, _l, _p in self.groups):
             return None
         # -- segment-count families: single-leaf reduced Counts whose
@@ -214,15 +284,13 @@ class RaggedProgram:
                 if (sub[0] == "count" and sub[2]
                         and sub[1][0] == "leaf"
                         and lmap.get(sub[1][1], ("", 0))[0] == "v"):
-                    vkey, lane_idx, _n, _shape = \
-                        self.vleaves[lmap[sub[1][1]][1]]
-                    families.setdefault(vkey, []).append(
-                        (ent, lane_idx))
+                    v = self.vleaves[lmap[sub[1][1]][1]]
+                    families.setdefault(v[0], []).append((ent, v))
         for vkey, members in list(families.items()):
             if len(members) < self._SEG_MIN:
                 del families[vkey]
                 continue
-            for slot, (ent, _li) in enumerate(members):
+            for slot, (ent, _v) in enumerate(members):
                 seg_entry[id(ent)] = (vkey, slot)
         # -- keep only the virtual leaves some surviving (non-segment)
         # subplan actually reads: a leaf consumed solely by a segment
@@ -263,19 +331,21 @@ class RaggedProgram:
                 walk(sub[1])
             return out
 
-        plain: list = []          # (ent, lmap, poff) in batch order
+        plain: list = []      # (ent, lmap, poff, group_i) batch order
         kept: set[int] = set()
-        for entries, lmap, poff in self.groups:
+        for gidx, (entries, lmap, poff) in enumerate(self.groups):
             for ent in entries:
                 if id(ent) in seg_entry:
                     continue
-                plain.append((ent, lmap, poff))
+                plain.append((ent, lmap, poff, gidx))
                 for li in _refs(ent[1]):
                     tag, i = lmap[li]
                     if tag == "v":
                         kept.add(i)
         vkeep = sorted(kept)
         vre = {vi: k for k, vi in enumerate(vkeep)}
+        if self.mesh:
+            return self._finalize_mesh(families, plain, vkeep, vre)
         # -- leaf layout: bucket pages (pow2-padded) first, direct
         # after.  Only buckets something references survive — a failed
         # subplan build can leave orphan page leaves behind, and an
@@ -316,7 +386,7 @@ class RaggedProgram:
         served: list = []
         table: dict = {}
         sub_ix: dict = {}
-        for ent, lmap, poff in plain:
+        for ent, lmap, poff, _gidx in plain:
             final = {}
             for li, (tag, i) in lmap.items():
                 final[li] = vre.get(i) if tag == "v" else nv + i
@@ -337,7 +407,8 @@ class RaggedProgram:
             slot_of: dict[int, int] = {}
             uniq: list = []
             member_slots: list = []
-            for ent, li in members:
+            for ent, v in members:
+                li = v[1]
                 s = slot_of.get(id(li))
                 if s is None:
                     s = slot_of[id(li)] = len(uniq)
@@ -367,7 +438,226 @@ class RaggedProgram:
             return None
         plan = ("ragged", tuple(bucket_meta), tuple(vmeta),
                 tuple(subs))
-        return plan, leaves, self.params, served, table
+        return plan, leaves, self.params, served, table, None
+
+    def _finalize_mesh(self, families, plain, vkeep, vre):
+        """Emit the ``("ragged_mesh", ...)`` plan: per-device page
+        POOLS as mesh-sharded leaves (assembled zero-copy with
+        ``make_array_from_single_device_arrays`` — every page is
+        already committed on its placement owner), per-device
+        gather/scatter index params, and a combine spec per sub —
+        psum trees for reduced outputs, dump-row scatter-adds for
+        per-shard outputs — so every cross-device combine happens
+        INSIDE the compiled program (no host merge phase).  Padded
+        local shard positions gather the pool's guaranteed-zero tail
+        page; zero shards are harmless for every reduction we run
+        (the place_shards invariant — all BSI range arms mask with
+        the exists plane)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from pilosa_tpu.memory import placement
+
+        ndev = self.ndev
+        n_base = len(self.params)
+        used_keys = ({self.vleaves[vi][0] for vi in vkeep}
+                     | set(families.keys()))
+        devs = placement.devices()
+        if len(devs) < ndev:
+            raise RaggedUnbuildable("mesh shrank below plan width")
+        smesh = placement.serving_mesh()
+        bucket_meta: list = []    # (pool_pages, page_lanes, W)
+        bucket_id: dict = {}
+        zero_row: dict = {}       # bucket key -> all-zero pool row
+        leaves: list = []
+        dev_bytes = [0] * ndev
+        for key, per_dev in self.buckets.items():
+            if key not in used_keys:
+                continue
+            pl, w = key
+            # +1 guarantees >= one zero pad page per device: slot
+            # p2-1 is all-zero everywhere, the padding gather target
+            p2 = _pow2(max(len(pages) for pages in per_dev) + 1)
+            pieces = []
+            for d in range(ndev):
+                blocks = [jax.device_put(p, devs[d])
+                          for p in per_dev[d]]
+                dev_bytes[d] += len(blocks) * pl * w * 4
+                if len(blocks) < p2:
+                    z = jax.device_put(
+                        np.zeros((pl, w), dtype=np.uint32), devs[d])
+                    blocks.extend([z] * (p2 - len(blocks)))
+                pieces.append(jnp.stack(blocks)[None])
+            glob = jax.make_array_from_single_device_arrays(
+                (ndev, p2, pl, w), NamedSharding(smesh, P("dev")),
+                pieces)
+            bucket_id[key] = len(bucket_meta)
+            bucket_meta.append((p2, pl, w))
+            zero_row[key] = (p2 - 1) * pl
+            leaves.append(glob)
+        # -- per-group geometry: each device's owned shard positions,
+        # padded to a common pow2 local width
+        geo: dict = {}
+
+        def _geometry(gidx):
+            g = geo.get(gidx)
+            if g is None:
+                owners = self.group_owners[gidx]
+                if owners is None:
+                    raise RaggedUnbuildable("mesh group w/o owners")
+                s = int(owners.shape[0])
+                owned = [np.flatnonzero(owners == d)
+                         for d in range(ndev)]
+                s_p = _pow2(max([o.size for o in owned] + [1]))
+                sel = np.full((ndev, s_p), s, dtype=np.int64)
+                for d in range(ndev):
+                    sel[d, :owned[d].size] = owned[d]
+                geo[gidx] = g = (s, s_p, sel)
+            return g
+
+        # -- virtual leaves: one per-device gather param each.  The
+        # local leaf keeps the global lead shape with the shard axis
+        # compressed to s_p; the gather grid extends the shard axis
+        # by one sentinel slab pointing at the zero pool row.
+        vmeta: list = []
+        for vi in vkeep:
+            key, pool_row, lane_dev, n, shape, sa, gidx = \
+                self.vleaves[vi]
+            s, s_p, sel = _geometry(gidx)
+            lead = shape[:-1]
+            if lead[sa] != s:
+                raise RaggedUnbuildable("leaf shard axis mismatch")
+            grid = np.arange(n, dtype=np.int64).reshape(lead)
+            pad_shape = list(lead)
+            pad_shape[sa] = 1
+            ext = np.concatenate(
+                [grid, np.full(pad_shape, n, dtype=np.int64)],
+                axis=sa)
+            row_ext = np.concatenate(
+                [pool_row, np.array([zero_row[key]], np.int64)])
+            dev_ext = np.concatenate(
+                [lane_dev, np.array([-1], np.int32)])
+            gat = []
+            for d in range(ndev):
+                flat = np.take(ext, sel[d], axis=sa).reshape(-1)
+                fd = dev_ext[flat]
+                if np.any((fd != d) & (fd != -1)):
+                    raise RaggedUnbuildable("placement drift: lane "
+                                            "owner != group owner")
+                gat.append(row_ext[flat])
+            gi = self._add_mesh_param(np.stack(gat))
+            lshape = list(lead)
+            lshape[sa] = s_p
+            vmeta.append((bucket_id[key], gi,
+                          tuple(lshape) + (shape[-1],)))
+        # -- subs + per-sub combine specs.  spos params (local shard
+        # position -> global shard index, padding -> the S dump row)
+        # are per group and shared by every scatter sub of the group.
+        spos_param: dict = {}
+
+        def _spos(gidx):
+            p = spos_param.get(gidx)
+            if p is None:
+                _s, _sp, sel = _geometry(gidx)
+                p = spos_param[gidx] = self._add_mesh_param(sel)
+            return p
+
+        subs: list = []
+        combines: list = []
+        served: list = []
+        table: dict = {}
+        sub_ix: dict = {}
+        for ent, lmap, poff, gidx in plain:
+            # no direct leaves in mesh mode (add_group rejects them)
+            final = {li: vre.get(i) for li, (_t, i) in lmap.items()}
+            riders, sub, demux, slot_key = ent
+            rsub = _remap_sub(sub, final, poff)
+            if rsub[0] == "gb_hist":
+                # pallas arms can't lower inside the shard_map body;
+                # the XLA arm is the same math, bit-exact
+                rsub = rsub[:6] + ("xla",)
+            i = sub_ix.get(rsub)
+            if i is None:
+                s, _sp, _sel = _geometry(gidx)
+                k = rsub[0]
+                if k == "count":
+                    comb = (("psum",) if rsub[2]
+                            else ("scatter", _spos(gidx), s, 0))
+                elif k == "words":
+                    comb = ("scatter", _spos(gidx), s, 0)
+                elif k == "bsi_sum":
+                    comb = (("psum",) if rsub[3]
+                            else ("scatter3", _spos(gidx), s))
+                elif k == "row_counts":
+                    comb = (("psum",) if rsub[3]
+                            else ("scatter", _spos(gidx), s, 1))
+                elif k == "gb_hist":
+                    comb = ("psum",)
+                else:
+                    raise RaggedUnbuildable(f"unmeshable sub {k}")
+                subs.append(rsub)
+                combines.append(comb)
+                i = sub_ix[rsub] = len(subs) - 1
+            if slot_key is not None:
+                table[slot_key] = (demux, ("plain", i))
+            for r in riders:
+                served.append((r, demux, ("plain", i)))
+        # -- segment families: per-device lane/segment id arrays over
+        # the device pools; padding points at the zero row + the dump
+        # segment, partial per-segment counts psum to the exact total
+        for vkey, members in families.items():
+            slot_of: dict[int, int] = {}
+            uniq: list = []
+            member_slots: list = []
+            for ent, v in members:
+                slt = slot_of.get(id(v[1]))
+                if slt is None:
+                    slt = slot_of[id(v[1])] = len(uniq)
+                    uniq.append((v[1], v[2]))
+                member_slots.append((ent, slt))
+            nseg = len(uniq)
+            npad_seg = _pow2(nseg + 1)   # +1 dump slot for padding
+            per_rows = [[] for _ in range(ndev)]
+            per_segs = [[] for _ in range(ndev)]
+            for slt, (pool_row, lane_dev) in enumerate(uniq):
+                for d in range(ndev):
+                    m = lane_dev == d
+                    per_rows[d].append(pool_row[m])
+                    per_segs[d].append(
+                        np.full(int(m.sum()), slt, dtype=np.int32))
+            lens = [int(sum(a.size for a in per_rows[d]))
+                    for d in range(ndev)]
+            lpad = _pow2(max(lens + [1]))
+            rows = np.full((ndev, lpad), zero_row[vkey],
+                           dtype=np.int64)
+            segs = np.full((ndev, lpad), nseg, dtype=np.int32)
+            for d in range(ndev):
+                if lens[d]:
+                    rows[d, :lens[d]] = np.concatenate(per_rows[d])
+                    segs[d, :lens[d]] = np.concatenate(per_segs[d])
+            gi = self._add_mesh_param(rows)
+            si = self._add_mesh_param(segs)
+            subs.append(("segcount", bucket_id[vkey], gi, si,
+                         npad_seg))
+            combines.append(("psum",))
+            for ent, slt in member_slots:
+                riders, _sub, demux, slot_key = ent
+                if slot_key is not None:
+                    table[slot_key] = (demux,
+                                       ("seg", len(subs) - 1, slt))
+                for r in riders:
+                    served.append((r, demux,
+                                   ("seg", len(subs) - 1, slt)))
+        if not subs:
+            return None
+        plan = ("ragged_mesh", ndev, placement.epoch(), n_base,
+                tuple(bucket_meta), tuple(vmeta), tuple(subs),
+                tuple(combines))
+        meshinfo = {"ndev": ndev, "dev_bytes": dev_bytes,
+                    "dev_pages": [dict(m) for m in self.dev_mix]}
+        return plan, leaves, self.params, served, table, meshinfo
 
 
 # ---------------------------------------------------------------------------
@@ -514,6 +804,41 @@ class CanonicalComposition:
 # batch execution (called by ServingLayer._run_batch on the leader)
 # ---------------------------------------------------------------------------
 
+def _mesh_width(eng) -> int:
+    """Serving-mesh width for the fused program: > 1 only when the
+    serving mesh (memory/placement.py) is configured AND the engine
+    runs the plain paged placement — the legacy GSPMD mesh and
+    host_only keep whole-array entries, so there is no page table to
+    walk per device."""
+    from pilosa_tpu import memory as _mem
+    from pilosa_tpu.memory import placement
+    if eng.mesh is not None or eng.host_only \
+            or not _mem.paged_enabled():
+        return 1
+    return placement.mesh_devices()
+
+
+def _note_roofline(plan, leaves, dt, meshinfo, served) -> None:
+    """Per-dispatch bandwidth attribution for the fused ragged
+    program: the aggregate 'ragged' op family plus — under the mesh —
+    a per-device series (each chip's resident pool bytes over the
+    same program wall time) and the per-device page-encoding mix on
+    every rider's flight record."""
+    from pilosa_tpu.obs import roofline
+    nbytes = sum(int(getattr(a, "nbytes", 0)) for a in leaves)
+    roofline.note("ragged", nbytes, dt)
+    if not meshinfo:
+        return
+    for d, b in enumerate(meshinfo.get("dev_bytes", ())):
+        roofline.note("ragged", b, dt, device=d)
+    mix = {f"d{d}:{k}": v
+           for d, m in enumerate(meshinfo.get("dev_pages", ()))
+           for k, v in m.items()}
+    if mix:
+        for r, _d, _e in served:
+            r.acc.add_pages(mix)
+
+
 def run_ragged(layer, groups: dict) -> None:
     """Plan, dispatch, and demux EVERY group of the batch through the
     ONE canonical fused program.  Mirrors the per-group leader
@@ -548,6 +873,21 @@ def run_ragged(layer, groups: dict) -> None:
         if cached is not None and (cached[0] != fp
                                    or cached[1] != epoch):
             cached = None
+        if cached is not None and cached[2] is not None \
+                and cached[2][0] == "ragged_mesh":
+            # mesh plans pin topology + placement epoch at build
+            # time: a rebalance or mesh resize must rebuild, never
+            # replay pools addressed by a dead placement
+            from pilosa_tpu.memory import placement as _pl
+            if (cached[2][1] != _mesh_width(eng)
+                    or cached[2][2] != _pl.epoch()):
+                cached = None
+                canon.cached = None
+        elif cached is not None and cached[2] is not None \
+                and _mesh_width(eng) > 1:
+            # single-device plan cached before the mesh came up
+            cached = None
+            canon.cached = None
     if cached is not None:
         _serve_cached(layer, eng, cached, by_key, len(groups))
     else:
@@ -594,13 +934,17 @@ def _plan_and_dispatch(layer, eng, work, n_groups: int,
     [(slot, riders), ...]), ...] in stable order — dispatch it, and
     demux every rider.  `canon` given: a build failure evicts the
     slot from the canonical set, and a successful build returns the
-    (plan, leaves, params, table, consts) payload for the
+    (plan, leaves, params, table, consts, meshinfo) payload for the
     cross-batch program cache (None otherwise)."""
-    prog = RaggedProgram()
+    from pilosa_tpu.memory import placement as _placement
+    ndev = _mesh_width(eng)
+    prog = RaggedProgram(ndev=ndev)
     dead_keys: list = []
     consts: dict = {}
     for idx, skey, pairs in work:
         shards = list(skey)
+        owners = (_placement.owners(idx.name, shards)
+                  if ndev > 1 else None)
         b = PlanBuilder(eng, idx, shards, {})
         entries = []
         for slot, riders in pairs:
@@ -644,29 +988,59 @@ def _plan_and_dispatch(layer, eng, work, n_groups: int,
                 continue
             entries.append((riders, built[0], built[1], slot_key))
         if entries:
-            prog.add_group(b, entries)
+            try:
+                prog.add_group(b, entries, owners=owners)
+            except RaggedUnbuildable:
+                # the group can't enter the mesh program (whole/host
+                # operand, unplaced pages): its riders degrade to the
+                # solo path, everything else stays fused
+                for riders, _s, _d, slot_key in entries:
+                    for r in riders:
+                        r.direct = True
+                    if slot_key is not None:
+                        dead_keys.append(slot_key)
     if canon is not None and dead_keys:
         canon.drop(dead_keys)
     cacheable = canon is not None and not dead_keys
-    fin = prog.finalize()
+    try:
+        fin = prog.finalize()
+    except RaggedUnbuildable as e:
+        # finalize-time mesh rejection (placement drift, topology
+        # shrink): every rider of the batch degrades, no error
+        capture_exception(e, where="serving.ragged_finalize")
+        for _idx, _skey, pairs in work:
+            for _slot, riders in pairs:
+                for r in riders:
+                    r.direct = True
+        if canon is not None:
+            canon.drop([slot_key for _i, _s, pairs in work
+                        for slot, _r in pairs
+                        for slot_key in [(id(slot.idx), slot.skey,
+                                          slot.kind,
+                                          repr(slot.call))]])
+        return None
     if fin is None:
         # a program of constants alone is still cacheable
-        return ((None, None, None, {}, consts)
+        return ((None, None, None, {}, consts, None)
                 if cacheable and consts else None)
-    plan, leaves, params, served, table = fin
-    payload = ((plan, leaves, params, table, consts)
+    plan, leaves, params, served, table, meshinfo = fin
+    payload = ((plan, leaves, params, table, consts, meshinfo)
                if cacheable else None)
     if not served:
         # no rider this batch — skip the dispatch but keep the built
         # program for the cache (the next batch serves from it)
         return payload
-    kern = kernels.enabled() and not eng.host_only
+    kern = (kernels.enabled() and not eng.host_only
+            and plan[0] != "ragged_mesh")
     sig = (repr(plan), kern)
     kind = _dispatch_kind(sig, leaves, params)
+    nsubs = len(plan[3]) if plan[0] == "ragged" else len(plan[6])
     sp = Span("serving.dispatch")
-    sp.tags.update(batch=len(served), subqueries=len(plan[3]),
+    sp.tags.update(batch=len(served), subqueries=nsubs,
                    ragged=True, program=program, groups=n_groups,
+                   mesh=plan[0] == "ragged_mesh",
                    compile=kind == "compile")
+    oom0 = metrics.OOM_TOTAL.total(outcome="caught")
     t0 = time.perf_counter()
     try:
         # same chaos seam + OOM backstop as the per-group dispatch
@@ -685,8 +1059,12 @@ def _plan_and_dispatch(layer, eng, work, n_groups: int,
         return
     finally:
         sp.finish()
-    metrics.SERVING_DISPATCH.inc(kind="ragged")
+    metrics.SERVING_DISPATCH.inc(
+        kind="ragged_mesh" if plan[0] == "ragged_mesh" else "ragged")
     dt = time.perf_counter() - t0
+    if kind == "execute" and \
+            metrics.OOM_TOTAL.total(outcome="caught") == oom0:
+        _note_roofline(plan, leaves, dt, meshinfo, served)
     for r, _d, _e in served:
         r.acc.add_phase(kind, dt)
         if r.ctx is not None:
@@ -711,7 +1089,8 @@ def _serve_cached(layer, eng, cached, by_key, n_groups: int) -> None:
     to its slot's demux/extract, run the ONE cached fused program,
     demux.  Keys the cache doesn't know stay in `by_key` for the
     extras program."""
-    _fp, _epoch, plan, leaves, params, table, consts = cached
+    _fp, _epoch, plan, leaves, params, table, consts, meshinfo = \
+        cached
     served: list = []
     for key in list(by_key):
         if key in consts:
@@ -725,13 +1104,17 @@ def _serve_cached(layer, eng, cached, by_key, n_groups: int) -> None:
                 served.append((r, demux, ext))
     if not served or plan is None:
         return
-    kern = kernels.enabled() and not eng.host_only
+    kern = (kernels.enabled() and not eng.host_only
+            and plan[0] != "ragged_mesh")
     sig = (repr(plan), kern)
     kind = _dispatch_kind(sig, leaves, params)
+    nsubs = len(plan[3]) if plan[0] == "ragged" else len(plan[6])
     sp = Span("serving.dispatch")
-    sp.tags.update(batch=len(served), subqueries=len(plan[3]),
+    sp.tags.update(batch=len(served), subqueries=nsubs,
                    ragged=True, program="canonical-cached",
-                   groups=n_groups, compile=kind == "compile")
+                   groups=n_groups, mesh=plan[0] == "ragged_mesh",
+                   compile=kind == "compile")
+    oom0 = metrics.OOM_TOTAL.total(outcome="caught")
     t0 = time.perf_counter()
     try:
         from pilosa_tpu.obs import faults
@@ -749,8 +1132,12 @@ def _serve_cached(layer, eng, cached, by_key, n_groups: int) -> None:
         return
     finally:
         sp.finish()
-    metrics.SERVING_DISPATCH.inc(kind="ragged")
+    metrics.SERVING_DISPATCH.inc(
+        kind="ragged_mesh" if plan[0] == "ragged_mesh" else "ragged")
     dt = time.perf_counter() - t0
+    if kind == "execute" and \
+            metrics.OOM_TOTAL.total(outcome="caught") == oom0:
+        _note_roofline(plan, leaves, dt, meshinfo, served)
     for r, _d, _e in served:
         r.acc.add_phase(kind, dt)
         if r.ctx is not None:

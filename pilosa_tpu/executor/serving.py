@@ -1277,12 +1277,16 @@ class ServingLayer:
         bits, shifts, n_codes = _code_space(fields_rows)
         codes = _combo_codes(shifts, combos)
         arm = _onepass_arm(n_codes, depth)
-        if eng._n_total_devices() > 1 and arm != "xla":
-            # mirror the solo path's mesh guard: a pallas_call over
-            # mesh-sharded leaves inside the fused multi program would
-            # force a gather (or fail to lower and demote every rider
-            # in the batch); the scatter reference shards under GSPMD
-            arm = "xla"
+        if arm != "xla":
+            from pilosa_tpu.memory import placement as _placement
+            if (eng._n_total_devices() > 1
+                    or _placement.mesh_devices() > 1):
+                # mirror the solo path's mesh guard: a pallas_call
+                # over mesh-sharded leaves inside the fused multi (or
+                # shard_map ragged_mesh) program would force a gather
+                # (or fail to lower and demote every rider in the
+                # batch); the scatter reference shards under GSPMD
+                arm = "xla"
         signed = False
         if agg_field is not None:
             frags = eng._frags(idx, agg_field, agg_field.bsi_view,

@@ -140,16 +140,30 @@ class PageView:
     sparse device format carry a MIX of dense arrays and
     memory/encode.py EncodedPage payloads — consumers with no packed
     arm take ``dense_pages()`` (the per-page decode-to-dense
-    boundary, bit-exact by construction)."""
+    boundary, bit-exact by construction).
 
-    __slots__ = ("shape", "lanes", "page_lanes", "pages")
+    Under the serving mesh (memory/placement.py) the view also
+    carries the entry's device layout: ``page_device[pi]`` the page's
+    owner slot, ``lane_page``/``lane_slot`` the lane -> (page, row)
+    map, ``shard_axis`` which leading axis the placement partitioned —
+    everything the mesh ragged program needs to build per-device
+    pools and local gathers.  All None on the single-device layout
+    (page order IS lane order)."""
+
+    __slots__ = ("shape", "lanes", "page_lanes", "pages",
+                 "page_device", "lane_page", "lane_slot", "shard_axis")
 
     def __init__(self, shape: tuple, lanes: int, page_lanes: int,
-                 pages: list):
+                 pages: list, page_device=None, lane_page=None,
+                 lane_slot=None, shard_axis=None):
         self.shape = tuple(shape)
         self.lanes = int(lanes)
         self.page_lanes = int(page_lanes)
         self.pages = list(pages)
+        self.page_device = page_device
+        self.lane_page = lane_page
+        self.lane_slot = lane_slot
+        self.shard_axis = shard_axis
 
     @property
     def width_words(self) -> int:
@@ -169,9 +183,36 @@ def _expand_view(view: PageView):
     non-raw fetch path would have returned — the whole-operand decode
     boundary for plans with no packed arm."""
     pages = view.dense_pages()
+    if view.lane_page is not None:
+        return _assemble_permuted(pages, view.lane_page,
+                                  view.lane_slot, view.page_lanes,
+                                  view.shape)
     if len(pages) == 1 and view.lanes == view.page_lanes:
         return pages[0].reshape(view.shape)
     return bm.assemble_pages(tuple(pages), view.shape)
+
+
+def _assemble_permuted(pages, lane_page, lane_slot, page_lanes,
+                       shape):
+    """Single-array assembly of DEVICE-PARTITIONED pages: pull every
+    page to one device (the correct-but-slower fallback for consumers
+    outside the mesh program), concatenate, and undo the placement
+    permutation (lane -> page row)."""
+    import jax
+    d0 = jax.devices()[0]
+    pulled = tuple(jax.device_put(p, d0) for p in pages)
+    inv = (lane_page.astype(np.int32) * np.int32(page_lanes)
+           + lane_slot.astype(np.int32))
+    cat = jnp.concatenate(pulled, axis=0)
+    return cat[jnp.asarray(inv)].reshape(shape)
+
+
+def _same_lane_device(a, b) -> bool:
+    """Structural placement compare for PagedStack reuse (None =
+    single-device layout)."""
+    if a is None or b is None:
+        return a is None and b is None
+    return np.array_equal(a, b)
 
 
 def _page_mix(pages) -> dict:
@@ -410,7 +451,7 @@ class TileStackCache:
             if old is not None:
                 self._bytes -= old[2]
         if old is not None and old[2]:
-            self._client.release(old[2])
+            self._release_entry(old[1], old[2])
         cap = self._budget_cap()
         if nbytes > cap:
             # an entry that alone exceeds the budget is never cached
@@ -424,13 +465,12 @@ class TileStackCache:
         if not self._client.reserve(nbytes):
             metrics.STACK_CACHE.inc(outcome="denied")
             return arr, outcome, moved
-        released = 0
         with self._lock:
             self._entries[key] = (versions, arr, nbytes, time.time())
             self._bytes += nbytes
-            released = self._enforce_local_cap_locked()
-        if released:
-            self._client.release(released)
+            shed, shed_map = self._enforce_local_cap_locked()
+        if shed:
+            self._release_freed(shed, shed_map)
         return arr, outcome, moved
 
     # -- paged path (single-device placements) --------------------------
@@ -444,7 +484,9 @@ class TileStackCache:
         old_versions = None
         if stale is not None and isinstance(stale[1], PagedStack):
             cand = stale[1]
-            if cand.shape == shape and cand.page_lanes == pl:
+            if (cand.shape == shape and cand.page_lanes == pl
+                    and _same_lane_device(cand.lane_device,
+                                          recipe.lane_device)):
                 ps, old_versions = cand, stale[0]
         if ps is None and stale is not None:
             # structural change or whole→paged transition: drop the
@@ -455,7 +497,7 @@ class TileStackCache:
                     self._entries.pop(key)
                     self._bytes -= stale[2]
             if stale[2]:
-                self._client.release(stale[2])
+                self._release_entry(stale[1], stale[2])
         patched_b = 0
         rebuilt_b = 0
         # local page map: every page array this access touches, so the
@@ -474,18 +516,21 @@ class TileStackCache:
         if ps is None or dirty is None:
             if ps is not None:
                 self._drop_pages(key, ps)
-            ps = PagedStack(shape, pl, weight=recipe.weight)
+            ps = PagedStack(shape, pl, weight=recipe.weight,
+                            lane_device=recipe.lane_device,
+                            shard_axis=recipe.shard_axis)
             host = np.asarray(recipe.build_host(),
                               dtype=np.uint32).reshape(-1, w)
             retained = 0
             for pi in range(ps.n_pages):
-                lo, hi = ps.lane_range(pi)
-                block = host[lo:hi]
+                ids = ps.page_lane_ids(pi)
+                block = host[ids]
                 if block.shape[0] < pl:
                     block = np.concatenate(
                         [block, np.zeros((pl - block.shape[0], w),
                                          np.uint32)])
-                local[pi] = self._commit_page(block, key)
+                local[pi] = self._commit_page(
+                    block, key, device=self._page_jdev(ps, pi))
                 # true encoded page bytes — both for the admission
                 # cap and the maintenance-traffic attribution (a
                 # packed page uploads its coordinates, not the dense
@@ -509,13 +554,15 @@ class TileStackCache:
                         local[pi] = p
             by_page: dict[int, dict] = {}
             for lane, runs in dirty.items():
-                by_page.setdefault(lane // pl, {})[lane] = runs
+                by_page.setdefault(ps.page_of(lane)[0],
+                                   {})[lane] = runs
             fresh: set[int] = set()
             retained = ps.resident_bytes()
             for pi in range(ps.n_pages):
                 if pi not in local:
                     block = ps.build_page_host(pi, recipe.lane_words)
-                    local[pi] = self._commit_page(block, key)
+                    local[pi] = self._commit_page(
+                        block, key, device=self._page_jdev(ps, pi))
                     nb_pi = encode.page_nbytes(local[pi])
                     if (retained + nb_pi <= resident_cap
                             and self._page_install(key, ps, pi,
@@ -553,7 +600,7 @@ class TileStackCache:
                 if rebuilt_b:
                     metrics.STACK_MAINT_BYTES.inc(rebuilt_b,
                                                   kind="rebuilt")
-        released = 0
+        repl = None
         with self._lock:
             old = self._entries.get(key)
             old_nb = old[2] if old is not None and old[1] is ps else 0
@@ -562,14 +609,16 @@ class TileStackCache:
                 # unless versions raced; replace it
                 self._entries.pop(key)
                 self._bytes -= old[2]
-                released += old[2]
+                repl = (old[1], old[2])
             nb = ps.resident_bytes()
             self._entries[key] = (versions, ps, nb, time.time())
             self._entries.move_to_end(key)
             self._bytes += nb - old_nb
-            released += self._enforce_local_cap_locked()
-        if released:
-            self._client.release(released)
+            shed, shed_map = self._enforce_local_cap_locked()
+        if repl is not None:
+            self._release_entry(*repl)
+        if shed:
+            self._release_freed(shed, shed_map)
         arrs = [local[i] for i in range(ps.n_pages)]
         return (self._assemble(ps, arrs), outcome,
                 patched_b + rebuilt_b)
@@ -583,12 +632,55 @@ class TileStackCache:
         except Exception:
             return None
 
-    def _commit_block(self, block: np.ndarray):
+    def _commit_block(self, block: np.ndarray, device=None):
         """Host page block → device, degrading to the host array when
         even a single page can't be allocated (the OOM backstop then
-        re-executes on the CPU backend)."""
+        re-executes on the CPU backend).  ``device`` commits the page
+        to its placement owner (serving mesh)."""
+        if device is not None:
+            return pressure.guarded(
+                lambda: jax.device_put(block, device),
+                host_fallback=lambda: block)
         return pressure.guarded(lambda: jnp.asarray(block),
                                 host_fallback=lambda: block)
+
+    @staticmethod
+    def _page_jdev(ps: PagedStack, pi: int):
+        """The jax device a page commits to (None = default)."""
+        slot = ps.device_of(pi)
+        if slot is None:
+            return None
+        from pilosa_tpu.memory import placement
+        return placement.device_of(slot)
+
+    def _release_freed(self, freed: int, dev_map: dict):
+        """Release shed bytes to the ledger with their device labels
+        (dev_map: slot -> labeled bytes; the remainder was whole-entry
+        / unlabeled)."""
+        labeled = 0
+        for slot, nb in dev_map.items():
+            if nb > 0:
+                self._client.release(nb, device=slot)
+                labeled += nb
+        rest = freed - labeled
+        if rest > 0:
+            self._client.release(rest)
+
+    def _release_entry(self, payload, nbytes: int):
+        """Release one replaced/dropped entry's accounted bytes,
+        per-device when the payload is a device-partitioned stack."""
+        if (isinstance(payload, PagedStack)
+                and payload.page_device is not None):
+            labeled = 0
+            for slot, nb in payload.device_resident_bytes().items():
+                if slot >= 0 and nb > 0:
+                    self._client.release(nb, device=slot)
+                    labeled += nb
+            rest = nbytes - labeled
+            if rest > 0:
+                self._client.release(rest)
+        elif nbytes:
+            self._client.release(nbytes)
 
     @staticmethod
     def _stats_ident(key):
@@ -601,7 +693,7 @@ class TileStackCache:
         return None
 
     def _commit_page(self, block: np.ndarray, key, prev=None,
-                     reason: str = "build"):
+                     reason: str = "build", device=None):
         """Encode-or-dense commit of one host page block
         (memory/encode.py): the container-adaptive arm of
         _commit_block.  ``prev`` is the page's current payload
@@ -633,16 +725,17 @@ class TileStackCache:
                     stats.note_page_encoding(ident[0], ident[1],
                                              enc.kind)
         if enc is None:
-            return self._commit_block(block)
-        return pressure.guarded(enc.to_device,
+            return self._commit_block(block, device=device)
+        return pressure.guarded(lambda: enc.to_device(device),
                                 host_fallback=lambda: enc)
 
     def _page_install(self, key, ps: PagedStack, pi: int, arr) -> bool:
         """Retain one built page iff the ledger admits it (at the
-        page's TRUE encoded byte size); denied pages serve this
-        access transiently and rebuild next time."""
+        page's TRUE encoded byte size, against the owning device's
+        budget share when placed); denied pages serve this access
+        transiently and rebuild next time."""
         nb = encode.page_nbytes(arr)
-        if not self._client.reserve(nb):
+        if not self._client.reserve(nb, device=ps.device_of(pi)):
             metrics.STACK_CACHE.inc(outcome="denied")
             return False
         with self._lock:
@@ -663,35 +756,36 @@ class TileStackCache:
         (memory/encode.py) have no scatter arm: a write to one rebuilds
         the block and re-encodes — the drift path where a filling page
         flips back to dense."""
+        dev = self._page_jdev(ps, pi)
         cur = local.get(pi)
         if cur is not None and encode.is_encoded(cur):
             block = ps.build_page_host(pi, recipe.lane_words)
             arr = self._commit_page(block, key, prev=cur,
-                                    reason="patch")
+                                    reason="patch", device=dev)
             local[pi] = arr
             self._page_replace(key, ps, pi, arr)
             metrics.STACK_PAGES.inc(event="patch",
                                     encoding=encode.page_kind(arr))
             return 0, encode.page_nbytes(arr)
         w = ps.width_words
-        lo0 = pi * ps.page_lanes
         segs = []
         patched_words = 0
         for lane in sorted(lanes_d):
             runs = lanes_d[lane]
             runs = ([(0, w)] if runs is None
                     else _coalesce_runs(runs, w))
+            li = ps.page_of(lane)[1]
             for lo, hi in runs:
                 plen = min(1 << (hi - lo - 1).bit_length(), w)
                 start = min(lo, w - plen)
-                segs.append((lane - lo0, start, plen, lane))
+                segs.append((li, start, plen, lane))
                 patched_words += plen
         if not segs:
             return 0, 0
         if patched_words > _patch_max_frac() * ps.page_lanes * w:
             block = ps.build_page_host(pi, recipe.lane_words)
             arr = self._commit_page(block, key, prev=local.get(pi),
-                                    reason="drift")
+                                    reason="drift", device=dev)
             local[pi] = arr
             self._page_replace(key, ps, pi, arr)
             return 0, encode.page_nbytes(arr)
@@ -745,7 +839,7 @@ class TileStackCache:
                 self._sync_entry_locked(key, ps)
                 release = nb_old
         if release:
-            self._client.release(release)
+            self._client.release(release, device=ps.device_of(pi))
         self._page_install(key, ps, pi, arr)
 
     def _assemble(self, ps: PagedStack, arrs: list):
@@ -758,11 +852,23 @@ class TileStackCache:
             # per-access assemble dispatch is skipped entirely (sparse
             # pages ride along encoded; consumers expand per page or
             # take the packed fast paths)
-            return PageView(ps.shape, ps.lanes, ps.page_lanes, arrs)
+            return PageView(ps.shape, ps.lanes, ps.page_lanes, arrs,
+                            page_device=ps.page_device,
+                            lane_page=ps.lane_page,
+                            lane_slot=ps.lane_slot,
+                            shard_axis=ps.shard_axis)
         if any(encode.is_encoded(a) for a in arrs):
             # decode-to-dense boundary: this consumer needs the full
             # tile operand (no packed arm for arbitrary plan nodes)
             arrs = [encode.to_dense(a) for a in arrs]
+        if ps.page_table is not None:
+            # device-partitioned pages: single-array consumers pull
+            # everything to one device and undo the placement
+            # permutation (correct-but-slower fallback — the mesh
+            # program is the fast path)
+            return _assemble_permuted(arrs, ps.lane_page,
+                                      ps.lane_slot, ps.page_lanes,
+                                      ps.shape)
         if len(arrs) == 1 and ps.lanes == ps.page_lanes:
             return arrs[0].reshape(ps.shape)
         return bm.assemble_pages(tuple(arrs), ps.shape)
@@ -778,10 +884,10 @@ class TileStackCache:
         the ledger governs).  Returns bytes to release to the ledger
         (caller releases outside the lock)."""
         if self.max_bytes is None or self._bytes <= self.max_bytes:
-            return 0
+            return 0, {}
         return self._shed_locked(self._bytes - self.max_bytes)
 
-    def _shed_locked(self, need: int) -> int:
+    def _shed_locked(self, need: int):
         """Evict ~need bytes, ENTRY-concentrated: order entries by
         cost-aware score (memory/policy.py — age / rebuild-weight /
         frequency), then drain the victim's pages coldest-first,
@@ -790,10 +896,13 @@ class TileStackCache:
         across entries would break every operand at once — measured
         pathological); the page-granular STOP is the paged win: the
         marginal entry loses only the bytes pressure demanded, and
-        the next access restores just those pages.  Returns bytes
-        freed; the caller releases them to the ledger."""
+        the next access restores just those pages.  Returns
+        ``(freed_bytes, {device slot: labeled bytes})``; the caller
+        releases them to the ledger (``_release_freed``) so per-device
+        occupancy stays truthful under eviction."""
         from pilosa_tpu.memory import policy
         freed = 0
+        dev_map: dict[int, int] = {}
         now = time.time()
         cands = []
         for k, ent in self._entries.items():
@@ -821,7 +930,11 @@ class TileStackCache:
                 if p is None:
                     continue
                 ps.pages[pi] = None
-                freed += encode.page_nbytes(p)
+                nb_p = encode.page_nbytes(p)
+                freed += nb_p
+                slot = ps.device_of(pi)
+                if slot is not None:
+                    dev_map[slot] = dev_map.get(slot, 0) + nb_p
                 metrics.STACK_PAGES.inc(event="evict",
                                         encoding=encode.page_kind(p))
             self._sync_entry_locked(k, ps)
@@ -831,14 +944,14 @@ class TileStackCache:
                 # long-lived server (pre-paging, byte pressure popped
                 # whole entries and bounded the dict implicitly)
                 self._entries.pop(k, None)
-        return freed
+        return freed, dev_map
 
     def _reclaim(self, need: int) -> int:
         """Ledger reclaim callback (cross-client pressure)."""
         with self._lock:
-            freed = self._shed_locked(int(need))
+            freed, dev_map = self._shed_locked(int(need))
         if freed:
-            self._client.release(freed)
+            self._release_freed(freed, dev_map)
         return freed
 
     def _cold_ts(self) -> float:
@@ -867,14 +980,19 @@ class TileStackCache:
 
     def _drop_pages(self, key, ps: PagedStack):
         freed = 0
+        dev_map: dict[int, int] = {}
         with self._lock:
             for pi, p in enumerate(ps.pages):
                 if p is not None:
                     ps.pages[pi] = None
-                    freed += encode.page_nbytes(p)
+                    nb_p = encode.page_nbytes(p)
+                    freed += nb_p
+                    slot = ps.device_of(pi)
+                    if slot is not None:
+                        dev_map[slot] = dev_map.get(slot, 0) + nb_p
             self._sync_entry_locked(key, ps)
         if freed:
-            self._client.release(freed)
+            self._release_freed(freed, dev_map)
 
     def _note_too_big(self, key, nbytes: int, cap: int):
         with self._lock:
@@ -943,12 +1061,20 @@ class TileStackCache:
         return True
 
     def clear(self):
+        dev_map: dict[int, int] = {}
         with self._lock:
             total = self._bytes
+            for ent in self._entries.values():
+                ps = ent[1]
+                if (isinstance(ps, PagedStack)
+                        and ps.page_device is not None):
+                    for slot, nb in ps.device_resident_bytes().items():
+                        if slot >= 0:
+                            dev_map[slot] = dev_map.get(slot, 0) + nb
             self._entries.clear()
             self._bytes = 0
         if total:
-            self._client.release(total)
+            self._release_freed(total, dev_map)
 
     @property
     def nbytes(self) -> int:
@@ -1447,6 +1573,82 @@ def _plan_run(plan, kern: bool = False):
                     outs.append(r(all_leaves, params))
             return tuple(outs)
         return run
+    if kind == "ragged_mesh":
+        # the mesh-sharded fused program (executor/ragged.py):
+        #   ("ragged_mesh", ndev, placement_epoch, n_base, buckets,
+        #    vmeta, subs, combines)
+        # ONE shard_map program over the serving mesh: each device
+        # gathers virtual leaves out of ITS page pool slice (leaves =
+        # per-bucket (ndev, pool, page_lanes, W) arrays, P("dev")),
+        # evaluates every sub over its owned shards, and the partials
+        # combine INSIDE the program — psum trees for reduced outputs,
+        # dump-row scatter-adds re-assembling per-shard outputs — so
+        # no host ever merges device partials.  Padded local shard
+        # positions read the pool's guaranteed-zero tail page; zero
+        # shards are harmless for every reduction here (the
+        # place_shards invariant).
+        from jax.sharding import PartitionSpec as P
+
+        from pilosa_tpu.memory import placement
+        from pilosa_tpu.parallel.mesh import shard_map_nocheck
+        ndev, _ep, n_base, buckets, vmeta, subs, combines = plan[1:8]
+        smesh = placement.serving_mesh()
+        assert smesh.devices.size == ndev
+        nb = len(buckets)
+        runs = tuple(None if s[0] == "segcount" else _plan_run(s, kern)
+                     for s in subs)
+
+        def _combine(o, comb, prms):
+            if comb[0] == "psum":
+                return jax.lax.psum(o, "dev")
+            if comb[0] == "scatter":
+                _c, gi, s, axis = comb
+                spos = prms[gi]
+                if axis == 0:
+                    z = jnp.zeros((s + 1,) + o.shape[1:], o.dtype)
+                    return jax.lax.psum(z.at[spos].add(o), "dev")[:s]
+                z = jnp.zeros(o.shape[:1] + (s + 1,) + o.shape[2:],
+                              o.dtype)
+                return jax.lax.psum(z.at[:, spos].add(o),
+                                    "dev")[:, :s]
+            _c, gi, s = comb                          # scatter3
+            spos = prms[gi]
+
+            def sc(x):
+                z = jnp.zeros((s + 1,) + x.shape[1:], x.dtype)
+                return jax.lax.psum(z.at[spos].add(x), "dev")[:s]
+            return tuple(sc(x) for x in o)
+
+        def body(*ops):
+            pools = ops[:nb]
+            # mesh params arrive (1, X) per device — strip the axis
+            prms = (tuple(ops[nb:nb + n_base])
+                    + tuple(m[0] for m in ops[nb + n_base:]))
+            flats = [pool.reshape(p2 * pl, w)
+                     for (p2, pl, w), pool in zip(buckets, pools)]
+            vl = tuple(flats[b][prms[gi]].reshape(shape)
+                       for b, gi, shape in vmeta)
+            outs = []
+            for s, r, comb in zip(subs, runs, combines):
+                if r is None:
+                    _k, b, gi, si, nseg = s
+                    o = bm.segment_count(flats[b][prms[gi]],
+                                         prms[si], nseg)
+                else:
+                    o = r(vl, prms)
+                outs.append(_combine(o, comb, prms))
+            return tuple(outs)
+
+        def run(leaves, params):
+            in_specs = ([P("dev")] * nb + [P()] * n_base
+                        + [P("dev")] * (len(params) - n_base))
+            out_specs = tuple((P(), P(), P()) if s[0] == "bsi_sum"
+                              else P() for s in subs)
+            fn = shard_map_nocheck(body, mesh=smesh,
+                                   in_specs=tuple(in_specs),
+                                   out_specs=out_specs)
+            return fn(*leaves, *params)
+        return run
     if kind == "words":
         tree = plan[1]
 
@@ -1696,7 +1898,8 @@ def _block(out):
 # labels behind pilosa_device_bandwidth_{gbps,fraction}{op}
 _ROOF_OPS = {"count": "count", "words": "row", "row_counts": "topn",
              "bsi_sum": "sum", "groupby": "groupby", "multi": "multi",
-             "ragged": "ragged", "row_counts_flat": "topn"}
+             "ragged": "ragged", "ragged_mesh": "ragged",
+             "row_counts_flat": "topn"}
 
 
 def _plan_hbm_bytes(plan, leaves, params) -> int:
@@ -2262,14 +2465,46 @@ class StackedEngine:
     def _pageable(self) -> bool:
         """Paged residency (memory/pages.py) applies to plain
         single-device placements; mesh shardings and host_only numpy
-        stacks keep whole-array entries."""
+        stacks keep whole-array entries.  The SERVING mesh
+        (memory/placement.py) is not ``self.mesh``: it keeps paging
+        on and places pages per device."""
         return self.mesh is None and not self.host_only
+
+    def _mesh_key(self):
+        """Mesh/topology token for stack cache keys: the GSPMD mesh
+        identity plus — when the serving mesh is on — its width and
+        the placement epoch, so a device-count flip or rebalance can
+        never false-hit a stack laid out for another topology."""
+        from pilosa_tpu.memory import placement
+        n = placement.mesh_devices() if self._pageable() else 1
+        if n <= 1:
+            return id(self.mesh)
+        return (id(self.mesh), n, placement.epoch())
+
+    def _lane_devices(self, idx, skey, lead, shard_axis: int):
+        """Per-lane serving-mesh owner slots (int32 (lanes,)) for a
+        pageable stack, or None when the mesh is off.  ``shard_axis``
+        is the position of the shard axis inside ``lead``; every
+        other leading axis repeats its shard's owner — all of a
+        shard's lanes colocate on its placement device."""
+        from pilosa_tpu.memory import placement
+        if not self._pageable() or placement.mesh_devices() <= 1:
+            return None
+        owners = placement.owners(idx.name, skey)
+        inner = 1
+        for d in lead[shard_axis + 1:]:
+            inner *= int(d)
+        outer = 1
+        for d in lead[:shard_axis]:
+            outer *= int(d)
+        return np.tile(np.repeat(owners, inner), outer)
 
     def _cached_stack(self, key, versions, build, *, frags, lanes,
                       logical_lead, lane_words, width_words,
                       build_host=None, versions_fn=None,
                       weight: float = 1.0, pageable: bool = True,
-                      alive_fn=None):
+                      alive_fn=None, lane_device=None,
+                      shard_axis: int | None = None):
         """Shared fetch path for every stack builder: wires the
         whole-entry patcher and, on pageable placements, the paged
         StackRecipe (page-granular eviction/patching + prefetch).
@@ -2308,7 +2543,9 @@ class StackedEngine:
                 versions_fn=versions_fn,
                 deltas_fn=deltas_fn,
                 weight=weight,
-                alive_fn=alive_fn)
+                alive_fn=alive_fn,
+                lane_device=lane_device,
+                shard_axis=shard_axis)
         return self.cache.get(key, versions, build, patcher, recipe)
 
     def row_stack(self, idx, field, views: tuple[str, ...], row_id: int,
@@ -2317,7 +2554,7 @@ class StackedEngine:
         shards = list(skey)
         width = idx.width
         key = ("row", idx.name, field.name, views, row_id, skey,
-               id(self.mesh))
+               self._mesh_key())
         per_view = [self._frags(idx, field, vn, shards) for vn in views]
 
         def versions_fn():
@@ -2351,7 +2588,10 @@ class StackedEngine:
             logical_lead=(len(shards),), lane_words=lane_words,
             width_words=width // 32, build_host=build_host,
             versions_fn=versions_fn,
-            alive_fn=lambda: idx.fields.get(field.name) is field)
+            alive_fn=lambda: idx.fields.get(field.name) is field,
+            lane_device=self._lane_devices(idx, skey,
+                                           (len(shards),), 0),
+            shard_axis=0)
 
     def _plane_lanes(self, frags, n_shards: int, depth: int, width: int):
         """(lanes, lane_words) for an (S, 2+depth, W) plane stack:
@@ -2373,7 +2613,8 @@ class StackedEngine:
         shards = list(skey)
         depth = field.bit_depth
         width = idx.width
-        key = ("planes", idx.name, field.name, depth, skey, id(self.mesh))
+        key = ("planes", idx.name, field.name, depth, skey,
+               self._mesh_key())
         frags = self._frags(idx, field, field.bsi_view, shards)
         versions = self._versions(frags)
 
@@ -2395,7 +2636,10 @@ class StackedEngine:
             lane_words=lane_words, width_words=width // 32,
             build_host=build_host,
             versions_fn=lambda: self._versions(frags),
-            alive_fn=lambda: idx.fields.get(field.name) is field)
+            alive_fn=lambda: idx.fields.get(field.name) is field,
+            lane_device=self._lane_devices(
+                idx, skey, (len(shards), 2 + depth), 0),
+            shard_axis=0)
 
     def existence_stack(self, idx, skey: tuple):
         from pilosa_tpu.models.index import EXISTENCE_FIELD
@@ -2492,6 +2736,12 @@ class StackedEngine:
             bits = p.page_lanes * p.width_words * 32
             sig.append(bits)
             off += bits
+        # device-partitioned pages permute lanes into page order; the
+        # flat offsets are then PERMUTED coordinates — still a valid
+        # bijection for set algebra, but only between leaves sharing
+        # the exact same permutation, so it joins the signature
+        if leaf.lane_page is not None:
+            sig.append(leaf.lane_page.tobytes())
         # per-page positions are sorted and page offsets ascend, so
         # the concatenation is globally sorted unique; single-page
         # leaves hand back the cached array itself (never mutated)
@@ -2756,7 +3006,12 @@ class StackedEngine:
             else:
                 parts.append(np.bitwise_count(np.asarray(p))
                              .sum(axis=1, dtype=np.int64))
-        out = np.concatenate(parts)[: r * s].reshape(r, s).sum(axis=1)
+        flat = np.concatenate(parts)
+        if view.lane_page is not None:
+            # undo the placement permutation: lane -> page row
+            flat = flat[view.lane_page.astype(np.int64)
+                        * view.page_lanes + view.lane_slot]
+        out = flat[: r * s].reshape(r, s).sum(axis=1)
         dt = time.perf_counter() - t0
         flight.note_phase("execute", dt)
         roofline.note("topn", enc_bytes, dt)
@@ -2848,7 +3103,7 @@ class StackedEngine:
         shards = list(skey)
         fkey = tuple((f.name, tuple(int(r) for r in rl))
                      for f, rl in fields_rows)
-        key = ("groupcodes", idx.name, fkey, skey, id(self.mesh),
+        key = ("groupcodes", idx.name, fkey, skey, self._mesh_key(),
                flat, as_np)
         per_field = [self._frags(idx, f, VIEW_STANDARD, shards)
                      for f, _ in fields_rows]
@@ -2945,7 +3200,11 @@ class StackedEngine:
                                       for v in self._versions(fr)),
             weight=4.0, pageable=not (flat or as_np),
             alive_fn=lambda: all(idx.fields.get(f.name) is f
-                                 for f, _ in fields_rows))
+                                 for f, _ in fields_rows),
+            lane_device=(None if (flat or as_np)
+                         else self._lane_devices(
+                             idx, skey, (len(shards), cb + 1), 0)),
+            shard_axis=0)
 
     def plane_stack_np(self, idx, field, skey: tuple):
         """Host numpy twin of plane_stack for the native histogram
@@ -3630,7 +3889,7 @@ class StackedEngine:
         shards = list(skey)
         row_key = tuple(int(r) for r in row_ids)
         key = ("rowchunk", idx.name, field.name, views, row_key, skey,
-               id(self.mesh))
+               self._mesh_key())
         per_view = [self._frags(idx, field, vn, shards) for vn in views]
         versions = tuple(v for fr in per_view
                          for v in self._versions(fr))
@@ -3681,7 +3940,10 @@ class StackedEngine:
             build_host=build_host,
             versions_fn=lambda: tuple(v for fr in per_view
                                       for v in self._versions(fr)),
-            alive_fn=lambda: idx.fields.get(field.name) is field)
+            alive_fn=lambda: idx.fields.get(field.name) is field,
+            lane_device=self._lane_devices(
+                idx, skey, (len(row_key), len(shards)), 1),
+            shard_axis=1)
 
     # -- flat placements for the mesh GroupBy kernel --------------------
     # The shard_map kernel path shards the SHARD axis over every mesh

@@ -148,13 +148,21 @@ class EncodedPage:
     def bit_count(self) -> int:
         return int(self.lane_counts.sum())
 
-    def to_device(self) -> "EncodedPage":
-        """Move the payload arrays onto the device (in place)."""
+    def to_device(self, device=None) -> "EncodedPage":
+        """Move the payload arrays onto the device (in place).
+        ``device`` commits them to a specific mesh device (the page's
+        placement owner) so ``expand()`` decodes to dense ON that
+        device; None keeps the default-device behavior."""
+        import jax
         import jax.numpy as jnp
-        self.coords = jnp.asarray(self.coords)
+        if device is not None:
+            put = lambda a: jax.device_put(np.asarray(a), device)  # noqa: E731
+        else:
+            put = jnp.asarray
+        self.coords = put(self.coords)
         if self.run_starts is not None:
-            self.run_starts = jnp.asarray(self.run_starts)
-            self.run_lens = jnp.asarray(self.run_lens)
+            self.run_starts = put(self.run_starts)
+            self.run_lens = put(self.run_lens)
         return self
 
     def expand(self):

@@ -22,6 +22,18 @@ including ones that never touch a device.
 Clients are held by WEAK reference: a garbage-collected cache (tests
 construct thousands of Executors) drops out of the accounting with its
 arrays, so the ledger can never leak dead caches or their bytes.
+
+Under the serving mesh (memory/placement.py) the one global pool
+splits into PER-DEVICE budgets: the global budget divides evenly
+across the mesh slots (``device_budget``), reservations carry the
+owning slot (``reserve(..., device=slot)``) and are denied when THAT
+device's labeled total would cross its share — a hot shard cannot
+silently eat a remote chip's HBM.  Reclaim stays a global sweep
+(clients shed coldest-first regardless of device; the per-device cap
+re-checks after each round), device-less reservations (whole-stack
+entries, jit executables, result payloads) stay bounded by the global
+budget only, and ``device_bytes()`` feeds both the placer's balance
+decision and the bench occupancy cells.
 """
 
 from __future__ import annotations
@@ -40,13 +52,14 @@ class Client:
     """One registered device-byte owner.  ``reserve``/``release`` are
     the only mutators; ``bytes`` is the client's accounted total."""
 
-    __slots__ = ("name", "_bytes", "_reclaim_cb", "_cold_ts_cb",
-                 "_ledger", "__weakref__")
+    __slots__ = ("name", "_bytes", "_dev", "_reclaim_cb",
+                 "_cold_ts_cb", "_ledger", "__weakref__")
 
     def __init__(self, name: str, ledger: "Ledger", reclaim_cb=None,
                  cold_ts_cb=None):
         self.name = name
         self._bytes = 0
+        self._dev: dict[int, int] = {}   # mesh slot -> labeled bytes
         self._reclaim_cb = reclaim_cb
         self._cold_ts_cb = cold_ts_cb
         self._ledger = ledger
@@ -55,11 +68,13 @@ class Client:
     def bytes(self) -> int:
         return self._bytes
 
-    def reserve(self, nbytes: int, trigger: str = "reserve") -> bool:
-        return self._ledger.reserve(self, nbytes, trigger=trigger)
+    def reserve(self, nbytes: int, trigger: str = "reserve",
+                device: int | None = None) -> bool:
+        return self._ledger.reserve(self, nbytes, trigger=trigger,
+                                    device=device)
 
-    def release(self, nbytes: int):
-        self._ledger.release(self, nbytes)
+    def release(self, nbytes: int, device: int | None = None):
+        self._ledger.release(self, nbytes, device=device)
 
     def cold_ts(self) -> float:
         """Timestamp of this client's coldest resident entry (0 =
@@ -83,6 +98,10 @@ class Ledger:
         self._budget: int | None = None
         self._clients: list[weakref.ref] = []
         self._lock = threading.Lock()
+        # serving-mesh width (memory/placement.py keeps this current);
+        # 1 = no per-device split, every device check degenerates to
+        # the global one
+        self._n_devices = 1
 
     # -- registration ---------------------------------------------------
 
@@ -161,6 +180,37 @@ class Ledger:
             pass  # CPU backends report no stats — config fallback
         return _FALLBACK_BUDGET
 
+    # -- devices --------------------------------------------------------
+
+    def set_devices(self, n: int):
+        """Serving-mesh width: the global budget splits evenly into
+        per-device shares and device-labeled reservations are checked
+        against their slot's share."""
+        with self._lock:
+            self._n_devices = max(int(n), 1)
+
+    def device_budget(self) -> int:
+        """One mesh slot's byte share of the global budget."""
+        b = self.budget()
+        with self._lock:
+            return b // max(self._n_devices, 1)
+
+    def device_bytes(self, n: int | None = None) -> list[int]:
+        """Device-labeled resident bytes per mesh slot, summed across
+        clients (the placer's balance signal + bench occupancy)."""
+        with self._lock:
+            nd = max(self._n_devices if n is None else int(n), 1)
+            out = [0] * nd
+            for c in self._live_locked():
+                for slot, nb in c._dev.items():
+                    if 0 <= slot < nd:
+                        out[slot] += nb
+            return out
+
+    def _dev_total_locked(self, slot: int) -> int:
+        return sum(c._dev.get(slot, 0)
+                   for c in self._live_locked())
+
     # -- accounting -----------------------------------------------------
 
     @property
@@ -172,25 +222,39 @@ class Ledger:
         return max(self.budget() - self.total_bytes, 0)
 
     def reserve(self, client: Client, nbytes: int,
-                trigger: str = "reserve") -> bool:
+                trigger: str = "reserve",
+                device: int | None = None) -> bool:
         """Account ``nbytes`` to ``client`` iff they fit the budget,
         reclaiming cold bytes across clients first.  False = denied —
-        the caller must not retain the allocation."""
+        the caller must not retain the allocation.  ``device`` labels
+        the bytes with their mesh slot and additionally enforces that
+        slot's per-device share."""
         nbytes = int(nbytes)
         if nbytes <= 0:
             return True
         budget = self.budget()  # resolve before taking the lock
-        if nbytes > budget:
+        with self._lock:
+            nd = self._n_devices
+        dev_budget = budget // nd if (device is not None
+                                      and nd > 1) else None
+        if nbytes > budget or (dev_budget is not None
+                               and nbytes > dev_budget):
             metrics.MEM_DENIED.inc(client=client.name)
             return False
         for attempt in range(_RECLAIM_ATTEMPTS):
             with self._lock:
                 total = sum(c._bytes for c in self._live_locked())
-                if total + nbytes <= budget:
+                need = max(total + nbytes - budget, 0)
+                if need == 0 and dev_budget is not None:
+                    dtot = self._dev_total_locked(device)
+                    need = max(dtot + nbytes - dev_budget, 0)
+                if need == 0:
                     client._bytes += nbytes
+                    if device is not None:
+                        client._dev[device] = (
+                            client._dev.get(device, 0) + nbytes)
                     self._export_locked()
                     return True
-                need = total + nbytes - budget
             freed = self._reclaim(need, requester=client,
                                   trigger=trigger)
             if freed <= 0:
@@ -198,12 +262,19 @@ class Ledger:
         metrics.MEM_DENIED.inc(client=client.name)
         return False
 
-    def release(self, client: Client, nbytes: int):
+    def release(self, client: Client, nbytes: int,
+                device: int | None = None):
         nbytes = int(nbytes)
         if nbytes <= 0:
             return
         with self._lock:
             client._bytes = max(client._bytes - nbytes, 0)
+            if device is not None:
+                left = client._dev.get(device, 0) - nbytes
+                if left > 0:
+                    client._dev[device] = left
+                else:
+                    client._dev.pop(device, None)
             self._export_locked()
 
     # -- reclaim --------------------------------------------------------
@@ -248,7 +319,12 @@ class Ledger:
 
     def _export_locked(self):
         per: dict[str, int] = {}
+        dev: dict[int, int] = {}
         for c in self._live_locked():
             per[c.name] = per.get(c.name, 0) + c._bytes
+            for slot, nb in c._dev.items():
+                dev[slot] = dev.get(slot, 0) + nb
         for name, nb in per.items():
             metrics.MEM_RESIDENT.set(nb, client=name)
+        for slot, nb in dev.items():
+            metrics.MEM_DEVICE_RESIDENT.set(nb, device=f"d{slot}")

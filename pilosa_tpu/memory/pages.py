@@ -64,6 +64,13 @@ class StackRecipe:
     - ``alive_fn()``: False once the fields this recipe captured were
       dropped/recreated — the prefetcher must not rebuild (and
       budget-reserve) stacks no live query can ever hit
+    - ``lane_device``: serving-mesh owner slot per lane (int32
+      (lanes,), from memory/placement.py) or None for the single-
+      device layout — gives the PagedStack its device axis
+    - ``shard_axis``: which leading axis of ``logical_lead`` indexes
+      the group's shards (the axis ``lane_device`` varies along) —
+      the mesh program needs it to rebuild per-device local leaves
+      with the shard axis compressed to the device's owned shards
     """
 
     logical_lead: tuple
@@ -74,6 +81,8 @@ class StackRecipe:
     deltas_fn: object = None
     weight: float = 1.0
     alive_fn: object = None
+    lane_device: object = None
+    shard_axis: int | None = None
 
     @property
     def lanes(self) -> int:
@@ -94,13 +103,27 @@ class PagedStack:
     ENTRY-level scalars: an operand always needs all its pages, so
     per-page stamps would carry no signal (every access touches every
     page) at O(n_pages) bookkeeping cost — eviction concentrates on
-    whole entries and drains their pages in index order."""
+    whole entries and drains their pages in index order.
+
+    With ``lane_device`` (the serving mesh, memory/placement.py) the
+    stack grows a DEVICE AXIS: lanes partition by owner slot (stable —
+    within a device, global lane order is preserved) and each device's
+    lane run pages independently, so a page never straddles two
+    devices.  ``page_device[pi]`` is the page's owner slot,
+    ``page_table[pi]`` its global lane ids, and ``inv[lane]`` the
+    lane's row in the padded page concatenation (the permutation the
+    single-array assembly fallback applies).  ``lane_device is None``
+    keeps the exact legacy layout (contiguous lanes per page,
+    ``inv`` identity)."""
 
     __slots__ = ("shape", "lanes", "page_lanes", "width_words",
-                 "weight", "pages", "last_access", "hits")
+                 "weight", "pages", "last_access", "hits",
+                 "lane_device", "shard_axis", "page_device",
+                 "page_table", "lane_page", "lane_slot")
 
     def __init__(self, shape: tuple, page_lanes: int,
-                 weight: float = 1.0):
+                 weight: float = 1.0, lane_device=None,
+                 shard_axis: int | None = None):
         self.shape = tuple(shape)
         self.width_words = int(shape[-1])
         n = 1
@@ -109,7 +132,35 @@ class PagedStack:
         self.lanes = n
         self.page_lanes = int(page_lanes)
         self.weight = float(weight)
-        n_pages = -(-self.lanes // self.page_lanes)
+        self.shard_axis = shard_axis
+        if lane_device is None:
+            self.lane_device = None
+            self.page_device = None
+            self.page_table = None
+            self.lane_page = None
+            self.lane_slot = None
+            n_pages = -(-self.lanes // self.page_lanes)
+        else:
+            ld = np.ascontiguousarray(lane_device, dtype=np.int32)
+            if ld.shape != (self.lanes,):
+                raise ValueError("lane_device must be (lanes,)")
+            self.lane_device = ld
+            order = np.argsort(ld, kind="stable")
+            self.page_table = []
+            self.page_device = []
+            pl = self.page_lanes
+            for dev in np.unique(ld):
+                run = order[ld[order] == dev]
+                for k in range(0, run.size, pl):
+                    self.page_table.append(run[k:k + pl])
+                    self.page_device.append(int(dev))
+            self.lane_page = np.empty(self.lanes, dtype=np.int32)
+            self.lane_slot = np.empty(self.lanes, dtype=np.int32)
+            for pi, ids in enumerate(self.page_table):
+                self.lane_page[ids] = pi
+                self.lane_slot[ids] = np.arange(ids.size,
+                                                dtype=np.int32)
+            n_pages = len(self.page_table)
         self.pages: list = [None] * n_pages
         self.last_access = time.time()
         self.hits = 0
@@ -136,16 +187,59 @@ class PagedStack:
         return [i for i, p in enumerate(self.pages) if p is None]
 
     def lane_range(self, pi: int) -> tuple[int, int]:
+        """Legacy contiguous page extent (single-device layout only —
+        device-partitioned pages hold non-contiguous lane id sets, use
+        ``page_lane_ids``)."""
+        if self.page_table is not None:
+            raise ValueError("lane_range undefined for device-"
+                             "partitioned pages")
         lo = pi * self.page_lanes
         return lo, min(lo + self.page_lanes, self.lanes)
 
+    def page_lane_ids(self, pi: int) -> np.ndarray:
+        """Global lane ids resident in page ``pi`` (<= page_lanes)."""
+        if self.page_table is not None:
+            return self.page_table[pi]
+        lo, hi = self.lane_range(pi)
+        return np.arange(lo, hi, dtype=np.int32)
+
+    def page_of(self, lane: int) -> tuple[int, int]:
+        """(page index, row inside the page) holding ``lane``."""
+        if self.lane_page is not None:
+            return int(self.lane_page[lane]), int(self.lane_slot[lane])
+        return divmod(int(lane), self.page_lanes)
+
+    def device_of(self, pi: int) -> int | None:
+        """The page's serving-mesh owner slot (None = unplaced)."""
+        return (None if self.page_device is None
+                else self.page_device[pi])
+
+    def inv_perm(self) -> "np.ndarray | None":
+        """lane -> row in the padded page concatenation, or None when
+        page order IS lane order (the legacy layout)."""
+        if self.lane_page is None:
+            return None
+        return (self.lane_page.astype(np.int64) * self.page_lanes
+                + self.lane_slot)
+
+    def device_resident_bytes(self) -> dict[int, int]:
+        """True resident bytes by owner slot (invariant checks +
+        bench occupancy)."""
+        out: dict[int, int] = {}
+        for pi, p in enumerate(self.pages):
+            if p is None:
+                continue
+            d = self.device_of(pi)
+            out[-1 if d is None else d] = (
+                out.get(-1 if d is None else d, 0) + int(p.nbytes))
+        return out
+
     def build_page_host(self, pi: int, lane_words) -> np.ndarray:
         """Host words for one page (zero-padded past the last lane)."""
-        lo, hi = self.lane_range(pi)
         block = np.zeros((self.page_lanes, self.width_words),
                          dtype=np.uint32)
-        for k, lane in enumerate(range(lo, hi)):
-            block[k] = lane_words(lane)
+        for k, lane in enumerate(self.page_lane_ids(pi)):
+            block[k] = lane_words(int(lane))
         return block
 
     def touch(self, now: float | None = None):

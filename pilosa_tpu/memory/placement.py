@@ -1,0 +1,213 @@
+"""Shard -> device placement for the mesh-sharded serving plane.
+
+The serving mesh distributes the fused ragged program (executor/
+ragged.py) over N local devices: every (index, shard) gets a sticky
+owner slot, every paged stack partitions its lanes by that owner
+(memory/pages.py grows a device axis), and the compiled program walks
+each device's resident page-table slice under one ``shard_map`` with
+psum/scatter combines inside the program.  This module is the single
+source of placement truth:
+
+- **unit**: the (index, shard) pair.  Every stack kind a ragged group
+  touches (row / plane / groupcode / rowchunk) maps lanes to shards,
+  so per-shard stickiness colocates ALL of a shard's pages on one
+  device — elementwise IR ops stay device-local and only reductions
+  cross chips (the pilosa node-per-shard ownership model, folded into
+  one process).
+- **balance**: a new shard goes to the slot with the fewest live
+  device bytes (the per-device ledger occupancy, memory/ledger.py),
+  assignment-count as tiebreak — "balance encoded bytes" with the
+  container-adaptive format (PR 16) charging true encoded sizes.
+- **epoch**: any rebalance/pin change bumps ``epoch()``.  Stack cache
+  keys and compiled-plan signatures carry ``(mesh_devices, epoch)``,
+  so a device-count flip or rebalance can never false-hit a stale
+  stack or executable; superseded entries age out through normal
+  eviction (that aging IS the migration mechanism — pages rebuild on
+  their new owner on next use).
+
+Knobs: ``[cluster] mesh-devices`` (env twin ``PILOSA_TPU_MESH_DEVICES``)
+sets the mesh width (0/1 = off); ``[cluster] placement-pin`` (env twin
+``PILOSA_TPU_PLACEMENT_PIN``) force-places shards, syntax
+``index/shard=dev`` or ``index/*=dev``, comma-separated.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+_lock = threading.RLock()
+_configured: int = 0            # [cluster] mesh-devices (0 = off)
+_pins: dict = {}                # (index, shard|"*") -> slot
+_assign: dict = {}              # (index, shard) -> slot
+_counts: dict = {}              # slot -> assignment count
+_epoch: int = 0
+_mesh_cache: dict = {}          # (n, device ids) -> jax Mesh
+
+
+def configure(mesh_devices: int | None = None,
+              pin: str | None = None):
+    """Apply the [cluster] mesh knobs (config.py).  Changing either
+    bumps the placement epoch (cached stacks/plans must not be
+    reused under a different topology or pin set)."""
+    global _configured, _pins
+    with _lock:
+        changed = False
+        if mesh_devices is not None and int(mesh_devices) != _configured:
+            _configured = int(mesh_devices)
+            changed = True
+        if pin is not None:
+            pins = _parse_pins(pin)
+            if pins != _pins:
+                _pins = pins
+                changed = True
+        if changed:
+            _rebalance_locked()
+
+
+def _parse_pins(spec: str) -> dict:
+    pins: dict = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        lhs, _, dev = part.partition("=")
+        idx, _, shard = lhs.partition("/")
+        try:
+            slot = int(dev)
+            key = (idx.strip(), "*" if shard.strip() == "*"
+                   else int(shard))
+        except ValueError:
+            continue
+        pins[key] = slot
+    return pins
+
+
+def mesh_devices() -> int:
+    """Effective serving-mesh width: env twin > config, clamped to
+    the local device count once a backend exists.  <= 1 means the
+    mesh path is off (the exact single-device behavior)."""
+    v = os.environ.get("PILOSA_TPU_MESH_DEVICES")
+    if v is not None:
+        try:
+            n = int(v)
+        except ValueError:
+            n = 0
+    else:
+        n = _configured
+    if n <= 1:
+        return 1
+    import jax
+    return max(1, min(n, jax.local_device_count()))
+
+
+def devices() -> list:
+    """The mesh's device list (first ``mesh_devices()`` local
+    devices, in enumeration order — slot i is always devices()[i])."""
+    import jax
+    return list(jax.devices()[:mesh_devices()])
+
+
+def device_of(slot: int):
+    import jax
+    return jax.devices()[int(slot)]
+
+
+def serving_mesh():
+    """The cached 1-D ("dev",) Mesh the fused serving program is
+    shard_map'ped over.  Distinct from StackedEngine.mesh (the legacy
+    GSPMD placement arm): the serving mesh keeps paging ON — pages
+    are placed per device, not replicated."""
+    devs = devices()
+    key = (len(devs), tuple(d.id for d in devs))
+    with _lock:
+        m = _mesh_cache.get(key)
+        if m is None:
+            from jax.sharding import Mesh
+            m = Mesh(np.array(devs), ("dev",))
+            _mesh_cache[key] = m
+        return m
+
+
+def epoch() -> int:
+    return _epoch
+
+
+def rebalance():
+    """Forget every sticky assignment and bump the epoch.  New stack
+    keys / plan signatures rebuild on freshly balanced owners; the
+    superseded generation ages out via eviction (live migration =
+    rebuild-on-new-owner + evict-old, epoch-fenced by the keys)."""
+    with _lock:
+        _rebalance_locked()
+
+
+def _rebalance_locked():
+    global _epoch
+    _assign.clear()
+    _counts.clear()
+    _epoch += 1
+
+
+def reset():
+    """Test hook: drop assignments, pins and config; bump epoch."""
+    global _configured, _pins
+    with _lock:
+        _configured = 0
+        _pins = {}
+        _rebalance_locked()
+
+
+def _device_bytes() -> list[int]:
+    from pilosa_tpu import memory
+    try:
+        return memory.ledger().device_bytes(mesh_devices())
+    except Exception:
+        return [0] * mesh_devices()
+
+
+def place(index_name: str, shard: int) -> int:
+    """Sticky owner slot for one (index, shard).  First placement
+    balances live per-device ledger bytes (assignment count breaks
+    ties); pins override."""
+    n = mesh_devices()
+    if n <= 1:
+        return 0
+    key = (str(index_name), int(shard))
+    with _lock:
+        slot = _assign.get(key)
+        if slot is not None:
+            return slot
+        pin = _pins.get(key, _pins.get((key[0], "*")))
+        if pin is not None and 0 <= int(pin) < n:
+            slot = int(pin)
+        else:
+            occ = _device_bytes()
+            slot = min(range(n), key=lambda d: (
+                occ[d] if d < len(occ) else 0, _counts.get(d, 0), d))
+        _assign[key] = slot
+        _counts[slot] = _counts.get(slot, 0) + 1
+    # keep the ledger's per-device split current (idempotent; outside
+    # the placement lock — the ledger has its own)
+    from pilosa_tpu import memory
+    memory.ledger().set_devices(n)
+    return slot
+
+
+def owners(index_name: str, shards) -> np.ndarray:
+    """Owner slot per shard (int32, len(shards)) — the group-level
+    owner map every leaf of a ragged group shares."""
+    return np.array([place(index_name, s) for s in shards],
+                    dtype=np.int32)
+
+
+def snapshot() -> dict:
+    """Placement state for bench/debug surfaces."""
+    with _lock:
+        per = {d: 0 for d in range(mesh_devices())}
+        for slot in _assign.values():
+            per[slot] = per.get(slot, 0) + 1
+        return {"mesh_devices": mesh_devices(), "epoch": _epoch,
+                "assigned_shards": dict(per), "pins": len(_pins)}

@@ -403,6 +403,10 @@ MEM_BUDGET = registry.gauge(
 MEM_RESIDENT = registry.gauge(
     "pilosa_memory_resident_bytes",
     "Ledger-accounted resident device bytes by client")
+MEM_DEVICE_RESIDENT = registry.gauge(
+    "pilosa_memory_device_resident_bytes",
+    "Device-labeled resident bytes per serving-mesh slot (pages "
+    "placed by memory/placement.py; each slot is budget/N-bounded)")
 MEM_RECLAIMS = registry.counter(
     "pilosa_memory_reclaim_total",
     "Cross-client reclaim sweeps by trigger (reserve/oom/shrink)")

@@ -129,25 +129,47 @@ def ensure_peak(block: bool = True) -> float | None:
     return _peak_bytes_per_s
 
 
-def note(op: str, nbytes: int, seconds: float):
+def note(op: str, nbytes: int, seconds: float, device=None):
     """Fold one cached-executable dispatch into the per-op bandwidth
-    attribution (and the active flight record's roofline share)."""
+    attribution (and the active flight record's roofline share).
+
+    ``device`` (a serving-mesh slot index, memory/placement.py)
+    attributes a PER-DEVICE share of a mesh dispatch: the sample
+    accumulates under the ``"{op}/dev{device}"`` stats key — its own
+    snapshot/window row, the per-chip bench occupancy truth — and
+    sets the gauges with a ``device="d{device}"`` label.  Device
+    samples never touch the flight record (the caller notes the
+    aggregate separately; double-counting the per-device split would
+    inflate every rider's roofline share)."""
     if not enabled() or seconds <= 0 or nbytes <= 0:
         return
+    key = op if device is None else f"{op}/dev{device}"
     with _lock:
-        st = _stats.get(op)
+        st = _stats.get(key)
         if st is None:
-            st = _stats[op] = [0, 0.0, 0]
+            st = _stats[key] = [0, 0.0, 0]
         st[0] += int(nbytes)
         st[1] += seconds
         st[2] += 1
         b, s = st[0], st[1]
     gbps = b / s / 1e9
-    metrics.DEVICE_BW_GBPS.set(gbps, op=op)
+    labels = {"op": op}
+    if device is not None:
+        labels["device"] = f"d{device}"
+    metrics.DEVICE_BW_GBPS.set(gbps, **labels)
     peak = _peak_bytes_per_s
     if peak:
-        metrics.DEVICE_BW_FRACTION.set((b / s) / peak, op=op)
-    flight.note_op(op, nbytes, seconds)
+        metrics.DEVICE_BW_FRACTION.set((b / s) / peak, **labels)
+    if device is None:
+        flight.note_op(op, nbytes, seconds)
+
+
+def _split_key(key: str) -> dict:
+    """Stats key -> gauge labels ("ragged/dev3" -> op + device)."""
+    if "/dev" in key:
+        op, _, d = key.rpartition("/dev")
+        return {"op": op, "device": f"d{d}"}
+    return {"op": key}
 
 
 def _refresh_fractions():
@@ -157,10 +179,11 @@ def _refresh_fractions():
     if not peak:
         return
     with _lock:
-        items = [(op, st[0], st[1]) for op, st in _stats.items()]
-    for op, b, s in items:
+        items = [(key, st[0], st[1]) for key, st in _stats.items()]
+    for key, b, s in items:
         if s > 0:
-            metrics.DEVICE_BW_FRACTION.set((b / s) / peak, op=op)
+            metrics.DEVICE_BW_FRACTION.set((b / s) / peak,
+                                           **_split_key(key))
 
 
 def snapshot() -> dict:

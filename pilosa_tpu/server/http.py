@@ -96,6 +96,10 @@ class Server:
         # stacks + OOM backstop; the prefetcher warms predicted stack
         # pages from flight records off the serving hot path
         config.apply_memory_settings()
+        # serving mesh ([cluster] mesh-devices / placement-pin):
+        # per-device page placement for the mesh-sharded fused
+        # program (memory/placement.py)
+        config.apply_placement_settings()
         # roofline attribution ([roofline]): per-op achieved-GB/s vs a
         # measured/configured peak; the STREAM-style probe runs once
         # on a background thread so first queries never wait on it
