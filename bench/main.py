@@ -11,6 +11,7 @@ bench/common.py):
     bench/memory.py   HBM residency (paged vs whole) A/B
     bench/chaos.py    kill/rejoin + hedged-read gauntlets
     bench/writes.py   streaming write-storm gauntlet
+    bench/standing.py standing-query maintained-vs-invalidated A/B
     bench/ragged.py   ragged dispatch + QoS admission A/Bs (ISSUE 8)
 """
 
@@ -44,6 +45,7 @@ from bench.multichip import (
 from bench.ragged import build_events_index, ragged_gauntlet, ragged_smoke
 from bench.rebalance import rebalance_gauntlet, rebalance_smoke
 from bench.sparse import sparse_format_ab_gauntlet, sparse_smoke
+from bench.standing import standing_gauntlet, standing_smoke
 from bench.serving import (
     mixed_rw_gauntlet,
     overhead_smoke,
@@ -99,6 +101,12 @@ def main() -> None:
     # through the streaming write plane with a kill-mid-window +
     # restart + replay, acked-loss and bit-exact convergence asserted
     write_storm = write_storm_gauntlet()
+    # standing-query gauntlet (ISSUE 18): 32 pollers over registered
+    # Count/TopN/GroupBy/SQL standing queries under a write storm,
+    # maintained vs invalidated A/B — bit-exact at quiesce and zero
+    # maintained-arm stack builds hard-gated, poll p50/p99 ratio
+    # recorded
+    standing = standing_gauntlet()
     # fused-vs-onehot one-pass GroupBy kernel A/B over the combo
     # sweep (ISSUE 11): bit-exact hard-gated everywhere; wall p50 +
     # per-cell roofline windows recorded (CPU arms interpret on a
@@ -245,6 +253,12 @@ def main() -> None:
         # p99 vs the read-only baseline (latency ratio hard-gated
         # only on TPU/large-box runs)
         "write_storm_gauntlet": write_storm,
+        # standing-query A/B (ISSUE 18): write-through maintenance vs
+        # invalidate-and-reexecute under the same poller storm —
+        # poll p50/p99 invalidated/maintained ratios, maintenance
+        # outcome counts (incremental vs declared fallbacks), zero
+        # stack builds on the maintained arm
+        "standing_gauntlet": standing,
         # ragged + QoS gauntlet (ISSUE 8): dispatches/query A/B,
         # point-p99-under-GroupBy-storm A/B, typed backpressure
         "ragged_gauntlet": ragged,
@@ -337,6 +351,8 @@ def dispatch(argv) -> int:
         return chaos_smoke()
     if "--write-smoke" in argv:
         return write_smoke()
+    if "--standing-smoke" in argv:
+        return standing_smoke()
     if "--ragged-smoke" in argv:
         return ragged_smoke()
     if "--kernel-smoke" in argv:

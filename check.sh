@@ -85,6 +85,24 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 
+echo "== standing smoke =="
+# standing-query plane gate (bench.py --standing-smoke,
+# bench/standing.py): Count/TopN/GroupBy/SQL standing queries
+# registered on the serving plane, 8 pollers under a streaming write
+# storm, maintained vs PILOSA_TPU_STANDING=0 invalidated A/B ->
+# CORRECTNESS-ONLY gates: every registration admitted, zero poll/
+# writer failures, served results bit-exact vs a cold executor at
+# quiesce, ZERO stack builds during the maintained arm (polls ride
+# the write-through cache; maintenance — declared fallbacks
+# included — is host-side), and maintenance actually advanced
+# results incrementally.  Poll latency/throughput ratios are
+# recorded in the BENCH JSON, never asserted here.
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python bench.py --standing-smoke; then
+    echo "check.sh: standing smoke failed" >&2
+    exit 1
+fi
+
 echo "== ragged smoke =="
 # ragged dispatch + QoS admission gate (bench.py --ragged-smoke):
 # mixed-index traffic through the fused page-table program +

@@ -404,6 +404,11 @@ class API:
                 shards = {int(s) for s in u}
         serving.cache.sweep(self.holder, fields, shards)
         metrics.RESULT_CACHE.inc(outcome="write")
+        standing = getattr(serving, "standing", None)
+        if standing is not None:
+            # maintained subscriptions advance off the same landed
+            # delta the sweep just declared
+            standing.on_write(index, fields, shards)
 
     def import_bits(self, index: str, field: str, rows=None, cols=None,
                     row_keys=None, col_keys=None, timestamps=None,

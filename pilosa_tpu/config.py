@@ -215,6 +215,24 @@ class Config:
     slo_latency_objective: float = 0.99
     slo_availability_objective: float = 0.999
     slo_windows: str = "5m,1h,6h"
+    # temporal analytics ([timeq], models/timeq.py): write-finest
+    # lands TIME writes in standard + the finest quantum unit only
+    # (coarse views compact on the rollup tick instead of fanning out
+    # per write); rollup arms the HTTP ticker's quantum-rollup sweep;
+    # qcover plans multi-view range covers as per-view fused leaves
+    # (one restack per cover shift instead of a whole-cover rebuild;
+    # env twin PILOSA_TPU_QCOVER is the bench A/B lever).
+    timeq_write_finest: bool = False
+    timeq_rollup: bool = False
+    timeq_qcover: bool = True
+    # standing queries ([standing], executor/standing.py): registered
+    # Count/TopN/GroupBy/SQL results are delta-maintained on write —
+    # the serving ResultCache entry is ADVANCED by maintenance
+    # instead of swept.  PILOSA_TPU_STANDING=0 is the kill-switch /
+    # bench A/B lever and outranks a default-True config; max bounds
+    # live registrations (register past it -> typed error).
+    standing_enabled: bool = True
+    standing_max: int = 256
 
     def apply_kernel_setting(self):
         """Translate tpu_kernels into the Pallas dispatch env flag.
@@ -382,6 +400,29 @@ class Config:
             availability_objective=self.slo_availability_objective,
             windows=self.slo_windows)
 
+    def apply_timeq_settings(self):
+        """Push the [timeq] knobs into models/timeq.py.  Env twins
+        (PILOSA_TPU_TIMEQ_WRITE_FINEST / PILOSA_TPU_TIMEQ_ROLLUP /
+        PILOSA_TPU_QCOVER) are read dynamically by the module and
+        outrank these values (bench A/B levers)."""
+        from pilosa_tpu.models import timeq
+        qc = self.timeq_qcover
+        if qc and "PILOSA_TPU_QCOVER" in os.environ:
+            qc = None  # env kill-switch stays in charge
+        timeq.configure(write_finest=self.timeq_write_finest,
+                        rollup=self.timeq_rollup, qcover=qc)
+
+    def apply_standing_settings(self):
+        """Configure the standing-query registry ([standing]).  The
+        PILOSA_TPU_STANDING env kill-switch outranks a default-True
+        config (same contract as apply_roofline_settings)."""
+        from pilosa_tpu.executor import standing
+        enabled = self.standing_enabled
+        if enabled and "PILOSA_TPU_STANDING" in os.environ:
+            enabled = None  # env kill-switch stays in charge
+        standing.configure(enabled=enabled,
+                           max_registrations=self.standing_max)
+
     def apply_placement_settings(self):
         """Push the [cluster] serving-mesh knobs into the placement
         module (memory/placement.py).  Env twins
@@ -485,6 +526,11 @@ _TOML_KEYS = {
     "memory.prefetch-interval-s": "memory_prefetch_interval_s",
     "memory.oom-retry": "memory_oom_retry",
     "memory.host-fallback": "memory_host_fallback",
+    "timeq.write-finest": "timeq_write_finest",
+    "timeq.rollup": "timeq_rollup",
+    "timeq.qcover": "timeq_qcover",
+    "standing.enabled": "standing_enabled",
+    "standing.max": "standing_max",
 }
 
 ENV_PREFIX = "PILOSA_TPU_"

@@ -89,6 +89,8 @@ def _remap_tree(node, lmap, poff):
                 tuple(_remap_tree(c, lmap, poff) for c in node[2]))
     if k == "not":
         return ("not", lmap[node[1]], _remap_tree(node[2], lmap, poff))
+    if k == "qcover":
+        return ("qcover", tuple(lmap[i] for i in node[1]))
     if k == "shift":
         return ("shift", node[1], _remap_tree(node[2], lmap, poff))
     if k == "bsi_cmp":
@@ -310,6 +312,8 @@ class RaggedProgram:
                 elif k == "not":
                     out.add(node[1])
                     walk(node[2])
+                elif k == "qcover":
+                    out.update(node[1])
                 elif k == "shift":
                     walk(node[2])
                 elif k in ("bsi_cmp", "bsi_between", "bsi_notnull"):

@@ -341,6 +341,12 @@ class ResultCache:
             "result_cache", reclaim=self._reclaim)
         self.hits = 0
         self.misses = 0
+        # write-through entry keys owned by the standing-query
+        # registry (executor/standing.py): maintenance ADVANCES their
+        # snapshot in place, so sweeps/eviction must not drop them —
+        # a stale get() still misses (no wrong answers) but leaves
+        # the entry for the registry's catch_up to advance
+        self._standing: set = set()
 
     # cost-aware eviction scans this many LRU-end entries for the
     # cheapest recompute; small so eviction stays O(1)-ish
@@ -356,7 +362,7 @@ class ResultCache:
         rate).  Returns the freed bytes (0 = nothing evictable)."""
         window = [(k, e) for k, e in itertools.islice(
             self._entries.items(), self._EVICT_WINDOW)
-            if k != exclude]
+            if k != exclude and k not in self._standing]
         if not window:
             return 0
         best = min(range(len(window)),
@@ -372,7 +378,10 @@ class ResultCache:
         freed = 0
         with self._lock:
             while self._entries and freed < need:
-                freed += self._evict_one_locked()
+                got = self._evict_one_locked()
+                if not got:
+                    break  # only standing entries left: not evictable
+                freed += got
         if freed:
             self._client.release(freed)
         return freed
@@ -396,7 +405,9 @@ class ResultCache:
             dropped = 0
             with self._lock:
                 cur = self._entries.get(key)
-                if cur is ent:
+                # standing entries stay put on staleness: the
+                # registry advances them instead of re-executing
+                if cur is ent and key not in self._standing:
                     self._entries.pop(key)
                     self._bytes -= ent[3]
                     dropped = ent[3]
@@ -441,6 +452,31 @@ class ResultCache:
         if released:
             self._client.release(released)
 
+    def mark_standing(self, key) -> None:
+        with self._lock:
+            self._standing.add(key)
+
+    def unmark_standing(self, key) -> None:
+        """Return a key to normal swept-entry lifecycle (and drop the
+        now-unmaintained entry so it cannot serve stale)."""
+        dropped = 0
+        with self._lock:
+            self._standing.discard(key)
+            ent = self._entries.pop(key, None)
+            if ent is not None:
+                self._bytes -= ent[3]
+                dropped = ent[3]
+        if dropped:
+            self._client.release(dropped)
+
+    def advance(self, key, fields: frozenset, snapshot: tuple,
+                results, cost_ms: float | None = None) -> None:
+        """Write-through maintenance: replace a standing entry's
+        snapshot+results in place.  put() already replaces in place
+        and its eviction excludes standing keys; a ledger denial just
+        drops the entry — the registry's catch_up still serves."""
+        self.put(key, fields, snapshot, results, cost_ms)
+
     def sweep(self, holder, touched: set | None = None,
               shards: set | None = None) -> int:
         """Evict exactly the entries whose snapshot is stale (called
@@ -456,6 +492,8 @@ class ResultCache:
             items = list(self._entries.items())
         evicted = 0
         for key, ent in items:
+            if key in self._standing:
+                continue  # maintained, not swept
             if touched is not None and not (ent[0] & touched):
                 continue
             eshards = shards
@@ -504,6 +542,8 @@ class ResultCache:
         for key, ent in items:
             if key[0] != index:
                 continue
+            if key in self._standing:
+                continue  # registry fallback re-seeds from the move
             if key[2] is not None and not (set(key[2]) & shards):
                 continue
             dropped = 0
@@ -741,6 +781,11 @@ class ServingLayer:
         # rebuilt freely in-process and the loop identity is the name)
         from pilosa_tpu.obs import watchdog
         self.watch = watchdog.register("serving-batcher")
+        # standing-query registry (executor/standing.py): maintained
+        # write-through entries over this cache.  Runtime import —
+        # standing imports serving's module surface
+        from pilosa_tpu.executor.standing import StandingRegistry
+        self.standing = StandingRegistry(self)
 
     def start_prefetcher(self, interval_s: float = 0.5):
         """Warm predicted stack pages off the serving hot path
@@ -777,6 +822,9 @@ class ServingLayer:
                     wf, ws = _write_targets(ex.holder.index(index), q)
                     self.cache.sweep(ex.holder, wf, ws)
                     metrics.RESULT_CACHE.inc(outcome="write")
+                    # push the landed delta through the standing
+                    # registrations this write can have touched
+                    self.standing.on_write(index, wf, ws)
         # default deadline: a [serving] default-deadline-ms applies to
         # every request that carried no deadline of its own — a
         # tenant/priority header must not opt a request out of the
@@ -892,6 +940,16 @@ class ServingLayer:
                 return cache_res
             if self.cache is not None and fields is not None:
                 metrics.RESULT_CACHE.inc(outcome="miss")
+            # a registry-owned key pulls maintenance instead of
+            # re-executing: the poll pays O(delta), never a restack
+            if self.standing.owns(key):
+                got = self.standing.catch_up(key)
+                if got is not _MISS:
+                    route = "standing"
+                    metrics.QUERY_TOTAL.inc(index=index, status="ok")
+                    metrics.QUERY_DURATION.observe(
+                        time.perf_counter() - t0)
+                    return got
             # classification pays a shard-list sort — skip it
             # entirely in cache-only mode
             req = (self._classify(index, idx, q, shards, fields, key,

@@ -56,6 +56,7 @@ import jax.numpy as jnp
 from pilosa_tpu import memory
 from pilosa_tpu.memory import encode, pressure
 from pilosa_tpu.memory.pages import PagedStack, StackRecipe, page_lanes_for
+from pilosa_tpu.models import timeq
 from pilosa_tpu.models.view import VIEW_STANDARD
 from pilosa_tpu.obs import flight, metrics, roofline, stats
 from pilosa_tpu.obs.tracing import start_span
@@ -1465,6 +1466,12 @@ def _eval(node, leaves, params):
         return acc
     if k == "not":
         return bm.difference(leaves[node[1]], _eval(node[2], leaves, params))
+    if k == "qcover":
+        # time-quantum cover: union of per-view single-view stacks
+        acc = leaves[node[1][0]]
+        for i in node[1][1:]:
+            acc = bm.union(acc, leaves[i])
+        return acc
     if k == "shift":
         return bm.shift(_eval(node[2], leaves, params), node[1])
     if k == "bsi_cmp":
@@ -2126,6 +2133,16 @@ class PlanBuilder:
         if row_id is None:
             return ("zeros",)
         views = tuple(f.views_for_range(call.arg("from"), call.arg("to")))
+        if len(views) > 1 and timeq.qcover():
+            # quantum-cover op: one SINGLE-view stack leaf per cover
+            # member, unioned in-program.  Each leaf caches under its
+            # own view key, so a rolling window restacks only the
+            # quantum that entered the cover and a live-edge write
+            # dirties one leaf — the monolithic multi-view leaf would
+            # restack the whole cover either way.
+            metrics.TIMEQ_QCOVER_TOTAL.inc()
+            return ("qcover", tuple(self._row_leaf(f, (vn,), row_id)
+                                    for vn in views))
         return ("leaf", self._row_leaf(f, views, row_id))
 
     def _build_bsi(self, fname: str, cond: Condition):

@@ -16,6 +16,7 @@ import threading
 import numpy as np
 
 from pilosa_tpu.models import timeq
+from pilosa_tpu.models.fragment import bump_mutation_epoch
 from pilosa_tpu.models.schema import FieldOptions, FieldType
 from pilosa_tpu.models.view import (
     VIEW_BSI_PREFIX,
@@ -41,6 +42,10 @@ class Field:
         self.views: dict[str, View] = {}
         self._row_translator = None
         self._lock = threading.RLock()
+        # (child_view, parent_view) pairs already compacted by
+        # rollup_views — OR-folding is idempotent, the set only
+        # avoids re-paying the copy every tick
+        self._rolled: set[tuple[str, str]] = set()
         # BSI depth grows with observed magnitudes (bsiGroup, field.go:2394)
         if self.options.type.is_bsi:
             lo, hi = self.options.min, self.options.max
@@ -73,14 +78,25 @@ class Field:
                 self.views[name] = v
             return v
 
-    def remove_expired_views(self, now: dt.datetime | None = None) -> list[str]:
+    def remove_expired_views(self, now: dt.datetime | None = None,
+                             epoch_latch: list | None = None) -> list[str]:
         """Drop time-quantum views whose span ended more than
         options.ttl seconds ago (time.go:158 TTL view removal; the
-        holder ticker drives this).  Returns removed view names."""
+        holder ticker drives this).  Returns removed view names.
+
+        The sweep runs under ONE global mutation-epoch stamp: the
+        epoch bumps lazily before the first gen moves (the
+        epoch-before-gen ordering every canonical fused program's
+        staleness check depends on), then every retired fragment bumps
+        its gen without re-bumping the epoch — a sweep retiring N
+        views used to invalidate every canonical program N times.
+        ``epoch_latch`` (a one-element [bool] shared by the holder's
+        multi-field sweep) extends the single stamp across fields."""
         if self.options.ttl <= 0:
             return []
         now = now or dt.datetime.now(dt.timezone.utc).replace(tzinfo=None)
         removed = []
+        latch = epoch_latch if epoch_latch is not None else [False]
         with self._lock:
             for name in list(self.views):
                 span = timeq.view_time_range(name)
@@ -90,6 +106,9 @@ class Field:
                 if (now - end).total_seconds() > self.options.ttl:
                     v = self.views.pop(name)
                     removed.append(name)
+                    if not latch[0]:
+                        latch[0] = True
+                        bump_mutation_epoch()  # once, before any gen moves
                     # invalidate derived state: stack-cache patchers
                     # and prefetch recipes hold DIRECT references to
                     # these fragments, and their (gen, version) stamps
@@ -98,7 +117,7 @@ class Field:
                     # serving the expired quantum forever.  A bumped
                     # gen makes every derived stamp compare stale.
                     for fr in v.fragments.values():
-                        fr.bump_gen()
+                        fr.bump_gen(bump_epoch=False)
                     if self.storage is not None:
                         # also reclaim the persisted bitmaps, or the
                         # expired view resurrects on the next open
@@ -185,11 +204,34 @@ class Field:
             raise ValueError("bool field rows must be 0 or 1")
         shard = col // self.width
         shard_col = col % self.width
-        changed = False
         view_names = [VIEW_STANDARD]
         if t == FieldType.TIME and timestamp is not None:
-            view_names += timeq.views_by_time(
-                VIEW_STANDARD, timestamp, self.options.time_quantum)
+            q = self.options.time_quantum
+            if timeq.write_finest() and len(q) > 1:
+                # finest-unit-only writes ([timeq] write-finest): the
+                # coarse quanta compact from fine ones on the rollup
+                # tick instead of paying len(quantum) fragment writes
+                # per bit.  A coarser view that ALREADY exists (rolled
+                # up, or written before the mode flipped) must stay in
+                # sync with late writes into its span, so those still
+                # get the bit — selection + write hold the field lock
+                # so a concurrent rollup can't materialize a parent
+                # between the existence check and the write.
+                with self._lock:
+                    view_names += [timeq.view_by_time_unit(
+                        VIEW_STANDARD, timestamp, q[-1])]
+                    view_names += [
+                        vn for u in q[:-1]
+                        if (vn := timeq.view_by_time_unit(
+                            VIEW_STANDARD, timestamp, u)) in self.views]
+                    return self._set_bit_views(view_names, row, shard,
+                                               shard_col)
+            view_names += timeq.views_by_time(VIEW_STANDARD, timestamp, q)
+        return self._set_bit_views(view_names, row, shard, shard_col)
+
+    def _set_bit_views(self, view_names, row, shard, shard_col) -> bool:
+        changed = False
+        t = self.options.type
         for vn in view_names:
             frag = self.view(vn, create=True).fragment(shard, create=True)
             if t in (FieldType.MUTEX, FieldType.BOOL):
@@ -387,7 +429,81 @@ class Field:
             end = timeq.parse_time(to)
         views = timeq.views_by_time_range(
             VIEW_STANDARD, start, end, self.options.time_quantum)
-        return [v for v in views if v in self.views]
+        return self._refine_cover(views, str(self.options.time_quantum))
+
+    def _refine_cover(self, views: list[str], quantum: str) -> list[str]:
+        """Resolve a quantum cover against the views that actually
+        exist.  A cover view that is missing refines into the
+        next-finer units of the quantum over its span (recursively) —
+        under [timeq] write-finest the coarse views only materialize
+        at rollup, so a cover naming an un-rolled month must read its
+        days/hours instead of silently dropping the span.  With the
+        default write-all-units mode this is a no-op: a coarse view
+        exists whenever any finer one in its span does."""
+        out: list[str] = []
+        for v in views:
+            if v in self.views:
+                out.append(v)
+                continue
+            span = timeq.view_time_range(v)
+            unit = timeq.view_unit(v)
+            finer = timeq.finer_units(quantum, unit)
+            if span is None or not finer:
+                continue  # nothing written there (or not a time view)
+            sub = timeq.views_by_time_range(
+                VIEW_STANDARD, span[0], span[1],
+                self.options.time_quantum.__class__(finer))
+            out.extend(self._refine_cover(sub, finer))
+        return out
+
+    def rollup_views(self, now: dt.datetime | None = None
+                     ) -> list[tuple[str, str]]:
+        """Compact completed fine-unit quantum views into their
+        coarser parents ([timeq] rollup; the maintenance ticker
+        drives this).  Each completed child view OR-folds into the
+        parent view of the next coarser unit, finest first so a full
+        hour→day→month→year cascade lands in one pass.  Folding is
+        idempotent (pure OR) and late writes stay consistent because
+        set_bit also writes every ALREADY-materialized parent of its
+        timestamp.  Returns (child, parent) pairs folded."""
+        if self.options.type != FieldType.TIME:
+            return []
+        q = str(self.options.time_quantum)
+        if len(q) < 2:
+            return []
+        now = now or dt.datetime.now(dt.timezone.utc).replace(tzinfo=None)
+        folded: list[tuple[str, str]] = []
+        with self._lock:
+            fine_to_coarse = list(zip(q[::-1], q[::-1][1:]))
+            for child_unit, parent_unit in fine_to_coarse:
+                for vn in sorted(self.views):
+                    if timeq.view_unit(vn) != child_unit:
+                        continue
+                    span = timeq.view_time_range(vn)
+                    if span is None or span[1] > now:
+                        continue  # quantum still open for writes
+                    parent = timeq.view_by_time_unit(
+                        VIEW_STANDARD, span[0], parent_unit)
+                    if (vn, parent) in self._rolled:
+                        continue
+                    self._fold_view(vn, parent)
+                    self._rolled.add((vn, parent))
+                    folded.append((vn, parent))
+        return folded
+
+    def _fold_view(self, child: str, parent: str) -> None:
+        """OR every row of every shard of ``child`` into ``parent``
+        (creating it), through the real mutators so versions, delta
+        logs, and persistence stay correct.  Caller holds _lock."""
+        cv = self.views[child]
+        pv = self.view(parent, create=True)
+        for shard, cfrag in sorted(cv.fragments.items()):
+            pfrag = pv.fragment(shard, create=True)
+            for row in cfrag.row_ids:
+                w = np.asarray(cfrag.row_words(row), dtype=np.uint32)
+                merged = np.bitwise_or(
+                    np.asarray(pfrag.row_words(row), dtype=np.uint32), w)
+                pfrag.set_row_words(row, merged)
 
     def close(self):
         if self._row_translator is not None:

@@ -285,15 +285,22 @@ class Fragment:
         if PARANOIA:
             self.check_row(row)
 
-    def bump_gen(self):
+    def bump_gen(self, bump_epoch: bool = True):
         """Retire this fragment's cache identity: every derived
         (gen, version) stamp — tile stacks, result-cache snapshots,
         prefetch recipes — compares unequal afterwards.  Called when
         the fragment leaves the live tree without being destroyed
         (TTL view expiry, models/field.py): closures holding a direct
         reference would otherwise keep reading unchanged stamps and
-        serve the expired view's data forever."""
-        bump_mutation_epoch()  # before the gen moves — see _invalidate
+        serve the expired view's data forever.
+
+        ``bump_epoch=False`` skips the global mutation-epoch bump for
+        batched sweeps (TTL expiry retiring N views): the caller bumps
+        the epoch ONCE before the first gen moves — the same
+        epoch-before-gen ordering, paid once instead of invalidating
+        every canonical fused program N times per sweep."""
+        if bump_epoch:
+            bump_mutation_epoch()  # before the gen moves — see _invalidate
         self.gen = next(_FRAG_GEN)
 
     def check_row(self, row: int):

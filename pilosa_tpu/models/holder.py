@@ -68,13 +68,30 @@ class Holder:
 
     def remove_expired_views(self) -> list[str]:
         """TTL sweep over every time field (the reference's view-
-        removal ticker, time.go:158 + holder monitors)."""
+        removal ticker, time.go:158 + holder monitors).  One shared
+        epoch latch: however many views expire across however many
+        fields, the global mutation epoch bumps at most ONCE (before
+        the first gen moves) — a no-op tick bumps nothing."""
         removed = []
+        latch = [False]
         with self._lock:
             for idx in self.indexes.values():
                 for f in idx.fields.values():
-                    removed += f.remove_expired_views()
+                    removed += f.remove_expired_views(epoch_latch=latch)
         return removed
+
+    def rollup_views(self) -> list[tuple[str, str, str, str]]:
+        """Quantum-rollup sweep over every time field ([timeq]
+        rollup): completed fine-unit views OR-fold into their coarser
+        parents.  Returns (index, field, child_view, parent_view)
+        tuples folded this pass."""
+        folded = []
+        with self._lock:
+            for iname, idx in self.indexes.items():
+                for f in idx.fields.values():
+                    folded += [(iname, f.name, c, p)
+                               for c, p in f.rollup_views()]
+        return folded
 
     def close(self):
         with self._lock:

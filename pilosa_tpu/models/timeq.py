@@ -12,13 +12,86 @@ coarse units exist (e.g. quantum "Y"), views overcover the range edges
 from __future__ import annotations
 
 import datetime as dt
+import os
 import re as _re
 
 from pilosa_tpu.models.schema import TimeQuantum
 
 _FMT = {"Y": "%Y", "M": "%Y%m", "D": "%Y%m%d", "H": "%Y%m%d%H"}
+_UNIT_BY_LEN = {4: "Y", 6: "M", 8: "D", 10: "H"}
+_UNIT_ORDER = "YMDH"  # coarse -> fine
 
 TIME_FORMAT = "%Y-%m-%dT%H:%M"  # pql time literal format (time.go TimeFormat)
+
+# [timeq] write-finest: TIME writes land standard + the finest
+# quantum unit only; coarse views compact from fine ones on the
+# rollup tick (Field.rollup_views).  Default off = the reference's
+# write-every-unit fan-out.  Env twin outranks config (A/B lever).
+_WRITE_FINEST = False
+
+# [timeq] qcover: multi-view range covers plan as a ("qcover", ...)
+# op — one single-view stack leaf per cover member, unioned inside
+# the fused program.  A cover shift then restacks only the quantum
+# that entered/left; the monolithic multi-view leaf restacks the
+# whole cover on any member's write.  Default on; env twin is the
+# bench A/B lever.
+_QCOVER = True
+
+# [timeq] rollup: the HTTP maintenance ticker folds completed fine
+# views into their coarser parents (Holder.rollup_views).  Default
+# off — the write-every-unit default needs no compaction.
+_ROLLUP = False
+
+
+def configure(write_finest: bool | None = None,
+              rollup: bool | None = None,
+              qcover: bool | None = None) -> None:
+    """Apply the [timeq] knobs (config.py)."""
+    global _WRITE_FINEST, _ROLLUP, _QCOVER
+    if write_finest is not None:
+        _WRITE_FINEST = bool(write_finest)
+    if rollup is not None:
+        _ROLLUP = bool(rollup)
+    if qcover is not None:
+        _QCOVER = bool(qcover)
+
+
+def write_finest() -> bool:
+    ev = os.environ.get("PILOSA_TPU_TIMEQ_WRITE_FINEST")
+    if ev is not None:
+        return ev.lower() not in ("0", "false", "")
+    return _WRITE_FINEST
+
+
+def rollup_enabled() -> bool:
+    ev = os.environ.get("PILOSA_TPU_TIMEQ_ROLLUP")
+    if ev is not None:
+        return ev.lower() not in ("0", "false", "")
+    return _ROLLUP
+
+
+def qcover() -> bool:
+    ev = os.environ.get("PILOSA_TPU_QCOVER")
+    if ev is not None:
+        return ev.lower() not in ("0", "false", "")
+    return _QCOVER
+
+
+def view_unit(view_name: str) -> str | None:
+    """Quantum unit ("Y"/"M"/"D"/"H") of a time view name, None for
+    non-time views — the suffix-length twin of view_time_range."""
+    _, _, suffix = view_name.rpartition("_")
+    if not suffix.isdigit():
+        return None
+    return _UNIT_BY_LEN.get(len(suffix))
+
+
+def finer_units(quantum: str, unit: str) -> str:
+    """Units of ``quantum`` strictly finer than ``unit``, coarse
+    first — always a suffix of a valid quantum, hence valid itself."""
+    i = _UNIT_ORDER.index(unit)
+    return "".join(u for u in str(quantum)
+                   if _UNIT_ORDER.index(u) > i)
 
 
 def view_by_time_unit(name: str, t: dt.datetime, unit: str) -> str:

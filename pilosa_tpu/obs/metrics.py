@@ -611,3 +611,30 @@ SLO_BUDGET_REMAINING = registry.gauge(
     "pilosa_slo_error_budget_remaining",
     "Error-budget fraction left over the longest configured window "
     "per SLO")
+
+# -- temporal analytics (models/timeq.py + executor/standing.py) --
+# quantum-cover plan ops, rollup folds, and the standing-query
+# registry's maintenance outcomes (incremental = O(delta) patch,
+# fallback = declared structural re-execution, noop = no relevant
+# delta)
+TIMEQ_QCOVER_TOTAL = registry.counter(
+    "pilosa_timeq_qcover_total",
+    "Multi-view time ranges planned as quantum-cover fused ops "
+    "(one single-view stack leaf per cover member)")
+TIMEQ_ROLLUP_TOTAL = registry.counter(
+    "pilosa_timeq_rollup_total",
+    "Completed fine-quantum views OR-folded into their coarser "
+    "parent views by the rollup tick")
+STANDING_REGISTERED = registry.gauge(
+    "pilosa_standing_registered",
+    "Live standing-query registrations")
+STANDING_MAINTAIN = registry.counter(
+    "pilosa_standing_maintain_total",
+    "Standing-query maintenance passes by outcome "
+    "(incremental/fallback/noop)")
+STANDING_MAINTAIN_SECONDS = registry.histogram(
+    "pilosa_standing_maintain_seconds",
+    "Wall seconds per standing-query maintenance pass",
+    buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
+             1.0),
+    quantiles=(0.5, 0.95, 0.99))

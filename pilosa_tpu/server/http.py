@@ -110,6 +110,11 @@ class Server:
         # SQL serving plane ([sql]): SELECT statements ride the fused
         # serving plane with the catalog-fed cost-based planner
         config.apply_sql_settings()
+        # temporal analytics ([timeq] + [standing]): quantum-cover
+        # fused plan op, rollup/write-finest lifecycle, and the
+        # standing-query registry's admission knobs
+        config.apply_timeq_settings()
+        config.apply_standing_settings()
         # statistics catalog ([stats]): persisted flight/roofline
         # telemetry feeding the cost gates, admission classing, cache
         # eviction, and hedge derivation; persisted under the
@@ -192,17 +197,32 @@ class Server:
             watch.stamp("tick")
             try:
                 removed = self.holder.remove_expired_views()
-                if removed:
-                    self.logger.info("ttl removed %d views",
-                                     len(removed))
-                    # an expired quantum view invalidates derived
-                    # state: the dropped fragments' gens were bumped
-                    # (models/field.py), and the serving result cache
-                    # is swept eagerly so no cached Row/Count keeps
-                    # serving the expired window
+                # quantum rollup ([timeq] rollup): completed fine
+                # views OR-fold into their coarser parents so range
+                # covers shrink as data ages
+                from pilosa_tpu.models import timeq
+                folded = (self.holder.rollup_views()
+                          if timeq.rollup_enabled() else [])
+                for _ in folded:
+                    metrics.TIMEQ_ROLLUP_TOTAL.inc()
+                if removed or folded:
+                    if removed:
+                        self.logger.info("ttl removed %d views",
+                                         len(removed))
+                    if folded:
+                        self.logger.info("rolled up %d views",
+                                         len(folded))
+                    # an expired/rolled quantum view invalidates
+                    # derived state: the dropped fragments' gens were
+                    # bumped (models/field.py), the serving result
+                    # cache is swept eagerly so no cached Row/Count
+                    # keeps serving the expired window, and standing
+                    # registrations re-scope their quantum cover (one
+                    # declared fallback each)
                     srv = self.api.executor.serving
                     if srv is not None and srv.cache is not None:
                         srv.cache.sweep(self.holder)
+                        srv.standing.on_write()
                 self.holder.sync()
                 # SLO sample ring: one cumulative reading per tick so
                 # burn-rate windows have history between scrapes
@@ -292,6 +312,12 @@ class Server:
         r(Route("POST", "/index/{index}/import-columns",
                 self._post_import_columns))
         r(Route("POST", "/index/{index}/ingest", self._post_ingest))
+        # standing queries (executor/standing.py): register/list/drop
+        # write-through maintained subscriptions
+        r(Route("POST", "/index/{index}/standing",
+                self._post_standing))
+        r(Route("GET", "/standing", self._get_standing))
+        r(Route("DELETE", "/standing/{sid}", self._delete_standing))
         r(Route("POST", "/internal/translate/{index}/keys/find",
                 self._post_translate_find))
         r(Route("POST", "/internal/translate/{index}/keys/create",
@@ -337,6 +363,9 @@ class Server:
         # recent log-line ring (obs/logger.py) — the tail every
         # incident bundle attaches, served live for correlation
         r(Route("GET", "/debug/logs", self._get_debug_logs))
+        # standing-query registry (executor/standing.py): live
+        # registrations with per-query maintenance outcome counters
+        r(Route("GET", "/debug/standing", self._get_debug_standing))
         r(Route("GET", "/internal/diagnostics", self._get_diagnostics))
         r(Route("GET", "/internal/perf-counters",
                 self._get_perf_counters))
@@ -746,6 +775,54 @@ class Server:
                                 qos=_qos_from_headers(req.headers))
         except PermissionError as e:
             raise ApiError(str(e), 403)
+
+    def _standing_registry(self):
+        srv = self.api.executor.serving
+        if srv is None or srv.cache is None:
+            raise ApiError("standing queries require the serving "
+                           "result cache", 400)
+        return srv.standing
+
+    def _post_standing(self, req):
+        """Register a standing query: body {"query": "<PQL>"} or
+        {"sql": "SELECT COUNT(*) ..."}.  The result is maintained
+        write-through from ingest deltas; polls of the same query
+        text serve the advanced entry (route "standing")."""
+        from pilosa_tpu.executor.standing import StandingUnsupported
+        reg = self._standing_registry()
+        body = req.json_lenient() or {}
+        try:
+            if body.get("sql"):
+                return reg.register_sql(self.api.sql_engine,
+                                        body["sql"])
+            if not body.get("query"):
+                raise ApiError(
+                    "body requires \"query\" (PQL) or \"sql\"", 400)
+            return reg.register(req.vars["index"], body["query"])
+        except StandingUnsupported as e:
+            raise ApiError(str(e), 400)
+
+    def _get_standing(self, req):
+        return {"standing": self._standing_registry().list_info()}
+
+    def _delete_standing(self, req):
+        reg = self._standing_registry()
+        try:
+            sid = int(req.vars["sid"])
+        except ValueError:
+            raise ApiError("standing id must be an integer", 400)
+        if not reg.unregister(sid):
+            raise ApiError(f"standing query not found: {sid}", 404)
+        return {"removed": sid}
+
+    def _get_debug_standing(self, req):
+        """Standing-query registry: registrations with maintenance
+        outcome counters (incremental/fallback/noop) — the operator
+        view of whether subscriptions stay on the O(delta) path."""
+        from pilosa_tpu.executor import standing as _standing
+        reg = self._standing_registry()
+        return {"enabled": _standing.enabled(),
+                "standing": reg.list_info()}
 
     def _post_import_columns(self, req):
         """Binary columnar import — the wire form of

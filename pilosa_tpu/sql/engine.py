@@ -160,9 +160,11 @@ class SQLEngine:
                     # validation still backstops correctness)
                     serving = getattr(self.executor, "serving", None)
                     if serving is not None and serving.cache is not None:
-                        serving.cache.sweep(
-                            self.holder,
-                            self._written_fields(stmts))
+                        wf = self._written_fields(stmts)
+                        serving.cache.sweep(self.holder, wf)
+                        standing = getattr(serving, "standing", None)
+                        if standing is not None:
+                            standing.on_write(None, wf)
         except ExecError as e:  # surface executor errors as SQL errors
             raise SQLError(str(e)) from e
 
@@ -359,6 +361,17 @@ class SQLEngine:
                         stmt, canon, fp, cls, qos, decisions,
                         time.perf_counter() - t0, routes=["cached"])
                     return hit
+                # standing SQL registration: a stale poll pulls
+                # maintenance instead of re-planning the SELECT
+                standing = getattr(serving, "standing", None)
+                if standing is not None and standing.owns(key):
+                    got = standing.catch_up(key)
+                    if got is not _MISS:
+                        self._commit_sql_flight(
+                            stmt, canon, fp, cls, qos, decisions,
+                            time.perf_counter() - t0,
+                            routes=["standing"])
+                        return got
                 metrics.RESULT_CACHE.inc(outcome="miss")
         fl = flight.begin(stmt.table or "", canon)
         inner = _sched.QoS(

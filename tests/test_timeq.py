@@ -265,3 +265,85 @@ def test_server_maintenance_ticker():
         assert all(not v.startswith("standard_2000") for v in f.views)
     finally:
         srv.close()
+
+
+def test_ttl_sweep_bumps_epoch_once():
+    """Satellite (ISSUE 18): one TTL sweep retiring MANY views moves
+    the global mutation epoch exactly ONCE — per-view epoch bumps
+    made every derived consistency check (serving snapshots, stack
+    admission) re-validate N times per sweep for one logical event."""
+    import datetime as dt
+    from pilosa_tpu.models import fragment
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.models.schema import (
+        FieldOptions,
+        FieldType,
+        TimeQuantum,
+    )
+
+    h = Holder(width=1 << 12)
+    idx = h.create_index("ep")
+    for fi in range(2):
+        f = idx.create_field(f"ev{fi}", FieldOptions(
+            type=FieldType.TIME, time_quantum=TimeQuantum("YMDH"),
+            ttl=86400.0))
+        for day in (1, 2, 3):
+            f.set_bit(1, day, timestamp=dt.datetime(2019, 5, day, 6))
+    before = fragment.mutation_epoch()
+    removed = h.remove_expired_views()
+    # many views across two fields retired in one sweep...
+    assert len(removed) > 6
+    # ...one epoch move
+    assert fragment.mutation_epoch() == before + 1
+    # an empty sweep moves nothing
+    before = fragment.mutation_epoch()
+    assert h.remove_expired_views() == []
+    assert fragment.mutation_epoch() == before
+
+
+def test_quantum_cover_fused_bit_exact():
+    """The qcover plan op: a multi-view time range plans as ONE
+    fused op unioning single-view stack leaves — bit-exact against
+    cold execution and against the kill-switched per-row-union plan
+    (PILOSA_TPU_QCOVER=0 A/B)."""
+    import datetime as dt
+    import os
+
+    from pilosa_tpu.executor.executor import Executor
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.models.schema import (
+        FieldOptions,
+        FieldType,
+        TimeQuantum,
+    )
+    from pilosa_tpu.obs import metrics
+
+    h = Holder(width=1 << 12)
+    idx = h.create_index("qc", track_existence=False)
+    f = idx.create_field("ev", FieldOptions(
+        type=FieldType.TIME, time_quantum=TimeQuantum("YMDH")))
+    for day in (1, 2, 3, 4):
+        for c in range(10 * day):
+            f.set_bit(1, c + 100 * day,
+                      timestamp=dt.datetime(2022, 6, day, day))
+    q = ("Count(Row(ev=1, from='2022-06-01T00:00',"
+         " to='2022-06-03T12:00'))")
+    cold = Executor(h).execute("qc", q)
+
+    before = metrics.TIMEQ_QCOVER_TOTAL.value()
+    ex = Executor(h)
+    ex.enable_serving(window_s=0.0, max_batch=8)
+    assert ex.execute_serving("qc", q) == cold
+    assert metrics.TIMEQ_QCOVER_TOTAL.value() > before
+
+    old = os.environ.get("PILOSA_TPU_QCOVER")
+    os.environ["PILOSA_TPU_QCOVER"] = "0"
+    try:
+        ex2 = Executor(h)
+        ex2.enable_serving(window_s=0.0, max_batch=8)
+        assert ex2.execute_serving("qc", q) == cold
+    finally:
+        if old is None:
+            del os.environ["PILOSA_TPU_QCOVER"]
+        else:
+            os.environ["PILOSA_TPU_QCOVER"] = old
