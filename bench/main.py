@@ -23,6 +23,7 @@ import statistics
 import sys
 import time
 
+from bench.audit import audit_smoke
 from bench.chaos import chaos_gauntlet, chaos_smoke, hedge_ab_gauntlet
 from bench.common import (
     NORTH_STAR_CHIPS,
@@ -353,6 +354,8 @@ def dispatch(argv) -> int:
         return write_smoke()
     if "--standing-smoke" in argv:
         return standing_smoke()
+    if "--audit-smoke" in argv:
+        return audit_smoke()
     if "--ragged-smoke" in argv:
         return ragged_smoke()
     if "--kernel-smoke" in argv:

@@ -103,6 +103,24 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 
+echo "== audit smoke =="
+# continuous correctness-auditing gate (bench.py --audit-smoke,
+# bench/audit.py): 32-client mixed read/write gauntlet at a
+# production sampling rate (default 2%) with the shadow-execution
+# verifier live -> CORRECTNESS-ONLY gates: ZERO false positives
+# across the storm (matches and stale_skips are the only legal
+# outcomes), the one-shot audit-corrupt drill caught with EXACTLY
+# one audit-mismatch incident bundle carrying both digests and the
+# producing arm, zero read failures, and the serve-time sampling
+# hook's fixed cost <= 8us (PILOSA_TPU_AUDIT_TAP_MAX_US).  The
+# audit-on/off QPS overhead A/B is recorded in the BENCH JSON,
+# never asserted on a 2-core box.
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python bench.py --audit-smoke; then
+    echo "check.sh: audit smoke failed" >&2
+    exit 1
+fi
+
 echo "== ragged smoke =="
 # ragged dispatch + QoS admission gate (bench.py --ragged-smoke):
 # mixed-index traffic through the fused page-table program +

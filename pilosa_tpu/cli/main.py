@@ -295,6 +295,25 @@ log-ring = 512
 enabled = true
 interval-s = 1.0
 deadline-s = 10.0
+
+[audit]
+# continuous correctness auditing (obs/audit.py): shadow-execution
+# sampling on served reads (re-executed on the independent host
+# oracle arm, compared bit-exact), plus maintenance-ticker scrubbers
+# for the result cache, standing queries, and replica divergence.
+# PILOSA_TPU_AUDIT=0 is the runtime kill-switch; sample-rate is the
+# per-serve sampling probability, route-rates overrides it per route
+# ("cached=0.05,fused=0.01").  Mismatches fire a rate-limited
+# audit-mismatch incident bundle and land in /debug/audit.
+enabled = true
+sample-rate = 0.01
+route-rates = ""
+queue-max = 64
+concurrency = 1
+scrub-cache-n = 4
+scrub-standing-n = 2
+scrub-replica-n = 2
+quarantine = 32
 """
 
 

@@ -194,6 +194,17 @@ class ClusterNode:
         # operator finds every node's black boxes from one curl
         self.server.add_route("GET", "/debug/cluster/incidents",
                               self._debug_cluster_incidents)
+        # correctness-audit federation (ISSUE 19): cluster-wide
+        # quarantine/scrub view, plus the replica anti-entropy scrub
+        # hook — the audit plane (obs/audit.py) stays cluster-
+        # agnostic, so the coordinator (which owns placement and the
+        # block-repair machinery) registers itself as the scrubber
+        self.server.add_route("GET", "/debug/cluster/audit",
+                              self._debug_cluster_audit)
+        self._audit_scrub_cursor = 0
+        _srv = self.api.executor.serving
+        if _srv is not None and getattr(_srv, "audit", None) is not None:
+            _srv.audit.replica_scrub = self.audit_scrub
         # online resharding (ISSUE 14): the donor-side write fence
         # plus the control RPCs the RebalanceController drives over
         # the node-to-node data plane, and the per-shard transfer
@@ -644,6 +655,113 @@ class ClusterNode:
                 repaired += 1
         return repaired
 
+    # -- replica anti-entropy scrub (ISSUE 19) -------------------------
+
+    def audit_scrub(self, budget: int = 2) -> int:
+        """Continuous replica scrub (obs/audit.py ticker hook):
+        compare block checksums of up to ``budget`` locally-
+        replicated fragments against a live co-owner.  Divergence is
+        COUNTED as a detection first
+        (``pilosa_audit_total{kind="replica",outcome="mismatch"}``,
+        quarantine entry, rate-limited ``audit-mismatch`` incident) —
+        then repaired through the same block-pull path
+        ``sync_from_peers`` uses, never silently healed.  Returns the
+        fragments scanned this pass (a rotating cursor spreads full
+        coverage across ticks)."""
+        if budget <= 0:
+            return 0
+        snap = self.snapshot()
+        peers = {n.id: n for n in snap.nodes
+                 if n.id != self.node_id
+                 and n.state == NodeState.STARTED}
+        if not peers:
+            return 0
+        client = self._client()
+        frags: list[tuple] = []
+        for index in sorted(self.api.holder.indexes):
+            idx = self.api.holder.index(index)
+            if idx is None:
+                continue
+            for shard in sorted(idx.available_shards):
+                owners = snap.shard_nodes(index, shard)
+                if self.node_id not in (n.id for n in owners):
+                    continue
+                src = next((n for n in owners if n.id in peers), None)
+                if src is None:
+                    continue  # no live co-owner to compare against
+                for fname in sorted(idx.fields):
+                    frags.append((index, fname, shard, src))
+        if not frags:
+            return 0
+        n = len(frags)
+        start = self._audit_scrub_cursor % n
+        scanned = 0
+        while scanned < min(budget, n):
+            index, fname, shard, src = frags[(start + scanned) % n]
+            scanned += 1
+            try:
+                self._audit_scrub_one(client, src, index, fname, shard)
+            except Exception as e:
+                self.server.logger.warn(
+                    "replica scrub %s/%s/%s failed: %s",
+                    index, fname, shard, e)
+                metrics.AUDIT_TOTAL.inc(kind="replica",
+                                        outcome="error")
+        self._audit_scrub_cursor = (start + scanned) % n
+        return scanned
+
+    def _audit_scrub_one(self, client, src, index, fname, shard):
+        from pilosa_tpu.obs import incidents
+        try:
+            views = client.get_json(
+                src.uri, f"/internal/fragment/{index}/{fname}/views")
+        except _NET_ERRORS + (RemoteError,):
+            return
+        diverged: dict[str, list] = {}
+        for view in views:
+            try:
+                theirs = client.get_json(
+                    src.uri,
+                    f"/internal/fragment/{index}/{fname}/{view}/"
+                    f"{shard}/checksums")
+            except _NET_ERRORS + (RemoteError,):
+                continue
+            mine = self.api.fragment_checksums(index, fname, view,
+                                               shard)
+            bad = sorted(b for b in set(theirs) | set(mine)
+                         if theirs.get(b) != mine.get(b))
+            if bad:
+                diverged[view] = bad
+        if not diverged:
+            metrics.AUDIT_TOTAL.inc(kind="replica", outcome="match")
+            return
+        # detection FIRST, repair second: anti-entropy must surface
+        # divergence, not silently heal it
+        metrics.AUDIT_TOTAL.inc(kind="replica", outcome="mismatch")
+        ent = {"id": f"aud-replica-{self.node_id}-"
+                     f"{index}/{fname}/{shard}",
+               "time": time.time(), "kind": "replica",
+               "index": index,
+               "fragment": f"{index}/{fname}/{shard}",
+               "peer": src.id,
+               "diverged": diverged}
+        srv = self.api.executor.serving
+        plane = getattr(srv, "audit", None) if srv is not None else None
+        repaired = self._repair_fragment(client, src, index, fname,
+                                         shard)
+        ent["repaired_blocks"] = repaired
+        if plane is not None:
+            plane.quarantine.append(ent)
+        incidents.report(
+            "audit-mismatch",
+            detail=(f"replica scrub divergence on "
+                    f"{index}/{fname}/{shard} vs {src.id} "
+                    f"({sum(len(v) for v in diverged.values())} "
+                    f"blocks)"),
+            context=ent)
+        if repaired:
+            metrics.AUDIT_TOTAL.inc(kind="replica", outcome="repaired")
+
     # -- federated observability (ISSUE 10) ----------------------------
 
     def _federate(self, path: str, timeout_s: float):
@@ -878,6 +996,35 @@ class ClusterNode:
                          key=lambda m: -m.get("time", 0))[:limit]
         return {"incidents": entries,
                 "watchdog": stalls,
+                "nodes": sorted(per_node),
+                "unreachable": unreachable,
+                "partial": bool(unreachable)}
+
+    def _debug_cluster_audit(self, req):
+        """Cluster-wide correctness-audit view: fan out /debug/audit
+        to live nodes, merge quarantine entries by id (first sighting
+        wins, node-attributed, newest first) and keep the per-node
+        counter/config payloads verbatim so a divergent kill-switch or
+        sample rate on one node is visible from any node."""
+        from pilosa_tpu.obs import audit
+        q = req.query
+        timeout_s = float(q.get("timeout_ms", ["1000"])[0]) / 1e3
+        srv = self.api.executor.serving
+        per_node = {self.node_id: audit.payload(
+            getattr(srv, "audit", None) if srv is not None else None)}
+        got, unreachable = self._federate("/debug/audit", timeout_s)
+        per_node.update(got)
+        merged: dict[str, dict] = {}
+        for nid in sorted(per_node):
+            doc = per_node[nid] or {}
+            for m in doc.get("quarantine") or ():
+                mid = m.get("id")
+                if mid and mid not in merged:
+                    merged[mid] = {**m, "node": nid}
+        entries = sorted(merged.values(),
+                         key=lambda m: -m.get("time", 0))
+        return {"quarantine": entries,
+                "per_node": per_node,
                 "nodes": sorted(per_node),
                 "unreachable": unreachable,
                 "partial": bool(unreachable)}

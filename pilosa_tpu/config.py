@@ -233,6 +233,23 @@ class Config:
     # live registrations (register past it -> typed error).
     standing_enabled: bool = True
     standing_max: int = 256
+    # continuous correctness auditing ([audit], obs/audit.py): the
+    # shadow-execution sampler + ticker scrubbers.  PILOSA_TPU_AUDIT=0
+    # is the runtime kill-switch and outranks a default-True config;
+    # sample-rate is the per-served-read sampling fraction,
+    # route-rates overrides it per serve route
+    # ("cached=0.05,fused=0.01"), queue-max/concurrency bound the
+    # shadow worker, scrub-*-n budget each ticker scrubber, and
+    # quarantine caps the mismatch evidence ring.
+    audit_enabled: bool = True
+    audit_sample_rate: float = 0.01
+    audit_route_rates: str = ""
+    audit_queue_max: int = 64
+    audit_concurrency: int = 1
+    audit_scrub_cache_n: int = 4
+    audit_scrub_standing_n: int = 2
+    audit_scrub_replica_n: int = 2
+    audit_quarantine: int = 32
 
     def apply_kernel_setting(self):
         """Translate tpu_kernels into the Pallas dispatch env flag.
@@ -423,6 +440,25 @@ class Config:
         standing.configure(enabled=enabled,
                            max_registrations=self.standing_max)
 
+    def apply_audit_settings(self):
+        """Configure the correctness-auditing plane ([audit]).  The
+        PILOSA_TPU_AUDIT env kill-switch outranks a default-True
+        config (same contract as apply_standing_settings)."""
+        from pilosa_tpu.obs import audit
+        enabled = self.audit_enabled
+        if enabled and "PILOSA_TPU_AUDIT" in os.environ:
+            enabled = None  # env kill-switch stays in charge
+        audit.configure(
+            enabled=enabled,
+            sample_rate=self.audit_sample_rate,
+            route_rates=self.audit_route_rates,
+            queue_max=self.audit_queue_max,
+            concurrency=self.audit_concurrency,
+            scrub_cache_n=self.audit_scrub_cache_n,
+            scrub_standing_n=self.audit_scrub_standing_n,
+            scrub_replica_n=self.audit_scrub_replica_n,
+            quarantine=self.audit_quarantine)
+
     def apply_placement_settings(self):
         """Push the [cluster] serving-mesh knobs into the placement
         module (memory/placement.py).  Env twins
@@ -531,6 +567,15 @@ _TOML_KEYS = {
     "timeq.qcover": "timeq_qcover",
     "standing.enabled": "standing_enabled",
     "standing.max": "standing_max",
+    "audit.enabled": "audit_enabled",
+    "audit.sample-rate": "audit_sample_rate",
+    "audit.route-rates": "audit_route_rates",
+    "audit.queue-max": "audit_queue_max",
+    "audit.concurrency": "audit_concurrency",
+    "audit.scrub-cache-n": "audit_scrub_cache_n",
+    "audit.scrub-standing-n": "audit_scrub_standing_n",
+    "audit.scrub-replica-n": "audit_scrub_replica_n",
+    "audit.quarantine": "audit_quarantine",
 }
 
 ENV_PREFIX = "PILOSA_TPU_"

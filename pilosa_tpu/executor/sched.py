@@ -46,6 +46,11 @@ from pilosa_tpu.pql.ast import Query
 
 CLASS_POINT = "point"
 CLASS_HEAVY = "heavy"
+# the correctness-audit plane's dedicated lowest-priority class
+# (obs/audit.py): its own concurrency cap, non-blocking acquisition —
+# audits shed when the cap is busy, they never queue against (or
+# steal) serving slots
+CLASS_AUDIT = "audit"
 
 # calls whose per-query device/host cost is orders beyond a point
 # read: combo enumeration (GroupBy), whole-table materialization
@@ -213,9 +218,12 @@ class AdmissionScheduler:
     shed.  One per ServingLayer."""
 
     def __init__(self, heavy_slots: int = 2, queue_max: int = 128,
-                 tenant_weights: dict[str, float] | None = None):
+                 tenant_weights: dict[str, float] | None = None,
+                 audit_slots: int = 1):
         self.heavy_slots = max(1, int(heavy_slots))
         self.queue_max = max(1, int(queue_max))
+        self.audit_slots = max(1, int(audit_slots))
+        self._audit_running = 0
         self.weights = dict(tenant_weights or {})
         self._cond = threading.Condition()
         # per-tenant state is DROPPED when a tenant's queue drains:
@@ -250,6 +258,29 @@ class AdmissionScheduler:
             if tenant is None:
                 return self._queued
             return len(self._queues.get(tenant, ()))
+
+    # -- the audit gate -------------------------------------------------
+
+    def audit_slot(self):
+        """Non-blocking admission for the correctness-audit class:
+        returns a slot handle (call ``release()`` when done) or None
+        when the cap is busy — the caller sheds the AUDIT, never a
+        serving query.  Audit slots are accounted separately from
+        heavy slots by construction, so a saturated audit plane can
+        never occupy serving concurrency."""
+        with self._cond:
+            if self._audit_running >= self.audit_slots:
+                metrics.ADMISSION_TOTAL.inc(**{"class": CLASS_AUDIT,
+                                               "outcome": "shed"})
+                return None
+            self._audit_running += 1
+        metrics.ADMISSION_TOTAL.inc(**{"class": CLASS_AUDIT,
+                                       "outcome": "admitted"})
+        return _AuditSlot(self)
+
+    def _audit_release(self):
+        with self._cond:
+            self._audit_running = max(0, self._audit_running - 1)
 
     # -- the heavy gate -------------------------------------------------
 
@@ -361,6 +392,26 @@ class AdmissionScheduler:
                 len(q), tenant=self._gauge_tenant(tenant))
             self._drop_if_empty_locked(tenant)
         self._cond.notify_all()
+
+
+class _AuditSlot:
+    __slots__ = ("sched", "_done")
+
+    def __init__(self, sched: AdmissionScheduler):
+        self.sched = sched
+        self._done = False
+
+    def release(self):
+        if not self._done:
+            self._done = True
+            self.sched._audit_release()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
 
 
 class _HeavySlot:

@@ -52,6 +52,8 @@ from pilosa_tpu.executor.stacked import (
     _dispatch_kind,
 )
 from pilosa_tpu.models.index import EXISTENCE_FIELD
+from pilosa_tpu.obs import audit as _audit
+from pilosa_tpu.obs import faults as _faults
 from pilosa_tpu.obs import flight, metrics
 from pilosa_tpu.obs import stats as _stats
 from pilosa_tpu.obs.monitor import capture_exception
@@ -427,6 +429,12 @@ class ResultCache:
         profile estimate, or the duration just measured) — the
         cost-aware eviction's ranking signal; None with the stats
         catalog disabled keeps pure LRU semantics."""
+        if _faults.armed("audit-corrupt") and _faults.take(
+                "audit-corrupt", f"cache:{key[0]}"):
+            # corruption drill (obs/audit.py): the STORED entry gets a
+            # flipped bit while the serve in flight stays clean — the
+            # injection the cache-audit scrubber must catch
+            results = _audit.corrupt_results(results)
         nbytes = _result_nbytes(results)
         if nbytes > self.max_bytes:
             return
@@ -786,6 +794,10 @@ class ServingLayer:
         # standing imports serving's module surface
         from pilosa_tpu.executor.standing import StandingRegistry
         self.standing = StandingRegistry(self)
+        # continuous correctness auditing (obs/audit.py): the shadow
+        # sampler taps every successful read route; workers spawn
+        # lazily on the first sampled serve
+        self.audit = _audit.AuditPlane(self)
 
     def start_prefetcher(self, interval_s: float = 0.5):
         """Warm predicted stack pages off the serving hot path
@@ -937,7 +949,12 @@ class ServingLayer:
                 metrics.QUERY_TOTAL.inc(index=index, status="ok")
                 metrics.QUERY_DURATION.observe(
                     time.perf_counter() - t0)
-                return cache_res
+                # audit tap with the hit's OWN guard snapshot: get()
+                # verified the entry against `snap`, so the answer is
+                # proven to reflect exactly that fragment-version state
+                return _audit.tap(self.audit, index, idx, q, shards,
+                                  key, fields, snap, "cached",
+                                  cache_res, fl)
             if self.cache is not None and fields is not None:
                 metrics.RESULT_CACHE.inc(outcome="miss")
             # a registry-owned key pulls maintenance instead of
@@ -949,7 +966,15 @@ class ServingLayer:
                     metrics.QUERY_TOTAL.inc(index=index, status="ok")
                     metrics.QUERY_DURATION.observe(
                         time.perf_counter() - t0)
-                    return got
+                    # the registry's snapshot is the one that provably
+                    # covers the maintained result (catch_up may have
+                    # advanced past `snap` taken at admission)
+                    sq = self.standing._by_key.get(key)
+                    return _audit.tap(
+                        self.audit, index, idx, q, shards, key,
+                        fields,
+                        sq.snapshot if sq is not None else None,
+                        "standing", got, fl)
             # classification pays a shard-list sort — skip it
             # entirely in cache-only mode
             req = (self._classify(index, idx, q, shards, fields, key,
@@ -972,14 +997,19 @@ class ServingLayer:
                     metrics.QUERY_TOTAL.inc(index=index, status="ok")
                     metrics.QUERY_DURATION.observe(
                         time.perf_counter() - t0)
-                    return req.result
+                    # req.snapshot survived the batch post-pass
+                    # re-check, so it provably covers the fused answer
+                    return _audit.tap(self.audit, index, idx, q,
+                                      shards, key, fields,
+                                      req.snapshot, "fused",
+                                      req.result, fl)
                 # fallback on THIS thread: failed/stale fused serves
                 # re-execute in parallel across their callers, not
                 # serially on the batch leader.  snap is stale here by
                 # definition — _exec_and_cache takes a fresh one.
                 snap = None
             return self._exec_and_cache(index, idx, q, shards, fields,
-                                        key, snap)
+                                        key, snap, fl=fl)
         except Exception as e:
             err = f"{type(e).__name__}: {e}"
             raise
@@ -1395,7 +1425,7 @@ class ServingLayer:
     # -- solo path with cache store ------------------------------------
 
     def _exec_and_cache(self, index, idx, q, shards, fields, key,
-                        snap=None):
+                        snap=None, fl=None):
         """Solo execution with the store protocol: snapshot before,
         execute, store only if the snapshot held.  `snap`, when
         given, must have been taken pre-execution on this path."""
@@ -1416,6 +1446,12 @@ class ServingLayer:
         # would make the cached value's snapshot provenance unclear)
         if field_snapshot(idx, fields, sset) == snap:
             self.cache.put(key, fields, snap, results, cost_ms=cost)
+            # audit tap ONLY on held snapshots: a raced execution has
+            # no provable provenance and sampling it could produce a
+            # shadow false positive
+            results = _audit.tap(self.audit, index, idx, q, shards,
+                                 key, fields, snap, "solo", results,
+                                 fl)
         return results
 
     @staticmethod

@@ -55,7 +55,7 @@ from pilosa_tpu.executor.serving import (
 from pilosa_tpu.models.index import EXISTENCE_FIELD
 from pilosa_tpu.models.schema import CACHE_TYPE_NONE
 from pilosa_tpu.models.view import VIEW_STANDARD
-from pilosa_tpu.obs import flight, metrics
+from pilosa_tpu.obs import faults, flight, metrics
 from pilosa_tpu.pql import parse
 from pilosa_tpu.pql.ast import Call, Query
 
@@ -384,6 +384,13 @@ class StandingRegistry:
             self._assemble(sq, idx)
             sq.snapshot = snap
             sq.error = None
+            if faults.armed("audit-corrupt") and faults.take(
+                    "audit-corrupt", f"standing:{sq.sid}"):
+                # corruption drill (obs/audit.py): flip a bit in the
+                # maintained result — the standing drift audit must
+                # catch it at the next quiesce-point scrub
+                from pilosa_tpu.obs import audit as _audit
+                sq.results = _audit.corrupt_results(sq.results)
         except StandingUnsupported as e:
             # the query drifted out of the maintainable shape (e.g. a
             # Rows row set the groupby path cannot follow): retire it

@@ -64,6 +64,14 @@ Fault points wired through the stack (the point name is the contract;
                           (detail: ``partition=N``) — rollback must lift
                           the fences so blocked writers proceed on the
                           donor, and no epoch has zero or two owners
+``audit-corrupt``         Correctness-audit drill (obs/audit.py): flip one
+                          bit in a served result (detail:
+                          ``serve:{route}:{index}``), a stored ResultCache
+                          entry (detail: ``cache:{index}``), or a maintained
+                          standing result (detail: ``standing:{sid}``) —
+                          the injection that PROVES the shadow/cache/
+                          standing verifiers detect; armed only via the
+                          test/config API like every other point
 ========================  ====================================================
 
 Arming:

@@ -72,7 +72,7 @@ _STATS_PROFILES = 16
 
 TRIGGERS = ("slo-burn", "perf-regression", "watchdog-stall",
             "device-oom", "batch-leader-exception", "ingest-crash",
-            "manual")
+            "audit-mismatch", "manual")
 
 
 def format_stack(frame, max_frames: int = 64) -> str:

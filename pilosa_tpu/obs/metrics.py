@@ -602,6 +602,13 @@ WATCHDOG_STALLS = registry.counter(
     "ingest-window/rebalance-controller/maintenance-ticker/"
     "heartbeat:*)")
 
+# -- continuous correctness auditing (obs/audit.py) --
+AUDIT_TOTAL = registry.counter(
+    "pilosa_audit_total",
+    "Correctness-audit events by verifier kind (shadow/cache/"
+    "standing/replica) and outcome (sampled/match/mismatch/"
+    "stale_skip/shed/unguarded/repaired/error)")
+
 # -- SLO burn-rate plane (obs/slo.py) --
 SLO_BURN_RATE = registry.gauge(
     "pilosa_slo_burn_rate",

@@ -127,6 +127,10 @@ class Server:
         # in every bundle
         config.apply_watchdog_settings()
         config.apply_incident_settings(data_dir=self.holder.path)
+        # continuous correctness auditing ([audit], obs/audit.py):
+        # shadow-execution sampler on the serving routes + the
+        # maintenance-ticker scrubbers below
+        config.apply_audit_settings()
         if (self.api.executor.serving is not None
                 and config.memory_prefetch):
             self.api.executor.serving.start_prefetcher(
@@ -238,6 +242,12 @@ class Server:
                 # stays off — collection is in-process only)
                 from pilosa_tpu.obs import diagnostics
                 diagnostics.collect()
+                # correctness-audit scrubbers (obs/audit.py): sampled
+                # ResultCache recomputes, standing drift checks at
+                # quiesce, and — on cluster nodes — the replica
+                # block-checksum scrub, each budgeted per tick
+                from pilosa_tpu.obs import audit
+                audit.tick(self.api.executor.serving)
             except Exception as e:
                 self.logger.error("maintenance tick failed: %s", e)
             finally:
@@ -273,6 +283,9 @@ class Server:
             self.logger.warn("stats snapshot on close failed: %s", e)
         if self.api.executor.serving is not None:
             self.api.executor.serving.stop_prefetcher()
+            aud = getattr(self.api.executor.serving, "audit", None)
+            if aud is not None:
+                aud.close()
         if self.stream is not None:
             self.stream.close()
         self._ticker_stop.set()
@@ -366,6 +379,9 @@ class Server:
         # standing-query registry (executor/standing.py): live
         # registrations with per-query maintenance outcome counters
         r(Route("GET", "/debug/standing", self._get_debug_standing))
+        # continuous correctness auditing (obs/audit.py): recent
+        # samples, mismatch quarantine ring, scrub progress
+        r(Route("GET", "/debug/audit", self._get_debug_audit))
         r(Route("GET", "/internal/diagnostics", self._get_diagnostics))
         r(Route("GET", "/internal/perf-counters",
                 self._get_perf_counters))
@@ -539,6 +555,10 @@ class Server:
             ?route=fused|cached|direct|solo|cluster|ingest
             ?tenant=NAME          serving-path tenant attribution
             ?since_ms=EPOCH_MS    records started at/after this time
+            ?audited=1|0          audit-sampled serves only (or the
+                                  never-sampled remainder) — the hop
+                                  from an audit-mismatch incident
+                                  bundle to the query's full trace
         """
         from pilosa_tpu.obs import flight
         q = req.query
@@ -551,7 +571,8 @@ class Server:
             flight.recorder.recent(len(flight.recorder)),
             route=q.get("route", [None])[0],
             tenant=q.get("tenant", [None])[0],
-            since_ms=q.get("since_ms", [None])[0])
+            since_ms=q.get("since_ms", [None])[0],
+            audited=q.get("audited", [None])[0])
         return {"enabled": flight.recorder.enabled,
                 "matched": len(recs),
                 "queries": recs[:max(0, limit)]}
@@ -824,6 +845,15 @@ class Server:
         return {"enabled": _standing.enabled(),
                 "standing": reg.list_info()}
 
+    def _get_debug_audit(self, req):
+        """Continuous correctness auditing (obs/audit.py): sampler
+        config, per-kind/outcome counters, recent samples, the
+        mismatch quarantine ring, and scrub progress."""
+        from pilosa_tpu.obs import audit
+        srv = self.api.executor.serving
+        return audit.payload(getattr(srv, "audit", None)
+                             if srv is not None else None)
+
     def _post_import_columns(self, req):
         """Binary columnar import — the wire form of
         API.import_columns for out-of-process ingesters (the
@@ -1081,11 +1111,12 @@ class Server:
 
 
 def filter_flight_records(recs: list, route=None, tenant=None,
-                          since_ms=None) -> list:
+                          since_ms=None, audited=None) -> list:
     """The /debug/queries filter predicates (route / tenant /
-    since_ms) — ONE implementation shared with the federated
-    /debug/cluster/queries (cluster/coordinator.py) so the merged
-    endpoint applies exactly what the per-node endpoint does."""
+    since_ms / audited) — ONE implementation shared with the
+    federated /debug/cluster/queries (cluster/coordinator.py) so the
+    merged endpoint applies exactly what the per-node endpoint
+    does."""
     if route is not None:
         recs = [r for r in recs if r.get("route") == route]
     if tenant is not None:
@@ -1093,6 +1124,9 @@ def filter_flight_records(recs: list, route=None, tenant=None,
     if since_ms is not None:
         cut = float(since_ms) / 1e3
         recs = [r for r in recs if r.get("start", 0.0) >= cut]
+    if audited is not None:
+        want = str(audited).lower() not in ("0", "false", "")
+        recs = [r for r in recs if bool(r.get("audited")) == want]
     return recs
 
 

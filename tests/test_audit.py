@@ -1,0 +1,400 @@
+"""Continuous correctness auditing (obs/audit.py): production
+shadow-execution verifier, replica scrubber, maintained-result drift
+audits.
+
+The contract under test: the audit plane NEVER false-positives (a
+write racing a sampled serve skips-and-counts, it does not fire), the
+``audit-corrupt`` drill is ALWAYS caught by every verifier kind
+(shadow / cache / standing / replica), the kill switch restores
+bit-exact untouched serving, a saturated audit queue sheds audits —
+never queries — and a hand-diverged replica block is detected (counted
+as a mismatch, incident fired) and then repaired through the existing
+resync path.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.executor.executor import Executor
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.models.schema import FieldOptions, FieldType
+from pilosa_tpu.obs import audit, faults, incidents
+
+
+@pytest.fixture(autouse=True)
+def _reset_audit():
+    audit.configure(enabled=True, sample_rate=0.01, route_rates={},
+                    queue_max=64, concurrency=1, scrub_cache_n=4,
+                    scrub_standing_n=2, scrub_replica_n=2,
+                    quarantine=32)
+    faults.clear()
+    yield
+    faults.clear()
+    audit.configure(enabled=True, sample_rate=0.01, route_rates={})
+
+
+@pytest.fixture()
+def fresh_incidents(tmp_path):
+    m = incidents.IncidentManager(dir=str(tmp_path / "inc"),
+                                  min_interval_s=3600.0)
+    prev = incidents.swap(m)
+    yield m
+    incidents.swap(prev)
+
+
+def build(n=200):
+    h = Holder(width=1 << 12)
+    idx = h.create_index("i")
+    idx.create_field("a", FieldOptions(type=FieldType.SET,
+                                       cache_type="none"))
+    idx.create_field("b")
+    ex = Executor(h)
+    for c in range(n):
+        ex.execute("i", f"Set({c}, a={c % 4})")
+        ex.execute("i", f"Set({c}, b={c % 6})")
+    srv = ex.enable_serving(window_s=0.0, max_batch=8)
+    return h, ex, srv
+
+
+def outcome(srv, kind, oc):
+    return srv.audit.counts.get((kind, oc), 0)
+
+
+# ---------------------------------------------------------------------------
+# no false positives
+# ---------------------------------------------------------------------------
+
+def test_no_false_positives_under_write_storm():
+    """Seeded property run: sample EVERY serve (rate 1.0) while
+    writes interleave with reads.  Matches and stale_skips are the
+    only legal shadow outcomes — one mismatch is a plane bug."""
+    audit.configure(sample_rate=1.0)
+    h, ex, srv = build(n=160)
+    srv.audit.seed(0xF00D)
+    rng = np.random.default_rng(0xF00D)
+    qs = ["Count(Row(a=1))", "Row(a=2)", "TopN(a, n=3)",
+          "Count(Union(Row(a=0), Row(b=5)))",
+          "GroupBy(Rows(a), Rows(b))"]
+    for step in range(60):
+        col = int(rng.integers(0, 500))
+        fld = "a" if rng.integers(0, 2) else "b"
+        rid = int(rng.integers(0, 4 if fld == "a" else 6))
+        op = "Clear" if rng.integers(0, 3) == 0 else "Set"
+        ex.execute_serving("i", f"{op}({col}, {fld}={rid})")
+        ex.execute_serving("i", qs[step % len(qs)])
+    assert srv.audit.wait_idle(30)
+    assert outcome(srv, "shadow", "mismatch") == 0, \
+        srv.audit.describe()
+    assert outcome(srv, "shadow", "match") > 0
+    assert not srv.audit.quarantine
+
+
+def test_scrubbers_no_false_positives():
+    """Cache + standing scrub passes over a live (quiesced) system
+    must come back all-match."""
+    audit.configure(sample_rate=1.0, scrub_cache_n=8,
+                    scrub_standing_n=8)
+    h, ex, srv = build(n=120)
+    srv.standing.register("i", "Count(Row(a=1))")
+    srv.standing.register("i", "TopN(a, n=2)")
+    for q in ["Count(Row(a=0))", "Row(a=3)", "Count(Row(b=2))"]:
+        ex.execute_serving("i", q)
+        ex.execute_serving("i", q)  # second serve hits the cache
+    assert srv.audit.wait_idle(30)
+    srv.audit.scrub()
+    assert srv.audit.wait_idle(30)
+    d = srv.audit.describe()
+    assert outcome(srv, "cache", "mismatch") == 0, d
+    assert outcome(srv, "standing", "mismatch") == 0, d
+    assert outcome(srv, "cache", "match") > 0, d
+    assert outcome(srv, "standing", "match") > 0, d
+    assert d["scrub"]["cache_scanned"] > 0
+    assert d["scrub"]["standing_scanned"] == 2
+
+
+# ---------------------------------------------------------------------------
+# the audit-corrupt drill: every verifier kind must catch it
+# ---------------------------------------------------------------------------
+
+def test_corruption_drill_serve_seam(fresh_incidents):
+    """A bit flipped in a SERVED result (the answer the client saw)
+    is caught by the shadow verifier: exactly one mismatch, exactly
+    one incident bundle carrying both digests and both arms."""
+    audit.configure(sample_rate=1.0)
+    h, ex, srv = build()
+    q = "Count(Row(a=1))"
+    clean = Executor(h).execute("i", q)
+    faults.inject("audit-corrupt", match="serve:", times=1)
+    served = ex.execute_serving("i", q)
+    assert served != clean  # the drill corrupted what was served
+    assert srv.audit.wait_idle(30)
+    assert outcome(srv, "shadow", "mismatch") == 1
+    (ent,) = srv.audit.quarantine
+    assert ent["kind"] == "shadow"
+    assert ent["live_digest"] != ent["shadow_digest"]
+    assert ent["shadow_arm"]["arm"] == "host-loop"
+    assert ent["shadow_arm"]["use_stacked"] is False
+    assert ent["live_arm"]["route"] in ("solo", "fused", "cached")
+    # exactly ONE bundle (min_interval_s dedups any repeat)
+    assert fresh_incidents.wait_idle(10)
+    bundles = [b for b in fresh_incidents.list()
+               if b["trigger"] == "audit-mismatch"]
+    assert len(bundles) == 1
+    ctx = fresh_incidents.fetch(bundles[0]["id"])["context"]
+    assert ctx["live_digest"] == ent["live_digest"]
+    assert ctx["shadow_digest"] == ent["shadow_digest"]
+    # the drill was one-shot: the next serve is clean and matches
+    assert ex.execute_serving("i", q) == clean
+    assert srv.audit.wait_idle(30)
+    assert outcome(srv, "shadow", "mismatch") == 1
+
+
+def test_corruption_drill_cache_seam():
+    """A bit flipped in a STORED ResultCache entry (the serve in
+    flight stays clean) is caught by the cache scrubber."""
+    audit.configure(sample_rate=1.0)
+    h, ex, srv = build()
+    q = "Count(Row(a=0))"
+    clean = Executor(h).execute("i", q)
+    # first serve: stores clean, notes the key in the side-table
+    assert ex.execute_serving("i", q) == clean
+    assert srv.audit.wait_idle(30)
+    # invalidate, arm, re-serve: the re-store corrupts the ENTRY only
+    ex.execute_serving("i", "Set(9001, a=3)")
+    faults.inject("audit-corrupt", match="cache:", times=1)
+    assert ex.execute_serving("i", q) == clean
+    assert srv.audit.wait_idle(30)
+    assert outcome(srv, "shadow", "mismatch") == 0  # serve was clean
+    srv.audit.scrub()
+    assert srv.audit.wait_idle(30)
+    assert outcome(srv, "cache", "mismatch") == 1, \
+        srv.audit.describe()
+    ents = [e for e in srv.audit.quarantine if e["kind"] == "cache"]
+    assert len(ents) == 1
+    assert ents[0]["live_digest"] != ents[0]["shadow_digest"]
+
+
+def test_corruption_drill_standing_seam():
+    """A bit flipped in a MAINTAINED standing result is caught by the
+    drift audit at the next scrub quiesce point."""
+    audit.configure(sample_rate=0.0)  # scrub-only detection
+    h, ex, srv = build()
+    q = "Count(Row(a=1))"
+    srv.standing.register("i", q)
+    faults.inject("audit-corrupt", match="standing:", times=1)
+    ex.execute_serving("i", "Set(9002, a=1)")
+    ex.execute_serving("i", q)  # maintenance runs; drill corrupts
+    srv.audit.scrub()
+    assert srv.audit.wait_idle(30)
+    assert outcome(srv, "standing", "mismatch") == 1, \
+        srv.audit.describe()
+    ents = [e for e in srv.audit.quarantine
+            if e["kind"] == "standing"]
+    assert len(ents) == 1
+    assert ents[0]["live_digest"] != ents[0]["shadow_digest"]
+
+
+# ---------------------------------------------------------------------------
+# kill switch
+# ---------------------------------------------------------------------------
+
+def test_kill_switch_bit_exact(monkeypatch):
+    """PILOSA_TPU_AUDIT=0 disables the whole plane at runtime and the
+    A/B serve stream stays bit-exact against cold execution."""
+    audit.configure(sample_rate=1.0)
+    h, ex, srv = build(n=120)
+    cold = Executor(h)
+    qs = ["Count(Row(a=1))", "Row(a=2)", "TopN(a, n=3)"]
+    on = [ex.execute_serving("i", q) for q in qs]
+    assert srv.audit.wait_idle(30)
+    sampled_before = outcome(srv, "shadow", "sampled")
+    assert sampled_before == len(qs)
+    monkeypatch.setenv("PILOSA_TPU_AUDIT", "0")
+    assert not audit.enabled()
+    off = [ex.execute_serving("i", q) for q in qs]
+    srv.audit.scrub()  # scrub gate no-ops too
+    assert on == off == [cold.execute("i", q) for q in qs]
+    assert outcome(srv, "shadow", "sampled") == sampled_before
+    assert srv.audit.scrub_stats["ticks"] == 0
+    monkeypatch.delenv("PILOSA_TPU_AUDIT")
+    ex.execute_serving("i", qs[0])
+    assert srv.audit.wait_idle(30)
+    assert outcome(srv, "shadow", "sampled") == sampled_before + 1
+
+
+def test_env_twin_and_route_rates(monkeypatch):
+    """[audit] config knobs flow through apply_audit_settings, env
+    twins win, and route-rate overrides beat the global rate."""
+    from pilosa_tpu import config as cfg
+    monkeypatch.setenv("PILOSA_TPU_AUDIT_SAMPLE_RATE", "0.5")
+    monkeypatch.setenv("PILOSA_TPU_AUDIT_ROUTE_RATES",
+                       "cached=1.0,fused=0")
+    c = cfg.load()
+    assert c.audit_sample_rate == 0.5
+    assert audit.parse_route_rates(c.audit_route_rates) == \
+        {"cached": 1.0, "fused": 0.0}
+    c.apply_audit_settings()
+    try:
+        assert audit._SAMPLE_RATE == 0.5
+        assert audit._ROUTE_RATES == {"cached": 1.0, "fused": 0.0}
+    finally:
+        audit.configure(sample_rate=0.01, route_rates={})
+    # malformed operator input is ignored, never raises
+    assert audit.parse_route_rates("garbage,=3,x=notafloat") == {}
+
+
+# ---------------------------------------------------------------------------
+# scheduler-class isolation
+# ---------------------------------------------------------------------------
+
+def test_saturated_audit_plane_sheds_audits_not_queries():
+    """Audit slots busy + queue full: every audit sheds (counted),
+    every query still answers bit-exact.  Audits can never steal
+    serving capacity."""
+    audit.configure(sample_rate=1.0, queue_max=1)
+    h, ex, srv = build(n=120)
+    cold = Executor(h)
+    slot = srv.sched.audit_slot()  # hold the ONLY audit slot
+    assert slot is not None
+    assert srv.sched.audit_slot() is None  # cap enforced
+    try:
+        for i in range(10):
+            q = f"Count(Row(a={i % 4}))"
+            assert ex.execute_serving("i", q) == cold.execute("i", q)
+        # give queued samples time to reach the busy-cap check
+        import time
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and srv.audit.queue_depth():
+            time.sleep(0.01)
+    finally:
+        slot.release()
+    assert srv.audit.wait_idle(30)
+    d = srv.audit.describe()
+    assert outcome(srv, "shadow", "match") == 0, d
+    assert outcome(srv, "shadow", "mismatch") == 0, d
+    assert outcome(srv, "shadow", "shed") == 10, d
+    # released: the plane verifies again
+    ex.execute_serving("i", "Count(Row(a=1))")
+    assert srv.audit.wait_idle(30)
+    assert outcome(srv, "shadow", "match") == 1
+
+
+# ---------------------------------------------------------------------------
+# flight-record integration
+# ---------------------------------------------------------------------------
+
+def test_flight_records_carry_audit_outcome():
+    from pilosa_tpu.obs import flight
+    from pilosa_tpu.server.http import filter_flight_records
+    audit.configure(sample_rate=0.0)
+    h, ex, srv = build()
+    ex.execute_serving("i", "Count(Row(a=2))")  # never sampled
+    audit.configure(sample_rate=1.0)
+    ex.execute_serving("i", "Count(Row(a=1))")
+    assert srv.audit.wait_idle(30)
+    recs = flight.recorder.recent(50)
+    hits = filter_flight_records(recs, audited="1")
+    assert hits and all(r["audited"] for r in hits)
+    assert any(r.get("audit_outcome") == "match" for r in hits)
+    misses = filter_flight_records(recs, audited="0")
+    assert all(not r.get("audited") for r in misses)
+    assert len(hits) + len(misses) == len(recs)
+
+
+# ---------------------------------------------------------------------------
+# replica anti-entropy scrub (cluster)
+# ---------------------------------------------------------------------------
+
+def test_replica_scrub_detects_and_repairs(fresh_incidents):
+    """A hand-diverged fragment block on one replica is DETECTED
+    (mismatch counted, quarantine entry, incident bundle) and then
+    repaired through the existing block-pull path — checksums agree
+    again afterwards."""
+    from pilosa_tpu.cluster import ClusterNode, InMemDisCo
+    disco = InMemDisCo(lease_ttl=30)
+    nodes = [ClusterNode(f"n{i}", disco, holder=Holder(),
+                         replica_n=2, heartbeat_interval=30).open()
+             for i in range(2)]
+    try:
+        n0, n1 = nodes
+        n0.apply_schema({"indexes": [{"name": "c", "fields": [
+            {"name": "f", "options": {"type": "set"}}]}]})
+        cols = list(range(64))
+        n0.import_bits("c", "f", [1] * len(cols), cols)
+        assert n0.query("c", "Count(Row(f=1))")["results"] == [64]
+        # hand-diverge n0's local copy, bypassing replication
+        n0.api.holder.index("c").field("f").set_bit(1, 1000)
+        before = n0.api.fragment_checksums("c", "f", "standard", 0)
+        assert before != n1.api.fragment_checksums(
+            "c", "f", "standard", 0)
+        scanned = n0.audit_scrub(budget=16)
+        assert scanned > 0
+        ents = [e for e in n0.api.executor.serving.audit.quarantine
+                if e["kind"] == "replica"]
+        assert len(ents) == 1
+        assert ents[0]["fragment"] == "c/f/0"
+        assert ents[0]["diverged"]
+        assert ents[0]["repaired_blocks"] > 0
+        # repaired: local checksums converge back to the peer's
+        assert n0.api.fragment_checksums("c", "f", "standard", 0) \
+            == n1.api.fragment_checksums("c", "f", "standard", 0)
+        assert n0.query("c", "Count(Row(f=1))")["results"] == [64]
+        assert fresh_incidents.wait_idle(10)
+        assert any(b["trigger"] == "audit-mismatch"
+                   for b in fresh_incidents.list())
+        # a second pass over the healed cluster finds nothing
+        n0.api.executor.serving.audit.quarantine.clear()
+        n0.audit_scrub(budget=16)
+        assert not n0.api.executor.serving.audit.quarantine
+    finally:
+        for n in nodes:
+            n.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP + federation surface
+# ---------------------------------------------------------------------------
+
+def _req(port, method, path, body=None):
+    import http.client
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+    data = json.dumps(body) if isinstance(body, (dict, list)) else body
+    c.request(method, path, body=data,
+              headers={"Content-Type": "application/json"})
+    r = c.getresponse()
+    raw = r.read()
+    c.close()
+    return r.status, json.loads(raw or b"{}")
+
+
+def test_debug_audit_http_and_federation():
+    from pilosa_tpu.cluster import ClusterNode, InMemDisCo
+    node = ClusterNode("n0", InMemDisCo(lease_ttl=30), replica_n=1,
+                       heartbeat_interval=30).open()
+    try:
+        # AFTER open: server startup applies the default [audit] config
+        audit.configure(sample_rate=1.0)
+        node.apply_schema({"indexes": [{"name": "c", "fields": [
+            {"name": "f", "options": {"type": "set"}}]}]})
+        node.import_bits("c", "f", [1, 1], [0, 1])
+        node.query("c", "Count(Row(f=1))")
+        srv = node.api.executor.serving
+        assert srv.audit.wait_idle(30)
+        port = node.server.port
+        st, d = _req(port, "GET", "/debug/audit")
+        assert st == 200 and d["enabled"] and d["active"]
+        assert d["sample_rate"] == 1.0
+        assert any(k.startswith("shadow:") for k in d["counts"])
+        st, d = _req(port, "GET", "/debug/cluster/audit")
+        assert st == 200 and not d["partial"]
+        assert d["nodes"] == ["n0"]
+        assert d["per_node"]["n0"]["active"]
+        # the audited-flight filter over HTTP
+        st, d = _req(port, "GET", "/debug/queries?audited=1")
+        assert st == 200
+        assert d["queries"] and all(r["audited"]
+                                    for r in d["queries"])
+    finally:
+        node.close()
