@@ -25,6 +25,7 @@ import time
 
 from bench.audit import audit_smoke
 from bench.chaos import chaos_gauntlet, chaos_smoke, hedge_ab_gauntlet
+from bench.dax import dax_gauntlet, dax_smoke
 from bench.common import (
     NORTH_STAR_CHIPS,
     NORTH_STAR_MS,
@@ -135,6 +136,11 @@ def main() -> None:
     # writes bit-exact on the recipient, then a drain under the same
     # gates
     rebalance = rebalance_gauntlet()
+    # disaggregation gauntlet (ISSUE 20): an empty-data-dir worker
+    # serving a >=10x-over-budget corpus from the blob tier bit-exact
+    # vs the local-disk fleet, and an SLO-burn-driven scale-out/in
+    # cycle under a read storm with the incident bundle over HTTP
+    dax = dax_gauntlet()
     # sparse-format skewed gauntlet (ISSUE 16): Zipfian index (<=1%
     # dense rows) served with the container-adaptive paged layout on
     # vs off — bit-exact hard-gated, ledger-bytes + Count/TopN p50
@@ -278,6 +284,12 @@ def main() -> None:
         # the recipient vs cold rebuild, event-window p99 spike vs
         # baseline, owner-invariant probe sampled throughout
         "rebalance_gauntlet": rebalance,
+        # disaggregated tier (ISSUE 20): Cold-start cell (blob-fed
+        # stateless worker at >=10x ledger overcommit, bit-exact,
+        # warmup recorded) + Autoscale cell (SLO burn trip -> live
+        # standby admission -> recovery -> drain, zero failed/
+        # mismatched, incident bundle fetched over HTTP)
+        "dax_gauntlet": dax,
         # sparse-format A/B (ISSUE 16): working-set-per-ledger-byte
         # and Count/TopN p50 ratios, packed-page evidence
         # (pilosa_stack_pages_total{encoding=packed} delta per arm)
@@ -366,6 +378,8 @@ def dispatch(argv) -> int:
         return sql_smoke()
     if "--rebalance-smoke" in argv:
         return rebalance_smoke()
+    if "--dax-smoke" in argv:
+        return dax_smoke()
     if "--incident-smoke" in argv:
         return incident_smoke()
     if "--sparse-smoke" in argv:

@@ -225,6 +225,23 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 
+echo "== dax smoke =="
+# disaggregated-tier gate (bench.py --dax-smoke, bench/dax.py):
+# an empty-data-dir worker serves a >=10x-over-budget corpus from
+# blob manifests bit-exact vs the local-disk fleet (ledger never
+# over budget, real evictions + re-hydrations), then an injected
+# storm trips the SLO burn threshold and the autoscaler admits the
+# standby live with a scale-event-interrupted fault armed — the run
+# must resume, show zero failed / zero mismatched queries, recover
+# burn, drain the worker back, and serve the scale event's incident
+# bundle over HTTP.  CORRECTNESS-ONLY gates (2-core rule): warmup
+# walls, QPS, and latency are recorded in the JSON, never asserted.
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python bench.py --dax-smoke; then
+    echo "check.sh: dax smoke failed" >&2
+    exit 1
+fi
+
 echo "== tier-1 (budget ${BUDGET}s) =="
 # per-run log (concurrent gates must not clobber each other);
 # no pipe around pytest: under plain sh a `... | tee` pipeline would

@@ -64,6 +64,7 @@ def cmd_server(args) -> int:
     cfg.apply_roofline_settings()
     cfg.apply_slo_settings()
     cfg.apply_watchdog_settings()
+    cfg.apply_dax_settings()
     holder = Holder(path=cfg.data_dir) if cfg.data_dir else Holder()
     holder.load_schema()
     auth = None
@@ -314,6 +315,38 @@ scrub-cache-n = 4
 scrub-standing-n = 2
 scrub-replica-n = 2
 quarantine = 32
+
+[blob]
+# blob shard store (storage/blob.py) — the disaggregated tier's one
+# durable home.  backend "" disables the tier; "dir" keeps objects
+# under root (default <data-dir>/blob); "mem" is the in-process
+# fault-drill arm.  Env twins: PILOSA_TPU_BLOB_BACKEND / _BLOB_ROOT.
+backend = ""
+root = ""
+
+[dax]
+# disaggregated compute tier (dax/worker.py + dax/controller.py).
+# blob is the tier switch (PILOSA_TPU_DAX_BLOB=0 kills it at
+# runtime); lazy-hydrate materializes shards on first touch;
+# worker-budget-bytes bounds each stateless worker's resident set
+# through its private HBM ledger (0 = unbounded).  The autoscaler
+# scales out past scale-out-burn (SLO burn rate) or pressure-high
+# (ledger fill fraction), scales in under scale-in-burn, admits from
+# standby warm spares, and never leaves [min-workers, max-workers].
+blob = true
+lazy-hydrate = true
+worker-budget-bytes = 0
+prefetch = 2
+scale-out-burn = 2.0
+scale-in-burn = 0.5
+pressure-high = 0.9
+min-workers = 1
+max-workers = 8
+standby = 1
+reconcile-interval-s = 5.0
+cooldown-s = 30.0
+chase-lag = 8
+chase-rounds = 12
 """
 
 
@@ -365,6 +398,15 @@ def cmd_dax(args) -> int:
                 "storage %s)", args.bind, front.port, args.workers,
                 args.data_dir)
     try:
+        if svc.blob is not None:
+            # disaggregated shape: warm spares + the autoscaler's
+            # reconcile loop ([dax] standby / thresholds)
+            from pilosa_tpu.dax import settings as dax_settings
+            for i in range(dax_settings.standby()):
+                svc.add_standby(f"standby{i}")
+            svc.start_autoscaler()
+            logger.info("dax blob tier active (%d standby)",
+                        dax_settings.standby())
         svc.controller.start_poller()
         while True:
             _time.sleep(3600)

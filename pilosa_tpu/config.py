@@ -251,6 +251,28 @@ class Config:
     audit_scrub_replica_n: int = 2
     audit_quarantine: int = 32
 
+    # -- disaggregated DAX tier ([dax] + [blob], dax/settings.py) --
+    # blob names the tier kill-switch (PILOSA_TPU_DAX_BLOB=0 outranks
+    # it); backend/root pick the blob store; worker-budget-bytes
+    # bounds each stateless worker's resident set (0 = unbounded);
+    # the scale-* thresholds drive the autoscaler's reconcile loop.
+    blob_backend: str = ""
+    blob_root: str = ""
+    dax_blob: bool = True
+    dax_lazy_hydrate: bool = True
+    dax_worker_budget_bytes: int = 0
+    dax_prefetch: int = 2
+    dax_scale_out_burn: float = 2.0
+    dax_scale_in_burn: float = 0.5
+    dax_pressure_high: float = 0.9
+    dax_min_workers: int = 1
+    dax_max_workers: int = 8
+    dax_standby: int = 1
+    dax_reconcile_interval_s: float = 5.0
+    dax_cooldown_s: float = 30.0
+    dax_chase_lag: int = 8
+    dax_chase_rounds: int = 12
+
     def apply_kernel_setting(self):
         """Translate tpu_kernels into the Pallas dispatch env flag.
         'auto' (the default) leaves PILOSA_TPU_PALLAS untouched — a
@@ -459,6 +481,33 @@ class Config:
             scrub_replica_n=self.audit_scrub_replica_n,
             quarantine=self.audit_quarantine)
 
+    def apply_dax_settings(self):
+        """Push the [dax]/[blob] stanzas into dax/settings.py.  The
+        PILOSA_TPU_DAX_BLOB env kill-switch outranks a default-True
+        config (same contract as apply_standing_settings); the other
+        knobs' env twins are re-read dynamically by the accessors."""
+        from pilosa_tpu.dax import settings as dax_settings
+        blob = self.dax_blob
+        if blob and "PILOSA_TPU_DAX_BLOB" in os.environ:
+            blob = None  # env kill-switch stays in charge
+        dax_settings.configure(
+            blob=blob,
+            backend=self.blob_backend,
+            root=self.blob_root,
+            lazy_hydrate=self.dax_lazy_hydrate,
+            worker_budget_bytes=self.dax_worker_budget_bytes,
+            prefetch=self.dax_prefetch,
+            scale_out_burn=self.dax_scale_out_burn,
+            scale_in_burn=self.dax_scale_in_burn,
+            pressure_high=self.dax_pressure_high,
+            min_workers=self.dax_min_workers,
+            max_workers=self.dax_max_workers,
+            standby=self.dax_standby,
+            reconcile_interval_s=self.dax_reconcile_interval_s,
+            cooldown_s=self.dax_cooldown_s,
+            chase_lag=self.dax_chase_lag,
+            chase_rounds=self.dax_chase_rounds)
+
     def apply_placement_settings(self):
         """Push the [cluster] serving-mesh knobs into the placement
         module (memory/placement.py).  Env twins
@@ -576,6 +625,22 @@ _TOML_KEYS = {
     "audit.scrub-standing-n": "audit_scrub_standing_n",
     "audit.scrub-replica-n": "audit_scrub_replica_n",
     "audit.quarantine": "audit_quarantine",
+    "blob.backend": "blob_backend",
+    "blob.root": "blob_root",
+    "dax.blob": "dax_blob",
+    "dax.lazy-hydrate": "dax_lazy_hydrate",
+    "dax.worker-budget-bytes": "dax_worker_budget_bytes",
+    "dax.prefetch": "dax_prefetch",
+    "dax.scale-out-burn": "dax_scale_out_burn",
+    "dax.scale-in-burn": "dax_scale_in_burn",
+    "dax.pressure-high": "dax_pressure_high",
+    "dax.min-workers": "dax_min_workers",
+    "dax.max-workers": "dax_max_workers",
+    "dax.standby": "dax_standby",
+    "dax.reconcile-interval-s": "dax_reconcile_interval_s",
+    "dax.cooldown-s": "dax_cooldown_s",
+    "dax.chase-lag": "dax_chase_lag",
+    "dax.chase-rounds": "dax_chase_rounds",
 }
 
 ENV_PREFIX = "PILOSA_TPU_"

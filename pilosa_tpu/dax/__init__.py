@@ -21,12 +21,27 @@ Components (reference files):
     Snapshotter — dax/snapshotter/snapshotter.go:24
 """
 
-from pilosa_tpu.dax.controller import Controller
-from pilosa_tpu.dax.computer import ComputeNode
-from pilosa_tpu.dax.directive import Directive
-from pilosa_tpu.dax.queryer import Queryer
-from pilosa_tpu.dax.snapshotter import Snapshotter
-from pilosa_tpu.dax.writelogger import WriteLogger
+# PEP 562 lazy re-exports: config application touches
+# pilosa_tpu.dax.settings on every server boot, and /debug/dax reads
+# the light registries — neither should drag the queryer/executor
+# stack in.  `from pilosa_tpu.dax import Controller` etc. keep
+# working exactly as the eager imports did.
+_EXPORTS = {
+    "Controller": "pilosa_tpu.dax.controller",
+    "ComputeNode": "pilosa_tpu.dax.computer",
+    "Directive": "pilosa_tpu.dax.directive",
+    "Queryer": "pilosa_tpu.dax.queryer",
+    "Snapshotter": "pilosa_tpu.dax.snapshotter",
+    "WriteLogger": "pilosa_tpu.dax.writelogger",
+}
 
-__all__ = ["Controller", "ComputeNode", "Directive", "Queryer",
-           "Snapshotter", "WriteLogger"]
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}")
+    import importlib
+    return getattr(importlib.import_module(mod), name)

@@ -252,6 +252,10 @@ class Queryer:
         groups = self._group_by_shard(cols)
         self.controller.add_shards(table, groups.keys())
         for shard, idxs in groups.items():
+            # a live migration may have this shard FENCED (ownership
+            # mid-flip): hold until the flip lands so the write goes
+            # to exactly one owner
+            self.controller.fence_wait(table, shard)
             _, uri = self.controller.worker_for(table, shard)
             body = {"table": table, "field": field, "shard": shard,
                     "cols": [int(cols[i]) for i in idxs]}
@@ -545,38 +549,75 @@ class Queryer:
         shipped = [(_sort_call_for_shipping(c) if c.name == "Sort"
                     else c) for c in q.calls]
         pql = "".join(c.to_pql() for c in shipped)
-        shards = sorted(self.controller.tables.get(table, ()))
-        # group shards by owning worker (ComputeNodes in the reference)
-        by_worker: dict[str, list[int]] = {}
-        uris: dict[str, str] = {}
-        for s in shards:
-            addr, uri = self.controller.worker_for(table, s)
-            by_worker.setdefault(addr, []).append(s)
-            uris[addr] = uri
+        from pilosa_tpu.cluster.client import RemoteError
         from pilosa_tpu.obs import faults, flight
         from pilosa_tpu.taskpool import Pool
 
-        def one(pool, addr):
-            with pool.blocked():  # RPC wait
-                faults.fire("dax-rpc", uris[addr])
-                t0 = time.perf_counter()
-                try:
-                    out = self._client.query_node(
-                        uris[addr], table, pql, by_worker[addr],
-                        idempotent=True)
-                    flight.note_attempt(addr,
-                                        time.perf_counter() - t0, "ok")
-                    return out
-                except Exception:
-                    flight.note_attempt(
-                        addr, time.perf_counter() - t0, "error")
-                    raise
+        # a live migration can flip a shard's owner between routing
+        # and worker execution; the ex-owner answers a typed 409
+        # (never a silent empty partial).  Only the CONFLICTED
+        # subset re-resolves ownership and retries — re-running the
+        # whole fan-out would re-race every other in-flight flip
+        # (a scale event moves many shards back to back), while the
+        # conflicted shards' own flip completes in bounded time.
+        remaining = sorted(self.controller.tables.get(table, ()))
+        partials: list = []
+        conflict: Exception | None = None
+        for attempt in range(8):
+            # group shards by owning worker (ComputeNodes in the
+            # reference)
+            by_worker: dict[str, list[int]] = {}
+            uris: dict[str, str] = {}
+            for s in remaining:
+                addr, uri = self.controller.worker_for(table, s)
+                by_worker.setdefault(addr, []).append(s)
+                uris[addr] = uri
 
-        # Pool.map settles every sibling RPC before re-raising the
-        # first failure (by worker order), so one worker dying fails
-        # only THIS query — never the pool or mid-flight siblings
-        outs = Pool(size=2).map(one, sorted(by_worker))
-        partials = [r["results"] for r in outs]
+            def one(pool, addr):
+                with pool.blocked():  # RPC wait
+                    faults.fire("dax-rpc", uris[addr])
+                    t0 = time.perf_counter()
+                    try:
+                        out = self._client.query_node(
+                            uris[addr], table, pql, by_worker[addr],
+                            idempotent=True)
+                        flight.note_attempt(
+                            addr, time.perf_counter() - t0, "ok")
+                        return ("ok", addr, out)
+                    except RemoteError as e:
+                        flight.note_attempt(
+                            addr, time.perf_counter() - t0, "error")
+                        if getattr(e, "status", None) == 409:
+                            return ("conflict", addr, e)
+                        raise
+                    except Exception:
+                        flight.note_attempt(
+                            addr, time.perf_counter() - t0, "error")
+                        raise
+
+            # Pool.map settles every sibling RPC before re-raising
+            # the first failure (by worker order), so one worker
+            # dying fails only THIS query — never the pool or
+            # mid-flight siblings
+            outs = Pool(size=2).map(one, sorted(by_worker))
+            partials.extend(o["results"] for st, _, o in outs
+                            if st == "ok")
+            conflicted = [(a, e) for st, a, e in outs
+                          if st == "conflict"]
+            if not conflicted:
+                remaining = []
+                break
+            conflict = conflicted[0][1]
+            remaining = sorted(
+                s for a, _ in conflicted for s in by_worker[a])
+            time.sleep(0.02 * (attempt + 1))
+        if remaining:
+            routes = {s: self.controller.worker_for(table, s)[0]
+                      for s in remaining}
+            raise RemoteError(
+                getattr(conflict, "status", 409),
+                f"ownership retries exhausted for {table}/shards "
+                f"{remaining} (now routed {routes}): {conflict}")
         if not partials:
             out = {"results": [_empty_result(c) for c in q.calls]}
         else:

@@ -126,6 +126,23 @@ class Schemar:
         self._tx(lambda db: db.execute(
             "DELETE FROM shard_jobs WHERE tbl=?", (table,)))
 
+    def save_kv(self, key: str, value: str):
+        """Generic durable controller state (placement overlay,
+        standby roster, admit order) — same write-through-per-
+        mutation contract as the named tables."""
+        self._tx(lambda db: db.execute(
+            "INSERT INTO kv (key, value) VALUES (?, ?) "
+            "ON CONFLICT(key) DO UPDATE SET value=excluded.value",
+            (key, value)))
+
+    def load_kv(self, key: str) -> str | None:
+        with self._lock:
+            if self._closed:
+                return None
+            row = self._db.execute(
+                "SELECT value FROM kv WHERE key=?", (key,)).fetchone()
+        return row[0] if row else None
+
     def save_worker_state(self, address: str, version: int,
                           pushed: str | None):
         self._tx(lambda db: db.execute(

@@ -4,12 +4,23 @@ Reference: dax/server/ — one binary can host any combination of the
 controller, queryer, and computer services; tests and small
 deployments run them all in-process (the test.Cluster analog for
 DAX).
+
+Two worker shapes coexist:
+
+- ``add_worker``: the seed's shared-storage worker (one WriteLogger +
+  Snapshotter directory for the fleet, eager shard loads) — the
+  legacy arrangement every pre-tier test runs.
+- ``add_blob_worker`` / ``add_standby``: the disaggregated shape — a
+  PRIVATE empty data dir per worker, all durable state in the blob
+  tier, lazy ledger-paged hydration.  ``start_autoscaler`` runs the
+  controller's reconcile loop over them.
 """
 
 from __future__ import annotations
 
 import os
 
+from pilosa_tpu.dax import settings
 from pilosa_tpu.dax.computer import ComputeNode
 from pilosa_tpu.dax.controller import Controller
 from pilosa_tpu.dax.queryer import Queryer
@@ -18,13 +29,28 @@ from pilosa_tpu.dax.snapshotter import Snapshotter
 from pilosa_tpu.dax.writelogger import WriteLogger
 
 
+def blob_from_settings(storage_dir: str):
+    """BlobStore per the [blob] stanza (None when no backend is
+    configured or the tier kill-switch is off)."""
+    from pilosa_tpu.storage.blob import BlobStore, make_backend
+    if not settings.blob_enabled():
+        return None
+    kind = settings.backend()
+    if not kind:
+        return None
+    root = settings.root() or os.path.join(storage_dir, "blob")
+    return BlobStore(make_backend(kind, root))
+
+
 class DAXService:
     """All three services over one shared storage directory."""
 
     def __init__(self, storage_dir: str, n_workers: int = 2,
-                 poll_interval: float = 0.5):
+                 poll_interval: float = 0.5, blob=None):
         self._storage_dir = storage_dir
         self._poll_interval = poll_interval
+        self.blob = blob if blob is not None \
+            else blob_from_settings(storage_dir)
         self.wl = WriteLogger(os.path.join(storage_dir, "writelog"))
         self.snaps = Snapshotter(os.path.join(storage_dir, "snapshots"))
         self.controller = Controller(
@@ -73,6 +99,7 @@ class DAXService:
         the schemar DB (the reference's controller restart: schema +
         job registry + directive versions survive in the SQL store).
         Workers keep serving throughout."""
+        self.controller.stop_reconciler()
         self.controller.stop_poller()
         self.controller._schemar.close()
         self.controller = Controller(
@@ -83,10 +110,55 @@ class DAXService:
         return self.controller
 
     def add_worker(self, address: str) -> ComputeNode:
-        w = ComputeNode(address, self.wl, self.snaps).open()
+        """Shared-storage worker (the seed arrangement).  When the
+        service has a blob tier, the worker writes through to it on
+        snapshot and hydrates lazily from it."""
+        w = ComputeNode(address, self.wl, self.snaps,
+                        blob=self.blob).open()
         self.workers.append(w)
         self.controller.register_worker(address, w.uri)
         return w
+
+    # -- the disaggregated shape ---------------------------------------
+
+    def _stateless_node(self, address: str,
+                        budget_bytes: int | None = None
+                        ) -> ComputeNode:
+        if self.blob is None:
+            raise RuntimeError(
+                "stateless workers need a blob tier (configure "
+                "[blob] backend or pass blob=)")
+        d = os.path.join(self._storage_dir, "workers", address)
+        w = ComputeNode(
+            address,
+            WriteLogger(os.path.join(d, "writelog")),
+            Snapshotter(os.path.join(d, "snapshots")),
+            blob=self.blob, lazy=True,
+            budget_bytes=budget_bytes).open()
+        self.workers.append(w)
+        return w
+
+    def add_blob_worker(self, address: str,
+                        budget_bytes: int | None = None
+                        ) -> ComputeNode:
+        """A stateless worker: boots with an EMPTY private data dir
+        and hydrates assigned shards from blob manifests on first
+        touch, paged through its own HBM-budget ledger."""
+        w = self._stateless_node(address, budget_bytes)
+        self.controller.register_worker(address, w.uri)
+        return w
+
+    def add_standby(self, address: str,
+                    budget_bytes: int | None = None) -> ComputeNode:
+        """A warm spare the autoscaler can admit: boots, health-
+        checks, holds nothing until a scale-out."""
+        w = self._stateless_node(address, budget_bytes)
+        self.controller.register_standby(address, w.uri)
+        return w
+
+    def start_autoscaler(self, interval: float | None = None):
+        self.controller.start_reconciler(interval)
+        return self
 
     def kill_worker(self, address: str):
         """Fault injection: stop the worker WITHOUT deregistering —
@@ -103,6 +175,7 @@ class DAXService:
             except Exception:
                 pass
             self.queryer_front = None
+        self.controller.stop_reconciler()
         self.controller.stop_poller()
         for w in self.workers:
             try:

@@ -1,0 +1,155 @@
+"""[dax] / [blob] runtime knobs — config-pushed, env-twin-overridable.
+
+``config.apply_dax_settings()`` pushes the loaded stanzas here; the
+accessor functions re-check the env twins dynamically so the bench
+A/B levers (``PILOSA_TPU_DAX_BLOB=0`` above all) flip live without a
+config reload — the same contract every other plane's kill-switch
+keeps.  This module stays import-light (no dax package machinery) so
+config application never drags the queryer/executor in.
+"""
+
+from __future__ import annotations
+
+import os
+
+# config-pushed state (configure()); env twins outrank at read time
+_blob = True
+_backend = ""            # "" = no blob tier | "dir" | "mem"
+_root = ""               # dir-backend root ("" = <data-dir>/blob)
+_lazy_hydrate = True
+_worker_budget_bytes = 0  # 0 = unbounded (no per-worker ledger bound)
+_prefetch = 2            # shards warmed per hydrate tick (0 = off)
+_scale_out_burn = 2.0    # SLO burn rate tripping scale-out
+_scale_in_burn = 0.5     # burn rate under which scale-in may drain
+_pressure_high = 0.9     # worker ledger fill fraction tripping scale-out
+_min_workers = 1
+_max_workers = 8
+_standby = 1             # standby workers cli dax keeps warm
+_reconcile_interval_s = 5.0
+_cooldown_s = 30.0       # min seconds between scale events
+_chase_lag = 8           # hydrate-replay backlog under which FENCE starts
+_chase_rounds = 12       # bounded DELTA-CHASE rounds
+
+
+def configure(blob=None, backend=None, root=None, lazy_hydrate=None,
+              worker_budget_bytes=None, prefetch=None,
+              scale_out_burn=None, scale_in_burn=None,
+              pressure_high=None, min_workers=None, max_workers=None,
+              standby=None, reconcile_interval_s=None,
+              cooldown_s=None, chase_lag=None, chase_rounds=None):
+    """Apply the [dax]/[blob] config stanzas (None = leave as is)."""
+    g = globals()
+    for name, val in (("_blob", blob), ("_backend", backend),
+                      ("_root", root), ("_lazy_hydrate", lazy_hydrate),
+                      ("_worker_budget_bytes", worker_budget_bytes),
+                      ("_prefetch", prefetch),
+                      ("_scale_out_burn", scale_out_burn),
+                      ("_scale_in_burn", scale_in_burn),
+                      ("_pressure_high", pressure_high),
+                      ("_min_workers", min_workers),
+                      ("_max_workers", max_workers),
+                      ("_standby", standby),
+                      ("_reconcile_interval_s", reconcile_interval_s),
+                      ("_cooldown_s", cooldown_s),
+                      ("_chase_lag", chase_lag),
+                      ("_chase_rounds", chase_rounds)):
+        if val is not None:
+            g[name] = val
+
+
+def _env_float(name: str, fallback: float) -> float:
+    v = os.environ.get(name)
+    if v is None:
+        return fallback
+    try:
+        return float(v)
+    except ValueError:
+        return fallback
+
+
+def _env_int(name: str, fallback: int) -> int:
+    v = os.environ.get(name)
+    if v is None:
+        return fallback
+    try:
+        return int(v)
+    except ValueError:
+        return fallback
+
+
+def blob_enabled() -> bool:
+    """The tier kill-switch: PILOSA_TPU_DAX_BLOB=0 outranks any
+    config (the A/B lever) — off, workers fall back to the seed's
+    local-disk snapshot+log recovery, bit-exact."""
+    v = os.environ.get("PILOSA_TPU_DAX_BLOB")
+    if v is not None:
+        return v != "0"
+    return bool(_blob)
+
+
+def backend() -> str:
+    return os.environ.get("PILOSA_TPU_BLOB_BACKEND", _backend)
+
+
+def root() -> str:
+    return os.environ.get("PILOSA_TPU_BLOB_ROOT", _root)
+
+
+def lazy_hydrate() -> bool:
+    v = os.environ.get("PILOSA_TPU_DAX_LAZY_HYDRATE")
+    if v is not None:
+        return v != "0"
+    return bool(_lazy_hydrate)
+
+
+def worker_budget_bytes() -> int:
+    return _env_int("PILOSA_TPU_DAX_WORKER_BUDGET_BYTES",
+                    int(_worker_budget_bytes))
+
+
+def prefetch() -> int:
+    return _env_int("PILOSA_TPU_DAX_PREFETCH", int(_prefetch))
+
+
+def scale_out_burn() -> float:
+    return _env_float("PILOSA_TPU_DAX_SCALE_OUT_BURN",
+                      float(_scale_out_burn))
+
+
+def scale_in_burn() -> float:
+    return _env_float("PILOSA_TPU_DAX_SCALE_IN_BURN",
+                      float(_scale_in_burn))
+
+
+def pressure_high() -> float:
+    return _env_float("PILOSA_TPU_DAX_PRESSURE_HIGH",
+                      float(_pressure_high))
+
+
+def min_workers() -> int:
+    return _env_int("PILOSA_TPU_DAX_MIN_WORKERS", int(_min_workers))
+
+
+def max_workers() -> int:
+    return _env_int("PILOSA_TPU_DAX_MAX_WORKERS", int(_max_workers))
+
+
+def standby() -> int:
+    return _env_int("PILOSA_TPU_DAX_STANDBY", int(_standby))
+
+
+def reconcile_interval_s() -> float:
+    return _env_float("PILOSA_TPU_DAX_RECONCILE_INTERVAL_S",
+                      float(_reconcile_interval_s))
+
+
+def cooldown_s() -> float:
+    return _env_float("PILOSA_TPU_DAX_COOLDOWN_S", float(_cooldown_s))
+
+
+def chase_lag() -> int:
+    return _env_int("PILOSA_TPU_DAX_CHASE_LAG", int(_chase_lag))
+
+
+def chase_rounds() -> int:
+    return _env_int("PILOSA_TPU_DAX_CHASE_ROUNDS", int(_chase_rounds))

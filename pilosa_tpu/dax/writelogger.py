@@ -87,6 +87,23 @@ class WriteLogger:
         with self._lock:
             return self._version_locked(table, shard)
 
+    def fast_forward(self, table: str, shard: int, version: int):
+        """Advance a (possibly fresh) log to an absolute version the
+        blob tier already covers, so later appends continue the
+        global numbering instead of regressing below it.  Any local
+        entries at or below `version` are covered by definition and
+        dropped (stateless workers boot with an empty log, so this is
+        normally a pure base bump)."""
+        with self._lock:
+            cur = self._version_locked(table, shard)
+            if version <= cur:
+                return
+            p = self._log_path(table, shard)
+            with open(p, "w"):
+                pass
+            self._set_base(table, shard, version)
+            self._versions[(table, shard)] = version
+
     def truncate_through(self, table: str, shard: int, version: int):
         """Drop entries a snapshot at absolute `version` covers."""
         with self._lock:

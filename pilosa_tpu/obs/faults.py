@@ -72,6 +72,22 @@ Fault points wired through the stack (the point name is the contract;
                           the injection that PROVES the shadow/cache/
                           standing verifiers detect; armed only via the
                           test/config API like every other point
+``blob-unavailable``      Blob shard store (storage/blob.py): every backend
+                          op raises (detail: ``op:key``) — the tier
+                          degrades to typed 503s at the worker surface,
+                          never silent partial results
+``blob-torn-upload``      Blob put dies after writing HALF the object and
+                          BEFORE the manifest flip (detail: object key) —
+                          proves a torn upload is never visible to readers
+``worker-hydrate-crash``  ComputeNode hydration (dax/worker.py): die at
+                          the start of a shard hydrate (detail:
+                          ``addr:table/shard``) — no partial residency;
+                          the next touch restarts from the manifest
+``scale-event-interrupted``  Autoscaler migration (dax/controller.py):
+                          die between migration phases (detail:
+                          ``table/shard->addr:phase``) — an interrupted
+                          scale event rolls back its fence and the next
+                          reconcile resumes or completes the move
 ========================  ====================================================
 
 Arming:

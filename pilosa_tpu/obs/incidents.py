@@ -72,7 +72,8 @@ _STATS_PROFILES = 16
 
 TRIGGERS = ("slo-burn", "perf-regression", "watchdog-stall",
             "device-oom", "batch-leader-exception", "ingest-crash",
-            "audit-mismatch", "manual")
+            "audit-mismatch", "dax-scale-out", "dax-scale-in",
+            "manual")
 
 
 def format_stack(frame, max_frames: int = 64) -> str:

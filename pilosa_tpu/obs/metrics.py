@@ -645,3 +645,27 @@ STANDING_MAINTAIN_SECONDS = registry.histogram(
     buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
              1.0),
     quantiles=(0.5, 0.95, 0.99))
+
+# -- disaggregated DAX tier (storage/blob.py + dax/worker.py +
+# dax/controller.py reconcile loop) --
+DAX_HYDRATIONS = registry.counter(
+    "pilosa_dax_hydrations_total",
+    "Worker shard hydrations by outcome (full = restored and "
+    "retained under the ledger, transient = served without "
+    "retention after a ledger denial, replay = resident tail "
+    "replay, error = hydrate crashed and left the shard cold)")
+DAX_BLOB_BYTES = registry.counter(
+    "pilosa_dax_blob_bytes_total",
+    "Blob shard-store transfer bytes by op (get/put/delete), "
+    "manifests included")
+DAX_RESIDENT_SHARDS = registry.gauge(
+    "pilosa_dax_resident_shards",
+    "Shards currently materialized on a worker, per worker")
+DAX_COLD_SHARDS = registry.gauge(
+    "pilosa_dax_cold_shards",
+    "Shards assigned to a worker but not resident (hydrate on "
+    "first touch), per worker")
+DAX_SCALE_EVENTS = registry.counter(
+    "pilosa_dax_scale_events_total",
+    "Autoscaler decisions by direction (out/in) and outcome "
+    "(done/partial/failed/skipped)")

@@ -131,6 +131,10 @@ class Server:
         # shadow-execution sampler on the serving routes + the
         # maintenance-ticker scrubbers below
         config.apply_audit_settings()
+        # disaggregated DAX tier ([dax] + [blob]): blob shard store
+        # backend, lazy hydration + per-worker ledger budgets, and
+        # the autoscaler's scale thresholds (dax/settings.py)
+        config.apply_dax_settings()
         if (self.api.executor.serving is not None
                 and config.memory_prefetch):
             self.api.executor.serving.start_prefetcher(
@@ -382,6 +386,10 @@ class Server:
         # continuous correctness auditing (obs/audit.py): recent
         # samples, mismatch quarantine ring, scrub progress
         r(Route("GET", "/debug/audit", self._get_debug_audit))
+        # disaggregated DAX tier (dax/worker.py + dax/controller.py):
+        # worker roster with per-shard residency, placement overlay,
+        # and the autoscaler's last reconcile decision
+        r(Route("GET", "/debug/dax", self._get_debug_dax))
         r(Route("GET", "/internal/diagnostics", self._get_diagnostics))
         r(Route("GET", "/internal/perf-counters",
                 self._get_perf_counters))
@@ -853,6 +861,22 @@ class Server:
         srv = self.api.executor.serving
         return audit.payload(getattr(srv, "audit", None)
                              if srv is not None else None)
+
+    def _get_debug_dax(self, req):
+        """Disaggregated-tier state: every in-process worker's
+        residency (dax/worker.py) and every controller's roster +
+        last reconcile decision.  A plain cluster node answers with
+        empty rosters — only modules ALREADY imported are consulted,
+        so the debug sweep never drags the DAX stack in."""
+        import sys
+        payload: dict = {"workers": [], "controllers": []}
+        wmod = sys.modules.get("pilosa_tpu.dax.worker")
+        if wmod is not None:
+            payload["workers"] = wmod.hydrator_payloads()
+        cmod = sys.modules.get("pilosa_tpu.dax.controller")
+        if cmod is not None:
+            payload["controllers"] = cmod.controller_payloads()
+        return payload
 
     def _post_import_columns(self, req):
         """Binary columnar import — the wire form of

@@ -1,0 +1,785 @@
+"""Disaggregated DAX tier tests (ISSUE 20): blob shard store,
+stateless budget-paged workers, SLO-driven autoscaling.
+
+The property the whole suite pins: a worker booted with an EMPTY data
+dir, hydrating from blob manifests through a ledger 10x smaller than
+the corpus, answers every query bit-exact vs a local-disk node — and
+keeps doing so across scale-out, scale-in, and every drill in the
+fault matrix (blob-unavailable, blob-torn-upload,
+worker-hydrate-crash, scale-event-interrupted).
+"""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.dax import settings
+from pilosa_tpu.dax.server import DAXService
+from pilosa_tpu.dax.writelogger import WriteLogger
+from pilosa_tpu.obs import faults, incidents
+from pilosa_tpu.storage.blob import (
+    BlobError,
+    BlobStore,
+    LocalDirBackend,
+    MemBackend,
+    make_backend,
+)
+
+SHARD = 1 << 20
+
+SCHEMA = {"indexes": [{"name": "t", "fields": [
+    {"name": "f", "options": {"type": "set"}},
+    {"name": "v", "options": {"type": "int", "min": 0, "max": 1000}},
+]}]}
+
+# 24 shards: jump-hash actually splits table "t" across two workers
+# (with <=8 shards every one happens to land in bucket 0 of 2)
+N = 24
+
+_SIG = {"burn": 9.9, "pressure": {}, "shed": 0, "shed_delta": 0.0}
+
+
+@pytest.fixture(autouse=True)
+def _tier_env(monkeypatch):
+    """Deterministic knobs per test — via the env twins, because
+    every Server construction re-applies its config's [dax] stanza
+    over settings.configure() state.  Restore module state after."""
+    monkeypatch.setenv("PILOSA_TPU_DAX_PREFETCH", "0")
+    monkeypatch.setenv("PILOSA_TPU_DAX_COOLDOWN_S", "0")
+    monkeypatch.setenv("PILOSA_TPU_DAX_CHASE_LAG", "2")
+    monkeypatch.setenv("PILOSA_TPU_DAX_CHASE_ROUNDS", "4")
+    saved = {k: getattr(settings, k) for k in vars(settings)
+             if k.startswith("_") and not k.startswith("__")
+             and not callable(getattr(settings, k))}
+    yield
+    faults.clear()
+    for k, v in saved.items():
+        setattr(settings, k, v)
+
+
+def _seed(svc, n_shards=N):
+    svc.queryer.apply_schema(SCHEMA)
+    cols = [s * SHARD + 7 for s in range(n_shards)]
+    svc.queryer.import_bits("t", "f", [1] * n_shards, cols)
+    svc.queryer.import_values("t", "v", cols,
+                              [(s % 90) + 10 for s in range(n_shards)])
+    return cols
+
+
+def _checkpoint(svc):
+    """Push every held shard's state into the blob tier."""
+    for w in svc.workers:
+        for t, shards in list(w.held.items()):
+            for s in sorted(shards):
+                w.snapshot_shard(t, s)
+
+
+def _seal(svc):
+    for w in svc.workers:
+        for t, shards in list(w.held.items()):
+            for s in sorted(shards):
+                w.hyd.seal_tail(t, s)
+
+
+def _results(svc):
+    return {
+        "row1": svc.queryer.query("t", "Row(f=1)")
+        ["results"][0]["columns"],
+        "row2": svc.queryer.query("t", "Row(f=2)")
+        ["results"][0]["columns"],
+        "cnt": svc.queryer.query("t", "Count(Row(f=1))")["results"],
+        "sum": svc.queryer.query("t", "Sum(Row(f=1), field=v)")
+        ["results"][0],
+    }
+
+
+def _cold_service(tmp_path, name, blob, budget=None):
+    """A fresh service whose ONLY worker boots with an empty private
+    data dir — everything it serves must come from the blob tier."""
+    svc = DAXService(str(tmp_path / name), n_workers=0, blob=blob)
+    svc.queryer.apply_schema(SCHEMA)
+    svc.add_blob_worker(f"{name}-w0", budget_bytes=budget)
+    for t, s in blob.shards():
+        svc.controller.add_shards(t, [s])
+    return svc
+
+
+# ---------------------------------------------------------------------------
+# blob store
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(params=["mem", "dir"])
+def backend(request, tmp_path):
+    if request.param == "mem":
+        return MemBackend()
+    return LocalDirBackend(str(tmp_path / "blob"))
+
+
+def test_blob_store_roundtrip(backend):
+    store = BlobStore(backend)
+    assert store.manifest("t", 0) is None
+    assert store.covered_version("t", 0) == 0
+    assert store.restore("t", 0) is None
+
+    store.put_snapshot("t", 0, 5, b"snapshot-at-5")
+    assert store.covered_version("t", 0) == 5
+    store.put_segment("t", 0, 5, 8, b"entries-6-7-8")
+    assert store.covered_version("t", 0) == 8
+    # gapped seal rejected: the manifest never claims coverage it
+    # doesn't have
+    with pytest.raises(BlobError, match="gap"):
+        store.put_segment("t", 0, 9, 12, b"gap")
+    with pytest.raises(BlobError, match="empty"):
+        store.put_segment("t", 0, 8, 8, b"")
+    # stale snapshot (older than the manifest's) rejected
+    with pytest.raises(BlobError, match="stale"):
+        store.put_snapshot("t", 0, 4, b"old")
+
+    version, snap, segs = store.restore("t", 0)
+    assert (version, snap) == (8, b"snapshot-at-5")
+    assert segs == [(5, 8, b"entries-6-7-8")]
+
+    # a newer snapshot retires the segments it supersedes
+    store.put_snapshot("t", 0, 8, b"snapshot-at-8")
+    version, snap, segs = store.restore("t", 0)
+    assert (version, snap, segs) == (8, b"snapshot-at-8", [])
+
+    store.put_snapshot("t", 1, 2, b"other-shard")
+    assert store.shards() == [("t", 0), ("t", 1)]
+    store.delete_shard("t", 0)
+    assert store.shards() == [("t", 1)]
+    assert store.manifest("t", 0) is None
+
+
+def test_blob_torn_upload_never_visible(backend):
+    """An upload that dies after the data put but before the manifest
+    flip leaves the OLD manifest resolving old, complete objects."""
+    store = BlobStore(backend)
+    store.put_snapshot("t", 3, 10, b"good-snapshot-v10")
+    faults.inject("blob-torn-upload", times=1)
+    with pytest.raises(faults.InjectedFault):
+        store.put_snapshot("t", 3, 20, b"newer-snapshot-v20")
+    # reader sees the v10 world, checksum-intact
+    version, snap, segs = store.restore("t", 3)
+    assert (version, snap, segs) == (10, b"good-snapshot-v10", [])
+    assert store.covered_version("t", 3) == 10
+    # the retry (fault exhausted) completes the flip
+    store.put_snapshot("t", 3, 20, b"newer-snapshot-v20")
+    assert store.restore("t", 3)[:2] == (20, b"newer-snapshot-v20")
+
+
+def test_blob_checksum_mismatch_is_typed(tmp_path):
+    store = BlobStore(LocalDirBackend(str(tmp_path / "b")))
+    store.put_snapshot("t", 0, 1, b"the-real-bytes")
+    key = store.manifest("t", 0)["snapshot"]["key"]
+    # corrupt the object in place (bypassing the put path)
+    with open(os.path.join(str(tmp_path / "b"),
+                           *key.split("/")), "wb") as f:
+        f.write(b"bitrot")
+    with pytest.raises(BlobError, match="checksum mismatch"):
+        store.restore("t", 0)
+
+
+def test_localdir_backend_hygiene(tmp_path):
+    b = LocalDirBackend(str(tmp_path / "b"))
+    for bad in ("/etc/passwd", "~/x", "a/../../escape"):
+        with pytest.raises(BlobError, match="invalid object key"):
+            b.put(bad, b"x")
+    b.put("t/00000/obj", b"data")
+    # torn-put debris (.tmp) is never listable
+    with open(str(tmp_path / "b" / "t" / "00000" / "half.tmp"),
+              "wb") as f:
+        f.write(b"partial")
+    assert b.list() == ["t/00000/obj"]
+    with pytest.raises(BlobError, match="no such object"):
+        b.get("t/00000/missing")
+    with pytest.raises(BlobError):
+        make_backend("dir", None)
+    with pytest.raises(BlobError):
+        make_backend("s3", "/x")
+
+
+def test_blob_unavailable_fault_is_typed(backend):
+    from pilosa_tpu.storage.blob import BlobUnavailableError
+    store = BlobStore(backend)
+    store.put_snapshot("t", 0, 1, b"x")
+    faults.inject("blob-unavailable", times=1)
+    with pytest.raises(BlobUnavailableError):
+        store.manifest("t", 0)
+    assert store.covered_version("t", 0) == 1  # recovered
+
+
+# ---------------------------------------------------------------------------
+# stateless workers: cold start, paging, warming
+# ---------------------------------------------------------------------------
+
+def test_cold_start_bit_exact_10x_over_budget(tmp_path):
+    """The tentpole property: an empty-data-dir worker hydrating
+    snapshot+segments from blob through a ledger >=10x smaller than
+    the corpus answers bit-exact vs the local-disk fleet, paging
+    residency (evictions > 0, resident bytes never over budget)."""
+    blob = BlobStore(MemBackend())
+    src = DAXService(str(tmp_path / "src"), n_workers=2, blob=blob)
+    cols = _seed(src)
+    _checkpoint(src)                       # wave 1 -> snapshots
+    src.queryer.import_bits("t", "f", [2] * N,
+                            [c + 1 for c in cols])
+    _seal(src)                             # wave 2 -> WAL segments
+    oracle = _results(src)
+
+    # probe: unbounded cold worker measures the corpus and doubles as
+    # the blob-path bit-exactness check
+    probe = _cold_service(tmp_path, "probe", blob)
+    try:
+        assert _results(probe) == oracle
+        total = probe.workers[0].hyd.payload()["resident_bytes"]
+    finally:
+        probe.close()
+    budget = max(total // 12, 64)
+    assert total >= 10 * budget
+
+    cold = _cold_service(tmp_path, "cold", blob, budget=budget)
+    try:
+        assert _results(cold) == oracle
+        p = cold.workers[0].hyd.payload()
+        assert p["resident_bytes"] <= budget
+        assert p["evictions"] > 0
+        assert p["hydrations"] > N  # re-hydration = paging happened
+        assert p["pressure"] <= 1.0
+    finally:
+        cold.close()
+        src.close()
+
+
+def test_cold_worker_writes_continue_blob_numbering(tmp_path):
+    """A write landing on a hydrated stateless worker appends to its
+    PRIVATE log at the blob's absolute version — sealing afterwards
+    extends the manifest instead of regressing it."""
+    blob = BlobStore(MemBackend())
+    src = DAXService(str(tmp_path / "src"), n_workers=1, blob=blob)
+    _seed(src, n_shards=2)
+    _checkpoint(src)
+    covered0 = blob.covered_version("t", 0)
+    assert covered0 > 0
+    src.close()
+
+    cold = _cold_service(tmp_path, "cold", blob)
+    try:
+        cold.queryer.import_bits("t", "f", [3], [7])
+        w = cold.workers[0]
+        assert w.wl.version("t", 0) == covered0 + 1
+        assert w.hyd.seal_tail("t", 0) == 1
+        assert blob.covered_version("t", 0) == covered0 + 1
+        r = cold.queryer.query("t", "Row(f=3)")
+        assert r["results"][0]["columns"] == [7]
+    finally:
+        cold.close()
+
+
+def test_prefetch_warms_cold_shards(tmp_path, monkeypatch):
+    """One touched shard kicks the warmer; the hottest still-cold
+    assigned shards hydrate in the background."""
+    import time
+    blob = BlobStore(MemBackend())
+    src = DAXService(str(tmp_path / "src"), n_workers=1, blob=blob)
+    _seed(src, n_shards=6)
+    _checkpoint(src)
+    src.close()
+    monkeypatch.setenv("PILOSA_TPU_DAX_PREFETCH", "3")
+    cold = _cold_service(tmp_path, "cold", blob)
+    try:
+        w = cold.workers[0]
+        from pilosa_tpu.cluster.client import InternalClient
+        InternalClient()._request(
+            w.uri, "POST", "/index/t/query",
+            {"query": "Count(Row(f=1))", "shards": [0]})
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline \
+                and len(w.hyd._resident) < 1 + 3:
+            time.sleep(0.02)
+        assert len(w.hyd._resident) >= 1 + 3
+    finally:
+        cold.close()
+
+
+def test_kill_switch_ab_bit_exact(tmp_path, monkeypatch):
+    """PILOSA_TPU_DAX_BLOB=0 drops workers back to local-disk
+    snapshot+log hydration; results match the blob path bit-exact."""
+    blob = BlobStore(MemBackend())
+    svc = DAXService(str(tmp_path / "svc"), n_workers=2, blob=blob)
+    try:
+        _seed(svc, n_shards=6)
+        _checkpoint(svc)
+        on = _results(svc)
+
+        def evict_all():
+            for w in svc.workers:
+                for t, shards in list(w.held.items()):
+                    for s in sorted(shards):
+                        with w._lock:
+                            w.hyd.release(t, s)
+                            w.held[t].add(s)  # still assigned
+
+        monkeypatch.setenv("PILOSA_TPU_DAX_BLOB", "0")
+        assert not settings.blob_enabled()
+        evict_all()
+        assert _results(svc) == on      # local-disk arm
+        monkeypatch.delenv("PILOSA_TPU_DAX_BLOB")
+        assert settings.blob_enabled()
+        evict_all()
+        assert _results(svc) == on      # blob arm
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# fault matrix: hydration crash / blob outage
+# ---------------------------------------------------------------------------
+
+def test_blob_unavailable_query_typed_503(tmp_path):
+    """Blob outage during cold hydration surfaces as a typed 503 on
+    the query path — degraded, never a silent partial result — and
+    clears with the outage."""
+    from pilosa_tpu.cluster.client import RemoteError
+    blob = BlobStore(MemBackend())
+    src = DAXService(str(tmp_path / "src"), n_workers=1, blob=blob)
+    _seed(src, n_shards=4)
+    _checkpoint(src)
+    oracle = _results(src)
+    src.close()
+    cold = _cold_service(tmp_path, "cold", blob)
+    try:
+        faults.inject("blob-unavailable", times=0)  # unlimited
+        with pytest.raises(RemoteError) as ei:
+            cold.queryer.query("t", "Count(Row(f=1))")
+        assert ei.value.status == 503
+        assert "blob tier unavailable" in str(ei.value)
+        faults.clear("blob-unavailable")
+        assert _results(cold) == oracle
+    finally:
+        cold.close()
+
+
+def test_worker_hydrate_crash_leaves_shard_cold(tmp_path):
+    """A crash mid-hydrate leaves NO partial residency: the query
+    fails, the shard stays cold, the next touch hydrates clean."""
+    from pilosa_tpu.cluster.client import RemoteError
+    blob = BlobStore(MemBackend())
+    src = DAXService(str(tmp_path / "src"), n_workers=1, blob=blob)
+    _seed(src, n_shards=4)
+    _checkpoint(src)
+    oracle = _results(src)
+    src.close()
+    cold = _cold_service(tmp_path, "cold", blob)
+    try:
+        w = cold.workers[0]
+        faults.inject("worker-hydrate-crash", times=1)
+        with pytest.raises(RemoteError):
+            cold.queryer.query("t", "Count(Row(f=1))")
+        assert not w.hyd._resident        # nothing half-loaded
+        assert _results(cold) == oracle   # retry succeeds
+    finally:
+        cold.close()
+
+
+def test_query_for_unheld_shard_is_typed_409(tmp_path):
+    """A read naming a shard the worker doesn't hold (a migration
+    flip raced the queryer's routing) answers a typed 409 — never a
+    silent empty partial computed over released fragments.  The
+    queryer re-resolves ownership and retries on that signal, so
+    front-door reads stay exact."""
+    from pilosa_tpu.cluster.client import InternalClient, RemoteError
+    blob = BlobStore(MemBackend())
+    src = DAXService(str(tmp_path / "src"), n_workers=1, blob=blob)
+    try:
+        _seed(src, n_shards=4)
+        w = src.workers[0]
+        with pytest.raises(RemoteError) as ei:
+            InternalClient()._request(
+                w.uri, "POST", "/index/t/query",
+                {"query": "Count(Row(f=1))", "shards": [2, 99]})
+        assert ei.value.status == 409
+        assert "does not hold" in str(ei.value)
+        # held shards still answer; the front stays exact throughout
+        assert src.queryer.query(
+            "t", "Count(Row(f=1))")["results"] == [4]
+    finally:
+        src.close()
+
+
+def test_directive_release_drains_inflight_readers(tmp_path):
+    """A directive revoking a shard DRAINS registered in-flight reads
+    before freeing the fragments (the rebalance plane's RELEASE
+    discipline): an admitted read completes over intact data instead
+    of racing the release into a torn answer.  New reads for the
+    revoked shard 409 at entry meanwhile — `held` drops first."""
+    import threading
+    import time
+
+    from pilosa_tpu.dax.directive import Directive
+    blob = BlobStore(MemBackend())
+    src = DAXService(str(tmp_path / "src"), n_workers=1, blob=blob)
+    try:
+        _seed(src, n_shards=4)
+        w = src.workers[0]
+        key = ("t", 2)
+        with w._lock:  # register a reader like _post_query_hydrated
+            w._shard_readers[key] = 1
+        applied = threading.Event()
+
+        def revoke():
+            w.apply_directive(Directive(
+                address=w.address, version=w.directive_version + 1,
+                assignments={"t": [0, 1, 3]}))
+            applied.set()
+
+        th = threading.Thread(target=revoke, daemon=True)
+        th.start()
+        time.sleep(0.3)
+        # the drain holds the release while the reader is registered:
+        # no epoch bump, fragments intact — but held already dropped,
+        # so a NEW read for the shard is refused at entry
+        assert not applied.is_set()
+        assert w._release_epoch.get(key, 0) == 0
+        assert 2 not in w.held.get("t", set())
+        with w._lock:  # the reader finishes: deregister + notify
+            del w._shard_readers[key]
+            w._readers_cv.notify_all()
+        th.join(10)
+        assert applied.is_set()
+        assert w._release_epoch.get(key, 0) == 1
+    finally:
+        src.close()
+
+
+def test_import_blob_outage_rejects_write_typed(tmp_path):
+    """A write that can't hydrate its baseline is REJECTED 503 — not
+    applied to a half-restored shard."""
+    from pilosa_tpu.cluster.client import RemoteError
+    blob = BlobStore(MemBackend())
+    src = DAXService(str(tmp_path / "src"), n_workers=1, blob=blob)
+    _seed(src, n_shards=2)
+    _checkpoint(src)
+    src.close()
+    cold = _cold_service(tmp_path, "cold", blob)
+    try:
+        faults.inject("blob-unavailable", times=0)
+        with pytest.raises(RemoteError) as ei:
+            cold.queryer.import_bits("t", "f", [9], [3])
+        assert ei.value.status == 503
+        faults.clear("blob-unavailable")
+        cold.queryer.import_bits("t", "f", [9], [3])
+        r = cold.queryer.query("t", "Row(f=9)")
+        assert r["results"][0]["columns"] == [3]
+    finally:
+        cold.close()
+
+
+# ---------------------------------------------------------------------------
+# autoscaling: live scale-out / scale-in, interruption drills
+# ---------------------------------------------------------------------------
+
+def _blob_fleet(tmp_path, standbys=1):
+    blob = BlobStore(MemBackend())
+    svc = DAXService(str(tmp_path / "fleet"), n_workers=0, blob=blob)
+    svc.add_blob_worker("w0")
+    for i in range(standbys):
+        svc.add_standby(f"s{i}")
+    return svc
+
+
+def test_scale_out_then_in_storm_bit_exact(tmp_path):
+    """Read/write storm across a full scale cycle: standby admitted
+    live (shards migrate through COPY/CHASE/FENCE/flip), writes land
+    mid-cycle, drain returns the worker to the pool — every query
+    bit-exact vs a cold oracle, no leaked fences."""
+    incidents.get().clear()
+    svc = _blob_fleet(tmp_path)
+    try:
+        cols = _seed(svc)
+        _checkpoint(svc)
+        before = _results(svc)
+
+        d = svc.controller._scale_out(dict(_SIG))
+        assert d["outcome"] == "done"
+        assert sorted(svc.controller.workers) == ["s0", "w0"]
+        moved = [k for k, v in d["outcomes"].items() if v == "done"]
+        assert len(moved) >= 5            # 24 shards actually split
+        assert svc.controller._fences == {}
+        assert _results(svc) == before
+
+        # writes land on the NEW owners
+        svc.queryer.import_bits("t", "f", [2] * N,
+                                [c + 1 for c in cols])
+        after_w = _results(svc)
+        assert after_w["row2"] == [c + 1 for c in cols]
+
+        d = svc.controller._scale_in(dict(_SIG))
+        assert d["outcome"] == "done"
+        assert sorted(svc.controller.workers) == ["w0"]
+        assert "s0" in svc.controller.standbys
+        assert svc.controller._fences == {}
+        assert _results(svc) == after_w
+
+        # the scale events left incident bundles with the move plans
+        assert incidents.get().wait_idle(30)
+        got = {b["trigger"]: b
+               for b in incidents.get().payload()["incidents"]}
+        assert {"dax-scale-out", "dax-scale-in"} <= set(got)
+        out_bundle = incidents.get().fetch(got["dax-scale-out"]["id"])
+        ctx = out_bundle["context"]
+        assert ctx["admitted"] == "s0"
+        assert ctx["plan"] and all(v in ("done", "noop")
+                                   for v in ctx["outcomes"].values())
+    finally:
+        svc.close()
+
+
+def test_interrupted_scale_out_resumes(tmp_path):
+    """A migration killed mid-event leaves a resumable overlay: the
+    next reconcile finishes exactly the remaining moves."""
+    svc = _blob_fleet(tmp_path)
+    try:
+        _seed(svc)
+        _checkpoint(svc)
+        before = _results(svc)
+        faults.inject("scale-event-interrupted", times=1)
+        d = svc.controller._scale_out(dict(_SIG))
+        assert d["outcome"] == "partial"
+        assert svc.controller._fences == {}   # fence never leaks
+        assert _results(svc) == before        # donor still serves
+
+        d2 = svc.controller.reconcile_once()
+        assert d2["action"] == "resume"
+        assert all(v in ("done", "noop")
+                   for v in d2["outcomes"].values())
+        assert svc.controller._pending_moves_locked() == []
+        assert _results(svc) == before
+    finally:
+        svc.close()
+
+
+def test_interrupted_scale_in_resumes_drain(tmp_path):
+    """A drain killed mid-event keeps the draining worker in the
+    roster (still owning its unmigrated shards); the next reconcile
+    resumes THE DRAIN rather than rebalancing back onto it."""
+    svc = _blob_fleet(tmp_path)
+    try:
+        _seed(svc)
+        _checkpoint(svc)
+        assert svc.controller._scale_out(dict(_SIG))["outcome"] \
+            == "done"
+        before = _results(svc)
+
+        faults.inject("scale-event-interrupted", times=1)
+        d = svc.controller._scale_in(dict(_SIG))
+        assert d["outcome"] == "partial"
+        assert sorted(svc.controller.workers) == ["s0", "w0"]
+        assert svc.controller._draining == "s0"
+        assert _results(svc) == before
+
+        d2 = svc.controller.reconcile_once()
+        assert d2["action"] == "resume-drain"
+        assert d2["outcome"] == "done"
+        assert sorted(svc.controller.workers) == ["w0"]
+        assert svc.controller._draining is None
+        assert svc.controller._fences == {}
+        assert _results(svc) == before
+    finally:
+        svc.close()
+
+
+def test_reconcile_thresholds_drive_scaling(tmp_path, monkeypatch):
+    """The reconcile loop's decisions follow the burn signal through
+    the configured thresholds: high burn admits the standby, calm
+    burn drains it, cooldown gates back-to-back events."""
+    svc = _blob_fleet(tmp_path)
+    try:
+        _seed(svc, n_shards=8)
+        _checkpoint(svc)
+        burn = {"v": 0.0}
+        monkeypatch.setattr(
+            svc.controller, "signals",
+            lambda: dict(_SIG, burn=burn["v"]))
+
+        assert svc.controller.reconcile_once()["action"] == "none"
+        burn["v"] = 5.0                   # > scale_out_burn (2.0)
+        d = svc.controller.reconcile_once()
+        assert d["action"] == "scale-out"
+        assert sorted(svc.controller.workers) == ["s0", "w0"]
+
+        monkeypatch.setenv("PILOSA_TPU_DAX_COOLDOWN_S", "3600")
+        burn["v"] = 0.0                   # <= scale_in_burn
+        assert svc.controller.reconcile_once()["action"] == "none"
+        monkeypatch.setenv("PILOSA_TPU_DAX_COOLDOWN_S", "0")
+        d = svc.controller.reconcile_once()
+        assert d["action"] == "scale-in"
+        assert sorted(svc.controller.workers) == ["w0"]
+        assert svc.controller.last_reconcile["action"] == "scale-in"
+    finally:
+        svc.close()
+
+
+def test_scale_state_survives_controller_restart(tmp_path):
+    """Overlay pins, admitted list and a mid-drain marker persist in
+    the schemar: a restarted controller resumes the interrupted
+    event instead of forgetting it."""
+    svc = _blob_fleet(tmp_path)
+    try:
+        _seed(svc)
+        _checkpoint(svc)
+        faults.inject("scale-event-interrupted", times=1)
+        assert svc.controller._scale_out(dict(_SIG))["outcome"] \
+            == "partial"
+        pend = svc.controller._pending_moves_locked()
+        assert pend
+
+        svc.restart_controller()
+        for w in svc.workers:  # re-register live workers
+            svc.controller.register_worker(w.address, w.uri)
+        assert svc.controller._pending_moves_locked() == pend
+        d = svc.controller.reconcile_once()
+        assert d["action"] == "resume"
+        assert svc.controller._pending_moves_locked() == []
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# surfaces: /debug/dax, /dax/residency, metrics
+# ---------------------------------------------------------------------------
+
+def test_debug_dax_surface(tmp_path):
+    blob = BlobStore(MemBackend())
+    src = DAXService(str(tmp_path / "src"), n_workers=0, blob=blob)
+    try:
+        # unique address: /debug/dax lists every live hydrator in the
+        # process, and prior tests' "worker0" may not be GC'd yet
+        src.add_worker("dbg-w0")
+        _seed(src, n_shards=4)
+        _checkpoint(src)
+        w = src.workers[0]
+        with urllib.request.urlopen(
+                f"http://{w.uri}/debug/dax", timeout=10) as r:
+            body = json.loads(r.read())
+        assert {"workers", "controllers"} <= set(body)
+        mine = [p for p in body["workers"]
+                if p["worker"] == w.address]
+        assert mine and mine[0]["resident"]
+        assert mine[0]["assigned"]["t"] == [0, 1, 2, 3]
+        with urllib.request.urlopen(
+                f"http://{w.uri}/dax/residency", timeout=10) as r:
+            res = json.loads(r.read())
+        assert res["worker"] == w.address
+        assert res["blob"] is True
+    finally:
+        src.close()
+
+
+def test_dax_metrics_move(tmp_path):
+    from pilosa_tpu.obs import metrics
+    blob = BlobStore(MemBackend())
+    put0 = metrics.DAX_BLOB_BYTES.total(op="put")
+    hyd0 = metrics.DAX_HYDRATIONS.total()
+    src = DAXService(str(tmp_path / "src"), n_workers=1, blob=blob)
+    try:
+        _seed(src, n_shards=2)
+        _checkpoint(src)
+        assert metrics.DAX_BLOB_BYTES.total(op="put") > put0
+        assert metrics.DAX_HYDRATIONS.total() > hyd0
+        assert metrics.DAX_RESIDENT_SHARDS.value(
+            worker=src.workers[0].address) == 2
+    finally:
+        src.close()
+
+
+# ---------------------------------------------------------------------------
+# config plumbing
+# ---------------------------------------------------------------------------
+
+def test_dax_config_stanzas_and_env_twins(tmp_path, monkeypatch):
+    from pilosa_tpu import config as cfg
+    c = cfg.load()
+    assert (c.dax_blob, c.blob_backend, c.dax_max_workers) \
+        == (True, "", 8)
+    p = tmp_path / "server.toml"
+    p.write_text("""
+[blob]
+backend = "dir"
+root = "/data/blob"
+
+[dax]
+worker-budget-bytes = 4096
+scale-out-burn = 3.5
+max-workers = 4
+lazy-hydrate = false
+""")
+    c = cfg.load(str(p))
+    assert c.blob_backend == "dir"
+    assert c.blob_root == "/data/blob"
+    assert c.dax_worker_budget_bytes == 4096
+    assert c.dax_scale_out_burn == 3.5
+    assert c.dax_max_workers == 4
+    assert c.dax_lazy_hydrate is False
+    # env twins outrank the file
+    monkeypatch.setenv("PILOSA_TPU_DAX_MAX_WORKERS", "6")
+    assert cfg.load(str(p)).dax_max_workers == 6
+    # apply pushes into the live settings module
+    c.apply_dax_settings()
+    assert settings.backend() == "dir"
+    assert settings.worker_budget_bytes() == 4096
+    assert settings.scale_out_burn() == 3.5
+    assert not settings.lazy_hydrate()
+    # ...whose accessors re-read their own env twins dynamically
+    monkeypatch.setenv("PILOSA_TPU_DAX_SCALE_OUT_BURN", "7.25")
+    assert settings.scale_out_burn() == 7.25
+
+
+def test_kill_switch_outranks_config(monkeypatch):
+    from pilosa_tpu import config as cfg
+    monkeypatch.setenv("PILOSA_TPU_DAX_BLOB", "0")
+    c = cfg.load()
+    c.dax_blob = True
+    c.apply_dax_settings()
+    assert not settings.blob_enabled()
+    monkeypatch.delenv("PILOSA_TPU_DAX_BLOB")
+    assert settings.blob_enabled()
+
+
+def test_generate_config_has_dax_stanzas():
+    from pilosa_tpu.cli.main import DEFAULT_CONFIG
+    assert "[dax]" in DEFAULT_CONFIG
+    assert "[blob]" in DEFAULT_CONFIG
+    assert "worker-budget-bytes" in DEFAULT_CONFIG
+    assert "scale-out-burn" in DEFAULT_CONFIG
+
+
+def test_blob_from_settings_respects_switch(tmp_path, monkeypatch):
+    from pilosa_tpu.dax.server import blob_from_settings
+    assert blob_from_settings(str(tmp_path)) is None  # no backend
+    settings.configure(backend="dir", root="")
+    b = blob_from_settings(str(tmp_path))
+    assert b is not None
+    assert b.backend.root == os.path.join(str(tmp_path), "blob")
+    monkeypatch.setenv("PILOSA_TPU_DAX_BLOB", "0")
+    assert blob_from_settings(str(tmp_path)) is None
+
+
+# ---------------------------------------------------------------------------
+# writelogger fast-forward
+# ---------------------------------------------------------------------------
+
+def test_writelogger_fast_forward(tmp_path):
+    wl = WriteLogger(str(tmp_path / "wl"))
+    wl.append("t", 0, {"op": "bits", "rows": [1], "cols": [2]})
+    wl.append("t", 0, {"op": "bits", "rows": [1], "cols": [3]})
+    wl.fast_forward("t", 0, 10)
+    assert wl.version("t", 0) == 10
+    assert wl.replay("t", 0, from_version=0) == []
+    v = wl.append("t", 0, {"op": "bits", "rows": [1], "cols": [4]})
+    assert v == 11
+    assert len(wl.replay("t", 0, from_version=10)) == 1
+    wl.fast_forward("t", 0, 5)           # never regresses
+    assert wl.version("t", 0) == 11
